@@ -1,0 +1,34 @@
+"""Test harness configuration.
+
+All tests run on a virtual 8-device CPU backend so multi-chip sharding
+(psum/shard_map paths) is exercised without TPU hardware, per SURVEY.md §5.
+The axon sitecustomize force-selects the TPU platform via jax.config, so we
+must override `jax_platforms` in-process *before* the first backend use —
+env vars alone are not enough.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

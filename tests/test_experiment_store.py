@@ -1,0 +1,109 @@
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import StoreError
+from tmlibrary_tpu.models.experiment import grid_experiment
+from tmlibrary_tpu.models.store import ExperimentStore
+
+
+@pytest.fixture
+def experiment():
+    return grid_experiment(
+        name="t",
+        well_rows=2,
+        well_cols=2,
+        sites_per_well=(2, 2),
+        channel_names=("DAPI", "GFP"),
+        site_shape=(32, 32),
+    )
+
+
+def test_manifest_roundtrip(tmp_path, experiment):
+    path = tmp_path / "manifest.json"
+    experiment.save(path)
+    loaded = type(experiment).load(path)
+    assert loaded == experiment
+    assert loaded.n_sites == 16
+    assert loaded.n_channels == 2
+
+
+def test_well_names():
+    exp = grid_experiment(well_rows=3, well_cols=12)
+    names = {w.name for p in exp.plates for w in p.wells}
+    assert "A01" in names and "C12" in names
+
+
+def test_site_enumeration_order(experiment):
+    refs = list(experiment.sites())
+    assert len(refs) == 16
+    # canonical order: wells row-major, sites row-major within well
+    assert refs[0].as_tuple() == ("plate00", 0, 0, 0, 0)
+    assert refs[1].as_tuple() == ("plate00", 0, 0, 0, 1)
+    assert refs[4].as_tuple() == ("plate00", 0, 1, 0, 0)
+
+
+def test_store_pixel_roundtrip(tmp_path, experiment, rng):
+    store = ExperimentStore.create(tmp_path / "exp", experiment)
+    pixels = rng.integers(0, 65535, size=(16, 32, 32), dtype=np.uint16)
+    store.write_sites(pixels, list(range(16)), channel=0)
+    got = store.read_sites(list(range(16)), channel=0)
+    np.testing.assert_array_equal(got, pixels)
+    # partial batch read
+    got2 = store.read_sites([3, 7, 11], channel=0)
+    np.testing.assert_array_equal(got2, pixels[[3, 7, 11]])
+
+
+def test_store_reopen(tmp_path, experiment, rng):
+    store = ExperimentStore.create(tmp_path / "exp", experiment)
+    pixels = rng.integers(0, 100, size=(4, 32, 32), dtype=np.uint16)
+    store.write_sites(pixels, [0, 1, 2, 3], channel=1)
+    store2 = ExperimentStore.open(tmp_path / "exp")
+    assert store2.experiment == experiment
+    np.testing.assert_array_equal(store2.read_sites([0, 1, 2, 3], channel=1), pixels)
+
+
+def test_store_missing_plane(tmp_path, experiment):
+    store = ExperimentStore.create(tmp_path / "exp", experiment)
+    with pytest.raises(StoreError):
+        store.read_sites([0], channel=0)
+
+
+def test_illumstats_roundtrip(tmp_path, experiment, rng):
+    store = ExperimentStore.create(tmp_path / "exp", experiment)
+    stats = {
+        "mean_log": rng.random((32, 32)).astype(np.float32),
+        "std_log": rng.random((32, 32)).astype(np.float32),
+        "n": np.asarray(16),
+    }
+    store.write_illumstats(stats, channel=0)
+    assert store.has_illumstats(channel=0)
+    got = store.read_illumstats(channel=0)
+    np.testing.assert_array_equal(got["mean_log"], stats["mean_log"])
+    assert int(got["n"]) == 16
+
+
+def test_labels_and_features(tmp_path, experiment, rng):
+    import pandas as pd
+
+    store = ExperimentStore.create(tmp_path / "exp", experiment)
+    labels = rng.integers(0, 5, size=(16, 32, 32)).astype(np.int32)
+    store.write_labels(labels, list(range(16)), "nuclei")
+    got = store.read_labels(None, "nuclei")
+    np.testing.assert_array_equal(got, labels)
+    assert store.list_objects() == ["nuclei"]
+
+    df = pd.DataFrame({"site": [0, 0], "label": [1, 2], "area": [10.0, 20.0]})
+    store.append_features("nuclei", df, shard="batch000")
+    # idempotent re-write of the same shard
+    store.append_features("nuclei", df, shard="batch000")
+    read = store.read_features("nuclei")
+    assert len(read) == 2
+
+
+def test_shifts_roundtrip(tmp_path, experiment):
+    store = ExperimentStore.create(tmp_path / "exp", experiment)
+    shifts = np.array([[1, -2]] * 16, dtype=np.int32)
+    store.write_shifts(shifts, cycle=1)
+    np.testing.assert_array_equal(store.read_shifts(1), shifts)
+    store.write_intersection({"top": 2, "bottom": 1, "left": 0, "right": 2})
+    assert store.read_intersection()["top"] == 2

@@ -1,0 +1,155 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.ops import image_ops
+from tmlibrary_tpu.ops.smooth import (
+    bilateral_smooth,
+    gaussian_smooth,
+    median_smooth,
+    uniform_smooth,
+)
+from tmlibrary_tpu.ops.threshold import (
+    otsu_value,
+    threshold_adaptive,
+    threshold_manual,
+    threshold_otsu,
+)
+
+
+@pytest.fixture
+def img(rng):
+    return rng.integers(0, 4096, size=(64, 64)).astype(np.float32)
+
+
+# ------------------------------------------------------------------ smoothing
+@pytest.mark.parametrize("sigma", [0.8, 1.5, 3.0])
+def test_gaussian_matches_scipy(img, sigma):
+    ours = np.asarray(gaussian_smooth(img, sigma))
+    theirs = ndi.gaussian_filter(img, sigma, mode="reflect")
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-2)
+
+
+@pytest.mark.parametrize("size", [3, 4, 7])
+def test_uniform_matches_scipy(img, size):
+    ours = np.asarray(uniform_smooth(img, size))
+    theirs = ndi.uniform_filter(img, size, mode="reflect")
+    np.testing.assert_allclose(ours, theirs, rtol=1e-4, atol=1e-2)
+
+
+@pytest.mark.parametrize("size", [3, 5])
+def test_median_matches_scipy(img, size):
+    ours = np.asarray(median_smooth(img, size))
+    theirs = ndi.median_filter(img, size, mode="reflect")
+    np.testing.assert_allclose(ours, theirs, atol=1e-3)
+
+
+def test_bilateral_preserves_edge():
+    img = np.zeros((32, 32), np.float32)
+    img[:, 16:] = 1000.0
+    out = np.asarray(bilateral_smooth(img, size=5, sigma_space=2.0, sigma_range=50.0))
+    # edge must stay sharp: values near the step keep their side's level
+    assert out[16, 14] < 100.0 and out[16, 18] > 900.0
+
+
+# ----------------------------------------------------------------- threshold
+def test_threshold_manual(img):
+    mask = np.asarray(threshold_manual(img, 2000))
+    np.testing.assert_array_equal(mask, img > 2000)
+
+
+def test_otsu_bimodal():
+    rng = np.random.default_rng(0)
+    lo = rng.normal(500, 50, size=(64, 64))
+    hi = rng.normal(3000, 100, size=(64, 64))
+    mix = np.where(rng.random((64, 64)) > 0.3, lo, hi).astype(np.float32)
+    t = float(otsu_value(mix))
+    # any cut separating the two populations is correct; otsu picks the
+    # first bin of the empty gap between modes
+    assert 600 < t < 2800
+    mask = np.asarray(threshold_otsu(mix))
+    np.testing.assert_array_equal(mask, mix > t)
+    # the cut must separate the populations almost perfectly (the hi
+    # population was drawn with p=0.3)
+    assert abs(mask.mean() - 0.3) < 0.02
+
+
+def test_threshold_adaptive_finds_local_objects():
+    # two blobs on a strong illumination gradient — global threshold fails,
+    # adaptive must find both
+    y, x = np.mgrid[0:128, 0:128]
+    gradient = x * 20.0
+    img = gradient.astype(np.float32)
+    img[20:30, 20:30] += 800
+    img[90:100, 90:100] += 800
+    mask = np.asarray(threshold_adaptive(img, method="mean", kernel_size=31, constant=100))
+    assert mask[25, 25] and mask[95, 95]
+    # background well away from blobs mostly off
+    assert mask[60:80, 30:50].mean() < 0.2
+
+
+# ------------------------------------------------------------------ image ops
+def test_shift_image_zero_fill():
+    img = jnp.arange(16.0).reshape(4, 4)
+    out = np.asarray(image_ops.shift_image(img, 1, -1))
+    assert out[0].sum() == 0  # first row blanked (shift down)
+    assert (out[:, -1] == 0).all()  # last col blanked (shift left)
+    # interior moved correctly: out[y, x] = img[y-1, x+1]
+    assert out[1, 0] == 1.0
+
+
+def test_align_shift_and_crop():
+    img = jnp.arange(36.0).reshape(6, 6)
+    out = np.asarray(image_ops.align(img, 1, 1, window=(1, 1, 1, 1)))
+    assert out.shape == (4, 4)
+    # out[y, x] = shifted[y+1, x+1] = img[y, x]
+    np.testing.assert_array_equal(out, np.arange(36.0).reshape(6, 6)[:4, :4])
+
+
+def test_clip_and_rescale(img):
+    clipped = np.asarray(image_ops.clip_values(img, 100, 2000))
+    assert clipped.min() >= 100 and clipped.max() <= 2000
+    scaled = np.asarray(image_ops.rescale(img, 100, 2000))
+    assert scaled.min() >= 0.0 and scaled.max() <= 1.0
+
+
+def test_extract_insert_roundtrip(img):
+    j = jnp.asarray(img)
+    patch = image_ops.extract(j, 8, 8, 16, 16)
+    np.testing.assert_array_equal(np.asarray(patch), img[8:24, 8:24])
+    out = image_ops.insert(jnp.zeros_like(j), patch, 8, 8)
+    np.testing.assert_array_equal(np.asarray(out)[8:24, 8:24], img[8:24, 8:24])
+    assert np.asarray(out)[:8].sum() == 0
+
+
+def test_pad(img):
+    out = np.asarray(image_ops.pad(jnp.asarray(img), 1, 2, 3, 4, value=7))
+    assert out.shape == (67, 71)
+    assert (out[0] == 7).all()
+
+
+def test_join_grid():
+    tiles = jnp.stack([jnp.full((4, 4), i, jnp.float32) for i in range(6)])
+    mosaic = np.asarray(image_ops.join_grid(tiles, 2, 3))
+    assert mosaic.shape == (8, 12)
+    assert mosaic[0, 0] == 0 and mosaic[0, 11] == 2
+    assert mosaic[7, 0] == 3 and mosaic[7, 11] == 5
+
+
+def test_correct_illumination_flattens_field(rng):
+    # synthetic vignetting: true signal * smooth field
+    y, x = np.mgrid[0:64, 0:64]
+    field = 0.5 + 0.5 * np.exp(-((y - 32) ** 2 + (x - 32) ** 2) / 800.0)
+    signal = rng.integers(500, 1000, size=(200, 64, 64)).astype(np.float32)
+    observed = signal * field[None]
+    log_obs = np.log10(1.0 + observed)
+    mean_log = log_obs.mean(axis=0)
+    std_log = log_obs.std(axis=0)
+    corrected = np.asarray(
+        image_ops.correct_illumination(observed[0], mean_log, std_log)
+    )
+    # corner vs center ratio should be far closer to 1 after correction
+    raw_ratio = observed[0][:8, :8].mean() / observed[0][28:36, 28:36].mean()
+    cor_ratio = corrected[:8, :8].mean() / corrected[28:36, 28:36].mean()
+    assert abs(cor_ratio - 1.0) < abs(raw_ratio - 1.0) * 0.3

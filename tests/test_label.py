@@ -1,0 +1,131 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.ops.label import (
+    areas_by_label,
+    binary_dilate,
+    binary_erode,
+    connected_components,
+    fill_holes,
+    filter_by_area,
+    label,
+    relabel_sequential,
+)
+
+
+def random_blobs(rng, shape=(96, 96), n=12, r=5):
+    img = np.zeros(shape, bool)
+    ys = rng.integers(r, shape[0] - r, n)
+    xs = rng.integers(r, shape[1] - r, n)
+    yy, xx = np.mgrid[0 : shape[0], 0 : shape[1]]
+    for y, x in zip(ys, xs):
+        img |= (yy - y) ** 2 + (xx - x) ** 2 <= r**2
+    return img
+
+
+@pytest.mark.parametrize("connectivity", [4, 8])
+def test_label_matches_scipy_bitwise(rng, connectivity):
+    mask = random_blobs(rng)
+    structure = (
+        ndi.generate_binary_structure(2, 1)
+        if connectivity == 4
+        else ndi.generate_binary_structure(2, 2)
+    )
+    expected, n_expected = ndi.label(mask, structure=structure)
+    labels, count = connected_components(jnp.asarray(mask), connectivity)
+    assert int(count) == n_expected
+    np.testing.assert_array_equal(np.asarray(labels), expected)
+
+
+def test_label_diagonal_connectivity():
+    mask = np.eye(8, dtype=bool)
+    labels4, n4 = connected_components(jnp.asarray(mask), 4)
+    labels8, n8 = connected_components(jnp.asarray(mask), 8)
+    assert int(n4) == 8  # each diagonal pixel isolated under 4-connectivity
+    assert int(n8) == 1
+
+
+def test_label_empty_and_full():
+    empty = jnp.zeros((16, 16), bool)
+    labels, n = connected_components(empty)
+    assert int(n) == 0 and int(jnp.max(labels)) == 0
+    full = jnp.ones((16, 16), bool)
+    labels, n = connected_components(full)
+    assert int(n) == 1 and np.all(np.asarray(labels) == 1)
+
+
+def test_label_snake():
+    # a long serpentine path stresses propagation depth (pointer jumping)
+    mask = np.zeros((32, 32), bool)
+    for row in range(0, 32, 2):
+        mask[row, :] = True
+        if row + 1 < 32:
+            mask[row + 1, 31 if (row // 2) % 2 == 0 else 0] = True
+    expected, n_expected = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    labels, count = connected_components(jnp.asarray(mask), 8)
+    assert int(count) == n_expected == 1
+    np.testing.assert_array_equal(np.asarray(labels), expected)
+
+
+def test_label_under_vmap(rng):
+    masks = np.stack([random_blobs(rng) for _ in range(4)])
+    fn = jax.jit(jax.vmap(lambda m: connected_components(m, 8)))
+    labels, counts = fn(jnp.asarray(masks))
+    for i in range(4):
+        exp, n = ndi.label(masks[i], ndi.generate_binary_structure(2, 2))
+        assert int(counts[i]) == n
+        np.testing.assert_array_equal(np.asarray(labels[i]), exp)
+
+
+def test_fill_holes_matches_scipy(rng):
+    mask = random_blobs(rng)
+    # punch holes
+    mask[20:24, 20:24] = True
+    ring = np.zeros_like(mask)
+    ring[40:50, 40:50] = True
+    ring[43:47, 43:47] = False
+    mask |= ring
+    ours = np.asarray(fill_holes(jnp.asarray(mask)))
+    theirs = ndi.binary_fill_holes(mask)
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_dilate_erode_match_scipy(rng):
+    mask = random_blobs(rng)
+    s8 = ndi.generate_binary_structure(2, 2)
+    np.testing.assert_array_equal(
+        np.asarray(binary_dilate(jnp.asarray(mask), 8)), ndi.binary_dilation(mask, s8)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(binary_erode(jnp.asarray(mask), 8)), ndi.binary_erosion(mask, s8)
+    )
+    s4 = ndi.generate_binary_structure(2, 1)
+    np.testing.assert_array_equal(
+        np.asarray(binary_dilate(jnp.asarray(mask), 4, iterations=2)),
+        ndi.binary_dilation(mask, s4, iterations=2),
+    )
+
+
+def test_areas_and_filter():
+    mask = np.zeros((32, 32), bool)
+    mask[1:3, 1:3] = True  # area 4
+    mask[10:20, 10:20] = True  # area 100
+    mask[25:28, 25:30] = True  # area 15
+    labels = label(jnp.asarray(mask), 8)
+    areas = np.asarray(areas_by_label(labels, max_objects=10))
+    assert sorted(a for a in areas if a > 0) == [4, 15, 100]
+    filtered = filter_by_area(labels, max_objects=10, min_area=10, max_area=50)
+    kept = np.unique(np.asarray(filtered))
+    assert list(kept) == [0, 1]  # only the area-15 object remains, renumbered
+    remaining_area = int((np.asarray(filtered) > 0).sum())
+    assert remaining_area == 15
+
+
+def test_relabel_sequential():
+    labels = jnp.asarray(np.array([[0, 1, 2], [3, 3, 0]], np.int32))
+    keep = jnp.asarray([True, False, True])
+    out = np.asarray(relabel_sequential(labels, keep))
+    np.testing.assert_array_equal(out, [[0, 1, 0], [2, 2, 0]])

@@ -1,0 +1,176 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.errors import PipelineDescriptionError, PipelineError
+from tmlibrary_tpu.jterator.description import PipelineDescription
+from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+
+def blob_image(rng, shape=(96, 96), n=8, r=6, level=3000.0):
+    img = rng.normal(200.0, 20.0, size=shape).astype(np.float32)
+    yy, xx = np.mgrid[0 : shape[0], 0 : shape[1]]
+    ys = rng.integers(r + 2, shape[0] - r - 2, n)
+    xs = rng.integers(r + 2, shape[1] - r - 2, n)
+    for y, x in zip(ys, xs):
+        img += level * np.exp(-((yy - y) ** 2 + (xx - x) ** 2) / (2 * (r / 2) ** 2))
+    return img
+
+
+PIPE = {
+    "description": "smooth + threshold + label (config 2)",
+    "input": {"channels": [{"name": "DAPI", "correct": False, "align": False}]},
+    "pipeline": [
+        {
+            "handles": {
+                "module": "smooth",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI"},
+                    {"name": "method", "type": "Character", "value": "gaussian"},
+                    {"name": "sigma", "type": "Numeric", "value": 1.5},
+                ],
+                "output": [
+                    {"name": "smoothed_image", "type": "IntensityImage", "key": "DAPI_smooth"}
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "threshold_otsu",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI_smooth"}
+                ],
+                "output": [{"name": "mask", "type": "BinaryImage", "key": "mask"}],
+            }
+        },
+        {
+            "handles": {
+                "module": "fill",
+                "input": [{"name": "mask", "type": "BinaryImage", "key": "mask"}],
+                "output": [{"name": "filled_mask", "type": "BinaryImage", "key": "mask_filled"}],
+            }
+        },
+        {
+            "handles": {
+                "module": "label",
+                "input": [{"name": "mask", "type": "BinaryImage", "key": "mask_filled"}],
+                "output": [{"name": "label_image", "type": "LabelImage", "key": "nuclei_labels"}],
+            }
+        },
+        {
+            "handles": {
+                "module": "register_objects",
+                "input": [
+                    {"name": "label_image", "type": "LabelImage", "key": "nuclei_labels"}
+                ],
+                "output": [
+                    {
+                        "name": "objects",
+                        "type": "SegmentedObjects",
+                        "key": "nuclei",
+                        "objects": "nuclei",
+                    }
+                ],
+            }
+        },
+    ],
+    "output": {"objects": [{"name": "nuclei", "as_polygons": True}]},
+}
+
+
+def test_description_parses_and_validates():
+    desc = PipelineDescription.from_dict(PIPE)
+    desc.validate()
+    assert [m.module for m in desc.modules] == [
+        "smooth",
+        "threshold_otsu",
+        "fill",
+        "label",
+        "register_objects",
+    ]
+
+
+def test_description_rejects_broken_dataflow():
+    bad = {
+        "input": {"channels": [{"name": "DAPI"}]},
+        "pipeline": [
+            {
+                "handles": {
+                    "module": "fill",
+                    "input": [{"name": "mask", "type": "BinaryImage", "key": "nope"}],
+                    "output": [
+                        {"name": "filled_mask", "type": "BinaryImage", "key": "out"}
+                    ],
+                }
+            }
+        ],
+    }
+    with pytest.raises(PipelineDescriptionError):
+        PipelineDescription.from_dict(bad).validate()
+
+
+def test_description_rejects_unregistered_output_objects():
+    bad = dict(PIPE, output={"objects": [{"name": "cells"}]})
+    with pytest.raises(PipelineDescriptionError):
+        PipelineDescription.from_dict(bad).validate()
+
+
+def test_site_fn_matches_scipy_reference(rng):
+    desc = PipelineDescription.from_dict(PIPE)
+    pipe = ImageAnalysisPipeline(desc, max_objects=64)
+    img = blob_image(rng)
+    result = pipe.build_site_fn()({"DAPI": jnp.asarray(img)})
+
+    # golden: same chain with scipy
+    sm = ndi.gaussian_filter(img, 1.5, mode="reflect")
+    # otsu on our fixed-bin histogram
+    from tmlibrary_tpu.ops.threshold import otsu_value
+
+    t = float(otsu_value(jnp.asarray(sm)))
+    mask = ndi.binary_fill_holes(sm > t)
+    expected, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+
+    assert int(result.counts["nuclei"]) == n
+    np.testing.assert_array_equal(np.asarray(result.objects["nuclei"]), expected)
+
+
+def test_batch_fn_vmaps_sites(rng):
+    desc = PipelineDescription.from_dict(PIPE)
+    pipe = ImageAnalysisPipeline(desc, max_objects=64)
+    batch = np.stack([blob_image(rng, n=4 + i) for i in range(3)])
+    fn = pipe.build_batch_fn()
+    result = fn({"DAPI": jnp.asarray(batch)}, {}, jnp.zeros((3, 2), jnp.int32))
+    assert result.objects["nuclei"].shape == (3, 96, 96)
+    assert result.counts["nuclei"].shape == (3,)
+    for i in range(3):
+        sm = ndi.gaussian_filter(batch[i], 1.5, mode="reflect")
+        from tmlibrary_tpu.ops.threshold import otsu_value
+
+        t = float(otsu_value(jnp.asarray(sm)))
+        mask = ndi.binary_fill_holes(sm > t)
+        _, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+        assert int(result.counts["nuclei"][i]) == n
+
+
+def test_missing_module_output_raises():
+    bad = {
+        "input": {"channels": [{"name": "DAPI"}]},
+        "pipeline": [
+            {
+                "handles": {
+                    "module": "smooth",
+                    "input": [
+                        {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI"}
+                    ],
+                    "output": [
+                        {"name": "wrong_name", "type": "IntensityImage", "key": "out"}
+                    ],
+                }
+            }
+        ],
+    }
+    desc = PipelineDescription.from_dict(bad)
+    pipe = ImageAnalysisPipeline(desc)
+    with pytest.raises(PipelineError):
+        pipe.build_site_fn()({"DAPI": jnp.zeros((8, 8))})

@@ -1,0 +1,123 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.ops.segment_primary import (
+    distance_transform_approx,
+    segment_primary,
+)
+from tmlibrary_tpu.ops.segment_secondary import (
+    expand_labels,
+    propagate_labels,
+    watershed_from_seeds,
+)
+
+
+def two_cells(shape=(64, 64)):
+    """Two bright nuclei inside two larger dim cells, touching in the middle."""
+    yy, xx = np.mgrid[0 : shape[0], 0 : shape[1]]
+    nuc = np.zeros(shape, np.float32)
+    cell = np.zeros(shape, np.float32)
+    for cy, cx in [(32, 20), (32, 44)]:
+        nuc += 4000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 4.0**2))
+        cell += 1500 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 10.0**2))
+    return nuc + 100, cell + nuc * 0.2 + 100
+
+
+def test_segment_primary_counts_blobs():
+    nuc, _ = two_cells()
+    labels, count = segment_primary(jnp.asarray(nuc), threshold_method="manual",
+                                    threshold_value=1000.0, smooth_sigma=1.0)
+    assert int(count) == 2
+    mask = ndi.gaussian_filter(nuc, 1.0, mode="reflect") > 1000
+    expected, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    np.testing.assert_array_equal(np.asarray(labels), expected)
+
+
+def test_segment_primary_size_filter():
+    img = np.full((64, 64), 100.0, np.float32)
+    img[4:6, 4:6] = 5000  # area 4 (+ smoothing halo)
+    img[20:40, 20:40] = 5000  # area 400
+    labels, count = segment_primary(
+        jnp.asarray(img), threshold_method="manual", threshold_value=2000.0,
+        smooth_sigma=0.0, min_area=50,
+    )
+    assert int(count) == 1
+    assert int((np.asarray(labels) > 0).sum()) == 400
+
+
+def test_propagate_fills_mask():
+    seeds = jnp.zeros((32, 32), jnp.int32).at[8, 8].set(1).at[24, 24].set(2)
+    mask = jnp.ones((32, 32), bool)
+    out = np.asarray(propagate_labels(seeds, mask))
+    assert set(np.unique(out)) == {1, 2}
+    assert out[8, 8] == 1 and out[24, 24] == 2
+
+
+def test_expand_labels_distance():
+    seeds = jnp.zeros((16, 16), jnp.int32).at[8, 8].set(3)
+    out = np.asarray(expand_labels(seeds, iterations=2))
+    assert out[8, 8] == 3 and out[6, 6] == 3 and out[8, 11] == 0
+
+
+def test_watershed_splits_touching_cells():
+    nuc, cell = two_cells()
+    seeds, n = segment_primary(
+        jnp.asarray(nuc), threshold_method="manual", threshold_value=1000.0
+    )
+    assert int(n) == 2
+    mask = cell > 300
+    labels = np.asarray(
+        watershed_from_seeds(jnp.asarray(cell), seeds, jnp.asarray(mask), n_levels=32)
+    )
+    # both seeds grew, cover most of the mask, and split near the midline
+    assert (labels == 1).sum() > 100 and (labels == 2).sum() > 100
+    covered = (labels > 0).sum() / mask.sum()
+    assert covered > 0.95
+    # left cell is label of left seed, right cell label of right seed
+    assert labels[32, 16] == labels[32, 20] == np.asarray(seeds)[32, 20]
+    assert labels[32, 48] == labels[32, 44] == np.asarray(seeds)[32, 44]
+    # border between the two regions sits near the intensity valley (x≈32)
+    border_x = [
+        x for x in range(64)
+        if labels[32, x] > 0 and x + 1 < 64 and labels[32, x + 1] > 0
+        and labels[32, x] != labels[32, x + 1]
+    ]
+    assert border_x and abs(border_x[0] - 32) <= 3
+
+
+def test_watershed_respects_mask():
+    seeds = jnp.zeros((32, 32), jnp.int32).at[16, 8].set(1)
+    mask = np.zeros((32, 32), bool)
+    mask[:, :16] = True  # wall at x=16
+    intensity = jnp.ones((32, 32), jnp.float32)
+    labels = np.asarray(watershed_from_seeds(intensity, seeds, jnp.asarray(mask)))
+    assert labels[:, 16:].sum() == 0
+    assert (labels[:, :16] == 1).all()
+
+
+def test_distance_transform_monotone():
+    mask = np.zeros((32, 32), bool)
+    mask[8:24, 8:24] = True
+    dist = np.asarray(distance_transform_approx(jnp.asarray(mask), max_distance=16))
+    assert dist[16, 16] == dist.max()
+    assert dist[8, 8] == 1.0  # corner pixel: eroded away after first ring
+    assert (dist[~mask] == 0).all()
+
+
+def test_segment_under_jit_vmap():
+    nuc, cell = two_cells()
+    batch_nuc = jnp.stack([jnp.asarray(nuc)] * 2)
+    batch_cell = jnp.stack([jnp.asarray(cell)] * 2)
+
+    @jax.jit
+    @jax.vmap
+    def run(n, c):
+        seeds, cnt = segment_primary(n, threshold_method="manual", threshold_value=1000.0)
+        cells = watershed_from_seeds(c, seeds, c > 300, n_levels=16)
+        return cnt, cells
+
+    cnt, cells = run(batch_nuc, batch_cell)
+    assert list(np.asarray(cnt)) == [2, 2]
+    assert np.asarray(cells).shape == (2, 64, 64)

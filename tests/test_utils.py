@@ -1,0 +1,46 @@
+import pytest
+
+from tmlibrary_tpu.utils import (
+    assert_type,
+    create_partitions,
+    flatten,
+    next_power_of_two,
+    pad_to,
+)
+
+
+def test_create_partitions_even():
+    assert create_partitions(list(range(6)), 2) == [[0, 1], [2, 3], [4, 5]]
+
+
+def test_create_partitions_ragged_tail():
+    assert create_partitions(list(range(5)), 2) == [[0, 1], [2, 3], [4]]
+
+
+def test_create_partitions_size_larger_than_items():
+    assert create_partitions([1, 2], 10) == [[1, 2]]
+
+
+def test_create_partitions_invalid_size():
+    with pytest.raises(ValueError):
+        create_partitions([1], 0)
+
+
+def test_flatten():
+    assert flatten([[1, 2], [3], []]) == [1, 2, 3]
+
+
+def test_assert_type():
+    assert_type(1, "x", int)
+    with pytest.raises(TypeError):
+        assert_type("a", "x", int, float)
+
+
+def test_pad_to():
+    assert pad_to([1, 2], 4, 0) == [1, 2, 0, 0]
+    with pytest.raises(ValueError):
+        pad_to([1, 2, 3], 2, 0)
+
+
+def test_next_power_of_two():
+    assert [next_power_of_two(n) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 16]

@@ -1,0 +1,15 @@
+"""tmlibrary_tpu — TPU-native high-throughput microscopy image analysis.
+
+A brand-new, TPU-first (JAX/XLA/Pallas/pjit) framework with the capabilities
+of the TissueMAPS backend library (reference: ``scottberry/TmLibrary``, see
+``SURVEY.md``): experiment ingest, illumination statistics (corilla),
+cycle alignment (align), pyramid tiling (illuminati), and the jterator
+per-site image-analysis pipeline (smooth → threshold → segment → measure),
+executed as fused JAX programs that ``vmap`` over acquisition sites and shard
+across a TPU mesh instead of fanning out cluster jobs via GC3Pie.
+"""
+
+from tmlibrary_tpu.version import __version__
+from tmlibrary_tpu.config import cfg, LibraryConfig
+
+__all__ = ["__version__", "cfg", "LibraryConfig"]

@@ -1,0 +1,55 @@
+"""Framework configuration.
+
+Reference parity: ``tmlib/config.py`` — the reference reads a ``tmaps.cfg``
+INI file (``LibraryConfig``) holding DB connection, storage paths and the
+cluster resource definition.  The TPU rebuild has no database and no cluster
+scheduler, so configuration shrinks to: storage root, device/mesh settings,
+and logging.  Values come from (highest priority first) explicit kwargs, the
+``TM_*`` environment, then defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from pathlib import Path
+
+
+@dataclasses.dataclass
+class LibraryConfig:
+    """Install-level configuration.
+
+    Attributes
+    ----------
+    storage_home:
+        Root directory under which experiment stores live
+        (reference analogue: ``tmaps.cfg`` ``storage_home``).
+    mesh_shape:
+        Default device mesh shape for multi-chip runs, as a dict of
+        axis name → size.  ``None`` means "one axis named 'sites' over all
+        visible devices".
+    compute_dtype:
+        dtype used for on-device pixel math (bfloat16 keeps the MXU busy;
+        float32 where numerics demand it, e.g. Welford accumulators).
+    """
+
+    storage_home: Path = dataclasses.field(
+        default_factory=lambda: Path(
+            os.environ.get("TM_STORAGE_HOME", os.path.expanduser("~/tm_storage"))
+        )
+    )
+    mesh_shape: dict | None = None
+    compute_dtype: str = dataclasses.field(
+        default_factory=lambda: os.environ.get("TM_COMPUTE_DTYPE", "float32")
+    )
+    verbosity: int = dataclasses.field(
+        default_factory=lambda: int(os.environ.get("TM_VERBOSITY", "0"))
+    )
+
+    def experiment_location(self, experiment_name: str) -> Path:
+        return Path(self.storage_home) / "experiments" / experiment_name
+
+
+#: Global default config instance, mirroring the reference's module-level
+#: ``tmlib.cfg``.
+cfg = LibraryConfig()

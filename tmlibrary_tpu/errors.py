@@ -1,0 +1,52 @@
+"""Exception hierarchy.
+
+Reference parity: ``tmlib/errors.py`` — the reference defines a small tree of
+library-specific errors (``MetadataError``, ``PipelineError``,
+``JobDescriptionError``, ``NotSupportedError``, ``RegistryError``).  We keep
+the same names so error-handling code written against the reference maps
+directly, and add TPU-rebuild-specific errors for the store and mesh layers.
+"""
+
+
+class TmError(Exception):
+    """Base class for all framework errors."""
+
+
+class MetadataError(TmError):
+    """Error in experiment/image metadata handling."""
+
+
+class PipelineError(TmError):
+    """Error in the jterator pipeline description or execution."""
+
+
+class PipelineDescriptionError(PipelineError):
+    """Invalid ``.pipe`` pipeline description."""
+
+
+class HandleError(PipelineError):
+    """Invalid module handle description or binding."""
+
+
+class JobDescriptionError(TmError):
+    """Error in a batch/job description."""
+
+
+class NotSupportedError(TmError):
+    """Requested feature is not supported."""
+
+
+class RegistryError(TmError):
+    """Error looking up a registered step/module/tool."""
+
+
+class StoreError(TmError):
+    """Error in the array/feature store layer."""
+
+
+class WorkflowError(TmError):
+    """Error in workflow orchestration (stage/step DAG, ledger, resume)."""
+
+
+class ShardingError(TmError):
+    """Error constructing or using a device mesh / sharding."""

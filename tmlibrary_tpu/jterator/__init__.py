@@ -1,0 +1,21 @@
+"""jterator: the per-site image-analysis pipeline engine.
+
+Reference parity: ``tmlib/workflow/jterator/`` — pipeline description
+(``.pipe.yaml``), typed module handles (``handles/*.handles.yaml``), the
+module registry, and ``ImageAnalysisPipeline`` (the hot path per
+BASELINE.json).
+
+TPU design: the module chain compiles into ONE jitted program; the site axis
+is a ``vmap`` batch dimension; the batch axis shards over the device mesh
+(see :mod:`tmlibrary_tpu.parallel`).  Where the reference spawns a GC3Pie job
+per site batch and runs modules as separate Python calls, here the whole
+pipeline is a single fused XLA computation per batch.
+"""
+
+from tmlibrary_tpu.jterator.description import (
+    HandleDescriptions,
+    PipelineDescription,
+)
+from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+__all__ = ["PipelineDescription", "HandleDescriptions", "ImageAnalysisPipeline"]

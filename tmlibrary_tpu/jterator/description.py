@@ -1,0 +1,149 @@
+"""Pipeline and handle descriptions (YAML).
+
+Reference parity: ``tmlib/workflow/jterator/description.py`` and
+``project.py`` — ``PipelineDescription`` (the ``.pipe.yaml`` file: input
+channels/objects, ordered module chain, output objects) and
+``HandleDescriptions`` (one ``handles/*.handles.yaml`` per module instance).
+The YAML schema keeps the reference's shape so existing pipeline projects
+translate mechanically::
+
+    # my.pipe.yaml
+    description: Cell Painting segment+measure
+    input:
+      channels:
+        - {name: DAPI, correct: true, align: false}
+        - {name: Actin, correct: true, align: false}
+    pipeline:
+      - {handles: handles/smooth.handles.yaml, active: true}
+      - {handles: handles/segment.handles.yaml, active: true}
+    output:
+      objects:
+        - {name: nuclei, as_polygons: true}
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+import yaml
+
+from tmlibrary_tpu.errors import PipelineDescriptionError
+from tmlibrary_tpu.jterator.handles import HandleCollection
+
+
+@dataclasses.dataclass(frozen=True)
+class ChannelInput:
+    name: str
+    correct: bool = True
+    align: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ObjectInput:
+    """A previously-segmented object type loaded from the store."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ObjectOutput:
+    name: str
+    as_polygons: bool = True
+
+
+@dataclasses.dataclass
+class PipelineDescription:
+    """Parsed ``.pipe.yaml`` plus its resolved handle collections."""
+
+    description: str
+    channels: list[ChannelInput]
+    objects_in: list[ObjectInput]
+    modules: list[HandleCollection]
+    objects_out: list[ObjectOutput]
+
+    @classmethod
+    def from_dict(cls, d: dict, base_dir: Path | None = None) -> "PipelineDescription":
+        inp = d.get("input", {}) or {}
+        channels = [
+            ChannelInput(
+                name=c["name"],
+                correct=bool(c.get("correct", True)),
+                align=bool(c.get("align", False)),
+            )
+            for c in inp.get("channels", []) or []
+        ]
+        objects_in = [ObjectInput(name=o["name"]) for o in inp.get("objects", []) or []]
+        modules: list[HandleCollection] = []
+        for item in d.get("pipeline", []) or []:
+            if not item.get("active", True):
+                continue
+            if "handles" in item and isinstance(item["handles"], str):
+                if base_dir is None:
+                    raise PipelineDescriptionError(
+                        "handles given as a path but no base_dir provided"
+                    )
+                hpath = base_dir / item["handles"]
+                if not hpath.exists():
+                    raise PipelineDescriptionError(f"handles file missing: {hpath}")
+                hd = yaml.safe_load(hpath.read_text())
+            elif "handles" in item:
+                hd = item["handles"]  # inline dict (convenient for tests)
+            else:
+                raise PipelineDescriptionError("pipeline item needs 'handles'")
+            modules.append(HandleCollection.from_dict(hd))
+        out = d.get("output", {}) or {}
+        objects_out = [
+            ObjectOutput(name=o["name"], as_polygons=bool(o.get("as_polygons", True)))
+            for o in out.get("objects", []) or []
+        ]
+        if not modules:
+            raise PipelineDescriptionError("pipeline has no active modules")
+        return cls(
+            description=d.get("description", ""),
+            channels=channels,
+            objects_in=objects_in,
+            modules=modules,
+            objects_out=objects_out,
+        )
+
+    @classmethod
+    def load(cls, pipe_path: Path) -> "PipelineDescription":
+        pipe_path = Path(pipe_path)
+        d = yaml.safe_load(pipe_path.read_text())
+        return cls.from_dict(d, base_dir=pipe_path.parent)
+
+    def validate(self) -> None:
+        """Check store-key dataflow: every module input key must be produced
+        by an earlier module or be an input channel/object (the reference
+        validates the same invariant when building a pipeline)."""
+        available = {c.name for c in self.channels} | {o.name for o in self.objects_in}
+        for mod in self.modules:
+            for name, key in mod.array_inputs().items():
+                if key not in available:
+                    raise PipelineDescriptionError(
+                        f"module '{mod.module}' input '{name}' reads key "
+                        f"'{key}' which no upstream produces "
+                        f"(available: {sorted(available)})"
+                    )
+            for h in mod.output:
+                if h.key:
+                    available.add(h.key)
+                if h.type == "SegmentedObjects" and h.objects:
+                    # downstream modules may read registered objects by name
+                    available.add(h.objects)
+        produced_objects = {
+            h.objects
+            for mod in self.modules
+            for h in mod.output
+            if h.type == "SegmentedObjects"
+        }
+        for obj in self.objects_out:
+            if obj.name not in produced_objects:
+                raise PipelineDescriptionError(
+                    f"output objects '{obj.name}' never registered by any module"
+                )
+
+
+# alias matching the reference's class name for the per-module YAML
+HandleDescriptions = HandleCollection

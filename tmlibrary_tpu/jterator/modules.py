@@ -1,0 +1,289 @@
+"""Module registry and the TPU ("jtmodules twin") implementations.
+
+Reference parity: the external ``jtmodules`` package (one file per module,
+each exposing ``main()`` + ``VERSION``) and
+``tmlib/workflow/jterator/module.py`` (``ImageAnalysisModule`` import/bind/
+call machinery).  The reference dispatches by module source path and
+supports Python/Matlab/R; here modules register under a name + ``backend``
+key (``backend: tpu`` per BASELINE's north star) and must be jit/vmap-safe
+JAX functions.  Matlab/R bridges are out of scope (SURVEY.md §8 non-goals).
+
+Module contract: ``fn(**kwargs) -> dict`` mapping output-handle names to
+arrays (or, for ``Measurement`` outputs, to ``{feature_name: (max_objects,)
+array}`` dicts).  Array kwargs are traced; everything else is a static
+compile-time constant from the handle description.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from tmlibrary_tpu.errors import RegistryError
+from tmlibrary_tpu.ops import label as label_ops
+from tmlibrary_tpu.ops import smooth as smooth_ops
+from tmlibrary_tpu.ops import threshold as threshold_ops
+
+#: name -> backend -> (fn, version)
+_REGISTRY: dict[str, dict[str, tuple[Callable, str]]] = {}
+
+
+def register_module(name: str, version: str = "0.1.0", backend: str = "tpu"):
+    def deco(fn: Callable) -> Callable:
+        _REGISTRY.setdefault(name, {})[backend] = (fn, version)
+        return fn
+
+    return deco
+
+
+def get_module(name: str, backend: str = "tpu") -> Callable:
+    try:
+        return _REGISTRY[name][backend][0]
+    except KeyError:
+        have = {n: list(b) for n, b in _REGISTRY.items()}
+        raise RegistryError(
+            f"no module '{name}' for backend '{backend}' (registered: {have})"
+        ) from None
+
+
+def get_module_version(name: str, backend: str = "tpu") -> str:
+    return _REGISTRY[name][backend][1]
+
+
+def list_modules(backend: str | None = None) -> list[str]:
+    if backend is None:
+        return sorted(_REGISTRY)
+    return sorted(n for n, b in _REGISTRY.items() if backend in b)
+
+
+def module_accepts(name: str, backend: str, kwarg: str) -> bool:
+    fn = get_module(name, backend)
+    params = inspect.signature(fn).parameters
+    return kwarg in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
+
+
+# --------------------------------------------------------------------------
+# module implementations (jtmodules twins)
+# --------------------------------------------------------------------------
+
+
+@register_module("smooth")
+def smooth(intensity_image, method: str = "gaussian", sigma: float = 2.0, size: int = 3):
+    """Smoothing (reference ``jtmodules/smooth.py``): gaussian | median |
+    average | bilateral."""
+    if method == "gaussian":
+        out = smooth_ops.gaussian_smooth(intensity_image, sigma)
+    elif method == "median":
+        out = smooth_ops.median_smooth(intensity_image, size)
+    elif method == "average":
+        out = smooth_ops.uniform_smooth(intensity_image, size)
+    elif method == "bilateral":
+        out = smooth_ops.bilateral_smooth(intensity_image, size=size, sigma_space=sigma)
+    else:
+        raise ValueError(f"unknown smooth method '{method}'")
+    return {"smoothed_image": out}
+
+
+@register_module("threshold_manual")
+def threshold_manual(intensity_image, threshold: float = 0.0):
+    """Reference ``jtmodules/threshold_manual.py``."""
+    return {"mask": threshold_ops.threshold_manual(intensity_image, threshold)}
+
+
+@register_module("threshold_otsu")
+def threshold_otsu(intensity_image, correction_factor: float = 1.0, bins: int = 256):
+    """Reference ``jtmodules/threshold_otsu.py``."""
+    return {
+        "mask": threshold_ops.threshold_otsu(
+            intensity_image, bins=bins, correction_factor=correction_factor
+        )
+    }
+
+
+@register_module("threshold_adaptive")
+def threshold_adaptive(
+    intensity_image,
+    method: str = "gaussian",
+    kernel_size: int = 31,
+    constant: float = 0.0,
+    min_threshold: float | None = None,
+    max_threshold: float | None = None,
+):
+    """Reference ``jtmodules/threshold_adaptive.py``."""
+    return {
+        "mask": threshold_ops.threshold_adaptive(
+            intensity_image,
+            method=method,
+            kernel_size=kernel_size,
+            constant=constant,
+            min_threshold=min_threshold,
+            max_threshold=max_threshold,
+        )
+    }
+
+
+@register_module("label")
+def label(mask, connectivity: int = 8):
+    """Reference ``jtmodules/label.py``."""
+    return {"label_image": label_ops.label(mask, connectivity)}
+
+
+@register_module("fill")
+def fill(mask):
+    """Reference ``jtmodules/fill.py`` (fill holes in binary mask)."""
+    return {"filled_mask": label_ops.fill_holes(mask)}
+
+
+@register_module("filter")
+def filter_objects(
+    label_image,
+    feature: str = "area",
+    lower_threshold: float | None = None,
+    upper_threshold: float | None = None,
+    max_objects: int = 256,
+):
+    """Reference ``jtmodules/filter.py`` (remove objects by feature range;
+    v0 supports the 'area' feature, the overwhelmingly common use)."""
+    if feature != "area":
+        raise ValueError(f"filter feature '{feature}' not supported yet")
+    out = label_ops.filter_by_area(
+        label_image,
+        max_objects=max_objects,
+        min_area=int(lower_threshold or 0),
+        max_area=int(upper_threshold) if upper_threshold is not None else None,
+    )
+    return {"filtered_label_image": out}
+
+
+@register_module("register_objects")
+def register_objects(label_image):
+    """Reference ``jtmodules/register_objects.py``: promote a label image to
+    registered SegmentedObjects (persistence + measurement attachment)."""
+    return {"objects": jnp.asarray(label_image, jnp.int32)}
+
+
+@register_module("invert")
+def invert(image):
+    """Reference ``jtmodules/invert.py`` (invert intensities/mask)."""
+    img = jnp.asarray(image)
+    if img.dtype == jnp.bool_:
+        return {"inverted_image": ~img}
+    return {"inverted_image": jnp.max(img) - img}
+
+
+@register_module("rescale")
+def rescale(intensity_image, lower: float = 0.0, upper: float = 65535.0):
+    """Linear rescale to [0,1] (reference uses jtlib rescaling helpers)."""
+    from tmlibrary_tpu.ops import image_ops
+
+    return {"rescaled_image": image_ops.rescale(intensity_image, lower, upper)}
+
+
+@register_module("mask")
+def apply_mask(image, mask):
+    """Zero out pixels outside ``mask`` (reference ``jtmodules/mask.py``)."""
+    img = jnp.asarray(image)
+    return {"masked_image": jnp.where(jnp.asarray(mask, bool), img, jnp.zeros_like(img))}
+
+
+@register_module("combine_masks")
+def combine_masks(mask_1, mask_2, operation: str = "AND"):
+    """Reference ``jtmodules/combine_masks.py``."""
+    a = jnp.asarray(mask_1, bool)
+    b = jnp.asarray(mask_2, bool)
+    if operation.upper() == "AND":
+        return {"combined_mask": a & b}
+    if operation.upper() == "OR":
+        return {"combined_mask": a | b}
+    if operation.upper() == "XOR":
+        return {"combined_mask": a ^ b}
+    raise ValueError(f"unknown combine operation '{operation}'")
+
+
+@register_module("segment_primary")
+def segment_primary(
+    intensity_image,
+    threshold_method: str = "otsu",
+    threshold_value: float = 0.0,
+    correction_factor: float = 1.0,
+    kernel_size: int = 31,
+    constant: float = 0.0,
+    smooth_sigma: float = 1.0,
+    fill: bool = True,
+    min_area: int = 0,
+    max_area: int | None = None,
+    declump: bool = False,
+    declump_min_distance: int = 5,
+    max_objects: int = 256,
+):
+    """Reference ``jtmodules/segment_primary.py`` (nuclei)."""
+    from tmlibrary_tpu.ops.segment_primary import segment_primary as _sp
+
+    labels, _count = _sp(
+        intensity_image,
+        threshold_method=threshold_method,
+        threshold_value=threshold_value,
+        correction_factor=correction_factor,
+        kernel_size=kernel_size,
+        constant=constant,
+        smooth_sigma=smooth_sigma,
+        fill=fill,
+        min_area=min_area,
+        max_area=max_area,
+        declump=declump,
+        declump_min_distance=declump_min_distance,
+        max_objects=max_objects,
+    )
+    return {"objects": labels}
+
+
+@register_module("segment_secondary")
+def segment_secondary(
+    primary_label_image,
+    intensity_image,
+    method: str = "watershed",
+    threshold_method: str = "otsu",
+    threshold_value: float = 0.0,
+    correction_factor: float = 1.0,
+    n_levels: int = 32,
+):
+    """Reference ``jtmodules/segment_secondary.py`` (cells grown from
+    nuclei seeds, same label ids as seeds)."""
+    from tmlibrary_tpu.ops import threshold as _t
+    from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+
+    img = jnp.asarray(intensity_image, jnp.float32)
+    if threshold_method == "otsu":
+        mask = _t.threshold_otsu(img, correction_factor=correction_factor)
+    elif threshold_method == "manual":
+        mask = _t.threshold_manual(img, threshold_value)
+    else:
+        raise ValueError(f"unknown threshold method '{threshold_method}'")
+    if method != "watershed":
+        raise ValueError(f"unknown secondary method '{method}'")
+    labels = watershed_from_seeds(img, primary_label_image, mask, n_levels=n_levels)
+    return {"objects": labels}
+
+
+@register_module("expand_or_shrink")
+def expand_or_shrink(label_image, n: int = 1, max_objects: int = 256):
+    """Reference ``jtmodules/expand_or_shrink.py``: morphological expansion
+    (n>0) or shrinkage (n<0) of labeled objects.
+
+    Expansion assigns background pixels to the nearest label iteratively
+    (ties go to the larger label id via max-propagation, deterministic).
+    """
+    from tmlibrary_tpu.ops.segment_secondary import expand_labels
+
+    lab = jnp.asarray(label_image, jnp.int32)
+    if n == 0:
+        return {"expanded_image": lab}
+    if n > 0:
+        return {"expanded_image": expand_labels(lab, iterations=n)}
+    mask = lab > 0
+    eroded = label_ops.binary_erode(mask, connectivity=8, iterations=-n)
+    return {"expanded_image": jnp.where(eroded, lab, 0)}

@@ -1,0 +1,198 @@
+"""The jterator pipeline engine — THE hot path.
+
+Reference parity: ``tmlib/workflow/jterator/api.py``
+``ImageAnalysisPipeline.run_job`` (SURVEY.md §4.3): per site, load channel
+images (correct + align), run the module chain binding handles between a
+pipeline store, register segmented objects, collect measurements.
+
+TPU design (BASELINE north star): the whole module chain traces into ONE
+XLA program over a single site's channel dict; ``vmap`` adds the site-batch
+axis; ``jit`` fuses everything — smoothing, thresholding, labeling,
+watershed, measurement — into one device computation per batch.  Sites →
+vmap lanes; batches → mesh shards (see ``tmlibrary_tpu.parallel``).  Host
+work is only store IO and ragged exports (polygons, Parquet).
+
+Static-shape policy: object-indexed outputs are padded to ``max_objects``
+per site; measurement rows beyond the site's object count are garbage and
+masked on export using the returned counts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from tmlibrary_tpu.errors import PipelineError
+from tmlibrary_tpu.jterator import modules as module_registry
+from tmlibrary_tpu.jterator.description import PipelineDescription
+from tmlibrary_tpu.ops import image_ops
+
+
+@dataclasses.dataclass
+class SiteResult:
+    """Pytree of one site's (or one batch's, when vmapped) pipeline output."""
+
+    objects: dict[str, jax.Array]  # objects name -> (H, W) int32 labels
+    counts: dict[str, jax.Array]  # objects name -> scalar int32
+    measurements: dict[str, dict[str, jax.Array]]  # objects -> feature -> (M,)
+
+
+jax.tree_util.register_dataclass(
+    SiteResult, data_fields=["objects", "counts", "measurements"], meta_fields=[]
+)
+
+
+class ImageAnalysisPipeline:
+    """Compile a :class:`PipelineDescription` into batched device programs.
+
+    Parameters
+    ----------
+    description:
+        Parsed pipeline + handles.
+    max_objects:
+        Static per-site object capacity (measurement padding).
+    """
+
+    def __init__(self, description: PipelineDescription, max_objects: int = 256):
+        description.validate()
+        self.description = description
+        self.max_objects = max_objects
+        self._site_fn: Callable | None = None
+
+    # ------------------------------------------------------------- site fn
+    def build_site_fn(self) -> Callable[[dict[str, jax.Array]], SiteResult]:
+        """Pure function: {store key: (H, W) array} → :class:`SiteResult`."""
+        desc = self.description
+        max_objects = self.max_objects
+
+        def site_fn(initial_store: dict[str, jax.Array]) -> SiteResult:
+            store: dict[str, Any] = dict(initial_store)
+            objects: dict[str, jax.Array] = {}
+            measurements: dict[str, dict[str, jax.Array]] = {}
+
+            for mod in desc.modules:
+                fn = module_registry.get_module(mod.module, mod.backend)
+                kwargs = dict(mod.constants())
+                for kwname, key in mod.array_inputs().items():
+                    if key in store:
+                        kwargs[kwname] = store[key]
+                    elif key in objects:
+                        kwargs[kwname] = objects[key]
+                    else:
+                        raise PipelineError(
+                            f"module '{mod.module}' input key '{key}' missing"
+                        )
+                if "max_objects" not in kwargs and module_registry.module_accepts(
+                    mod.module, mod.backend, "max_objects"
+                ):
+                    kwargs["max_objects"] = max_objects
+                try:
+                    outs = fn(**kwargs)
+                except TypeError as e:
+                    raise PipelineError(
+                        f"module '{mod.module}' called with invalid arguments: {e}"
+                    ) from e
+                if not isinstance(outs, dict):
+                    raise PipelineError(
+                        f"module '{mod.module}' must return a dict of outputs"
+                    )
+
+                for h in mod.output:
+                    if h.type in ("Plot", "Figure"):
+                        continue
+                    if h.name not in outs:
+                        raise PipelineError(
+                            f"module '{mod.module}' did not return output "
+                            f"'{h.name}' (returned: {sorted(outs)})"
+                        )
+                    val = outs[h.name]
+                    if h.type == "SegmentedObjects":
+                        labels = jnp.asarray(val, jnp.int32)
+                        objects[h.objects] = labels
+                        if h.key:
+                            store[h.key] = labels
+                    elif h.type == "Measurement":
+                        if not isinstance(val, dict):
+                            raise PipelineError(
+                                f"measurement output '{h.name}' of "
+                                f"'{mod.module}' must be a dict of features"
+                            )
+                        tgt = measurements.setdefault(h.objects, {})
+                        for feat, arr in val.items():
+                            name = f"{feat}_{h.channel}" if h.channel else feat
+                            tgt[name] = jnp.asarray(arr, jnp.float32)
+                    else:
+                        store[h.key] = val
+
+            counts = {
+                name: jnp.max(lab).astype(jnp.int32) for name, lab in objects.items()
+            }
+            wanted = {o.name for o in desc.objects_out} or set(objects)
+            return SiteResult(
+                objects={k: v for k, v in objects.items() if k in wanted},
+                counts={k: v for k, v in counts.items() if k in wanted},
+                measurements={
+                    k: v for k, v in measurements.items() if k in wanted
+                },
+            )
+
+        return site_fn
+
+    # ------------------------------------------------------- preprocessing
+    def build_preprocess_fn(
+        self, window: tuple[int, int, int, int] | None = None
+    ) -> Callable:
+        """Per-site channel preprocessing: illumination correction + cycle
+        alignment (reference: ``ChannelImage.correct``/``align`` calls at the
+        top of ``run_job``'s site loop).
+
+        Returns ``fn(raw: dict, stats: dict, shift: (2,) array) -> dict``
+        where ``raw`` maps channel name → (H, W) uint16 and ``stats`` maps
+        channel name → (mean_log, std_log) pairs (absent = no correction).
+        """
+        desc = self.description
+
+        def preprocess(
+            raw: dict[str, jax.Array],
+            stats: dict[str, tuple[jax.Array, jax.Array]],
+            shift: jax.Array,
+        ) -> dict[str, jax.Array]:
+            out: dict[str, jax.Array] = {}
+            for ch in desc.channels:
+                img = jnp.asarray(raw[ch.name], jnp.float32)
+                if ch.correct and ch.name in stats:
+                    mean_log, std_log = stats[ch.name]
+                    img = image_ops.correct_illumination(img, mean_log, std_log)
+                if ch.align:
+                    img = image_ops.align(img, shift[0], shift[1], window)
+                out[ch.name] = img
+            return out
+
+        return preprocess
+
+    # ------------------------------------------------------------ batch fn
+    def build_batch_fn(
+        self, window: tuple[int, int, int, int] | None = None
+    ) -> Callable:
+        """jit(vmap(preprocess ∘ site_fn)) over the site-batch axis.
+
+        Signature: ``fn(raw: {ch: (B,H,W)}, stats: {ch: (mean,std)},
+        shifts: (B,2)) -> SiteResult`` with a leading batch axis on every
+        leaf.  ``stats`` fields broadcast (shared per channel).
+        """
+        site_fn = self.build_site_fn()
+        preprocess = self.build_preprocess_fn(window)
+
+        def one_site(raw, stats, shift):
+            images = preprocess(raw, stats, shift)
+            # pass loaded objects (if any) through untouched
+            for key, val in raw.items():
+                if key not in images:
+                    images[key] = val
+            return site_fn(images)
+
+        batched = jax.vmap(one_site, in_axes=(0, None, 0))
+        return jax.jit(batched)

@@ -1,0 +1,40 @@
+"""Logging configuration.
+
+Reference parity: ``tmlib/log.py`` — ``configure_logging`` plus
+``map_logging_verbosity`` translating a ``-v`` count into a logging level.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+#: verbosity count (number of ``-v`` flags) → logging level
+_VERBOSITY_TO_LEVEL = {
+    0: logging.WARNING,
+    1: logging.INFO,
+    2: logging.DEBUG,
+}
+
+FORMAT = "%(asctime)s | %(levelname)-8s | %(name)s | %(message)s"
+
+
+def map_logging_verbosity(verbosity: int) -> int:
+    """Map a ``-v`` flag count to a :mod:`logging` level.
+
+    Mirrors the reference's mapping: 0 → WARNING, 1 → INFO, ≥2 → DEBUG.
+    """
+    if verbosity < 0:
+        raise ValueError("verbosity must be non-negative")
+    return _VERBOSITY_TO_LEVEL.get(min(verbosity, 2), logging.DEBUG)
+
+
+def configure_logging(verbosity: int = 0, stream=None) -> logging.Logger:
+    """Configure the root framework logger and return it."""
+    logger = logging.getLogger("tmlibrary_tpu")
+    logger.setLevel(map_logging_verbosity(verbosity))
+    if not logger.handlers:
+        handler = logging.StreamHandler(stream or sys.stderr)
+        handler.setFormatter(logging.Formatter(FORMAT))
+        logger.addHandler(handler)
+    return logger

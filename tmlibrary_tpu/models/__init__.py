@@ -1,0 +1,27 @@
+"""Data layer.
+
+Reference parity: ``tmlib/models/`` — but **not a database**.  The reference
+stores experiment structure, mapobjects and features in PostgreSQL/Citus
+(SQLAlchemy ORM, PostGIS geometries, hstore feature values) and pixels on a
+shared filesystem.  The TPU rebuild replaces that with:
+
+- an **experiment manifest** (JSON): plate → well → site → channel / tpoint /
+  zplane axes (reference ``tmlib/models/{experiment,plate,well,site,channel}.py``),
+- a **pixel store**: chunked arrays on disk addressed by those axes
+  (reference ``tmlib/models/file.py`` ``ChannelImageFile``),
+- a **feature store**: Parquet tables (objects × features)
+  (reference ``tmlib/models/feature.py`` ``FeatureValues`` hstore),
+- a **segmentation store**: label arrays + host-extracted polygons
+  (reference ``tmlib/models/mapobject.py`` ``MapobjectSegmentation``).
+"""
+
+from tmlibrary_tpu.models.experiment import (
+    Channel,
+    Experiment,
+    Plate,
+    Site,
+    Well,
+)
+from tmlibrary_tpu.models.store import ExperimentStore
+
+__all__ = ["Channel", "Experiment", "Plate", "Site", "Well", "ExperimentStore"]

@@ -1,0 +1,12 @@
+"""Ops library: JAX twins of the reference's pixel-math stack.
+
+Reference parity map (see SURVEY.md §3):
+
+- ``jtmodules``/``jtlib`` (smooth, threshold, segment, measure, register) →
+  the modules in this package, all pure ``jnp``/``lax`` and jit/vmap-safe.
+- cv2 / mahotas / scipy.ndimage native kernels → XLA ops (separable convs,
+  window gathers, ``segment_sum`` reductions, one-hot matmul GLCMs), Pallas
+  where XLA's lowering is not enough.
+- host-only raggedness (polygon tracing, PNG encode) stays host-side in
+  :mod:`tmlibrary_tpu.ops.polygons`.
+"""

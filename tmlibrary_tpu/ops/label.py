@@ -1,0 +1,201 @@
+"""Connected-component labeling and binary morphology on TPU.
+
+Reference parity: ``jtmodules/label.py`` (mahotas/scipy connected components),
+``jtmodules/fill.py`` (binary hole filling), ``jtmodules/filter.py``
+(filter objects by feature) — all native-library calls in the reference.
+
+TPU design (SURVEY.md §8 "hard parts" #1): labeling is an iterative
+min-label propagation with **pointer jumping** inside ``lax.while_loop`` —
+each pixel carries the linear index of some pixel in its component; per
+iteration every pixel takes the min over its neighborhood, then follows its
+current label's label (path halving), so convergence is ~O(log diameter)
+rather than O(diameter).  All shapes static; ``vmap``-safe.
+
+Label order is **bit-identical to ``scipy.ndimage.label``**: the converged
+label of a component is its minimum linear index (= first pixel in row-major
+scan order), and compaction ranks roots by that index — exactly scipy's
+assignment order.  This is the acceptance gate from BASELINE.json
+("bit-identical object counts").
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+def _neighbor_shifts(connectivity: int) -> list[tuple[int, int]]:
+    if connectivity == 4:
+        return [(-1, 0), (1, 0), (0, -1), (0, 1)]
+    if connectivity == 8:
+        return [
+            (-1, -1), (-1, 0), (-1, 1),
+            (0, -1), (0, 1),
+            (1, -1), (1, 0), (1, 1),
+        ]
+    raise ValueError("connectivity must be 4 or 8")
+
+
+def _shift_with_fill(arr: jax.Array, dy: int, dx: int, fill) -> jax.Array:
+    """Shift a 2-D array by (dy, dx), filling exposed borders with ``fill``."""
+    h, w = arr.shape
+    padded = jnp.pad(arr, ((1, 1), (1, 1)), constant_values=fill)
+    return lax.dynamic_slice(padded, (1 + dy, 1 + dx), (h, w))
+
+
+def _propagate_min(labels: jax.Array, mask: jax.Array, shifts) -> jax.Array:
+    out = labels
+    for dy, dx in shifts:
+        neigh = _shift_with_fill(labels, dy, dx, _BIG)
+        out = jnp.minimum(out, neigh)
+    return jnp.where(mask, out, _BIG)
+
+
+def connected_components(
+    mask: jax.Array, connectivity: int = 8
+) -> tuple[jax.Array, jax.Array]:
+    """Label connected foreground components.
+
+    Returns ``(labels, count)``: int32 label image (0 = background, 1..N in
+    scipy scan order) and the scalar component count.
+    """
+    mask = jnp.asarray(mask, bool)
+    h, w = mask.shape
+    shifts = _neighbor_shifts(connectivity)
+    linear = jnp.arange(h * w, dtype=jnp.int32).reshape(h, w)
+    init = jnp.where(mask, linear, _BIG)
+
+    def cond(state):
+        labels, prev_changed = state
+        return prev_changed
+
+    def body(state):
+        labels, _ = state
+        new = _propagate_min(labels, mask, shifts)
+        # pointer jumping (path halving): follow label -> label's label.
+        # Background pixels hold _BIG; gather with a clipped index and
+        # re-mask so they stay _BIG.
+        flat = new.reshape(-1)
+        for _ in range(2):
+            idx = jnp.clip(flat, 0, h * w - 1)
+            flat = jnp.minimum(flat, jnp.where(flat < _BIG, flat[idx], _BIG))
+        new = jnp.where(mask, flat.reshape(h, w), _BIG)
+        changed = jnp.any(new != labels)
+        return new, changed
+
+    labels, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
+
+    # compact to 1..N in row-major order of component roots (scipy order)
+    is_root = mask & (labels == linear)
+    ranks = jnp.cumsum(is_root.reshape(-1).astype(jnp.int32))
+    count = ranks[-1]
+    root_rank = ranks.reshape(-1)[jnp.clip(labels.reshape(-1), 0, h * w - 1)]
+    out = jnp.where(mask, root_rank.reshape(h, w), 0).astype(jnp.int32)
+    return out, count
+
+
+def label(mask: jax.Array, connectivity: int = 8) -> jax.Array:
+    """Label image only (reference ``jtmodules/label.main``)."""
+    return connected_components(mask, connectivity)[0]
+
+
+# ------------------------------------------------------------ binary morphology
+def binary_dilate(mask: jax.Array, connectivity: int = 8, iterations: int = 1) -> jax.Array:
+    mask = jnp.asarray(mask, bool)
+    shifts = _neighbor_shifts(connectivity)
+    for _ in range(iterations):
+        out = mask
+        for dy, dx in shifts:
+            out = out | _shift_with_fill(mask, dy, dx, False)
+        mask = out
+    return mask
+
+
+def binary_erode(mask: jax.Array, connectivity: int = 8, iterations: int = 1) -> jax.Array:
+    mask = jnp.asarray(mask, bool)
+    shifts = _neighbor_shifts(connectivity)
+    for _ in range(iterations):
+        out = mask
+        for dy, dx in shifts:
+            out = out & _shift_with_fill(mask, dy, dx, True)
+        mask = out
+    return mask
+
+
+def fill_holes(mask: jax.Array, connectivity: int = 4) -> jax.Array:
+    """Fill background holes (reference ``jtmodules/fill.main``,
+    scipy ``binary_fill_holes`` semantics: background connectivity is the
+    complement of the foreground's — holes are 4-connected background regions
+    not reachable from the border).
+    """
+    mask = jnp.asarray(mask, bool)
+    h, w = mask.shape
+    bg = ~mask
+    border = jnp.zeros_like(mask).at[0, :].set(True).at[-1, :].set(True)
+    border = border.at[:, 0].set(True).at[:, -1].set(True)
+    seed = bg & border
+    shifts = _neighbor_shifts(connectivity)
+
+    def cond(state):
+        reach, changed = state
+        return changed
+
+    def body(state):
+        reach, _ = state
+        grown = reach
+        for dy, dx in shifts:
+            grown = grown | _shift_with_fill(reach, dy, dx, False)
+        grown = grown & bg
+        return grown, jnp.any(grown != reach)
+
+    reach, _ = lax.while_loop(cond, body, (seed, jnp.bool_(True)))
+    return mask | (bg & ~reach)
+
+
+# ------------------------------------------------------------------ filtering
+def areas_by_label(labels: jax.Array, max_objects: int) -> jax.Array:
+    """Pixel count per label id 1..max_objects → (max_objects,) int32."""
+    flat = labels.reshape(-1)
+    ones = jnp.ones_like(flat, dtype=jnp.int32)
+    # segment 0 is background; drop it
+    sums = jax.ops.segment_sum(ones, flat, num_segments=max_objects + 1)
+    return sums[1:]
+
+
+def relabel_sequential(labels: jax.Array, keep: jax.Array) -> jax.Array:
+    """Keep labels where ``keep[label-1]`` is True, renumbering 1..K densely
+    in ascending original-label order (scipy-compatible)."""
+    keep = jnp.asarray(keep, bool)
+    new_ids = jnp.cumsum(keep.astype(jnp.int32))
+    mapping = jnp.concatenate([jnp.zeros((1,), jnp.int32), jnp.where(keep, new_ids, 0)])
+    return mapping[labels]
+
+
+def filter_by_area(
+    labels: jax.Array,
+    max_objects: int,
+    min_area: int = 0,
+    max_area: int | None = None,
+) -> jax.Array:
+    """Remove objects outside [min_area, max_area] (reference
+    ``jtmodules/filter.main`` with the 'area' feature).
+
+    Labels beyond ``max_objects`` are dropped first — without this,
+    the relabeling gather would clamp them onto object ``max_objects``'s id,
+    silently merging distinct objects.
+    """
+    labels = clip_label_count(labels, max_objects)
+    areas = areas_by_label(labels, max_objects)
+    keep = areas >= min_area
+    if max_area is not None:
+        keep = keep & (areas <= max_area)
+    keep = keep & (areas > 0)
+    return relabel_sequential(labels, keep)
+
+
+def clip_label_count(labels: jax.Array, max_objects: int) -> jax.Array:
+    """Zero out labels beyond ``max_objects`` (static-shape safety valve)."""
+    return jnp.where(labels <= max_objects, labels, 0)

@@ -1,0 +1,62 @@
+"""Host-side polygon extraction from label images.
+
+Reference parity: the reference converts label images into PostGIS polygons
+per mapobject (``tmlib/models/mapobject.py`` ``MapobjectSegmentation``,
+via shapely).  Contour tracing is ragged (variable vertices per object), so
+it stays on the host — cv2's border following on a per-label mask — and its
+output feeds the Parquet object table rather than a database.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def labels_to_polygons(labels: np.ndarray) -> list[tuple[int, np.ndarray]]:
+    """Trace the outer contour of every labeled object.
+
+    Returns ``[(label, contour)]`` with ``contour`` an ``(K, 2)`` int32 array
+    of (y, x) vertices.  Objects with fewer than 3 boundary pixels yield
+    their pixel coordinates as a degenerate contour.
+    """
+    import cv2
+
+    labels = np.asarray(labels)
+    out: list[tuple[int, np.ndarray]] = []
+    ids = np.unique(labels)
+    ids = ids[ids > 0]
+    for lab in ids:
+        mask = (labels == lab).astype(np.uint8)
+        contours, _ = cv2.findContours(mask, cv2.RETR_EXTERNAL, cv2.CHAIN_APPROX_SIMPLE)
+        if not contours:
+            ys, xs = np.nonzero(mask)
+            out.append((int(lab), np.stack([ys, xs], axis=1).astype(np.int32)))
+            continue
+        largest = max(contours, key=cv2.contourArea)
+        # cv2 returns (K, 1, 2) in (x, y); convert to (K, 2) (y, x)
+        contour = largest[:, 0, ::-1].astype(np.int32)
+        out.append((int(lab), contour))
+    return out
+
+
+def polygons_to_table(
+    polygons: list[tuple[int, np.ndarray]], site_index: int
+):
+    """Flatten traced polygons into a DataFrame for the Parquet object store."""
+    import pandas as pd
+
+    rows = []
+    for label, contour in polygons:
+        cy, cx = contour[:, 0].mean(), contour[:, 1].mean()
+        rows.append(
+            {
+                "site": site_index,
+                "label": label,
+                "centroid_y": float(cy),
+                "centroid_x": float(cx),
+                "n_vertices": int(contour.shape[0]),
+                "contour_y": contour[:, 0].tolist(),
+                "contour_x": contour[:, 1].tolist(),
+            }
+        )
+    return pd.DataFrame(rows)

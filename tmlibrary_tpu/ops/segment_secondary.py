@@ -1,0 +1,99 @@
+"""Secondary segmentation: grow cell objects outward from primary seeds.
+
+Reference parity: ``jtmodules/segment_secondary.py`` — CellProfiler-style
+``propagate``/watershed from primary-object seeds (nuclei) constrained to a
+cell mask, keeping the **same label id** as the seed so primary and
+secondary objects correspond 1:1.
+
+TPU design (SURVEY.md §8 hard part #1b): level-ordered iterative flooding.
+Intensity is bucketed into ``n_levels`` descending levels; at each level,
+seed labels expand (8-neighbor max-label adoption, deterministic tie-break)
+into still-unlabeled mask pixels whose intensity reaches that level, to
+convergence (``lax.while_loop``), before dimmer pixels are admitted.  This
+approximates priority-queue watershed flooding with compiler-friendly
+control flow: O(levels x diameter) dense steps instead of a heap.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tmlibrary_tpu.ops.label import _neighbor_shifts, _shift_with_fill
+
+
+def _adopt_step(labels: jax.Array, allowed: jax.Array, connectivity: int = 8) -> jax.Array:
+    """Unlabeled allowed pixels adopt the max label among their neighbors."""
+    shifts = _neighbor_shifts(connectivity)
+    neigh_max = jnp.zeros_like(labels)
+    for dy, dx in shifts:
+        neigh_max = jnp.maximum(neigh_max, _shift_with_fill(labels, dy, dx, 0))
+    return jnp.where((labels == 0) & allowed, neigh_max, labels)
+
+
+def propagate_labels(
+    labels: jax.Array, allowed: jax.Array, connectivity: int = 8
+) -> jax.Array:
+    """Expand labels into ``allowed`` until convergence."""
+    labels = jnp.asarray(labels, jnp.int32)
+    allowed = jnp.asarray(allowed, bool)
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        lab, _ = state
+        new = _adopt_step(lab, allowed, connectivity)
+        return new, jnp.any(new != lab)
+
+    out, _ = lax.while_loop(cond, body, (labels, jnp.bool_(True)))
+    return out
+
+
+def expand_labels(
+    labels: jax.Array, iterations: int = 1, connectivity: int = 8
+) -> jax.Array:
+    """Morphologically expand every object by ``iterations`` pixels
+    (reference ``jtmodules/expand_or_shrink.py``).  Ties between competing
+    objects resolve to the larger label id (deterministic)."""
+    lab = jnp.asarray(labels, jnp.int32)
+    allowed = jnp.ones(lab.shape, bool)
+    for _ in range(iterations):
+        lab = _adopt_step(lab, allowed, connectivity)
+    return lab
+
+
+def watershed_from_seeds(
+    intensity: jax.Array,
+    seeds: jax.Array,
+    mask: jax.Array,
+    n_levels: int = 32,
+    connectivity: int = 8,
+) -> jax.Array:
+    """Level-ordered flooding of ``seeds`` through ``mask``.
+
+    Brighter mask pixels are claimed before dimmer ones, so region borders
+    fall along intensity valleys — the watershed behavior the reference gets
+    from CellProfiler's ``propagate``.  Seed pixels always keep their label.
+    Returns int32 labels covering ``mask`` wherever a seed can reach it.
+    """
+    intensity = jnp.asarray(intensity, jnp.float32)
+    seeds = jnp.asarray(seeds, jnp.int32)
+    mask = jnp.asarray(mask, bool) | (seeds > 0)
+
+    lo = jnp.min(jnp.where(mask, intensity, jnp.inf))
+    hi = jnp.max(jnp.where(mask, intensity, -jnp.inf))
+    span = jnp.maximum(hi - lo, 1e-6)
+
+    def level_body(i, labels):
+        # descending levels: i=0 admits only the brightest band
+        level = hi - span * (i + 1) / n_levels
+        allowed = mask & (intensity >= level)
+        return propagate_labels(labels, allowed, connectivity)
+
+    labels = lax.fori_loop(0, n_levels, level_body, seeds)
+    # mop up any mask pixels below the lowest level (numerical edge)
+    labels = propagate_labels(labels, mask, connectivity)
+    return jnp.where(mask, labels, 0)

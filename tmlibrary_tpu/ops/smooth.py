@@ -1,0 +1,131 @@
+"""Smoothing filters.
+
+Reference parity: ``jtmodules/smooth.py`` (gaussian / median / average /
+bilateral methods backed by cv2 + mahotas in the reference) and the filter
+helpers in ``jtlib/filter/``.
+
+TPU design: separable convolutions lowered through
+``lax.conv_general_dilated`` (XLA maps them to the VPU/MXU), window-gather
+median for small apertures.  Boundary handling matches
+``scipy.ndimage``'s default ``mode='reflect'`` (== ``jnp.pad`` ``symmetric``)
+so golden tests compare against scipy directly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _gaussian_kernel1d(sigma: float, radius: int) -> jnp.ndarray:
+    x = jnp.arange(-radius, radius + 1, dtype=jnp.float32)
+    k = jnp.exp(-0.5 * (x / sigma) ** 2)
+    return k / jnp.sum(k)
+
+
+def _conv1d(img: jax.Array, kernel: jnp.ndarray, axis: int) -> jax.Array:
+    """Correlate a 2-D image with a 1-D kernel along ``axis`` (reflect pad)."""
+    r = kernel.shape[0] // 2
+    pad = [(0, 0), (0, 0)]
+    pad[axis] = (r, r)
+    padded = jnp.pad(img, pad, mode="symmetric")
+    lhs = padded[None, None, :, :]
+    if axis == 0:
+        rhs = kernel.reshape(1, 1, -1, 1)
+    else:
+        rhs = kernel.reshape(1, 1, 1, -1)
+    out = lax.conv_general_dilated(
+        lhs.astype(jnp.float32),
+        rhs,
+        window_strides=(1, 1),
+        padding="VALID",
+        # full fp32 accumulation: TPU convs default to bf16 passes, which
+        # flips pixels sitting exactly on a threshold vs the CPU golden
+        precision=lax.Precision.HIGHEST,
+    )
+    return out[0, 0]
+
+
+def gaussian_smooth(img: jax.Array, sigma: float, truncate: float = 4.0) -> jax.Array:
+    """Separable Gaussian blur matching ``scipy.ndimage.gaussian_filter``.
+
+    ``sigma``/``truncate`` are static (compile-time) parameters — radius is
+    ``int(truncate * sigma + 0.5)`` exactly as scipy computes it.
+    """
+    radius = int(truncate * float(sigma) + 0.5)
+    k = _gaussian_kernel1d(float(sigma), radius)
+    out = _conv1d(jnp.asarray(img, jnp.float32), k, axis=0)
+    return _conv1d(out, k, axis=1)
+
+
+def uniform_smooth(img: jax.Array, size: int) -> jax.Array:
+    """Separable box (mean) filter matching ``scipy.ndimage.uniform_filter``."""
+    if size < 1:
+        raise ValueError("size must be >= 1")
+    # scipy centers even-sized windows with the extra tap on the left
+    left = size // 2
+    right = size - left - 1
+    padded = jnp.pad(
+        jnp.asarray(img, jnp.float32), ((left, right), (left, right)), mode="symmetric"
+    )
+    k = jnp.full((size,), 1.0 / size, jnp.float32)
+    out = lax.conv_general_dilated(
+        padded[None, None],
+        k.reshape(1, 1, -1, 1),
+        (1, 1),
+        "VALID",
+        precision=lax.Precision.HIGHEST,
+    )
+    out = lax.conv_general_dilated(
+        out, k.reshape(1, 1, 1, -1), (1, 1), "VALID", precision=lax.Precision.HIGHEST
+    )
+    return out[0, 0]
+
+
+def _window_stack(img: jax.Array, size: int) -> jax.Array:
+    """Gather the ``size*size`` neighborhood of every pixel → (k*k, H, W)."""
+    r = size // 2
+    padded = jnp.pad(img, ((r, r), (r, r)), mode="symmetric")
+    h, w = img.shape
+    views = [
+        lax.dynamic_slice(padded, (dy, dx), (h, w))
+        for dy in range(size)
+        for dx in range(size)
+    ]
+    return jnp.stack(views)
+
+
+def median_smooth(img: jax.Array, size: int) -> jax.Array:
+    """Median filter (odd ``size``) matching ``scipy.ndimage.median_filter``.
+
+    Implemented as a window-gather + sort: fine for the small apertures
+    (3–9 px) microscopy pipelines use; the gather unrolls to ``size**2``
+    static slices that XLA fuses.
+    """
+    if size % 2 != 1:
+        raise ValueError("median filter size must be odd")
+    stack = _window_stack(jnp.asarray(img, jnp.float32), size)
+    return jnp.median(stack, axis=0)
+
+
+def bilateral_smooth(
+    img: jax.Array, size: int = 5, sigma_space: float = 2.0, sigma_range: float = 50.0
+) -> jax.Array:
+    """Bilateral filter (edge-preserving smoothing).
+
+    Reference exposes cv2's bilateral option in ``jtmodules/smooth.py``; here
+    it is an explicit window-gather with Gaussian space × range weights.
+    """
+    img_f = jnp.asarray(img, jnp.float32)
+    stack = _window_stack(img_f, size)
+    r = size // 2
+    dy, dx = jnp.meshgrid(
+        jnp.arange(-r, r + 1, dtype=jnp.float32),
+        jnp.arange(-r, r + 1, dtype=jnp.float32),
+        indexing="ij",
+    )
+    w_space = jnp.exp(-(dy**2 + dx**2) / (2.0 * sigma_space**2)).reshape(-1, 1, 1)
+    w_range = jnp.exp(-((stack - img_f[None]) ** 2) / (2.0 * sigma_range**2))
+    w = w_space * w_range
+    return jnp.sum(w * stack, axis=0) / jnp.maximum(jnp.sum(w, axis=0), 1e-12)

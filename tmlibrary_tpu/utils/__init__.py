@@ -1,0 +1,54 @@
+"""General utilities.
+
+Reference parity: ``tmlib/utils.py`` — notably ``create_partitions`` (batch
+chunking used by every step's ``create_run_batches``), ``flatten``, and the
+type-assertion helpers.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Iterable, Sequence
+
+
+def create_partitions(items: Sequence[Any], size: int) -> list[list[Any]]:
+    """Split ``items`` into consecutive chunks of at most ``size`` elements.
+
+    This is the batching primitive every workflow step uses to plan its run
+    jobs (reference: ``tmlib.utils.create_partitions``).  In the TPU rebuild a
+    "partition" becomes a ``vmap`` batch rather than a cluster job.
+    """
+    if size < 1:
+        raise ValueError("partition size must be >= 1")
+    items = list(items)
+    return [items[i : i + size] for i in range(0, len(items), size)]
+
+
+def flatten(nested: Iterable[Iterable[Any]]) -> list[Any]:
+    """Flatten one level of nesting."""
+    return list(itertools.chain.from_iterable(nested))
+
+
+def assert_type(value: Any, name: str, *types: type) -> None:
+    """Raise ``TypeError`` unless ``value`` is an instance of one of ``types``."""
+    if not isinstance(value, tuple(types)):
+        expected = " or ".join(t.__name__ for t in types)
+        raise TypeError(
+            f"argument '{name}' must be of type {expected}, "
+            f"got {type(value).__name__}"
+        )
+
+
+def pad_to(values: Sequence[Any], length: int, fill: Any) -> list[Any]:
+    """Pad ``values`` with ``fill`` up to ``length`` (static-shape helper)."""
+    values = list(values)
+    if len(values) > length:
+        raise ValueError(f"got {len(values)} values, more than length={length}")
+    return values + [fill] * (length - len(values))
+
+
+def next_power_of_two(n: int) -> int:
+    """Smallest power of two >= n (shape bucketing for XLA compile caching)."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()

@@ -1,0 +1,8 @@
+"""Version of the tmlibrary_tpu framework.
+
+Reference parity: ``tmlib/version.py`` (path-level citation; see SURVEY.md §0
+for the provenance caveat — the reference mount was empty, citations are
+path-level against the public TissueMAPS/TmLibrary layout).
+"""
+
+__version__ = "0.1.0"
