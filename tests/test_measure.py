@@ -1,0 +1,141 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.ops.measure import (
+    haralick_features,
+    intensity_features,
+    morphology_features,
+    zernike_features,
+)
+
+MAX_OBJ = 16
+
+
+@pytest.fixture
+def labeled_scene(rng):
+    labels = np.zeros((64, 64), np.int32)
+    labels[5:15, 5:15] = 1  # 10x10 square
+    labels[30:40, 20:45] = 2  # 10x25 rectangle
+    labels[50:54, 50:54] = 3  # 4x4 square
+    intensity = rng.integers(100, 5000, size=(64, 64)).astype(np.float32)
+    return jnp.asarray(labels), jnp.asarray(intensity), labels, intensity
+
+
+def test_intensity_matches_numpy(labeled_scene):
+    jl, ji, labels, intensity = labeled_scene
+    feats = intensity_features(jl, ji, MAX_OBJ)
+    for lab in (1, 2, 3):
+        sel = intensity[labels == lab]
+        i = lab - 1
+        np.testing.assert_allclose(float(feats["Intensity_mean"][i]), sel.mean(), rtol=1e-5)
+        np.testing.assert_allclose(float(feats["Intensity_sum"][i]), sel.sum(), rtol=1e-5)
+        assert float(feats["Intensity_max"][i]) == sel.max()
+        assert float(feats["Intensity_min"][i]) == sel.min()
+        np.testing.assert_allclose(float(feats["Intensity_std"][i]), sel.std(), rtol=1e-4)
+    # padded rows are zeros
+    assert float(feats["Intensity_mean"][5]) == 0.0
+
+
+def test_morphology_basics(labeled_scene):
+    jl, _, labels, _ = labeled_scene
+    feats = morphology_features(jl, MAX_OBJ)
+    areas = np.asarray(feats["Morphology_area"])
+    assert list(areas[:3]) == [100.0, 250.0, 16.0]
+    np.testing.assert_allclose(float(feats["Morphology_centroid_y"][0]), 9.5)
+    np.testing.assert_allclose(float(feats["Morphology_centroid_x"][0]), 9.5)
+    assert float(feats["Morphology_bbox_height"][1]) == 10.0
+    assert float(feats["Morphology_bbox_width"][1]) == 25.0
+    np.testing.assert_allclose(float(feats["Morphology_extent"][0]), 1.0)
+    # perimeter of a filled 10x10 square, 4-connected boundary = 36 pixels
+    assert float(feats["Morphology_perimeter"][0]) == 36.0
+
+
+def test_morphology_ellipse_matches_regionprops_math():
+    # ellipse mask: a=12 (x), b=6 (y)
+    yy, xx = np.mgrid[0:64, 0:64]
+    mask = ((xx - 32) / 12.0) ** 2 + ((yy - 32) / 6.0) ** 2 <= 1.0
+    labels = jnp.asarray(mask.astype(np.int32))
+    feats = morphology_features(labels, MAX_OBJ)
+    major = float(feats["Morphology_major_axis_length"][0])
+    minor = float(feats["Morphology_minor_axis_length"][0])
+    # regionprops-style: major ~ 2a = 24, minor ~ 2b = 12
+    assert abs(major - 24.0) < 1.5
+    assert abs(minor - 12.0) < 1.0
+    ecc = float(feats["Morphology_eccentricity"][0])
+    assert abs(ecc - np.sqrt(1 - (6 / 12) ** 2)) < 0.03
+    # orientation: measured from the x axis -> 0 for an x-aligned major axis
+    ori = float(feats["Morphology_orientation"][0])
+    assert abs(ori) < 0.05
+
+
+def test_haralick_flat_vs_noisy_texture(rng):
+    labels = np.zeros((64, 64), np.int32)
+    labels[4:28, 4:28] = 1  # flat region
+    labels[36:60, 36:60] = 2  # noisy region
+    img = np.full((64, 64), 1000.0, np.float32)
+    img[36:60, 36:60] = rng.integers(0, 5000, size=(24, 24)).astype(np.float32)
+    img[0, 0] = 0.0
+    img[1, 0] = 5000.0  # pin global range so quantization spreads
+    feats = haralick_features(jnp.asarray(labels), jnp.asarray(img), MAX_OBJ)
+    # flat object: max homogeneity (ASM=1, contrast=0, entropy~0)
+    np.testing.assert_allclose(float(feats["Texture_angular_second_moment"][0]), 1.0, atol=1e-5)
+    np.testing.assert_allclose(float(feats["Texture_contrast"][0]), 0.0, atol=1e-5)
+    # noisy object: high entropy, high contrast, low ASM
+    assert float(feats["Texture_entropy"][1]) > 2.0
+    assert float(feats["Texture_contrast"][1]) > 10.0
+    assert float(feats["Texture_angular_second_moment"][1]) < 0.1
+
+
+def test_haralick_correlation_of_smooth_gradient():
+    labels = np.zeros((64, 64), np.int32)
+    labels[8:56, 8:56] = 1
+    yy, _ = np.mgrid[0:64, 0:64]
+    img = yy.astype(np.float32) * 100  # smooth vertical gradient
+    feats = haralick_features(jnp.asarray(labels), jnp.asarray(img), MAX_OBJ)
+    # neighboring pixels strongly correlated along the gradient
+    assert float(feats["Texture_correlation"][0]) > 0.9
+
+
+def test_zernike_rotation_invariance():
+    # |Z_nm| must be (approximately) invariant under rotation of the mask
+    yy, xx = np.mgrid[0:64, 0:64]
+    blob = (((xx - 32) / 14.0) ** 2 + ((yy - 32) / 7.0) ** 2) <= 1.0
+    blob_rot = (((yy - 32) / 14.0) ** 2 + ((xx - 32) / 7.0) ** 2) <= 1.0  # 90° rotation
+    f1 = zernike_features(jnp.asarray(blob.astype(np.int32)), MAX_OBJ, degree=6)
+    f2 = zernike_features(jnp.asarray(blob_rot.astype(np.int32)), MAX_OBJ, degree=6)
+    for k in f1:
+        v1, v2 = float(f1[k][0]), float(f2[k][0])
+        assert abs(v1 - v2) < 0.05, (k, v1, v2)
+
+
+def test_zernike_distinguishes_shapes():
+    yy, xx = np.mgrid[0:64, 0:64]
+    disk = ((xx - 32) ** 2 + (yy - 32) ** 2) <= 14**2
+    ellipse = (((xx - 32) / 14.0) ** 2 + ((yy - 32) / 5.0) ** 2) <= 1.0
+    fd = zernike_features(jnp.asarray(disk.astype(np.int32)), MAX_OBJ, degree=4)
+    fe = zernike_features(jnp.asarray(ellipse.astype(np.int32)), MAX_OBJ, degree=4)
+    # Z_2_2 captures elongation: near zero for disk, large for ellipse
+    assert float(fd["Zernike_2_2"][0]) < 0.05
+    assert float(fe["Zernike_2_2"][0]) > 0.1
+
+
+def test_measure_under_jit_vmap(labeled_scene):
+    jl, ji, _, _ = labeled_scene
+    batch_l = jnp.stack([jl, jl])
+    batch_i = jnp.stack([ji, ji * 2.0])
+
+    @jax.jit
+    @jax.vmap
+    def run(l, i):
+        return intensity_features(l, i, MAX_OBJ)
+
+    feats = run(batch_l, batch_i)
+    assert feats["Intensity_mean"].shape == (2, MAX_OBJ)
+    np.testing.assert_allclose(
+        np.asarray(feats["Intensity_mean"][1]),
+        np.asarray(feats["Intensity_mean"][0]) * 2.0,
+        rtol=1e-5,
+    )

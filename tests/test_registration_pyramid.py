@@ -1,0 +1,88 @@
+import jax.numpy as jnp
+import numpy as np
+
+from tmlibrary_tpu.ops.pyramid import (
+    TILE_SIZE,
+    cut_tiles,
+    downsample_2x,
+    pyramid_levels,
+    to_uint8,
+)
+from tmlibrary_tpu.ops.registration import (
+    batch_phase_correlation,
+    intersection_window,
+    phase_correlation,
+)
+
+
+def test_phase_correlation_recovers_known_shift(rng):
+    base = rng.random((128, 128)).astype(np.float32)
+    base = np.asarray(jnp.asarray(base))
+    for dy, dx in [(0, 0), (5, -3), (-7, 11), (20, 20)]:
+        shifted = np.roll(base, (-dy, -dx), axis=(0, 1))
+        gy, gx = phase_correlation(jnp.asarray(base), jnp.asarray(shifted))
+        assert (int(gy), int(gx)) == (dy, dx), (dy, dx, int(gy), int(gx))
+
+
+def test_batch_phase_correlation(rng):
+    base = rng.random((4, 64, 64)).astype(np.float32)
+    shifts = [(1, 2), (-3, 4), (0, 0), (6, -5)]
+    target = np.stack(
+        [np.roll(base[i], (-dy, -dx), axis=(0, 1)) for i, (dy, dx) in enumerate(shifts)]
+    )
+    got = np.asarray(batch_phase_correlation(jnp.asarray(base), jnp.asarray(target)))
+    np.testing.assert_array_equal(got, np.asarray(shifts))
+
+
+def test_intersection_window():
+    shifts = np.array([[3, -2], [-1, 4], [0, 0]])
+    w = intersection_window(shifts)
+    assert w == {"top": 3, "bottom": 1, "left": 4, "right": 2}
+    assert intersection_window(np.zeros((0, 2))) == {
+        "top": 0, "bottom": 0, "left": 0, "right": 0,
+    }
+
+
+def test_downsample_2x_mean():
+    img = jnp.asarray(np.arange(16, dtype=np.float32).reshape(4, 4))
+    out = np.asarray(downsample_2x(img))
+    np.testing.assert_allclose(out, [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_downsample_odd_shape():
+    img = jnp.ones((5, 7), jnp.float32)
+    out = np.asarray(downsample_2x(img))
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out, 1.0)
+
+
+def test_pyramid_levels_chain(rng):
+    mosaic = jnp.asarray(rng.random((1024, 768)).astype(np.float32))
+    levels = pyramid_levels(mosaic)
+    shapes = [l.shape for l in levels]
+    assert shapes[0] == (1024, 768)
+    assert shapes[1] == (512, 384)
+    assert shapes[-1][0] <= TILE_SIZE and shapes[-1][1] <= TILE_SIZE
+    # mean preserved through the chain
+    np.testing.assert_allclose(
+        float(jnp.mean(levels[0])), float(jnp.mean(levels[1])), rtol=1e-3
+    )
+
+
+def test_cut_tiles_pads_edges(rng):
+    level = rng.random((300, 520)).astype(np.float32)
+    tiles = cut_tiles(level)
+    assert set(tiles) == {(r, c) for r in range(2) for c in range(3)}
+    np.testing.assert_array_equal(tiles[(0, 0)], level[:256, :256])
+    # edge tile zero-padded
+    t = tiles[(1, 2)]
+    assert t.shape == (256, 256)
+    np.testing.assert_array_equal(t[: 300 - 256, : 520 - 512], level[256:, 512:])
+    assert t[300 - 256 :, :].sum() == 0
+
+
+def test_to_uint8_stretch():
+    img = jnp.asarray([[0.0, 50.0, 100.0, 200.0]])
+    out = np.asarray(to_uint8(img, 50.0, 150.0))
+    assert out.dtype == np.uint8
+    assert list(out[0]) == [0, 0, 127, 255]

@@ -1,0 +1,93 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.ops.stats import (
+    welford_finalize,
+    welford_init,
+    welford_merge,
+    welford_scan,
+    welford_update,
+)
+from tmlibrary_tpu.parallel.mesh import shard_batch, site_mesh
+from tmlibrary_tpu.parallel.stats import sharded_channel_stats
+
+
+@pytest.fixture
+def stack(rng):
+    # 32 sites of 24x24 uint16-range data with per-pixel structure
+    base = rng.integers(200, 2000, size=(24, 24)).astype(np.float32)
+    noise = rng.normal(0, 50, size=(32, 24, 24)).astype(np.float32)
+    return np.clip(base[None] + noise, 0, 65535)
+
+
+def test_welford_scan_matches_numpy(stack):
+    state = welford_scan(jnp.asarray(stack))
+    out = welford_finalize(state)
+    log_stack = np.log10(1.0 + stack)
+    np.testing.assert_allclose(np.asarray(out["mean_log"]), log_stack.mean(0), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["std_log"]), log_stack.std(0), rtol=1e-4, atol=1e-6
+    )
+    assert float(out["n"]) == 32
+
+
+def test_welford_merge_equals_sequential(stack):
+    a = welford_scan(jnp.asarray(stack[:20]))
+    b = welford_scan(jnp.asarray(stack[20:]))
+    merged = welford_finalize(welford_merge(a, b))
+    seq = welford_finalize(welford_scan(jnp.asarray(stack)))
+    np.testing.assert_allclose(
+        np.asarray(merged["mean_log"]), np.asarray(seq["mean_log"]), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(merged["var_log"]), np.asarray(seq["var_log"]), rtol=1e-4, atol=1e-8
+    )
+
+
+def test_welford_merge_with_empty_state(stack):
+    empty = welford_init((24, 24))
+    full = welford_scan(jnp.asarray(stack))
+    merged = welford_merge(empty, full)
+    np.testing.assert_allclose(
+        np.asarray(merged.mean), np.asarray(full.mean), rtol=1e-6
+    )
+    assert float(merged.n) == float(full.n)
+
+
+def test_percentiles_exact_for_integers():
+    # known distribution: values 0..999 once each
+    img = np.arange(1000, dtype=np.float32).reshape(1, 25, 40)
+    out = welford_finalize(welford_scan(jnp.asarray(img)))
+    keys = np.asarray(out["percentile_keys"])
+    vals = np.asarray(out["percentile_values"])
+    got = dict(zip(keys.tolist(), vals.tolist()))
+    assert got[50.0] == 499.0  # smallest v with cum(v) >= 500
+    assert got[99.0] == 989.0
+    assert got[1.0] == 9.0
+
+
+def test_sharded_stats_match_sequential(stack, devices):
+    mesh = site_mesh(8)
+    sharded = shard_batch(jnp.asarray(stack), mesh)
+    out = sharded_channel_stats(sharded, mesh)
+    seq = welford_finalize(welford_scan(jnp.asarray(stack)))
+    np.testing.assert_allclose(
+        np.asarray(out["mean_log"]), np.asarray(seq["mean_log"]), rtol=1e-5
+    )
+    # parallel-variance merge reassociates fp32 ops vs the sequential fold;
+    # agreement to ~1e-3 relative is the expected numeric quality
+    np.testing.assert_allclose(
+        np.asarray(out["std_log"]), np.asarray(seq["std_log"]), rtol=5e-3, atol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(out["hist"]), np.asarray(seq["hist"]))
+    assert float(out["n"]) == 32
+
+
+def test_sharded_stats_deterministic(stack, devices):
+    mesh = site_mesh(8)
+    sharded = shard_batch(jnp.asarray(stack), mesh)
+    out1 = sharded_channel_stats(sharded, mesh)
+    out2 = sharded_channel_stats(sharded, mesh)
+    np.testing.assert_array_equal(np.asarray(out1["std_log"]), np.asarray(out2["std_log"]))

@@ -1,0 +1,235 @@
+import json
+
+import numpy as np
+import pytest
+import yaml
+
+from tmlibrary_tpu.models.experiment import Experiment
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.workflow.engine import (
+    RunLedger,
+    Workflow,
+    WorkflowDescription,
+)
+
+PIPE_YAML = {
+    "description": "nuclei segmentation + intensity",
+    "input": {"channels": [{"name": "DAPI", "correct": True, "align": False}]},
+    "pipeline": [
+        {
+            "handles": {
+                "module": "smooth",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI"},
+                    {"name": "sigma", "type": "Numeric", "value": 1.5},
+                ],
+                "output": [
+                    {"name": "smoothed_image", "type": "IntensityImage", "key": "sm"}
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "segment_primary",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "sm"},
+                    {"name": "threshold_method", "type": "Character", "value": "otsu"},
+                    {"name": "smooth_sigma", "type": "Numeric", "value": 0.0},
+                    {"name": "min_area", "type": "Numeric", "value": 10},
+                ],
+                "output": [
+                    {
+                        "name": "objects",
+                        "type": "SegmentedObjects",
+                        "key": "nuclei",
+                        "objects": "nuclei",
+                    }
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "measure_intensity",
+                "input": [
+                    {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI"},
+                ],
+                "output": [
+                    {
+                        "name": "measurements",
+                        "type": "Measurement",
+                        "objects": "nuclei",
+                        "channel": "DAPI",
+                    }
+                ],
+            }
+        },
+    ],
+    "output": {"objects": [{"name": "nuclei"}]},
+}
+
+
+@pytest.fixture
+def source_dir(tmp_path, rng):
+    """Synthetic 1-plate 2x2-well 2x2-site single-channel experiment on disk."""
+    import cv2
+
+    src = tmp_path / "microscope"
+    src.mkdir()
+    yy, xx = np.mgrid[0:64, 0:64]
+    for well in ("A01", "A02", "B01", "B02"):
+        for site in range(4):
+            img = rng.normal(300, 20, (64, 64))
+            for _ in range(6):
+                y, x = rng.integers(8, 56, 2)
+                img += 4000 * np.exp(-((yy - y) ** 2 + (xx - x) ** 2) / (2 * 3.0**2))
+            path = src / f"{well}_s{site}_DAPI.png"
+            cv2.imwrite(str(path), np.clip(img, 0, 65535).astype(np.uint16))
+    return src
+
+
+@pytest.fixture
+def store(tmp_path):
+    placeholder = Experiment(
+        name="wf", plates=[], channels=[], site_height=1, site_width=1
+    )
+    return ExperimentStore.create(tmp_path / "exp", placeholder)
+
+
+def make_description(source_dir, store):
+    pipe_path = store.root / "nuclei.pipe.yaml"
+    pipe_path.write_text(yaml.safe_dump(PIPE_YAML))
+    return WorkflowDescription.canonical(
+        {
+            "metaconfig": {"source_dir": str(source_dir)},
+            "imextract": {},
+            "corilla": {"chunk_size": 8, "n_devices": 1},
+            "jterator": {
+                "pipe": "nuclei.pipe.yaml",
+                "batch_size": 8,
+                "max_objects": 64,
+                "n_devices": 1,
+            },
+        }
+    )
+
+
+def test_full_workflow_end_to_end(source_dir, store):
+    desc = make_description(source_dir, store)
+    summary = Workflow(store, desc).run()
+    assert set(summary) == {"metaconfig", "imextract", "corilla", "jterator"}
+
+    # manifest was configured from filenames
+    exp = ExperimentStore.open(store.root).experiment
+    assert exp.n_sites == 16
+    assert [c.name for c in exp.channels] == ["DAPI"]
+    assert exp.site_height == 64
+
+    # pixels ingested
+    pixels = store.read_sites(None, channel=0)
+    assert pixels.shape == (16, 64, 64)
+    assert pixels.max() > 1000
+
+    # corilla stats exist and are sane
+    stats = store.read_illumstats(channel=0)
+    assert stats["mean_log"].shape == (64, 64)
+    assert float(stats["n"]) == 16
+
+    # segmentations + features persisted
+    labels = store.read_labels(None, "nuclei")
+    assert labels.shape == (16, 64, 64)
+    assert labels.max() > 0
+    feats = store.read_features("nuclei")
+    assert len(feats) > 20
+    assert "Intensity_mean_DAPI" in feats.columns
+    assert (feats["label"] >= 1).all()
+    # every site produced at least one object (6 blobs planted per site)
+    assert set(feats["site_index"].unique()) == set(range(16))
+
+
+def test_workflow_resume_skips_completed(source_dir, store):
+    desc = make_description(source_dir, store)
+    wf = Workflow(store, desc)
+    wf.run()
+    events_before = len(wf.ledger.events())
+    # resume after completion: no step re-runs
+    wf2 = Workflow(store, desc)
+    summary = wf2.run(resume=True)
+    assert summary == {}
+    assert len(wf2.ledger.events()) == events_before
+
+
+def test_workflow_resume_after_failure(source_dir, store):
+    desc = make_description(source_dir, store)
+    # break jterator by pointing at a missing pipe file
+    for stage in desc.stages:
+        for s in stage.steps:
+            if s.name == "jterator":
+                s.args["pipe"] = "missing.pipe.yaml"
+    from tmlibrary_tpu.errors import WorkflowError
+
+    with pytest.raises(WorkflowError):
+        Workflow(store, desc).run()
+    status = RunLedger(store.workflow_dir / "ledger.jsonl").status()
+    assert status["jterator"]["state"] == "failed"
+    assert status["corilla"]["state"] == "done"
+
+    # fix and resume: earlier steps skipped, jterator runs
+    desc2 = make_description(source_dir, store)
+    summary = Workflow(store, desc2).run(resume=True)
+    assert list(summary) == ["jterator"]
+    assert store.read_labels(None, "nuclei").max() > 0
+
+
+def test_workflow_rejects_unknown_step():
+    from tmlibrary_tpu.errors import WorkflowError
+    from tmlibrary_tpu.workflow.engine import (
+        WorkflowStageDescription,
+        WorkflowStepDescription,
+    )
+
+    desc = WorkflowDescription(
+        stages=[
+            WorkflowStageDescription(
+                name="x", steps=[WorkflowStepDescription(name="nope")]
+            )
+        ]
+    )
+    with pytest.raises(WorkflowError):
+        desc.validate()
+
+
+def test_description_yaml_roundtrip(tmp_path, source_dir, store):
+    desc = make_description(source_dir, store)
+    path = tmp_path / "wf.yaml"
+    desc.save(path)
+    loaded = WorkflowDescription.load(path)
+    assert loaded.to_dict() == desc.to_dict()
+
+
+def test_cli_end_to_end(source_dir, tmp_path, capsys):
+    from tmlibrary_tpu.cli import main
+
+    root = str(tmp_path / "cli_exp")
+    assert main(["create", "--root", root, "--name", "cli"]) == 0
+    assert (
+        main(
+            [
+                "metaconfig", "init", "--root", root,
+                "--source-dir", str(source_dir),
+            ]
+        )
+        == 0
+    )
+    assert main(["metaconfig", "run", "--root", root]) == 0
+    assert main(["imextract", "init", "--root", root]) == 0
+    assert main(["imextract", "run", "--root", root]) == 0
+    assert main(["corilla", "init", "--root", root, "--n-devices", "1"]) == 0
+    assert main(["corilla", "run", "--root", root]) == 0
+    store = ExperimentStore.open(root)
+    assert store.experiment.n_sites == 16
+    assert store.has_illumstats(channel=0)
+    # error path: run without init
+    assert main(["jterator", "run", "--root", root, "--job", "0"]) == 1
+    err = capsys.readouterr().err
+    assert "run init first" in err

@@ -1,0 +1,190 @@
+"""Benchmark pipelines and synthetic data (shared by bench.py and tests).
+
+The flagship configuration is BASELINE.json config 3: the Cell Painting
+segment+measure pipeline — ``segment_primary`` (nuclei from DAPI) →
+``segment_secondary`` (cells grown from nuclei through the actin channel) →
+``measure_intensity`` on both channels.  The benchmark metric is
+sites/sec/chip (reference: jterator's per-site job throughput).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tmlibrary_tpu.jterator.description import PipelineDescription
+
+CELL_PAINTING_PIPE = {
+    "description": "Cell Painting: segment nuclei + cells, measure intensity",
+    "input": {
+        "channels": [
+            {"name": "DAPI", "correct": False, "align": False},
+            {"name": "Actin", "correct": False, "align": False},
+        ]
+    },
+    "pipeline": [
+        {
+            "handles": {
+                "module": "smooth",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI"},
+                    {"name": "sigma", "type": "Numeric", "value": 1.5},
+                ],
+                "output": [
+                    {"name": "smoothed_image", "type": "IntensityImage", "key": "dapi_sm"}
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "segment_primary",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "dapi_sm"},
+                    {"name": "threshold_method", "type": "Character", "value": "otsu"},
+                    {"name": "smooth_sigma", "type": "Numeric", "value": 0.0},
+                    {"name": "min_area", "type": "Numeric", "value": 20},
+                ],
+                "output": [
+                    {
+                        "name": "objects",
+                        "type": "SegmentedObjects",
+                        "key": "nuclei",
+                        "objects": "nuclei",
+                    }
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "segment_secondary",
+                "input": [
+                    {"name": "primary_label_image", "type": "LabelImage", "key": "nuclei"},
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "Actin"},
+                    {"name": "correction_factor", "type": "Numeric", "value": 0.8},
+                    {"name": "n_levels", "type": "Numeric", "value": 16},
+                ],
+                "output": [
+                    {
+                        "name": "objects",
+                        "type": "SegmentedObjects",
+                        "key": "cells",
+                        "objects": "cells",
+                    }
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "measure_intensity",
+                "input": [
+                    {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI"},
+                ],
+                "output": [
+                    {
+                        "name": "measurements",
+                        "type": "Measurement",
+                        "objects": "nuclei",
+                        "channel": "DAPI",
+                    }
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "measure_intensity",
+                "input": [
+                    {"name": "objects_image", "type": "LabelImage", "key": "cells"},
+                    {"name": "intensity_image", "type": "IntensityImage", "key": "Actin"},
+                ],
+                "output": [
+                    {
+                        "name": "measurements",
+                        "type": "Measurement",
+                        "objects": "cells",
+                        "channel": "Actin",
+                    }
+                ],
+            }
+        },
+    ],
+    "output": {
+        "objects": [{"name": "nuclei"}, {"name": "cells"}]
+    },
+}
+
+
+def cell_painting_description() -> PipelineDescription:
+    return PipelineDescription.from_dict(CELL_PAINTING_PIPE)
+
+
+def synthetic_cell_painting_batch(
+    n_sites: int, size: int = 256, n_cells: int = 12, seed: int = 0
+) -> dict[str, np.ndarray]:
+    """Synthetic DAPI (nuclei) + Actin (cell body) site images, float32."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    dapi = rng.normal(300.0, 25.0, (n_sites, size, size)).astype(np.float32)
+    actin = rng.normal(300.0, 25.0, (n_sites, size, size)).astype(np.float32)
+    margin = size // 10
+    for s in range(n_sites):
+        ys = rng.integers(margin, size - margin, n_cells)
+        xs = rng.integers(margin, size - margin, n_cells)
+        for y, x in zip(ys, xs):
+            r_n = rng.uniform(3.5, 5.5)
+            r_c = r_n * rng.uniform(2.0, 3.0)
+            d2 = (yy - y) ** 2 + (xx - x) ** 2
+            dapi[s] += 4000.0 * np.exp(-d2 / (2 * r_n**2))
+            actin[s] += 1500.0 * np.exp(-d2 / (2 * r_c**2))
+    return {
+        "DAPI": np.clip(dapi, 0, 65535),
+        "Actin": np.clip(actin, 0, 65535),
+    }
+
+
+# ------------------------------------------------------------------ CPU golden
+def _otsu_numpy(img: np.ndarray, bins: int = 256) -> float:
+    """Pure-numpy Otsu (same fixed-bin formulation as ops.threshold)."""
+    lo, hi = float(img.min()), float(img.max())
+    span = max(hi - lo, 1e-6)
+    idx = np.clip(((img - lo) / span * bins).astype(np.int32), 0, bins - 1)
+    hist = np.bincount(idx.ravel(), minlength=bins).astype(np.float64)
+    centers = lo + (np.arange(bins) + 0.5) / bins * span
+    w0 = np.cumsum(hist)
+    w1 = w0[-1] - w0
+    sum0 = np.cumsum(hist * centers)
+    mu0 = sum0 / np.maximum(w0, 1e-12)
+    mu1 = (sum0[-1] - sum0) / np.maximum(w1, 1e-12)
+    between = np.where((w0 > 0) & (w1 > 0), w0 * w1 * (mu0 - mu1) ** 2, -1.0)
+    return float(centers[int(np.argmax(between))])
+
+
+def cpu_reference_site(dapi: np.ndarray, actin: np.ndarray) -> tuple[int, int]:
+    """Single-threaded scipy/numpy implementation of the same pipeline —
+    the single-CPU denominator (BASELINE.md: measured, not published).
+    Returns (n_nuclei, n_cells)."""
+    import scipy.ndimage as ndi
+
+    sm = ndi.gaussian_filter(dapi, 1.5, mode="reflect")
+    t = _otsu_numpy(sm)
+    mask = ndi.binary_fill_holes(sm > t)
+    labels, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    # size filter >= 20
+    sizes = np.bincount(labels.ravel())
+    keep = np.flatnonzero(sizes >= 20)[1:]
+    n_nuclei = len(keep)
+    # secondary: nearest-seed growth through actin mask (approximate golden)
+    t2 = _otsu_numpy(actin) * 0.8
+    cell_mask = actin > t2
+    dist, (iy, ix) = ndi.distance_transform_edt(labels == 0, return_indices=True)
+    cells = np.where(cell_mask, labels[iy, ix], 0)
+    n_cells = len(np.unique(cells)) - 1
+    # intensity stats per object (numpy)
+    for lab_img, img in ((labels, dapi), (cells, actin)):
+        ids = np.unique(lab_img)[1:]
+        if len(ids):
+            ndi.mean(img, lab_img, ids)
+            ndi.standard_deviation(img, lab_img, ids)
+            ndi.maximum(img, lab_img, ids)
+            ndi.minimum(img, lab_img, ids)
+            ndi.sum(img, lab_img, ids)
+    return n_nuclei, n_cells

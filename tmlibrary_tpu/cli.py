@@ -1,0 +1,191 @@
+"""Unified command-line interface.
+
+Reference parity: ``tmlib/workflow/cli.py`` + per-step console scripts
+(``metaconfig``, ``imextract``, ``corilla``, ``align``, ``illuminati``,
+``jterator``) and ``tm_workflow`` (``manager.py``) — argparse verbs
+``init`` / ``run`` / ``collect`` / ``submit`` / ``resume`` / ``status`` /
+``log`` / ``cleanup`` / ``info`` (SURVEY.md §2 row 1).
+
+Here the per-step scripts fold into one ``tmx`` entry point::
+
+    tmx create  --root DIR --name NAME
+    tmx <step>  init    --root DIR [step args...]
+    tmx <step>  run     --root DIR --job N
+    tmx <step>  collect --root DIR
+    tmx <step>  info    --root DIR
+    tmx workflow submit --root DIR [--description wf.yaml] [--resume]
+    tmx workflow status --root DIR
+    tmx log     --root DIR [--tail N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tmlibrary_tpu.log import configure_logging
+from tmlibrary_tpu.models.experiment import Experiment
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.workflow.engine import (
+    RunLedger,
+    Workflow,
+    WorkflowDescription,
+)
+from tmlibrary_tpu.workflow.registry import get_step, list_steps
+
+
+def _add_common(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--root", required=True, help="experiment store directory")
+    parser.add_argument("-v", "--verbosity", action="count", default=0)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tmx", description="TPU-native microscopy image analysis"
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_create = sub.add_parser("create", help="create an empty experiment store")
+    _add_common(p_create)
+    p_create.add_argument("--name", required=True)
+
+    p_log = sub.add_parser("log", help="show the run ledger")
+    _add_common(p_log)
+    p_log.add_argument("--tail", type=int, default=20)
+
+    p_wf = sub.add_parser("workflow", help="full workflow orchestration")
+    wf_sub = p_wf.add_subparsers(dest="verb", required=True)
+    p_submit = wf_sub.add_parser("submit", help="run the workflow")
+    _add_common(p_submit)
+    p_submit.add_argument("--description", help="workflow YAML (default: canonical)")
+    p_submit.add_argument("--resume", action="store_true",
+                          help="skip work completed in a previous run")
+    p_status = wf_sub.add_parser("status", help="per-step progress")
+    _add_common(p_status)
+
+    for name in list_steps():
+        step_cls = get_step(name)
+        p_step = sub.add_parser(name, help=f"{name} step")
+        verb_sub = p_step.add_subparsers(dest="verb", required=True)
+        p_init = verb_sub.add_parser("init", help="plan batches")
+        _add_common(p_init)
+        step_cls.batch_args.add_to_parser(p_init)
+        p_run = verb_sub.add_parser("run", help="run one batch (or all)")
+        _add_common(p_run)
+        p_run.add_argument("--job", type=int, default=None,
+                           help="batch index (default: all)")
+        p_collect = verb_sub.add_parser("collect", help="merge phase")
+        _add_common(p_collect)
+        p_info = verb_sub.add_parser("info", help="planned batches")
+        _add_common(p_info)
+    return parser
+
+
+def _open_store(args) -> ExperimentStore:
+    return ExperimentStore.open(Path(args.root))
+
+
+def cmd_create(args) -> int:
+    root = Path(args.root)
+    if (root / ExperimentStore.MANIFEST).exists():
+        print(f"error: store already exists at {root}", file=sys.stderr)
+        return 1
+    placeholder = Experiment(
+        name=args.name, plates=[], channels=[], site_height=1, site_width=1
+    )
+    ExperimentStore.create(root, placeholder)
+    print(f"created experiment '{args.name}' at {root}")
+    return 0
+
+
+def cmd_workflow(args) -> int:
+    store = _open_store(args)
+    if args.verb == "status":
+        status = RunLedger(store.workflow_dir / "ledger.jsonl").status()
+        if not status:
+            print("no workflow runs recorded")
+            return 0
+        for step, entry in status.items():
+            done = entry["batches_done"]
+            total = entry["n_batches"]
+            frac = f"{done}/{total}" if total is not None else str(done)
+            line = f"{step:12s} {entry['state']:8s} batches {frac} " \
+                   f"({entry['elapsed']:.1f}s)"
+            if entry.get("error"):
+                line += f" error: {entry['error']}"
+            print(line)
+        return 0
+    # submit
+    if args.description:
+        desc = WorkflowDescription.load(Path(args.description))
+    else:
+        wf_yaml = store.workflow_dir / "workflow.yaml"
+        if wf_yaml.exists():
+            desc = WorkflowDescription.load(wf_yaml)
+        else:
+            print("error: no workflow description (pass --description or put "
+                  "workflow.yaml in the store's workflow dir)", file=sys.stderr)
+            return 1
+    summary = Workflow(store, desc).run(resume=args.resume)
+    print(json.dumps(summary, default=str, indent=2))
+    return 0
+
+
+def cmd_step(args) -> int:
+    store = _open_store(args)
+    step = get_step(args.command)(store)
+    if args.verb == "init":
+        step_args = {
+            a.name: getattr(args, a.name)
+            for a in step.batch_args
+            if getattr(args, a.name, None) is not None
+        }
+        batches = step.init(step_args)
+        print(f"{args.command}: planned {len(batches)} batches")
+        return 0
+    if args.verb == "run":
+        indices = [args.job] if args.job is not None else step.list_batches()
+        for i in indices:
+            result = step.run(i)
+            print(f"{args.command} batch {i}: {json.dumps(result, default=str)}")
+        return 0
+    if args.verb == "collect":
+        print(json.dumps(step.collect(), default=str))
+        return 0
+    if args.verb == "info":
+        for i in step.list_batches():
+            batch = step.load_batch(i)
+            keys = {k: v for k, v in batch.items() if k not in ("args",)}
+            print(f"batch {i}: {json.dumps(keys, default=str)[:200]}")
+        return 0
+    return 1
+
+
+def cmd_log(args) -> int:
+    store = _open_store(args)
+    ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+    for event in ledger.events()[-args.tail:]:
+        print(json.dumps(event, default=str))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    configure_logging(getattr(args, "verbosity", 0))
+    try:
+        if args.command == "create":
+            return cmd_create(args)
+        if args.command == "workflow":
+            return cmd_workflow(args)
+        if args.command == "log":
+            return cmd_log(args)
+        return cmd_step(args)
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

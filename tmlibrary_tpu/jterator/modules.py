@@ -269,6 +269,54 @@ def segment_secondary(
     return {"objects": labels}
 
 
+@register_module("measure_intensity")
+def measure_intensity(objects_image, intensity_image, max_objects: int = 256):
+    """Reference ``jtmodules/measure_intensity.py``."""
+    from tmlibrary_tpu.ops.measure import intensity_features
+
+    return {
+        "measurements": intensity_features(objects_image, intensity_image, max_objects)
+    }
+
+
+@register_module("measure_morphology")
+def measure_morphology(objects_image, max_objects: int = 256):
+    """Reference ``jtmodules/measure_morphology.py``."""
+    from tmlibrary_tpu.ops.measure import morphology_features
+
+    return {"measurements": morphology_features(objects_image, max_objects)}
+
+
+@register_module("measure_texture")
+def measure_texture(
+    objects_image,
+    intensity_image,
+    levels: int = 32,
+    distance: int = 1,
+    max_objects: int = 256,
+):
+    """Reference ``jtmodules/measure_texture.py`` (Haralick)."""
+    from tmlibrary_tpu.ops.measure import haralick_features
+
+    return {
+        "measurements": haralick_features(
+            objects_image, intensity_image, max_objects, levels=levels, distance=distance
+        )
+    }
+
+
+@register_module("measure_zernike")
+def measure_zernike(objects_image, degree: int = 9, patch: int = 64, max_objects: int = 256):
+    """Reference ``jtmodules/measure_zernike.py``."""
+    from tmlibrary_tpu.ops.measure import zernike_features
+
+    return {
+        "measurements": zernike_features(
+            objects_image, max_objects, degree=degree, patch=patch
+        )
+    }
+
+
 @register_module("expand_or_shrink")
 def expand_or_shrink(label_image, n: int = 1, max_objects: int = 256):
     """Reference ``jtmodules/expand_or_shrink.py``: morphological expansion

@@ -175,13 +175,15 @@ class ImageAnalysisPipeline:
 
     # ------------------------------------------------------------ batch fn
     def build_batch_fn(
-        self, window: tuple[int, int, int, int] | None = None
+        self, window: tuple[int, int, int, int] | None = None, jit: bool = True
     ) -> Callable:
         """jit(vmap(preprocess ∘ site_fn)) over the site-batch axis.
 
         Signature: ``fn(raw: {ch: (B,H,W)}, stats: {ch: (mean,std)},
         shifts: (B,2)) -> SiteResult`` with a leading batch axis on every
         leaf.  ``stats`` fields broadcast (shared per channel).
+        ``jit=False`` returns the traceable vmapped function (for callers
+        composing their own jit, e.g. with explicit shardings).
         """
         site_fn = self.build_site_fn()
         preprocess = self.build_preprocess_fn(window)
@@ -195,4 +197,4 @@ class ImageAnalysisPipeline:
             return site_fn(images)
 
         batched = jax.vmap(one_site, in_axes=(0, None, 0))
-        return jax.jit(batched)
+        return jax.jit(batched) if jit else batched

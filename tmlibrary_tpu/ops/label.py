@@ -4,12 +4,14 @@ Reference parity: ``jtmodules/label.py`` (mahotas/scipy connected components),
 ``jtmodules/fill.py`` (binary hole filling), ``jtmodules/filter.py``
 (filter objects by feature) — all native-library calls in the reference.
 
-TPU design (SURVEY.md §8 "hard parts" #1): labeling is an iterative
-min-label propagation with **pointer jumping** inside ``lax.while_loop`` —
-each pixel carries the linear index of some pixel in its component; per
-iteration every pixel takes the min over its neighborhood, then follows its
-current label's label (path halving), so convergence is ~O(log diameter)
-rather than O(diameter).  All shapes static; ``vmap``-safe.
+TPU design (SURVEY.md §8 "hard parts" #1): labeling iterates {diagonal
+neighbor min-propagation, row run-scan, column run-scan} inside
+``lax.while_loop`` — each pixel carries the minimum linear index seen in
+its component, and the segmented associative scans (``_run_min_scan``)
+move labels across entire straight runs per iteration with **no gathers**
+(TPU's slow path).  Convergence is ~O(turns of the most serpentine
+component): a handful of iterations for blob-like microscopy objects.
+All shapes static; ``vmap``-safe.
 
 Label order is **bit-identical to ``scipy.ndimage.label``**: the converged
 label of a component is its minimum linear index (= first pixel in row-major
@@ -39,11 +41,17 @@ def _neighbor_shifts(connectivity: int) -> list[tuple[int, int]]:
     raise ValueError("connectivity must be 4 or 8")
 
 
-def _shift_with_fill(arr: jax.Array, dy: int, dx: int, fill) -> jax.Array:
-    """Shift a 2-D array by (dy, dx), filling exposed borders with ``fill``."""
+def shift_with_fill(arr: jax.Array, dy: int, dx: int, fill) -> jax.Array:
+    """``out[y, x] = arr[y + dy, x + dx]`` with ``fill`` at exposed borders
+    (the neighborhood-access primitive shared by labeling, morphology and
+    the GLCM ops)."""
     h, w = arr.shape
     padded = jnp.pad(arr, ((1, 1), (1, 1)), constant_values=fill)
     return lax.dynamic_slice(padded, (1 + dy, 1 + dx), (h, w))
+
+
+# backward-compat private alias (internal call sites predate the rename)
+_shift_with_fill = shift_with_fill
 
 
 def _propagate_min(labels: jax.Array, mask: jax.Array, shifts) -> jax.Array:
@@ -54,6 +62,34 @@ def _propagate_min(labels: jax.Array, mask: jax.Array, shifts) -> jax.Array:
     return jnp.where(mask, out, _BIG)
 
 
+def _run_min_scan(labels: jax.Array, mask: jax.Array, axis: int) -> jax.Array:
+    """Propagate the min label across contiguous foreground runs along
+    ``axis`` via a segmented associative scan (both directions) — O(log N)
+    depth, no gathers (TPU gathers are the slow path)."""
+    # run start: previous element along the axis is background
+    is_start = mask & ~_shift_with_fill(
+        mask, *((-1, 0) if axis == 0 else (0, -1)), False
+    )
+    # background pixels are their own segment so nothing crosses them
+    resets = is_start | ~mask
+
+    def op(a, b):
+        av, ar = a
+        bv, br = b
+        return jnp.where(br, bv, jnp.minimum(av, bv)), ar | br
+
+    fwd, _ = lax.associative_scan(op, (labels, resets), axis=axis)
+    # reverse pass: a run's first element holds the run min after the
+    # forward pass only at its end; sweep back so every element gets it.
+    # run end: next element along the axis is background
+    is_end = mask & ~_shift_with_fill(
+        mask, *((1, 0) if axis == 0 else (0, 1)), False
+    )
+    resets_r = is_end | ~mask
+    bwd, _ = lax.associative_scan(op, (fwd, resets_r), axis=axis, reverse=True)
+    return jnp.where(mask, bwd, _BIG)
+
+
 def connected_components(
     mask: jax.Array, connectivity: int = 8
 ) -> tuple[jax.Array, jax.Array]:
@@ -61,10 +97,22 @@ def connected_components(
 
     Returns ``(labels, count)``: int32 label image (0 = background, 1..N in
     scipy scan order) and the scalar component count.
+
+    Algorithm: iterate {8/4-neighbor min propagation, row run-scan, column
+    run-scan} to a fixed point.  The run scans move labels across entire
+    straight runs per iteration, so convergence is ~O(number of "turns" of
+    the most serpentine component) — a handful of iterations for blob-like
+    microscopy objects — with no per-pixel gathers.
     """
     mask = jnp.asarray(mask, bool)
     h, w = mask.shape
-    shifts = _neighbor_shifts(connectivity)
+    if connectivity == 4:
+        # row+col run scans fully cover 4-neighbor propagation
+        shifts = []
+    elif connectivity == 8:
+        shifts = [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+    else:
+        raise ValueError("connectivity must be 4 or 8")
     linear = jnp.arange(h * w, dtype=jnp.int32).reshape(h, w)
     init = jnp.where(mask, linear, _BIG)
 
@@ -74,15 +122,9 @@ def connected_components(
 
     def body(state):
         labels, _ = state
-        new = _propagate_min(labels, mask, shifts)
-        # pointer jumping (path halving): follow label -> label's label.
-        # Background pixels hold _BIG; gather with a clipped index and
-        # re-mask so they stay _BIG.
-        flat = new.reshape(-1)
-        for _ in range(2):
-            idx = jnp.clip(flat, 0, h * w - 1)
-            flat = jnp.minimum(flat, jnp.where(flat < _BIG, flat[idx], _BIG))
-        new = jnp.where(mask, flat.reshape(h, w), _BIG)
+        new = _propagate_min(labels, mask, shifts) if shifts else labels
+        new = _run_min_scan(new, mask, axis=1)
+        new = _run_min_scan(new, mask, axis=0)
         changed = jnp.any(new != labels)
         return new, changed
 

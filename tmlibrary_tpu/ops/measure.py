@@ -1,0 +1,358 @@
+"""Per-object feature measurement.
+
+Reference parity: ``jtmodules/measure_intensity.py``,
+``measure_morphology.py``, ``measure_texture.py`` (mahotas Haralick),
+``measure_zernike.py`` and the extractors in ``jtlib/features/``.
+
+TPU design (SURVEY.md §8 hard parts #3/#4): measurements are ragged per
+site (variable object count), so everything is computed into fixed
+``(max_objects, ...)`` buffers with ``jax.ops.segment_sum``-family
+reductions over the label image — rows past a site's object count are
+garbage and must be masked by the caller using the object count.  Haralick
+GLCMs accumulate with one scatter-add per direction over
+(label, level, level) cells; Zernike moments project per-object patches
+(static patch size) onto radial polynomials evaluated at each object's own
+scale.  Everything jit/vmap-safe, fp32.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmlibrary_tpu.ops.label import shift_with_fill
+
+
+def _seg_sum(values: jax.Array, labels: jax.Array, max_objects: int) -> jax.Array:
+    """segment_sum over label ids; returns per-object rows 1..max_objects."""
+    flat = labels.reshape(-1)
+    vals = values.reshape(-1)
+    out = jax.ops.segment_sum(vals, flat, num_segments=max_objects + 1)
+    return out[1:]
+
+
+# ------------------------------------------------------------------ intensity
+def intensity_features(
+    labels: jax.Array, intensity: jax.Array, max_objects: int
+) -> dict[str, jax.Array]:
+    """Reference feature set of ``jtlib/features/intensity.py``:
+    max, mean, min, sum, std per object."""
+    labels = jnp.asarray(labels, jnp.int32)
+    img = jnp.asarray(intensity, jnp.float32)
+    ones = jnp.ones_like(img)
+    count = _seg_sum(ones, labels, max_objects)
+    safe_n = jnp.maximum(count, 1.0)
+    total = _seg_sum(img, labels, max_objects)
+    mean = total / safe_n
+    sq = _seg_sum(img * img, labels, max_objects)
+    var = jnp.maximum(sq / safe_n - mean * mean, 0.0)
+    flat = labels.reshape(-1)
+    mx = jax.ops.segment_max(img.reshape(-1), flat, num_segments=max_objects + 1)[1:]
+    mn = jax.ops.segment_min(img.reshape(-1), flat, num_segments=max_objects + 1)[1:]
+    present = count > 0
+    return {
+        "Intensity_max": jnp.where(present, mx, 0.0),
+        "Intensity_mean": mean,
+        "Intensity_min": jnp.where(present, mn, 0.0),
+        "Intensity_sum": total,
+        "Intensity_std": jnp.sqrt(var),
+    }
+
+
+# ----------------------------------------------------------------- morphology
+def morphology_features(labels: jax.Array, max_objects: int) -> dict[str, jax.Array]:
+    """Reference feature set of ``jtlib/features/morphology.py``
+    (CellProfiler-style): area, centroids, bounding box/extent, perimeter
+    (8-connected boundary pixel count), equivalent diameter, form factor,
+    second-moment ellipse (major/minor axis length, eccentricity,
+    orientation).  Convex-hull features (solidity) are host-side only and
+    live in the polygon pathway.
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    h, w = labels.shape
+    yy, xx = jnp.meshgrid(
+        jnp.arange(h, dtype=jnp.float32), jnp.arange(w, dtype=jnp.float32), indexing="ij"
+    )
+    ones = jnp.ones((h, w), jnp.float32)
+    area = _seg_sum(ones, labels, max_objects)
+    safe_a = jnp.maximum(area, 1.0)
+    cy = _seg_sum(yy, labels, max_objects) / safe_a
+    cx = _seg_sum(xx, labels, max_objects) / safe_a
+
+    # bounding box via segment min/max
+    flat = labels.reshape(-1)
+    y_min = jax.ops.segment_min(yy.reshape(-1), flat, num_segments=max_objects + 1)[1:]
+    y_max = jax.ops.segment_max(yy.reshape(-1), flat, num_segments=max_objects + 1)[1:]
+    x_min = jax.ops.segment_min(xx.reshape(-1), flat, num_segments=max_objects + 1)[1:]
+    x_max = jax.ops.segment_max(xx.reshape(-1), flat, num_segments=max_objects + 1)[1:]
+    present = area > 0
+    bbox_h = jnp.where(present, y_max - y_min + 1.0, 0.0)
+    bbox_w = jnp.where(present, x_max - x_min + 1.0, 0.0)
+    extent = area / jnp.maximum(bbox_h * bbox_w, 1.0)
+
+    # perimeter: pixels with at least one 4-neighbor of a different label
+    boundary = jnp.zeros((h, w), bool)
+    for dy, dx in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+        boundary = boundary | (shift_with_fill(labels, dy, dx, 0) != labels)
+    boundary = boundary & (labels > 0)
+    perimeter = _seg_sum(boundary.astype(jnp.float32), labels, max_objects)
+
+    # central second moments -> ellipse fit (CellProfiler/regionprops math)
+    mu_yy = _seg_sum(yy * yy, labels, max_objects) / safe_a - cy * cy
+    mu_xx = _seg_sum(xx * xx, labels, max_objects) / safe_a - cx * cx
+    mu_yx = _seg_sum(yy * xx, labels, max_objects) / safe_a - cy * cx
+    # regionprops adds 1/12 (pixel as unit square) to the diagonal
+    mu_yy = mu_yy + 1.0 / 12.0
+    mu_xx = mu_xx + 1.0 / 12.0
+    common = jnp.sqrt(jnp.maximum((mu_yy - mu_xx) ** 2 + 4.0 * mu_yx**2, 0.0))
+    l1 = (mu_yy + mu_xx + common) / 2.0
+    l2 = (mu_yy + mu_xx - common) / 2.0
+    l2 = jnp.clip(l2, 1e-12, None)
+    major = 4.0 * jnp.sqrt(jnp.maximum(l1, 0.0))
+    minor = 4.0 * jnp.sqrt(jnp.maximum(l2, 0.0))
+    eccentricity = jnp.sqrt(jnp.clip(1.0 - l2 / jnp.maximum(l1, 1e-12), 0.0, 1.0))
+    # angle of the major axis measured from the +x (column) axis in
+    # (-pi/2, pi/2]; note skimage regionprops measures from the row axis
+    orientation = 0.5 * jnp.arctan2(2.0 * mu_yx, mu_xx - mu_yy)
+
+    equivalent_diameter = jnp.sqrt(4.0 * area / jnp.pi)
+    form_factor = 4.0 * jnp.pi * area / jnp.maximum(perimeter**2, 1.0)
+
+    z = jnp.zeros_like(area)
+    def m(v):
+        return jnp.where(present, v, z)
+
+    return {
+        "Morphology_area": area,
+        "Morphology_centroid_y": m(cy),
+        "Morphology_centroid_x": m(cx),
+        "Morphology_bbox_height": bbox_h,
+        "Morphology_bbox_width": bbox_w,
+        "Morphology_extent": m(extent),
+        "Morphology_perimeter": perimeter,
+        "Morphology_equivalent_diameter": m(equivalent_diameter),
+        "Morphology_form_factor": m(form_factor),
+        "Morphology_major_axis_length": m(major),
+        "Morphology_minor_axis_length": m(minor),
+        "Morphology_eccentricity": m(eccentricity),
+        "Morphology_orientation": m(orientation),
+    }
+
+
+# -------------------------------------------------------------------- texture
+def _glcm(
+    labels: jax.Array,
+    quantized: jax.Array,
+    max_objects: int,
+    levels: int,
+    offset: tuple[int, int],
+) -> jax.Array:
+    """Per-object gray-level co-occurrence counts for one direction →
+    (max_objects, levels, levels).  Symmetric (mahotas-style: pairs counted
+    both ways)."""
+    dy, dx = offset
+    lab2 = shift_with_fill(labels, -dy, -dx, 0)
+    q2 = shift_with_fill(quantized, -dy, -dx, 0)
+    valid = (labels > 0) & (lab2 == labels)
+    # scatter-add into (label, q1, q2) cells
+    idx = (
+        labels.astype(jnp.int32) * (levels * levels)
+        + quantized * levels
+        + q2
+    )
+    idx = jnp.where(valid, idx, 0)
+    counts = jax.ops.segment_sum(
+        valid.reshape(-1).astype(jnp.float32),
+        idx.reshape(-1),
+        num_segments=(max_objects + 1) * levels * levels,
+    )
+    glcm = counts.reshape(max_objects + 1, levels, levels)[1:]
+    return glcm + jnp.swapaxes(glcm, 1, 2)
+
+
+def haralick_features(
+    labels: jax.Array,
+    intensity: jax.Array,
+    max_objects: int,
+    levels: int = 32,
+    distance: int = 1,
+) -> dict[str, jax.Array]:
+    """Haralick texture features averaged over the 4 directions
+    (reference: mahotas.features.haralick via ``jtlib/features/texture.py``).
+
+    Features: angular second moment, contrast, correlation, sum of squares
+    variance, inverse difference moment (homogeneity), sum average, sum
+    variance, sum entropy, entropy, difference variance, difference entropy,
+    and the two information measures of correlation.
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    img = jnp.asarray(intensity, jnp.float32)
+    # global [min,max] quantization into `levels` bins (static shape)
+    lo = jnp.min(img)
+    hi = jnp.max(img)
+    span = jnp.maximum(hi - lo, 1e-6)
+    q = jnp.clip(((img - lo) / span * levels).astype(jnp.int32), 0, levels - 1)
+
+    offsets = [(0, distance), (distance, 0), (distance, distance), (distance, -distance)]
+    i_idx = jnp.arange(levels, dtype=jnp.float32)[None, :, None]
+    j_idx = jnp.arange(levels, dtype=jnp.float32)[None, None, :]
+    eps = 1e-10
+
+    acc: dict[str, jax.Array] = {}
+    for off in offsets:
+        glcm = _glcm(labels, q, max_objects, levels, off)
+        total = jnp.maximum(glcm.sum(axis=(1, 2), keepdims=True), eps)
+        p = glcm / total  # (M, L, L) normalized
+
+        px = p.sum(axis=2)  # (M, L)
+        py = p.sum(axis=1)
+        mu_x = (px * i_idx[:, :, 0]).sum(axis=1)
+        mu_y = (py * i_idx[:, :, 0]).sum(axis=1)
+        sd_x = jnp.sqrt(jnp.maximum((px * (i_idx[:, :, 0] - mu_x[:, None]) ** 2).sum(axis=1), 0.0))
+        sd_y = jnp.sqrt(jnp.maximum((py * (i_idx[:, :, 0] - mu_y[:, None]) ** 2).sum(axis=1), 0.0))
+
+        asm = (p**2).sum(axis=(1, 2))
+        contrast = (p * (i_idx - j_idx) ** 2).sum(axis=(1, 2))
+        corr_num = (p * (i_idx - mu_x[:, None, None]) * (j_idx - mu_y[:, None, None])).sum(axis=(1, 2))
+        correlation = corr_num / jnp.maximum(sd_x * sd_y, eps)
+        variance = (p * (i_idx - mu_x[:, None, None]) ** 2).sum(axis=(1, 2))
+        idm = (p / (1.0 + (i_idx - j_idx) ** 2)).sum(axis=(1, 2))
+        entropy = -(p * jnp.log(p + eps)).sum(axis=(1, 2))
+
+        # p_{x+y}(k), k = i+j in [0, 2L-2]; p_{x-y}(k), k = |i-j| in [0, L-1]
+        k_sum = jnp.arange(2 * levels - 1, dtype=jnp.float32)
+        sum_idx = (jnp.arange(levels)[:, None] + jnp.arange(levels)[None, :]).reshape(-1)
+        p_flat = p.reshape(max_objects, -1)
+        p_sum = jax.vmap(
+            lambda row: jax.ops.segment_sum(row, sum_idx, num_segments=2 * levels - 1)
+        )(p_flat)
+        diff_idx = jnp.abs(jnp.arange(levels)[:, None] - jnp.arange(levels)[None, :]).reshape(-1)
+        p_diff = jax.vmap(
+            lambda row: jax.ops.segment_sum(row, diff_idx, num_segments=levels)
+        )(p_flat)
+
+        sum_avg = (p_sum * k_sum).sum(axis=1)
+        sum_entropy = -(p_sum * jnp.log(p_sum + eps)).sum(axis=1)
+        sum_var = (p_sum * (k_sum - sum_entropy[:, None]) ** 2).sum(axis=1)  # Haralick's defn
+        k_diff = jnp.arange(levels, dtype=jnp.float32)
+        diff_avg = (p_diff * k_diff).sum(axis=1)
+        diff_var = (p_diff * (k_diff - diff_avg[:, None]) ** 2).sum(axis=1)
+        diff_entropy = -(p_diff * jnp.log(p_diff + eps)).sum(axis=1)
+
+        hx = -(px * jnp.log(px + eps)).sum(axis=1)
+        hy = -(py * jnp.log(py + eps)).sum(axis=1)
+        pxpy = px[:, :, None] * py[:, None, :]
+        hxy1 = -(p * jnp.log(pxpy + eps)).sum(axis=(1, 2))
+        hxy2 = -(pxpy * jnp.log(pxpy + eps)).sum(axis=(1, 2))
+        imc1 = (entropy - hxy1) / jnp.maximum(jnp.maximum(hx, hy), eps)
+        imc2 = jnp.sqrt(jnp.clip(1.0 - jnp.exp(-2.0 * (hxy2 - entropy)), 0.0, 1.0))
+
+        feats = {
+            "Texture_angular_second_moment": asm,
+            "Texture_contrast": contrast,
+            "Texture_correlation": correlation,
+            "Texture_sum_of_squares_variance": variance,
+            "Texture_inverse_difference_moment": idm,
+            "Texture_sum_average": sum_avg,
+            "Texture_sum_variance": sum_var,
+            "Texture_sum_entropy": sum_entropy,
+            "Texture_entropy": entropy,
+            "Texture_difference_variance": diff_var,
+            "Texture_difference_entropy": diff_entropy,
+            "Texture_info_measure_corr_1": imc1,
+            "Texture_info_measure_corr_2": imc2,
+        }
+        for k, v in feats.items():
+            acc[k] = acc.get(k, 0.0) + v / len(offsets)
+    return acc
+
+
+# -------------------------------------------------------------------- zernike
+def _zernike_coeffs(degree: int) -> list[tuple[int, int, np.ndarray]]:
+    """Static (n, m, radial-coefficient) table for n<=degree, m>=0,
+    (n-m) even.  Coefficient k applies to rho^(n-2k)."""
+    out = []
+    for n in range(degree + 1):
+        for m_ in range(n % 2, n + 1, 2):
+            coeffs = np.zeros((n - m_) // 2 + 1)
+            for k in range((n - m_) // 2 + 1):
+                coeffs[k] = (
+                    (-1) ** k
+                    * math.factorial(n - k)
+                    / (
+                        math.factorial(k)
+                        * math.factorial((n + m_) // 2 - k)
+                        * math.factorial((n - m_) // 2 - k)
+                    )
+                )
+            out.append((n, m_, coeffs))
+    return out
+
+
+def zernike_features(
+    labels: jax.Array,
+    max_objects: int,
+    degree: int = 9,
+    patch: int = 64,
+) -> dict[str, jax.Array]:
+    """Zernike moment magnitudes |Z_nm| per object
+    (reference: ``jtlib/features/zernike.py`` via centrosome/mahotas).
+
+    Each object's mask is sampled on a static ``patch``-sized window centered
+    at its centroid and mapped onto the unit disk using the object's own
+    radius (max centroid distance), then projected onto the Zernike basis.
+    Objects larger than ``patch`` are effectively cropped (choose ``patch``
+    above the expected object diameter).
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    h, w = labels.shape
+    yy, xx = jnp.meshgrid(
+        jnp.arange(h, dtype=jnp.float32), jnp.arange(w, dtype=jnp.float32), indexing="ij"
+    )
+    ones = jnp.ones((h, w), jnp.float32)
+    area = _seg_sum(ones, labels, max_objects)
+    safe_a = jnp.maximum(area, 1.0)
+    cy = _seg_sum(yy, labels, max_objects) / safe_a
+    cx = _seg_sum(xx, labels, max_objects) / safe_a
+
+    # per-object patch extraction at the centroid (static patch size)
+    half = patch // 2
+    pad = half
+    padded = jnp.pad(labels, ((pad, pad), (pad, pad)))
+
+    def extract_one(label_id, cy_i, cx_i):
+        y0 = jnp.clip(jnp.round(cy_i).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.round(cx_i).astype(jnp.int32), 0, w - 1)
+        window = jax.lax.dynamic_slice(padded, (y0, x0), (patch, patch))
+        return (window == label_id).astype(jnp.float32)
+
+    ids = jnp.arange(1, max_objects + 1, dtype=jnp.int32)
+    masks = jax.vmap(extract_one)(ids, cy, cx)  # (M, patch, patch)
+
+    # unit-disk coordinates per object, scaled by the object's max radius
+    gy = jnp.arange(patch, dtype=jnp.float32) - (half - 0.5)
+    gx = jnp.arange(patch, dtype=jnp.float32) - (half - 0.5)
+    dy, dx = jnp.meshgrid(gy, gx, indexing="ij")
+    r_pix = jnp.sqrt(dy**2 + dx**2)
+    r_obj = jnp.max(
+        jnp.where(masks > 0, r_pix[None], 0.0), axis=(1, 2)
+    )
+    r_obj = jnp.maximum(r_obj, 1.0)
+    rho = r_pix[None] / r_obj[:, None, None]  # (M, patch, patch)
+    theta = jnp.arctan2(dy, dx)[None]
+    inside = (rho <= 1.0) & (masks > 0)
+    npix = jnp.maximum(inside.sum(axis=(1, 2)).astype(jnp.float32), 1.0)
+
+    out: dict[str, jax.Array] = {}
+    for n, m_, coeffs in _zernike_coeffs(degree):
+        radial = jnp.zeros_like(rho)
+        for k, c in enumerate(coeffs):
+            radial = radial + float(c) * rho ** (n - 2 * k)
+        re = (radial * jnp.cos(m_ * theta) * inside).sum(axis=(1, 2))
+        im = (radial * jnp.sin(m_ * theta) * inside).sum(axis=(1, 2))
+        mag = jnp.sqrt(re**2 + im**2) * (n + 1) / jnp.pi / npix
+        out[f"Zernike_{n}_{m_}"] = jnp.where(area > 0, mag, 0.0)
+    return out

@@ -1,0 +1,79 @@
+"""Pyramid tiling ops (illuminati).
+
+Reference parity: ``tmlib/workflow/illuminati/api.py`` ``PyramidBuilder`` —
+zoomify-style pyramid: level 0 is the corrected/aligned/stitched well
+mosaic cut into 256-px tiles; each higher level is a 2x2 mean downsample of
+the previous, with per-level jobs and inter-level dependencies in the
+reference (SURVEY.md §4.5).
+
+TPU design: the mosaic is one array (sharded for big plates);
+``lax.reduce_window`` mean-pooling builds the level chain on device; only
+PNG encoding of tiles is host-side.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+TILE_SIZE = 256
+
+
+def downsample_2x(img: jax.Array) -> jax.Array:
+    """2x2 mean pooling (one pyramid level step).  Odd trailing row/col are
+    edge-padded first so shape halving rounds up, matching zoomify."""
+    h, w = img.shape
+    ph, pw = h % 2, w % 2
+    img_f = jnp.asarray(img, jnp.float32)
+    if ph or pw:
+        img_f = jnp.pad(img_f, ((0, ph), (0, pw)), mode="edge")
+    summed = lax.reduce_window(
+        img_f, 0.0, lax.add, window_dimensions=(2, 2), window_strides=(2, 2),
+        padding="VALID",
+    )
+    return summed / 4.0
+
+
+def pyramid_levels(mosaic: jax.Array, n_levels: int | None = None) -> list[jax.Array]:
+    """Full level chain, level 0 (native) first.  ``n_levels=None`` builds
+    until the image fits in a single tile."""
+    levels = [jnp.asarray(mosaic, jnp.float32)]
+    if n_levels is None:
+        n_levels = 1
+        h, w = mosaic.shape
+        while max(h, w) > TILE_SIZE:
+            h, w = (h + 1) // 2, (w + 1) // 2
+            n_levels += 1
+    fn = jax.jit(downsample_2x)
+    for _ in range(n_levels - 1):
+        levels.append(fn(levels[-1]))
+    return levels
+
+
+def cut_tiles(level: np.ndarray) -> dict[tuple[int, int], np.ndarray]:
+    """Cut one level into 256-px tiles (host-side; edge tiles zero-padded to
+    full size, matching the reference's fixed tile geometry).  Keys are
+    (row, col) tile indices."""
+    level = np.asarray(level)
+    h, w = level.shape
+    tiles: dict[tuple[int, int], np.ndarray] = {}
+    for ty in range(0, max(h, 1), TILE_SIZE):
+        for tx in range(0, max(w, 1), TILE_SIZE):
+            tile = level[ty : ty + TILE_SIZE, tx : tx + TILE_SIZE]
+            if tile.shape != (TILE_SIZE, TILE_SIZE):
+                full = np.zeros((TILE_SIZE, TILE_SIZE), level.dtype)
+                full[: tile.shape[0], : tile.shape[1]] = tile
+                tile = full
+            tiles[(ty // TILE_SIZE, tx // TILE_SIZE)] = tile
+    return tiles
+
+
+def to_uint8(level: jax.Array, lower: float, upper: float) -> jax.Array:
+    """Percentile-stretch to display range (reference ``ChannelImage.scale``
+    with corilla's clip percentiles)."""
+    span = max(upper - lower, 1e-6)
+    return jnp.clip((jnp.asarray(level, jnp.float32) - lower) / span * 255.0, 0, 255).astype(
+        jnp.uint8
+    )

@@ -1,0 +1,74 @@
+"""Cycle-to-cycle image registration.
+
+Reference parity: ``tmlib/workflow/align/registration.py`` — per-site shift
+between acquisition cycles (the reference registers each cycle's site
+against the reference cycle and stores ``SiteShift`` rows plus the
+``SiteIntersection`` crop window).
+
+TPU design: FFT phase correlation in ``jnp.fft`` (XLA-native), batched over
+sites with ``vmap``.  Subpixel refinement is unnecessary for the reference's
+integer-shift semantics.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def phase_correlation(
+    reference: jax.Array, target: jax.Array, upsample_hint: None = None
+) -> tuple[jax.Array, jax.Array]:
+    """Integer (dy, dx) such that rolling ``target`` by (dy, dx) aligns it
+    with ``reference`` (i.e. ``reference[y, x] ≈ target[y - dy, x - dx]``).
+
+    Classic cross-power-spectrum method; shifts are returned in the
+    signed range [-H/2, H/2) / [-W/2, W/2).
+    """
+    a = jnp.asarray(reference, jnp.float32)
+    b = jnp.asarray(target, jnp.float32)
+    fa = jnp.fft.rfft2(a)
+    fb = jnp.fft.rfft2(b)
+    cross = fa * jnp.conj(fb)
+    denom = jnp.maximum(jnp.abs(cross), 1e-12)
+    corr = jnp.fft.irfft2(cross / denom, s=a.shape)
+    idx = jnp.argmax(corr)
+    h, w = a.shape
+    dy = idx // w
+    dx = idx % w
+    dy = jnp.where(dy > h // 2, dy - h, dy).astype(jnp.int32)
+    dx = jnp.where(dx > w // 2, dx - w, dx).astype(jnp.int32)
+    return dy, dx
+
+
+def batch_phase_correlation(
+    reference_stack: jax.Array, target_stack: jax.Array
+) -> jax.Array:
+    """vmap over the site axis → (B, 2) int32 shifts."""
+
+    def one(a, b):
+        dy, dx = phase_correlation(a, b)
+        return jnp.stack([dy, dx])
+
+    return jax.jit(jax.vmap(one))(reference_stack, target_stack)
+
+
+def intersection_window(all_shifts: jax.Array) -> dict[str, int]:
+    """Crop window covering the overlap of all cycles at all sites
+    (reference ``SiteIntersection``): positive dy pushes content down, so
+    the top margin must absorb the largest positive dy, etc.
+
+    ``all_shifts``: (N, 2) stacked (dy, dx) over every cycle and site
+    (host-side; returns Python ints for static crop shapes).
+    """
+    import numpy as np
+
+    s = np.asarray(all_shifts)
+    if s.size == 0:
+        return {"top": 0, "bottom": 0, "left": 0, "right": 0}
+    return {
+        "top": int(np.clip(s[:, 0].max(), 0, None)),
+        "bottom": int(np.clip(-s[:, 0].min(), 0, None)),
+        "left": int(np.clip(s[:, 1].max(), 0, None)),
+        "right": int(np.clip(-s[:, 1].min(), 0, None)),
+    }

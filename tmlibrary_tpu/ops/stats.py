@@ -1,0 +1,108 @@
+"""Online illumination statistics (corilla's numeric core).
+
+Reference parity: ``tmlib/workflow/corilla/stats.py`` ``OnlineStatistics`` —
+Welford per-pixel mean/variance over all sites of a channel, computed in the
+log10 domain, plus intensity percentiles; results feed
+``ChannelImage.correct`` (SURVEY.md §4.4).
+
+TPU design (BASELINE north star): the per-site update loop becomes
+``lax.scan`` over the site axis on each shard; shards combine with the
+parallel-variance (Chan et al.) merge — deterministic fold in device order,
+because floating-point Welford merging is order-sensitive (SURVEY.md §8 hard
+part #2).  Percentiles are EXACT for uint16 data: a 65536-bin histogram is
+accumulated alongside and inverted at finalize time.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+HIST_BINS = 65536  # exact for uint16 pixel data
+
+
+class WelfordState(NamedTuple):
+    """Per-pixel running statistics + global intensity histogram."""
+
+    n: jax.Array  # scalar float32 — number of sites seen
+    mean: jax.Array  # (H, W) float32 — running mean (log domain)
+    m2: jax.Array  # (H, W) float32 — running sum of squared deviations
+    hist: jax.Array  # (HIST_BINS,) float32 — raw-intensity histogram
+
+
+def welford_init(shape: tuple[int, int]) -> WelfordState:
+    return WelfordState(
+        n=jnp.zeros((), jnp.float32),
+        mean=jnp.zeros(shape, jnp.float32),
+        m2=jnp.zeros(shape, jnp.float32),
+        hist=jnp.zeros((HIST_BINS,), jnp.float32),
+    )
+
+
+def welford_update(state: WelfordState, raw: jax.Array) -> WelfordState:
+    """Fold one site (raw uint16-range image) into the statistics.
+
+    The mean/variance track ``log10(1 + raw)`` (the correction domain);
+    the histogram tracks raw intensities (the percentile domain) — same
+    split as the reference, which keeps separate stats and percentile
+    accumulators.
+    """
+    raw_f = jnp.asarray(raw, jnp.float32)
+    x = jnp.log10(1.0 + raw_f)
+    n = state.n + 1.0
+    delta = x - state.mean
+    mean = state.mean + delta / n
+    m2 = state.m2 + delta * (x - mean)
+    idx = jnp.clip(raw_f, 0, HIST_BINS - 1).astype(jnp.int32)
+    hist = state.hist.at[idx.reshape(-1)].add(1.0)
+    return WelfordState(n=n, mean=mean, m2=m2, hist=hist)
+
+
+def welford_scan(stack: jax.Array, init: WelfordState | None = None) -> WelfordState:
+    """``lax.scan`` the update over a (B, H, W) site stack."""
+    stack = jnp.asarray(stack)
+    if init is None:
+        init = welford_init(stack.shape[1:])
+
+    def step(state, x):
+        return welford_update(state, x), None
+
+    out, _ = lax.scan(step, init, stack)
+    return out
+
+
+def welford_merge(a: WelfordState, b: WelfordState) -> WelfordState:
+    """Chan et al. parallel combination of two disjoint-sample states."""
+    n = a.n + b.n
+    safe_n = jnp.maximum(n, 1.0)
+    delta = b.mean - a.mean
+    mean = a.mean + delta * (b.n / safe_n)
+    m2 = a.m2 + b.m2 + delta * delta * (a.n * b.n / safe_n)
+    return WelfordState(n=n, mean=mean, m2=m2, hist=a.hist + b.hist)
+
+
+def welford_finalize(
+    state: WelfordState, percentile_qs: tuple[float, ...] = (0.1, 1.0, 50.0, 99.0, 99.9)
+) -> dict[str, jax.Array]:
+    """Extract mean/std fields (log domain) and exact raw-intensity
+    percentiles (inverted from the histogram)."""
+    n = jnp.maximum(state.n, 1.0)
+    var = state.m2 / n  # population variance, matching np.std(ddof=0)
+    cum = jnp.cumsum(state.hist)
+    total = jnp.maximum(cum[-1], 1.0)
+    qs = jnp.asarray(percentile_qs, jnp.float32) / 100.0
+    # smallest intensity with cumulative count >= q * total
+    targets = qs * total
+    values = jnp.searchsorted(cum, targets, side="left").astype(jnp.float32)
+    return {
+        "mean_log": state.mean,
+        "std_log": jnp.sqrt(jnp.maximum(var, 0.0)),
+        "var_log": var,
+        "n": state.n,
+        "percentile_keys": jnp.asarray(percentile_qs, jnp.float32),
+        "percentile_values": jnp.clip(values, 0, HIST_BINS - 1),
+        "hist": state.hist,
+    }

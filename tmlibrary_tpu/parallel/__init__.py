@@ -1,0 +1,20 @@
+"""Parallel execution layer: device meshes, sharded batch execution,
+cross-device statistics reduction.
+
+Reference parity: the reference's "distributed backend" is GC3Pie job
+fan-out over SSH/SLURM/SGE plus PostgreSQL/Citus shared state (SURVEY.md
+§2 row "Distributed comm backend") — there are no NCCL/MPI collectives to
+port.  The TPU-native equivalent is:
+
+- a ``jax.sharding.Mesh`` over the chips (``mesh.py``) — the "cluster";
+- the site axis sharded over the mesh (``shard_map``) — the "job fan-out";
+- XLA collectives over ICI/DCN (psum/all_gather) for reductions that the
+  reference did by writing per-job results into the DB and merging in a
+  collect phase (``stats.py``: corilla's cross-device Welford merge);
+- ``jax.distributed`` multi-host init for pod scale (``dist.py``).
+"""
+
+from tmlibrary_tpu.parallel.mesh import site_mesh, shard_batch
+from tmlibrary_tpu.parallel.stats import sharded_channel_stats
+
+__all__ = ["site_mesh", "shard_batch", "sharded_channel_stats"]

@@ -1,0 +1,55 @@
+"""Device mesh construction and site-axis sharding helpers.
+
+The canonical layout: a 1-D mesh with axis ``"sites"`` over all chips; the
+leading (site-batch) axis of every pixel stack shards across it.  This is
+the TPU translation of the reference's per-site job fan-out
+(``tmlib/workflow/api.py`` ``create_run_batches`` → GC3Pie jobs): instead of
+N cluster jobs each taking a site sublist, one ``shard_map``-ped program
+takes 1/N of the site axis per chip.
+
+For multi-host pods, build the same mesh over ``jax.devices()`` after
+``jax.distributed.initialize`` — collectives then ride ICI within a slice
+and DCN across slices with no code change.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tmlibrary_tpu.errors import ShardingError
+
+
+def site_mesh(n_devices: int | None = None, axis: str = "sites") -> Mesh:
+    """A 1-D mesh over the first ``n_devices`` visible devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            raise ShardingError(
+                f"requested {n_devices} devices, only {len(devs)} visible"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "sites") -> NamedSharding:
+    """Sharding for a (B, ...) stack: leading axis split over the mesh."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(array, mesh: Mesh, axis: str = "sites"):
+    """Place a host (B, ...) array onto the mesh, sharded on the leading
+    axis.  B must divide evenly by the mesh size (pad upstream — batch
+    planning in the workflow layer rounds site batches to multiples of the
+    mesh size, the moral equivalent of the reference's ``create_partitions``)."""
+    n = mesh.devices.size
+    if array.shape[0] % n != 0:
+        raise ShardingError(
+            f"batch axis {array.shape[0]} not divisible by mesh size {n}"
+        )
+    return jax.device_put(array, batch_sharding(mesh, axis))

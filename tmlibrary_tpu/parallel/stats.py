@@ -1,0 +1,76 @@
+"""Cross-device corilla: sharded Welford with deterministic tree merge.
+
+Reference parity: ``corilla``'s collect phase — the reference runs one job
+per channel and folds sites sequentially in that job
+(``tmlib/workflow/corilla/api.py``); at pod scale we shard the site axis
+over the mesh, ``lax.scan`` locally, and merge shard states with the
+parallel-variance combination (``ops/stats.welford_merge``) via
+``all_gather`` + an in-order fold, which is bitwise-deterministic for a
+given mesh size (ordinary ``psum`` would not be order-stable for the
+variance combination).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+from tmlibrary_tpu.ops.stats import (
+    WelfordState,
+    welford_finalize,
+    welford_merge,
+    welford_scan,
+)
+
+
+def _scan_and_merge(stack_shard: jax.Array, axis: str) -> WelfordState:
+    """Per-shard body: local scan, then deterministic cross-shard fold."""
+    from tmlibrary_tpu.ops.stats import welford_init
+
+    # the scan carry must be marked device-varying to satisfy shard_map's
+    # varying-axis check (each shard accumulates different values)
+    init = jax.tree.map(
+        lambda x: lax.pcast(x, (axis,), to="varying"),
+        welford_init(stack_shard.shape[1:]),
+    )
+    local = welford_scan(stack_shard, init)
+    # gather every shard's state to every device; fold in shard order
+    gathered = jax.tree.map(
+        lambda x: lax.all_gather(x, axis_name=axis), local
+    )
+    n_shards = gathered.n.shape[0]
+
+    def fold(i, acc):
+        piece = jax.tree.map(lambda x: x[i], gathered)
+        return welford_merge(acc, piece)
+
+    first = jax.tree.map(lambda x: x[0], gathered)
+    return lax.fori_loop(1, n_shards, fold, first)
+
+
+def sharded_welford(stack: jax.Array, mesh: Mesh, axis: str = "sites") -> WelfordState:
+    """Merged :class:`WelfordState` over a (B, H, W) stack sharded on the
+    leading axis.  ``B`` must be divisible by the mesh size (the workflow
+    layer plans batches that way)."""
+    fn = jax.shard_map(
+        functools.partial(_scan_and_merge, axis=axis),
+        mesh=mesh,
+        in_specs=PartitionSpec(axis),
+        out_specs=PartitionSpec(),  # merged state identical on all shards
+        # the all_gather + in-order fold makes outputs replicated, but the
+        # varying-axis checker can't prove it statically
+        check_vma=False,
+    )
+    return jax.jit(fn)(jnp.asarray(stack))
+
+
+def sharded_channel_stats(
+    stack: jax.Array, mesh: Mesh, axis: str = "sites"
+) -> dict[str, jax.Array]:
+    """One channel's finalized illumination statistics over a sharded
+    (B, H, W) stack; outputs are replicated."""
+    return welford_finalize(sharded_welford(stack, mesh, axis))

@@ -1,0 +1,18 @@
+"""Workflow orchestration layer.
+
+Reference parity: ``tmlib/workflow/`` — the stage/step engine
+(``workflow.py``), job fan-out (``jobs.py``), the step-API base
+(``api.py`` ``ClusterRoutines``), the typed args system (``args.py``), the
+step registry (``__init__.py``) and the submission manager
+(``manager.py``/``submission.py``).
+
+TPU redesign (SURVEY.md §4.1): the reference drives a GC3Pie task DAG where
+every step spawns init/run/collect processes on a cluster; here the whole
+stage→step graph is an in-process loop dispatching batched device programs,
+with a JSON run ledger giving the same persistence/resume semantics the
+reference got from DB-backed task state.
+"""
+
+from tmlibrary_tpu.workflow.registry import get_step, list_steps, register_step
+
+__all__ = ["get_step", "list_steps", "register_step"]

@@ -1,0 +1,110 @@
+"""Step API base: plan / run / collect.
+
+Reference parity: ``tmlib/workflow/api.py`` ``ClusterRoutines`` — every
+step implements ``create_run_batches`` (plan), ``run_job`` (per-batch
+work), ``collect_job`` (merge) and ``delete_previous_job_output``
+(idempotent re-runs); batch descriptions are JSON files in the experiment's
+workflow directory (SURVEY.md §4.2).
+
+The TPU rebuild keeps the same three-phase shape — it is what makes
+resume/idempotence work — but a "batch" feeds a sharded device program
+instead of a cluster job."""
+
+from __future__ import annotations
+
+import abc
+import json
+import logging
+import shutil
+from pathlib import Path
+from typing import Any
+
+from tmlibrary_tpu.errors import JobDescriptionError
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.workflow.args import ArgumentCollection
+
+logger = logging.getLogger(__name__)
+
+
+class Step(abc.ABC):
+    """Base class for workflow steps (reference ``ClusterRoutines``)."""
+
+    #: set by @register_step
+    name: str = "step"
+    #: override with the step's typed arguments
+    batch_args: ArgumentCollection = ArgumentCollection()
+
+    def __init__(self, store: ExperimentStore):
+        self.store = store
+
+    # ------------------------------------------------------------- locations
+    @property
+    def step_dir(self) -> Path:
+        d = self.store.workflow_dir / self.name
+        d.mkdir(parents=True, exist_ok=True)
+        return d
+
+    def _batch_path(self, index: int) -> Path:
+        return self.step_dir / f"batch_{index:03d}.json"
+
+    # ----------------------------------------------------------------- plan
+    @abc.abstractmethod
+    def create_batches(self, args: dict[str, Any]) -> list[dict]:
+        """Plan run batches from resolved arguments (reference
+        ``create_run_batches``).  Each batch must be JSON-serializable."""
+
+    def init(self, args: dict[str, Any] | None = None) -> list[dict]:
+        """Resolve args, plan batches, persist them (CLI verb ``init``)."""
+        resolved = self.batch_args.resolve(args)
+        self.delete_previous_output()
+        batches = self.create_batches(resolved)
+        for old in self.step_dir.glob("batch_*.json"):
+            old.unlink()
+        for i, batch in enumerate(batches):
+            batch = dict(batch)
+            batch["index"] = i
+            batch["args"] = resolved
+            self._batch_path(i).write_text(json.dumps(batch))
+        logger.info("%s: planned %d batches", self.name, len(batches))
+        return batches
+
+    def load_batch(self, index: int) -> dict:
+        path = self._batch_path(index)
+        if not path.exists():
+            raise JobDescriptionError(
+                f"no batch {index} for step '{self.name}' — run init first"
+            )
+        return json.loads(path.read_text())
+
+    def list_batches(self) -> list[int]:
+        return sorted(
+            int(p.stem.split("_")[1]) for p in self.step_dir.glob("batch_*.json")
+        )
+
+    # ------------------------------------------------------------------ run
+    @abc.abstractmethod
+    def run_batch(self, batch: dict) -> dict:
+        """Execute one batch; return a JSON-serializable result summary
+        (reference ``run_job``)."""
+
+    def run(self, index: int) -> dict:
+        batch = self.load_batch(index)
+        result = self.run_batch(batch)
+        return result or {}
+
+    # -------------------------------------------------------------- collect
+    def collect(self) -> dict:
+        """Merge phase after all batches ran (reference ``collect_job``).
+        Default: nothing to merge."""
+        return {}
+
+    # ----------------------------------------------------------- idempotence
+    def delete_previous_output(self) -> None:
+        """Remove this step's previous outputs so re-runs are idempotent
+        (reference ``delete_previous_job_output``).  Default: nothing."""
+
+    # ------------------------------------------------------------- utilities
+    def _clear_dir(self, path: Path) -> None:
+        if path.exists():
+            shutil.rmtree(path)
+        path.mkdir(parents=True, exist_ok=True)

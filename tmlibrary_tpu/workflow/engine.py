@@ -1,0 +1,267 @@
+"""Workflow engine: stage/step DAG execution with ledger-backed resume.
+
+Reference parity: ``tmlib/workflow/workflow.py`` (``Workflow`` →
+``WorkflowStage`` → ``WorkflowStep`` = init → run → collect, driven through
+GC3Pie ``next()`` transitions), ``description.py`` (YAML-serializable
+workflow description validated against the step registry),
+``dependencies.py`` (canonical stage order) and
+``manager.py``/``submission.py`` (DB-backed submission state + ``resume``).
+
+TPU redesign (SURVEY.md §4.1): no process fan-out — stages iterate in one
+process dispatching batched device programs; the JSON-lines run ledger
+replaces the ``Submission``/``Task`` tables: every init/run/collect event
+is appended with timing, and ``resume`` replays the ledger to skip
+completed work.  Idempotence still comes from each step's
+``delete_previous_output`` + deterministic batch plans, exactly the
+reference's contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+from tmlibrary_tpu.errors import WorkflowError
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.workflow.registry import get_step, list_steps
+
+logger = logging.getLogger(__name__)
+
+#: canonical stage DAG (reference ``tmlib/workflow/dependencies.py``):
+#: conversion → preprocessing → pyramid → analysis
+CANONICAL_STAGES: list[tuple[str, list[str]]] = [
+    ("image_conversion", ["metaconfig", "imextract"]),
+    ("image_preprocessing", ["corilla", "align"]),
+    ("pyramid_creation", ["illuminati"]),
+    ("image_analysis", ["jterator"]),
+]
+
+
+@dataclasses.dataclass
+class WorkflowStepDescription:
+    name: str
+    args: dict[str, Any] = dataclasses.field(default_factory=dict)
+    active: bool = True
+
+
+@dataclasses.dataclass
+class WorkflowStageDescription:
+    name: str
+    steps: list[WorkflowStepDescription]
+
+
+@dataclasses.dataclass
+class WorkflowDescription:
+    """YAML-serializable workflow plan (reference ``WorkflowDescription``)."""
+
+    stages: list[WorkflowStageDescription]
+
+    def validate(self) -> None:
+        known = set(list_steps())
+        for stage in self.stages:
+            for step in stage.steps:
+                if step.name not in known:
+                    raise WorkflowError(
+                        f"workflow references unknown step '{step.name}' "
+                        f"(registered: {sorted(known)})"
+                    )
+
+    def active_steps(self) -> list[WorkflowStepDescription]:
+        return [s for st in self.stages for s in st.steps if s.active]
+
+    # ------------------------------------------------------------- serialize
+    def to_dict(self) -> dict:
+        return {
+            "stages": [
+                {
+                    "name": st.name,
+                    "steps": [
+                        {"name": s.name, "args": s.args, "active": s.active}
+                        for s in st.steps
+                    ],
+                }
+                for st in self.stages
+            ]
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "WorkflowDescription":
+        return cls(
+            stages=[
+                WorkflowStageDescription(
+                    name=st["name"],
+                    steps=[
+                        WorkflowStepDescription(
+                            name=s["name"],
+                            args=s.get("args", {}) or {},
+                            active=bool(s.get("active", True)),
+                        )
+                        for st_s in [st.get("steps", [])]
+                        for s in st_s
+                    ],
+                )
+                for st in d.get("stages", [])
+            ]
+        )
+
+    @classmethod
+    def load(cls, path: Path) -> "WorkflowDescription":
+        return cls.from_dict(yaml.safe_load(Path(path).read_text()))
+
+    def save(self, path: Path) -> None:
+        Path(path).write_text(yaml.safe_dump(self.to_dict(), sort_keys=False))
+
+    @classmethod
+    def canonical(cls, step_args: dict[str, dict] | None = None) -> "WorkflowDescription":
+        """The canonical four-stage workflow; ``step_args`` maps step name →
+        args, and steps without args are included but may be skipped at run
+        time if they plan zero batches (e.g. align with one cycle)."""
+        step_args = step_args or {}
+        return cls(
+            stages=[
+                WorkflowStageDescription(
+                    name=stage,
+                    steps=[
+                        WorkflowStepDescription(
+                            name=s,
+                            args=step_args.get(s, {}),
+                            active=s in step_args,
+                        )
+                        for s in steps
+                    ],
+                )
+                for stage, steps in CANONICAL_STAGES
+            ]
+        )
+
+
+class RunLedger:
+    """Append-only JSON-lines event log (replaces the reference's
+    ``Submission``/``Task`` tables)."""
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+
+    def append(self, **event) -> None:
+        event["ts"] = time.time()
+        with open(self.path, "a") as f:
+            f.write(json.dumps(event) + "\n")
+
+    def events(self) -> list[dict]:
+        if not self.path.exists():
+            return []
+        out = []
+        for line in self.path.read_text().splitlines():
+            if line.strip():
+                out.append(json.loads(line))
+        return out
+
+    def completed_steps(self) -> set[str]:
+        return {e["step"] for e in self.events() if e.get("event") == "step_done"}
+
+    def completed_batches(self, step: str) -> set[int]:
+        done = set()
+        for e in self.events():
+            if e.get("step") != step:
+                continue
+            if e.get("event") == "batch_done":
+                done.add(e["batch"])
+            elif e.get("event") == "init_done":
+                # a re-init invalidates earlier batch completions
+                done.clear()
+        return done
+
+    def status(self) -> dict[str, Any]:
+        steps: dict[str, dict] = {}
+        for e in self.events():
+            s = e.get("step")
+            if not s:
+                continue
+            entry = steps.setdefault(
+                s, {"state": "pending", "batches_done": 0, "n_batches": None,
+                    "elapsed": 0.0}
+            )
+            if e["event"] == "init_done":
+                entry.update(state="running", n_batches=e.get("n_batches"),
+                             batches_done=0)
+            elif e["event"] == "batch_done":
+                entry["batches_done"] += 1
+                entry["elapsed"] += e.get("elapsed", 0.0)
+            elif e["event"] == "step_done":
+                entry["state"] = "done"
+            elif e["event"] == "step_failed":
+                entry["state"] = "failed"
+                entry["error"] = e.get("error")
+        return steps
+
+
+class Workflow:
+    """Execute a workflow description against an experiment store."""
+
+    def __init__(self, store: ExperimentStore, description: WorkflowDescription):
+        description.validate()
+        self.store = store
+        self.description = description
+        self.ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+
+    def run(self, resume: bool = False) -> dict:
+        """Run all active steps in order; with ``resume=True`` skip completed
+        steps and completed batches of the interrupted step (reference
+        ``resume`` CLI verb backed by DB task state)."""
+        if not resume and self.ledger.path.exists():
+            self.ledger.path.unlink()
+        done_steps = self.ledger.completed_steps() if resume else set()
+        summary = {}
+        for stage in self.description.stages:
+            for sd in stage.steps:
+                if not sd.active:
+                    continue
+                if sd.name in done_steps:
+                    logger.info("resume: skipping completed step %s", sd.name)
+                    continue
+                summary[sd.name] = self._run_step(sd, resume)
+        return summary
+
+    def _run_step(self, sd: WorkflowStepDescription, resume: bool) -> dict:
+        step_cls = get_step(sd.name)
+        step = step_cls(self.store)
+        t0 = time.time()
+        try:
+            existing = step.list_batches() if resume else []
+            if existing:
+                batches = [step.load_batch(i) for i in existing]
+                done = self.ledger.completed_batches(sd.name)
+                # if the description's args changed since the batches were
+                # planned, the old plan is stale — re-init from scratch
+                if batches and step.batch_args.resolve(sd.args) != batches[0]["args"]:
+                    logger.info("resume: args changed for %s, re-planning", sd.name)
+                    existing = []
+            if not existing:
+                batches = step.init(sd.args)
+                batches = [step.load_batch(i) for i in range(len(batches))]
+                done = set()
+                self.ledger.append(step=sd.name, event="init_done",
+                                   n_batches=len(batches))
+            results = []
+            for batch in batches:
+                if batch["index"] in done:
+                    continue
+                bt0 = time.time()
+                result = step.run_batch(batch)
+                self.ledger.append(step=sd.name, event="batch_done",
+                                   batch=batch["index"],
+                                   elapsed=time.time() - bt0, result=result)
+                results.append(result)
+            collected = step.collect()
+            self.ledger.append(step=sd.name, event="step_done",
+                               elapsed=time.time() - t0, collected=collected)
+            return {"n_batches": len(batches), "collected": collected}
+        except Exception as e:
+            self.ledger.append(step=sd.name, event="step_failed", error=str(e))
+            raise WorkflowError(f"step '{sd.name}' failed: {e}") from e

@@ -1,0 +1,17 @@
+"""Built-in workflow steps.
+
+Reference parity (SURVEY.md §2/§3): one module per reference step package —
+``metaconfig`` (metadata → manifest), ``imextract`` (pixel ingest),
+``corilla`` (illumination statistics), ``align`` (cycle registration),
+``illuminati`` (pyramid tiles), ``jterator`` (image analysis).
+Importing this package registers them all.
+"""
+
+from tmlibrary_tpu.workflow.steps import (  # noqa: F401
+    align,
+    corilla,
+    illuminati,
+    imextract,
+    jterator,
+    metaconfig,
+)

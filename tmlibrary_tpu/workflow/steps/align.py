@@ -1,0 +1,89 @@
+"""align: register acquisition cycles per site.
+
+Reference parity: ``tmlib/workflow/align/`` ``ImageRegistrator`` — computes
+per-site shifts of every cycle against a reference cycle (one reference
+channel), stores ``SiteShift`` rows and, in collect, the ``SiteIntersection``
+overlap window (SURVEY.md §2 align row).
+
+TPU execution: FFT phase correlation batched over the site axis with vmap;
+shifts exceeding ``max_shift`` are zeroed (registration failure fallback,
+as in the reference).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tmlibrary_tpu.ops.registration import batch_phase_correlation, intersection_window
+from tmlibrary_tpu.utils import create_partitions
+from tmlibrary_tpu.workflow.api import Step
+from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
+from tmlibrary_tpu.workflow.registry import register_step
+
+
+@register_step("align")
+class ImageRegistrator(Step):
+    batch_args = ArgumentCollection(
+        Argument("ref_cycle", int, default=0, help="reference cycle"),
+        Argument("ref_channel", int, default=0, help="channel used to register"),
+        Argument("batch_size", int, default=32, help="sites per device batch"),
+        Argument("max_shift", int, default=50,
+                 help="shifts larger than this are treated as failures (zeroed)"),
+    )
+
+    def create_batches(self, args):
+        exp = self.store.experiment
+        if exp.n_cycles < 2:
+            return []
+        sites = list(range(self.store.n_sites))
+        return [
+            {"cycle": cycle, "sites": part}
+            for cycle in range(exp.n_cycles)
+            if cycle != args["ref_cycle"]
+            for part in create_partitions(sites, args["batch_size"])
+        ]
+
+    def run_batch(self, batch: dict) -> dict:
+        import jax.numpy as jnp
+
+        args = batch["args"]
+        cycle, sites = batch["cycle"], batch["sites"]
+        ref = self.store.read_sites(sites, cycle=args["ref_cycle"],
+                                    channel=args["ref_channel"]).astype(np.float32)
+        tgt = self.store.read_sites(sites, cycle=cycle,
+                                    channel=args["ref_channel"]).astype(np.float32)
+        # np.array (copy): np.asarray of a jax.Array is a read-only view
+        shifts = np.array(batch_phase_correlation(jnp.asarray(ref), jnp.asarray(tgt)))
+        bad = np.abs(shifts).max(axis=1) > args["max_shift"]
+        shifts[bad] = 0
+
+        # accumulate into the per-cycle shift table (idempotent slice write)
+        path_exists = self.store.has_shifts(cycle)
+        table = (
+            self.store.read_shifts(cycle)
+            if path_exists
+            else np.zeros((self.store.n_sites, 2), np.int32)
+        )
+        table[np.asarray(sites)] = shifts
+        self.store.write_shifts(table, cycle)
+        return {"cycle": cycle, "n_sites": len(sites), "n_failed": int(bad.sum())}
+
+    def collect(self) -> dict:
+        exp = self.store.experiment
+        args = self.batch_args.resolve(
+            self.load_batch(0)["args"] if self.list_batches() else None
+        )
+        all_shifts = [
+            self.store.read_shifts(c)
+            for c in range(exp.n_cycles)
+            if c != args["ref_cycle"] and self.store.has_shifts(c)
+        ]
+        window = intersection_window(
+            np.concatenate(all_shifts) if all_shifts else np.zeros((0, 2))
+        )
+        self.store.write_intersection(window)
+        return {"window": window}
+
+    def delete_previous_output(self) -> None:
+        for p in (self.store.root / "alignment").glob("*"):
+            p.unlink()

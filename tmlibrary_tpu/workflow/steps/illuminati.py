@@ -1,0 +1,144 @@
+"""illuminati: multi-resolution pyramid tiles for the viewer.
+
+Reference parity: ``tmlib/workflow/illuminati/api.py`` ``PyramidBuilder`` —
+level 0 stitches corrected/aligned/rescaled site images into the plate
+mosaic and cuts 256-px tiles; level L+1 jobs consume level L (inter-level
+dependency waves); tiles land in the DB (SURVEY.md §4.5).
+
+TPU execution: one batch per (plate, channel); correction + rescale run
+batched on device, the mosaic assembles host-side (it can exceed HBM for
+large plates), the downsample chain runs on device per level, PNG tiles go
+to ``pyramids/<channel>/<level>/<row>_<col>.png`` — a zoomify-style layout
+any slippy-map viewer can serve statically.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmlibrary_tpu.models.experiment import SiteRef
+from tmlibrary_tpu.models.image import IllumstatsContainer
+from tmlibrary_tpu.ops import image_ops
+from tmlibrary_tpu.ops.pyramid import cut_tiles, pyramid_levels, to_uint8
+from tmlibrary_tpu.utils import create_partitions
+from tmlibrary_tpu.workflow.api import Step
+from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
+from tmlibrary_tpu.workflow.registry import register_step
+
+
+@register_step("illuminati")
+class PyramidBuilder(Step):
+    batch_args = ArgumentCollection(
+        Argument("correct", bool, default=True, help="apply illumination stats"),
+        Argument("align", bool, default=False, help="apply cycle-0 alignment"),
+        Argument("clip_percent", float, default=99.9,
+                 help="upper clip percentile for display rescale"),
+        Argument("batch_size", int, default=32, help="sites per device batch"),
+        Argument("cycle", int, default=0, help="cycle to tile"),
+    )
+
+    def create_batches(self, args):
+        exp = self.store.experiment
+        return [
+            {"plate": p.name, "channel": ch.index}
+            for p in exp.plates
+            for ch in exp.channels
+            if self.store.has_plane(cycle=args["cycle"], channel=ch.index)
+        ]
+
+    # ------------------------------------------------------------------ run
+    def run_batch(self, batch: dict) -> dict:
+        args = batch["args"]
+        exp = self.store.experiment
+        channel = batch["channel"]
+        cycle = args["cycle"]
+        plate = next(p for p in exp.plates if p.name == batch["plate"])
+
+        stats = None
+        if args["correct"] and self.store.has_illumstats(cycle=cycle, channel=channel):
+            stats = IllumstatsContainer.from_store(
+                self.store.read_illumstats(cycle=cycle, channel=channel)
+            )
+
+        # display range from corilla percentiles (reference: scale step)
+        if stats is not None and stats.percentiles:
+            upper = stats.percentiles.get(args["clip_percent"])
+            lower = stats.percentiles.get(0.1, 0.0)
+        else:
+            upper = lower = None
+
+        @jax.jit
+        def prep(stack, shifts):
+            def one(img, shift):
+                out = jnp.asarray(img, jnp.float32)
+                if stats is not None:
+                    out = image_ops.correct_illumination(
+                        out, stats.mean_log, stats.std_log
+                    )
+                if args["align"]:
+                    out = image_ops.shift_image(out, shift[0], shift[1])
+                return out
+
+            return jax.vmap(one)(stack, shifts)
+
+        # site grid geometry
+        spw_y = max(s.y for w in plate.wells for s in w.sites) + 1
+        spw_x = max(s.x for w in plate.wells for s in w.sites) + 1
+        rows = max(w.row for w in plate.wells) + 1
+        cols = max(w.column for w in plate.wells) + 1
+        H, W = exp.site_height, exp.site_width
+        mosaic = np.zeros((rows * spw_y * H, cols * spw_x * W), np.float32)
+
+        refs = [
+            (SiteRef(plate.name, w.row, w.column, s.y, s.x), w, s)
+            for w in plate.wells
+            for s in w.sites
+        ]
+        shifts_table = (
+            self.store.read_shifts(cycle)
+            if args["align"] and self.store.has_shifts(cycle)
+            else np.zeros((self.store.n_sites, 2), np.int32)
+        )
+        for part in create_partitions(refs, args["batch_size"]):
+            idx = [self.store.site_linear_index(r) for r, _, _ in part]
+            stack = self.store.read_sites(idx, cycle=cycle, channel=channel)
+            prepped = np.asarray(
+                prep(jnp.asarray(stack), jnp.asarray(shifts_table[idx]))
+            )
+            for (ref, w, s), img in zip(part, prepped):
+                y0 = (w.row * spw_y + s.y) * H
+                x0 = (w.column * spw_x + s.x) * W
+                mosaic[y0 : y0 + H, x0 : x0 + W] = img
+
+        if upper is None:
+            lower = float(np.percentile(mosaic, 0.1))
+            upper = float(np.percentile(mosaic, args["clip_percent"]))
+
+        levels = pyramid_levels(jnp.asarray(mosaic))
+        out_dir = self.store.root / "pyramids" / f"channel{channel:02d}"
+        n_tiles = 0
+        for li, level in enumerate(levels):
+            level8 = np.asarray(to_uint8(level, float(lower), float(upper)))
+            ldir = out_dir / f"{len(levels) - 1 - li}"
+            ldir.mkdir(parents=True, exist_ok=True)
+            for (ty, tx), tile in cut_tiles(level8).items():
+                import cv2
+
+                cv2.imwrite(str(ldir / f"{ty}_{tx}.png"), tile)
+                n_tiles += 1
+        return {
+            "channel": channel,
+            "mosaic_shape": list(mosaic.shape),
+            "n_levels": len(levels),
+            "n_tiles": n_tiles,
+        }
+
+    def delete_previous_output(self) -> None:
+        import shutil
+
+        root = self.store.root / "pyramids"
+        if root.exists():
+            shutil.rmtree(root)
+        root.mkdir()

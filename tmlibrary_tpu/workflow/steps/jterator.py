@@ -1,0 +1,233 @@
+"""jterator: run the image-analysis pipeline over all sites.
+
+Reference parity: ``tmlib/workflow/jterator/api.py`` ``ImageAnalysisPipeline``
+— ``create_run_batches`` groups sites by ``batch_size``; ``run_job`` loads
+channel images (correct + align), runs the module chain per site, registers
+segmented objects (label images → PostGIS polygons) and persists feature
+values (SURVEY.md §4.3 — THE hot path).
+
+TPU execution: one compiled program per experiment geometry
+(jit(vmap(chain))); a batch of sites is one device dispatch, sharded over
+the mesh when more than one chip is visible.  Outputs: label stacks in the
+segmentation store, feature Parquet shards (idempotent per batch), optional
+host-traced polygons.  Metric: sites/sec/chip (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tmlibrary_tpu.errors import PipelineError
+from tmlibrary_tpu.models.image import IllumstatsContainer
+from tmlibrary_tpu.utils import create_partitions
+from tmlibrary_tpu.workflow.api import Step
+from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
+from tmlibrary_tpu.workflow.registry import register_step
+
+
+@register_step("jterator")
+class ImageAnalysisRunner(Step):
+    batch_args = ArgumentCollection(
+        Argument("pipe", str, required=True,
+                 help="path to the .pipe.yaml pipeline description"),
+        Argument("batch_size", int, default=32, help="sites per device batch"),
+        Argument("max_objects", int, default=256,
+                 help="static per-site object capacity"),
+        Argument("n_devices", int, default=0, help="mesh size (0 = all)"),
+        Argument("cycle", int, default=0),
+        Argument("tpoint", int, default=0),
+        Argument("zplane", int, default=0),
+        Argument("as_polygons", bool, default=False,
+                 help="also trace object outlines host-side"),
+    )
+
+    def __init__(self, store):
+        super().__init__(store)
+        self._compiled = None
+        self._desc = None
+
+    def create_batches(self, args):
+        sites = list(range(self.store.n_sites))
+        return [
+            {"sites": part} for part in create_partitions(sites, args["batch_size"])
+        ]
+
+    # ---------------------------------------------------------------- compile
+    def _pipeline(self, args):
+        from pathlib import Path
+
+        from tmlibrary_tpu.jterator.description import PipelineDescription
+        from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+        if self._desc is None:
+            pipe_path = Path(args["pipe"])
+            if not pipe_path.is_absolute():
+                pipe_path = self.store.root / pipe_path
+            self._desc = PipelineDescription.load(pipe_path)
+        if self._compiled is None:
+            pipe = ImageAnalysisPipeline(self._desc, max_objects=args["max_objects"])
+            self._compiled = pipe.build_batch_fn()
+        return self._desc, self._compiled
+
+    # -------------------------------------------------------------------- run
+    def run_batch(self, batch: dict) -> dict:
+        import jax
+        import jax.numpy as jnp
+
+        from tmlibrary_tpu.parallel.mesh import batch_sharding, site_mesh
+
+        args = batch["args"]
+        sites = batch["sites"]
+        desc, fn = self._pipeline(args)
+        exp = self.store.experiment
+        cycle, tpoint, zplane = args["cycle"], args["tpoint"], args["zplane"]
+
+        n_dev = args["n_devices"] or len(jax.devices())
+        n_dev = min(n_dev, len(jax.devices()))
+        # pad the batch so the site axis shards evenly (padded lanes are
+        # recomputed copies of site 0 and dropped on export)
+        n_valid = len(sites)
+        padded_sites = list(sites)
+        if n_valid % n_dev:
+            padded_sites += [sites[0]] * (n_dev - n_valid % n_dev)
+
+        sharding = None
+        if n_dev > 1:
+            sharding = batch_sharding(site_mesh(n_dev))
+
+        raw = {}
+        for ch in desc.channels:
+            idx = exp.channel_index(ch.name)
+            stack = self.store.read_sites(padded_sites, cycle=cycle, channel=idx,
+                                          tpoint=tpoint, zplane=zplane)
+            arr = jnp.asarray(stack)
+            raw[ch.name] = jax.device_put(arr, sharding) if sharding else arr
+        for obj in desc.objects_in:
+            stack = self.store.read_labels(padded_sites, obj.name,
+                                           tpoint=tpoint, zplane=zplane)
+            arr = jnp.asarray(stack)
+            raw[obj.name] = jax.device_put(arr, sharding) if sharding else arr
+
+        stats = {}
+        for ch in desc.channels:
+            if ch.correct:
+                idx = exp.channel_index(ch.name)
+                if not self.store.has_illumstats(cycle=cycle, channel=idx):
+                    raise PipelineError(
+                        f"channel '{ch.name}' wants illumination correction but "
+                        f"corilla statistics are missing — run corilla first"
+                    )
+                cont = IllumstatsContainer.from_store(
+                    self.store.read_illumstats(cycle=cycle, channel=idx)
+                )
+                stats[ch.name] = (cont.mean_log, cont.std_log)
+
+        if any(ch.align for ch in desc.channels) and self.store.has_shifts(cycle):
+            table = self.store.read_shifts(cycle)
+            shifts = jnp.asarray(table[np.asarray(padded_sites)])
+        else:
+            shifts = jnp.zeros((len(padded_sites), 2), jnp.int32)
+        if sharding is not None:
+            shifts = jax.device_put(shifts, sharding)
+
+        result = fn(raw, stats, shifts)
+        counts = {k: np.asarray(v)[:n_valid] for k, v in result.counts.items()}
+        objects = {k: np.asarray(v)[:n_valid] for k, v in result.objects.items()}
+        measurements = {
+            obj: {f: np.asarray(v)[:n_valid] for f, v in feats.items()}
+            for obj, feats in result.measurements.items()
+        }
+
+        # ------------------------------------------------------------ persist
+        for name, labels in objects.items():
+            self.store.write_labels(labels, sites, name, tpoint=tpoint, zplane=zplane)
+
+        shard = f"batch_{batch['index']:03d}"
+        site_meta = self._site_metadata(sites)
+        for name in objects:
+            table = self._feature_table(
+                name, counts[name], measurements.get(name, {}), site_meta,
+                args["max_objects"],
+            )
+            self.store.append_features(name, table, shard=shard)
+            if args["as_polygons"]:
+                self._write_polygons(name, objects[name], sites, shard)
+
+        return {
+            "n_sites": n_valid,
+            "objects": {k: int(v.sum()) for k, v in counts.items()},
+        }
+
+    # ---------------------------------------------------------------- helpers
+    def _site_metadata(self, sites: list[int]) -> list[dict]:
+        refs = list(self.store.experiment.sites())
+        out = []
+        for s in sites:
+            r = refs[s]
+            out.append(
+                {
+                    "site_index": s,
+                    "plate": r.plate,
+                    "well_row": r.well_row,
+                    "well_col": r.well_column,
+                    "site_y": r.site_y,
+                    "site_x": r.site_x,
+                }
+            )
+        return out
+
+    @staticmethod
+    def _feature_table(name, counts, feats, site_meta, max_objects):
+        import pandas as pd
+
+        rows: dict[str, list] = {k: [] for k in
+                                 ("site_index", "plate", "well_row", "well_col",
+                                  "site_y", "site_x", "label")}
+        for fname in feats:
+            rows[fname] = []
+        for b, meta in enumerate(site_meta):
+            n = int(counts[b])
+            for lab in range(1, min(n, max_objects) + 1):
+                for k in ("site_index", "plate", "well_row", "well_col",
+                          "site_y", "site_x"):
+                    rows[k].append(meta[k])
+                rows["label"].append(lab)
+                for fname, arr in feats.items():
+                    rows[fname].append(float(arr[b, lab - 1]))
+        return pd.DataFrame(rows)
+
+    def _write_polygons(self, name, labels, sites, shard):
+        import pandas as pd
+
+        from tmlibrary_tpu.ops.polygons import labels_to_polygons, polygons_to_table
+
+        tables = []
+        for b, site in enumerate(sites):
+            polys = labels_to_polygons(labels[b])
+            if polys:
+                tables.append(polygons_to_table(polys, site))
+        if tables:
+            df = pd.concat(tables, ignore_index=True)
+            out = self.store.root / "segmentations" / f"{name}_polygons_{shard}.parquet"
+            df.to_parquet(out, index=False)
+
+    def collect(self) -> dict:
+        """Summarize counts per object type (reference's collect phase
+        registers mapobject types and cleans up)."""
+        summary = {}
+        for name in self.store.list_objects():
+            try:
+                feats = self.store.read_features(name)
+                summary[name] = int(len(feats))
+            except Exception:
+                continue
+        return {"objects_total": summary}
+
+    def delete_previous_output(self) -> None:
+        import shutil
+
+        for sub in ("segmentations", "features"):
+            d = self.store.root / sub
+            if d.exists():
+                shutil.rmtree(d)
+            d.mkdir()

@@ -1,0 +1,255 @@
+"""metaconfig: configure experiment metadata from microscope files.
+
+Reference parity: ``tmlib/workflow/metaconfig/`` — ``MetadataConfigurator``
+merges vendor metadata (filenames, OME-XML, vendor sidecar files like
+Yokogawa CellVoyager ``.mlf``/``.mes``) into a canonical experiment layout:
+plates → wells → sites with grid coordinates, channels, cycles, z-planes.
+
+TPU rebuild: pure host-side ingest planning.  The vendor zoo is represented
+by two handlers that cover the common cases without Bio-Formats/JVM:
+
+- ``default``: a configurable filename-regex handler (named groups
+  ``well``, ``site``/(``site_y``,``site_x``), ``channel``, optional
+  ``plate``, ``cycle``, ``tpoint``, ``zplane``) — the moral equivalent of
+  the reference's ``default`` handler for "plain TIFF series" microscopes.
+- ``cellvoyager``: the Yokogawa filename convention
+  (``..._W<well>F<field>T<tpoint>Z<zplane>C<channel>.tif``-style), the
+  vendor the reference's handler set confirms (SURVEY.md §2 metaconfig row).
+
+The output is the experiment manifest + an image-file mapping JSON the
+``imextract`` step consumes (reference ``ImageFileMapping``).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from collections import defaultdict
+from pathlib import Path
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.models.experiment import Channel, Experiment, Plate, Site, Well
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.workflow.api import Step
+from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
+from tmlibrary_tpu.workflow.registry import register_step
+
+#: default handler: one named-group regex over the filename
+DEFAULT_PATTERN = (
+    r"(?:(?P<plate>[A-Za-z0-9]+)_)?"
+    r"(?P<well>[A-Z]{1,2}\d{2})_"
+    r"s(?P<site>\d+)_"
+    r"(?:c(?P<cycle>\d+)_)?"
+    r"(?:t(?P<tpoint>\d+)_)?"
+    r"(?:z(?P<zplane>\d+)_)?"
+    r"(?P<channel>[A-Za-z0-9\-]+)"
+    r"\.(?:tif|tiff|png)$"
+)
+
+#: Yokogawa CellVoyager: ...__W0001F001T0001Z01C1.tif style
+CELLVOYAGER_PATTERN = (
+    r"(?P<prefix>.*?)_?"
+    r"W(?P<well_num>\d+)"
+    r"F(?P<site>\d+)"
+    r"T(?P<tpoint>\d+)"
+    r"Z(?P<zplane>\d+)"
+    r"C(?P<channel>\d+)"
+    r"\.(?:tif|tiff|png)$"
+)
+
+
+def parse_well_name(name: str) -> tuple[int, int]:
+    """'B03' → (row=1, col=2)."""
+    m = re.fullmatch(r"([A-Z]{1,2})(\d{1,2})", name)
+    if not m:
+        raise MetadataError(f"cannot parse well name '{name}'")
+    letters, digits = m.groups()
+    row = 0
+    for ch in letters:
+        row = row * 26 + (ord(ch) - ord("A") + 1)
+    return row - 1, int(digits) - 1
+
+
+def well_num_to_rowcol(num: int, plate_cols: int = 24) -> tuple[int, int]:
+    """CellVoyager numeric well index (1-based, row-major) → (row, col)."""
+    return (num - 1) // plate_cols, (num - 1) % plate_cols
+
+
+class FilenameHandler:
+    """Parse one file path into a canonical index dict."""
+
+    def __init__(self, pattern: str, style: str, plate_cols: int = 24,
+                 sites_per_well_x: int | None = None):
+        self.regex = re.compile(pattern)
+        self.style = style
+        self.plate_cols = plate_cols
+        self.sites_per_well_x = sites_per_well_x
+
+    def parse(self, filename: str) -> dict | None:
+        m = self.regex.search(filename)
+        if not m:
+            return None
+        g = m.groupdict()
+        if self.style == "cellvoyager":
+            row, col = well_num_to_rowcol(int(g["well_num"]), self.plate_cols)
+        else:
+            row, col = parse_well_name(g["well"])
+        return {
+            "plate": g.get("plate") or "plate00",
+            "well_row": row,
+            "well_col": col,
+            "site": int(g["site"]) - (1 if self.style == "cellvoyager" else 0),
+            "channel": str(g["channel"]),
+            "cycle": int(g.get("cycle") or 0),
+            "tpoint": int(g.get("tpoint") or (1 if self.style == "cellvoyager" else 0))
+            - (1 if self.style == "cellvoyager" else 0),
+            "zplane": int(g.get("zplane") or (1 if self.style == "cellvoyager" else 0))
+            - (1 if self.style == "cellvoyager" else 0),
+        }
+
+
+@register_step("metaconfig")
+class MetadataConfigurator(Step):
+    """Build the experiment manifest + file mapping from a source directory."""
+
+    batch_args = ArgumentCollection(
+        Argument("source_dir", str, required=True,
+                 help="directory of microscope image files"),
+        Argument("handler", str, default="default",
+                 choices=("default", "cellvoyager"),
+                 help="vendor filename handler"),
+        Argument("pattern", str, default=None,
+                 help="override the handler's filename regex"),
+        Argument("sites_per_well_x", int, default=None,
+                 help="well grid width in sites (default: square-ish)"),
+        Argument("plate_cols", int, default=24,
+                 help="plate width in wells (cellvoyager numeric wells)"),
+    )
+
+    MAPPING_FILE = "file_mapping.json"
+
+    def create_batches(self, args):
+        # metadata configuration is one unit of host work
+        return [{"source_dir": args["source_dir"]}]
+
+    def run_batch(self, batch: dict) -> dict:
+        args = batch["args"]
+        src = Path(args["source_dir"])
+        if not src.is_dir():
+            raise MetadataError(f"source directory not found: {src}")
+        pattern = args["pattern"] or (
+            CELLVOYAGER_PATTERN if args["handler"] == "cellvoyager" else DEFAULT_PATTERN
+        )
+        handler = FilenameHandler(pattern, args["handler"], args["plate_cols"])
+
+        entries = []
+        skipped = 0
+        for path in sorted(src.rglob("*")):
+            if not path.is_file():
+                continue
+            parsed = handler.parse(path.name)
+            if parsed is None:
+                skipped += 1
+                continue
+            parsed["path"] = str(path)
+            entries.append(parsed)
+        if not entries:
+            raise MetadataError(
+                f"no files in {src} matched the '{args['handler']}' pattern"
+            )
+
+        manifest = self._build_manifest(entries, args)
+        store = ExperimentStore.create(self.store.root, manifest)
+        # refresh our store handle's manifest
+        self.store.experiment = manifest
+        self.store._site_index = store._site_index
+
+        mapping = self._build_mapping(entries, manifest)
+        (self.step_dir / self.MAPPING_FILE).write_text(json.dumps(mapping))
+        return {
+            "n_files": len(entries),
+            "n_skipped": skipped,
+            "n_sites": manifest.n_sites,
+            "n_channels": manifest.n_channels,
+        }
+
+    # ------------------------------------------------------------------ build
+    def _build_manifest(self, entries: list[dict], args) -> Experiment:
+        import cv2
+
+        channels = sorted({e["channel"] for e in entries})
+        n_cycles = max(e["cycle"] for e in entries) + 1
+        n_tpoints = max(e["tpoint"] for e in entries) + 1
+        n_zplanes = max(e["zplane"] for e in entries) + 1
+
+        # site linear index -> (y, x) grid within well
+        sites_per_well = max(e["site"] for e in entries) + 1
+        spw_x = args["sites_per_well_x"] or int(round(sites_per_well**0.5)) or 1
+        spw_y = -(-sites_per_well // spw_x)
+
+        by_plate: dict[str, set[tuple[int, int]]] = defaultdict(set)
+        for e in entries:
+            by_plate[e["plate"]].add((e["well_row"], e["well_col"]))
+
+        site_objs = tuple(
+            Site(y=i // spw_x, x=i % spw_x) for i in range(sites_per_well)
+        )
+        plates = [
+            Plate(
+                name=pname,
+                wells=tuple(
+                    Well(row=r, column=c, sites=site_objs)
+                    for r, c in sorted(wells)
+                ),
+            )
+            for pname, wells in sorted(by_plate.items())
+        ]
+
+        probe = cv2.imread(entries[0]["path"], cv2.IMREAD_UNCHANGED)
+        if probe is None:
+            raise MetadataError(f"cannot read probe image {entries[0]['path']}")
+        h, w = probe.shape[:2]
+
+        return Experiment(
+            name=self.store.experiment.name,
+            plates=plates,
+            channels=[Channel(index=i, name=n) for i, n in enumerate(channels)],
+            site_height=int(h),
+            site_width=int(w),
+            n_cycles=n_cycles,
+            n_tpoints=n_tpoints,
+            n_zplanes=n_zplanes,
+        )
+
+    def _build_mapping(self, entries: list[dict], manifest: Experiment) -> list[dict]:
+        """Reference ``ImageFileMapping``: file path → store coordinates."""
+        channel_index = {c.name: c.index for c in manifest.channels}
+        spw_x = max(s.x for p in manifest.plates for w in p.wells for s in w.sites) + 1
+        from tmlibrary_tpu.models.experiment import SiteRef
+
+        mapping = []
+        for e in entries:
+            ref = SiteRef(
+                plate=e["plate"],
+                well_row=e["well_row"],
+                well_column=e["well_col"],
+                site_y=e["site"] // spw_x,
+                site_x=e["site"] % spw_x,
+            )
+            mapping.append(
+                {
+                    "path": e["path"],
+                    "site_index": self.store.site_linear_index(ref),
+                    "cycle": e["cycle"],
+                    "channel": channel_index[e["channel"]],
+                    "tpoint": e["tpoint"],
+                    "zplane": e["zplane"],
+                }
+            )
+        return mapping
+
+    def load_mapping(self) -> list[dict]:
+        path = self.step_dir / self.MAPPING_FILE
+        if not path.exists():
+            raise MetadataError("file mapping missing — run metaconfig first")
+        return json.loads(path.read_text())
