@@ -1,0 +1,175 @@
+// tmnative: first-party native host kernels.
+//
+// Reference parity: the reference's performance-critical host code lives in
+// third-party C++ (cv2, mahotas — SURVEY.md §3 "external binary deps"); the
+// TPU rebuild keeps device math in XLA and implements its own native host
+// kernels for the two pathways that stay on the CPU:
+//
+//   1. union-find connected-component labeling (scipy scan order) — the
+//      host-side golden/fallback for the device labeler and the fast path
+//      for host-only workflows (ingest QC, tests);
+//   2. Moore-neighbor boundary tracing — polygon extraction for the object
+//      store (reference: PostGIS polygons via shapely/cv2).
+//
+// Built as a plain shared library, loaded via ctypes (no pybind11 in the
+// image). C ABI only.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+struct UnionFind {
+  std::vector<int32_t> parent;
+  explicit UnionFind(size_t n) : parent(n) {
+    for (size_t i = 0; i < n; ++i) parent[i] = static_cast<int32_t>(i);
+  }
+  int32_t find(int32_t x) {
+    while (parent[x] != x) {
+      parent[x] = parent[parent[x]];  // path halving
+      x = parent[x];
+    }
+    return x;
+  }
+  void unite(int32_t a, int32_t b) {
+    a = find(a);
+    b = find(b);
+    if (a == b) return;
+    // keep the smaller root: scan-order labeling falls out of this
+    if (a < b) parent[b] = a; else parent[a] = b;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Label the foreground (mask != 0) with 4- or 8-connectivity.
+// labels_out receives 0 for background, 1..N in scipy scan order
+// (components numbered by first pixel in row-major order).
+// Returns N, or -1 on invalid arguments.
+int32_t tm_cc_label(const uint8_t* mask, int32_t h, int32_t w,
+                    int32_t connectivity, int32_t* labels_out) {
+  if (!mask || !labels_out || h <= 0 || w <= 0) return -1;
+  if (connectivity != 4 && connectivity != 8) return -1;
+  const size_t n = static_cast<size_t>(h) * static_cast<size_t>(w);
+  UnionFind uf(n);
+
+  // one pass of neighbor unions (only look up/left — prior pixels)
+  for (int32_t y = 0; y < h; ++y) {
+    for (int32_t x = 0; x < w; ++x) {
+      const size_t i = static_cast<size_t>(y) * w + x;
+      if (!mask[i]) continue;
+      if (x > 0 && mask[i - 1]) uf.unite(static_cast<int32_t>(i), static_cast<int32_t>(i - 1));
+      if (y > 0) {
+        const size_t up = i - w;
+        if (mask[up]) uf.unite(static_cast<int32_t>(i), static_cast<int32_t>(up));
+        if (connectivity == 8) {
+          if (x > 0 && mask[up - 1]) uf.unite(static_cast<int32_t>(i), static_cast<int32_t>(up - 1));
+          if (x + 1 < w && mask[up + 1]) uf.unite(static_cast<int32_t>(i), static_cast<int32_t>(up + 1));
+        }
+      }
+    }
+  }
+
+  // second pass: roots are component minima (smaller-root union), so
+  // numbering roots in scan order reproduces scipy.ndimage.label exactly
+  std::vector<int32_t> remap(n, 0);
+  int32_t next = 0;
+  for (size_t i = 0; i < n; ++i) {
+    if (!mask[i]) { labels_out[i] = 0; continue; }
+    const int32_t r = uf.find(static_cast<int32_t>(i));
+    if (remap[r] == 0) remap[r] = ++next;
+    labels_out[i] = remap[r];
+  }
+  return next;
+}
+
+// Moore-neighbor boundary trace of one labeled object (8-connected
+// boundary, clockwise, starting at the first pixel in scan order).
+// out_yx receives up to max_pts (y, x) pairs; returns the number of
+// points, 0 if the label is absent, or -1 on invalid arguments.
+int32_t tm_trace_boundary(const int32_t* labels, int32_t h, int32_t w,
+                          int32_t label, int32_t* out_yx, int32_t max_pts) {
+  if (!labels || !out_yx || h <= 0 || w <= 0 || max_pts <= 0) return -1;
+  auto at = [&](int32_t y, int32_t x) -> bool {
+    return y >= 0 && y < h && x >= 0 && x < w &&
+           labels[static_cast<size_t>(y) * w + x] == label;
+  };
+  // first pixel in scan order
+  int32_t sy = -1, sx = -1;
+  for (int32_t y = 0; y < h && sy < 0; ++y)
+    for (int32_t x = 0; x < w; ++x)
+      if (at(y, x)) { sy = y; sx = x; break; }
+  if (sy < 0) return 0;
+
+  // clockwise Moore neighborhood order: W, NW, N, NE, E, SE, S, SW
+  static const int32_t dy[8] = {0, -1, -1, -1, 0, 1, 1, 1};
+  static const int32_t dx[8] = {-1, -1, 0, 1, 1, 1, 0, -1};
+
+  // Moore tracing with explicit backtrack + Jacob's stopping criterion:
+  // stop when the start pixel is re-entered from its original backtrack.
+  int32_t cy = sy, cx = sx;
+  int32_t back = 0;  // direction from current to backtrack; start = west
+  const int32_t back0 = back;
+  int32_t count = 0;
+  const int64_t limit = static_cast<int64_t>(h) * w * 4 + 8;
+  for (int64_t iter = 0; iter < limit; ++iter) {
+    if (iter == 0 || !(cy == sy && cx == sx)) {
+      if (count < max_pts) {
+        out_yx[2 * count] = cy;
+        out_yx[2 * count + 1] = cx;
+      }
+      ++count;
+    }
+    // scan clockwise from just past the backtrack neighbor
+    int32_t k = 1;
+    int32_t d = -1;
+    for (; k <= 8; ++k) {
+      d = (back + k) % 8;
+      if (at(cy + dy[d], cx + dx[d])) break;
+    }
+    if (k > 8) break;  // isolated pixel
+    // move; the new backtrack is the neighbor scanned just before d,
+    // expressed as a direction from the NEW pixel
+    const int32_t prev = (back + k - 1) % 8;
+    const int32_t py = cy + dy[prev], px = cx + dx[prev];
+    cy += dy[d];
+    cx += dx[d];
+    // direction from new current back to that previous (background) pixel
+    back = 0;
+    for (int32_t j = 0; j < 8; ++j) {
+      if (cy + dy[j] == py && cx + dx[j] == px) { back = j; break; }
+    }
+    if (cy == sy && cx == sx && back == back0) break;
+  }
+  // return the TRUE count even when it exceeds max_pts, so callers can
+  // detect truncation and retry with a larger buffer
+  return count;
+}
+
+// Per-object bounding boxes: out receives (min_y, min_x, max_y, max_x) per
+// label 1..max_label (rows of 4); labels absent get (-1,-1,-1,-1).
+void tm_bounding_boxes(const int32_t* labels, int32_t h, int32_t w,
+                       int32_t max_label, int32_t* out) {
+  for (int32_t l = 0; l < max_label; ++l) {
+    out[4 * l] = -1; out[4 * l + 1] = -1; out[4 * l + 2] = -1; out[4 * l + 3] = -1;
+  }
+  for (int32_t y = 0; y < h; ++y) {
+    for (int32_t x = 0; x < w; ++x) {
+      const int32_t v = labels[static_cast<size_t>(y) * w + x];
+      if (v < 1 || v > max_label) continue;
+      int32_t* b = out + 4 * (v - 1);
+      if (b[0] < 0) { b[0] = y; b[1] = x; b[2] = y; b[3] = x; }
+      else {
+        if (y < b[0]) b[0] = y;
+        if (x < b[1]) b[1] = x;
+        if (y > b[2]) b[2] = y;
+        if (x > b[3]) b[3] = x;
+      }
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,108 @@
+import jax.numpy as jnp
+import numpy as np
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.jterator.modules import (
+    combine_masks,
+    expand_or_shrink,
+    filter_edges,
+    invert,
+    morphology,
+    project,
+    rescale,
+    separate_clumps,
+    apply_mask,
+)
+
+
+def test_project_methods(rng):
+    v = rng.random((4, 8, 8)).astype(np.float32)
+    jv = jnp.asarray(v)
+    np.testing.assert_allclose(np.asarray(project(jv, "max")["projected_image"]), v.max(0))
+    np.testing.assert_allclose(
+        np.asarray(project(jv, "mean")["projected_image"]), v.mean(0), rtol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(project(jv, "sum")["projected_image"]), v.sum(0), rtol=1e-6
+    )
+
+
+def test_morphology_open_removes_specks(rng):
+    mask = np.zeros((32, 32), bool)
+    mask[8:20, 8:20] = True
+    mask[2, 2] = True  # single-pixel speck
+    out = np.asarray(morphology(jnp.asarray(mask), "open", 1)["output_mask"])
+    assert not out[2, 2]
+    assert out[10:18, 10:18].all()
+
+
+def test_morphology_close_fills_gap():
+    mask = np.ones((16, 16), bool)
+    mask[8, 8] = False
+    out = np.asarray(morphology(jnp.asarray(mask), "close", 1)["output_mask"])
+    assert out[8, 8]
+
+
+def test_filter_edges_sobel_highlights_step():
+    img = np.zeros((16, 16), np.float32)
+    img[:, 8:] = 1000.0
+    out = np.asarray(filter_edges(jnp.asarray(img), "sobel")["filtered_image"])
+    assert out[8, 7] > 1000 and out[8, 8] > 1000
+    assert out[8, 3] == 0.0
+
+
+def test_filter_edges_log_zero_on_flat():
+    img = np.full((16, 16), 500.0, np.float32)
+    out = np.asarray(filter_edges(jnp.asarray(img), "log")["filtered_image"])
+    np.testing.assert_allclose(out, 0.0, atol=1e-2)
+
+
+def test_separate_clumps_splits_dumbbell():
+    # two overlapping disks forming a dumbbell — one CC, two true objects
+    yy, xx = np.mgrid[0:48, 0:48]
+    m1 = (yy - 24) ** 2 + (xx - 16) ** 2 <= 81
+    m2 = (yy - 24) ** 2 + (xx - 32) ** 2 <= 81
+    mask = m1 | m2
+    labels = mask.astype(np.int32)
+    _, n0 = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    assert n0 == 1
+    out = np.asarray(
+        separate_clumps(jnp.asarray(labels), min_distance=5)["separated_label_image"]
+    )
+    ids = set(np.unique(out)) - {0}
+    assert len(ids) == 2
+    # each disk center belongs to a different object
+    assert out[24, 12] != out[24, 36]
+
+
+def test_invert_and_mask_and_combine(rng):
+    img = jnp.asarray(rng.integers(0, 100, (8, 8)).astype(np.float32))
+    inv = np.asarray(invert(img)["inverted_image"])
+    np.testing.assert_allclose(inv, float(jnp.max(img)) - np.asarray(img))
+    bmask = jnp.asarray(np.eye(8, dtype=bool))
+    binv = np.asarray(invert(bmask)["inverted_image"])
+    np.testing.assert_array_equal(binv, ~np.eye(8, dtype=bool))
+    masked = np.asarray(apply_mask(img, bmask)["masked_image"])
+    assert masked[0, 1] == 0 and masked[0, 0] == np.asarray(img)[0, 0]
+    comb = np.asarray(
+        combine_masks(bmask, jnp.asarray(np.ones((8, 8), bool)), "AND")["combined_mask"]
+    )
+    np.testing.assert_array_equal(comb, np.eye(8, dtype=bool))
+
+
+def test_rescale_module(rng):
+    img = jnp.asarray(rng.integers(0, 1000, (8, 8)).astype(np.float32))
+    out = np.asarray(rescale(img, 0.0, 1000.0)["rescaled_image"])
+    assert out.min() >= 0.0 and out.max() <= 1.0
+
+
+def test_expand_or_shrink_roundtrip():
+    labels = np.zeros((24, 24), np.int32)
+    labels[10:14, 10:14] = 1
+    grown = np.asarray(expand_or_shrink(jnp.asarray(labels), n=2)["expanded_image"])
+    assert grown[8, 8] == 1  # diagonal growth reaches the corner
+    assert (grown > 0).sum() > 16
+    shrunk = np.asarray(expand_or_shrink(jnp.asarray(grown), n=-2)["expanded_image"])
+    # shrinking back leaves roughly the original square
+    assert (shrunk > 0).sum() <= (grown > 0).sum()
+    assert shrunk[11, 11] == 1

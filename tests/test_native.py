@@ -1,0 +1,111 @@
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu import native
+
+
+@pytest.fixture(scope="module", autouse=True)
+def require_native():
+    if not native.available():
+        pytest.skip("native library unavailable (no g++?)")
+
+
+def blobs(rng, shape=(128, 128), n=15, r=6):
+    img = np.zeros(shape, bool)
+    yy, xx = np.mgrid[0 : shape[0], 0 : shape[1]]
+    for y, x in zip(rng.integers(r, shape[0] - r, n), rng.integers(r, shape[1] - r, n)):
+        img |= (yy - y) ** 2 + (xx - x) ** 2 <= r**2
+    return img
+
+
+@pytest.mark.parametrize("connectivity", [4, 8])
+def test_cc_label_matches_scipy(rng, connectivity):
+    mask = blobs(rng)
+    structure = ndi.generate_binary_structure(2, 1 if connectivity == 4 else 2)
+    expected, n_exp = ndi.label(mask, structure=structure)
+    labels, n = native.cc_label_host(mask, connectivity)
+    assert n == n_exp
+    np.testing.assert_array_equal(labels, expected)
+
+
+def test_cc_label_snake(rng):
+    mask = np.zeros((64, 64), bool)
+    for row in range(0, 64, 2):
+        mask[row, :] = True
+        if row + 1 < 64:
+            mask[row + 1, 63 if (row // 2) % 2 == 0 else 0] = True
+    labels, n = native.cc_label_host(mask, 8)
+    expected, n_exp = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    assert n == n_exp == 1
+    np.testing.assert_array_equal(labels, expected)
+
+
+def test_cc_label_empty():
+    labels, n = native.cc_label_host(np.zeros((8, 8), bool), 8)
+    assert n == 0 and labels.sum() == 0
+
+
+def test_trace_boundary_square():
+    labels = np.zeros((16, 16), np.int32)
+    labels[4:9, 4:9] = 1  # 5x5 square
+    pts = native.trace_boundary_host(labels, 1)
+    assert pts is not None
+    # boundary of a 5x5 square = 16 pixels
+    assert len(pts) == 16
+    # all points on the perimeter, start at first scan pixel
+    assert tuple(pts[0]) == (4, 4)
+    for y, x in pts:
+        assert labels[y, x] == 1
+        assert y in (4, 8) or x in (4, 8)
+
+
+def test_trace_boundary_single_pixel():
+    labels = np.zeros((8, 8), np.int32)
+    labels[3, 3] = 7
+    pts = native.trace_boundary_host(labels, 7)
+    assert len(pts) == 1 and tuple(pts[0]) == (3, 3)
+
+
+def test_trace_boundary_absent_label():
+    labels = np.zeros((8, 8), np.int32)
+    pts = native.trace_boundary_host(labels, 5)
+    assert len(pts) == 0
+
+
+def test_trace_matches_mask_outline(rng):
+    mask = blobs(rng, n=1, r=10)
+    labels, n = native.cc_label_host(mask, 8)
+    assert n >= 1
+    pts = native.trace_boundary_host(labels, 1)
+    # every traced point is a boundary pixel of the object (touches bg)
+    for y, x in pts:
+        assert labels[y, x] == 1
+        neigh = labels[max(0, y - 1) : y + 2, max(0, x - 1) : x + 2]
+        assert (neigh == 0).any() or y in (0, 127) or x in (0, 127)
+
+
+def test_bounding_boxes(rng):
+    labels = np.zeros((32, 32), np.int32)
+    labels[2:5, 3:9] = 1
+    labels[20:30, 15:18] = 2
+    boxes = native.bounding_boxes_host(labels, max_label=3)
+    np.testing.assert_array_equal(boxes[0], [2, 3, 4, 8])
+    np.testing.assert_array_equal(boxes[1], [20, 15, 29, 17])
+    np.testing.assert_array_equal(boxes[2], [-1, -1, -1, -1])
+
+
+def test_native_vs_python_fallback(rng):
+    """Fallback path must agree with the native path."""
+    mask = blobs(rng)
+    native_labels, n1 = native.cc_label_host(mask, 8)
+    import tmlibrary_tpu.native as nat
+
+    saved, saved_attempt = nat._lib, nat._load_attempted
+    try:
+        nat._lib, nat._load_attempted = None, True  # force fallback
+        fb_labels, n2 = native.cc_label_host(mask, 8)
+    finally:
+        nat._lib, nat._load_attempted = saved, saved_attempt
+    assert n1 == n2
+    np.testing.assert_array_equal(native_labels, fb_labels)

@@ -1,0 +1,101 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from tmlibrary_tpu.errors import NotSupportedError
+from tmlibrary_tpu.readers import (
+    BFImageReader,
+    DatasetReader,
+    ImageReader,
+    JsonReader,
+    TablesReader,
+    XmlReader,
+)
+from tmlibrary_tpu.writers import (
+    DatasetWriter,
+    ImageWriter,
+    JsonWriter,
+    TablesWriter,
+    XmlWriter,
+)
+
+
+def test_image_roundtrip(tmp_path, rng):
+    img = rng.integers(0, 65535, (32, 32)).astype(np.uint16)
+    path = tmp_path / "a.png"
+    with ImageWriter(path) as w:
+        w.write(img)
+    with ImageReader(path) as r:
+        back = r.read()
+    np.testing.assert_array_equal(back, img)
+
+
+def test_image_reader_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ImageReader(tmp_path / "nope.png").read()
+
+
+def test_bfimage_reader_states_unsupported(tmp_path):
+    with pytest.raises(NotSupportedError, match="Bio-Formats"):
+        BFImageReader(tmp_path / "x.nd2").read()
+
+
+def test_hdf5_roundtrip(tmp_path, rng):
+    path = tmp_path / "d.h5"
+    data = rng.random((8, 8)).astype(np.float32)
+    with DatasetWriter(path) as w:
+        w.write("group/stats/mean", data)
+        w.write("scalar", 5)
+    with DatasetReader(path) as r:
+        np.testing.assert_array_equal(r.read("group/stats/mean"), data)
+        assert int(r.read("scalar")) == 5
+        assert r.exists("group/stats/mean")
+        assert not r.exists("nope")
+        assert "group/stats/mean" in r.list_datasets()
+    with DatasetReader(path) as r:
+        with pytest.raises(KeyError):
+            r.read("missing/path")
+
+
+def test_hdf5_append(tmp_path):
+    path = tmp_path / "a.h5"
+    with DatasetWriter(path) as w:
+        w.append("rows", np.ones((2, 3)))
+        w.append("rows", np.full((3, 3), 2.0))
+    with DatasetReader(path) as r:
+        got = r.read("rows")
+    assert got.shape == (5, 3)
+    assert got[2:].mean() == 2.0
+
+
+def test_json_xml_roundtrip(tmp_path):
+    with JsonWriter(tmp_path / "x.json") as w:
+        w.write({"a": [1, 2]})
+    with JsonReader(tmp_path / "x.json") as r:
+        assert r.read() == {"a": [1, 2]}
+
+    from xml.etree import ElementTree
+
+    el = ElementTree.Element("OME")
+    ElementTree.SubElement(el, "Image", {"ID": "1"})
+    with XmlWriter(tmp_path / "x.xml") as w:
+        w.write(el)
+    with XmlReader(tmp_path / "x.xml") as r:
+        back = r.read()
+    assert back.tag == "OME" and back[0].get("ID") == "1"
+
+
+@pytest.mark.parametrize("suffix", [".parquet", ".csv"])
+def test_tables_roundtrip(tmp_path, suffix):
+    df = pd.DataFrame({"a": [1, 2], "b": ["x", "y"]})
+    path = tmp_path / f"t{suffix}"
+    with TablesWriter(path) as w:
+        w.write(df)
+    with TablesReader(path) as r:
+        back = r.read()
+    pd.testing.assert_frame_equal(back, df)
+
+
+def test_tables_unsupported(tmp_path):
+    with pytest.raises(NotSupportedError):
+        TablesWriter(tmp_path / "t.xlsx").write(pd.DataFrame())

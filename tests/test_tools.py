@@ -1,0 +1,103 @@
+import numpy as np
+import pandas as pd
+import pytest
+
+from tmlibrary_tpu.errors import NotSupportedError, RegistryError
+from tmlibrary_tpu.models.experiment import grid_experiment
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.tools import ToolRequestManager, get_tool, list_tools
+
+
+@pytest.fixture
+def store_with_features(tmp_path, rng):
+    """Store with a synthetic two-population feature table."""
+    exp = grid_experiment(name="tools", well_rows=1, well_cols=1,
+                          sites_per_well=(2, 2), site_shape=(16, 16))
+    store = ExperimentStore.create(tmp_path / "exp", exp)
+    rows = []
+    for site in range(4):
+        for label in range(1, 21):
+            # population A: small dim objects; population B: large bright
+            pop_b = label > 10
+            rows.append(
+                {
+                    "site_index": site,
+                    "plate": "plate00",
+                    "well_row": 0,
+                    "well_col": 0,
+                    "site_y": site // 2,
+                    "site_x": site % 2,
+                    "label": label,
+                    "Morphology_area": rng.normal(400 if pop_b else 80, 10),
+                    "Intensity_mean_DAPI": rng.normal(3000 if pop_b else 500, 50),
+                }
+            )
+    store.append_features("nuclei", pd.DataFrame(rows), shard="batch_000")
+    return store
+
+
+def test_registry():
+    assert set(list_tools()) >= {"classification", "clustering", "heatmap"}
+    with pytest.raises(RegistryError):
+        get_tool("nope")
+
+
+def test_clustering_separates_populations(store_with_features):
+    mgr = ToolRequestManager(store_with_features)
+    result = mgr.submit("clustering", {"objects_name": "nuclei", "k": 2})
+    assert result.layer_type == "categorical"
+    v = result.values
+    a = v[v["label"] <= 10]["value"]
+    b = v[v["label"] > 10]["value"]
+    # each true population lands in one cluster
+    assert a.nunique() == 1 and b.nunique() == 1
+    assert a.iloc[0] != b.iloc[0]
+    # result persisted
+    results = mgr.list_results()
+    assert len(results) == 1 and results[0]["tool"] == "clustering"
+
+
+@pytest.mark.parametrize("method", ["logreg", "svm", "randomforest"])
+def test_classification_methods(store_with_features, method):
+    mgr = ToolRequestManager(store_with_features)
+    examples = [
+        {"site_index": 0, "label": 1, "class": "dim"},
+        {"site_index": 0, "label": 2, "class": "dim"},
+        {"site_index": 1, "label": 3, "class": "dim"},
+        {"site_index": 0, "label": 11, "class": "bright"},
+        {"site_index": 0, "label": 12, "class": "bright"},
+        {"site_index": 1, "label": 13, "class": "bright"},
+    ]
+    result = mgr.submit(
+        "classification",
+        {"objects_name": "nuclei", "method": method, "training_examples": examples},
+    )
+    v = result.values
+    classes = result.attributes["classes"]
+    # population A (labels 1..10) should classify 'dim', B 'bright'
+    pred_a = [classes[i] for i in v[v["label"] <= 10]["value"]]
+    pred_b = [classes[i] for i in v[v["label"] > 10]["value"]]
+    assert np.mean([p == "dim" for p in pred_a]) > 0.95
+    assert np.mean([p == "bright" for p in pred_b]) > 0.95
+
+
+def test_classification_requires_examples(store_with_features):
+    mgr = ToolRequestManager(store_with_features)
+    with pytest.raises(NotSupportedError):
+        mgr.submit("classification", {"objects_name": "nuclei"})
+
+
+def test_heatmap(store_with_features):
+    mgr = ToolRequestManager(store_with_features)
+    result = mgr.submit(
+        "heatmap", {"objects_name": "nuclei", "feature": "Intensity_mean_DAPI"}
+    )
+    assert result.layer_type == "continuous"
+    assert result.attributes["max"] > result.attributes["min"]
+    assert len(result.values) == 80
+
+
+def test_heatmap_unknown_feature(store_with_features):
+    mgr = ToolRequestManager(store_with_features)
+    with pytest.raises(NotSupportedError, match="not found"):
+        mgr.submit("heatmap", {"objects_name": "nuclei", "feature": "Bogus"})

@@ -1,0 +1,162 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.ops.volume import (
+    connected_components_3d,
+    propagate_labels_3d,
+    volume_features,
+    watershed_from_seeds_3d,
+)
+
+
+def blob_volume(rng, shape=(16, 48, 48), n=6, r=4):
+    vol = np.zeros(shape, bool)
+    zz, yy, xx = np.mgrid[0 : shape[0], 0 : shape[1], 0 : shape[2]]
+    for _ in range(n):
+        z = rng.integers(r, shape[0] - r)
+        y = rng.integers(r, shape[1] - r)
+        x = rng.integers(r, shape[2] - r)
+        vol |= (zz - z) ** 2 + (yy - y) ** 2 + (xx - x) ** 2 <= r**2
+    return vol
+
+
+@pytest.mark.parametrize("connectivity,order", [(6, 1), (18, 2), (26, 3)])
+def test_cc3d_matches_scipy(rng, connectivity, order):
+    vol = blob_volume(rng)
+    structure = ndi.generate_binary_structure(3, order)
+    expected, n_exp = ndi.label(vol, structure=structure)
+    labels, n = connected_components_3d(jnp.asarray(vol), connectivity)
+    assert int(n) == n_exp
+    np.testing.assert_array_equal(np.asarray(labels), expected)
+
+
+def test_cc3d_z_column():
+    vol = np.zeros((8, 8, 8), bool)
+    vol[:, 4, 4] = True  # column through all z
+    labels, n = connected_components_3d(jnp.asarray(vol), 6)
+    assert int(n) == 1
+    assert (np.asarray(labels)[:, 4, 4] == 1).all()
+
+
+def test_cc3d_corner_connectivity():
+    vol = np.zeros((4, 4, 4), bool)
+    vol[0, 0, 0] = True
+    vol[1, 1, 1] = True  # corner-touching
+    _, n6 = connected_components_3d(jnp.asarray(vol), 6)
+    _, n18 = connected_components_3d(jnp.asarray(vol), 18)
+    _, n26 = connected_components_3d(jnp.asarray(vol), 26)
+    assert int(n6) == 2 and int(n18) == 2 and int(n26) == 1
+
+
+def test_propagate_3d():
+    seeds = jnp.zeros((8, 16, 16), jnp.int32).at[4, 4, 4].set(1).at[4, 12, 12].set(2)
+    out = np.asarray(propagate_labels_3d(seeds, jnp.ones((8, 16, 16), bool)))
+    assert set(np.unique(out)) == {1, 2}
+
+
+def test_watershed_3d_splits():
+    zz, yy, xx = np.mgrid[0:12, 0:32, 0:32].astype(np.float32)
+    intensity = (
+        2000 * np.exp(-((zz - 6) ** 2 + (yy - 16) ** 2 + (xx - 10) ** 2) / 18.0)
+        + 2000 * np.exp(-((zz - 6) ** 2 + (yy - 16) ** 2 + (xx - 22) ** 2) / 18.0)
+    )
+    seeds = np.zeros((12, 32, 32), np.int32)
+    seeds[6, 16, 10] = 1
+    seeds[6, 16, 22] = 2
+    mask = intensity > 200
+    labels = np.asarray(
+        watershed_from_seeds_3d(jnp.asarray(intensity), jnp.asarray(seeds),
+                                jnp.asarray(mask), n_levels=12)
+    )
+    assert (labels == 1).sum() > 20 and (labels == 2).sum() > 20
+    assert labels[6, 16, 10] == 1 and labels[6, 16, 22] == 2
+    # divide near x=16
+    border = labels[6, 16, 14:19]
+    assert 1 in border and 2 in border
+
+
+def test_volume_features(rng):
+    labels = np.zeros((8, 16, 16), np.int32)
+    labels[2:5, 4:8, 4:8] = 1  # 3*4*4 = 48 voxels
+    intensity = np.full((8, 16, 16), 10.0, np.float32)
+    feats = volume_features(jnp.asarray(labels), jnp.asarray(intensity), 8)
+    assert float(feats["Volume_voxels"][0]) == 48.0
+    np.testing.assert_allclose(float(feats["Volume_centroid_z"][0]), 3.0)
+    np.testing.assert_allclose(float(feats["Volume_intensity_mean"][0]), 10.0)
+    assert float(feats["Volume_voxels"][3]) == 0.0
+
+
+def test_volume_pipeline_modules(rng):
+    """z-stack channel → generate_volume_image → segment_volume →
+    measure_volume through the engine."""
+    from tmlibrary_tpu.jterator.description import PipelineDescription
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+    pipe = {
+        "input": {"channels": [{"name": "DAPI", "correct": False, "zstack": True}]},
+        "pipeline": [
+            {
+                "handles": {
+                    "module": "generate_volume_image",
+                    "input": [{"name": "zstack", "type": "IntensityImage", "key": "DAPI"}],
+                    "output": [
+                        {"name": "volume_image", "type": "IntensityImage", "key": "vol"}
+                    ],
+                }
+            },
+            {
+                "handles": {
+                    "module": "segment_volume",
+                    "input": [
+                        {"name": "volume_image", "type": "IntensityImage", "key": "vol"},
+                        {"name": "threshold_method", "type": "Character", "value": "manual"},
+                        {"name": "threshold_value", "type": "Numeric", "value": 1000},
+                    ],
+                    "output": [
+                        {
+                            "name": "objects",
+                            "type": "SegmentedObjects",
+                            "key": "nuclei3d",
+                            "objects": "nuclei3d",
+                        }
+                    ],
+                }
+            },
+            {
+                "handles": {
+                    "module": "measure_volume",
+                    "input": [
+                        {"name": "objects_image", "type": "LabelImage", "key": "nuclei3d"},
+                        {"name": "intensity_image", "type": "IntensityImage", "key": "vol"},
+                    ],
+                    "output": [
+                        {"name": "measurements", "type": "Measurement", "objects": "nuclei3d"}
+                    ],
+                }
+            },
+        ],
+        "output": {"objects": [{"name": "nuclei3d"}]},
+    }
+    desc = PipelineDescription.from_dict(pipe)
+    engine = ImageAnalysisPipeline(desc, max_objects=16)
+    fn = engine.build_batch_fn()
+
+    vols = []
+    for _ in range(2):
+        v = rng.normal(300, 20, (6, 32, 32)).astype(np.float32)
+        zz, yy, xx = np.mgrid[0:6, 0:32, 0:32]
+        for _ in range(3):
+            z, y, x = rng.integers(1, 5), rng.integers(6, 26), rng.integers(6, 26)
+            v += 4000 * np.exp(-(((zz - z) * 2) ** 2 + (yy - y) ** 2 + (xx - x) ** 2) / 8.0)
+        vols.append(v)
+    batch = jnp.asarray(np.stack(vols))  # (B, Z, H, W)
+    result = fn({"DAPI": batch}, {}, jnp.zeros((2, 2), jnp.int32))
+    assert result.objects["nuclei3d"].shape == (2, 6, 32, 32)
+    counts = np.asarray(result.counts["nuclei3d"])
+    assert (counts >= 1).all()
+    vox = np.asarray(result.measurements["nuclei3d"]["Volume_voxels"])
+    assert vox.shape == (2, 16)
+    assert (vox[0, : counts[0]] > 0).all()

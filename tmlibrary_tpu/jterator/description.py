@@ -37,6 +37,10 @@ class ChannelInput:
     name: str
     correct: bool = True
     align: bool = False
+    #: load the channel as a (Z, H, W) z-stack volume instead of one plane
+    #: (feeds generate_volume_image / segment_volume; correction and
+    #: alignment are per-plane concerns and are skipped for volumes)
+    zstack: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -70,6 +74,7 @@ class PipelineDescription:
                 name=c["name"],
                 correct=bool(c.get("correct", True)),
                 align=bool(c.get("align", False)),
+                zstack=bool(c.get("zstack", False)),
             )
             for c in inp.get("channels", []) or []
         ]

@@ -317,6 +317,133 @@ def measure_zernike(objects_image, degree: int = 9, patch: int = 64, max_objects
     }
 
 
+@register_module("project")
+def project(zstack, method: str = "max"):
+    """Z-projection of a (Z, H, W) volume (reference ``jtmodules/project.py``)."""
+    v = jnp.asarray(zstack, jnp.float32)
+    if method == "max":
+        return {"projected_image": jnp.max(v, axis=0)}
+    if method == "mean":
+        return {"projected_image": jnp.mean(v, axis=0)}
+    if method == "sum":
+        return {"projected_image": jnp.sum(v, axis=0)}
+    raise ValueError(f"unknown projection method '{method}'")
+
+
+@register_module("morphology")
+def morphology(mask, operation: str = "open", iterations: int = 1):
+    """Binary morphology (reference ``jtmodules/morphology.py``):
+    open | close | dilate | erode."""
+    m = jnp.asarray(mask, bool)
+    if operation == "dilate":
+        out = label_ops.binary_dilate(m, 8, iterations)
+    elif operation == "erode":
+        out = label_ops.binary_erode(m, 8, iterations)
+    elif operation == "open":
+        out = label_ops.binary_dilate(
+            label_ops.binary_erode(m, 8, iterations), 8, iterations
+        )
+    elif operation == "close":
+        out = label_ops.binary_erode(
+            label_ops.binary_dilate(m, 8, iterations), 8, iterations
+        )
+    else:
+        raise ValueError(f"unknown morphology operation '{operation}'")
+    return {"output_mask": out}
+
+
+@register_module("filter_edges")
+def filter_edges(intensity_image, method: str = "sobel"):
+    """Edge enhancement (reference ``jtmodules/filter.py`` edge options):
+    sobel gradient magnitude or Laplacian-of-Gaussian."""
+    img = jnp.asarray(intensity_image, jnp.float32)
+    if method == "sobel":
+        # 3x3 sobel on an edge-replicated pad: flat borders yield zero
+        # gradient (zero-fill shifts would ring the frame with false edges)
+        p = jnp.pad(img, 1, mode="edge")
+        h, w = img.shape
+
+        def s(dy, dx):
+            return p[1 + dy : 1 + dy + h, 1 + dx : 1 + dx + w]
+
+        gy =(s(1, -1) + 2 * s(1, 0) + s(1, 1)) - (s(-1, -1) + 2 * s(-1, 0) + s(-1, 1))
+        gx = (s(-1, 1) + 2 * s(0, 1) + s(1, 1)) - (s(-1, -1) + 2 * s(0, -1) + s(1, -1))
+        return {"filtered_image": jnp.sqrt(gy**2 + gx**2)}
+    if method == "log":
+        sm = smooth_ops.gaussian_smooth(img, 2.0)
+        # edge-replicated padding keeps the Laplacian zero on flat borders
+        p = jnp.pad(sm, 1, mode="edge")
+        lap = p[:-2, 1:-1] + p[2:, 1:-1] + p[1:-1, :-2] + p[1:-1, 2:] - 4.0 * sm
+        return {"filtered_image": lap}
+    raise ValueError(f"unknown edge filter '{method}'")
+
+
+@register_module("separate_clumps")
+def separate_clumps(label_image, min_distance: int = 5, max_objects: int = 256):
+    """Split touching objects by distance-transform watershed
+    (reference ``jtmodules/separate_clumps.py`` shape-based declumping)."""
+    from tmlibrary_tpu.ops.segment_primary import (
+        distance_transform_approx,
+        local_maxima_seeds,
+    )
+    from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+
+    mask = jnp.asarray(label_image) > 0
+    dist = distance_transform_approx(mask)
+    seeds = local_maxima_seeds(
+        dist, mask, min_distance=min_distance, smooth_sigma=min_distance / 2.0
+    )
+    out = watershed_from_seeds(dist, seeds, mask)
+    return {"separated_label_image": label_ops.clip_label_count(out, max_objects)}
+
+
+@register_module("generate_volume_image")
+def generate_volume_image(zstack):
+    """Pass a (Z, H, W) z-stack through as a volume for 3-D segmentation
+    (reference ``jtmodules/generate_volume_image.py``)."""
+    return {"volume_image": jnp.asarray(zstack, jnp.float32)}
+
+
+@register_module("segment_volume")
+def segment_volume(
+    volume_image,
+    threshold_method: str = "otsu",
+    threshold_value: float = 0.0,
+    correction_factor: float = 1.0,
+    connectivity: int = 26,
+    max_objects: int = 256,
+):
+    """3-D segmentation: threshold + 3-D connected components
+    (BASELINE config 5 stretch; see ops/volume.py)."""
+    from tmlibrary_tpu.ops.volume import connected_components_3d
+
+    if connectivity not in (6, 18, 26):
+        raise ValueError(
+            f"3-D connectivity must be 6, 18 or 26, got {connectivity} "
+            f"(2-D values 4/8 do not apply to volumes)"
+        )
+    vol = jnp.asarray(volume_image, jnp.float32)
+    if threshold_method == "otsu":
+        t = threshold_ops.otsu_value(vol) * correction_factor
+        mask = vol > t
+    elif threshold_method == "manual":
+        mask = vol > threshold_value
+    else:
+        raise ValueError(f"unknown threshold method '{threshold_method}'")
+    labels, _ = connected_components_3d(mask, connectivity)
+    return {"objects": label_ops.clip_label_count(labels, max_objects)}
+
+
+@register_module("measure_volume")
+def measure_volume(objects_image, intensity_image, max_objects: int = 256):
+    """3-D per-object measurements (volume, centroid, intensity stats)."""
+    from tmlibrary_tpu.ops.volume import volume_features
+
+    return {
+        "measurements": volume_features(objects_image, intensity_image, max_objects)
+    }
+
+
 @register_module("expand_or_shrink")
 def expand_or_shrink(label_image, n: int = 1, max_objects: int = 256):
     """Reference ``jtmodules/expand_or_shrink.py``: morphological expansion

@@ -163,6 +163,10 @@ class ImageAnalysisPipeline:
             out: dict[str, jax.Array] = {}
             for ch in desc.channels:
                 img = jnp.asarray(raw[ch.name], jnp.float32)
+                if ch.zstack:
+                    # volumes skip per-plane correction/alignment
+                    out[ch.name] = img
+                    continue
                 if ch.correct and ch.name in stats:
                     mean_log, std_log = stats[ch.name]
                     img = image_ops.correct_illumination(img, mean_log, std_log)

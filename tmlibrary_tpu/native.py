@@ -1,0 +1,145 @@
+"""ctypes loader for the first-party native host kernels.
+
+See ``native/tmnative.cpp``.  The library auto-builds with ``g++`` on first
+use if the ``.so`` is missing; every entry point has a pure-Python/scipy
+fallback, so the framework works without a compiler (the native path is a
+performance + golden-reference layer, mirroring how the reference leans on
+cv2/mahotas binaries).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_SO_PATH = _NATIVE_DIR / "libtmnative.so"
+_lib = None
+_load_attempted = False
+
+
+def _build() -> bool:
+    src = _NATIVE_DIR / "tmnative.cpp"
+    if not src.exists():
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
+             "-o", str(_SO_PATH), str(src)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        logger.info("native build unavailable: %s", e)
+        return False
+
+
+def _load():
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if not _SO_PATH.exists() and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO_PATH))
+    except OSError as e:
+        logger.info("native library failed to load: %s", e)
+        return None
+    lib.tm_cc_label.restype = ctypes.c_int32
+    lib.tm_cc_label.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.tm_trace_boundary.restype = ctypes.c_int32
+    lib.tm_trace_boundary.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32), ctypes.c_int32,
+    ]
+    lib.tm_bounding_boxes.restype = None
+    lib.tm_bounding_boxes.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+# ----------------------------------------------------------------- wrappers
+def cc_label_host(mask: np.ndarray, connectivity: int = 8) -> tuple[np.ndarray, int]:
+    """Host connected-component labeling, scipy scan order.
+
+    Native union-find when available; ``scipy.ndimage.label`` fallback.
+    """
+    mask = np.ascontiguousarray(mask.astype(np.uint8))
+    lib = _load()
+    if lib is None:
+        import scipy.ndimage as ndi
+
+        structure = ndi.generate_binary_structure(2, 1 if connectivity == 4 else 2)
+        labels, n = ndi.label(mask, structure=structure)
+        return labels.astype(np.int32), int(n)
+    h, w = mask.shape
+    out = np.empty((h, w), np.int32)
+    n = lib.tm_cc_label(
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w, connectivity,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if n < 0:
+        raise ValueError("tm_cc_label: invalid arguments")
+    return out, int(n)
+
+
+def trace_boundary_host(
+    labels: np.ndarray, label: int, max_pts: int = 1 << 16
+) -> np.ndarray:
+    """Moore boundary trace → (K, 2) int32 (y, x); empty if label absent.
+    Returns None when the native library is unavailable (callers fall back
+    to cv2).  The buffer grows automatically if the boundary exceeds
+    ``max_pts`` (the C function reports the true count)."""
+    lib = _load()
+    if lib is None:
+        return None
+    labels = np.ascontiguousarray(labels.astype(np.int32))
+    h, w = labels.shape
+    while True:
+        buf = np.empty((max_pts, 2), np.int32)
+        n = lib.tm_trace_boundary(
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), h, w, int(label),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), max_pts,
+        )
+        if n < 0:
+            raise ValueError("tm_trace_boundary: invalid arguments")
+        if n <= max_pts:
+            return buf[:n].copy()
+        max_pts = n  # truncated: retry with the exact required size
+
+
+def bounding_boxes_host(labels: np.ndarray, max_label: int) -> np.ndarray:
+    """(max_label, 4) int32 (min_y, min_x, max_y, max_x); -1 rows = absent."""
+    lib = _load()
+    labels = np.ascontiguousarray(labels.astype(np.int32))
+    h, w = labels.shape
+    if lib is None:
+        out = np.full((max_label, 4), -1, np.int32)
+        for lab in range(1, max_label + 1):
+            ys, xs = np.nonzero(labels == lab)
+            if len(ys):
+                out[lab - 1] = (ys.min(), xs.min(), ys.max(), xs.max())
+        return out
+    out = np.empty((max_label, 4), np.int32)
+    lib.tm_bounding_boxes(
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), h, w, max_label,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    return out

@@ -16,15 +16,27 @@ def labels_to_polygons(labels: np.ndarray) -> list[tuple[int, np.ndarray]]:
     """Trace the outer contour of every labeled object.
 
     Returns ``[(label, contour)]`` with ``contour`` an ``(K, 2)`` int32 array
-    of (y, x) vertices.  Objects with fewer than 3 boundary pixels yield
-    their pixel coordinates as a degenerate contour.
+    of (y, x) vertices.  Prefers the first-party native Moore tracer
+    (``native/tmnative.cpp``); falls back to cv2 border following.
     """
-    import cv2
-
     labels = np.asarray(labels)
-    out: list[tuple[int, np.ndarray]] = []
     ids = np.unique(labels)
     ids = ids[ids > 0]
+
+    from tmlibrary_tpu import native
+
+    if native.available():
+        out = []
+        labels32 = labels.astype(np.int32)
+        for lab in ids:
+            pts = native.trace_boundary_host(labels32, int(lab))
+            if pts is not None and len(pts):
+                out.append((int(lab), pts))
+        return out
+
+    import cv2
+
+    out: list[tuple[int, np.ndarray]] = []
     for lab in ids:
         mask = (labels == lab).astype(np.uint8)
         contours, _ = cv2.findContours(mask, cv2.RETR_EXTERNAL, cv2.CHAIN_APPROX_SIMPLE)

@@ -37,12 +37,22 @@ def distance_transform_approx(mask: jax.Array, max_distance: int = 64) -> jax.Ar
 
 
 def local_maxima_seeds(
-    surface: jax.Array, mask: jax.Array, min_distance: int = 5
+    surface: jax.Array,
+    mask: jax.Array,
+    min_distance: int = 5,
+    smooth_sigma: float = 0.0,
 ) -> jax.Array:
     """Find peaks of ``surface`` within ``mask`` separated by at least
-    ``min_distance`` (max-filter comparison), returning a labeled seed image."""
+    ``min_distance`` (max-filter comparison), returning a labeled seed image.
+
+    ``smooth_sigma`` pre-blurs the surface (CellProfiler-style): on chamfer
+    distance transforms the saddle between touching objects forms a flat
+    plateau that would otherwise register as a spurious third maximum.
+    """
     from tmlibrary_tpu.ops.smooth import _window_stack
 
+    if smooth_sigma > 0:
+        surface = gaussian_smooth(surface, smooth_sigma)
     size = 2 * min_distance + 1
     stack = _window_stack(surface, size)
     is_max = (surface >= jnp.max(stack, axis=0)) & jnp.asarray(mask, bool)
@@ -86,7 +96,10 @@ def segment_primary(
         # split touching objects: watershed on the distance transform from
         # its local maxima (CellProfiler shape-based declumping)
         dist = distance_transform_approx(mask)
-        seeds = local_maxima_seeds(dist, mask, min_distance=declump_min_distance)
+        seeds = local_maxima_seeds(
+            dist, mask, min_distance=declump_min_distance,
+            smooth_sigma=declump_min_distance / 2.0,
+        )
         # note: watershed labels carry seed ids (peak scan order), not
         # connected-component scan order
         labels = watershed_from_seeds(dist, seeds, mask)

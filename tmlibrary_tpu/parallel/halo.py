@@ -1,0 +1,113 @@
+"""Spatial sharding with halo exchange for mosaic-scale images.
+
+SURVEY.md §6 ("long-context"): the reference's scaling axis is image/mosaic
+size — it cuts work into per-site jobs and per-level waves.  For a single
+image too large for one chip (stitched plate mosaics are tens of
+gigapixels), the TPU-native answer is the sequence-parallelism analogue:
+shard the row axis across the mesh and exchange boundary rows with
+``lax.ppermute`` so neighborhood ops (smoothing, downsampling, local
+thresholds) stay exact at shard seams — the microscopy equivalent of ring
+attention's block-wise neighbor exchange.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+
+from tmlibrary_tpu.errors import ShardingError
+
+
+def halo_exchange(block: jax.Array, halo: int, axis_name: str) -> jax.Array:
+    """Extend a row-sharded block with ``halo`` rows from each neighbor.
+
+    Boundary shards fill their outer halo by symmetric reflection of their
+    own edge rows, so the assembled result matches a global
+    ``mode='symmetric'`` pad (the scipy-compatible boundary the ops use).
+    Returns ``(rows + 2*halo, W)``.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # neighbor edges travel one hop down/up the ring
+    from_prev = lax.ppermute(
+        block[-halo:], axis_name, [(i, (i + 1) % n) for i in range(n)]
+    )
+    from_next = lax.ppermute(
+        block[:halo], axis_name, [(i, (i - 1) % n) for i in range(n)]
+    )
+    reflect_top = block[:halo][::-1]
+    reflect_bottom = block[-halo:][::-1]
+    top = jnp.where(idx == 0, reflect_top, from_prev)
+    bottom = jnp.where(idx == n - 1, reflect_bottom, from_next)
+    return jnp.concatenate([top, block, bottom], axis=0)
+
+
+def sharded_halo_map(
+    fn,
+    image: jax.Array,
+    mesh: Mesh,
+    halo: int,
+    axis: str = "rows",
+):
+    """Apply ``fn`` (a (H', W) → (H', W) neighborhood op with reach <=
+    ``halo``) over a row-sharded image with exact seams.
+
+    ``fn`` receives the halo-extended block and must return it same-shaped;
+    the wrapper crops the halos back off.  The row count must divide by the
+    mesh size.
+    """
+    h = image.shape[0]
+    n = mesh.devices.size
+    if h % n != 0:
+        raise ShardingError(f"image rows {h} not divisible by mesh size {n}")
+
+    def body(block):
+        extended = halo_exchange(block, halo, axis)
+        out = fn(extended)
+        return out[halo:-halo]
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(axis),
+        out_specs=PartitionSpec(axis),
+    )
+    return jax.jit(mapped)(image)
+
+
+def sharded_gaussian_smooth(
+    image: jax.Array, mesh: Mesh, sigma: float, axis: str = "rows"
+) -> jax.Array:
+    """Row-sharded Gaussian blur, bit-matching the single-device
+    ``ops.smooth.gaussian_smooth`` (and thus scipy) including edges."""
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+    radius = int(4.0 * float(sigma) + 0.5)
+    return sharded_halo_map(
+        functools.partial(gaussian_smooth, sigma=sigma), image, mesh, radius, axis
+    )
+
+
+def sharded_downsample_2x(image: jax.Array, mesh: Mesh, axis: str = "rows") -> jax.Array:
+    """Row-sharded 2x2 mean downsample (pyramid level step) for mosaics
+    larger than one chip's HBM.  Shard row counts must be even."""
+    from tmlibrary_tpu.ops.pyramid import downsample_2x
+
+    h, w = image.shape
+    n = mesh.devices.size
+    if h % n != 0 or (h // n) % 2 != 0:
+        raise ShardingError(
+            f"rows {h} must split into even-sized shards over {n} devices"
+        )
+
+    mapped = jax.shard_map(
+        downsample_2x,
+        mesh=mesh,
+        in_specs=PartitionSpec(axis),
+        out_specs=PartitionSpec(axis),
+    )
+    return jax.jit(mapped)(image)

@@ -1,0 +1,122 @@
+"""Context-manager readers.
+
+Reference parity: ``tmlib/readers.py`` — ``ImageReader`` (cv2),
+``BFImageReader`` (Bio-Formats via javabridge — out of scope: no JVM;
+vendor ingest goes through metaconfig's filename handlers instead),
+``DatasetReader`` (HDF5/h5py), ``JsonReader``, ``XmlReader``,
+``TablesReader`` (pandas/HDF) — all usable as context managers.
+
+These exist for workflow-script parity: framework-internal IO goes through
+:mod:`tmlibrary_tpu.models.store`, but user analysis scripts written
+against the reference's reader API translate 1:1.
+"""
+
+from __future__ import annotations
+
+import json
+from abc import ABC
+from pathlib import Path
+from xml.etree import ElementTree
+
+import numpy as np
+
+from tmlibrary_tpu.errors import NotSupportedError
+
+
+class Reader(ABC):
+    """Base context-manager reader (reference ``tmlib.readers.Reader``)."""
+
+    def __init__(self, filename):
+        self.filename = Path(filename)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ImageReader(Reader):
+    """Read 2-D image files via cv2 (PNG/TIFF; uint8/uint16 preserved)."""
+
+    def read(self) -> np.ndarray:
+        import cv2
+
+        img = cv2.imread(str(self.filename), cv2.IMREAD_UNCHANGED)
+        if img is None:
+            raise FileNotFoundError(f"cannot read image: {self.filename}")
+        if img.ndim == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2GRAY)
+        return img
+
+
+class BFImageReader(Reader):
+    """Bio-Formats reader placeholder.
+
+    The reference reads vendor microscope formats through the Java
+    Bio-Formats library (``python-bioformats``/``javabridge``).  This image
+    has no JVM; vendor ingest is handled by metaconfig's filename handlers
+    plus plain-TIFF extraction.  Instantiating this reader states that
+    clearly instead of failing deep inside a job.
+    """
+
+    def read(self):
+        raise NotSupportedError(
+            "Bio-Formats is not available (no JVM); convert vendor files to "
+            "TIFF/PNG and use the metaconfig filename handlers"
+        )
+
+
+class DatasetReader(Reader):
+    """HDF5 dataset reader (reference ``DatasetReader``; h5py-backed)."""
+
+    def __enter__(self):
+        import h5py
+
+        self._f = h5py.File(self.filename, "r")
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+    def read(self, path: str) -> np.ndarray:
+        if path not in self._f:
+            raise KeyError(f"no dataset '{path}' in {self.filename}")
+        return np.asarray(self._f[path])
+
+    def list_datasets(self, group: str = "/") -> list[str]:
+        import h5py
+
+        out = []
+        self._f[group].visititems(
+            lambda name, obj: out.append(name) if isinstance(obj, h5py.Dataset) else None
+        )
+        return out
+
+    def exists(self, path: str) -> bool:
+        return path in self._f
+
+
+class JsonReader(Reader):
+    def read(self):
+        return json.loads(self.filename.read_text())
+
+
+class XmlReader(Reader):
+    def read(self) -> ElementTree.Element:
+        return ElementTree.fromstring(self.filename.read_text())
+
+
+class TablesReader(Reader):
+    """Tabular reader (reference used pandas/HDF; here Parquet + CSV)."""
+
+    def read(self):
+        import pandas as pd
+
+        suffix = self.filename.suffix.lower()
+        if suffix == ".parquet":
+            return pd.read_parquet(self.filename)
+        if suffix == ".csv":
+            return pd.read_csv(self.filename)
+        raise NotSupportedError(f"unsupported table format '{suffix}'")

@@ -1,0 +1,34 @@
+"""Interactive analysis tools.
+
+Reference parity: ``tmlib/tools/`` — the ``Tool`` registry
+(``classification``, ``clustering``, ``heatmap``), each consuming the
+per-object feature values of one mapobject type and producing a
+``ToolResult`` with a per-object label layer (``tmlib/models/result.py``
+``LabelLayer``/``ToolResult``), plus ``ToolRequestManager``
+(``manager.py``) which the server uses to submit tool jobs via GC3Pie.
+
+TPU rebuild: tools read the feature Parquet written by jterator, compute on
+device where it pays (JAX k-means, JAX softmax classifier) or via sklearn
+(SVM / random forest — CPU, matching the reference's sklearn backends), and
+persist results as Parquet + JSON under the experiment's ``tools/`` dir.
+The request manager is an in-process call — no job fan-out.
+"""
+
+from tmlibrary_tpu.tools.base import (
+    Tool,
+    ToolRequestManager,
+    ToolResult,
+    get_tool,
+    list_tools,
+    register_tool,
+)
+from tmlibrary_tpu.tools import classification, clustering, heatmap  # noqa: F401
+
+__all__ = [
+    "Tool",
+    "ToolResult",
+    "ToolRequestManager",
+    "register_tool",
+    "get_tool",
+    "list_tools",
+]

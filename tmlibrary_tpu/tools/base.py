@@ -1,0 +1,142 @@
+"""Tool base, registry and request manager.
+
+Reference parity: ``tmlib/tools/base.py`` (``Tool`` ABC + registry),
+``tmlib/tools/manager.py`` (``ToolRequestManager``), ``tmlib/tools/jobs.py``
+(``ToolJob`` — here an in-process call), ``tmlib/models/result.py``
+(``ToolResult``/``LabelLayer`` persisted per submission).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import json
+import time
+from typing import Any, Type
+
+import numpy as np
+import pandas as pd
+
+from tmlibrary_tpu.errors import RegistryError
+from tmlibrary_tpu.models.store import ExperimentStore
+
+_TOOLS: dict[str, Type["Tool"]] = {}
+
+
+def register_tool(name: str):
+    def deco(cls):
+        cls.name = name
+        _TOOLS[name] = cls
+        return cls
+
+    return deco
+
+
+def get_tool(name: str) -> Type["Tool"]:
+    try:
+        return _TOOLS[name]
+    except KeyError:
+        raise RegistryError(
+            f"no tool '{name}' registered (have: {sorted(_TOOLS)})"
+        ) from None
+
+
+def list_tools() -> list[str]:
+    return sorted(_TOOLS)
+
+
+@dataclasses.dataclass
+class ToolResult:
+    """Per-object result layer (reference ``ToolResult`` + ``LabelLayer``).
+
+    ``values`` carries one row per object: the object identity columns
+    (site_index, label) plus a ``value`` column (class id, cluster id, or
+    continuous heatmap value).
+    """
+
+    tool: str
+    objects_name: str
+    layer_type: str  # "categorical" | "continuous"
+    values: pd.DataFrame
+    attributes: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def save(self, directory) -> None:
+        from pathlib import Path
+
+        d = Path(directory)
+        d.mkdir(parents=True, exist_ok=True)
+        self.values.to_parquet(d / "values.parquet", index=False)
+        (d / "result.json").write_text(
+            json.dumps(
+                {
+                    "tool": self.tool,
+                    "objects_name": self.objects_name,
+                    "layer_type": self.layer_type,
+                    "attributes": self.attributes,
+                    "n_objects": int(len(self.values)),
+                },
+                default=str,
+            )
+        )
+
+
+class Tool(abc.ABC):
+    """One analysis tool (reference ``tmlib.tools.base.Tool``)."""
+
+    name: str = "tool"
+
+    def __init__(self, store: ExperimentStore):
+        self.store = store
+
+    def load_feature_matrix(
+        self, objects_name: str, features: list[str] | None = None
+    ) -> tuple[pd.DataFrame, np.ndarray, list[str]]:
+        """(identity frame, standardized (N, F) matrix, feature names)."""
+        table = self.store.read_features(objects_name)
+        id_cols = ["site_index", "label"]
+        feat_cols = features or [
+            c
+            for c in table.columns
+            if c not in id_cols
+            and c not in ("plate", "well_row", "well_col", "site_y", "site_x")
+            and np.issubdtype(table[c].dtype, np.number)
+        ]
+        missing = [c for c in feat_cols if c not in table.columns]
+        if missing:
+            raise RegistryError(
+                f"features not found for '{objects_name}': {missing} "
+                f"(have: {sorted(c for c in table.columns if c not in id_cols)})"
+            )
+        x = table[feat_cols].to_numpy(np.float32)
+        # standardize (reference tools z-score before sklearn)
+        mu = x.mean(axis=0, keepdims=True)
+        sd = x.std(axis=0, keepdims=True)
+        x = (x - mu) / np.where(sd > 1e-9, sd, 1.0)
+        return table[id_cols + ["plate", "well_row", "well_col"]].copy(), x, feat_cols
+
+    @abc.abstractmethod
+    def process(self, payload: dict[str, Any]) -> ToolResult:
+        """Handle one tool request (reference ``Tool.process_request``)."""
+
+
+class ToolRequestManager:
+    """Submit tool requests and persist results
+    (reference ``tmlib/tools/manager.py``, minus GC3Pie job fan-out)."""
+
+    def __init__(self, store: ExperimentStore):
+        self.store = store
+
+    def submit(self, tool_name: str, payload: dict[str, Any]) -> ToolResult:
+        tool = get_tool(tool_name)(self.store)
+        result = tool.process(payload)
+        request_id = f"{tool_name}_{int(time.time() * 1000):x}"
+        result.save(self.store.tools_dir / request_id)
+        return result
+
+    def list_results(self) -> list[dict]:
+        out = []
+        for d in sorted(self.store.tools_dir.iterdir()):
+            meta = d / "result.json"
+            if meta.exists():
+                out.append({"request": d.name, **json.loads(meta.read_text())})
+        return out

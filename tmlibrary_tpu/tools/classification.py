@@ -1,0 +1,114 @@
+"""Classification tool: supervised per-object classification.
+
+Reference parity: ``tmlib/tools/classification.py`` — trains an sklearn
+SVM or random forest on user-labeled example objects, predicts a class for
+every object of the type, and publishes a supervised ``LabelLayer``.
+
+TPU rebuild: the default method is a JAX multinomial logistic regression
+(one jitted Adam-free full-batch gradient loop — the feature matrices are
+small, the matmuls land on the MXU); ``svm`` and ``randomforest`` keep the
+reference's sklearn backends on the host.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmlibrary_tpu.errors import NotSupportedError
+from tmlibrary_tpu.tools.base import Tool, ToolResult, register_tool
+
+
+def softmax_train(
+    x: jax.Array,
+    y: jax.Array,
+    n_classes: int,
+    n_iter: int = 300,
+    lr: float = 0.1,
+    l2: float = 1e-4,
+) -> tuple[jax.Array, jax.Array]:
+    """Full-batch multinomial logistic regression; returns (W, b)."""
+    x = jnp.asarray(x, jnp.float32)
+    y = jnp.asarray(y, jnp.int32)
+    n, f = x.shape
+    w = jnp.zeros((f, n_classes), jnp.float32)
+    b = jnp.zeros((n_classes,), jnp.float32)
+
+    def loss_fn(params):
+        w, b = params
+        logits = x @ w + b
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.mean(logp[jnp.arange(n), y])
+        return nll + l2 * jnp.sum(w * w)
+
+    grad_fn = jax.grad(loss_fn)
+
+    def step(params, _):
+        g = grad_fn(params)
+        return (params[0] - lr * g[0], params[1] - lr * g[1]), None
+
+    (w, b), _ = jax.lax.scan(step, (w, b), None, length=n_iter)
+    return w, b
+
+
+@register_tool("classification")
+class Classification(Tool):
+    def process(self, payload: dict) -> ToolResult:
+        objects_name = payload["objects_name"]
+        method = payload.get("method", "logreg")
+        features = payload.get("features")
+        # training examples: [{"site_index": .., "label": .., "class": ..}]
+        examples = payload.get("training_examples") or []
+        if not examples:
+            raise NotSupportedError("classification needs training_examples")
+
+        ids, x, feat_cols = self.load_feature_matrix(objects_name, features)
+        key = ids.set_index(["site_index", "label"]).index
+        lookup = {t: i for i, t in enumerate(key)}
+        class_names = sorted({e["class"] for e in examples})
+        cls_index = {c: i for i, c in enumerate(class_names)}
+
+        rows, labels = [], []
+        for e in examples:
+            t = (e["site_index"], e["label"])
+            if t not in lookup:
+                raise NotSupportedError(f"training example {t} is not a known object")
+            rows.append(lookup[t])
+            labels.append(cls_index[e["class"]])
+        x_train = x[np.asarray(rows)]
+        y_train = np.asarray(labels, np.int32)
+
+        if method == "logreg":
+            w, b = jax.jit(softmax_train, static_argnums=(2,))(
+                jnp.asarray(x_train), jnp.asarray(y_train), len(class_names)
+            )
+            pred = np.asarray(jnp.argmax(jnp.asarray(x) @ w + b, axis=1))
+        elif method == "svm":
+            from sklearn.svm import SVC
+
+            model = SVC(kernel="rbf", gamma="scale")
+            model.fit(x_train, y_train)
+            pred = model.predict(x)
+        elif method == "randomforest":
+            from sklearn.ensemble import RandomForestClassifier
+
+            model = RandomForestClassifier(n_estimators=100, random_state=0)
+            model.fit(x_train, y_train)
+            pred = model.predict(x)
+        else:
+            raise NotSupportedError(f"unknown classification method '{method}'")
+
+        ids["value"] = np.asarray(pred).astype(np.int32)
+        return ToolResult(
+            tool=self.name,
+            objects_name=objects_name,
+            layer_type="categorical",
+            values=ids,
+            attributes={
+                "method": method,
+                "classes": class_names,
+                "features": feat_cols,
+                "n_training": len(examples),
+            },
+        )

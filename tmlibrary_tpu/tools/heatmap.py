@@ -1,0 +1,42 @@
+"""Heatmap tool: one feature as a continuous per-object layer.
+
+Reference parity: ``tmlib/tools/heatmap.py`` — selects a single feature of
+a mapobject type and publishes it as a continuous ``LabelLayer`` (the UI
+colors objects by value).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tmlibrary_tpu.errors import NotSupportedError
+from tmlibrary_tpu.tools.base import Tool, ToolResult, register_tool
+
+
+@register_tool("heatmap")
+class Heatmap(Tool):
+    def process(self, payload: dict) -> ToolResult:
+        objects_name = payload["objects_name"]
+        feature = payload.get("feature")
+        if not feature:
+            raise NotSupportedError("heatmap needs a 'feature'")
+        table = self.store.read_features(objects_name)
+        if feature not in table.columns:
+            raise NotSupportedError(
+                f"feature '{feature}' not found (have: "
+                f"{sorted(c for c in table.columns if c.startswith(('Intensity', 'Morphology', 'Texture', 'Zernike')))})"
+            )
+        ids = table[["site_index", "label", "plate", "well_row", "well_col"]].copy()
+        vals = table[feature].to_numpy(np.float64)
+        ids["value"] = vals
+        return ToolResult(
+            tool=self.name,
+            objects_name=objects_name,
+            layer_type="continuous",
+            values=ids,
+            attributes={
+                "feature": feature,
+                "min": float(np.nanmin(vals)) if len(vals) else 0.0,
+                "max": float(np.nanmax(vals)) if len(vals) else 0.0,
+            },
+        )

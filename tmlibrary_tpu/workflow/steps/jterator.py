@@ -98,8 +98,16 @@ class ImageAnalysisRunner(Step):
         raw = {}
         for ch in desc.channels:
             idx = exp.channel_index(ch.name)
-            stack = self.store.read_sites(padded_sites, cycle=cycle, channel=idx,
-                                          tpoint=tpoint, zplane=zplane)
+            if ch.zstack:
+                planes = [
+                    self.store.read_sites(padded_sites, cycle=cycle, channel=idx,
+                                          tpoint=tpoint, zplane=zp)
+                    for zp in range(exp.n_zplanes)
+                ]
+                stack = np.stack(planes, axis=1)  # (B, Z, H, W)
+            else:
+                stack = self.store.read_sites(padded_sites, cycle=cycle, channel=idx,
+                                              tpoint=tpoint, zplane=zplane)
             arr = jnp.asarray(stack)
             raw[ch.name] = jax.device_put(arr, sharding) if sharding else arr
         for obj in desc.objects_in:
@@ -110,7 +118,9 @@ class ImageAnalysisRunner(Step):
 
         stats = {}
         for ch in desc.channels:
-            if ch.correct:
+            # volumes skip correction (see build_preprocess_fn) — don't
+            # demand stats they will never use
+            if ch.correct and not ch.zstack:
                 idx = exp.channel_index(ch.name)
                 if not self.store.has_illumstats(cycle=cycle, channel=idx):
                     raise PipelineError(
@@ -140,7 +150,13 @@ class ImageAnalysisRunner(Step):
 
         # ------------------------------------------------------------ persist
         for name, labels in objects.items():
-            self.store.write_labels(labels, sites, name, tpoint=tpoint, zplane=zplane)
+            if labels.ndim == 4:  # (B, Z, H, W) volume labels: one stack per z
+                for zp in range(labels.shape[1]):
+                    self.store.write_labels(labels[:, zp], sites, name,
+                                            tpoint=tpoint, zplane=zp)
+            else:
+                self.store.write_labels(labels, sites, name,
+                                        tpoint=tpoint, zplane=zplane)
 
         shard = f"batch_{batch['index']:03d}"
         site_meta = self._site_metadata(sites)
@@ -150,7 +166,8 @@ class ImageAnalysisRunner(Step):
                 args["max_objects"],
             )
             self.store.append_features(name, table, shard=shard)
-            if args["as_polygons"]:
+            # polygon tracing is 2-D only; volume objects skip it
+            if args["as_polygons"] and objects[name].ndim == 3:
                 self._write_polygons(name, objects[name], sites, shard)
 
         return {

@@ -1,0 +1,92 @@
+"""Context-manager writers.
+
+Reference parity: ``tmlib/writers.py`` — ``ImageWriter`` (PNG via cv2),
+``DatasetWriter`` (HDF5), ``JsonWriter``, ``XmlWriter``, ``TablesWriter``.
+Same role as :mod:`tmlibrary_tpu.readers`: API parity for user scripts;
+the framework's own persistence goes through the store.
+"""
+
+from __future__ import annotations
+
+import json
+from abc import ABC
+from pathlib import Path
+from xml.etree import ElementTree
+
+import numpy as np
+
+from tmlibrary_tpu.errors import NotSupportedError
+
+
+class Writer(ABC):
+    def __init__(self, filename):
+        self.filename = Path(filename)
+        self.filename.parent.mkdir(parents=True, exist_ok=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class ImageWriter(Writer):
+    def write(self, image: np.ndarray) -> None:
+        import cv2
+
+        if not cv2.imwrite(str(self.filename), np.asarray(image)):
+            raise IOError(f"cannot write image: {self.filename}")
+
+
+class DatasetWriter(Writer):
+    """HDF5 dataset writer with the reference's write/append surface."""
+
+    def __enter__(self):
+        import h5py
+
+        self._f = h5py.File(self.filename, "a")
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+    def write(self, path: str, data, compression: str | None = "gzip") -> None:
+        arr = np.asarray(data)
+        if path in self._f:
+            del self._f[path]
+        kwargs = {"compression": compression} if arr.ndim > 0 else {}
+        self._f.create_dataset(path, data=arr, **kwargs)
+
+    def append(self, path: str, data) -> None:
+        """Append rows along axis 0 (creates a resizable dataset)."""
+        arr = np.atleast_1d(np.asarray(data))
+        if path not in self._f:
+            maxshape = (None,) + arr.shape[1:]
+            self._f.create_dataset(path, data=arr, maxshape=maxshape)
+            return
+        ds = self._f[path]
+        n = ds.shape[0]
+        ds.resize(n + arr.shape[0], axis=0)
+        ds[n:] = arr
+
+
+class JsonWriter(Writer):
+    def write(self, data) -> None:
+        self.filename.write_text(json.dumps(data, indent=2, default=str))
+
+
+class XmlWriter(Writer):
+    def write(self, element: ElementTree.Element) -> None:
+        self.filename.write_bytes(ElementTree.tostring(element))
+
+
+class TablesWriter(Writer):
+    def write(self, table) -> None:
+        suffix = self.filename.suffix.lower()
+        if suffix == ".parquet":
+            table.to_parquet(self.filename, index=False)
+        elif suffix == ".csv":
+            table.to_csv(self.filename, index=False)
+        else:
+            raise NotSupportedError(f"unsupported table format '{suffix}'")
