@@ -179,18 +179,29 @@ def fill_holes(mask: jax.Array, connectivity: int = 4) -> jax.Array:
     border = jnp.zeros_like(mask).at[0, :].set(True).at[-1, :].set(True)
     border = border.at[:, 0].set(True).at[:, -1].set(True)
     seed = bg & border
-    shifts = _neighbor_shifts(connectivity)
+    if connectivity not in (4, 8):
+        raise ValueError("connectivity must be 4 or 8")
 
     def cond(state):
         reach, changed = state
         return changed
 
+    # diagonal steps are only relevant at 8-connectivity; the run scans
+    # below fully cover horizontal/vertical propagation
+    diag = [] if connectivity == 4 else [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+
     def body(state):
         reach, _ = state
         grown = reach
-        for dy, dx in shifts:
+        for dy, dx in diag:
             grown = grown | _shift_with_fill(reach, dy, dx, False)
         grown = grown & bg
+        # flood entire background runs at once (reuse the min run-scan:
+        # 0 = reached, 1 = not; run min 0 means the whole run is reached)
+        for axis in (1, 0):
+            v = jnp.where(grown, 0, 1).astype(jnp.int32)
+            runmin = _run_min_scan(v, bg, axis)
+            grown = (runmin == 0) & bg
         return grown, jnp.any(grown != reach)
 
     reach, _ = lax.while_loop(cond, body, (seed, jnp.bool_(True)))

@@ -33,7 +33,11 @@ def otsu_value(img: jax.Array, bins: int = 256) -> jax.Array:
     hi = jnp.max(img_f)
     span = jnp.maximum(hi - lo, 1e-6)
     idx = jnp.clip(((img_f - lo) / span * bins).astype(jnp.int32), 0, bins - 1)
-    hist = jnp.zeros((bins,), jnp.float32).at[idx.reshape(-1)].add(1.0)
+    # fused broadcast-compare-reduce histogram: TPU scatter-adds serialize;
+    # XLA streams this reduction without materializing the (P, bins) compare
+    hist = jnp.sum(
+        (idx.reshape(-1)[:, None] == jnp.arange(bins)).astype(jnp.float32), axis=0
+    )
     centers = lo + (jnp.arange(bins, dtype=jnp.float32) + 0.5) / bins * span
 
     w0 = jnp.cumsum(hist)

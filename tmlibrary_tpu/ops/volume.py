@@ -157,27 +157,24 @@ def volume_features(
     labels: jax.Array, intensity: jax.Array, max_objects: int
 ) -> dict[str, jax.Array]:
     """Per-object 3-D measurements: volume, centroid, intensity stats."""
+    from tmlibrary_tpu.ops.measure import grouped_sums
+
     labels = jnp.asarray(labels, jnp.int32)
     img = jnp.asarray(intensity, jnp.float32)
     z, h, w = labels.shape
-    flat = labels.reshape(-1)
-
-    def seg(v):
-        return jax.ops.segment_sum(v.reshape(-1), flat, num_segments=max_objects + 1)[1:]
-
     ones = jnp.ones((z, h, w), jnp.float32)
-    vol = seg(ones)
-    safe = jnp.maximum(vol, 1.0)
     zz, yy, xx = jnp.meshgrid(
         jnp.arange(z, dtype=jnp.float32),
         jnp.arange(h, dtype=jnp.float32),
         jnp.arange(w, dtype=jnp.float32),
         indexing="ij",
     )
-    total = seg(img)
+    sums = grouped_sums(labels, [ones, zz, yy, xx, img, img * img], max_objects)
+    vol = sums[:, 0]
+    safe = jnp.maximum(vol, 1.0)
+    total = sums[:, 4]
     mean = total / safe
-    sq = seg(img * img)
-    var = jnp.maximum(sq / safe - mean * mean, 0.0)
+    var = jnp.maximum(sums[:, 5] / safe - mean * mean, 0.0)
     present = vol > 0
 
     def m(v):
@@ -185,9 +182,9 @@ def volume_features(
 
     return {
         "Volume_voxels": vol,
-        "Volume_centroid_z": m(seg(zz) / safe),
-        "Volume_centroid_y": m(seg(yy) / safe),
-        "Volume_centroid_x": m(seg(xx) / safe),
+        "Volume_centroid_z": m(sums[:, 1] / safe),
+        "Volume_centroid_y": m(sums[:, 2] / safe),
+        "Volume_centroid_x": m(sums[:, 3] / safe),
         "Volume_intensity_mean": m(mean),
         "Volume_intensity_sum": total,
         "Volume_intensity_std": m(jnp.sqrt(var)),
