@@ -1,0 +1,316 @@
+"""Vendor sidecar metadata handlers (CellVoyager .mlf/.mes, OME-XML).
+
+Reference parity: tmlib/workflow/metaconfig vendor handler set
+(SURVEY.md §2 metaconfig row).
+"""
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.workflow.steps.omexml import parse_ome_xml, write_ome_xml
+from tmlibrary_tpu.workflow.steps.vendors import (
+    parse_mes_channels,
+    parse_mlf,
+    positions_to_grid,
+)
+
+BTS = "http://www.yokogawa.co.jp/BTS/BTSSchema/1.0"
+
+MLF_TEMPLATE = """<?xml version="1.0" encoding="utf-8"?>
+<bts:MeasurementData xmlns:bts="{ns}">
+{records}
+</bts:MeasurementData>
+"""
+
+REC = (
+    '  <bts:MeasurementRecord bts:Type="IMG" bts:Row="{row}" bts:Column="{col}"'
+    ' bts:TimePoint="1" bts:FieldIndex="{field}" bts:ZIndex="1" bts:Ch="{ch}"'
+    ' bts:X="{x}" bts:Y="{y}">{name}</bts:MeasurementRecord>'
+)
+
+MES = """<?xml version="1.0" encoding="utf-8"?>
+<bts:MeasurementSetting xmlns:bts="{ns}">
+  <bts:ChannelList>
+    <bts:Channel bts:Ch="1" bts:Target="DAPI" />
+    <bts:Channel bts:Ch="2" bts:Target="GFP" />
+  </bts:ChannelList>
+</bts:MeasurementSetting>
+""".format(ns=BTS)
+
+
+def _write_cv_dataset(root):
+    """2 wells x 2x2 site grid x 2 channels with stage positions."""
+    import cv2
+
+    records = []
+    for row, col in [(2, 3), (2, 4)]:
+        for field in range(1, 5):
+            fy, fx = divmod(field - 1, 2)
+            for ch in (1, 2):
+                name = f"img_R{row}C{col}F{field}C{ch}.tif"
+                records.append(
+                    REC.format(
+                        row=row, col=col, field=field, ch=ch,
+                        x=1000.0 * col + 120.0 * fx + (0.01 if ch == 2 else 0.0),
+                        y=1000.0 * row + 120.0 * fy,
+                        name=name,
+                    )
+                )
+                img = np.full((32, 32), 100 * ch, np.uint16)
+                cv2.imwrite(str(root / name), img)
+    (root / "MeasurementData.mlf").write_text(
+        MLF_TEMPLATE.format(ns=BTS, records="\n".join(records))
+    )
+    (root / "MeasurementSetting.mes").write_text(MES)
+
+
+def test_parse_mlf(tmp_path):
+    _write_cv_dataset(tmp_path)
+    entries = parse_mlf(tmp_path / "MeasurementData.mlf")
+    assert len(entries) == 2 * 4 * 2
+    e = entries[0]
+    assert e["well_row"] == 1 and e["well_col"] == 2  # 1-based -> 0-based
+    assert e["site"] == 0 and e["zplane"] == 0 and e["tpoint"] == 0
+    assert e["filename"].endswith(".tif")
+    assert e["stage_x"] is not None
+
+
+def test_parse_mes_channels(tmp_path):
+    (tmp_path / "s.mes").write_text(MES)
+    names = parse_mes_channels(tmp_path / "s.mes")
+    assert names == {1: "DAPI", 2: "GFP"}
+
+
+def test_positions_to_grid_collapses_jitter():
+    idx = positions_to_grid([0.0, 0.005, 120.0, 240.0, 239.999])
+    assert idx[0.0] == idx[0.005] == 0
+    assert idx[120.0] == 1
+    assert idx[240.0] == idx[239.999] == 2
+
+
+def test_positions_to_grid_exact_grid_no_jitter():
+    idx = positions_to_grid([0.0, 120.0, 240.0])
+    assert [idx[p] for p in (0.0, 120.0, 240.0)] == [0, 1, 2]
+
+
+def test_strip_with_jitter_falls_back_to_field_index(tmp_path):
+    """1xN strip: Y carries only jitter — grid must be rejected, not
+    split into phantom rows (the dense-rectangle cross-check)."""
+    import cv2
+
+    records = []
+    for field in (1, 2):
+        name = f"strip_F{field}.tif"
+        cv2.imwrite(str(tmp_path / name), np.full((8, 8), 9, np.uint16))
+        records.append(
+            REC.format(row=1, col=1, field=field, ch=1,
+                       x=200.0 * (field - 1),
+                       y=3000.0 + 0.004 * field,  # jitter only
+                       name=name)
+        )
+    (tmp_path / "MeasurementData.mlf").write_text(
+        MLF_TEMPLATE.format(ns=BTS, records="\n".join(records))
+    )
+    from tmlibrary_tpu.workflow.steps.vendors import cellvoyager_sidecar
+
+    entries, skipped = cellvoyager_sidecar(tmp_path)
+    assert skipped == 0
+    assert len(entries) == 2
+    # grid rejected -> no site_y/site_x, field index is the address
+    assert all("site_y" not in e for e in entries)
+    assert [e["site"] for e in entries] == [0, 1]
+
+
+def _empty_store(root, name):
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    placeholder = Experiment(
+        name=name, plates=[], channels=[], site_height=1, site_width=1
+    )
+    return ExperimentStore.create(root, placeholder)
+
+
+def test_metaconfig_cellvoyager_sidecar(tmp_path):
+    """End-to-end: .mlf-driven metaconfig builds the right layout."""
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    _write_cv_dataset(src)
+    root = tmp_path / "exp"
+    store = _empty_store(root, "cvtest")
+
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "cellvoyager"})
+    result = step.run(0)
+    assert result["n_files"] == 16
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_channels == 2
+    assert {c.name for c in exp.channels} == {"DAPI", "GFP"}
+    assert exp.n_sites == 2 * 4  # 2 wells x 4 sites
+    # stage positions produced a 2x2 grid
+    sites = exp.plates[0].wells[0].sites
+    assert {(s.y, s.x) for s in sites} == {(0, 0), (0, 1), (1, 0), (1, 1)}
+    # OME-XML parity artifact exists and round-trips
+    ome = (root / "workflow" / "metaconfig" / "experiment.ome.xml").read_text()
+    images = parse_ome_xml(ome)
+    assert len(images) == 8
+    assert images[0].size_c == 2
+
+
+def test_metaconfig_auto_falls_back_to_filenames(tmp_path):
+    """auto handler: no sidecar files -> default filename pattern."""
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    for well in ("A01", "A02"):
+        for site in (0, 1):
+            for ch in ("DAPI", "GFP"):
+                cv2.imwrite(
+                    str(src / f"{well}_s{site}_{ch}.tif"),
+                    np.full((16, 16), 7, np.uint16),
+                )
+    root = tmp_path / "exp"
+    store = _empty_store(root, "autotest")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "auto"})
+    result = step.run(0)
+    assert result["n_files"] == 8
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 4
+
+
+OME_COMPANION = """<?xml version="1.0"?>
+<OME xmlns="http://www.openmicroscopy.org/Schemas/OME/2016-06">
+  <Image ID="Image:0" Name="{name}">
+    <Pixels ID="Pixels:0" DimensionOrder="XYCZT" Type="uint16"
+            SizeX="8" SizeY="8" SizeZ="1" SizeC="2" SizeT="1">
+      <Channel ID="Channel:0:0" Name="DAPI"/>
+      <Channel ID="Channel:0:1" Name="GFP"/>
+    </Pixels>
+  </Image>
+</OME>
+"""
+
+
+def test_metaconfig_omexml_multipage(tmp_path):
+    """Multi-plane OME image -> per-plane page reads, not duplicated page 0."""
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    cv2.imwritemulti(
+        str(src / "A01_s0.tif"),
+        [np.full((8, 8), v, np.uint16) for v in (111, 222)],
+    )
+    (src / "A01_s0.ome.xml").write_text(OME_COMPANION.format(name="A01_s0"))
+
+    root = tmp_path / "exp"
+    store = _empty_store(root, "ometest")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "omexml"})
+    result = step.run(0)
+    assert result["n_files"] == 2  # one entry per channel plane
+    exp = ExperimentStore.open(root).experiment
+    assert {c.name for c in exp.channels} == {"DAPI", "GFP"}
+
+    ext = get_step("imextract")(ExperimentStore.open(root))
+    ext.init({})
+    ext.run(0)
+    store = ExperimentStore.open(root)
+    ch = {c.name: c.index for c in store.experiment.channels}
+    assert store.read_sites([0], channel=ch["DAPI"])[0][0, 0] == 111
+    assert store.read_sites([0], channel=ch["GFP"])[0][0, 0] == 222
+
+
+def test_metaconfig_auto_survives_broken_sidecar(tmp_path):
+    """auto: a stale .mlf with no usable records must not end ingest."""
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    (src / "MeasurementData.mlf").write_text(
+        f'<?xml version="1.0"?><bts:MeasurementData xmlns:bts="{BTS}">'
+        "</bts:MeasurementData>"
+    )
+    cv2.imwrite(str(src / "A01_s0_DAPI.tif"), np.full((8, 8), 5, np.uint16))
+    root = tmp_path / "exp"
+    store = _empty_store(root, "stale")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "auto"})
+    result = step.run(0)
+    assert result["n_files"] == 1  # fell through to the filename pattern
+
+
+def test_metaconfig_pattern_overrides_sidecar(tmp_path):
+    """An explicit --pattern wins over present sidecar files."""
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    _write_cv_dataset(src)  # .mlf names 16 files
+    cv2.imwrite(str(src / "A01_s0_DAPI.tif"), np.full((8, 8), 3, np.uint16))
+    root = tmp_path / "exp"
+    store = _empty_store(root, "pat")
+    step = get_step("metaconfig")(store)
+    step.init({
+        "source_dir": str(src),
+        "handler": "auto",
+        "pattern": (
+            r"(?P<well>[A-Z]\d{2})_s(?P<site>\d+)_"
+            r"(?P<channel>[A-Za-z0-9]+)\.tif$"
+        ),
+    })
+    result = step.run(0)
+    # the .mlf would have yielded 16 files; the pattern selected exactly 1
+    assert result["n_files"] == 1
+    exp = ExperimentStore.open(root).experiment
+    assert [c.name for c in exp.channels] == ["DAPI"]
+
+
+def test_ome_xml_writer_roundtrip(tmp_path):
+    from tmlibrary_tpu.models.experiment import (
+        Channel,
+        Experiment,
+        Plate,
+        Site,
+        Well,
+    )
+
+    exp = Experiment(
+        name="t",
+        plates=[
+            Plate(
+                name="p0",
+                wells=(
+                    Well(row=0, column=0, sites=(Site(y=0, x=0), Site(y=0, x=1))),
+                ),
+            )
+        ],
+        channels=[Channel(index=0, name="DAPI")],
+        site_height=64,
+        site_width=48,
+        n_cycles=1,
+        n_tpoints=2,
+        n_zplanes=3,
+    )
+    images = parse_ome_xml(write_ome_xml(exp))
+    assert len(images) == 2
+    assert images[0].size_x == 48 and images[0].size_y == 64
+    assert images[0].size_z == 3 and images[0].size_t == 2
+    assert images[0].channel_names == ["DAPI"]

@@ -81,16 +81,37 @@ def grouped_minmax(
     labels: jax.Array, values: jax.Array, max_objects: int
 ) -> tuple[jax.Array, jax.Array]:
     """Per-object (min, max) of ``values`` via a fused masked reduce
-    (streams the (P, K) broadcast through one reduction — ~2.4x faster
-    than two segment_min/max scatters on TPU).  Rows for absent labels
-    come back as (+inf, -inf)."""
+    (streams the (chunk, K) broadcast through one reduction — ~2.4x faster
+    than two segment_min/max scatters on TPU).  The pixel axis is chunked
+    like :func:`grouped_sums` so the broadcast operand stays bounded on
+    large sites / 3-D volumes under the site-batch vmap.  Rows for absent
+    labels come back as (+inf, -inf)."""
     flat_l = labels.reshape(-1)
     flat_v = jnp.asarray(values, jnp.float32).reshape(-1)
+    p = flat_l.shape[0]
+    pad = (-p) % _SUM_CHUNK
+    if pad:
+        # padded pixels carry label 0 → they match no id in 1..max_objects
+        flat_l = jnp.concatenate([flat_l, jnp.zeros((pad,), flat_l.dtype)])
+        flat_v = jnp.concatenate([flat_v, jnp.zeros((pad,), flat_v.dtype)])
+    n_chunks = flat_l.shape[0] // _SUM_CHUNK
+    flat_l = flat_l.reshape(n_chunks, _SUM_CHUNK)
+    flat_v = flat_v.reshape(n_chunks, _SUM_CHUNK)
     ids = jnp.arange(1, max_objects + 1, dtype=flat_l.dtype)
-    sel = flat_l[:, None] == ids
-    mx = jnp.max(jnp.where(sel, flat_v[:, None], -jnp.inf), axis=0)
-    mn = jnp.min(jnp.where(sel, flat_v[:, None], jnp.inf), axis=0)
-    return mn, mx
+
+    def body(i, carry):
+        mn, mx = carry
+        sel = flat_l[i][:, None] == ids
+        v = flat_v[i][:, None]
+        mx = jnp.maximum(mx, jnp.max(jnp.where(sel, v, -jnp.inf), axis=0))
+        mn = jnp.minimum(mn, jnp.min(jnp.where(sel, v, jnp.inf), axis=0))
+        return mn, mx
+
+    init = (
+        jnp.full((max_objects,), jnp.inf, jnp.float32),
+        jnp.full((max_objects,), -jnp.inf, jnp.float32),
+    )
+    return jax.lax.fori_loop(0, n_chunks, body, init)
 
 
 # ------------------------------------------------------------------ intensity

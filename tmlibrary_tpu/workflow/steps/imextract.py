@@ -48,7 +48,22 @@ class ImageExtractor(Step):
             pixels = []
             indices = []
             for f in files:
-                img = cv2.imread(f["path"], cv2.IMREAD_UNCHANGED)
+                page = f.get("page")
+                if page is not None:
+                    # multi-page OME-TIFF: decode only the declared page
+                    # (caching whole files across a batch risks host OOM
+                    # on large z/t stacks)
+                    ok, pages = cv2.imreadmulti(
+                        f["path"], start=page, count=1,
+                        flags=cv2.IMREAD_UNCHANGED,
+                    )
+                    if not ok or not pages:
+                        raise MetadataError(
+                            f"cannot read page {page} of {f['path']}"
+                        )
+                    img = pages[0]
+                else:
+                    img = cv2.imread(f["path"], cv2.IMREAD_UNCHANGED)
                 if img is None:
                     raise MetadataError(f"cannot read image {f['path']}")
                 if img.ndim == 3:

@@ -116,8 +116,9 @@ class MetadataConfigurator(Step):
         Argument("source_dir", str, required=True,
                  help="directory of microscope image files"),
         Argument("handler", str, default="default",
-                 choices=("default", "cellvoyager"),
-                 help="vendor filename handler"),
+                 choices=("default", "cellvoyager", "omexml", "auto"),
+                 help="vendor metadata handler (sidecar files preferred, "
+                      "filename patterns as fallback)"),
         Argument("pattern", str, default=None,
                  help="override the handler's filename regex"),
         Argument("sites_per_well_x", int, default=None,
@@ -137,26 +138,66 @@ class MetadataConfigurator(Step):
         src = Path(args["source_dir"])
         if not src.is_dir():
             raise MetadataError(f"source directory not found: {src}")
-        pattern = args["pattern"] or (
-            CELLVOYAGER_PATTERN if args["handler"] == "cellvoyager" else DEFAULT_PATTERN
-        )
-        handler = FilenameHandler(pattern, args["handler"], args["plate_cols"])
 
-        entries = []
+        # sidecar metadata (CellVoyager .mlf/.mes, companion OME-XML) wins
+        # over filename parsing when present — reference metaconfig likewise
+        # prefers vendor metadata files over filename heuristics.  An
+        # explicit --pattern overrides everything: the user is naming the
+        # files to ingest, so sidecars must not widen the selection.
+        from tmlibrary_tpu.workflow.steps.vendors import SIDECAR_HANDLERS
+
+        entries: list[dict] | None = None
         skipped = 0
-        for path in sorted(src.rglob("*")):
-            if not path.is_file():
-                continue
-            parsed = handler.parse(path.name)
-            if parsed is None:
-                skipped += 1
-                continue
-            parsed["path"] = str(path)
-            entries.append(parsed)
+        use_sidecars = not args.get("pattern") and (
+            args["handler"] in SIDECAR_HANDLERS or args["handler"] == "auto"
+        )
+        if use_sidecars:
+            is_auto = args["handler"] == "auto"
+            names = list(SIDECAR_HANDLERS) if is_auto else [args["handler"]]
+            for name in names:
+                try:
+                    result = SIDECAR_HANDLERS[name](src)
+                except MetadataError:
+                    if not is_auto:
+                        raise
+                    continue  # auto: a broken sidecar should not end ingest
+                if result is None:
+                    continue  # this vendor's sidecar files are absent
+                found, skipped = result
+                if found:
+                    entries = found
+                    break
+                if not is_auto:
+                    raise MetadataError(
+                        f"'{name}' sidecar files exist under {src} but no "
+                        "image could be resolved from them (unrecognised "
+                        "image names or missing pixel files)"
+                    )
+        if entries is None and use_sidecars and args["handler"] == "omexml":
+            raise MetadataError(f"no companion OME-XML files found under {src}")
+
+        if entries is None:  # filename-pattern fallback
+            skipped = 0  # drop any count carried over from a failed sidecar
+            style = "cellvoyager" if args["handler"] == "cellvoyager" else "default"
+            pattern = args["pattern"] or (
+                CELLVOYAGER_PATTERN if style == "cellvoyager" else DEFAULT_PATTERN
+            )
+            handler = FilenameHandler(pattern, style, args["plate_cols"])
+            entries = []
+            for path in sorted(src.rglob("*")):
+                if not path.is_file():
+                    continue
+                parsed = handler.parse(path.name)
+                if parsed is None:
+                    skipped += 1
+                    continue
+                parsed["path"] = str(path)
+                entries.append(parsed)
         if not entries:
             raise MetadataError(
                 f"no files in {src} matched the '{args['handler']}' pattern"
             )
+        self._linearise_sites(entries, args)
 
         manifest = self._build_manifest(entries, args)
         store = ExperimentStore.create(self.store.root, manifest)
@@ -166,12 +207,60 @@ class MetadataConfigurator(Step):
 
         mapping = self._build_mapping(entries, manifest)
         (self.step_dir / self.MAPPING_FILE).write_text(json.dumps(mapping))
+        # parity artifact: merged metadata as OME-XML (reference metaconfig
+        # normalises everything into OME-XML before layout derivation)
+        from tmlibrary_tpu.workflow.steps.omexml import write_ome_xml
+
+        (self.step_dir / "experiment.ome.xml").write_text(write_ome_xml(manifest))
         return {
             "n_files": len(entries),
             "n_skipped": skipped,
             "n_sites": manifest.n_sites,
             "n_channels": manifest.n_channels,
         }
+
+    @staticmethod
+    def _linearise_sites(entries: list[dict], args) -> None:
+        """Collapse explicit (site_y, site_x) grid coords to linear indices.
+
+        Sidecar handlers emit stage-position-derived grid coordinates;
+        filename handlers emit linear indices.  Everything downstream works
+        on the linear index + a well grid width.
+        """
+        if not any("site_y" in e for e in entries):
+            if any(e.get("site") is None for e in entries):
+                raise MetadataError(
+                    "sidecar metadata provided neither site indices nor "
+                    "grid coordinates for some images"
+                )
+            return
+        if not all("site_y" in e for e in entries):
+            # mixed basis (some records lacked stage positions): grid-derived
+            # and field-index site numbers would collide, so fall back to the
+            # always-present field index for every entry — unless an entry
+            # has no field index at all (grid was its only address).
+            if any(e.get("site") is None for e in entries):
+                raise MetadataError(
+                    "inconsistent site addressing in sidecar metadata: some "
+                    "images carry only grid coordinates, others only site "
+                    "indices — cannot merge them into one layout"
+                )
+            for e in entries:
+                e.pop("site_y", None)
+                e.pop("site_x", None)
+            return
+        derived = max(e["site_x"] for e in entries) + 1
+        explicit = args.get("sites_per_well_x")
+        if explicit and explicit < derived:
+            raise MetadataError(
+                f"sites_per_well_x={explicit} is narrower than the "
+                f"stage-position-derived well grid ({derived} columns)"
+            )
+        spw_x = explicit or derived
+        for e in entries:
+            e["site"] = e["site_y"] * spw_x + e["site_x"]
+        if not explicit:
+            args["sites_per_well_x"] = spw_x
 
     # ------------------------------------------------------------------ build
     def _build_manifest(self, entries: list[dict], args) -> Experiment:
@@ -236,16 +325,17 @@ class MetadataConfigurator(Step):
                 site_y=e["site"] // spw_x,
                 site_x=e["site"] % spw_x,
             )
-            mapping.append(
-                {
-                    "path": e["path"],
-                    "site_index": self.store.site_linear_index(ref),
-                    "cycle": e["cycle"],
-                    "channel": channel_index[e["channel"]],
-                    "tpoint": e["tpoint"],
-                    "zplane": e["zplane"],
-                }
-            )
+            rec = {
+                "path": e["path"],
+                "site_index": self.store.site_linear_index(ref),
+                "cycle": e["cycle"],
+                "channel": channel_index[e["channel"]],
+                "tpoint": e["tpoint"],
+                "zplane": e["zplane"],
+            }
+            if "page" in e:  # multi-page OME-TIFF plane
+                rec["page"] = e["page"]
+            mapping.append(rec)
         return mapping
 
     def load_mapping(self) -> list[dict]:
