@@ -1,0 +1,114 @@
+"""Typed handle classes: YAML round-trip + per-type array validation.
+
+Reference parity: tmlib/workflow/jterator/handles.py typed handle set.
+"""
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import HandleError
+from tmlibrary_tpu.jterator.handles import (
+    HandleCollection,
+    InputHandle,
+    OutputHandle,
+)
+
+HANDLES_DICT = {
+    "module": "segment_primary",
+    "version": "0.1.0",
+    "input": [
+        {"name": "intensity_image", "type": "IntensityImage", "key": "DAPI"},
+        {"name": "threshold_method", "type": "Character", "value": "otsu"},
+        {"name": "min_area", "type": "Numeric", "value": 10},
+    ],
+    "output": [
+        {
+            "name": "objects",
+            "type": "SegmentedObjects",
+            "key": "nuclei",
+            "objects": "nuclei",
+        },
+        {"name": "figure", "type": "Figure"},
+    ],
+}
+
+
+def test_roundtrip_dict():
+    hc = HandleCollection.from_dict(HANDLES_DICT)
+    d = hc.to_dict()
+    hc2 = HandleCollection.from_dict(d)
+    assert hc2 == hc
+
+
+def test_roundtrip_yaml_file(tmp_path):
+    hc = HandleCollection.from_dict(HANDLES_DICT)
+    path = tmp_path / "segment.handles.yaml"
+    hc.save(path)
+    assert HandleCollection.load(path) == hc
+
+
+def test_intensity_rejects_signed_int():
+    h = InputHandle(name="intensity_image", type="IntensityImage", key="x")
+    h.validate_array(np.zeros((4, 4), np.uint16))  # ok
+    h.validate_array(np.zeros((4, 4), np.float32))  # ok
+    with pytest.raises(HandleError):
+        h.validate_array(np.zeros((4, 4), np.int32))
+
+
+def test_label_rejects_float():
+    h = InputHandle(name="objects_image", type="LabelImage", key="x")
+    h.validate_array(np.zeros((4, 4), np.int32))  # ok
+    with pytest.raises(HandleError):
+        h.validate_array(np.zeros((4, 4), np.float32))
+
+
+def test_binary_accepts_bool_and_int():
+    h = InputHandle(name="mask", type="BinaryImage", key="x")
+    h.validate_array(np.zeros((4, 4), bool))
+    h.validate_array(np.zeros((4, 4), np.int32))
+    with pytest.raises(HandleError):
+        h.validate_array(np.zeros((4, 4), np.float64))
+
+
+def test_pipeline_rejects_wrong_dtype_at_trace_time():
+    """A LabelImage input fed a float image fails at compile, not runtime."""
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.jterator.description import PipelineDescription
+
+    pipe = {
+        "description": "bad dtypes",
+        "input": {"channels": [{"name": "DAPI"}]},
+        "pipeline": [
+            {
+                "handles": {
+                    "module": "measure_morphology",
+                    "input": [
+                        {
+                            "name": "objects_image",
+                            "type": "LabelImage",
+                            "key": "DAPI",  # float image bound as labels
+                        }
+                    ],
+                    "output": [
+                        {
+                            "name": "measurements",
+                            "type": "Measurement",
+                            "objects": "nuclei",
+                        }
+                    ],
+                }
+            }
+        ],
+    }
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+    desc = PipelineDescription.from_dict(pipe)
+    fn = ImageAnalysisPipeline(desc, max_objects=8).build_site_fn()
+    with pytest.raises(HandleError):
+        fn({"DAPI": jnp.zeros((8, 8), jnp.float32)})
+
+
+def test_output_handle_requires_objects_for_measurement():
+    with pytest.raises(HandleError):
+        OutputHandle(name="m", type="Measurement")

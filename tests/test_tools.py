@@ -101,3 +101,46 @@ def test_heatmap_unknown_feature(store_with_features):
     mgr = ToolRequestManager(store_with_features)
     with pytest.raises(NotSupportedError, match="not found"):
         mgr.submit("heatmap", {"objects_name": "nuclei", "feature": "Bogus"})
+
+
+def test_tool_cli(store_with_features, capsys):
+    """tmx tool submit/list/available (reference tm_tool CLI)."""
+    import json
+
+    from tmlibrary_tpu.cli import main
+
+    root = str(store_with_features.root)
+    assert main(["tool", "available"]) == 0
+    out = capsys.readouterr().out
+    assert "clustering" in out and "classification" in out
+
+    assert main([
+        "tool", "submit", "--root", root, "--name", "clustering",
+        "--payload", '{"objects_name": "nuclei", "k": 2}',
+    ]) == 0
+    submitted = json.loads(capsys.readouterr().out)
+    assert submitted["tool"] == "clustering"
+    assert submitted["n_objects"] == 80
+
+    assert main(["tool", "list", "--root", root]) == 0
+    listed = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert len(listed) == 1 and listed[0]["tool"] == "clustering"
+
+
+def test_device_trace_writes_profile(tmp_path):
+    """device_trace produces a TensorBoard-compatible trace directory."""
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.profiling import device_trace
+
+    with device_trace(tmp_path / "prof"):
+        (jnp.arange(64.0) ** 2).sum().block_until_ready()
+    files = list((tmp_path / "prof").rglob("*"))
+    assert any(f.is_file() for f in files)
+
+
+def test_device_trace_none_is_noop():
+    from tmlibrary_tpu.profiling import device_trace
+
+    with device_trace(None):
+        pass

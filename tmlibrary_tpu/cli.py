@@ -62,8 +62,24 @@ def build_parser() -> argparse.ArgumentParser:
     p_submit.add_argument("--description", help="workflow YAML (default: canonical)")
     p_submit.add_argument("--resume", action="store_true",
                           help="skip work completed in a previous run")
+    p_submit.add_argument("--profile", metavar="DIR", default=None,
+                          help="write a jax.profiler device trace to DIR")
     p_status = wf_sub.add_parser("status", help="per-step progress")
     _add_common(p_status)
+
+    p_tool = sub.add_parser("tool", help="analysis tools over the feature store")
+    tool_sub = p_tool.add_subparsers(dest="verb", required=True)
+    p_tsubmit = tool_sub.add_parser("submit", help="run one tool request")
+    _add_common(p_tsubmit)
+    p_tsubmit.add_argument("--name", required=True,
+                           help="tool name (see 'tool available')")
+    p_tsubmit.add_argument("--payload", default="{}",
+                           help="request payload as inline JSON")
+    p_tsubmit.add_argument("--payload-file", default=None,
+                           help="request payload from a JSON file")
+    p_tlist = tool_sub.add_parser("list", help="persisted tool results")
+    _add_common(p_tlist)
+    tool_sub.add_parser("available", help="registered tool names")
 
     for name in list_steps():
         step_cls = get_step(name)
@@ -128,8 +144,43 @@ def cmd_workflow(args) -> int:
             print("error: no workflow description (pass --description or put "
                   "workflow.yaml in the store's workflow dir)", file=sys.stderr)
             return 1
-    summary = Workflow(store, desc).run(resume=args.resume)
+    from tmlibrary_tpu.profiling import device_trace
+
+    with device_trace(args.profile):
+        summary = Workflow(store, desc).run(resume=args.resume)
     print(json.dumps(summary, default=str, indent=2))
+    return 0
+
+
+def cmd_tool(args) -> int:
+    from tmlibrary_tpu.tools import base as tools_base
+
+    if args.verb == "available":
+        for name in tools_base.list_tools():
+            print(name)
+        return 0
+    store = _open_store(args)
+    manager = tools_base.ToolRequestManager(store)
+    if args.verb == "submit":
+        if args.payload_file:
+            payload = json.loads(Path(args.payload_file).read_text())
+        else:
+            payload = json.loads(args.payload)
+        result = manager.submit(args.name, payload)
+        print(json.dumps(
+            {
+                "tool": result.tool,
+                "objects_name": result.objects_name,
+                "layer_type": result.layer_type,
+                "n_objects": int(len(result.values)),
+                "attributes": result.attributes,
+            },
+            default=str,
+        ))
+        return 0
+    # list
+    for entry in manager.list_results():
+        print(json.dumps(entry, default=str))
     return 0
 
 
@@ -179,6 +230,8 @@ def main(argv=None) -> int:
             return cmd_create(args)
         if args.command == "workflow":
             return cmd_workflow(args)
+        if args.command == "tool":
+            return cmd_tool(args)
         if args.command == "log":
             return cmd_log(args)
         return cmd_step(args)

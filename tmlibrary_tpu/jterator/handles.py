@@ -38,6 +38,46 @@ VALID_INPUT_TYPES = IMAGE_TYPES | OBJECT_TYPES | CONSTANT_TYPES | IGNORED_TYPES
 VALID_OUTPUT_TYPES = IMAGE_TYPES | OBJECT_TYPES | MEASUREMENT_TYPES | IGNORED_TYPES
 
 
+def _check_intensity(name: str, arr) -> None:
+    import numpy as np
+
+    if not (
+        np.issubdtype(arr.dtype, np.unsignedinteger)
+        or np.issubdtype(arr.dtype, np.floating)
+    ):
+        raise HandleError(
+            f"IntensityImage '{name}' expects unsigned-int or float pixels, "
+            f"got {arr.dtype}"
+        )
+
+
+def _check_label(name: str, arr) -> None:
+    import numpy as np
+
+    if not np.issubdtype(arr.dtype, np.integer):
+        raise HandleError(
+            f"LabelImage '{name}' expects integer labels, got {arr.dtype}"
+        )
+
+
+def _check_binary(name: str, arr) -> None:
+    import numpy as np
+
+    if not (arr.dtype == bool or np.issubdtype(arr.dtype, np.integer)):
+        raise HandleError(
+            f"BinaryImage '{name}' expects bool/integer mask, got {arr.dtype}"
+        )
+
+
+#: per-type array validators (reference: per-class setter checks)
+_ARRAY_CHECKS = {
+    "IntensityImage": _check_intensity,
+    "LabelImage": _check_label,
+    "BinaryImage": _check_binary,
+    "SegmentedObjects": _check_label,
+}
+
+
 @dataclasses.dataclass(frozen=True)
 class InputHandle:
     """Binds one module kwarg to a store entry or constant."""
@@ -64,6 +104,25 @@ class InputHandle:
     @property
     def is_array(self) -> bool:
         return self.type in IMAGE_TYPES | OBJECT_TYPES
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"name": self.name, "type": self.type}
+        if self.key is not None:
+            d["key"] = self.key
+        if self.value is not None:
+            d["value"] = self.value
+        return d
+
+    def validate_array(self, arr) -> None:
+        """Eager (host-side) dtype/rank check before tracing.
+
+        Mirrors the reference's per-type handle classes, which refuse
+        wrong-kind pixel arrays at bind time (``tmlib/workflow/jterator/
+        handles.py`` setters) instead of failing deep inside a module.
+        """
+        check = _ARRAY_CHECKS.get(self.type)
+        if check is not None:
+            check(self.name, arr)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,6 +154,14 @@ class OutputHandle:
             )
         if self.type in MEASUREMENT_TYPES and not self.objects:
             raise HandleError(f"measurement output '{self.name}' needs objects")
+
+    def to_dict(self) -> dict:
+        d: dict[str, Any] = {"name": self.name, "type": self.type}
+        for field in ("key", "objects", "channel"):
+            v = getattr(self, field)
+            if v is not None:
+                d[field] = v
+        return d
 
 
 @dataclasses.dataclass
@@ -137,6 +204,35 @@ class HandleCollection:
             input=inputs,
             output=outputs,
         )
+
+    def to_dict(self) -> dict:
+        """YAML-serialisable form; inverse of :meth:`from_dict`.
+
+        Round-tripping matters for compat with the reference's per-module
+        ``handles/*.handles.yaml`` project files, which tooling edits and
+        rewrites (``tmlib/workflow/jterator/project.py``).
+        """
+        d: dict[str, Any] = {"module": self.module}
+        if self.version is not None:
+            d["version"] = self.version
+        if self.backend != "tpu":
+            d["backend"] = self.backend
+        d["input"] = [h.to_dict() for h in self.input]
+        d["output"] = [h.to_dict() for h in self.output]
+        return d
+
+    @classmethod
+    def load(cls, path) -> "HandleCollection":
+        import yaml
+
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    def save(self, path) -> None:
+        import yaml
+
+        with open(path, "w") as f:
+            yaml.safe_dump(self.to_dict(), f, sort_keys=False)
 
     def constants(self) -> dict[str, Any]:
         return {h.name: h.value for h in self.input if h.is_constant}

@@ -85,6 +85,11 @@ class ImageAnalysisPipeline:
                         raise PipelineError(
                             f"module '{mod.module}' input key '{key}' missing"
                         )
+                for h in mod.input:
+                    # dtype is static under tracing, so per-type handle
+                    # checks run at compile time at zero runtime cost
+                    if h.is_array and h.name in kwargs:
+                        h.validate_array(kwargs[h.name])
                 if "max_objects" not in kwargs and module_registry.module_accepts(
                     mod.module, mod.backend, "max_objects"
                 ):

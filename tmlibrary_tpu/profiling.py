@@ -1,0 +1,35 @@
+"""Profiling and tracing.
+
+Reference parity: the reference has no built-in profiler (SURVEY.md §6 —
+GC3Pie records per-job wall/cpu time in task state; per-job timing lands in
+the submission tables).  The TPU rebuild does better: the run ledger already
+records per-step/per-batch wall time (``workflow/engine.py``), and this
+module adds device-level tracing via ``jax.profiler`` so kernel time on the
+TPU can be inspected with TensorBoard/XProf.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str | Path | None):
+    """Wrap a block in a ``jax.profiler`` trace when ``log_dir`` is set.
+
+    No-op when ``log_dir`` is None so call sites can pass the CLI flag
+    straight through.  The trace directory is TensorBoard-compatible
+    (``tensorboard --logdir <dir>`` → Profile tab / xprof).
+    """
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    path = Path(log_dir)
+    path.mkdir(parents=True, exist_ok=True)
+    with jax.profiler.trace(str(path)):
+        yield
+
+

@@ -28,7 +28,12 @@ import xml.etree.ElementTree as ET
 from pathlib import Path
 from typing import Callable
 
+import logging
+
 from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.workflow.steps.omexml import _strip_ns
+
+logger = logging.getLogger(__name__)
 
 #: registry: handler name -> callable(source_dir) ->
 #:   (entries, n_skipped) when sidecar files were found (entries may be
@@ -47,8 +52,15 @@ def register_sidecar_handler(name: str):
     return deco
 
 
-def _strip_ns(tag: str) -> str:
-    return tag.rsplit("}", 1)[-1]
+def _index_files(source_dir: Path, stems: bool = False) -> dict[str, Path]:
+    """filename (and optionally extension-less stem) -> path, first wins."""
+    by_name: dict[str, Path] = {}
+    for p in source_dir.rglob("*"):
+        if p.is_file():
+            by_name.setdefault(p.name, p)
+            if stems and p.suffix.lower() in (".tif", ".tiff", ".png"):
+                by_name.setdefault(p.stem, p)
+    return by_name
 
 
 def _attr(el: ET.Element, *names: str) -> str | None:
@@ -177,16 +189,17 @@ def cellvoyager_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     if not entries:
         return [], 0  # .mlf present but held no IMG records
 
-    # channel names from the .mes settings file, if present
+    # channel names from the .mes settings file, if present; a corrupt .mes
+    # must not abort ingest — the C<nn> fallback names cover its absence
     channel_names: dict[int, str] = {}
     for mes in sorted(source_dir.rglob("*.mes")):
-        channel_names.update(parse_mes_channels(mes))
+        try:
+            channel_names.update(parse_mes_channels(mes))
+        except MetadataError as exc:
+            logger.warning("ignoring unparseable .mes file: %s", exc)
 
     # resolve filenames against the tree once (rglob per entry would be O(n^2))
-    by_name: dict[str, Path] = {}
-    for p in source_dir.rglob("*"):
-        if p.is_file():
-            by_name.setdefault(p.name, p)
+    by_name = _index_files(source_dir)
 
     # stage positions -> within-well grid.  Positions are absolute stage
     # coordinates, so the grid must be derived per well (reference
@@ -279,13 +292,8 @@ def omexml_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     if not companions:
         return None
 
-    by_name: dict[str, Path] = {}
-    for p in source_dir.rglob("*"):
-        if p.is_file():
-            by_name.setdefault(p.name, p)
-            # TIFF series referenced by stem: Image Name "foo" -> file foo.tif
-            if p.suffix.lower() in (".tif", ".tiff", ".png"):
-                by_name.setdefault(p.stem, p)
+    # TIFF series referenced by stem: Image Name "foo" -> file foo.tif
+    by_name = _index_files(source_dir, stems=True)
 
     entries: list[dict] = []
     skipped = 0
