@@ -1,0 +1,152 @@
+"""Tests for typed metadata records, user registry, tool result layers and
+the new jtmodule twins (clip/combine_channels/expand/shrink/mip)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tmlibrary_tpu.models.metadata import (
+    ChannelImageMetadata,
+    ChannelLayer,
+    IllumstatsImageMetadata,
+    ImageFileMapping,
+    ImageMetadata,
+    PyramidTileMetadata,
+)
+from tmlibrary_tpu.models.user import ExperimentShare, User, UserRegistry
+
+
+def test_image_metadata_round_trip():
+    m = ChannelImageMetadata(
+        plate=1, well="B03", site_y=2, site_x=4, channel="DAPI", is_corrected=True
+    )
+    d = m.to_dict()
+    back = ChannelImageMetadata.from_dict(d)
+    assert back == m
+    # base-class round trip ignores unknown keys
+    assert ImageMetadata.from_dict({**d, "bogus": 1}).well == "B03"
+
+
+def test_illumstats_metadata_round_trip():
+    m = IllumstatsImageMetadata(channel="GFP", cycle=2, n_sites=384, is_smoothed=True)
+    assert IllumstatsImageMetadata.from_dict(m.to_dict()) == m
+
+
+def test_pyramid_tile_metadata_filename():
+    t = PyramidTileMetadata(level=3, row=2, col=7, channel="channel00")
+    assert t.filename() == "channel00/3/2_7.png"
+
+
+def test_channel_layer_grid():
+    # 1024x768 mosaic, 256px tiles, 3 levels: max_zoom=2 full res
+    layer = ChannelLayer(channel="c", height=1024, width=768, max_zoom=2)
+    assert layer.grid(2) == (4, 3)
+    assert layer.grid(1) == (2, 2)  # 512x384
+    assert layer.grid(0) == (1, 1)  # 256x192
+    with pytest.raises(ValueError):
+        layer.grid(3)
+    assert ChannelLayer.from_dict(layer.to_dict()) == layer
+
+
+def test_image_file_mapping_round_trip():
+    m = ImageFileMapping(path="a.tif", site_index=7, channel=1, series=2, plane=3)
+    assert ImageFileMapping.from_dict(m.to_dict()) == m
+
+
+def test_user_registry(tmp_path):
+    reg = UserRegistry(tmp_path / "users.json")
+    reg.add_user(User("alice", "a@x"))
+    reg.add_user(User("bob"))
+    reg.set_owner("exp1", "alice")
+    reg.share(ExperimentShare("exp1", "bob", write=False))
+    assert reg.can_read("exp1", "bob") and not reg.can_write("exp1", "bob")
+    assert reg.can_write("exp1", "alice")
+    # persisted
+    reg2 = UserRegistry(tmp_path / "users.json")
+    assert [u.name for u in reg2.users()] == ["alice", "bob"]
+    assert reg2.can_read("exp1", "bob")
+    with pytest.raises(KeyError):
+        reg2.set_owner("exp2", "nobody")
+
+
+def test_tool_result_label_layers():
+    from tmlibrary_tpu.tools.base import (
+        ContinuousLabelLayer,
+        Plot,
+        ScalarLabelLayer,
+        SupervisedClassifierLabelLayer,
+        ToolResult,
+    )
+
+    df = pd.DataFrame(
+        {"site_index": [0, 0, 1], "label": [1, 2, 1], "value": [0.5, 1.5, 2.5]}
+    )
+    cont = ToolResult("heatmap", "cells", "continuous", df)
+    layer = cont.label_layer()
+    assert isinstance(layer, ContinuousLabelLayer)
+    assert layer.value_range() == (0.5, 2.5)
+
+    cat = ToolResult(
+        "classification", "cells", "categorical", df, attributes={"classes": ["a", "b"]}
+    )
+    sup = cat.label_layer()
+    assert isinstance(sup, SupervisedClassifierLabelLayer)
+    assert sup.classes == ["a", "b"]
+
+    scal = ToolResult("clustering", "cells", "categorical", df).label_layer()
+    assert type(scal) is ScalarLabelLayer
+    assert scal.unique_values() == [0.5, 1.5, 2.5]
+
+    p = Plot("scatter", {"data": [1, 2]})
+    assert Plot.from_json(p.to_json()) == p
+
+
+def test_tool_result_save_includes_plots(tmp_path):
+    import json
+
+    from tmlibrary_tpu.tools.base import Plot, ToolResult
+
+    df = pd.DataFrame({"site_index": [0], "label": [1], "value": [1.0]})
+    res = ToolResult("t", "cells", "continuous", df, plots=[Plot("bar", {"x": [1]})])
+    res.save(tmp_path / "r0")
+    meta = json.loads((tmp_path / "r0" / "result.json").read_text())
+    assert meta["plots"] == [{"type": "bar", "figure": {"x": [1]}}]
+
+
+def test_image_join_grid():
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.models.image import ChannelImage
+
+    tiles = [
+        ChannelImage(jnp.full((2, 3), i, jnp.float32), {"site": i}) for i in range(6)
+    ]
+    mosaic = ChannelImage.join(tiles, 2, 3)
+    assert mosaic.shape == (4, 9)
+    assert isinstance(mosaic, ChannelImage)
+    np.testing.assert_array_equal(np.asarray(mosaic.array[:2, :3]), np.zeros((2, 3)))
+    np.testing.assert_array_equal(np.asarray(mosaic.array[2:, 6:]), np.full((2, 3), 5))
+
+
+def test_new_modules():
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.jterator.modules import get_module
+
+    img = jnp.asarray(np.arange(16, dtype=np.float32).reshape(4, 4))
+    out = get_module("clip")(img, lower=2.0, upper=10.0)["clipped_image"]
+    assert float(out.min()) == 2.0 and float(out.max()) == 10.0
+
+    comb = get_module("combine_channels")(img, img, weight_1=0.5, weight_2=0.5)
+    np.testing.assert_allclose(np.asarray(comb["combined_image"]), np.asarray(img))
+
+    lab = jnp.zeros((8, 8), jnp.int32).at[3:5, 3:5].set(1)
+    grown = get_module("expand")(lab, n=1)["expanded_image"]
+    assert int((grown > 0).sum()) > int((lab > 0).sum())
+    shrunk = get_module("shrink")(grown, n=1)["shrunken_image"]
+    assert int((shrunk > 0).sum()) < int((grown > 0).sum())
+
+    stack = jnp.stack([img, 2 * img, 0.5 * img])
+    np.testing.assert_allclose(
+        np.asarray(get_module("mip")(stack)["mip_image"]), np.asarray(2 * img)
+    )

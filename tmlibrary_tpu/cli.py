@@ -162,6 +162,8 @@ def cmd_tool(args) -> int:
     store = _open_store(args)
     manager = tools_base.ToolRequestManager(store)
     if args.verb == "submit":
+        if args.payload_file and args.payload != "{}":
+            raise SystemExit("--payload and --payload-file are mutually exclusive")
         if args.payload_file:
             payload = json.loads(Path(args.payload_file).read_text())
         else:

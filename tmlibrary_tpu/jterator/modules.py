@@ -462,3 +462,41 @@ def expand_or_shrink(label_image, n: int = 1, max_objects: int = 256):
     mask = lab > 0
     eroded = label_ops.binary_erode(mask, connectivity=8, iterations=-n)
     return {"expanded_image": jnp.where(eroded, lab, 0)}
+
+
+@register_module("clip")
+def clip(intensity_image, lower: float = 0.0, upper: float = 65535.0):
+    """Reference ``jtmodules/clip.py``: clip intensities to [lower, upper]."""
+    from tmlibrary_tpu.ops import image_ops
+
+    return {"clipped_image": image_ops.clip_values(intensity_image, lower, upper)}
+
+
+@register_module("combine_channels")
+def combine_channels(image_1, image_2, weight_1: float = 1.0, weight_2: float = 1.0):
+    """Reference ``jtmodules/combine_channels.py``: weighted sum of two
+    channel images (used to pool correlated stains before segmentation)."""
+    a = jnp.asarray(image_1, jnp.float32)
+    b = jnp.asarray(image_2, jnp.float32)
+    return {"combined_image": weight_1 * a + weight_2 * b}
+
+
+@register_module("expand")
+def expand(label_image, n: int = 1):
+    """Reference ``jtmodules/expand.py``: grow labeled objects by ``n``
+    pixels (nearest-label assignment, deterministic tie-break)."""
+    return {"expanded_image": expand_or_shrink(label_image, n=n)["expanded_image"]}
+
+
+@register_module("shrink")
+def shrink(label_image, n: int = 1):
+    """Reference ``jtmodules/shrink.py``: erode labeled objects by ``n``
+    pixels (labels kept where the object mask survives erosion)."""
+    return {"shrunken_image": expand_or_shrink(label_image, n=-n)["expanded_image"]}
+
+
+@register_module("mip")
+def mip(zstack):
+    """Reference ``jtmodules/mip.py``: maximum-intensity projection of a
+    z-stack (alias for ``project(method="max")``)."""
+    return {"mip_image": project(zstack, method="max")["projected_image"]}

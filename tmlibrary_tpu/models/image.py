@@ -65,6 +65,20 @@ class Image:
     def numpy(self) -> np.ndarray:
         return np.asarray(self.array)
 
+    @classmethod
+    def join(cls, tiles: "list[Image] | jax.Array", grid_rows: int, grid_cols: int) -> "Image":
+        """Assemble a row-major grid of equally-sized tiles into one mosaic
+        (reference ``tmlib.image.Image.join``)."""
+        if isinstance(tiles, (list, tuple)):
+            if not tiles:
+                raise ValueError("Image.join requires at least one tile")
+            meta = dict(tiles[0].metadata)
+            stack = jnp.stack([t.array for t in tiles])
+        else:
+            meta = {}
+            stack = jnp.asarray(tiles)
+        return cls(image_ops.join_grid(stack, grid_rows, grid_cols), meta)
+
 
 @jax.tree_util.register_pytree_node_class
 class ChannelImage(Image):

@@ -1,0 +1,82 @@
+"""User and experiment-sharing records.
+
+Reference parity: ``tmlib/models/user.py`` (``User``) and the
+``ExperimentShare`` association in ``tmlib/models/experiment.py``.  The
+reference stores these as ORM rows to drive the web UI's auth/ACL; this
+framework has no server, so they are a JSON registry file
+(``users.json`` next to the experiment stores) that records ownership and
+read/write grants — enough for a front-end to enforce the same semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+
+@dataclasses.dataclass
+class User:
+    """Reference ``tmlib.models.user.User`` (minus password auth — auth
+    belongs to the serving layer, not the compute library)."""
+
+    name: str
+    email: str = ""
+
+
+@dataclasses.dataclass
+class ExperimentShare:
+    """Grant of access to one experiment (reference ``ExperimentShare``)."""
+
+    experiment: str
+    user: str
+    write: bool = False
+
+
+class UserRegistry:
+    """JSON-file registry of users, experiment ownership and shares."""
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self._data = {"users": {}, "owners": {}, "shares": []}
+        if self.path.exists():
+            self._data = json.loads(self.path.read_text())
+
+    def _save(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.write_text(json.dumps(self._data, indent=2))
+
+    def add_user(self, user: User) -> None:
+        self._data["users"][user.name] = {"email": user.email}
+        self._save()
+
+    def users(self) -> list[User]:
+        return [User(n, d.get("email", "")) for n, d in sorted(self._data["users"].items())]
+
+    def set_owner(self, experiment: str, user: str) -> None:
+        if user not in self._data["users"]:
+            raise KeyError(f"unknown user '{user}'")
+        self._data["owners"][experiment] = user
+        self._save()
+
+    def share(self, share: ExperimentShare) -> None:
+        if share.user not in self._data["users"]:
+            raise KeyError(f"unknown user '{share.user}'")
+        self._data["shares"].append(dataclasses.asdict(share))
+        self._save()
+
+    def can_read(self, experiment: str, user: str) -> bool:
+        if self._data["owners"].get(experiment) == user:
+            return True
+        return any(
+            s["experiment"] == experiment and s["user"] == user
+            for s in self._data["shares"]
+        )
+
+    def can_write(self, experiment: str, user: str) -> bool:
+        if self._data["owners"].get(experiment) == user:
+            return True
+        return any(
+            s["experiment"] == experiment and s["user"] == user and s["write"]
+            for s in self._data["shares"]
+        )

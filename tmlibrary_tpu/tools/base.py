@@ -59,6 +59,17 @@ class ToolResult:
     layer_type: str  # "categorical" | "continuous"
     values: pd.DataFrame
     attributes: dict[str, Any] = dataclasses.field(default_factory=dict)
+    plots: list["Plot"] = dataclasses.field(default_factory=list)
+
+    def label_layer(self) -> "LabelLayer":
+        """Materialize the viewer layer for this result (reference: each
+        ``ToolResult`` owns a ``LabelLayer`` row)."""
+        if self.layer_type == "continuous":
+            return ContinuousLabelLayer(self.objects_name, self.values)
+        classes = self.attributes.get("classes")
+        if classes is not None:
+            return SupervisedClassifierLabelLayer(self.objects_name, self.values, classes)
+        return ScalarLabelLayer(self.objects_name, self.values)
 
     def save(self, directory) -> None:
         from pathlib import Path
@@ -74,10 +85,73 @@ class ToolResult:
                     "layer_type": self.layer_type,
                     "attributes": self.attributes,
                     "n_objects": int(len(self.values)),
+                    "plots": [
+                        {"type": p.type, "figure": p.figure} for p in self.plots
+                    ],
                 },
                 default=str,
             )
         )
+
+
+@dataclasses.dataclass
+class LabelLayer:
+    """Viewer overlay mapping each object to a display value (reference
+    ``tmlib/models/result.py`` ``LabelLayer`` + subtypes).  ``mapping``
+    is (site_index, label) → value; subclasses fix the value semantics."""
+
+    objects_name: str
+    mapping: pd.DataFrame  # columns: site_index, label, value
+    type: str = "generic"
+
+    def value_range(self) -> tuple[float, float]:
+        v = self.mapping["value"]
+        return float(v.min()), float(v.max())
+
+
+class ScalarLabelLayer(LabelLayer):
+    """Discrete per-object values (reference ``ScalarLabelLayer``)."""
+
+    def __init__(self, objects_name: str, mapping: pd.DataFrame):
+        super().__init__(objects_name, mapping, type="scalar")
+
+    def unique_values(self) -> list:
+        return sorted(self.mapping["value"].unique().tolist())
+
+
+class SupervisedClassifierLabelLayer(ScalarLabelLayer):
+    """Predicted class per object (reference
+    ``SupervisedClassifierLabelLayer``); carries the label→color hints."""
+
+    def __init__(self, objects_name: str, mapping: pd.DataFrame, classes: list[str]):
+        super().__init__(objects_name, mapping)
+        self.type = "supervised"
+        self.classes = list(classes)
+
+
+class ContinuousLabelLayer(LabelLayer):
+    """Continuous per-object values, e.g. heatmap features (reference
+    ``ContinuousLabelLayer``)."""
+
+    def __init__(self, objects_name: str, mapping: pd.DataFrame):
+        super().__init__(objects_name, mapping, type="continuous")
+
+
+@dataclasses.dataclass
+class Plot:
+    """A serializable figure attached to a tool result (reference
+    ``tmlib/models/plot.py`` ``Plot``): plotly-style JSON spec + type tag."""
+
+    type: str
+    figure: dict[str, Any]
+
+    def to_json(self) -> str:
+        return json.dumps({"type": self.type, "figure": self.figure})
+
+    @classmethod
+    def from_json(cls, s: str) -> "Plot":
+        d = json.loads(s)
+        return cls(type=d["type"], figure=d["figure"])
 
 
 class Tool(abc.ABC):

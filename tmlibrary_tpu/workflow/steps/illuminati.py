@@ -20,6 +20,7 @@ import numpy as np
 
 from tmlibrary_tpu.models.experiment import SiteRef
 from tmlibrary_tpu.models.image import IllumstatsContainer
+from tmlibrary_tpu.models.metadata import ChannelLayer
 from tmlibrary_tpu.ops import image_ops
 from tmlibrary_tpu.ops.pyramid import cut_tiles, pyramid_levels, to_uint8
 from tmlibrary_tpu.utils import create_partitions
@@ -128,6 +129,15 @@ class PyramidBuilder(Step):
 
                 cv2.imwrite(str(ldir / f"{ty}_{tx}.png"), tile)
                 n_tiles += 1
+        layer = ChannelLayer(
+            channel=f"channel{channel:02d}",
+            height=mosaic.shape[0],
+            width=mosaic.shape[1],
+            max_zoom=len(levels) - 1,
+        )
+        import json
+
+        (out_dir / "layer.json").write_text(json.dumps(layer.to_dict()))
         return {
             "channel": channel,
             "mosaic_shape": list(mosaic.shape),

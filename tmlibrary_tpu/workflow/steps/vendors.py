@@ -195,7 +195,8 @@ def cellvoyager_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     for mes in sorted(source_dir.rglob("*.mes")):
         try:
             channel_names.update(parse_mes_channels(mes))
-        except MetadataError as exc:
+        except (MetadataError, ValueError) as exc:
+            # ValueError: well-formed XML with a non-numeric channel number
             logger.warning("ignoring unparseable .mes file: %s", exc)
 
     # resolve filenames against the tree once (rglob per entry would be O(n^2))
