@@ -1,0 +1,77 @@
+"""Multi-host runtime helpers on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.parallel.distributed import (
+    batch_spec,
+    global_to_host_local,
+    host_local_to_global,
+    initialize,
+    local_site_slice,
+    pod_mesh,
+    sync_hosts,
+)
+
+
+def test_initialize_single_host_noop(monkeypatch):
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    assert initialize() is False
+    # explicit single-process is also a no-op
+    assert initialize("127.0.0.1:9999", num_processes=1, process_id=0) is False
+
+
+def test_pod_mesh_default(devices):
+    mesh = pod_mesh()
+    assert mesh.axis_names == ("wells", "sites")
+    assert mesh.devices.size == 8
+    # single host: wells defaults to process_count=1
+    assert mesh.shape["wells"] == 1 and mesh.shape["sites"] == 8
+
+
+def test_pod_mesh_explicit_wells(devices):
+    mesh = pod_mesh(wells=4)
+    assert mesh.shape["wells"] == 4 and mesh.shape["sites"] == 2
+    with pytest.raises(ValueError):
+        pod_mesh(wells=3)
+
+
+def test_batch_shards_over_pod_mesh(devices):
+    mesh = pod_mesh(wells=2)
+    batch = np.arange(16 * 4 * 4, dtype=np.float32).reshape(16, 4, 4)
+    spec = batch_spec(mesh)
+    sharded = jax.device_put(
+        batch, jax.sharding.NamedSharding(mesh, spec)
+    )
+    assert len(sharded.addressable_shards) == 8
+    assert sharded.addressable_shards[0].data.shape == (2, 4, 4)
+    # computation over the sharded axis matches unsharded
+    out = jax.jit(lambda x: jnp.sum(x, axis=(1, 2)))(sharded)
+    np.testing.assert_allclose(np.asarray(out), batch.sum(axis=(1, 2)))
+
+
+def test_local_site_slice_partitions_everything():
+    n_sites = 37
+    covered = []
+    for pid in range(4):
+        s = local_site_slice(n_sites, process_id=pid, n_processes=4)
+        covered.extend(range(*s.indices(n_sites)))
+    assert covered == list(range(n_sites))
+    # single-process: the whole range
+    s = local_site_slice(10, process_id=0, n_processes=1)
+    assert (s.start, s.stop) == (0, 10)
+
+
+def test_host_local_global_round_trip(devices):
+    mesh = pod_mesh()
+    local = np.random.default_rng(0).normal(size=(8, 4, 4)).astype(np.float32)
+    g = host_local_to_global(local, mesh)
+    assert g.shape == (8, 4, 4)
+    back = global_to_host_local(g, mesh)
+    np.testing.assert_array_equal(back, local)
+
+
+def test_sync_hosts_single_host_noop():
+    sync_hosts("test")  # must not raise or hang on one host

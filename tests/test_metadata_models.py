@@ -150,3 +150,22 @@ def test_new_modules():
     np.testing.assert_allclose(
         np.asarray(get_module("mip")(stack)["mip_image"]), np.asarray(2 * img)
     )
+
+
+def test_channel_layer_grid_odd_sizes_match_pyramid_levels():
+    """grid() must follow the illuminati ceil-halving chain exactly."""
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.ops.pyramid import cut_tiles, pyramid_levels
+
+    mosaic = jnp.zeros((513, 290), jnp.float32)
+    levels = pyramid_levels(mosaic)
+    layer = ChannelLayer(
+        channel="c", height=513, width=290, max_zoom=len(levels) - 1
+    )
+    for li, lvl in enumerate(levels):
+        zoom = len(levels) - 1 - li
+        tiles = cut_tiles(np.asarray(lvl, np.uint8))
+        rows = max(t[0] for t in tiles) + 1
+        cols = max(t[1] for t in tiles) + 1
+        assert layer.grid(zoom) == (rows, cols), (zoom, lvl.shape)

@@ -314,3 +314,130 @@ def test_ome_xml_writer_roundtrip(tmp_path):
     assert images[0].size_x == 48 and images[0].size_y == 64
     assert images[0].size_z == 3 and images[0].size_t == 2
     assert images[0].channel_names == ["DAPI"]
+
+
+# ------------------------------------------------------------------ metamorph
+ND_FILE = """\
+"NDInfoFile", Version 1.0
+"Description", File recreated from images
+"StartTime1", 20260729 10:00:00
+"DoTimelapse", TRUE
+"NTimePoints", 2
+"DoStage", TRUE
+"NStagePositions", 4
+"Stage1", "A01"
+"Stage2", "A01"
+"Stage3", "B02: center"
+"Stage4", "B02: edge"
+"DoWave", TRUE
+"NWaves", 2
+"WaveName1", "DAPI"
+"WaveDoZ1", FALSE
+"WaveName2", "FITC"
+"WaveDoZ2", FALSE
+"DoZSeries", FALSE
+"NZSteps", 1
+"EndFile"
+"""
+
+
+def test_parse_nd(tmp_path):
+    from tmlibrary_tpu.workflow.steps.vendors import parse_nd
+
+    nd = tmp_path / "exp1.nd"
+    nd.write_text(ND_FILE)
+    info = parse_nd(nd)
+    assert info["waves"] == ["DAPI", "FITC"]
+    assert len(info["stages"]) == 4
+    assert info["n_tpoints"] == 2
+    assert info["n_zsteps"] == 1
+
+
+def test_metaconfig_metamorph_sidecar(tmp_path):
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    (src / "exp1.nd").write_text(ND_FILE)
+    rng = np.random.default_rng(0)
+    for t in (1, 2):
+        for wi, wave in ((1, "DAPI"), (2, "FITC")):
+            for s in (1, 2, 3, 4):
+                img = rng.integers(0, 4000, (32, 32)).astype(np.uint16)
+                cv2.imwrite(str(src / f"exp1_w{wi}{wave}_s{s}_t{t}.tif"), img)
+
+    root = tmp_path / "exp"
+    store = _empty_store(root, "mmtest")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "metamorph"})
+    result = step.run(0)
+    # 2 tpoints x 2 waves x 4 positions
+    assert result["n_files"] == 16
+    assert result["n_skipped"] == 0
+    exp = ExperimentStore.open(root).experiment
+    assert {c.name for c in exp.channels} == {"DAPI", "FITC"}
+    assert exp.n_tpoints == 2
+    # A01 holds two sites (repeated label), B02 two sites (distinct labels
+    # sharing the well token)
+    wells = {(w.row, w.column): len(w.sites) for w in exp.plates[0].wells}
+    assert wells == {(0, 0): 2, (1, 1): 2}
+
+    # imextract can ingest the mapping end to end
+    ext = get_step("imextract")(store)
+    ext.init({})
+    for i in ext.list_batches():
+        ext.run(i)
+    pixels = store.read_sites(None, channel=0, tpoint=1)
+    assert pixels.shape == (4, 32, 32)
+    assert pixels.max() > 0
+
+
+def test_metamorph_auto_detected(tmp_path):
+    """auto handler picks up .nd sidecars without being named."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    nd = ND_FILE.replace('"DoTimelapse", TRUE', '"DoTimelapse", FALSE')
+    (src / "scan.nd").write_text(nd)
+    rng = np.random.default_rng(1)
+    for wi, wave in ((1, "DAPI"), (2, "FITC")):
+        for s in (1, 2, 3, 4):
+            img = rng.integers(0, 4000, (16, 16)).astype(np.uint16)
+            cv2.imwrite(str(src / f"scan_w{wi}{wave}_s{s}.tif"), img)
+    store = _empty_store(tmp_path / "exp", "mmauto")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "auto"})
+    result = step.run(0)
+    assert result["n_files"] == 8
+
+
+def test_metamorph_two_nd_files_distinct_sites(tmp_path):
+    """Two acquisitions hitting the same well must not collide on sites."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import metamorph_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    nd = (
+        '"NDInfoFile", Version 1.0\n'
+        '"DoStage", TRUE\n"NStagePositions", 1\n"Stage1", "A01"\n'
+        '"DoWave", TRUE\n"NWaves", 1\n"WaveName1", "DAPI"\n"EndFile"\n'
+    )
+    rng = np.random.default_rng(0)
+    for base in ("scan1", "scan2"):
+        (src / f"{base}.nd").write_text(nd)
+        cv2.imwrite(
+            str(src / f"{base}_w1DAPI_s1.tif"),
+            rng.integers(0, 4000, (16, 16)).astype(np.uint16),
+        )
+    entries, skipped = metamorph_sidecar(src)
+    assert skipped == 0 and len(entries) == 2
+    coords = {(e["well_row"], e["well_col"], e["site"]) for e in entries}
+    assert coords == {(0, 0, 0), (0, 0, 1)}

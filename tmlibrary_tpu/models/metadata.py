@@ -104,14 +104,16 @@ class ChannelLayer:
 
     def grid(self, level: int) -> tuple[int, int]:
         """(rows, cols) of the tile grid at zoomify ``level`` — level
-        ``max_zoom`` is full resolution, each level below halves the mosaic
-        (matching the illuminati step's ``pyramids/<channel>/<level>/``
-        directory numbering)."""
+        ``max_zoom`` is full resolution, each level below ceil-halves the
+        mosaic exactly as the illuminati downsample chain does
+        (``pyramid_levels``: ``(h+1)//2`` per level), matching the
+        ``pyramids/<channel>/<level>/`` directory numbering."""
         shift = self.max_zoom - level
         if shift < 0:
             raise ValueError(f"level {level} exceeds max_zoom {self.max_zoom}")
-        h = max(1, self.height >> shift)
-        w = max(1, self.width >> shift)
+        h, w = self.height, self.width
+        for _ in range(shift):
+            h, w = (h + 1) // 2, (w + 1) // 2
         return (
             -(-h // self.tile_size),
             -(-w // self.tile_size),

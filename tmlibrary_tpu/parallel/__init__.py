@@ -11,10 +11,18 @@ port.  The TPU-native equivalent is:
 - XLA collectives over ICI/DCN (psum/all_gather) for reductions that the
   reference did by writing per-job results into the DB and merging in a
   collect phase (``stats.py``: corilla's cross-device Welford merge);
-- ``jax.distributed`` multi-host init for pod scale (``dist.py``).
+- ``jax.distributed`` multi-host init for pod scale (``distributed.py``:
+  bootstrap, DCN/ICI hybrid pod meshes, per-host data-plane slices).
 """
 
+from tmlibrary_tpu.parallel.distributed import initialize, pod_mesh
 from tmlibrary_tpu.parallel.mesh import site_mesh, shard_batch
 from tmlibrary_tpu.parallel.stats import sharded_channel_stats
 
-__all__ = ["site_mesh", "shard_batch", "sharded_channel_stats"]
+__all__ = [
+    "site_mesh",
+    "shard_batch",
+    "sharded_channel_stats",
+    "initialize",
+    "pod_mesh",
+]

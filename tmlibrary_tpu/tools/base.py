@@ -94,7 +94,7 @@ class ToolResult:
         )
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(eq=False)
 class LabelLayer:
     """Viewer overlay mapping each object to a display value (reference
     ``tmlib/models/result.py`` ``LabelLayer`` + subtypes).  ``mapping``

@@ -24,6 +24,7 @@ coords from stage positions the same way (metaconfig ``base.py``).
 
 from __future__ import annotations
 
+import re
 import xml.etree.ElementTree as ET
 from pathlib import Path
 from typing import Callable
@@ -58,7 +59,7 @@ def _index_files(source_dir: Path, stems: bool = False) -> dict[str, Path]:
     for p in source_dir.rglob("*"):
         if p.is_file():
             by_name.setdefault(p.name, p)
-            if stems and p.suffix.lower() in (".tif", ".tiff", ".png"):
+            if stems and p.suffix.lower() in (".tif", ".tiff", ".png", ".stk"):
                 by_name.setdefault(p.stem, p)
     return by_name
 
@@ -347,5 +348,131 @@ def omexml_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                         if sy is not None:
                             rec["site_y"] = sy
                             rec["site_x"] = sx
+                        entries.append(rec)
+    return entries, skipped
+
+
+# ----------------------------------------------------------------- metamorph
+def parse_nd(path: Path) -> dict:
+    """Parse a MetaMorph ``.nd`` acquisition-description file.
+
+    Reference parity: ``tmlib/workflow/metaconfig``'s vendor handler set
+    (SURVEY.md §2 metaconfig row, vendor set tagged [L]).  The ``.nd``
+    format is line-oriented ``"Key", value`` pairs describing the
+    wave (channel), stage-position and timepoint dimensions of one
+    acquisition; image files are named
+    ``<base>_w<N><wave>_s<position>_t<timepoint>``.
+    """
+    keys: dict[str, str] = {}
+    for raw in path.read_text(errors="replace").splitlines():
+        line = raw.strip()
+        if not line or line == '"EndFile"':
+            continue
+        parts = line.split(",", 1)
+        key = parts[0].strip().strip('"')
+        val = parts[1].strip().strip('"') if len(parts) > 1 else ""
+        keys[key] = val
+
+    def flag(name: str) -> bool:
+        return keys.get(name, "FALSE").upper() == "TRUE"
+
+    def num(name: str, default: int = 1) -> int:
+        try:
+            return int(keys.get(name, default))
+        except ValueError:
+            raise MetadataError(f"malformed numeric field {name} in {path}")
+
+    waves = []
+    if flag("DoWave"):
+        waves = [keys.get(f"WaveName{i}", f"w{i}") for i in range(1, num("NWaves") + 1)]
+    stages = []
+    if flag("DoStage"):
+        stages = [
+            keys.get(f"Stage{i}", f"s{i}") for i in range(1, num("NStagePositions") + 1)
+        ]
+    return {
+        "waves": waves,
+        "stages": stages,
+        "n_tpoints": num("NTimePoints") if flag("DoTimelapse") else 1,
+        "n_zsteps": num("NZSteps") if flag("DoZSeries") else 1,
+    }
+
+
+_WELL_TOKEN = re.compile(r"([A-Z]{1,2})(\d{1,2})")
+
+
+@register_sidecar_handler("metamorph")
+def metamorph_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """MetaMorph handler: requires ``*.nd`` files in the source tree.
+
+    Well assignment: a stage label containing a well token (``A01``) maps
+    to that well, with repeated labels numbering sites within the well in
+    label order; labels without a well token all land in one well with the
+    position index as the site.  Z-series acquisitions are stored as
+    multi-page stacks, addressed via per-plane ``page`` indices.
+    """
+    nds = sorted(source_dir.rglob("*.nd"))
+    if not nds:
+        return None
+    by_stem = _index_files(source_dir, stems=True)
+
+    entries: list[dict] = []
+    skipped = 0
+    # shared across .nd files: two acquisitions hitting the same well must
+    # get distinct site numbers, not overwrite each other's store slots
+    site_counter: dict[tuple[int, int], int] = {}
+    for nd in nds:
+        try:
+            info = parse_nd(nd)
+        except MetadataError as exc:
+            logger.warning("ignoring unparseable .nd file: %s", exc)
+            continue
+        base = nd.stem
+        waves = info["waves"] or [None]
+        stages = info["stages"] or [None]
+
+        # stage label -> (well_row, well_col, site).  Deferred import:
+        # metaconfig is the module that imports this handler registry.
+        from tmlibrary_tpu.workflow.steps.metaconfig import parse_well_name
+        addr: list[tuple[int, int, int]] = []
+        for pos, label in enumerate(stages):
+            m = _WELL_TOKEN.search(label) if label else None
+            if m:
+                row, col = parse_well_name(m.group(0))
+            else:
+                row, col = 0, 0
+            site = site_counter.get((row, col), 0)
+            site_counter[(row, col)] = site + 1
+            addr.append((row, col, site))
+
+        for t in range(info["n_tpoints"]):
+            for wi, wave in enumerate(waves):
+                for pos, label in enumerate(stages):
+                    stem = base
+                    if wave is not None:
+                        stem += f"_w{wi + 1}{wave}"
+                    if info["stages"]:
+                        stem += f"_s{pos + 1}"
+                    if info["n_tpoints"] > 1:
+                        stem += f"_t{t + 1}"
+                    path = by_stem.get(stem)
+                    if path is None:
+                        skipped += 1
+                        continue
+                    row, col, site = addr[pos]
+                    for z in range(info["n_zsteps"]):
+                        rec = {
+                            "plate": "plate00",
+                            "well_row": row,
+                            "well_col": col,
+                            "site": site,
+                            "channel": wave if wave is not None else "w1",
+                            "cycle": 0,
+                            "tpoint": t,
+                            "zplane": z,
+                            "path": str(path),
+                        }
+                        if info["n_zsteps"] > 1:
+                            rec["page"] = z  # stack page = z plane
                         entries.append(rec)
     return entries, skipped
