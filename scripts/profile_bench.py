@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Per-stage timing of the cell-painting bench pipeline on the current device.
+
+Each timed fn reduces its output to ONE scalar inside jit so the host fetch
+(which is the only honest completion fence under the axon relay) transfers a
+few bytes, not megapixels.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmlibrary_tpu.benchmarks import synthetic_cell_painting_batch
+from tmlibrary_tpu.ops import label as lab
+from tmlibrary_tpu.ops import threshold as thr
+from tmlibrary_tpu.ops.segment_primary import segment_primary
+from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+from tmlibrary_tpu.ops.measure import intensity_features
+from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+SIZE = int(os.environ.get("BENCH_SITE_SIZE", "256"))
+MAXOBJ = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
+
+
+def timeit(name, fn, *args):
+    np.asarray(fn(*args))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:35s} {best*1e3:9.2f} ms  ({BATCH/best:8.1f} sites/s)")
+
+
+def scalar(fn):
+    """Wrap fn so jit returns a single float32 checksum."""
+    def wrapped(*args):
+        out = fn(*args)
+        leaves = jax.tree_util.tree_leaves(out)
+        return sum(jnp.sum(jnp.asarray(l, jnp.float32)) for l in leaves)
+    return jax.jit(wrapped)
+
+
+def main():
+    data = synthetic_cell_painting_batch(BATCH, size=SIZE)
+    dapi = jax.device_put(jnp.asarray(data["DAPI"]))
+    actin = jax.device_put(jnp.asarray(data["Actin"]))
+
+    v = jax.vmap
+
+    timeit("noop (fetch floor)", scalar(lambda a: a[:, 0, 0]), dapi)
+    timeit("smooth(gauss 1.5)", scalar(v(lambda im: gaussian_smooth(im, 1.5))), dapi)
+
+    sp = lambda im: segment_primary(
+        im, threshold_method="otsu", smooth_sigma=0.0, min_area=20, max_objects=MAXOBJ
+    )[0]
+    timeit("segment_primary (full)", scalar(v(sp)), dapi)
+
+    # stage internals of segment_primary
+    smoothed = jax.jit(v(lambda im: gaussian_smooth(im, 1.5)))(dapi)
+    otsu_mask = lambda im: thr.threshold_otsu(im)
+    timeit("  otsu threshold", scalar(v(otsu_mask)), smoothed)
+    masks = jax.jit(v(otsu_mask))(smoothed)
+    timeit("  fill_holes", scalar(v(lab.fill_holes)), masks)
+    timeit("  connected_components", scalar(v(lambda m: lab.connected_components(m)[0])), masks)
+    filled = jax.jit(v(lab.fill_holes))(masks)
+    nuclei = jax.jit(v(sp))(dapi)
+
+    sec = lambda lbl, im: watershed_from_seeds(
+        im, lbl, thr.threshold_otsu(im, correction_factor=0.8), n_levels=16
+    )
+    timeit("segment_secondary (16 lvl)", scalar(v(sec)), nuclei, actin)
+    cells = jax.jit(v(sec))(nuclei, actin)
+
+    mi = lambda lbl, im: intensity_features(lbl, im, MAXOBJ)
+    timeit("measure_intensity(nuclei)", scalar(v(mi)), nuclei, dapi)
+    timeit("measure_intensity(cells)", scalar(v(mi)), cells, actin)
+
+
+if __name__ == "__main__":
+    main()

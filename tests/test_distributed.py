@@ -23,6 +23,20 @@ def test_initialize_single_host_noop(monkeypatch):
     assert initialize("127.0.0.1:9999", num_processes=1, process_id=0) is False
 
 
+def test_initialize_partial_config_fails_fast(monkeypatch):
+    """A pod launch script that sets only half the coordinator config must
+    error, not silently run every host as an independent single-host job."""
+    import pytest
+
+    from tmlibrary_tpu.errors import ShardingError
+
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    with pytest.raises(ShardingError):
+        initialize("10.0.0.1:1234")
+    with pytest.raises(ShardingError):
+        initialize(num_processes=4, process_id=0)
+
+
 def test_pod_mesh_default(devices):
     mesh = pod_mesh()
     assert mesh.axis_names == ("wells", "sites")

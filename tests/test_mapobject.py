@@ -1,0 +1,74 @@
+"""Mapobject type registry + static grid geometry.
+
+Reference parity: ``tmlib/models/mapobject.py`` (``MapobjectType``,
+static Plates/Wells/Sites types, polygon-zoom threshold).
+"""
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.models.experiment import grid_experiment
+from tmlibrary_tpu.models.mapobject import (
+    MapobjectType,
+    MapobjectTypeRegistry,
+    min_poly_zoom,
+    static_mapobjects,
+)
+
+
+def test_registry_roundtrip(tmp_path):
+    reg = MapobjectTypeRegistry(tmp_path)
+    assert reg.names() == []
+    reg.register(MapobjectType(name="nuclei", min_poly_zoom=2))
+    reg.register(MapobjectType(name="cells", min_poly_zoom=1))
+    assert reg.names() == ["cells", "nuclei"]
+    got = reg.get("nuclei")
+    assert got.ref_type == "segmented"
+    assert got.min_poly_zoom == 2
+    reg.delete("cells")
+    assert reg.names() == ["nuclei"]
+    with pytest.raises(MetadataError):
+        reg.get("cells")
+
+
+def test_static_mapobjects_geometry():
+    exp = grid_experiment(
+        well_rows=2, well_cols=3, sites_per_well=(2, 2), site_shape=(128, 128)
+    )
+    geo = static_mapobjects(exp, "plate00")
+    assert len(geo["Plates"]) == 1
+    assert len(geo["Wells"]) == 6
+    assert len(geo["Sites"]) == 24
+    name, plate_rect = geo["Plates"][0]
+    assert name == "plate00"
+    # plate spans (2 rows x 2 sites x 128) x (3 cols x 2 sites x 128)
+    assert plate_rect.max(axis=0).tolist() == [2 * 256, 3 * 256]
+    # outlines are closed
+    for _, rect in geo["Wells"] + geo["Sites"]:
+        assert np.array_equal(rect[0], rect[-1])
+    # well A01 at origin; well B03 offset one well row, two well cols
+    wells = dict(geo["Wells"])
+    assert wells["A01"][0].tolist() == [0, 0]
+    assert wells["B03"][0].tolist() == [256, 512]
+
+
+def test_static_mapobjects_spacing_and_errors():
+    exp = grid_experiment(well_rows=1, well_cols=2, sites_per_well=(1, 1),
+                          site_shape=(100, 100))
+    geo = static_mapobjects(exp, "plate00", well_spacing=10)
+    _, plate_rect = geo["Plates"][0]
+    assert plate_rect.max(axis=0).tolist() == [100, 210]
+    with pytest.raises(MetadataError):
+        static_mapobjects(exp, "nope")
+
+
+def test_min_poly_zoom():
+    # tiny objects: polygons only at the finest level
+    assert min_poly_zoom(6, mean_object_px=1.0) == 5
+    # large objects resolve to >=2px earlier (coarser levels)
+    assert min_poly_zoom(6, mean_object_px=10000.0) < 3
+    assert min_poly_zoom(6, mean_object_px=0.0) == 5
+    # monotone: bigger objects never need a finer zoom
+    zooms = [min_poly_zoom(8, a) for a in (4, 64, 1024, 16384)]
+    assert zooms == sorted(zooms, reverse=True)

@@ -1,0 +1,168 @@
+"""Project management: skeleton creation, handle templates, module
+add/remove, round-trip into a runnable PipelineDescription.
+
+Reference parity: ``tmlib/workflow/jterator/project.py`` (Project) and the
+static handles templates shipped with each jtmodule.
+"""
+
+import numpy as np
+import pytest
+import yaml
+
+from tmlibrary_tpu.errors import PipelineDescriptionError
+from tmlibrary_tpu.jterator.handles import HandleCollection, InputHandle
+from tmlibrary_tpu.jterator.modules import list_modules
+from tmlibrary_tpu.jterator.project import (
+    HANDLES_SUFFIX,
+    Project,
+    handles_template,
+)
+
+
+def test_handles_template_smooth():
+    hc = handles_template("smooth")
+    assert hc.module == "smooth"
+    assert hc.backend == "tpu"
+    names = {h.name: h for h in hc.input}
+    assert names["intensity_image"].type == "IntensityImage"
+    assert names["intensity_image"].key == "intensity_image"
+    assert names["sigma"].type == "Numeric"
+    assert names["method"].type == "Character"
+    out = {h.name: h for h in hc.output}
+    assert out["smoothed_image"].type == "IntensityImage"
+
+
+def test_handles_template_segment_and_measure():
+    seg = handles_template("segment_primary")
+    out = seg.output[0]
+    assert out.type == "SegmentedObjects"
+    assert out.objects and out.key
+    mi = handles_template("measure_intensity")
+    assert mi.output[0].type == "Measurement"
+    ins = {h.name: h for h in mi.input}
+    assert ins["objects_image"].type == "LabelImage"
+    assert ins["intensity_image"].type == "IntensityImage"
+
+
+def test_handles_template_every_module_valid():
+    """Every registered module must yield a loadable template (the
+    reference ships a handles template per module)."""
+    for name in list_modules():
+        hc = handles_template(name)
+        rt = HandleCollection.from_dict(hc.to_dict())
+        assert rt.module == name
+
+
+def test_project_lifecycle(tmp_path):
+    proj = Project.create(tmp_path / "proj", description="demo")
+    assert proj.exists
+    with pytest.raises(PipelineDescriptionError):
+        Project.create(tmp_path / "proj")
+
+    proj.add_channel("DAPI", correct=False)
+    with pytest.raises(PipelineDescriptionError):
+        proj.add_channel("DAPI")
+
+    proj.add_module("smooth", intensity_image="DAPI", sigma=2.5)
+    hc = proj.get_handles("smooth")
+    consts = hc.constants()
+    assert consts["sigma"] == 2.5
+    # array input override rebinds the store key
+    arrays = hc.array_inputs()
+    assert arrays["intensity_image"] == "DAPI"
+
+    assert proj.module_names() == ["smooth"]
+    assert proj.handles_path("smooth").name == f"smooth{HANDLES_SUFFIX}"
+
+    with pytest.raises(PipelineDescriptionError):
+        proj.add_module("smooth")  # duplicate instance
+    proj.add_module("smooth", instance="smooth_2", intensity_image="DAPI")
+    assert proj.module_names() == ["smooth", "smooth_2"]
+
+    proj.remove_module("smooth_2")
+    assert proj.module_names() == ["smooth"]
+    with pytest.raises(PipelineDescriptionError):
+        proj.remove_module("smooth_2")
+
+    with pytest.raises(PipelineDescriptionError):
+        proj.add_module("smooth", instance="s3", bogus_knob=1)
+
+
+def test_project_unknown_constant_rejected(tmp_path):
+    proj = Project.create(tmp_path / "p")
+    with pytest.raises(PipelineDescriptionError):
+        proj.add_module("smooth", not_a_param=3)
+
+
+def test_project_set_active(tmp_path):
+    proj = Project.create(tmp_path / "p")
+    proj.add_channel("DAPI", correct=False)
+    proj.add_module("smooth", intensity_image="DAPI")
+    proj.set_active("smooth", False)
+    d = yaml.safe_load(proj.pipe_path.read_text())
+    assert d["pipeline"][0]["active"] is False
+    with pytest.raises(PipelineDescriptionError):
+        proj.set_active("ghost", True)
+
+
+def test_project_builds_runnable_description(tmp_path):
+    """A project assembled through the API must parse, validate, and run
+    through the pipeline engine."""
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+    proj = Project.create(tmp_path / "p", description="smooth+segment")
+    proj.add_channel("DAPI", correct=False)
+    proj.add_module("smooth", intensity_image="DAPI", sigma=1.0)
+    # rebind segment input to the smooth output key
+    proj.add_module(
+        "segment_primary",
+        intensity_image="smoothed_image",
+        min_area=5,
+        max_objects=16,
+    )
+    proj.add_output_objects("segment_primary")
+    desc = proj.description()
+    assert [m.module for m in desc.modules] == ["smooth", "segment_primary"]
+
+    pipe = ImageAnalysisPipeline(desc, max_objects=16)
+    fn = pipe.build_batch_fn(jit=False)
+    rng = np.random.default_rng(0)
+    img = rng.normal(200.0, 10.0, (2, 64, 64)).astype(np.float32)
+    img[:, 20:30, 20:30] += 5000.0
+    result = fn({"DAPI": jnp.asarray(img)}, {}, jnp.zeros((2, 2), jnp.int32))
+    counts = np.asarray(result.counts["segment_primary"])
+    assert (counts >= 1).all()
+
+
+def test_project_update_handles(tmp_path):
+    proj = Project.create(tmp_path / "p")
+    proj.add_module("smooth", intensity_image="DAPI")
+    hc = proj.get_handles("smooth")
+    hc.input = [
+        InputHandle(name=h.name, type=h.type, key=h.key,
+                    value=4.0 if h.name == "sigma" else h.value)
+        for h in hc.input
+    ]
+    proj.update_handles("smooth", hc)
+    assert proj.get_handles("smooth").constants()["sigma"] == 4.0
+    with pytest.raises(PipelineDescriptionError):
+        proj.update_handles("ghost", hc)
+
+
+def test_project_cli(tmp_path, capsys):
+    from tmlibrary_tpu.cli import main
+
+    d = str(tmp_path / "proj")
+    assert main(["project", "create", "--dir", d]) == 0
+    assert main(["project", "add-channel", "--dir", d, "--name", "DAPI",
+                 "--no-correct"]) == 0
+    assert main(["project", "add-module", "--dir", d, "--module", "smooth"]) == 0
+    assert main(["project", "show", "--dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "module=smooth" in out
+    assert main(["project", "modules"]) == 0
+    assert "segment_primary" in capsys.readouterr().out
+    assert main(["project", "remove-module", "--dir", d,
+                 "--instance", "smooth"]) == 0

@@ -56,6 +56,14 @@ def test_downsample_odd_shape():
     np.testing.assert_allclose(out, 1.0)
 
 
+def test_n_pyramid_levels_matches_chain(rng):
+    from tmlibrary_tpu.ops.pyramid import n_pyramid_levels
+
+    for shape in ((1024, 768), (256, 256), (100, 100), (8192, 8192), (257, 1)):
+        mosaic = jnp.zeros(shape, jnp.float32)
+        assert n_pyramid_levels(*shape) == len(pyramid_levels(mosaic))
+
+
 def test_pyramid_levels_chain(rng):
     mosaic = jnp.asarray(rng.random((1024, 768)).astype(np.float32))
     levels = pyramid_levels(mosaic)

@@ -81,6 +81,27 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p_tlist)
     tool_sub.add_parser("available", help="registered tool names")
 
+    p_proj = sub.add_parser("project", help="manage a jterator pipeline project")
+    proj_sub = p_proj.add_subparsers(dest="verb", required=True)
+    p_pcreate = proj_sub.add_parser("create", help="create a skeleton project")
+    p_pcreate.add_argument("--dir", required=True, help="project directory")
+    p_pcreate.add_argument("--description", default="")
+    p_padd = proj_sub.add_parser("add-module", help="append a module instance")
+    p_padd.add_argument("--dir", required=True)
+    p_padd.add_argument("--module", required=True)
+    p_padd.add_argument("--instance", default=None)
+    p_premove = proj_sub.add_parser("remove-module", help="remove a module instance")
+    p_premove.add_argument("--dir", required=True)
+    p_premove.add_argument("--instance", required=True)
+    p_pchan = proj_sub.add_parser("add-channel", help="declare an input channel")
+    p_pchan.add_argument("--dir", required=True)
+    p_pchan.add_argument("--name", required=True)
+    p_pchan.add_argument("--no-correct", action="store_true")
+    p_pchan.add_argument("--align", action="store_true")
+    p_pshow = proj_sub.add_parser("show", help="modules in pipeline order")
+    p_pshow.add_argument("--dir", required=True)
+    proj_sub.add_parser("modules", help="registered module names")
+
     for name in list_steps():
         step_cls = get_step(name)
         p_step = sub.add_parser(name, help=f"{name} step")
@@ -186,6 +207,42 @@ def cmd_tool(args) -> int:
     return 0
 
 
+def cmd_project(args) -> int:
+    from tmlibrary_tpu.jterator.project import Project
+
+    if args.verb == "modules":
+        from tmlibrary_tpu.jterator.modules import list_modules
+
+        for name in list_modules():
+            print(name)
+        return 0
+    if args.verb == "create":
+        Project.create(Path(args.dir), description=args.description)
+        print(f"created project at {args.dir}")
+        return 0
+    proj = Project(Path(args.dir))
+    if args.verb == "add-module":
+        hc = proj.add_module(args.module, instance=args.instance)
+        print(f"added '{args.module}' as "
+              f"'{args.instance or args.module}' "
+              f"({len(hc.input)} inputs, {len(hc.output)} outputs)")
+        return 0
+    if args.verb == "remove-module":
+        proj.remove_module(args.instance)
+        print(f"removed '{args.instance}'")
+        return 0
+    if args.verb == "add-channel":
+        proj.add_channel(args.name, correct=not args.no_correct, align=args.align)
+        print(f"added channel '{args.name}'")
+        return 0
+    if args.verb == "show":
+        for name in proj.module_names():
+            hc = proj.get_handles(name)
+            print(f"{name}: module={hc.module} backend={hc.backend}")
+        return 0
+    return 1
+
+
 def cmd_step(args) -> int:
     store = _open_store(args)
     step = get_step(args.command)(store)
@@ -234,6 +291,8 @@ def main(argv=None) -> int:
             return cmd_workflow(args)
         if args.command == "tool":
             return cmd_tool(args)
+        if args.command == "project":
+            return cmd_project(args)
         if args.command == "log":
             return cmd_log(args)
         return cmd_step(args)

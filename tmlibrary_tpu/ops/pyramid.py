@@ -52,6 +52,16 @@ def pyramid_levels(mosaic: jax.Array, n_levels: int | None = None) -> list[jax.A
     return levels
 
 
+def n_pyramid_levels(height: int, width: int) -> int:
+    """Level count ``pyramid_levels`` builds for an image of this size
+    (native level + halvings until it fits one tile)."""
+    n, h, w = 1, height, width
+    while max(h, w) > TILE_SIZE:
+        h, w = (h + 1) // 2, (w + 1) // 2
+        n += 1
+    return n
+
+
 def cut_tiles(level: np.ndarray) -> dict[tuple[int, int], np.ndarray]:
     """Cut one level into 256-px tiles (host-side; edge tiles zero-padded to
     full size, matching the reference's fixed tile geometry).  Keys are

@@ -32,6 +32,8 @@ import numpy as np
 from jax.experimental import mesh_utils, multihost_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tmlibrary_tpu.errors import ShardingError
+
 logger = logging.getLogger(__name__)
 
 
@@ -54,6 +56,19 @@ def initialize(
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and "JAX_PROCESS_ID" in os.environ:
         process_id = int(os.environ["JAX_PROCESS_ID"])
+    # partial configuration is a launch-script bug: silently falling back
+    # to single-host would make every pod host process (and write) ALL
+    # sites independently — fail fast instead
+    if coordinator_address and not num_processes:
+        raise ShardingError(
+            "JAX_COORDINATOR_ADDRESS is set but JAX_NUM_PROCESSES is not — "
+            "refusing to silently run single-host on a pod launch"
+        )
+    if num_processes and num_processes > 1 and not coordinator_address:
+        raise ShardingError(
+            f"JAX_NUM_PROCESSES={num_processes} but no coordinator address — "
+            "set JAX_COORDINATOR_ADDRESS or pass coordinator_address"
+        )
     if not coordinator_address or not num_processes or num_processes <= 1:
         logger.info("single-host run (no coordinator configured)")
         return False

@@ -229,8 +229,32 @@ class ImageAnalysisRunner(Step):
             df.to_parquet(out, index=False)
 
     def collect(self) -> dict:
-        """Summarize counts per object type (reference's collect phase
-        registers mapobject types and cleans up)."""
+        """Register mapobject types and summarize counts per object type
+        (reference's collect phase creates ``MapobjectType`` rows and
+        computes their polygon-zoom threshold)."""
+        from tmlibrary_tpu.models.mapobject import (
+            MapobjectType,
+            MapobjectTypeRegistry,
+            min_poly_zoom,
+        )
+        from tmlibrary_tpu.ops.pyramid import n_pyramid_levels
+
+        registry = MapobjectTypeRegistry(self.store.root)
+        # zoom levels are defined over the viewer pyramid, which illuminati
+        # builds from the full plate mosaic — use the largest plate's
+        # mosaic dimensions, not a single site's
+        exp = self.store.experiment
+        n_levels = 1
+        for plate in exp.plates:
+            spw_y = max((s.y for w in plate.wells for s in w.sites), default=0) + 1
+            spw_x = max((s.x for w in plate.wells for s in w.sites), default=0) + 1
+            rows = max((w.row for w in plate.wells), default=0) + 1
+            cols = max((w.column for w in plate.wells), default=0) + 1
+            n_levels = max(
+                n_levels,
+                n_pyramid_levels(rows * spw_y * exp.site_height,
+                                 cols * spw_x * exp.site_width),
+            )
         summary = {}
         for name in self.store.list_objects():
             try:
@@ -238,6 +262,16 @@ class ImageAnalysisRunner(Step):
                 summary[name] = int(len(feats))
             except Exception:
                 continue
+            mean_px = 0.0
+            if "area" in getattr(feats, "columns", []):
+                mean_px = float(feats["area"].mean())
+            registry.register(
+                MapobjectType(
+                    name=name,
+                    ref_type="segmented",
+                    min_poly_zoom=min_poly_zoom(n_levels, mean_px),
+                )
+            )
         return {"objects_total": summary}
 
     def delete_previous_output(self) -> None:

@@ -58,9 +58,14 @@ CELLVOYAGER_PATTERN = (
 )
 
 
+#: the well-name grammar ('B03', 'AA12'): single source of truth shared by
+#: parse_well_name and the vendor sidecar handlers' token search
+WELL_NAME_PATTERN = r"([A-Z]{1,2})(\d{1,2})"
+
+
 def parse_well_name(name: str) -> tuple[int, int]:
     """'B03' → (row=1, col=2)."""
-    m = re.fullmatch(r"([A-Z]{1,2})(\d{1,2})", name)
+    m = re.fullmatch(WELL_NAME_PATTERN, name)
     if not m:
         raise MetadataError(f"cannot parse well name '{name}'")
     letters, digits = m.groups()

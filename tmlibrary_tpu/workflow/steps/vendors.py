@@ -398,7 +398,13 @@ def parse_nd(path: Path) -> dict:
     }
 
 
-_WELL_TOKEN = re.compile(r"([A-Z]{1,2})(\d{1,2})")
+def _well_token():
+    """Compiled well-name token search, sourced from metaconfig's
+    WELL_NAME_PATTERN so the two can't drift.  Deferred import:
+    metaconfig is the module that imports this handler registry."""
+    from tmlibrary_tpu.workflow.steps.metaconfig import WELL_NAME_PATTERN
+
+    return re.compile(WELL_NAME_PATTERN)
 
 
 @register_sidecar_handler("metamorph")
@@ -431,12 +437,11 @@ def metamorph_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
         waves = info["waves"] or [None]
         stages = info["stages"] or [None]
 
-        # stage label -> (well_row, well_col, site).  Deferred import:
-        # metaconfig is the module that imports this handler registry.
         from tmlibrary_tpu.workflow.steps.metaconfig import parse_well_name
+        well_token = _well_token()
         addr: list[tuple[int, int, int]] = []
         for pos, label in enumerate(stages):
-            m = _WELL_TOKEN.search(label) if label else None
+            m = well_token.search(label) if label else None
             if m:
                 row, col = parse_well_name(m.group(0))
             else:
