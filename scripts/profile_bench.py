@@ -68,15 +68,22 @@ def main():
     timeit("  otsu threshold", scalar(v(otsu_mask)), smoothed)
     masks = jax.jit(v(otsu_mask))(smoothed)
     timeit("  fill_holes", scalar(v(lab.fill_holes)), masks)
-    timeit("  connected_components", scalar(v(lambda m: lab.connected_components(m)[0])), masks)
     filled = jax.jit(v(lab.fill_holes))(masks)
+    timeit("  connected_components(xla)",
+           scalar(v(lambda m: lab.connected_components(m, method="xla")[0])), filled)
+    timeit("  connected_components(pallas)",
+           scalar(v(lambda m: lab.connected_components(m, method="pallas")[0])), filled)
     nuclei = jax.jit(v(sp))(dapi)
 
-    sec = lambda lbl, im: watershed_from_seeds(
-        im, lbl, thr.threshold_otsu(im, correction_factor=0.8), n_levels=16
-    )
-    timeit("segment_secondary (16 lvl)", scalar(v(sec)), nuclei, actin)
-    cells = jax.jit(v(sec))(nuclei, actin)
+    def sec_method(method):
+        return lambda lbl, im: watershed_from_seeds(
+            im, lbl, thr.threshold_otsu(im, correction_factor=0.8),
+            n_levels=16, method=method,
+        )
+
+    timeit("segment_secondary (xla)", scalar(v(sec_method("xla"))), nuclei, actin)
+    timeit("segment_secondary (pallas)", scalar(v(sec_method("pallas"))), nuclei, actin)
+    cells = jax.jit(v(sec_method("xla")))(nuclei, actin)
 
     mi = lambda lbl, im: intensity_features(lbl, im, MAXOBJ)
     timeit("measure_intensity(nuclei)", scalar(v(mi)), nuclei, dapi)

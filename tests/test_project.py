@@ -94,6 +94,30 @@ def test_project_unknown_constant_rejected(tmp_path):
         proj.add_module("smooth", not_a_param=3)
 
 
+def test_project_none_default_knobs_settable(tmp_path):
+    """Optional knobs with None defaults (omitted from the template) must
+    still be settable through add_module — e.g. filter's thresholds."""
+    proj = Project.create(tmp_path / "p")
+    hc = proj.add_module("filter", label_image="nuclei", lower_threshold=100)
+    consts = hc.constants()
+    assert consts["lower_threshold"] == 100
+    saved = proj.get_handles("filter")
+    assert saved.constants()["lower_threshold"] == 100
+
+
+def test_project_add_module_requires_project(tmp_path):
+    """add_module on a missing project must not leave an orphan handles
+    file behind."""
+    proj = Project(tmp_path / "ghost")
+    (tmp_path / "ghost").mkdir()
+    with pytest.raises(PipelineDescriptionError):
+        proj.add_module("smooth")
+    assert not proj.handles_path("smooth").exists()
+    # creating the project afterwards works cleanly
+    Project.create(tmp_path / "ghost")
+    Project(tmp_path / "ghost").add_module("smooth")
+
+
 def test_project_set_active(tmp_path):
     proj = Project.create(tmp_path / "p")
     proj.add_channel("DAPI", correct=False)

@@ -147,6 +147,36 @@ def test_full_workflow_end_to_end(source_dir, store):
     assert set(feats["site_index"].unique()) == set(range(16))
 
 
+def test_illuminati_static_mapobjects(source_dir, store):
+    """The pyramid step's collect phase registers the static
+    Plates/Wells/Sites mapobject types with grid outlines (reference:
+    auto-created MapobjectType rows for the viewer overlay)."""
+    from tmlibrary_tpu.models.mapobject import MapobjectTypeRegistry
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    Workflow(store, desc).run()
+
+    step = get_step("illuminati")(store)
+    step.init({"correct": False, "align": False, "batch_size": 8})
+    for i in step.list_batches():
+        step.run(i)
+    out = step.collect()
+    assert out["static_mapobjects"] == {"Plates": 1, "Wells": 4, "Sites": 16}
+
+    reg = MapobjectTypeRegistry(store.root)
+    assert {"Plates", "Wells", "Sites"} <= set(reg.names())
+    assert reg.get("Wells").ref_type == "static"
+    import pandas as pd
+
+    wells = pd.read_parquet(store.root / "segmentations" /
+                            "Wells_polygons_plate00.parquet")
+    assert len(wells) == 4
+    assert {"name", "contour_y", "contour_x"} <= set(wells.columns)
+    # pyramid tiles exist too
+    assert (store.root / "pyramids" / "channel00" / "layer.json").exists()
+
+
 def test_workflow_resume_skips_completed(source_dir, store):
     desc = make_description(source_dir, store)
     wf = Workflow(store, desc)

@@ -108,11 +108,26 @@ def _plate_grid(exp: Experiment, plate_name: str) -> tuple[int, int, int, int]:
     plate = next((p for p in exp.plates if p.name == plate_name), None)
     if plate is None:
         raise MetadataError(f"no plate named '{plate_name}'")
-    n_rows = max(w.row for w in plate.wells) + 1
-    n_cols = max(w.column for w in plate.wells) + 1
+    n_rows = max((w.row for w in plate.wells), default=0) + 1
+    n_cols = max((w.column for w in plate.wells), default=0) + 1
     sy = max((s.y for w in plate.wells for s in w.sites), default=0) + 1
     sx = max((s.x for w in plate.wells for s in w.sites), default=0) + 1
     return n_rows, n_cols, sy, sx
+
+
+def plate_mosaic_shape(
+    exp: Experiment, plate_name: str, well_spacing: int = 0
+) -> tuple[int, int]:
+    """(height, width) in pixels of one plate's stitched mosaic — the
+    single source of truth shared by illuminati's stitching and the
+    pyramid-depth computation."""
+    n_rows, n_cols, sy, sx = _plate_grid(exp, plate_name)
+    wh = sy * exp.site_height
+    ww = sx * exp.site_width
+    return (
+        n_rows * wh + (n_rows - 1) * well_spacing,
+        n_cols * ww + (n_cols - 1) * well_spacing,
+    )
 
 
 def _rect(y0: int, x0: int, y1: int, x1: int) -> np.ndarray:

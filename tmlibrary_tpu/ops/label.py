@@ -91,44 +91,59 @@ def _run_min_scan(labels: jax.Array, mask: jax.Array, axis: int) -> jax.Array:
 
 
 def connected_components(
-    mask: jax.Array, connectivity: int = 8
+    mask: jax.Array, connectivity: int = 8, method: str = "auto"
 ) -> tuple[jax.Array, jax.Array]:
     """Label connected foreground components.
 
     Returns ``(labels, count)``: int32 label image (0 = background, 1..N in
     scipy scan order) and the scalar component count.
 
-    Algorithm: iterate {8/4-neighbor min propagation, row run-scan, column
-    run-scan} to a fixed point.  The run scans move labels across entire
-    straight runs per iteration, so convergence is ~O(number of "turns" of
-    the most serpentine component) — a handful of iterations for blob-like
-    microscopy objects — with no per-pixel gathers.
+    ``method``: ``"xla"`` iterates {8/4-neighbor min propagation, row
+    run-scan, column run-scan} to a fixed point — the run scans move labels
+    across entire straight runs per iteration, so convergence is ~O(turns
+    of the most serpentine component) with no per-pixel gathers.
+    ``"pallas"`` runs the same fixpoint entirely in VMEM
+    (:func:`~tmlibrary_tpu.ops.pallas_kernels.cc_min_propagate`) — O(1)
+    HBM traffic.  ``"auto"`` picks pallas on TPU backends when
+    ``TMX_PALLAS=1`` is set (see ``pallas_kernels.pallas_enabled``), XLA
+    otherwise.  Both converge to the identical min-linear-index labeling.
     """
     mask = jnp.asarray(mask, bool)
     h, w = mask.shape
-    if connectivity == 4:
-        # row+col run scans fully cover 4-neighbor propagation
-        shifts = []
-    elif connectivity == 8:
-        shifts = [(-1, -1), (-1, 1), (1, -1), (1, 1)]
-    else:
+    if connectivity not in (4, 8):
         raise ValueError("connectivity must be 4 or 8")
     linear = jnp.arange(h * w, dtype=jnp.int32).reshape(h, w)
-    init = jnp.where(mask, linear, _BIG)
 
-    def cond(state):
-        labels, prev_changed = state
-        return prev_changed
+    if method == "auto":
+        from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
 
-    def body(state):
-        labels, _ = state
-        new = _propagate_min(labels, mask, shifts) if shifts else labels
-        new = _run_min_scan(new, mask, axis=1)
-        new = _run_min_scan(new, mask, axis=0)
-        changed = jnp.any(new != labels)
-        return new, changed
+        method = "pallas" if pallas_enabled() else "xla"
+    if method == "pallas":
+        from tmlibrary_tpu.ops.pallas_kernels import cc_min_propagate
 
-    labels, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
+        # interpret mode keeps the pallas path testable off-TPU
+        labels = cc_min_propagate(
+            mask, connectivity, interpret=jax.default_backend() == "cpu"
+        )
+        labels = jnp.where(mask, labels, _BIG)
+    else:
+        # row+col run scans fully cover 4-neighbor propagation
+        shifts = [] if connectivity == 4 else [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+        init = jnp.where(mask, linear, _BIG)
+
+        def cond(state):
+            labels, prev_changed = state
+            return prev_changed
+
+        def body(state):
+            labels, _ = state
+            new = _propagate_min(labels, mask, shifts) if shifts else labels
+            new = _run_min_scan(new, mask, axis=1)
+            new = _run_min_scan(new, mask, axis=0)
+            changed = jnp.any(new != labels)
+            return new, changed
+
+        labels, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
 
     # compact to 1..N in row-major order of component roots (scipy order)
     is_root = mask & (labels == linear)

@@ -1,0 +1,222 @@
+"""Pallas TPU kernels for the iterative label-propagation hot loops.
+
+Reference parity: the pixel math these kernels accelerate is the
+reference's mahotas/scipy connected-components labeling
+(``jtmodules/label.py``, ``segment_primary``) and CellProfiler-style
+watershed propagation (``jtmodules/segment_secondary.py``).
+
+Why Pallas (SURVEY.md §8 hard part #1): the XLA implementations in
+:mod:`tmlibrary_tpu.ops.label` / :mod:`~tmlibrary_tpu.ops.segment_secondary`
+run a ``lax.while_loop`` whose carried label image round-trips HBM every
+iteration (plus associative-scan passes).  A site image is tiny relative to
+VMEM (256×256 int32 = 256 KB vs ~16 MB), so these kernels load the image
+ONCE, iterate the neighbor-propagation fixpoint entirely in VMEM on the
+VPU, and write the converged result — O(1) HBM traffic instead of
+O(iterations).
+
+Semantics are bit-identical to the XLA twins (asserted by
+``tests/test_pallas_kernels.py``):
+
+- :func:`cc_min_propagate`: every foreground pixel converges to the
+  minimum linear index of its 8/4-connected component (the same fixpoint
+  ``ops.label.connected_components`` reaches; compaction to scipy label
+  order stays in XLA).
+- :func:`watershed_flood`: level-ordered flooding of seed labels through a
+  mask with 8-neighbor max-label adoption — the same schedule as
+  ``ops.segment_secondary.watershed_from_seeds``.
+
+Convergence checks run every ``CHUNK`` propagation steps so the scalar
+reduction doesn't serialize each cheap VPU pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: sentinel for "no label yet" in min-propagation; small enough that
+#: int32 arithmetic can never overflow around it (plain int so kernels
+#: don't close over a traced constant)
+BIG = 2**30
+
+#: propagation steps between convergence checks
+CHUNK = 8
+
+
+def _shift_fill(a: jax.Array, dy: int, dx: int, fill, h: int, w: int) -> jax.Array:
+    """``out[y, x] = a[y + dy, x + dx]`` with ``fill`` at exposed borders,
+    built from circular rolls + iota border masks (pallas-friendly: no
+    pads, no gathers)."""
+    out = a
+    if dy:
+        # pltpu.roll wants non-negative shifts: roll by (-dy) mod h
+        out = pltpu.roll(out, shift=(-dy) % h, axis=0)
+        rows = lax.broadcasted_iota(jnp.int32, (h, w), 0)
+        border = rows == (h - 1 if dy > 0 else 0)
+        out = jnp.where(border, fill, out)
+    if dx:
+        out = pltpu.roll(out, shift=(-dx) % w, axis=1)
+        cols = lax.broadcasted_iota(jnp.int32, (h, w), 1)
+        border = cols == (w - 1 if dx > 0 else 0)
+        out = jnp.where(border, fill, out)
+    return out
+
+
+def _shifts_for(connectivity: int) -> list[tuple[int, int]]:
+    if connectivity == 4:
+        return [(-1, 0), (1, 0), (0, -1), (0, 1)]
+    if connectivity == 8:
+        return [
+            (-1, -1), (-1, 0), (-1, 1),
+            (0, -1), (0, 1),
+            (1, -1), (1, 0), (1, 1),
+        ]
+    raise ValueError("connectivity must be 4 or 8")
+
+
+# ----------------------------------------------------------- CC min-propagate
+def _cc_kernel(mask_ref, out_ref, *, connectivity: int):
+    h, w = out_ref.shape
+    mask = mask_ref[:] != 0
+    shifts = _shifts_for(connectivity)
+
+    rows = lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    linear = rows * w + cols
+    labels = jnp.where(mask, linear, BIG)
+
+    def step(lab):
+        new = lab
+        for dy, dx in shifts:
+            new = jnp.minimum(new, _shift_fill(lab, dy, dx, BIG, h, w))
+        return jnp.where(mask, new, BIG)
+
+    def body(state):
+        lab, _ = state
+        new = lab
+        for _ in range(CHUNK):
+            new = step(new)
+        return new, jnp.any(new != lab)
+
+    def cond(state):
+        return state[1]
+
+    labels, _ = lax.while_loop(cond, body, (labels, jnp.bool_(True)))
+    out_ref[:] = labels
+
+
+@functools.partial(jax.jit, static_argnames=("connectivity", "interpret"))
+def cc_min_propagate(
+    mask: jax.Array, connectivity: int = 8, interpret: bool = False
+) -> jax.Array:
+    """Converged min-linear-index labels for one (H, W) bool mask.
+
+    Background pixels hold ``BIG``.  Identical fixpoint to the XLA path in
+    ``ops.label.connected_components`` (which then compacts to scipy
+    order).
+    """
+    h, w = mask.shape
+    return pl.pallas_call(
+        functools.partial(_cc_kernel, connectivity=connectivity),
+        out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(jnp.asarray(mask, jnp.int32))
+
+
+# -------------------------------------------------------------- watershed
+def _watershed_kernel(intensity_ref, seeds_ref, mask_ref, out_ref,
+                      *, n_levels: int, connectivity: int):
+    h, w = out_ref.shape
+    intensity = intensity_ref[:]
+    seeds = seeds_ref[:]
+    mask = (mask_ref[:] != 0) | (seeds > 0)
+    shifts = _shifts_for(connectivity)
+
+    neg_inf = jnp.float32(-3.4e38)
+    pos_inf = jnp.float32(3.4e38)
+    lo = jnp.min(jnp.where(mask, intensity, pos_inf))
+    hi = jnp.max(jnp.where(mask, intensity, neg_inf))
+    span = jnp.maximum(hi - lo, 1e-6)
+
+    def adopt(lab, allowed):
+        neigh_max = jnp.zeros_like(lab)
+        for dy, dx in shifts:
+            neigh_max = jnp.maximum(neigh_max, _shift_fill(lab, dy, dx, 0, h, w))
+        return jnp.where((lab == 0) & allowed, neigh_max, lab)
+
+    def flood(labels, allowed):
+        def body(state):
+            lab, _ = state
+            new = lab
+            for _ in range(CHUNK):
+                new = adopt(new, allowed)
+            return new, jnp.any(new != lab)
+
+        out, _ = lax.while_loop(lambda s: s[1], body, (labels, jnp.bool_(True)))
+        return out
+
+    def level_body(i, labels):
+        level = hi - span * (i + 1).astype(jnp.float32) / n_levels
+        allowed = mask & (intensity >= level)
+        return flood(labels, allowed)
+
+    labels = lax.fori_loop(0, n_levels, level_body, seeds)
+    labels = flood(labels, mask)  # mop up below the lowest level
+    out_ref[:] = jnp.where(mask, labels, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_levels", "connectivity", "interpret")
+)
+def watershed_flood(
+    intensity: jax.Array,
+    seeds: jax.Array,
+    mask: jax.Array,
+    n_levels: int = 32,
+    connectivity: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Level-ordered watershed flooding of one (H, W) site, all in VMEM.
+
+    Same schedule and tie-breaking as
+    ``ops.segment_secondary.watershed_from_seeds``.
+    """
+    h, w = intensity.shape
+    return pl.pallas_call(
+        functools.partial(
+            _watershed_kernel, n_levels=n_levels, connectivity=connectivity
+        ),
+        out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(
+        jnp.asarray(intensity, jnp.float32),
+        jnp.asarray(seeds, jnp.int32),
+        jnp.asarray(mask, jnp.int32),
+    )
+
+
+# ------------------------------------------------------------------ dispatch
+def pallas_enabled() -> bool:
+    """Whether ``method="auto"`` dispatches to the pallas kernels.
+
+    Opt-in via ``TMX_PALLAS=1`` on TPU-class backends (the XLA twins are
+    the portable path and the golden reference); CPU/GPU always use XLA.
+    """
+    import os
+
+    if jax.default_backend() in ("cpu", "gpu"):
+        return False
+    return os.environ.get("TMX_PALLAS", "0") not in ("0", "false", "no")

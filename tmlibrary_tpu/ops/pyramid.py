@@ -41,11 +41,7 @@ def pyramid_levels(mosaic: jax.Array, n_levels: int | None = None) -> list[jax.A
     until the image fits in a single tile."""
     levels = [jnp.asarray(mosaic, jnp.float32)]
     if n_levels is None:
-        n_levels = 1
-        h, w = mosaic.shape
-        while max(h, w) > TILE_SIZE:
-            h, w = (h + 1) // 2, (w + 1) // 2
-            n_levels += 1
+        n_levels = n_pyramid_levels(*mosaic.shape)
     fn = jax.jit(downsample_2x)
     for _ in range(n_levels - 1):
         levels.append(fn(levels[-1]))

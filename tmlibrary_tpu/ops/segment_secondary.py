@@ -71,6 +71,7 @@ def watershed_from_seeds(
     mask: jax.Array,
     n_levels: int = 32,
     connectivity: int = 8,
+    method: str = "auto",
 ) -> jax.Array:
     """Level-ordered flooding of ``seeds`` through ``mask``.
 
@@ -78,7 +79,24 @@ def watershed_from_seeds(
     fall along intensity valleys — the watershed behavior the reference gets
     from CellProfiler's ``propagate``.  Seed pixels always keep their label.
     Returns int32 labels covering ``mask`` wherever a seed can reach it.
+
+    ``method="pallas"`` runs the whole level loop in VMEM
+    (:func:`~tmlibrary_tpu.ops.pallas_kernels.watershed_flood`);
+    ``"auto"`` picks pallas on TPU backends when ``TMX_PALLAS=1`` is set
+    (see ``pallas_kernels.pallas_enabled``), otherwise the portable XLA
+    twin below.  Identical schedule and tie-breaking either way.
     """
+    if method == "auto":
+        from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+
+        method = "pallas" if pallas_enabled() else "xla"
+    if method == "pallas":
+        from tmlibrary_tpu.ops.pallas_kernels import watershed_flood
+
+        return watershed_flood(
+            intensity, seeds, mask, n_levels=n_levels, connectivity=connectivity,
+            interpret=jax.default_backend() == "cpu",
+        )
     intensity = jnp.asarray(intensity, jnp.float32)
     seeds = jnp.asarray(seeds, jnp.int32)
     mask = jnp.asarray(mask, bool) | (seeds > 0)

@@ -145,6 +145,48 @@ class PyramidBuilder(Step):
             "n_tiles": n_tiles,
         }
 
+    def collect(self) -> dict:
+        """Register the static Plates/Wells/Sites mapobject types with their
+        grid outlines (reference: the static ``MapobjectType`` rows created
+        alongside the pyramid so the viewer can overlay plate geometry)."""
+        import pandas as pd
+
+        from tmlibrary_tpu.models.mapobject import (
+            MapobjectType,
+            MapobjectTypeRegistry,
+            static_mapobjects,
+        )
+
+        registry = MapobjectTypeRegistry(self.store.root)
+        out_dir = self.store.root / "segmentations"
+        out_dir.mkdir(exist_ok=True)
+        counts: dict[str, int] = {}
+        for plate in self.store.experiment.plates:
+            geo = static_mapobjects(self.store.experiment, plate.name)
+            for type_name, outlines in geo.items():
+                rows = [
+                    {
+                        "plate": plate.name,
+                        "name": label,
+                        "centroid_y": float(rect[:-1, 0].mean()),
+                        "centroid_x": float(rect[:-1, 1].mean()),
+                        "contour_y": rect[:, 0].tolist(),
+                        "contour_x": rect[:, 1].tolist(),
+                    }
+                    for label, rect in outlines
+                ]
+                df = pd.DataFrame(rows)
+                df.to_parquet(
+                    out_dir / f"{type_name}_polygons_{plate.name}.parquet",
+                    index=False,
+                )
+                counts[type_name] = counts.get(type_name, 0) + len(rows)
+        for type_name in counts:
+            registry.register(
+                MapobjectType(name=type_name, ref_type="static", min_poly_zoom=0)
+            )
+        return {"static_mapobjects": counts}
+
     def delete_previous_output(self) -> None:
         import shutil
 

@@ -236,6 +236,7 @@ class ImageAnalysisRunner(Step):
             MapobjectType,
             MapobjectTypeRegistry,
             min_poly_zoom,
+            plate_mosaic_shape,
         )
         from tmlibrary_tpu.ops.pyramid import n_pyramid_levels
 
@@ -246,14 +247,8 @@ class ImageAnalysisRunner(Step):
         exp = self.store.experiment
         n_levels = 1
         for plate in exp.plates:
-            spw_y = max((s.y for w in plate.wells for s in w.sites), default=0) + 1
-            spw_x = max((s.x for w in plate.wells for s in w.sites), default=0) + 1
-            rows = max((w.row for w in plate.wells), default=0) + 1
-            cols = max((w.column for w in plate.wells), default=0) + 1
             n_levels = max(
-                n_levels,
-                n_pyramid_levels(rows * spw_y * exp.site_height,
-                                 cols * spw_x * exp.site_width),
+                n_levels, n_pyramid_levels(*plate_mosaic_shape(exp, plate.name))
             )
         summary = {}
         for name in self.store.list_objects():
@@ -263,8 +258,14 @@ class ImageAnalysisRunner(Step):
             except Exception:
                 continue
             mean_px = 0.0
-            if "area" in getattr(feats, "columns", []):
-                mean_px = float(feats["area"].mean())
+            cols = getattr(feats, "columns", [])
+            # measure_morphology emits 'Morphology_area'; accept a bare
+            # 'area' too for externally-written feature tables
+            area_col = next(
+                (c for c in ("Morphology_area", "area") if c in cols), None
+            )
+            if area_col is not None:
+                mean_px = float(feats[area_col].mean())
             registry.register(
                 MapobjectType(
                     name=name,
