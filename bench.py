@@ -34,9 +34,24 @@ def main() -> None:
     size = int(os.environ.get("BENCH_SITE_SIZE", "256"))
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
+    config = os.environ.get("BENCH_CONFIG", "3")  # BASELINE.md milestone ladder
 
-    data = synthetic_cell_painting_batch(batch, size=size)
-    pipe = ImageAnalysisPipeline(cell_painting_description(), max_objects=max_objects)
+    if config == "4":
+        from tmlibrary_tpu.benchmarks import (
+            full_feature_description,
+            synthetic_full_stack_batch,
+        )
+
+        data = synthetic_full_stack_batch(batch, size=size)
+        desc = full_feature_description()
+        metric = "jterator_full_stack_sites_per_sec_per_chip"
+        unit = f"sites/sec ({size}x{size}, 5ch, segment+all-features)"
+    else:
+        data = synthetic_cell_painting_batch(batch, size=size)
+        desc = cell_painting_description()
+        metric = "jterator_cell_painting_sites_per_sec_per_chip"
+        unit = f"sites/sec ({size}x{size}, 2ch, segment+measure)"
+    pipe = ImageAnalysisPipeline(desc, max_objects=max_objects)
     fn = pipe.build_batch_fn()
 
     raw = {k: jnp.asarray(v) for k, v in data.items()}
@@ -58,20 +73,26 @@ def main() -> None:
         best = min(best, time.perf_counter() - t0)
     tpu_sites_per_sec = batch / best
 
-    # single-CPU denominator: same pipeline in scipy/numpy, single thread
+    # single-CPU denominator: the SAME workload in scipy/numpy, single thread
     n_cpu = min(4, batch)
     t0 = time.perf_counter()
-    for s in range(n_cpu):
-        cpu_reference_site(data["DAPI"][s], data["Actin"][s])
+    if config == "4":
+        from tmlibrary_tpu.benchmarks import cpu_reference_site_full
+
+        for s in range(n_cpu):
+            cpu_reference_site_full({ch: v[s] for ch, v in data.items()})
+    else:
+        for s in range(n_cpu):
+            cpu_reference_site(data["DAPI"][s], data["Actin"][s])
     cpu_elapsed = time.perf_counter() - t0
     cpu_sites_per_sec = n_cpu / cpu_elapsed
 
     print(
         json.dumps(
             {
-                "metric": "jterator_cell_painting_sites_per_sec_per_chip",
+                "metric": metric,
                 "value": round(tpu_sites_per_sec, 2),
-                "unit": f"sites/sec ({size}x{size}, 2ch, segment+measure)",
+                "unit": unit,
                 "vs_baseline": round(tpu_sites_per_sec / cpu_sites_per_sec, 2),
             }
         )

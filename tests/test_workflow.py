@@ -166,7 +166,7 @@ def test_illuminati_static_mapobjects(source_dir, store):
 
     reg = MapobjectTypeRegistry(store.root)
     assert {"Plates", "Wells", "Sites"} <= set(reg.names())
-    assert reg.get("Wells").ref_type == "static"
+    assert reg.get("Wells").ref_type == "well"
     import pandas as pd
 
     wells = pd.read_parquet(store.root / "segmentations" /

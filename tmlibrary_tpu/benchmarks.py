@@ -117,6 +117,173 @@ def cell_painting_description() -> PipelineDescription:
     return PipelineDescription.from_dict(CELL_PAINTING_PIPE)
 
 
+#: the five canonical Cell Painting stains (BASELINE.json config 4)
+FULL_STACK_CHANNELS = ("DAPI", "Actin", "Tubulin", "ER", "Mito")
+
+
+def full_feature_description(
+    channels: tuple[str, ...] = FULL_STACK_CHANNELS,
+    texture_levels: int = 16,
+    zernike_degree: int = 6,
+) -> PipelineDescription:
+    """BASELINE.json config 4: the full feature stack — nuclei + cells
+    segmentation, then measure_intensity on every channel for both object
+    types, measure_morphology on both, Haralick texture and Zernike
+    moments.  5-channel 384-well plate is the target geometry; channel
+    count is configurable for tests."""
+    nucleus_ch, cell_ch = channels[0], channels[1]
+
+    def _measure(module, inputs, objects, channel=None):
+        out = {"name": "measurements", "type": "Measurement", "objects": objects}
+        if channel:
+            out["channel"] = channel
+        return {"handles": {"module": module, "input": inputs, "output": [out]}}
+
+    pipeline = [
+        {
+            "handles": {
+                "module": "smooth",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage",
+                     "key": nucleus_ch},
+                    {"name": "sigma", "type": "Numeric", "value": 1.5},
+                ],
+                "output": [
+                    {"name": "smoothed_image", "type": "IntensityImage",
+                     "key": "nuc_sm"}
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "segment_primary",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage",
+                     "key": "nuc_sm"},
+                    {"name": "threshold_method", "type": "Character",
+                     "value": "otsu"},
+                    {"name": "smooth_sigma", "type": "Numeric", "value": 0.0},
+                    {"name": "min_area", "type": "Numeric", "value": 20},
+                ],
+                "output": [
+                    {"name": "objects", "type": "SegmentedObjects",
+                     "key": "nuclei", "objects": "nuclei"}
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "segment_secondary",
+                "input": [
+                    {"name": "primary_label_image", "type": "LabelImage",
+                     "key": "nuclei"},
+                    {"name": "intensity_image", "type": "IntensityImage",
+                     "key": cell_ch},
+                    {"name": "correction_factor", "type": "Numeric", "value": 0.8},
+                    {"name": "n_levels", "type": "Numeric", "value": 16},
+                ],
+                "output": [
+                    {"name": "objects", "type": "SegmentedObjects",
+                     "key": "cells", "objects": "cells"}
+                ],
+            }
+        },
+    ]
+    # intensity on every channel for both object types
+    for objects in ("nuclei", "cells"):
+        for ch in channels:
+            pipeline.append(
+                _measure(
+                    "measure_intensity",
+                    [
+                        {"name": "objects_image", "type": "LabelImage",
+                         "key": objects},
+                        {"name": "intensity_image", "type": "IntensityImage",
+                         "key": ch},
+                    ],
+                    objects,
+                    channel=ch,
+                )
+            )
+    # morphology on both object types
+    for objects in ("nuclei", "cells"):
+        pipeline.append(
+            _measure(
+                "measure_morphology",
+                [{"name": "objects_image", "type": "LabelImage", "key": objects}],
+                objects,
+            )
+        )
+    # Haralick texture: cells on the cytoskeleton channel
+    pipeline.append(
+        _measure(
+            "measure_texture",
+            [
+                {"name": "objects_image", "type": "LabelImage", "key": "cells"},
+                {"name": "intensity_image", "type": "IntensityImage",
+                 "key": cell_ch},
+                {"name": "levels", "type": "Numeric", "value": texture_levels},
+            ],
+            "cells",
+            channel=cell_ch,
+        )
+    )
+    # Zernike moments: nuclei shape
+    pipeline.append(
+        _measure(
+            "measure_zernike",
+            [
+                {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+                {"name": "degree", "type": "Numeric", "value": zernike_degree},
+            ],
+            "nuclei",
+        )
+    )
+    return PipelineDescription.from_dict(
+        {
+            "description": "Cell Painting full feature stack (config 4)",
+            "input": {
+                "channels": [
+                    {"name": ch, "correct": False, "align": False}
+                    for ch in channels
+                ]
+            },
+            "pipeline": pipeline,
+            "output": {"objects": [{"name": "nuclei"}, {"name": "cells"}]},
+        }
+    )
+
+
+def synthetic_full_stack_batch(
+    n_sites: int,
+    size: int = 256,
+    n_cells: int = 12,
+    channels: tuple[str, ...] = FULL_STACK_CHANNELS,
+    seed: int = 0,
+) -> dict[str, np.ndarray]:
+    """Synthetic multi-channel Cell Painting batch: nuclei in channel 0,
+    cell bodies in every other channel (varying radius/brightness)."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    out = {
+        ch: rng.normal(300.0, 25.0, (n_sites, size, size)).astype(np.float32)
+        for ch in channels
+    }
+    margin = size // 10
+    for s in range(n_sites):
+        ys = rng.integers(margin, size - margin, n_cells)
+        xs = rng.integers(margin, size - margin, n_cells)
+        for y, x in zip(ys, xs):
+            r_n = rng.uniform(3.5, 5.5)
+            d2 = (yy - y) ** 2 + (xx - x) ** 2
+            out[channels[0]][s] += 4000.0 * np.exp(-d2 / (2 * r_n**2))
+            for k, ch in enumerate(channels[1:]):
+                r_c = r_n * rng.uniform(1.8, 3.0)
+                amp = rng.uniform(900.0, 1800.0)
+                out[ch][s] += amp * np.exp(-d2 / (2 * r_c**2))
+    return {ch: np.clip(v, 0, 65535) for ch, v in out.items()}
+
+
 def synthetic_cell_painting_batch(
     n_sites: int, size: int = 256, n_cells: int = 12, seed: int = 0
 ) -> dict[str, np.ndarray]:
@@ -156,6 +323,117 @@ def _otsu_numpy(img: np.ndarray, bins: int = 256) -> float:
     mu1 = (sum0[-1] - sum0) / np.maximum(w1, 1e-12)
     between = np.where((w0 > 0) & (w1 > 0), w0 * w1 * (mu0 - mu1) ** 2, -1.0)
     return float(centers[int(np.argmax(between))])
+
+
+def _zernike_numpy(mask: np.ndarray, degree: int = 6, patch: int = 64) -> np.ndarray:
+    """Independent numpy Zernike magnitudes of one object mask (reference:
+    mahotas ``zernike_moments``) — used only as the single-CPU throughput
+    denominator for config 4."""
+    from math import factorial
+
+    ys, xs = np.nonzero(mask)
+    if len(ys) == 0:
+        return np.zeros(1)
+    cy, cx = ys.mean(), xs.mean()
+    r = max(np.sqrt(((ys - cy) ** 2 + (xs - cx) ** 2)).max(), 1.0)
+    rho = np.sqrt((ys - cy) ** 2 + (xs - cx) ** 2) / r
+    theta = np.arctan2(ys - cy, xs - cx)
+    vals = []
+    for n in range(degree + 1):
+        for m in range(0, n + 1):
+            if (n - m) % 2:
+                continue
+            rad = np.zeros_like(rho)
+            for k in range((n - m) // 2 + 1):
+                c = ((-1) ** k * factorial(n - k)) / (
+                    factorial(k)
+                    * factorial((n + m) // 2 - k)
+                    * factorial((n - m) // 2 - k)
+                )
+                rad += c * rho ** (n - 2 * k)
+            z = (rad * np.exp(-1j * m * theta)).sum() * (n + 1) / np.pi
+            vals.append(np.abs(z))
+    return np.asarray(vals)
+
+
+def _haralick_numpy(img: np.ndarray, mask: np.ndarray, levels: int = 16) -> np.ndarray:
+    """Independent numpy GLCM Haralick summary of one object (reference:
+    mahotas ``haralick``) — throughput denominator only."""
+    lo, hi = img.min(), img.max()
+    q = np.clip(((img - lo) / max(hi - lo, 1e-6) * levels).astype(np.int32),
+                0, levels - 1)
+    feats = []
+    for dy, dx in ((0, 1), (1, 0), (1, 1), (1, -1)):
+        h, w = q.shape
+        y0, x0 = max(0, -dy), max(0, -dx)
+        y1, x1 = min(h, h - dy), min(w, w - dx)
+        src = q[y0:y1, x0:x1]
+        dst = q[y0 + dy:y1 + dy, x0 + dx:x1 + dx]
+        m = mask[y0:y1, x0:x1] & mask[y0 + dy:y1 + dy, x0 + dx:x1 + dx]
+        pairs = src[m] * levels + dst[m]
+        glcm = np.bincount(pairs, minlength=levels * levels).astype(np.float64)
+        glcm = glcm.reshape(levels, levels)
+        glcm = glcm + glcm.T
+        total = max(glcm.sum(), 1.0)
+        p = glcm / total
+        i_idx, j_idx = np.mgrid[0:levels, 0:levels]
+        contrast = (p * (i_idx - j_idx) ** 2).sum()
+        energy = (p ** 2).sum()
+        homogeneity = (p / (1.0 + np.abs(i_idx - j_idx))).sum()
+        entropy = -(p[p > 0] * np.log(p[p > 0])).sum()
+        feats.extend([contrast, energy, homogeneity, entropy])
+    return np.asarray(feats)
+
+
+def cpu_reference_site_full(
+    channels: dict[str, np.ndarray], texture_levels: int = 16,
+    zernike_degree: int = 6,
+) -> tuple[int, int]:
+    """Single-threaded scipy/numpy implementation of the config-4 full
+    feature stack (segment nuclei+cells, intensity on every channel for
+    both object types, morphology, Haralick texture, Zernike) — the
+    honest single-CPU denominator for ``BENCH_CONFIG=4``."""
+    import scipy.ndimage as ndi
+
+    names = list(channels)
+    dapi, cell_ch = channels[names[0]], channels[names[1]]
+    n_nuclei, _ = cpu_reference_site(dapi, cell_ch)
+
+    sm = ndi.gaussian_filter(dapi.astype(np.float32), 1.5, mode="reflect")
+    mask = ndi.binary_fill_holes(sm > _otsu_numpy(sm))
+    labels, _ = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    t2 = _otsu_numpy(cell_ch) * 0.8
+    dist, (iy, ix) = ndi.distance_transform_edt(labels == 0, return_indices=True)
+    cells = np.where(cell_ch > t2, labels[iy, ix], 0)
+
+    for lab_img in (labels, cells):
+        ids = np.unique(lab_img)[1:]
+        if not len(ids):
+            continue
+        # intensity on every channel
+        for img in channels.values():
+            ndi.mean(img, lab_img, ids)
+            ndi.standard_deviation(img, lab_img, ids)
+            ndi.maximum(img, lab_img, ids)
+            ndi.minimum(img, lab_img, ids)
+            ndi.sum(img, lab_img, ids)
+        # morphology
+        ndi.center_of_mass(lab_img > 0, lab_img, ids)
+        slices = ndi.find_objects(lab_img)
+        np.bincount(lab_img.ravel())
+        eroded = ndi.binary_erosion(lab_img > 0)
+        ((lab_img > 0) & ~eroded).sum()
+        # texture + zernike per object
+        for lab in ids:
+            sl = slices[lab - 1]
+            if sl is None:
+                continue
+            obj_mask = lab_img[sl] == lab
+            if lab_img is cells:
+                _haralick_numpy(cell_ch[sl], obj_mask, texture_levels)
+            else:
+                _zernike_numpy(obj_mask, zernike_degree)
+    return n_nuclei, len(np.unique(cells)) - 1
 
 
 def cpu_reference_site(dapi: np.ndarray, actin: np.ndarray) -> tuple[int, int]:

@@ -102,9 +102,15 @@ class MapobjectTypeRegistry:
         self._write(d)
 
 
+#: plural static type name → the singular ``ref_type`` recorded on it
+STATIC_REF_TYPES = {"Plates": "plate", "Wells": "well", "Sites": "site"}
+
+
 # ------------------------------------------------------------- static geometry
-def _plate_grid(exp: Experiment, plate_name: str) -> tuple[int, int, int, int]:
-    """(n_well_rows, n_well_cols, sites_y, sites_x) for one plate."""
+def plate_grid(exp: Experiment, plate_name: str) -> tuple[int, int, int, int]:
+    """(n_well_rows, n_well_cols, sites_y, sites_x) for one plate — the
+    single source of truth for plate-grid geometry, shared by illuminati's
+    stitching, the static outlines and the pyramid-depth computation."""
     plate = next((p for p in exp.plates if p.name == plate_name), None)
     if plate is None:
         raise MetadataError(f"no plate named '{plate_name}'")
@@ -121,7 +127,7 @@ def plate_mosaic_shape(
     """(height, width) in pixels of one plate's stitched mosaic — the
     single source of truth shared by illuminati's stitching and the
     pyramid-depth computation."""
-    n_rows, n_cols, sy, sx = _plate_grid(exp, plate_name)
+    n_rows, n_cols, sy, sx = plate_grid(exp, plate_name)
     wh = sy * exp.site_height
     ww = sx * exp.site_width
     return (
@@ -149,7 +155,7 @@ def static_mapobjects(
     illuminati's mosaic layout option.  Returns
     ``{"Plates"|"Wells"|"Sites": [(label, (5, 2) outline), ...]}``.
     """
-    n_rows, n_cols, sy, sx = _plate_grid(exp, plate_name)
+    n_rows, n_cols, sy, sx = plate_grid(exp, plate_name)
     wh = sy * exp.site_height  # well height in px
     ww = sx * exp.site_width
     out: dict[str, list[tuple[str, np.ndarray]]] = {

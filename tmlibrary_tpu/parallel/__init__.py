@@ -12,17 +12,25 @@ port.  The TPU-native equivalent is:
   reference did by writing per-job results into the DB and merging in a
   collect phase (``stats.py``: corilla's cross-device Welford merge);
 - ``jax.distributed`` multi-host init for pod scale (``distributed.py``:
-  bootstrap, DCN/ICI hybrid pod meshes, per-host data-plane slices).
+  bootstrap, DCN/ICI hybrid pod meshes, per-host data-plane slices);
+- halo exchange for spatially-sharded mosaics (``halo.py``) and
+  all-to-all resharding between the site-parallel and spatial layouts
+  (``reshard.py``) — the sequence-parallelism analogues (SURVEY.md §6).
 """
 
 from tmlibrary_tpu.parallel.distributed import initialize, pod_mesh
+from tmlibrary_tpu.parallel.halo import sharded_halo_map
 from tmlibrary_tpu.parallel.mesh import site_mesh, shard_batch
+from tmlibrary_tpu.parallel.reshard import rows_to_sites, sites_to_rows
 from tmlibrary_tpu.parallel.stats import sharded_channel_stats
 
 __all__ = [
     "site_mesh",
     "shard_batch",
     "sharded_channel_stats",
+    "sharded_halo_map",
+    "sites_to_rows",
+    "rows_to_sites",
     "initialize",
     "pod_mesh",
 ]

@@ -1,0 +1,78 @@
+"""All-to-all resharding between the site axis and the spatial axis.
+
+SURVEY.md §6 ("long-context"): the two shardings this framework uses are
+**site-parallel** (each device owns whole sites — the jterator hot path)
+and **spatial** (each device owns a row band of one huge image — the
+mosaic/halo path in :mod:`tmlibrary_tpu.parallel.halo`).  Moving a batch
+between them is a transpose across the mesh, exactly the sequence-parallel
+"all-to-all" that long-context trainers use to switch between
+head-parallel and sequence-parallel layouts; on TPU it lowers to one ICI
+``all_to_all`` collective instead of a host gather/scatter round trip.
+
+Layout contract: with ``n`` devices, ``sites_to_rows`` turns a
+``(B, H, W)`` batch sharded on B into the same logical array sharded on H
+(each device holds ``(B, H/n, W)`` — every site's row band ``i``);
+``rows_to_sites`` is the exact inverse.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tmlibrary_tpu.errors import ShardingError
+
+
+def _check(batch_shape: tuple, mesh: Mesh, axis: str) -> int:
+    n = mesh.shape[axis]
+    b, h = batch_shape[0], batch_shape[1]
+    if b % n:
+        raise ShardingError(f"site axis {b} not divisible by mesh '{axis}'={n}")
+    if h % n:
+        raise ShardingError(f"row axis {h} not divisible by mesh '{axis}'={n}")
+    return n
+
+
+def sites_to_rows(batch: jax.Array, mesh: Mesh, axis: str = "sites") -> jax.Array:
+    """(B, H, W) sharded on B → same array sharded on H (dim 1).
+
+    One ``all_to_all`` over the mesh axis: each device trades its sites'
+    foreign row bands for every site's local row band.
+    """
+    _check(batch.shape, mesh, axis)
+
+    def body(block):  # block: (B/n, H, W)
+        # split rows into n bands and exchange: concat sites, keep own band
+        return lax.all_to_all(block, axis, split_axis=1, concat_axis=0, tiled=True)
+
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(axis),
+        out_specs=PartitionSpec(None, axis),
+    )(batch)
+    return out
+
+
+def rows_to_sites(batch: jax.Array, mesh: Mesh, axis: str = "sites") -> jax.Array:
+    """(B, H, W) sharded on H (dim 1) → same array sharded on B — the
+    inverse of :func:`sites_to_rows`."""
+    _check(batch.shape, mesh, axis)
+
+    def body(block):  # block: (B, H/n, W)
+        return lax.all_to_all(block, axis, split_axis=0, concat_axis=1, tiled=True)
+
+    out = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(None, axis),
+        out_specs=PartitionSpec(axis),
+    )(batch)
+    return out
+
+
+def reshard_site_batch(batch: jax.Array, mesh: Mesh, axis: str = "sites"):
+    """Lay a host batch out site-sharded on the mesh (the standard input
+    placement for the jterator hot path)."""
+    return jax.device_put(batch, NamedSharding(mesh, PartitionSpec(axis)))

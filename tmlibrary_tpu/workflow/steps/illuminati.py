@@ -84,13 +84,13 @@ class PyramidBuilder(Step):
 
             return jax.vmap(one)(stack, shifts)
 
-        # site grid geometry
-        spw_y = max(s.y for w in plate.wells for s in w.sites) + 1
-        spw_x = max(s.x for w in plate.wells for s in w.sites) + 1
-        rows = max(w.row for w in plate.wells) + 1
-        cols = max(w.column for w in plate.wells) + 1
+        # site grid geometry (shared helper — same layout as the static
+        # outlines and the pyramid-depth computation)
+        from tmlibrary_tpu.models.mapobject import plate_grid, plate_mosaic_shape
+
+        rows, cols, spw_y, spw_x = plate_grid(exp, plate.name)
         H, W = exp.site_height, exp.site_width
-        mosaic = np.zeros((rows * spw_y * H, cols * spw_x * W), np.float32)
+        mosaic = np.zeros(plate_mosaic_shape(exp, plate.name), np.float32)
 
         refs = [
             (SiteRef(plate.name, w.row, w.column, s.y, s.x), w, s)
@@ -152,6 +152,7 @@ class PyramidBuilder(Step):
         import pandas as pd
 
         from tmlibrary_tpu.models.mapobject import (
+            STATIC_REF_TYPES,
             MapobjectType,
             MapobjectTypeRegistry,
             static_mapobjects,
@@ -183,7 +184,11 @@ class PyramidBuilder(Step):
                 counts[type_name] = counts.get(type_name, 0) + len(rows)
         for type_name in counts:
             registry.register(
-                MapobjectType(name=type_name, ref_type="static", min_poly_zoom=0)
+                MapobjectType(
+                    name=type_name,
+                    ref_type=STATIC_REF_TYPES[type_name],
+                    min_poly_zoom=0,
+                )
             )
         return {"static_mapobjects": counts}
 
