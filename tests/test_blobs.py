@@ -1,0 +1,127 @@
+"""LoG blob detection vs scipy golden + pipeline integration."""
+
+import jax.numpy as jnp
+import numpy as np
+import scipy.ndimage as ndi
+
+from tmlibrary_tpu.ops.blobs import detect_blobs, local_maxima, log_response
+
+
+def dots_image(rng, shape=(96, 96), n=10, r=2.0, amp=500.0):
+    img = rng.normal(50.0, 3.0, shape).astype(np.float32)
+    yy, xx = np.mgrid[0 : shape[0], 0 : shape[1]]
+    pts = []
+    while len(pts) < n:
+        y, x = rng.integers(8, shape[0] - 8, 2)
+        if all(abs(y - py) + abs(x - px) > 10 for py, px in pts):
+            pts.append((y, x))
+    for y, x in pts:
+        img += amp * np.exp(-((yy - y) ** 2 + (xx - x) ** 2) / (2 * r**2))
+    return img, pts
+
+
+def test_log_response_matches_scipy(rng):
+    img = rng.normal(100.0, 10.0, (64, 64)).astype(np.float32)
+    sigma = 2.0
+    got = np.asarray(log_response(img, sigma))
+    # scipy: gaussian then 5-point laplacian (same decomposition)
+    sm = ndi.gaussian_filter(img, sigma, mode="reflect")
+    lap = (
+        np.pad(sm, 1, mode="symmetric")[:-2, 1:-1]
+        + np.pad(sm, 1, mode="symmetric")[2:, 1:-1]
+        + np.pad(sm, 1, mode="symmetric")[1:-1, :-2]
+        + np.pad(sm, 1, mode="symmetric")[1:-1, 2:]
+        - 4 * sm
+    )
+    want = -(sigma**2) * lap
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-3)
+
+
+def test_local_maxima_unique_per_peak(rng):
+    img, pts = dots_image(rng)
+    resp = np.asarray(log_response(img, 2.0))
+    peaks = np.asarray(local_maxima(jnp.asarray(resp), min_distance=4))
+    strong = peaks & (resp > 100.0)
+    # exactly one peak per planted dot, each within 2px of a dot center
+    assert strong.sum() == len(pts)
+    ys, xs = np.nonzero(strong)
+    for y, x in zip(ys, xs):
+        assert min(abs(y - py) + abs(x - px) for py, px in pts) <= 2
+
+
+def test_detect_blobs_counts_and_centers(rng):
+    img, pts = dots_image(rng)
+    blobs, centers, count = detect_blobs(
+        img, sigmas=(1.5, 2.5), threshold=100.0, min_distance=4, max_objects=64
+    )
+    blobs, centers = np.asarray(blobs), np.asarray(centers)
+    assert int(count) == len(pts)
+    # each planted dot lies inside a distinct blob region
+    labels_at_pts = {int(blobs[y, x]) for y, x in pts}
+    assert 0 not in labels_at_pts
+    assert len(labels_at_pts) == len(pts)
+    # centers carry their region's label
+    ys, xs = np.nonzero(centers)
+    for y, x in zip(ys, xs):
+        assert centers[y, x] == blobs[y, x]
+
+
+def test_detect_blobs_empty(rng):
+    flat = rng.normal(100.0, 1.0, (48, 48)).astype(np.float32)
+    blobs, centers, count = detect_blobs(flat, threshold=1e6)
+    assert int(count) == 0
+    assert np.asarray(blobs).max() == 0
+
+
+def test_detect_blobs_module_in_pipeline(rng):
+    from tmlibrary_tpu.jterator.description import PipelineDescription
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+    pipe = {
+        "description": "spots",
+        "input": {"channels": [{"name": "FISH", "correct": False}]},
+        "pipeline": [
+            {
+                "handles": {
+                    "module": "detect_blobs",
+                    "input": [
+                        {"name": "intensity_image", "type": "IntensityImage",
+                         "key": "FISH"},
+                        {"name": "threshold", "type": "Numeric", "value": 100.0},
+                        {"name": "min_distance", "type": "Numeric", "value": 4},
+                    ],
+                    "output": [
+                        {"name": "objects", "type": "SegmentedObjects",
+                         "key": "spots", "objects": "spots"},
+                        {"name": "centers", "type": "LabelImage",
+                         "key": "spot_centers"},
+                    ],
+                }
+            },
+            {
+                "handles": {
+                    "module": "measure_intensity",
+                    "input": [
+                        {"name": "objects_image", "type": "LabelImage",
+                         "key": "spots"},
+                        {"name": "intensity_image", "type": "IntensityImage",
+                         "key": "FISH"},
+                    ],
+                    "output": [
+                        {"name": "measurements", "type": "Measurement",
+                         "objects": "spots", "channel": "FISH"}
+                    ],
+                }
+            },
+        ],
+        "output": {"objects": [{"name": "spots"}]},
+    }
+    desc = PipelineDescription.from_dict(pipe)
+    engine = ImageAnalysisPipeline(desc, max_objects=32)
+    fn = engine.build_batch_fn(jit=False)
+    imgs = np.stack([dots_image(rng, n=6)[0] for _ in range(2)])
+    result = fn({"FISH": jnp.asarray(imgs)}, {}, jnp.zeros((2, 2), jnp.int32))
+    counts = np.asarray(result.counts["spots"])
+    assert (counts == 6).all()
+    mean = np.asarray(result.measurements["spots"]["Intensity_mean_FISH"])
+    assert (mean[0, :6] > 100.0).all()

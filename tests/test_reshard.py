@@ -9,11 +9,8 @@ import pytest
 
 from tmlibrary_tpu.errors import ShardingError
 from tmlibrary_tpu.parallel.mesh import site_mesh
-from tmlibrary_tpu.parallel.reshard import (
-    reshard_site_batch,
-    rows_to_sites,
-    sites_to_rows,
-)
+from tmlibrary_tpu.parallel.mesh import shard_batch
+from tmlibrary_tpu.parallel.reshard import rows_to_sites, sites_to_rows
 
 
 @pytest.fixture
@@ -23,7 +20,7 @@ def mesh(devices):
 
 def test_sites_to_rows_and_back(mesh, rng):
     batch = jnp.asarray(rng.random((16, 32, 24)).astype(np.float32))
-    sharded = reshard_site_batch(batch, mesh)
+    sharded = shard_batch(batch, mesh)
     rows = sites_to_rows(sharded, mesh)
     # logical value unchanged by the layout move
     np.testing.assert_array_equal(np.asarray(rows), np.asarray(batch))
@@ -39,7 +36,7 @@ def test_spatial_op_in_rows_layout(mesh, rng):
     """A row-local op applied in the spatial layout matches applying it
     unsharded (the reason to reshard at all)."""
     batch = jnp.asarray(rng.random((8, 64, 16)).astype(np.float32))
-    rows = sites_to_rows(reshard_site_batch(batch, mesh), mesh)
+    rows = sites_to_rows(shard_batch(batch, mesh), mesh)
     out = jax.jit(lambda x: x * 2.0 + 1.0)(rows)
     np.testing.assert_allclose(np.asarray(out), np.asarray(batch) * 2.0 + 1.0)
 

@@ -397,11 +397,14 @@ def cpu_reference_site_full(
 
     names = list(channels)
     dapi, cell_ch = channels[names[0]], channels[names[1]]
-    n_nuclei, _ = cpu_reference_site(dapi, cell_ch)
 
+    # segmentation exactly once (same chain as cpu_reference_site,
+    # including its min_area >= 20 filter)
     sm = ndi.gaussian_filter(dapi.astype(np.float32), 1.5, mode="reflect")
     mask = ndi.binary_fill_holes(sm > _otsu_numpy(sm))
     labels, _ = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    sizes = np.bincount(labels.ravel())[1:]
+    n_nuclei = int((sizes >= 20).sum())
     t2 = _otsu_numpy(cell_ch) * 0.8
     dist, (iy, ix) = ndi.distance_transform_edt(labels == 0, return_indices=True)
     cells = np.where(cell_ch > t2, labels[iy, ix], 0)

@@ -500,3 +500,29 @@ def mip(zstack):
     """Reference ``jtmodules/mip.py``: maximum-intensity projection of a
     z-stack (alias for ``project(method="max")``)."""
     return {"mip_image": project(zstack, method="max")["projected_image"]}
+
+
+@register_module("detect_blobs")
+def detect_blobs(
+    intensity_image,
+    threshold: float = 10.0,
+    min_distance: int = 3,
+    sigma_min: float = 1.5,
+    sigma_max: float = 4.0,
+    n_scales: int = 3,
+    max_objects: int = 256,
+):
+    """Reference ``jtmodules/detect_blobs.py`` (LoG spot detection for
+    punctate structures)."""
+    from tmlibrary_tpu.ops.blobs import detect_blobs as _db
+
+    lo, hi, n = float(sigma_min), float(sigma_max), int(n_scales)
+    sigmas = tuple(lo + (hi - lo) * i / max(n - 1, 1) for i in range(n))
+    blobs, centers, _count = _db(
+        intensity_image,
+        sigmas=sigmas,
+        threshold=threshold,
+        min_distance=min_distance,
+        max_objects=max_objects,
+    )
+    return {"objects": blobs, "centers": centers}

@@ -1,0 +1,94 @@
+"""Blob (spot) detection via Laplacian-of-Gaussian.
+
+Reference parity: ``jtmodules/detect_blobs.py`` / ``jtlib.segmentation.
+detect_blobs`` — LoG spot detection for punctate structures (vesicles,
+speckles, FISH dots), returning segmented blob regions and their seed
+centers.
+
+TPU design: the scale-normalized LoG response is two separable Gaussian
+passes plus a 5-point Laplacian (all ``lax.conv_general_dilated`` on the
+VPU/MXU); centers are local maxima found with a max-pool comparison
+(``lax.reduce_window``); regions grow from the thresholded response via
+the shared connected-components labeling.  All shapes static; ``vmap``-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tmlibrary_tpu.ops.label import clip_label_count, connected_components
+from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+
+def log_response(img: jax.Array, sigma: float) -> jax.Array:
+    """Scale-normalized negative LoG response (bright blobs → positive):
+    ``-sigma^2 * Laplacian(Gaussian(img))`` — matching
+    ``scipy.ndimage.gaussian_laplace`` up to the sign/normalization used
+    by blob detectors."""
+    sm = gaussian_smooth(jnp.asarray(img, jnp.float32), sigma)
+    padded = jnp.pad(sm, ((1, 1), (1, 1)), mode="symmetric")
+    h, w = sm.shape
+    lap = (
+        lax.dynamic_slice(padded, (0, 1), (h, w))
+        + lax.dynamic_slice(padded, (2, 1), (h, w))
+        + lax.dynamic_slice(padded, (1, 0), (h, w))
+        + lax.dynamic_slice(padded, (1, 2), (h, w))
+        - 4.0 * sm
+    )
+    return -(float(sigma) ** 2) * lap
+
+
+def local_maxima(response: jax.Array, min_distance: int = 3) -> jax.Array:
+    """Boolean map of strict local maxima within a
+    ``(2*min_distance+1)``-square neighborhood (ties broken toward the
+    first pixel in scan order, matching peak_local_max's exclusion)."""
+    size = 2 * int(min_distance) + 1
+    neigh_max = lax.reduce_window(
+        response,
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(size, size),
+        window_strides=(1, 1),
+        padding="SAME",
+    )
+    is_max = response >= neigh_max
+    # break plateau ties: keep the scan-order-first pixel of each plateau
+    h, w = response.shape
+    linear = jnp.arange(h * w, dtype=jnp.float32).reshape(h, w)
+    tie_break = lax.reduce_window(
+        jnp.where(is_max, -linear, -jnp.inf),
+        -jnp.inf,
+        lax.max,
+        window_dimensions=(size, size),
+        window_strides=(1, 1),
+        padding="SAME",
+    )
+    return is_max & (jnp.abs(tie_break) == linear)
+
+
+def detect_blobs(
+    img: jax.Array,
+    sigmas: tuple[float, ...] = (1.5, 2.5, 4.0),
+    threshold: float = 10.0,
+    min_distance: int = 3,
+    max_objects: int = 256,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Multi-scale LoG blob detection.
+
+    Returns ``(blobs, centers, count)``: int32 label image of blob
+    regions (thresholded max-scale LoG response, connected-components
+    labeled in scipy scan order), an int32 map with the blob label at
+    each detected center (0 elsewhere), and the scalar blob count.
+    """
+    img = jnp.asarray(img, jnp.float32)
+    response = log_response(img, sigmas[0])
+    for s in sigmas[1:]:
+        response = jnp.maximum(response, log_response(img, s))
+    mask = response > threshold
+    labels, count = connected_components(mask, connectivity=8)
+    labels = clip_label_count(labels, max_objects)
+    peaks = local_maxima(response, min_distance) & mask
+    centers = jnp.where(peaks, labels, 0)
+    return labels, centers, jnp.minimum(count, max_objects)

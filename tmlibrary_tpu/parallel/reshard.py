@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import jax
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
 
 from tmlibrary_tpu.errors import ShardingError
 
@@ -72,7 +72,3 @@ def rows_to_sites(batch: jax.Array, mesh: Mesh, axis: str = "sites") -> jax.Arra
     return out
 
 
-def reshard_site_batch(batch: jax.Array, mesh: Mesh, axis: str = "sites"):
-    """Lay a host batch out site-sharded on the mesh (the standard input
-    placement for the jterator hot path)."""
-    return jax.device_put(batch, NamedSharding(mesh, PartitionSpec(axis)))
