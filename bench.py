@@ -36,6 +36,8 @@ def main() -> None:
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
     config = os.environ.get("BENCH_CONFIG", "3")  # BASELINE.md milestone ladder
 
+    if config not in ("3", "4"):
+        raise SystemExit(f"BENCH_CONFIG must be '3' or '4', got '{config}'")
     if config == "4":
         from tmlibrary_tpu.benchmarks import (
             full_feature_description,

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""One-command TPU tuning sweep (run when the chip is available):
+
+1. bench batch-size sweep (64/128/256) for the default config;
+2. XLA vs pallas kernel timing for CC labeling and watershed;
+3. prints the recommended defaults.
+
+Usage: python scripts/tune_tpu.py
+"""
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_bench(env_overrides):
+    env = dict(os.environ, **{k: str(v) for k, v in env_overrides.items()})
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, timeout=1200,
+    )
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            import json
+
+            return json.loads(line)
+    raise RuntimeError(f"bench failed: {out.stderr[-500:]}")
+
+
+def kernel_shootout():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmlibrary_tpu.benchmarks import synthetic_cell_painting_batch
+    from tmlibrary_tpu.ops import threshold as thr
+    from tmlibrary_tpu.ops.label import connected_components
+    from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+    B = 64
+    data = synthetic_cell_painting_batch(B, size=256)
+    dapi = jnp.asarray(data["DAPI"])
+    actin = jnp.asarray(data["Actin"])
+    v = jax.vmap
+
+    sm = jax.jit(v(lambda im: gaussian_smooth(im, 1.5)))(dapi)
+    masks = jax.jit(v(thr.threshold_otsu))(sm)
+
+    def bench_fn(name, fn, *args):
+        wrapped = jax.jit(
+            lambda *a: sum(jnp.sum(jnp.asarray(l, jnp.float32))
+                           for l in jax.tree_util.tree_leaves(fn(*a)))
+        )
+        np.asarray(wrapped(*args))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(wrapped(*args))
+            best = min(best, time.perf_counter() - t0)
+        print(f"  {name:32s} {best*1e3:8.2f} ms ({B/best:7.1f} sites/s)")
+        return best
+
+    print("CC labeling:")
+    t_x = bench_fn("xla", v(lambda m: connected_components(m, method='xla')[0]), masks)
+    t_p = bench_fn("pallas", v(lambda m: connected_components(m, method='pallas')[0]), masks)
+    nuclei = jax.jit(v(lambda m: connected_components(m, method='xla')[0]))(masks)
+    print("watershed (16 levels):")
+    w_x = bench_fn(
+        "xla",
+        v(lambda l, im: watershed_from_seeds(
+            im, l, thr.threshold_otsu(im, correction_factor=0.8),
+            n_levels=16, method='xla')),
+        nuclei, actin,
+    )
+    w_p = bench_fn(
+        "pallas",
+        v(lambda l, im: watershed_from_seeds(
+            im, l, thr.threshold_otsu(im, correction_factor=0.8),
+            n_levels=16, method='pallas')),
+        nuclei, actin,
+    )
+    return t_p < t_x and w_p < w_x
+
+
+def main():
+    print("== batch sweep (config 3) ==")
+    best = None
+    for batch in (64, 128, 256):
+        r = run_bench({"BENCH_BATCH": batch})
+        print(f"  batch={batch}: {r['value']} sites/s")
+        if best is None or r["value"] > best[1]:
+            best = (batch, r["value"])
+    print(f"best batch: {best[0]} ({best[1]} sites/s)")
+
+    print("== pallas shootout ==")
+    pallas_wins = kernel_shootout()
+    print(f"pallas wins: {pallas_wins}")
+    if pallas_wins:
+        r = run_bench({"BENCH_BATCH": best[0], "TMX_PALLAS": "1"})
+        print(f"bench with TMX_PALLAS=1: {r['value']} sites/s")
+
+
+if __name__ == "__main__":
+    main()

@@ -122,6 +122,35 @@ def test_watershed_flood_matches_xla(rng):
     np.testing.assert_array_equal(got, want)
 
 
+def test_distance_transform_matches_xla(rng):
+    from tmlibrary_tpu.ops.pallas_kernels import distance_transform
+    from tmlibrary_tpu.ops.segment_primary import distance_transform_approx
+
+    img = blobs(rng, n=5, r=8)
+    mask = img > 0.2
+    got = np.asarray(distance_transform(mask, interpret=True))
+    want = np.asarray(distance_transform_approx(mask, method="xla"))
+    np.testing.assert_array_equal(got, want)
+    # chessboard distance golden (interior): erosion counting equals
+    # chebyshev distance-to-background.  Image-border pixels differ by
+    # design: erosion treats outside-of-image as foreground (reflect),
+    # cdt does not.
+    dist_cheb = ndi.distance_transform_cdt(mask, metric="chessboard")
+    interior = np.zeros_like(mask)
+    interior[8:-8, 8:-8] = True
+    np.testing.assert_array_equal(got[interior], dist_cheb[interior])
+
+
+def test_distance_transform_through_dispatch(rng):
+    from tmlibrary_tpu.ops.segment_primary import distance_transform_approx
+
+    img = blobs(rng, n=3, r=6)
+    mask = img > 0.3
+    got = np.asarray(distance_transform_approx(mask, method="pallas"))
+    want = np.asarray(distance_transform_approx(mask, method="xla"))
+    np.testing.assert_array_equal(got, want)
+
+
 def test_watershed_flood_seeds_kept(rng):
     img = blobs(rng, n=4, r=6)
     seed_mask = img > 0.6
