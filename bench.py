@@ -8,27 +8,36 @@ The baseline denominator is the single-threaded scipy/numpy implementation
 of the same pipeline measured on this host (BASELINE.md: the reference
 publishes no numbers; the reference mount is empty — the official
 denominator is a measured single-CPU run).
+
+Resilience (round-1 VERDICT missing item #1): the TPU relay backend can
+fail OR HANG at init, so the measurement runs in a child process with a
+hard timeout, retried with backoff.  If the chip never comes up, the
+benchmark falls back to the CPU backend and emits the JSON line with
+``backend: "cpu_fallback"`` and the TPU error recorded — a structured
+record instead of a stack trace and rc=1.
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-import numpy as np
 
-
-def main() -> None:
+def measure(platform: str) -> None:
+    """Child-process body: run the measurement on ``platform`` and print
+    the result JSON line."""
     import jax
-    import jax.numpy as jnp
 
-    from tmlibrary_tpu.benchmarks import (
-        cell_painting_description,
-        cpu_reference_site,
-        synthetic_cell_painting_batch,
-    )
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+    import numpy as np
+
     from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
 
     size = int(os.environ.get("BENCH_SITE_SIZE", "256"))
@@ -49,6 +58,11 @@ def main() -> None:
         metric = "jterator_full_stack_sites_per_sec_per_chip"
         unit = f"sites/sec ({size}x{size}, 5ch, segment+all-features)"
     else:
+        from tmlibrary_tpu.benchmarks import (
+            cell_painting_description,
+            synthetic_cell_painting_batch,
+        )
+
         data = synthetic_cell_painting_batch(batch, size=size)
         desc = cell_painting_description()
         metric = "jterator_cell_painting_sites_per_sec_per_chip"
@@ -66,40 +80,114 @@ def main() -> None:
     result = fn(raw, {}, shifts)
     np.asarray(result.counts["cells"])
 
-    reps = 3
+    reps = int(os.environ.get("BENCH_REPS", "3"))
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         result = fn(raw, {}, shifts)
         np.asarray(result.counts["cells"])
         best = min(best, time.perf_counter() - t0)
-    tpu_sites_per_sec = batch / best
+    device_sites_per_sec = batch / best
 
-    # single-CPU denominator: the SAME workload in scipy/numpy, single thread
-    n_cpu = min(4, batch)
-    t0 = time.perf_counter()
-    if config == "4":
-        from tmlibrary_tpu.benchmarks import cpu_reference_site_full
+    # single-CPU denominator: the SAME workload in scipy/numpy, single
+    # thread — up to 8 sites (capped by batch), best-of-3 reps
+    # (round-1 VERDICT weak item #2)
+    n_cpu = min(8, batch)
+    cpu_best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        if config == "4":
+            from tmlibrary_tpu.benchmarks import cpu_reference_site_full
 
-        for s in range(n_cpu):
-            cpu_reference_site_full({ch: v[s] for ch, v in data.items()})
-    else:
-        for s in range(n_cpu):
-            cpu_reference_site(data["DAPI"][s], data["Actin"][s])
-    cpu_elapsed = time.perf_counter() - t0
-    cpu_sites_per_sec = n_cpu / cpu_elapsed
+            for s in range(n_cpu):
+                cpu_reference_site_full({ch: v[s] for ch, v in data.items()})
+        else:
+            from tmlibrary_tpu.benchmarks import cpu_reference_site
 
+            for s in range(n_cpu):
+                cpu_reference_site(data["DAPI"][s], data["Actin"][s])
+        cpu_best = min(cpu_best, time.perf_counter() - t0)
+    cpu_sites_per_sec = n_cpu / cpu_best
+
+    record = {
+        "metric": metric,
+        "value": round(device_sites_per_sec, 2),
+        "unit": unit,
+        "vs_baseline": round(device_sites_per_sec / cpu_sites_per_sec, 2),
+        "backend": jax.default_backend(),
+        "cpu_denominator_sites_per_sec": round(cpu_sites_per_sec, 3),
+    }
+    print(json.dumps(record), flush=True)
+
+
+def main() -> None:
+    """Parent: run the measurement in a child with timeout + retries."""
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
+    timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1200"))
+    backoff_s = int(os.environ.get("BENCH_RETRY_BACKOFF", "20"))
+    last_err = ""
+
+    def try_once(platform: str) -> bool:
+        nonlocal last_err
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child", platform],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"{platform}: attempt timed out after {timeout_s}s"
+            print(f"bench: {last_err}", file=sys.stderr, flush=True)
+            return False
+        for line in proc.stdout.splitlines():
+            if line.startswith("{"):
+                # error record from a cpu fallback gets annotated below
+                out = json.loads(line)
+                if platform == "cpu":
+                    out["backend"] = "cpu_fallback"
+                    out["error"] = f"tpu unavailable: {last_err}"
+                print(json.dumps(out), flush=True)
+                return True
+        last_err = (
+            f"{platform}: rc={proc.returncode}, "
+            f"stderr tail: {proc.stderr[-400:]}"
+        )
+        print(f"bench: {last_err}", file=sys.stderr, flush=True)
+        return False
+
+    for i in range(attempts):
+        if try_once("default"):
+            return
+        if i < attempts - 1:
+            time.sleep(backoff_s * (i + 1))
+    # chip never came up: fall back to the CPU backend so the round still
+    # produces a measured number, annotated as a fallback
+    if try_once("cpu"):
+        return
+    config = os.environ.get("BENCH_CONFIG", "3")
+    metric = (
+        "jterator_full_stack_sites_per_sec_per_chip"
+        if config == "4"
+        else "jterator_cell_painting_sites_per_sec_per_chip"
+    )
     print(
         json.dumps(
             {
                 "metric": metric,
-                "value": round(tpu_sites_per_sec, 2),
-                "unit": unit,
-                "vs_baseline": round(tpu_sites_per_sec / cpu_sites_per_sec, 2),
+                "value": 0.0,
+                "unit": "sites/sec",
+                "vs_baseline": 0.0,
+                "error": f"all backends failed: {last_err}",
             }
-        )
+        ),
+        flush=True,
     )
+    sys.exit(0)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        measure(sys.argv[2])
+    else:
+        main()

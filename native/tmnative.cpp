@@ -14,8 +14,10 @@
 // Built as a plain shared library, loaded via ctypes (no pybind11 in the
 // image). C ABI only.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -170,6 +172,93 @@ void tm_bounding_boxes(const int32_t* labels, int32_t h, int32_t w,
       }
     }
   }
+}
+
+// Per-object rasterized convex hull pixel counts (skimage
+// convex_hull_image semantics over pixel centers): for each label
+// 1..max_label, out[l-1] receives the number of pixels whose center lies
+// inside or on the convex hull of the object's pixel centers.  Labels
+// absent get 0.  Solidity = area / hull_count falls out on the caller
+// side.  Returns 0, or -1 on invalid arguments.
+int32_t tm_hull_pixel_counts(const int32_t* labels, int32_t h, int32_t w,
+                             int32_t max_label, int32_t* out) {
+  if (!labels || !out || h <= 0 || w <= 0 || max_label <= 0) return -1;
+  std::memset(out, 0, sizeof(int32_t) * static_cast<size_t>(max_label));
+
+  // gather per-label bounding boxes + pixel lists in one scan
+  std::vector<int32_t> bbox(static_cast<size_t>(max_label) * 4);
+  for (int32_t l = 0; l < max_label; ++l) {
+    bbox[4 * l] = -1; bbox[4 * l + 1] = -1; bbox[4 * l + 2] = -1; bbox[4 * l + 3] = -1;
+  }
+  std::vector<std::vector<std::pair<int32_t, int32_t>>> pts(max_label);
+  for (int32_t y = 0; y < h; ++y) {
+    for (int32_t x = 0; x < w; ++x) {
+      const int32_t v = labels[static_cast<size_t>(y) * w + x];
+      if (v < 1 || v > max_label) continue;
+      int32_t* b = &bbox[4 * (v - 1)];
+      if (b[0] < 0) { b[0] = y; b[1] = x; b[2] = y; b[3] = x; }
+      else {
+        if (y < b[0]) b[0] = y;
+        if (x < b[1]) b[1] = x;
+        if (y > b[2]) b[2] = y;
+        if (x > b[3]) b[3] = x;
+      }
+      pts[v - 1].emplace_back(x, y);
+    }
+  }
+
+  auto cross = [](int64_t ox, int64_t oy, int64_t ax, int64_t ay,
+                  int64_t bx, int64_t by) -> int64_t {
+    return (ax - ox) * (by - oy) - (ay - oy) * (bx - ox);
+  };
+
+  for (int32_t l = 0; l < max_label; ++l) {
+    auto& p = pts[l];
+    const size_t n = p.size();
+    if (n == 0) continue;
+    if (n <= 2) { out[l] = static_cast<int32_t>(n); continue; }
+    // Andrew's monotone chain (points are already sorted by (y, x) from the
+    // scan; re-sort by (x, y) as the algorithm expects)
+    std::sort(p.begin(), p.end());
+    std::vector<std::pair<int32_t, int32_t>> hull(2 * n);
+    size_t k = 0;
+    for (size_t i = 0; i < n; ++i) {            // lower hull
+      while (k >= 2 && cross(hull[k - 2].first, hull[k - 2].second,
+                             hull[k - 1].first, hull[k - 1].second,
+                             p[i].first, p[i].second) <= 0) --k;
+      hull[k++] = p[i];
+    }
+    for (size_t i = n - 1, t = k + 1; i-- > 0;) {  // upper hull
+      while (k >= t && cross(hull[k - 2].first, hull[k - 2].second,
+                             hull[k - 1].first, hull[k - 1].second,
+                             p[i].first, p[i].second) <= 0) --k;
+      hull[k++] = p[i];
+    }
+    hull.resize(k - 1);  // last point == first point
+    const size_t m = hull.size();
+    if (m <= 2) {  // degenerate (collinear object): hull pixels = object pixels
+      out[l] = static_cast<int32_t>(n);
+      continue;
+    }
+    // hull is counter-clockwise in (x, y) with cross<=0 popped: a pixel
+    // center is inside-or-on iff it is left of (cross >= 0) every edge
+    const int32_t* b = &bbox[4 * l];
+    int32_t count = 0;
+    for (int32_t y = b[0]; y <= b[2]; ++y) {
+      for (int32_t x = b[1]; x <= b[3]; ++x) {
+        bool inside = true;
+        for (size_t i = 0; i < m && inside; ++i) {
+          const auto& a0 = hull[i];
+          const auto& a1 = hull[(i + 1) % m];
+          if (cross(a0.first, a0.second, a1.first, a1.second, x, y) < 0)
+            inside = false;
+        }
+        if (inside) ++count;
+      }
+    }
+    out[l] = count;
+  }
+  return 0;
 }
 
 }  // extern "C"

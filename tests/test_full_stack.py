@@ -1,8 +1,11 @@
-"""BASELINE.md config 4: the full feature stack pipeline compiles into one
-program and emits every feature family for both object types."""
+"""BASELINE.md config 4: the full feature stack (round-1 VERDICT weak #6:
+this flagship program needs real coverage — determinism across batch sizes,
+mesh-shape invariance, masked-row export, CPU-reference parity)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tmlibrary_tpu.benchmarks import (
     FULL_STACK_CHANNELS,
@@ -11,18 +14,30 @@ from tmlibrary_tpu.benchmarks import (
 )
 from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
 
+MAX_OBJ = 32
 
-def test_full_feature_stack_pipeline():
+
+@pytest.fixture(scope="module")
+def pipe():
     desc = full_feature_description(texture_levels=8, zernike_degree=4)
     desc.validate()
-    pipe = ImageAnalysisPipeline(desc, max_objects=32)
-    fn = pipe.build_batch_fn(jit=False)
+    return ImageAnalysisPipeline(desc, max_objects=MAX_OBJ)
 
-    batch = 2
-    data = synthetic_full_stack_batch(batch, size=96, n_cells=5)
+
+@pytest.fixture(scope="module")
+def batch4():
+    return synthetic_full_stack_batch(4, size=96, n_cells=5)
+
+
+def _run(pipe, data, jit=False):
+    fn = pipe.build_batch_fn(jit=jit)
+    b = next(iter(data.values())).shape[0]
     raw = {k: jnp.asarray(v) for k, v in data.items()}
-    result = fn(raw, {}, jnp.zeros((batch, 2), jnp.int32))
+    return fn(raw, {}, jnp.zeros((b, 2), jnp.int32))
 
+
+def test_full_feature_stack_pipeline(pipe, batch4):
+    result = _run(pipe, batch4)
     counts_n = np.asarray(result.counts["nuclei"])
     counts_c = np.asarray(result.counts["cells"])
     assert (counts_n >= 1).all()
@@ -30,19 +45,219 @@ def test_full_feature_stack_pipeline():
 
     for objects in ("nuclei", "cells"):
         feats = result.measurements[objects]
-        # intensity on all five channels
         for ch in FULL_STACK_CHANNELS:
             assert f"Intensity_mean_{ch}" in feats, (objects, ch)
-        # morphology
         assert "Morphology_area" in feats
-    # texture on cells, zernike on nuclei
     assert any(k.startswith("Texture_") for k in result.measurements["cells"])
     assert any(k.startswith("Zernike_") for k in result.measurements["nuclei"])
 
-    # per-feature shape: (batch, max_objects)
     area = np.asarray(result.measurements["nuclei"]["Morphology_area"])
-    assert area.shape == (batch, 32)
-    # areas of real objects are positive
-    for b in range(batch):
+    assert area.shape == (4, MAX_OBJ)
+    for b in range(4):
         n = int(counts_n[b])
         assert (area[b, :n] > 0).all()
+
+
+def test_feature_key_completeness(pipe, batch4):
+    """Exact feature families per object type — a missing module output or
+    renamed feature must fail loudly, not silently shrink the table."""
+    result = _run(pipe, {k: v[:1] for k, v in batch4.items()})
+    nuc = set(result.measurements["nuclei"])
+    cells = set(result.measurements["cells"])
+
+    intensity = {f"Intensity_{s}_{ch}" for ch in FULL_STACK_CHANNELS
+                 for s in ("max", "mean", "min", "sum", "std")}
+    morphology = {
+        "Morphology_area", "Morphology_centroid_y", "Morphology_centroid_x",
+        "Morphology_bbox_height", "Morphology_bbox_width", "Morphology_extent",
+        "Morphology_perimeter", "Morphology_equivalent_diameter",
+        "Morphology_form_factor", "Morphology_major_axis_length",
+        "Morphology_minor_axis_length", "Morphology_eccentricity",
+        "Morphology_orientation",
+    }
+    texture_base = {
+        "Texture_angular_second_moment", "Texture_contrast",
+        "Texture_correlation", "Texture_sum_of_squares_variance",
+        "Texture_inverse_difference_moment", "Texture_sum_average",
+        "Texture_sum_variance", "Texture_sum_entropy", "Texture_entropy",
+        "Texture_difference_variance", "Texture_difference_entropy",
+        "Texture_info_measure_corr_1", "Texture_info_measure_corr_2",
+    }
+    # degree 4: (n,m) with m the same parity as n
+    zernike = {f"Zernike_{n}_{m}" for n in range(5)
+               for m in range(n % 2, n + 1, 2)}
+
+    assert intensity <= nuc and intensity <= cells
+    assert morphology <= nuc and morphology <= cells
+    texture_in_cells = {k for k in cells if k.startswith("Texture_")}
+    assert len(texture_in_cells) == len(texture_base)
+    for base in texture_base:
+        assert any(k.startswith(base) for k in texture_in_cells), base
+    assert zernike <= nuc
+
+
+def test_determinism_across_batch_sizes(pipe, batch4):
+    """Site results must not depend on which batch the site rode in
+    (vmap lanes are independent)."""
+    full = _run(pipe, batch4)
+    half_a = _run(pipe, {k: v[:2] for k, v in batch4.items()})
+    half_b = _run(pipe, {k: v[2:] for k, v in batch4.items()})
+
+    np.testing.assert_array_equal(
+        np.asarray(full.counts["nuclei"]),
+        np.concatenate([np.asarray(half_a.counts["nuclei"]),
+                        np.asarray(half_b.counts["nuclei"])]),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(full.objects["cells"][:2]), np.asarray(half_a.objects["cells"])
+    )
+    for feat in ("Morphology_area", "Intensity_mean_" + FULL_STACK_CHANNELS[0]):
+        for objects in ("nuclei", "cells"):
+            np.testing.assert_allclose(
+                np.asarray(full.measurements[objects][feat][:2]),
+                np.asarray(half_a.measurements[objects][feat]),
+                rtol=1e-5, atol=1e-5,
+            )
+
+
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (4, 2)])
+def test_mesh_shape_invariance(pipe, batch4, devices, mesh_shape):
+    """The flagship program must produce identical results under every
+    (wells, sites) mesh factorization — GSPMD partitioning is semantics-
+    preserving for this data-parallel program."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    data = {k: np.concatenate([v, v], axis=0) for k, v in batch4.items()}  # B=8
+    want = _run(pipe, data)
+
+    mesh = Mesh(np.asarray(devices).reshape(mesh_shape), ("wells", "sites"))
+    shard = NamedSharding(mesh, PartitionSpec(("wells", "sites")))
+    fn = jax.jit(pipe.build_batch_fn(jit=False))
+    raw = {k: jax.device_put(jnp.asarray(v), shard) for k, v in data.items()}
+    shifts = jax.device_put(jnp.zeros((8, 2), jnp.int32), shard)
+    got = fn(raw, {}, shifts)
+
+    np.testing.assert_array_equal(
+        np.asarray(want.counts["nuclei"]), np.asarray(got.counts["nuclei"])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(want.objects["nuclei"]), np.asarray(got.objects["nuclei"])
+    )
+    np.testing.assert_allclose(
+        np.asarray(want.measurements["cells"]["Morphology_area"]),
+        np.asarray(got.measurements["cells"]["Morphology_area"]),
+        rtol=1e-5,
+    )
+
+
+def test_counts_match_cpu_reference(pipe, batch4):
+    """Bit-identical object-count gate vs the single-threaded scipy
+    implementation of the same pipeline (BASELINE.json north star)."""
+    from tmlibrary_tpu.benchmarks import cpu_reference_site_full
+
+    result = _run(pipe, batch4)
+    counts_n = np.asarray(result.counts["nuclei"])
+    for s in range(4):
+        n_ref, _ = cpu_reference_site_full(
+            {ch: v[s] for ch, v in batch4.items()}
+        )
+        assert int(counts_n[s]) == n_ref, s
+
+
+def test_masked_row_export(pipe, batch4):
+    """Measurement rows beyond a site's object count are padding garbage
+    and must not reach the feature table."""
+    from tmlibrary_tpu.workflow.steps.jterator import ImageAnalysisRunner
+
+    result = _run(pipe, {k: v[:2] for k, v in batch4.items()})
+    counts = np.asarray(result.counts["nuclei"])
+    feats = {k: np.asarray(v) for k, v in result.measurements["nuclei"].items()}
+    site_meta = [
+        {"site_index": s, "plate": "P1", "well_row": 0, "well_col": 0,
+         "site_y": 0, "site_x": s}
+        for s in range(2)
+    ]
+    table = ImageAnalysisRunner._feature_table(
+        "nuclei", counts, feats, site_meta, MAX_OBJ
+    )
+    assert len(table) == int(counts.sum())
+    for s in range(2):
+        sub = table[table["site_index"] == s]
+        assert list(sub["label"]) == list(range(1, int(counts[s]) + 1))
+    # exported values match the unmasked leading rows
+    a0 = table[table["site_index"] == 0]["Morphology_area"].to_numpy()
+    np.testing.assert_allclose(
+        a0, feats["Morphology_area"][0, : int(counts[0])], rtol=1e-6
+    )
+
+
+def test_solidity_exported_end_to_end(tmp_path, rng):
+    """The workflow-level jterator step joins host-measured solidity into
+    the morphology features (round-1 VERDICT missing item #4)."""
+    import cv2
+    import yaml
+
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.engine import Workflow, WorkflowDescription
+
+    src = tmp_path / "microscope"
+    src.mkdir()
+    yy, xx = np.mgrid[0:64, 0:64]
+    for well in ("A01", "A02"):
+        for site in range(2):
+            img = rng.normal(300, 20, (64, 64))
+            for _ in range(5):
+                y, x = rng.integers(10, 54, 2)
+                img += 4000 * np.exp(-((yy - y) ** 2 + (xx - x) ** 2) / (2 * 3.0**2))
+            cv2.imwrite(str(src / f"{well}_s{site}_DAPI.png"),
+                        np.clip(img, 0, 65535).astype(np.uint16))
+
+    pipe_yaml = {
+        "description": "segment + morphology",
+        "input": {"channels": [{"name": "DAPI", "correct": False,
+                                "align": False}]},
+        "pipeline": [
+            {"handles": {
+                "module": "segment_primary",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage",
+                     "key": "DAPI"},
+                    {"name": "threshold_method", "type": "Character",
+                     "value": "otsu"},
+                    {"name": "min_area", "type": "Numeric", "value": 10},
+                ],
+                "output": [{"name": "objects", "type": "SegmentedObjects",
+                            "key": "nuclei", "objects": "nuclei"}],
+            }},
+            {"handles": {
+                "module": "measure_morphology",
+                "input": [
+                    {"name": "objects_image", "type": "LabelImage",
+                     "key": "nuclei"},
+                ],
+                "output": [{"name": "measurements", "type": "Measurement",
+                            "objects": "nuclei"}],
+            }},
+        ],
+        "output": {"objects": [{"name": "nuclei"}]},
+    }
+
+    placeholder = Experiment(name="fs", plates=[], channels=[],
+                             site_height=1, site_width=1)
+    store = ExperimentStore.create(tmp_path / "exp", placeholder)
+    (store.root / "m.pipe.yaml").write_text(yaml.safe_dump(pipe_yaml))
+    desc = WorkflowDescription.canonical({
+        "metaconfig": {"source_dir": str(src)},
+        "imextract": {},
+        "jterator": {"pipe": "m.pipe.yaml", "batch_size": 4,
+                     "max_objects": 32, "n_devices": 1},
+    })
+    Workflow(store, desc).run()
+
+    feats = store.read_features("nuclei")
+    assert "Morphology_solidity" in feats.columns
+    sol = feats["Morphology_solidity"].to_numpy()
+    assert (sol > 0.0).all() and (sol <= 1.0 + 1e-6).all()
+    # round gaussian blobs are nearly convex
+    assert sol.mean() > 0.85

@@ -99,6 +99,139 @@ def test_haralick_correlation_of_smooth_gradient():
     assert float(feats["Texture_correlation"][0]) > 0.9
 
 
+def _haralick_reference_numpy(img, mask, levels=32, distance=1):
+    """Independent numpy implementation of per-object Haralick features with
+    mahotas semantics: per-object gray stretch (``mh.stretch``:
+    floor((v-min)*(levels-1)/(max-min))), symmetric GLCM per direction,
+    Haralick's 13 features (f7 sum-variance uses f8 sum-entropy per the
+    original paper, as mahotas does), averaged over the 4 directions."""
+    sel = img[mask]
+    lo, hi = sel.min(), sel.max()
+    span = max(hi - lo, 1e-6)
+    q = np.clip(np.floor((img - lo) * (levels - 1) / span), 0, levels - 1).astype(int)
+    eps = 1e-10
+    acc = np.zeros(13)
+    h, w = img.shape
+    for dy, dx in ((0, distance), (distance, 0), (distance, distance), (distance, -distance)):
+        glcm = np.zeros((levels, levels))
+        for y in range(h):
+            for x in range(w):
+                y2, x2 = y + dy, x + dx
+                if 0 <= y2 < h and 0 <= x2 < w and mask[y, x] and mask[y2, x2]:
+                    glcm[q[y, x], q[y2, x2]] += 1
+        glcm = glcm + glcm.T
+        p = glcm / max(glcm.sum(), eps)
+        i_idx, j_idx = np.mgrid[0:levels, 0:levels].astype(float)
+        px, py = p.sum(1), p.sum(0)
+        k = np.arange(levels, dtype=float)
+        mu_x, mu_y = (px * k).sum(), (py * k).sum()
+        sd_x = np.sqrt(max((px * (k - mu_x) ** 2).sum(), 0.0))
+        sd_y = np.sqrt(max((py * (k - mu_y) ** 2).sum(), 0.0))
+        asm = (p ** 2).sum()
+        contrast = (p * (i_idx - j_idx) ** 2).sum()
+        corr = (p * (i_idx - mu_x) * (j_idx - mu_y)).sum() / max(sd_x * sd_y, eps)
+        variance = (p * (i_idx - mu_x) ** 2).sum()
+        idm = (p / (1.0 + (i_idx - j_idx) ** 2)).sum()
+        entropy = -(p * np.log(p + eps)).sum()
+        p_sum = np.zeros(2 * levels - 1)
+        p_diff = np.zeros(levels)
+        for i in range(levels):
+            for j in range(levels):
+                p_sum[i + j] += p[i, j]
+                p_diff[abs(i - j)] += p[i, j]
+        ks = np.arange(2 * levels - 1, dtype=float)
+        sum_avg = (p_sum * ks).sum()
+        sum_entropy = -(p_sum * np.log(p_sum + eps)).sum()
+        sum_var = (p_sum * (ks - sum_entropy) ** 2).sum()
+        diff_avg = (p_diff * k).sum()
+        diff_var = (p_diff * (k - diff_avg) ** 2).sum()
+        diff_entropy = -(p_diff * np.log(p_diff + eps)).sum()
+        hx = -(px * np.log(px + eps)).sum()
+        hy = -(py * np.log(py + eps)).sum()
+        pxpy = px[:, None] * py[None, :]
+        hxy1 = -(p * np.log(pxpy + eps)).sum()
+        hxy2 = -(pxpy * np.log(pxpy + eps)).sum()
+        imc1 = (entropy - hxy1) / max(hx, hy, eps)
+        imc2 = np.sqrt(np.clip(1.0 - np.exp(-2.0 * (hxy2 - entropy)), 0.0, 1.0))
+        acc += np.array([asm, contrast, corr, variance, idm, sum_avg, sum_var,
+                         sum_entropy, entropy, diff_var, diff_entropy, imc1, imc2]) / 4.0
+    return acc
+
+
+_HARALICK_KEYS = [
+    "Texture_angular_second_moment", "Texture_contrast", "Texture_correlation",
+    "Texture_sum_of_squares_variance", "Texture_inverse_difference_moment",
+    "Texture_sum_average", "Texture_sum_variance", "Texture_sum_entropy",
+    "Texture_entropy", "Texture_difference_variance", "Texture_difference_entropy",
+    "Texture_info_measure_corr_1", "Texture_info_measure_corr_2",
+]
+
+
+def test_haralick_golden_vs_numpy_reference(rng):
+    """Fidelity gate (round-1 VERDICT #4): per-object quantization must
+    reproduce an independent numpy implementation of the mahotas-semantics
+    pipeline on a multi-object scene, including an object whose local gray
+    range is a narrow slice of the image's global range."""
+    labels = np.zeros((48, 48), np.int32)
+    labels[4:20, 4:20] = 1     # full-range noise
+    labels[26:42, 26:42] = 2   # narrow-range texture (global quant would crush it)
+    img = np.zeros((48, 48), np.float32)
+    img[4:20, 4:20] = rng.integers(0, 5000, (16, 16))
+    img[26:42, 26:42] = 2000 + rng.integers(0, 64, (16, 16))
+    feats = haralick_features(
+        jnp.asarray(labels), jnp.asarray(img), MAX_OBJ, levels=8
+    )
+    for obj in (1, 2):
+        want = _haralick_reference_numpy(img, labels == obj, levels=8)
+        got = np.array([float(feats[k][obj - 1]) for k in _HARALICK_KEYS])
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_haralick_per_object_quantization_sees_local_contrast(rng):
+    """An object occupying a tiny slice of the global gray range must still
+    spread across quantization bins (the round-1 global-range bug made such
+    objects look flat)."""
+    labels = np.zeros((32, 32), np.int32)
+    labels[8:24, 8:24] = 1
+    img = np.full((32, 32), 0.0, np.float32)
+    img[8:24, 8:24] = 1000 + rng.integers(0, 10, (16, 16))  # 1% of global span
+    img[0, 0] = 100000.0  # blow out the global range
+    feats = haralick_features(jnp.asarray(labels), jnp.asarray(img), MAX_OBJ)
+    assert float(feats["Texture_entropy"][0]) > 1.0
+    assert float(feats["Texture_angular_second_moment"][0]) < 0.5
+
+
+def test_glcm_matmul_matches_scatter(rng):
+    from tmlibrary_tpu.ops.measure import _glcm_matmul, _glcm_scatter, quantize_per_object
+
+    labels = np.zeros((64, 64), np.int32)
+    labels[4:30, 4:30] = 1
+    labels[34:60, 10:50] = 2
+    img = rng.integers(0, 4000, (64, 64)).astype(np.float32)
+    q = quantize_per_object(jnp.asarray(labels), jnp.asarray(img), MAX_OBJ, 16)
+    for off in ((0, 1), (1, 0), (1, 1), (1, -1)):
+        a = np.asarray(_glcm_matmul(jnp.asarray(labels), q, MAX_OBJ, 16, off))
+        b = np.asarray(_glcm_scatter(jnp.asarray(labels), q, MAX_OBJ, 16, off))
+        np.testing.assert_array_equal(a, b)
+
+
+def test_glcm_hand_computed_micro_case():
+    """2x3 image, one object, horizontal direction — GLCM counted by hand."""
+    from tmlibrary_tpu.ops.measure import _glcm_scatter
+
+    labels = jnp.ones((2, 3), jnp.int32)
+    #  q = [[0, 1, 1],
+    #       [2, 0, 1]]
+    q = jnp.asarray([[0, 1, 1], [2, 0, 1]], jnp.int32)
+    glcm = np.asarray(_glcm_scatter(labels, q, 4, 3, (0, 1)))[0]
+    # directed pairs (0,1): (0,1),(1,1),(2,0),(0,1) -> symmetric doubles
+    want = np.zeros((3, 3))
+    for a, b in ((0, 1), (1, 1), (2, 0), (0, 1)):
+        want[a, b] += 1
+    want = want + want.T
+    np.testing.assert_array_equal(glcm, want)
+
+
 def test_zernike_rotation_invariance():
     # |Z_nm| must be (approximately) invariant under rotation of the mask
     yy, xx = np.mgrid[0:64, 0:64]
@@ -120,6 +253,79 @@ def test_zernike_distinguishes_shapes():
     # Z_2_2 captures elongation: near zero for disk, large for ellipse
     assert float(fd["Zernike_2_2"][0]) < 0.05
     assert float(fe["Zernike_2_2"][0]) > 0.1
+
+
+def _zernike_reference_numpy(mask, degree):
+    """Independent numpy Zernike magnitudes with mahotas semantics
+    (``zernike_moments``): unit disk at the object's max centroid distance,
+    mass-normalized projection, ``*(n+1)/pi``."""
+    from math import factorial
+
+    ys, xs = np.nonzero(mask)
+    cy, cx = ys.mean(), xs.mean()
+    r = max(np.sqrt((ys - cy) ** 2 + (xs - cx) ** 2).max(), 1.0)
+    rho = np.sqrt((ys - cy) ** 2 + (xs - cx) ** 2) / r
+    theta = np.arctan2(ys - cy, xs - cx)
+    frac = np.ones(len(ys)) / len(ys)
+    out = {}
+    for n in range(degree + 1):
+        for m in range(n % 2, n + 1, 2):
+            rad = np.zeros_like(rho)
+            for k in range((n - m) // 2 + 1):
+                c = ((-1) ** k * factorial(n - k)) / (
+                    factorial(k)
+                    * factorial((n + m) // 2 - k)
+                    * factorial((n - m) // 2 - k)
+                )
+                rad += c * rho ** (n - 2 * k)
+            z = (frac * rad * np.exp(-1j * m * theta)).sum() * (n + 1) / np.pi
+            out[f"Zernike_{n}_{m}"] = abs(z)
+    return out
+
+
+def test_zernike_golden_vs_numpy_reference():
+    """Fidelity gate (round-1 VERDICT missing item #5): device Zernike must
+    reproduce the mahotas-semantics numpy implementation exactly."""
+    yy, xx = np.mgrid[0:96, 0:96]
+    labels = np.zeros((96, 96), np.int32)
+    ellipse = (((xx - 30) / 16.0) ** 2 + ((yy - 28) / 8.0) ** 2) <= 1.0
+    labels[ellipse] = 1
+    crescent = (((xx - 66) ** 2 + (yy - 66) ** 2) <= 196) & ~(
+        ((xx - 72) ** 2 + (yy - 62) ** 2) <= 120
+    )
+    labels[crescent & (labels == 0)] = 2
+    feats = zernike_features(jnp.asarray(labels), MAX_OBJ, degree=6)
+    for obj, mask in ((1, labels == 1), (2, labels == 2)):
+        want = _zernike_reference_numpy(mask, 6)
+        for k, v in want.items():
+            got = float(feats[k][obj - 1])
+            np.testing.assert_allclose(got, v, rtol=2e-3, atol=2e-4), k
+
+
+def test_zernike_oversize_object_not_cropped():
+    """Objects larger than the old 64-px static patch must measure exactly
+    (the round-1 implementation silently cropped them)."""
+    yy, xx = np.mgrid[0:160, 0:160]
+    big = (((xx - 80) / 70.0) ** 2 + ((yy - 80) / 35.0) ** 2) <= 1.0
+    feats = zernike_features(jnp.asarray(big.astype(np.int32)), 4, degree=4)
+    want = _zernike_reference_numpy(big, 4)
+    for k, v in want.items():
+        np.testing.assert_allclose(float(feats[k][0]), v, rtol=2e-3, atol=2e-4)
+    # scale quasi-invariance: the same shape at 1/4 area gives close moments
+    small = (((xx - 40) / 35.0) ** 2 + ((yy - 40) / 17.5) ** 2) <= 1.0
+    f_small = zernike_features(jnp.asarray(small.astype(np.int32)), 4, degree=4)
+    for k in want:
+        assert abs(float(feats[k][0]) - float(f_small[k][0])) < 0.02, k
+
+
+def test_zernike_disk_analytic_values():
+    """Uniform disk: Z_00 = 1/pi (mass-normalized), all higher moments ~0
+    except radial aliasing at the pixel level."""
+    yy, xx = np.mgrid[0:64, 0:64]
+    disk = ((xx - 32) ** 2 + (yy - 32) ** 2) <= 20**2
+    feats = zernike_features(jnp.asarray(disk.astype(np.int32)), 4, degree=2)
+    np.testing.assert_allclose(float(feats["Zernike_0_0"][0]), 1 / np.pi, rtol=1e-3)
+    assert float(feats["Zernike_2_2"][0]) < 0.02
 
 
 def test_measure_under_jit_vmap(labeled_scene):

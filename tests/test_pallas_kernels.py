@@ -141,6 +141,24 @@ def test_distance_transform_matches_xla(rng):
     np.testing.assert_array_equal(got[interior], dist_cheb[interior])
 
 
+def test_distance_transform_border_touching_mask(rng):
+    """Masks touching the image border must not erode from the edge side:
+    both paths treat out-of-image neighbors as foreground."""
+    from tmlibrary_tpu.ops.pallas_kernels import distance_transform
+    from tmlibrary_tpu.ops.segment_primary import distance_transform_approx
+
+    mask = np.zeros((64, 64), bool)
+    mask[0:12, 0:12] = True      # corner blob
+    mask[50:64, 20:40] = True    # bottom-edge blob
+    mask[:, 60:64] = True        # full-height right stripe
+    got = np.asarray(distance_transform(mask, interpret=True))
+    want = np.asarray(distance_transform_approx(mask, method="xla"))
+    np.testing.assert_array_equal(got, want)
+    # the corner pixel is insulated by the border on two sides: its
+    # distance must reflect only the in-image background
+    assert got[0, 0] == min(12, 12)
+
+
 def test_distance_transform_through_dispatch(rng):
     from tmlibrary_tpu.ops.segment_primary import distance_transform_approx
 

@@ -121,3 +121,41 @@ def test_segment_under_jit_vmap():
     cnt, cells = run(batch_nuc, batch_cell)
     assert list(np.asarray(cnt)) == [2, 2]
     assert np.asarray(cells).shape == (2, 64, 64)
+
+
+def test_declump_labels_in_scan_order():
+    """Declumped labels must follow scipy scan order (first pixel in
+    row-major order -> label 1), not seed-peak discovery order
+    (round-1 VERDICT weak item #7)."""
+    from tmlibrary_tpu.ops.label import relabel_by_scan_order
+
+    # two touching disks, the later-scanned one has the HIGHER peak so a
+    # naive seed order would invert the ids
+    yy, xx = np.mgrid[0:64, 0:64]
+    img = np.zeros((64, 64), np.float32)
+    img[((yy - 40) ** 2 + (xx - 22) ** 2) <= 100] = 2000.0
+    img[((yy - 20) ** 2 + (xx - 34) ** 2) <= 100] = 1500.0
+    labels, count = segment_primary(
+        jnp.asarray(img), threshold_method="manual", threshold_value=500.0,
+        smooth_sigma=0.0, declump=True, declump_min_distance=6, max_objects=8,
+    )
+    labels = np.asarray(labels)
+    assert int(count) == 2
+    # label 1 owns the first foreground pixel in scan order
+    first_pix = np.argwhere(labels > 0)[0]
+    assert labels[tuple(first_pix)] == 1
+    # ids are ordered by each region's min linear index
+    firsts = [np.flatnonzero((labels == l).ravel())[0] for l in (1, 2)]
+    assert firsts == sorted(firsts)
+
+
+def test_relabel_by_scan_order_matches_scipy_convention(rng):
+    from tmlibrary_tpu.ops.label import relabel_by_scan_order
+
+    # random permuted labeling of scipy components must map back exactly
+    mask = ndi.binary_dilation(rng.random((48, 48)) > 0.92, iterations=2)
+    want, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    perm = np.concatenate([[0], rng.permutation(n) + 1])
+    scrambled = perm[want]
+    got = np.asarray(relabel_by_scan_order(jnp.asarray(scrambled), 64))
+    np.testing.assert_array_equal(got, want)

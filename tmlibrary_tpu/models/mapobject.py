@@ -137,8 +137,10 @@ def plate_mosaic_shape(
 
 
 def _rect(y0: int, x0: int, y1: int, x1: int) -> np.ndarray:
-    """Closed CCW rectangle outline, (5, 2) [y, x] int32 — same vertex
-    convention as ops.polygons traces."""
+    """Closed rectangle outline, (5, 2) [y, x] int32 — same vertex
+    convention as ops.polygons traces.  The winding is counter-clockwise
+    in y-down image coordinates (equivalently clockwise in math-convention
+    y-up axes); signed-area consumers must account for the y-down frame."""
     return np.array(
         [[y0, x0], [y1, x0], [y1, x1], [y0, x1], [y0, x0]], dtype=np.int32
     )

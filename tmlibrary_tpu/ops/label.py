@@ -267,3 +267,30 @@ def filter_by_area(
 def clip_label_count(labels: jax.Array, max_objects: int) -> jax.Array:
     """Zero out labels beyond ``max_objects`` (static-shape safety valve)."""
     return jnp.where(labels <= max_objects, labels, 0)
+
+
+def relabel_by_scan_order(labels: jax.Array, max_labels: int) -> jax.Array:
+    """Renumber labels 1..K by each region's first pixel in row-major scan
+    order — scipy's assignment order.  Watershed/declump outputs carry seed
+    scan order, which deviates from the bit-identical gate
+    (``scipy.ndimage.label`` semantics); one compaction pass reconciles
+    them.  Absent label ids map to 0.  jit/vmap-safe, static shapes."""
+    labels = jnp.asarray(labels, jnp.int32)
+    h, w = labels.shape
+    big = jnp.int32(h * w)
+    linear = jnp.arange(h * w, dtype=jnp.int32)
+    first = jax.ops.segment_min(
+        linear, labels.reshape(-1), num_segments=max_labels + 1
+    )[1:]  # (max_labels,) min linear index per label; h*w-clamped if absent
+    first = jnp.minimum(first, big)
+    order = jnp.argsort(first)  # label-1 ids sorted by first pixel
+    ranks = (
+        jnp.zeros((max_labels,), jnp.int32)
+        .at[order]
+        .set(jnp.arange(1, max_labels + 1, dtype=jnp.int32))
+    )
+    present = first < big
+    mapping = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.where(present, ranks, 0)]
+    )
+    return mapping[jnp.clip(labels, 0, max_labels)]

@@ -214,9 +214,12 @@ def _distance_kernel(mask_ref, out_ref, *, max_distance: int):
     mask = mask_ref[:] != 0
 
     def erode(cur):
+        # out-of-image neighbors count as foreground (fill=1) to match the
+        # XLA golden ``binary_erode``'s border=True convention — masks that
+        # touch the image edge must not erode from the edge side
         out = cur
         for dy, dx in _shifts_for(8):
-            out = out & (_shift_fill(cur.astype(jnp.int32), dy, dx, 0, h, w) != 0)
+            out = out & (_shift_fill(cur.astype(jnp.int32), dy, dx, 1, h, w) != 0)
         return out
 
     def cond(state):

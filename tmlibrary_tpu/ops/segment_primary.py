@@ -131,9 +131,13 @@ def segment_primary(
             dist, mask, min_distance=declump_min_distance,
             smooth_sigma=declump_min_distance / 2.0,
         )
-        # note: watershed labels carry seed ids (peak scan order), not
-        # connected-component scan order
         labels = watershed_from_seeds(dist, seeds, mask)
+        # watershed labels carry seed ids (peak scan order); re-rank by
+        # each region's first pixel so declumped output keeps the
+        # scipy-scan-order convention of the bit-identical gate.  Clip
+        # first: ids beyond capacity must drop, not alias onto the last id.
+        labels = label_ops.clip_label_count(labels, max_objects)
+        labels = label_ops.relabel_by_scan_order(labels, max_objects)
     labels = label_ops.clip_label_count(labels, max_objects)
     if min_area > 0 or max_area is not None:
         labels = label_ops.filter_by_area(

@@ -148,6 +148,20 @@ class ImageAnalysisRunner(Step):
             for obj, feats in result.measurements.items()
         }
 
+        # solidity is hull-based and ragged, so it is measured host-side on
+        # the exported label images and joined into the morphology features
+        # (reference: jtlib/features/morphology solidity via regionprops)
+        from tmlibrary_tpu.native import solidity_host
+
+        max_obj = args["max_objects"]
+        for name, feats in measurements.items():
+            if "Morphology_area" in feats and objects.get(name) is not None \
+                    and objects[name].ndim == 3:
+                feats["Morphology_solidity"] = np.stack(
+                    [solidity_host(objects[name][b], max_obj)
+                     for b in range(n_valid)]
+                )
+
         # ------------------------------------------------------------ persist
         for name, labels in objects.items():
             if labels.ndim == 4:  # (B, Z, H, W) volume labels: one stack per z
