@@ -2,11 +2,16 @@
 """One-command TPU tuning sweep (run when the chip is available):
 
 1. bench batch-size sweep (64/128/256) for the default config;
-2. XLA vs pallas kernel timing for CC labeling and watershed;
-3. prints the recommended defaults.
+2. XLA vs pallas kernel timing for CC labeling, watershed and the
+   distance transform;
+3. GLCM accumulation shootout: one-hot matmul (MXU) vs scatter-add;
+4. writes every number to ``tuning/TUNING.json`` (committed — it is the
+   data-driven default for ``pallas_enabled()`` and the GLCM method) and
+   prints the recommended defaults.
 
 Usage: python scripts/tune_tpu.py
 """
+import json
 import os
 import subprocess
 import sys
@@ -15,30 +20,49 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS: dict = {}
 
 
 def run_bench(env_overrides):
     env = dict(os.environ, **{k: str(v) for k, v in env_overrides.items()})
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
-        capture_output=True, text=True, env=env, timeout=1200,
+        capture_output=True, text=True, env=env, timeout=2400,
     )
     for line in out.stdout.splitlines():
         if line.startswith("{"):
-            import json
-
             return json.loads(line)
     raise RuntimeError(f"bench failed: {out.stderr[-500:]}")
+
+
+def _bench_fn(name, fn, *args, batch=None):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    wrapped = jax.jit(
+        lambda *a: sum(jnp.sum(jnp.asarray(l, jnp.float32))
+                       for l in jax.tree_util.tree_leaves(fn(*a)))
+    )
+    np.asarray(wrapped(*args))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(wrapped(*args))
+        best = min(best, time.perf_counter() - t0)
+    rate = f" ({batch/best:7.1f} sites/s)" if batch else ""
+    print(f"  {name:32s} {best*1e3:8.2f} ms{rate}")
+    return best
 
 
 def kernel_shootout():
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from tmlibrary_tpu.benchmarks import synthetic_cell_painting_batch
     from tmlibrary_tpu.ops import threshold as thr
     from tmlibrary_tpu.ops.label import connected_components
+    from tmlibrary_tpu.ops.segment_primary import distance_transform_approx
     from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
     from tmlibrary_tpu.ops.smooth import gaussian_smooth
 
@@ -51,58 +75,108 @@ def kernel_shootout():
     sm = jax.jit(v(lambda im: gaussian_smooth(im, 1.5)))(dapi)
     masks = jax.jit(v(thr.threshold_otsu))(sm)
 
-    def bench_fn(name, fn, *args):
-        wrapped = jax.jit(
-            lambda *a: sum(jnp.sum(jnp.asarray(l, jnp.float32))
-                           for l in jax.tree_util.tree_leaves(fn(*a)))
-        )
-        np.asarray(wrapped(*args))
-        best = float("inf")
-        for _ in range(3):
-            t0 = time.perf_counter()
-            np.asarray(wrapped(*args))
-            best = min(best, time.perf_counter() - t0)
-        print(f"  {name:32s} {best*1e3:8.2f} ms ({B/best:7.1f} sites/s)")
-        return best
-
     print("CC labeling:")
-    t_x = bench_fn("xla", v(lambda m: connected_components(m, method='xla')[0]), masks)
-    t_p = bench_fn("pallas", v(lambda m: connected_components(m, method='pallas')[0]), masks)
+    t_x = _bench_fn("xla", v(lambda m: connected_components(m, method='xla')[0]), masks, batch=B)
+    t_p = _bench_fn("pallas", v(lambda m: connected_components(m, method='pallas')[0]), masks, batch=B)
     nuclei = jax.jit(v(lambda m: connected_components(m, method='xla')[0]))(masks)
     print("watershed (16 levels):")
-    w_x = bench_fn(
+    w_x = _bench_fn(
         "xla",
         v(lambda l, im: watershed_from_seeds(
             im, l, thr.threshold_otsu(im, correction_factor=0.8),
             n_levels=16, method='xla')),
-        nuclei, actin,
+        nuclei, actin, batch=B,
     )
-    w_p = bench_fn(
+    w_p = _bench_fn(
         "pallas",
         v(lambda l, im: watershed_from_seeds(
             im, l, thr.threshold_otsu(im, correction_factor=0.8),
             n_levels=16, method='pallas')),
-        nuclei, actin,
+        nuclei, actin, batch=B,
     )
-    return t_p < t_x and w_p < w_x
+    print("distance transform:")
+    d_x = _bench_fn("xla", v(lambda m: distance_transform_approx(m, method='xla')), masks, batch=B)
+    d_p = _bench_fn("pallas", v(lambda m: distance_transform_approx(m, method='pallas')), masks, batch=B)
+    RESULTS["kernels_ms"] = {
+        "cc_xla": t_x * 1e3, "cc_pallas": t_p * 1e3,
+        "watershed_xla": w_x * 1e3, "watershed_pallas": w_p * 1e3,
+        "distance_xla": d_x * 1e3, "distance_pallas": d_p * 1e3,
+    }
+    return (t_p + w_p + d_p) < (t_x + w_x + d_x)
+
+
+def glcm_shootout():
+    """Measured matmul-vs-scatter GLCM numbers (round-1 VERDICT item #7)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.benchmarks import synthetic_cell_painting_batch
+    from tmlibrary_tpu.ops import threshold as thr
+    from tmlibrary_tpu.ops.label import connected_components
+    from tmlibrary_tpu.ops.measure import haralick_features
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+    B, M, L = 64, 64, 32
+    data = synthetic_cell_painting_batch(B, size=256)
+    dapi = jnp.asarray(data["DAPI"])
+    actin = jnp.asarray(data["Actin"])
+    v = jax.vmap
+    sm = jax.jit(v(lambda im: gaussian_smooth(im, 1.5)))(dapi)
+    labels = jax.jit(v(lambda im: connected_components(
+        thr.threshold_otsu(im), method='xla')[0]))(sm)
+
+    print(f"GLCM haralick (batch {B}, {M} objects, {L} levels):")
+    g_m = _bench_fn(
+        "matmul", v(lambda l, im: haralick_features(
+            l, im, M, levels=L, glcm_method="matmul")), labels, actin, batch=B)
+    g_s = _bench_fn(
+        "scatter", v(lambda l, im: haralick_features(
+            l, im, M, levels=L, glcm_method="scatter")), labels, actin, batch=B)
+    RESULTS["glcm_ms"] = {"matmul": g_m * 1e3, "scatter": g_s * 1e3}
+    return g_m < g_s
 
 
 def main():
+    import jax
+
+    RESULTS["backend"] = jax.default_backend()
+    RESULTS["device"] = str(jax.devices()[0])
+
     print("== batch sweep (config 3) ==")
     best = None
+    sweep = {}
     for batch in (64, 128, 256):
-        r = run_bench({"BENCH_BATCH": batch})
+        r = run_bench({"BENCH_BATCH": batch, "BENCH_ATTEMPTS": "1"})
         print(f"  batch={batch}: {r['value']} sites/s")
+        sweep[batch] = r["value"]
         if best is None or r["value"] > best[1]:
             best = (batch, r["value"])
+    RESULTS["batch_sweep"] = sweep
+    RESULTS["best_batch"] = best[0]
     print(f"best batch: {best[0]} ({best[1]} sites/s)")
 
     print("== pallas shootout ==")
     pallas_wins = kernel_shootout()
+    RESULTS["pallas_wins"] = bool(pallas_wins)
     print(f"pallas wins: {pallas_wins}")
+
+    print("== glcm shootout ==")
+    matmul_wins = glcm_shootout()
+    RESULTS["glcm_matmul_wins"] = bool(matmul_wins)
+    print(f"glcm matmul wins: {matmul_wins}")
+
     if pallas_wins:
-        r = run_bench({"BENCH_BATCH": best[0], "TMX_PALLAS": "1"})
+        r = run_bench({"BENCH_BATCH": best[0], "TMX_PALLAS": "1",
+                       "BENCH_ATTEMPTS": "1"})
+        RESULTS["bench_with_pallas"] = r["value"]
         print(f"bench with TMX_PALLAS=1: {r['value']} sites/s")
+
+    out_dir = os.path.join(REPO, "tuning")
+    os.makedirs(out_dir, exist_ok=True)
+    out_path = os.path.join(out_dir, "TUNING.json")
+    with open(out_path, "w") as f:
+        json.dump(RESULTS, f, indent=2, sort_keys=True)
+    print(f"wrote {out_path} — commit it to make these the defaults")
 
 
 if __name__ == "__main__":

@@ -314,9 +314,16 @@ def _glcm(
     """Per-object symmetric co-occurrence counts for one direction →
     (max_objects, levels, levels).  ``method``: ``"matmul"`` rides the MXU
     (TPU default), ``"scatter"`` uses segment_sum (CPU default), ``"auto"``
-    picks by backend."""
+    picks by backend — overridden by the committed hardware-tuning verdict
+    (``tuning/TUNING.json`` ``glcm_matmul_wins``) when present."""
     if method == "auto":
-        method = "matmul" if jax.default_backend() not in ("cpu",) else "scatter"
+        if jax.default_backend() == "cpu":
+            method = "scatter"
+        else:
+            from tmlibrary_tpu.ops.pallas_kernels import _tuning_results
+
+            wins = _tuning_results().get("glcm_matmul_wins")
+            method = "matmul" if wins in (None, True) else "scatter"
     fn = _glcm_matmul if method == "matmul" else _glcm_scatter
     return fn(labels, quantized, max_objects, levels, offset)
 

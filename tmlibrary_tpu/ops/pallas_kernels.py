@@ -255,14 +255,37 @@ def distance_transform(
 
 
 # ------------------------------------------------------------------ dispatch
+@functools.lru_cache(maxsize=1)
+def _tuning_results() -> dict:
+    """Committed hardware-tuning measurements (``tuning/TUNING.json``,
+    written by ``scripts/tune_tpu.py`` on a real chip); {} if absent."""
+    import json
+    import pathlib
+
+    path = (
+        pathlib.Path(__file__).resolve().parent.parent.parent
+        / "tuning"
+        / "TUNING.json"
+    )
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
 def pallas_enabled() -> bool:
     """Whether ``method="auto"`` dispatches to the pallas kernels.
 
-    Opt-in via ``TMX_PALLAS=1`` on TPU-class backends (the XLA twins are
-    the portable path and the golden reference); CPU/GPU always use XLA.
+    Resolution order on TPU-class backends: the ``TMX_PALLAS`` env var
+    (explicit override) → the committed hardware tuning verdict
+    (``tuning/TUNING.json`` ``pallas_wins``) → off.  CPU/GPU always use
+    the XLA twins (the portable path and the golden reference).
     """
     import os
 
     if jax.default_backend() in ("cpu", "gpu"):
         return False
-    return os.environ.get("TMX_PALLAS", "0") not in ("0", "false", "no")
+    env = os.environ.get("TMX_PALLAS")
+    if env is not None:
+        return env not in ("0", "false", "no")
+    return bool(_tuning_results().get("pallas_wins", False))

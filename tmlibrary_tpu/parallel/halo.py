@@ -111,3 +111,40 @@ def sharded_downsample_2x(image: jax.Array, mesh: Mesh, axis: str = "rows") -> j
         out_specs=PartitionSpec(axis),
     )
     return jax.jit(mapped)(image)
+
+
+def sharded_pyramid_levels(
+    mosaic: jax.Array, mesh: Mesh, n_levels: int | None = None, axis: str = "rows"
+) -> list[jax.Array]:
+    """Full pyramid level chain over a row-sharded mosaic — the distributed
+    twin of ``ops.pyramid.pyramid_levels`` (reference: illuminati's
+    per-level job waves, SURVEY.md §4.5, re-expressed as mesh-sharded
+    ``reduce_window`` steps).
+
+    Levels stay sharded while each shard keeps an even row count (2x2
+    windows then never straddle shard seams, so every sharded level is
+    bit-identical to the single-device chain); the small tail levels fall
+    back to plain ``downsample_2x`` — XLA gathers the by-then-tiny array
+    automatically.  Level 0 (native resolution) is returned sharded.
+    """
+    from jax.sharding import NamedSharding
+
+    from tmlibrary_tpu.ops.pyramid import downsample_2x, n_pyramid_levels
+
+    mosaic = jnp.asarray(mosaic, jnp.float32)
+    if n_levels is None:
+        n_levels = n_pyramid_levels(*mosaic.shape)
+    n = mesh.devices.size
+    h = mosaic.shape[0]
+    if h % n == 0:
+        mosaic = jax.device_put(mosaic, NamedSharding(mesh, PartitionSpec(axis)))
+    levels = [mosaic]
+    plain = jax.jit(downsample_2x)
+    for _ in range(n_levels - 1):
+        cur = levels[-1]
+        h = cur.shape[0]
+        if h % n == 0 and (h // n) % 2 == 0:
+            levels.append(sharded_downsample_2x(cur, mesh, axis))
+        else:
+            levels.append(plain(cur))
+    return levels

@@ -38,6 +38,9 @@ class PyramidBuilder(Step):
                  help="upper clip percentile for display rescale"),
         Argument("batch_size", int, default=32, help="sites per device batch"),
         Argument("cycle", int, default=0, help="cycle to tile"),
+        Argument("n_devices", int, default=1,
+                 help="row-shard the mosaic pyramid over this many devices "
+                      "(mosaics larger than one chip's HBM)"),
     )
 
     def create_batches(self, args):
@@ -117,7 +120,16 @@ class PyramidBuilder(Step):
             lower = float(np.percentile(mosaic, 0.1))
             upper = float(np.percentile(mosaic, args["clip_percent"]))
 
-        levels = pyramid_levels(jnp.asarray(mosaic))
+        n_dev = min(args["n_devices"], len(jax.devices()))
+        if n_dev > 1:
+            from jax.sharding import Mesh
+
+            from tmlibrary_tpu.parallel.halo import sharded_pyramid_levels
+
+            mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("rows",))
+            levels = sharded_pyramid_levels(jnp.asarray(mosaic), mesh)
+        else:
+            levels = pyramid_levels(jnp.asarray(mosaic))
         out_dir = self.store.root / "pyramids" / f"channel{channel:02d}"
         n_tiles = 0
         for li, level in enumerate(levels):
