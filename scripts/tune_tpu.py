@@ -36,6 +36,8 @@ def run_bench(env_overrides):
 
 
 def _bench_fn(name, fn, *args, batch=None):
+    """Best-of-3 timing; a kernel that fails to compile on the hardware
+    records inf (and the error in RESULTS) instead of killing the sweep."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -44,7 +46,13 @@ def _bench_fn(name, fn, *args, batch=None):
         lambda *a: sum(jnp.sum(jnp.asarray(l, jnp.float32))
                        for l in jax.tree_util.tree_leaves(fn(*a)))
     )
-    np.asarray(wrapped(*args))
+    try:
+        np.asarray(wrapped(*args))
+    except Exception as exc:  # Mosaic/XLA compile or runtime failure
+        msg = str(exc).splitlines()[0][:200]
+        print(f"  {name:32s} FAILED: {msg}")
+        RESULTS.setdefault("kernel_errors", {})[name] = msg
+        return float("inf")
     best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
@@ -76,27 +84,27 @@ def kernel_shootout():
     masks = jax.jit(v(thr.threshold_otsu))(sm)
 
     print("CC labeling:")
-    t_x = _bench_fn("xla", v(lambda m: connected_components(m, method='xla')[0]), masks, batch=B)
-    t_p = _bench_fn("pallas", v(lambda m: connected_components(m, method='pallas')[0]), masks, batch=B)
+    t_x = _bench_fn("cc_xla", v(lambda m: connected_components(m, method='xla')[0]), masks, batch=B)
+    t_p = _bench_fn("cc_pallas", v(lambda m: connected_components(m, method='pallas')[0]), masks, batch=B)
     nuclei = jax.jit(v(lambda m: connected_components(m, method='xla')[0]))(masks)
     print("watershed (16 levels):")
     w_x = _bench_fn(
-        "xla",
+        "ws_xla",
         v(lambda l, im: watershed_from_seeds(
             im, l, thr.threshold_otsu(im, correction_factor=0.8),
             n_levels=16, method='xla')),
         nuclei, actin, batch=B,
     )
     w_p = _bench_fn(
-        "pallas",
+        "ws_pallas",
         v(lambda l, im: watershed_from_seeds(
             im, l, thr.threshold_otsu(im, correction_factor=0.8),
             n_levels=16, method='pallas')),
         nuclei, actin, batch=B,
     )
     print("distance transform:")
-    d_x = _bench_fn("xla", v(lambda m: distance_transform_approx(m, method='xla')), masks, batch=B)
-    d_p = _bench_fn("pallas", v(lambda m: distance_transform_approx(m, method='pallas')), masks, batch=B)
+    d_x = _bench_fn("dt_xla", v(lambda m: distance_transform_approx(m, method='xla')), masks, batch=B)
+    d_p = _bench_fn("dt_pallas", v(lambda m: distance_transform_approx(m, method='pallas')), masks, batch=B)
     RESULTS["kernels_ms"] = {
         "cc_xla": t_x * 1e3, "cc_pallas": t_p * 1e3,
         "watershed_xla": w_x * 1e3, "watershed_pallas": w_p * 1e3,
@@ -127,10 +135,10 @@ def glcm_shootout():
 
     print(f"GLCM haralick (batch {B}, {M} objects, {L} levels):")
     g_m = _bench_fn(
-        "matmul", v(lambda l, im: haralick_features(
+        "glcm_matmul", v(lambda l, im: haralick_features(
             l, im, M, levels=L, glcm_method="matmul")), labels, actin, batch=B)
     g_s = _bench_fn(
-        "scatter", v(lambda l, im: haralick_features(
+        "glcm_scatter", v(lambda l, im: haralick_features(
             l, im, M, levels=L, glcm_method="scatter")), labels, actin, batch=B)
     RESULTS["glcm_ms"] = {"matmul": g_m * 1e3, "scatter": g_s * 1e3}
     return g_m < g_s
@@ -171,11 +179,25 @@ def main():
         RESULTS["bench_with_pallas"] = r["value"]
         print(f"bench with TMX_PALLAS=1: {r['value']} sites/s")
 
+    write_results()
+
+
+def write_results():
+    """Write TUNING.json with inf (failed kernels) mapped to null so the
+    committed file stays strict JSON."""
+
+    def clean(o):
+        if isinstance(o, dict):
+            return {k: clean(v) for k, v in o.items()}
+        if isinstance(o, float) and (o != o or o in (float("inf"), float("-inf"))):
+            return None
+        return o
+
     out_dir = os.path.join(REPO, "tuning")
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, "TUNING.json")
     with open(out_path, "w") as f:
-        json.dump(RESULTS, f, indent=2, sort_keys=True)
+        json.dump(clean(RESULTS), f, indent=2, sort_keys=True, allow_nan=False)
     print(f"wrote {out_path} — commit it to make these the defaults")
 
 

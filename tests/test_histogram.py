@@ -1,0 +1,45 @@
+"""Factored one-hot matmul histogram vs bincount golden.
+
+Reference parity: the histogram computations inside Otsu thresholding and
+corilla's percentile statistics (SURVEY.md §3 corilla row).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.ops.histogram import histogram_fixed_bins, _factor
+
+
+@pytest.mark.parametrize("bins", [16, 256, 100, 65536])
+def test_factor(bins):
+    a, b = _factor(bins)
+    assert a * b == bins
+
+
+@pytest.mark.parametrize("bins", [256, 100])
+@pytest.mark.parametrize("method", ["matmul", "scatter"])
+def test_matches_bincount(bins, method, rng):
+    idx = rng.integers(0, bins, size=20_001).astype(np.int32)
+    out = np.asarray(histogram_fixed_bins(jnp.asarray(idx), bins, method=method))
+    golden = np.bincount(idx, minlength=bins).astype(np.float32)
+    assert np.array_equal(out, golden)
+
+
+def test_weighted(rng):
+    bins = 64
+    idx = rng.integers(0, bins, size=5000).astype(np.int32)
+    w = rng.random(5000).astype(np.float32)
+    out = np.asarray(
+        histogram_fixed_bins(jnp.asarray(idx), bins, weights=jnp.asarray(w),
+                             method="matmul")
+    )
+    golden = np.bincount(idx, weights=w, minlength=bins).astype(np.float32)
+    np.testing.assert_allclose(out, golden, rtol=1e-5, atol=1e-4)
+
+
+def test_big_bins_65536(rng):
+    """The corilla 65536-bin exact-percentile domain."""
+    idx = rng.integers(0, 65536, size=4096).astype(np.int32)
+    out = np.asarray(histogram_fixed_bins(jnp.asarray(idx), 65536, method="matmul"))
+    golden = np.bincount(idx, minlength=65536).astype(np.float32)
+    assert np.array_equal(out, golden)

@@ -345,3 +345,44 @@ def test_measure_under_jit_vmap(labeled_scene):
         np.asarray(feats["Intensity_mean"][0]) * 2.0,
         rtol=1e-5,
     )
+
+
+def test_intensity_quantiles_match_numpy(rng):
+    """Histogram-read quantiles vs numpy per-object percentiles."""
+    import numpy as np
+
+    from tmlibrary_tpu.ops.measure import intensity_quantiles
+
+    labels = np.zeros((64, 64), np.int32)
+    labels[4:20, 4:24] = 1
+    labels[30:60, 10:40] = 2
+    img = rng.integers(100, 4000, (64, 64)).astype(np.float32)
+
+    out = {k: np.asarray(v) for k, v in intensity_quantiles(
+        labels, img, max_objects=4).items()}
+    for lab in (1, 2):
+        vals = img[labels == lab]
+        lo, hi = vals.min(), vals.max()
+        tol = (hi - lo) / 255.0 + 1e-3  # one histogram bucket
+        assert abs(out["Intensity_median"][lab - 1]
+                   - np.percentile(vals, 50, method="inverted_cdf")) <= tol
+        assert abs(out["Intensity_p25"][lab - 1]
+                   - np.percentile(vals, 25, method="inverted_cdf")) <= tol
+        assert abs(out["Intensity_p75"][lab - 1]
+                   - np.percentile(vals, 75, method="inverted_cdf")) <= tol
+    # absent object rows are zeroed
+    assert out["Intensity_median"][2] == 0.0
+
+
+def test_intensity_quantiles_constant_object():
+    """An object with one gray value reports that value at every quantile."""
+    import numpy as np
+
+    from tmlibrary_tpu.ops.measure import intensity_quantiles
+
+    labels = np.zeros((16, 16), np.int32)
+    labels[2:10, 2:10] = 1
+    img = np.full((16, 16), 7.0, np.float32)
+    out = intensity_quantiles(labels, img, max_objects=2)
+    assert float(out["Intensity_median"][0]) == 7.0
+    assert float(out["Intensity_p25"][0]) == 7.0

@@ -441,3 +441,227 @@ def test_metamorph_two_nd_files_distinct_sites(tmp_path):
     assert skipped == 0 and len(entries) == 2
     coords = {(e["well_row"], e["well_col"], e["site"]) for e in entries}
     assert coords == {(0, 0, 0), (0, 0, 1)}
+
+
+# ------------------------------------------------------------------ harmony
+HARMONY_INDEX = """<?xml version="1.0" encoding="utf-8"?>
+<EvaluationInputData xmlns="http://www.perkinelmer.com/PEHH/HarmonyV5">
+  <Plates><Plate><PlateID>plate1</PlateID></Plate></Plates>
+  <Images>
+{records}
+  </Images>
+</EvaluationInputData>
+"""
+
+HARMONY_REC = """    <Image Version="1">
+      <URL>{name}</URL>
+      <Row>{row}</Row><Col>{col}</Col>
+      <FieldID>{field}</FieldID>
+      <PlaneID>{plane}</PlaneID>
+      <TimepointID>{tp}</TimepointID>
+      <ChannelID>{ch}</ChannelID>
+      <ChannelName>{chname}</ChannelName>
+      <PositionX Unit="m">{x}</PositionX>
+      <PositionY Unit="m">{y}</PositionY>
+    </Image>"""
+
+
+def _write_harmony_dataset(root):
+    """1 well x 2 fields x 2 channels x 2 z-planes, Harmony v5 layout."""
+    import cv2
+
+    images = root / "Images"
+    images.mkdir()
+    records = []
+    for field in (1, 2):
+        for ch, chname in ((1, "HOECHST 33342"), (2, "Alexa 488")):
+            for plane in (1, 2):
+                name = f"r02c03f{field:02d}p{plane:02d}-ch{ch}sk1fk1fl1.tiff"
+                records.append(
+                    HARMONY_REC.format(
+                        name=name, row=2, col=3, field=field, plane=plane,
+                        tp=1, ch=ch, chname=chname,
+                        x=0.001 * field, y=0.0,
+                    )
+                )
+                cv2.imwrite(
+                    str(images / name), np.full((16, 16), 50 * ch, np.uint16)
+                )
+    (images / "Index.idx.xml").write_text(
+        HARMONY_INDEX.format(records="\n".join(records))
+    )
+
+
+def test_parse_harmony_index(tmp_path):
+    from tmlibrary_tpu.workflow.steps.vendors import parse_harmony_index
+
+    _write_harmony_dataset(tmp_path)
+    entries = parse_harmony_index(tmp_path / "Images" / "Index.idx.xml")
+    assert len(entries) == 2 * 2 * 2
+    e = entries[0]
+    assert e["well_row"] == 1 and e["well_col"] == 2
+    assert e["site"] == 0 and e["zplane"] == 0
+    assert e["tpoint"] == 0  # 1-based TimepointID normalised by min
+    assert e["channel"] == "HOECHST 33342"
+
+
+def test_metaconfig_harmony_sidecar(tmp_path):
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    _write_harmony_dataset(src)
+    root = tmp_path / "exp"
+    store = _empty_store(root, "harmonytest")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "harmony"})
+    result = step.run(0)
+    assert result["n_files"] == 8
+    exp = ExperimentStore.open(root).experiment
+    assert {c.name for c in exp.channels} == {"HOECHST 33342", "Alexa 488"}
+    assert exp.n_sites == 2
+    assert exp.n_zplanes == 2
+
+
+# -------------------------------------------------------------- imagexpress
+HTD = '\n'.join([
+    '"Description", HTS',
+    '"TimePoints", 1',
+    '"XWells", 24',
+    '"YWells", 16',
+    '"XSites", 2',
+    '"YSites", 2',
+    '"SiteSelection1", TRUE, TRUE',
+    '"SiteSelection2", TRUE, FALSE',
+    '"NWavelengths", 2',
+    '"WaveName1", "DAPI"',
+    '"WaveName2", "FITC"',
+    '"EndFile",',
+])
+
+
+def _write_ixp_dataset(root):
+    """2 wells x 3 selected sites x 2 waves, MetaXpress naming with GUIDs."""
+    import cv2
+
+    (root / "plate.HTD").write_text(HTD)
+    guid = "8FA43E10-7698-4E3B-9BAD-F1AD342D8E71"
+    for well in ("B02", "B03"):
+        for site in (1, 2, 3):
+            for wave in (1, 2):
+                name = f"exp1_{well}_s{site}_w{wave}{guid}.tif"
+                cv2.imwrite(
+                    str(root / name), np.full((16, 16), 10 * wave, np.uint16)
+                )
+                # thumbnails must be ignored
+                cv2.imwrite(
+                    str(root / f"exp1_{well}_s{site}_w{wave}_thumb{guid}.tif"),
+                    np.full((4, 4), 1, np.uint16),
+                )
+
+
+def test_parse_htd(tmp_path):
+    from tmlibrary_tpu.workflow.steps.vendors import parse_htd
+
+    (tmp_path / "plate.HTD").write_text(HTD)
+    info = parse_htd(tmp_path / "plate.HTD")
+    assert info["waves"] == ["DAPI", "FITC"]
+    # selection: row0 both, row1 only first -> 3 sites
+    assert info["site_grid"] == [(0, 0), (0, 1), (1, 0)]
+    assert info["n_tpoints"] == 1
+
+
+def test_metaconfig_imagexpress_sidecar(tmp_path):
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    _write_ixp_dataset(src)
+    root = tmp_path / "exp"
+    store = _empty_store(root, "ixptest")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "imagexpress"})
+    result = step.run(0)
+    assert result["n_files"] == 12
+    exp = ExperimentStore.open(root).experiment
+    assert {c.name for c in exp.channels} == {"DAPI", "FITC"}
+    # 3 selected sites land on the HTD's 2x2 grid positions
+    sites = exp.plates[0].wells[0].sites
+    assert {(s.y, s.x) for s in sites} >= {(0, 0), (0, 1), (1, 0)}
+
+
+def test_imagexpress_timepoint_dirs(tmp_path):
+    """TimePoint_<t> directory layout maps to tpoint indices."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import imagexpress_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    (src / "plate.HTD").write_text(HTD.replace('"TimePoints", 1', '"TimePoints", 2'))
+    for t in (1, 2):
+        d = src / f"TimePoint_{t}"
+        d.mkdir()
+        cv2.imwrite(
+            str(d / "exp1_B02_s1_w1.tif"), np.full((8, 8), 5, np.uint16)
+        )
+    entries, skipped = imagexpress_sidecar(src)
+    assert len(entries) == 2
+    assert sorted(e["tpoint"] for e in entries) == [0, 1]
+    assert skipped == 0
+
+
+def test_harmony_meander_fields_use_stage_grid(tmp_path):
+    """Non-row-major FieldID order: stage positions fix the well grid."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import harmony_sidecar
+
+    src = tmp_path / "src"
+    images = src / "Images"
+    images.mkdir(parents=True)
+    # meander: field 1 -> (0,0), field 2 -> (0,1), field 3 -> (1,1), field 4 -> (1,0)
+    pos = {1: (0.0, 0.0), 2: (0.001, 0.0), 3: (0.001, 0.001), 4: (0.0, 0.001)}
+    records = []
+    for field, (x, y) in pos.items():
+        name = f"r01c01f{field:02d}p01-ch1sk1fk1fl1.tiff"
+        records.append(
+            HARMONY_REC.format(
+                name=name, row=1, col=1, field=field, plane=1, tp=1,
+                ch=1, chname="DAPI", x=x, y=y,
+            )
+        )
+        cv2.imwrite(str(images / name), np.full((8, 8), 9, np.uint16))
+    (images / "Index.idx.xml").write_text(
+        HARMONY_INDEX.format(records="\n".join(records))
+    )
+    entries, skipped = harmony_sidecar(src)
+    assert skipped == 0
+    grid = {e["site"]: (e["site_y"], e["site_x"]) for e in entries}
+    # field 3 sits at stage (y=0.001, x=0.001) -> grid (1, 1), NOT (1, 0)
+    assert grid[2] == (1, 1)
+    assert grid[3] == (1, 0)
+
+
+def test_harmony_ref_index_not_double_counted(tmp_path):
+    """Index.ref.xml alongside Index.idx.xml must not duplicate planes."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import harmony_sidecar
+
+    src = tmp_path / "src"
+    images = src / "Images"
+    images.mkdir(parents=True)
+    name = "r01c01f01p01-ch1sk1fk1fl1.tiff"
+    rec = HARMONY_REC.format(
+        name=name, row=1, col=1, field=1, plane=1, tp=1, ch=1,
+        chname="DAPI", x=0.0, y=0.0,
+    )
+    doc = HARMONY_INDEX.format(records=rec)
+    (images / "Index.idx.xml").write_text(doc)
+    (images / "Index.ref.xml").write_text(doc)
+    cv2.imwrite(str(images / name), np.full((8, 8), 9, np.uint16))
+    entries, _ = harmony_sidecar(src)
+    assert len(entries) == 1

@@ -270,13 +270,21 @@ def segment_secondary(
 
 
 @register_module("measure_intensity")
-def measure_intensity(objects_image, intensity_image, max_objects: int = 256):
-    """Reference ``jtmodules/measure_intensity.py``."""
-    from tmlibrary_tpu.ops.measure import intensity_features
+def measure_intensity(
+    objects_image, intensity_image, max_objects: int = 256, quantiles: bool = False
+):
+    """Reference ``jtmodules/measure_intensity.py``.
 
-    return {
-        "measurements": intensity_features(objects_image, intensity_image, max_objects)
-    }
+    ``quantiles=True`` additionally exports per-object p25/median/p75
+    (quantile-type intensity statistics some jtlib versions ship)."""
+    from tmlibrary_tpu.ops.measure import intensity_features, intensity_quantiles
+
+    feats = intensity_features(objects_image, intensity_image, max_objects)
+    if quantiles:
+        feats.update(
+            intensity_quantiles(objects_image, intensity_image, max_objects)
+        )
+    return {"measurements": feats}
 
 
 @register_module("measure_morphology")

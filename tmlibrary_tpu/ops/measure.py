@@ -138,6 +138,78 @@ def intensity_features(
     }
 
 
+def intensity_quantiles(
+    labels: jax.Array,
+    intensity: jax.Array,
+    max_objects: int,
+    qs: tuple[float, ...] = (0.25, 0.5, 0.75),
+    bins: int = 256,
+) -> dict[str, jax.Array]:
+    """Per-object intensity quantiles (p25 / median / p75 by default).
+
+    Reference parity: quantile-type per-object intensity statistics
+    (round-1 VERDICT weak item #8 — some jtlib versions export them
+    alongside mean/std; SURVEY.md §3 jtlibrary row).
+
+    TPU design: a ragged per-object sort is gather-bound, so quantiles are
+    read off a per-object histogram instead: each object's gray range is
+    stretched into ``bins`` buckets (reusing :func:`quantize_per_object`),
+    per-(object, bucket) counts accumulate in one one-hot MXU pass (same
+    trick as the GLCM rows), and the quantile is the bucket where the
+    object's CDF crosses ``q``, mapped back to gray units.  Exact when an
+    object's gray span has ≤ ``bins`` distinct levels (the common case for
+    stained cells); otherwise quantized to span/bins granularity.
+    """
+    labels = jnp.asarray(labels, jnp.int32)
+    img = jnp.asarray(intensity, jnp.float32)
+    lo, hi = grouped_minmax(labels, img, max_objects)
+    present = hi >= lo
+    lo = jnp.where(present, lo, 0.0)
+    span = jnp.where(present, hi - lo, 1.0)
+
+    q_pix = quantize_per_object(labels, img, max_objects, bins)
+    # per-(object, bucket) counts as ONE contraction: label one-hot
+    # (P, M+1) x bucket one-hot (P, bins) -> (M+1, bins) on the MXU, chunked
+    # over pixels so both operands stay bounded under the site-batch vmap
+    # (a fused (M+1)*bins one-hot would be ~2 GB at M=bins=256)
+    lab_flat = labels.reshape(-1)
+    q_flat = q_pix.reshape(-1)
+    p = lab_flat.shape[0]
+    pad = (-p) % _GLCM_CHUNK
+    if pad:
+        lab_flat = jnp.concatenate([lab_flat, jnp.zeros((pad,), lab_flat.dtype)])
+        q_flat = jnp.concatenate([q_flat, jnp.zeros((pad,), q_flat.dtype)])
+    n_chunks = lab_flat.shape[0] // _GLCM_CHUNK
+    lab_flat = lab_flat.reshape(n_chunks, _GLCM_CHUNK)
+    q_flat = q_flat.reshape(n_chunks, _GLCM_CHUNK)
+
+    def body(i, acc):
+        oh_l = jax.nn.one_hot(lab_flat[i], max_objects + 1, dtype=jnp.float32)
+        oh_q = jax.nn.one_hot(q_flat[i], bins, dtype=jnp.float32)
+        return acc + jnp.einsum(
+            "pm,pb->mb", oh_l, oh_q, precision=jax.lax.Precision.HIGHEST
+        )
+
+    counts = jax.lax.fori_loop(
+        0, n_chunks, body, jnp.zeros((max_objects + 1, bins), jnp.float32)
+    )[1:]
+
+    cdf = jnp.cumsum(counts, axis=1)  # (M, bins)
+    total = jnp.maximum(cdf[:, -1:], 1.0)
+    out: dict[str, jax.Array] = {}
+    centers = lo[:, None] + (
+        jnp.arange(bins, dtype=jnp.float32)[None, :] * span[:, None] / (bins - 1)
+    )
+    for q in qs:
+        # first bucket where CDF >= q * n  (nearest-rank quantile)
+        reached = cdf >= q * total
+        idx = jnp.argmax(reached, axis=1)
+        val = jnp.take_along_axis(centers, idx[:, None], axis=1)[:, 0]
+        name = "Intensity_median" if q == 0.5 else f"Intensity_p{int(round(q * 100)):02d}"
+        out[name] = jnp.where(present, val, 0.0)
+    return out
+
+
 # ----------------------------------------------------------------- morphology
 def morphology_features(labels: jax.Array, max_objects: int) -> dict[str, jax.Array]:
     """Reference feature set of ``jtlib/features/morphology.py``
@@ -317,13 +389,17 @@ def _glcm(
     picks by backend — overridden by the committed hardware-tuning verdict
     (``tuning/TUNING.json`` ``glcm_matmul_wins``) when present."""
     if method == "auto":
-        if jax.default_backend() == "cpu":
+        backend = jax.default_backend()
+        if backend == "cpu":
             method = "scatter"
-        else:
+        elif backend == "tpu":
+            # the committed tuning verdict was measured on a TPU — scope it
             from tmlibrary_tpu.ops.pallas_kernels import _tuning_results
 
             wins = _tuning_results().get("glcm_matmul_wins")
             method = "matmul" if wins in (None, True) else "scatter"
+        else:  # gpu and friends: untuned, keep the matmul default
+            method = "matmul"
     fn = _glcm_matmul if method == "matmul" else _glcm_scatter
     return fn(labels, quantized, max_objects, levels, offset)
 

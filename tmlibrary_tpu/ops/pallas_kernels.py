@@ -211,7 +211,10 @@ def watershed_flood(
 # ----------------------------------------------------------- distance xform
 def _distance_kernel(mask_ref, out_ref, *, max_distance: int):
     h, w = out_ref.shape
-    mask = mask_ref[:] != 0
+    # the eroding mask is carried as int32 0/1, not bool: Mosaic cannot
+    # legalize vector<i1> while_loop carries (scf.yield legalization error
+    # seen on v5e), and min over {0,1} is exactly boolean AND
+    mask = (mask_ref[:] != 0).astype(jnp.int32)
 
     def erode(cur):
         # out-of-image neighbors count as foreground (fill=1) to match the
@@ -219,12 +222,12 @@ def _distance_kernel(mask_ref, out_ref, *, max_distance: int):
         # touch the image edge must not erode from the edge side
         out = cur
         for dy, dx in _shifts_for(8):
-            out = out & (_shift_fill(cur.astype(jnp.int32), dy, dx, 1, h, w) != 0)
+            out = jnp.minimum(out, _shift_fill(cur, dy, dx, 1, h, w))
         return out
 
     def cond(state):
         _, cur, i = state
-        return jnp.any(cur) & (i < max_distance)
+        return (jnp.max(cur) > 0) & (i < max_distance)
 
     def body(state):
         dist, cur, i = state

@@ -57,7 +57,12 @@ def welford_update(state: WelfordState, raw: jax.Array) -> WelfordState:
     mean = state.mean + delta / n
     m2 = state.m2 + delta * (x - mean)
     idx = jnp.clip(raw_f, 0, HIST_BINS - 1).astype(jnp.int32)
-    hist = state.hist.at[idx.reshape(-1)].add(1.0)
+    # 65536-bin exact histogram: a scatter-add serializes on TPU, so the
+    # bin index is factored into (hi, lo) digits and counted by one small
+    # matmul per chunk (ops.histogram) — MXU instead of serialized scatter
+    from tmlibrary_tpu.ops.histogram import histogram_fixed_bins
+
+    hist = state.hist + histogram_fixed_bins(idx, HIST_BINS)
     return WelfordState(n=n, mean=mean, m2=m2, hist=hist)
 
 

@@ -33,11 +33,10 @@ def otsu_value(img: jax.Array, bins: int = 256) -> jax.Array:
     hi = jnp.max(img_f)
     span = jnp.maximum(hi - lo, 1e-6)
     idx = jnp.clip(((img_f - lo) / span * bins).astype(jnp.int32), 0, bins - 1)
-    # fused broadcast-compare-reduce histogram: TPU scatter-adds serialize;
-    # XLA streams this reduction without materializing the (P, bins) compare
-    hist = jnp.sum(
-        (idx.reshape(-1)[:, None] == jnp.arange(bins)).astype(jnp.float32), axis=0
-    )
+    # factored one-hot matmul histogram (MXU) on TPU, scatter on CPU
+    from tmlibrary_tpu.ops.histogram import histogram_fixed_bins
+
+    hist = histogram_fixed_bins(idx, bins)
     centers = lo + (jnp.arange(bins, dtype=jnp.float32) + 0.5) / bins * span
 
     w0 = jnp.cumsum(hist)

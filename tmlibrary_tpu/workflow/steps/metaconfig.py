@@ -121,7 +121,8 @@ class MetadataConfigurator(Step):
         Argument("source_dir", str, required=True,
                  help="directory of microscope image files"),
         Argument("handler", str, default="default",
-                 choices=("default", "cellvoyager", "omexml", "metamorph", "auto"),
+                 choices=("default", "cellvoyager", "omexml", "metamorph",
+                          "harmony", "imagexpress", "auto"),
                  help="vendor metadata handler (sidecar files preferred, "
                       "filename patterns as fallback)"),
         Argument("pattern", str, default=None,

@@ -111,6 +111,43 @@ def positions_to_grid(positions: list[float], tol: float | None = None) -> dict:
     return index_of
 
 
+def derive_well_grids(
+    entries: list[dict],
+) -> dict[tuple[int, int], tuple[dict, dict]]:
+    """Per-well (y_index, x_index) grids from stage positions.
+
+    Positions are absolute stage coordinates, so the grid must be derived
+    per well (reference metaconfig ``base.py`` does the same per-well grid
+    derivation).  A well's grid is kept only when it cross-checks: the
+    grid cells must form a dense rectangle addressing exactly the well's
+    field set, else stage jitter was misread as grid lines
+    (:func:`positions_to_grid` docstring) and callers fall back to field
+    indices for that well.
+    """
+    from collections import defaultdict
+
+    per_well: dict[tuple[int, int], list[dict]] = defaultdict(list)
+    for e in entries:
+        per_well[(e["well_row"], e["well_col"])].append(e)
+    grids: dict[tuple[int, int], tuple[dict, dict]] = {}
+    for key, group in per_well.items():
+        xs = [e["stage_x"] for e in group if e["stage_x"] is not None]
+        ys = [e["stage_y"] for e in group if e["stage_y"] is not None]
+        y_index = positions_to_grid(ys)
+        x_index = positions_to_grid(xs)
+        fields = {e["site"] for e in group}
+        cells = {
+            (y_index[e["stage_y"]], x_index[e["stage_x"]])
+            for e in group
+            if e["stage_x"] is not None and e["stage_y"] is not None
+        }
+        ny = len(set(y_index.values()))
+        nx = len(set(x_index.values()))
+        if len(cells) == len(fields) and ny * nx == len(fields):
+            grids[key] = (y_index, x_index)
+    return grids
+
+
 # --------------------------------------------------------------- cellvoyager
 def parse_mes_channels(path: Path) -> dict[int, str]:
     """Parse ``MeasurementSetting.mes``: channel number -> descriptive name."""
@@ -203,33 +240,8 @@ def cellvoyager_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     # resolve filenames against the tree once (rglob per entry would be O(n^2))
     by_name = _index_files(source_dir)
 
-    # stage positions -> within-well grid.  Positions are absolute stage
-    # coordinates, so the grid must be derived per well (reference
-    # metaconfig base.py does the same grid derivation per well).
-    from collections import defaultdict
-
-    per_well: dict[tuple[int, int], list[dict]] = defaultdict(list)
-    for e in entries:
-        per_well[(e["well_row"], e["well_col"])].append(e)
-    grids: dict[tuple[int, int], tuple[dict, dict]] = {}
-    for key, group in per_well.items():
-        xs = [e["stage_x"] for e in group if e["stage_x"] is not None]
-        ys = [e["stage_y"] for e in group if e["stage_y"] is not None]
-        y_index = positions_to_grid(ys)
-        x_index = positions_to_grid(xs)
-        # cross-check: the grid must be a dense rectangle addressing exactly
-        # the well's field set, else stage jitter was misread as grid lines
-        # (positions_to_grid docstring) — fall back to field indices then.
-        fields = {e["site"] for e in group}
-        cells = {
-            (y_index[e["stage_y"]], x_index[e["stage_x"]])
-            for e in group
-            if e["stage_x"] is not None and e["stage_y"] is not None
-        }
-        ny = len(set(y_index.values()))
-        nx = len(set(x_index.values()))
-        if len(cells) == len(fields) and ny * nx == len(fields):
-            grids[key] = (y_index, x_index)
+    # stage positions -> within-well grid (shared per-well derivation)
+    grids = derive_well_grids(entries)
 
     out = []
     skipped = 0
@@ -350,6 +362,262 @@ def omexml_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                             rec["site_x"] = sx
                         entries.append(rec)
     return entries, skipped
+
+
+# ------------------------------------------------------------------ harmony
+def _child_text(el: ET.Element, *names: str) -> str | None:
+    """First child element's text matched by local tag name."""
+    for ch in el:
+        if _strip_ns(ch.tag) in names and ch.text is not None:
+            return ch.text.strip()
+    return None
+
+
+def parse_harmony_index(path: Path) -> list[dict]:
+    """Parse a PerkinElmer Operetta/Opera Phenix ``Index.idx.xml``.
+
+    Reference parity: the reference's metaconfig vendor-handler set
+    (SURVEY.md §2 metaconfig row, exact vendor set tagged [L]) is a plugin
+    registry per microscope; Harmony exports are the PerkinElmer member of
+    that zoo.  The index document lists one ``<Image>`` record per plane
+    with child elements ``URL`` (filename), ``Row``/``Col`` (1-based well),
+    ``FieldID`` (site), ``ChannelID``/``ChannelName``, ``PlaneID`` (z),
+    ``TimepointID`` and stage ``PositionX``/``PositionY``.
+    """
+    try:
+        root = ET.fromstring(path.read_text(errors="replace"))
+    except ET.ParseError as exc:
+        raise MetadataError(f"cannot parse Harmony index file {path}: {exc}")
+    entries: list[dict] = []
+    for el in root.iter():
+        if _strip_ns(el.tag) != "Image":
+            continue
+        url = _child_text(el, "URL")
+        row = _child_text(el, "Row")
+        col = _child_text(el, "Col")
+        field = _child_text(el, "FieldID")
+        if url is None or row is None or col is None or field is None:
+            continue  # non-plane Image stanza (e.g. map entries)
+        ch_id = _child_text(el, "ChannelID") or "1"
+        ch_name = _child_text(el, "ChannelName")
+        z = _child_text(el, "PlaneID") or "1"
+        t = _child_text(el, "TimepointID") or "1"
+        # TimepointID is 0-based in some Harmony exports, 1-based in others;
+        # normalised by a min-subtraction over the whole index below.
+        x = _child_text(el, "PositionX")
+        y = _child_text(el, "PositionY")
+        entries.append(
+            {
+                "well_row": int(row) - 1,
+                "well_col": int(col) - 1,
+                "site": int(field) - 1,
+                "channel": ch_name or f"ch{int(ch_id)}",
+                "cycle": 0,
+                "tpoint": int(t),
+                "zplane": int(z) - 1,
+                "filename": url,
+                "stage_x": float(x) if x is not None else None,
+                "stage_y": float(y) if y is not None else None,
+            }
+        )
+    if entries:
+        t_min = min(e["tpoint"] for e in entries)
+        for e in entries:
+            e["tpoint"] -= t_min
+    return entries
+
+
+@register_sidecar_handler("harmony")
+def harmony_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """Operetta/Opera Phenix handler: requires an ``Index.idx.xml``
+    under the source tree (``Index.ref.xml`` is a fallback when no idx
+    file exists — a tree holding both describes the SAME planes twice,
+    so only one flavour is ever read).
+
+    FieldID order is not guaranteed row-major (Harmony supports meander /
+    center-out field layouts), so within-well grid coordinates are derived
+    from the stage positions via :func:`derive_well_grids` whenever they
+    cross-check against the field set.
+    """
+    indexes = sorted(source_dir.rglob("Index.idx.xml")) or sorted(
+        source_dir.rglob("Index.ref.xml")
+    )
+    if not indexes:
+        return None
+    entries: list[dict] = []
+    for idx in indexes:
+        entries.extend(parse_harmony_index(idx))
+    if not entries:
+        return [], 0
+
+    by_name = _index_files(source_dir)
+    grids = derive_well_grids(entries)
+    out: list[dict] = []
+    skipped = 0
+    for e in entries:
+        path = by_name.get(e["filename"]) or by_name.get(Path(e["filename"]).name)
+        if path is None:
+            skipped += 1
+            continue
+        rec = {
+            "plate": "plate00",
+            "well_row": e["well_row"],
+            "well_col": e["well_col"],
+            "site": e["site"],
+            "channel": e["channel"],
+            "cycle": e["cycle"],
+            "tpoint": e["tpoint"],
+            "zplane": e["zplane"],
+            "path": str(path),
+        }
+        grid = grids.get((e["well_row"], e["well_col"]))
+        if grid is not None and e["stage_x"] is not None and e["stage_y"] is not None:
+            y_index, x_index = grid
+            rec["site_y"] = y_index[e["stage_y"]]
+            rec["site_x"] = x_index[e["stage_x"]]
+        out.append(rec)
+    return out, skipped
+
+
+# -------------------------------------------------------------- imagexpress
+def parse_htd(path: Path) -> dict:
+    """Parse a Molecular Devices ImageXpress/MetaXpress ``.HTD`` file.
+
+    Line-oriented ``"Key", v1, v2, ...`` records describing the plate scan:
+    well grid (``XWells``/``YWells`` + per-row ``WellsSelection<r>``
+    booleans), within-well site grid (``XSites``/``YSites`` +
+    ``SiteSelection<r>``), wavelengths (``NWavelengths`` +
+    ``WaveName<i>``) and timepoints.
+    """
+    fields: dict[str, list[str]] = {}
+    for raw in path.read_text(errors="replace").splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        parts = [p.strip().strip('"') for p in line.split(",")]
+        if parts:
+            fields[parts[0]] = parts[1:]
+
+    def num(name: str, default: int = 1) -> int:
+        try:
+            return int(fields.get(name, [str(default)])[0])
+        except (ValueError, IndexError):
+            raise MetadataError(f"malformed numeric field {name} in {path}")
+
+    def bools(name: str) -> list[bool]:
+        return [v.upper() == "TRUE" for v in fields.get(name, [])]
+
+    n_waves = num("NWavelengths")
+    waves = [
+        fields.get(f"WaveName{i}", [f"w{i}"])[0] for i in range(1, n_waves + 1)
+    ]
+    x_sites, y_sites = num("XSites"), num("YSites")
+    # site linear numbering (1-based, row-major) covers SELECTED cells only
+    site_grid: list[tuple[int, int]] = []
+    any_selection = any(f"SiteSelection{r + 1}" in fields for r in range(y_sites))
+    for r in range(y_sites):
+        sel = bools(f"SiteSelection{r + 1}") if any_selection else [True] * x_sites
+        for c in range(x_sites):
+            if c < len(sel) and sel[c]:
+                site_grid.append((r, c))
+    return {
+        "waves": waves,
+        "site_grid": site_grid,
+        "sites_x": x_sites,
+        "n_tpoints": num("TimePoints"),
+        "n_zsteps": num("ZSteps") if fields.get("DoZSeries", ["FALSE"])[0].upper() == "TRUE" else 1,
+    }
+
+
+#: <base>_<well>_s<site>_w<wave>[GUID][_z<k>].tif — the GUID suffix appears
+#: in MetaXpress ≥5 exports; thumbnails end in "_thumb" and are excluded
+IMAGEXPRESS_FILE = re.compile(
+    r"_(?P<well>[A-Z]{1,2}\d{2})"
+    r"_s(?P<site>\d+)"
+    r"_w(?P<wave>\d+)"
+    r"(?!.*_thumb)"
+    r"(?:[0-9A-F-]{36})?"
+    r"(?:_z(?P<z>\d+))?"
+    r"\.(?:tif|tiff|TIF|TIFF)$"
+)
+
+
+@register_sidecar_handler("imagexpress")
+def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """ImageXpress handler: requires a ``*.HTD`` plate-description file.
+
+    Image files are matched by the MetaXpress filename convention; the
+    timepoint comes from the enclosing ``TimePoint_<t>`` directory when the
+    scan is a timelapse.  Site linear indices from the filename are mapped
+    onto the HTD's selected-site grid so the manifest's within-well grid
+    coordinates are faithful even for sparse site selections.
+    """
+    htds = sorted(p for p in source_dir.rglob("*") if p.suffix.upper() == ".HTD")
+    if not htds:
+        return None
+    info = None
+    for htd in htds:
+        try:
+            info = parse_htd(htd)
+            break
+        except MetadataError as exc:
+            logger.warning("ignoring unparseable .HTD file: %s", exc)
+    if info is None:
+        raise MetadataError(f"no parseable .HTD file under {source_dir}")
+
+    entries: list[dict] = []
+    skipped = 0
+    for p in sorted(source_dir.rglob("*")):
+        if not p.is_file() or p.suffix.lower() not in (".tif", ".tiff"):
+            continue
+        if "_thumb" in p.name:
+            continue
+        m = IMAGEXPRESS_FILE.search(p.name)
+        if m is None:
+            skipped += 1
+            continue
+        row, col = parse_well_name_token(m.group("well"))
+        site_i = int(m.group("site")) - 1
+        if site_i < len(info["site_grid"]):
+            sy, sx = info["site_grid"][site_i]
+        else:
+            sy, sx = divmod(site_i, info["sites_x"])
+        wave_i = int(m.group("wave"))
+        channel = (
+            info["waves"][wave_i - 1]
+            if 0 < wave_i <= len(info["waves"])
+            else f"w{wave_i}"
+        )
+        tpoint = 0
+        # only directory levels BELOW source_dir address timepoints — an
+        # ancestor directory that happens to be named TimePoint_<n> must not
+        for part in p.relative_to(source_dir).parts[:-1]:
+            tm = re.fullmatch(r"TimePoint_(\d+)", part)
+            if tm:
+                tpoint = int(tm.group(1)) - 1
+        entries.append(
+            {
+                "plate": "plate00",
+                "well_row": row,
+                "well_col": col,
+                "site": site_i,
+                "site_y": sy,
+                "site_x": sx,
+                "channel": channel,
+                "cycle": 0,
+                "tpoint": tpoint,
+                "zplane": int(m.group("z") or 1) - 1,
+                "path": str(p),
+            }
+        )
+    return entries, skipped
+
+
+def parse_well_name_token(token: str) -> tuple[int, int]:
+    """'B03' → (1, 2) without importing metaconfig at module load."""
+    from tmlibrary_tpu.workflow.steps.metaconfig import parse_well_name
+
+    return parse_well_name(token)
 
 
 # ----------------------------------------------------------------- metamorph
