@@ -49,7 +49,8 @@ def _bench_fn(name, fn, *args, batch=None):
     try:
         np.asarray(wrapped(*args))
     except Exception as exc:  # Mosaic/XLA compile or runtime failure
-        msg = str(exc).splitlines()[0][:200]
+        # f-string is never empty (type name), so splitlines()[0] is safe
+        msg = f"{type(exc).__name__}: {exc}".splitlines()[0][:200]
         print(f"  {name:32s} FAILED: {msg}")
         RESULTS.setdefault("kernel_errors", {})[name] = msg
         return float("inf")

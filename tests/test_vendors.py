@@ -665,3 +665,25 @@ def test_harmony_ref_index_not_double_counted(tmp_path):
     cv2.imwrite(str(images / name), np.full((8, 8), 9, np.uint16))
     entries, _ = harmony_sidecar(src)
     assert len(entries) == 1
+
+
+def test_imagexpress_multi_plate_htds(tmp_path):
+    """Each .HTD scopes its own directory: per-plate waves and names."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import imagexpress_sidecar
+
+    src = tmp_path / "src"
+    for plate, wave in (("plateA", "DAPI"), ("plateB", "Cy5")):
+        d = src / plate
+        d.mkdir(parents=True)
+        (d / f"{plate}.HTD").write_text('\n'.join([
+            '"TimePoints", 1', '"XSites", 1', '"YSites", 1',
+            '"NWavelengths", 1', f'"WaveName1", "{wave}"', '"EndFile",',
+        ]))
+        cv2.imwrite(str(d / f"exp_{'B02' if plate == 'plateA' else 'B03'}_s1_w1.tif"),
+                    np.full((8, 8), 5, np.uint16))
+    entries, skipped = imagexpress_sidecar(src)
+    assert skipped == 0
+    by_plate = {e["plate"]: e["channel"] for e in entries}
+    assert by_plate == {"plateA": "DAPI", "plateB": "Cy5"}

@@ -19,6 +19,12 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+#: search order: wheel-installed copy (setup.py build_py drops the compiled
+#: library inside the package), then the source tree's native/ directory
+_SO_CANDIDATES = (
+    Path(__file__).resolve().parent / "libtmnative.so",
+    _NATIVE_DIR / "libtmnative.so",
+)
 _SO_PATH = _NATIVE_DIR / "libtmnative.so"
 _lib = None
 _load_attempted = False
@@ -41,11 +47,14 @@ def _build() -> bool:
 
 
 def _load():
-    global _lib, _load_attempted
+    global _lib, _load_attempted, _SO_PATH
     if _lib is not None or _load_attempted:
         return _lib
     _load_attempted = True
-    if not _SO_PATH.exists() and not _build():
+    found = next((p for p in _SO_CANDIDATES if p.exists()), None)
+    if found is not None:
+        _SO_PATH = found
+    elif not _build():  # _build writes the source-tree candidate
         return None
     try:
         lib = ctypes.CDLL(str(_SO_PATH))

@@ -162,12 +162,14 @@ def intensity_quantiles(
     """
     labels = jnp.asarray(labels, jnp.int32)
     img = jnp.asarray(intensity, jnp.float32)
-    lo, hi = grouped_minmax(labels, img, max_objects)
-    present = hi >= lo
-    lo = jnp.where(present, lo, 0.0)
-    span = jnp.where(present, hi - lo, 1.0)
+    raw_lo, raw_hi = grouped_minmax(labels, img, max_objects)
+    present = raw_hi >= raw_lo
+    lo = jnp.where(present, raw_lo, 0.0)
+    span = jnp.where(present, raw_hi - lo, 1.0)
 
-    q_pix = quantize_per_object(labels, img, max_objects, bins)
+    q_pix = quantize_per_object(
+        labels, img, max_objects, bins, bounds=(raw_lo, raw_hi)
+    )
     # per-(object, bucket) counts as ONE contraction: label one-hot
     # (P, M+1) x bucket one-hot (P, bins) -> (M+1, bins) on the MXU, chunked
     # over pixels so both operands stay bounded under the site-batch vmap
@@ -409,6 +411,7 @@ def quantize_per_object(
     intensity: jax.Array,
     max_objects: int,
     levels: int,
+    bounds: tuple[jax.Array, jax.Array] | None = None,
 ) -> jax.Array:
     """Per-object gray-level stretch to ``[0, levels-1]`` — mahotas
     semantics (``jtlib/features/texture.py`` stretches each object's
@@ -418,7 +421,12 @@ def quantize_per_object(
     fidelity (round-1 VERDICT missing item #3)."""
     labels = jnp.asarray(labels, jnp.int32)
     img = jnp.asarray(intensity, jnp.float32)
-    lo, hi = grouped_minmax(labels, img, max_objects)  # (M,) +inf/-inf absent
+    # (M,) per-object range; +inf/-inf marks absent.  ``bounds`` lets a
+    # caller that already holds grouped_minmax output skip the second full
+    # reduction pass over all pixels.
+    lo, hi = bounds if bounds is not None else grouped_minmax(
+        labels, img, max_objects
+    )
     present = hi >= lo
     lo = jnp.where(present, lo, 0.0)
     span = jnp.where(present, hi - lo, 1.0)

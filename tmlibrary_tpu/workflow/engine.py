@@ -249,15 +249,25 @@ class Workflow:
                 self.ledger.append(step=sd.name, event="init_done",
                                    n_batches=len(batches))
             results = []
-            for batch in batches:
-                if batch["index"] in done:
-                    continue
+            pending = [b for b in batches if b["index"] not in done]
+            if hasattr(step, "run_batches_pipelined"):
+                # device-async pipelining: host IO of adjacent batches runs
+                # in the shadow of device compute (see the step's docstring)
                 bt0 = time.time()
-                result = step.run_batch(batch)
-                self.ledger.append(step=sd.name, event="batch_done",
-                                   batch=batch["index"],
-                                   elapsed=time.time() - bt0, result=result)
-                results.append(result)
+                for batch, result in step.run_batches_pipelined(pending):
+                    self.ledger.append(step=sd.name, event="batch_done",
+                                       batch=batch["index"],
+                                       elapsed=time.time() - bt0, result=result)
+                    results.append(result)
+                    bt0 = time.time()
+            else:
+                for batch in pending:
+                    bt0 = time.time()
+                    result = step.run_batch(batch)
+                    self.ledger.append(step=sd.name, event="batch_done",
+                                       batch=batch["index"],
+                                       elapsed=time.time() - bt0, result=result)
+                    results.append(result)
             collected = step.collect()
             self.ledger.append(step=sd.name, event="step_done",
                                elapsed=time.time() - t0, collected=collected)

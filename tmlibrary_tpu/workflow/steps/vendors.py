@@ -544,72 +544,91 @@ IMAGEXPRESS_FILE = re.compile(
 
 @register_sidecar_handler("imagexpress")
 def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
-    """ImageXpress handler: requires a ``*.HTD`` plate-description file.
+    """ImageXpress handler: requires ``*.HTD`` plate-description files.
 
-    Image files are matched by the MetaXpress filename convention; the
-    timepoint comes from the enclosing ``TimePoint_<t>`` directory when the
-    scan is a timelapse.  Site linear indices from the filename are mapped
-    onto the HTD's selected-site grid so the manifest's within-well grid
-    coordinates are faithful even for sparse site selections.
+    Each ``.HTD`` describes ONE plate scan and applies only to the image
+    files under its own directory (the standard MetaXpress export layout
+    puts one HTD per plate folder); multi-plate source trees therefore get
+    per-plate wave names and site grids instead of the first HTD's.  Image
+    files are matched by the MetaXpress filename convention; the timepoint
+    comes from the enclosing ``TimePoint_<t>`` directory when the scan is a
+    timelapse.  Site linear indices from the filename are mapped onto the
+    HTD's selected-site grid so the manifest's within-well grid coordinates
+    are faithful even for sparse site selections.
     """
     htds = sorted(p for p in source_dir.rglob("*") if p.suffix.upper() == ".HTD")
     if not htds:
         return None
-    info = None
+    # one plate scope per HTD directory; first parseable HTD in a dir wins
+    scopes: list[tuple[Path, str, dict]] = []
+    seen_dirs: set[Path] = set()
     for htd in htds:
+        if htd.parent in seen_dirs:
+            continue
         try:
             info = parse_htd(htd)
-            break
         except MetadataError as exc:
             logger.warning("ignoring unparseable .HTD file: %s", exc)
-    if info is None:
+            continue
+        seen_dirs.add(htd.parent)
+        plate = htd.stem if len(htds) > 1 else "plate00"
+        scopes.append((htd.parent, plate, info))
+    if not scopes:
         raise MetadataError(f"no parseable .HTD file under {source_dir}")
 
     entries: list[dict] = []
     skipped = 0
-    for p in sorted(source_dir.rglob("*")):
-        if not p.is_file() or p.suffix.lower() not in (".tif", ".tiff"):
-            continue
-        if "_thumb" in p.name:
-            continue
-        m = IMAGEXPRESS_FILE.search(p.name)
-        if m is None:
-            skipped += 1
-            continue
-        row, col = parse_well_name_token(m.group("well"))
-        site_i = int(m.group("site")) - 1
-        if site_i < len(info["site_grid"]):
-            sy, sx = info["site_grid"][site_i]
-        else:
-            sy, sx = divmod(site_i, info["sites_x"])
-        wave_i = int(m.group("wave"))
-        channel = (
-            info["waves"][wave_i - 1]
-            if 0 < wave_i <= len(info["waves"])
-            else f"w{wave_i}"
-        )
-        tpoint = 0
-        # only directory levels BELOW source_dir address timepoints — an
-        # ancestor directory that happens to be named TimePoint_<n> must not
-        for part in p.relative_to(source_dir).parts[:-1]:
-            tm = re.fullmatch(r"TimePoint_(\d+)", part)
-            if tm:
-                tpoint = int(tm.group(1)) - 1
-        entries.append(
-            {
-                "plate": "plate00",
-                "well_row": row,
-                "well_col": col,
-                "site": site_i,
-                "site_y": sy,
-                "site_x": sx,
-                "channel": channel,
-                "cycle": 0,
-                "tpoint": tpoint,
-                "zplane": int(m.group("z") or 1) - 1,
-                "path": str(p),
-            }
-        )
+    claimed: set[Path] = set()
+    # deepest scope first so nested plate folders claim their own files
+    for scope_dir, plate, info in sorted(
+        scopes, key=lambda s: len(s[0].parts), reverse=True
+    ):
+        for p in sorted(scope_dir.rglob("*")):
+            if p in claimed or not p.is_file():
+                continue
+            if p.suffix.lower() not in (".tif", ".tiff"):
+                continue
+            claimed.add(p)
+            if "_thumb" in p.name:
+                continue
+            m = IMAGEXPRESS_FILE.search(p.name)
+            if m is None:
+                skipped += 1
+                continue
+            row, col = parse_well_name_token(m.group("well"))
+            site_i = int(m.group("site")) - 1
+            if site_i < len(info["site_grid"]):
+                sy, sx = info["site_grid"][site_i]
+            else:
+                sy, sx = divmod(site_i, info["sites_x"])
+            wave_i = int(m.group("wave"))
+            channel = (
+                info["waves"][wave_i - 1]
+                if 0 < wave_i <= len(info["waves"])
+                else f"w{wave_i}"
+            )
+            tpoint = 0
+            # only directory levels BELOW the plate scope address
+            # timepoints — an ancestor dir named TimePoint_<n> must not
+            for part in p.relative_to(scope_dir).parts[:-1]:
+                tm = re.fullmatch(r"TimePoint_(\d+)", part)
+                if tm:
+                    tpoint = int(tm.group(1)) - 1
+            entries.append(
+                {
+                    "plate": plate,
+                    "well_row": row,
+                    "well_col": col,
+                    "site": site_i,
+                    "site_y": sy,
+                    "site_x": sx,
+                    "channel": channel,
+                    "cycle": 0,
+                    "tpoint": tpoint,
+                    "zplane": int(m.group("z") or 1) - 1,
+                    "path": str(p),
+                }
+            )
     return entries, skipped
 
 
