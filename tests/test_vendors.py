@@ -687,3 +687,24 @@ def test_imagexpress_multi_plate_htds(tmp_path):
     assert skipped == 0
     by_plate = {e["plate"]: e["channel"] for e in entries}
     assert by_plate == {"plateA": "DAPI", "plateB": "Cy5"}
+
+
+def test_imagexpress_htd_in_sidecar_folder(tmp_path):
+    """Images living outside the .HTD's directory are still ingested
+    (layouts that park the HTD in a PlateInfo/ sidecar folder)."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import imagexpress_sidecar
+
+    src = tmp_path / "src"
+    info_dir = src / "PlateInfo"
+    info_dir.mkdir(parents=True)
+    (info_dir / "plate.HTD").write_text('\n'.join([
+        '"TimePoints", 1', '"XSites", 1', '"YSites", 1',
+        '"NWavelengths", 1', '"WaveName1", "DAPI"', '"EndFile",',
+    ]))
+    cv2.imwrite(str(src / "exp_B02_s1_w1.tif"), np.full((8, 8), 5, np.uint16))
+    entries, skipped = imagexpress_sidecar(src)
+    assert len(entries) == 1
+    assert entries[0]["channel"] == "DAPI"
+    assert skipped == 0

@@ -301,3 +301,30 @@ def test_jterator_pipelined_matches_sequential(source_dir, store):
         jt2.run(j)
     labels_seq = store.read_labels(None, "nuclei")
     assert np.array_equal(labels_pipelined, labels_seq)
+
+
+def test_jterator_figures_artifacts(source_dir, store):
+    """figures=True writes per-site segmentation overlay PNGs
+    (reference: jterator module Figure artifacts)."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    for name in ("metaconfig", "imextract", "corilla"):
+        sd = next(s for stage in desc.stages for s in stage.steps if s.name == name)
+        step = get_step(name)(store)
+        step.init(sd.args)
+        for j in step.list_batches():
+            step.run(j)
+
+    jd = next(s for stage in desc.stages for s in stage.steps if s.name == "jterator")
+    jt = get_step("jterator")(store)
+    jt.init({**jd.args, "batch_size": 16, "figures": True})
+    jt.run(0)
+    figs = sorted((store.root / "figures").glob("nuclei_site*.png"))
+    assert len(figs) == 16
+    img = cv2.imread(str(figs[0]), cv2.IMREAD_UNCHANGED)
+    assert img.shape == (64, 64, 3)
+    # boundaries are colored: the overlay is not pure grayscale
+    assert not (img[..., 0] == img[..., 1]).all()

@@ -1,0 +1,91 @@
+"""Host-side figure artifacts for pipeline debugging.
+
+Reference parity: jterator modules accept a ``plot`` argument and emit a
+figure artifact per module run into the project's ``figures/`` directory
+(``tmlib/workflow/jterator/handles.py`` ``Figure`` handle; jtmodules
+render plotly documents).  The fused TPU pipeline cannot call a plotting
+library per module inside jit, so figures are rendered AFTER the device
+batch completes, from the persisted label images — one segmentation
+overlay per (object type, site): the intensity channel percentile-stretched
+to 8-bit with object boundaries colored by label id.
+
+Pure numpy + cv2 (no plotting dependency); PNG files are the artifact.
+"""
+
+from __future__ import annotations
+
+import colorsys
+from pathlib import Path
+
+import numpy as np
+
+
+def _stretch_u8(img: np.ndarray, p_lo: float = 1.0, p_hi: float = 99.0) -> np.ndarray:
+    """Percentile contrast stretch to uint8 (viewer-style display scaling)."""
+    img = np.asarray(img, np.float32)
+    lo, hi = np.percentile(img, (p_lo, p_hi))
+    if hi <= lo:
+        hi = lo + 1.0
+    return np.clip((img - lo) / (hi - lo) * 255.0, 0, 255).astype(np.uint8)
+
+
+def _label_palette(n: int) -> np.ndarray:
+    """(n+1, 3) BGR palette: background black, labels on a golden-angle
+    hue wheel so adjacent ids get distinct colors."""
+    out = np.zeros((n + 1, 3), np.uint8)
+    for i in range(1, n + 1):
+        h = (i * 0.618033988749895) % 1.0
+        r, g, b = colorsys.hsv_to_rgb(h, 0.85, 1.0)
+        out[i] = (int(b * 255), int(g * 255), int(r * 255))
+    return out
+
+
+def _boundaries(labels: np.ndarray) -> np.ndarray:
+    """Bool mask of foreground pixels with a 4-neighbor of another label."""
+    lab = np.asarray(labels)
+    edge = np.zeros(lab.shape, bool)
+    edge[:-1, :] |= lab[:-1, :] != lab[1:, :]
+    edge[1:, :] |= lab[1:, :] != lab[:-1, :]
+    edge[:, :-1] |= lab[:, :-1] != lab[:, 1:]
+    edge[:, 1:] |= lab[:, 1:] != lab[:, :-1]
+    return edge & (lab > 0)
+
+
+def segmentation_overlay(
+    intensity: np.ndarray, labels: np.ndarray
+) -> np.ndarray:
+    """(H, W, 3) BGR uint8: stretched grayscale with colored boundaries."""
+    base = _stretch_u8(intensity)
+    img = np.stack([base, base, base], axis=-1)
+    lab = np.asarray(labels, np.int64)
+    n = int(lab.max()) if lab.size else 0
+    if n > 0:
+        palette = _label_palette(n)
+        edges = _boundaries(lab)
+        img[edges] = palette[lab[edges]]
+    return img
+
+
+def write_figures(
+    figures_dir: Path | str,
+    objects_name: str,
+    intensity_stack: np.ndarray,
+    label_stack: np.ndarray,
+    site_indices: list[int],
+) -> list[Path]:
+    """Write one overlay PNG per site: ``<objects>_site<idx>.png``.
+
+    ``intensity_stack``/``label_stack``: (B, H, W) arrays aligned with
+    ``site_indices``.  Returns the written paths.
+    """
+    import cv2
+
+    out_dir = Path(figures_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    written: list[Path] = []
+    for b, site in enumerate(site_indices):
+        overlay = segmentation_overlay(intensity_stack[b], label_stack[b])
+        path = out_dir / f"{objects_name}_site{site:05d}.png"
+        cv2.imwrite(str(path), overlay)
+        written.append(path)
+    return written

@@ -253,21 +253,16 @@ class Workflow:
             if hasattr(step, "run_batches_pipelined"):
                 # device-async pipelining: host IO of adjacent batches runs
                 # in the shadow of device compute (see the step's docstring)
-                bt0 = time.time()
-                for batch, result in step.run_batches_pipelined(pending):
-                    self.ledger.append(step=sd.name, event="batch_done",
-                                       batch=batch["index"],
-                                       elapsed=time.time() - bt0, result=result)
-                    results.append(result)
-                    bt0 = time.time()
+                runs = step.run_batches_pipelined(pending)
             else:
-                for batch in pending:
-                    bt0 = time.time()
-                    result = step.run_batch(batch)
-                    self.ledger.append(step=sd.name, event="batch_done",
-                                       batch=batch["index"],
-                                       elapsed=time.time() - bt0, result=result)
-                    results.append(result)
+                runs = ((b, step.run_batch(b)) for b in pending)
+            bt0 = time.time()
+            for batch, result in runs:
+                self.ledger.append(step=sd.name, event="batch_done",
+                                   batch=batch["index"],
+                                   elapsed=time.time() - bt0, result=result)
+                results.append(result)
+                bt0 = time.time()
             collected = step.collect()
             self.ledger.append(step=sd.name, event="step_done",
                                elapsed=time.time() - t0, collected=collected)

@@ -39,6 +39,9 @@ class ImageAnalysisRunner(Step):
         Argument("zplane", int, default=0),
         Argument("as_polygons", bool, default=False,
                  help="also trace object outlines host-side"),
+        Argument("figures", bool, default=False,
+                 help="write per-site segmentation-overlay PNGs "
+                      "(reference: jterator module plot/Figure artifacts)"),
     )
 
     def __init__(self, store):
@@ -227,6 +230,27 @@ class ImageAnalysisRunner(Step):
             if args["as_polygons"] and objects[name].ndim == 3:
                 self._write_polygons(name, objects[name], sites, shard)
 
+        if args.get("figures"):
+            # segmentation-overlay artifacts (reference module Figure
+            # outputs) — rendered host-side from the persisted labels on
+            # the first input channel
+            from tmlibrary_tpu.jterator.figures import write_figures
+
+            desc, _ = self._pipeline(args)
+            first_ch = next((c for c in desc.channels if not c.zstack), None)
+            if first_ch is not None:
+                idx = self.store.experiment.channel_index(first_ch.name)
+                base = self.store.read_sites(
+                    sites, cycle=args["cycle"], channel=idx,
+                    tpoint=tpoint, zplane=zplane,
+                )
+                for name, labels in objects.items():
+                    if labels.ndim == 3:
+                        write_figures(
+                            self.store.root / "figures", name, base,
+                            labels, sites,
+                        )
+
         return {
             "n_sites": n_valid,
             "objects": {k: int(v.sum()) for k, v in counts.items()},
@@ -335,7 +359,7 @@ class ImageAnalysisRunner(Step):
     def delete_previous_output(self) -> None:
         import shutil
 
-        for sub in ("segmentations", "features"):
+        for sub in ("segmentations", "features", "figures"):
             d = self.store.root / sub
             if d.exists():
                 shutil.rmtree(d)

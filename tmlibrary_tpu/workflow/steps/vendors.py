@@ -559,7 +559,10 @@ def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     htds = sorted(p for p in source_dir.rglob("*") if p.suffix.upper() == ".HTD")
     if not htds:
         return None
-    # one plate scope per HTD directory; first parseable HTD in a dir wins
+    # one plate scope per HTD directory; first parseable HTD in a dir wins.
+    # Plate names come from the scope directory's path relative to the
+    # source root — scope dirs are unique, so names cannot collide even
+    # when two plate folders carry same-named .HTD files.
     scopes: list[tuple[Path, str, dict]] = []
     seen_dirs: set[Path] = set()
     for htd in htds:
@@ -571,7 +574,8 @@ def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
             logger.warning("ignoring unparseable .HTD file: %s", exc)
             continue
         seen_dirs.add(htd.parent)
-        plate = htd.stem if len(htds) > 1 else "plate00"
+        rel = htd.parent.relative_to(source_dir)
+        plate = "_".join(rel.parts) if rel.parts else "plate00"
         scopes.append((htd.parent, plate, info))
     if not scopes:
         raise MetadataError(f"no parseable .HTD file under {source_dir}")
@@ -579,11 +583,15 @@ def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     entries: list[dict] = []
     skipped = 0
     claimed: set[Path] = set()
-    # deepest scope first so nested plate folders claim their own files
-    for scope_dir, plate, info in sorted(
-        scopes, key=lambda s: len(s[0].parts), reverse=True
-    ):
-        for p in sorted(scope_dir.rglob("*")):
+    # deepest scope first so nested plate folders claim their own files;
+    # a final source-root pass under the shallowest scope picks up images
+    # living outside every HTD directory (layouts that park the HTD in a
+    # sidecar folder like PlateInfo/) instead of silently dropping them
+    ordered = sorted(scopes, key=lambda s: len(s[0].parts), reverse=True)
+    shallowest = ordered[-1]
+    sweeps = list(ordered) + [(source_dir, shallowest[1], shallowest[2])]
+    for scan_dir, plate, info in sweeps:
+        for p in sorted(scan_dir.rglob("*")):
             if p in claimed or not p.is_file():
                 continue
             if p.suffix.lower() not in (".tif", ".tiff"):
@@ -610,7 +618,7 @@ def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
             tpoint = 0
             # only directory levels BELOW the plate scope address
             # timepoints — an ancestor dir named TimePoint_<n> must not
-            for part in p.relative_to(scope_dir).parts[:-1]:
+            for part in p.relative_to(scan_dir).parts[:-1]:
                 tm = re.fullmatch(r"TimePoint_(\d+)", part)
                 if tm:
                     tpoint = int(tm.group(1)) - 1
