@@ -708,3 +708,25 @@ def test_imagexpress_htd_in_sidecar_folder(tmp_path):
     assert len(entries) == 1
     assert entries[0]["channel"] == "DAPI"
     assert skipped == 0
+
+
+def test_imagexpress_multi_plate_stray_file_skipped(tmp_path):
+    """Multi-plate trees never guess an owner for stray images."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import imagexpress_sidecar
+
+    src = tmp_path / "src"
+    for plate in ("plateA", "plateB"):
+        d = src / plate
+        d.mkdir(parents=True)
+        (d / "p.HTD").write_text('\n'.join([
+            '"TimePoints", 1', '"XSites", 1', '"YSites", 1',
+            '"NWavelengths", 1', '"WaveName1", "DAPI"', '"EndFile",',
+        ]))
+        cv2.imwrite(str(d / "exp_B02_s1_w1.tif"), np.full((8, 8), 5, np.uint16))
+    cv2.imwrite(str(src / "overview_B05_s1_w1.tif"), np.full((8, 8), 5, np.uint16))
+    entries, skipped = imagexpress_sidecar(src)
+    assert len(entries) == 2
+    assert {e["plate"] for e in entries} == {"plateA", "plateB"}
+    assert skipped == 1

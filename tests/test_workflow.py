@@ -328,3 +328,51 @@ def test_jterator_figures_artifacts(source_dir, store):
     assert img.shape == (64, 64, 3)
     # boundaries are colored: the overlay is not pure grayscale
     assert not (img[..., 0] == img[..., 1]).all()
+
+
+def test_jterator_applies_intersection_crop(source_dir, store):
+    """With cycle alignment, every channel is cropped to the stored
+    intersection window inside the fused program, and persisted labels /
+    centroids are mapped back to the site frame (reference
+    SiteIntersection semantics)."""
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    for name in ("metaconfig", "imextract", "corilla"):
+        sd = next(s for stage in desc.stages for s in stage.steps if s.name == name)
+        step = get_step(name)(store)
+        step.init(sd.args)
+        for j in step.list_batches():
+            step.run(j)
+
+    # simulate an align run: +3px dy shift everywhere, stored window
+    n = store.n_sites
+    store.write_shifts(np.tile([[3, 0]], (n, 1)).astype(np.int32), cycle=0)
+    store.write_intersection({"top": 3, "bottom": 0, "left": 0, "right": 0})
+
+    pipe_yaml = yaml.safe_load(yaml.safe_dump(PIPE_YAML))
+    pipe_yaml["input"]["channels"][0]["align"] = True
+    pipe_yaml["pipeline"].append({"handles": {
+        "module": "measure_morphology",
+        "input": [
+            {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+        ],
+        "output": [
+            {"name": "measurements", "type": "Measurement", "objects": "nuclei"},
+        ],
+    }})
+    (store.root / "aligned.pipe.yaml").write_text(yaml.safe_dump(pipe_yaml))
+
+    jd = next(s for stage in desc.stages for s in stage.steps if s.name == "jterator")
+    jt = get_step("jterator")(store)
+    jt.init({**jd.args, "pipe": "aligned.pipe.yaml", "batch_size": 16})
+    jt.run(0)
+
+    labels = store.read_labels(None, "nuclei")
+    assert labels.shape == (16, 64, 64)  # site frame preserved
+    # cropped top margin maps back to rows 0..2 == empty after padding
+    assert labels[:, :3, :].max() == 0
+    assert labels.max() > 0
+    feats = store.read_features("nuclei")
+    # centroids are site-frame: none can sit inside the cropped margin
+    assert (feats["Morphology_centroid_y"] >= 3).all()

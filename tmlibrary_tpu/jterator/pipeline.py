@@ -169,7 +169,13 @@ class ImageAnalysisPipeline:
             for ch in desc.channels:
                 img = jnp.asarray(raw[ch.name], jnp.float32)
                 if ch.zstack:
-                    # volumes skip per-plane correction/alignment
+                    # volumes skip per-plane correction/alignment, but the
+                    # intersection crop still applies to their spatial dims
+                    # so every channel shares one frame
+                    if window is not None:
+                        top, bottom, left, right = window
+                        zh, zw = img.shape[-2], img.shape[-1]
+                        img = img[..., top : zh - bottom, left : zw - right]
                     out[ch.name] = img
                     continue
                 if ch.correct and ch.name in stats:
@@ -177,6 +183,11 @@ class ImageAnalysisPipeline:
                     img = image_ops.correct_illumination(img, mean_log, std_log)
                 if ch.align:
                     img = image_ops.align(img, shift[0], shift[1], window)
+                elif window is not None:
+                    # the intersection window applies to EVERY channel once
+                    # cycles are aligned (reference SiteIntersection crops
+                    # the whole site), else channel shapes diverge mid-chain
+                    img = image_ops.crop_window(img, *window)
                 out[ch.name] = img
             return out
 
@@ -199,9 +210,13 @@ class ImageAnalysisPipeline:
 
         def one_site(raw, stats, shift):
             images = preprocess(raw, stats, shift)
-            # pass loaded objects (if any) through untouched
+            # pass loaded objects (if any) through; label images loaded
+            # from the store live in the uncropped site frame, so they get
+            # the same intersection crop as the pixel channels
             for key, val in raw.items():
                 if key not in images:
+                    if window is not None and jnp.ndim(val) == 2:
+                        val = image_ops.crop_window(val, *window)
                     images[key] = val
             return site_fn(images)
 

@@ -17,12 +17,27 @@ from __future__ import annotations
 
 import numpy as np
 
-from tmlibrary_tpu.errors import PipelineError
+from tmlibrary_tpu.errors import PipelineError, StoreError
 from tmlibrary_tpu.models.image import IllumstatsContainer
 from tmlibrary_tpu.utils import create_partitions
 from tmlibrary_tpu.workflow.api import Step
 from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
 from tmlibrary_tpu.workflow.registry import register_step
+
+
+def _host_shift(img: np.ndarray, dy: int, dx: int) -> np.ndarray:
+    """Integer translate with zero fill — host twin of ops.image_ops.shift_image."""
+    out = np.roll(img, (int(dy), int(dx)), axis=(0, 1))
+    h, w = out.shape
+    if dy > 0:
+        out[:dy, :] = 0
+    elif dy < 0:
+        out[h + dy:, :] = 0
+    if dx > 0:
+        out[:, :dx] = 0
+    elif dx < 0:
+        out[:, w + dx:] = 0
+    return out
 
 
 @register_step("jterator")
@@ -48,6 +63,7 @@ class ImageAnalysisRunner(Step):
         super().__init__(store)
         self._compiled = None
         self._desc = None
+        self._window: tuple[int, int, int, int] | None = None
 
     def create_batches(self, args):
         sites = list(range(self.store.n_sites))
@@ -68,8 +84,19 @@ class ImageAnalysisRunner(Step):
                 pipe_path = self.store.root / pipe_path
             self._desc = PipelineDescription.load(pipe_path)
         if self._compiled is None:
+            # aligned multiplexing experiments crop every channel to the
+            # inter-cycle intersection (reference SiteIntersection); the
+            # window is experiment-static, so it compiles into the program
+            if any(ch.align for ch in self._desc.channels):
+                try:
+                    w = self.store.read_intersection()
+                    self._window = (w["top"], w["bottom"], w["left"], w["right"])
+                except StoreError:
+                    self._window = None  # align step didn't run: no crop
+                if self._window == (0, 0, 0, 0):
+                    self._window = None
             pipe = ImageAnalysisPipeline(self._desc, max_objects=args["max_objects"])
-            self._compiled = pipe.build_batch_fn()
+            self._compiled = pipe.build_batch_fn(window=self._window)
         return self._desc, self._compiled
 
     # -------------------------------------------------------------------- run
@@ -194,6 +221,25 @@ class ImageAnalysisRunner(Step):
             for obj, feats in result.measurements.items()
         }
 
+        if self._window is not None:
+            # cropped intersection frame → site frame: pad labels back with
+            # the window offsets and shift positional features, so stored
+            # stacks, polygons and figures all live in site coordinates
+            top, bottom, left, right = self._window
+            # labels (2-D (B,H,W) or volume (B,Z,H,W)) were computed in the
+            # cropped frame; pad the spatial dims back to the site frame
+            objects = {
+                name: np.pad(
+                    lab,
+                    [(0, 0)] * (lab.ndim - 2) + [(top, bottom), (left, right)],
+                )
+                for name, lab in objects.items()
+            }
+            for feats in measurements.values():
+                if "Morphology_centroid_y" in feats:
+                    feats["Morphology_centroid_y"] = feats["Morphology_centroid_y"] + top
+                    feats["Morphology_centroid_x"] = feats["Morphology_centroid_x"] + left
+
         # solidity is hull-based and ragged, so it is measured host-side on
         # the exported label images and joined into the morphology features
         # (reference: jtlib/features/morphology solidity via regionprops)
@@ -244,6 +290,14 @@ class ImageAnalysisRunner(Step):
                     sites, cycle=args["cycle"], channel=idx,
                     tpoint=tpoint, zplane=zplane,
                 )
+                if first_ch.align and self.store.has_shifts(args["cycle"]):
+                    # labels live in the aligned frame; shift the raw base
+                    # the same way or boundaries draw offset from the cells
+                    table = self.store.read_shifts(args["cycle"])
+                    base = np.stack([
+                        _host_shift(base[b], *table[s])
+                        for b, s in enumerate(sites)
+                    ])
                 for name, labels in objects.items():
                     if labels.ndim == 3:
                         write_figures(

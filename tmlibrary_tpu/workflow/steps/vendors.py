@@ -588,8 +588,14 @@ def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     # living outside every HTD directory (layouts that park the HTD in a
     # sidecar folder like PlateInfo/) instead of silently dropping them
     ordered = sorted(scopes, key=lambda s: len(s[0].parts), reverse=True)
-    shallowest = ordered[-1]
-    sweeps = list(ordered) + [(source_dir, shallowest[1], shallowest[2])]
+    sweeps = list(ordered)
+    if len(scopes) == 1:
+        # single-plate layout with the HTD in a sidecar folder: images
+        # outside the HTD directory unambiguously belong to that plate.
+        # With several plates, a stray file outside every plate folder has
+        # no owner — it is counted as skipped below, never guessed.
+        only = scopes[0]
+        sweeps.append((source_dir, only[1], only[2]))
     for scan_dir, plate, info in sweeps:
         for p in sorted(scan_dir.rglob("*")):
             if p in claimed or not p.is_file():
@@ -637,6 +643,14 @@ def imagexpress_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                     "path": str(p),
                 }
             )
+    if len(scopes) > 1:
+        # multi-plate: stray pattern-matching images outside every plate
+        # folder are visible in the skip count instead of silently ignored
+        for p in sorted(source_dir.rglob("*")):
+            if p in claimed or not p.is_file():
+                continue
+            if p.suffix.lower() in (".tif", ".tiff") and "_thumb" not in p.name:
+                skipped += 1
     return entries, skipped
 
 
