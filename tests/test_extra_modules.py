@@ -106,3 +106,31 @@ def test_expand_or_shrink_roundtrip():
     # shrinking back leaves roughly the original square
     assert (shrunk > 0).sum() <= (grown > 0).sum()
     assert shrunk[11, 11] == 1
+
+
+def test_separate_clumps_form_factor_selectivity():
+    """max_form_factor < 1: round objects stay intact, dumbbells split."""
+    import numpy as np
+
+    yy, xx = np.mgrid[0:64, 0:96]
+    labels = np.zeros((64, 96), np.int32)
+    # dumbbell: two overlapping disks -> low form factor
+    d1 = (yy - 32) ** 2 + (xx - 24) ** 2 < 121
+    d2 = (yy - 32) ** 2 + (xx - 40) ** 2 < 121
+    labels[d1 | d2] = 1
+    # clean disk far away -> form factor ~1
+    labels[(yy - 32) ** 2 + (xx - 75) ** 2 < 121] = 2
+
+    out = np.asarray(
+        separate_clumps(
+            jnp.asarray(labels), min_distance=5, max_form_factor=0.6
+        )["separated_label_image"]
+    )
+    # disk kept as ONE object: its pixel set maps to a single output id
+    disk_ids = set(np.unique(out[labels == 2]))
+    assert len(disk_ids) == 1 and 0 not in disk_ids
+    # dumbbell split into two
+    clump_ids = set(np.unique(out[labels == 1])) - {0}
+    assert len(clump_ids) == 2
+    # all ids compact 1..3
+    assert set(np.unique(out)) == {0, 1, 2, 3}

@@ -376,3 +376,37 @@ def test_jterator_applies_intersection_crop(source_dir, store):
     feats = store.read_features("nuclei")
     # centroids are site-frame: none can sit inside the cropped margin
     assert (feats["Morphology_centroid_y"] >= 3).all()
+
+
+def test_cli_export_features(source_dir, store, tmp_path, capsys):
+    """tmx export writes the combined feature table as CSV/Parquet."""
+    import pandas as pd
+
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    for name in ("metaconfig", "imextract", "corilla", "jterator"):
+        sd = next(s for stage in desc.stages for s in stage.steps if s.name == name)
+        step = get_step(name)(store)
+        step.init(sd.args)
+        for j in step.list_batches():
+            step.run(j)
+
+    out_csv = tmp_path / "nuclei.csv"
+    rc = main(["export", "--root", str(store.root), "--objects", "nuclei",
+               "--out", str(out_csv)])
+    assert rc == 0
+    df = pd.read_csv(out_csv)
+    assert len(df) > 20
+    assert {"site_index", "label", "Intensity_mean_DAPI"} <= set(df.columns)
+
+    out_pq = tmp_path / "nuclei.parquet"
+    assert main(["export", "--root", str(store.root), "--objects", "nuclei",
+                 "--out", str(out_pq)]) == 0
+    assert len(pd.read_parquet(out_pq)) == len(df)
+
+    # unknown object type is a clean error, not a traceback
+    assert main(["export", "--root", str(store.root), "--objects", "nope",
+                 "--out", str(tmp_path / "x.csv")]) == 1
+    assert "no feature shards" in capsys.readouterr().err

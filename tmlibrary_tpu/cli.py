@@ -55,6 +55,17 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p_log)
     p_log.add_argument("--tail", type=int, default=20)
 
+    p_export = sub.add_parser(
+        "export", help="export an object type's combined feature table"
+    )
+    _add_common(p_export)
+    p_export.add_argument("--objects", required=True, help="object type name")
+    p_export.add_argument("--out", required=True, help="output file path")
+    p_export.add_argument(
+        "--format", choices=("csv", "parquet"), default=None,
+        help="inferred from --out suffix when omitted",
+    )
+
     p_wf = sub.add_parser("workflow", help="full workflow orchestration")
     wf_sub = p_wf.add_subparsers(dest="verb", required=True)
     p_submit = wf_sub.add_parser("submit", help="run the workflow")
@@ -281,6 +292,27 @@ def cmd_log(args) -> int:
     return 0
 
 
+def cmd_export(args) -> int:
+    """Combined per-object feature table → one CSV/Parquet file.
+
+    Reference parity: the reference serves feature values through tmserver's
+    data-export endpoints (FeatureValues over the Citus shards); here the
+    Parquet shards the jterator step appended are concatenated and written
+    as one table with the site/well metadata columns already joined.
+    """
+    store = _open_store(args)
+    table = store.read_features(args.objects)
+    out = Path(args.out)
+    fmt = args.format or ("csv" if out.suffix.lower() == ".csv" else "parquet")
+    out.parent.mkdir(parents=True, exist_ok=True)
+    if fmt == "csv":
+        table.to_csv(out, index=False)
+    else:
+        table.to_parquet(out, index=False)
+    print(f"wrote {len(table)} rows x {len(table.columns)} cols to {out}")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     configure_logging(getattr(args, "verbosity", 0))
@@ -295,6 +327,8 @@ def main(argv=None) -> int:
             return cmd_project(args)
         if args.command == "log":
             return cmd_log(args)
+        if args.command == "export":
+            return cmd_export(args)
         return cmd_step(args)
     except Exception as e:
         print(f"error: {e}", file=sys.stderr)
