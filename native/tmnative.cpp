@@ -262,3 +262,311 @@ int32_t tm_hull_pixel_counts(const int32_t* labels, int32_t h, int32_t w,
 }
 
 }  // extern "C"
+
+
+// ---------------------------------------------------------------------------
+// Minimal TIFF reader: the native data-loader for imextract.
+//
+// Reference parity: the reference's image ingest leans on Bio-Formats (Java)
+// and cv2 (C++) for plane decoding (SURVEY.md §3 readers row); this is the
+// first-party replacement covering the formats microscopes actually emit as
+// plain TIFF: classic little/big-endian TIFF, strip-organized, grayscale
+// 8/16-bit, uncompressed / LZW (with horizontal predictor) / PackBits,
+// multi-page.  Anything else returns an error and the Python caller falls
+// back to cv2.
+// ---------------------------------------------------------------------------
+
+#include <cstdio>
+
+namespace tifflite {
+
+struct Buf {
+  std::vector<uint8_t> d;
+  bool le = true;
+  uint16_t rd16(size_t o) const {
+    if (o + 2 > d.size()) return 0;
+    return le ? (uint16_t)(d[o] | (d[o + 1] << 8))
+              : (uint16_t)((d[o] << 8) | d[o + 1]);
+  }
+  uint32_t rd32(size_t o) const {
+    if (o + 4 > d.size()) return 0;
+    return le ? ((uint32_t)d[o] | ((uint32_t)d[o + 1] << 8) |
+                 ((uint32_t)d[o + 2] << 16) | ((uint32_t)d[o + 3] << 24))
+              : (((uint32_t)d[o] << 24) | ((uint32_t)d[o + 1] << 16) |
+                 ((uint32_t)d[o + 2] << 8) | (uint32_t)d[o + 3]);
+  }
+};
+
+static bool load_file(const char* path, Buf& b) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return false;
+  // reject non-TIFF from the 4-byte header BEFORE slurping the file, so a
+  // PNG handed to the reader costs 4 bytes of IO, not a full read
+  uint8_t hdr[4];
+  if (std::fread(hdr, 1, 4, f) != 4) { std::fclose(f); return false; }
+  if (hdr[0] == 'I' && hdr[1] == 'I') b.le = true;
+  else if (hdr[0] == 'M' && hdr[1] == 'M') b.le = false;
+  else { std::fclose(f); return false; }
+  uint16_t magic = b.le ? (uint16_t)(hdr[2] | (hdr[3] << 8))
+                        : (uint16_t)((hdr[2] << 8) | hdr[3]);
+  if (magic != 42) { std::fclose(f); return false; }  // classic TIFF only
+  std::fseek(f, 0, SEEK_END);
+  long sz = std::ftell(f);
+  if (sz <= 8) { std::fclose(f); return false; }
+  std::fseek(f, 0, SEEK_SET);
+  b.d.resize((size_t)sz);
+  size_t got = std::fread(b.d.data(), 1, (size_t)sz, f);
+  std::fclose(f);
+  return got == (size_t)sz;
+}
+
+// cap on IFD-chain walks: bounds page counts AND terminates on cyclic
+// next-IFD pointers in corrupt/malicious files
+constexpr int32_t kMaxPages = 65535;
+
+struct Entry { uint16_t type; uint32_t count; size_t value_off; };
+
+// value_off points at the 4-byte value field itself; values larger than
+// 4 bytes live at the offset stored there.
+static size_t entry_data(const Buf& b, const Entry& e, size_t elem_size) {
+  size_t total = (size_t)e.count * elem_size;
+  return total <= 4 ? e.value_off : (size_t)b.rd32(e.value_off);
+}
+
+static uint32_t entry_int(const Buf& b, const Entry& e, uint32_t idx) {
+  size_t elem = e.type == 3 ? 2 : 4;  // SHORT or LONG
+  size_t base = entry_data(b, e, elem);
+  return elem == 2 ? b.rd16(base + 2 * idx) : b.rd32(base + 4 * idx);
+}
+
+struct IFD {
+  uint32_t width = 0, height = 0, bits = 0, compression = 1;
+  uint32_t samples = 1, rows_per_strip = 0xFFFFFFFFu, predictor = 1;
+  std::vector<size_t> strip_offsets, strip_counts;
+};
+
+static bool parse_ifd(const Buf& b, size_t off, IFD& out, size_t* next) {
+  if (off == 0 || off + 2 > b.d.size()) return false;
+  uint16_t n = b.rd16(off);
+  size_t p = off + 2;
+  if (p + 12 * (size_t)n + 4 > b.d.size()) return false;
+  Entry so{0, 0, 0}, sc{0, 0, 0};
+  for (uint16_t i = 0; i < n; ++i, p += 12) {
+    uint16_t tag = b.rd16(p);
+    Entry e{b.rd16(p + 2), b.rd32(p + 4), p + 8};
+    switch (tag) {
+      case 256: out.width = entry_int(b, e, 0); break;
+      case 257: out.height = entry_int(b, e, 0); break;
+      case 258: out.bits = entry_int(b, e, 0); break;
+      case 259: out.compression = entry_int(b, e, 0); break;
+      case 273: so = e; break;
+      case 277: out.samples = entry_int(b, e, 0); break;
+      case 278: out.rows_per_strip = entry_int(b, e, 0); break;
+      case 279: sc = e; break;
+      case 317: out.predictor = entry_int(b, e, 0); break;
+      default: break;
+    }
+  }
+  *next = b.rd32(p);
+  if (so.count == 0 || sc.count == 0 || so.count != sc.count) return false;
+  for (uint32_t i = 0; i < so.count; ++i) {
+    out.strip_offsets.push_back(entry_int(b, so, i));
+    out.strip_counts.push_back(entry_int(b, sc, i));
+  }
+  return out.width > 0 && out.height > 0;
+}
+
+static bool lzw_decode(const uint8_t* src, size_t n, std::vector<uint8_t>& out,
+                       size_t expect) {
+  // TIFF LZW: MSB-first codes, 256=Clear, 257=EOI, early code-width change
+  std::vector<std::vector<uint8_t>> table;
+  table.reserve(4096);
+  auto reset = [&]() {
+    table.clear();
+    for (int i = 0; i < 256; ++i) table.push_back({(uint8_t)i});
+    table.push_back({});  // 256 clear
+    table.push_back({});  // 257 eoi
+  };
+  reset();
+  out.clear();
+  out.reserve(expect);
+  size_t bitpos = 0;
+  int width = 9;
+  int prev = -1;
+  auto next_code = [&]() -> int {
+    if ((bitpos + (size_t)width) > 8 * n) return 257;
+    uint32_t v = 0;
+    for (int i = 0; i < width; ++i) {
+      size_t byte = (bitpos + (size_t)i) >> 3;
+      int bit = 7 - (int)((bitpos + (size_t)i) & 7);
+      v = (v << 1) | ((src[byte] >> bit) & 1);
+    }
+    bitpos += (size_t)width;
+    return (int)v;
+  };
+  while (out.size() < expect) {
+    int code = next_code();
+    if (code == 257) break;  // EOI
+    if (code == 256) {       // Clear
+      reset();
+      width = 9;
+      prev = -1;
+      continue;
+    }
+    std::vector<uint8_t> entry;
+    if (code < (int)table.size() && (code < 256 || code > 257)) {
+      entry = table[(size_t)code];
+    } else if (code == (int)table.size() && prev >= 0) {
+      entry = table[(size_t)prev];
+      entry.push_back(table[(size_t)prev][0]);
+    } else {
+      return false;  // corrupt stream
+    }
+    out.insert(out.end(), entry.begin(), entry.end());
+    if (prev >= 0) {
+      std::vector<uint8_t> ne = table[(size_t)prev];
+      ne.push_back(entry[0]);
+      table.push_back(std::move(ne));
+    }
+    // early change: width grows when the NEXT code would not fit
+    if (table.size() + 1 >= (size_t)(1u << width) && width < 12) ++width;
+    prev = code;
+  }
+  return out.size() >= expect;
+}
+
+static bool packbits_decode(const uint8_t* src, size_t n,
+                            std::vector<uint8_t>& out, size_t expect) {
+  out.clear();
+  out.reserve(expect);
+  size_t i = 0;
+  while (i < n && out.size() < expect) {
+    int8_t c = (int8_t)src[i++];
+    if (c >= 0) {
+      size_t cnt = (size_t)c + 1;
+      if (i + cnt > n) return false;
+      out.insert(out.end(), src + i, src + i + cnt);
+      i += cnt;
+    } else if (c != -128) {
+      if (i >= n) return false;
+      out.insert(out.end(), (size_t)(1 - c), src[i++]);
+    }
+  }
+  return out.size() >= expect;
+}
+
+// Walk to page `page`; -1 errors, else fills ifd.
+static int walk(const Buf& b, int32_t page, IFD& ifd) {
+  if (page >= kMaxPages) return -1;
+  size_t off = b.rd32(4);
+  for (int32_t i = 0; i < kMaxPages; ++i) {
+    IFD cur;
+    size_t next = 0;
+    if (!parse_ifd(b, off, cur, &next)) return -1;
+    if (i == page) { ifd = cur; return 0; }
+    if (next == 0) return -1;
+    off = next;
+  }
+  return -1;
+}
+
+}  // namespace tifflite
+
+extern "C" {
+
+// out4: [n_pages, height, width, bits] of page 0.  Returns 0, or -1 when
+// the file is not a TIFF this reader handles.
+int32_t tm_tiff_info(const char* path, int32_t* out4) {
+  if (!path || !out4) return -1;
+  tifflite::Buf b;
+  if (!tifflite::load_file(path, b)) return -1;
+  tifflite::IFD first;
+  size_t off = b.rd32(4), next = 0;
+  if (!tifflite::parse_ifd(b, off, first, &next)) return -1;
+  int32_t pages = 1;
+  while (next != 0 && pages < tifflite::kMaxPages) {
+    tifflite::IFD cur;
+    size_t nn = 0;
+    if (!tifflite::parse_ifd(b, next, cur, &nn)) break;
+    ++pages;
+    next = nn;
+  }
+  out4[0] = pages;
+  out4[1] = (int32_t)first.height;
+  out4[2] = (int32_t)first.width;
+  out4[3] = (int32_t)first.bits;
+  return 0;
+}
+
+// Decode grayscale page `page` into out (row-major uint16, h*w elements,
+// 8-bit samples are widened).  Returns 0 on success; -1 on any
+// parse/shape/unsupported-feature condition (caller falls back to cv2).
+int32_t tm_tiff_read(const char* path, int32_t page, uint16_t* out,
+                     int32_t h, int32_t w) {
+  if (!path || !out || h <= 0 || w <= 0 || page < 0) return -1;
+  tifflite::Buf b;
+  if (!tifflite::load_file(path, b)) return -1;
+  tifflite::IFD ifd;
+  if (tifflite::walk(b, page, ifd) != 0) return -1;
+  if ((int32_t)ifd.height != h || (int32_t)ifd.width != w) return -1;
+  if (ifd.samples != 1) return -1;                    // grayscale only
+  if (ifd.bits != 8 && ifd.bits != 16) return -1;
+  if (ifd.predictor != 1 && ifd.predictor != 2) return -1;
+
+  const size_t bytes_per_row = (size_t)w * (ifd.bits / 8);
+  std::vector<uint8_t> plane;
+  plane.reserve(bytes_per_row * (size_t)h);
+  uint32_t rps = ifd.rows_per_strip ? ifd.rows_per_strip : (uint32_t)h;
+  std::vector<uint8_t> strip;
+  for (size_t s = 0; s < ifd.strip_offsets.size(); ++s) {
+    uint32_t rows = rps;
+    uint32_t row0 = (uint32_t)s * rps;
+    if (row0 >= (uint32_t)h) break;
+    if (row0 + rows > (uint32_t)h) rows = (uint32_t)h - row0;
+    size_t expect = bytes_per_row * rows;
+    size_t off = ifd.strip_offsets[s], cnt = ifd.strip_counts[s];
+    if (off + cnt > b.d.size()) return -1;
+    const uint8_t* src = b.d.data() + off;
+    if (ifd.compression == 1) {
+      if (cnt < expect) return -1;
+      plane.insert(plane.end(), src, src + expect);
+    } else if (ifd.compression == 5) {
+      if (!tifflite::lzw_decode(src, cnt, strip, expect)) return -1;
+      plane.insert(plane.end(), strip.begin(), strip.begin() + expect);
+    } else if (ifd.compression == 32773) {
+      if (!tifflite::packbits_decode(src, cnt, strip, expect)) return -1;
+      plane.insert(plane.end(), strip.begin(), strip.begin() + expect);
+    } else {
+      return -1;  // unsupported codec
+    }
+  }
+  if (plane.size() < bytes_per_row * (size_t)h) return -1;
+
+  // samples -> uint16 with file byte order, then the horizontal predictor
+  for (int32_t y = 0; y < h; ++y) {
+    const uint8_t* row = plane.data() + (size_t)y * bytes_per_row;
+    uint16_t* dst = out + (size_t)y * (size_t)w;
+    if (ifd.bits == 8) {
+      for (int32_t x = 0; x < w; ++x) dst[x] = row[x];
+    } else {
+      for (int32_t x = 0; x < w; ++x) {
+        dst[x] = b.le ? (uint16_t)(row[2 * x] | (row[2 * x + 1] << 8))
+                      : (uint16_t)((row[2 * x] << 8) | row[2 * x + 1]);
+      }
+    }
+    if (ifd.predictor == 2) {
+      // horizontal differencing accumulates in the SAMPLE width: 8-bit
+      // samples wrap at 256, 16-bit at 65536
+      if (ifd.bits == 8) {
+        for (int32_t x = 1; x < w; ++x)
+          dst[x] = (uint16_t)((dst[x] + dst[x - 1]) & 0xFF);
+      } else {
+        for (int32_t x = 1; x < w; ++x)
+          dst[x] = (uint16_t)(dst[x] + dst[x - 1]);
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -184,3 +184,58 @@ def test_hull_native_matches_numpy_fallback(rng):
         native._lib = lib
         native._load_attempted = attempted
     np.testing.assert_array_equal(got, fallback)
+
+
+# -------------------------------------------------------------- tiff reader
+class TestTiffReader:
+    """First-party TIFF decode vs cv2 golden (SURVEY.md §3 readers row)."""
+
+    @pytest.mark.parametrize("dtype,hi", [(np.uint8, 255), (np.uint16, 65535)])
+    @pytest.mark.parametrize("comp", [1, 5, 32773])  # none, LZW, PackBits
+    def test_matches_cv2(self, tmp_path, rng, dtype, hi, comp):
+        import cv2
+
+        from tmlibrary_tpu.native import tiff_info, tiff_read
+
+        img = rng.integers(0, hi, (48, 80)).astype(dtype)
+        p = tmp_path / "x.tif"
+        cv2.imwrite(str(p), img, [cv2.IMWRITE_TIFF_COMPRESSION, comp])
+        info = tiff_info(p)
+        if info is None:
+            pytest.skip("native library unavailable")
+        assert info == (1, 48, 80, 8 * dtype().itemsize)
+        out = tiff_read(p, 0, 48, 80)
+        assert out is not None
+        assert np.array_equal(out, img.astype(np.uint16))
+
+    def test_multipage(self, tmp_path, rng):
+        import cv2
+
+        from tmlibrary_tpu.native import tiff_info, tiff_read
+
+        pages = [rng.integers(0, 65535, (16, 24)).astype(np.uint16)
+                 for _ in range(3)]
+        p = tmp_path / "stack.tif"
+        cv2.imwritemulti(str(p), pages)
+        info = tiff_info(p)
+        if info is None:
+            pytest.skip("native library unavailable")
+        assert info[0] == 3
+        for i, page in enumerate(pages):
+            out = tiff_read(p, i, 16, 24)
+            assert out is not None and np.array_equal(out, page)
+        # out-of-range page declines instead of crashing
+        assert tiff_read(p, 5, 16, 24) is None
+
+    def test_declines_non_tiff_and_wrong_shape(self, tmp_path, rng):
+        import cv2
+
+        from tmlibrary_tpu.native import tiff_read
+
+        img = rng.integers(0, 255, (16, 16)).astype(np.uint8)
+        png = tmp_path / "x.png"
+        cv2.imwrite(str(png), img)
+        assert tiff_read(png, 0, 16, 16) is None  # not a TIFF -> fallback
+        tif = tmp_path / "y.tif"
+        cv2.imwrite(str(tif), img)
+        assert tiff_read(tif, 0, 32, 32) is None  # shape mismatch -> decline

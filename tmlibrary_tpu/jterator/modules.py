@@ -452,9 +452,14 @@ def separate_clumps(
     )
     split = watershed_from_seeds(dist, seeds, elig_pix)
     # merge: kept objects keep their pixels, split pixels get offset ids,
-    # then compact to scipy scan order over the combined label space
+    # then compact to scipy scan order over the combined label space.
+    # Clip BEFORE relabeling: watershed seed ids are unbounded by
+    # max_objects, and relabel's gather would alias over-capacity ids onto
+    # 2*max_objects (merging distinct fragments) instead of dropping them
+    # — same overflow rule as segment_primary.
     combined = jnp.where(elig_pix, split + max_objects, labels)
     combined = jnp.where(mask, combined, 0)
+    combined = label_ops.clip_label_count(combined, 2 * max_objects)
     out = label_ops.relabel_by_scan_order(combined, 2 * max_objects)
     return {"separated_label_image": label_ops.clip_label_count(out, max_objects)}
 

@@ -81,6 +81,19 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
     ]
+    # tm_tiff_* may be absent from stale prebuilt libraries; probe
+    try:
+        lib.tm_tiff_info.restype = ctypes.c_int32
+        lib.tm_tiff_info.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.tm_tiff_read.restype = ctypes.c_int32
+        lib.tm_tiff_read.argtypes = [
+            ctypes.c_char_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.c_int32, ctypes.c_int32,
+        ]
+    except AttributeError:
+        logger.info("native library predates the TIFF reader; rebuild native/")
     _lib = lib
     return _lib
 
@@ -240,3 +253,37 @@ def bounding_boxes_host(labels: np.ndarray, max_label: int) -> np.ndarray:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return out
+
+
+# -------------------------------------------------------------- tiff reader
+def tiff_info(path) -> tuple[int, int, int, int] | None:
+    """(n_pages, height, width, bits) of a TIFF the native reader handles,
+    else None (caller falls back to cv2)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_tiff_info"):
+        return None
+    out = np.zeros((4,), np.int32)
+    rc = lib.tm_tiff_info(
+        str(path).encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    )
+    if rc != 0:
+        return None
+    return tuple(int(v) for v in out)
+
+
+def tiff_read(path, page: int, height: int, width: int) -> np.ndarray | None:
+    """Decode one grayscale TIFF page to (height, width) uint16 with the
+    first-party native reader (classic TIFF, strips, none/LZW/PackBits,
+    horizontal predictor, 8/16-bit).  None = unsupported file; caller
+    falls back to cv2.  Reference parity: the Bio-Formats/cv2 plane-decode
+    role of ``tmlib/readers.py`` (SURVEY.md §3 readers row)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_tiff_read"):
+        return None
+    out = np.empty((height, width), np.uint16)
+    rc = lib.tm_tiff_read(
+        str(path).encode(), int(page),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        int(height), int(width),
+    )
+    return out if rc == 0 else None

@@ -33,9 +33,37 @@ class ImageExtractor(Step):
             for chunk in create_partitions(mapping, args["batch_size"])
         ]
 
-    def run_batch(self, batch: dict) -> dict:
+    @staticmethod
+    def _read_plane(path: str, page: int | None, height: int, width: int):
+        """One grayscale plane as uint16: first-party native TIFF reader
+        (classic strip TIFF, none/LZW/PackBits — the native data-loader),
+        cv2 for everything it declines (PNG, tiled/BigTIFF, RGB, ...)."""
+        from tmlibrary_tpu.native import tiff_read
+
+        img = tiff_read(path, page or 0, height, width)
+        if img is not None:
+            return img
+
         import cv2
 
+        if page is not None:
+            # multi-page OME-TIFF: decode only the declared page (caching
+            # whole files across a batch risks host OOM on large z/t stacks)
+            ok, pages = cv2.imreadmulti(
+                path, start=page, count=1, flags=cv2.IMREAD_UNCHANGED
+            )
+            if not ok or not pages:
+                raise MetadataError(f"cannot read page {page} of {path}")
+            img = pages[0]
+        else:
+            img = cv2.imread(path, cv2.IMREAD_UNCHANGED)
+        if img is None:
+            raise MetadataError(f"cannot read image {path}")
+        if img.ndim == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2GRAY)
+        return img
+
+    def run_batch(self, batch: dict) -> dict:
         exp = self.store.experiment
         # group by target plane so each plane's sites write in one slice
         by_plane: dict[tuple, list[dict]] = {}
@@ -48,26 +76,9 @@ class ImageExtractor(Step):
             pixels = []
             indices = []
             for f in files:
-                page = f.get("page")
-                if page is not None:
-                    # multi-page OME-TIFF: decode only the declared page
-                    # (caching whole files across a batch risks host OOM
-                    # on large z/t stacks)
-                    ok, pages = cv2.imreadmulti(
-                        f["path"], start=page, count=1,
-                        flags=cv2.IMREAD_UNCHANGED,
-                    )
-                    if not ok or not pages:
-                        raise MetadataError(
-                            f"cannot read page {page} of {f['path']}"
-                        )
-                    img = pages[0]
-                else:
-                    img = cv2.imread(f["path"], cv2.IMREAD_UNCHANGED)
-                if img is None:
-                    raise MetadataError(f"cannot read image {f['path']}")
-                if img.ndim == 3:
-                    img = cv2.cvtColor(img, cv2.COLOR_BGR2GRAY)
+                img = self._read_plane(
+                    f["path"], f.get("page"), exp.site_height, exp.site_width
+                )
                 if img.shape != (exp.site_height, exp.site_width):
                     raise MetadataError(
                         f"{f['path']}: shape {img.shape} != site shape "
