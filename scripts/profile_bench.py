@@ -89,6 +89,23 @@ def main():
     timeit("measure_intensity(nuclei)", scalar(v(mi)), nuclei, dapi)
     timeit("measure_intensity(cells)", scalar(v(mi)), cells, actin)
 
+    from tmlibrary_tpu.ops.measure import (
+        haralick_features,
+        intensity_quantiles,
+        morphology_features,
+        zernike_features,
+    )
+
+    timeit("measure_morphology", scalar(v(lambda l: morphology_features(l, MAXOBJ))),
+           nuclei)
+    timeit("intensity_quantiles", scalar(v(lambda l, im: intensity_quantiles(
+        l, im, MAXOBJ))), nuclei, dapi)
+    for method in ("matmul", "scatter"):
+        timeit(f"haralick L=16 ({method})", scalar(v(lambda l, im: haralick_features(
+            l, im, MAXOBJ, levels=16, glcm_method=method))), nuclei, actin)
+    timeit("zernike deg=6", scalar(v(lambda l: zernike_features(l, MAXOBJ, degree=6))),
+           nuclei)
+
 
 if __name__ == "__main__":
     main()

@@ -410,3 +410,33 @@ def test_cli_export_features(source_dir, store, tmp_path, capsys):
     assert main(["export", "--root", str(store.root), "--objects", "nope",
                  "--out", str(tmp_path / "x.csv")]) == 1
     assert "no feature shards" in capsys.readouterr().err
+
+
+def test_jterator_sharded_matches_single_device(source_dir, store):
+    """The step's sharded run_batch (site axis over a 4-device mesh) must
+    persist the same labels and counts as a single-device run."""
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    for name in ("metaconfig", "imextract", "corilla"):
+        sd = next(s for stage in desc.stages for s in stage.steps if s.name == name)
+        step = get_step(name)(store)
+        step.init(sd.args)
+        for j in step.list_batches():
+            step.run(j)
+
+    jd = next(s for stage in desc.stages for s in stage.steps if s.name == "jterator")
+
+    jt1 = get_step("jterator")(store)
+    jt1.init({**jd.args, "batch_size": 16, "n_devices": 1})
+    r1 = jt1.run(0)
+    labels_1dev = store.read_labels(None, "nuclei").copy()
+
+    jt4 = get_step("jterator")(store)
+    jt4.delete_previous_output()
+    jt4.init({**jd.args, "batch_size": 16, "n_devices": 4})
+    r4 = jt4.run(0)
+    labels_4dev = store.read_labels(None, "nuclei")
+
+    assert r1["objects"] == r4["objects"]
+    assert np.array_equal(labels_1dev, labels_4dev)
