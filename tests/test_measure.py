@@ -386,3 +386,23 @@ def test_intensity_quantiles_constant_object():
     out = intensity_quantiles(labels, img, max_objects=2)
     assert float(out["Intensity_median"][0]) == 7.0
     assert float(out["Intensity_p25"][0]) == 7.0
+
+
+def test_grouped_minmax_multi_paths_agree(rng):
+    """The chunked masked-reduce path (TPU) and the scatter path (CPU)
+    produce identical per-object min/max, including absent-label rows."""
+    from tmlibrary_tpu.ops.measure import grouped_minmax_multi
+
+    labels = np.zeros((40, 50), np.int32)
+    labels[2:10, 3:9] = 1
+    labels[20:35, 10:40] = 3  # label 2 absent
+    vals = [rng.normal(size=(40, 50)).astype(np.float32),
+            rng.integers(0, 1000, (40, 50)).astype(np.float32)]
+    mn_r, mx_r = grouped_minmax_multi(labels, vals, 4, method="reduce")
+    mn_s, mx_s = grouped_minmax_multi(labels, vals, 4, method="scatter")
+    assert np.array_equal(np.asarray(mn_r), np.asarray(mn_s))
+    assert np.array_equal(np.asarray(mx_r), np.asarray(mx_s))
+    for j, v in enumerate(vals):
+        assert np.asarray(mn_r)[0, j] == v[labels == 1].min()
+        assert np.asarray(mx_r)[2, j] == v[labels == 3].max()
+    assert np.isinf(np.asarray(mn_r)[1]).all()  # absent label -> +inf

@@ -142,6 +142,7 @@ def grouped_minmax_multi(
     labels: jax.Array,
     values: list[jax.Array],
     max_objects: int,
+    method: str = "auto",
 ) -> tuple[jax.Array, jax.Array]:
     """Per-object (min, max) of SEVERAL pixel value channels in one pass
     over the pixels — (M, K) mins and maxs.  One chunked loop carrying 2K
@@ -152,7 +153,9 @@ def grouped_minmax_multi(
     stacked = jnp.stack(
         [jnp.asarray(v, jnp.float32).reshape(-1) for v in values], axis=-1
     )  # (P, K)
-    if jax.default_backend() == "cpu":
+    if method == "auto":
+        method = "scatter" if jax.default_backend() == "cpu" else "reduce"
+    if method == "scatter":
         mn = jax.ops.segment_min(stacked, flat_l, num_segments=max_objects + 1)
         mx = jax.ops.segment_max(stacked, flat_l, num_segments=max_objects + 1)
         return mn[1:], mx[1:]
