@@ -440,3 +440,26 @@ def test_jterator_sharded_matches_single_device(source_dir, store):
 
     assert r1["objects"] == r4["objects"]
     assert np.array_equal(labels_1dev, labels_4dev)
+
+
+def test_step_log_capture_and_cli(source_dir, store, capsys):
+    """Per-batch/step log files are captured and surfaced by `tmx log
+    --step` (reference per-job stdout files, SURVEY §6)."""
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    mc = get_step("metaconfig")(store)
+    mc.init({"source_dir": str(source_dir)})
+    mc.run(0)
+    log_file = store.workflow_dir / "metaconfig" / "logs" / "batch_000.log"
+    assert log_file.exists()
+
+    rc = main(["log", "--root", str(store.root), "--step", "metaconfig",
+               "--job", "0"])
+    assert rc == 0
+    # engine-driven runs also produce a per-step run log
+    desc = make_description(source_dir, store)
+    Workflow(store, desc).run()
+    assert (store.workflow_dir / "jterator" / "logs" / "run.log").exists()
+    capsys.readouterr()
+    assert main(["log", "--root", str(store.root), "--step", "nope"]) == 1

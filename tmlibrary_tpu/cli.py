@@ -51,9 +51,14 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p_create)
     p_create.add_argument("--name", required=True)
 
-    p_log = sub.add_parser("log", help="show the run ledger")
+    p_log = sub.add_parser("log", help="show the run ledger or captured step logs")
     _add_common(p_log)
     p_log.add_argument("--tail", type=int, default=20)
+    p_log.add_argument("--step", default=None,
+                       help="print a step's captured log file instead")
+    p_log.add_argument("--job", type=int, default=None,
+                       help="batch index (with --step); omit for the "
+                            "whole-step run log")
 
     p_export = sub.add_parser(
         "export", help="export an object type's combined feature table"
@@ -286,6 +291,16 @@ def cmd_step(args) -> int:
 
 def cmd_log(args) -> int:
     store = _open_store(args)
+    if args.step:
+        name = "run" if args.job is None else f"batch_{args.job:03d}"
+        path = store.workflow_dir / args.step / "logs" / f"{name}.log"
+        if not path.exists():
+            print(f"error: no captured log at {path}", file=sys.stderr)
+            return 1
+        lines = path.read_text().splitlines()
+        for line in lines[-args.tail:] if args.tail else lines:
+            print(line)
+        return 0
     ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
     for event in ledger.events()[-args.tail:]:
         print(json.dumps(event, default=str))

@@ -13,6 +13,7 @@ instead of a cluster job."""
 from __future__ import annotations
 
 import abc
+import contextlib
 import json
 import logging
 import shutil
@@ -89,8 +90,30 @@ class Step(abc.ABC):
 
     def run(self, index: int) -> dict:
         batch = self.load_batch(index)
-        result = self.run_batch(batch)
+        with self.capture_logs(f"batch_{index:03d}"):
+            result = self.run_batch(batch)
         return result or {}
+
+    @contextlib.contextmanager
+    def capture_logs(self, name: str):
+        """Capture framework logging to ``<step_dir>/logs/<name>.log`` for
+        the duration (reference parity: per-job stdout/stderr files in the
+        experiment workflow dir, surfaced by the ``log`` CLI verb —
+        SURVEY.md §6 observability row)."""
+        log_dir = self.step_dir / "logs"
+        log_dir.mkdir(parents=True, exist_ok=True)
+        handler = logging.FileHandler(log_dir / f"{name}.log")
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+        )
+        handler.setLevel(logging.DEBUG)
+        root = logging.getLogger()
+        root.addHandler(handler)
+        try:
+            yield
+        finally:
+            root.removeHandler(handler)
+            handler.close()
 
     # -------------------------------------------------------------- collect
     def collect(self) -> dict:

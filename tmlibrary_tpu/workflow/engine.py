@@ -257,12 +257,13 @@ class Workflow:
             else:
                 runs = ((b, step.run_batch(b)) for b in pending)
             bt0 = time.time()
-            for batch, result in runs:
-                self.ledger.append(step=sd.name, event="batch_done",
-                                   batch=batch["index"],
-                                   elapsed=time.time() - bt0, result=result)
-                results.append(result)
-                bt0 = time.time()
+            with step.capture_logs("run"):  # per-step log file (§6)
+                for batch, result in runs:
+                    self.ledger.append(step=sd.name, event="batch_done",
+                                       batch=batch["index"],
+                                       elapsed=time.time() - bt0, result=result)
+                    results.append(result)
+                    bt0 = time.time()
             collected = step.collect()
             self.ledger.append(step=sd.name, event="step_done",
                                elapsed=time.time() - t0, collected=collected)
