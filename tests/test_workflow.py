@@ -453,6 +453,18 @@ def test_step_log_capture_and_cli(source_dir, store, capsys):
     mc.run(0)
     log_file = store.workflow_dir / "metaconfig" / "logs" / "batch_000.log"
     assert log_file.exists()
+    # INFO-level framework logging is captured even at default verbosity
+    import logging as _logging
+
+    _logging.getLogger("tmlibrary_tpu.test").info("marker-not-captured")
+    with mc.capture_logs("probe"):
+        _logging.getLogger("tmlibrary_tpu.test").info("marker-captured")
+    probe = (store.workflow_dir / "metaconfig" / "logs" / "probe.log").read_text()
+    assert "marker-captured" in probe
+    assert "marker-not-captured" not in probe
+    # re-running truncates instead of appending
+    mc.run(0)
+    assert log_file.read_text().count("planned") <= 1
 
     rc = main(["log", "--root", str(store.root), "--step", "metaconfig",
                "--job", "0"])

@@ -1,0 +1,220 @@
+"""Distributed connected-component labeling over spatially-sharded mosaics.
+
+The reference never labels a whole plate mosaic — objects live inside one
+site, so its cluster fan-out needs no cross-job connectivity (SURVEY.md §3
+"Parallelism strategies").  The TPU rebuild's spatial sharding
+(:mod:`tmlibrary_tpu.parallel.halo`) makes mosaic-scale segmentation
+possible, and that NEEDS cross-shard labeling: a cell crossing a shard
+seam must get one id on both sides.
+
+Algorithm (the halo analogue of multi-GPU union-find CC):
+
+1. every shard labels its block locally with GLOBAL min-linear-index
+   propagation (the same fixpoint as ``ops.label.connected_components``,
+   with row indices offset by the shard's global position);
+2. boundary rows travel one hop up/down the mesh ring (``ppermute``); each
+   shard min-joins its edge rows against the neighbor's opposite edge
+   (8- or 4-connectivity) and re-runs the local fixpoint;
+3. repeat until a global ``psum`` of the per-shard change flags is zero —
+   a component snaking across k shards converges in <= k outer rounds;
+4. dense scipy-scan-order ids: roots (label == own linear index) are
+   all-gathered as sorted per-shard lists and every pixel's rank is a
+   ``searchsorted`` into the merged root list — exactly the rank-by-first-
+   pixel numbering of ``scipy.ndimage.label``.
+
+Everything is jit-compiled ``shard_map``; the only allocation above a
+block is the (devices x max_roots_per_shard) root table.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tmlibrary_tpu.errors import ShardingError
+from tmlibrary_tpu.ops.label import _propagate_min, _run_min_scan
+
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+def _local_fixpoint(labels, mask, connectivity, axis_name=None):
+    """Converge min-label propagation inside one block (global indices)."""
+    shifts = [] if connectivity == 4 else [(-1, -1), (-1, 1), (1, -1), (1, 1)]
+
+    def body(state):
+        lab, _ = state
+        new = _propagate_min(lab, mask, shifts) if shifts else lab
+        new = _run_min_scan(new, mask, axis=1)
+        new = _run_min_scan(new, mask, axis=0)
+        return new, jnp.any(new != lab)
+
+    init_flag = jnp.bool_(True)
+    if axis_name is not None:
+        # under shard_map the carry must be device-varying like the body's
+        # output (vma typing)
+        init_flag = lax.pcast(init_flag, (axis_name,), to="varying")
+    out, _ = lax.while_loop(lambda s: s[1], body, (labels, init_flag))
+    return out
+
+
+def _seam_join(labels, mask, axis_name, connectivity):
+    """Min-join edge rows against ring neighbors; returns (labels, changed)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [(i, (i - 1) % n) for i in range(n)]
+
+    # neighbor-above's bottom row lands at my top; neighbor-below's top
+    # row lands at my bottom
+    above_lab = lax.ppermute(labels[-1], axis_name, down)
+    above_msk = lax.ppermute(mask[-1], axis_name, down)
+    below_lab = lax.ppermute(labels[0], axis_name, up)
+    below_msk = lax.ppermute(mask[0], axis_name, up)
+    # ring wrap is not adjacency: first/last shards ignore the wrapped row
+    above_msk = jnp.where(idx == 0, False, above_msk)
+    below_msk = jnp.where(idx == n - 1, False, below_msk)
+
+    dxs = (0,) if connectivity == 4 else (-1, 0, 1)
+
+    def row_min(row_lab, row_msk):
+        cand = jnp.full_like(row_lab, _BIG)
+        w = row_lab.shape[0]
+        for dx in dxs:
+            shifted = jnp.roll(row_lab, dx)
+            shifted_m = jnp.roll(row_msk, dx)
+            col = jnp.arange(w)
+            valid = shifted_m & ((col - dx >= 0) & (col - dx < w))
+            cand = jnp.minimum(cand, jnp.where(valid, shifted, _BIG))
+        return cand
+
+    top_cand = row_min(above_lab, above_msk)
+    bot_cand = row_min(below_lab, below_msk)
+    if labels.shape[0] == 1:
+        # single-row shards: row 0 IS row -1 — join both neighbors into the
+        # one row at once (two sequential .at[] writes would discard the
+        # first join and the loop would never converge)
+        new_row = jnp.where(
+            mask[0],
+            jnp.minimum(labels[0], jnp.minimum(top_cand, bot_cand)),
+            labels[0],
+        )
+        changed = jnp.any(new_row != labels[0])
+        return labels.at[0].set(new_row), changed
+    new_top = jnp.where(
+        mask[0], jnp.minimum(labels[0], top_cand), labels[0]
+    )
+    new_bot = jnp.where(
+        mask[-1], jnp.minimum(labels[-1], bot_cand), labels[-1]
+    )
+    changed = jnp.any(new_top != labels[0]) | jnp.any(new_bot != labels[-1])
+    labels = labels.at[0].set(new_top).at[-1].set(new_bot)
+    return labels, changed
+
+
+def distributed_connected_components(
+    mask: jax.Array,
+    mesh: Mesh,
+    connectivity: int = 8,
+    max_roots_per_shard: int = 4096,
+    axis: str = "rows",
+) -> tuple[jax.Array, jax.Array]:
+    """Label a row-sharded (H, W) bool mask; ids 1..N in scipy scan order.
+
+    Returns ``(labels, count)`` with ``labels`` sharded like the input.
+    Raises :class:`ShardingError` when rows don't divide the mesh, or when
+    a shard holds more than ``max_roots_per_shard`` components (the static
+    root-table bound; raise it for dense masks).
+    """
+    mask = jnp.asarray(mask, bool)
+    h, w = mask.shape
+    n = mesh.devices.size
+    if h % n != 0:
+        raise ShardingError(f"mask rows {h} not divisible by mesh size {n}")
+    if connectivity not in (4, 8):
+        raise ValueError("connectivity must be 4 or 8")
+    rows = h // n
+    k = max_roots_per_shard
+
+    def body(block):
+        idx = lax.axis_index(axis)
+        row0 = idx * rows
+        yy = (row0 + jnp.arange(rows, dtype=jnp.int32))[:, None]
+        xx = jnp.arange(w, dtype=jnp.int32)[None, :]
+        linear = yy * w + xx
+        labels = jnp.where(block, linear, _BIG)
+        labels = _local_fixpoint(labels, block, connectivity, axis)
+
+        def outer(state):
+            lab, _ = state
+            lab, changed = _seam_join(lab, block, axis, connectivity)
+            lab = _local_fixpoint(lab, block, connectivity, axis)
+            return lab, lax.psum(changed.astype(jnp.int32), axis) > 0
+
+        # psum makes the outer flag replicated, so its init stays plain
+        labels, _ = lax.while_loop(
+            lambda s: s[1], outer, (labels, jnp.bool_(True))
+        )
+
+        # dense ranks: roots sorted per shard, merged by all_gather
+        is_root = block & (labels == linear)
+        n_local = jnp.sum(is_root.astype(jnp.int32))
+        roots = jnp.sort(
+            jnp.where(is_root, linear, _BIG).reshape(-1)
+        )[:k]
+        all_roots = jnp.sort(lax.all_gather(roots, axis).reshape(-1))
+        rank = jnp.searchsorted(all_roots, labels.reshape(-1)).reshape(labels.shape)
+        out = jnp.where(block, rank + 1, 0).astype(jnp.int32)
+        count = lax.psum(n_local, axis)
+        overflow = lax.pmax(n_local, axis)
+        return out, count[None], overflow[None]
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(axis),
+        out_specs=(
+            PartitionSpec(axis),
+            PartitionSpec(axis),
+            PartitionSpec(axis),
+        ),
+    )
+    sharded = jax.device_put(mask, NamedSharding(mesh, PartitionSpec(axis)))
+    labels, counts, overflow = jax.jit(mapped)(sharded)
+    max_local = int(np.max(np.asarray(overflow)))
+    if max_local > k:
+        raise ShardingError(
+            f"a shard holds {max_local} components > "
+            f"max_roots_per_shard={k}; raise the bound"
+        )
+    return labels, jnp.asarray(counts)[0]
+
+
+def sharded_segment_mosaic(
+    intensity: jax.Array,
+    mesh: Mesh,
+    sigma: float = 1.5,
+    threshold: float | None = None,
+    connectivity: int = 8,
+    axis: str = "rows",
+) -> tuple[jax.Array, jax.Array]:
+    """Smooth + threshold + label a row-sharded mosaic end-to-end.
+
+    The giant-image demonstration path: halo-exact Gaussian smoothing, a
+    global Otsu cut when ``threshold`` is None (histogram reduced with
+    ``psum``-free global ops on the sharded array), then
+    :func:`distributed_connected_components`.  Returns (labels, count).
+    """
+    from tmlibrary_tpu.ops.threshold import otsu_value
+    from tmlibrary_tpu.parallel.halo import sharded_gaussian_smooth
+
+    img = jnp.asarray(intensity, jnp.float32)
+    smoothed = sharded_gaussian_smooth(img, mesh, sigma, axis=axis)
+    t = otsu_value(smoothed) if threshold is None else jnp.float32(threshold)
+    return distributed_connected_components(
+        smoothed > t, mesh, connectivity=connectivity, axis=axis
+    )

@@ -102,18 +102,34 @@ class Step(abc.ABC):
         SURVEY.md §6 observability row)."""
         log_dir = self.step_dir / "logs"
         log_dir.mkdir(parents=True, exist_ok=True)
-        handler = logging.FileHandler(log_dir / f"{name}.log")
+        # mode="w": each capture is one run — appending would interleave a
+        # re-run's lines with the previous (possibly failed) run's
+        handler = logging.FileHandler(log_dir / f"{name}.log", mode="w")
         handler.setFormatter(
             logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
         )
         handler.setLevel(logging.DEBUG)
-        root = logging.getLogger()
-        root.addHandler(handler)
+        # the package logger's level (WARNING at default CLI verbosity)
+        # filters records before any handler sees them — open it to DEBUG
+        # for the capture window so the file gets the full INFO trail,
+        # while pinning the existing console handlers to the previous
+        # effective level so terminal verbosity is unchanged
+        pkg = logging.getLogger("tmlibrary_tpu")
+        prev_level = pkg.level
+        effective = pkg.getEffectiveLevel()
+        pinned = [(h, h.level) for h in pkg.handlers]
+        for h, _ in pinned:
+            h.setLevel(max(h.level, effective))
+        pkg.setLevel(logging.DEBUG)
+        pkg.addHandler(handler)
         try:
             yield
         finally:
-            root.removeHandler(handler)
+            pkg.removeHandler(handler)
             handler.close()
+            for h, lvl in pinned:
+                h.setLevel(lvl)
+            pkg.setLevel(prev_level)
 
     # -------------------------------------------------------------- collect
     def collect(self) -> dict:

@@ -264,7 +264,8 @@ class Workflow:
                                        elapsed=time.time() - bt0, result=result)
                     results.append(result)
                     bt0 = time.time()
-            collected = step.collect()
+                # collect is part of the step execution the log file covers
+                collected = step.collect()
             self.ledger.append(step=sd.name, event="step_done",
                                elapsed=time.time() - t0, collected=collected)
             return {"n_batches": len(batches), "collected": collected}
