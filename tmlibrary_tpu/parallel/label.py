@@ -218,3 +218,98 @@ def sharded_segment_mosaic(
     return distributed_connected_components(
         smoothed > t, mesh, connectivity=connectivity, axis=axis
     )
+
+
+# ------------------------------------------------------------- watershed
+def _sharded_adopt(labels, allowed, axis_name, connectivity):
+    """One synchronous adopt step with 1-row halos, bit-matching the
+    single-device :func:`~tmlibrary_tpu.ops.segment_secondary._adopt_step`
+    on the gathered image (global border fill = 0 falls out of zeroing the
+    ring-wrapped rows)."""
+    from tmlibrary_tpu.ops.segment_secondary import _adopt_step
+
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [(i, (i - 1) % n) for i in range(n)]
+    above = lax.ppermute(labels[-1], axis_name, down)
+    below = lax.ppermute(labels[0], axis_name, up)
+    above = jnp.where(idx == 0, 0, above)
+    below = jnp.where(idx == n - 1, 0, below)
+    ext = jnp.concatenate([above[None], labels, below[None]], axis=0)
+    false_row = jnp.zeros((1, allowed.shape[1]), bool)
+    allowed_ext = jnp.concatenate([false_row, allowed, false_row], axis=0)
+    new_ext = _adopt_step(ext, allowed_ext, connectivity)
+    return new_ext[1:-1]
+
+
+def distributed_watershed_from_seeds(
+    intensity: jax.Array,
+    seeds: jax.Array,
+    mask: jax.Array,
+    mesh: Mesh,
+    n_levels: int = 32,
+    connectivity: int = 8,
+    axis: str = "rows",
+) -> jax.Array:
+    """Level-ordered watershed flooding over a row-sharded mosaic.
+
+    Bit-identical to ``ops.segment_secondary.watershed_from_seeds`` on the
+    gathered image: the level thresholds are global (``pmin``/``pmax`` of
+    the masked intensity), and every adopt step exchanges 1-row halos so
+    the synchronous adoption schedule — and therefore every tie-break —
+    matches the single-device iteration exactly.
+    """
+    intensity = jnp.asarray(intensity, jnp.float32)
+    seeds = jnp.asarray(seeds, jnp.int32)
+    mask = jnp.asarray(mask, bool)
+    h, w = intensity.shape
+    n = mesh.devices.size
+    if h % n != 0:
+        raise ShardingError(f"rows {h} not divisible by mesh size {n}")
+
+    def body(int_block, seed_block, mask_block):
+        mask_b = mask_block | (seed_block > 0)
+        lo = lax.pmin(
+            jnp.min(jnp.where(mask_b, int_block, jnp.inf)), axis
+        )
+        hi = lax.pmax(
+            jnp.max(jnp.where(mask_b, int_block, -jnp.inf)), axis
+        )
+        span = jnp.maximum(hi - lo, 1e-6)
+
+        def flood(labels, allowed):
+            def inner(state):
+                lab, _ = state
+                new = _sharded_adopt(lab, allowed, axis, connectivity)
+                changed = lax.psum(
+                    jnp.any(new != lab).astype(jnp.int32), axis
+                )
+                return new, changed > 0
+
+            out, _ = lax.while_loop(
+                lambda s: s[1], inner, (labels, jnp.bool_(True))
+            )
+            return out
+
+        def level_body(i, labels):
+            level = hi - span * (i + 1) / n_levels
+            allowed = mask_b & (int_block >= level)
+            return flood(labels, allowed)
+
+        labels = lax.fori_loop(0, n_levels, level_body, seed_block)
+        labels = flood(labels, mask_b)
+        return jnp.where(mask_b, labels, 0)
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(PartitionSpec(axis), PartitionSpec(axis), PartitionSpec(axis)),
+        out_specs=PartitionSpec(axis),
+    )
+    spec = NamedSharding(mesh, PartitionSpec(axis))
+    return jax.jit(mapped)(
+        jax.device_put(intensity, spec),
+        jax.device_put(seeds, spec),
+        jax.device_put(mask, spec),
+    )
