@@ -37,9 +37,21 @@ class Reader(ABC):
 
 
 class ImageReader(Reader):
-    """Read 2-D image files via cv2 (PNG/TIFF; uint8/uint16 preserved)."""
+    """Read 2-D image files; grayscale TIFFs decode through the
+    first-party native reader (``native.tiff_read``), everything else
+    (PNG, RGB, tiled TIFF) through cv2.  uint8/uint16 preserved."""
 
-    def read(self) -> np.ndarray:
+    def read(self, page: int = 0) -> np.ndarray:
+        if str(self.filename).lower().endswith((".tif", ".tiff")):
+            from tmlibrary_tpu.native import tiff_info, tiff_read
+
+            info = tiff_info(self.filename)
+            if info is not None:
+                _, h, w, bits = info
+                img = tiff_read(self.filename, page, h, w)
+                if img is not None:
+                    return img.astype(np.uint8) if bits == 8 else img
+
         import cv2
 
         img = cv2.imread(str(self.filename), cv2.IMREAD_UNCHANGED)
