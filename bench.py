@@ -32,6 +32,10 @@ def measure(platform: str) -> None:
     the result JSON line."""
     import jax
 
+    from tmlibrary_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
+
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 

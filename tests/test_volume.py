@@ -160,3 +160,34 @@ def test_volume_pipeline_modules(rng):
     vox = np.asarray(result.measurements["nuclei3d"]["Volume_voxels"])
     assert vox.shape == (2, 16)
     assert (vox[0, : counts[0]] > 0).all()
+
+
+def test_generate_volume_image_focus_outputs(rng):
+    """Depth map picks each region's sharpest plane; focus composite
+    carries the sharp texture."""
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.jterator.modules import generate_volume_image
+
+    texture = rng.normal(500, 200, (32, 32)).astype(np.float32)
+    sharp_left = texture.copy()
+    sharp_left[:, 16:] = ndi.gaussian_filter(texture[:, 16:], 3.0)
+    sharp_right = texture.copy()
+    sharp_right[:, :16] = ndi.gaussian_filter(texture[:, :16], 3.0)
+    stack = np.stack([sharp_left, sharp_right])  # z0 sharp left, z1 sharp right
+
+    out = generate_volume_image(jnp.asarray(stack), focus_window=5)
+    depth = np.asarray(out["depth_image"])
+    # interior pixels (away from the seam) resolve to the sharp plane
+    assert (depth[8:24, 2:12] == 0).mean() > 0.9
+    assert (depth[8:24, 20:30] == 1).mean() > 0.9
+    assert out["volume_image"].shape == stack.shape
+    assert out["focus_image"].shape == (32, 32)
+
+    weighted = generate_volume_image(
+        jnp.asarray(stack), focus_window=5, mode="focus"
+    )["volume_image"]
+    # out-of-focus half of each plane is attenuated
+    assert float(jnp.abs(weighted[0, :, 20:]).mean()) < float(
+        jnp.abs(weighted[0, :, :12]).mean()
+    )

@@ -331,6 +331,9 @@ def cmd_export(args) -> int:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     configure_logging(getattr(args, "verbosity", 0))
+    from tmlibrary_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache()
     try:
         if args.command == "create":
             return cmd_create(args)

@@ -52,3 +52,31 @@ def next_power_of_two(n: int) -> int:
     if n <= 1:
         return 1
     return 1 << (n - 1).bit_length()
+
+
+def enable_compilation_cache(directory=None) -> str | None:
+    """Turn on JAX's persistent compilation cache so repeated CLI/bench
+    invocations skip recompiling the fused pipeline (first compiles are
+    tens of seconds).  ``TMX_NO_COMPILE_CACHE=1`` disables; the default
+    directory is ``~/.cache/tmlibrary_tpu/xla``.  Returns the directory
+    used, or None when disabled/unsupported."""
+    import os
+
+    if os.environ.get("TMX_NO_COMPILE_CACHE"):
+        return None
+    import jax
+
+    path = str(
+        directory
+        or os.environ.get("TMX_COMPILE_CACHE_DIR")
+        or os.path.expanduser("~/.cache/tmlibrary_tpu/xla")
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything, not only long compiles
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # older jax or read-only home: cache is best-effort
+        return None
+    return path
