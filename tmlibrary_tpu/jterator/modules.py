@@ -19,6 +19,7 @@ from __future__ import annotations
 import inspect
 from typing import Any, Callable
 
+import jax
 import jax.numpy as jnp
 
 from tmlibrary_tpu.errors import RegistryError
@@ -485,29 +486,35 @@ def generate_volume_image(
     - ``focus_image`` — the all-in-focus composite (each pixel from its
       sharpest plane).
     """
-    from tmlibrary_tpu.ops.smooth import uniform_smooth
-
     vol = jnp.asarray(zstack, jnp.float32)  # (Z, H, W)
 
     def plane_focus(img):
-        # 5-point Laplacian via shifts (no dtype-sensitive conv needed)
+        # 5-point Laplacian on an edge-replicated pad: a constant-0 fill
+        # would make border focus track intensity (|lap| ~ v at edges) and
+        # the height map near every image edge would pick the BRIGHTEST
+        # plane, not the sharpest
+        padded = jnp.pad(img, 1, mode="edge")
         lap = (
             -4.0 * img
-            + label_ops.shift_with_fill(img, -1, 0, 0.0)
-            + label_ops.shift_with_fill(img, 1, 0, 0.0)
-            + label_ops.shift_with_fill(img, 0, -1, 0.0)
-            + label_ops.shift_with_fill(img, 0, 1, 0.0)
+            + padded[:-2, 1:-1]
+            + padded[2:, 1:-1]
+            + padded[1:-1, :-2]
+            + padded[1:-1, 2:]
         )
-        return uniform_smooth(lap * lap, focus_window)
+        return smooth_ops.uniform_smooth(lap * lap, focus_window)
 
-    focus = jnp.stack([plane_focus(vol[z]) for z in range(vol.shape[0])])
+    focus = jax.vmap(plane_focus)(vol)  # one batched subgraph, any Z
     depth = jnp.argmax(focus, axis=0).astype(jnp.float32)  # (H, W)
     best = jnp.max(focus, axis=0)
     in_focus = jnp.take_along_axis(
         vol, depth[None].astype(jnp.int32), axis=0
     )[0]
     if mode == "focus":
-        weights = focus / jnp.maximum(best[None], 1e-6)
+        # degenerate pixels (uniform in every plane -> focus 0 everywhere)
+        # keep full weight instead of being zeroed out of the volume
+        weights = jnp.where(
+            best[None] > 1e-6, focus / jnp.maximum(best[None], 1e-6), 1.0
+        )
         out_vol = vol * weights
     elif mode == "volume":
         out_vol = vol
