@@ -131,8 +131,26 @@ def main() -> None:
     backoff_s = int(os.environ.get("BENCH_RETRY_BACKOFF", "20"))
     last_err = ""
 
+    def probe_device() -> bool:
+        """90s child probe: backend init HANGS (not fails) when the TPU
+        relay tunnel is down, so a cheap probe keeps a dead chip from
+        burning the full attempt timeout twice before the CPU fallback."""
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "90")),
+                capture_output=True,
+            )
+            return probe.returncode == 0
+        except subprocess.TimeoutExpired:
+            return False
+
     def try_once(platform: str) -> bool:
         nonlocal last_err
+        if platform == "default" and not probe_device():
+            last_err = "default: device probe timed out (relay down?)"
+            print(f"bench: {last_err}", file=sys.stderr, flush=True)
+            return False
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--child", platform],
