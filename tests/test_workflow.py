@@ -475,3 +475,18 @@ def test_step_log_capture_and_cli(source_dir, store, capsys):
     assert (store.workflow_dir / "jterator" / "logs" / "run.log").exists()
     capsys.readouterr()
     assert main(["log", "--root", str(store.root), "--step", "nope"]) == 1
+
+
+def test_cli_cleanup_verb(source_dir, store, tmp_path):
+    from tmlibrary_tpu.cli import main
+
+    root = str(store.root)
+    assert main(["metaconfig", "init", "--root", root,
+                 "--source-dir", str(source_dir)]) == 0
+    assert main(["metaconfig", "run", "--root", root]) == 0
+    assert main(["imextract", "init", "--root", root]) == 0
+    assert main(["imextract", "run", "--root", root]) == 0
+    assert main(["imextract", "cleanup", "--root", root]) == 0
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    assert get_step("imextract")(store).list_batches() == []

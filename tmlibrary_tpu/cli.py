@@ -133,6 +133,10 @@ def build_parser() -> argparse.ArgumentParser:
         _add_common(p_collect)
         p_info = verb_sub.add_parser("info", help="planned batches")
         _add_common(p_info)
+        p_clean = verb_sub.add_parser(
+            "cleanup", help="delete this step's previous outputs"
+        )
+        _add_common(p_clean)
     return parser
 
 
@@ -285,6 +289,13 @@ def cmd_step(args) -> int:
             batch = step.load_batch(i)
             keys = {k: v for k, v in batch.items() if k not in ("args",)}
             print(f"batch {i}: {json.dumps(keys, default=str)[:200]}")
+        return 0
+    if args.verb == "cleanup":
+        # reference `cleanup` verb: idempotent removal of step outputs
+        step.delete_previous_output()
+        for p in step.step_dir.glob("batch_*.json"):
+            p.unlink()
+        print(f"{args.command}: outputs removed")
         return 0
     return 1
 
