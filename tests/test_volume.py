@@ -191,3 +191,37 @@ def test_generate_volume_image_focus_outputs(rng):
     assert float(jnp.abs(weighted[0, :, 20:]).mean()) < float(
         jnp.abs(weighted[0, :, :12]).mean()
     )
+
+
+def test_segment_volume_secondary_grows_from_seeds():
+    from tmlibrary_tpu.jterator.modules import (
+        segment_volume,
+        segment_volume_secondary,
+    )
+
+    zz, yy, xx = np.mgrid[0:8, 0:24, 0:24]
+    vol = np.full((8, 24, 24), 100.0, np.float32)
+    # two bright nuclei inside a dimmer cell body band
+    for cz, cy, cx in ((4, 6, 6), (4, 17, 17)):
+        d2 = (zz - cz) ** 2 + (yy - cy) ** 2 + (xx - cx) ** 2
+        vol += 4000 * np.exp(-d2 / 6.0)
+    body = 800.0 * (((yy - 12) ** 2 + (xx - 12) ** 2) < 140)
+    vol += body
+
+    seeds = np.asarray(
+        segment_volume(jnp.asarray(vol), threshold_value=3000.0,
+                       max_objects=8)["objects"]
+    )
+    assert seeds.max() == 2
+
+    out = np.asarray(
+        segment_volume_secondary(
+            jnp.asarray(vol), jnp.asarray(seeds),
+            threshold_value=500.0, max_objects=8,
+        )["objects"]
+    )
+    # cells keep seed ids and grow beyond them
+    assert set(np.unique(out)) == {0, 1, 2}
+    assert (out > 0).sum() > (seeds > 0).sum()
+    for lab in (1, 2):
+        assert (out[seeds == lab] == lab).all()

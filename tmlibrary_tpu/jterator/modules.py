@@ -557,6 +557,34 @@ def segment_volume(
     return {"objects": label_ops.clip_label_count(labels, max_objects)}
 
 
+@register_module("segment_volume_secondary")
+def segment_volume_secondary(
+    volume_image,
+    primary_label_image,
+    threshold_value: float = 0.0,
+    correction_factor: float = 1.0,
+    n_levels: int = 16,
+    max_objects: int = 256,
+):
+    """3-D secondary segmentation: grow cell volumes outward from primary
+    3-D seeds by level-ordered flooding, keeping seed ids (the volume twin
+    of ``segment_secondary``; reference jtmodules pairs primary/secondary
+    segmentation in 3-D via the same CellProfiler propagate scheme)."""
+    from tmlibrary_tpu.ops.volume import watershed_from_seeds_3d
+
+    vol = jnp.asarray(volume_image, jnp.float32)
+    if threshold_value > 0.0:
+        t = jnp.float32(threshold_value) * correction_factor
+    else:
+        t = threshold_ops.otsu_value(vol) * correction_factor
+    mask = vol > t
+    out = watershed_from_seeds_3d(
+        vol, label_ops.clip_label_count(primary_label_image, max_objects),
+        mask, n_levels=n_levels,
+    )
+    return {"objects": label_ops.clip_label_count(out, max_objects)}
+
+
 @register_module("measure_volume")
 def measure_volume(objects_image, intensity_image, max_objects: int = 256):
     """3-D per-object measurements (volume, centroid, intensity stats)."""
