@@ -94,3 +94,19 @@ def test_to_uint8_stretch():
     out = np.asarray(to_uint8(img, 50.0, 150.0))
     assert out.dtype == np.uint8
     assert list(out[0]) == [0, 0, 127, 255]
+
+
+def test_phase_correlation_quality(rng):
+    """Quality ~1 for a true circular shift, low for unrelated noise."""
+    from tmlibrary_tpu.ops.registration import phase_correlation_quality
+
+    img = rng.normal(100, 30, (64, 64)).astype(np.float32)
+    shifted = np.roll(img, (5, -3), axis=(0, 1))
+    dy, dx, q = phase_correlation_quality(img, shifted)
+    # convention: reference[y, x] = target[y - dy, x - dx]
+    assert (int(dy), int(dx)) == (-5, 3)
+    assert float(q) > 0.9
+
+    other = rng.normal(100, 30, (64, 64)).astype(np.float32)
+    _, _, q_noise = phase_correlation_quality(img, other)
+    assert float(q_noise) < 0.2

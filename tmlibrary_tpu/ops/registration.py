@@ -41,6 +41,31 @@ def phase_correlation(
     return dy, dx
 
 
+def phase_correlation_quality(
+    reference: jax.Array, target: jax.Array
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(dy, dx, quality): quality is the normalized correlation-surface
+    peak in [0, 1] — 1.0 for a pure circular shift of identical content,
+    near 1/sqrt(H*W) for unrelated images.  A confidence the reference's
+    integer-shift registration lacks; the align step uses it to zero out
+    unreliable sites (empty wells, debris)."""
+    a = jnp.asarray(reference, jnp.float32)
+    b = jnp.asarray(target, jnp.float32)
+    fa = jnp.fft.rfft2(a)
+    fb = jnp.fft.rfft2(b)
+    cross = fa * jnp.conj(fb)
+    denom = jnp.maximum(jnp.abs(cross), 1e-12)
+    corr = jnp.fft.irfft2(cross / denom, s=a.shape)
+    idx = jnp.argmax(corr)
+    h, w = a.shape
+    dy = idx // w
+    dx = idx % w
+    quality = jnp.clip(corr.reshape(-1)[idx], 0.0, 1.0)
+    dy = jnp.where(dy > h // 2, dy - h, dy).astype(jnp.int32)
+    dx = jnp.where(dx > w // 2, dx - w, dx).astype(jnp.int32)
+    return dy, dx, quality
+
+
 def batch_phase_correlation(
     reference_stack: jax.Array, target_stack: jax.Array
 ) -> jax.Array:
@@ -49,6 +74,18 @@ def batch_phase_correlation(
     def one(a, b):
         dy, dx = phase_correlation(a, b)
         return jnp.stack([dy, dx])
+
+    return jax.jit(jax.vmap(one))(reference_stack, target_stack)
+
+
+def batch_phase_correlation_quality(
+    reference_stack: jax.Array, target_stack: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """vmap over the site axis → ((B, 2) int32 shifts, (B,) quality)."""
+
+    def one(a, b):
+        dy, dx, q = phase_correlation_quality(a, b)
+        return jnp.stack([dy, dx]), q
 
     return jax.jit(jax.vmap(one))(reference_stack, target_stack)
 

@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from tmlibrary_tpu.ops.registration import batch_phase_correlation, intersection_window
+from tmlibrary_tpu.ops.registration import (
+    batch_phase_correlation_quality,
+    intersection_window,
+)
 from tmlibrary_tpu.utils import create_partitions
 from tmlibrary_tpu.workflow.api import Step
 from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
@@ -29,6 +32,9 @@ class ImageRegistrator(Step):
         Argument("batch_size", int, default=32, help="sites per device batch"),
         Argument("max_shift", int, default=50,
                  help="shifts larger than this are treated as failures (zeroed)"),
+        Argument("min_quality", float, default=0.0,
+                 help="zero shifts whose correlation peak falls below this "
+                      "(0 = off); peak is 1.0 for identical shifted content"),
     )
 
     def create_batches(self, args):
@@ -53,8 +59,14 @@ class ImageRegistrator(Step):
         tgt = self.store.read_sites(sites, cycle=cycle,
                                     channel=args["ref_channel"]).astype(np.float32)
         # np.array (copy): np.asarray of a jax.Array is a read-only view
-        shifts = np.array(batch_phase_correlation(jnp.asarray(ref), jnp.asarray(tgt)))
+        dev_shifts, dev_quality = batch_phase_correlation_quality(
+            jnp.asarray(ref), jnp.asarray(tgt)
+        )
+        shifts = np.array(dev_shifts)
+        quality = np.asarray(dev_quality)
         bad = np.abs(shifts).max(axis=1) > args["max_shift"]
+        if args["min_quality"] > 0.0:
+            bad |= quality < args["min_quality"]
         shifts[bad] = 0
 
         # accumulate into the per-cycle shift table (idempotent slice write)
