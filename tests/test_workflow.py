@@ -490,3 +490,33 @@ def test_cli_cleanup_verb(source_dir, store, tmp_path):
     from tmlibrary_tpu.workflow.registry import get_step
 
     assert get_step("imextract")(store).list_batches() == []
+
+
+def test_cli_export_geojson(source_dir, store, tmp_path):
+    """GeoJSON polygon export (reference: tmserver's mapobject GeoJSON)."""
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    for name in ("metaconfig", "imextract", "corilla"):
+        sd = next(s for stage in desc.stages for s in stage.steps if s.name == name)
+        step = get_step(name)(store)
+        step.init(sd.args)
+        for j in step.list_batches():
+            step.run(j)
+    jd = next(s for stage in desc.stages for s in stage.steps if s.name == "jterator")
+    jt = get_step("jterator")(store)
+    jt.init({**jd.args, "batch_size": 16, "as_polygons": True})
+    jt.run(0)
+
+    out = tmp_path / "nuclei.geojson"
+    assert main(["export", "--root", str(store.root), "--objects", "nuclei",
+                 "--out", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert doc["type"] == "FeatureCollection"
+    assert len(doc["features"]) > 10
+    f0 = doc["features"][0]
+    assert f0["geometry"]["type"] == "Polygon"
+    ring = f0["geometry"]["coordinates"][0]
+    assert ring[0] == ring[-1]  # closed
+    assert {"site", "label"} <= set(f0["properties"])

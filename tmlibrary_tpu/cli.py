@@ -67,8 +67,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_export.add_argument("--objects", required=True, help="object type name")
     p_export.add_argument("--out", required=True, help="output file path")
     p_export.add_argument(
-        "--format", choices=("csv", "parquet"), default=None,
-        help="inferred from --out suffix when omitted",
+        "--format", choices=("csv", "parquet", "geojson"), default=None,
+        help="inferred from --out suffix when omitted; geojson exports the "
+             "traced object polygons (run jterator with --as-polygons)",
     )
 
     p_wf = sub.add_parser("workflow", help="full workflow orchestration")
@@ -327,10 +328,51 @@ def cmd_export(args) -> int:
     as one table with the site/well metadata columns already joined.
     """
     store = _open_store(args)
-    table = store.read_features(args.objects)
     out = Path(args.out)
-    fmt = args.format or ("csv" if out.suffix.lower() == ".csv" else "parquet")
+    suffix_fmt = {".csv": "csv", ".geojson": "geojson", ".json": "geojson"}
+    fmt = args.format or suffix_fmt.get(out.suffix.lower(), "parquet")
     out.parent.mkdir(parents=True, exist_ok=True)
+    if fmt == "geojson":
+        # reference parity: tmserver serves MapobjectSegmentation polygons
+        # as GeoJSON FeatureCollections for the viewer
+        import pandas as pd
+
+        shards = sorted(
+            (store.root / "segmentations").glob(f"{args.objects}_polygons_*.parquet")
+        )
+        if not shards:
+            print(
+                f"error: no polygon shards for '{args.objects}' — run "
+                "jterator with --as-polygons", file=sys.stderr,
+            )
+            return 1
+        table = pd.concat([pd.read_parquet(p) for p in shards], ignore_index=True)
+        features = []
+        for _, row in table.iterrows():
+            ring = [
+                [float(x), float(y)]
+                for y, x in zip(row["contour_y"], row["contour_x"])
+            ]
+            if ring and ring[0] != ring[-1]:
+                ring.append(ring[0])  # GeoJSON rings are closed
+            props = {
+                k: (row[k].item() if hasattr(row[k], "item") else row[k])
+                for k in table.columns
+                if k not in ("contour_y", "contour_x")
+            }
+            features.append(
+                {
+                    "type": "Feature",
+                    "geometry": {"type": "Polygon", "coordinates": [ring]},
+                    "properties": props,
+                }
+            )
+        out.write_text(
+            json.dumps({"type": "FeatureCollection", "features": features})
+        )
+        print(f"wrote {len(features)} polygon features to {out}")
+        return 0
+    table = store.read_features(args.objects)
     if fmt == "csv":
         table.to_csv(out, index=False)
     else:
