@@ -406,3 +406,18 @@ def test_grouped_minmax_multi_paths_agree(rng):
         assert np.asarray(mn_r)[0, j] == v[labels == 1].min()
         assert np.asarray(mx_r)[2, j] == v[labels == 3].max()
     assert np.isinf(np.asarray(mn_r)[1]).all()  # absent label -> +inf
+
+
+def test_measure_texture_distance_suffix():
+    """distance != 1 suffixes feature names so multi-scale instances
+    coexist in one table."""
+    from tmlibrary_tpu.jterator.modules import measure_texture
+
+    labels = np.zeros((32, 32), np.int32)
+    labels[4:28, 4:28] = 1
+    img = np.arange(32 * 32, dtype=np.float32).reshape(32, 32)
+    d1 = measure_texture(labels, img, levels=8, distance=1, max_objects=2)
+    d3 = measure_texture(labels, img, levels=8, distance=3, max_objects=2)
+    assert "Texture_contrast" in d1["measurements"]
+    assert "Texture_contrast_d3" in d3["measurements"]
+    assert not (set(d1["measurements"]) & set(d3["measurements"]))

@@ -304,14 +304,20 @@ def measure_texture(
     distance: int = 1,
     max_objects: int = 256,
 ):
-    """Reference ``jtmodules/measure_texture.py`` (Haralick)."""
+    """Reference ``jtmodules/measure_texture.py`` (Haralick).
+
+    Multi-scale texture (the reference computes Haralick at several pixel
+    distances): a non-default ``distance`` suffixes every feature with
+    ``_d<distance>`` so two module instances at different scales coexist
+    in one feature table instead of overwriting each other."""
     from tmlibrary_tpu.ops.measure import haralick_features
 
-    return {
-        "measurements": haralick_features(
-            objects_image, intensity_image, max_objects, levels=levels, distance=distance
-        )
-    }
+    feats = haralick_features(
+        objects_image, intensity_image, max_objects, levels=levels, distance=distance
+    )
+    if distance != 1:
+        feats = {f"{k}_d{distance}": v for k, v in feats.items()}
+    return {"measurements": feats}
 
 
 @register_module("measure_zernike")
