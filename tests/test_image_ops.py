@@ -153,3 +153,25 @@ def test_correct_illumination_flattens_field(rng):
     raw_ratio = observed[0][:8, :8].mean() / observed[0][28:36, 28:36].mean()
     cor_ratio = corrected[:8, :8].mean() / corrected[28:36, 28:36].mean()
     assert abs(cor_ratio - 1.0) < abs(raw_ratio - 1.0) * 0.3
+
+
+def test_threshold_adaptive_mean_matches_cv2(rng):
+    """Golden vs cv2.adaptiveThreshold (mean): our mask = img > local+C is
+    cv2's THRESH_BINARY with C negated, away from the border (cv2 uses
+    BORDER_REPLICATE vs our symmetric pad)."""
+    import cv2
+
+    from tmlibrary_tpu.ops.threshold import threshold_adaptive
+
+    img = rng.integers(0, 255, (64, 64)).astype(np.uint8)
+    block, c = 15, 5.0
+    ours = np.asarray(
+        threshold_adaptive(img.astype(np.float32), method="mean",
+                           kernel_size=block, constant=c)
+    )
+    cv = cv2.adaptiveThreshold(
+        img, 255, cv2.ADAPTIVE_THRESH_MEAN_C, cv2.THRESH_BINARY, block, -c
+    ) > 0
+    interior = (slice(block, -block), slice(block, -block))
+    agree = (ours[interior] == cv[interior]).mean()
+    assert agree > 0.98, agree
