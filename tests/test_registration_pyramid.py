@@ -110,3 +110,23 @@ def test_phase_correlation_quality(rng):
     other = rng.normal(100, 30, (64, 64)).astype(np.float32)
     _, _, q_noise = phase_correlation_quality(img, other)
     assert float(q_noise) < 0.2
+
+
+def test_phase_correlation_subpixel(rng):
+    """Matrix-multiply DFT refinement recovers known sub-pixel shifts to
+    1/upsample resolution (sign convention matches phase_correlation)."""
+    from tmlibrary_tpu.ops.registration import phase_correlation_subpixel
+
+    img = rng.normal(100, 30, (64, 64)).astype(np.float32)
+
+    def fshift(im, dy, dx):
+        f = np.fft.fft2(im)
+        fy = np.fft.fftfreq(im.shape[0])[:, None]
+        fx = np.fft.fftfreq(im.shape[1])[None, :]
+        return np.real(np.fft.ifft2(f * np.exp(-2j * np.pi * (fy * dy + fx * dx))))
+
+    for true_dy, true_dx in ((2.3, -1.7), (0.4, 0.0), (-3.8, 2.2)):
+        shifted = fshift(img, true_dy, true_dx)
+        dy, dx = phase_correlation_subpixel(img, shifted, upsample=20)
+        assert abs(float(dy) + true_dy) <= 0.05
+        assert abs(float(dx) + true_dx) <= 0.05
