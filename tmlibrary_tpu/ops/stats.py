@@ -25,11 +25,21 @@ HIST_BINS = 65536  # exact for uint16 pixel data
 
 
 class WelfordState(NamedTuple):
-    """Per-pixel running statistics + global intensity histogram."""
+    """Per-pixel running statistics + global intensity histogram.
+
+    ``mean``/``m2`` track the log-domain values SHIFTED by ``offset`` (the
+    first sample seen, captured per pixel): with an fp32 carry, the raw
+    running mean sits at ~4.8 (log10 of uint16-range data) where eps is
+    ~5e-7, and low-contrast channels' per-sample deltas vanish below it —
+    the variance of a nearly-flat channel collapses to zero.  Shifted
+    deltas are ~N(0, sigma) and keep full relative precision (SURVEY.md §8
+    hard part #2).  The physical mean is ``offset + mean`` (finalize).
+    """
 
     n: jax.Array  # scalar float32 — number of sites seen
-    mean: jax.Array  # (H, W) float32 — running mean (log domain)
+    mean: jax.Array  # (H, W) float32 — running mean MINUS offset (log domain)
     m2: jax.Array  # (H, W) float32 — running sum of squared deviations
+    offset: jax.Array  # (H, W) float32 — per-pixel shift (first sample)
     hist: jax.Array  # (HIST_BINS,) float32 — raw-intensity histogram
 
 
@@ -38,6 +48,7 @@ def welford_init(shape: tuple[int, int]) -> WelfordState:
         n=jnp.zeros((), jnp.float32),
         mean=jnp.zeros(shape, jnp.float32),
         m2=jnp.zeros(shape, jnp.float32),
+        offset=jnp.zeros(shape, jnp.float32),
         hist=jnp.zeros((HIST_BINS,), jnp.float32),
     )
 
@@ -52,10 +63,13 @@ def welford_update(state: WelfordState, raw: jax.Array) -> WelfordState:
     """
     raw_f = jnp.asarray(raw, jnp.float32)
     x = jnp.log10(1.0 + raw_f)
+    # first sample becomes the per-pixel shift (see WelfordState docstring)
+    offset = jnp.where(state.n == 0, x, state.offset)
+    xs = x - offset
     n = state.n + 1.0
-    delta = x - state.mean
+    delta = xs - state.mean
     mean = state.mean + delta / n
-    m2 = state.m2 + delta * (x - mean)
+    m2 = state.m2 + delta * (xs - mean)
     idx = jnp.clip(raw_f, 0, HIST_BINS - 1).astype(jnp.int32)
     # 65536-bin exact histogram: a scatter-add serializes on TPU, so the
     # bin index is factored into (hi, lo) digits and counted by one small
@@ -63,7 +77,7 @@ def welford_update(state: WelfordState, raw: jax.Array) -> WelfordState:
     from tmlibrary_tpu.ops.histogram import histogram_fixed_bins
 
     hist = state.hist + histogram_fixed_bins(idx, HIST_BINS)
-    return WelfordState(n=n, mean=mean, m2=m2, hist=hist)
+    return WelfordState(n=n, mean=mean, m2=m2, offset=offset, hist=hist)
 
 
 def welford_scan(stack: jax.Array, init: WelfordState | None = None) -> WelfordState:
@@ -80,13 +94,25 @@ def welford_scan(stack: jax.Array, init: WelfordState | None = None) -> WelfordS
 
 
 def welford_merge(a: WelfordState, b: WelfordState) -> WelfordState:
-    """Chan et al. parallel combination of two disjoint-sample states."""
+    """Chan et al. parallel combination of two disjoint-sample states.
+
+    The shards carry different per-pixel offsets (each captured its own
+    first sample), so ``b`` is re-expressed in the surviving frame before
+    the combination; m2 is shift-invariant.  Empty states pass the other
+    side through exactly (no fp residue from frame conversion)."""
     n = a.n + b.n
     safe_n = jnp.maximum(n, 1.0)
-    delta = b.mean - a.mean
+    offset = jnp.where(a.n > 0, a.offset, b.offset)
+    b_mean = b.mean + (b.offset - offset)
+    delta = b_mean - a.mean
     mean = a.mean + delta * (b.n / safe_n)
     m2 = a.m2 + b.m2 + delta * delta * (a.n * b.n / safe_n)
-    return WelfordState(n=n, mean=mean, m2=m2, hist=a.hist + b.hist)
+    # exact pass-through when one side is empty
+    mean = jnp.where(a.n == 0, b.mean, jnp.where(b.n == 0, a.mean, mean))
+    m2 = jnp.where(a.n == 0, b.m2, jnp.where(b.n == 0, a.m2, m2))
+    return WelfordState(
+        n=n, mean=mean, m2=m2, offset=offset, hist=a.hist + b.hist
+    )
 
 
 def welford_finalize(
@@ -103,7 +129,7 @@ def welford_finalize(
     targets = qs * total
     values = jnp.searchsorted(cum, targets, side="left").astype(jnp.float32)
     return {
-        "mean_log": state.mean,
+        "mean_log": state.offset + state.mean,
         "std_log": jnp.sqrt(jnp.maximum(var, 0.0)),
         "var_log": var,
         "n": state.n,
