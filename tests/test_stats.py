@@ -97,9 +97,6 @@ def test_welford_merge_numerically_hard(devices):
     """Parallel-variance merge under catastrophic-cancellation conditions:
     large common offset, tiny variance (SURVEY §8 hard part #2).  The
     sharded estimate must track the float64 ground truth closely."""
-    import jax.numpy as jnp
-
-    from tmlibrary_tpu.ops.stats import welford_finalize, welford_scan
     from tmlibrary_tpu.parallel.mesh import shard_batch, site_mesh
     from tmlibrary_tpu.parallel.stats import sharded_welford
 

@@ -98,8 +98,10 @@ def welford_merge(a: WelfordState, b: WelfordState) -> WelfordState:
 
     The shards carry different per-pixel offsets (each captured its own
     first sample), so ``b`` is re-expressed in the surviving frame before
-    the combination; m2 is shift-invariant.  Empty states pass the other
-    side through exactly (no fp residue from frame conversion)."""
+    the combination; m2 is shift-invariant.  The general formula is
+    already exact when either side is empty: the surviving offset makes
+    the frame conversion a no-op for the non-empty side, and b.n/n is
+    exactly 0.0 or 1.0."""
     n = a.n + b.n
     safe_n = jnp.maximum(n, 1.0)
     offset = jnp.where(a.n > 0, a.offset, b.offset)
@@ -107,9 +109,6 @@ def welford_merge(a: WelfordState, b: WelfordState) -> WelfordState:
     delta = b_mean - a.mean
     mean = a.mean + delta * (b.n / safe_n)
     m2 = a.m2 + b.m2 + delta * delta * (a.n * b.n / safe_n)
-    # exact pass-through when one side is empty
-    mean = jnp.where(a.n == 0, b.mean, jnp.where(b.n == 0, a.mean, mean))
-    m2 = jnp.where(a.n == 0, b.m2, jnp.where(b.n == 0, a.m2, m2))
     return WelfordState(
         n=n, mean=mean, m2=m2, offset=offset, hist=a.hist + b.hist
     )
