@@ -520,3 +520,16 @@ def test_cli_export_geojson(source_dir, store, tmp_path):
     ring = f0["geometry"]["coordinates"][0]
     assert ring[0] == ring[-1]  # closed
     assert {"site", "label"} <= set(f0["properties"])
+
+
+def test_cli_args_schema(capsys):
+    """tmx <step> args prints the argument schema (reference: the args
+    introspection tmserver renders as UI forms)."""
+    from tmlibrary_tpu.cli import main
+
+    assert main(["jterator", "args"]) == 0
+    schema = json.loads(capsys.readouterr().out)
+    names = {a["name"] for a in schema}
+    assert {"pipe", "batch_size", "max_objects", "figures"} <= names
+    pipe = next(a for a in schema if a["name"] == "pipe")
+    assert pipe["required"] is True

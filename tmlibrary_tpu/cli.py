@@ -138,6 +138,7 @@ def build_parser() -> argparse.ArgumentParser:
             "cleanup", help="delete this step's previous outputs"
         )
         _add_common(p_clean)
+        verb_sub.add_parser("args", help="argument schema as JSON")
     return parser
 
 
@@ -265,6 +266,10 @@ def cmd_project(args) -> int:
 
 
 def cmd_step(args) -> int:
+    if args.verb == "args":
+        # schema introspection needs no experiment store
+        print(json.dumps(get_step(args.command).batch_args.to_schema(), indent=2))
+        return 0
     store = _open_store(args)
     step = get_step(args.command)(store)
     if args.verb == "init":

@@ -39,6 +39,23 @@ class ArgumentCollection:
     def names(self) -> list[str]:
         return list(self._args)
 
+    def to_schema(self) -> list[dict]:
+        """JSON-able description of every argument (name, type, default,
+        choices, help) — the introspection surface the reference uses to
+        render per-step UI forms (``tmlib/workflow/args.py`` exposes the
+        same metadata to tmserver)."""
+        return [
+            {
+                "name": a.name,
+                "type": a.type.__name__,
+                "default": a.default,
+                "required": a.required,
+                "help": a.help,
+                "choices": list(a.choices) if a.choices else None,
+            }
+            for a in self._args.values()
+        ]
+
     def add_to_parser(self, parser: argparse.ArgumentParser) -> None:
         for a in self._args.values():
             kwargs: dict[str, Any] = {"help": a.help, "default": a.default}
