@@ -388,6 +388,76 @@ def morphology_features(labels: jax.Array, max_objects: int) -> dict[str, jax.Ar
 _GLCM_CHUNK = 1 << 13  # pixels per matmul chunk: (chunk, (M+1)*L) one-hot
 
 
+def _glcm_matmul_all(
+    labels: jax.Array,
+    quantized: jax.Array,
+    max_objects: int,
+    levels: int,
+    offsets: list[tuple[int, int]],
+) -> list[jax.Array]:
+    """All directions' GLCMs in ONE chunked contraction.
+
+    The (label, q1) row one-hot is direction-independent once validity is
+    moved entirely into the column operand (invalid pairs contribute a
+    zero column vector), so the 4 directions share each chunk's expensive
+    row one-hot and contract against their column one-hots concatenated
+    to (P, 4L) — one wider MXU matmul instead of four, and one pass over
+    the pixels instead of four."""
+    row = jnp.where(labels > 0, labels * levels + quantized, 0).reshape(-1)
+    cols = []
+    for dy, dx in offsets:
+        lab2 = shift_with_fill(labels, -dy, -dx, 0)
+        q2 = shift_with_fill(quantized, -dy, -dx, 0)
+        valid = (labels > 0) & (lab2 == labels)
+        cols.append(
+            (jnp.where(valid, q2, 0).reshape(-1), valid.reshape(-1))
+        )
+
+    p = row.shape[0]
+    pad = (-p) % _GLCM_CHUNK
+    if pad:
+        row = jnp.concatenate([row, jnp.zeros((pad,), row.dtype)])
+        cols = [
+            (
+                jnp.concatenate([c, jnp.zeros((pad,), c.dtype)]),
+                jnp.concatenate([v, jnp.zeros((pad,), bool)]),
+            )
+            for c, v in cols
+        ]
+    n_chunks = row.shape[0] // _GLCM_CHUNK
+    row = row.reshape(n_chunks, _GLCM_CHUNK)
+    cols = [
+        (c.reshape(n_chunks, _GLCM_CHUNK), v.reshape(n_chunks, _GLCM_CHUNK))
+        for c, v in cols
+    ]
+    n_rows = (max_objects + 1) * levels
+    k = len(offsets)
+
+    def body(i, acc):
+        oh_rc = jax.nn.one_hot(row[i], n_rows, dtype=jnp.float32)
+        oh_cols = jnp.concatenate(
+            [
+                jax.nn.one_hot(c[i], levels, dtype=jnp.float32)
+                * v[i][:, None].astype(jnp.float32)
+                for c, v in cols
+            ],
+            axis=-1,
+        )  # (chunk, k*L)
+        return acc + jnp.einsum(
+            "pr,pc->rc", oh_rc, oh_cols, precision=jax.lax.Precision.HIGHEST
+        )
+
+    init = jnp.zeros((n_rows, k * levels), jnp.float32)
+    counts = jax.lax.fori_loop(0, n_chunks, body, init)
+    out = []
+    for d in range(k):
+        glcm = counts[:, d * levels : (d + 1) * levels].reshape(
+            max_objects + 1, levels, levels
+        )[1:]
+        out.append(glcm + jnp.swapaxes(glcm, 1, 2))
+    return out
+
+
 def _glcm_matmul(
     labels: jax.Array,
     quantized: jax.Array,
@@ -478,20 +548,24 @@ def _glcm(
     (TPU default), ``"scatter"`` uses segment_sum (CPU default), ``"auto"``
     picks by backend — overridden by the committed hardware-tuning verdict
     (``tuning/TUNING.json`` ``glcm_matmul_wins``) when present."""
-    if method == "auto":
-        backend = jax.default_backend()
-        if backend == "cpu":
-            method = "scatter"
-        elif backend == "tpu":
-            # the committed tuning verdict was measured on a TPU — scope it
-            from tmlibrary_tpu.ops.pallas_kernels import _tuning_results
-
-            wins = _tuning_results().get("glcm_matmul_wins")
-            method = "matmul" if wins in (None, True) else "scatter"
-        else:  # gpu and friends: untuned, keep the matmul default
-            method = "matmul"
+    method = _resolve_glcm_method(method)
     fn = _glcm_matmul if method == "matmul" else _glcm_scatter
     return fn(labels, quantized, max_objects, levels, offset)
+
+
+def _resolve_glcm_method(method: str) -> str:
+    if method != "auto":
+        return method
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return "scatter"
+    if backend == "tpu":
+        # the committed tuning verdict was measured on a TPU — scope it
+        from tmlibrary_tpu.ops.pallas_kernels import _tuning_results
+
+        wins = _tuning_results().get("glcm_matmul_wins")
+        return "matmul" if wins in (None, True) else "scatter"
+    return "matmul"  # gpu and friends: untuned, keep the matmul default
 
 
 def quantize_per_object(
@@ -565,9 +639,18 @@ def haralick_features(
     j_idx = jnp.arange(levels, dtype=jnp.float32)[None, None, :]
     eps = 1e-10
 
+    method = _resolve_glcm_method(glcm_method)
+    if method == "matmul":
+        # all 4 directions share each chunk's row one-hot in one pass
+        glcms = _glcm_matmul_all(labels, q, max_objects, levels, offsets)
+    else:
+        glcms = [
+            _glcm_scatter(labels, q, max_objects, levels, off)
+            for off in offsets
+        ]
+
     acc: dict[str, jax.Array] = {}
-    for off in offsets:
-        glcm = _glcm(labels, q, max_objects, levels, off, method=glcm_method)
+    for glcm in glcms:
         total = jnp.maximum(glcm.sum(axis=(1, 2), keepdims=True), eps)
         p = glcm / total  # (M, L, L) normalized
 
