@@ -202,17 +202,25 @@ def test_haralick_per_object_quantization_sees_local_contrast(rng):
 
 
 def test_glcm_matmul_matches_scatter(rng):
-    from tmlibrary_tpu.ops.measure import _glcm_matmul, _glcm_scatter, quantize_per_object
+    """The fused all-directions matmul kernel (the production TPU path)
+    must agree exactly with the per-direction scatter path on every
+    direction's GLCM."""
+    from tmlibrary_tpu.ops.measure import (
+        _glcm_matmul_all,
+        _glcm_scatter,
+        quantize_per_object,
+    )
 
     labels = np.zeros((64, 64), np.int32)
     labels[4:30, 4:30] = 1
     labels[34:60, 10:50] = 2
     img = rng.integers(0, 4000, (64, 64)).astype(np.float32)
     q = quantize_per_object(jnp.asarray(labels), jnp.asarray(img), MAX_OBJ, 16)
-    for off in ((0, 1), (1, 0), (1, 1), (1, -1)):
-        a = np.asarray(_glcm_matmul(jnp.asarray(labels), q, MAX_OBJ, 16, off))
+    offsets = [(0, 1), (1, 0), (1, 1), (1, -1)]
+    fused = _glcm_matmul_all(jnp.asarray(labels), q, MAX_OBJ, 16, offsets)
+    for off, a in zip(offsets, fused):
         b = np.asarray(_glcm_scatter(jnp.asarray(labels), q, MAX_OBJ, 16, off))
-        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(np.asarray(a), b)
 
 
 def test_glcm_hand_computed_micro_case():
@@ -421,3 +429,107 @@ def test_measure_texture_distance_suffix():
     assert "Texture_contrast" in d1["measurements"]
     assert "Texture_contrast_d3" in d3["measurements"]
     assert not (set(d1["measurements"]) & set(d3["measurements"]))
+
+
+def test_point_pattern_two_parents():
+    """Hand-computed scene: two rectangular parents, spots at known
+    centroids; NN distances, Clark-Evans, centroid and border distances
+    all verified against independent numpy arithmetic."""
+    from tmlibrary_tpu.ops.measure import point_pattern_features
+
+    parents = np.zeros((48, 48), np.int32)
+    parents[2:22, 2:42] = 1   # 20x40 rect
+    parents[26:46, 2:42] = 2  # 20x40 rect
+    points = np.zeros((48, 48), np.int32)
+    # parent 1: three 1-px spots in a line, 8 px apart
+    points[10, 10] = 1
+    points[10, 18] = 2
+    points[10, 26] = 3
+    # parent 2: two spots 5 px apart (3-4-5 triangle)
+    points[32, 10] = 4
+    points[35, 14] = 5
+    feats = jax.jit(
+        lambda a, b: point_pattern_features(a, b, 4, 8)
+    )(parents, points)
+    f = {k: np.asarray(v) for k, v in feats.items()}
+
+    assert np.array_equal(f["PointPattern_count"][:2], [3.0, 2.0])
+    assert f["PointPattern_count"][2:].sum() == 0
+    # NN: parent 1 -> [8, 8, 8]; parent 2 -> [5, 5]
+    assert np.allclose(f["PointPattern_nn_dist_mean"][:2], [8.0, 5.0])
+    assert np.allclose(f["PointPattern_nn_dist_std"][:2], [0.0, 0.0])
+    # density + Clark-Evans, independent arithmetic
+    area = 20.0 * 40.0
+    for k, (n, nn) in enumerate([(3.0, 8.0), (2.0, 5.0)]):
+        assert np.isclose(f["PointPattern_density"][k], n / area)
+        ce = nn / (0.5 / np.sqrt(n / area))
+        assert np.isclose(f["PointPattern_clark_evans"][k], ce, rtol=1e-5)
+    # centroid distances: parent 1 centroid (11.5, 21.5)
+    d = [np.hypot(10 - 11.5, x - 21.5) for x in (10, 18, 26)]
+    assert np.isclose(f["PointPattern_centroid_dist_mean"][0], np.mean(d), rtol=1e-5)
+    # border distance: chessboard distance to the nearest boundary pixel
+    # (parent-1 outline rows are y=2/21; all three spots sit 8 away)
+    assert np.isclose(f["PointPattern_border_dist_mean"][0], 8.0)
+
+
+def test_point_pattern_background_and_singleton():
+    """Spots on background are unassigned; a parent with one spot has no
+    NN sample (nn stats 0) but still counts/centroid-distances."""
+    from tmlibrary_tpu.ops.measure import point_pattern_features
+
+    parents = np.zeros((32, 32), np.int32)
+    parents[4:16, 4:16] = 1
+    points = np.zeros((32, 32), np.int32)
+    points[8, 8] = 1    # inside parent 1
+    points[25, 25] = 2  # on background -> ignored
+    feats = point_pattern_features(parents, points, 3, 4)
+    f = {k: np.asarray(v) for k, v in feats.items()}
+    assert f["PointPattern_count"][0] == 1.0
+    assert f["PointPattern_nn_dist_mean"][0] == 0.0
+    assert f["PointPattern_clark_evans"][0] == 0.0
+    assert f["PointPattern_centroid_dist_mean"][0] > 0.0
+    assert f["PointPattern_count"][1:].sum() == 0
+
+
+def test_point_pattern_module_registration():
+    from tmlibrary_tpu.jterator.modules import get_module
+
+    fn = get_module("measure_point_pattern")
+    parents = np.zeros((32, 32), np.int32)
+    parents[4:28, 4:28] = 1
+    points = np.zeros((32, 32), np.int32)
+    points[10, 10] = 1
+    points[20, 20] = 2
+    out = fn(parents, points, max_objects=4, max_points=4)
+    assert out["measurements"]["PointPattern_count"][0] == 2.0
+
+
+def test_point_pattern_border_distance_euclidean():
+    """Border distance is exact Euclidean (not chamfer rings): a 1-px hole
+    diagonally offset from a spot must yield the sqrt-form distance,
+    verified against an independent numpy min over boundary pixels."""
+    from tmlibrary_tpu.ops.measure import point_pattern_features
+
+    parents = np.ones((40, 40), np.int32)
+    parents[20 + 5, 20 + 5] = 0  # diagonal 1-px hole
+    points = np.zeros((40, 40), np.int32)
+    points[20, 20] = 1
+    feats = point_pattern_features(parents, points, 2, 2)
+    got = float(np.asarray(feats["PointPattern_border_dist_mean"])[0])
+
+    # independent numpy golden: same boundary definition, exact Euclidean
+    lab = parents
+    boundary = np.zeros_like(lab, bool)
+    for dy, dx in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+        shifted = np.full_like(lab, -1)
+        ys = slice(max(dy, 0), lab.shape[0] + min(dy, 0))
+        xs = slice(max(dx, 0), lab.shape[1] + min(dx, 0))
+        yd = slice(max(-dy, 0), lab.shape[0] + min(-dy, 0))
+        xd = slice(max(-dx, 0), lab.shape[1] + min(-dx, 0))
+        shifted[yd, xd] = lab[ys, xs]
+        boundary |= shifted != lab
+    by, bx = np.nonzero(boundary)
+    exp = np.sqrt(((by - 20.0) ** 2 + (bx - 20.0) ** 2)).min()
+    assert np.isclose(got, exp, rtol=1e-5), (got, exp)
+    # and it IS the diagonal neighbor of the hole, not a chamfer ring count
+    assert np.isclose(exp, np.sqrt(4.0**2 + 5.0**2))

@@ -332,6 +332,26 @@ def measure_zernike(objects_image, degree: int = 9, patch: int = 64, max_objects
     }
 
 
+@register_module("measure_point_pattern")
+def measure_point_pattern(
+    objects_image,
+    points_image,
+    max_objects: int = 256,
+    max_points: int = 256,
+):
+    """Reference ``jtlib/features/point_pattern.py`` — spatial statistics
+    of child point objects (spots) within parent objects: count, density,
+    nearest-neighbor distances, Clark–Evans aggregation index, distances
+    to the parent centroid and border."""
+    from tmlibrary_tpu.ops.measure import point_pattern_features
+
+    return {
+        "measurements": point_pattern_features(
+            objects_image, points_image, max_objects, max_points
+        )
+    }
+
+
 @register_module("project")
 def project(zstack, method: str = "max"):
     """Z-projection of a (Z, H, W) volume (reference ``jtmodules/project.py``)."""

@@ -458,54 +458,6 @@ def _glcm_matmul_all(
     return out
 
 
-def _glcm_matmul(
-    labels: jax.Array,
-    quantized: jax.Array,
-    max_objects: int,
-    levels: int,
-    offset: tuple[int, int],
-) -> jax.Array:
-    """GLCM accumulation as ONE chunked matmul on the MXU: the (label, q1)
-    pair one-hot ``(P, (M+1)*L)`` contracted against the q2 one-hot
-    ``(P, L)`` yields all per-object co-occurrence matrices at once —
-    no scatter-adds (the TPU serialization trap this module's docstring
-    describes).  Chunked over the pixel axis like :func:`grouped_sums` so
-    the one-hot operand stays bounded under the site-batch vmap."""
-    dy, dx = offset
-    lab2 = shift_with_fill(labels, -dy, -dx, 0)
-    q2 = shift_with_fill(quantized, -dy, -dx, 0)
-    valid = (labels > 0) & (lab2 == labels)
-    # row index: (label, q1) fused; invalid pairs land in label 0's rows
-    row = jnp.where(valid, labels * levels + quantized, 0).reshape(-1)
-    col = jnp.where(valid, q2, 0).reshape(-1)
-    vmask = valid.reshape(-1)
-
-    p = row.shape[0]
-    pad = (-p) % _GLCM_CHUNK
-    if pad:
-        row = jnp.concatenate([row, jnp.zeros((pad,), row.dtype)])
-        col = jnp.concatenate([col, jnp.zeros((pad,), col.dtype)])
-        vmask = jnp.concatenate([vmask, jnp.zeros((pad,), bool)])
-    n_chunks = row.shape[0] // _GLCM_CHUNK
-    row = row.reshape(n_chunks, _GLCM_CHUNK)
-    col = col.reshape(n_chunks, _GLCM_CHUNK)
-    vmask = vmask.reshape(n_chunks, _GLCM_CHUNK)
-    n_rows = (max_objects + 1) * levels
-
-    def body(i, acc):
-        oh_rc = jax.nn.one_hot(row[i], n_rows, dtype=jnp.float32)
-        oh_q2 = jax.nn.one_hot(col[i], levels, dtype=jnp.float32)
-        oh_q2 = oh_q2 * vmask[i][:, None].astype(jnp.float32)
-        return acc + jnp.einsum(
-            "pr,pc->rc", oh_rc, oh_q2, precision=jax.lax.Precision.HIGHEST
-        )
-
-    init = jnp.zeros((n_rows, levels), jnp.float32)
-    counts = jax.lax.fori_loop(0, n_chunks, body, init)
-    glcm = counts.reshape(max_objects + 1, levels, levels)[1:]
-    return glcm + jnp.swapaxes(glcm, 1, 2)
-
-
 def _glcm_scatter(
     labels: jax.Array,
     quantized: jax.Array,
@@ -533,24 +485,6 @@ def _glcm_scatter(
     )
     glcm = counts.reshape(max_objects + 1, levels, levels)[1:]
     return glcm + jnp.swapaxes(glcm, 1, 2)
-
-
-def _glcm(
-    labels: jax.Array,
-    quantized: jax.Array,
-    max_objects: int,
-    levels: int,
-    offset: tuple[int, int],
-    method: str = "auto",
-) -> jax.Array:
-    """Per-object symmetric co-occurrence counts for one direction →
-    (max_objects, levels, levels).  ``method``: ``"matmul"`` rides the MXU
-    (TPU default), ``"scatter"`` uses segment_sum (CPU default), ``"auto"``
-    picks by backend — overridden by the committed hardware-tuning verdict
-    (``tuning/TUNING.json`` ``glcm_matmul_wins``) when present."""
-    method = _resolve_glcm_method(method)
-    fn = _glcm_matmul if method == "matmul" else _glcm_scatter
-    return fn(labels, quantized, max_objects, levels, offset)
 
 
 def _resolve_glcm_method(method: str) -> str:
@@ -817,3 +751,154 @@ def zernike_features(
         mag = jnp.sqrt(re**2 + im**2) * (n + 1) / jnp.pi / safe_a
         out[f"Zernike_{n}_{m_}"] = jnp.where(area > 0, mag, 0.0)
     return out
+
+
+# -------------------------------------------------------------- point pattern
+def point_pattern_features(
+    parent_labels: jax.Array,
+    point_labels: jax.Array,
+    max_parents: int,
+    max_points: int,
+) -> dict[str, jax.Array]:
+    """Spatial point-pattern statistics of child "point" objects (e.g.
+    spots/speckles) within parent objects.
+
+    Reference parity: ``jtlib/features/point_pattern.py`` (SURVEY.md §3
+    jtlibrary row) — per parent: point count and density, nearest-neighbor
+    distance statistics among the parent's points, the Clark–Evans
+    aggregation index (observed mean NN distance over the expectation
+    ``0.5/sqrt(density)`` for complete spatial randomness), distances from
+    points to the parent centroid, and distances to the parent border.
+
+    TPU design: points are reduced to centroids once (one ``grouped_sums``
+    MXU pass over the point label image), then every statistic is computed
+    on the fixed ``(max_points,)`` axis — the all-pairs distance matrix is
+    a dense ``(max_points, max_points)`` op and per-parent aggregation is a
+    masked broadcast over ``(max_points, max_parents)``, both tiny and
+    tiling-friendly.  Border distance is the exact Euclidean distance from
+    each point centroid to the nearest label-boundary pixel: a masked min
+    over image pixels, chunked so the ``(max_points, chunk)`` tile stays
+    bounded under the site-batch vmap (same metric as the NN/centroid
+    distances; no chamfer approximation, no distance cap).  Everything
+    jit/vmap-safe; rows for absent parents are zero.
+    """
+    parents = jnp.asarray(parent_labels, jnp.int32)
+    points = jnp.asarray(point_labels, jnp.int32)
+    h, w = parents.shape
+    yy, xx = jnp.meshgrid(
+        jnp.arange(h, dtype=jnp.float32),
+        jnp.arange(w, dtype=jnp.float32),
+        indexing="ij",
+    )
+    ones = jnp.ones((h, w), jnp.float32)
+
+    # ---- point centroids + parent centroids/areas (two MXU passes)
+    psums = grouped_sums(points, [ones, yy, xx], max_points)  # (P, 3)
+    p_n = psums[:, 0]
+    p_present = p_n > 0
+    safe_pn = jnp.maximum(p_n, 1.0)
+    py = psums[:, 1] / safe_pn
+    px = psums[:, 2] / safe_pn
+
+    gsums = grouped_sums(parents, [ones, yy, xx], max_parents)  # (M, 3)
+    area = gsums[:, 0]
+    safe_a = jnp.maximum(area, 1.0)
+    g_cy = gsums[:, 1] / safe_a
+    g_cx = gsums[:, 2] / safe_a
+    parent_present = area > 0
+
+    # ---- assign each point to the parent under its centroid pixel
+    iy = jnp.clip(jnp.round(py).astype(jnp.int32), 0, h - 1)
+    ix = jnp.clip(jnp.round(px).astype(jnp.int32), 0, w - 1)
+    owner = jnp.where(p_present, parents[iy, ix], 0)  # (P,) 0 = unassigned
+
+    # ---- nearest-neighbor distance among same-parent points
+    dy = py[:, None] - py[None, :]
+    dx = px[:, None] - px[None, :]
+    d2 = dy * dy + dx * dx  # (P, P)
+    # owner is already 0 for absent points, so owner > 0 implies presence
+    pair_ok = (
+        (owner[:, None] == owner[None, :])
+        & (owner[:, None] > 0)
+        & ~jnp.eye(max_points, dtype=bool)
+    )
+    BIG = jnp.float32(jnp.inf)
+    nn = jnp.sqrt(jnp.min(jnp.where(pair_ok, d2, BIG), axis=1))  # (P,)
+    has_nn = jnp.isfinite(nn)
+    nn = jnp.where(has_nn, nn, 0.0)
+
+    # ---- distance from each point to its parent's centroid
+    oc_y = g_cy[jnp.clip(owner - 1, 0, max_parents - 1)]
+    oc_x = g_cx[jnp.clip(owner - 1, 0, max_parents - 1)]
+    cdist = jnp.sqrt((py - oc_y) ** 2 + (px - oc_x) ** 2)
+
+    # ---- exact Euclidean distance from each point to the nearest
+    # label-boundary pixel: masked min over pixels, chunked over the image
+    boundary = jnp.zeros((h, w), bool)
+    for sy, sx in ((-1, 0), (1, 0), (0, -1), (0, 1)):
+        boundary = boundary | (shift_with_fill(parents, sy, sx, -1) != parents)
+    b_flat = boundary.reshape(-1)
+    y_flat = yy.reshape(-1)
+    x_flat = xx.reshape(-1)
+    n_pix = h * w
+    pad = (-n_pix) % _GLCM_CHUNK
+    if pad:  # padded pixels are non-boundary -> masked to +inf below
+        b_flat = jnp.concatenate([b_flat, jnp.zeros((pad,), bool)])
+        y_flat = jnp.concatenate([y_flat, jnp.zeros((pad,), jnp.float32)])
+        x_flat = jnp.concatenate([x_flat, jnp.zeros((pad,), jnp.float32)])
+    n_chunks = b_flat.shape[0] // _GLCM_CHUNK
+    b_flat = b_flat.reshape(n_chunks, _GLCM_CHUNK)
+    y_flat = y_flat.reshape(n_chunks, _GLCM_CHUNK)
+    x_flat = x_flat.reshape(n_chunks, _GLCM_CHUNK)
+
+    def bd_body(i, best):
+        d2b = (py[:, None] - y_flat[i][None, :]) ** 2 + (
+            px[:, None] - x_flat[i][None, :]
+        ) ** 2
+        d2b = jnp.where(b_flat[i][None, :], d2b, BIG)
+        return jnp.minimum(best, jnp.min(d2b, axis=1))
+
+    bdist = jnp.sqrt(
+        jax.lax.fori_loop(
+            0, n_chunks, bd_body, jnp.full((max_points,), BIG, jnp.float32)
+        )
+    )
+
+    # ---- per-parent aggregation: masked broadcast over (P, M)
+    assign = owner[:, None] == jnp.arange(1, max_parents + 1)[None, :]  # (P, M)
+
+    def _agg(vals, valid):
+        sel = assign & valid[:, None]
+        n = jnp.sum(sel, axis=0).astype(jnp.float32)
+        s = jnp.sum(jnp.where(sel, vals[:, None], 0.0), axis=0)
+        sq = jnp.sum(jnp.where(sel, (vals * vals)[:, None], 0.0), axis=0)
+        mean = s / jnp.maximum(n, 1.0)
+        var = jnp.maximum(sq / jnp.maximum(n, 1.0) - mean * mean, 0.0)
+        return n, mean, jnp.sqrt(var)
+
+    n_pts = jnp.sum(assign, axis=0).astype(jnp.float32)
+    n_nn, nn_mean, nn_std = _agg(nn, has_nn)
+    _, cd_mean, cd_std = _agg(cdist, p_present)
+    _, bd_mean, bd_std = _agg(bdist, p_present)
+
+    density = n_pts / safe_a
+    # Clark–Evans: observed mean NN distance / E[NN] under CSR
+    expected_nn = 0.5 / jnp.sqrt(jnp.maximum(density, 1e-12))
+    clark_evans = jnp.where(n_nn > 0, nn_mean / expected_nn, 0.0)
+
+    z = jnp.zeros_like(area)
+
+    def m(v):
+        return jnp.where(parent_present, v, z)
+
+    return {
+        "PointPattern_count": m(n_pts),
+        "PointPattern_density": m(density),
+        "PointPattern_nn_dist_mean": m(nn_mean),
+        "PointPattern_nn_dist_std": m(nn_std),
+        "PointPattern_clark_evans": m(clark_evans),
+        "PointPattern_centroid_dist_mean": m(cd_mean),
+        "PointPattern_centroid_dist_std": m(cd_std),
+        "PointPattern_border_dist_mean": m(bd_mean),
+        "PointPattern_border_dist_std": m(bd_std),
+    }
