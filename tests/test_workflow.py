@@ -533,3 +533,49 @@ def test_cli_args_schema(capsys):
     assert {"pipe", "batch_size", "max_objects", "figures"} <= names
     pipe = next(a for a in schema if a["name"] == "pipe")
     assert pipe["required"] is True
+
+
+def test_workflow_types_registry():
+    """Reference dependencies.py defines two workflow types: canonical
+    (no inter-cycle registration) and multiplexing (adds align)."""
+    from tmlibrary_tpu.errors import WorkflowError
+    from tmlibrary_tpu.workflow.engine import WORKFLOW_TYPES, WorkflowDescription
+
+    assert set(WORKFLOW_TYPES) == {"canonical", "multiplexing"}
+    canon = WorkflowDescription.for_type("canonical", {"jterator": {}})
+    steps = [s.name for st in canon.stages for s in st.steps]
+    assert "align" not in steps
+    multi = WorkflowDescription.for_type("multiplexing", {"jterator": {}})
+    steps = [s.name for st in multi.stages for s in st.steps]
+    assert "align" in steps
+    # stage order is identical four-stage DAG in both
+    assert [st.name for st in canon.stages] == [st.name for st in multi.stages]
+
+    with pytest.raises(WorkflowError):
+        WorkflowDescription.for_type("nope")
+
+
+def test_canonical_autoselects_multiplexing_for_align():
+    from tmlibrary_tpu.workflow.engine import WorkflowDescription
+
+    d = WorkflowDescription.canonical({"align": {"ref_cycle": 0}})
+    steps = [s.name for st in d.stages for s in st.steps]
+    assert "align" in steps
+    d2 = WorkflowDescription.canonical({"jterator": {}})
+    assert "align" not in [s.name for st in d2.stages for s in st.steps]
+
+
+def test_cli_workflow_template(store, capsys):
+    from tmlibrary_tpu.cli import main
+
+    root = str(store.root)
+    assert main(["workflow", "template", "--root", root,
+                 "--type", "multiplexing"]) == 0
+    wf_yaml = store.workflow_dir / "workflow.yaml"
+    d = WorkflowDescription.load(wf_yaml)
+    steps = [s.name for st in d.stages for s in st.steps]
+    assert "align" in steps and "jterator" in steps
+    assert not any(s.active for st in d.stages for s in st.steps)
+    # refuses to clobber an existing description
+    capsys.readouterr()
+    assert main(["workflow", "template", "--root", root]) == 1

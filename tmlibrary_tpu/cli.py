@@ -83,6 +83,14 @@ def build_parser() -> argparse.ArgumentParser:
                           help="write a jax.profiler device trace to DIR")
     p_status = wf_sub.add_parser("status", help="per-step progress")
     _add_common(p_status)
+    p_tmpl = wf_sub.add_parser(
+        "template", help="write a typed skeleton workflow.yaml"
+    )
+    _add_common(p_tmpl)
+    p_tmpl.add_argument(
+        "--type", dest="wf_type", choices=("canonical", "multiplexing"),
+        default="canonical", help="workflow type (multiplexing adds align)",
+    )
 
     p_tool = sub.add_parser("tool", help="analysis tools over the feature store")
     tool_sub = p_tool.add_subparsers(dest="verb", required=True)
@@ -175,6 +183,15 @@ def cmd_workflow(args) -> int:
             if entry.get("error"):
                 line += f" error: {entry['error']}"
             print(line)
+        return 0
+    if args.verb == "template":
+        out = store.workflow_dir / "workflow.yaml"
+        if out.exists():
+            print(f"error: {out} already exists", file=sys.stderr)
+            return 1
+        WorkflowDescription.for_type(args.wf_type).save(out)
+        print(f"wrote {args.wf_type} workflow template to {out} — fill in "
+              "step args and set active: true on the steps to run")
         return 0
     # submit
     if args.description:

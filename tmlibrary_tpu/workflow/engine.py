@@ -33,14 +33,27 @@ from tmlibrary_tpu.workflow.registry import get_step, list_steps
 
 logger = logging.getLogger(__name__)
 
-#: canonical stage DAG (reference ``tmlib/workflow/dependencies.py``):
-#: conversion → preprocessing → pyramid → analysis
-CANONICAL_STAGES: list[tuple[str, list[str]]] = [
-    ("image_conversion", ["metaconfig", "imextract"]),
-    ("image_preprocessing", ["corilla", "align"]),
-    ("pyramid_creation", ["illuminati"]),
-    ("image_analysis", ["jterator"]),
-]
+#: workflow-type stage DAGs (reference ``tmlib/workflow/dependencies.py``:
+#: ``CanonicalWorkflowDependencies`` and ``MultiplexingWorkflowDependencies``)
+#: — conversion → preprocessing → pyramid → analysis; the multiplexing type
+#: adds inter-cycle registration (``align``) to the preprocessing stage.
+WORKFLOW_TYPES: dict[str, list[tuple[str, list[str]]]] = {
+    "canonical": [
+        ("image_conversion", ["metaconfig", "imextract"]),
+        ("image_preprocessing", ["corilla"]),
+        ("pyramid_creation", ["illuminati"]),
+        ("image_analysis", ["jterator"]),
+    ],
+    "multiplexing": [
+        ("image_conversion", ["metaconfig", "imextract"]),
+        ("image_preprocessing", ["corilla", "align"]),
+        ("pyramid_creation", ["illuminati"]),
+        ("image_analysis", ["jterator"]),
+    ],
+}
+
+#: back-compat alias: the widest stage DAG (multiplexing superset)
+CANONICAL_STAGES = WORKFLOW_TYPES["multiplexing"]
 
 
 @dataclasses.dataclass
@@ -118,10 +131,20 @@ class WorkflowDescription:
         Path(path).write_text(yaml.safe_dump(self.to_dict(), sort_keys=False))
 
     @classmethod
-    def canonical(cls, step_args: dict[str, dict] | None = None) -> "WorkflowDescription":
-        """The canonical four-stage workflow; ``step_args`` maps step name →
-        args, and steps without args are included but may be skipped at run
-        time if they plan zero batches (e.g. align with one cycle)."""
+    def for_type(
+        cls,
+        workflow_type: str,
+        step_args: dict[str, dict] | None = None,
+    ) -> "WorkflowDescription":
+        """Build a description for a registered workflow type
+        (``canonical`` | ``multiplexing``); ``step_args`` maps step name →
+        args, and only steps with args are active (inactive steps stay in
+        the plan so they can be toggled on later)."""
+        if workflow_type not in WORKFLOW_TYPES:
+            raise WorkflowError(
+                f"unknown workflow type '{workflow_type}' "
+                f"(registered: {sorted(WORKFLOW_TYPES)})"
+            )
         step_args = step_args or {}
         return cls(
             stages=[
@@ -136,9 +159,17 @@ class WorkflowDescription:
                         for s in steps
                     ],
                 )
-                for stage, steps in CANONICAL_STAGES
+                for stage, steps in WORKFLOW_TYPES[workflow_type]
             ]
         )
+
+    @classmethod
+    def canonical(cls, step_args: dict[str, dict] | None = None) -> "WorkflowDescription":
+        """The four-stage workflow, auto-typed: requesting ``align`` args
+        selects the multiplexing variant (the only type that runs
+        inter-cycle registration)."""
+        wtype = "multiplexing" if "align" in (step_args or {}) else "canonical"
+        return cls.for_type(wtype, step_args)
 
 
 class RunLedger:
