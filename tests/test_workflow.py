@@ -69,6 +69,16 @@ PIPE_YAML = {
 }
 
 
+def synth_site_image(rng, n_blobs=6, margin=8):
+    """One synthetic uint16 site: noisy background + Gaussian nuclei blobs."""
+    yy, xx = np.mgrid[0:64, 0:64]
+    img = rng.normal(300, 20, (64, 64))
+    for _ in range(n_blobs):
+        y, x = rng.integers(margin, 64 - margin, 2)
+        img += 4000 * np.exp(-((yy - y) ** 2 + (xx - x) ** 2) / (2 * 3.0**2))
+    return np.clip(img, 0, 65535).astype(np.uint16)
+
+
 @pytest.fixture
 def source_dir(tmp_path, rng):
     """Synthetic 1-plate 2x2-well 2x2-site single-channel experiment on disk."""
@@ -76,15 +86,10 @@ def source_dir(tmp_path, rng):
 
     src = tmp_path / "microscope"
     src.mkdir()
-    yy, xx = np.mgrid[0:64, 0:64]
     for well in ("A01", "A02", "B01", "B02"):
         for site in range(4):
-            img = rng.normal(300, 20, (64, 64))
-            for _ in range(6):
-                y, x = rng.integers(8, 56, 2)
-                img += 4000 * np.exp(-((yy - y) ** 2 + (xx - x) ** 2) / (2 * 3.0**2))
             path = src / f"{well}_s{site}_DAPI.png"
-            cv2.imwrite(str(path), np.clip(img, 0, 65535).astype(np.uint16))
+            cv2.imwrite(str(path), synth_site_image(rng))
     return src
 
 
@@ -579,3 +584,47 @@ def test_cli_workflow_template(store, capsys):
     # refuses to clobber an existing description
     capsys.readouterr()
     assert main(["workflow", "template", "--root", root]) == 1
+
+
+@pytest.fixture
+def multiplex_source_dir(tmp_path, rng):
+    """2-cycle experiment: cycle 1 is cycle 0 rolled down 4 px (known
+    inter-cycle stage drift for the align step to recover)."""
+    import cv2
+
+    src = tmp_path / "mx"
+    src.mkdir()
+    for well in ("A01", "A02"):
+        for site in range(2):
+            img = synth_site_image(rng, n_blobs=5, margin=10)
+            cv2.imwrite(str(src / f"{well}_s{site}_c0_DAPI.png"), img)
+            cv2.imwrite(str(src / f"{well}_s{site}_c1_DAPI.png"),
+                        np.roll(img, 4, axis=0))
+    return src
+
+
+def test_multiplexing_workflow_end_to_end(multiplex_source_dir, store):
+    """The multiplexing workflow type runs align for real: per-site
+    phase-correlation shifts of cycle 1 against cycle 0 recover the
+    planted 4-px drift, and collect stores the intersection window."""
+    desc = WorkflowDescription.for_type(
+        "multiplexing",
+        {
+            "metaconfig": {"source_dir": str(multiplex_source_dir)},
+            "imextract": {},
+            "align": {"ref_cycle": 0, "batch_size": 4},
+        },
+    )
+    summary = Workflow(store, desc).run()
+    assert set(summary) == {"metaconfig", "imextract", "align"}
+
+    exp = ExperimentStore.open(store.root).experiment
+    assert exp.n_cycles == 2
+    shifts = store.read_shifts(cycle=1)
+    assert shifts.shape == (4, 2)
+    # stored shifts are CORRECTIONS: content drifted 4 px down, so the
+    # stored roll that re-aligns cycle 1 is dy=-4 at every site
+    np.testing.assert_array_equal(shifts, np.tile([[-4, 0]], (4, 1)))
+    # rolling up by 4 exposes invalid rows at the bottom -> bottom margin
+    window = store.read_intersection()
+    assert window == {"top": 0, "bottom": 4, "left": 0, "right": 0}

@@ -76,7 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
     wf_sub = p_wf.add_subparsers(dest="verb", required=True)
     p_submit = wf_sub.add_parser("submit", help="run the workflow")
     _add_common(p_submit)
-    p_submit.add_argument("--description", help="workflow YAML (default: canonical)")
+    p_submit.add_argument(
+        "--description",
+        help="workflow YAML (default: the store's workflow/workflow.yaml)",
+    )
     p_submit.add_argument("--resume", action="store_true",
                           help="skip work completed in a previous run")
     p_submit.add_argument("--profile", metavar="DIR", default=None,

@@ -140,8 +140,13 @@ def batch_phase_correlation_quality(
 
 def intersection_window(all_shifts: jax.Array) -> dict[str, int]:
     """Crop window covering the overlap of all cycles at all sites
-    (reference ``SiteIntersection``): positive dy pushes content down, so
-    the top margin must absorb the largest positive dy, etc.
+    (reference ``SiteIntersection``).
+
+    ``all_shifts`` are the stored *corrections* (the roll
+    ``shift_image`` applies at analysis time, i.e. the negated drift):
+    rolling DOWN by a positive dy exposes invalid rows at the TOP, so
+    the top margin absorbs the largest positive dy, the bottom margin
+    the largest negative dy, and likewise left/right for dx.
 
     ``all_shifts``: (N, 2) stacked (dy, dx) over every cycle and site
     (host-side; returns Python ints for static crop shapes).
