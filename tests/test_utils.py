@@ -44,3 +44,46 @@ def test_pad_to():
 
 def test_next_power_of_two():
     assert [next_power_of_two(n) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 16]
+
+
+def test_library_config_ini_and_env(tmp_path, monkeypatch):
+    """Install config: TM_* env beats the INI file beats defaults
+    (reference tmaps.cfg mechanism)."""
+    from tmlibrary_tpu.config import LibraryConfig
+
+    ini = tmp_path / "tm.cfg"
+    ini.write_text(
+        "[tmlibrary]\nstorage_home = /data/ini_home\ncompute_dtype = bfloat16\n"
+    )
+    monkeypatch.setenv("TM_CONFIG_FILE", str(ini))
+    monkeypatch.delenv("TM_STORAGE_HOME", raising=False)
+    monkeypatch.delenv("TM_COMPUTE_DTYPE", raising=False)
+    c = LibraryConfig()
+    assert str(c.storage_home) == "/data/ini_home"
+    assert c.compute_dtype == "bfloat16"
+    # env wins over the INI
+    monkeypatch.setenv("TM_STORAGE_HOME", "/data/env_home")
+    assert str(LibraryConfig().storage_home) == "/data/env_home"
+    # missing file / section -> defaults
+    monkeypatch.setenv("TM_CONFIG_FILE", str(tmp_path / "nope.cfg"))
+    monkeypatch.delenv("TM_STORAGE_HOME", raising=False)
+    assert str(LibraryConfig().storage_home).endswith("tm_storage")
+
+
+def test_library_config_ini_malformed_and_percent(tmp_path, monkeypatch):
+    """A '%' in INI values must not break parsing (no interpolation), and
+    a malformed file degrades to defaults instead of crashing import."""
+    from tmlibrary_tpu.config import LibraryConfig
+
+    ini = tmp_path / "tm.cfg"
+    ini.write_text("[tmlibrary]\nstorage_home = /data/run_%Y\n")
+    monkeypatch.setenv("TM_CONFIG_FILE", str(ini))
+    monkeypatch.delenv("TM_STORAGE_HOME", raising=False)
+    assert str(LibraryConfig().storage_home) == "/data/run_%Y"
+
+    bad = tmp_path / "bad.cfg"
+    bad.write_text("storage_home = no section header\n")
+    monkeypatch.setenv("TM_CONFIG_FILE", str(bad))
+    with pytest.warns(UserWarning, match="malformed config"):
+        c = LibraryConfig()
+    assert str(c.storage_home).endswith("tm_storage")

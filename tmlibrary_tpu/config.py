@@ -5,14 +5,59 @@ INI file (``LibraryConfig``) holding DB connection, storage paths and the
 cluster resource definition.  The TPU rebuild has no database and no cluster
 scheduler, so configuration shrinks to: storage root, device/mesh settings,
 and logging.  Values come from (highest priority first) explicit kwargs, the
-``TM_*`` environment, then defaults.
+``TM_*`` environment, an INI file (``$TM_CONFIG_FILE`` or
+``~/.tmlibrary.cfg``, section ``[tmlibrary]``), then defaults.
 """
 
 from __future__ import annotations
 
+import configparser
 import dataclasses
+import functools
 import os
 from pathlib import Path
+
+
+def _ini_values() -> dict:
+    """Read the ``[tmlibrary]`` section of the config INI, if present
+    (reference ``tmaps.cfg`` mechanism).  Cached per (path, mtime) so a
+    ``LibraryConfig()`` construction doesn't re-parse the file once per
+    field; a malformed file degrades to defaults with a warning instead
+    of crashing package import (``cfg`` is built at module level)."""
+    path = os.environ.get(
+        "TM_CONFIG_FILE", os.path.expanduser("~/.tmlibrary.cfg")
+    )
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return {}
+    return _parse_ini(path, mtime)
+
+
+@functools.lru_cache(maxsize=8)
+def _parse_ini(path: str, _mtime_ns: int) -> dict:
+    # no interpolation: '%' is common in paths/date patterns and the
+    # reference INI has no interpolation semantics either
+    parser = configparser.ConfigParser(interpolation=None)
+    try:
+        parser.read(path)
+        if not parser.has_section("tmlibrary"):
+            return {}
+        return dict(parser.items("tmlibrary"))
+    except configparser.Error as exc:
+        import warnings
+
+        warnings.warn(f"ignoring malformed config file {path}: {exc}")
+        return {}
+
+
+def _setting(name: str, default: str) -> str:
+    """One install-level setting: ``TM_<NAME>`` env beats the INI file
+    beats the built-in default."""
+    env = os.environ.get(f"TM_{name.upper()}")
+    if env is not None:
+        return env
+    return _ini_values().get(name, default)
 
 
 @dataclasses.dataclass
@@ -35,15 +80,15 @@ class LibraryConfig:
 
     storage_home: Path = dataclasses.field(
         default_factory=lambda: Path(
-            os.environ.get("TM_STORAGE_HOME", os.path.expanduser("~/tm_storage"))
+            _setting("storage_home", os.path.expanduser("~/tm_storage"))
         )
     )
     mesh_shape: dict | None = None
     compute_dtype: str = dataclasses.field(
-        default_factory=lambda: os.environ.get("TM_COMPUTE_DTYPE", "float32")
+        default_factory=lambda: _setting("compute_dtype", "float32")
     )
     verbosity: int = dataclasses.field(
-        default_factory=lambda: int(os.environ.get("TM_VERBOSITY", "0"))
+        default_factory=lambda: int(_setting("verbosity", "0"))
     )
 
     def experiment_location(self, experiment_name: str) -> Path:
