@@ -239,3 +239,67 @@ class TestTiffReader:
         tif = tmp_path / "y.tif"
         cv2.imwrite(str(tif), img)
         assert tiff_read(tif, 0, 32, 32) is None  # shape mismatch -> decline
+
+
+def test_simplify_polygon_square_to_corners():
+    """Collinear mid-edge vertices collapse; the 4 corners survive."""
+    from tmlibrary_tpu import native
+
+    ring = np.array(
+        [[0, 0], [0, 2], [0, 4], [2, 4], [4, 4], [4, 2], [4, 0], [2, 0]],
+        np.int32,
+    )
+    s = native.simplify_polygon_host(ring, 0.5)
+    assert s.tolist() == [[0, 0], [0, 4], [4, 4], [4, 0]]
+    # tolerance 0 and tiny rings are no-ops
+    assert np.array_equal(native.simplify_polygon_host(ring, 0.0), ring)
+    tiny = ring[:2]
+    assert np.array_equal(native.simplify_polygon_host(tiny, 5.0), tiny)
+
+
+def test_simplify_polygon_native_matches_numpy(rng):
+    """The C++ and numpy implementations agree vertex-for-vertex on real
+    traced blob contours at several tolerances."""
+    from tmlibrary_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable")
+    labels = np.zeros((96, 96), np.int32)
+    yy, xx = np.mgrid[0:96, 0:96]
+    labels[((yy - 48) / 30.0) ** 2 + ((xx - 48) / 18.0) ** 2 <= 1.0] = 1
+    contour = native.trace_boundary_host(labels, 1)
+    assert len(contour) > 40
+    for tol in (0.5, 1.0, 2.5):
+        a = native.simplify_polygon_host(contour, tol)
+        b = native._simplify_numpy(contour.astype(np.int32), tol)
+        assert np.array_equal(a, b), tol
+        assert 3 <= len(a) < len(contour)
+    # max deviation of dropped vertices from the simplified ring is
+    # bounded by the tolerance (DP guarantee), checked for tol=2.5
+    closed = np.vstack([a, a[:1]]).astype(float)
+
+    def seg_dist(p, s0, s1):
+        d = s1 - s0
+        t = np.clip(np.dot(p - s0, d) / max(np.dot(d, d), 1e-9), 0, 1)
+        return np.linalg.norm(p - (s0 + t * d))
+
+    for p in contour.astype(float):
+        dmin = min(
+            seg_dist(p, closed[i], closed[i + 1]) for i in range(len(closed) - 1)
+        )
+        assert dmin <= 2.5 + 1e-6
+
+
+def test_simplify_polygon_never_degenerate():
+    """A huge tolerance must still leave >= 3 vertices (valid GeoJSON
+    linear ring), re-adding the farthest-from-chord vertex."""
+    from tmlibrary_tpu import native
+
+    ring = np.array(
+        [[0, 0], [0, 10], [3, 20], [10, 10], [10, 0], [5, 1]], np.int32
+    )
+    s = native.simplify_polygon_host(ring, 1000.0)
+    assert len(s) >= 3
+    # the kept vertices are a subset of the input ring
+    in_set = {tuple(p) for p in ring.tolist()}
+    assert all(tuple(p) in in_set for p in s.tolist())

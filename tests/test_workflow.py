@@ -526,6 +526,16 @@ def test_cli_export_geojson(source_dir, store, tmp_path):
     assert ring[0] == ring[-1]  # closed
     assert {"site", "label"} <= set(f0["properties"])
 
+    # --simplify drops collinear/near-collinear vertices but keeps shape
+    out2 = tmp_path / "nuclei_simple.geojson"
+    assert main(["export", "--root", str(store.root), "--objects", "nuclei",
+                 "--out", str(out2), "--simplify", "1.0"]) == 0
+    doc2 = json.loads(out2.read_text())
+    assert len(doc2["features"]) == len(doc["features"])
+    n_full = sum(len(f["geometry"]["coordinates"][0]) for f in doc["features"])
+    n_simp = sum(len(f["geometry"]["coordinates"][0]) for f in doc2["features"])
+    assert n_simp < n_full
+
 
 def test_cli_args_schema(capsys):
     """tmx <step> args prints the argument schema (reference: the args

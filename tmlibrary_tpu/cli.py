@@ -25,6 +25,8 @@ import json
 import sys
 from pathlib import Path
 
+import numpy as np
+
 from tmlibrary_tpu.log import configure_logging
 from tmlibrary_tpu.models.experiment import Experiment
 from tmlibrary_tpu.models.store import ExperimentStore
@@ -70,6 +72,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--format", choices=("csv", "parquet", "geojson"), default=None,
         help="inferred from --out suffix when omitted; geojson exports the "
              "traced object polygons (run jterator with --as-polygons)",
+    )
+    p_export.add_argument(
+        "--simplify", type=float, default=0.0, metavar="TOL",
+        help="geojson only: Douglas-Peucker-simplify polygon rings to this "
+             "perpendicular-distance tolerance in pixels (reference: PostGIS "
+             "geometry simplification for viewer-scale objects)",
     )
 
     p_wf = sub.add_parser("workflow", help="full workflow orchestration")
@@ -372,12 +380,14 @@ def cmd_export(args) -> int:
             )
             return 1
         table = pd.concat([pd.read_parquet(p) for p in shards], ignore_index=True)
+        from tmlibrary_tpu import native
+
         features = []
         for _, row in table.iterrows():
-            ring = [
-                [float(x), float(y)]
-                for y, x in zip(row["contour_y"], row["contour_x"])
-            ]
+            contour = np.stack([row["contour_y"], row["contour_x"]], axis=1)
+            if args.simplify > 0:
+                contour = native.simplify_polygon_host(contour, args.simplify)
+            ring = [[float(x), float(y)] for y, x in contour]
             if ring and ring[0] != ring[-1]:
                 ring.append(ring[0])  # GeoJSON rings are closed
             props = {

@@ -81,7 +81,15 @@ def _load():
         ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
     ]
-    # tm_tiff_* may be absent from stale prebuilt libraries; probe
+    # newer entry points may be absent from stale prebuilt libraries; probe
+    try:
+        lib.tm_simplify_polygon.restype = ctypes.c_int32
+        lib.tm_simplify_polygon.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_uint8),
+        ]
+    except AttributeError:
+        logger.info("native library predates polygon simplify; rebuild native/")
     try:
         lib.tm_tiff_info.restype = ctypes.c_int32
         lib.tm_tiff_info.argtypes = [
@@ -287,3 +295,88 @@ def tiff_read(path, page: int, height: int, width: int) -> np.ndarray | None:
         int(height), int(width),
     )
     return out if rc == 0 else None
+
+
+def _simplify_numpy(contour: np.ndarray, tolerance: float) -> np.ndarray:
+    """Pure-numpy Douglas-Peucker fallback with the same ring-splitting
+    semantics as ``tm_simplify_polygon`` (split at vertex 0 and its
+    farthest vertex; the closing edge is simplified like any other)."""
+    n = len(contour)
+    keep = np.zeros(n, bool)
+    if n <= 2:
+        return contour
+    pts = contour.astype(np.float64)
+    tol2 = tolerance * tolerance
+
+    def dist2(idx, a, b_pt):
+        ay, ax = pts[a]
+        by, bx = b_pt
+        dy, dx = by - ay, bx - ax
+        len2 = dy * dy + dx * dx
+        ey = pts[idx, 0] - ay
+        ex = pts[idx, 1] - ax
+        if len2 == 0.0:
+            return ey * ey + ex * ex
+        cross = dx * ey - dy * ex
+        return cross * cross / len2
+
+    d0 = ((pts - pts[0]) ** 2).sum(axis=1)
+    far_i = int(d0[1:].argmax()) + 1
+    keep[0] = keep[far_i] = True
+    stack = [(0, far_i), (far_i, n)]  # b == n: chord ends at vertex 0
+    while stack:
+        a, b = stack.pop()
+        b_pt = pts[0] if b == n else pts[b]
+        worst, worst_d = -1, tol2
+        for i in range(a + 1, b):
+            d = dist2(i, a, b_pt)
+            if d > worst_d:
+                worst_d, worst = d, i
+        if worst >= 0:
+            keep[worst] = True
+            stack.append((a, worst))
+            stack.append((worst, b))
+    return contour[keep]
+
+
+def simplify_polygon_host(contour: np.ndarray, tolerance: float) -> np.ndarray:
+    """Douglas-Peucker simplification of a closed (K, 2) (y, x) contour
+    ring to the given perpendicular-distance tolerance (pixels).
+
+    Reference parity: the reference serves viewer-scale geometries through
+    PostGIS simplification of ``MapobjectSegmentation`` polygons
+    (``tmlib/models/mapobject.py`` row, SURVEY.md §3); here the native
+    C++ routine does it at export time.  Falls back to an identical
+    numpy implementation when the native library is unavailable."""
+    contour = np.ascontiguousarray(contour, np.int32)
+    if tolerance <= 0 or len(contour) <= 3:
+        return contour
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_simplify_polygon"):
+        out = _simplify_numpy(contour, tolerance)
+    else:
+        keep = np.zeros(len(contour), np.uint8)
+        kept = lib.tm_simplify_polygon(
+            contour.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(contour), float(tolerance),
+            keep.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if kept < 0:
+            raise ValueError("tm_simplify_polygon: invalid arguments")
+        out = contour[keep.astype(bool)]
+    if len(out) >= 3:
+        return out
+    # a large tolerance can collapse the ring to its two always-kept
+    # split vertices (vertex 0 and the vertex farthest from it), which is
+    # not a valid polygon (GeoJSON linear rings need >= 4 positions incl.
+    # closure): re-add the vertex farthest from that chord so downstream
+    # consumers always get a real ring
+    pts = contour.astype(np.float64)
+    far = int(((pts - pts[0]) ** 2).sum(axis=1).argmax())
+    d = pts[far] - pts[0]
+    len2 = max(float(d @ d), 1e-9)
+    cross = np.abs(
+        d[1] * (pts[:, 0] - pts[0, 0]) - d[0] * (pts[:, 1] - pts[0, 1])
+    ) / np.sqrt(len2)
+    cross[0] = cross[far] = -1.0
+    return contour[sorted({0, far, int(cross.argmax())})]
