@@ -49,9 +49,25 @@ def measure(platform: str) -> None:
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
     config = os.environ.get("BENCH_CONFIG", "3")  # BASELINE.md milestone ladder
 
-    if config not in ("3", "4"):
-        raise SystemExit(f"BENCH_CONFIG must be '3' or '4', got '{config}'")
-    if config == "4":
+    if config not in ("3", "4", "volume"):
+        raise SystemExit(
+            f"BENCH_CONFIG must be '3', '4' or 'volume', got '{config}'"
+        )
+    if config == "volume":
+        from tmlibrary_tpu.benchmarks import (
+            synthetic_volume_batch,
+            volume_description,
+        )
+
+        # default z-stack site is 4x the pixels of a 2-D site -> 4x smaller batch
+        batch = int(os.environ.get("BENCH_BATCH", "16"))
+        depth = int(os.environ.get("BENCH_DEPTH", "16"))
+        size = int(os.environ.get("BENCH_SITE_SIZE", "128"))
+        data = synthetic_volume_batch(batch, size=size, depth=depth)
+        desc = volume_description()
+        metric = "jterator_volume_sites_per_sec_per_chip"
+        unit = f"sites/sec ({depth}x{size}x{size} z-stack, 3-D segment+measure)"
+    elif config == "4":
         from tmlibrary_tpu.benchmarks import (
             full_feature_description,
             synthetic_full_stack_batch,
@@ -81,15 +97,16 @@ def measure(platform: str) -> None:
     # counts — under the axon relay, block_until_ready returns before the
     # remote computation finishes, so fetch-based timing is the only honest
     # clock (scalar-sized transfer, negligible vs compute).
+    count_key = "cells3d" if config == "volume" else "cells"
     result = fn(raw, {}, shifts)
-    np.asarray(result.counts["cells"])
+    np.asarray(result.counts[count_key])
 
     reps = int(os.environ.get("BENCH_REPS", "3"))
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
         result = fn(raw, {}, shifts)
-        np.asarray(result.counts["cells"])
+        np.asarray(result.counts[count_key])
         best = min(best, time.perf_counter() - t0)
     device_sites_per_sec = batch / best
 
@@ -100,7 +117,12 @@ def measure(platform: str) -> None:
     cpu_best = float("inf")
     for _ in range(3):
         t0 = time.perf_counter()
-        if config == "4":
+        if config == "volume":
+            from tmlibrary_tpu.benchmarks import cpu_reference_site_volume
+
+            for s in range(n_cpu):
+                cpu_reference_site_volume(data["DAPI"][s])
+        elif config == "4":
             from tmlibrary_tpu.benchmarks import cpu_reference_site_full
 
             for s in range(n_cpu):
@@ -188,11 +210,10 @@ def main() -> None:
     if try_once("cpu"):
         return
     config = os.environ.get("BENCH_CONFIG", "3")
-    metric = (
-        "jterator_full_stack_sites_per_sec_per_chip"
-        if config == "4"
-        else "jterator_cell_painting_sites_per_sec_per_chip"
-    )
+    metric = {
+        "4": "jterator_full_stack_sites_per_sec_per_chip",
+        "volume": "jterator_volume_sites_per_sec_per_chip",
+    }.get(config, "jterator_cell_painting_sites_per_sec_per_chip")
     print(
         json.dumps(
             {

@@ -469,3 +469,134 @@ def cpu_reference_site(dapi: np.ndarray, actin: np.ndarray) -> tuple[int, int]:
             ndi.minimum(img, lab_img, ids)
             ndi.sum(img, lab_img, ids)
     return n_nuclei, n_cells
+
+
+# ------------------------------------------------------------- volume config
+def volume_description(n_levels: int = 8) -> PipelineDescription:
+    """BASELINE config 5 (stretch): the 3-D z-stack pipeline — focus-based
+    volume generation, 3-D primary segmentation (Otsu + 26-connected CC),
+    3-D secondary growth by level-ordered flooding, volumetric
+    measurements."""
+    def h(module, inputs, outputs):
+        return {"handles": {"module": module, "input": inputs, "output": outputs}}
+
+    return PipelineDescription.from_dict(
+        {
+            "description": "3-D volume segment+measure",
+            "input": {
+                "channels": [{"name": "DAPI", "correct": False, "zstack": True}]
+            },
+            "pipeline": [
+                h(
+                    "generate_volume_image",
+                    [
+                        {"name": "zstack", "type": "IntensityImage", "key": "DAPI"},
+                        {"name": "mode", "type": "Character", "value": "focus"},
+                    ],
+                    [{"name": "volume_image", "type": "IntensityImage", "key": "vol"}],
+                ),
+                h(
+                    "segment_volume",
+                    [
+                        {"name": "volume_image", "type": "IntensityImage", "key": "vol"},
+                        {"name": "threshold_method", "type": "Character", "value": "otsu"},
+                    ],
+                    [
+                        {
+                            "name": "objects",
+                            "type": "SegmentedObjects",
+                            "key": "nuclei3d",
+                            "objects": "nuclei3d",
+                        }
+                    ],
+                ),
+                h(
+                    "segment_volume_secondary",
+                    [
+                        {"name": "volume_image", "type": "IntensityImage", "key": "vol"},
+                        {"name": "primary_label_image", "type": "LabelImage", "key": "nuclei3d"},
+                        {"name": "correction_factor", "type": "Numeric", "value": 0.8},
+                        {"name": "n_levels", "type": "Numeric", "value": n_levels},
+                    ],
+                    [
+                        {
+                            "name": "objects",
+                            "type": "SegmentedObjects",
+                            "key": "cells3d",
+                            "objects": "cells3d",
+                        }
+                    ],
+                ),
+                h(
+                    "measure_volume",
+                    [
+                        {"name": "objects_image", "type": "LabelImage", "key": "nuclei3d"},
+                        {"name": "intensity_image", "type": "IntensityImage", "key": "vol"},
+                    ],
+                    [
+                        {
+                            "name": "measurements",
+                            "type": "Measurement",
+                            "objects": "nuclei3d",
+                        }
+                    ],
+                ),
+            ],
+            "output": {"objects": [{"name": "nuclei3d"}, {"name": "cells3d"}]},
+        }
+    )
+
+
+def synthetic_volume_batch(
+    n_sites: int, size: int = 128, depth: int = 16, n_cells: int = 8, seed: int = 0
+) -> dict[str, np.ndarray]:
+    """Synthetic (B, Z, H, W) DAPI z-stacks: 3-D Gaussian nuclei at random
+    depths over a noisy background."""
+    rng = np.random.default_rng(seed)
+    zz, yy, xx = np.mgrid[0:depth, 0:size, 0:size].astype(np.float32)
+    out = rng.normal(300.0, 25.0, (n_sites, depth, size, size)).astype(np.float32)
+    margin = size // 8
+    for s in range(n_sites):
+        for _ in range(n_cells):
+            y = rng.integers(margin, size - margin)
+            x = rng.integers(margin, size - margin)
+            z = rng.integers(depth // 4, 3 * depth // 4)
+            r_xy = rng.uniform(4.0, 6.0)
+            r_z = rng.uniform(1.5, 2.5)
+            out[s] += 4000.0 * np.exp(
+                -(
+                    ((zz - z) ** 2) / (2 * r_z**2)
+                    + ((yy - y) ** 2 + (xx - x) ** 2) / (2 * r_xy**2)
+                )
+            )
+    return {"DAPI": np.clip(out, 0, 65535)}
+
+
+def cpu_reference_site_volume(zstack: np.ndarray) -> tuple[int, int]:
+    """Single-CPU scipy equivalent of the volume pipeline (denominator):
+    variance-of-Laplacian focus weighting, Otsu, 26-connected 3-D label,
+    seeded 3-D watershed growth, per-object volume/intensity stats."""
+    import scipy.ndimage as ndi
+
+    # focus weighting per plane (box-filtered squared Laplacian)
+    lap = np.stack([ndi.laplace(p) for p in zstack])
+    focus = np.stack([ndi.uniform_filter(l * l, 5) for l in lap])
+    w = focus / np.maximum(focus.max(axis=0, keepdims=True), 1e-6)
+    vol = zstack * w
+
+    t = _otsu_numpy(vol)
+    labels, n = ndi.label(vol > t, structure=np.ones((3, 3, 3)))
+
+    # secondary: grow from seeds through the lower-threshold mask
+    mask2 = vol > t * 0.8
+    inv = (vol.max() - vol).astype(np.uint16)
+    cells = ndi.watershed_ift(inv, markers=labels.astype(np.int32),
+                              structure=np.ones((3, 3, 3), int))
+    cells = np.where(mask2, cells, 0)
+
+    # volumetric stats per object
+    for lab in range(1, n + 1):
+        sel = vol[labels == lab]
+        if sel.size:
+            sel.mean(), sel.std(), sel.max(), sel.min(), sel.sum()
+    return n, len(np.unique(cells)) - 1
