@@ -39,20 +39,23 @@ def measure(platform: str) -> None:
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    import jax.numpy as jnp
-    import numpy as np
-
-    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
-
     size = int(os.environ.get("BENCH_SITE_SIZE", "256"))
     batch = int(os.environ.get("BENCH_BATCH", "64"))
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
     config = os.environ.get("BENCH_CONFIG", "3")  # BASELINE.md milestone ladder
 
-    if config not in ("3", "4", "volume"):
+    if config not in ("3", "4", "volume", "corilla"):
         raise SystemExit(
-            f"BENCH_CONFIG must be '3', '4' or 'volume', got '{config}'"
+            f"BENCH_CONFIG must be '3', '4', 'volume' or 'corilla', got '{config}'"
         )
+    if config == "corilla":
+        return measure_corilla(size)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
     if config == "volume":
         from tmlibrary_tpu.benchmarks import (
             synthetic_volume_batch,
@@ -146,6 +149,66 @@ def measure(platform: str) -> None:
     print(json.dumps(record), flush=True)
 
 
+def measure_corilla(size: int) -> None:
+    """BASELINE config 1: corilla online illumination statistics —
+    channels/sec (the reference's second headline metric).  Device path:
+    one ``lax.scan`` Welford (log-domain mean/var + exact 65536-bin
+    histogram) per channel, ``vmap``ped over the channel axis; CPU
+    denominator: the same update as a single-thread numpy loop."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmlibrary_tpu.benchmarks import (
+        cpu_reference_channel,
+        synthetic_channel_stack,
+    )
+    from tmlibrary_tpu.ops.stats import welford_finalize, welford_scan
+
+    n_sites = int(os.environ.get("BENCH_SITES", "96"))
+    n_channels = int(os.environ.get("BENCH_CHANNELS", "8"))
+    stack = synthetic_channel_stack(n_channels, n_sites, size)
+
+    fn = jax.jit(
+        jax.vmap(lambda s: welford_finalize(welford_scan(s)))
+    )
+    dev_stack = jnp.asarray(stack)
+    out = fn(dev_stack)
+    np.asarray(out["n"])  # force completion (honest clock under the relay)
+
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(dev_stack)
+        np.asarray(out["n"])
+        best = min(best, time.perf_counter() - t0)
+    device_chans_per_sec = n_channels / best
+
+    # single-thread numpy Welford + histogram, one channel, best-of-3
+    cpu_best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cpu_reference_channel(stack[0])
+        cpu_best = min(cpu_best, time.perf_counter() - t0)
+    cpu_chans_per_sec = 1.0 / cpu_best
+
+    print(
+        json.dumps(
+            {
+                "metric": "corilla_channels_per_sec_per_chip",
+                "value": round(device_chans_per_sec, 3),
+                "unit": f"channels/sec ({n_sites} sites of {size}x{size}, "
+                        "online mean/var + exact percentile histogram)",
+                "vs_baseline": round(device_chans_per_sec / cpu_chans_per_sec, 2),
+                "backend": jax.default_backend(),
+                "cpu_denominator_channels_per_sec": round(cpu_chans_per_sec, 4),
+            }
+        ),
+        flush=True,
+    )
+
+
 def main() -> None:
     """Parent: run the measurement in a child with timeout + retries."""
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "2"))
@@ -213,13 +276,14 @@ def main() -> None:
     metric = {
         "4": "jterator_full_stack_sites_per_sec_per_chip",
         "volume": "jterator_volume_sites_per_sec_per_chip",
+        "corilla": "corilla_channels_per_sec_per_chip",
     }.get(config, "jterator_cell_painting_sites_per_sec_per_chip")
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": 0.0,
-                "unit": "sites/sec",
+                "unit": "channels/sec" if config == "corilla" else "sites/sec",
                 "vs_baseline": 0.0,
                 "error": f"all backends failed: {last_err}",
             }

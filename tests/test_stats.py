@@ -127,3 +127,24 @@ def test_welford_merge_numerically_hard(devices):
     # the sequential path has no frame conversions: tight vs float64 truth
     np.testing.assert_allclose(seq["std_log"], truth_std, rtol=0.05,
                                atol=1e-8)
+
+
+def test_corilla_bench_cpu_reference_matches_device():
+    """The corilla benchmark's numpy denominator computes the SAME
+    statistics as the device welford_scan path (fair vs_baseline)."""
+    from tmlibrary_tpu.benchmarks import (
+        cpu_reference_channel,
+        synthetic_channel_stack,
+    )
+    from tmlibrary_tpu.ops.stats import welford_finalize, welford_scan
+
+    sites = synthetic_channel_stack(1, 12, 32, seed=5)[0]
+    dev = welford_finalize(welford_scan(jnp.asarray(sites)))
+    ref = cpu_reference_channel(sites)
+    np.testing.assert_allclose(
+        np.asarray(dev["mean_log"]), ref["mean_log"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(dev["std_log"]), ref["std_log"], rtol=1e-4, atol=1e-6
+    )
+    np.testing.assert_array_equal(np.asarray(dev["hist"]), ref["hist"])

@@ -600,3 +600,37 @@ def cpu_reference_site_volume(zstack: np.ndarray) -> tuple[int, int]:
         if sel.size:
             sel.mean(), sel.std(), sel.max(), sel.min(), sel.sum()
     return n, len(np.unique(cells)) - 1
+
+
+# ------------------------------------------------------------ corilla config
+def synthetic_channel_stack(
+    n_channels: int, n_sites: int, size: int, seed: int = 0
+) -> np.ndarray:
+    """(C, S, H, W) float32 uint16-range site stack for the corilla
+    benchmark (BASELINE config 1)."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(
+        0, 5000, (n_channels, n_sites, size, size)
+    ).astype(np.float32)
+
+
+def cpu_reference_channel(sites: np.ndarray) -> dict[str, np.ndarray]:
+    """Single-thread numpy equivalent of one corilla channel job: online
+    log-domain Welford mean/std plus the exact 65536-bin raw-intensity
+    histogram (reference ``OnlineStatistics.update`` per site)."""
+    mean = np.zeros(sites.shape[1:], np.float64)
+    m2 = np.zeros_like(mean)
+    hist = np.zeros(65536, np.int64)
+    for i, raw in enumerate(sites):
+        x = np.log10(1.0 + raw)
+        delta = x - mean
+        mean += delta / (i + 1)
+        m2 += delta * (x - mean)
+        hist += np.bincount(
+            np.clip(raw, 0, 65535).astype(np.int64).ravel(), minlength=65536
+        )
+    return {
+        "mean_log": mean,
+        "std_log": np.sqrt(m2 / max(len(sites), 1)),
+        "hist": hist,
+    }
