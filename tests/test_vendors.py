@@ -730,3 +730,140 @@ def test_imagexpress_multi_plate_stray_file_skipped(tmp_path):
     assert len(entries) == 2
     assert {e["plate"] for e in entries} == {"plateA", "plateB"}
     assert skipped == 1
+
+
+def _write_scanr_dir(tmp_path, names, descriptor=None):
+    import cv2
+
+    src = tmp_path / "scanr"
+    (src / "data").mkdir(parents=True)
+    for n in names:
+        cv2.imwrite(str(src / "data" / n), np.full((8, 8), 7, np.uint16))
+    if descriptor is not None:
+        (src / "experiment_descriptor.xml").write_text(descriptor)
+    return src
+
+
+def test_scanr_sidecar_basic(tmp_path):
+    """W tokens map row-major onto the plate; P is the 1-based site."""
+    from tmlibrary_tpu.workflow.steps.vendors import scanr_sidecar
+
+    src = _write_scanr_dir(tmp_path, [
+        "exp--W00001--P00001--Z00000--T00000--DAPI.tif",
+        "exp--W00001--P00002--Z00000--T00000--DAPI.tif",
+        "exp--W00014--P00001--Z00000--T00000--DAPI.tif",
+        "exp--W00001--P00001--Z00000--T00000--GFP.tif",
+    ])
+    entries, skipped = scanr_sidecar(src)
+    assert skipped == 0
+    assert len(entries) == 4
+    by = {(e["well_row"], e["well_col"], e["site"], e["channel"]) for e in entries}
+    # 6-well heuristic would not fit W14; 24-well (4x6) is the smallest
+    # standard plate fitting 14 -> W14 (0-based 13) = row 2, col 1
+    assert (0, 0, 0, "DAPI") in by
+    assert (0, 0, 1, "DAPI") in by
+    assert (2, 1, 0, "DAPI") in by
+    assert (0, 0, 0, "GFP") in by
+
+
+def test_scanr_descriptor_geometry_and_dims(tmp_path):
+    """experiment_descriptor.xml row/column counts beat the heuristic;
+    Z and T tokens land in zplane/tpoint."""
+    from tmlibrary_tpu.workflow.steps.vendors import scanr_sidecar
+
+    desc = '<Experiment><Plate Rows="2" Columns="7"/></Experiment>'
+    src = _write_scanr_dir(tmp_path, [
+        "s--W00008--P00001--Z00002--T00001--Cy5.tif",
+    ], descriptor=desc)
+    entries, _ = scanr_sidecar(src)
+    (e,) = entries
+    # 0-based linear 7 on a 2x7 plate -> row 1, col 0
+    assert (e["well_row"], e["well_col"]) == (1, 0)
+    assert e["zplane"] == 2 and e["tpoint"] == 1
+    assert e["channel"] == "Cy5"
+
+
+def test_scanr_not_matching_returns_none(tmp_path):
+    from tmlibrary_tpu.workflow.steps.vendors import scanr_sidecar
+
+    src = _write_scanr_dir(tmp_path, ["A01_s0_DAPI.tif"])
+    assert scanr_sidecar(src) is None
+
+
+def test_metaconfig_scanr_auto(tmp_path):
+    """The auto prober picks up a ScanR tree end-to-end."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = _write_scanr_dir(tmp_path, [
+        "exp--W00001--P00001--Z00000--T00000--DAPI.tif",
+        "exp--W00002--P00001--Z00000--T00000--DAPI.tif",
+    ])
+    store = ExperimentStore.create(
+        tmp_path / "exp",
+        Experiment(name="s", plates=[], channels=[], site_height=1, site_width=1),
+    )
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "auto"})
+    for i in step.list_batches():
+        step.run(i)
+    step.collect()
+    exp = ExperimentStore.open(store.root).experiment
+    assert exp.n_sites == 2
+    assert [c.name for c in exp.channels] == ["DAPI"]
+
+
+def test_scanr_zero_based_tokens(tmp_path):
+    """Exports counting W/P from zero must not underflow or collide."""
+    from tmlibrary_tpu.workflow.steps.vendors import scanr_sidecar
+
+    src = _write_scanr_dir(tmp_path, [
+        "x--W00000--P00000--DAPI.tif",
+        "x--W00000--P00001--DAPI.tif",
+        "x--W00001--P00000--DAPI.tif",
+    ])
+    entries, _ = scanr_sidecar(src)
+    keys = {(e["well_row"], e["well_col"], e["site"]) for e in entries}
+    assert keys == {(0, 0, 0), (0, 0, 1), (0, 1, 0)}
+
+
+def test_scanr_descriptor_ignores_per_well_elements(tmp_path):
+    """<Well Row=.. Column=..> entries must not be read as the plate
+    geometry (only plate-tagged elements count)."""
+    from tmlibrary_tpu.workflow.steps.vendors import scanr_sidecar
+
+    desc = (
+        "<Experiment>"
+        '<Well Row="8" Column="2"/>'
+        '<PlateLayout Rows="4" Columns="6"/>'
+        "</Experiment>"
+    )
+    src = _write_scanr_dir(tmp_path, [
+        "s--W00014--P00001--DAPI.tif",
+    ], descriptor=desc)
+    (e,) = scanr_sidecar(src)[0]
+    # 4x6 from PlateLayout: W14 (0-based 13) -> row 2, col 1
+    assert (e["well_row"], e["well_col"]) == (2, 1)
+
+
+def test_scanr_explicit_handler_choice(tmp_path):
+    """--handler scanr is selectable explicitly, not only via auto."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = _write_scanr_dir(tmp_path, [
+        "exp--W00001--P00001--Z00000--T00000--DAPI.tif",
+    ])
+    store = ExperimentStore.create(
+        tmp_path / "exp2",
+        Experiment(name="s2", plates=[], channels=[], site_height=1,
+                   site_width=1),
+    )
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "scanr"})
+    for i in step.list_batches():
+        step.run(i)
+    step.collect()
+    assert ExperimentStore.open(store.root).experiment.n_sites == 1

@@ -790,3 +790,119 @@ def metamorph_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                             rec["page"] = z  # stack page = z plane
                         entries.append(rec)
     return entries, skipped
+
+
+# -------------------------------------------------------------------- scanr
+#: standard plate geometries (wells -> (rows, cols)), smallest-first
+_PLATE_GEOMETRIES = (
+    (6, (2, 3)), (12, (3, 4)), (24, (4, 6)), (48, (6, 8)),
+    (96, (8, 12)), (384, (16, 24)), (1536, (32, 48)),
+)
+
+
+def _scanr_tokens(stem: str) -> dict[str, str] | None:
+    """Split a ScanR filename stem on ``--`` into its dimension tokens.
+
+    ScanR names planes ``<prefix>--W00001--P00012--Z00000--T00000--<chan>``
+    (Z/T optional); W (well) and P (position) are required for a match,
+    the trailing token is the channel name."""
+    parts = stem.split("--")
+    if len(parts) < 3:
+        return None
+    out: dict[str, str] = {}
+    for tok in parts[1:-1]:
+        m = re.fullmatch(r"([WPZT])(\d+)", tok)
+        if m:
+            out[m.group(1)] = m.group(2)
+    if "W" not in out or "P" not in out:
+        return None
+    out["channel"] = parts[-1]
+    return out
+
+
+def _scanr_plate_shape(source_dir: Path, n_wells: int) -> tuple[int, int]:
+    """Plate geometry: from ``experiment_descriptor.xml`` when a
+    plate-describing element carries row/column counts, else the smallest
+    standard plate that fits the well count (documented heuristic — ScanR
+    well indices are linear).
+
+    Only elements whose tag mentions "plate" with exact ``rows``/
+    ``columns``-style attribute names are considered, so per-well
+    ``<Well Row=.. Column=..>`` entries or pitch/spacing attributes can't
+    masquerade as the geometry."""
+    attr_rows = re.compile(r"^(n?_?rows?)$", re.IGNORECASE)
+    attr_cols = re.compile(r"^(n?_?col(umn)?s?)$", re.IGNORECASE)
+    for xml in sorted(source_dir.rglob("experiment_descriptor.xml")):
+        try:
+            root = ET.parse(xml).getroot()
+        except ET.ParseError:
+            continue
+        for el in root.iter():
+            if "plate" not in _strip_ns(el.tag).lower():
+                continue
+            rows = next(
+                (v for k, v in el.attrib.items() if attr_rows.match(k)), None
+            )
+            cols = next(
+                (v for k, v in el.attrib.items() if attr_cols.match(k)), None
+            )
+            try:
+                if rows and cols and int(rows) * int(cols) >= n_wells:
+                    return int(rows), int(cols)
+            except ValueError:
+                continue
+    for n, shape in _PLATE_GEOMETRIES:
+        if n >= n_wells:
+            return shape
+    # beyond 1536: single row of wells
+    return 1, n_wells
+
+
+@register_sidecar_handler("scanr")
+def scanr_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """Olympus ScanR handler: recognizes the ``--W...--P...--`` token
+    filename convention (``experiment_descriptor.xml`` is consulted for
+    the plate geometry when present, but is not required).
+
+    Reference parity: ``tmlib/workflow/metaconfig``'s vendor handler set
+    (SURVEY.md §2 metaconfig row, vendor set tagged [L]).  ScanR well
+    indices are linear and 1-based; they map row-major onto the plate
+    geometry.  Positions are 1-based sites within the well; Z and T
+    tokens become zplane/tpoint.
+    """
+    images = [
+        p for p in sorted(source_dir.rglob("*"))
+        if p.suffix.lower() in (".tif", ".tiff", ".png")
+    ]
+    parsed = [(p, _scanr_tokens(p.stem)) for p in images]
+    matches = [(p, t) for p, t in parsed if t is not None]
+    if not matches:
+        return None
+
+    # ScanR W/P tokens are 1-based by convention, but some exports count
+    # from 0: an observed zero token flips that dimension to 0-based.
+    # (Min-normalization would be wrong — screens routinely image a well
+    # subset, and W must keep its absolute plate position.)
+    w_base = 0 if min(int(t["W"]) for _, t in matches) == 0 else 1
+    p_base = 0 if min(int(t["P"]) for _, t in matches) == 0 else 1
+    n_wells = max(int(t["W"]) for _, t in matches) - w_base + 1
+    rows, cols = _scanr_plate_shape(source_dir, n_wells)
+
+    entries: list[dict] = []
+    skipped = len(parsed) - len(matches)
+    for path, t in matches:
+        w = int(t["W"]) - w_base  # linear well index, row-major
+        entries.append(
+            {
+                "plate": "plate00",
+                "well_row": w // cols,
+                "well_col": w % cols,
+                "site": int(t["P"]) - p_base,
+                "channel": t["channel"],
+                "cycle": 0,
+                "tpoint": int(t.get("T", 0)),
+                "zplane": int(t.get("Z", 0)),
+                "path": str(path),
+            }
+        )
+    return entries, skipped
