@@ -638,3 +638,80 @@ def test_multiplexing_workflow_end_to_end(multiplex_source_dir, store):
     # rolling up by 4 exposes invalid rows at the bottom -> bottom margin
     window = store.read_intersection()
     assert window == {"top": 0, "bottom": 4, "left": 0, "right": 0}
+
+
+def test_workflow_resume_skips_completed_batches(source_dir, store):
+    """Mid-step crash recovery: batches the ledger already records as done
+    are not re-run on resume (reference: GC3Pie task-level resume)."""
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    # run everything up to jterator
+    for name in ("metaconfig", "imextract", "corilla"):
+        sd = next(s for stage in desc.stages for s in stage.steps if s.name == name)
+        step = get_step(name)(store)
+        step.init(sd.args)
+        for j in step.list_batches():
+            step.run(j)
+
+    # simulate a crash after jterator batch 0: plan 4 batches of 4 sites,
+    # run only the first, and record what the engine would have logged
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    jd.args["batch_size"] = 4
+    jt = get_step("jterator")(store)
+    jt.init(jd.args)
+    assert len(jt.list_batches()) == 4
+    jt.run(0)
+    ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+    ledger.append(step="metaconfig", event="step_done")
+    ledger.append(step="imextract", event="step_done")
+    ledger.append(step="corilla", event="step_done")
+    ledger.append(step="jterator", event="init_done", n_batches=4)
+    ledger.append(step="jterator", event="batch_done", batch=0)
+
+    summary = Workflow(store, desc).run(resume=True)
+    assert list(summary) == ["jterator"]
+    events = ledger.events()
+    done = [e["batch"] for e in events
+            if e.get("step") == "jterator" and e.get("event") == "batch_done"]
+    # batch 0 was recorded once (the simulated pre-crash run), 1..3 ran now
+    assert sorted(done) == [0, 1, 2, 3]
+    # all 16 sites have labels regardless
+    assert (store.read_labels(None, "nuclei") > 0).any(axis=(1, 2)).all()
+
+
+def test_workflow_resume_replans_on_args_change(source_dir, store):
+    """Resume with changed step args discards the stale batch plan and
+    re-inits (engine re-init invalidation)."""
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    Workflow(store, desc).run()
+
+    # change jterator's batching and resume: step re-runs from a new plan
+    desc2 = make_description(source_dir, store)
+    jd = next(s for stage in desc2.stages for s in stage.steps
+              if s.name == "jterator")
+    jd.args["batch_size"] = 4
+    # forget the step_done so jterator is considered interrupted
+    ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+    events = [e for e in ledger.events()
+              if not (e.get("step") == "jterator"
+                      and e.get("event") == "step_done")]
+    ledger.path.write_text("".join(json.dumps(e) + "\n" for e in events))
+
+    summary = Workflow(store, desc2).run(resume=True)
+    assert list(summary) == ["jterator"]
+    jt = get_step("jterator")(store)
+    assert len(jt.list_batches()) == 4  # re-planned at the new batch size
+    # the new plan actually RAN in full: 4 fresh batch_done events after
+    # the last init_done, and every site has labels
+    after = ledger.events()
+    last_init = max(i for i, e in enumerate(after)
+                    if e.get("step") == "jterator"
+                    and e.get("event") == "init_done")
+    ran = [e["batch"] for e in after[last_init:]
+           if e.get("step") == "jterator" and e.get("event") == "batch_done"]
+    assert sorted(ran) == [0, 1, 2, 3]
+    assert (store.read_labels(None, "nuclei") > 0).any(axis=(1, 2)).all()

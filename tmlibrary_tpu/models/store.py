@@ -55,7 +55,8 @@ class ExperimentStore:
             ref.as_tuple(): i for i, ref in enumerate(experiment.sites())
         }
         self._lock = threading.Lock()
-        self._open_stacks: dict[Path, np.memmap] = {}
+        #: path -> (memmap, inode at open time); see _open_stack
+        self._open_stacks: dict[Path, tuple[np.memmap, int]] = {}
 
     # ------------------------------------------------------------- lifecycle
     @classmethod
@@ -106,17 +107,40 @@ class ExperimentStore:
     def _open_stack(self, path: Path, dtype, write: bool) -> np.memmap:
         """Open (or create, when writing) an ``(n_sites, H, W)`` site stack,
         guarding against shape mismatches from stale files written under a
-        different manifest."""
+        different manifest.
+
+        The cache is validated against the file's current inode: a step's
+        ``delete_previous_output`` may rmtree the directory while a memmap
+        from an earlier run is still cached, and the open mapping keeps the
+        unlinked inode alive — without the check, re-run writes would land
+        in the deleted file and silently never appear on disk."""
         with self._lock:
             cached = self._open_stacks.get(path)
-            if cached is not None and (write == (cached.mode in ("r+", "w+"))):
-                return cached
+            if cached is not None:
+                mm, ino = cached
+                if write == (mm.mode in ("r+", "w+")):
+                    try:
+                        if path.stat().st_ino == ino:
+                            return mm
+                    except OSError:
+                        pass  # deleted out from under the cache: reopen
+                self._open_stacks.pop(path, None)
             exp = self.experiment
             shape = (self.n_sites, exp.site_height, exp.site_width)
+            # inode is captured BEFORE the open: if the file is replaced
+            # in the stat->open window, the recorded (old) inode mismatches
+            # the path on the next call and we spuriously reopen — fail
+            # safe.  stat-after-open would pin the replacement's inode to
+            # the old mapping and silently lose writes under the same race.
+            try:
+                ino = path.stat().st_ino
+            except OSError:
+                ino = -1  # about to be created below
             if not path.exists():
                 if not write:
                     raise StoreError(f"pixel plane missing: {path.name}")
                 mm = np.lib.format.open_memmap(path, mode="w+", dtype=dtype, shape=shape)
+                ino = path.stat().st_ino
             else:
                 mm = np.lib.format.open_memmap(path, mode="r+" if write else "r")
                 if mm.shape != shape or mm.dtype != dtype:
@@ -124,7 +148,7 @@ class ExperimentStore:
                         f"site stack {path.name} has shape {mm.shape} dtype "
                         f"{mm.dtype}, expected {shape} {np.dtype(dtype)}"
                     )
-            self._open_stacks[path] = mm
+            self._open_stacks[path] = (mm, ino)
             return mm
 
     def _check_batch(self, arr: np.ndarray, site_indices: Sequence[int], what: str) -> None:
