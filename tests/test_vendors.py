@@ -867,3 +867,92 @@ def test_scanr_explicit_handler_choice(tmp_path):
         step.run(i)
     step.collect()
     assert ExperimentStore.open(store.root).experiment.n_sites == 1
+
+
+def test_leica_sidecar_basic(tmp_path):
+    """U/V tokens are well col/row; X/Y flatten row-major into sites
+    over the global grid extent; T/Z/C fill the remaining dims."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import leica_sidecar
+
+    src = tmp_path / "leica"
+    (src / "field").mkdir(parents=True)
+    names = [
+        "image--L00--S00--U01--V02--J08--E00--O00--X00--Y00--T00--Z00--C00.tif",
+        "image--L00--S00--U01--V02--J08--E00--O00--X01--Y00--T00--Z00--C00.tif",
+        "image--L00--S00--U01--V02--J08--E00--O00--X00--Y01--T03--Z02--C01.tif",
+        "notleica.tif",
+    ]
+    for n in names:
+        cv2.imwrite(str(src / "field" / n), np.full((8, 8), 9, np.uint16))
+    entries, skipped = leica_sidecar(src)
+    assert skipped == 1
+    assert len(entries) == 3
+    for e in entries:
+        assert (e["well_row"], e["well_col"]) == (2, 1)
+    # grid coords are authoritative (metaconfig linearises them)
+    by_grid = {(e["site_y"], e["site_x"]): e for e in entries}
+    assert set(by_grid) == {(0, 0), (0, 1), (1, 0)}
+    assert by_grid[(1, 0)]["tpoint"] == 3
+    assert by_grid[(1, 0)]["zplane"] == 2
+    assert by_grid[(1, 0)]["channel"] == "C01"
+
+
+def test_leica_loop_token_folds_into_tpoints(tmp_path):
+    """Time loops (L) must not collapse onto the same coordinates as
+    their T twins — they fold lexicographically into the tpoint axis."""
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import leica_sidecar
+
+    src = tmp_path / "loops"
+    src.mkdir()
+    for loop in (0, 1):
+        for t in (0, 1):
+            cv2.imwrite(
+                str(src / f"image--L{loop:02d}--S00--U00--V00--J08--E00"
+                          f"--O00--X00--Y00--T{t:02d}--Z00--C00.tif"),
+                np.full((8, 8), 9, np.uint16),
+            )
+    entries, _ = leica_sidecar(src)
+    assert sorted(e["tpoint"] for e in entries) == [0, 1, 2, 3]
+
+
+def test_leica_not_matching_returns_none(tmp_path):
+    import cv2
+
+    from tmlibrary_tpu.workflow.steps.vendors import leica_sidecar
+
+    src = tmp_path / "x"
+    src.mkdir()
+    cv2.imwrite(str(src / "A01_s0_DAPI.tif"), np.full((8, 8), 9, np.uint16))
+    assert leica_sidecar(src) is None
+
+
+def test_metaconfig_leica_auto(tmp_path):
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+    import cv2
+
+    src = tmp_path / "leica2"
+    src.mkdir()
+    for u in (0, 1):
+        cv2.imwrite(
+            str(src / f"image--L00--S00--U{u:02d}--V00--J08--E00--O00"
+                      f"--X00--Y00--T00--Z00--C00.tif"),
+            np.full((8, 8), 9, np.uint16),
+        )
+    store = ExperimentStore.create(
+        tmp_path / "exp",
+        Experiment(name="l", plates=[], channels=[], site_height=1,
+                   site_width=1),
+    )
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "auto"})
+    for i in step.list_batches():
+        step.run(i)
+    step.collect()
+    exp = ExperimentStore.open(store.root).experiment
+    assert exp.n_sites == 2

@@ -122,7 +122,7 @@ class MetadataConfigurator(Step):
                  help="directory of microscope image files"),
         Argument("handler", str, default="default",
                  choices=("default", "cellvoyager", "omexml", "metamorph",
-                          "harmony", "imagexpress", "scanr", "auto"),
+                          "harmony", "imagexpress", "scanr", "leica", "auto"),
                  help="vendor metadata handler (sidecar files preferred, "
                       "filename patterns as fallback)"),
         Argument("pattern", str, default=None,

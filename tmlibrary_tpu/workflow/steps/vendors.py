@@ -792,6 +792,15 @@ def metamorph_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     return entries, skipped
 
 
+def _image_files(source_dir: Path) -> list[Path]:
+    """All image files under the tree, sorted (shared by the token-based
+    filename handlers)."""
+    return [
+        p for p in sorted(source_dir.rglob("*"))
+        if p.suffix.lower() in (".tif", ".tiff", ".png")
+    ]
+
+
 # -------------------------------------------------------------------- scanr
 #: standard plate geometries (wells -> (rows, cols)), smallest-first
 _PLATE_GEOMETRIES = (
@@ -870,10 +879,7 @@ def scanr_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     geometry.  Positions are 1-based sites within the well; Z and T
     tokens become zplane/tpoint.
     """
-    images = [
-        p for p in sorted(source_dir.rglob("*"))
-        if p.suffix.lower() in (".tif", ".tiff", ".png")
-    ]
+    images = _image_files(source_dir)
     parsed = [(p, _scanr_tokens(p.stem)) for p in images]
     matches = [(p, t) for p, t in parsed if t is not None]
     if not matches:
@@ -906,3 +912,69 @@ def scanr_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
             }
         )
     return entries, skipped
+
+
+# ------------------------------------------------------------------- leica
+def _leica_tokens(stem: str) -> dict[str, int] | None:
+    """Parse a Leica MatrixScreener image stem.
+
+    The LAS X MatrixScreener export names planes
+    ``image--L00--S00--U01--V02--J08--E00--O00--X03--Y04--T00--Z05--C01``:
+    U/V are the well column/row on the plate, X/Y the field (site) grid
+    within the well, T/Z/C the timepoint, z-plane and channel.  U, V, X
+    and Y are required for a match; the other dimensions default to 0."""
+    parts = stem.split("--")
+    if len(parts) < 5:
+        return None
+    out: dict[str, int] = {}
+    for tok in parts[1:]:
+        m = re.fullmatch(r"([A-Z])(\d+)", tok)
+        if m:
+            out[m.group(1)] = int(m.group(2))
+    if not {"U", "V", "X", "Y"} <= set(out):
+        return None
+    return out
+
+
+@register_sidecar_handler("leica")
+def leica_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """Leica MatrixScreener handler (``--U--V--X--Y`` token filenames).
+
+    Reference parity: ``tmlib/workflow/metaconfig``'s vendor handler set
+    (SURVEY.md §2 metaconfig row, vendor set tagged [L]).  Wells come from
+    the U (column) / V (row) tokens; the within-well field grid (X, Y)
+    passes through as authoritative grid coordinates (metaconfig derives
+    the site numbering from them); time loops (L) fold with T into one
+    dense tpoint axis."""
+    images = _image_files(source_dir)
+    matches = [
+        (p, t) for p, t in ((p, _leica_tokens(p.name.split(".")[0]))
+                            for p in images)
+        if t is not None
+    ]
+    if not matches:
+        return None
+
+    # time loops (L) and timepoints (T) compose lexicographically into one
+    # dense tpoint axis — collapsing L would silently overwrite whole loops
+    n_t = max(t.get("T", 0) for _, t in matches) + 1
+    entries: list[dict] = []
+    for path, t in matches:
+        entries.append(
+            {
+                "plate": "plate00",
+                "well_row": t["V"],
+                "well_col": t["U"],
+                # site index is derived by metaconfig._linearise_sites from
+                # the authoritative grid coords — no duplicate flattening
+                "site": 0,
+                "site_y": t["Y"],
+                "site_x": t["X"],
+                "channel": f"C{t.get('C', 0):02d}",
+                "cycle": 0,
+                "tpoint": t.get("L", 0) * n_t + t.get("T", 0),
+                "zplane": t.get("Z", 0),
+                "path": str(path),
+            }
+        )
+    return entries, len(images) - len(matches)
