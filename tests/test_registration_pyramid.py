@@ -130,3 +130,23 @@ def test_phase_correlation_subpixel(rng):
         dy, dx = phase_correlation_subpixel(img, shifted, upsample=20)
         assert abs(float(dy) + true_dy) <= 0.05
         assert abs(float(dx) + true_dx) <= 0.05
+
+
+def test_pyramid_respects_compute_dtype(monkeypatch):
+    """compute_dtype drives the display-only pyramid math: bfloat16
+    levels still encode to the same 8-bit tiles for smooth content."""
+    from tmlibrary_tpu import config as cfg_mod
+    from tmlibrary_tpu.ops.pyramid import pyramid_levels
+
+    mosaic = np.linspace(0, 4000, 512 * 512, dtype=np.float32).reshape(512, 512)
+    lv_f32 = pyramid_levels(jnp.asarray(mosaic), n_levels=3)
+    monkeypatch.setattr(cfg_mod.cfg, "compute_dtype", "bfloat16")
+    lv_bf16 = pyramid_levels(jnp.asarray(mosaic), n_levels=3)
+    assert str(lv_bf16[1].dtype) == "bfloat16"
+    assert lv_f32[1].dtype == jnp.float32
+    # after 8-bit display quantization the chains agree to within the
+    # ~8-bit bfloat16 mantissa (a couple of gray counts out of 255)
+    for a, b in zip(lv_f32[1:], lv_bf16[1:]):
+        qa = np.asarray(jnp.asarray(a, jnp.float32) / 4000.0 * 255).astype(np.uint8)
+        qb = np.asarray(jnp.asarray(b, jnp.float32) / 4000.0 * 255).astype(np.uint8)
+        assert np.abs(qa.astype(int) - qb.astype(int)).max() <= 2

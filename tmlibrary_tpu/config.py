@@ -74,8 +74,14 @@ class LibraryConfig:
         axis name → size.  ``None`` means "one axis named 'sites' over all
         visible devices".
     compute_dtype:
-        dtype used for on-device pixel math (bfloat16 keeps the MXU busy;
-        float32 where numerics demand it, e.g. Welford accumulators).
+        dtype for display-only device math (the viewer pyramid's
+        downsample chain — ``ops/pyramid.py``); ``bfloat16`` halves that
+        path's HBM traffic at the cost of possible banding on channels
+        displayed over a narrow clip window (see ``_display_dtype``).
+        The analysis path (segmentation/measurement/statistics)
+        deliberately ignores this knob: it is fp32 with
+        HIGHEST-precision convs because bit-identical goldens gate it
+        (DESIGN.md).
     """
 
     storage_home: Path = dataclasses.field(

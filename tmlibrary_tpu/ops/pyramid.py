@@ -21,16 +21,35 @@ from jax import lax
 TILE_SIZE = 256
 
 
+def _display_dtype() -> jnp.dtype:
+    """dtype for the display-only pyramid math (``LibraryConfig``
+    ``compute_dtype``, default float32).
+
+    Trade-off of opting into bfloat16 here: it halves the pyramid's HBM
+    traffic, but its ~8-bit mantissa is RELATIVE to pixel value, not to
+    the display window — a dim channel stretched over a narrow clip
+    window (e.g. span 40 around intensity 1000, where the bf16 ulp is 8)
+    will show banding in the viewer.  Fine for well-exposed channels;
+    keep float32 when narrow stretches matter.  The analysis path
+    (segmentation/measurement) ignores this knob entirely: it is fp32
+    with HIGHEST-precision convs because bit-identical goldens gate it
+    (DESIGN.md)."""
+    from tmlibrary_tpu.config import cfg
+
+    return jnp.dtype(cfg.compute_dtype)
+
+
 def downsample_2x(img: jax.Array) -> jax.Array:
     """2x2 mean pooling (one pyramid level step).  Odd trailing row/col are
     edge-padded first so shape halving rounds up, matching zoomify."""
     h, w = img.shape
     ph, pw = h % 2, w % 2
-    img_f = jnp.asarray(img, jnp.float32)
+    img_f = jnp.asarray(img, _display_dtype())
     if ph or pw:
         img_f = jnp.pad(img_f, ((0, ph), (0, pw)), mode="edge")
     summed = lax.reduce_window(
-        img_f, 0.0, lax.add, window_dimensions=(2, 2), window_strides=(2, 2),
+        img_f, jnp.asarray(0.0, img_f.dtype), lax.add,
+        window_dimensions=(2, 2), window_strides=(2, 2),
         padding="VALID",
     )
     return summed / 4.0
@@ -39,7 +58,7 @@ def downsample_2x(img: jax.Array) -> jax.Array:
 def pyramid_levels(mosaic: jax.Array, n_levels: int | None = None) -> list[jax.Array]:
     """Full level chain, level 0 (native) first.  ``n_levels=None`` builds
     until the image fits in a single tile."""
-    levels = [jnp.asarray(mosaic, jnp.float32)]
+    levels = [jnp.asarray(mosaic, _display_dtype())]
     if n_levels is None:
         n_levels = n_pyramid_levels(*mosaic.shape)
     fn = jax.jit(downsample_2x)

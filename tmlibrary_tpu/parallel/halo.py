@@ -129,9 +129,15 @@ def sharded_pyramid_levels(
     """
     from jax.sharding import NamedSharding
 
-    from tmlibrary_tpu.ops.pyramid import downsample_2x, n_pyramid_levels
+    from tmlibrary_tpu.ops.pyramid import (
+        _display_dtype,
+        downsample_2x,
+        n_pyramid_levels,
+    )
 
-    mosaic = jnp.asarray(mosaic, jnp.float32)
+    # same display dtype as the single-device chain, or the bit-identical
+    # guarantee below breaks under compute_dtype=bfloat16
+    mosaic = jnp.asarray(mosaic, _display_dtype())
     if n_levels is None:
         n_levels = n_pyramid_levels(*mosaic.shape)
     n = mesh.devices.size
