@@ -715,3 +715,18 @@ def test_workflow_resume_replans_on_args_change(source_dir, store):
            if e.get("step") == "jterator" and e.get("event") == "batch_done"]
     assert sorted(ran) == [0, 1, 2, 3]
     assert (store.read_labels(None, "nuclei") > 0).any(axis=(1, 2)).all()
+
+
+def test_cli_workflow_resume_verb(source_dir, store):
+    """'tmx workflow resume' is the reference's resume verb: shorthand
+    for submit --resume (skips completed steps)."""
+    from tmlibrary_tpu.cli import main
+
+    desc = make_description(source_dir, store)
+    desc.save(store.workflow_dir / "workflow.yaml")
+    root = str(store.root)
+    assert main(["workflow", "submit", "--root", root]) == 0
+    events_before = len(RunLedger(store.workflow_dir / "ledger.jsonl").events())
+    assert main(["workflow", "resume", "--root", root]) == 0
+    events_after = len(RunLedger(store.workflow_dir / "ledger.jsonl").events())
+    assert events_after == events_before  # nothing re-ran

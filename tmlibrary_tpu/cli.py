@@ -82,16 +82,26 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_wf = sub.add_parser("workflow", help="full workflow orchestration")
     wf_sub = p_wf.add_subparsers(dest="verb", required=True)
-    p_submit = wf_sub.add_parser("submit", help="run the workflow")
-    _add_common(p_submit)
-    p_submit.add_argument(
+    # submit and resume (the reference's verb) share the same options and
+    # code path; resume just defaults resume=True
+    shared = argparse.ArgumentParser(add_help=False)
+    shared.add_argument(
         "--description",
         help="workflow YAML (default: the store's workflow/workflow.yaml)",
     )
+    shared.add_argument("--profile", metavar="DIR", default=None,
+                        help="write a jax.profiler device trace to DIR")
+    p_submit = wf_sub.add_parser("submit", help="run the workflow",
+                                 parents=[shared])
+    _add_common(p_submit)
     p_submit.add_argument("--resume", action="store_true",
                           help="skip work completed in a previous run")
-    p_submit.add_argument("--profile", metavar="DIR", default=None,
-                          help="write a jax.profiler device trace to DIR")
+    p_resume = wf_sub.add_parser(
+        "resume", help="shorthand for submit --resume (reference verb)",
+        parents=[shared],
+    )
+    _add_common(p_resume)
+    p_resume.set_defaults(resume=True)
     p_status = wf_sub.add_parser("status", help="per-step progress")
     _add_common(p_status)
     p_tmpl = wf_sub.add_parser(
