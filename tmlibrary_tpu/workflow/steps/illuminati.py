@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tmlibrary_tpu.errors import WorkflowError
 from tmlibrary_tpu.models.experiment import SiteRef
 from tmlibrary_tpu.models.image import IllumstatsContainer
 from tmlibrary_tpu.models.metadata import ChannelLayer
@@ -131,16 +132,37 @@ class PyramidBuilder(Step):
         else:
             levels = pyramid_levels(jnp.asarray(mosaic))
         out_dir = self.store.root / "pyramids" / f"channel{channel:02d}"
-        n_tiles = 0
-        for li, level in enumerate(levels):
-            level8 = np.asarray(to_uint8(level, float(lower), float(upper)))
-            ldir = out_dir / f"{len(levels) - 1 - li}"
-            ldir.mkdir(parents=True, exist_ok=True)
-            for (ty, tx), tile in cut_tiles(level8).items():
-                import cv2
+        # PNG encode is host-side and embarrassingly parallel; cv2 releases
+        # the GIL during imencode, so a thread pool overlaps tile encodes
+        # (the reference fanned per-level tile jobs out to the cluster)
+        import concurrent.futures as cf
+        import os as _os
 
-                cv2.imwrite(str(ldir / f"{ty}_{tx}.png"), tile)
-                n_tiles += 1
+        import cv2
+
+        workers = min(8, _os.cpu_count() or 1)
+        n_tiles = 0
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            # submit per level so only one level8 array is held at a time
+            # (cut_tiles returns views into it) — encodes overlap the next
+            # level's cut; futures are drained per level before the array
+            # is dropped
+            for li, level in enumerate(levels):
+                level8 = np.asarray(to_uint8(level, float(lower), float(upper)))
+                ldir = out_dir / f"{len(levels) - 1 - li}"
+                ldir.mkdir(parents=True, exist_ok=True)
+                futures = {
+                    pool.submit(cv2.imwrite, str(ldir / f"{ty}_{tx}.png"), tile):
+                    f"{ty}_{tx}.png"
+                    for (ty, tx), tile in cut_tiles(level8).items()
+                }
+                bad = [name for fut, name in futures.items() if not fut.result()]
+                if bad:
+                    raise WorkflowError(
+                        f"PNG tile encode failed for {len(bad)} tiles of "
+                        f"level {len(levels) - 1 - li}, e.g. {bad[0]}"
+                    )
+                n_tiles += len(futures)
         layer = ChannelLayer(
             channel=f"channel{channel:02d}",
             height=mosaic.shape[0],
