@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import logging
 import subprocess
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -28,6 +29,9 @@ _SO_CANDIDATES = (
 _SO_PATH = _NATIVE_DIR / "libtmnative.so"
 _lib = None
 _load_attempted = False
+#: first load may g++-build the library; concurrent callers (e.g. the
+#: imextract decode thread pool) must not race that build
+_load_lock = threading.Lock()
 
 
 def _build() -> bool:
@@ -48,8 +52,19 @@ def _build() -> bool:
 
 def _load():
     global _lib, _load_attempted, _SO_PATH
-    if _lib is not None or _load_attempted:
+    # fast-path ONLY on a published library: checking _load_attempted here
+    # would let callers slip past the lock mid-build and wrongly conclude
+    # the library is unavailable while another thread is still compiling it
+    if _lib is not None:
         return _lib
+    with _load_lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        return _load_locked()
+
+
+def _load_locked():
+    global _lib, _load_attempted, _SO_PATH
     _load_attempted = True
     found = next((p for p in _SO_CANDIDATES if p.exists()), None)
     if found is not None:
