@@ -158,6 +158,20 @@ def main():
     if os.path.exists(prior_path):
         with open(prior_path) as f:
             RESULTS.update(json.load(f))
+
+    # backend init is the flakiest part of the relay (it can raise seconds
+    # after a successful device probe), and JAX caches the failure for the
+    # process lifetime — so record it and exit rc=3 for the caller to retry
+    # in a fresh process, instead of stack-tracing
+    try:
+        jax.default_backend()
+    except RuntimeError as exc:
+        msg = f"{type(exc).__name__}: {exc}".splitlines()[0][:200]
+        print(f"backend init failed: {msg}")
+        RESULTS.setdefault("stage_errors", {})["backend_init"] = msg
+        write_results()
+        sys.exit(3)
+    RESULTS.get("stage_errors", {}).pop("backend_init", None)
         # stale-failure hygiene: a stage that is about to rerun must not
         # inherit its previous failure records from the committed file
         for name in ("sweep", "kernels", "glcm", "pallas_bench"):

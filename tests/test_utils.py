@@ -87,3 +87,28 @@ def test_library_config_ini_malformed_and_percent(tmp_path, monkeypatch):
     with pytest.warns(UserWarning, match="malformed config"):
         c = LibraryConfig()
     assert str(c.storage_home).endswith("tm_storage")
+
+
+def test_api_doc_is_current(tmp_path):
+    """docs/API.md is generated from the live registries; a stale file
+    means someone added a step/module/tool without regenerating.  The
+    check generates into a scratch path so the committed file is never
+    touched (a failure must stay reproducible)."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parent.parent
+    committed = (repo / "docs" / "API.md").read_text(encoding="utf-8")
+    scratch = tmp_path / "API.md"
+    out = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "gen_api_doc.py"),
+         str(scratch)],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stderr[-500:]
+    assert scratch.read_text(encoding="utf-8") == committed, (
+        "docs/API.md is stale — run: python scripts/gen_api_doc.py"
+    )
