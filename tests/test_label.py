@@ -129,3 +129,71 @@ def test_relabel_sequential():
     keep = jnp.asarray([True, False, True])
     out = np.asarray(relabel_sequential(labels, keep))
     np.testing.assert_array_equal(out, [[0, 1, 0], [2, 2, 0]])
+
+
+def test_filter_by_feature_eccentricity():
+    """Keep only elongated objects: a circle and a bar, filter on
+    eccentricity, cross-checked against skimage-style regionprops math
+    (our morphology_features golden suite)."""
+    from tmlibrary_tpu.ops.label import filter_by_feature
+    from tmlibrary_tpu.ops.measure import morphology_features
+
+    labels = np.zeros((64, 64), np.int32)
+    yy, xx = np.mgrid[0:64, 0:64]
+    labels[(yy - 16) ** 2 + (xx - 16) ** 2 <= 64] = 1  # circle
+    labels[40:44, 8:56] = 2  # 4x48 bar
+    feats = morphology_features(jnp.asarray(labels), 4)
+    ecc = np.asarray(feats["Morphology_eccentricity"])
+    assert ecc[0] < 0.5 < ecc[1]
+
+    out = np.asarray(
+        filter_by_feature(jnp.asarray(labels), "eccentricity", 4, lower=0.9)
+    )
+    assert set(np.unique(out)) == {0, 1}  # bar survives, relabeled to 1
+    assert (out[40:44, 8:56] == 1).all()
+    assert (out[(yy - 16) ** 2 + (xx - 16) ** 2 <= 64] == 0).all()
+
+    # exported column name works too; unknown feature raises
+    out2 = np.asarray(
+        filter_by_feature(
+            jnp.asarray(labels), "Morphology_eccentricity", 4, lower=0.9
+        )
+    )
+    assert np.array_equal(out, out2)
+    with pytest.raises(ValueError, match="not an on-device morphology"):
+        filter_by_feature(jnp.asarray(labels), "solidity", 4, lower=0.5)
+
+
+def test_filter_module_feature_dispatch():
+    from tmlibrary_tpu.jterator.modules import get_module
+
+    labels = np.zeros((32, 32), np.int32)
+    labels[4:8, 4:28] = 1   # thin bar, low form factor? (elongated)
+    labels[16:24, 16:24] = 2  # square
+    fn = get_module("filter")
+    out = fn(labels, feature="extent", lower_threshold=0.99, max_objects=4)
+    kept = set(np.unique(np.asarray(out["filtered_label_image"]))) - {0}
+    assert kept == {1, 2}  # both are filled rectangles, extent 1.0
+    out2 = fn(labels, feature="bbox_width", lower_threshold=10.0, max_objects=4)
+    kept2 = set(np.unique(np.asarray(out2["filtered_label_image"]))) - {0}
+    assert kept2 == {1}  # only the 24-wide bar passes
+
+
+def test_filter_area_spellings_agree_and_float_thresholds():
+    """'area' and 'Morphology_area' must produce identical results, with
+    exact float threshold semantics (no truncation)."""
+    from tmlibrary_tpu.jterator.modules import get_module
+
+    labels = np.zeros((32, 32), np.int32)
+    labels[2:12, 2:17] = 1  # 150 px
+    labels[20:30, 2:22] = 2  # 200 px
+    fn = get_module("filter")
+    a = np.asarray(fn(labels, feature="area", lower_threshold=150.5,
+                      max_objects=4)["filtered_label_image"])
+    b = np.asarray(fn(labels, feature="Morphology_area", lower_threshold=150.5,
+                      max_objects=4)["filtered_label_image"])
+    assert np.array_equal(a, b)
+    assert set(np.unique(a)) == {0, 1}  # only the 200-px object (relabeled)
+    assert (a[20:30, 2:22] == 1).all()
+    with pytest.raises(ValueError, match="lower_threshold"):
+        fn(labels, feature="area", max_objects=4)

@@ -147,16 +147,28 @@ def filter_objects(
     upper_threshold: float | None = None,
     max_objects: int = 256,
 ):
-    """Reference ``jtmodules/filter.py`` (remove objects by feature range;
-    v0 supports the 'area' feature, the overwhelmingly common use)."""
-    if feature != "area":
-        raise ValueError(f"filter feature '{feature}' not supported yet")
-    out = label_ops.filter_by_area(
-        label_image,
-        max_objects=max_objects,
-        min_area=int(lower_threshold or 0),
-        max_area=int(upper_threshold) if upper_threshold is not None else None,
-    )
+    """Reference ``jtmodules/filter.py`` — remove objects whose measured
+    feature falls outside ``[lower_threshold, upper_threshold]``; any
+    on-device morphology feature is accepted (``area``, ``eccentricity``,
+    ``form_factor``, ``extent``, ``perimeter``, axis lengths, ...)."""
+    if lower_threshold is None and upper_threshold is None:
+        raise ValueError(
+            "filter needs lower_threshold and/or upper_threshold"
+        )
+    if feature in ("area", "Morphology_area"):
+        # dedicated path (pixel counting only — no moment/perimeter math);
+        # float thresholds compare exactly like the generic path's
+        out = label_ops.filter_by_area(
+            label_image,
+            max_objects=max_objects,
+            min_area=lower_threshold if lower_threshold is not None else 0,
+            max_area=upper_threshold,
+        )
+    else:
+        out = label_ops.filter_by_feature(
+            label_image, feature, max_objects,
+            lower=lower_threshold, upper=upper_threshold,
+        )
     return {"filtered_label_image": out}
 
 

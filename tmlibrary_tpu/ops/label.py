@@ -245,8 +245,8 @@ def relabel_sequential(labels: jax.Array, keep: jax.Array) -> jax.Array:
 def filter_by_area(
     labels: jax.Array,
     max_objects: int,
-    min_area: int = 0,
-    max_area: int | None = None,
+    min_area: float = 0,
+    max_area: float | None = None,
 ) -> jax.Array:
     """Remove objects outside [min_area, max_area] (reference
     ``jtmodules/filter.main`` with the 'area' feature).
@@ -294,3 +294,45 @@ def relabel_by_scan_order(labels: jax.Array, max_labels: int) -> jax.Array:
         [jnp.zeros((1,), jnp.int32), jnp.where(present, ranks, 0)]
     )
     return mapping[jnp.clip(labels, 0, max_labels)]
+
+
+def filter_by_feature(
+    labels: jax.Array,
+    feature: str,
+    max_objects: int,
+    lower: float | None = None,
+    upper: float | None = None,
+) -> jax.Array:
+    """Remove objects whose morphology feature falls outside
+    ``[lower, upper]`` (reference ``jtmodules/filter.main`` — the
+    reference filters on any measured feature; this covers every
+    on-device morphology feature, with ``area`` staying on the cheap
+    dedicated path).
+
+    Feature names accept the bare form (``eccentricity``) or the
+    exported column name (``Morphology_eccentricity``).
+    """
+    from tmlibrary_tpu.ops.measure import morphology_features
+
+    if lower is None and upper is None:
+        raise ValueError(
+            "filter_by_feature needs at least one of lower/upper — with "
+            "neither it would be a silent no-op that still renumbers labels"
+        )
+    labels = clip_label_count(labels, max_objects)
+    name = feature if feature.startswith("Morphology_") else f"Morphology_{feature}"
+    feats = morphology_features(labels, max_objects)
+    if name not in feats:
+        raise ValueError(
+            f"filter feature '{feature}' is not an on-device morphology "
+            f"feature (available: "
+            f"{sorted(k.removeprefix('Morphology_') for k in feats)})"
+        )
+    values = feats[name]
+    present = feats["Morphology_area"] > 0
+    keep = present
+    if lower is not None:
+        keep = keep & (values >= lower)
+    if upper is not None:
+        keep = keep & (values <= upper)
+    return relabel_sequential(labels, keep)
