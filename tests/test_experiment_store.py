@@ -107,3 +107,107 @@ def test_shifts_roundtrip(tmp_path, experiment):
     np.testing.assert_array_equal(store.read_shifts(1), shifts)
     store.write_intersection({"top": 2, "bottom": 1, "left": 0, "right": 2})
     assert store.read_intersection()["top"] == 2
+
+
+def test_export_illumstats_hdf5(tmp_path):
+    """Reference-compat HDF5 export of a channel's illumination stats
+    (IllumstatsFile layout), readable back via DatasetReader."""
+    import numpy as np
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.readers import DatasetReader
+
+    exp = grid_experiment("h5", well_rows=1, well_cols=1,
+                          sites_per_well=(1, 1), channel_names=("DAPI",),
+                          site_shape=(8, 8))
+    store = ExperimentStore.create(tmp_path / "exp", exp)
+    rng = np.random.default_rng(0)
+    stats = {
+        "mean_log": rng.random((8, 8)).astype(np.float32),
+        "std_log": rng.random((8, 8)).astype(np.float32),
+        "percentile_keys": np.asarray([0.1, 50.0, 99.9], np.float32),
+        "percentile_values": np.asarray([10.0, 500.0, 4000.0], np.float32),
+        "n": np.asarray(16.0, np.float32),
+    }
+    store.write_illumstats(stats, channel=0)
+    out = tmp_path / "stats.h5"
+    store.export_illumstats_hdf5(out, channel=0)
+    with DatasetReader(out) as r:
+        np.testing.assert_array_equal(r.read("stats/mean"), stats["mean_log"])
+        np.testing.assert_array_equal(r.read("stats/std"), stats["std_log"])
+        np.testing.assert_array_equal(
+            r.read("stats/percentiles/keys"), stats["percentile_keys"]
+        )
+        assert float(np.asarray(r.read("stats/n"))) == 16.0
+
+
+def test_export_illumstats_hdf5_snapshots_and_validates(tmp_path):
+    """Re-export replaces the file wholesale (no stale datasets), and a
+    stats dict without 'n' fails instead of fabricating a sample count."""
+    import numpy as np
+    import pytest
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.readers import DatasetReader
+
+    exp = grid_experiment("h5b", well_rows=1, well_cols=1,
+                          sites_per_well=(1, 1), channel_names=("DAPI",),
+                          site_shape=(8, 8))
+    store = ExperimentStore.create(tmp_path / "exp", exp)
+    base = {
+        "mean_log": np.zeros((8, 8), np.float32),
+        "std_log": np.ones((8, 8), np.float32),
+        "n": np.asarray(4.0, np.float32),
+    }
+    out = tmp_path / "stats.h5"
+    store.write_illumstats(
+        {**base,
+         "percentile_keys": np.asarray([50.0], np.float32),
+         "percentile_values": np.asarray([100.0], np.float32)},
+        channel=0,
+    )
+    store.export_illumstats_hdf5(out, channel=0)
+    # second export WITHOUT percentiles must not leave the old ones behind
+    store.write_illumstats(base, channel=0)
+    store.export_illumstats_hdf5(out, channel=0)
+    import h5py
+
+    with h5py.File(out, "r") as f:
+        assert "stats/percentiles" not in f
+        assert float(f["stats/n"][()]) == 4.0
+
+    # missing 'n': validated BEFORE touching the file — the previous
+    # good export survives intact
+    store.write_illumstats({k: v for k, v in base.items() if k != "n"},
+                           channel=0)
+    from tmlibrary_tpu.errors import StoreError
+
+    with pytest.raises(StoreError, match="required fields"):
+        store.export_illumstats_hdf5(out, channel=0)
+    with h5py.File(out, "r") as f:
+        assert float(f["stats/n"][()]) == 4.0  # untouched
+
+
+def test_cli_export_illumstats(tmp_path):
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    exp = grid_experiment("h5c", well_rows=1, well_cols=1,
+                          sites_per_well=(1, 1), channel_names=("DAPI",),
+                          site_shape=(8, 8))
+    store = ExperimentStore.create(tmp_path / "exp", exp)
+    store.write_illumstats({
+        "mean_log": np.zeros((8, 8), np.float32),
+        "std_log": np.ones((8, 8), np.float32),
+        "n": np.asarray(1.0, np.float32),
+    }, channel=0)
+    out = tmp_path / "s.h5"
+    assert main(["export", "--root", str(store.root),
+                 "--illumstats", "0", "--out", str(out)]) == 0
+    assert out.exists()
+    # neither --objects nor --illumstats is an error
+    assert main(["export", "--root", str(store.root),
+                 "--out", str(tmp_path / "x.csv")]) == 1

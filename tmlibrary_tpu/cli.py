@@ -63,10 +63,20 @@ def build_parser() -> argparse.ArgumentParser:
                             "whole-step run log")
 
     p_export = sub.add_parser(
-        "export", help="export an object type's combined feature table"
+        "export", help="export feature tables / polygons / illumination stats"
     )
     _add_common(p_export)
-    p_export.add_argument("--objects", required=True, help="object type name")
+    p_export.add_argument("--objects", default=None, help="object type name")
+    p_export.add_argument(
+        "--illumstats", type=int, default=None, metavar="CHANNEL",
+        help="instead of a feature table, write this channel's illumination "
+             "statistics as an HDF5 file with the reference IllumstatsFile "
+             "layout (mutually exclusive with --objects)",
+    )
+    p_export.add_argument(
+        "--cycle", type=int, default=0,
+        help="acquisition cycle for --illumstats (default 0)",
+    )
     p_export.add_argument("--out", required=True, help="output file path")
     p_export.add_argument(
         "--format", choices=("csv", "parquet", "geojson"), default=None,
@@ -372,9 +382,25 @@ def cmd_export(args) -> int:
     """
     store = _open_store(args)
     out = Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    if args.illumstats is not None and args.objects is not None:
+        print("error: --objects and --illumstats are mutually exclusive",
+              file=sys.stderr)
+        return 1
+    if args.illumstats is not None:
+        store.export_illumstats_hdf5(
+            out, cycle=args.cycle, channel=args.illumstats
+        )
+        print(f"wrote cycle {args.cycle} channel {args.illumstats} "
+              f"illumination statistics (reference IllumstatsFile layout) "
+              f"to {out}")
+        return 0
+    if args.objects is None:
+        print("error: pass --objects NAME (feature/polygon export) or "
+              "--illumstats CHANNEL", file=sys.stderr)
+        return 1
     suffix_fmt = {".csv": "csv", ".geojson": "geojson", ".json": "geojson"}
     fmt = args.format or suffix_fmt.get(out.suffix.lower(), "parquet")
-    out.parent.mkdir(parents=True, exist_ok=True)
     if fmt == "geojson":
         # reference parity: tmserver serves MapobjectSegmentation polygons
         # as GeoJSON FeatureCollections for the viewer

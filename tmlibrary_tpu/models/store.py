@@ -221,6 +221,39 @@ class ExperimentStore:
     def has_illumstats(self, cycle: int = 0, channel: int = 0) -> bool:
         return self._illumstats_path(cycle, channel).exists()
 
+    def export_illumstats_hdf5(
+        self, path, cycle: int = 0, channel: int = 0
+    ) -> None:
+        """Write a channel's illumination statistics as an HDF5 file with
+        the reference's ``IllumstatsFile`` layout (``tmlib/models/file.py``
+        row: mean/std images in the log10 correction domain plus the
+        percentile table) so downstream tooling written against the
+        reference's stats files keeps working."""
+        from tmlibrary_tpu.writers import DatasetWriter
+
+        stats = self.read_illumstats(cycle=cycle, channel=channel)
+        missing = {"mean_log", "std_log", "n"} - set(stats)
+        if missing:
+            raise StoreError(
+                f"illumination statistics for cycle {cycle} channel "
+                f"{channel} lack required fields {sorted(missing)}"
+            )
+        # an export is a snapshot: write a fresh temp file and rename over
+        # the target, so stale datasets from an earlier export can't
+        # survive (DatasetWriter appends) and a failure mid-write can't
+        # destroy a previous good export
+        path = Path(path)
+        tmp = path.with_name(path.name + ".tmp")
+        tmp.unlink(missing_ok=True)
+        with DatasetWriter(tmp) as w:
+            w.write("stats/mean", stats["mean_log"])
+            w.write("stats/std", stats["std_log"])
+            w.write("stats/n", stats["n"], compression=None)
+            if "percentile_keys" in stats and "percentile_values" in stats:
+                w.write("stats/percentiles/keys", stats["percentile_keys"])
+                w.write("stats/percentiles/values", stats["percentile_values"])
+        tmp.replace(path)
+
     # --------------------------------------------------------- segmentations
     def _labels_path(self, objects_name: str, tpoint: int, zplane: int) -> Path:
         return (
