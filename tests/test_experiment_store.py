@@ -211,3 +211,98 @@ def test_cli_export_illumstats(tmp_path):
     # neither --objects nor --illumstats is an error
     assert main(["export", "--root", str(store.root),
                  "--out", str(tmp_path / "x.csv")]) == 1
+
+
+def test_cli_export_images_roundtrip(tmp_path):
+    """tmx export --images writes uint16 TIFFs whose names re-ingest
+    through the default filename handler; --correct/--align apply the
+    stored preprocessing."""
+    import cv2
+
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    exp = grid_experiment("imgs", well_rows=1, well_cols=2,
+                          sites_per_well=(1, 2), channel_names=("DAPI",),
+                          site_shape=(16, 16))
+    store = ExperimentStore.create(tmp_path / "exp", exp)
+    rng = np.random.default_rng(0)
+    pixels = rng.integers(100, 5000, (4, 16, 16)).astype(np.uint16)
+    store.write_sites(pixels, list(range(4)), channel=0)
+
+    out = tmp_path / "export"
+    assert main(["export", "--root", str(store.root), "--images", "0",
+                 "--out", str(out)]) == 0
+    names = sorted(p.name for p in out.glob("*.tif"))
+    assert names == ["A01_s0_DAPI.tif", "A01_s1_DAPI.tif",
+                     "A02_s0_DAPI.tif", "A02_s1_DAPI.tif"]
+    got = cv2.imread(str(out / "A01_s0_DAPI.tif"), cv2.IMREAD_UNCHANGED)
+    np.testing.assert_array_equal(got, pixels[0])
+
+    # --align applies the stored correction roll
+    store.write_shifts(np.tile([[2, 0]], (4, 1)).astype(np.int32), cycle=0)
+    out2 = tmp_path / "aligned"
+    assert main(["export", "--root", str(store.root), "--images", "0",
+                 "--align", "--out", str(out2)]) == 0
+    got2 = cv2.imread(str(out2 / "A01_s0_DAPI.tif"), cv2.IMREAD_UNCHANGED)
+    np.testing.assert_array_equal(got2[2:], pixels[0][:-2])
+    assert (got2[:2] == 0).all()
+
+    # mutually exclusive modes
+    assert main(["export", "--root", str(store.root), "--images", "0",
+                 "--illumstats", "0", "--out", str(out)]) == 1
+    # --correct without corilla stats is an error
+    assert main(["export", "--root", str(store.root), "--images", "0",
+                 "--correct", "--out", str(out)]) == 1
+
+
+def test_cli_export_images_multi_z_and_reingest(tmp_path):
+    """Multi-zplane exports write t/z-tokenized names that re-ingest
+    through the default filename handler into an equivalent store."""
+    import cv2
+
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment("z", well_rows=1, well_cols=1,
+                          sites_per_well=(1, 2), channel_names=("DAPI",),
+                          site_shape=(8, 8), n_zplanes=2)
+    store = ExperimentStore.create(tmp_path / "exp", exp)
+    rng = np.random.default_rng(1)
+    planes = {z: rng.integers(0, 5000, (2, 8, 8)).astype(np.uint16)
+              for z in range(2)}
+    for z, px in planes.items():
+        store.write_sites(px, [0, 1], channel=0, zplane=z)
+
+    out = tmp_path / "export"
+    assert main(["export", "--root", str(store.root), "--images", "0",
+                 "--out", str(out)]) == 0
+    names = sorted(p.name for p in out.glob("*.tif"))
+    assert names == ["A01_s0_z0_DAPI.tif", "A01_s0_z1_DAPI.tif",
+                     "A01_s1_z0_DAPI.tif", "A01_s1_z1_DAPI.tif"]
+
+    # round trip: metaconfig+imextract over the exported tree
+    store2 = ExperimentStore.create(
+        tmp_path / "exp2",
+        grid_experiment("z2", well_rows=1, well_cols=1,
+                        sites_per_well=(1, 1), channel_names=("X",),
+                        site_shape=(1, 1)),
+    )
+    mc = get_step("metaconfig")(store2)
+    mc.init({"source_dir": str(out)})
+    for i in mc.list_batches():
+        mc.run(i)
+    mc.collect()
+    ie = get_step("imextract")(store2)
+    ie.init({})
+    for i in ie.list_batches():
+        ie.run(i)
+    exp2 = ExperimentStore.open(store2.root).experiment
+    assert exp2.n_zplanes == 2 and exp2.n_sites == 2
+    for z in range(2):
+        np.testing.assert_array_equal(
+            store2.read_sites([0, 1], channel=0, zplane=z), planes[z]
+        )

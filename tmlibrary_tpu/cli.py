@@ -75,7 +75,21 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_export.add_argument(
         "--cycle", type=int, default=0,
-        help="acquisition cycle for --illumstats (default 0)",
+        help="acquisition cycle for --illumstats/--images (default 0)",
+    )
+    p_export.add_argument(
+        "--images", type=int, default=None, metavar="CHANNEL",
+        help="instead of a feature table, write this channel's site images "
+             "as uint16 TIFFs into --out (a directory), named with the "
+             "canonical <well>_s<site>_... pattern",
+    )
+    p_export.add_argument(
+        "--correct", action="store_true",
+        help="--images only: apply illumination correction (corilla stats)",
+    )
+    p_export.add_argument(
+        "--align", action="store_true",
+        help="--images only: apply cycle alignment shifts + intersection crop",
     )
     p_export.add_argument("--out", required=True, help="output file path")
     p_export.add_argument(
@@ -372,6 +386,113 @@ def cmd_log(args) -> int:
     return 0
 
 
+def _export_images(store: ExperimentStore, args, out: Path) -> int:
+    """Write one channel's (optionally corrected/aligned) site planes as
+    uint16 TIFFs — the road OUT of the store (reference parity: tmserver's
+    original/corrected image download endpoints).  Every tpoint/zplane is
+    exported; names use the default filename handler's grammar
+    (``[<plate>_]<well>_s<site>[_t<t>][_z<z>]_<channel>.tif``) so the
+    exported tree re-ingests as-is."""
+    import re as _re
+
+    import cv2
+    import jax
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.errors import StoreError
+    from tmlibrary_tpu.models.experiment import Well
+    from tmlibrary_tpu.models.image import IllumstatsContainer
+    from tmlibrary_tpu.ops import image_ops
+
+    channel, cycle = args.images, args.cycle
+    exp = store.experiment
+    # the default ingest pattern accepts [A-Za-z0-9-] channel tokens only
+    ch_name = _re.sub(r"[^A-Za-z0-9\-]", "-", exp.channels[channel].name)
+    out.mkdir(parents=True, exist_ok=True)
+
+    stats = None
+    if args.correct:
+        if not store.has_illumstats(cycle=cycle, channel=channel):
+            print("error: --correct requested but corilla stats are missing "
+                  f"for cycle {cycle} channel {channel}", file=sys.stderr)
+            return 1
+        stats = IllumstatsContainer.from_store(
+            store.read_illumstats(cycle=cycle, channel=channel)
+        )
+    shifts = None
+    window = (0, 0, 0, 0)
+    if args.align:
+        if not store.has_shifts(cycle):
+            print(f"error: --align requested but no shifts stored for cycle "
+                  f"{cycle} (run the align step)", file=sys.stderr)
+            return 1
+        shifts = store.read_shifts(cycle)
+        try:
+            w = store.read_intersection()
+            window = (w["top"], w["bottom"], w["left"], w["right"])
+        except StoreError:
+            pass  # align ran but no intersection stored: shift-only
+
+    def prep(imgs, shs):
+        def one(img, sh):
+            img = jnp.asarray(img, jnp.float32)
+            if stats is not None:
+                img = image_ops.correct_illumination(
+                    img, stats.mean_log, stats.std_log
+                )
+            if shifts is not None:
+                img = image_ops.align(
+                    img, sh[0], sh[1], window if any(window) else None
+                )
+            return img
+
+        return jax.vmap(one)(imgs, shs)
+
+    prep = jax.jit(prep)
+
+    # site index within the well (row-major over the well grid) so the
+    # exported names round-trip through the default filename handler
+    spw_x = max((r.site_x for r in exp.sites()), default=0) + 1
+    refs = list(exp.sites())
+    multi_plate = len(exp.plates) > 1
+    shift_table = (shifts if shifts is not None
+                   else np.zeros((len(refs), 2), np.int32))
+
+    from tmlibrary_tpu.utils import create_partitions
+
+    n = 0
+    for tpoint in range(exp.n_tpoints):
+        for zplane in range(exp.n_zplanes):
+            for part in create_partitions(list(range(len(refs))), 32):
+                stack = store.read_sites(
+                    part, cycle=cycle, channel=channel,
+                    tpoint=tpoint, zplane=zplane,
+                )
+                prepped = np.asarray(
+                    prep(jnp.asarray(stack), jnp.asarray(shift_table[part]))
+                )
+                for b, idx in enumerate(part):
+                    ref = refs[idx]
+                    arr = np.clip(prepped[b], 0, 65535).astype(np.uint16)
+                    well = Well(row=ref.well_row, column=ref.well_column,
+                                sites=())
+                    name = f"{well.name}_s{ref.site_y * spw_x + ref.site_x:d}"
+                    if multi_plate:
+                        name = f"{ref.plate}_{name}"
+                    if exp.n_tpoints > 1:
+                        name += f"_t{tpoint:d}"
+                    if exp.n_zplanes > 1:
+                        name += f"_z{zplane:d}"
+                    name += f"_{ch_name}.tif"
+                    if not cv2.imwrite(str(out / name), arr):
+                        print(f"error: failed writing {out / name}",
+                              file=sys.stderr)
+                        return 1
+                    n += 1
+    print(f"wrote {n} {ch_name} site images to {out}")
+    return 0
+
+
 def cmd_export(args) -> int:
     """Combined per-object feature table → one CSV/Parquet file.
 
@@ -383,10 +504,15 @@ def cmd_export(args) -> int:
     store = _open_store(args)
     out = Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)
-    if args.illumstats is not None and args.objects is not None:
-        print("error: --objects and --illumstats are mutually exclusive",
+    modes = [m for m, v in (("--objects", args.objects),
+                            ("--illumstats", args.illumstats),
+                            ("--images", args.images)) if v is not None]
+    if len(modes) > 1:
+        print(f"error: {' and '.join(modes)} are mutually exclusive",
               file=sys.stderr)
         return 1
+    if args.images is not None:
+        return _export_images(store, args, out)
     if args.illumstats is not None:
         store.export_illumstats_hdf5(
             out, cycle=args.cycle, channel=args.illumstats
