@@ -536,6 +536,24 @@ def test_cli_export_geojson(source_dir, store, tmp_path):
     n_simp = sum(len(f["geometry"]["coordinates"][0]) for f in doc2["features"])
     assert n_simp < n_full
 
+    # --join-features attaches measurement columns by (site, label)
+    out3 = tmp_path / "nuclei_joined.geojson"
+    assert main(["export", "--root", str(store.root), "--objects", "nuclei",
+                 "--out", str(out3),
+                 "--join-features", "Intensity_mean_DAPI"]) == 0
+    doc3 = json.loads(out3.read_text())
+    vals = [f["properties"]["Intensity_mean_DAPI"] for f in doc3["features"]]
+    assert all(isinstance(v, float) and v > 0 for v in vals)
+    feats_table = store.read_features("nuclei")
+    f0 = doc3["features"][0]["properties"]
+    row = feats_table[(feats_table["site_index"] == f0["site"])
+                      & (feats_table["label"] == f0["label"])]
+    assert np.isclose(float(row["Intensity_mean_DAPI"].iloc[0]),
+                      f0["Intensity_mean_DAPI"])
+    # unknown column is a clean error
+    assert main(["export", "--root", str(store.root), "--objects", "nuclei",
+                 "--out", str(out3), "--join-features", "nope"]) == 1
+
 
 def test_cli_args_schema(capsys):
     """tmx <step> args prints the argument schema (reference: the args

@@ -98,6 +98,13 @@ def build_parser() -> argparse.ArgumentParser:
              "traced object polygons (run jterator with --as-polygons)",
     )
     p_export.add_argument(
+        "--join-features", default=None, metavar="COL[,COL...]",
+        help="geojson only: join these measurement columns onto each "
+             "polygon's properties by (site, label) — viewer-ready colored "
+             "overlays (reference: tmserver joins FeatureValues onto "
+             "mapobjects)",
+    )
+    p_export.add_argument(
         "--simplify", type=float, default=0.0, metavar="TOL",
         help="geojson only: Douglas-Peucker-simplify polygon rings to this "
              "perpendicular-distance tolerance in pixels (reference: PostGIS "
@@ -396,7 +403,6 @@ def _export_images(store: ExperimentStore, args, out: Path) -> int:
     import re as _re
 
     import cv2
-    import jax
     import jax.numpy as jnp
 
     from tmlibrary_tpu.errors import StoreError
@@ -406,8 +412,12 @@ def _export_images(store: ExperimentStore, args, out: Path) -> int:
 
     channel, cycle = args.images, args.cycle
     exp = store.experiment
-    # the default ingest pattern accepts [A-Za-z0-9-] channel tokens only
+    # the default ingest pattern accepts [A-Za-z0-9-] channel tokens and
+    # [A-Za-z0-9] plate tokens only — sanitize both or the documented
+    # re-ingest round-trip breaks on vendor names with '_'/'-'/spaces
     ch_name = _re.sub(r"[^A-Za-z0-9\-]", "-", exp.channels[channel].name)
+    plate_token = {p.name: _re.sub(r"[^A-Za-z0-9]", "", p.name) or "plate"
+                   for p in exp.plates}
     out.mkdir(parents=True, exist_ok=True)
 
     stats = None
@@ -433,22 +443,10 @@ def _export_images(store: ExperimentStore, args, out: Path) -> int:
         except StoreError:
             pass  # align ran but no intersection stored: shift-only
 
-    def prep(imgs, shs):
-        def one(img, sh):
-            img = jnp.asarray(img, jnp.float32)
-            if stats is not None:
-                img = image_ops.correct_illumination(
-                    img, stats.mean_log, stats.std_log
-                )
-            if shifts is not None:
-                img = image_ops.align(
-                    img, sh[0], sh[1], window if any(window) else None
-                )
-            return img
-
-        return jax.vmap(one)(imgs, shs)
-
-    prep = jax.jit(prep)
+    prep = image_ops.make_batch_prep(
+        stats, apply_shift=shifts is not None,
+        window=window if any(window) else None,
+    )
 
     # site index within the well (row-major over the well grid) so the
     # exported names round-trip through the default filename handler
@@ -478,7 +476,7 @@ def _export_images(store: ExperimentStore, args, out: Path) -> int:
                                 sites=())
                     name = f"{well.name}_s{ref.site_y * spw_x + ref.site_x:d}"
                     if multi_plate:
-                        name = f"{ref.plate}_{name}"
+                        name = f"{plate_token[ref.plate]}_{name}"
                     if exp.n_tpoints > 1:
                         name += f"_t{tpoint:d}"
                     if exp.n_zplanes > 1:
@@ -542,6 +540,34 @@ def cmd_export(args) -> int:
             )
             return 1
         table = pd.concat([pd.read_parquet(p) for p in shards], ignore_index=True)
+        if args.join_features:
+            # join selected measurement columns onto the polygons by
+            # (site, label) — reference parity: tmserver joins
+            # FeatureValues / tool LabelLayers onto mapobjects for the
+            # viewer's colored overlays
+            wanted = [c.strip() for c in args.join_features.split(",") if c.strip()]
+            keys = {"label", "site_index", "site"}
+            if keys & set(wanted):
+                print(f"error: --join-features cannot include the join keys "
+                      f"{sorted(keys & set(wanted))}", file=sys.stderr)
+                return 1
+            feats = store.read_features(args.objects)
+            missing = [c for c in wanted if c not in feats.columns]
+            if missing:
+                print(f"error: --join-features columns not in the feature "
+                      f"table: {missing} (available: "
+                      f"{sorted(set(feats.columns) - {'label'})[:20]}...)",
+                      file=sys.stderr)
+                return 1
+            join = feats[["site_index", "label", *wanted]].rename(
+                columns={"site_index": "site"}
+            )
+            table = table.merge(join, on=["site", "label"], how="left")
+            # polygons with no feature row would serialize as bare NaN
+            # (invalid JSON); emit null instead
+            table[wanted] = table[wanted].astype(object).where(
+                pd.notna(table[wanted]), None
+            )
         from tmlibrary_tpu import native
 
         features = []

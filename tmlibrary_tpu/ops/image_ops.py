@@ -129,3 +129,28 @@ def join_grid(tiles: jax.Array, grid_rows: int, grid_cols: int) -> jax.Array:
         .transpose(0, 2, 1, 3)
         .reshape(grid_rows * h, grid_cols * w)
     )
+
+
+def make_batch_prep(stats=None, apply_shift: bool = False,
+                    window: tuple[int, int, int, int] | None = None):
+    """One jitted, vmapped site-preprocessing function: optional
+    illumination correction (corilla ``stats`` container), optional
+    per-site shift, optional intersection crop.
+
+    The single implementation behind the illuminati mosaic prep and the
+    image exporter (jterator's multi-channel preprocess composes the same
+    ops per channel inside its fused program)."""
+    import jax
+
+    def prep(stack, shifts):
+        def one(img, shift):
+            out = jnp.asarray(img, jnp.float32)
+            if stats is not None:
+                out = correct_illumination(out, stats.mean_log, stats.std_log)
+            if apply_shift:
+                out = align(out, shift[0], shift[1], window)
+            return out
+
+        return jax.vmap(one)(stack, shifts)
+
+    return jax.jit(prep)

@@ -74,19 +74,7 @@ class PyramidBuilder(Step):
         else:
             upper = lower = None
 
-        @jax.jit
-        def prep(stack, shifts):
-            def one(img, shift):
-                out = jnp.asarray(img, jnp.float32)
-                if stats is not None:
-                    out = image_ops.correct_illumination(
-                        out, stats.mean_log, stats.std_log
-                    )
-                if args["align"]:
-                    out = image_ops.shift_image(out, shift[0], shift[1])
-                return out
-
-            return jax.vmap(one)(stack, shifts)
+        prep = image_ops.make_batch_prep(stats, apply_shift=args["align"])
 
         # site grid geometry (shared helper — same layout as the static
         # outlines and the pyramid-depth computation)
