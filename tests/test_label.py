@@ -197,3 +197,19 @@ def test_filter_area_spellings_agree_and_float_thresholds():
     assert (a[20:30, 2:22] == 1).all()
     with pytest.raises(ValueError, match="lower_threshold"):
         fn(labels, feature="area", max_objects=4)
+
+
+@pytest.mark.parametrize("density", [0.3, 0.5, 0.7])
+def test_label_random_noise_percolation_bitwise(density):
+    """Pure-noise masks near the percolation threshold produce the most
+    serpentine components — the worst case for the iterative scan
+    labeler. Multiple seeds, both connectivities, bit-identical to
+    scipy."""
+    for seed in range(2):
+        mask = np.random.default_rng(seed).random((64, 64)) < density
+        for conn in (4, 8):
+            struct = ndi.generate_binary_structure(2, 1 if conn == 4 else 2)
+            want, n_want = ndi.label(mask, struct)
+            got, n_got = connected_components(jnp.asarray(mask), conn)
+            assert int(n_got) == n_want, (density, seed, conn)
+            np.testing.assert_array_equal(np.asarray(got), want)
