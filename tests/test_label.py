@@ -199,12 +199,12 @@ def test_filter_area_spellings_agree_and_float_thresholds():
         fn(labels, feature="area", max_objects=4)
 
 
-@pytest.mark.parametrize("density", [0.3, 0.5, 0.7])
+@pytest.mark.parametrize("density", [0.59])
 def test_label_random_noise_percolation_bitwise(density):
-    """Pure-noise masks near the percolation threshold produce the most
-    serpentine components — the worst case for the iterative scan
-    labeler. Multiple seeds, both connectivities, bit-identical to
-    scipy."""
+    """Pure-noise masks AT the site-percolation threshold (p_c ~ 0.59)
+    produce the most serpentine components — the worst convergence case
+    for the iterative scan labeler. Bit-identical to scipy for both
+    connectivities."""
     for seed in range(2):
         mask = np.random.default_rng(seed).random((64, 64)) < density
         for conn in (4, 8):
