@@ -748,3 +748,35 @@ def test_cli_workflow_resume_verb(source_dir, store):
     assert main(["workflow", "resume", "--root", root]) == 0
     events_after = len(RunLedger(store.workflow_dir / "ledger.jsonl").events())
     assert events_after == events_before  # nothing re-ran
+
+
+def test_cli_workflow_cleanup(source_dir, store):
+    """workflow cleanup wipes every step's outputs, plans and the ledger;
+    a fresh submit afterwards rebuilds everything."""
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    desc.save(store.workflow_dir / "workflow.yaml")
+    root = str(store.root)
+    assert main(["workflow", "submit", "--root", root]) == 0
+    store = ExperimentStore.open(store.root)  # CLI refreshed the manifest
+    assert store.read_labels(None, "nuclei").max() > 0
+
+    assert main(["workflow", "cleanup", "--root", root]) == 0
+    assert not (store.workflow_dir / "ledger.jsonl").exists()
+    assert get_step("jterator")(store).list_batches() == []
+    from tmlibrary_tpu.errors import StoreError
+    from tmlibrary_tpu.models.mapobject import MapobjectTypeRegistry
+    from tmlibrary_tpu.workflow.steps.metaconfig import MetadataConfigurator
+
+    with pytest.raises(StoreError):
+        store.read_labels(None, "nuclei")
+    # metaconfig's persisted mapping and the mapobject registrations are
+    # gone too — nothing advertises artifacts that no longer exist
+    mc = get_step("metaconfig")(store)
+    assert not (mc.step_dir / MetadataConfigurator.MAPPING_FILE).exists()
+    assert MapobjectTypeRegistry(store.root).names() == []
+
+    assert main(["workflow", "submit", "--root", root]) == 0
+    assert store.read_labels(None, "nuclei").max() > 0

@@ -135,6 +135,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_resume.set_defaults(resume=True)
     p_status = wf_sub.add_parser("status", help="per-step progress")
     _add_common(p_status)
+    p_clean = wf_sub.add_parser(
+        "cleanup", help="remove every step's outputs, batch plans and the "
+                        "run ledger (reference cleanup verb, workflow-wide)"
+    )
+    _add_common(p_clean)
     p_tmpl = wf_sub.add_parser(
         "template", help="write a typed skeleton workflow.yaml"
     )
@@ -206,6 +211,14 @@ def _open_store(args) -> ExperimentStore:
     return ExperimentStore.open(Path(args.root))
 
 
+def _cleanup_step(step) -> None:
+    """One step's cleanup recipe (shared by the per-step verb and
+    workflow-wide cleanup): outputs + batch plans."""
+    step.delete_previous_output()
+    for p in step.step_dir.glob("batch_*.json"):
+        p.unlink()
+
+
 def cmd_create(args) -> int:
     root = Path(args.root)
     if (root / ExperimentStore.MANIFEST).exists():
@@ -235,6 +248,21 @@ def cmd_workflow(args) -> int:
             if entry.get("error"):
                 line += f" error: {entry['error']}"
             print(line)
+        return 0
+    if args.verb == "cleanup":
+        from tmlibrary_tpu.models.mapobject import MapobjectTypeRegistry
+
+        for name in list_steps():
+            _cleanup_step(get_step(name)(store))
+        # the registry would otherwise advertise object types whose
+        # label/feature artifacts were just removed
+        registry = MapobjectTypeRegistry(store.root)
+        for name in registry.names():
+            registry.delete(name)
+        ledger_path = store.workflow_dir / "ledger.jsonl"
+        ledger_path.unlink(missing_ok=True)
+        print("removed all step outputs, batch plans, mapobject "
+              "registrations and the run ledger")
         return 0
     if args.verb == "template":
         out = store.workflow_dir / "workflow.yaml"
@@ -367,9 +395,7 @@ def cmd_step(args) -> int:
         return 0
     if args.verb == "cleanup":
         # reference `cleanup` verb: idempotent removal of step outputs
-        step.delete_previous_output()
-        for p in step.step_dir.glob("batch_*.json"):
-            p.unlink()
+        _cleanup_step(step)
         print(f"{args.command}: outputs removed")
         return 0
     return 1

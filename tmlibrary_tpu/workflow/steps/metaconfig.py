@@ -135,6 +135,12 @@ class MetadataConfigurator(Step):
 
     MAPPING_FILE = "file_mapping.json"
 
+    def delete_previous_output(self) -> None:
+        # the persisted file mapping and merged OME-XML, or a later
+        # imextract would silently extract against a stale mapping
+        for name in (self.MAPPING_FILE, "experiment.ome.xml"):
+            (self.step_dir / name).unlink(missing_ok=True)
+
     def create_batches(self, args):
         # metadata configuration is one unit of host work
         return [{"source_dir": args["source_dir"]}]
