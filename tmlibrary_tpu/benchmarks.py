@@ -5,6 +5,11 @@ segment+measure pipeline — ``segment_primary`` (nuclei from DAPI) →
 ``segment_secondary`` (cells grown from nuclei through the actin channel) →
 ``measure_intensity`` on both channels.  The benchmark metric is
 sites/sec/chip (reference: jterator's per-site job throughput).
+
+The other ``BENCH_CONFIG`` values cover the rest of the BASELINE ladder:
+``4`` (5-channel full feature stack), ``volume`` (3-D z-stack pipeline,
+config 5 stretch) and ``corilla`` (illumination statistics, channels/sec
+— the reference's second headline metric).
 """
 
 from __future__ import annotations
