@@ -169,3 +169,29 @@ def test_channel_layer_grid_odd_sizes_match_pyramid_levels():
         rows = max(t[0] for t in tiles) + 1
         cols = max(t[1] for t in tiles) + 1
         assert layer.grid(zoom) == (rows, cols), (zoom, lvl.shape)
+
+
+def test_production_scale_manifest_planning():
+    """A full 384-well / 6-site / 5-channel plate's metadata path
+    (manifest build, JSON round trip, site enumeration, batch planning)
+    stays trivially fast — guards against quadratic blowups as the
+    models grow."""
+    import time
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.utils import create_partitions
+
+    t0 = time.perf_counter()
+    exp = grid_experiment(
+        "big", well_rows=16, well_cols=24, sites_per_well=(2, 3),
+        channel_names=("DAPI", "Actin", "Tubulin", "ER", "Mito"),
+        site_shape=(2160, 2560),
+    )
+    assert exp.n_sites == 384 * 6
+    exp2 = type(exp).from_dict(exp.to_dict())
+    assert exp2 == exp
+    refs = list(exp.sites())
+    assert len(refs) == 2304
+    assert len(create_partitions(list(range(exp.n_sites)), 64)) == 36
+    # whole path is milliseconds; 5 s leaves two orders of headroom
+    assert time.perf_counter() - t0 < 5.0
