@@ -182,3 +182,47 @@ def test_watershed_flood_seeds_kept(rng):
     # labels only appear inside the (mask | seeds) region
     m = np.asarray(mask) | (s > 0)
     assert (out[~m] == 0).all()
+
+
+def test_pallas_enabled_resolution_order(monkeypatch):
+    """Dispatch resolution: env override beats the committed tuning
+    verdict beats off; CPU/GPU backends never use pallas."""
+    from tmlibrary_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk.jax, "default_backend", lambda: "tpu")
+    pk._tuning_results.cache_clear()
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {"pallas_wins": True})
+    monkeypatch.delenv("TMX_PALLAS", raising=False)
+    assert pk.pallas_enabled() is True
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {"pallas_wins": False})
+    assert pk.pallas_enabled() is False
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {})
+    assert pk.pallas_enabled() is False  # no verdict -> off
+    monkeypatch.setenv("TMX_PALLAS", "1")
+    assert pk.pallas_enabled() is True  # env beats everything
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {"pallas_wins": True})
+    monkeypatch.setenv("TMX_PALLAS", "0")
+    assert pk.pallas_enabled() is False
+    # non-TPU backends: always the XLA twins
+    monkeypatch.setattr(pk.jax, "default_backend", lambda: "cpu")
+    monkeypatch.setenv("TMX_PALLAS", "1")
+    assert pk.pallas_enabled() is False
+
+
+def test_glcm_method_resolution(monkeypatch):
+    """GLCM accumulation: scatter on CPU, tuning verdict on TPU (matmul
+    when absent), matmul elsewhere."""
+    import tmlibrary_tpu.ops.measure as measure
+    from tmlibrary_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(measure.jax, "default_backend", lambda: "cpu")
+    assert measure._resolve_glcm_method("auto") == "scatter"
+    assert measure._resolve_glcm_method("matmul") == "matmul"
+
+    monkeypatch.setattr(measure.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {"glcm_matmul_wins": False})
+    assert measure._resolve_glcm_method("auto") == "scatter"
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {"glcm_matmul_wins": True})
+    assert measure._resolve_glcm_method("auto") == "matmul"
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {})
+    assert measure._resolve_glcm_method("auto") == "matmul"  # untuned default
