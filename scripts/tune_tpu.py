@@ -172,21 +172,21 @@ def main():
         write_results()
         sys.exit(3)
     RESULTS.get("stage_errors", {}).pop("backend_init", None)
-        # stale-failure hygiene: a stage that is about to rerun must not
-        # inherit its previous failure records from the committed file
-        for name in ("sweep", "kernels", "glcm", "pallas_bench"):
-            if name not in skip:
-                RESULTS.get("stage_errors", {}).pop(name, None)
-        # kernel_errors entries belong to the kernels stage (cc_/ws_/dt_*)
-        # or the glcm stage (glcm_*) — keep only the skipped stage's
-        keep = {
-            k: v for k, v in RESULTS.pop("kernel_errors", {}).items()
-            if ("glcm" if k.startswith("glcm") else "kernels") in skip
-        }
-        if keep:
-            RESULTS["kernel_errors"] = keep
-        if not RESULTS.get("stage_errors"):
-            RESULTS.pop("stage_errors", None)
+    # stale-failure hygiene: a stage that is about to rerun must not
+    # inherit its previous failure records from the committed file
+    for name in ("sweep", "kernels", "glcm", "pallas_bench"):
+        if name not in skip:
+            RESULTS.get("stage_errors", {}).pop(name, None)
+    # kernel_errors entries belong to the kernels stage (cc_/ws_/dt_*)
+    # or the glcm stage (glcm_*) — keep only the skipped stage's
+    keep = {
+        k: v for k, v in RESULTS.pop("kernel_errors", {}).items()
+        if ("glcm" if k.startswith("glcm") else "kernels") in skip
+    }
+    if keep:
+        RESULTS["kernel_errors"] = keep
+    if not RESULTS.get("stage_errors"):
+        RESULTS.pop("stage_errors", None)
 
     RESULTS["backend"] = jax.default_backend()
     RESULTS["device"] = str(jax.devices()[0])

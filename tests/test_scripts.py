@@ -1,0 +1,20 @@
+"""Compile smoke tests for scripts/ — nothing imports these at test time,
+so a syntax error there ships silently (round-2 advisor finding: a stray
+indent made ``tune_tpu.py`` unrunnable while CI stayed green)."""
+import pathlib
+import py_compile
+
+import pytest
+
+SCRIPTS = sorted(
+    (pathlib.Path(__file__).resolve().parent.parent / "scripts").glob("*.py")
+)
+
+
+@pytest.mark.parametrize("path", SCRIPTS, ids=lambda p: p.name)
+def test_script_compiles(path):
+    py_compile.compile(str(path), doraise=True)
+
+
+def test_scripts_found():
+    assert len(SCRIPTS) >= 3
