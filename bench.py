@@ -26,6 +26,79 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
+# Proof-of-life for the TPU relay: a computation whose result is fetched
+# back to the host.  Shared with scripts/tpu_watch.py.
+PROBE_CODE = (
+    "import jax, numpy as np, jax.numpy as jnp; "
+    "assert float(np.asarray(jnp.arange(8.0).sum())) == 28.0; "
+    "print('ALIVE', jax.devices()[0])"
+)
+
+CACHE_PATH = os.environ.get(
+    "BENCH_TPU_CACHE", os.path.join(REPO, "tuning", "BENCH_TPU.json")
+)
+
+
+# env knob -> record field: a cached record only represents the requested
+# workload when every explicitly-set knob matches what was measured
+_WORKLOAD_KNOBS = {
+    "BENCH_BATCH": "batch",
+    "BENCH_MAX_OBJECTS": "max_objects",
+    "BENCH_SITE_SIZE": "site_size",
+    "BENCH_SITES": "sites",
+    "BENCH_CHANNELS": "channels",
+    "BENCH_DEPTH": "depth",
+}
+
+
+def emit_cached_tpu(live_error: str) -> bool:
+    """When the relay is down at driver time, emit the most recent
+    ON-HARDWARE measurement cached by scripts/tpu_watch.py instead of a
+    sub-baseline CPU number (round-2 VERDICT next-step #1).  The emitted
+    record keeps the measured value/denominator and carries full
+    provenance: when it was measured, how stale it is, and why a live
+    measurement was impossible right now.
+
+    Only a record of the SAME workload qualifies: config must match, any
+    explicitly-set BENCH_* workload knob must equal the recorded value,
+    and a TMX_PALLAS run is never served from cache (records don't track
+    the kernel backend)."""
+    if os.environ.get("TMX_PALLAS"):
+        return False
+    try:
+        with open(CACHE_PATH) as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        return False
+    config = os.environ.get("BENCH_CONFIG", "3")
+    entry = None
+    for cand in (cache.get("records") or {}).values():
+        rec = cand.get("record") or {}
+        if rec.get("config") != config:
+            continue
+        if any(
+            field in rec and int(os.environ[knob]) != rec[field]
+            for knob, field in _WORKLOAD_KNOBS.items()
+            if os.environ.get(knob)
+        ):
+            continue
+        if entry is None or cand.get("measured_at_unix", 0) > entry.get(
+            "measured_at_unix", 0
+        ):
+            entry = cand
+    if not entry or "record" not in entry:
+        return False
+    record = dict(entry["record"])
+    record["backend"] = "tpu_cached"
+    record["measured_at"] = entry.get("measured_at")
+    measured_unix = entry.get("measured_at_unix")
+    if measured_unix:
+        record["cache_age_hours"] = round((time.time() - measured_unix) / 3600, 2)
+    record["live_error"] = f"tpu unavailable now: {live_error}"
+    record["provenance"] = entry.get("provenance")
+    print(json.dumps(record), flush=True)
+    return True
+
 
 def measure(platform: str) -> None:
     """Child-process body: run the measurement on ``platform`` and print
@@ -96,6 +169,8 @@ def measure(platform: str) -> None:
     raw = {k: jnp.asarray(v) for k, v in data.items()}
     shifts = jnp.zeros((batch, 2), jnp.int32)
 
+    flops = _cost_flops(fn, raw, {}, shifts)
+
     # compile + warm up.  NOTE: completion is forced by a host fetch of the
     # counts — under the axon relay, block_until_ready returns before the
     # remote computation finishes, so fetch-based timing is the only honest
@@ -145,8 +220,49 @@ def measure(platform: str) -> None:
         "vs_baseline": round(device_sites_per_sec / cpu_sites_per_sec, 2),
         "backend": jax.default_backend(),
         "cpu_denominator_sites_per_sec": round(cpu_sites_per_sec, 3),
+        "config": config,
+        "batch": batch,
+        "max_objects": max_objects,
+        "site_size": size,
     }
+    if config == "volume":
+        record["depth"] = depth
+    record.update(_flops_fields(flops, batch, best, jax.default_backend()))
     print(json.dumps(record), flush=True)
+
+
+def _cost_flops(jitted_fn, *args):
+    """Total FLOPs of one compiled batch step via XLA's cost model, or None
+    if the backend does not report it (round-2 VERDICT weak-spot: "fast"
+    was only ever judged against scipy, never against the roofline)."""
+    try:
+        analysis = jitted_fn.lower(*args).compile().cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+            analysis = analysis[0] if analysis else {}
+        flops = float(analysis.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
+
+
+# MXU peak of one TPU v5e (v5 lite) chip in bf16; the pipeline runs mostly
+# f32 (correctness gate: HIGHEST-precision convs), so MFU against the bf16
+# peak is a conservative lower bound.
+_V5E_BF16_PEAK_FLOPS = 197e12
+
+
+def _flops_fields(flops, n_items, best_s, backend, item_key="flops_per_site"):
+    if not flops:
+        return {}
+    achieved = flops / best_s
+    out = {
+        item_key: round(flops / n_items),
+        "achieved_tflops_per_sec": round(achieved / 1e12, 4),
+    }
+    out["mfu_vs_v5e_bf16_peak"] = (
+        round(achieved / _V5E_BF16_PEAK_FLOPS, 6) if backend != "cpu" else None
+    )
+    return out
 
 
 def measure_corilla(size: int) -> None:
@@ -173,6 +289,7 @@ def measure_corilla(size: int) -> None:
         jax.vmap(lambda s: welford_finalize(welford_scan(s)))
     )
     dev_stack = jnp.asarray(stack)
+    flops = _cost_flops(fn, dev_stack)
     out = fn(dev_stack)
     np.asarray(out["n"])  # force completion (honest clock under the relay)
 
@@ -193,20 +310,23 @@ def measure_corilla(size: int) -> None:
         cpu_best = min(cpu_best, time.perf_counter() - t0)
     cpu_chans_per_sec = 1.0 / cpu_best
 
-    print(
-        json.dumps(
-            {
-                "metric": "corilla_channels_per_sec_per_chip",
-                "value": round(device_chans_per_sec, 3),
-                "unit": f"channels/sec ({n_sites} sites of {size}x{size}, "
-                        "online mean/var + exact percentile histogram)",
-                "vs_baseline": round(device_chans_per_sec / cpu_chans_per_sec, 2),
-                "backend": jax.default_backend(),
-                "cpu_denominator_channels_per_sec": round(cpu_chans_per_sec, 4),
-            }
-        ),
-        flush=True,
-    )
+    record = {
+        "metric": "corilla_channels_per_sec_per_chip",
+        "value": round(device_chans_per_sec, 3),
+        "unit": f"channels/sec ({n_sites} sites of {size}x{size}, "
+                "online mean/var + exact percentile histogram)",
+        "vs_baseline": round(device_chans_per_sec / cpu_chans_per_sec, 2),
+        "backend": jax.default_backend(),
+        "cpu_denominator_channels_per_sec": round(cpu_chans_per_sec, 4),
+        "config": "corilla",
+        "sites": n_sites,
+        "channels": n_channels,
+        "site_size": size,
+    }
+    record.update(_flops_fields(
+        flops, n_channels, best, jax.default_backend(),
+        item_key="flops_per_channel"))
+    print(json.dumps(record), flush=True)
 
 
 def main() -> None:
@@ -217,16 +337,19 @@ def main() -> None:
     last_err = ""
 
     def probe_device() -> bool:
-        """90s child probe: backend init HANGS (not fails) when the TPU
-        relay tunnel is down, so a cheap probe keeps a dead chip from
-        burning the full attempt timeout twice before the CPU fallback."""
+        """90s child probe with a REAL computation + host fetch: backend
+        init HANGS (not fails) when the TPU relay tunnel is down, and —
+        observed round 3 — ``jax.devices()`` can even return lazily while
+        actual compute still hangs, so only a round-tripped result proves
+        the chip is alive."""
         try:
             probe = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+                [sys.executable, "-c", PROBE_CODE],
                 timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "90")),
                 capture_output=True,
+                text=True,
             )
-            return probe.returncode == 0
+            return probe.returncode == 0 and "ALIVE" in probe.stdout
         except subprocess.TimeoutExpired:
             return False
 
@@ -268,7 +391,11 @@ def main() -> None:
             return
         if i < attempts - 1:
             time.sleep(backoff_s * (i + 1))
-    # chip never came up: fall back to the CPU backend so the round still
+    # chip never came up: prefer the watcher's cached ON-HARDWARE number
+    # (honest provenance beats a fresh-but-wrong-backend measurement) …
+    if emit_cached_tpu(last_err):
+        return
+    # … and only then fall back to the CPU backend so the round still
     # produces a measured number, annotated as a fallback
     if try_once("cpu"):
         return

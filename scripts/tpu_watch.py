@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Detached TPU-relay watcher: measure the moment the chip comes alive.
+
+The axon relay in this environment drops for hours at a time and
+``jax.devices()`` can hang — or even return lazily while real compute
+still hangs — when it is down.  This watcher loops a *real-computation*
+probe (see ``bench.PROBE_CODE``) and, on the first live window, runs the
+pending on-hardware work in priority order, flushing results to disk
+after every item so a mid-window relay death loses nothing:
+
+1. headline bench configs (3, 3 at the production max_objects=256, 4,
+   corilla, volume) -> ``tuning/BENCH_TPU.json`` records with full
+   provenance (timestamp, wall time, env, raw record);
+2. the tuning sweep (``scripts/tune_tpu.py``, itself stage-resilient)
+   -> ``tuning/TUNING.json``; already-completed stages are skipped via
+   ``TUNE_SKIP`` so a second window only runs what is still missing.
+
+``bench.py`` emits the freshest cached record (``backend: tpu_cached``)
+whenever the driver runs it while the relay is down.
+
+Launch detached:  nohup python scripts/tpu_watch.py >> tuning/watch.log 2>&1 &
+Idempotent: a second copy exits if the pidfile's process is still alive.
+Exits on its own once every pending item is done.
+"""
+import atexit
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import CACHE_PATH, PROBE_CODE  # noqa: E402
+
+TUNING_PATH = os.path.join(REPO, "tuning", "TUNING.json")
+PID_PATH = os.path.join(REPO, "tuning", "watch.pid")
+
+# (cache key, bench env) in priority order — headline first.
+BENCH_ITEMS = [
+    ("3", {"BENCH_CONFIG": "3"}),
+    ("3@mo256", {"BENCH_CONFIG": "3", "BENCH_MAX_OBJECTS": "256"}),
+    ("4", {"BENCH_CONFIG": "4"}),
+    ("corilla", {"BENCH_CONFIG": "corilla"}),
+    ("volume", {"BENCH_CONFIG": "volume"}),
+]
+
+TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed
+    "sweep": "batch_sweep",
+    "kernels": "kernels_ms",
+    "glcm": "glcm_ms",
+    "pallas_bench": "bench_with_pallas",
+}
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%H:%M:%S")
+    print(f"[watch {stamp}] {msg}", flush=True)
+
+
+def probe(timeout: int = 120) -> bool:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            timeout=timeout, capture_output=True, text=True,
+        )
+        return r.returncode == 0 and "ALIVE" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def load_json(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_cache(cache: dict) -> None:
+    os.makedirs(os.path.dirname(CACHE_PATH), exist_ok=True)
+    tmp = CACHE_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(cache, f, indent=2, sort_keys=True)
+    os.replace(tmp, CACHE_PATH)
+
+
+def bench_done(key: str) -> bool:
+    entry = (load_json(CACHE_PATH).get("records") or {}).get(key)
+    return bool(entry and entry.get("record"))
+
+
+def run_bench_item(key: str, overrides: dict) -> bool:
+    """One live measurement of ``bench.py``; returns False (relay gone or
+    measurement failed) without touching the cache unless the record is a
+    genuine on-hardware one."""
+    # strip inherited BENCH_*/TMX_* knobs: a stray export in the launching
+    # shell must not change the measured workload while entry['env'] claims
+    # only the overrides were set
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("BENCH_", "TMX_", "TUNE_"))
+    }
+    env.update(
+        BENCH_ATTEMPTS="1",          # the watcher IS the retry loop
+        BENCH_ATTEMPT_TIMEOUT="900",
+        **{k: str(v) for k, v in overrides.items()},
+    )
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=1500,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"bench[{key}]: timed out")
+        return False
+    record = None
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            record = json.loads(line)
+    if record is None:
+        log(f"bench[{key}]: no JSON line (rc={r.returncode}) "
+            f"stderr: {r.stderr[-200:]}")
+        return False
+    backend = record.get("backend", "")
+    if backend.startswith("cpu") or backend == "tpu_cached" or "error" in record:
+        log(f"bench[{key}]: not on-hardware (backend={backend}) — relay died?")
+        return False
+    cache = load_json(CACHE_PATH)
+    cache.setdefault("records", {})[key] = {
+        "record": record,
+        "measured_at": datetime.datetime.now(datetime.timezone.utc)
+        .isoformat(timespec="seconds"),
+        "measured_at_unix": time.time(),
+        "wall_s": round(time.time() - t0, 1),
+        "env": overrides,
+        "provenance": (
+            "measured live by scripts/tpu_watch.py during a relay-up window; "
+            "BENCH_ATTEMPTS=1 per window, watcher retries across windows"
+        ),
+    }
+    save_cache(cache)
+    log(f"bench[{key}]: CAPTURED {record.get('value')} {record.get('unit', '')}"
+        f" (vs_baseline {record.get('vs_baseline')})")
+    return True
+
+
+def pending_tune_stages() -> list:
+    tuning = load_json(TUNING_PATH)
+    if "written_by" not in tuning:
+        # pre-round-3 file was hand-transcribed after a relay death; only
+        # results written by tune_tpu.write_results() itself count as done
+        return list(TUNE_STAGES)
+    errors = tuning.get("stage_errors", {})
+    out = []
+    for stage, key in TUNE_STAGES.items():
+        if stage == "pallas_bench" and tuning.get("pallas_wins") is False:
+            continue  # tune_tpu only runs it when pallas wins
+        if key not in tuning or stage in errors:
+            out.append(stage)
+    return out
+
+
+def run_tune() -> bool:
+    skip = [s for s in TUNE_STAGES if s not in pending_tune_stages()]
+    env = dict(os.environ, TUNE_SKIP=",".join(skip))
+    log(f"tune_tpu: running (skip={skip or 'none'})")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "tune_tpu.py")],
+            env=env, capture_output=True, text=True, timeout=7200,
+        )
+    except subprocess.TimeoutExpired:
+        log("tune_tpu: timed out (partial stages are already flushed)")
+        return False
+    tail = "\n".join(r.stdout.splitlines()[-12:])
+    log(f"tune_tpu rc={r.returncode}:\n{tail}")
+    return r.returncode == 0 and not pending_tune_stages()
+
+
+def all_pending() -> list:
+    items = [f"bench:{k}" for k, _ in BENCH_ITEMS if not bench_done(k)]
+    items += [f"tune:{s}" for s in pending_tune_stages()]
+    return items
+
+
+def main() -> None:
+    # single instance
+    old = load_json(PID_PATH) if os.path.exists(PID_PATH) else {}
+    if old.get("pid"):
+        try:
+            os.kill(old["pid"], 0)
+            print(f"watcher already running (pid {old['pid']}); exiting")
+            return
+        except (OSError, ProcessLookupError):
+            pass
+    os.makedirs(os.path.dirname(PID_PATH), exist_ok=True)
+    with open(PID_PATH, "w") as f:
+        json.dump({"pid": os.getpid(), "started": time.time()}, f)
+
+    def _cleanup_pidfile():
+        # a stale pidfile + PID reuse would permanently lock future
+        # watchers out of on-hardware capture on this box
+        try:
+            if load_json(PID_PATH).get("pid") == os.getpid():
+                os.remove(PID_PATH)
+        except OSError:
+            pass
+
+    atexit.register(_cleanup_pidfile)
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
+
+    log(f"watcher up (pid {os.getpid()}); pending: {all_pending()}")
+    poll_s = int(os.environ.get("WATCH_POLL_S", "60"))
+    while True:
+        pending = all_pending()
+        if not pending:
+            log("all pending work done; exiting")
+            break
+        if not probe():
+            time.sleep(poll_s)
+            continue
+        log(f"relay ALIVE — firing pending work: {pending}")
+        for key, overrides in BENCH_ITEMS:
+            if not bench_done(key):
+                if not run_bench_item(key, overrides):
+                    break  # relay likely died; back to probing
+        else:
+            if pending_tune_stages():
+                run_tune()
+        time.sleep(10)
+
+
+if __name__ == "__main__":
+    main()

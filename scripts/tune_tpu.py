@@ -31,7 +31,17 @@ def run_bench(env_overrides):
     )
     for line in out.stdout.splitlines():
         if line.startswith("{"):
-            return json.loads(line)
+            rec = json.loads(line)
+            backend = rec.get("backend", "")
+            # a sweep point must be a LIVE on-hardware measurement — a
+            # cached or cpu-fallback record would silently repeat one
+            # stale number for every batch size
+            if backend.startswith("cpu") or backend == "tpu_cached":
+                raise RuntimeError(
+                    f"bench fell back to {backend} (relay died?) — "
+                    "refusing to record it as a tuning point"
+                )
+            return rec
     raise RuntimeError(f"bench failed: {out.stderr[-500:]}")
 
 
@@ -157,7 +167,13 @@ def main():
     prior_path = os.path.join(REPO, "tuning", "TUNING.json")
     if os.path.exists(prior_path):
         with open(prior_path) as f:
-            RESULTS.update(json.load(f))
+            prior = json.load(f)
+        # only merge results that write_results() itself produced: merging
+        # a hand-transcribed file and then stamping it written_by would
+        # launder hand numbers into machine provenance (the round-2 file
+        # is exactly that; it stays in git history, not in RESULTS)
+        if "written_by" in prior:
+            RESULTS.update(prior)
 
     # backend init is the flakiest part of the relay (it can raise seconds
     # after a successful device probe), and JAX caches the failure for the
@@ -250,6 +266,8 @@ def write_results():
             return None
         return o
 
+    RESULTS["written_by"] = "scripts/tune_tpu.py write_results"
+    RESULTS["written_at"] = time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime())
     out_dir = os.path.join(REPO, "tuning")
     os.makedirs(out_dir, exist_ok=True)
     out_path = os.path.join(out_dir, "TUNING.json")

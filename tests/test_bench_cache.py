@@ -1,0 +1,113 @@
+"""The tpu_cached emission path of bench.py (round-2 VERDICT next-step #1):
+when the relay is down at driver time, the freshest on-hardware record from
+scripts/tpu_watch.py must be emitted — with staleness and the live error —
+instead of a sub-baseline CPU number."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(env, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        env={**os.environ, **env},
+        capture_output=True, text=True, timeout=timeout,
+    )
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line: rc={proc.returncode} err={proc.stderr[-400:]}"
+    return json.loads(lines[-1])
+
+
+@pytest.fixture()
+def cache_file(tmp_path):
+    path = tmp_path / "BENCH_TPU.json"
+    record = {
+        "metric": "jterator_cell_painting_sites_per_sec_per_chip",
+        "value": 236.95,
+        "unit": "sites/sec (256x256, 2ch, segment+measure)",
+        "vs_baseline": 4.5,
+        "backend": "axon",
+        "cpu_denominator_sites_per_sec": 52.693,
+        "config": "3",
+        "batch": 64,
+        "max_objects": 64,
+    }
+    path.write_text(json.dumps({
+        "records": {
+            "3": {
+                "record": record,
+                "measured_at": "2026-07-30T05:00:00+00:00",
+                "measured_at_unix": time.time() - 7200,
+                "provenance": "test fixture",
+            }
+        }
+    }))
+    return str(path)
+
+
+def test_cached_tpu_emitted_when_relay_down(cache_file):
+    out = _run_bench({
+        "BENCH_TPU_CACHE": cache_file,
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        # break real TPU use even if the relay happens to be alive in CI:
+        # probe timeout of 3s fails fast either way on this relay
+    })
+    if out.get("backend") not in ("tpu_cached",):
+        # relay alive and fast enough to beat a 3s probe: the live path
+        # legitimately wins; nothing to assert about the cache then
+        pytest.skip(f"relay answered live: {out.get('backend')}")
+    assert out["value"] == 236.95
+    assert out["vs_baseline"] == 4.5
+    assert out["measured_at"] == "2026-07-30T05:00:00+00:00"
+    assert 1.8 < out["cache_age_hours"] < 2.3
+    assert "tpu unavailable now" in out["live_error"]
+    assert out["provenance"] == "test fixture"
+
+
+def test_cpu_fallback_when_no_cache(tmp_path):
+    out = _run_bench({
+        "BENCH_TPU_CACHE": str(tmp_path / "missing.json"),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "4",
+        "BENCH_REPS": "1",
+    })
+    if out.get("backend") not in ("cpu_fallback",):
+        pytest.skip(f"relay answered live: {out.get('backend')}")
+    assert out["value"] > 0
+    assert "error" in out
+
+
+def test_cache_rejected_on_workload_mismatch(cache_file):
+    """A cached batch-64 record must not be served for a batch-8 request
+    (tune_tpu's sweep would otherwise record one stale number per point)."""
+    out = _run_bench({
+        "BENCH_TPU_CACHE": cache_file,
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "8",
+        "BENCH_REPS": "1",
+    })
+    assert out.get("backend") != "tpu_cached"
+
+
+def test_cache_ignored_for_other_config(cache_file):
+    """A cached config-3 record must not satisfy a corilla run."""
+    out = _run_bench({
+        "BENCH_TPU_CACHE": cache_file,
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_CONFIG": "corilla",
+        "BENCH_SITES": "8",
+        "BENCH_CHANNELS": "2",
+        "BENCH_REPS": "1",
+    })
+    assert out.get("backend") != "tpu_cached"
+    assert out["metric"] == "corilla_channels_per_sec_per_chip"
