@@ -117,6 +117,29 @@ def _load_locked():
         ]
     except AttributeError:
         logger.info("native library predates the TIFF reader; rebuild native/")
+    try:
+        lib.tm_fill_holes.restype = ctypes.c_int32
+        lib.tm_fill_holes.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8),
+        ]
+        lib.tm_chebyshev_dt.restype = ctypes.c_int32
+        lib.tm_chebyshev_dt.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.tm_watershed_levels.restype = ctypes.c_int32
+        lib.tm_watershed_levels.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ]
+    except AttributeError:
+        logger.info(
+            "native library predates the CPU segmentation kernels; "
+            "rebuild native/"
+        )
     _lib = lib
     return _lib
 
@@ -394,4 +417,109 @@ def simplify_polygon_host(contour: np.ndarray, tolerance: float) -> np.ndarray:
         d[1] * (pts[:, 0] - pts[0, 0]) - d[0] * (pts[:, 1] - pts[0, 1])
     ) / np.sqrt(len2)
     cross[0] = cross[far] = -1.0
-    return contour[sorted({0, far, int(cross.argmax())})]
+    picked = contour[sorted({0, far, int(cross.argmax())})]
+    # an all-collinear contour (e.g. a 1-px-wide object's out-and-back
+    # Moore trace) leaves every candidate on the chord: the picked "ring"
+    # would still have zero area, or fewer than 3 distinct vertices.
+    # Return the unsimplified contour instead — downstream consumers
+    # handle it the same way they handle any unsimplified trace.
+    if len(picked) < 3:
+        return contour
+    a, b, c = picked[:3].astype(np.float64)
+    if abs((b[0] - a[0]) * (c[1] - a[1]) - (b[1] - a[1]) * (c[0] - a[0])) < 1e-12:
+        return contour
+    return picked
+
+
+# ------------------------------------------- CPU-fallback segmentation path
+def cpu_native_enabled() -> bool:
+    """``method="auto"`` dispatch gate for the iterative segmentation ops
+    (connected components, watershed, hole fill, distance transform).
+
+    The XLA ``lax.while_loop`` twins are pathological on the CPU backend
+    (round-2 bench: 0.39x single-thread scipy), so on ``cpu`` auto routes
+    to these native kernels via ``jax.pure_callback``.  ``TMX_NATIVE=0``
+    forces the portable XLA path; TPU/GPU backends never take this branch
+    (resolution order pinned in each op's docstring)."""
+    import os
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return False
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_watershed_levels"):
+        return False
+    return os.environ.get("TMX_NATIVE", "1") not in ("0", "false", "no")
+
+
+def fill_holes_host(mask: np.ndarray, connectivity: int = 4) -> np.ndarray:
+    """Fill background holes (native BFS; scipy fallback)."""
+    mask = np.ascontiguousarray(mask.astype(np.uint8))
+    h, w = mask.shape
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_fill_holes"):
+        import scipy.ndimage as ndi
+
+        structure = ndi.generate_binary_structure(2, 1 if connectivity == 4 else 2)
+        return ndi.binary_fill_holes(mask, structure=structure)
+    out = np.empty((h, w), np.uint8)
+    rc = lib.tm_fill_holes(
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w, connectivity,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+    )
+    if rc != 0:
+        raise ValueError("tm_fill_holes: invalid arguments")
+    return out.astype(bool)
+
+
+def chebyshev_dt_host(mask: np.ndarray, max_distance: int = 64) -> np.ndarray:
+    """Erosion-ring (chessboard) distance transform matching
+    ``ops.segment_primary.distance_transform_approx`` exactly."""
+    mask = np.ascontiguousarray(mask.astype(np.uint8))
+    h, w = mask.shape
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_chebyshev_dt"):
+        raise RuntimeError("native chebyshev_dt unavailable; use the XLA path")
+    out = np.empty((h, w), np.float32)
+    rc = lib.tm_chebyshev_dt(
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w,
+        int(max_distance),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if rc != 0:
+        raise ValueError("tm_chebyshev_dt: invalid arguments")
+    return out
+
+
+def watershed_levels_host(
+    intensity: np.ndarray,
+    seeds: np.ndarray,
+    mask: np.ndarray,
+    levels: np.ndarray,
+    connectivity: int = 8,
+) -> np.ndarray:
+    """Level-ordered watershed flooding, bit-identical to the XLA path of
+    ``ops.segment_secondary.watershed_from_seeds``.  ``levels`` must be the
+    descending threshold values computed by the same jitted expression the
+    XLA path uses (band membership is then decided by exact comparisons)."""
+    intensity = np.ascontiguousarray(intensity, np.float32)
+    seeds = np.ascontiguousarray(seeds, np.int32)
+    mask = np.ascontiguousarray(mask.astype(np.uint8))
+    levels = np.ascontiguousarray(levels, np.float32)
+    h, w = mask.shape
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_watershed_levels"):
+        raise RuntimeError("native watershed unavailable; use the XLA path")
+    out = np.empty((h, w), np.int32)
+    rc = lib.tm_watershed_levels(
+        intensity.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), h, w,
+        levels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), len(levels),
+        connectivity,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise ValueError("tm_watershed_levels: invalid arguments")
+    return out

@@ -104,9 +104,15 @@ def connected_components(
     of the most serpentine component) with no per-pixel gathers.
     ``"pallas"`` runs the same fixpoint entirely in VMEM
     (:func:`~tmlibrary_tpu.ops.pallas_kernels.cc_min_propagate`) — O(1)
-    HBM traffic.  ``"auto"`` picks pallas on TPU backends when
-    ``TMX_PALLAS=1`` is set (see ``pallas_kernels.pallas_enabled``), XLA
-    otherwise.  Both converge to the identical min-linear-index labeling.
+    HBM traffic.  ``"native"`` calls the first-party C++ union-find
+    (``native/tmnative.cpp`` ``tm_cc_label``, scipy scan order) via
+    ``jax.pure_callback`` — the fast path when the whole pipeline runs on
+    the CPU backend, where the while-loop fixpoint is pathological.
+
+    ``"auto"`` resolution order (pinned): native on the cpu backend when
+    the library is available and ``TMX_NATIVE`` isn't 0 → pallas on TPU
+    per ``pallas_kernels.pallas_enabled`` → xla.  All three produce the
+    identical scipy-scan-order labeling.
     """
     mask = jnp.asarray(mask, bool)
     h, w = mask.shape
@@ -115,9 +121,31 @@ def connected_components(
     linear = jnp.arange(h * w, dtype=jnp.int32).reshape(h, w)
 
     if method == "auto":
+        from tmlibrary_tpu import native
         from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
 
-        method = "pallas" if pallas_enabled() else "xla"
+        if native.cpu_native_enabled():
+            method = "native"
+        else:
+            method = "pallas" if pallas_enabled() else "xla"
+    if method == "native":
+        import numpy as np
+
+        from tmlibrary_tpu import native
+
+        def _cc_host(m):
+            labels, count = native.cc_label_host(np.asarray(m), connectivity)
+            return labels, np.int32(count)
+
+        return jax.pure_callback(
+            _cc_host,
+            (
+                jax.ShapeDtypeStruct((h, w), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            ),
+            mask,
+            vmap_method="sequential",
+        )
     if method == "pallas":
         from tmlibrary_tpu.ops.pallas_kernels import cc_min_propagate
 
@@ -182,14 +210,36 @@ def binary_erode(mask: jax.Array, connectivity: int = 8, iterations: int = 1) ->
     return mask
 
 
-def fill_holes(mask: jax.Array, connectivity: int = 4) -> jax.Array:
+def fill_holes(
+    mask: jax.Array, connectivity: int = 4, method: str = "auto"
+) -> jax.Array:
     """Fill background holes (reference ``jtmodules/fill.main``,
     scipy ``binary_fill_holes`` semantics: background connectivity is the
     complement of the foreground's — holes are 4-connected background regions
     not reachable from the border).
+
+    ``method="auto"`` routes to the native border-BFS
+    (``tm_fill_holes``) on the cpu backend (see
+    :func:`~tmlibrary_tpu.native.cpu_native_enabled`), the XLA flood
+    otherwise.
     """
     mask = jnp.asarray(mask, bool)
     h, w = mask.shape
+    if method == "auto":
+        from tmlibrary_tpu import native
+
+        method = "native" if native.cpu_native_enabled() else "xla"
+    if method == "native":
+        import numpy as np
+
+        from tmlibrary_tpu import native
+
+        return jax.pure_callback(
+            lambda m: native.fill_holes_host(np.asarray(m), connectivity),
+            jax.ShapeDtypeStruct((h, w), jnp.bool_),
+            mask,
+            vmap_method="sequential",
+        )
     bg = ~mask
     border = jnp.zeros_like(mask).at[0, :].set(True).at[-1, :].set(True)
     border = border.at[:, 0].set(True).at[:, -1].set(True)

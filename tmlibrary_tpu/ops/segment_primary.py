@@ -28,13 +28,32 @@ def distance_transform_approx(
     The XLA path erodes under ``lax.while_loop`` with an early exit once
     everything has eroded away (bounded by ``max_distance``);
     ``method="pallas"`` (or ``"auto"`` + ``TMX_PALLAS=1`` on TPU) runs the
-    identical fixpoint in VMEM.
+    identical fixpoint in VMEM; ``"native"`` computes the same values via
+    a two-pass chamfer in C++ (``tm_chebyshev_dt``) — the fast path on
+    the CPU backend.  ``"auto"`` resolution order (pinned): native on cpu
+    when available → pallas on TPU → xla.
     """
     mask = jnp.asarray(mask, bool)
     if method == "auto":
-        from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+        from tmlibrary_tpu import native
 
-        method = "pallas" if pallas_enabled() else "xla"
+        if native.cpu_native_enabled():
+            method = "native"
+        else:
+            from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+
+            method = "pallas" if pallas_enabled() else "xla"
+    if method == "native":
+        import numpy as np
+
+        from tmlibrary_tpu import native
+
+        return jax.pure_callback(
+            lambda m: native.chebyshev_dt_host(np.asarray(m), max_distance),
+            jax.ShapeDtypeStruct(mask.shape, jnp.float32),
+            mask,
+            vmap_method="sequential",
+        )
     if method == "pallas":
         from tmlibrary_tpu.ops.pallas_kernels import distance_transform
 

@@ -82,14 +82,24 @@ def watershed_from_seeds(
 
     ``method="pallas"`` runs the whole level loop in VMEM
     (:func:`~tmlibrary_tpu.ops.pallas_kernels.watershed_flood`);
-    ``"auto"`` picks pallas on TPU backends when ``TMX_PALLAS=1`` is set
-    (see ``pallas_kernels.pallas_enabled``), otherwise the portable XLA
-    twin below.  Identical schedule and tie-breaking either way.
+    ``"native"`` calls the C++ frontier flood (``tm_watershed_levels``)
+    via ``jax.pure_callback`` — the fast path on the CPU backend, where
+    per-level ``lax.while_loop`` convergence is pathological.
+    ``"auto"`` resolution order (pinned): native on cpu when available →
+    pallas on TPU per ``pallas_kernels.pallas_enabled`` → xla.  Identical
+    schedule and tie-breaking all three ways (the native path receives
+    the level thresholds computed by the same jitted expression, so band
+    membership is decided by exact float comparisons).
     """
     if method == "auto":
-        from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+        from tmlibrary_tpu import native
 
-        method = "pallas" if pallas_enabled() else "xla"
+        if native.cpu_native_enabled():
+            method = "native"
+        else:
+            from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+
+            method = "pallas" if pallas_enabled() else "xla"
     if method == "pallas":
         from tmlibrary_tpu.ops.pallas_kernels import watershed_flood
 
@@ -104,6 +114,25 @@ def watershed_from_seeds(
     lo = jnp.min(jnp.where(mask, intensity, jnp.inf))
     hi = jnp.max(jnp.where(mask, intensity, -jnp.inf))
     span = jnp.maximum(hi - lo, 1e-6)
+
+    if method == "native":
+        import numpy as np
+
+        from tmlibrary_tpu import native
+
+        # the SAME expression level_body uses (left-assoc: (span*(i+1))/n),
+        # so the host kernel compares against bit-identical thresholds
+        i = jnp.arange(n_levels, dtype=jnp.int32)
+        levels = hi - span * (i + 1) / n_levels
+        return jax.pure_callback(
+            lambda im, sd, mk, lv: native.watershed_levels_host(
+                np.asarray(im), np.asarray(sd), np.asarray(mk),
+                np.asarray(lv), connectivity,
+            ),
+            jax.ShapeDtypeStruct(intensity.shape, jnp.int32),
+            intensity, seeds, mask, levels,
+            vmap_method="sequential",
+        )
 
     def level_body(i, labels):
         # descending levels: i=0 admits only the brightest band

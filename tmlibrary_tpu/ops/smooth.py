@@ -25,26 +25,27 @@ def _gaussian_kernel1d(sigma: float, radius: int) -> jnp.ndarray:
 
 
 def _conv1d(img: jax.Array, kernel: jnp.ndarray, axis: int) -> jax.Array:
-    """Correlate a 2-D image with a 1-D kernel along ``axis`` (reflect pad)."""
-    r = kernel.shape[0] // 2
+    """Correlate a 2-D image with a 1-D kernel along ``axis`` (reflect pad).
+
+    Implemented as K static shifted-slice multiply-adds rather than
+    ``lax.conv_general_dilated``: a single-channel (1,1,H,W) conv hits
+    XLA-CPU's slow conv path (~10 ms per 256-px image — it dominated the
+    whole CPU-fallback pipeline), while the unrolled form fuses into one
+    vector pass on both CPU and TPU (VPU).  Accumulation is plain f32
+    multiply-add, so the TPU result cannot drop to bf16 passes the way
+    MXU convs default to — same guarantee HIGHEST precision gave the conv.
+    """
+    size = kernel.shape[0]
+    r = size // 2
     pad = [(0, 0), (0, 0)]
     pad[axis] = (r, r)
-    padded = jnp.pad(img, pad, mode="symmetric")
-    lhs = padded[None, None, :, :]
-    if axis == 0:
-        rhs = kernel.reshape(1, 1, -1, 1)
-    else:
-        rhs = kernel.reshape(1, 1, 1, -1)
-    out = lax.conv_general_dilated(
-        lhs.astype(jnp.float32),
-        rhs,
-        window_strides=(1, 1),
-        padding="VALID",
-        # full fp32 accumulation: TPU convs default to bf16 passes, which
-        # flips pixels sitting exactly on a threshold vs the CPU golden
-        precision=lax.Precision.HIGHEST,
-    )
-    return out[0, 0]
+    padded = jnp.pad(jnp.asarray(img, jnp.float32), pad, mode="symmetric")
+    h, w = img.shape
+    out = jnp.zeros((h, w), jnp.float32)
+    for i in range(size):
+        sl = lax.slice_in_dim(padded, i, i + (h if axis == 0 else w), axis=axis)
+        out = out + kernel[i] * sl
+    return out
 
 
 def gaussian_smooth(img: jax.Array, sigma: float, truncate: float = 4.0) -> jax.Array:
@@ -66,21 +67,20 @@ def uniform_smooth(img: jax.Array, size: int) -> jax.Array:
     # scipy centers even-sized windows with the extra tap on the left
     left = size // 2
     right = size - left - 1
-    padded = jnp.pad(
-        jnp.asarray(img, jnp.float32), ((left, right), (left, right)), mode="symmetric"
-    )
+    img = jnp.asarray(img, jnp.float32)
+    h, w = img.shape
     k = jnp.full((size,), 1.0 / size, jnp.float32)
-    out = lax.conv_general_dilated(
-        padded[None, None],
-        k.reshape(1, 1, -1, 1),
-        (1, 1),
-        "VALID",
-        precision=lax.Precision.HIGHEST,
-    )
-    out = lax.conv_general_dilated(
-        out, k.reshape(1, 1, 1, -1), (1, 1), "VALID", precision=lax.Precision.HIGHEST
-    )
-    return out[0, 0]
+    # shifted-slice accumulation for the same reason as _conv1d (slow
+    # XLA-CPU conv path for single-channel shapes)
+    padded = jnp.pad(img, ((left, right), (0, 0)), mode="symmetric")
+    out = jnp.zeros((h, w), jnp.float32)
+    for i in range(size):
+        out = out + k[i] * lax.slice_in_dim(padded, i, i + h, axis=0)
+    padded = jnp.pad(out, ((0, 0), (left, right)), mode="symmetric")
+    out = jnp.zeros((h, w), jnp.float32)
+    for i in range(size):
+        out = out + k[i] * lax.slice_in_dim(padded, i, i + w, axis=1)
+    return out
 
 
 def _window_stack(img: jax.Array, size: int) -> jax.Array:
