@@ -227,6 +227,13 @@ def measure(platform: str) -> None:
     }
     if config == "volume":
         record["depth"] = depth
+    # sites whose object count sits AT the static cap may have silently
+    # lost objects to clip_label_count — the headline number must carry
+    # that signal (round-2 VERDICT weak-spot #4)
+    at_cap = np.zeros(batch, bool)
+    for c in result.counts.values():
+        at_cap |= np.asarray(c) >= max_objects
+    record["saturated_sites"] = int(at_cap.sum())
     record.update(_flops_fields(flops, batch, best, jax.default_backend()))
     print(json.dumps(record), flush=True)
 

@@ -780,3 +780,90 @@ def test_cli_workflow_cleanup(source_dir, store):
 
     assert main(["workflow", "submit", "--root", root]) == 0
     assert store.read_labels(None, "nuclei").max() > 0
+
+
+def test_object_cap_saturation_is_loud(tmp_path, caplog):
+    """A site with more objects than max_objects must produce a visible
+    saturation signal (batch summary -> ledger, collect warning) instead
+    of silently losing the overflow (round-2 VERDICT weak-spot #4)."""
+    import logging
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "sat", well_rows=1, well_cols=1, sites_per_well=(1, 1),
+        channel_names=("DAPI",), site_shape=(64, 64),
+    )
+    st = ExperimentStore.create(tmp_path / "sat_exp", exp)
+    # 7x7 grid of bright 3x3 squares = 49 objects, comfortably over cap 16
+    img = np.full((64, 64), 300, np.uint16)
+    for gy in range(7):
+        for gx in range(7):
+            y, x = 4 + 8 * gy, 4 + 8 * gx
+            img[y:y + 3, x:x + 3] = 40000
+    st.write_sites(img[None], [0], channel=0)
+
+    pipe = dict(PIPE_YAML)
+    pipe["input"] = {"channels": [{"name": "DAPI", "correct": False, "align": False}]}
+    (st.root / "sat.pipe.yaml").write_text(yaml.safe_dump(pipe))
+
+    jt = get_step("jterator")(st)
+    jt.init({"pipe": "sat.pipe.yaml", "batch_size": 4, "max_objects": 16,
+             "n_devices": 1})
+    with caplog.at_level(logging.WARNING):
+        result = jt.run(0)
+    assert result["saturated"] == {"nuclei": 1}
+    assert result["objects"]["nuclei"] == 16  # capped, and visibly so
+    assert any("max_objects" in r.message for r in caplog.records)
+
+    caplog.clear()
+    # collect from a FRESH instance: the per-verb CLI runs init/run/collect
+    # in separate processes, so the signal must survive process boundaries
+    jt_collect = get_step("jterator")(st)
+    with caplog.at_level(logging.WARNING):
+        collected = jt_collect.collect()
+    assert collected["saturated_sites"] == {"nuclei": 1}
+    assert any("--max-objects" in r.message for r in caplog.records)
+
+    # a clean re-run of the same batch (same init) must CLEAR its entry
+    clean = np.full((64, 64), 300, np.uint16)
+    clean[10:13, 10:13] = 40000
+    st.write_sites(clean[None], [0], channel=0)
+    result2 = jt.run(0)
+    assert "saturated" not in result2
+    assert "saturated_sites" not in get_step("jterator")(st).collect()
+
+    # cleanup (init implies delete_previous_output) clears the stale signal
+    st.write_sites(img[None], [0], channel=0)
+    jt2 = get_step("jterator")(st)
+    jt2.init({"pipe": "sat.pipe.yaml", "batch_size": 4, "max_objects": 16,
+              "n_devices": 1})
+    jt2.run(0)
+    assert get_step("jterator")(st).collect()["saturated_sites"] == {"nuclei": 1}
+    jt2.init({"pipe": "sat.pipe.yaml", "batch_size": 4, "max_objects": 64,
+              "n_devices": 1})
+    assert "saturated_sites" not in get_step("jterator")(st).collect()
+
+
+def test_no_saturation_signal_below_cap(tmp_path):
+    """An unsaturated run must NOT emit the signal (no false alarms)."""
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "nosat", well_rows=1, well_cols=1, sites_per_well=(1, 1),
+        channel_names=("DAPI",), site_shape=(64, 64),
+    )
+    st = ExperimentStore.create(tmp_path / "nosat_exp", exp)
+    rng = np.random.default_rng(3)
+    st.write_sites(synth_site_image(rng, n_blobs=4)[None], [0], channel=0)
+    pipe = dict(PIPE_YAML)
+    pipe["input"] = {"channels": [{"name": "DAPI", "correct": False, "align": False}]}
+    (st.root / "nosat.pipe.yaml").write_text(yaml.safe_dump(pipe))
+    jt = get_step("jterator")(st)
+    jt.init({"pipe": "nosat.pipe.yaml", "batch_size": 4, "max_objects": 64,
+             "n_devices": 1})
+    result = jt.run(0)
+    assert "saturated" not in result
+    assert "saturated_sites" not in jt.collect()

@@ -17,12 +17,16 @@ from __future__ import annotations
 
 import numpy as np
 
+import logging
+
 from tmlibrary_tpu.errors import PipelineError, StoreError
 from tmlibrary_tpu.models.image import IllumstatsContainer
 from tmlibrary_tpu.utils import create_partitions
 from tmlibrary_tpu.workflow.api import Step
 from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
 from tmlibrary_tpu.workflow.registry import register_step
+
+logger = logging.getLogger(__name__)
 
 
 def _host_shift(img: np.ndarray, dy: int, dx: int) -> np.ndarray:
@@ -305,10 +309,34 @@ class ImageAnalysisRunner(Step):
                             labels, sites,
                         )
 
-        return {
+        summary = {
             "n_sites": n_valid,
             "objects": {k: int(v.sum()) for k, v in counts.items()},
         }
+        # object-capacity saturation must be LOUD: clip_label_count silently
+        # zeroes labels past max_objects, so a site whose count sits AT the
+        # cap may have lost objects — surface it per batch in the ledger,
+        # accumulate for the collect-phase warning, and leave the re-run
+        # recipe in the log (round-2 VERDICT weak-spot #4)
+        saturated = {
+            k: int((v >= max_obj).sum()) for k, v in counts.items()
+        }
+        saturated = {k: n for k, n in saturated.items() if n}
+        # record unconditionally: a clean re-run of a previously saturated
+        # batch must CLEAR its stale entry
+        self._record_saturation(batch["index"], saturated)
+        if saturated:
+            summary["saturated"] = saturated
+            logger.warning(
+                "object capacity saturated (count == max_objects == %d) for "
+                "%s — objects beyond the cap were dropped; re-run the step "
+                "with a higher cap: `tmx jterator cleanup && tmx jterator "
+                "init --max-objects N && tmx jterator run` (max_objects is "
+                "an init-time argument)",
+                max_obj,
+                ", ".join(f"{n} site(s) of '{k}'" for k, n in saturated.items()),
+            )
+        return summary
 
     # ---------------------------------------------------------------- helpers
     def _site_metadata(self, sites: list[int]) -> list[dict]:
@@ -408,7 +436,74 @@ class ImageAnalysisRunner(Step):
                     min_poly_zoom=min_poly_zoom(n_levels, mean_px),
                 )
             )
-        return {"objects_total": summary}
+        out = {"objects_total": summary}
+        totals = self._saturation_totals()
+        if totals:
+            # repeat the saturation warning at collect so it is the LAST
+            # thing in the step log, not buried between batches
+            out["saturated_sites"] = totals
+            logger.warning(
+                "object capacity was saturated during this run: %s — those "
+                "sites' feature tables and label stacks are missing the "
+                "objects beyond the cap; re-run with a higher "
+                "--max-objects to recover them",
+                ", ".join(f"'{k}': {n} site(s)" for k, n in totals.items()),
+            )
+        return out
+
+    # ------------------------------------------------- saturation bookkeeping
+    @property
+    def _saturation_path(self):
+        return self.step_dir / "saturation.json"
+
+    def _record_saturation(self, batch_index: int, saturated: dict) -> None:
+        """Persist per-batch saturation keyed by batch index, so collect
+        sees it from a fresh process (per-verb CLI runs) and a batch
+        re-run overwrites — or, when clean, clears — its own entry instead
+        of double-counting.  ``run --job N`` batches may execute as
+        concurrent processes (cluster-style fan-out), so the
+        read-modify-write is flock-serialized and the write is atomic
+        (tmp + rename): no lost entries, no torn JSON."""
+        import fcntl
+        import json
+        import os
+
+        path = self._saturation_path
+        if not saturated and not path.exists():
+            return
+        with open(path.with_suffix(".lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                state = json.loads(path.read_text()) if path.exists() else {}
+            except ValueError:
+                state = {}  # torn by a crashed writer; rebuilt from here on
+            if saturated:
+                state[str(batch_index)] = saturated
+            else:
+                state.pop(str(batch_index), None)
+            tmp = path.with_suffix(f".tmp{os.getpid()}")
+            tmp.write_text(json.dumps(state, sort_keys=True))
+            os.replace(tmp, path)
+
+    def _saturation_totals(self) -> dict:
+        import json
+
+        path = self._saturation_path
+        if not path.exists():
+            return {}
+        try:
+            state = json.loads(path.read_text())
+        except ValueError:
+            logger.warning(
+                "saturation.json is unreadable (crashed writer?) — "
+                "per-batch saturation truth remains in the run ledger"
+            )
+            return {}
+        totals: dict[str, int] = {}
+        for per_batch in state.values():
+            for k, n in per_batch.items():
+                totals[k] = totals.get(k, 0) + n
+        return totals
 
     def delete_previous_output(self) -> None:
         import shutil
@@ -418,3 +513,6 @@ class ImageAnalysisRunner(Step):
             if d.exists():
                 shutil.rmtree(d)
             d.mkdir()
+        # stale saturation signal belongs to the deleted outputs
+        self._saturation_path.unlink(missing_ok=True)
+        self._saturation_path.with_suffix(".lock").unlink(missing_ok=True)
