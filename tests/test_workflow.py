@@ -564,8 +564,12 @@ def test_cli_args_schema(capsys):
     schema = json.loads(capsys.readouterr().out)
     names = {a["name"] for a in schema}
     assert {"pipe", "batch_size", "max_objects", "figures"} <= names
+    # pipe stopped being schema-required when --layout spatial landed
+    # (the spatial path needs no module chain); sites-layout still
+    # enforces it at init time
     pipe = next(a for a in schema if a["name"] == "pipe")
-    assert pipe["required"] is True
+    assert pipe["required"] is False
+    assert "layout" in names
 
 
 def test_workflow_types_registry():
@@ -867,3 +871,188 @@ def test_no_saturation_signal_below_cap(tmp_path):
     result = jt.run(0)
     assert "saturated" not in result
     assert "saturated_sites" not in jt.collect()
+
+
+def test_spatial_layout_mosaic_segmentation(tmp_path, devices):
+    """`--layout spatial`: the well mosaic is row-sharded over the 8-CPU
+    mesh, segmented with distributed CC, and exported — an object crossing
+    a site border keeps ONE global id, and the labels are bit-identical
+    to the same chain on the unsharded mosaic (scipy scan order)."""
+    import jax.numpy as jnp
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+    from tmlibrary_tpu.ops.threshold import otsu_value
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "spatial", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI",), site_shape=(64, 64),
+    )
+    st = ExperimentStore.create(tmp_path / "spatial_exp", exp)
+    rng = np.random.default_rng(11)
+    mosaic = rng.normal(300, 20, (128, 128))
+    yy, xx = np.mgrid[0:128, 0:128]
+    # one blob dead on the 4-corner junction (spans ALL four sites) plus
+    # a few ordinary ones
+    for cy, cx in [(64, 64), (20, 30), (100, 20), (30, 100), (90, 95)]:
+        mosaic += 4000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 4.0**2))
+    mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+    tiles = np.stack([
+        mosaic[0:64, 0:64], mosaic[0:64, 64:128],
+        mosaic[64:128, 0:64], mosaic[64:128, 64:128],
+    ])
+    st.write_sites(tiles, [0, 1, 2, 3], channel=0)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8})
+    result = jt.run(0)
+    assert result["layout"] == "spatial"
+    assert result["objects"]["mosaic_cells"] == 5
+
+    labels = st.read_labels(None, "mosaic_cells")
+    # junction blob: same id in all four site stacks
+    ids = {int(labels[0][-1, -1]), int(labels[1][-1, 0]),
+           int(labels[2][0, -1]), int(labels[3][0, 0])}
+    assert len(ids) == 1 and ids != {0}
+
+    # bit-identity vs the unsharded chain (scipy scan order)
+    sm = np.asarray(gaussian_smooth(jnp.asarray(mosaic, jnp.float32), 1.5))
+    mask = sm > float(np.asarray(otsu_value(jnp.asarray(sm))))
+    golden, n = ndi.label(mask, structure=np.ones((3, 3)))
+    assert n == 5
+    restitched = np.zeros((128, 128), np.int32)
+    restitched[0:64, 0:64] = labels[0]
+    restitched[0:64, 64:128] = labels[1]
+    restitched[64:128, 0:64] = labels[2]
+    restitched[64:128, 64:128] = labels[3]
+    np.testing.assert_array_equal(restitched, golden)
+
+    # ragged feature table: one row per global object
+    feats = st.read_features("mosaic_cells")
+    assert len(feats) == 5
+    assert set(feats["label"]) == {1, 2, 3, 4, 5}
+    assert (feats["Morphology_area"] > 0).all()
+
+    collected = get_step("jterator")(st).collect()
+    assert collected["objects_total"]["mosaic_cells"] == 5
+
+
+def test_spatial_layout_divisor_fallback_and_polygons(tmp_path, devices):
+    """Mosaic rows not divisible by the requested mesh must shrink the
+    mesh (not pad, which would corrupt the Otsu cut), stay bit-identical
+    to the unsharded chain, and --as-polygons writes mosaic-frame rings."""
+    import jax.numpy as jnp
+    import pandas as pd
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+    from tmlibrary_tpu.ops.threshold import otsu_value
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "spatial2", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI",), site_shape=(50, 50),  # 100 rows: 8 -> 5 devs
+    )
+    st = ExperimentStore.create(tmp_path / "spatial2_exp", exp)
+    rng = np.random.default_rng(13)
+    mosaic = rng.normal(300, 20, (100, 100))
+    yy, xx = np.mgrid[0:100, 0:100]
+    for cy, cx in [(50, 50), (20, 75), (80, 20)]:
+        mosaic += 4000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 4.0**2))
+    mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+    tiles = np.stack([mosaic[0:50, 0:50], mosaic[0:50, 50:100],
+                      mosaic[50:100, 0:50], mosaic[50:100, 50:100]])
+    st.write_sites(tiles, [0, 1, 2, 3], channel=0)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8, "as_polygons": True})
+    result = jt.run(0)
+    assert result["objects"]["mosaic_cells"] == 3
+
+    labels = st.read_labels(None, "mosaic_cells")
+    restitched = np.zeros((100, 100), np.int32)
+    restitched[0:50, 0:50] = labels[0]
+    restitched[0:50, 50:100] = labels[1]
+    restitched[50:100, 0:50] = labels[2]
+    restitched[50:100, 50:100] = labels[3]
+    sm = np.asarray(gaussian_smooth(jnp.asarray(mosaic, jnp.float32), 1.5))
+    golden, n = ndi.label(
+        sm > float(np.asarray(otsu_value(jnp.asarray(sm)))),
+        structure=np.ones((3, 3)),
+    )
+    assert n == 3
+    np.testing.assert_array_equal(restitched, golden)
+
+    polys = pd.read_parquet(
+        st.root / "segmentations"
+        / "mosaic_cells_polygons_well_plate00_00_00.parquet"
+    )
+    assert sorted(polys["label"]) == [1, 2, 3]
+    assert (polys["site"] == -1).all()
+
+
+def test_spatial_layout_applies_illumination_correction(tmp_path, devices):
+    """When corilla statistics exist, the spatial layout must segment the
+    corrected pixels — same op as the sites layout's preprocess."""
+    import jax
+    import jax.numpy as jnp
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.ops import image_ops
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+    from tmlibrary_tpu.ops.threshold import otsu_value
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "spatial3", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI",), site_shape=(64, 64),
+    )
+    st = ExperimentStore.create(tmp_path / "spatial3_exp", exp)
+    rng = np.random.default_rng(17)
+    mosaic = rng.normal(300, 20, (128, 128))
+    yy, xx = np.mgrid[0:128, 0:128]
+    for cy, cx in [(64, 64), (30, 90)]:
+        mosaic += 4000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 4.0**2))
+    mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+    tiles = np.stack([mosaic[0:64, 0:64], mosaic[0:64, 64:128],
+                      mosaic[64:128, 0:64], mosaic[64:128, 64:128]])
+    st.write_sites(tiles, [0, 1, 2, 3], channel=0)
+    # synthetic vignetting field in the log domain
+    fy, fx = np.mgrid[0:64, 0:64]
+    mean_log = (2.5 + 0.002 * (fy + fx)).astype(np.float32)
+    std_log = np.full((64, 64), 0.3, np.float32)
+    st.write_illumstats({"mean_log": mean_log, "std_log": std_log,
+                         "n": np.int64(4)}, channel=0)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8})
+    jt.run(0)
+
+    labels = st.read_labels(None, "mosaic_cells")
+    restitched = np.zeros((128, 128), np.int32)
+    restitched[0:64, 0:64] = labels[0]
+    restitched[0:64, 64:128] = labels[1]
+    restitched[64:128, 0:64] = labels[2]
+    restitched[64:128, 64:128] = labels[3]
+
+    corrected = np.asarray(jax.jit(jax.vmap(
+        lambda im: image_ops.correct_illumination(
+            jnp.asarray(im, jnp.float32),
+            jnp.asarray(mean_log), jnp.asarray(std_log))
+    ))(jnp.asarray(tiles)))
+    golden_mosaic = np.zeros((128, 128), np.float32)
+    golden_mosaic[0:64, 0:64] = corrected[0]
+    golden_mosaic[0:64, 64:128] = corrected[1]
+    golden_mosaic[64:128, 0:64] = corrected[2]
+    golden_mosaic[64:128, 64:128] = corrected[3]
+    sm = np.asarray(gaussian_smooth(jnp.asarray(golden_mosaic), 1.5))
+    golden, n = ndi.label(
+        sm > float(np.asarray(otsu_value(jnp.asarray(sm)))),
+        structure=np.ones((3, 3)),
+    )
+    assert n >= 2
+    np.testing.assert_array_equal(restitched, golden)

@@ -19,18 +19,30 @@ def labels_to_polygons(labels: np.ndarray) -> list[tuple[int, np.ndarray]]:
     of (y, x) vertices.  Prefers the first-party native Moore tracer
     (``native/tmnative.cpp``); falls back to cv2 border following.
     """
+    import scipy.ndimage as ndi
+
     labels = np.asarray(labels)
     ids = np.unique(labels)
     ids = ids[ids > 0]
+    # trace each object on its bounding-box crop, not the full image: a
+    # per-label full-image scan/copy is O(count*H*W) — hours on a
+    # plate-scale mosaic with tens of thousands of cells.  The Moore trace
+    # starts at the object's first pixel in scan order, which the crop
+    # preserves, so contours are unchanged up to the (y0, x0) offset.
+    slices = ndi.find_objects(labels, max_label=int(ids.max()) if len(ids) else 0)
 
     from tmlibrary_tpu import native
 
     if native.available():
         out = []
-        labels32 = labels.astype(np.int32)
         for lab in ids:
-            pts = native.trace_boundary_host(labels32, int(lab))
+            sl = slices[int(lab) - 1]
+            if sl is None:
+                continue
+            crop = np.ascontiguousarray(labels[sl].astype(np.int32))
+            pts = native.trace_boundary_host(crop, int(lab))
             if pts is not None and len(pts):
+                pts = pts + np.asarray([sl[0].start, sl[1].start], np.int32)
                 out.append((int(lab), pts))
         return out
 
@@ -38,15 +50,22 @@ def labels_to_polygons(labels: np.ndarray) -> list[tuple[int, np.ndarray]]:
 
     out: list[tuple[int, np.ndarray]] = []
     for lab in ids:
-        mask = (labels == lab).astype(np.uint8)
+        sl = slices[int(lab) - 1]
+        if sl is None:
+            continue
+        offset = np.asarray([sl[0].start, sl[1].start], np.int32)
+        mask = (labels[sl] == lab).astype(np.uint8)
         contours, _ = cv2.findContours(mask, cv2.RETR_EXTERNAL, cv2.CHAIN_APPROX_SIMPLE)
         if not contours:
             ys, xs = np.nonzero(mask)
-            out.append((int(lab), np.stack([ys, xs], axis=1).astype(np.int32)))
+            out.append(
+                (int(lab),
+                 np.stack([ys, xs], axis=1).astype(np.int32) + offset)
+            )
             continue
         largest = max(contours, key=cv2.contourArea)
         # cv2 returns (K, 1, 2) in (x, y); convert to (K, 2) (y, x)
-        contour = largest[:, 0, ::-1].astype(np.int32)
+        contour = largest[:, 0, ::-1].astype(np.int32) + offset
         out.append((int(lab), contour))
     return out
 

@@ -28,6 +28,31 @@ from tmlibrary_tpu.workflow.registry import register_step
 
 logger = logging.getLogger(__name__)
 
+_CORRECT_JIT = None
+
+
+def _correct_batch(imgs, mean_log, std_log) -> "np.ndarray":
+    """Batched illumination correction, jitted ONCE (per shape) — a
+    per-well closure would recompile the same elementwise program for
+    every well of the plate."""
+    import jax
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.ops import image_ops
+
+    global _CORRECT_JIT
+    if _CORRECT_JIT is None:
+        _CORRECT_JIT = jax.jit(
+            jax.vmap(image_ops.correct_illumination, in_axes=(0, None, None))
+        )
+    return np.asarray(
+        _CORRECT_JIT(
+            jnp.asarray(imgs, jnp.float32),
+            jnp.asarray(mean_log),
+            jnp.asarray(std_log),
+        )
+    )
+
 
 def _host_shift(img: np.ndarray, dy: int, dx: int) -> np.ndarray:
     """Integer translate with zero fill — host twin of ops.image_ops.shift_image."""
@@ -47,8 +72,23 @@ def _host_shift(img: np.ndarray, dy: int, dx: int) -> np.ndarray:
 @register_step("jterator")
 class ImageAnalysisRunner(Step):
     batch_args = ArgumentCollection(
-        Argument("pipe", str, required=True,
-                 help="path to the .pipe.yaml pipeline description"),
+        Argument("pipe", str, default="",
+                 help="path to the .pipe.yaml pipeline description "
+                      "(required for --layout sites)"),
+        Argument("layout", str, default="sites", choices=("sites", "spatial"),
+                 help="'sites': vmap the module chain over per-site batches; "
+                      "'spatial': stitch each well into one mosaic, row-shard "
+                      "it over the device mesh and segment it with halo "
+                      "exchange + distributed connected components — objects "
+                      "crossing site borders get ONE id (the reference splits "
+                      "them, SURVEY.md §6 long-context row)"),
+        Argument("spatial_channel", str, default="",
+                 help="channel segmented in spatial layout "
+                      "(default: first experiment channel)"),
+        Argument("spatial_sigma", float, default=1.5,
+                 help="gaussian sigma for spatial-layout smoothing"),
+        Argument("spatial_objects", str, default="mosaic_cells",
+                 help="objects name for spatial-layout segmentation output"),
         Argument("batch_size", int, default=32, help="sites per device batch"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
@@ -70,6 +110,18 @@ class ImageAnalysisRunner(Step):
         self._window: tuple[int, int, int, int] | None = None
 
     def create_batches(self, args):
+        if args["layout"] == "spatial":
+            # one batch per well: the well mosaic is the sharding unit
+            wells: dict[tuple, list[int]] = {}
+            for i, r in enumerate(self.store.experiment.sites()):
+                key = (r.plate, r.well_row, r.well_column)
+                wells.setdefault(key, []).append(i)
+            return [
+                {"sites": idxs, "well": list(key)}
+                for key, idxs in sorted(wells.items())
+            ]
+        if not args["pipe"]:
+            raise ValueError("--pipe is required for --layout sites")
         sites = list(range(self.store.n_sites))
         return [
             {"sites": part} for part in create_partitions(sites, args["batch_size"])
@@ -105,8 +157,150 @@ class ImageAnalysisRunner(Step):
 
     # -------------------------------------------------------------------- run
     def run_batch(self, batch: dict) -> dict:
+        # .get: batch JSONs persisted by a pre-layout init lack the key
+        if batch["args"].get("layout", "sites") == "spatial":
+            return self._run_spatial(batch)
         result = self._launch(batch)
         return self._persist(batch, result)
+
+    # ------------------------------------------------------------ spatial run
+    def _run_spatial(self, batch: dict) -> dict:
+        """Whole-mosaic segmentation of one well (``--layout spatial``).
+
+        Stitch the well's sites into one mosaic (illumination-corrected
+        when corilla statistics exist — same op as the sites layout's
+        preprocess), row-shard it over the device mesh, segment with
+        halo-exact smoothing + a global Otsu cut +
+        :func:`~tmlibrary_tpu.parallel.label.distributed_connected_components`
+        (scipy scan order across the WHOLE mosaic), then export: per-site
+        label stacks carrying the global ids, a mosaic-level polygon table
+        when ``as_polygons`` is set, and a host-side ragged feature table
+        (area/centroid) for the well.  This is the rebuild's
+        context-parallelism path: objects crossing site borders keep one
+        identity, which per-site fan-out (reference or 'sites' layout)
+        cannot do.  Cycle-alignment shifts are NOT applied (the mosaic
+        path is single-cycle); ``figures`` is a sites-layout feature
+        (warned, not silently ignored)."""
+        import jax
+        import jax.numpy as jnp
+        import pandas as pd
+        from jax.sharding import Mesh
+
+        from tmlibrary_tpu.parallel.label import sharded_segment_mosaic
+
+        args = batch["args"]
+        sites = batch["sites"]
+        exp = self.store.experiment
+        tpoint, zplane = args["tpoint"], args["zplane"]
+
+        ch_name = args["spatial_channel"] or exp.channels[0].name
+        idx = exp.channel_index(ch_name)
+        imgs = self.store.read_sites(sites, cycle=args["cycle"], channel=idx,
+                                     tpoint=tpoint, zplane=zplane)
+        if self.store.has_illumstats(cycle=args["cycle"], channel=idx):
+            # the two layouts must segment the same pixels: apply the same
+            # correction the sites layout's preprocess applies
+            cont = IllumstatsContainer.from_store(
+                self.store.read_illumstats(cycle=args["cycle"], channel=idx)
+            )
+            imgs = _correct_batch(imgs, cont.mean_log, cont.std_log)
+        if args.get("figures"):
+            logger.warning(
+                "--figures is not supported in the spatial layout "
+                "(overlays are per-site artifacts); skipping"
+            )
+        refs = list(exp.sites())
+        srefs = [refs[i] for i in sites]
+        h, w = exp.site_height, exp.site_width
+        n_sy = max(r.site_y for r in srefs) + 1
+        n_sx = max(r.site_x for r in srefs) + 1
+        mosaic = np.zeros((n_sy * h, n_sx * w), np.float32)
+        for img, r in zip(imgs, srefs):
+            mosaic[r.site_y * h:(r.site_y + 1) * h,
+                   r.site_x * w:(r.site_x + 1) * w] = img
+
+        requested = args["n_devices"] or len(jax.devices())
+        requested = min(requested, len(jax.devices()))
+        hm = mosaic.shape[0]
+        # the mesh must divide the mosaic rows EXACTLY — padding rows would
+        # corrupt the global Otsu histogram and bottom-edge smoothing,
+        # breaking bit-identity with the unsharded chain; shrink to the
+        # largest divisor instead
+        n_dev = next(k for k in range(requested, 0, -1) if hm % k == 0)
+        if n_dev < requested:
+            logger.info(
+                "spatial layout: using %d of %d devices — mosaic rows %d "
+                "must divide the mesh evenly", n_dev, requested, hm,
+            )
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("rows",))
+        labels, count = sharded_segment_mosaic(
+            jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"]
+        )
+        labels = np.asarray(labels)
+        count = int(count)
+
+        name = args["spatial_objects"]
+        per_site = np.stack([
+            labels[r.site_y * h:(r.site_y + 1) * h,
+                   r.site_x * w:(r.site_x + 1) * w]
+            for r in srefs
+        ])
+        self.store.write_labels(per_site, sites, name,
+                                tpoint=tpoint, zplane=zplane)
+
+        # ragged global features, host-side (object count is dynamic here —
+        # nothing is padded to max_objects in the mosaic path).  Row-wise
+        # bincounts: no per-pixel index grids, so transient memory stays
+        # O(W + count) next to a potentially plate-scale mosaic.
+        area_i = np.bincount(labels.ravel(), minlength=count + 1)
+        cy_sum = np.zeros(count + 1)
+        cx_sum = np.zeros(count + 1)
+        col_idx = np.arange(labels.shape[1], dtype=np.float64)
+        for y in range(labels.shape[0]):
+            row = labels[y]
+            cy_sum += y * np.bincount(row, minlength=count + 1)
+            cx_sum += np.bincount(row, weights=col_idx, minlength=count + 1)
+        area = area_i[1:].astype(np.float64)
+        denom = np.maximum(area, 1)
+        cy = cy_sum[1:] / denom
+        cx = cx_sum[1:] / denom
+        plate, well_row, well_col = batch["well"]
+        table = pd.DataFrame({
+            "site_index": -1,  # mosaic objects may span several sites
+            "plate": plate,
+            "well_row": well_row,
+            "well_col": well_col,
+            "site_y": -1,
+            "site_x": -1,
+            "label": np.arange(1, count + 1, dtype=np.int64),
+            "Morphology_area": area,
+            "Morphology_centroid_y": cy,
+            "Morphology_centroid_x": cx,
+        })
+        shard = f"well_{plate}_{well_row:02d}_{well_col:02d}"
+        self.store.append_features(name, table, shard=shard)
+
+        if args.get("as_polygons"):
+            # mosaic-frame polygons: one ring per GLOBAL object, traced on
+            # the stitched label image (site_index -1 marks the frame)
+            from tmlibrary_tpu.ops.polygons import (
+                labels_to_polygons,
+                polygons_to_table,
+            )
+
+            polys = labels_to_polygons(labels)
+            if polys:
+                df = polygons_to_table(polys, site_index=-1)
+                out = (self.store.root / "segmentations"
+                       / f"{name}_polygons_{shard}.parquet")
+                df.to_parquet(out, index=False)
+
+        return {
+            "n_sites": len(sites),
+            "objects": {name: count},
+            "mosaic_shape": [int(labels.shape[0]), int(labels.shape[1])],
+            "layout": "spatial",
+        }
 
     def run_batches_pipelined(self, batches):
         """Generator over ``(batch, result_summary)`` with host work
@@ -120,6 +314,13 @@ class ImageAnalysisRunner(Step):
         reference's overlap of cluster jobs with DB writes (SURVEY.md §4.3
         crossing points) without threads or process fan-out.
         """
+        batches = list(batches)
+        if batches and batches[0]["args"].get("layout", "sites") == "spatial":
+            # the spatial path is one fused sharded program per well with
+            # host stitching on both ends — nothing to overlap
+            for b in batches:
+                yield b, self.run_batch(b)
+            return
         prev: tuple[dict, object] | None = None
         for batch in batches:
             try:
