@@ -37,7 +37,17 @@ class ImageExtractor(Step):
     def _read_plane(path: str, page: int | None, height: int, width: int):
         """One grayscale plane as uint16: first-party native TIFF reader
         (classic strip TIFF, none/LZW/PackBits — the native data-loader),
-        cv2 for everything it declines (PNG, tiled/BigTIFF, RGB, ...)."""
+        the first-party ND2 chunk-map reader for ``.nd2`` containers
+        (``page`` encodes sequence * n_components + component, as written
+        by the nd2 metaconfig handler), cv2 for everything else (PNG,
+        tiled/BigTIFF, RGB, ...)."""
+        if path.lower().endswith(".nd2"):
+            from tmlibrary_tpu.readers import ND2Reader
+
+            with ND2Reader(path) as r:
+                seq, comp = divmod(page or 0, r.n_components)
+                return r.read_plane(seq, comp)
+
         from tmlibrary_tpu.native import tiff_read
 
         img = tiff_read(path, page or 0, height, width)

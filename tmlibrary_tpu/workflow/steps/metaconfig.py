@@ -306,10 +306,18 @@ class MetadataConfigurator(Step):
             for pname, wells in sorted(by_plate.items())
         ]
 
-        probe = cv2.imread(entries[0]["path"], cv2.IMREAD_UNCHANGED)
-        if probe is None:
-            raise MetadataError(f"cannot read probe image {entries[0]['path']}")
-        h, w = probe.shape[:2]
+        probe_path = entries[0]["path"]
+        if probe_path.lower().endswith(".nd2"):
+            # container formats carry their own dimensions
+            from tmlibrary_tpu.readers import ND2Reader
+
+            with ND2Reader(probe_path) as r:
+                h, w = r.height, r.width
+        else:
+            probe = cv2.imread(probe_path, cv2.IMREAD_UNCHANGED)
+            if probe is None:
+                raise MetadataError(f"cannot read probe image {probe_path}")
+            h, w = probe.shape[:2]
 
         return Experiment(
             name=self.store.experiment.name,

@@ -978,3 +978,82 @@ def leica_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
             }
         )
     return entries, len(images) - len(matches)
+
+
+# ----------------------------------------------------------------------- nd2
+@register_sidecar_handler("nd2")
+def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """Nikon NIS-Elements ``.nd2`` containers, read by the first-party
+    chunk-map parser (:class:`tmlibrary_tpu.readers.ND2Reader` — narrows
+    the Bio-Formats gap, SURVEY.md §3 Readers row).
+
+    One file per well when a well-name token (``A01``) appears in the
+    filename; otherwise each file becomes its own well on row A.  XY
+    sequences map to sites, interleaved components to channels
+    (``C00``/``C01``/…); ``page`` encodes ``seq * n_components + comp``
+    for imextract's plane decode."""
+    from tmlibrary_tpu.readers import ND2Reader
+
+    files = sorted(source_dir.rglob("*.nd2"))
+    if not files:
+        return None
+    readable: list[tuple[Path, int, int, tuple[int, int] | None]] = []
+    skipped = 0
+    for path in files:
+        try:
+            with ND2Reader(path) as r:
+                n_seq, n_comp = r.n_sequences, r.n_components
+        except MetadataError as exc:
+            logger.warning("skipping unreadable ND2 file %s: %s", path, exc)
+            skipped += 1
+            continue
+        well = None
+        for token in re.split(r"[_\-\s]+", path.stem):
+            try:
+                well = parse_well_name_token(token)
+                break
+            except MetadataError:
+                continue
+        readable.append((path, n_seq, n_comp, well))
+
+    # well assignment: explicit tokens are authoritative and must be
+    # unique (two files on one well would silently overwrite each other's
+    # pixels in the store); token-less files take the next FREE column on
+    # row A so they can't collide with a real A-row well either
+    by_well: dict[tuple[int, int], Path] = {}
+    for path, _, _, well in readable:
+        if well is None:
+            continue
+        if well in by_well:
+            raise MetadataError(
+                f"ND2 files {by_well[well]} and {path} both claim well "
+                f"{well} — their planes would overwrite each other"
+            )
+        by_well[well] = path
+
+    entries: list[dict] = []
+    next_col = 0
+    for path, n_seq, n_comp, well in readable:
+        if well is None:
+            while (0, next_col) in by_well:
+                next_col += 1
+            well = (0, next_col)
+            by_well[well] = path
+        well_row, well_col = well
+        for seq in range(n_seq):
+            for comp in range(n_comp):
+                entries.append(
+                    {
+                        "plate": "plate00",
+                        "well_row": well_row,
+                        "well_col": well_col,
+                        "site": seq,
+                        "channel": f"C{comp:02d}",
+                        "cycle": 0,
+                        "tpoint": 0,
+                        "zplane": 0,
+                        "path": str(path),
+                        "page": seq * n_comp + comp,
+                    }
+                )
+    return entries, skipped
