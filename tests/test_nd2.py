@@ -184,3 +184,44 @@ def test_nd2_ingest_end_to_end(tmp_path):
         pixels = store.read_sites(None, channel=ch)
         np.testing.assert_array_equal(pixels[:4], wells["A01"][:, :, :, ch])
         np.testing.assert_array_equal(pixels[4:], wells["B02"][:, :, :, ch])
+
+
+def test_nd2_truncated_file_with_valid_signature(tmp_path, planes):
+    """Truncation after the signature must raise MetadataError (not a raw
+    struct.error), so ingest skips the file instead of aborting."""
+    path = tmp_path / "good.nd2"
+    write_nd2(path, planes)
+    blob = path.read_bytes()
+    bad = tmp_path / "trunc.nd2"
+    bad.write_bytes(blob[: len(blob) // 2])
+    with pytest.raises(MetadataError):
+        ND2Reader(bad).__enter__()
+    # the sidecar handler's skip path now applies
+    from tmlibrary_tpu.workflow.steps.vendors import nd2_sidecar
+
+    entries, skipped = nd2_sidecar(tmp_path)
+    assert skipped == 1
+    assert {e["path"] for e in entries} == {str(path)}
+
+
+def test_nd2_well_collision_surfaces_through_auto(tmp_path, planes):
+    """handler='auto' must re-raise the collision, not launder it into a
+    'no files matched' fallback error."""
+    from tmlibrary_tpu.errors import VendorConflictError
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_nd2(src / "run1_A01.nd2", planes)
+    write_nd2(src / "run2_A01.nd2", planes)
+    store = ExperimentStore.create(
+        tmp_path / "exp",
+        Experiment(name="collide", plates=[], channels=[],
+                   site_height=1, site_width=1),
+    )
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    with pytest.raises(VendorConflictError, match="both claim well"):
+        meta.run(0)

@@ -144,3 +144,103 @@ def test_device_trace_none_is_noop():
 
     with device_trace(None):
         pass
+
+
+def test_request_lifecycle_sync(store_with_features):
+    """Synchronous submit still records the full request lifecycle."""
+    mgr = ToolRequestManager(store_with_features)
+    mgr.submit("clustering", {"objects_name": "nuclei", "k": 2})
+    reqs = mgr.list_requests()
+    assert len(reqs) == 1
+    req = reqs[0]
+    assert req["state"] == "done"
+    assert req["tool"] == "clustering"
+    assert req["n_objects"] == 80
+    assert req["finished_at"] >= req["started_at"] >= req["submitted_at"]
+    # status() round-trips by id and keeps the payload
+    full = mgr.status(req["request"])
+    assert full["payload"] == {"objects_name": "nuclei", "k": 2}
+
+
+def test_request_lifecycle_failed(store_with_features):
+    mgr = ToolRequestManager(store_with_features)
+    with pytest.raises(Exception):
+        mgr.submit("heatmap", {"objects_name": "nuclei", "feature": "Bogus"})
+    (req,) = mgr.list_requests()
+    assert req["state"] == "failed"
+    assert "Bogus" in req["error"]
+    # unknown tool fails at submit, before any request dir exists
+    with pytest.raises(RegistryError):
+        mgr.create_request("nope", {})
+    assert len(mgr.list_requests()) == 1
+
+
+def test_request_background_end_to_end(store_with_features, monkeypatch):
+    """--background spawns a detached job whose state transitions to done
+    (reference ToolJob fan-out)."""
+    import time
+
+    # the child must not inherit a pinned-but-possibly-dead TPU relay
+    monkeypatch.setenv("TMX_PLATFORM", "cpu")
+
+    mgr = ToolRequestManager(store_with_features)
+    request_id = mgr.submit_async("clustering", {"objects_name": "nuclei", "k": 2})
+    assert mgr.status(request_id)["state"] in ("submitted", "running", "done")
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        state = mgr.status(request_id)["state"]
+        if state in ("done", "failed"):
+            break
+        time.sleep(1)
+    final = mgr.status(request_id)
+    assert final["state"] == "done", final
+    assert final["n_objects"] == 80
+    # the detached job captured its log
+    assert (store_with_features.tools_dir / request_id / "tool.log").exists()
+    # and the result itself is loadable
+    results = mgr.list_results()
+    assert any(r["request"] == request_id for r in results)
+
+
+def test_cli_tool_status_and_workflow_status(store_with_features, capsys):
+    import json as _json
+
+    from tmlibrary_tpu.cli import main
+
+    root = str(store_with_features.root)
+    assert main([
+        "tool", "submit", "--root", root, "--name", "clustering",
+        "--payload", '{"objects_name": "nuclei", "k": 2}',
+    ]) == 0
+    capsys.readouterr()
+    assert main(["tool", "list", "--root", root]) == 0
+    (line,) = capsys.readouterr().out.strip().splitlines()
+    entry = _json.loads(line)
+    assert entry["state"] == "done"
+    assert main(["tool", "status", "--root", root,
+                 "--request", entry["request"]]) == 0
+    status = _json.loads(capsys.readouterr().out)
+    assert status["state"] == "done" and "payload" in status
+
+
+def test_same_millisecond_requests_get_distinct_ids(store_with_features,
+                                                   monkeypatch):
+    import time as _time
+
+    mgr = ToolRequestManager(store_with_features)
+    monkeypatch.setattr(_time, "time", lambda: 1234.567)
+    a = mgr.create_request("clustering", {"k": 2})
+    b = mgr.create_request("clustering", {"k": 3})
+    assert a != b
+    assert mgr.status(a)["payload"] == {"k": 2}
+    assert mgr.status(b)["payload"] == {"k": 3}
+
+
+def test_status_of_pre_ledger_result_dir(store_with_features):
+    d = store_with_features.tools_dir / "clustering_legacy"
+    d.mkdir(parents=True)
+    (d / "result.json").write_text('{"tool": "clustering"}')
+    mgr = ToolRequestManager(store_with_features)
+    assert mgr.status("clustering_legacy") == {
+        "request": "clustering_legacy", "state": "done"
+    }

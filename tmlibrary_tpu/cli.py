@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
@@ -159,8 +160,21 @@ def build_parser() -> argparse.ArgumentParser:
                            help="request payload as inline JSON")
     p_tsubmit.add_argument("--payload-file", default=None,
                            help="request payload from a JSON file")
-    p_tlist = tool_sub.add_parser("list", help="persisted tool results")
+    p_tsubmit.add_argument("--background", action="store_true",
+                           help="run the request as a detached job and "
+                                "return its id immediately (reference "
+                                "ToolJob fan-out); poll with 'tool status'")
+    p_tlist = tool_sub.add_parser(
+        "list", help="tool requests with lifecycle state")
     _add_common(p_tlist)
+    p_tstatus = tool_sub.add_parser("status", help="one request's state")
+    _add_common(p_tstatus)
+    p_tstatus.add_argument("--request", required=True)
+    p_trun = tool_sub.add_parser(
+        "run-request", help="execute a submitted request (internal: the "
+                            "--background job body)")
+    _add_common(p_trun)
+    p_trun.add_argument("--request", required=True)
     tool_sub.add_parser("available", help="registered tool names")
 
     p_proj = sub.add_parser("project", help="manage a jterator pipeline project")
@@ -248,6 +262,15 @@ def cmd_workflow(args) -> int:
             if entry.get("error"):
                 line += f" error: {entry['error']}"
             print(line)
+        # tool request lifecycle (reference ToolRequestManager submissions
+        # surface in the same status view the UI polls)
+        from tmlibrary_tpu.tools.base import ToolRequestManager
+
+        for req in ToolRequestManager(store).list_requests():
+            line = f"tool:{req['request']:30s} {req.get('state', '?'):8s}"
+            if req.get("error"):
+                line += f" error: {req['error']}"
+            print(line)
         return 0
     if args.verb == "cleanup":
         from tmlibrary_tpu.models.mapobject import MapobjectTypeRegistry
@@ -308,6 +331,10 @@ def cmd_tool(args) -> int:
             payload = json.loads(Path(args.payload_file).read_text())
         else:
             payload = json.loads(args.payload)
+        if args.background:
+            request_id = manager.submit_async(args.name, payload)
+            print(json.dumps(manager.status(request_id), default=str))
+            return 0
         result = manager.submit(args.name, payload)
         print(json.dumps(
             {
@@ -320,8 +347,15 @@ def cmd_tool(args) -> int:
             default=str,
         ))
         return 0
+    if args.verb == "status":
+        print(json.dumps(manager.status(args.request), default=str))
+        return 0
+    if args.verb == "run-request":
+        manager.run_request(args.request)
+        print(json.dumps(manager.status(args.request), default=str))
+        return 0
     # list
-    for entry in manager.list_results():
+    for entry in manager.list_requests():
         print(json.dumps(entry, default=str))
     return 0
 
@@ -631,6 +665,15 @@ def cmd_export(args) -> int:
 
 
 def main(argv=None) -> int:
+    # TMX_PLATFORM=cpu forces the backend IN-PROCESS before first use:
+    # plain JAX_PLATFORMS is overridden by TPU-relay site configs, and a
+    # detached job (tool run-request) inheriting a pinned-but-dead relay
+    # would hang in backend init forever
+    platform = os.environ.get("TMX_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     args = build_parser().parse_args(argv)
     configure_logging(getattr(args, "verbosity", 0))
     from tmlibrary_tpu.utils import enable_compilation_cache

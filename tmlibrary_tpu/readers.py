@@ -133,12 +133,24 @@ class ND2Reader(Reader):
         if len(self._data) < 56 or self._data[16:48] != self.SIG_FILE:
             self.__exit__()
             raise MetadataError(f"not an ND2 v3 container: {self.filename}")
+        import struct
+
         try:
             self._chunks = self._parse_chunk_map()
             attrs = self._attributes()
-        except Exception:
+        except MetadataError:
             self.__exit__()
             raise
+        except (struct.error, OverflowError, IndexError, ValueError,
+                UnicodeDecodeError) as exc:
+            # a truncated file keeps a valid signature but its trailing
+            # bytes parse as garbage offsets — callers (the nd2 metaconfig
+            # handler) skip on MetadataError, not on raw struct errors
+            self.__exit__()
+            raise MetadataError(
+                f"corrupt ND2 container {self.filename}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
         self.width = int(attrs["uiWidth"])
         self.height = int(attrs["uiHeight"])
         self.n_components = int(attrs.get("uiComp", 1))

@@ -194,18 +194,121 @@ class Tool(abc.ABC):
 
 
 class ToolRequestManager:
-    """Submit tool requests and persist results
-    (reference ``tmlib/tools/manager.py``, minus GC3Pie job fan-out)."""
+    """Submit tool requests with a persisted lifecycle
+    (reference ``tmlib/tools/manager.py`` ``ToolRequestManager``: submits
+    ``ToolJob``s via GC3Pie and records request state in the DB — here
+    the job fan-out is a detached subprocess and the state lives in
+    ``<store>/tools/<request>/request.json``).
+
+    States: ``submitted`` → ``running`` → ``done`` | ``failed``.
+    """
 
     def __init__(self, store: ExperimentStore):
         self.store = store
 
+    # ------------------------------------------------------------ lifecycle
+    def _request_dir(self, request_id: str) -> "Path":
+        return self.store.tools_dir / request_id
+
+    def _write_state(self, request_id: str, **updates: Any) -> dict:
+        path = self._request_dir(request_id) / "request.json"
+        state = json.loads(path.read_text()) if path.exists() else {}
+        state.update(updates)
+        path.write_text(json.dumps(state, default=str, sort_keys=True))
+        return state
+
+    def create_request(self, tool_name: str, payload: dict[str, Any]) -> str:
+        get_tool(tool_name)  # unknown tools fail at submit, not in the job
+        base = f"{tool_name}_{int(time.time() * 1000):x}"
+        request_id = base
+        for attempt in range(1, 1000):
+            try:  # same-millisecond submissions must not share a dir
+                self._request_dir(request_id).mkdir(parents=True, exist_ok=False)
+                break
+            except FileExistsError:
+                request_id = f"{base}_{attempt}"
+        self._write_state(
+            request_id,
+            tool=tool_name,
+            payload=payload,
+            state="submitted",
+            submitted_at=time.time(),
+        )
+        return request_id
+
     def submit(self, tool_name: str, payload: dict[str, Any]) -> ToolResult:
-        tool = get_tool(tool_name)(self.store)
-        result = tool.process(payload)
-        request_id = f"{tool_name}_{int(time.time() * 1000):x}"
-        result.save(self.store.tools_dir / request_id)
+        """Synchronous submit: create the request, run it, return the
+        result (the request lifecycle is recorded either way)."""
+        return self.run_request(self.create_request(tool_name, payload))
+
+    def submit_async(self, tool_name: str, payload: dict[str, Any]) -> str:
+        """Detached submit (reference ``ToolJob`` fan-out): spawns
+        ``tmx tool run-request`` as its own session with stdout/stderr
+        captured to ``<request>/tool.log`` and returns the request id
+        immediately.  Poll with :meth:`status` / ``tmx tool list``."""
+        import subprocess
+        import sys
+
+        request_id = self.create_request(tool_name, payload)
+        log = open(self._request_dir(request_id) / "tool.log", "w")
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "tmlibrary_tpu.cli", "tool",
+                "run-request", "--root", str(self.store.root),
+                "--request", request_id,
+            ],
+            stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+        log.close()
+        return request_id
+
+    def run_request(self, request_id: str) -> ToolResult:
+        """Execute one submitted request, updating its persisted state."""
+        req = json.loads(
+            (self._request_dir(request_id) / "request.json").read_text()
+        )
+        self._write_state(request_id, state="running", started_at=time.time())
+        try:
+            tool = get_tool(req["tool"])(self.store)
+            result = tool.process(req["payload"])
+            result.save(self._request_dir(request_id))
+        except Exception as exc:
+            self._write_state(
+                request_id, state="failed", finished_at=time.time(),
+                error=f"{type(exc).__name__}: {exc}",
+            )
+            raise
+        self._write_state(
+            request_id, state="done", finished_at=time.time(),
+            layer_type=result.layer_type, n_objects=int(len(result.values)),
+        )
         return result
+
+    def status(self, request_id: str) -> dict:
+        path = self._request_dir(request_id) / "request.json"
+        if not path.exists():
+            # pre-ledger request dirs hold only result.json; report them
+            # exactly the way list_requests() does
+            if (self._request_dir(request_id) / "result.json").exists():
+                return {"request": request_id, "state": "done"}
+            raise RegistryError(f"no tool request '{request_id}'")
+        return {"request": request_id, **json.loads(path.read_text())}
+
+    def list_requests(self) -> list[dict]:
+        """Every request with its lifecycle state, newest last.  Requests
+        predating the lifecycle ledger (bare result dirs) appear as
+        ``done`` with no timing."""
+        out = []
+        for d in sorted(self.store.tools_dir.iterdir()):
+            meta = d / "request.json"
+            if meta.exists():
+                entry = {"request": d.name, **json.loads(meta.read_text())}
+                entry.pop("payload", None)  # keep the listing line compact
+                out.append(entry)
+            elif (d / "result.json").exists():
+                out.append({"request": d.name, "state": "done"})
+        return out
 
     def list_results(self) -> list[dict]:
         out = []

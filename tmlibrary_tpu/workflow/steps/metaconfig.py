@@ -27,7 +27,7 @@ import re
 from collections import defaultdict
 from pathlib import Path
 
-from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.errors import MetadataError, VendorConflictError
 from tmlibrary_tpu.models.experiment import Channel, Experiment, Plate, Site, Well
 from tmlibrary_tpu.models.store import ExperimentStore
 from tmlibrary_tpu.workflow.api import Step
@@ -169,6 +169,11 @@ class MetadataConfigurator(Step):
             for name in names:
                 try:
                     result = SIDECAR_HANDLERS[name](src)
+                except VendorConflictError:
+                    # a data-integrity conflict (e.g. two containers claim
+                    # one well) must surface, not be laundered into a
+                    # "no files matched" fallback error
+                    raise
                 except MetadataError:
                     if not is_auto:
                         raise

@@ -1025,7 +1025,9 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
         if well is None:
             continue
         if well in by_well:
-            raise MetadataError(
+            from tmlibrary_tpu.errors import VendorConflictError
+
+            raise VendorConflictError(
                 f"ND2 files {by_well[well]} and {path} both claim well "
                 f"{well} — their planes would overwrite each other"
             )
