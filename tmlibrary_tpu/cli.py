@@ -459,7 +459,11 @@ def _export_images(store: ExperimentStore, args, out: Path) -> int:
     original/corrected image download endpoints).  Every tpoint/zplane is
     exported; names use the default filename handler's grammar
     (``[<plate>_]<well>_s<site>[_t<t>][_z<z>]_<channel>.tif``) so the
-    exported tree re-ingests as-is."""
+    exported tree re-ingests as-is — EXCEPT under ``--align`` when a
+    cycle-intersection window is stored: aligned exports are cropped to
+    the intersection (smaller than the manifest site shape, matching what
+    the analysis actually saw), so that tree re-ingests only as a new
+    experiment, not back into this one."""
     import re as _re
 
     import cv2
