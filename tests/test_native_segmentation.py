@@ -137,3 +137,72 @@ def test_auto_resolves_native_on_cpu():
 def test_env_override_disables_native(monkeypatch):
     monkeypatch.setenv("TMX_NATIVE", "0")
     assert not native.cpu_native_enabled()
+
+
+#: a stale prebuilt library can hold the 2-D kernels without the 3-D ones
+needs_3d = pytest.mark.skipif(
+    not native.has_3d_kernels(),
+    reason="native 3-D segmentation kernels unavailable",
+)
+
+
+def _blob_volume(rng, z=12, size=48, n_blobs=8):
+    vol = np.zeros((z, size, size), bool)
+    zz, yy, xx = np.mgrid[:z, :size, :size]
+    for _ in range(n_blobs):
+        cz = rng.integers(2, z - 2)
+        cy, cx = rng.integers(4, size - 4, 2)
+        r = rng.integers(2, 6)
+        vol |= (zz - cz) ** 2 + (yy - cy) ** 2 + (xx - cx) ** 2 <= r**2
+    return vol
+
+
+@needs_3d
+@pytest.mark.parametrize("connectivity", [6, 18, 26])
+def test_cc3d_native_matches_xla(rng, connectivity):
+    from tmlibrary_tpu.ops.volume import connected_components_3d
+
+    for trial in range(3):
+        vol = _blob_volume(rng)
+        ln, cn = connected_components_3d(vol, connectivity, method="native")
+        lx, cx = connected_components_3d(vol, connectivity, method="xla")
+        np.testing.assert_array_equal(np.asarray(ln), np.asarray(lx))
+        assert int(cn) == int(cx)
+
+
+@needs_3d
+def test_cc3d_native_matches_scipy(rng):
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.ops.volume import connected_components_3d
+
+    vol = _blob_volume(rng)
+    ln, cn = connected_components_3d(vol, 26, method="native")
+    golden, n = ndi.label(vol, structure=np.ones((3, 3, 3)))
+    assert int(cn) == n
+    np.testing.assert_array_equal(np.asarray(ln), golden)
+
+
+@needs_3d
+@pytest.mark.parametrize("n_levels", [4, 16])
+def test_watershed3d_native_matches_xla(rng, n_levels):
+    from tmlibrary_tpu.ops.volume import watershed_from_seeds_3d
+
+    for trial in range(3):
+        z, size = 10, 40
+        vol = _blob_volume(rng, z, size)
+        intensity = rng.normal(size=(z, size, size)).astype(np.float32)
+        intensity += 3.0 * vol
+        seeds = np.zeros((z, size, size), np.int32)
+        zs, ys, xs = np.nonzero(vol)
+        for i, k in enumerate(
+            rng.choice(len(zs), size=min(6, len(zs)), replace=False)
+        ):
+            seeds[zs[k], ys[k], xs[k]] = i + 1
+        wn = watershed_from_seeds_3d(
+            intensity, seeds, vol, n_levels=n_levels, method="native"
+        )
+        wx = watershed_from_seeds_3d(
+            intensity, seeds, vol, n_levels=n_levels, method="xla"
+        )
+        np.testing.assert_array_equal(np.asarray(wn), np.asarray(wx))

@@ -140,6 +140,24 @@ def _load_locked():
             "native library predates the CPU segmentation kernels; "
             "rebuild native/"
         )
+    try:
+        lib.tm_cc_label3d.restype = ctypes.c_int32
+        lib.tm_cc_label3d.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.tm_watershed_levels3d.restype = ctypes.c_int32
+        lib.tm_watershed_levels3d.argtypes = [
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_float), ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+    except AttributeError:
+        logger.info(
+            "native library predates the 3-D segmentation kernels; "
+            "rebuild native/"
+        )
     _lib = lib
     return _lib
 
@@ -522,4 +540,57 @@ def watershed_levels_host(
     )
     if rc != 0:
         raise ValueError("tm_watershed_levels: invalid arguments")
+    return out
+
+
+def has_3d_kernels() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "tm_watershed_levels3d")
+
+
+def cc_label3d_host(
+    mask: np.ndarray, connectivity: int = 26
+) -> tuple[np.ndarray, int]:
+    """3-D connected components, scipy scan order (native union-find)."""
+    mask = np.ascontiguousarray(mask.astype(np.uint8))
+    z, h, w = mask.shape
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_cc_label3d"):
+        raise RuntimeError("native 3-D CC unavailable; use the XLA path")
+    out = np.empty((z, h, w), np.int32)
+    n = lib.tm_cc_label3d(
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), z, h, w,
+        connectivity, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if n < 0:
+        raise ValueError("tm_cc_label3d: invalid arguments")
+    return out, int(n)
+
+
+def watershed_levels3d_host(
+    intensity: np.ndarray,
+    seeds: np.ndarray,
+    mask: np.ndarray,
+    levels: np.ndarray,
+) -> np.ndarray:
+    """3-D level-ordered watershed flooding, bit-identical to the XLA
+    path of ``ops.volume.watershed_from_seeds_3d`` (26-neighbor)."""
+    intensity = np.ascontiguousarray(intensity, np.float32)
+    seeds = np.ascontiguousarray(seeds, np.int32)
+    mask = np.ascontiguousarray(mask.astype(np.uint8))
+    levels = np.ascontiguousarray(levels, np.float32)
+    z, h, w = mask.shape
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_watershed_levels3d"):
+        raise RuntimeError("native 3-D watershed unavailable; use the XLA path")
+    out = np.empty((z, h, w), np.int32)
+    rc = lib.tm_watershed_levels3d(
+        intensity.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        seeds.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), z, h, w,
+        levels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), len(levels),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+    )
+    if rc != 0:
+        raise ValueError("tm_watershed_levels3d: invalid arguments")
     return out

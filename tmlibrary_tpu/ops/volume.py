@@ -64,15 +64,49 @@ def _run_min_scan_3d(labels: jax.Array, mask: jax.Array, axis: int) -> jax.Array
     return jnp.where(mask, bwd, _BIG)
 
 
+def _native_3d() -> bool:
+    from tmlibrary_tpu import native
+
+    return native.cpu_native_enabled() and native.has_3d_kernels()
+
+
 def connected_components_3d(
-    mask: jax.Array, connectivity: int = 26
+    mask: jax.Array, connectivity: int = 26, method: str = "auto"
 ) -> tuple[jax.Array, jax.Array]:
     """Label 3-D connected components; scipy scan order, like the 2-D op.
 
     ``connectivity``: 6 (faces), 18 (faces+edges), 26 (full).
+    ``method="auto"`` routes to the native union-find (``tm_cc_label3d``)
+    on the cpu backend — same dispatch order as the 2-D ops (native →
+    xla; no pallas twin in 3-D yet).
     """
     mask = jnp.asarray(mask, bool)
     z, h, w = mask.shape
+    if connectivity not in (6, 18, 26):
+        # validate BEFORE dispatch: the xla diag-shift enumeration would
+        # silently treat e.g. the 2-D habit value 8 as 26-connectivity
+        # while the native kernel rejects it — backend-dependent behavior
+        raise ValueError("3-D connectivity must be 6, 18 or 26")
+    if method == "auto":
+        method = "native" if _native_3d() else "xla"
+    if method == "native":
+        import numpy as np
+
+        from tmlibrary_tpu import native
+
+        def _cc3d_host(m):
+            labels, count = native.cc_label3d_host(np.asarray(m), connectivity)
+            return labels, np.int32(count)
+
+        return jax.pure_callback(
+            _cc3d_host,
+            (
+                jax.ShapeDtypeStruct((z, h, w), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            ),
+            mask,
+            vmap_method="sequential",
+        )
     shifts = _diag_shifts_3d(connectivity)
     linear = jnp.arange(z * h * w, dtype=jnp.int32).reshape(z, h, w)
     init = jnp.where(mask, linear, _BIG)
@@ -134,14 +168,38 @@ def watershed_from_seeds_3d(
     seeds: jax.Array,
     mask: jax.Array,
     n_levels: int = 16,
+    method: str = "auto",
 ) -> jax.Array:
-    """3-D level-ordered flooding (same scheme as the 2-D watershed)."""
+    """3-D level-ordered flooding (same scheme as the 2-D watershed).
+
+    ``method="auto"`` routes to the native frontier flood
+    (``tm_watershed_levels3d``) on the cpu backend; the level thresholds
+    are computed by the same jitted expression either way, so band
+    membership is decided by exact float comparisons (bit-identical)."""
     intensity = jnp.asarray(intensity, jnp.float32)
     seeds = jnp.asarray(seeds, jnp.int32)
     mask = jnp.asarray(mask, bool) | (seeds > 0)
     lo = jnp.min(jnp.where(mask, intensity, jnp.inf))
     hi = jnp.max(jnp.where(mask, intensity, -jnp.inf))
     span = jnp.maximum(hi - lo, 1e-6)
+
+    if method == "auto":
+        method = "native" if _native_3d() else "xla"
+    if method == "native":
+        import numpy as np
+
+        from tmlibrary_tpu import native
+
+        i = jnp.arange(n_levels, dtype=jnp.int32)
+        levels = hi - span * (i + 1) / n_levels
+        return jax.pure_callback(
+            lambda im, sd, mk, lv: native.watershed_levels3d_host(
+                np.asarray(im), np.asarray(sd), np.asarray(mk), np.asarray(lv)
+            ),
+            jax.ShapeDtypeStruct(intensity.shape, jnp.int32),
+            intensity, seeds, mask, levels,
+            vmap_method="sequential",
+        )
 
     def level_body(i, labels):
         level = hi - span * (i + 1) / n_levels
