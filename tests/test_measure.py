@@ -533,3 +533,23 @@ def test_point_pattern_border_distance_euclidean():
     assert np.isclose(got, exp, rtol=1e-5), (got, exp)
     # and it IS the diagonal neighbor of the hole, not a chamfer ring count
     assert np.isclose(exp, np.sqrt(4.0**2 + 5.0**2))
+
+
+def test_zernike_host_matches_xla():
+    """The foreground-only host twin must agree with the device basis
+    projection (f64 vs f32 summation: tolerance, not bit-identity)."""
+    from tmlibrary_tpu.ops.measure import zernike_features
+
+    labels = np.zeros((96, 96), np.int32)
+    yy, xx = np.mgrid[0:96, 0:96]
+    for i, (cy, cx, ry, rx) in enumerate(
+        [(25, 25, 12, 7), (70, 30, 9, 9), (50, 70, 14, 6)]
+    ):
+        labels[(((xx - cx) / rx) ** 2 + ((yy - cy) / ry) ** 2) <= 1.0] = i + 1
+    host = zernike_features(jnp.asarray(labels), 8, degree=6, method="host")
+    xla = zernike_features(jnp.asarray(labels), 8, degree=6, method="xla")
+    assert set(host) == set(xla)
+    for k in host:
+        np.testing.assert_allclose(
+            np.asarray(host[k]), np.asarray(xla[k]), rtol=2e-3, atol=2e-4
+        )

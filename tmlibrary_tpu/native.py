@@ -459,8 +459,6 @@ def cpu_native_enabled() -> bool:
     to these native kernels via ``jax.pure_callback``.  ``TMX_NATIVE=0``
     forces the portable XLA path; TPU/GPU backends never take this branch
     (resolution order pinned in each op's docstring)."""
-    import os
-
     import jax
 
     if jax.default_backend() != "cpu":
@@ -468,6 +466,15 @@ def cpu_native_enabled() -> bool:
     lib = _load()
     if lib is None or not hasattr(lib, "tm_watershed_levels"):
         return False
+    return tmx_native_env_enabled()
+
+
+def tmx_native_env_enabled() -> bool:
+    """The ONE parser of the ``TMX_NATIVE`` kill switch — every
+    cpu-fallback host routing (native kernels, zernike host twin) shares
+    it so the flag disables them all at once."""
+    import os
+
     return os.environ.get("TMX_NATIVE", "1") not in ("0", "false", "no")
 
 

@@ -673,11 +673,75 @@ def _zernike_coeffs(degree: int) -> list[tuple[int, int, np.ndarray]]:
     return out
 
 
+def _host_ok() -> bool:
+    """Shared gate with the native segmentation path (TMX_NATIVE=0 turns
+    every cpu-fallback host routing off at once)."""
+    from tmlibrary_tpu.native import tmx_native_env_enabled
+
+    return tmx_native_env_enabled()
+
+
+def _zernike_host(labels: "np.ndarray", max_objects: int, degree: int) -> "np.ndarray":
+    """Host twin of the device Zernike projection, restricted to the
+    object pixels (the XLA path evaluates the whole basis over EVERY
+    image pixel — fine on TPU where it is fused VPU work, but it
+    dominated the CPU-fallback full-feature bench at ~31 ms/site for
+    typically ~10% foreground).  Same math, numpy, fg pixels only.
+    Returns (max_objects, n_table) float32 magnitudes."""
+    labels = np.asarray(labels)
+    table = _zernike_coeffs(degree)
+    out = np.zeros((max_objects, len(table)), np.float32)
+    area = np.bincount(
+        labels.ravel(), minlength=max_objects + 1
+    )[1:max_objects + 1].astype(np.float64)
+    ys, xs = np.nonzero(labels)
+    lab = labels[ys, xs]
+    keep = lab <= max_objects
+    ys, xs, lab = ys[keep], xs[keep], lab[keep]
+    if len(lab) == 0:
+        return out
+    safe_a = np.maximum(area, 1.0)
+    cy = np.bincount(lab, weights=ys, minlength=max_objects + 1)[1:] / safe_a
+    cx = np.bincount(lab, weights=xs, minlength=max_objects + 1)[1:] / safe_a
+    dy = ys - cy[lab - 1]
+    dx = xs - cx[lab - 1]
+    r2 = dy * dy + dx * dx
+    r2_max = np.zeros(max_objects, np.float64)
+    np.maximum.at(r2_max, lab - 1, r2)
+    r_obj = np.sqrt(np.maximum(np.where(area > 0, r2_max, 1.0), 1.0))
+    rho = np.sqrt(r2) / r_obj[lab - 1]
+    theta = np.arctan2(dy, dx)
+    ok = (rho <= 1.0).astype(np.float64)  # fp-rounding guard, like the XLA path
+    rho_pow = [np.ones_like(rho)]
+    for _ in range(degree):
+        rho_pow.append(rho_pow[-1] * rho)
+    cos_m = [np.ones_like(theta)]
+    sin_m = [np.zeros_like(theta)]
+    for m_ in range(1, degree + 1):
+        cos_m.append(np.cos(m_ * theta))
+        sin_m.append(np.sin(m_ * theta))
+    for idx, (n, m_, coeffs) in enumerate(table):
+        radial = np.zeros_like(rho)
+        for k, c in enumerate(coeffs):
+            radial = radial + float(c) * rho_pow[n - 2 * k]
+        base = radial * ok
+        re = np.bincount(
+            lab, weights=base * cos_m[m_], minlength=max_objects + 1
+        )[1:]
+        im = np.bincount(
+            lab, weights=base * sin_m[m_], minlength=max_objects + 1
+        )[1:]
+        mag = np.sqrt(re * re + im * im) * (n + 1) / np.pi / safe_a
+        out[:, idx] = np.where(area > 0, mag, 0.0)
+    return out
+
+
 def zernike_features(
     labels: jax.Array,
     max_objects: int,
     degree: int = 9,
     patch: int | None = None,
+    method: str = "auto",
 ) -> dict[str, jax.Array]:
     """Zernike moment magnitudes |Z_nm| per object
     (reference: ``jtlib/features/zernike.py`` via centrosome/mahotas:
@@ -694,10 +758,30 @@ def zernike_features(
     size, no dynamic-slice gathers.
 
     ``patch`` is accepted for backward compatibility and ignored.
+    ``method="auto"`` routes to the foreground-only host twin
+    (:func:`_zernike_host`) on the cpu backend — same dispatch gate as
+    the native segmentation kernels (``TMX_NATIVE=0`` forces xla); the
+    host path agrees within float tolerance (it sums per-object in f64,
+    the device path in f32), which the golden tests' 2e-3 rtol covers.
     """
     del patch  # patch-free since round 2; kept for YAML/handle compat
     labels = jnp.asarray(labels, jnp.int32)
     h, w = labels.shape
+
+    if method == "auto":
+        method = "host" if jax.default_backend() == "cpu" and _host_ok() else "xla"
+    if method == "host":
+        table = _zernike_coeffs(degree)
+        proj = jax.pure_callback(
+            lambda lb: _zernike_host(lb, max_objects, degree),
+            jax.ShapeDtypeStruct((max_objects, len(table)), jnp.float32),
+            labels,
+            vmap_method="sequential",
+        )
+        return {
+            f"Zernike_{n}_{m_}": proj[:, idx]
+            for idx, (n, m_, _) in enumerate(table)
+        }
     yy, xx = jnp.meshgrid(
         jnp.arange(h, dtype=jnp.float32), jnp.arange(w, dtype=jnp.float32), indexing="ij"
     )
