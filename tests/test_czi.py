@@ -1,0 +1,197 @@
+"""First-party Zeiss CZI (ZISRAW) container support — second entry in the
+Bio-Formats-gap program after ND2.
+
+``write_czi`` emits the layout ``CZIReader`` documents: 32-byte segment
+headers, a ZISRAWFILE header segment pointing at a ZISRAWDIRECTORY of
+DirectoryEntryDV records, and uncompressed Gray16 ZISRAWSUBBLOCK segments
+whose data starts at payload offset max(256, 16+entry) + metadata."""
+import struct
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.readers import CZIReader
+
+
+def _segment(sid: bytes, payload: bytes) -> bytes:
+    header = sid.ljust(16, b"\x00") + struct.pack("<qq", len(payload), len(payload))
+    return header + payload
+
+
+def _entry(pixel_type, file_pos, compression, dims) -> bytes:
+    """dims: list of (name, start, size)."""
+    out = b"DV" + struct.pack("<iqii", pixel_type, file_pos, 0, compression)
+    out += b"\x00" * 6  # PyramidType + reserved
+    out += struct.pack("<i", len(dims))
+    for name, start, size in dims:
+        out += name.encode().ljust(4, b"\x00")
+        out += struct.pack("<iifi", start, size, float(start), size)
+    return out
+
+
+def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0) -> None:
+    """``planes``: (S, C, H, W) uint16 — one z-plane, one tpoint."""
+    n_s, n_c, h, w = planes.shape
+    blob = bytearray()
+    # file header segment: payload with directory position at offset 36
+    file_payload = bytearray(512)
+    blob.extend(_segment(b"ZISRAWFILE", bytes(file_payload)))
+
+    entries = []
+    for s in range(n_s):
+        for c in range(n_c):
+            dims = [("X", 0, w), ("Y", 0, h), ("C", c, 1), ("Z", 0, 1),
+                    ("T", 0, 1), ("S", s, 1)]
+            file_pos = len(blob)
+            entry = _entry(pixel_type, file_pos, compression, dims)
+            data = planes[s, c].tobytes()
+            sub_payload = bytearray(struct.pack("<iiq", 0, 0, len(data)))
+            sub_payload += entry
+            pad = max(256, 16 + len(entry)) - len(sub_payload)
+            sub_payload += b"\x00" * pad
+            sub_payload += data
+            blob.extend(_segment(b"ZISRAWSUBBLOCK", bytes(sub_payload)))
+            entries.append(_entry(pixel_type, file_pos, compression, dims))
+
+    dir_pos = len(blob)
+    dir_payload = struct.pack("<i", len(entries)) + b"\x00" * 124
+    dir_payload += b"".join(entries)
+    blob.extend(_segment(b"ZISRAWDIRECTORY", dir_payload))
+    # patch DirectoryPosition into the file header payload at the spec
+    # offset: major(4) minor(4) reserved(8) guids(32) file_part(4) = 52
+    struct.pack_into("<q", blob, 32 + 52, dir_pos)
+    path.write_bytes(bytes(blob))
+
+
+@pytest.fixture()
+def planes():
+    rng = np.random.default_rng(41)
+    return rng.integers(0, 4000, (3, 2, 24, 40), dtype=np.uint16)
+
+
+def test_czi_reader_round_trip(tmp_path, planes):
+    path = tmp_path / "exp.czi"
+    write_czi(path, planes)
+    with CZIReader(path) as r:
+        assert (r.width, r.height) == (40, 24)
+        assert r.n_scenes == 3 and r.n_channels == 2
+        assert r.n_zplanes == 1 and r.n_tpoints == 1
+        for s in range(3):
+            for c in range(2):
+                np.testing.assert_array_equal(
+                    r.read_plane(s, c), planes[s, c]
+                )
+                np.testing.assert_array_equal(
+                    r.read_plane_linear(s * 2 + c), planes[s, c]
+                )
+
+
+def test_czi_reader_rejects_garbage(tmp_path):
+    path = tmp_path / "junk.czi"
+    path.write_bytes(b"definitely not zisraw" * 8)
+    with pytest.raises(MetadataError, match="not a CZI"):
+        CZIReader(path).__enter__()
+
+
+def test_czi_reader_rejects_compressed(tmp_path, planes):
+    path = tmp_path / "jxr.czi"
+    write_czi(path, planes, compression=4)  # JPEG-XR
+    with CZIReader(path) as r:
+        with pytest.raises(MetadataError, match="compressed"):
+            r.read_plane(0, 0)
+
+
+def test_czi_reader_rejects_non_gray16(tmp_path, planes):
+    path = tmp_path / "f32.czi"
+    write_czi(path, planes, pixel_type=12)  # Gray32Float
+    with CZIReader(path) as r:
+        with pytest.raises(MetadataError, match="Gray16"):
+            r.read_plane(0, 0)
+
+
+def test_czi_truncated_raises_metadata_error(tmp_path, planes):
+    path = tmp_path / "good.czi"
+    write_czi(path, planes)
+    blob = path.read_bytes()
+    bad = tmp_path / "trunc.czi"
+    bad.write_bytes(blob[: len(blob) // 2])
+    with pytest.raises(MetadataError):
+        CZIReader(bad).__enter__()
+
+
+def test_czi_ingest_end_to_end(tmp_path, planes):
+    """per-well .czi files -> metaconfig (auto) -> imextract -> store."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    rng = np.random.default_rng(43)
+    wells = {}
+    for well in ("A01", "B02"):
+        data = rng.integers(0, 4000, (3, 2, 24, 40), dtype=np.uint16)
+        write_czi(src / f"scan_{well}.czi", data)
+        wells[well] = data
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root,
+        Experiment(name="czitest", plates=[], channels=[],
+                   site_height=1, site_width=1),
+    )
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 3 * 2  # wells x scenes x channels
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 6
+    assert {c.name for c in exp.channels} == {"C00", "C01"}
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store = ExperimentStore.open(root)
+    for ch in range(2):
+        pixels = store.read_sites(None, channel=ch)
+        np.testing.assert_array_equal(pixels[:3], wells["A01"][:, ch])
+        np.testing.assert_array_equal(pixels[3:], wells["B02"][:, ch])
+
+
+def test_czi_nonzero_based_z_normalized(tmp_path):
+    """Substack acquisitions carry non-0-based Z starts; they must map to
+    dense zplane indices (review catch: raw starts made them unreadable)."""
+    rng = np.random.default_rng(53)
+    n_s, n_c, h, w = 1, 1, 16, 16
+    vols = rng.integers(0, 4000, (3, h, w), dtype=np.uint16)
+    blob = bytearray()
+    file_payload = bytearray(512)
+    blob.extend(_segment(b"ZISRAWFILE", bytes(file_payload)))
+    entries = []
+    for zi, zstart in enumerate((2, 3, 4)):
+        dims = [("X", 0, w), ("Y", 0, h), ("C", 0, 1), ("Z", zstart, 1),
+                ("T", 0, 1), ("S", 0, 1)]
+        file_pos = len(blob)
+        entry = _entry(1, file_pos, 0, dims)
+        data = vols[zi].tobytes()
+        sub = bytearray(struct.pack("<iiq", 0, 0, len(data)))
+        sub += entry
+        sub += b"\x00" * (max(256, 16 + len(entry)) - len(sub))
+        sub += data
+        blob.extend(_segment(b"ZISRAWSUBBLOCK", bytes(sub)))
+        entries.append(entry)
+    dir_pos = len(blob)
+    dir_payload = struct.pack("<i", len(entries)) + b"\x00" * 124 + b"".join(entries)
+    blob.extend(_segment(b"ZISRAWDIRECTORY", dir_payload))
+    struct.pack_into("<q", blob, 32 + 52, dir_pos)
+    path = tmp_path / "substack.czi"
+    path.write_bytes(bytes(blob))
+
+    with CZIReader(path) as r:
+        assert r.n_zplanes == 3
+        for zi in range(3):
+            np.testing.assert_array_equal(r.read_plane(0, 0, zplane=zi), vols[zi])

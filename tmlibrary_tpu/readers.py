@@ -331,6 +331,236 @@ class ND2Reader(Reader):
         return struct.unpack_from("<d", self._chunk_payload(off), 0)[0]
 
 
+class CZIReader(Reader):
+    """First-party reader for Zeiss ``.czi`` containers (ZISRAW layout).
+
+    Second entry in the Bio-Formats-gap program (after
+    :class:`ND2Reader`): covers the common high-content layout — scene
+    (S) × channel (C) × z (Z) × time (T) uncompressed Gray16 subblocks.
+
+    Container structure parsed here:
+
+    - the file is a sequence of segments, each with a 32-byte header:
+      16-byte ASCII id (null-padded), ``<i64 allocated_size>``
+      ``<i64 used_size>``, then the payload;
+    - ``ZISRAWFILE`` (at offset 0) holds the directory position at payload
+      offset 36 (``major, minor, reserved×2, guid×2, file_part`` precede);
+    - ``ZISRAWDIRECTORY`` lists ``DirectoryEntryDV`` records: pixel type,
+      file position, compression, and per-dimension
+      ``(name, start, size, …)`` entries (X/Y/C/Z/T/S/M);
+    - ``ZISRAWSUBBLOCK`` holds ``metadata_size, attachment_size,
+      data_size`` + its own directory entry; pixel data starts at payload
+      offset ``max(256, 16 + entry_size) + metadata_size``.
+
+    Only uncompressed Gray16 planes decode; compressed (JPEG-XR/zstd),
+    float, or mosaic-tiled (M-dimension) files raise
+    :class:`~tmlibrary_tpu.errors.MetadataError` with a clear message.
+    """
+
+    #: DirectoryEntryDV pixel types handled (Gray16)
+    _GRAY16 = 1
+
+    def __enter__(self):
+        import mmap
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        self._file = open(self.filename, "rb")
+        try:
+            self._data = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError as exc:
+            self._file.close()
+            raise MetadataError(f"not a CZI container: {self.filename}") from exc
+        if len(self._data) < 64 or self._data[0:10] != b"ZISRAWFILE":
+            self.__exit__()
+            raise MetadataError(f"not a CZI container: {self.filename}")
+        try:
+            payload = self._segment_payload(0, b"ZISRAWFILE")
+            # FileHeaderSegment: major(4) minor(4) reserved(4+4)
+            # primary_guid(16) file_guid(16) file_part(4) = 52 bytes,
+            # then DirectoryPosition(i64)
+            (dir_pos,) = struct.unpack_from("<q", payload, 52)
+            self._planes = self._parse_directory(dir_pos)
+            # raw dimension starts need not be 0-based (substack
+            # acquisitions): normalize EVERY axis through sorted id lists
+            self._scene_ids = sorted({p["S"] for p in self._planes})
+            self._channel_ids = sorted({p["C"] for p in self._planes})
+            self._z_ids = sorted({p["Z"] for p in self._planes})
+            self._t_ids = sorted({p["T"] for p in self._planes})
+            self.width = self._planes[0]["w"]
+            self.height = self._planes[0]["h"]
+        except MetadataError:
+            self.__exit__()
+            raise
+        except (struct.error, OverflowError, IndexError, KeyError,
+                ValueError) as exc:
+            self.__exit__()
+            raise MetadataError(
+                f"corrupt CZI container {self.filename}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
+        self.n_scenes = len(self._scene_ids)
+        self.n_channels = len(self._channel_ids)
+        self.n_zplanes = len(self._z_ids)
+        self.n_tpoints = len(self._t_ids)
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_data", None) is not None:
+            try:
+                self._data.close()
+            except (ValueError, AttributeError):
+                pass
+            self._data = None
+        if getattr(self, "_file", None) is not None:
+            self._file.close()
+            self._file = None
+        return False
+
+    # ------------------------------------------------------------ container
+    def _segment_payload(self, offset: int, expect: bytes) -> bytes:
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        sid = bytes(self._data[offset:offset + 16]).rstrip(b"\x00")
+        if sid != expect:
+            raise MetadataError(
+                f"{self.filename}: expected {expect.decode()} segment at "
+                f"{offset}, found {sid!r}"
+            )
+        _alloc, used = struct.unpack_from("<qq", self._data, offset + 16)
+        return bytes(self._data[offset + 32:offset + 32 + used])
+
+    @staticmethod
+    def _parse_entry(buf: bytes, pos: int) -> tuple[dict, int]:
+        """One DirectoryEntryDV at ``pos`` → (plane dict, end pos)."""
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        if buf[pos:pos + 2] != b"DV":
+            raise MetadataError("directory entry is not DV-typed")
+        pixel_type, file_pos, _file_part, compression = struct.unpack_from(
+            "<iqii", buf, pos + 2
+        )
+        (dim_count,) = struct.unpack_from("<i", buf, pos + 28)
+        plane = {
+            "pixel_type": pixel_type,
+            "compression": compression,
+            "file_pos": file_pos,
+            "C": 0, "Z": 0, "T": 0, "S": 0,
+        }
+        p = pos + 32
+        for _ in range(dim_count):
+            name = buf[p:p + 4].rstrip(b"\x00").decode("ascii", "replace")
+            start, size = struct.unpack_from("<ii", buf, p + 4)
+            if name == "X":
+                plane["w"] = size
+            elif name == "Y":
+                plane["h"] = size
+            elif name in ("C", "Z", "T", "S"):
+                plane[name] = start
+            elif name == "M" and size > 1:
+                raise MetadataError(
+                    "mosaic-tiled CZI (M dimension) is not supported"
+                )
+            p += 20
+        return plane, p
+
+    def _parse_directory(self, dir_pos: int) -> list[dict]:
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        payload = self._segment_payload(dir_pos, b"ZISRAWDIRECTORY")
+        (count,) = struct.unpack_from("<i", payload, 0)
+        pos = 128  # 4-byte count + 124 reserved
+        planes = []
+        for _ in range(count):
+            plane, pos = self._parse_entry(payload, pos)
+            planes.append(plane)
+        if not planes:
+            raise MetadataError(f"{self.filename}: empty subblock directory")
+        return planes
+
+    # ------------------------------------------------------------- pixels
+    def read_plane(
+        self, scene: int = 0, channel: int = 0, zplane: int = 0, tpoint: int = 0
+    ) -> np.ndarray:
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        want = {
+            "S": self._scene_ids[scene],
+            "C": self._channel_ids[channel],
+            "Z": self._z_ids[zplane],
+            "T": self._t_ids[tpoint],
+        }
+        plane = next(
+            (
+                p for p in self._planes
+                if all(p[k] == v for k, v in want.items())
+            ),
+            None,
+        )
+        if plane is None:
+            raise MetadataError(
+                f"{self.filename}: no subblock for "
+                f"scene={scene} channel={channel} z={zplane} t={tpoint}"
+            )
+        if plane["compression"] != 0:
+            raise MetadataError(
+                f"{self.filename}: compressed CZI subblocks "
+                f"(compression={plane['compression']}) are not supported"
+            )
+        if plane["pixel_type"] != self._GRAY16:
+            raise MetadataError(
+                f"{self.filename}: only Gray16 subblocks are supported "
+                f"(pixel_type={plane['pixel_type']})"
+            )
+        payload_off = plane["file_pos"] + 32
+        sid = bytes(self._data[plane["file_pos"]:plane["file_pos"] + 16])
+        if sid.rstrip(b"\x00") != b"ZISRAWSUBBLOCK":
+            raise MetadataError(
+                f"{self.filename}: directory points at a non-subblock segment"
+            )
+        meta_size, _att_size, data_size = struct.unpack_from(
+            "<iiq", self._data, payload_off
+        )
+        # the DV entry embedded in the subblock mirrors the directory's;
+        # data starts after max(256, 16 + entry bytes) + metadata
+        entry_buf = bytes(
+            self._data[payload_off + 16:payload_off + 16 + 32 + 20 * 16]
+        )
+        _, entry_end = self._parse_entry(entry_buf, 0)
+        data_off = payload_off + max(256, 16 + entry_end) + meta_size
+        h, w = plane["h"], plane["w"]
+        expect = 2 * h * w
+        if data_size < expect or data_off + expect > len(self._data):
+            # data_size is the writer's CLAIM; a truncated file can keep an
+            # intact directory while the pixels run past EOF
+            raise MetadataError(
+                f"{self.filename}: subblock holds {data_size} bytes "
+                f"({len(self._data) - data_off} in file), expected {expect}"
+            )
+        samples = np.frombuffer(
+            self._data, np.uint16, count=h * w, offset=data_off
+        )
+        return samples.reshape(h, w).copy()
+
+    def read_plane_linear(self, page: int) -> np.ndarray:
+        """Decode by linear page index, the encoding the czi metaconfig
+        handler writes: ``((s * C + c) * Z + z) * T + t``."""
+        per_scene = self.n_channels * self.n_zplanes * self.n_tpoints
+        s, rem = divmod(page, per_scene)
+        c, rem = divmod(rem, self.n_zplanes * self.n_tpoints)
+        z, t = divmod(rem, self.n_tpoints)
+        return self.read_plane(s, c, z, t)
+
+
 class DatasetReader(Reader):
     """HDF5 dataset reader (reference ``DatasetReader``; h5py-backed)."""
 

@@ -47,6 +47,11 @@ class ImageExtractor(Step):
             with ND2Reader(path) as r:
                 seq, comp = divmod(page or 0, r.n_components)
                 return r.read_plane(seq, comp)
+        if path.lower().endswith(".czi"):
+            from tmlibrary_tpu.readers import CZIReader
+
+            with CZIReader(path) as r:
+                return r.read_plane_linear(page or 0)
 
         from tmlibrary_tpu.native import tiff_read
 

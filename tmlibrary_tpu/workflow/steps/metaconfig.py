@@ -318,6 +318,11 @@ class MetadataConfigurator(Step):
 
             with ND2Reader(probe_path) as r:
                 h, w = r.height, r.width
+        elif probe_path.lower().endswith(".czi"):
+            from tmlibrary_tpu.readers import CZIReader
+
+            with CZIReader(probe_path) as r:
+                h, w = r.height, r.width
         else:
             probe = cv2.imread(probe_path, cv2.IMREAD_UNCHANGED)
             if probe is None:

@@ -980,6 +980,54 @@ def leica_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     return entries, len(images) - len(matches)
 
 
+# ------------------------------------------------- container-format helpers
+def parse_well_token(stem: str) -> tuple[int, int] | None:
+    """First well-name token (``A01``) in a filename stem, or None."""
+    for token in re.split(r"[_\-\s]+", stem):
+        try:
+            return parse_well_name_token(token)
+        except MetadataError:
+            continue
+    return None
+
+
+def assign_container_wells(
+    readable: list, kind: str
+) -> list:
+    """Shared well-assignment policy for one-file-per-well container
+    formats (nd2, czi, …): explicit well tokens are authoritative and
+    must be unique — two files on one well would silently overwrite each
+    other's pixels in the store — and token-less files take the next FREE
+    column on row A so they can't collide with a real A-row well either.
+
+    ``readable``: ``[(path, meta, well_or_None)]`` →
+    ``[(path, meta, (row, col))]``; raises
+    :class:`~tmlibrary_tpu.errors.VendorConflictError` on duplicates.
+    """
+    from tmlibrary_tpu.errors import VendorConflictError
+
+    by_well: dict[tuple[int, int], Path] = {}
+    for path, _, well in readable:
+        if well is None:
+            continue
+        if well in by_well:
+            raise VendorConflictError(
+                f"{kind} files {by_well[well]} and {path} both claim well "
+                f"{well} — their planes would overwrite each other"
+            )
+        by_well[well] = path
+    out = []
+    next_col = 0
+    for path, meta, well in readable:
+        if well is None:
+            while (0, next_col) in by_well:
+                next_col += 1
+            well = (0, next_col)
+            by_well[well] = path
+        out.append((path, meta, well))
+    return out
+
+
 # ----------------------------------------------------------------------- nd2
 @register_sidecar_handler("nd2")
 def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
@@ -997,50 +1045,20 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     files = sorted(source_dir.rglob("*.nd2"))
     if not files:
         return None
-    readable: list[tuple[Path, int, int, tuple[int, int] | None]] = []
+    readable = []
     skipped = 0
     for path in files:
         try:
             with ND2Reader(path) as r:
-                n_seq, n_comp = r.n_sequences, r.n_components
+                dims = (r.n_sequences, r.n_components)
         except MetadataError as exc:
             logger.warning("skipping unreadable ND2 file %s: %s", path, exc)
             skipped += 1
             continue
-        well = None
-        for token in re.split(r"[_\-\s]+", path.stem):
-            try:
-                well = parse_well_name_token(token)
-                break
-            except MetadataError:
-                continue
-        readable.append((path, n_seq, n_comp, well))
-
-    # well assignment: explicit tokens are authoritative and must be
-    # unique (two files on one well would silently overwrite each other's
-    # pixels in the store); token-less files take the next FREE column on
-    # row A so they can't collide with a real A-row well either
-    by_well: dict[tuple[int, int], Path] = {}
-    for path, _, _, well in readable:
-        if well is None:
-            continue
-        if well in by_well:
-            from tmlibrary_tpu.errors import VendorConflictError
-
-            raise VendorConflictError(
-                f"ND2 files {by_well[well]} and {path} both claim well "
-                f"{well} — their planes would overwrite each other"
-            )
-        by_well[well] = path
+        readable.append((path, dims, parse_well_token(path.stem)))
 
     entries: list[dict] = []
-    next_col = 0
-    for path, n_seq, n_comp, well in readable:
-        if well is None:
-            while (0, next_col) in by_well:
-                next_col += 1
-            well = (0, next_col)
-            by_well[well] = path
+    for path, (n_seq, n_comp), well in assign_container_wells(readable, "ND2"):
         well_row, well_col = well
         for seq in range(n_seq):
             for comp in range(n_comp):
@@ -1058,4 +1076,57 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                         "page": seq * n_comp + comp,
                     }
                 )
+    return entries, skipped
+
+
+# ----------------------------------------------------------------------- czi
+@register_sidecar_handler("czi")
+def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """Zeiss ``.czi`` containers, read by the first-party ZISRAW parser
+    (:class:`tmlibrary_tpu.readers.CZIReader`).
+
+    Same conventions as the nd2 handler: one file per well (well-name
+    token in the filename, else the next free column on row A), scenes
+    (S) map to sites, channels to ``C00``/…, with Z/T preserved;
+    ``page`` encodes ``((s * C + c) * Z + z) * T + t`` for imextract."""
+    from tmlibrary_tpu.readers import CZIReader
+
+    files = sorted(source_dir.rglob("*.czi"))
+    if not files:
+        return None
+    readable = []
+    skipped = 0
+    for path in files:
+        try:
+            with CZIReader(path) as r:
+                dims = (r.n_scenes, r.n_channels, r.n_zplanes, r.n_tpoints)
+        except MetadataError as exc:
+            logger.warning("skipping unreadable CZI file %s: %s", path, exc)
+            skipped += 1
+            continue
+        readable.append((path, dims, parse_well_token(path.stem)))
+
+    entries: list[dict] = []
+    for path, (n_s, n_c, n_z, n_t), well in assign_container_wells(
+        readable, "CZI"
+    ):
+        well_row, well_col = well
+        for s in range(n_s):
+            for c in range(n_c):
+                for z in range(n_z):
+                    for t in range(n_t):
+                        entries.append(
+                            {
+                                "plate": "plate00",
+                                "well_row": well_row,
+                                "well_col": well_col,
+                                "site": s,
+                                "channel": f"C{c:02d}",
+                                "cycle": 0,
+                                "tpoint": t,
+                                "zplane": z,
+                                "path": str(path),
+                                "page": ((s * n_c + c) * n_z + z) * n_t + t,
+                            }
+                        )
     return entries, skipped
