@@ -36,12 +36,62 @@ class Reader(ABC):
         return False
 
 
+def _container_reader(path):
+    """The container Reader class for ``path``, or None for plain images."""
+    name = str(path).lower()
+    if name.endswith(".nd2"):
+        return ND2Reader
+    if name.endswith(".czi"):
+        return CZIReader
+    return None
+
+
+def _container_plane(reader, page: int) -> np.ndarray:
+    """One plane from an OPEN container reader by the linear page index
+    its metaconfig handler writes (the single home of that convention:
+    ND2 ``seq * n_components + comp``, CZI ``((s*C+c)*Z+z)*T+t``)."""
+    if isinstance(reader, ND2Reader):
+        seq, comp = divmod(page, reader.n_components)
+        return reader.read_plane(seq, comp)
+    return reader.read_plane_linear(page)
+
+
+def read_container_plane(path, page: int) -> np.ndarray | None:
+    """Open-decode-close one container plane; None for non-container
+    paths (imextract's thread-pooled per-plane loader uses this)."""
+    cls = _container_reader(path)
+    if cls is None:
+        return None
+    with cls(path) as r:
+        return _container_plane(r, page)
+
+
 class ImageReader(Reader):
     """Read 2-D image files; grayscale TIFFs decode through the
-    first-party native reader (``native.tiff_read``), everything else
+    first-party native reader (``native.tiff_read``), Nikon ND2 / Zeiss
+    CZI containers through the first-party chunk parsers (``page`` is the
+    linear plane index their metaconfig handlers write; the parsed
+    chunk map is cached for the context's lifetime), everything else
     (PNG, RGB, tiled TIFF) through cv2.  uint8/uint16 preserved."""
 
+    def __enter__(self):
+        cls = _container_reader(self.filename)
+        self._container = cls(self.filename).__enter__() if cls else None
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_container", None) is not None:
+            self._container.__exit__()
+            self._container = None
+        return False
+
     def read(self, page: int = 0) -> np.ndarray:
+        container = getattr(self, "_container", None)
+        if container is not None:
+            return _container_plane(container, page)
+        out = read_container_plane(self.filename, page)  # non-context use
+        if out is not None:
+            return out
         if str(self.filename).lower().endswith((".tif", ".tiff")):
             from tmlibrary_tpu.native import tiff_info, tiff_read
 

@@ -41,17 +41,11 @@ class ImageExtractor(Step):
         (``page`` encodes sequence * n_components + component, as written
         by the nd2 metaconfig handler), cv2 for everything else (PNG,
         tiled/BigTIFF, RGB, ...)."""
-        if path.lower().endswith(".nd2"):
-            from tmlibrary_tpu.readers import ND2Reader
+        from tmlibrary_tpu.readers import read_container_plane
 
-            with ND2Reader(path) as r:
-                seq, comp = divmod(page or 0, r.n_components)
-                return r.read_plane(seq, comp)
-        if path.lower().endswith(".czi"):
-            from tmlibrary_tpu.readers import CZIReader
-
-            with CZIReader(path) as r:
-                return r.read_plane_linear(page or 0)
+        container = read_container_plane(path, page or 0)
+        if container is not None:
+            return container
 
         from tmlibrary_tpu.native import tiff_read
 
