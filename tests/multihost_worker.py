@@ -1,0 +1,101 @@
+"""Worker body for the REAL multi-process distributed test (launched by
+``tests/test_multihost.py``, one subprocess per simulated host).
+
+Exercises the production multi-host path end to end: env-var bootstrap of
+``jax.distributed`` (gloo CPU collectives), the hybrid DCN-aware
+``pod_mesh``, the per-host data plane (``local_site_slice`` +
+``host_local_to_global`` — no host ever holds the full batch), one
+jitted jterator pipeline execution over the global mesh, per-host shard
+extraction, and the cross-host barrier."""
+import os
+import sys
+
+# each simulated host gets 2 local devices -> 4 global
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=2"
+)
+os.environ["TMX_NATIVE"] = "0"  # pure-XLA path: portable across hosts
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmlibrary_tpu.parallel.distributed import (  # noqa: E402
+    batch_spec,
+    global_to_host_local,
+    host_local_to_global,
+    initialize,
+    local_site_slice,
+    pod_mesh,
+    sync_hosts,
+)
+
+
+def main() -> None:
+    assert initialize(), "env-var bootstrap did not go multi-host"
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    mesh = pod_mesh()  # wells axis = hosts (DCN), sites within host (ICI)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "wells": 2, "sites": 2,
+    }
+
+    from tmlibrary_tpu.benchmarks import (
+        cell_painting_description,
+        synthetic_cell_painting_batch,
+    )
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+    n_sites = 8
+    # deterministic global dataset; each host materializes ONLY its slice
+    data = synthetic_cell_painting_batch(n_sites, size=64, n_cells=5)
+    sl = local_site_slice(n_sites)
+    assert sl == slice(jax.process_index() * 4, jax.process_index() * 4 + 4)
+
+    pipe = ImageAnalysisPipeline(cell_painting_description(), max_objects=16)
+    fn = pipe.build_batch_fn(jit=False)
+    raw = {
+        k: host_local_to_global(np.asarray(v[sl]), mesh) for k, v in data.items()
+    }
+    shifts = host_local_to_global(np.zeros((4, 2), np.int32), mesh)
+
+    shard = NamedSharding(mesh, batch_spec(mesh))
+    jitted = jax.jit(fn, in_shardings=(
+        {k: shard for k in raw}, None, shard,
+    ))
+    result = jitted(raw, {}, shifts)
+    counts_global = result.counts["nuclei"]
+
+    # every host sees the SAME global counts; its host-local shard is the
+    # slice it owns
+    local_counts = global_to_host_local(counts_global, mesh)
+    assert local_counts.shape == (4,), local_counts.shape
+
+    # golden: this host's sites on ONE local device must agree
+    single = jax.jit(fn)(
+        {k: jnp.asarray(np.asarray(v[sl])) for k, v in data.items()},
+        {},
+        jnp.zeros((4, 2), jnp.int32),
+    )
+    np.testing.assert_array_equal(
+        local_counts, np.asarray(single.counts["nuclei"])
+    )
+
+    sync_hosts("multihost-test-done")
+    print(
+        f"WORKER_OK process={jax.process_index()} "
+        f"counts={local_counts.tolist()}",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

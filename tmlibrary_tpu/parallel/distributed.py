@@ -107,10 +107,26 @@ def pod_mesh(
         raise ValueError(f"wells axis {wells} does not divide {n} devices")
     sites = n // wells
     if n_hosts > 1 and wells % n_hosts == 0:
-        devices = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(wells // n_hosts, sites),
-            dcn_mesh_shape=(n_hosts, 1),
-        )
+        try:
+            devices = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(wells // n_hosts, sites),
+                dcn_mesh_shape=(n_hosts, 1),
+            )
+        except ValueError:
+            # slice topology absent (multi-process CPU) or slice/host
+            # granularity mismatch: use jax's documented fallback — the
+            # process is the DCN granule — and SAY so, because the layout
+            # is less ICI-aware than the slice-keyed hybrid mesh
+            logger.warning(
+                "pod_mesh: slice-aware hybrid mesh unavailable for this "
+                "topology; falling back to process-granule layout "
+                "(outer '%s' axis spans hosts)", axis_names[0],
+            )
+            devices = mesh_utils.create_hybrid_device_mesh(
+                mesh_shape=(wells // n_hosts, sites),
+                dcn_mesh_shape=(n_hosts, 1),
+                process_is_granule=True,
+            )
     else:
         devices = mesh_utils.create_device_mesh((wells, sites))
     return Mesh(devices, axis_names)
