@@ -699,36 +699,58 @@ struct Geo3 {
   }
 };
 
-// Synchronous-wave label flooding, identical to ops/segment_secondary.py
-// propagate_labels (and its 3-D twin): every unlabeled admitted pixel
-// simultaneously adopts the MAX label among its neighbors from the
-// previous state, repeated to convergence.  Labels are immutable once
-// assigned, so the Jacobi fixpoint equals a breadth-first wave where a
-// pixel joins at the first wave in which it has a labeled neighbor —
-// which is what makes an O(n) frontier implementation possible.  Phase 1
-// reads only pre-wave labels; phase 2 commits, keeping same-wave
-// assignments invisible exactly like the vectorized jnp.where update.
+// Shared level-loop body of tm_watershed_levels / tm_watershed_levels3d.
+//
+// Semantics are identical to ops/segment_secondary.py's XLA path (and its
+// 3-D twin): per level, every unlabeled admitted pixel simultaneously
+// adopts the MAX label among its neighbors from the previous state,
+// repeated to convergence, then one final pass admits the whole mask.
+// Labels are immutable once assigned, so the Jacobi fixpoint equals a
+// breadth-first wave where a pixel joins at the first wave in which it
+// has a labeled neighbor.  Phase 1 reads only pre-wave labels; phase 2
+// commits, keeping same-wave assignments invisible exactly like the
+// vectorized jnp.where update.
+//
+// Complexity: a PERSISTENT candidate set (unlabeled mask pixels adjacent
+// to the labeled region) carries over between levels and admission is
+// tested lazily per candidate, so there is exactly ONE full-image scan
+// (candidate seeding) instead of the naive two per level — per-level
+// cost is O(|boundary|), not O(n).  Every pixel enters the candidate
+// list at most once per discovery edge, preserving the wave order: at a
+// level's start ALL admitted candidates enter the first wave together,
+// exactly the set the Jacobi step would label first.
 template <typename Geo>
-struct FloodT {
-  Geo geo;
-  std::vector<int32_t>& labels;        // 0 = unlabeled
-  std::vector<uint8_t> in_frontier;    // dedupe stamp
-  std::vector<int32_t> frontier, next, adopted;
+void watershed_levels_impl(const float* intensity, const int32_t* seeds,
+                           const uint8_t* mask, size_t n, Geo geo,
+                           const float* levels, int32_t n_levels,
+                           int32_t* out) {
+  std::vector<int32_t> labels(seeds, seeds + n);
+  std::vector<uint8_t> in_cand(n, 0), in_next(n, 0);
+  std::vector<int32_t> candidates, frontier, next, adopted;
 
-  FloodT(Geo g, std::vector<int32_t>& lab)
-      : geo(g), labels(lab), in_frontier(lab.size(), 0) {}
+  // the one full scan: unlabeled mask pixels touching the seeded region
+  for (size_t i = 0; i < n; ++i) {
+    if (labels[i] != 0 || !mask[i]) continue;
+    bool touch = false;
+    geo.for_neighbors((int32_t)i, [&](int32_t q) { touch |= labels[q] != 0; });
+    if (touch) { candidates.push_back((int32_t)i); in_cand[i] = 1; }
+  }
 
-  // flood labels into pixels where admitted[i] != 0, to convergence
-  void run(const uint8_t* admitted) {
-    const size_t n = labels.size();
+  auto flood_level = [&](auto admitted) {
+    // admitted candidates form the first wave; the rest stay candidates
     frontier.clear();
-    std::fill(in_frontier.begin(), in_frontier.end(), 0);
-    for (size_t i = 0; i < n; ++i) {
-      if (labels[i] != 0 || !admitted[i]) continue;
-      bool touch = false;
-      geo.for_neighbors((int32_t)i, [&](int32_t q) { touch |= labels[q] != 0; });
-      if (touch) { frontier.push_back((int32_t)i); in_frontier[i] = 1; }
+    size_t keep = 0;
+    for (size_t k = 0; k < candidates.size(); ++k) {
+      const int32_t p = candidates[k];
+      if (labels[p] != 0) { in_cand[p] = 0; continue; }  // labeled later on
+      if (admitted(p)) {
+        in_cand[p] = 0;
+        frontier.push_back(p);
+      } else {
+        candidates[keep++] = p;
+      }
     }
+    candidates.resize(keep);
     while (!frontier.empty()) {
       adopted.assign(frontier.size(), 0);
       for (size_t k = 0; k < frontier.size(); ++k) {
@@ -739,43 +761,34 @@ struct FloodT {
         adopted[k] = best;  // >0 by frontier construction
       }
       next.clear();
-      for (size_t k = 0; k < frontier.size(); ++k) {
+      for (size_t k = 0; k < frontier.size(); ++k)
         labels[frontier[k]] = adopted[k];
-        in_frontier[frontier[k]] = 0;
-      }
       for (size_t k = 0; k < frontier.size(); ++k) {
         geo.for_neighbors(frontier[k], [&](int32_t q) {
-          if (labels[q] == 0 && admitted[q] && !in_frontier[q]) {
-            in_frontier[q] = 1;
-            next.push_back(q);
+          if (labels[q] != 0 || !mask[q]) return;
+          if (admitted(q)) {
+            // remaining candidates are all non-admitted at this level,
+            // so an admitted unlabeled neighbor can only be fresh
+            if (!in_next[q]) { in_next[q] = 1; next.push_back(q); }
+          } else if (!in_cand[q]) {
+            in_cand[q] = 1;
+            candidates.push_back(q);  // for a later (dimmer) level
           }
         });
       }
+      for (size_t k = 0; k < next.size(); ++k) in_next[next[k]] = 0;
       frontier.swap(next);
     }
-  }
-};
+  };
 
-// shared level-loop body of tm_watershed_levels / tm_watershed_levels3d
-template <typename Geo>
-void watershed_levels_impl(const float* intensity, const int32_t* seeds,
-                           const uint8_t* mask, size_t n, Geo geo,
-                           const float* levels, int32_t n_levels,
-                           int32_t* out) {
-  std::vector<int32_t> labels(seeds, seeds + n);
-  std::vector<uint8_t> admitted(n, 0);
-  FloodT<Geo> flood(geo, labels);
   for (int32_t l = 0; l < n_levels; ++l) {
     const float level = levels[l];
-    for (size_t i = 0; i < n; ++i)
-      admitted[i] = mask[i] && intensity[i] >= level;
-    flood.run(admitted.data());
+    flood_level([&](int32_t p) { return intensity[p] >= level; });
   }
-  flood.run(mask);  // mop up below the lowest level (numerical edge)
+  // mop up below the lowest level (numerical edge)
+  flood_level([](int32_t) { return true; });
   for (size_t i = 0; i < n; ++i) out[i] = mask[i] ? labels[i] : 0;
 }
-
-using Flood = FloodT<Geo2>;
 
 }  // namespace wsnative
 

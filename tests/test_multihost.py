@@ -10,8 +10,6 @@ import socket
 import subprocess
 import sys
 
-import pytest
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "multihost_worker.py")
 
@@ -50,15 +48,21 @@ def test_two_process_pipeline_over_pod_mesh():
         for p in procs:
             if p.poll() is None:
                 p.kill()
+                # drain the pipes after kill so a wedged coordinator is
+                # diagnosable from the failure output
+                out, _ = p.communicate()
+                print(f"--- killed worker output ---\n{out[-3000:]}")
     for pid, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
         assert f"WORKER_OK process={pid}" in out, out[-2000:]
-    # both workers computed over the same global mesh: their per-host count
-    # shards are disjoint slices of one result (sanity: both non-trivial)
+    # both workers computed over the same global mesh: each host's shard
+    # holds 4 real (non-zero) per-site counts for ITS slice
     counts = [
-        line.split("counts=")[1]
+        eval(line.split("counts=")[1])
         for out in outputs
         for line in out.splitlines()
         if "WORKER_OK" in line
     ]
     assert len(counts) == 2
+    for shard in counts:
+        assert len(shard) == 4 and all(c > 0 for c in shard), counts
