@@ -5,6 +5,7 @@ with gloo collectives and run one jitted jterator pipeline over the
 global hybrid mesh.  This is the path a v5e pod launch takes — every
 prior distributed test ran single-process on a forced 8-device backend;
 this one crosses actual process boundaries."""
+import ast
 import os
 import socket
 import subprocess
@@ -58,7 +59,7 @@ def test_two_process_pipeline_over_pod_mesh():
     # both workers computed over the same global mesh: each host's shard
     # holds 4 real (non-zero) per-site counts for ITS slice
     counts = [
-        eval(line.split("counts=")[1])
+        ast.literal_eval(line.split("counts=")[1])
         for out in outputs
         for line in out.splitlines()
         if "WORKER_OK" in line

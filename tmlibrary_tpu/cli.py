@@ -250,7 +250,10 @@ def cmd_workflow(args) -> int:
     store = _open_store(args)
     if args.verb == "status":
         status = RunLedger(store.workflow_dir / "ledger.jsonl").status()
-        if not status:
+        from tmlibrary_tpu.tools.base import ToolRequestManager
+
+        tool_requests = ToolRequestManager(store).list_requests()
+        if not status and not tool_requests:
             print("no workflow runs recorded")
             return 0
         for step, entry in status.items():
@@ -264,9 +267,7 @@ def cmd_workflow(args) -> int:
             print(line)
         # tool request lifecycle (reference ToolRequestManager submissions
         # surface in the same status view the UI polls)
-        from tmlibrary_tpu.tools.base import ToolRequestManager
-
-        for req in ToolRequestManager(store).list_requests():
+        for req in tool_requests:
             line = f"tool:{req['request']:30s} {req.get('state', '?'):8s}"
             if req.get("error"):
                 line += f" error: {req['error']}"
