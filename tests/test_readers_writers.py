@@ -99,3 +99,120 @@ def test_tables_roundtrip(tmp_path, suffix):
 def test_tables_unsupported(tmp_path):
     with pytest.raises(NotSupportedError):
         TablesWriter(tmp_path / "t.xlsx").write(pd.DataFrame())
+
+
+def test_ome_tiff_writer_round_trips(tmp_path):
+    """OMETiffWriter output reads back bit-exactly through BOTH the
+    first-party native TIFF reader and cv2, and the embedded OME-XML
+    parses through the framework's own OME parser."""
+    import cv2
+
+    from tmlibrary_tpu.native import tiff_info, tiff_read
+    from tmlibrary_tpu.workflow.steps.omexml import parse_ome_xml
+    from tmlibrary_tpu.writers import OMETiffWriter, minimal_ome_xml
+
+    rng = np.random.default_rng(61)
+    stack = rng.integers(0, 65535, (3, 20, 30), dtype=np.uint16)
+    path = tmp_path / "site.ome.tif"
+    OMETiffWriter(path).write(stack, minimal_ome_xml("site", 20, 30, 3))
+
+    assert tiff_info(path) == (3, 20, 30, 16)
+    for p in range(3):
+        np.testing.assert_array_equal(tiff_read(path, p, 20, 30), stack[p])
+    ok, pages = cv2.imreadmulti(str(path), flags=cv2.IMREAD_UNCHANGED)
+    assert ok
+    for p in range(3):
+        np.testing.assert_array_equal(pages[p], stack[p])
+
+    # the ImageDescription carries a parseable one-Image OME document
+    raw = path.read_bytes()
+    start = raw.find(b"<OME")
+    end = raw.find(b"</OME>") + len(b"</OME>")
+    (img,) = parse_ome_xml(raw[start:end].decode())
+    assert (img.size_x, img.size_y, img.size_z, img.size_c) == (30, 20, 3, 1)
+
+
+def test_ome_tiff_writer_uint8_and_2d(tmp_path):
+    from tmlibrary_tpu.native import tiff_read
+    from tmlibrary_tpu.writers import OMETiffWriter
+
+    img = np.arange(64, dtype=np.uint8).reshape(8, 8)
+    path = tmp_path / "plane.ome.tif"
+    OMETiffWriter(path).write(img)
+    np.testing.assert_array_equal(tiff_read(path, 0, 8, 8), img)
+
+
+def test_export_images_ome_round_trips_through_ingest(tmp_path):
+    """tmx export --images --ome output re-ingests through metaconfig's
+    default filename handler (the documented road out and back)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.models.experiment import Experiment, grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    exp = grid_experiment(
+        "omeexp", well_rows=1, well_cols=2, sites_per_well=(1, 2),
+        channel_names=("DAPI",), site_shape=(16, 16),
+    )
+    root = tmp_path / "exp"
+    st = ExperimentStore.create(root, exp)
+    rng = np.random.default_rng(67)
+    data = rng.integers(0, 4000, (4, 16, 16), dtype=np.uint16)
+    st.write_sites(data, [0, 1, 2, 3], channel=0)
+
+    out = tmp_path / "exported"
+    assert main(["export", "--root", str(root), "--images", "0",
+                 "--ome", "--out", str(out)]) == 0
+    assert len(list(out.glob("*.tif"))) == 4
+
+    root2 = tmp_path / "exp2"
+    ExperimentStore.create(
+        root2,
+        Experiment(name="re", plates=[], channels=[],
+                   site_height=1, site_width=1),
+    )
+    assert main(["metaconfig", "init", "--root", str(root2),
+                 "--source-dir", str(out)]) == 0
+    assert main(["metaconfig", "run", "--root", str(root2)]) == 0
+    assert main(["imextract", "init", "--root", str(root2)]) == 0
+    assert main(["imextract", "run", "--root", str(root2)]) == 0
+    st2 = ExperimentStore.open(root2)
+    assert st2.experiment.n_sites == 4
+    np.testing.assert_array_equal(st2.read_sites(None, channel=0), data)
+
+
+def test_ome_tiff_writer_odd_sizes_and_short_description(tmp_path):
+    """Odd-sized uint8 pages must stay word-aligned (TIFF 6.0) and a <=4
+    byte description is stored inline, not as an offset (review catches)."""
+    import cv2
+
+    from tmlibrary_tpu.native import tiff_read
+    from tmlibrary_tpu.writers import OMETiffWriter
+
+    rng = np.random.default_rng(73)
+    stack = rng.integers(0, 255, (3, 5, 5), dtype=np.uint8)
+    path = tmp_path / "odd.tif"
+    OMETiffWriter(path).write(stack, "abc")
+    for p in range(3):
+        got = tiff_read(path, p, 5, 5)
+        np.testing.assert_array_equal(got.astype(np.uint8), stack[p])
+    ok, pages = cv2.imreadmulti(str(path), flags=cv2.IMREAD_UNCHANGED)
+    assert ok
+    for p in range(3):
+        np.testing.assert_array_equal(pages[p], stack[p])
+    # every strip offset is even (word-aligned)
+    raw = path.read_bytes()
+    import struct as _s
+    (ifd0,) = _s.unpack_from("<I", raw, 4)
+    off = ifd0
+    while off:
+        (count,) = _s.unpack_from("<H", raw, off)
+        for e in range(count):
+            tag, typ, cnt, val = _s.unpack_from("<HHII", raw, off + 2 + 12 * e)
+            if tag == 273:
+                assert val % 2 == 0, val
+            if tag == 270:
+                assert cnt == 4  # 'abc\0' stored inline
+        (off,) = _s.unpack_from("<I", raw, off + 2 + 12 * count)

@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--align", action="store_true",
         help="--images only: apply cycle alignment shifts + intersection crop",
     )
+    p_export.add_argument(
+        "--ome", action="store_true",
+        help="--images only: write OME-TIFFs (OME-XML in ImageDescription, "
+             "the Bio-Formats convention) instead of bare TIFFs",
+    )
     p_export.add_argument("--out", required=True, help="output file path")
     p_export.add_argument(
         "--format", choices=("csv", "parquet", "geojson"), default=None,
@@ -474,6 +479,7 @@ def _export_images(store: ExperimentStore, args, out: Path) -> int:
     from tmlibrary_tpu.models.experiment import Well
     from tmlibrary_tpu.models.image import IllumstatsContainer
     from tmlibrary_tpu.ops import image_ops
+    from tmlibrary_tpu.writers import OMETiffWriter, minimal_ome_xml
 
     channel, cycle = args.images, args.cycle
     exp = store.experiment
@@ -547,7 +553,12 @@ def _export_images(store: ExperimentStore, args, out: Path) -> int:
                     if exp.n_zplanes > 1:
                         name += f"_z{zplane:d}"
                     name += f"_{ch_name}.tif"
-                    if not cv2.imwrite(str(out / name), arr):
+                    if args.ome:
+                        OMETiffWriter(out / name).write(
+                            arr,
+                            minimal_ome_xml(name, *arr.shape),
+                        )
+                    elif not cv2.imwrite(str(out / name), arr):
                         print(f"error: failed writing {out / name}",
                               file=sys.stderr)
                         return 1
