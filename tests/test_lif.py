@@ -1,0 +1,208 @@
+"""First-party Leica LIF container support — third entry in the
+Bio-Formats-gap program (ND2, CZI, LIF).
+
+``write_lif`` emits the block layout ``LIFReader`` documents: an XML
+header block (``<u32 0x70><u32 len><u8 0x2A><u32 n_chars>`` + UTF-16LE
+``LMSDataContainerHeader`` v2) followed by one memory block per series
+(``<u8 0x2A><u64 mem_size><u8 0x2A><u32 id_chars>`` + UTF-16LE id +
+pixels)."""
+import struct
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.readers import LIFReader
+
+
+def _series_xml(name: str, block_id: str, h: int, w: int, n_c: int,
+                n_z: int = 1, n_t: int = 1, bits: int = 16) -> str:
+    """One Element with planar channel layout: C outermost, then Z, T."""
+    item = bits // 8
+    plane = h * w * item
+    chans = "".join(
+        f'<ChannelDescription Resolution="{bits}" '
+        f'BytesInc="{c * n_z * n_t * plane}"/>'
+        for c in range(n_c)
+    )
+    dims = (
+        f'<DimensionDescription DimID="1" NumberOfElements="{w}" BytesInc="{item}"/>'
+        f'<DimensionDescription DimID="2" NumberOfElements="{h}" BytesInc="{w * item}"/>'
+    )
+    if n_z > 1:
+        dims += (f'<DimensionDescription DimID="3" NumberOfElements="{n_z}" '
+                 f'BytesInc="{n_t * plane}"/>')
+    if n_t > 1:
+        dims += (f'<DimensionDescription DimID="4" NumberOfElements="{n_t}" '
+                 f'BytesInc="{plane}"/>')
+    size = n_c * n_z * n_t * plane
+    return (
+        f'<Element Name="{name}"><Data><Image><ImageDescription>'
+        f"<Channels>{chans}</Channels><Dimensions>{dims}</Dimensions>"
+        f"</ImageDescription></Image></Data>"
+        f'<Memory Size="{size}" MemoryBlockID="{block_id}"/></Element>'
+    )
+
+
+def write_lif(path, series: list[np.ndarray], bits: int = 16) -> None:
+    """``series``: list of (C, Z, T, H, W) uint16 arrays (planar layout)."""
+    elements = []
+    for i, arr in enumerate(series):
+        n_c, n_z, n_t, h, w = arr.shape
+        elements.append(
+            _series_xml(f"Series{i}", f"MemBlock_{i}", h, w, n_c, n_z, n_t, bits)
+        )
+    xml = (
+        '<LMSDataContainerHeader Version="2"><Element Name="root"><Children>'
+        + "".join(elements)
+        + "</Children></Element></LMSDataContainerHeader>"
+    )
+    xml_bytes = xml.encode("utf-16-le")
+    blob = bytearray()
+    header = struct.pack("<II", 0x70, 5 + len(xml_bytes)) + b"\x2a"
+    header += struct.pack("<I", len(xml)) + xml_bytes
+    blob += header
+    for i, arr in enumerate(series):
+        data = arr.astype(f"<u{bits // 8}").tobytes()
+        bid = f"MemBlock_{i}".encode("utf-16-le")
+        content = b"\x2a" + struct.pack("<Q", len(data))
+        content += b"\x2a" + struct.pack("<I", len(f"MemBlock_{i}")) + bid
+        blob += struct.pack("<II", 0x70, len(content)) + content + data
+    path.write_bytes(bytes(blob))
+
+
+@pytest.fixture()
+def series():
+    rng = np.random.default_rng(79)
+    return [
+        rng.integers(0, 4000, (2, 1, 1, 24, 32), dtype=np.uint16)
+        for _ in range(3)
+    ]
+
+
+def test_lif_reader_round_trip(tmp_path, series):
+    path = tmp_path / "exp.lif"
+    write_lif(path, series)
+    with LIFReader(path) as r:
+        assert r.n_series == 3
+        assert r.uniform_dims() == (2, 1, 1)
+        for s in range(3):
+            for c in range(2):
+                np.testing.assert_array_equal(
+                    r.read_plane(s, c), series[s][c, 0, 0]
+                )
+                np.testing.assert_array_equal(
+                    r.read_plane_global(s * 2 + c), series[s][c, 0, 0]
+                )
+
+
+def test_lif_reader_z_and_t(tmp_path):
+    rng = np.random.default_rng(83)
+    arr = rng.integers(0, 4000, (1, 3, 2, 16, 16), dtype=np.uint16)
+    path = tmp_path / "zt.lif"
+    write_lif(path, [arr])
+    with LIFReader(path) as r:
+        assert r.uniform_dims() == (1, 3, 2)
+        for z in range(3):
+            for t in range(2):
+                np.testing.assert_array_equal(
+                    r.read_plane(0, 0, zplane=z, tpoint=t), arr[0, z, t]
+                )
+
+
+def test_lif_reader_uint8_widens(tmp_path):
+    rng = np.random.default_rng(89)
+    arr = rng.integers(0, 255, (1, 1, 1, 8, 8), dtype=np.uint16) & 0xFF
+    path = tmp_path / "u8.lif"
+    write_lif(path, [arr], bits=8)
+    with LIFReader(path) as r:
+        got = r.read_plane(0, 0)
+        assert got.dtype == np.uint16
+        np.testing.assert_array_equal(got, arr[0, 0, 0])
+
+
+def test_lif_reader_rejects_garbage(tmp_path):
+    path = tmp_path / "junk.lif"
+    path.write_bytes(b"this is not a leica file" * 4)
+    with pytest.raises(MetadataError, match="not a LIF"):
+        LIFReader(path).__enter__()
+
+
+def test_lif_reader_truncated_raises_metadata_error(tmp_path, series):
+    path = tmp_path / "good.lif"
+    write_lif(path, series)
+    bad = tmp_path / "trunc.lif"
+    bad.write_bytes(path.read_bytes()[: len(path.read_bytes()) * 2 // 3])
+    with pytest.raises(MetadataError):
+        LIFReader(bad).__enter__()
+
+
+def test_lif_reader_bounds(tmp_path, series):
+    path = tmp_path / "exp.lif"
+    write_lif(path, series)
+    with LIFReader(path) as r:
+        with pytest.raises(MetadataError, match="series"):
+            r.read_plane(9, 0)
+        with pytest.raises(MetadataError, match="channels"):
+            r.read_plane(0, 5)
+
+
+def test_lif_ingest_end_to_end(tmp_path, series):
+    """per-well .lif files -> metaconfig (auto) -> imextract -> store."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    rng = np.random.default_rng(97)
+    wells = {}
+    for well in ("A01", "B02"):
+        data = [
+            rng.integers(0, 4000, (2, 1, 1, 24, 32), dtype=np.uint16)
+            for _ in range(3)
+        ]
+        write_lif(src / f"scan_{well}.lif", data)
+        wells[well] = data
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root,
+        Experiment(name="liftest", plates=[], channels=[],
+                   site_height=1, site_width=1),
+    )
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 3 * 2  # wells x series x channels
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 6
+    assert {c.name for c in exp.channels} == {"C00", "C01"}
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store = ExperimentStore.open(root)
+    for ch in range(2):
+        pixels = store.read_sites(None, channel=ch)
+        for s in range(3):
+            np.testing.assert_array_equal(pixels[s], wells["A01"][s][ch, 0, 0])
+            np.testing.assert_array_equal(pixels[3 + s], wells["B02"][s][ch, 0, 0])
+
+
+def test_lif_mixed_plane_shapes_rejected(tmp_path):
+    """An overview series + field series (same C/Z/T, different shape)
+    must raise instead of silently setting the wrong site shape."""
+    rng = np.random.default_rng(103)
+    series = [
+        rng.integers(0, 4000, (1, 1, 1, 16, 16), dtype=np.uint16),
+        rng.integers(0, 4000, (1, 1, 1, 32, 32), dtype=np.uint16),
+    ]
+    path = tmp_path / "mixed.lif"
+    write_lif(path, series)
+    with LIFReader(path) as r:
+        with pytest.raises(MetadataError, match="plane shape"):
+            r.uniform_dims()

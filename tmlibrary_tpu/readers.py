@@ -43,16 +43,21 @@ def _container_reader(path):
         return ND2Reader
     if name.endswith(".czi"):
         return CZIReader
+    if name.endswith(".lif"):
+        return LIFReader
     return None
 
 
 def _container_plane(reader, page: int) -> np.ndarray:
     """One plane from an OPEN container reader by the linear page index
     its metaconfig handler writes (the single home of that convention:
-    ND2 ``seq * n_components + comp``, CZI ``((s*C+c)*Z+z)*T+t``)."""
+    ND2 ``seq * n_components + comp``, CZI ``((s*C+c)*Z+z)*T+t``,
+    LIF ``series * C*Z*T + (c*Z+z)*T + t``)."""
     if isinstance(reader, ND2Reader):
         seq, comp = divmod(page, reader.n_components)
         return reader.read_plane(seq, comp)
+    if isinstance(reader, LIFReader):
+        return reader.read_plane_global(page)
     return reader.read_plane_linear(page)
 
 
@@ -64,6 +69,16 @@ def read_container_plane(path, page: int) -> np.ndarray | None:
         return None
     with cls(path) as r:
         return _container_plane(r, page)
+
+
+def container_dimensions(path) -> tuple[int, int] | None:
+    """(height, width) of a container's planes, or None for non-container
+    paths (metaconfig's site-shape probe uses this)."""
+    cls = _container_reader(path)
+    if cls is None:
+        return None
+    with cls(path) as r:
+        return r.height, r.width
 
 
 class ImageReader(Reader):
@@ -609,6 +624,285 @@ class CZIReader(Reader):
         c, rem = divmod(rem, self.n_zplanes * self.n_tpoints)
         z, t = divmod(rem, self.n_tpoints)
         return self.read_plane(s, c, z, t)
+
+
+class LIFReader(Reader):
+    """First-party reader for Leica Image Files (``.lif``).
+
+    Third entry in the Bio-Formats-gap program (ND2, CZI, LIF): covers
+    uint16/uint8 grayscale image series — the high-content layout where
+    each series is one field/site with C/Z/T planes.
+
+    Container structure parsed here:
+
+    - the file is a sequence of blocks, each ``<u32 0x70> <u32 len>``
+      followed by a test byte ``0x2A``;
+    - the FIRST block holds the XML header: ``<u8 0x2A> <u32 n_chars>``
+      + UTF-16LE document (``LMSDataContainerHeader``, whose ``Version``
+      selects 4- vs 8-byte memory sizes);
+    - every following block is a memory block: ``<u8 0x2A> <u32|u64
+      mem_size> <u8 0x2A> <u32 id_chars>`` + UTF-16LE block id + the raw
+      pixel bytes;
+    - the XML's ``Element/Data/Image/ImageDescription`` carries
+      ``ChannelDescription`` (``Resolution`` bits, ``BytesInc``) and
+      ``DimensionDescription`` (``DimID`` 1=X 2=Y 3=Z 4=T,
+      ``NumberOfElements``, ``BytesInc``) entries, and the sibling
+      ``Memory`` element names the block holding the series' pixels.
+
+    Plane addressing is pure ``BytesInc`` arithmetic, so interleaved and
+    planar channel layouts both decode.  Non-8/16-bit resolutions raise
+    :class:`~tmlibrary_tpu.errors.MetadataError`.
+    """
+
+    MAGIC = 0x70
+
+    def __enter__(self):
+        import mmap
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        self._file = open(self.filename, "rb")
+        try:
+            self._data = mmap.mmap(self._file.fileno(), 0, access=mmap.ACCESS_READ)
+        except ValueError as exc:
+            self._file.close()
+            raise MetadataError(f"not a LIF container: {self.filename}") from exc
+        try:
+            if len(self._data) < 13 or struct.unpack_from("<I", self._data, 0)[0] != self.MAGIC:
+                raise MetadataError(f"not a LIF container: {self.filename}")
+            xml, pos = self._read_header()
+            from xml.etree import ElementTree as ET
+
+            root = ET.fromstring(xml)
+            version = int(root.get("Version") or 1)
+            self._blocks = self._scan_memory_blocks(pos, version)
+            self.series = self._parse_xml(root)
+        except MetadataError:
+            self.__exit__()
+            raise
+        except (struct.error, OverflowError, IndexError, KeyError,
+                ValueError, UnicodeDecodeError, SyntaxError) as exc:
+            # SyntaxError: a truncated UTF-16 header decodes to malformed
+            # XML and ElementTree.ParseError subclasses SyntaxError
+            self.__exit__()
+            raise MetadataError(
+                f"corrupt LIF container {self.filename}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
+        if not self.series:
+            self.__exit__()
+            raise MetadataError(
+                f"{self.filename}: no decodable image series "
+                "(only 8/16-bit grayscale series are supported)"
+            )
+        self.n_series = len(self.series)
+        self.height = self.series[0]["height"]
+        self.width = self.series[0]["width"]
+        return self
+
+    def __exit__(self, *exc):
+        if getattr(self, "_data", None) is not None:
+            try:
+                self._data.close()
+            except (ValueError, AttributeError):
+                pass
+            self._data = None
+        if getattr(self, "_file", None) is not None:
+            self._file.close()
+            self._file = None
+        return False
+
+    # ------------------------------------------------------------ container
+    def _read_header(self) -> tuple[str, int]:
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        _magic, _blen = struct.unpack_from("<II", self._data, 0)
+        if self._data[8] != 0x2A:
+            raise MetadataError(f"{self.filename}: bad header test byte")
+        (n_chars,) = struct.unpack_from("<I", self._data, 9)
+        xml = bytes(self._data[13:13 + 2 * n_chars]).decode("utf-16-le")
+        return xml, 13 + 2 * n_chars
+
+    def _scan_memory_blocks(
+        self, pos: int, version: int
+    ) -> dict[str, tuple[int, int]]:
+        """block id -> (data offset, size).  ``version`` comes from the
+        parsed header root (it selects 4- vs 8-byte memory sizes; a
+        substring sniff would misread files whose Version attribute sits
+        past the first decode window)."""
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        blocks: dict[str, tuple[int, int]] = {}
+        n = len(self._data)
+        while pos + 8 <= n:
+            magic, _blen = struct.unpack_from("<II", self._data, pos)
+            if magic != self.MAGIC:
+                raise MetadataError(
+                    f"{self.filename}: bad block magic at offset {pos}"
+                )
+            p = pos + 8
+            if self._data[p] != 0x2A:
+                raise MetadataError(f"{self.filename}: bad block test byte")
+            if version >= 2:
+                (mem_size,) = struct.unpack_from("<Q", self._data, p + 1)
+                p += 9
+            else:
+                (mem_size,) = struct.unpack_from("<I", self._data, p + 1)
+                p += 5
+            if self._data[p] != 0x2A:
+                raise MetadataError(f"{self.filename}: bad id test byte")
+            (id_chars,) = struct.unpack_from("<I", self._data, p + 1)
+            p += 5
+            block_id = bytes(self._data[p:p + 2 * id_chars]).decode("utf-16-le")
+            p += 2 * id_chars
+            if p + mem_size > n:
+                raise MetadataError(
+                    f"{self.filename}: memory block '{block_id}' runs past "
+                    f"EOF (truncated file?)"
+                )
+            if mem_size:
+                blocks[block_id] = (p, mem_size)
+            pos = p + mem_size
+        return blocks
+
+    def _parse_xml(self, root) -> list[dict]:
+        series: list[dict] = []
+        for el in root.iter("Element"):
+            image = el.find("./Data/Image")
+            memory = el.find("./Memory")
+            if image is None or memory is None:
+                continue
+            desc = image.find("ImageDescription")
+            if desc is None:
+                continue
+            channels = [
+                {
+                    "bits": int(c.get("Resolution", "16")),
+                    "bytes_inc": int(c.get("BytesInc", "0")),
+                }
+                for c in desc.iter("ChannelDescription")
+            ]
+            dims = {1: None, 2: None, 3: None, 4: None}
+            for d in desc.iter("DimensionDescription"):
+                dim_id = int(d.get("DimID", "0"))
+                if dim_id in dims:
+                    dims[dim_id] = {
+                        "n": int(d.get("NumberOfElements", "1")),
+                        "bytes_inc": int(d.get("BytesInc", "0")),
+                    }
+            if not channels or dims[1] is None or dims[2] is None:
+                continue
+            if any(c["bits"] not in (8, 16) for c in channels):
+                continue  # counted as undecodable; __enter__ errors if none
+            if dims[1]["bytes_inc"] <= 0 or dims[2]["bytes_inc"] <= 0:
+                # a zero X/Y stride would reach as_strided and replicate
+                # one pixel silently instead of erroring
+                continue
+            block_id = memory.get("MemoryBlockID", "")
+            if block_id not in self._blocks:
+                continue
+            series.append({
+                "name": el.get("Name", f"Series{len(series)}"),
+                "channels": channels,
+                "width": dims[1]["n"],
+                "x_inc": dims[1]["bytes_inc"],
+                "height": dims[2]["n"],
+                "y_inc": dims[2]["bytes_inc"],
+                "n_zplanes": dims[3]["n"] if dims[3] else 1,
+                "z_inc": dims[3]["bytes_inc"] if dims[3] else 0,
+                "n_tpoints": dims[4]["n"] if dims[4] else 1,
+                "t_inc": dims[4]["bytes_inc"] if dims[4] else 0,
+                "block": block_id,
+            })
+        return series
+
+    # ------------------------------------------------------------- pixels
+    def read_plane(
+        self, series: int = 0, channel: int = 0, zplane: int = 0, tpoint: int = 0
+    ) -> np.ndarray:
+        from tmlibrary_tpu.errors import MetadataError
+
+        if not 0 <= series < len(self.series):
+            raise MetadataError(
+                f"{self.filename}: no series {series} (have {len(self.series)})"
+            )
+        s = self.series[series]
+        if not 0 <= channel < len(s["channels"]):
+            raise MetadataError(
+                f"{self.filename}: series {series} has "
+                f"{len(s['channels'])} channels, asked for {channel}"
+            )
+        if not 0 <= zplane < s["n_zplanes"] or not 0 <= tpoint < s["n_tpoints"]:
+            raise MetadataError(
+                f"{self.filename}: plane z={zplane} t={tpoint} out of range "
+                f"Z={s['n_zplanes']} T={s['n_tpoints']}"
+            )
+        ch = s["channels"][channel]
+        itemsize = ch["bits"] // 8
+        base, size = self._blocks[s["block"]]
+        start = ch["bytes_inc"] + zplane * s["z_inc"] + tpoint * s["t_inc"]
+        h, w = s["height"], s["width"]
+        last = start + (h - 1) * s["y_inc"] + (w - 1) * s["x_inc"] + itemsize
+        if last > size:
+            raise MetadataError(
+                f"{self.filename}: series {series} plane runs past its "
+                f"memory block ({last} > {size} bytes)"
+            )
+        dtype = np.uint8 if itemsize == 1 else np.dtype("<u2")
+        # copy the plane's byte span out of the mmap FIRST: a frombuffer
+        # view would pin the mapping open past __exit__ (BufferError)
+        span = bytes(self._data[base + start:base + last])
+        plane = np.lib.stride_tricks.as_strided(
+            np.frombuffer(span, np.uint8),
+            shape=(h, w, itemsize),
+            strides=(s["y_inc"], s["x_inc"], 1),
+        )
+        out = np.ascontiguousarray(plane).view(dtype)[:, :, 0]
+        return out.astype(np.uint16) if itemsize == 1 else out
+
+    def read_plane_linear(self, series: int, page: int) -> np.ndarray:
+        """Decode by per-series linear page index, the encoding the lif
+        metaconfig handler writes: ``(c * Z + z) * T + t``."""
+        s = self.series[series]
+        c, rem = divmod(page, s["n_zplanes"] * s["n_tpoints"])
+        z, t = divmod(rem, s["n_tpoints"])
+        return self.read_plane(series, c, z, t)
+
+    def uniform_dims(self) -> tuple[int, int, int]:
+        """(C, Z, T), required identical across series — as is the plane
+        shape (the HCS layout the lif handler maps: series = sites of one
+        well; a mixed-size file, e.g. an overview scan plus field series,
+        must not silently set the experiment's site shape)."""
+        from tmlibrary_tpu.errors import MetadataError
+
+        dims = {
+            (len(s["channels"]), s["n_zplanes"], s["n_tpoints"])
+            for s in self.series
+        }
+        if len(dims) != 1:
+            raise MetadataError(
+                f"{self.filename}: series disagree on (C, Z, T) {sorted(dims)} "
+                "— not a uniform HCS acquisition"
+            )
+        shapes = {(s["height"], s["width"]) for s in self.series}
+        if len(shapes) != 1:
+            raise MetadataError(
+                f"{self.filename}: series disagree on plane shape "
+                f"{sorted(shapes)} — not a uniform HCS acquisition"
+            )
+        return next(iter(dims))
+
+    def read_plane_global(self, page: int) -> np.ndarray:
+        """Decode by whole-file linear page index
+        ``series * C*Z*T + (c*Z + z)*T + t`` (uniform series required)."""
+        c, z, t = self.uniform_dims()
+        series, rem = divmod(page, c * z * t)
+        return self.read_plane_linear(series, rem)
 
 
 class DatasetReader(Reader):

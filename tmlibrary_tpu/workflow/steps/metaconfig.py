@@ -312,17 +312,12 @@ class MetadataConfigurator(Step):
         ]
 
         probe_path = entries[0]["path"]
-        if probe_path.lower().endswith(".nd2"):
-            # container formats carry their own dimensions
-            from tmlibrary_tpu.readers import ND2Reader
+        # container formats (nd2/czi/lif) carry their own dimensions
+        from tmlibrary_tpu.readers import container_dimensions
 
-            with ND2Reader(probe_path) as r:
-                h, w = r.height, r.width
-        elif probe_path.lower().endswith(".czi"):
-            from tmlibrary_tpu.readers import CZIReader
-
-            with CZIReader(probe_path) as r:
-                h, w = r.height, r.width
+        dims = container_dimensions(probe_path)
+        if dims is not None:
+            h, w = dims
         else:
             probe = cv2.imread(probe_path, cv2.IMREAD_UNCHANGED)
             if probe is None:

@@ -1130,3 +1130,59 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                             }
                         )
     return entries, skipped
+
+
+# ----------------------------------------------------------------------- lif
+@register_sidecar_handler("lif")
+def lif_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
+    """Leica Image Files, read by the first-party block parser
+    (:class:`tmlibrary_tpu.readers.LIFReader`).
+
+    Same conventions as the nd2/czi handlers: one file per well (token or
+    next free column on row A), image series map to sites, channels to
+    ``C00``/…, Z/T preserved; ``page`` encodes the whole-file linear index
+    ``series * C*Z*T + (c*Z + z)*T + t`` for imextract.  Files whose
+    series disagree on (C, Z, T) are skipped with a logged reason."""
+    from tmlibrary_tpu.readers import LIFReader
+
+    files = sorted(source_dir.rglob("*.lif"))
+    if not files:
+        return None
+    readable = []
+    skipped = 0
+    for path in files:
+        try:
+            with LIFReader(path) as r:
+                n_series = r.n_series
+                n_c, n_z, n_t = r.uniform_dims()
+        except MetadataError as exc:
+            logger.warning("skipping unreadable LIF file %s: %s", path, exc)
+            skipped += 1
+            continue
+        readable.append((path, (n_series, n_c, n_z, n_t),
+                         parse_well_token(path.stem)))
+
+    entries: list[dict] = []
+    for path, (n_series, n_c, n_z, n_t), well in assign_container_wells(
+        readable, "LIF"
+    ):
+        well_row, well_col = well
+        for s in range(n_series):
+            for c in range(n_c):
+                for z in range(n_z):
+                    for t in range(n_t):
+                        entries.append(
+                            {
+                                "plate": "plate00",
+                                "well_row": well_row,
+                                "well_col": well_col,
+                                "site": s,
+                                "channel": f"C{c:02d}",
+                                "cycle": 0,
+                                "tpoint": t,
+                                "zplane": z,
+                                "path": str(path),
+                                "page": (s * n_c + c) * n_z * n_t + z * n_t + t,
+                            }
+                        )
+    return entries, skipped
