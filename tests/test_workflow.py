@@ -1056,3 +1056,72 @@ def test_spatial_layout_applies_illumination_correction(tmp_path, devices):
     )
     assert n >= 2
     np.testing.assert_array_equal(restitched, golden)
+
+
+def test_spatial_layout_sparse_well(tmp_path, devices):
+    """A well with a missing site (acquisition skip) still segments: the
+    absent tile stays zero in the mosaic and contributes no objects."""
+    from tmlibrary_tpu.models.experiment import Experiment, Plate, Site, Well
+    from tmlibrary_tpu.models.experiment import Channel as Ch
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    # 2x2 site grid with (1,1) never acquired
+    sites = (Site(y=0, x=0), Site(y=0, x=1), Site(y=1, x=0))
+    exp = Experiment(
+        name="sparse",
+        plates=[Plate(name="p0", wells=(Well(row=0, column=0, sites=sites),))],
+        channels=[Ch(index=0, name="DAPI")],
+        site_height=64, site_width=64,
+    )
+    st = ExperimentStore.create(tmp_path / "sparse_exp", exp)
+    rng = np.random.default_rng(19)
+    tiles = []
+    for _ in range(3):
+        img = rng.normal(300, 20, (64, 64))
+        yy, xx = np.mgrid[0:64, 0:64]
+        img += 4000 * np.exp(-((yy - 32) ** 2 + (xx - 32) ** 2) / (2 * 4.0**2))
+        tiles.append(np.clip(img, 0, 65535).astype(np.uint16))
+    st.write_sites(np.stack(tiles), [0, 1, 2], channel=0)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8})
+    result = jt.run(0)
+    assert result["objects"]["mosaic_cells"] == 3
+    labels = st.read_labels(None, "mosaic_cells")
+    assert labels.shape == (3, 64, 64)
+    assert all(labels[b].max() > 0 for b in range(3))
+
+
+def test_spatial_layout_engine_resume(tmp_path, devices):
+    """Engine resume skips completed spatial batches like site batches."""
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.workflow.engine import RunLedger
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "sres", well_rows=1, well_cols=2, sites_per_well=(1, 2),
+        channel_names=("DAPI",), site_shape=(64, 64),
+    )
+    st = ExperimentStore.create(tmp_path / "sres_exp", exp)
+    rng = np.random.default_rng(23)
+    imgs = []
+    for _ in range(4):
+        img = rng.normal(300, 20, (64, 64))
+        yy, xx = np.mgrid[0:64, 0:64]
+        img += 4000 * np.exp(-((yy - 20) ** 2 + (xx - 40) ** 2) / (2 * 4.0**2))
+        imgs.append(np.clip(img, 0, 65535).astype(np.uint16))
+    st.write_sites(np.stack(imgs), [0, 1, 2, 3], channel=0)
+
+    jt = get_step("jterator")(st)
+    batches = jt.init({"layout": "spatial", "n_devices": 8})
+    assert len(batches) == 2  # one per well
+    # run batch 0, record it in a ledger, then resume-style: only batch 1
+    ledger = RunLedger(st.workflow_dir / "ledger.jsonl")
+    r0 = jt.run(0)
+    ledger.append(step="jterator", event="batch_done", batch=0, result=r0)
+    done = ledger.completed_batches("jterator")
+    pending = [i for i in jt.list_batches() if i not in done]
+    assert pending == [1]
+    r1 = jt.run(1)
+    assert r1["layout"] == "spatial"
+    assert st.read_labels(None, "mosaic_cells").shape[0] == 4
