@@ -39,16 +39,23 @@ CACHE_PATH = os.environ.get(
 )
 
 
-# env knob -> record field: a cached record only represents the requested
-# workload when every explicitly-set knob matches what was measured
-_WORKLOAD_KNOBS = {
-    "BENCH_BATCH": "batch",
-    "BENCH_MAX_OBJECTS": "max_objects",
-    "BENCH_SITE_SIZE": "site_size",
-    "BENCH_SITES": "sites",
-    "BENCH_CHANNELS": "channels",
-    "BENCH_DEPTH": "depth",
-}
+# env knob -> (record field, per-config default): a cached record only
+# represents the requested workload when every knob's EFFECTIVE value
+# (env or the same default measure() would use) matches what was
+# measured — comparing only explicitly-set knobs would let a fresher
+# record of a different defaulted workload (e.g. the production
+# max_objects=256 variant) masquerade as the default headline number
+def _workload_knobs(config: str) -> dict:
+    return {
+        "BENCH_BATCH": ("batch", 16 if config == "volume" else 64),
+        "BENCH_MAX_OBJECTS": ("max_objects", 64),
+        "BENCH_SITE_SIZE": (
+            "site_size", 128 if config == "volume" else 256
+        ),
+        "BENCH_SITES": ("sites", 96),
+        "BENCH_CHANNELS": ("channels", 8),
+        "BENCH_DEPTH": ("depth", 16),
+    }
 
 
 def emit_cached_tpu(live_error: str) -> bool:
@@ -71,15 +78,16 @@ def emit_cached_tpu(live_error: str) -> bool:
     except (OSError, ValueError):
         return False
     config = os.environ.get("BENCH_CONFIG", "3")
+    knobs = _workload_knobs(config)
     entry = None
     for cand in (cache.get("records") or {}).values():
         rec = cand.get("record") or {}
         if rec.get("config") != config:
             continue
         if any(
-            field in rec and int(os.environ[knob]) != rec[field]
-            for knob, field in _WORKLOAD_KNOBS.items()
-            if os.environ.get(knob)
+            field in rec
+            and int(os.environ.get(knob) or default) != rec[field]
+            for knob, (field, default) in knobs.items()
         ):
             continue
         if entry is None or cand.get("measured_at_unix", 0) > entry.get(

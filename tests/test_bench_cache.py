@@ -111,3 +111,36 @@ def test_cache_ignored_for_other_config(cache_file):
     })
     assert out.get("backend") != "tpu_cached"
     assert out["metric"] == "corilla_channels_per_sec_per_chip"
+
+
+def test_cache_defaulted_workload_mismatch_rejected(tmp_path):
+    """A fresher record of a DIFFERENT defaulted workload (production
+    max_objects=256 variant) must not serve the default request."""
+    import time as _time
+
+    path = tmp_path / "BENCH_TPU.json"
+    base = {
+        "metric": "jterator_cell_painting_sites_per_sec_per_chip",
+        "unit": "u", "backend": "axon", "config": "3",
+        "batch": 64, "site_size": 256,
+    }
+    path.write_text(json.dumps({"records": {
+        "3": {"record": {**base, "value": 100.0, "vs_baseline": 2.0,
+                         "max_objects": 64},
+              "measured_at_unix": _time.time() - 7200,
+              "measured_at": "old", "provenance": "t"},
+        "3@mo256": {"record": {**base, "value": 50.0, "vs_baseline": 1.0,
+                               "max_objects": 256},
+                    "measured_at_unix": _time.time() - 60,
+                    "measured_at": "fresh", "provenance": "t"},
+    }}))
+    out = _run_bench({
+        "BENCH_TPU_CACHE": str(path),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+    })
+    if out.get("backend") != "tpu_cached":
+        pytest.skip(f"relay answered live: {out.get('backend')}")
+    # the default workload (max_objects=64) must win despite being staler
+    assert out["value"] == 100.0
+    assert out["max_objects"] == 64

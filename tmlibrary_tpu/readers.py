@@ -61,14 +61,50 @@ def _container_plane(reader, page: int) -> np.ndarray:
     return reader.read_plane_linear(page)
 
 
-def read_container_plane(path, page: int) -> np.ndarray | None:
-    """Open-decode-close one container plane; None for non-container
-    paths (imextract's thread-pooled per-plane loader uses this)."""
+#: (path, mtime_ns, size) -> open container reader.  imextract's decode
+#: loop calls read_container_plane once PER PLANE; re-parsing a per-well
+#: container's whole chunk map / subblock directory / XML header for
+#: every plane would be O(planes^2) parse work per file.  Readers are
+#: read-only after __enter__, so sharing one across the decode thread
+#: pool is safe; eviction only DROPS the reference (the mmap closes when
+#: the last user's reference is garbage-collected), so a concurrent
+#: reader can never see a closed mapping.
+_OPEN_READERS: dict = {}
+_OPEN_READERS_CAP = 64
+_open_readers_lock = None
+
+
+def _cached_container_reader(path):
+    import os
+    import threading
+
+    global _open_readers_lock
+    if _open_readers_lock is None:
+        _open_readers_lock = threading.Lock()
     cls = _container_reader(path)
     if cls is None:
         return None
-    with cls(path) as r:
-        return _container_plane(r, page)
+    st = os.stat(path)
+    key = (str(path), st.st_mtime_ns, st.st_size)
+    with _open_readers_lock:
+        reader = _OPEN_READERS.get(key)
+    if reader is not None:
+        return reader
+    reader = cls(path).__enter__()
+    with _open_readers_lock:
+        while len(_OPEN_READERS) >= _OPEN_READERS_CAP:
+            _OPEN_READERS.pop(next(iter(_OPEN_READERS)))
+        return _OPEN_READERS.setdefault(key, reader)
+
+
+def read_container_plane(path, page: int) -> np.ndarray | None:
+    """One container plane by linear page index; None for non-container
+    paths (imextract's thread-pooled per-plane loader uses this).  The
+    parsed container stays cached across calls — see ``_OPEN_READERS``."""
+    reader = _cached_container_reader(path)
+    if reader is None:
+        return None
+    return _container_plane(reader, page)
 
 
 def container_dimensions(path) -> tuple[int, int] | None:
@@ -558,6 +594,18 @@ class CZIReader(Reader):
 
         from tmlibrary_tpu.errors import MetadataError
 
+        for name, idx, n in (
+            ("scene", scene, self.n_scenes),
+            ("channel", channel, self.n_channels),
+            ("zplane", zplane, self.n_zplanes),
+            ("tpoint", tpoint, self.n_tpoints),
+        ):
+            if not 0 <= idx < n:
+                # a negative index would silently WRAP through the sorted
+                # id lists; match the sibling readers' MetadataError contract
+                raise MetadataError(
+                    f"{self.filename}: {name} {idx} out of range 0..{n - 1}"
+                )
         want = {
             "S": self._scene_ids[scene],
             "C": self._channel_ids[channel],

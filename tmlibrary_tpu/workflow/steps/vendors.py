@@ -1028,6 +1028,51 @@ def assign_container_wells(
     return out
 
 
+def _container_entry(path: Path, well: tuple[int, int], site: int,
+                     channel: int, zplane: int, tpoint: int,
+                     page: int) -> dict:
+    """The one home of the container-format entry schema."""
+    return {
+        "plate": "plate00",
+        "well_row": well[0],
+        "well_col": well[1],
+        "site": site,
+        "channel": f"C{channel:02d}",
+        "cycle": 0,
+        "tpoint": tpoint,
+        "zplane": zplane,
+        "path": str(path),
+        "page": page,
+    }
+
+
+def _container_sidecar(
+    source_dir: Path, suffix: str, reader_cls, kind: str,
+    dims_of: Callable, entries_of: Callable,
+) -> tuple[list[dict], int] | None:
+    """Shared scan -> skip-unreadable -> assign-wells -> emit loop of the
+    one-file-per-well container handlers (nd2/czi/lif); only the reader,
+    the dims tuple and the page formula differ per format."""
+    files = sorted(source_dir.rglob(f"*{suffix}"))
+    if not files:
+        return None
+    readable = []
+    skipped = 0
+    for path in files:
+        try:
+            with reader_cls(path) as r:
+                dims = dims_of(r)
+        except MetadataError as exc:
+            logger.warning("skipping unreadable %s file %s: %s", kind, path, exc)
+            skipped += 1
+            continue
+        readable.append((path, dims, parse_well_token(path.stem)))
+    entries: list[dict] = []
+    for path, dims, well in assign_container_wells(readable, kind):
+        entries.extend(entries_of(path, dims, well))
+    return entries, skipped
+
+
 # ----------------------------------------------------------------------- nd2
 @register_sidecar_handler("nd2")
 def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
@@ -1042,41 +1087,19 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     for imextract's plane decode."""
     from tmlibrary_tpu.readers import ND2Reader
 
-    files = sorted(source_dir.rglob("*.nd2"))
-    if not files:
-        return None
-    readable = []
-    skipped = 0
-    for path in files:
-        try:
-            with ND2Reader(path) as r:
-                dims = (r.n_sequences, r.n_components)
-        except MetadataError as exc:
-            logger.warning("skipping unreadable ND2 file %s: %s", path, exc)
-            skipped += 1
-            continue
-        readable.append((path, dims, parse_well_token(path.stem)))
+    def entries_of(path, dims, well):
+        n_seq, n_comp = dims
+        return [
+            _container_entry(path, well, site=seq, channel=comp,
+                             zplane=0, tpoint=0, page=seq * n_comp + comp)
+            for seq in range(n_seq)
+            for comp in range(n_comp)
+        ]
 
-    entries: list[dict] = []
-    for path, (n_seq, n_comp), well in assign_container_wells(readable, "ND2"):
-        well_row, well_col = well
-        for seq in range(n_seq):
-            for comp in range(n_comp):
-                entries.append(
-                    {
-                        "plate": "plate00",
-                        "well_row": well_row,
-                        "well_col": well_col,
-                        "site": seq,
-                        "channel": f"C{comp:02d}",
-                        "cycle": 0,
-                        "tpoint": 0,
-                        "zplane": 0,
-                        "path": str(path),
-                        "page": seq * n_comp + comp,
-                    }
-                )
-    return entries, skipped
+    return _container_sidecar(
+        source_dir, ".nd2", ND2Reader, "ND2",
+        lambda r: (r.n_sequences, r.n_components), entries_of,
+    )
 
 
 # ----------------------------------------------------------------------- czi
@@ -1091,45 +1114,22 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     ``page`` encodes ``((s * C + c) * Z + z) * T + t`` for imextract."""
     from tmlibrary_tpu.readers import CZIReader
 
-    files = sorted(source_dir.rglob("*.czi"))
-    if not files:
-        return None
-    readable = []
-    skipped = 0
-    for path in files:
-        try:
-            with CZIReader(path) as r:
-                dims = (r.n_scenes, r.n_channels, r.n_zplanes, r.n_tpoints)
-        except MetadataError as exc:
-            logger.warning("skipping unreadable CZI file %s: %s", path, exc)
-            skipped += 1
-            continue
-        readable.append((path, dims, parse_well_token(path.stem)))
+    def entries_of(path, dims, well):
+        n_s, n_c, n_z, n_t = dims
+        return [
+            _container_entry(path, well, site=s, channel=c, zplane=z,
+                             tpoint=t, page=((s * n_c + c) * n_z + z) * n_t + t)
+            for s in range(n_s)
+            for c in range(n_c)
+            for z in range(n_z)
+            for t in range(n_t)
+        ]
 
-    entries: list[dict] = []
-    for path, (n_s, n_c, n_z, n_t), well in assign_container_wells(
-        readable, "CZI"
-    ):
-        well_row, well_col = well
-        for s in range(n_s):
-            for c in range(n_c):
-                for z in range(n_z):
-                    for t in range(n_t):
-                        entries.append(
-                            {
-                                "plate": "plate00",
-                                "well_row": well_row,
-                                "well_col": well_col,
-                                "site": s,
-                                "channel": f"C{c:02d}",
-                                "cycle": 0,
-                                "tpoint": t,
-                                "zplane": z,
-                                "path": str(path),
-                                "page": ((s * n_c + c) * n_z + z) * n_t + t,
-                            }
-                        )
-    return entries, skipped
+    return _container_sidecar(
+        source_dir, ".czi", CZIReader, "CZI",
+        lambda r: (r.n_scenes, r.n_channels, r.n_zplanes, r.n_tpoints),
+        entries_of,
+    )
 
 
 # ----------------------------------------------------------------------- lif
@@ -1145,44 +1145,19 @@ def lif_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     series disagree on (C, Z, T) are skipped with a logged reason."""
     from tmlibrary_tpu.readers import LIFReader
 
-    files = sorted(source_dir.rglob("*.lif"))
-    if not files:
-        return None
-    readable = []
-    skipped = 0
-    for path in files:
-        try:
-            with LIFReader(path) as r:
-                n_series = r.n_series
-                n_c, n_z, n_t = r.uniform_dims()
-        except MetadataError as exc:
-            logger.warning("skipping unreadable LIF file %s: %s", path, exc)
-            skipped += 1
-            continue
-        readable.append((path, (n_series, n_c, n_z, n_t),
-                         parse_well_token(path.stem)))
+    def entries_of(path, dims, well):
+        n_series, n_c, n_z, n_t = dims
+        return [
+            _container_entry(path, well, site=s, channel=c, zplane=z,
+                             tpoint=t,
+                             page=(s * n_c + c) * n_z * n_t + z * n_t + t)
+            for s in range(n_series)
+            for c in range(n_c)
+            for z in range(n_z)
+            for t in range(n_t)
+        ]
 
-    entries: list[dict] = []
-    for path, (n_series, n_c, n_z, n_t), well in assign_container_wells(
-        readable, "LIF"
-    ):
-        well_row, well_col = well
-        for s in range(n_series):
-            for c in range(n_c):
-                for z in range(n_z):
-                    for t in range(n_t):
-                        entries.append(
-                            {
-                                "plate": "plate00",
-                                "well_row": well_row,
-                                "well_col": well_col,
-                                "site": s,
-                                "channel": f"C{c:02d}",
-                                "cycle": 0,
-                                "tpoint": t,
-                                "zplane": z,
-                                "path": str(path),
-                                "page": (s * n_c + c) * n_z * n_t + z * n_t + t,
-                            }
-                        )
-    return entries, skipped
+    return _container_sidecar(
+        source_dir, ".lif", LIFReader, "LIF",
+        lambda r: (r.n_series, *r.uniform_dims()), entries_of,
+    )
