@@ -175,8 +175,9 @@ class BFImageReader(Reader):
 
     def read(self):
         raise NotSupportedError(
-            "Bio-Formats is not available (no JVM); Nikon ND2 containers "
-            "read natively via ND2Reader / the 'nd2' metaconfig handler — "
+            "Bio-Formats is not available (no JVM); Nikon ND2, Zeiss CZI "
+            "and Leica LIF containers read natively (ND2Reader/CZIReader/"
+            "LIFReader + their auto-detected metaconfig handlers) — "
             "convert other vendor containers to TIFF/PNG and use the "
             "metaconfig filename handlers"
         )
