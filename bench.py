@@ -34,6 +34,25 @@ PROBE_CODE = (
     "print('ALIVE', jax.devices()[0])"
 )
 
+
+def probe_accelerator(timeout_s: int) -> bool:
+    """One subprocess probe: True only for a live NON-CPU default backend
+    (a CPU backend would 'pass' the computation, and a watcher trusting
+    that would loop forever re-measuring benchmarks it then discards).
+    Shared by bench.main's attempt gate and scripts/tpu_watch.py."""
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return (
+        probe.returncode == 0
+        and "ALIVE" in probe.stdout
+        and "cpu" not in probe.stdout.lower()
+    )
+
 CACHE_PATH = os.environ.get(
     "BENCH_TPU_CACHE", os.path.join(REPO, "tuning", "BENCH_TPU.json")
 )
@@ -84,9 +103,16 @@ def emit_cached_tpu(live_error: str) -> bool:
         rec = cand.get("record") or {}
         if rec.get("config") != config:
             continue
+        def _effective(knob: str, default: int) -> int:
+            try:
+                return int(os.environ.get(knob) or default)
+            except ValueError:
+                # an unparseable knob must not crash the parent: the
+                # child already failed with it, and a no-match here lets
+                # the fallback path still emit a structured record
+                return -1
         if any(
-            field in rec
-            and int(os.environ.get(knob) or default) != rec[field]
+            field in rec and _effective(knob, default) != rec[field]
             for knob, (field, default) in knobs.items()
         ):
             continue
@@ -357,16 +383,9 @@ def main() -> None:
         observed round 3 — ``jax.devices()`` can even return lazily while
         actual compute still hangs, so only a round-tripped result proves
         the chip is alive."""
-        try:
-            probe = subprocess.run(
-                [sys.executable, "-c", PROBE_CODE],
-                timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", "90")),
-                capture_output=True,
-                text=True,
-            )
-            return probe.returncode == 0 and "ALIVE" in probe.stdout
-        except subprocess.TimeoutExpired:
-            return False
+        return probe_accelerator(
+            int(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+        )
 
     def try_once(platform: str) -> bool:
         nonlocal last_err

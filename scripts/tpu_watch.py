@@ -34,7 +34,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import CACHE_PATH, PROBE_CODE  # noqa: E402
+from bench import CACHE_PATH, probe_accelerator  # noqa: E402
 
 TUNING_PATH = os.path.join(REPO, "tuning", "TUNING.json")
 PID_PATH = os.path.join(REPO, "tuning", "watch.pid")
@@ -62,14 +62,10 @@ def log(msg: str) -> None:
 
 
 def probe(timeout: int = 120) -> bool:
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", PROBE_CODE],
-            timeout=timeout, capture_output=True, text=True,
-        )
-        return r.returncode == 0 and "ALIVE" in r.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    # shared with bench.py: requires a round-tripped computation on a
+    # NON-CPU backend (a cpu backend passing the computation would loop
+    # the watcher forever re-measuring benchmarks it then discards)
+    return probe_accelerator(timeout)
 
 
 def load_json(path: str) -> dict:
@@ -196,7 +192,13 @@ def main() -> None:
             os.kill(old["pid"], 0)
             print(f"watcher already running (pid {old['pid']}); exiting")
             return
-        except (OSError, ProcessLookupError):
+        except PermissionError:
+            # EPERM means the process EXISTS (another user's watcher) —
+            # treating it as dead would run two watchers doing unlocked
+            # read-modify-writes on the cache
+            print(f"watcher already running (pid {old['pid']}, other user)")
+            return
+        except ProcessLookupError:
             pass
     os.makedirs(os.path.dirname(PID_PATH), exist_ok=True)
     with open(PID_PATH, "w") as f:

@@ -14,6 +14,7 @@ against the reference's reader API translate 1:1.
 from __future__ import annotations
 
 import json
+import threading as _threading
 from abc import ABC
 from pathlib import Path
 from xml.etree import ElementTree
@@ -71,16 +72,12 @@ def _container_plane(reader, page: int) -> np.ndarray:
 #: reader can never see a closed mapping.
 _OPEN_READERS: dict = {}
 _OPEN_READERS_CAP = 64
-_open_readers_lock = None
+_open_readers_lock = _threading.Lock()
 
 
 def _cached_container_reader(path):
     import os
-    import threading
 
-    global _open_readers_lock
-    if _open_readers_lock is None:
-        _open_readers_lock = threading.Lock()
     cls = _container_reader(path)
     if cls is None:
         return None
@@ -94,7 +91,10 @@ def _cached_container_reader(path):
     with _open_readers_lock:
         while len(_OPEN_READERS) >= _OPEN_READERS_CAP:
             _OPEN_READERS.pop(next(iter(_OPEN_READERS)))
-        return _OPEN_READERS.setdefault(key, reader)
+        winner = _OPEN_READERS.setdefault(key, reader)
+    if winner is not reader:  # lost an open race: release our fds now
+        reader.__exit__()
+    return winner
 
 
 def read_container_plane(path, page: int) -> np.ndarray | None:
@@ -257,6 +257,14 @@ class ND2Reader(Reader):
         self.height = int(attrs["uiHeight"])
         self.n_components = int(attrs.get("uiComp", 1))
         self.bits = int(attrs.get("uiBpcInMemory", 16))
+        if self.width <= 0 or self.height <= 0 or self.n_components < 1:
+            # uiComp=0 would reach divmod(page, 0) at decode time
+            self.__exit__()
+            raise MetadataError(
+                f"{self.filename}: nonsensical attributes (width="
+                f"{self.width}, height={self.height}, "
+                f"components={self.n_components})"
+            )
         if self.bits != 16:
             self.__exit__()
             raise MetadataError(
@@ -406,7 +414,16 @@ class ND2Reader(Reader):
                 f"{self.filename}: no sequence {sequence} "
                 f"(have {self.n_sequences})"
             )
-        payload = self._chunk_payload(off)
+        import struct
+
+        try:
+            payload = self._chunk_payload(off)
+        except (struct.error, OverflowError) as exc:
+            # a chunk-map offset near EOF surfaces here at READ time; the
+            # skip-on-MetadataError contract must hold on this path too
+            raise MetadataError(
+                f"{self.filename}: corrupt sequence chunk {sequence}: {exc}"
+            ) from exc
         n_px = self.height * self.width * self.n_components
         expect = 8 + 2 * n_px  # f64 timestamp + uint16 samples
         if len(payload) < expect:
@@ -430,7 +447,12 @@ class ND2Reader(Reader):
                 f"{self.filename}: no sequence {sequence} "
                 f"(have {self.n_sequences})"
             )
-        return struct.unpack_from("<d", self._chunk_payload(off), 0)[0]
+        try:
+            return struct.unpack_from("<d", self._chunk_payload(off), 0)[0]
+        except (struct.error, OverflowError) as exc:
+            raise MetadataError(
+                f"{self.filename}: corrupt sequence chunk {sequence}: {exc}"
+            ) from exc
 
 
 class CZIReader(Reader):
@@ -490,6 +512,11 @@ class CZIReader(Reader):
             self._channel_ids = sorted({p["C"] for p in self._planes})
             self._z_ids = sorted({p["Z"] for p in self._planes})
             self._t_ids = sorted({p["T"] for p in self._planes})
+            # O(1) lookups: a linear scan per plane would be O(planes^2)
+            # over a production-scale subblock directory
+            self._plane_index = {
+                (p["S"], p["C"], p["Z"], p["T"]): p for p in self._planes
+            }
             self.width = self._planes[0]["w"]
             self.height = self._planes[0]["h"]
         except MetadataError:
@@ -607,19 +634,12 @@ class CZIReader(Reader):
                 raise MetadataError(
                     f"{self.filename}: {name} {idx} out of range 0..{n - 1}"
                 )
-        want = {
-            "S": self._scene_ids[scene],
-            "C": self._channel_ids[channel],
-            "Z": self._z_ids[zplane],
-            "T": self._t_ids[tpoint],
-        }
-        plane = next(
-            (
-                p for p in self._planes
-                if all(p[k] == v for k, v in want.items())
-            ),
-            None,
-        )
+        plane = self._plane_index.get((
+            self._scene_ids[scene],
+            self._channel_ids[channel],
+            self._z_ids[zplane],
+            self._t_ids[tpoint],
+        ))
         if plane is None:
             raise MetadataError(
                 f"{self.filename}: no subblock for "
@@ -641,15 +661,23 @@ class CZIReader(Reader):
             raise MetadataError(
                 f"{self.filename}: directory points at a non-subblock segment"
             )
-        meta_size, _att_size, data_size = struct.unpack_from(
-            "<iiq", self._data, payload_off
-        )
-        # the DV entry embedded in the subblock mirrors the directory's;
-        # data starts after max(256, 16 + entry bytes) + metadata
-        entry_buf = bytes(
-            self._data[payload_off + 16:payload_off + 16 + 32 + 20 * 16]
-        )
-        _, entry_end = self._parse_entry(entry_buf, 0)
+        try:
+            meta_size, _att_size, data_size = struct.unpack_from(
+                "<iiq", self._data, payload_off
+            )
+            # the DV entry embedded in the subblock mirrors the directory's;
+            # data starts after max(256, 16 + entry bytes) + metadata
+            entry_buf = bytes(
+                self._data[payload_off + 16:payload_off + 16 + 32 + 20 * 16]
+            )
+            _, entry_end = self._parse_entry(entry_buf, 0)
+        except (struct.error, OverflowError, IndexError) as exc:
+            # truncation inside the subblock header surfaces at READ
+            # time; the skip-on-MetadataError contract must hold here too
+            raise MetadataError(
+                f"{self.filename}: corrupt subblock at "
+                f"{plane['file_pos']}: {exc}"
+            ) from exc
         data_off = payload_off + max(256, 16 + entry_end) + meta_size
         h, w = plane["h"], plane["w"]
         expect = 2 * h * w
