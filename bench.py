@@ -151,9 +151,10 @@ def measure(platform: str) -> None:
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
     config = os.environ.get("BENCH_CONFIG", "3")  # BASELINE.md milestone ladder
 
-    if config not in ("3", "4", "volume", "corilla"):
+    if config not in ("2", "3", "4", "volume", "corilla"):
         raise SystemExit(
-            f"BENCH_CONFIG must be '3', '4', 'volume' or 'corilla', got '{config}'"
+            f"BENCH_CONFIG must be '2', '3', '4', 'volume' or 'corilla', "
+            f"got '{config}'"
         )
     if config == "corilla":
         return measure_corilla(size)
@@ -187,6 +188,16 @@ def measure(platform: str) -> None:
         desc = full_feature_description()
         metric = "jterator_full_stack_sites_per_sec_per_chip"
         unit = f"sites/sec ({size}x{size}, 5ch, segment+all-features)"
+    elif config == "2":
+        from tmlibrary_tpu.benchmarks import (
+            smooth_threshold_description,
+            synthetic_cell_painting_batch,
+        )
+
+        data = synthetic_cell_painting_batch(batch, size=size, dapi_only=True)
+        desc = smooth_threshold_description()
+        metric = "jterator_smooth_threshold_sites_per_sec_per_chip"
+        unit = f"sites/sec ({size}x{size}, 1ch, smooth+adaptive threshold)"
     else:
         from tmlibrary_tpu.benchmarks import (
             cell_painting_description,
@@ -209,7 +220,7 @@ def measure(platform: str) -> None:
     # counts — under the axon relay, block_until_ready returns before the
     # remote computation finishes, so fetch-based timing is the only honest
     # clock (scalar-sized transfer, negligible vs compute).
-    count_key = "cells3d" if config == "volume" else "cells"
+    count_key = {"volume": "cells3d", "2": "fg"}.get(config, "cells")
     result = fn(raw, {}, shifts)
     np.asarray(result.counts[count_key])
 
@@ -234,6 +245,13 @@ def measure(platform: str) -> None:
 
             for s in range(n_cpu):
                 cpu_reference_site_volume(data["DAPI"][s])
+        elif config == "2":
+            from tmlibrary_tpu.benchmarks import (
+                cpu_reference_site_smooth_threshold,
+            )
+
+            for s in range(n_cpu):
+                cpu_reference_site_smooth_threshold(data["DAPI"][s])
         elif config == "4":
             from tmlibrary_tpu.benchmarks import cpu_reference_site_full
 
@@ -263,11 +281,14 @@ def measure(platform: str) -> None:
         record["depth"] = depth
     # sites whose object count sits AT the static cap may have silently
     # lost objects to clip_label_count — the headline number must carry
-    # that signal (round-2 VERDICT weak-spot #4)
-    at_cap = np.zeros(batch, bool)
-    for c in result.counts.values():
-        at_cap |= np.asarray(c) >= max_objects
-    record["saturated_sites"] = int(at_cap.sum())
+    # that signal (round-2 VERDICT weak-spot #4).  Config 2's bare label
+    # module does NOT clip (counts are exact), so the signal would be a
+    # guaranteed false positive there.
+    if config != "2":
+        at_cap = np.zeros(batch, bool)
+        for c in result.counts.values():
+            at_cap |= np.asarray(c) >= max_objects
+        record["saturated_sites"] = int(at_cap.sum())
     record.update(_flops_fields(flops, batch, best, jax.default_backend()))
     print(json.dumps(record), flush=True)
 
@@ -435,6 +456,7 @@ def main() -> None:
         return
     config = os.environ.get("BENCH_CONFIG", "3")
     metric = {
+        "2": "jterator_smooth_threshold_sites_per_sec_per_chip",
         "4": "jterator_full_stack_sites_per_sec_per_chip",
         "volume": "jterator_volume_sites_per_sec_per_chip",
         "corilla": "corilla_channels_per_sec_per_chip",

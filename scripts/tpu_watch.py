@@ -9,7 +9,7 @@ pending on-hardware work in priority order, flushing results to disk
 after every item so a mid-window relay death loses nothing:
 
 1. headline bench configs (3, 3 at the production max_objects=256, 4,
-   corilla, volume) -> ``tuning/BENCH_TPU.json`` records with full
+   corilla, volume, 2) -> ``tuning/BENCH_TPU.json`` records with full
    provenance (timestamp, wall time, env, raw record);
 2. the tuning sweep (``scripts/tune_tpu.py``, itself stage-resilient)
    -> ``tuning/TUNING.json``; already-completed stages are skipped via
@@ -46,6 +46,7 @@ BENCH_ITEMS = [
     ("4", {"BENCH_CONFIG": "4"}),
     ("corilla", {"BENCH_CONFIG": "corilla"}),
     ("volume", {"BENCH_CONFIG": "volume"}),
+    ("2", {"BENCH_CONFIG": "2"}),
 ]
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed

@@ -174,3 +174,27 @@ def test_missing_module_output_raises():
     pipe = ImageAnalysisPipeline(desc)
     with pytest.raises(PipelineError):
         pipe.build_site_fn()({"DAPI": jnp.zeros((8, 8))})
+
+
+def test_smooth_threshold_config2_matches_scipy():
+    """BASELINE config 2 (smooth + adaptive threshold + label): device
+    object counts equal the single-thread scipy twin exactly."""
+    import numpy as np
+
+    from tmlibrary_tpu.benchmarks import (
+        cpu_reference_site_smooth_threshold,
+        smooth_threshold_description,
+        synthetic_cell_painting_batch,
+    )
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+    data = synthetic_cell_painting_batch(4, size=128)
+    pipe = ImageAnalysisPipeline(smooth_threshold_description(), max_objects=256)
+    fn = pipe.build_batch_fn()
+    res = fn({"DAPI": jnp.asarray(data["DAPI"])}, {}, jnp.zeros((4, 2), jnp.int32))
+    got = np.asarray(res.counts["fg"]).tolist()
+    want = [
+        cpu_reference_site_smooth_threshold(np.asarray(data["DAPI"][s], np.float32))
+        for s in range(4)
+    ]
+    assert got == want

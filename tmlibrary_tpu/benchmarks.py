@@ -7,9 +7,10 @@ segment+measure pipeline — ``segment_primary`` (nuclei from DAPI) →
 sites/sec/chip (reference: jterator's per-site job throughput).
 
 The other ``BENCH_CONFIG`` values cover the rest of the BASELINE ladder:
-``4`` (5-channel full feature stack), ``volume`` (3-D z-stack pipeline,
-config 5 stretch) and ``corilla`` (illumination statistics, channels/sec
-— the reference's second headline metric).
+``2`` (the minimum end-to-end slice: smooth + adaptive threshold +
+label, single channel), ``4`` (5-channel full feature stack), ``volume``
+(3-D z-stack pipeline, config 5 stretch) and ``corilla`` (illumination
+statistics, channels/sec — the reference's second headline metric).
 """
 
 from __future__ import annotations
@@ -290,9 +291,15 @@ def synthetic_full_stack_batch(
 
 
 def synthetic_cell_painting_batch(
-    n_sites: int, size: int = 256, n_cells: int = 12, seed: int = 0
+    n_sites: int, size: int = 256, n_cells: int = 12, seed: int = 0,
+    dapi_only: bool = False,
 ) -> dict[str, np.ndarray]:
-    """Synthetic DAPI (nuclei) + Actin (cell body) site images, float32."""
+    """Synthetic DAPI (nuclei) + Actin (cell body) site images, float32.
+
+    ``dapi_only`` skips the Actin channel's per-cell splats (config 2
+    uses one channel; half the generator time would be thrown away).
+    Same rng draw sequence either way, so the DAPI images are identical.
+    """
     rng = np.random.default_rng(seed)
     yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
     dapi = rng.normal(300.0, 25.0, (n_sites, size, size)).astype(np.float32)
@@ -306,11 +313,12 @@ def synthetic_cell_painting_batch(
             r_c = r_n * rng.uniform(2.0, 3.0)
             d2 = (yy - y) ** 2 + (xx - x) ** 2
             dapi[s] += 4000.0 * np.exp(-d2 / (2 * r_n**2))
-            actin[s] += 1500.0 * np.exp(-d2 / (2 * r_c**2))
-    return {
-        "DAPI": np.clip(dapi, 0, 65535),
-        "Actin": np.clip(actin, 0, 65535),
-    }
+            if not dapi_only:
+                actin[s] += 1500.0 * np.exp(-d2 / (2 * r_c**2))
+    out = {"DAPI": np.clip(dapi, 0, 65535)}
+    if not dapi_only:
+        out["Actin"] = np.clip(actin, 0, 65535)
+    return out
 
 
 # ------------------------------------------------------------------ CPU golden
@@ -639,3 +647,72 @@ def cpu_reference_channel(sites: np.ndarray) -> dict[str, np.ndarray]:
         "std_log": np.sqrt(m2 / max(len(sites), 1)),
         "hist": hist,
     }
+
+
+# --------------------------------------------------- config 2 (milestone)
+#: BASELINE.json config 2: the minimum end-to-end slice — smooth +
+#: adaptive threshold on 2-D single-channel sites
+SMOOTH_THRESHOLD_PIPE = {
+    "description": "smooth + adaptive threshold (BASELINE config 2)",
+    "input": {"channels": [{"name": "DAPI", "correct": False, "align": False}]},
+    "pipeline": [
+        {
+            "handles": {
+                "module": "smooth",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage",
+                     "key": "DAPI"},
+                    {"name": "sigma", "type": "Numeric", "value": 1.5},
+                ],
+                "output": [
+                    {"name": "smoothed_image", "type": "IntensityImage",
+                     "key": "sm"}
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "threshold_adaptive",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage",
+                     "key": "sm"},
+                    {"name": "method", "type": "Character", "value": "mean"},
+                    {"name": "kernel_size", "type": "Numeric", "value": 31},
+                    {"name": "constant", "type": "Numeric", "value": 2},
+                ],
+                "output": [
+                    {"name": "mask", "type": "BinaryImage", "key": "mask"}
+                ],
+            }
+        },
+        {
+            "handles": {
+                "module": "label",
+                "input": [
+                    {"name": "mask", "type": "BinaryImage", "key": "mask"},
+                ],
+                "output": [
+                    {"name": "label_image", "type": "SegmentedObjects",
+                     "key": "fg", "objects": "fg"}
+                ],
+            }
+        },
+    ],
+}
+
+
+def smooth_threshold_description():
+    from tmlibrary_tpu.jterator.description import PipelineDescription
+
+    return PipelineDescription.from_dict(SMOOTH_THRESHOLD_PIPE)
+
+
+def cpu_reference_site_smooth_threshold(dapi: "np.ndarray") -> int:
+    """Single-threaded scipy twin of config 2 (denominator)."""
+    import scipy.ndimage as ndi
+
+    sm = ndi.gaussian_filter(dapi, 1.5, mode="reflect")
+    local_mean = ndi.uniform_filter(sm, 31, mode="reflect")
+    mask = sm > local_mean + 2
+    _, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    return n
