@@ -934,6 +934,14 @@ def test_spatial_layout_mosaic_segmentation(tmp_path, devices):
     assert len(feats) == 5
     assert set(feats["label"]) == {1, 2, 3, 4, 5}
     assert (feats["Morphology_area"] > 0).all()
+    assert ((feats["Morphology_solidity"] > 0)
+            & (feats["Morphology_solidity"] <= 1.0)).all()
+    assert (feats["Morphology_bbox_height"] > 0).all()
+    # the junction blob's bbox spans both site rows/cols of the mosaic
+    junction = feats.loc[
+        feats["Morphology_centroid_y"].sub(64).abs().idxmin()
+    ]
+    assert junction["Morphology_bbox_height"] > 8
 
     collected = get_step("jterator")(st).collect()
     assert collected["objects_total"]["mosaic_cells"] == 5

@@ -255,15 +255,48 @@ class ImageAnalysisRunner(Step):
         area_i = np.bincount(labels.ravel(), minlength=count + 1)
         cy_sum = np.zeros(count + 1)
         cx_sum = np.zeros(count + 1)
+        # bounding boxes fold into the same row-wise pass: O(foreground)
+        # total, no per-label full-mosaic scans and no native dependency
+        ymin = np.full(count + 1, labels.shape[0], np.int64)
+        ymax = np.full(count + 1, -1, np.int64)
+        xmin = np.full(count + 1, labels.shape[1], np.int64)
+        xmax = np.full(count + 1, -1, np.int64)
         col_idx = np.arange(labels.shape[1], dtype=np.float64)
         for y in range(labels.shape[0]):
             row = labels[y]
-            cy_sum += y * np.bincount(row, minlength=count + 1)
+            rc = np.bincount(row, minlength=count + 1)
+            cy_sum += y * rc
             cx_sum += np.bincount(row, weights=col_idx, minlength=count + 1)
+            nz = np.flatnonzero(row)
+            if len(nz):
+                lab = row[nz]
+                np.minimum.at(xmin, lab, nz)
+                np.maximum.at(xmax, lab, nz)
+                present = np.flatnonzero(rc)
+                ymin[present] = np.minimum(ymin[present], y)
+                ymax[present] = y
         area = area_i[1:].astype(np.float64)
         denom = np.maximum(area, 1)
         cy = cy_sum[1:] / denom
         cx = cx_sum[1:] / denom
+        bbox_h = (ymax[1:] - ymin[1:] + 1).astype(np.float64)
+        bbox_w = (xmax[1:] - xmin[1:] + 1).astype(np.float64)
+
+        # hull solidity uses the native helper when the library built; its
+        # pure-python fallback is O(count * H * W) — at mosaic scale that
+        # is effectively a hang, so degrade to NaN instead
+        from tmlibrary_tpu import native as native_mod
+
+        if count and native_mod.available():
+            solidity = native_mod.solidity_host(labels, count).astype(np.float64)
+        else:
+            if count:
+                logger.info(
+                    "native library unavailable: mosaic solidity emitted "
+                    "as NaN (the python hull fallback is quadratic at "
+                    "mosaic scale)"
+                )
+            solidity = np.full(count, np.nan)
         plate, well_row, well_col = batch["well"]
         table = pd.DataFrame({
             "site_index": -1,  # mosaic objects may span several sites
@@ -276,6 +309,9 @@ class ImageAnalysisRunner(Step):
             "Morphology_area": area,
             "Morphology_centroid_y": cy,
             "Morphology_centroid_x": cx,
+            "Morphology_bbox_height": bbox_h,
+            "Morphology_bbox_width": bbox_w,
+            "Morphology_solidity": solidity,
         })
         shard = f"well_{plate}_{well_row:02d}_{well_col:02d}"
         self.store.append_features(name, table, shard=shard)
