@@ -936,6 +936,13 @@ def test_spatial_layout_mosaic_segmentation(tmp_path, devices):
     assert (feats["Morphology_area"] > 0).all()
     assert ((feats["Morphology_solidity"] > 0)
             & (feats["Morphology_solidity"] <= 1.0)).all()
+    # intensity stats over the segmentation channel, per GLOBAL object
+    for lab in (1, 2):
+        sel = mosaic[restitched == lab].astype(np.float64)
+        row = feats.loc[feats["label"] == lab].iloc[0]
+        np.testing.assert_allclose(row["Intensity_mean_DAPI"], sel.mean(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(row["Intensity_max_DAPI"], sel.max())
     assert (feats["Morphology_bbox_height"] > 0).all()
     # the junction blob's bbox spans both site rows/cols of the mosaic
     junction = feats.loc[

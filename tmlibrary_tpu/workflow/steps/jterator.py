@@ -261,17 +261,28 @@ class ImageAnalysisRunner(Step):
         ymax = np.full(count + 1, -1, np.int64)
         xmin = np.full(count + 1, labels.shape[1], np.int64)
         xmax = np.full(count + 1, -1, np.int64)
+        # intensity statistics over the (corrected) segmentation channel
+        # ride the same row-wise pass
+        i_sum = np.zeros(count + 1)
+        i_sq = np.zeros(count + 1)
+        i_min = np.full(count + 1, np.inf)
+        i_max = np.full(count + 1, -np.inf)
         col_idx = np.arange(labels.shape[1], dtype=np.float64)
         for y in range(labels.shape[0]):
             row = labels[y]
+            vals = mosaic[y].astype(np.float64)
             rc = np.bincount(row, minlength=count + 1)
             cy_sum += y * rc
             cx_sum += np.bincount(row, weights=col_idx, minlength=count + 1)
+            i_sum += np.bincount(row, weights=vals, minlength=count + 1)
+            i_sq += np.bincount(row, weights=vals * vals, minlength=count + 1)
             nz = np.flatnonzero(row)
             if len(nz):
                 lab = row[nz]
                 np.minimum.at(xmin, lab, nz)
                 np.maximum.at(xmax, lab, nz)
+                np.minimum.at(i_min, lab, vals[nz])
+                np.maximum.at(i_max, lab, vals[nz])
                 present = np.flatnonzero(rc)
                 ymin[present] = np.minimum(ymin[present], y)
                 ymax[present] = y
@@ -281,6 +292,8 @@ class ImageAnalysisRunner(Step):
         cx = cx_sum[1:] / denom
         bbox_h = (ymax[1:] - ymin[1:] + 1).astype(np.float64)
         bbox_w = (xmax[1:] - xmin[1:] + 1).astype(np.float64)
+        i_mean = i_sum[1:] / denom
+        i_var = np.maximum(i_sq[1:] / denom - i_mean * i_mean, 0.0)
 
         # hull solidity uses the native helper when the library built; its
         # pure-python fallback is O(count * H * W) — at mosaic scale that
@@ -312,6 +325,11 @@ class ImageAnalysisRunner(Step):
             "Morphology_bbox_height": bbox_h,
             "Morphology_bbox_width": bbox_w,
             "Morphology_solidity": solidity,
+            f"Intensity_mean_{ch_name}": i_mean,
+            f"Intensity_sum_{ch_name}": i_sum[1:],
+            f"Intensity_std_{ch_name}": np.sqrt(i_var),
+            f"Intensity_min_{ch_name}": np.where(area > 0, i_min[1:], 0.0),
+            f"Intensity_max_{ch_name}": np.where(area > 0, i_max[1:], 0.0),
         })
         shard = f"well_{plate}_{well_row:02d}_{well_col:02d}"
         self.store.append_features(name, table, shard=shard)
