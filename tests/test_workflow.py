@@ -1140,3 +1140,44 @@ def test_spatial_layout_engine_resume(tmp_path, devices):
     r1 = jt.run(1)
     assert r1["layout"] == "spatial"
     assert st.read_labels(None, "mosaic_cells").shape[0] == 4
+
+
+def test_spatial_layout_multichannel_intensity(tmp_path, devices):
+    """All channels get per-global-object intensity columns, not just the
+    segmentation channel."""
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "spatmc", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI", "GFP"), site_shape=(64, 64),
+    )
+    st = ExperimentStore.create(tmp_path / "spatmc_exp", exp)
+    rng = np.random.default_rng(31)
+    yy, xx = np.mgrid[0:128, 0:128]
+    dapi = rng.normal(300, 20, (128, 128))
+    dapi += 4000 * np.exp(-((yy - 64) ** 2 + (xx - 64) ** 2) / (2 * 4.0**2))
+    dapi = np.clip(dapi, 0, 65535).astype(np.uint16)
+    gfp = rng.integers(100, 900, (128, 128)).astype(np.uint16)
+    for ch, mos in ((0, dapi), (1, gfp)):
+        st.write_sites(np.stack([mos[:64, :64], mos[:64, 64:],
+                                 mos[64:, :64], mos[64:, 64:]]),
+                       [0, 1, 2, 3], channel=ch)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8})
+    jt.run(0)
+    feats = st.read_features("mosaic_cells")
+    assert len(feats) == 1
+    labels = st.read_labels(None, "mosaic_cells")
+    full = np.zeros((128, 128), np.int32)
+    full[:64, :64] = labels[0]; full[:64, 64:] = labels[1]
+    full[64:, :64] = labels[2]; full[64:, 64:] = labels[3]
+    row = feats.iloc[0]
+    for ch_name, mos in (("DAPI", dapi), ("GFP", gfp)):
+        sel = mos[full == 1].astype(np.float64)
+        np.testing.assert_allclose(
+            row[f"Intensity_mean_{ch_name}"], sel.mean(), rtol=1e-6
+        )
+        np.testing.assert_allclose(row[f"Intensity_max_{ch_name}"], sel.max())
+        np.testing.assert_allclose(row[f"Intensity_min_{ch_name}"], sel.min())

@@ -28,6 +28,28 @@ from tmlibrary_tpu.workflow.registry import register_step
 
 logger = logging.getLogger(__name__)
 
+
+def _mosaic_intensity_stats(labels, vals_mosaic, count):
+    """Row-wise ragged per-object intensity accumulators over a mosaic:
+    (sum, sq_sum, min, max), each ``(count + 1,)`` with index 0 =
+    background.  O(foreground) total, O(W + count) transients."""
+    i_sum = np.zeros(count + 1)
+    i_sq = np.zeros(count + 1)
+    i_min = np.full(count + 1, np.inf)
+    i_max = np.full(count + 1, -np.inf)
+    for y in range(labels.shape[0]):
+        row = labels[y]
+        vals = vals_mosaic[y].astype(np.float64)
+        i_sum += np.bincount(row, weights=vals, minlength=count + 1)
+        i_sq += np.bincount(row, weights=vals * vals, minlength=count + 1)
+        nz = np.flatnonzero(row)
+        if len(nz):
+            lab = row[nz]
+            np.minimum.at(i_min, lab, vals[nz])
+            np.maximum.at(i_max, lab, vals[nz])
+    return i_sum, i_sq, i_min, i_max
+
+
 _CORRECT_JIT = None
 
 
@@ -164,6 +186,27 @@ class ImageAnalysisRunner(Step):
         return self._persist(batch, result)
 
     # ------------------------------------------------------------ spatial run
+    def _stitched_channel(
+        self, sites, srefs, ch_index, args, n_sy, n_sx, h, w
+    ) -> "np.ndarray":
+        """One channel's well mosaic, illumination-corrected when corilla
+        statistics exist (the same correction the sites layout's
+        preprocess applies — the two layouts must see the same pixels)."""
+        imgs = self.store.read_sites(
+            sites, cycle=args["cycle"], channel=ch_index,
+            tpoint=args["tpoint"], zplane=args["zplane"],
+        )
+        if self.store.has_illumstats(cycle=args["cycle"], channel=ch_index):
+            cont = IllumstatsContainer.from_store(
+                self.store.read_illumstats(cycle=args["cycle"], channel=ch_index)
+            )
+            imgs = _correct_batch(imgs, cont.mean_log, cont.std_log)
+        mosaic = np.zeros((n_sy * h, n_sx * w), np.float32)
+        for img, r in zip(imgs, srefs):
+            mosaic[r.site_y * h:(r.site_y + 1) * h,
+                   r.site_x * w:(r.site_x + 1) * w] = img
+        return mosaic
+
     def _run_spatial(self, batch: dict) -> dict:
         """Whole-mosaic segmentation of one well (``--layout spatial``).
 
@@ -195,15 +238,6 @@ class ImageAnalysisRunner(Step):
 
         ch_name = args["spatial_channel"] or exp.channels[0].name
         idx = exp.channel_index(ch_name)
-        imgs = self.store.read_sites(sites, cycle=args["cycle"], channel=idx,
-                                     tpoint=tpoint, zplane=zplane)
-        if self.store.has_illumstats(cycle=args["cycle"], channel=idx):
-            # the two layouts must segment the same pixels: apply the same
-            # correction the sites layout's preprocess applies
-            cont = IllumstatsContainer.from_store(
-                self.store.read_illumstats(cycle=args["cycle"], channel=idx)
-            )
-            imgs = _correct_batch(imgs, cont.mean_log, cont.std_log)
         if args.get("figures"):
             logger.warning(
                 "--figures is not supported in the spatial layout "
@@ -214,10 +248,7 @@ class ImageAnalysisRunner(Step):
         h, w = exp.site_height, exp.site_width
         n_sy = max(r.site_y for r in srefs) + 1
         n_sx = max(r.site_x for r in srefs) + 1
-        mosaic = np.zeros((n_sy * h, n_sx * w), np.float32)
-        for img, r in zip(imgs, srefs):
-            mosaic[r.site_y * h:(r.site_y + 1) * h,
-                   r.site_x * w:(r.site_x + 1) * w] = img
+        mosaic = self._stitched_channel(sites, srefs, idx, args, n_sy, n_sx, h, w)
 
         requested = args["n_devices"] or len(jax.devices())
         requested = min(requested, len(jax.devices()))
@@ -261,28 +292,17 @@ class ImageAnalysisRunner(Step):
         ymax = np.full(count + 1, -1, np.int64)
         xmin = np.full(count + 1, labels.shape[1], np.int64)
         xmax = np.full(count + 1, -1, np.int64)
-        # intensity statistics over the (corrected) segmentation channel
-        # ride the same row-wise pass
-        i_sum = np.zeros(count + 1)
-        i_sq = np.zeros(count + 1)
-        i_min = np.full(count + 1, np.inf)
-        i_max = np.full(count + 1, -np.inf)
         col_idx = np.arange(labels.shape[1], dtype=np.float64)
         for y in range(labels.shape[0]):
             row = labels[y]
-            vals = mosaic[y].astype(np.float64)
             rc = np.bincount(row, minlength=count + 1)
             cy_sum += y * rc
             cx_sum += np.bincount(row, weights=col_idx, minlength=count + 1)
-            i_sum += np.bincount(row, weights=vals, minlength=count + 1)
-            i_sq += np.bincount(row, weights=vals * vals, minlength=count + 1)
             nz = np.flatnonzero(row)
             if len(nz):
                 lab = row[nz]
                 np.minimum.at(xmin, lab, nz)
                 np.maximum.at(xmax, lab, nz)
-                np.minimum.at(i_min, lab, vals[nz])
-                np.maximum.at(i_max, lab, vals[nz])
                 present = np.flatnonzero(rc)
                 ymin[present] = np.minimum(ymin[present], y)
                 ymax[present] = y
@@ -292,8 +312,6 @@ class ImageAnalysisRunner(Step):
         cx = cx_sum[1:] / denom
         bbox_h = (ymax[1:] - ymin[1:] + 1).astype(np.float64)
         bbox_w = (xmax[1:] - xmin[1:] + 1).astype(np.float64)
-        i_mean = i_sum[1:] / denom
-        i_var = np.maximum(i_sq[1:] / denom - i_mean * i_mean, 0.0)
 
         # hull solidity uses the native helper when the library built; its
         # pure-python fallback is O(count * H * W) — at mosaic scale that
@@ -311,7 +329,7 @@ class ImageAnalysisRunner(Step):
                 )
             solidity = np.full(count, np.nan)
         plate, well_row, well_col = batch["well"]
-        table = pd.DataFrame({
+        cols = {
             "site_index": -1,  # mosaic objects may span several sites
             "plate": plate,
             "well_row": well_row,
@@ -325,12 +343,30 @@ class ImageAnalysisRunner(Step):
             "Morphology_bbox_height": bbox_h,
             "Morphology_bbox_width": bbox_w,
             "Morphology_solidity": solidity,
-            f"Intensity_mean_{ch_name}": i_mean,
-            f"Intensity_sum_{ch_name}": i_sum[1:],
-            f"Intensity_std_{ch_name}": np.sqrt(i_var),
-            f"Intensity_min_{ch_name}": np.where(area > 0, i_min[1:], 0.0),
-            f"Intensity_max_{ch_name}": np.where(area > 0, i_max[1:], 0.0),
-        })
+        }
+        # intensity over EVERY channel (sites-layout parity:
+        # measure_intensity per channel), one stitched mosaic at a time;
+        # the segmentation channel reuses the already-corrected stitch.
+        # Zero-object wells still emit the (empty) columns so every
+        # well's parquet shard carries the same schema.
+        for ch in exp.channels:
+            if count == 0:
+                empty = np.zeros(0)
+                for stat in ("mean", "sum", "std", "min", "max"):
+                    cols[f"Intensity_{stat}_{ch.name}"] = empty
+                continue
+            vals_mosaic = mosaic if ch.index == idx else self._stitched_channel(
+                sites, srefs, ch.index, args, n_sy, n_sx, h, w
+            )
+            s2, q2, mn2, mx2 = _mosaic_intensity_stats(labels, vals_mosaic, count)
+            mean2 = s2[1:] / denom
+            var2 = np.maximum(q2[1:] / denom - mean2 * mean2, 0.0)
+            cols[f"Intensity_mean_{ch.name}"] = mean2
+            cols[f"Intensity_sum_{ch.name}"] = s2[1:]
+            cols[f"Intensity_std_{ch.name}"] = np.sqrt(var2)
+            cols[f"Intensity_min_{ch.name}"] = np.where(area > 0, mn2[1:], 0.0)
+            cols[f"Intensity_max_{ch.name}"] = np.where(area > 0, mx2[1:], 0.0)
+        table = pd.DataFrame(cols)
         shard = f"well_{plate}_{well_row:02d}_{well_col:02d}"
         self.store.append_features(name, table, shard=shard)
 
