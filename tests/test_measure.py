@@ -553,3 +553,20 @@ def test_zernike_host_matches_xla():
         np.testing.assert_allclose(
             np.asarray(host[k]), np.asarray(xla[k]), rtol=2e-3, atol=2e-4
         )
+
+
+def test_zernike_host_features_matches_fg_twin():
+    """The row-blocked ragged API must reproduce _zernike_host exactly
+    (same math, different blocking)."""
+    from tmlibrary_tpu.ops.measure import _zernike_host, zernike_host_features
+
+    labels = np.zeros((96, 96), np.int32)
+    yy, xx = np.mgrid[0:96, 0:96]
+    for i, (cy, cx, ry, rx) in enumerate(
+        [(25, 25, 12, 7), (70, 30, 9, 9), (50, 70, 14, 6)]
+    ):
+        labels[(((xx - cx) / rx) ** 2 + ((yy - cy) / ry) ** 2) <= 1.0] = i + 1
+    for block in (8, 33, 512):
+        got = zernike_host_features(labels, 3, degree=6, row_block=block)
+        want = _zernike_host(labels, 3, 6)
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)

@@ -1181,3 +1181,5 @@ def test_spatial_layout_multichannel_intensity(tmp_path, devices):
         )
         np.testing.assert_allclose(row[f"Intensity_max_{ch_name}"], sel.max())
         np.testing.assert_allclose(row[f"Intensity_min_{ch_name}"], sel.min())
+    # Zernike shape moments present and sane (Z_00 of a blob ~ 1/pi)
+    assert abs(row["Zernike_0_0"] - 1.0 / np.pi) < 0.05

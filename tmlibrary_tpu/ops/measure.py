@@ -743,6 +743,101 @@ def _zernike_host(labels: "np.ndarray", max_objects: int, degree: int) -> "np.nd
     return out
 
 
+def zernike_host_features(
+    labels: "np.ndarray", count: int, degree: int = 9, row_block: int = 512
+) -> "np.ndarray":
+    """PUBLIC ragged host Zernike for dynamic object counts (the spatial
+    mosaic path): same math and normalization as :func:`_zernike_host`,
+    but processed in row blocks so transient memory stays
+    O(row_block * W + count) next to a plate-scale mosaic instead of
+    materializing every foreground pixel's polar tables at once.
+    Returns ``(count, n_table)`` float32 magnitudes in
+    :func:`_zernike_coeffs` order."""
+    labels = np.asarray(labels)
+    table = _zernike_coeffs(degree)
+    out = np.zeros((count, len(table)), np.float32)
+    if count == 0:
+        return out
+    h, w = labels.shape
+    colf = np.arange(w, dtype=np.float64)
+
+    # pass 1: area + centroids
+    area = np.zeros(count + 1)
+    ysum = np.zeros(count + 1)
+    xsum = np.zeros(count + 1)
+    for y0 in range(0, h, row_block):
+        blk = labels[y0:y0 + row_block]
+        flat = blk.ravel()
+        area += np.bincount(flat, minlength=count + 1)
+        rows = np.repeat(
+            np.arange(y0, y0 + blk.shape[0], dtype=np.float64), w
+        )
+        xsum += np.bincount(flat, weights=np.tile(colf, blk.shape[0]),
+                            minlength=count + 1)
+        ysum += np.bincount(flat, weights=rows, minlength=count + 1)
+    safe_a = np.maximum(area[1:], 1.0)
+    cy = np.concatenate([[0.0], ysum[1:] / safe_a])
+    cx = np.concatenate([[0.0], xsum[1:] / safe_a])
+
+    # pass 2: per-object max radius
+    r2_max = np.zeros(count + 1)
+    for y0 in range(0, h, row_block):
+        blk = labels[y0:y0 + row_block]
+        ys, xs = np.nonzero(blk)
+        if not len(ys):
+            continue
+        lab = blk[ys, xs]
+        dy = (ys + y0) - cy[lab]
+        dx = xs - cx[lab]
+        np.maximum.at(r2_max, lab, dy * dy + dx * dx)
+    r_obj = np.concatenate([
+        [1.0],
+        np.sqrt(np.maximum(np.where(area[1:] > 0, r2_max[1:], 1.0), 1.0)),
+    ])
+
+    # pass 3: basis projections
+    re_acc = np.zeros((len(table), count + 1))
+    im_acc = np.zeros((len(table), count + 1))
+    for y0 in range(0, h, row_block):
+        blk = labels[y0:y0 + row_block]
+        ys, xs = np.nonzero(blk)
+        if not len(ys):
+            continue
+        lab = blk[ys, xs]
+        dy = (ys + y0) - cy[lab]
+        dx = xs - cx[lab]
+        r2 = dy * dy + dx * dx
+        rho = np.sqrt(r2) / r_obj[lab]
+        theta = np.arctan2(dy, dx)
+        ok = (rho <= 1.0).astype(np.float64)
+        rho_pow = [np.ones_like(rho)]
+        for _ in range(degree):
+            rho_pow.append(rho_pow[-1] * rho)
+        cos_m = [np.ones_like(theta)]
+        sin_m = [np.zeros_like(theta)]
+        for m_ in range(1, degree + 1):
+            cos_m.append(np.cos(m_ * theta))
+            sin_m.append(np.sin(m_ * theta))
+        for idx, (n, m_, coeffs) in enumerate(table):
+            radial = np.zeros_like(rho)
+            for k, c in enumerate(coeffs):
+                radial = radial + float(c) * rho_pow[n - 2 * k]
+            base = radial * ok
+            re_acc[idx] += np.bincount(
+                lab, weights=base * cos_m[m_], minlength=count + 1
+            )
+            im_acc[idx] += np.bincount(
+                lab, weights=base * sin_m[m_], minlength=count + 1
+            )
+    for idx, (n, m_, _) in enumerate(table):
+        mag = (
+            np.sqrt(re_acc[idx, 1:] ** 2 + im_acc[idx, 1:] ** 2)
+            * (n + 1) / np.pi / safe_a
+        )
+        out[:, idx] = np.where(area[1:] > 0, mag, 0.0)
+    return out
+
+
 def zernike_features(
     labels: jax.Array,
     max_objects: int,

@@ -111,6 +111,9 @@ class ImageAnalysisRunner(Step):
                  help="gaussian sigma for spatial-layout smoothing"),
         Argument("spatial_objects", str, default="mosaic_cells",
                  help="objects name for spatial-layout segmentation output"),
+        Argument("spatial_zernike_degree", int, default=9,
+                 help="Zernike moment degree for spatial-layout features "
+                      "(matches measure_zernike's default; 0 disables)"),
         Argument("batch_size", int, default=32, help="sites per device batch"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
@@ -366,6 +369,19 @@ class ImageAnalysisRunner(Step):
             cols[f"Intensity_std_{ch.name}"] = np.sqrt(var2)
             cols[f"Intensity_min_{ch.name}"] = np.where(area > 0, mn2[1:], 0.0)
             cols[f"Intensity_max_{ch.name}"] = np.where(area > 0, mx2[1:], 0.0)
+        # shape moments: the public ragged host Zernike handles a dynamic
+        # object count in row blocks (mahotas semantics; default degree 9
+        # matches the sites layout's measure_zernike default, 0 disables)
+        z_degree = args["spatial_zernike_degree"]
+        if z_degree > 0:
+            from tmlibrary_tpu.ops.measure import (
+                _zernike_coeffs,
+                zernike_host_features,
+            )
+
+            zern = zernike_host_features(labels, count, z_degree)
+            for z_idx, (n_z, m_z, _) in enumerate(_zernike_coeffs(z_degree)):
+                cols[f"Zernike_{n_z}_{m_z}"] = zern[:, z_idx].astype(np.float64)
         table = pd.DataFrame(cols)
         shard = f"well_{plate}_{well_row:02d}_{well_col:02d}"
         self.store.append_features(name, table, shard=shard)
