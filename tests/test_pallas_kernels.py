@@ -209,6 +209,37 @@ def test_pallas_enabled_resolution_order(monkeypatch):
     assert pk.pallas_enabled() is False
 
 
+def test_pallas_enabled_per_kernel(monkeypatch):
+    """The measured per-kernel shootout beats the aggregate verdict: a
+    split TUNING.json (cc faster in pallas, watershed faster in xla)
+    must dispatch each kernel to its own winner."""
+    from tmlibrary_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("TMX_PALLAS", raising=False)
+    split = {
+        "pallas_wins": True,
+        "kernels_ms": {
+            "cc_pallas": 88.8, "cc_xla": 186.9,
+            "watershed_pallas": 53.4, "watershed_xla": 47.4,
+            "distance_pallas": None, "distance_xla": 68.2,  # failed kernel
+        },
+    }
+    monkeypatch.setattr(pk, "_tuning_results", lambda: split)
+    assert pk.pallas_enabled("cc") is True
+    assert pk.pallas_enabled("watershed") is False
+    # null timing (kernel FAILED on hardware during the shootout) ->
+    # never auto-dispatch to the failed kernel
+    assert pk.pallas_enabled("distance") is False
+    # unknown kernel name (no shootout entry at all) -> aggregate fallback
+    assert pk.pallas_enabled("nope") is True
+    # env override still beats the per-kernel data, both directions
+    monkeypatch.setenv("TMX_PALLAS", "0")
+    assert pk.pallas_enabled("cc") is False
+    monkeypatch.setenv("TMX_PALLAS", "1")
+    assert pk.pallas_enabled("watershed") is True
+
+
 def test_glcm_method_resolution(monkeypatch):
     """GLCM accumulation: scatter on CPU, tuning verdict on TPU (matmul
     when absent), matmul elsewhere."""

@@ -111,8 +111,9 @@ def connected_components(
 
     ``"auto"`` resolution order (pinned): native on the cpu backend when
     the library is available and ``TMX_NATIVE`` isn't 0 → pallas on TPU
-    per ``pallas_kernels.pallas_enabled`` → xla.  All three produce the
-    identical scipy-scan-order labeling.
+    per ``pallas_kernels.pallas_enabled("cc")`` (the measured per-kernel
+    shootout; on v5e the VMEM fixpoint wins ~2.1x) → xla.  All three
+    produce the identical scipy-scan-order labeling.
     """
     mask = jnp.asarray(mask, bool)
     h, w = mask.shape
@@ -127,7 +128,7 @@ def connected_components(
         if native.cpu_native_enabled():
             method = "native"
         else:
-            method = "pallas" if pallas_enabled() else "xla"
+            method = "pallas" if pallas_enabled("cc") else "xla"
     if method == "native":
         import numpy as np
 

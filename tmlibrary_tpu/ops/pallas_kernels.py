@@ -276,13 +276,20 @@ def _tuning_results() -> dict:
         return {}
 
 
-def pallas_enabled() -> bool:
+def pallas_enabled(kernel: str | None = None) -> bool:
     """Whether ``method="auto"`` dispatches to the pallas kernels.
 
     Resolution order on TPU-class backends: the ``TMX_PALLAS`` env var
-    (explicit override) → the committed hardware tuning verdict
-    (``tuning/TUNING.json`` ``pallas_wins``) → off.  CPU/GPU always use
-    the XLA twins (the portable path and the golden reference).
+    (explicit global override) → the committed per-kernel shootout
+    (``tuning/TUNING.json`` ``kernels_ms``: ``{kernel}_pallas`` vs
+    ``{kernel}_xla``, when ``kernel`` is one of ``"cc"`` /
+    ``"watershed"`` / ``"distance"`` and both timings are present) → the
+    aggregate ``pallas_wins`` verdict → off.  The per-kernel gate matters
+    because the hardware verdict is split: on TPU v5e the CC fixpoint is
+    ~2.1x faster in VMEM while the watershed/distance fixpoints measured
+    slightly faster as XLA loops — a single global flag would pick wrong
+    for one side or the other.  CPU/GPU always use the XLA twins (the
+    portable path and the golden reference).
     """
     import os
 
@@ -291,4 +298,16 @@ def pallas_enabled() -> bool:
     env = os.environ.get("TMX_PALLAS")
     if env is not None:
         return env not in ("0", "false", "no")
-    return bool(_tuning_results().get("pallas_wins", False))
+    tuning = _tuning_results()
+    if kernel is not None:
+        ms = tuning.get("kernels_ms") or {}
+        t_pallas = ms.get(f"{kernel}_pallas")
+        t_xla = ms.get(f"{kernel}_xla")
+        if isinstance(t_pallas, (int, float)) and isinstance(t_xla, (int, float)):
+            return t_pallas < t_xla
+        # a kernel that failed on hardware during the shootout is recorded
+        # as null — never auto-dispatch to it, even if the aggregate
+        # verdict says pallas wins overall
+        if t_pallas is None and f"{kernel}_pallas" in ms:
+            return False
+    return bool(tuning.get("pallas_wins", False))

@@ -31,7 +31,9 @@ def distance_transform_approx(
     identical fixpoint in VMEM; ``"native"`` computes the same values via
     a two-pass chamfer in C++ (``tm_chebyshev_dt``) — the fast path on
     the CPU backend.  ``"auto"`` resolution order (pinned): native on cpu
-    when available → pallas on TPU → xla.
+    when available → pallas on TPU per
+    ``pallas_kernels.pallas_enabled("distance")`` (measured per-kernel
+    shootout) → xla.
     """
     mask = jnp.asarray(mask, bool)
     if method == "auto":
@@ -42,7 +44,7 @@ def distance_transform_approx(
         else:
             from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
 
-            method = "pallas" if pallas_enabled() else "xla"
+            method = "pallas" if pallas_enabled("distance") else "xla"
     if method == "native":
         import numpy as np
 

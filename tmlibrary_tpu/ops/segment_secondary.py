@@ -86,7 +86,9 @@ def watershed_from_seeds(
     via ``jax.pure_callback`` — the fast path on the CPU backend, where
     per-level ``lax.while_loop`` convergence is pathological.
     ``"auto"`` resolution order (pinned): native on cpu when available →
-    pallas on TPU per ``pallas_kernels.pallas_enabled`` → xla.  Identical
+    pallas on TPU per ``pallas_kernels.pallas_enabled("watershed")`` (the
+    measured per-kernel shootout — on v5e the XLA level loop edged out
+    the VMEM flood, so auto stays xla there) → xla.  Identical
     schedule and tie-breaking all three ways (the native path receives
     the level thresholds computed by the same jitted expression, so band
     membership is decided by exact float comparisons).
@@ -99,7 +101,7 @@ def watershed_from_seeds(
         else:
             from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
 
-            method = "pallas" if pallas_enabled() else "xla"
+            method = "pallas" if pallas_enabled("watershed") else "xla"
     if method == "pallas":
         from tmlibrary_tpu.ops.pallas_kernels import watershed_flood
 
