@@ -48,13 +48,21 @@ def _conv1d(img: jax.Array, kernel: jnp.ndarray, axis: int) -> jax.Array:
     return out
 
 
+def gaussian_radius(sigma: float, truncate: float = 4.0) -> int:
+    """Kernel reach of :func:`gaussian_smooth` — ``int(truncate * sigma
+    + 0.5)`` exactly as scipy computes it.  The sharded halo wrappers
+    size their exchange from THIS helper so the halo can never drift
+    out of lockstep with the kernel radius."""
+    return int(truncate * float(sigma) + 0.5)
+
+
 def gaussian_smooth(img: jax.Array, sigma: float, truncate: float = 4.0) -> jax.Array:
     """Separable Gaussian blur matching ``scipy.ndimage.gaussian_filter``.
 
-    ``sigma``/``truncate`` are static (compile-time) parameters — radius is
-    ``int(truncate * sigma + 0.5)`` exactly as scipy computes it.
+    ``sigma``/``truncate`` are static (compile-time) parameters — radius
+    comes from :func:`gaussian_radius`.
     """
-    radius = int(truncate * float(sigma) + 0.5)
+    radius = gaussian_radius(sigma, truncate)
     k = _gaussian_kernel1d(float(sigma), radius)
     out = _conv1d(jnp.asarray(img, jnp.float32), k, axis=0)
     return _conv1d(out, k, axis=1)

@@ -46,6 +46,87 @@ def halo_exchange(block: jax.Array, halo: int, axis_name: str) -> jax.Array:
     return jnp.concatenate([top, block, bottom], axis=0)
 
 
+def halo_exchange_2d(
+    block: jax.Array, halo: int, row_axis: str, col_axis: str
+) -> jax.Array:
+    """Extend a 2-D-sharded block with ``halo`` rows AND columns from its
+    neighbors, including the diagonal corners.
+
+    Corner data needs no extra collective: the vertical exchange runs
+    first, so when the horizontal exchange then ships the vertically
+    extended block's edge columns, those columns already carry the halo
+    rows the column-neighbor received from ITS vertical neighbors — i.e.
+    exactly this shard's diagonal neighbors' corner pixels.  Boundary
+    shards reflect symmetrically on their outer edges, matching a global
+    ``mode='symmetric'`` pad.  Returns ``(rows + 2*halo, cols + 2*halo)``.
+    """
+    ext = halo_exchange(block, halo, row_axis)
+    n = lax.axis_size(col_axis)
+    idx = lax.axis_index(col_axis)
+    from_prev = lax.ppermute(
+        ext[:, -halo:], col_axis, [(i, (i + 1) % n) for i in range(n)]
+    )
+    from_next = lax.ppermute(
+        ext[:, :halo], col_axis, [(i, (i - 1) % n) for i in range(n)]
+    )
+    reflect_left = ext[:, :halo][:, ::-1]
+    reflect_right = ext[:, -halo:][:, ::-1]
+    left = jnp.where(idx == 0, reflect_left, from_prev)
+    right = jnp.where(idx == n - 1, reflect_right, from_next)
+    return jnp.concatenate([left, ext, right], axis=1)
+
+
+def sharded_halo_map_2d(
+    fn,
+    image: jax.Array,
+    mesh: Mesh,
+    halo: int,
+    row_axis: str = "rows",
+    col_axis: str = "cols",
+):
+    """2-D twin of :func:`sharded_halo_map`: apply a neighborhood op with
+    reach <= ``halo`` over an image sharded on BOTH spatial axes.  Both
+    image dimensions must divide their mesh axis."""
+    h, w = image.shape
+    nr = mesh.shape[row_axis]
+    nc = mesh.shape[col_axis]
+    if h % nr != 0 or w % nc != 0:
+        raise ShardingError(
+            f"image {h}x{w} not divisible by mesh {nr}x{nc}"
+        )
+
+    def body(block):
+        extended = halo_exchange_2d(block, halo, row_axis, col_axis)
+        out = fn(extended)
+        return out[halo:-halo, halo:-halo]
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(row_axis, col_axis),
+        out_specs=PartitionSpec(row_axis, col_axis),
+    )
+    return jax.jit(mapped)(image)
+
+
+def sharded_gaussian_smooth_2d(
+    image: jax.Array,
+    mesh: Mesh,
+    sigma: float,
+    row_axis: str = "rows",
+    col_axis: str = "cols",
+) -> jax.Array:
+    """Gaussian blur over an image sharded on both spatial axes,
+    bit-matching the single-device ``ops.smooth.gaussian_smooth``."""
+    from tmlibrary_tpu.ops.smooth import gaussian_radius, gaussian_smooth
+
+    radius = gaussian_radius(sigma)
+    return sharded_halo_map_2d(
+        functools.partial(gaussian_smooth, sigma=sigma),
+        image, mesh, radius, row_axis, col_axis,
+    )
+
+
 def sharded_halo_map(
     fn,
     image: jax.Array,
@@ -84,9 +165,9 @@ def sharded_gaussian_smooth(
 ) -> jax.Array:
     """Row-sharded Gaussian blur, bit-matching the single-device
     ``ops.smooth.gaussian_smooth`` (and thus scipy) including edges."""
-    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+    from tmlibrary_tpu.ops.smooth import gaussian_radius, gaussian_smooth
 
-    radius = int(4.0 * float(sigma) + 0.5)
+    radius = gaussian_radius(sigma)
     return sharded_halo_map(
         functools.partial(gaussian_smooth, sigma=sigma), image, mesh, radius, axis
     )

@@ -56,8 +56,10 @@ def _local_fixpoint(labels, mask, connectivity, axis_name=None):
     init_flag = jnp.bool_(True)
     if axis_name is not None:
         # under shard_map the carry must be device-varying like the body's
-        # output (vma typing)
-        init_flag = lax.pcast(init_flag, (axis_name,), to="varying")
+        # output (vma typing); axis_name may be one name or a tuple (the
+        # 2-D spatial layout is varying over both mesh axes)
+        names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+        init_flag = lax.pcast(init_flag, names, to="varying")
     out, _ = lax.while_loop(lambda s: s[1], body, (labels, init_flag))
     return out
 
@@ -192,6 +194,203 @@ def distributed_connected_components(
             f"max_roots_per_shard={k}; raise the bound"
         )
     return labels, jnp.asarray(counts)[0]
+
+
+def _edge_extend(vec_lab, vec_msk, other_axis):
+    """Extend a boundary row ``(W,)`` with ONE corner pixel from each
+    neighbor along ``other_axis`` — the missing operand for diagonal
+    (8-connectivity) adjacencies that cross a seam corner where four
+    shards meet.  Returns ``(W + 2,)`` arrays; the added pixels are
+    masked off on the mesh's outer edge."""
+    n = lax.axis_size(other_axis)
+    idx = lax.axis_index(other_axis)
+    right = [(i, (i + 1) % n) for i in range(n)]
+    left = [(i, (i - 1) % n) for i in range(n)]
+    from_left_l = lax.ppermute(vec_lab[-1:], other_axis, right)
+    from_left_m = lax.ppermute(vec_msk[-1:], other_axis, right)
+    from_right_l = lax.ppermute(vec_lab[:1], other_axis, left)
+    from_right_m = lax.ppermute(vec_msk[:1], other_axis, left)
+    from_left_m = jnp.where(idx == 0, False, from_left_m)
+    from_right_m = jnp.where(idx == n - 1, False, from_right_m)
+    lab = jnp.concatenate([from_left_l, vec_lab, from_right_l])
+    msk = jnp.concatenate([from_left_m, vec_msk, from_right_m])
+    return lab, msk
+
+
+def _seam_join_2d_axis(labels, mask, axis_name, other_axis, connectivity):
+    """Min-join the top/bottom edge rows against ring neighbors along
+    ``axis_name``, with the exchanged rows corner-extended along
+    ``other_axis`` so diagonal adjacencies across four-shard corners are
+    seen.  Transpose the block to reuse this for column seams."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [(i, (i - 1) % n) for i in range(n)]
+
+    above_lab = lax.ppermute(labels[-1], axis_name, down)
+    above_msk = lax.ppermute(mask[-1], axis_name, down)
+    below_lab = lax.ppermute(labels[0], axis_name, up)
+    below_msk = lax.ppermute(mask[0], axis_name, up)
+    above_msk = jnp.where(idx == 0, False, above_msk)
+    below_msk = jnp.where(idx == n - 1, False, below_msk)
+
+    def row_min(row_lab, row_msk):
+        # 4-connectivity sees only the straight-across neighbor — no
+        # corner extension (and none of its ppermutes) needed
+        if connectivity == 4:
+            return jnp.where(row_msk, row_lab, _BIG)
+        # corner-extend, then take the (W,) windowed min of the extended
+        # (W+2,) row: position c sees ext[c], ext[c+1], ext[c+2] = the
+        # dx in {-1,0,+1} diagonal/straight neighbors across the seam
+        ext_lab, ext_msk = _edge_extend(row_lab, row_msk, other_axis)
+        w = row_lab.shape[0]
+        cand = jnp.full((w,), _BIG, dtype=row_lab.dtype)
+        for off in range(3):
+            seg_l = lax.dynamic_slice_in_dim(ext_lab, off, w)
+            seg_m = lax.dynamic_slice_in_dim(ext_msk, off, w)
+            cand = jnp.minimum(cand, jnp.where(seg_m, seg_l, _BIG))
+        return cand
+
+    top_cand = row_min(above_lab, above_msk)
+    bot_cand = row_min(below_lab, below_msk)
+    if labels.shape[0] == 1:
+        new_row = jnp.where(
+            mask[0],
+            jnp.minimum(labels[0], jnp.minimum(top_cand, bot_cand)),
+            labels[0],
+        )
+        changed = jnp.any(new_row != labels[0])
+        return labels.at[0].set(new_row), changed
+    new_top = jnp.where(mask[0], jnp.minimum(labels[0], top_cand), labels[0])
+    new_bot = jnp.where(
+        mask[-1], jnp.minimum(labels[-1], bot_cand), labels[-1]
+    )
+    changed = jnp.any(new_top != labels[0]) | jnp.any(new_bot != labels[-1])
+    labels = labels.at[0].set(new_top).at[-1].set(new_bot)
+    return labels, changed
+
+
+def distributed_connected_components_2d(
+    mask: jax.Array,
+    mesh: Mesh,
+    connectivity: int = 8,
+    max_roots_per_shard: int = 4096,
+    row_axis: str = "rows",
+    col_axis: str = "cols",
+) -> tuple[jax.Array, jax.Array]:
+    """Label a mask sharded over BOTH spatial axes; scipy-scan-order ids.
+
+    The 2-D twin of :func:`distributed_connected_components` for meshes
+    laid out ``rows x cols`` (a v5e-8 as 4x2, a pod slice as 16x16…):
+    each shard holds an ``(H/nr, W/nc)`` tile, seam joins run along both
+    mesh axes with corner-extended edge rows (a component touching four
+    shards only diagonally still merges), and the final scan-order
+    ranking all-gathers sorted root tables over both axes.  Returns
+    ``(labels, count)`` with ``labels`` sharded like the input.
+    """
+    mask = jnp.asarray(mask, bool)
+    h, w = mask.shape
+    nr = mesh.shape[row_axis]
+    nc = mesh.shape[col_axis]
+    if h % nr != 0 or w % nc != 0:
+        raise ShardingError(
+            f"mask {h}x{w} not divisible by mesh {nr}x{nc}"
+        )
+    if connectivity not in (4, 8):
+        raise ValueError("connectivity must be 4 or 8")
+    rows, cols = h // nr, w // nc
+    k = max_roots_per_shard
+    axes = (row_axis, col_axis)
+
+    def body(block):
+        ridx = lax.axis_index(row_axis)
+        cidx = lax.axis_index(col_axis)
+        yy = (ridx * rows + jnp.arange(rows, dtype=jnp.int32))[:, None]
+        xx = (cidx * cols + jnp.arange(cols, dtype=jnp.int32))[None, :]
+        linear = yy * w + xx
+        labels = jnp.where(block, linear, _BIG)
+        labels = _local_fixpoint(labels, block, connectivity, axes)
+
+        def outer(state):
+            lab, _ = state
+            lab, ch_r = _seam_join_2d_axis(
+                lab, block, row_axis, col_axis, connectivity
+            )
+            lab_t, ch_c = _seam_join_2d_axis(
+                lab.T, block.T, col_axis, row_axis, connectivity
+            )
+            lab = lab_t.T
+            lab = _local_fixpoint(lab, block, connectivity, axes)
+            changed = ch_r.astype(jnp.int32) + ch_c.astype(jnp.int32)
+            return lab, lax.psum(changed, axes) > 0
+
+        labels, _ = lax.while_loop(
+            lambda s: s[1], outer, (labels, jnp.bool_(True))
+        )
+
+        is_root = block & (labels == linear)
+        n_local = jnp.sum(is_root.astype(jnp.int32))
+        roots = jnp.sort(jnp.where(is_root, linear, _BIG).reshape(-1))[:k]
+        all_roots = jnp.sort(lax.all_gather(roots, axes).reshape(-1))
+        rank = jnp.searchsorted(all_roots, labels.reshape(-1)).reshape(
+            labels.shape
+        )
+        out = jnp.where(block, rank + 1, 0).astype(jnp.int32)
+        count = lax.psum(n_local, axes)
+        overflow = lax.pmax(n_local, axes)
+        return out, count[None, None], overflow[None, None]
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(row_axis, col_axis),
+        out_specs=(
+            PartitionSpec(row_axis, col_axis),
+            PartitionSpec(row_axis, col_axis),
+            PartitionSpec(row_axis, col_axis),
+        ),
+    )
+    sharded = jax.device_put(
+        mask, NamedSharding(mesh, PartitionSpec(row_axis, col_axis))
+    )
+    labels, counts, overflow = jax.jit(mapped)(sharded)
+    max_local = int(np.max(np.asarray(overflow)))
+    if max_local > k:
+        raise ShardingError(
+            f"a shard holds {max_local} components > "
+            f"max_roots_per_shard={k}; raise the bound"
+        )
+    return labels, jnp.asarray(counts).reshape(-1)[0]
+
+
+def sharded_segment_mosaic_2d(
+    intensity: jax.Array,
+    mesh: Mesh,
+    sigma: float = 1.5,
+    threshold: float | None = None,
+    connectivity: int = 8,
+    row_axis: str = "rows",
+    col_axis: str = "cols",
+) -> tuple[jax.Array, jax.Array]:
+    """Smooth + threshold + label a mosaic sharded on both spatial axes:
+    the giant-image path for meshes with a 2-D spatial layout.  Halo-exact
+    smoothing (corners included), global Otsu, then
+    :func:`distributed_connected_components_2d`."""
+    from tmlibrary_tpu.ops.threshold import otsu_value
+    from tmlibrary_tpu.parallel.halo import sharded_gaussian_smooth_2d
+
+    img = jnp.asarray(intensity, jnp.float32)
+    smoothed = sharded_gaussian_smooth_2d(
+        img, mesh, sigma, row_axis=row_axis, col_axis=col_axis
+    )
+    t = otsu_value(smoothed) if threshold is None else jnp.float32(threshold)
+    return distributed_connected_components_2d(
+        smoothed > t,
+        mesh,
+        connectivity=connectivity,
+        row_axis=row_axis,
+        col_axis=col_axis,
+    )
 
 
 def sharded_segment_mosaic(
