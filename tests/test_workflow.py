@@ -954,6 +954,70 @@ def test_spatial_layout_mosaic_segmentation(tmp_path, devices):
     assert collected["objects_total"]["mosaic_cells"] == 5
 
 
+def test_spatial_layout_grid_mesh(tmp_path, devices):
+    """spatial_grid='auto' picks a 2-D rows x cols tile grid when it
+    keeps more devices busy (100-row mosaic on 8 devices: 1-D shrinks to
+    5, a 4x2 grid uses all 8) and stays bit-identical to the unsharded
+    chain; 'rows' forces the 1-D layout with identical results."""
+    import jax.numpy as jnp
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+    from tmlibrary_tpu.ops.threshold import otsu_value
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "spatialg", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI",), site_shape=(50, 50),
+    )
+    st = ExperimentStore.create(tmp_path / "spatialg_exp", exp)
+    rng = np.random.default_rng(17)
+    mosaic = rng.normal(300, 20, (100, 100))
+    yy, xx = np.mgrid[0:100, 0:100]
+    # one blob dead on the four-site junction plus ordinary ones
+    for cy, cx in [(50, 50), (18, 70), (82, 25)]:
+        mosaic += 4000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 4.0**2))
+    mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+    tiles = np.stack([mosaic[0:50, 0:50], mosaic[0:50, 50:100],
+                      mosaic[50:100, 0:50], mosaic[50:100, 50:100]])
+    st.write_sites(tiles, [0, 1, 2, 3], channel=0)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8})
+    result = jt.run(0)
+    assert result["mesh_shape"] == [4, 2]  # auto chose the grid
+    assert result["objects"]["mosaic_cells"] == 3
+
+    labels = st.read_labels(None, "mosaic_cells")
+    restitched = np.zeros((100, 100), np.int32)
+    restitched[0:50, 0:50] = labels[0]
+    restitched[0:50, 50:100] = labels[1]
+    restitched[50:100, 0:50] = labels[2]
+    restitched[50:100, 50:100] = labels[3]
+    sm = np.asarray(gaussian_smooth(jnp.asarray(mosaic, jnp.float32), 1.5))
+    golden, n = ndi.label(
+        sm > float(np.asarray(otsu_value(jnp.asarray(sm)))),
+        structure=np.ones((3, 3)),
+    )
+    assert n == 3
+    np.testing.assert_array_equal(restitched, golden)
+    # junction blob: one global id across all four sites
+    ids = {int(labels[0][-1, -1]), int(labels[1][-1, 0]),
+           int(labels[2][0, -1]), int(labels[3][0, 0])}
+    assert len(ids) == 1 and ids != {0}
+
+    # forcing 1-D must give the same labels (and report a rows mesh)
+    st2 = ExperimentStore.create(tmp_path / "spatialg_rows", exp)
+    st2.write_sites(tiles, [0, 1, 2, 3], channel=0)
+    jt2 = get_step("jterator")(st2)
+    jt2.init({"layout": "spatial", "n_devices": 8, "spatial_grid": "rows"})
+    r2 = jt2.run(0)
+    assert r2["mesh_shape"] == [5, 1]
+    lab2 = st2.read_labels(None, "mosaic_cells")
+    np.testing.assert_array_equal(np.stack(labels), np.stack(lab2))
+
+
 def test_spatial_layout_divisor_fallback_and_polygons(tmp_path, devices):
     """Mosaic rows not divisible by the requested mesh must shrink the
     mesh (not pad, which would corrupt the Otsu cut), stay bit-identical

@@ -65,57 +65,13 @@ def _local_fixpoint(labels, mask, connectivity, axis_name=None):
 
 
 def _seam_join(labels, mask, axis_name, connectivity):
-    """Min-join edge rows against ring neighbors; returns (labels, changed)."""
-    n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    down = [(i, (i + 1) % n) for i in range(n)]
-    up = [(i, (i - 1) % n) for i in range(n)]
+    """Min-join edge rows against ring neighbors; returns (labels, changed).
 
-    # neighbor-above's bottom row lands at my top; neighbor-below's top
-    # row lands at my bottom
-    above_lab = lax.ppermute(labels[-1], axis_name, down)
-    above_msk = lax.ppermute(mask[-1], axis_name, down)
-    below_lab = lax.ppermute(labels[0], axis_name, up)
-    below_msk = lax.ppermute(mask[0], axis_name, up)
-    # ring wrap is not adjacency: first/last shards ignore the wrapped row
-    above_msk = jnp.where(idx == 0, False, above_msk)
-    below_msk = jnp.where(idx == n - 1, False, below_msk)
-
-    dxs = (0,) if connectivity == 4 else (-1, 0, 1)
-
-    def row_min(row_lab, row_msk):
-        cand = jnp.full_like(row_lab, _BIG)
-        w = row_lab.shape[0]
-        for dx in dxs:
-            shifted = jnp.roll(row_lab, dx)
-            shifted_m = jnp.roll(row_msk, dx)
-            col = jnp.arange(w)
-            valid = shifted_m & ((col - dx >= 0) & (col - dx < w))
-            cand = jnp.minimum(cand, jnp.where(valid, shifted, _BIG))
-        return cand
-
-    top_cand = row_min(above_lab, above_msk)
-    bot_cand = row_min(below_lab, below_msk)
-    if labels.shape[0] == 1:
-        # single-row shards: row 0 IS row -1 — join both neighbors into the
-        # one row at once (two sequential .at[] writes would discard the
-        # first join and the loop would never converge)
-        new_row = jnp.where(
-            mask[0],
-            jnp.minimum(labels[0], jnp.minimum(top_cand, bot_cand)),
-            labels[0],
-        )
-        changed = jnp.any(new_row != labels[0])
-        return labels.at[0].set(new_row), changed
-    new_top = jnp.where(
-        mask[0], jnp.minimum(labels[0], top_cand), labels[0]
-    )
-    new_bot = jnp.where(
-        mask[-1], jnp.minimum(labels[-1], bot_cand), labels[-1]
-    )
-    changed = jnp.any(new_top != labels[0]) | jnp.any(new_bot != labels[-1])
-    labels = labels.at[0].set(new_top).at[-1].set(new_bot)
-    return labels, changed
+    The 1-D layout is the 2-D seam join with no orthogonal mesh axis:
+    ``other_axis=None`` pads the exchanged rows with masked sentinels
+    instead of corner pixels, which degenerates to exactly the in-block
+    diagonal window the 1-D path always used."""
+    return _seam_join_2d_axis(labels, mask, axis_name, None, connectivity)
 
 
 def distributed_connected_components(
@@ -201,7 +157,15 @@ def _edge_extend(vec_lab, vec_msk, other_axis):
     neighbor along ``other_axis`` — the missing operand for diagonal
     (8-connectivity) adjacencies that cross a seam corner where four
     shards meet.  Returns ``(W + 2,)`` arrays; the added pixels are
-    masked off on the mesh's outer edge."""
+    masked off on the mesh's outer edge.  ``other_axis=None`` (1-D
+    layout: no orthogonal neighbors exist) pads with masked sentinels."""
+    if other_axis is None:
+        pad_l = jnp.full((1,), _BIG, vec_lab.dtype)
+        pad_m = jnp.zeros((1,), bool)
+        return (
+            jnp.concatenate([pad_l, vec_lab, pad_l]),
+            jnp.concatenate([pad_m, vec_msk, pad_m]),
+        )
     n = lax.axis_size(other_axis)
     idx = lax.axis_index(other_axis)
     right = [(i, (i + 1) % n) for i in range(n)]

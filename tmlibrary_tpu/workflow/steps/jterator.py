@@ -53,6 +53,21 @@ def _mosaic_intensity_stats(labels, vals_mosaic, count):
 _CORRECT_JIT = None
 
 
+def _best_spatial_grid(requested: int, hm: int, wm: int) -> tuple[int, int]:
+    """Largest ``nr * nc <= requested`` with ``nr`` dividing the mosaic
+    rows and ``nc`` the columns; equal products prefer more rows (the
+    1-D-like shape, fewer seam axes)."""
+    best = (1, 1)
+    for nr in range(requested, 0, -1):
+        if hm % nr:
+            continue
+        cap = requested // nr
+        nc = next(k for k in range(cap, 0, -1) if wm % k == 0)
+        if nr * nc > best[0] * best[1]:
+            best = (nr, nc)
+    return best
+
+
 def _correct_batch(imgs, mean_log, std_log) -> "np.ndarray":
     """Batched illumination correction, jitted ONCE (per shape) — a
     per-well closure would recompile the same elementwise program for
@@ -109,6 +124,12 @@ class ImageAnalysisRunner(Step):
                       "(default: first experiment channel)"),
         Argument("spatial_sigma", float, default=1.5,
                  help="gaussian sigma for spatial-layout smoothing"),
+        Argument("spatial_grid", str, default="auto",
+                 choices=("auto", "rows", "grid"),
+                 help="spatial-layout mesh shape: 'rows' shards the mosaic "
+                      "row axis 1-D; 'grid' tiles it rows x cols (2-D halo "
+                      "exchange, corner-exact seams); 'auto' picks whichever "
+                      "uses more devices — results are identical either way"),
         Argument("spatial_objects", str, default="mosaic_cells",
                  help="objects name for spatial-layout segmentation output"),
         Argument("spatial_zernike_degree", int, default=9,
@@ -255,21 +276,50 @@ class ImageAnalysisRunner(Step):
 
         requested = args["n_devices"] or len(jax.devices())
         requested = min(requested, len(jax.devices()))
-        hm = mosaic.shape[0]
-        # the mesh must divide the mosaic rows EXACTLY — padding rows would
-        # corrupt the global Otsu histogram and bottom-edge smoothing,
-        # breaking bit-identity with the unsharded chain; shrink to the
-        # largest divisor instead
-        n_dev = next(k for k in range(requested, 0, -1) if hm % k == 0)
-        if n_dev < requested:
-            logger.info(
-                "spatial layout: using %d of %d devices — mosaic rows %d "
-                "must divide the mesh evenly", n_dev, requested, hm,
-            )
-        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("rows",))
-        labels, count = sharded_segment_mosaic(
-            jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"]
+        hm, wm = mosaic.shape
+        # the mesh must divide the mosaic EXACTLY — padding would corrupt
+        # the global Otsu histogram and edge smoothing, breaking
+        # bit-identity with the unsharded chain; shrink to divisors
+        # instead.  Candidates: 1-D row shards vs a 2-D rows x cols tile
+        # grid — a 2-D factorization often keeps MORE devices busy (e.g.
+        # 100 rows on 8 devices: rows-only shrinks to 5, a 4x2 grid uses
+        # all 8), and the outputs are layout-invariant either way.
+        n_rows1d = next(k for k in range(requested, 0, -1) if hm % k == 0)
+        nr2, nc2 = _best_spatial_grid(requested, hm, wm)
+        kind = args.get("spatial_grid", "auto")
+        use_grid = kind == "grid" or (
+            kind == "auto" and nr2 * nc2 > n_rows1d
         )
+        if use_grid:
+            from tmlibrary_tpu.parallel.label import sharded_segment_mosaic_2d
+
+            n_dev = nr2 * nc2
+            if n_dev < requested:
+                logger.info(
+                    "spatial layout: %dx%d grid uses %d of %d devices — "
+                    "mosaic %dx%d must divide the mesh evenly",
+                    nr2, nc2, n_dev, requested, hm, wm,
+                )
+            mesh = Mesh(
+                np.asarray(jax.devices()[:n_dev]).reshape(nr2, nc2),
+                ("rows", "cols"),
+            )
+            mesh_shape = [nr2, nc2]
+            labels, count = sharded_segment_mosaic_2d(
+                jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"]
+            )
+        else:
+            n_dev = n_rows1d
+            if n_dev < requested:
+                logger.info(
+                    "spatial layout: using %d of %d devices — mosaic rows "
+                    "%d must divide the mesh evenly", n_dev, requested, hm,
+                )
+            mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("rows",))
+            mesh_shape = [n_dev, 1]
+            labels, count = sharded_segment_mosaic(
+                jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"]
+            )
         labels = np.asarray(labels)
         count = int(count)
 
@@ -406,6 +456,7 @@ class ImageAnalysisRunner(Step):
             "objects": {name: count},
             "mosaic_shape": [int(labels.shape[0]), int(labels.shape[1])],
             "layout": "spatial",
+            "mesh_shape": mesh_shape,
         }
 
     def run_batches_pipelined(self, batches):
