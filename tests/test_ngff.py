@@ -1,0 +1,243 @@
+"""First-party OME-NGFF (OME-Zarr v0.4) plate export + import.
+
+Covers the from-scratch Zarr v2 array primitives (chunking, padded edge
+chunks, zlib/raw compression, fill-value holes), the HCS plate writer,
+the container-protocol reader, and the full round trip: export a store
+with ``write_ngff_plate`` -> re-ingest the plate through the ``ngff``
+metaconfig handler + imextract -> bit-identical pixels.
+"""
+import json
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.models.experiment import Experiment, grid_experiment
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.ngff import (
+    NGFFReader,
+    write_ngff_plate,
+    zarr_read_array,
+    zarr_read_plane,
+    zarr_write_array,
+)
+
+
+# ---------------------------------------------------------- zarr primitives
+@pytest.mark.parametrize("compressor", ["zlib", None])
+@pytest.mark.parametrize(
+    "shape,chunks",
+    [
+        ((5, 7), (2, 3)),          # padded edge chunks both axes
+        ((8, 8), (8, 8)),          # single chunk
+        ((1, 2, 3, 10, 11), (1, 1, 1, 4, 4)),  # 5-D tczyx
+    ],
+)
+def test_zarr_array_round_trip(tmp_path, compressor, shape, chunks):
+    rng = np.random.default_rng(1)
+    arr = rng.integers(0, 65535, shape, dtype=np.uint16)
+    zarr_write_array(tmp_path / "a", arr, chunks, compressor)
+    out = zarr_read_array(tmp_path / "a")
+    np.testing.assert_array_equal(out, arr)
+    meta = json.loads((tmp_path / "a" / ".zarray").read_text())
+    assert meta["zarr_format"] == 2
+    assert meta["dtype"] == "<u2"
+    assert meta["order"] == "C"
+    assert meta["fill_value"] == 0
+
+
+def test_zarr_float_dtype_and_missing_chunk(tmp_path):
+    arr = np.linspace(0, 1, 24, dtype=np.float32).reshape(4, 6)
+    zarr_write_array(tmp_path / "f", arr, (2, 2), None)
+    np.testing.assert_array_equal(zarr_read_array(tmp_path / "f"), arr)
+    # a missing chunk file reads as fill value, per spec
+    (tmp_path / "f" / "0.0").unlink()
+    out = zarr_read_array(tmp_path / "f")
+    assert (out[:2, :2] == 0).all()
+    np.testing.assert_array_equal(out[2:, :], arr[2:, :])
+
+
+def test_zarr_read_plane_touches_only_needed_chunks(tmp_path):
+    rng = np.random.default_rng(2)
+    arr = rng.integers(0, 1000, (2, 3, 2, 30, 20), dtype=np.uint16)
+    zarr_write_array(tmp_path / "p", arr, (1, 1, 1, 16, 16))
+    plane = zarr_read_plane(tmp_path / "p", 1, 2, 0)
+    np.testing.assert_array_equal(plane, arr[1, 2, 0])
+    with pytest.raises(MetadataError):
+        zarr_read_plane(tmp_path / "p" / "missing", 0, 0, 0)
+
+
+def test_zarr_fortran_order_chunks_decode(tmp_path):
+    """A conforming third-party plate may write order='F' chunks; the
+    reader must reorder the buffer, not reinterpret it as C."""
+    arr = np.arange(24, dtype=np.uint16).reshape(4, 6)
+    zarr_write_array(tmp_path / "f", arr, (4, 6), None)
+    meta = json.loads((tmp_path / "f" / ".zarray").read_text())
+    meta["order"] = "F"
+    (tmp_path / "f" / ".zarray").write_text(json.dumps(meta))
+    (tmp_path / "f" / "0.0").write_bytes(
+        np.asfortranarray(arr).tobytes(order="F")
+    )
+    np.testing.assert_array_equal(zarr_read_array(tmp_path / "f"), arr)
+
+
+def test_zarr_unsupported_compressor_raises(tmp_path):
+    arr = np.zeros((2, 2), np.uint16)
+    zarr_write_array(tmp_path / "b", arr, (2, 2))
+    meta = json.loads((tmp_path / "b" / ".zarray").read_text())
+    meta["compressor"] = {"id": "blosc"}
+    (tmp_path / "b" / ".zarray").write_text(json.dumps(meta))
+    with pytest.raises(MetadataError):
+        zarr_read_array(tmp_path / "b")
+
+
+# ------------------------------------------------------------- plate writer
+@pytest.fixture
+def blob_store(tmp_path):
+    exp = grid_experiment(
+        "ngffexp", well_rows=1, well_cols=2, sites_per_well=(1, 2),
+        channel_names=("DAPI", "Actin"), site_shape=(48, 40),
+    )
+    st = ExperimentStore.create(tmp_path / "exp", exp)
+    rng = np.random.default_rng(5)
+    data = {}
+    for ch in range(2):
+        batch = rng.integers(0, 60000, (4, 48, 40), dtype=np.uint16)
+        st.write_sites(batch, [0, 1, 2, 3], channel=ch)
+        data[ch] = batch
+    return st, data
+
+
+def test_write_ngff_plate_layout_and_reader(blob_store, tmp_path):
+    st, data = blob_store
+    plate = write_ngff_plate(st, tmp_path / "plate.zarr", n_levels=2)
+
+    attrs = json.loads((plate / ".zattrs").read_text())["plate"]
+    assert attrs["version"] == "0.4"
+    assert [r["name"] for r in attrs["rows"]] == ["A"]
+    assert [c["name"] for c in attrs["columns"]] == ["1", "2"]
+    assert [w["path"] for w in attrs["wells"]] == ["A/1", "A/2"]
+    assert attrs["field_count"] == 2
+
+    # field image: multiscales metadata + level shapes
+    fattrs = json.loads((plate / "A" / "1" / "0" / ".zattrs").read_text())
+    ms = fattrs["multiscales"][0]
+    assert [a["name"] for a in ms["axes"]] == ["t", "c", "z", "y", "x"]
+    assert [d["path"] for d in ms["datasets"]] == ["0", "1"]
+    assert ms["datasets"][1]["coordinateTransformations"][0]["scale"][-1] == 2.0
+    assert [ch["label"] for ch in fattrs["omero"]["channels"]] == [
+        "DAPI", "Actin"
+    ]
+    lvl0 = zarr_read_array(plate / "A" / "1" / "0" / "0")
+    assert lvl0.shape == (1, 2, 1, 48, 40)
+    np.testing.assert_array_equal(lvl0[0, 0, 0], data[0][0])
+    np.testing.assert_array_equal(lvl0[0, 1, 0], data[1][0])
+    lvl1 = zarr_read_array(plate / "A" / "1" / "0" / "1")
+    assert lvl1.shape == (1, 2, 1, 24, 20)
+
+    # container-protocol reader: dims + the shared linear page decode
+    with NGFFReader(plate) as r:
+        assert (r.n_wells, r.n_fields) == (2, 2)
+        assert (r.n_tpoints, r.n_channels, r.n_zplanes) == (1, 2, 1)
+        assert (r.height, r.width) == (48, 40)
+        assert r.channel_names == ["DAPI", "Actin"]
+        # page = (((well*F + field)*T + t)*C + c)*Z + z
+        np.testing.assert_array_equal(r.read_plane_linear(0), data[0][0])
+        np.testing.assert_array_equal(r.read_plane_linear(1), data[1][0])
+        np.testing.assert_array_equal(r.read_plane_linear(2), data[0][1])
+        # well A/2, field 1, channel 1 -> site index 3
+        np.testing.assert_array_equal(
+            r.read_plane_linear(((1 * 2 + 1) * 1 + 0) * 2 + 1), data[1][3]
+        )
+
+
+def test_ngff_reader_rejects_non_plate(tmp_path):
+    d = tmp_path / "x.zarr"
+    d.mkdir()
+    with pytest.raises(MetadataError):
+        NGFFReader(d).__enter__()
+    (d / ".zattrs").write_text(json.dumps({"multiscales": []}))
+    with pytest.raises(MetadataError):
+        NGFFReader(d).__enter__()
+    # wells entries missing 'path' must raise MetadataError (the sidecar
+    # skip contract), not a bare KeyError that aborts the whole scan
+    (d / ".zattrs").write_text(json.dumps({"plate": {"wells": [{}]}}))
+    with pytest.raises(MetadataError):
+        NGFFReader(d).__enter__()
+
+
+def test_ngff_one_based_field_paths(blob_store, tmp_path):
+    """Spec-legal plates may name field images '1', '2' (non-0-based):
+    the page decode must follow the well metadata's paths."""
+    st, data = blob_store
+    plate = write_ngff_plate(st, tmp_path / "p.zarr", n_levels=1)
+    for well in ("1", "2"):
+        wdir = plate / "A" / well
+        (wdir / "0").rename(wdir / "9")
+        (wdir / "1").rename(wdir / "0")
+        (wdir / "9").rename(wdir / "1")  # swap: field0 <-> field1
+        (wdir / ".zattrs").write_text(json.dumps({
+            "well": {"images": [{"path": "1"}, {"path": "0"}],
+                     "version": "0.4"}
+        }))
+    with NGFFReader(plate) as r:
+        # page 0 = well A/1, field 0 -> now at directory "1"
+        np.testing.assert_array_equal(r.read_plane_linear(0), data[0][0])
+        np.testing.assert_array_equal(r.read_plane_linear(2), data[0][1])
+
+
+def test_ngff_ingest_round_trip(blob_store, tmp_path):
+    """export --ngff equivalent -> metaconfig auto-detect -> imextract ->
+    bit-identical pixels, channel names and well layout preserved."""
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    st, data = blob_store
+    src = tmp_path / "source"
+    src.mkdir()
+    write_ngff_plate(st, src / "screen.zarr", n_levels=1)
+
+    root = tmp_path / "exp2"
+    store2 = ExperimentStore.create(
+        root,
+        Experiment(name="ngff2", plates=[], channels=[],
+                   site_height=1, site_width=1),
+    )
+    meta = get_step("metaconfig")(store2)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 2 * 2  # wells x fields x channels
+
+    exp2 = ExperimentStore.open(root).experiment
+    assert exp2.n_sites == 4
+    assert {c.name for c in exp2.channels} == {"DAPI", "Actin"}
+    rows_cols = {(w.row, w.column) for p in exp2.plates for w in p.wells}
+    assert rows_cols == {(0, 0), (0, 1)}
+    assert exp2.plates[0].name == "screen"
+
+    ime = get_step("imextract")(store2)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store2 = ExperimentStore.open(root)
+    # canonical site order: well A/1 fields then A/2 fields
+    ch_index = {c.name: i for i, c in enumerate(exp2.channels)}
+    for name, orig_ch in (("DAPI", 0), ("Actin", 1)):
+        pixels = store2.read_sites(None, channel=ch_index[name])
+        np.testing.assert_array_equal(pixels, data[orig_ch])
+
+
+def test_ngff_handler_skips_broken_plate(tmp_path):
+    from tmlibrary_tpu.workflow.steps.vendors import ngff_sidecar
+
+    src = tmp_path / "source"
+    bad = src / "broken.zarr"
+    bad.mkdir(parents=True)
+    (bad / ".zattrs").write_text("{not json")
+    out = ngff_sidecar(src)
+    assert out is not None
+    entries, skipped = out
+    assert entries == [] and skipped == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert ngff_sidecar(empty) is None  # no plates at all

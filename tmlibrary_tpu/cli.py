@@ -97,6 +97,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="--images only: write OME-TIFFs (OME-XML in ImageDescription, "
              "the Bio-Formats convention) instead of bare TIFFs",
     )
+    p_export.add_argument(
+        "--ngff", action="store_true",
+        help="write the WHOLE experiment as an OME-NGFF (OME-Zarr v0.4) "
+             "HCS plate into --out (a directory, conventionally *.zarr): "
+             "every channel/tpoint/zplane as multiscale tczyx fields; the "
+             "exported plate re-ingests via the ngff metaconfig handler",
+    )
+    p_export.add_argument(
+        "--ngff-levels", type=int, default=3, metavar="N",
+        help="--ngff only: number of 2x multiscale levels (default 3)",
+    )
     p_export.add_argument("--out", required=True, help="output file path")
     p_export.add_argument(
         "--format", choices=("csv", "parquet", "geojson"), default=None,
@@ -580,11 +591,19 @@ def cmd_export(args) -> int:
     out.parent.mkdir(parents=True, exist_ok=True)
     modes = [m for m, v in (("--objects", args.objects),
                             ("--illumstats", args.illumstats),
-                            ("--images", args.images)) if v is not None]
+                            ("--images", args.images),
+                            ("--ngff", args.ngff or None)) if v is not None]
     if len(modes) > 1:
         print(f"error: {' and '.join(modes)} are mutually exclusive",
               file=sys.stderr)
         return 1
+    if args.ngff:
+        from tmlibrary_tpu.ngff import write_ngff_plate
+
+        write_ngff_plate(store, out, n_levels=args.ngff_levels)
+        print(f"wrote OME-NGFF 0.4 HCS plate "
+              f"({len(store.experiment.channels)} channels) to {out}")
+        return 0
     if args.images is not None:
         return _export_images(store, args, out)
     if args.illumstats is not None:

@@ -46,6 +46,10 @@ def _container_reader(path):
         return CZIReader
     if name.endswith(".lif"):
         return LIFReader
+    if name.endswith(".zarr"):  # OME-NGFF plate directory (covers .ome.zarr)
+        from tmlibrary_tpu.ngff import NGFFReader
+
+        return NGFFReader
     return None
 
 
@@ -59,6 +63,7 @@ def _container_plane(reader, page: int) -> np.ndarray:
         return reader.read_plane(seq, comp)
     if isinstance(reader, LIFReader):
         return reader.read_plane_global(page)
+    # CZI and NGFF both expose the shared linear-page decode
     return reader.read_plane_linear(page)
 
 

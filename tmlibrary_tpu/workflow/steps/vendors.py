@@ -1161,3 +1161,62 @@ def lif_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
         source_dir, ".lif", LIFReader, "LIF",
         lambda r: (r.n_series, *r.uniform_dims()), entries_of,
     )
+
+
+# ---------------------------------------------------------------------- ngff
+@register_sidecar_handler("ngff")
+def ngff_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
+    """OME-NGFF (OME-Zarr v0.4) HCS plates, read by the first-party Zarr
+    v2 parser (:class:`tmlibrary_tpu.ngff.NGFFReader`).
+
+    Unlike the nd2/czi/lif handlers, wells come from the plate's own HCS
+    metadata (``rowIndex``/``columnIndex``), not filename tokens; fields
+    map to sites, omero channel labels (sanitized) name the channels, and
+    the plate takes the ``*.zarr`` directory's stem.  ``page`` encodes
+    ``(((well * F + field) * T + t) * C + c) * Z + z`` — the convention
+    :meth:`~tmlibrary_tpu.ngff.NGFFReader.read_plane_linear` decodes for
+    imextract."""
+    from tmlibrary_tpu.ngff import NGFFReader
+
+    plates = sorted(
+        p for p in source_dir.rglob("*.zarr")
+        if p.is_dir() and (p / ".zattrs").exists()
+    )
+    if not plates:
+        return None
+    entries: list[dict] = []
+    skipped = 0
+    for path in plates:
+        try:
+            with NGFFReader(path) as r:
+                wells = list(r.well_indices)
+                nf, nt = r.n_fields, r.n_tpoints
+                nc, nz = r.n_channels, r.n_zplanes
+                labels = r.channel_names
+        except MetadataError as exc:
+            logger.warning("skipping unreadable NGFF plate %s: %s",
+                           path, exc)
+            skipped += 1
+            continue
+        plate_name = (re.sub(r"[^A-Za-z0-9]", "", path.stem) or "plate00")
+        names = [
+            (re.sub(r"[^A-Za-z0-9\-]", "-", labels[c])
+             if labels and c < len(labels) and labels[c]
+             else f"C{c:02d}")
+            for c in range(nc)
+        ]
+        for wi, well in enumerate(wells):
+            for f in range(nf):
+                for t in range(nt):
+                    for c in range(nc):
+                        for z in range(nz):
+                            e = _container_entry(
+                                path, well, site=f, channel=c,
+                                zplane=z, tpoint=t,
+                                page=(((wi * nf + f) * nt + t) * nc + c)
+                                * nz + z,
+                            )
+                            e["plate"] = plate_name
+                            e["channel"] = names[c]
+                            entries.append(e)
+    return entries, skipped
