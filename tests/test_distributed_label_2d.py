@@ -130,6 +130,59 @@ def test_sharded_halo_map_2d_dims_must_divide(mesh42):
         sharded_halo_map_2d(lambda x: x, np.zeros((64, 45)), mesh42, 1)
 
 
+def test_distributed_watershed_2d_bit_identical(mesh42, mesh24, rng):
+    """2-D-sharded watershed == single-device watershed on the gathered
+    mosaic, tie-breaks included (zero-filled 1-pixel halos per adopt
+    step, corners carried by the two-step exchange)."""
+    from tmlibrary_tpu.ops.label import connected_components
+    from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+    from tmlibrary_tpu.parallel.label import (
+        distributed_watershed_from_seeds,
+        distributed_watershed_from_seeds_2d,
+    )
+
+    yy, xx = np.mgrid[0:64, 0:48]
+    img = rng.normal(100, 10, (64, 48)).astype(np.float32)
+    # one basin dead on the center four-shard corner (tiles are 16x24)
+    for cy, cx in ((8, 10), (32, 24), (52, 12), (36, 40)):
+        img += 2000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 30.0)
+    seeds_mask = img > 1500
+    seeds = np.asarray(connected_components(jnp.asarray(seeds_mask))[0])
+    mask = img > 300
+
+    golden = np.asarray(
+        watershed_from_seeds(jnp.asarray(img), jnp.asarray(seeds),
+                             jnp.asarray(mask), n_levels=8, method="xla")
+    )
+    for mesh in (mesh42, mesh24):
+        sharded = np.asarray(
+            distributed_watershed_from_seeds_2d(
+                img, seeds, mask, mesh, n_levels=8
+            )
+        )
+        assert np.array_equal(sharded, golden)
+    assert golden.max() > 0
+    # and the 1-D path agrees on the same inputs
+    mesh1d = Mesh(np.asarray(mesh42.devices).reshape(-1), ("rows",))
+    one_d = np.asarray(
+        distributed_watershed_from_seeds(img, seeds, mask, mesh1d, n_levels=8)
+    )
+    assert np.array_equal(one_d, golden)
+
+
+def test_distributed_watershed_2d_dims_must_divide(mesh42):
+    from tmlibrary_tpu.parallel.label import (
+        distributed_watershed_from_seeds_2d,
+    )
+
+    bad = np.zeros((63, 48), np.float32)
+    with pytest.raises(ShardingError):
+        distributed_watershed_from_seeds_2d(
+            bad, np.zeros((63, 48), np.int32), np.zeros((63, 48), bool),
+            mesh42,
+        )
+
+
 def test_sharded_segment_mosaic_2d_end_to_end(mesh42, mesh24, rng):
     """Blob mosaic: smooth + global otsu + 2-D CC matches the 1-D sharded
     path (itself scipy-golden-tested) exactly."""

@@ -151,6 +151,82 @@ def test_write_ngff_plate_layout_and_reader(blob_store, tmp_path):
         )
 
 
+def test_ngff_label_image_export(blob_store, tmp_path):
+    """Segmentation stacks ride along as NGFF image-label multiscales:
+    int32, nearest-subsampled display levels (never mean-pooled), listed
+    in the labels/ group, and pointing back at their source image."""
+    st, _ = blob_store
+    rng = np.random.default_rng(31)
+    labels = np.zeros((4, 48, 40), np.int32)
+    labels[:, 5:20, 5:20] = rng.integers(1, 5, (4, 15, 15))
+    st.write_labels(labels, [0, 1, 2, 3], "nuclei")
+    plate = write_ngff_plate(
+        st, tmp_path / "lp.zarr", n_levels=2, label_names=["nuclei"]
+    )
+    ldir = plate / "A" / "1" / "0" / "labels"
+    assert json.loads((ldir / ".zattrs").read_text())["labels"] == ["nuclei"]
+    lattrs = json.loads((ldir / "nuclei" / ".zattrs").read_text())
+    assert lattrs["image-label"]["source"]["image"] == "../../"
+    lvl0 = zarr_read_array(ldir / "nuclei" / "0")
+    assert lvl0.shape == (1, 1, 1, 48, 40) and lvl0.dtype == np.int32
+    np.testing.assert_array_equal(lvl0[0, 0, 0], labels[0])
+    lvl1 = zarr_read_array(ldir / "nuclei" / "1")
+    # nearest subsampling: every value is a real label id from level 0
+    np.testing.assert_array_equal(lvl1[0, 0, 0], labels[0][::2, ::2])
+
+
+def test_ngff_label_levels_align_with_image_levels(tmp_path):
+    """Odd field dimensions: label pyramid levels must have EXACTLY the
+    image levels' shapes (crop-then-subsample), or viewers pairing
+    multiscale levels by index render shifted overlays."""
+    exp = grid_experiment(
+        "odd", well_rows=1, well_cols=1, sites_per_well=(1, 1),
+        channel_names=("DAPI",), site_shape=(65, 49),
+    )
+    st = ExperimentStore.create(tmp_path / "odd_exp", exp)
+    rng = np.random.default_rng(7)
+    st.write_sites(
+        rng.integers(0, 60000, (1, 65, 49), dtype=np.uint16), [0], channel=0
+    )
+    st.write_labels(
+        rng.integers(0, 3, (1, 65, 49)).astype(np.int32), [0], "cells"
+    )
+    plate = write_ngff_plate(
+        st, tmp_path / "odd.zarr", n_levels=3, label_names=["cells"]
+    )
+    field = plate / "A" / "1" / "0"
+    for lvl in ("0", "1", "2"):
+        img_shape = json.loads(
+            (field / lvl / ".zarray").read_text()
+        )["shape"]
+        lab_shape = json.loads(
+            (field / "labels" / "cells" / lvl / ".zarray").read_text()
+        )["shape"]
+        assert img_shape[3:] == lab_shape[3:], (lvl, img_shape, lab_shape)
+
+
+def test_ngff_labels_fail_fast_and_listing_reset(blob_store, tmp_path):
+    st, _ = blob_store
+    # typo'd label name: no plate I/O at all
+    with pytest.raises(MetadataError):
+        write_ngff_plate(st, tmp_path / "t.zarr", label_names=["nuceli"])
+    assert not (tmp_path / "t.zarr").exists()
+    # a re-export into the same directory with fewer labels must not
+    # advertise the previous run's names
+    labels = np.zeros((4, 48, 40), np.int32)
+    labels[:, :4, :4] = 1
+    st.write_labels(labels, [0, 1, 2, 3], "nuclei")
+    st.write_labels(labels, [0, 1, 2, 3], "cells")
+    plate = write_ngff_plate(st, tmp_path / "r.zarr", n_levels=1,
+                             label_names=["nuclei", "cells"])
+    plate = write_ngff_plate(st, tmp_path / "r.zarr", n_levels=1,
+                             label_names=["nuclei"])
+    listing = json.loads(
+        (plate / "A" / "1" / "0" / "labels" / ".zattrs").read_text()
+    )
+    assert listing["labels"] == ["nuclei"]
+
+
 def test_ngff_reader_rejects_non_plate(tmp_path):
     d = tmp_path / "x.zarr"
     d.mkdir()

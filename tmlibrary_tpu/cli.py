@@ -108,6 +108,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--ngff-levels", type=int, default=3, metavar="N",
         help="--ngff only: number of 2x multiscale levels (default 3)",
     )
+    p_export.add_argument(
+        "--ngff-labels", default=None, metavar="NAME[,NAME...]",
+        help="--ngff only: also export these segmentation stacks as NGFF "
+             "image-label multiscales under each field's labels/ group",
+    )
     p_export.add_argument("--out", required=True, help="output file path")
     p_export.add_argument(
         "--format", choices=("csv", "parquet", "geojson"), default=None,
@@ -600,9 +605,15 @@ def cmd_export(args) -> int:
     if args.ngff:
         from tmlibrary_tpu.ngff import write_ngff_plate
 
-        write_ngff_plate(store, out, n_levels=args.ngff_levels)
+        label_names = (
+            [n.strip() for n in args.ngff_labels.split(",") if n.strip()]
+            if args.ngff_labels else None
+        )
+        write_ngff_plate(store, out, n_levels=args.ngff_levels,
+                         label_names=label_names)
+        extra = (f" + labels {','.join(label_names)}" if label_names else "")
         print(f"wrote OME-NGFF 0.4 HCS plate "
-              f"({len(store.experiment.channels)} channels) to {out}")
+              f"({len(store.experiment.channels)} channels{extra}) to {out}")
         return 0
     if args.images is not None:
         return _export_images(store, args, out)

@@ -187,22 +187,87 @@ def _downsample_2x(plane: np.ndarray) -> np.ndarray:
     return pooled.astype(plane.dtype)
 
 
+def _write_label_image(
+    field_dir: Path,
+    name: str,
+    stack: np.ndarray,
+    n_levels: int,
+    chunk_yx: int,
+    compressor: str | None,
+) -> None:
+    """One NGFF 0.4 ``image-label`` under ``<field>/labels/<name>``:
+    a 5-D (t, 1, z, y, x) int32 multiscale whose display levels use
+    nearest subsampling (mean-pooling label ids would invent objects).
+    The ``labels/`` group listing is written by the caller — one listing
+    per export run, so names from a previous export into the same
+    directory are never advertised."""
+    img_dir = field_dir / "labels" / name
+    img_dir.mkdir(parents=True, exist_ok=True)
+    (img_dir / ".zgroup").write_text(json.dumps({"zarr_format": 2}))
+    datasets = []
+    level = stack
+    for lvl in range(n_levels):
+        if lvl:
+            # crop odd edges BEFORE subsampling — the exact level shapes
+            # of the image pyramid's _downsample_2x, so viewers that pair
+            # multiscale levels by index see aligned overlays
+            h, w = level.shape[3], level.shape[4]
+            level = level[:, :, :, : h - h % 2 : 2, : w - w % 2 : 2]
+            if level.shape[3] < 1 or level.shape[4] < 1:
+                break
+        zarr_write_array(
+            img_dir / str(lvl), level, (1, 1, 1, chunk_yx, chunk_yx),
+            compressor,
+        )
+        datasets.append({
+            "path": str(lvl),
+            "coordinateTransformations": [{
+                "type": "scale",
+                "scale": [1.0, 1.0, 1.0, float(2 ** lvl), float(2 ** lvl)],
+            }],
+        })
+    (img_dir / ".zattrs").write_text(json.dumps({
+        "multiscales": [{
+            "version": NGFF_VERSION,
+            "name": name,
+            "axes": _AXES,
+            "datasets": datasets,
+        }],
+        "image-label": {
+            "version": NGFF_VERSION,
+            "source": {"image": "../../"},
+        },
+    }, indent=2))
+
+
 def write_ngff_plate(
     store,
     out: Path,
     n_levels: int = 3,
     chunk_yx: int = 256,
     compressor: str | None = "zlib",
+    label_names: list[str] | None = None,
 ) -> Path:
     """Export the experiment store as one OME-NGFF 0.4 HCS plate.
 
     Every (well, site, tpoint, zplane, channel) plane is read from the
     store (raw, as ingested) and written as 5-D tczyx multiscale fields
     grouped ``<row>/<col>/<field>``; ``n_levels`` 2x display levels per
-    field.  Returns the plate root (``<out>``, conventionally
-    ``*.zarr``)."""
+    field.  ``label_names`` additionally exports those segmentation
+    stacks as NGFF ``image-label`` multiscales under each field's
+    ``labels/`` group (the standard road for masks, reference parity:
+    MapobjectSegmentation rows served to the viewer).  Returns the plate
+    root (``<out>``, conventionally ``*.zarr``)."""
     out = Path(out)
     exp = store.experiment
+    # fail fast on a mistyped label name BEFORE any plate I/O — aborting
+    # mid-export would leave a partial .zarr the user has to clean up
+    for lname in label_names or []:
+        if not store.has_labels(lname):
+            raise MetadataError(
+                f"no segmentation stack named {lname!r} in the store "
+                f"(run jterator first, or check --ngff-labels spelling)"
+            )
     refs = list(exp.sites())
     n_t, n_z = exp.n_tpoints, exp.n_zplanes
     n_c = len(exp.channels)
@@ -308,6 +373,33 @@ def write_ngff_plate(
                 }],
                 "omero": omero,
             }, indent=2))
+            if label_names:
+                labels_dir = field_dir / "labels"
+                labels_dir.mkdir(parents=True, exist_ok=True)
+                (labels_dir / ".zgroup").write_text(
+                    json.dumps({"zarr_format": 2})
+                )
+                # the listing is THIS run's names only — never merged
+                # with a previous export's leftovers in the same dir
+                (labels_dir / ".zattrs").write_text(
+                    json.dumps({"labels": list(label_names)}, indent=2)
+                )
+            for lname in label_names or []:
+                stack = np.stack([
+                    np.stack([
+                        np.stack([
+                            store.read_labels(
+                                [site_idx], lname, tpoint=t, zplane=z
+                            )[0]
+                            for z in range(n_z)
+                        ])
+                    ])  # single label "channel"
+                    for t in range(n_t)
+                ])
+                _write_label_image(
+                    field_dir, lname, stack, n_levels, chunk_yx,
+                    compressor,
+                )
     return out
 
 

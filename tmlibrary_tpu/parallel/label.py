@@ -384,6 +384,127 @@ def sharded_segment_mosaic(
 
 
 # ------------------------------------------------------------- watershed
+def _halo1_zero(x, axis_name):
+    """1-row halo exchange along one mesh axis with ZERO fill at the
+    mesh's outer edges (the global-border semantics of the single-device
+    ``_shift_with_fill(…, 0)``, unlike :func:`halo.halo_exchange`'s
+    symmetric reflection).  Returns ``(rows + 2, cols)``.  Shared by the
+    1-D and 2-D sharded adopt steps — one home for the border rule."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    down = [(i, (i + 1) % n) for i in range(n)]
+    up = [(i, (i - 1) % n) for i in range(n)]
+    above = lax.ppermute(x[-1:], axis_name, down)
+    below = lax.ppermute(x[:1], axis_name, up)
+    above = jnp.where(idx == 0, 0, above)
+    below = jnp.where(idx == n - 1, 0, below)
+    return jnp.concatenate([above, x, below], axis=0)
+
+
+def _halo1_zero_2d(x, row_axis, col_axis):
+    """Zero-filled 1-pixel halo on both axes: the vertical exchange runs
+    first, so the horizontal exchange of the extended block carries the
+    diagonal corner pixels.  Returns ``(rows + 2, cols + 2)``."""
+    ext = _halo1_zero(x, row_axis)
+    return _halo1_zero(ext.T, col_axis).T
+
+
+def _sharded_adopt_2d(labels, allowed, row_axis, col_axis, connectivity):
+    """One synchronous adopt step over a 2-D-sharded block, bit-matching
+    the single-device ``_adopt_step`` on the gathered image: labels get a
+    zero-filled 1-pixel halo on all four sides (corners included via the
+    two-step exchange); ``allowed`` needs no exchange — the halo ring is
+    cropped off, so only the interior's allowed mask matters."""
+    from tmlibrary_tpu.ops.segment_secondary import _adopt_step
+
+    ext = _halo1_zero_2d(labels, row_axis, col_axis)
+    allowed_ext = jnp.pad(allowed, 1, constant_values=False)
+    new_ext = _adopt_step(ext, allowed_ext, connectivity)
+    return new_ext[1:-1, 1:-1]
+
+
+def distributed_watershed_from_seeds_2d(
+    intensity: jax.Array,
+    seeds: jax.Array,
+    mask: jax.Array,
+    mesh: Mesh,
+    n_levels: int = 32,
+    connectivity: int = 8,
+    row_axis: str = "rows",
+    col_axis: str = "cols",
+) -> jax.Array:
+    """Level-ordered watershed flooding over a mosaic sharded on BOTH
+    spatial axes — the 2-D twin of
+    :func:`distributed_watershed_from_seeds`, bit-identical to the
+    single-device ``watershed_from_seeds`` on the gathered image (global
+    level thresholds via ``pmin``/``pmax`` over both mesh axes, 1-pixel
+    zero-filled halos each adopt step so every tie-break matches the
+    synchronous schedule)."""
+    intensity = jnp.asarray(intensity, jnp.float32)
+    seeds = jnp.asarray(seeds, jnp.int32)
+    mask = jnp.asarray(mask, bool)
+    h, w = intensity.shape
+    nr = mesh.shape[row_axis]
+    nc = mesh.shape[col_axis]
+    if h % nr != 0 or w % nc != 0:
+        raise ShardingError(
+            f"mosaic {h}x{w} not divisible by mesh {nr}x{nc}"
+        )
+    axes = (row_axis, col_axis)
+
+    def body(int_block, seed_block, mask_block):
+        mask_b = mask_block | (seed_block > 0)
+        lo = lax.pmin(
+            jnp.min(jnp.where(mask_b, int_block, jnp.inf)), axes
+        )
+        hi = lax.pmax(
+            jnp.max(jnp.where(mask_b, int_block, -jnp.inf)), axes
+        )
+        span = jnp.maximum(hi - lo, 1e-6)
+
+        def flood(labels, allowed):
+            def inner(state):
+                lab, _ = state
+                new = _sharded_adopt_2d(
+                    lab, allowed, row_axis, col_axis, connectivity
+                )
+                changed = lax.psum(
+                    jnp.any(new != lab).astype(jnp.int32), axes
+                )
+                return new, changed > 0
+
+            out, _ = lax.while_loop(
+                lambda s: s[1], inner, (labels, jnp.bool_(True))
+            )
+            return out
+
+        def level_body(i, labels):
+            level = hi - span * (i + 1) / n_levels
+            allowed = mask_b & (int_block >= level)
+            return flood(labels, allowed)
+
+        labels = lax.fori_loop(0, n_levels, level_body, seed_block)
+        labels = flood(labels, mask_b)
+        return jnp.where(mask_b, labels, 0)
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            PartitionSpec(row_axis, col_axis),
+            PartitionSpec(row_axis, col_axis),
+            PartitionSpec(row_axis, col_axis),
+        ),
+        out_specs=PartitionSpec(row_axis, col_axis),
+    )
+    spec = NamedSharding(mesh, PartitionSpec(row_axis, col_axis))
+    return jax.jit(mapped)(
+        jax.device_put(intensity, spec),
+        jax.device_put(seeds, spec),
+        jax.device_put(mask, spec),
+    )
+
+
 def _sharded_adopt(labels, allowed, axis_name, connectivity):
     """One synchronous adopt step with 1-row halos, bit-matching the
     single-device :func:`~tmlibrary_tpu.ops.segment_secondary._adopt_step`
@@ -391,15 +512,7 @@ def _sharded_adopt(labels, allowed, axis_name, connectivity):
     ring-wrapped rows)."""
     from tmlibrary_tpu.ops.segment_secondary import _adopt_step
 
-    n = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
-    down = [(i, (i + 1) % n) for i in range(n)]
-    up = [(i, (i - 1) % n) for i in range(n)]
-    above = lax.ppermute(labels[-1], axis_name, down)
-    below = lax.ppermute(labels[0], axis_name, up)
-    above = jnp.where(idx == 0, 0, above)
-    below = jnp.where(idx == n - 1, 0, below)
-    ext = jnp.concatenate([above[None], labels, below[None]], axis=0)
+    ext = _halo1_zero(labels, axis_name)
     false_row = jnp.zeros((1, allowed.shape[1]), bool)
     allowed_ext = jnp.concatenate([false_row, allowed, false_row], axis=0)
     new_ext = _adopt_step(ext, allowed_ext, connectivity)
