@@ -1018,6 +1018,74 @@ def test_spatial_layout_grid_mesh(tmp_path, devices):
     np.testing.assert_array_equal(np.stack(labels), np.stack(lab2))
 
 
+def test_spatial_layout_secondary_objects(tmp_path, devices):
+    """--spatial-secondary-channel: cells grow from mosaic nuclei through
+    the actin channel via distributed watershed, keep the nuclei's GLOBAL
+    ids, and match the single-device segment_secondary chain exactly."""
+    import jax.numpy as jnp
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+    from tmlibrary_tpu.ops.threshold import threshold_otsu, otsu_value
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "spatsec", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI", "Actin"), site_shape=(50, 50),
+    )
+    st = ExperimentStore.create(tmp_path / "spatsec_exp", exp)
+    rng = np.random.default_rng(19)
+    yy, xx = np.mgrid[0:100, 0:100]
+    dapi = rng.normal(300, 15, (100, 100))
+    actin = rng.normal(400, 15, (100, 100))
+    # nuclei (one dead on the 4-site junction) with larger actin halos
+    for cy, cx in [(50, 50), (20, 24), (80, 70)]:
+        dapi += 4000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 3.0**2))
+        actin += 3000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 7.0**2))
+    dapi = np.clip(dapi, 0, 65535).astype(np.uint16)
+    actin = np.clip(actin, 0, 65535).astype(np.uint16)
+    for ch, mosaic in ((0, dapi), (1, actin)):
+        tiles = np.stack([mosaic[0:50, 0:50], mosaic[0:50, 50:100],
+                          mosaic[50:100, 0:50], mosaic[50:100, 50:100]])
+        st.write_sites(tiles, [0, 1, 2, 3], channel=ch)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8,
+             "spatial_secondary_channel": "Actin"})
+    result = jt.run(0)
+    assert result["mesh_shape"] == [4, 2]  # the 2-D watershed branch
+    n = result["objects"]["mosaic_cells"]
+    assert n == 3
+    assert result["objects"]["mosaic_secondary"] == n
+
+    nuc = st.read_labels(None, "mosaic_cells")
+    cells = st.read_labels(None, "mosaic_secondary")
+    re_nuc = np.zeros((100, 100), np.int32)
+    re_cells = np.zeros((100, 100), np.int32)
+    for i, (sy, sx) in enumerate([(0, 0), (0, 1), (1, 0), (1, 1)]):
+        re_nuc[sy * 50:(sy + 1) * 50, sx * 50:(sx + 1) * 50] = nuc[i]
+        re_cells[sy * 50:(sy + 1) * 50, sx * 50:(sx + 1) * 50] = cells[i]
+
+    # single-device golden: same chain on the gathered mosaics
+    mask = np.asarray(threshold_otsu(jnp.asarray(actin, jnp.float32)))
+    golden = np.asarray(watershed_from_seeds(
+        jnp.asarray(actin, jnp.float32), jnp.asarray(re_nuc),
+        jnp.asarray(mask), n_levels=32, method="xla",
+    ))
+    np.testing.assert_array_equal(re_cells, golden)
+    # cells contain their nuclei and share ids
+    assert ((re_cells == re_nuc) | (re_nuc == 0)).all()
+    assert (np.bincount(re_cells.ravel())[1:] >=
+            np.bincount(re_nuc.ravel(), minlength=n + 1)[1:]).all()
+    # secondary features landed with the same label ids
+    feats = st.read_features("mosaic_secondary")
+    assert sorted(feats["label"]) == [1, 2, 3]
+    assert (feats["Morphology_area"].to_numpy() >=
+            st.read_features("mosaic_cells")["Morphology_area"].to_numpy()).all()
+
+
 def test_spatial_layout_divisor_fallback_and_polygons(tmp_path, devices):
     """Mosaic rows not divisible by the requested mesh must shrink the
     mesh (not pad, which would corrupt the Otsu cut), stay bit-identical

@@ -260,14 +260,20 @@ def write_ngff_plate(
     root (``<out>``, conventionally ``*.zarr``)."""
     out = Path(out)
     exp = store.experiment
-    # fail fast on a mistyped label name BEFORE any plate I/O — aborting
-    # mid-export would leave a partial .zarr the user has to clean up
+    # fail fast on a mistyped/partial label name BEFORE any plate I/O —
+    # aborting mid-export would leave a partial .zarr the user has to
+    # clean up.  Every (tpoint, zplane) the field loop will read must
+    # exist, not just t0/z0 (a jterator run on one tpoint of a
+    # multi-tpoint experiment is exactly the partial case)
     for lname in label_names or []:
-        if not store.has_labels(lname):
-            raise MetadataError(
-                f"no segmentation stack named {lname!r} in the store "
-                f"(run jterator first, or check --ngff-labels spelling)"
-            )
+        for t in range(exp.n_tpoints):
+            for z in range(exp.n_zplanes):
+                if not store.has_labels(lname, tpoint=t, zplane=z):
+                    raise MetadataError(
+                        f"no segmentation stack named {lname!r} for "
+                        f"tpoint {t} zplane {z} (run jterator first, or "
+                        f"check --ngff-labels spelling)"
+                    )
     refs = list(exp.sites())
     n_t, n_z = exp.n_tpoints, exp.n_zplanes
     n_c = len(exp.channels)

@@ -135,6 +135,19 @@ class ImageAnalysisRunner(Step):
         Argument("spatial_zernike_degree", int, default=9,
                  help="Zernike moment degree for spatial-layout features "
                       "(matches measure_zernike's default; 0 disables)"),
+        Argument("spatial_secondary_channel", str, default="",
+                 help="grow secondary objects (cells) from the primary "
+                      "mosaic objects through THIS channel via distributed "
+                      "watershed — ids stay the primary's global ids "
+                      "(empty: disabled)"),
+        Argument("spatial_secondary_objects", str, default="mosaic_secondary",
+                 help="objects name for the spatial secondary segmentation"),
+        Argument("spatial_secondary_factor", float, default=1.0,
+                 help="otsu correction factor for the secondary mask "
+                      "(segment_secondary's correction_factor)"),
+        Argument("spatial_secondary_levels", int, default=32,
+                 help="watershed flooding levels for the secondary mask "
+                      "(segment_secondary's n_levels)"),
         Argument("batch_size", int, default=32, help="sites per device batch"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
@@ -323,7 +336,82 @@ class ImageAnalysisRunner(Step):
         labels = np.asarray(labels)
         count = int(count)
 
+        # one stitch per channel per well, shared by the watershed input
+        # and BOTH families' intensity loops (stitching re-reads every
+        # site image and re-corrects at mosaic scale — not free)
+        stitched = {idx: mosaic}
+
+        def get_channel(i: int) -> np.ndarray:
+            if i not in stitched:
+                stitched[i] = self._stitched_channel(
+                    sites, srefs, i, args, n_sy, n_sx, h, w
+                )
+            return stitched[i]
+
         name = args["spatial_objects"]
+        self._persist_mosaic_objects(
+            name, labels, count, batch, args, sites, srefs, get_channel,
+            tpoint, zplane,
+        )
+        objects = {name: count}
+
+        # secondary objects over the whole mosaic: primary labels seed a
+        # distributed watershed through a second channel (the sites
+        # layout's segment_secondary chain — otsu mask, level flooding,
+        # seed ids preserved), so cells keep their nucleus' GLOBAL id
+        sec_ch = args.get("spatial_secondary_channel", "")
+        if sec_ch:
+            from tmlibrary_tpu.ops import threshold as threshold_ops
+            from tmlibrary_tpu.parallel.label import (
+                distributed_watershed_from_seeds,
+                distributed_watershed_from_seeds_2d,
+            )
+
+            sec_idx = exp.channel_index(sec_ch)
+            img = jnp.asarray(get_channel(sec_idx), jnp.float32)
+            mask = threshold_ops.threshold_otsu(
+                img,
+                correction_factor=args["spatial_secondary_factor"],
+            )
+            flood = (
+                distributed_watershed_from_seeds_2d if use_grid
+                else distributed_watershed_from_seeds
+            )
+            sec_labels = np.asarray(flood(
+                img, jnp.asarray(labels), mask, mesh,
+                n_levels=args["spatial_secondary_levels"],
+            ))
+            sec_name = args["spatial_secondary_objects"]
+            # watershed preserves seed ids: the id space (and count) is
+            # the primary's, so features join across the two families
+            self._persist_mosaic_objects(
+                sec_name, sec_labels, count, batch, args, sites, srefs,
+                get_channel, tpoint, zplane,
+            )
+            objects[sec_name] = count
+
+        return {
+            "n_sites": len(sites),
+            "objects": objects,
+            "mosaic_shape": [int(labels.shape[0]), int(labels.shape[1])],
+            "layout": "spatial",
+            "mesh_shape": mesh_shape,
+        }
+
+    def _persist_mosaic_objects(
+        self, name, labels, count, batch, args, sites, srefs,
+        get_channel, tpoint, zplane,
+    ) -> None:
+        """Persist one mosaic-scale object family: per-site label stacks
+        carrying the global ids, the ragged host-side feature table
+        (morphology + per-channel intensity + Zernike), and optional
+        mosaic-frame polygons.  ``get_channel(i)`` returns the stitched
+        (corrected) mosaic of channel ``i`` — memoized by the caller so
+        families share one stitch per channel."""
+        import pandas as pd
+
+        exp = self.store.experiment
+        h, w = exp.site_height, exp.site_width
         per_site = np.stack([
             labels[r.site_y * h:(r.site_y + 1) * h,
                    r.site_x * w:(r.site_x + 1) * w]
@@ -408,9 +496,7 @@ class ImageAnalysisRunner(Step):
                 for stat in ("mean", "sum", "std", "min", "max"):
                     cols[f"Intensity_{stat}_{ch.name}"] = empty
                 continue
-            vals_mosaic = mosaic if ch.index == idx else self._stitched_channel(
-                sites, srefs, ch.index, args, n_sy, n_sx, h, w
-            )
+            vals_mosaic = get_channel(ch.index)
             s2, q2, mn2, mx2 = _mosaic_intensity_stats(labels, vals_mosaic, count)
             mean2 = s2[1:] / denom
             var2 = np.maximum(q2[1:] / denom - mean2 * mean2, 0.0)
@@ -450,14 +536,6 @@ class ImageAnalysisRunner(Step):
                 out = (self.store.root / "segmentations"
                        / f"{name}_polygons_{shard}.parquet")
                 df.to_parquet(out, index=False)
-
-        return {
-            "n_sites": len(sites),
-            "objects": {name: count},
-            "mosaic_shape": [int(labels.shape[0]), int(labels.shape[1])],
-            "layout": "spatial",
-            "mesh_shape": mesh_shape,
-        }
 
     def run_batches_pipelined(self, batches):
         """Generator over ``(batch, result_summary)`` with host work
