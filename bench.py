@@ -74,6 +74,8 @@ def _workload_knobs(config: str) -> dict:
         "BENCH_SITES": ("sites", 96),
         "BENCH_CHANNELS": ("channels", 8),
         "BENCH_DEPTH": ("depth", 16),
+        "BENCH_GRID_Y": ("grid_y", 8),
+        "BENCH_GRID_X": ("grid_x", 8),
     }
 
 
@@ -151,13 +153,15 @@ def measure(platform: str) -> None:
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
     config = os.environ.get("BENCH_CONFIG", "3")  # BASELINE.md milestone ladder
 
-    if config not in ("2", "3", "4", "volume", "corilla"):
+    if config not in ("2", "3", "4", "volume", "corilla", "pyramid"):
         raise SystemExit(
-            f"BENCH_CONFIG must be '2', '3', '4', 'volume' or 'corilla', "
-            f"got '{config}'"
+            f"BENCH_CONFIG must be '2', '3', '4', 'volume', 'corilla' or "
+            f"'pyramid', got '{config}'"
         )
     if config == "corilla":
         return measure_corilla(size)
+    if config == "pyramid":
+        return measure_pyramid(size)
 
     import jax.numpy as jnp
     import numpy as np
@@ -325,6 +329,104 @@ def _flops_fields(flops, n_items, best_s, backend, item_key="flops_per_site"):
         round(achieved / _V5E_BF16_PEAK_FLOPS, 6) if backend != "cpu" else None
     )
     return out
+
+
+def measure_pyramid(size: int) -> None:
+    """BASELINE config 5 (pyramid half): illuminati mosaic stitch + full
+    zoomify level chain + display stretch, measured in level-0
+    megapixels/sec.  Device path: ONE jitted program (stitch reshape,
+    ``reduce_window`` 2x chain, uint8 stretch per level); CPU
+    denominator: the identical chain in single-thread numpy."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmlibrary_tpu.benchmarks import (
+        cpu_reference_pyramid,
+        synthetic_cell_painting_batch,
+    )
+    from tmlibrary_tpu.ops.pyramid import (
+        downsample_2x,
+        n_pyramid_levels,
+        to_uint8,
+    )
+
+    gy = int(os.environ.get("BENCH_GRID_Y", "8"))
+    gx = int(os.environ.get("BENCH_GRID_X", "8"))
+    sites = np.asarray(
+        synthetic_cell_painting_batch(gy * gx, size=size, dapi_only=True)
+        ["DAPI"], np.float32,
+    )
+    n_levels = n_pyramid_levels(gy * size, gx * size)
+    # display window: fixed percentiles of the synthetic stack (corilla's
+    # clip percentiles in production), static for the jit
+    lower = float(np.percentile(sites, 0.1))
+    upper = float(np.percentile(sites, 99.9))
+
+    def chain(batch):
+        mosaic = (
+            batch.reshape(gy, gx, size, size)
+            .transpose(0, 2, 1, 3)
+            .reshape(gy * size, gx * size)
+        )
+        levels = [to_uint8(mosaic, lower, upper)]
+        cur = mosaic
+        for _ in range(n_levels - 1):
+            cur = downsample_2x(cur)
+            levels.append(to_uint8(cur, lower, upper))
+        return levels
+
+    fn = jax.jit(chain)
+    dev_sites = jnp.asarray(sites)
+    flops = _cost_flops(fn, dev_sites)
+    levels = fn(dev_sites)
+    np.asarray(levels[-1])  # honest clock under the relay
+
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        levels = fn(dev_sites)
+        np.asarray(levels[-1])
+        best = min(best, time.perf_counter() - t0)
+    mpix = gy * gx * size * size / 1e6
+    device_mpix_per_sec = mpix / best
+
+    cpu_best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        cpu_levels = cpu_reference_pyramid(
+            sites, (gy, gx), n_levels, lower, upper
+        )
+        cpu_best = min(cpu_best, time.perf_counter() - t0)
+    cpu_mpix_per_sec = mpix / cpu_best
+
+    # the level chains must agree (uint8-quantized display math): a fast
+    # wrong pyramid is not a result
+    for dev_l, cpu_l in zip(levels, cpu_levels):
+        diff = np.abs(
+            np.asarray(dev_l, np.int16) - cpu_l.astype(np.int16)
+        )
+        assert diff.max() <= 1, f"pyramid mismatch: max diff {diff.max()}"
+
+    record = {
+        "metric": "illuminati_mosaic_megapixels_per_sec_per_chip",
+        "value": round(device_mpix_per_sec, 2),
+        "unit": f"Mpix/sec ({gy}x{gx} sites of {size}x{size}: stitch + "
+                f"{n_levels}-level zoomify chain + uint8 stretch)",
+        "vs_baseline": round(device_mpix_per_sec / cpu_mpix_per_sec, 2),
+        "backend": jax.default_backend(),
+        "cpu_denominator_mpix_per_sec": round(cpu_mpix_per_sec, 3),
+        "config": "pyramid",
+        "grid_y": gy,
+        "grid_x": gx,
+        "site_size": size,
+        "n_levels": n_levels,
+    }
+    record.update(_flops_fields(
+        flops, gy * gx, best, jax.default_backend(),
+        item_key="flops_per_site"))
+    print(json.dumps(record), flush=True)
 
 
 def measure_corilla(size: int) -> None:

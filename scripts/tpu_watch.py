@@ -47,6 +47,7 @@ BENCH_ITEMS = [
     ("corilla", {"BENCH_CONFIG": "corilla"}),
     ("volume", {"BENCH_CONFIG": "volume"}),
     ("2", {"BENCH_CONFIG": "2"}),
+    ("pyramid", {"BENCH_CONFIG": "pyramid"}),
 ]
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed

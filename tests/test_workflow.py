@@ -1023,12 +1023,10 @@ def test_spatial_layout_secondary_objects(tmp_path, devices):
     the actin channel via distributed watershed, keep the nuclei's GLOBAL
     ids, and match the single-device segment_secondary chain exactly."""
     import jax.numpy as jnp
-    import scipy.ndimage as ndi
 
     from tmlibrary_tpu.models.experiment import grid_experiment
     from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
-    from tmlibrary_tpu.ops.smooth import gaussian_smooth
-    from tmlibrary_tpu.ops.threshold import threshold_otsu, otsu_value
+    from tmlibrary_tpu.ops.threshold import threshold_otsu
     from tmlibrary_tpu.workflow.registry import get_step
 
     exp = grid_experiment(

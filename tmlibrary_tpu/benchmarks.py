@@ -9,8 +9,10 @@ sites/sec/chip (reference: jterator's per-site job throughput).
 The other ``BENCH_CONFIG`` values cover the rest of the BASELINE ladder:
 ``2`` (the minimum end-to-end slice: smooth + adaptive threshold +
 label, single channel), ``4`` (5-channel full feature stack), ``volume``
-(3-D z-stack pipeline, config 5 stretch) and ``corilla`` (illumination
-statistics, channels/sec — the reference's second headline metric).
+(3-D z-stack pipeline, config 5 stretch), ``corilla`` (illumination
+statistics, channels/sec — the reference's second headline metric) and
+``pyramid`` (config 5's other half: illuminati mosaic stitch + zoomify
+level chain, Mpix/sec).
 """
 
 from __future__ import annotations
@@ -716,3 +718,34 @@ def cpu_reference_site_smooth_threshold(dapi: "np.ndarray") -> int:
     mask = sm > local_mean + 2
     _, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
     return n
+
+
+def cpu_reference_pyramid(
+    sites: np.ndarray, grid: tuple[int, int], n_levels: int,
+    lower: float, upper: float,
+) -> list[np.ndarray]:
+    """Single-thread numpy equivalent of one illuminati mosaic job:
+    stitch the site grid, then the zoomify level chain (2x2 mean pool,
+    edge-padded odd dims) with each level display-stretched to uint8 —
+    the same math the device chain runs (BASELINE config 5's pyramid
+    half)."""
+    gy, gx = grid
+    n, h, w = sites.shape
+    mosaic = (
+        sites.reshape(gy, gx, h, w).transpose(0, 2, 1, 3)
+        .reshape(gy * h, gx * w).astype(np.float32)
+    )
+    span = max(upper - lower, 1e-6)
+
+    def stretch(lvl):
+        return np.clip((lvl - lower) / span * 255.0, 0, 255).astype(np.uint8)
+
+    levels = [stretch(mosaic)]
+    cur = mosaic
+    for _ in range(n_levels - 1):
+        hh, ww = cur.shape
+        if hh % 2 or ww % 2:
+            cur = np.pad(cur, ((0, hh % 2), (0, ww % 2)), mode="edge")
+        cur = cur.reshape(cur.shape[0] // 2, 2, cur.shape[1] // 2, 2).mean((1, 3))
+        levels.append(stretch(cur))
+    return levels

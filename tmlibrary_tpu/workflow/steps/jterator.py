@@ -336,17 +336,22 @@ class ImageAnalysisRunner(Step):
         labels = np.asarray(labels)
         count = int(count)
 
-        # one stitch per channel per well, shared by the watershed input
-        # and BOTH families' intensity loops (stitching re-reads every
-        # site image and re-corrects at mosaic scale — not free)
+        # with a secondary channel every stitched mosaic is used at least
+        # twice (watershed input + both families' intensity loops), so
+        # memoize — accepting a peak of one mosaic per channel.  Without
+        # one, each channel is read exactly once: caching would only
+        # regress peak memory (plate-scale mosaics are GBs each), so
+        # stitch on demand and let each mosaic go out of scope.
+        sec_ch = args.get("spatial_secondary_channel", "")
         stitched = {idx: mosaic}
 
         def get_channel(i: int) -> np.ndarray:
-            if i not in stitched:
-                stitched[i] = self._stitched_channel(
-                    sites, srefs, i, args, n_sy, n_sx, h, w
-                )
-            return stitched[i]
+            if i in stitched:
+                return stitched[i]
+            m = self._stitched_channel(sites, srefs, i, args, n_sy, n_sx, h, w)
+            if sec_ch:
+                stitched[i] = m
+            return m
 
         name = args["spatial_objects"]
         self._persist_mosaic_objects(
@@ -359,7 +364,6 @@ class ImageAnalysisRunner(Step):
         # distributed watershed through a second channel (the sites
         # layout's segment_secondary chain — otsu mask, level flooding,
         # seed ids preserved), so cells keep their nucleus' GLOBAL id
-        sec_ch = args.get("spatial_secondary_channel", "")
         if sec_ch:
             from tmlibrary_tpu.ops import threshold as threshold_ops
             from tmlibrary_tpu.parallel.label import (
