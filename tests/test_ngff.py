@@ -303,6 +303,114 @@ def test_ngff_ingest_round_trip(blob_store, tmp_path):
         np.testing.assert_array_equal(pixels, data[orig_ch])
 
 
+def _write_bare_image(path, arr, channel_labels=None):
+    """Minimal conforming bare OME-Zarr image (root-level multiscales)."""
+    path.mkdir(parents=True, exist_ok=True)
+    (path / ".zgroup").write_text(json.dumps({"zarr_format": 2}))
+    attrs = {
+        "multiscales": [{
+            "version": "0.4",
+            "axes": [{"name": n} for n in "tczyx"],
+            "datasets": [{"path": "0"}],
+        }]
+    }
+    if channel_labels:
+        attrs["omero"] = {
+            "channels": [{"label": l} for l in channel_labels]
+        }
+    (path / ".zattrs").write_text(json.dumps(attrs))
+    zarr_write_array(path / "0", arr, (1, 1, 1, 64, 64))
+
+
+def test_ngff_bare_image_reader(tmp_path):
+    """A plain (non-HCS) OME-Zarr image reads as a one-well one-field
+    plate: the wild's most common form must ingest too."""
+    rng = np.random.default_rng(3)
+    arr = rng.integers(0, 60000, (2, 3, 1, 40, 32), dtype=np.uint16)
+    _write_bare_image(tmp_path / "img.zarr", arr, ["DAPI", "GFP", "RFP"])
+    with NGFFReader(tmp_path / "img.zarr") as r:
+        assert r.is_plate is False
+        assert (r.n_wells, r.n_fields) == (1, 1)
+        assert (r.n_tpoints, r.n_channels, r.n_zplanes) == (2, 3, 1)
+        assert (r.height, r.width) == (40, 32)
+        assert r.channel_names == ["DAPI", "GFP", "RFP"]
+        # page = ((field*T + t)*C + c)*Z + z
+        np.testing.assert_array_equal(r.read_plane_linear(0), arr[0, 0, 0])
+        np.testing.assert_array_equal(r.read_plane_linear(4), arr[1, 1, 0])
+
+
+def test_ngff_bare_image_ingest(tmp_path):
+    """Bare images assign wells like the other containers: filename
+    token, else next free column on row A — and extract bit-identically
+    through metaconfig + imextract."""
+    from tmlibrary_tpu.workflow.registry import get_step
+    from tmlibrary_tpu.workflow.steps.vendors import ngff_sidecar
+
+    rng = np.random.default_rng(13)
+    src = tmp_path / "source"
+    a = rng.integers(0, 60000, (1, 2, 1, 24, 24), dtype=np.uint16)
+    b = rng.integers(0, 60000, (1, 2, 1, 24, 24), dtype=np.uint16)
+    _write_bare_image(src / "scan_B02.zarr", a, ["DAPI", "GFP"])
+    _write_bare_image(src / "extra.zarr", b, ["DAPI", "GFP"])
+    entries, skipped = ngff_sidecar(src)
+    assert skipped == 0 and len(entries) == 2 * 2
+    wells = {(e["well_row"], e["well_col"]) for e in entries}
+    assert wells == {(1, 1), (0, 0)}  # B02 token + next free col on row A
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="bare", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    meta.run(0)
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+    store = ExperimentStore.open(root)
+    names = {c.name: i for i, c in enumerate(store.experiment.channels)}
+    # canonical site order: well (0,0)=extra then (1,1)=scan_B02
+    for ch_name, c in (("DAPI", 0), ("GFP", 1)):
+        px = store.read_sites(None, channel=names[ch_name])
+        np.testing.assert_array_equal(px[0], b[0, c, 0])
+        np.testing.assert_array_equal(px[1], a[0, c, 0])
+
+
+def test_ngff_bare_image_nonstandard_level_path(tmp_path):
+    """Wild images may store level 0 under any multiscales dataset path
+    (e.g. 'scale0'), not '0' — the reader must follow the metadata."""
+    rng = np.random.default_rng(23)
+    arr = rng.integers(0, 60000, (1, 1, 1, 16, 16), dtype=np.uint16)
+    d = tmp_path / "wild.zarr"
+    d.mkdir()
+    (d / ".zgroup").write_text(json.dumps({"zarr_format": 2}))
+    (d / ".zattrs").write_text(json.dumps({
+        "multiscales": [{"version": "0.4",
+                         "axes": [{"name": n} for n in "tczyx"],
+                         "datasets": [{"path": "scale0"}]}]
+    }))
+    zarr_write_array(d / "scale0", arr, (1, 1, 1, 16, 16))
+    with NGFFReader(d) as r:
+        assert (r.height, r.width) == (16, 16)
+        np.testing.assert_array_equal(r.read_plane_linear(0), arr[0, 0, 0])
+
+
+def test_ngff_bare_image_well_collision_with_plate(blob_store, tmp_path):
+    """A token-less bare image must not silently overwrite an HCS
+    plate's well when the plate's sanitized stem is 'plate00'."""
+    from tmlibrary_tpu.errors import VendorConflictError
+    from tmlibrary_tpu.workflow.steps.vendors import ngff_sidecar
+
+    st, _ = blob_store
+    src = tmp_path / "src"
+    write_ngff_plate(st, src / "plate-00.zarr", n_levels=1)
+    arr = np.zeros((1, 2, 1, 48, 40), np.uint16)
+    _write_bare_image(src / "nameless.zarr", arr, ["DAPI", "Actin"])
+    with pytest.raises(VendorConflictError):
+        ngff_sidecar(src)
+
+
 def test_ngff_handler_skips_broken_plate(tmp_path):
     from tmlibrary_tpu.workflow.steps.vendors import ngff_sidecar
 

@@ -410,23 +410,64 @@ def write_ngff_plate(
 
 
 # ------------------------------------------------------- container protocol
+def _level0_name(attrs: dict) -> str:
+    """The first multiscale dataset's path — the level-0 array directory.
+    Our writer uses ``"0"``, but the spec only promises SOME path, so
+    wild images (``scale0``, ``s0``…) must be followed, not assumed."""
+    try:
+        return str(attrs["multiscales"][0]["datasets"][0]["path"])
+    except (KeyError, IndexError, TypeError):
+        return "0"
+
+
 class NGFFReader:
-    """Container-protocol reader over an OME-NGFF HCS plate directory.
+    """Container-protocol reader over an OME-NGFF directory — an HCS
+    plate, or a bare multiscale image (the most common OME-Zarr form in
+    the wild), which reads as a one-well one-field plate.
 
     Matches the :mod:`tmlibrary_tpu.readers` container conventions
     (context manager, ``height``/``width``, a linear page decode) so a
-    ``*.zarr`` plate ingests exactly like an ND2/CZI/LIF file.  The
+    ``*.zarr`` directory ingests exactly like an ND2/CZI/LIF file.  The
     linear page convention (shared with the ``ngff`` metaconfig handler,
     which writes it into the file mappings) is::
 
         page = (((well * F + field) * T + t) * C + c) * Z + z
 
     with wells in plate-attrs order and F/T/C/Z the uniform per-field
-    dimensions (non-uniform plates raise).
+    dimensions (non-uniform plates raise).  ``is_plate`` tells the two
+    forms apart — for a bare image the handler assigns the well from the
+    filename instead of plate metadata.
     """
 
     def __init__(self, path):
         self.path = Path(path)
+
+    def _enter_bare_image(self, attrs: dict):
+        """A root-level ``multiscales`` image: one well at (0, 0), one
+        field whose directory IS the container root."""
+        self.is_plate = False
+        self.well_paths = [""]
+        self.well_indices = [(0, 0)]
+        self.fields_per_well = [1]
+        self.field_paths = [[""]]
+        self.level0_names = [[_level0_name(attrs)]]
+        meta = _zarray_meta(self.path / self.level0_names[0][0])
+        if len(meta["shape"]) != 5:
+            raise MetadataError(
+                f"NGFF image {self.path} is not 5-D tczyx"
+            )
+        dims = tuple(meta["shape"])
+        self.channel_names = None
+        omero = attrs.get("omero") or {}
+        if isinstance(omero.get("channels"), list):
+            self.channel_names = [
+                ch.get("label", f"C{i:02d}")
+                for i, ch in enumerate(omero["channels"])
+            ]
+        self.n_fields = 1
+        self.n_tpoints, self.n_channels, self.n_zplanes = dims[:3]
+        self.height, self.width = dims[3], dims[4]
+        return self
 
     def __enter__(self):
         attrs_file = self.path / ".zattrs"
@@ -438,9 +479,12 @@ class NGFFReader:
             ) from exc
         plate = attrs.get("plate")
         if not plate or "wells" not in plate:
+            if attrs.get("multiscales"):
+                return self._enter_bare_image(attrs)
             raise MetadataError(
-                f"no HCS 'plate' metadata in {attrs_file}"
+                f"no HCS 'plate' or 'multiscales' metadata in {attrs_file}"
             )
+        self.is_plate = True
         try:
             self.well_paths = [w["path"] for w in plate["wells"]]
         except (KeyError, TypeError) as exc:
@@ -456,6 +500,9 @@ class NGFFReader:
         #: spec does not promise 0-based numeric image paths, so the
         #: linear page decode must index THESE, not str(field)
         self.field_paths: list[list[str]] = []
+        #: per-(well, field) level-0 dataset directory names (the spec
+        #: only promises some multiscales datasets[0].path, not "0")
+        self.level0_names: list[list[str]] = []
         dims = None
         self.channel_names: list[str] | None = None
         for wp in self.well_paths:
@@ -470,9 +517,18 @@ class NGFFReader:
                 ) from exc
             self.fields_per_well.append(len(images))
             self.field_paths.append(paths)
+            well_levels: list[str] = []
             for img in images:
                 field_dir = well_dir / img["path"]
-                meta = _zarray_meta(field_dir / "0")
+                try:
+                    fattrs = json.loads(
+                        (field_dir / ".zattrs").read_text()
+                    )
+                except (OSError, ValueError):
+                    fattrs = {}
+                lvl0 = _level0_name(fattrs)
+                well_levels.append(lvl0)
+                meta = _zarray_meta(field_dir / lvl0)
                 if len(meta["shape"]) != 5:
                     raise MetadataError(
                         f"NGFF field {field_dir} is not 5-D tczyx"
@@ -486,17 +542,15 @@ class NGFFReader:
                     )
                 if self.channel_names is None:
                     try:
-                        fattrs = json.loads(
-                            (field_dir / ".zattrs").read_text()
-                        )
                         self.channel_names = [
                             ch.get("label", f"C{i:02d}")
                             for i, ch in enumerate(
                                 fattrs["omero"]["channels"]
                             )
                         ]
-                    except (OSError, ValueError, KeyError):
+                    except (KeyError, TypeError):
                         pass
+            self.level0_names.append(well_levels)
         if dims is None:
             raise MetadataError(f"NGFF plate {self.path} has no fields")
         if len(set(self.fields_per_well)) != 1:
@@ -529,6 +583,7 @@ class NGFFReader:
             )
         field_dir = (
             self.path / self.well_paths[well]
-            / self.field_paths[well][field] / "0"
+            / self.field_paths[well][field]
+            / self.level0_names[well][field]
         )
         return zarr_read_plane(field_dir, t, c, z)

@@ -1169,10 +1169,13 @@ def ngff_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
     """OME-NGFF (OME-Zarr v0.4) HCS plates, read by the first-party Zarr
     v2 parser (:class:`tmlibrary_tpu.ngff.NGFFReader`).
 
-    Unlike the nd2/czi/lif handlers, wells come from the plate's own HCS
-    metadata (``rowIndex``/``columnIndex``), not filename tokens; fields
-    map to sites, omero channel labels (sanitized) name the channels, and
-    the plate takes the ``*.zarr`` directory's stem.  ``page`` encodes
+    HCS plates take their wells from the plate's own metadata
+    (``rowIndex``/``columnIndex``) and their plate name from the
+    ``*.zarr`` directory's stem; BARE multiscale images (no ``plate``
+    key — the most common OME-Zarr form) are assigned wells like the
+    nd2/czi/lif containers: filename token (``A01``), else the next
+    free column on row A.  Fields map to sites, omero channel labels
+    (sanitized) name the channels.  ``page`` encodes
     ``(((well * F + field) * T + t) * C + c) * Z + z`` — the convention
     :meth:`~tmlibrary_tpu.ngff.NGFFReader.read_plane_linear` decodes for
     imextract."""
@@ -1186,25 +1189,19 @@ def ngff_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
         return None
     entries: list[dict] = []
     skipped = 0
-    for path in plates:
-        try:
-            with NGFFReader(path) as r:
-                wells = list(r.well_indices)
-                nf, nt = r.n_fields, r.n_tpoints
-                nc, nz = r.n_channels, r.n_zplanes
-                labels = r.channel_names
-        except MetadataError as exc:
-            logger.warning("skipping unreadable NGFF plate %s: %s",
-                           path, exc)
-            skipped += 1
-            continue
-        plate_name = (re.sub(r"[^A-Za-z0-9]", "", path.stem) or "plate00")
-        names = [
+    bare: list[tuple] = []
+
+    def channel_names(nc, labels):
+        return [
             (re.sub(r"[^A-Za-z0-9\-]", "-", labels[c])
              if labels and c < len(labels) and labels[c]
              else f"C{c:02d}")
             for c in range(nc)
         ]
+
+    def emit(path, info, wells, plate_name):
+        nf, nt, nc, nz, labels = info
+        names = channel_names(nc, labels)
         for wi, well in enumerate(wells):
             for f in range(nf):
                 for t in range(nt):
@@ -1219,4 +1216,38 @@ def ngff_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
                             e["plate"] = plate_name
                             e["channel"] = names[c]
                             entries.append(e)
+
+    for path in plates:
+        try:
+            with NGFFReader(path) as r:
+                info = (r.n_fields, r.n_tpoints, r.n_channels,
+                        r.n_zplanes, r.channel_names)
+                if r.is_plate:
+                    plate_name = (
+                        re.sub(r"[^A-Za-z0-9]", "", path.stem) or "plate00"
+                    )
+                    emit(path, info, list(r.well_indices), plate_name)
+                else:
+                    bare.append((path, info, parse_well_token(path.stem)))
+        except MetadataError as exc:
+            logger.warning("skipping unreadable NGFF plate %s: %s",
+                           path, exc)
+            skipped += 1
+    # bare images land on "plate00" (the shared container convention);
+    # assign_container_wells only deduplicates AMONG the bare files, so
+    # an HCS plate whose sanitized stem is also "plate00" must not have
+    # its wells silently overwritten by a bare image's pixels
+    claimed = {
+        (e["plate"], e["well_row"], e["well_col"]) for e in entries
+    }
+    for path, info, well in assign_container_wells(bare, "NGFF"):
+        if ("plate00", well[0], well[1]) in claimed:
+            from tmlibrary_tpu.errors import VendorConflictError
+
+            raise VendorConflictError(
+                f"bare NGFF image {path} would land on plate00 well "
+                f"{well}, already claimed by an HCS plate in the same "
+                f"source dir — rename one of them"
+            )
+        emit(path, info, [well], "plate00")
     return entries, skipped
