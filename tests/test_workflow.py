@@ -954,6 +954,70 @@ def test_spatial_layout_mosaic_segmentation(tmp_path, devices):
     assert collected["objects_total"]["mosaic_cells"] == 5
 
 
+def test_spatial_layout_applies_cycle_shifts(tmp_path, devices):
+    """Stored align-step shifts move each site into the aligned frame
+    during stitching, so a multiplexing cycle's mosaic segments exactly
+    like the pre-shift golden."""
+    import jax.numpy as jnp
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+    from tmlibrary_tpu.ops.threshold import otsu_value
+    from tmlibrary_tpu.workflow.registry import get_step
+    from tmlibrary_tpu.workflow.steps.jterator import _host_shift
+
+    exp = grid_experiment(
+        "spatsh", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI",), site_shape=(32, 32), n_cycles=2,
+    )
+    st = ExperimentStore.create(tmp_path / "spatsh_exp", exp)
+    rng = np.random.default_rng(23)
+    yy, xx = np.mgrid[0:64, 0:64]
+    mosaic = rng.normal(300, 15, (64, 64))
+    for cy, cx in [(16, 16), (40, 48)]:
+        mosaic += 4000 * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / 18.0)
+    mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+    tiles = np.stack([mosaic[0:32, 0:32], mosaic[0:32, 32:64],
+                      mosaic[32:64, 0:32], mosaic[32:64, 32:64]])
+    # cycle-1 acquisition drifted by (+2, -3) per site
+    drift = np.stack([_host_shift(t, -2, 3) for t in tiles])
+    st.write_sites(drift, [0, 1, 2, 3], cycle=1, channel=0)
+    shifts = np.tile(np.asarray([[2, -3]], np.int32), (4, 1))
+    st.write_shifts(shifts, cycle=1)
+
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8, "cycle": 1})
+    result = jt.run(0)
+    assert result["objects"]["mosaic_cells"] == 2
+
+    labels = st.read_labels(None, "mosaic_cells")
+    restitched = np.zeros((64, 64), np.int32)
+    for i, (sy, sx) in enumerate([(0, 0), (0, 1), (1, 0), (1, 1)]):
+        restitched[sy * 32:(sy + 1) * 32, sx * 32:(sx + 1) * 32] = labels[i]
+    # golden: the same chain on the ALIGNED stitched mosaic (per-site
+    # un-drift, zero-filled edges — what _stitched_channel builds), with
+    # the Otsu cut computed over the VALID pixels only (the shift's zero
+    # stripes must not feed the histogram)
+    aligned = np.zeros((64, 64), np.float32)
+    valid = np.zeros((64, 64), bool)
+    ones = np.ones((32, 32), np.float32)
+    for i, (sy, sx) in enumerate([(0, 0), (0, 1), (1, 0), (1, 1)]):
+        aligned[sy * 32:(sy + 1) * 32, sx * 32:(sx + 1) * 32] = _host_shift(
+            drift[i].astype(np.float32), 2, -3
+        )
+        valid[sy * 32:(sy + 1) * 32, sx * 32:(sx + 1) * 32] = (
+            _host_shift(ones, 2, -3) > 0
+        )
+    sm = np.asarray(gaussian_smooth(jnp.asarray(aligned), 1.5))
+    golden, n = ndi.label(
+        sm > float(np.asarray(otsu_value(jnp.asarray(sm[valid])))),
+        structure=np.ones((3, 3)),
+    )
+    assert n == 2
+    np.testing.assert_array_equal(restitched, golden)
+
+
 def test_spatial_layout_grid_mesh(tmp_path, devices):
     """spatial_grid='auto' picks a 2-D rows x cols tile grid when it
     keeps more devices busy (100-row mosaic on 8 devices: 1-D shrinks to

@@ -148,6 +148,10 @@ class ImageAnalysisRunner(Step):
         Argument("spatial_secondary_levels", int, default=32,
                  help="watershed flooding levels for the secondary mask "
                       "(segment_secondary's n_levels)"),
+        Argument("spatial_align", bool, default=True,
+                 help="apply align-step shifts when stitching (the sites "
+                      "layout gates this per pipe channel; disable if the "
+                      "stored registration is untrusted)"),
         Argument("batch_size", int, default=32, help="sites per device batch"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
@@ -227,8 +231,13 @@ class ImageAnalysisRunner(Step):
         self, sites, srefs, ch_index, args, n_sy, n_sx, h, w
     ) -> "np.ndarray":
         """One channel's well mosaic, illumination-corrected when corilla
-        statistics exist (the same correction the sites layout's
-        preprocess applies — the two layouts must see the same pixels)."""
+        statistics exist and cycle-aligned when the align step stored
+        shifts for this cycle (the same correct+align prep the sites
+        layout applies — the two layouts must see the same pixels).
+        Alignment is shift-only: the per-site intersection crop cannot
+        apply at mosaic scale (it would shrink tiles out of the grid), so
+        shifted-in edges are zero-filled exactly like the sites path's
+        ``shift_image``."""
         imgs = self.store.read_sites(
             sites, cycle=args["cycle"], channel=ch_index,
             tpoint=args["tpoint"], zplane=args["zplane"],
@@ -238,11 +247,45 @@ class ImageAnalysisRunner(Step):
                 self.store.read_illumstats(cycle=args["cycle"], channel=ch_index)
             )
             imgs = _correct_batch(imgs, cont.mean_log, cont.std_log)
+        shifts = None
+        if args.get("spatial_align", True) and self.store.has_shifts(
+            args["cycle"]
+        ):
+            shifts = self.store.read_shifts(args["cycle"])
         mosaic = np.zeros((n_sy * h, n_sx * w), np.float32)
-        for img, r in zip(imgs, srefs):
+        for img, r, site_idx in zip(imgs, srefs, sites):
+            if shifts is not None:
+                dy, dx = int(shifts[site_idx][0]), int(shifts[site_idx][1])
+                if dy or dx:
+                    img = _host_shift(img, dy, dx)
             mosaic[r.site_y * h:(r.site_y + 1) * h,
                    r.site_x * w:(r.site_x + 1) * w] = img
         return mosaic
+
+    def _stitch_validity(
+        self, sites, srefs, args, n_sy, n_sx, h, w
+    ) -> "np.ndarray | None":
+        """Boolean mosaic of pixels that carry real data after the
+        per-site alignment shift (zero-filled shifted-in edges are
+        False).  None when no shift moved anything — every pixel is
+        valid and callers can skip the masked-threshold path."""
+        if not (args.get("spatial_align", True)
+                and self.store.has_shifts(args["cycle"])):
+            return None
+        shifts = self.store.read_shifts(args["cycle"])
+        if not any(
+            int(shifts[s][0]) or int(shifts[s][1]) for s in sites
+        ):
+            return None
+        valid = np.zeros((n_sy * h, n_sx * w), bool)
+        for r, site_idx in zip(srefs, sites):
+            v = _host_shift(
+                np.ones((h, w), np.float32),
+                int(shifts[site_idx][0]), int(shifts[site_idx][1]),
+            ) > 0
+            valid[r.site_y * h:(r.site_y + 1) * h,
+                  r.site_x * w:(r.site_x + 1) * w] = v
+        return valid
 
     def _run_spatial(self, batch: dict) -> dict:
         """Whole-mosaic segmentation of one well (``--layout spatial``).
@@ -258,8 +301,10 @@ class ImageAnalysisRunner(Step):
         (area/centroid) for the well.  This is the rebuild's
         context-parallelism path: objects crossing site borders keep one
         identity, which per-site fan-out (reference or 'sites' layout)
-        cannot do.  Cycle-alignment shifts are NOT applied (the mosaic
-        path is single-cycle); ``figures`` is a sites-layout feature
+        cannot do.  Cycle-alignment shifts stored by the align step are
+        applied per site during stitching (shift-only — see
+        :meth:`_stitched_channel`), so multiplexing cycles segment in
+        the aligned frame; ``figures`` is a sites-layout feature
         (warned, not silently ignored)."""
         import jax
         import jax.numpy as jnp
@@ -286,6 +331,22 @@ class ImageAnalysisRunner(Step):
         n_sy = max(r.site_y for r in srefs) + 1
         n_sx = max(r.site_x for r in srefs) + 1
         mosaic = self._stitched_channel(sites, srefs, idx, args, n_sy, n_sx, h, w)
+
+        # alignment zero-fills shifted-in edges INSIDE the mosaic; those
+        # stripes would feed the global Otsu histogram as an artificial
+        # zero mode (the sites layout crops them away via the
+        # intersection window), so when any exist the threshold is
+        # computed over the VALID pixels only and passed in explicitly
+        valid = self._stitch_validity(sites, srefs, args, n_sy, n_sx, h, w)
+        threshold = None
+        if valid is not None:
+            from tmlibrary_tpu.ops.smooth import gaussian_smooth
+            from tmlibrary_tpu.ops.threshold import otsu_value
+
+            sm = np.asarray(jax.jit(
+                lambda x: gaussian_smooth(x, args["spatial_sigma"])
+            )(jnp.asarray(mosaic)))
+            threshold = float(otsu_value(jnp.asarray(sm[valid])))
 
         requested = args["n_devices"] or len(jax.devices())
         requested = min(requested, len(jax.devices()))
@@ -319,7 +380,8 @@ class ImageAnalysisRunner(Step):
             )
             mesh_shape = [nr2, nc2]
             labels, count = sharded_segment_mosaic_2d(
-                jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"]
+                jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"],
+                threshold=threshold,
             )
         else:
             n_dev = n_rows1d
@@ -331,7 +393,8 @@ class ImageAnalysisRunner(Step):
             mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("rows",))
             mesh_shape = [n_dev, 1]
             labels, count = sharded_segment_mosaic(
-                jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"]
+                jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"],
+                threshold=threshold,
             )
         labels = np.asarray(labels)
         count = int(count)
@@ -372,11 +435,19 @@ class ImageAnalysisRunner(Step):
             )
 
             sec_idx = exp.channel_index(sec_ch)
-            img = jnp.asarray(get_channel(sec_idx), jnp.float32)
-            mask = threshold_ops.threshold_otsu(
-                img,
-                correction_factor=args["spatial_secondary_factor"],
-            )
+            sec_np = np.asarray(get_channel(sec_idx), np.float32)
+            img = jnp.asarray(sec_np)
+            if valid is not None:
+                # same zero-stripe exclusion as the primary threshold
+                t_sec = float(
+                    threshold_ops.otsu_value(jnp.asarray(sec_np[valid]))
+                ) * args["spatial_secondary_factor"]
+                mask = img > t_sec
+            else:
+                mask = threshold_ops.threshold_otsu(
+                    img,
+                    correction_factor=args["spatial_secondary_factor"],
+                )
             flood = (
                 distributed_watershed_from_seeds_2d if use_grid
                 else distributed_watershed_from_seeds
