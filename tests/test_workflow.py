@@ -1115,7 +1115,7 @@ def test_spatial_layout_secondary_objects(tmp_path, devices):
 
     jt = get_step("jterator")(st)
     jt.init({"layout": "spatial", "n_devices": 8,
-             "spatial_secondary_channel": "Actin"})
+             "spatial_secondary_channel": "Actin", "figures": True})
     result = jt.run(0)
     assert result["mesh_shape"] == [4, 2]  # the 2-D watershed branch
     n = result["objects"]["mosaic_cells"]
@@ -1144,6 +1144,14 @@ def test_spatial_layout_secondary_objects(tmp_path, devices):
     # secondary features landed with the same label ids
     feats = st.read_features("mosaic_secondary")
     assert sorted(feats["label"]) == [1, 2, 3]
+    # --figures wrote one whole-well overlay per object family
+    import cv2
+    for fam in ("mosaic_cells", "mosaic_secondary"):
+        fig = st.root / "figures" / f"{fam}_well_plate00_00_00.png"
+        assert fig.exists()
+        img = cv2.imread(str(fig))
+        assert img is not None and img.shape == (100, 100, 3)
+        assert (img.max(axis=-1) != img.min(axis=-1)).any()  # colored edges
     assert (feats["Morphology_area"].to_numpy() >=
             st.read_features("mosaic_cells")["Morphology_area"].to_numpy()).all()
 

@@ -14,7 +14,6 @@ Pure numpy + cv2 (no plotting dependency); PNG files are the artifact.
 
 from __future__ import annotations
 
-import colorsys
 from pathlib import Path
 
 import numpy as np
@@ -31,12 +30,28 @@ def _stretch_u8(img: np.ndarray, p_lo: float = 1.0, p_hi: float = 99.0) -> np.nd
 
 def _label_palette(n: int) -> np.ndarray:
     """(n+1, 3) BGR palette: background black, labels on a golden-angle
-    hue wheel so adjacent ids get distinct colors."""
+    hue wheel so adjacent ids get distinct colors.  Vectorized — mosaic
+    wells carry up to millions of global ids, and a per-id Python
+    ``colorsys`` loop at that scale costs seconds per figure."""
     out = np.zeros((n + 1, 3), np.uint8)
-    for i in range(1, n + 1):
-        h = (i * 0.618033988749895) % 1.0
-        r, g, b = colorsys.hsv_to_rgb(h, 0.85, 1.0)
-        out[i] = (int(b * 255), int(g * 255), int(r * 255))
+    if n == 0:
+        return out
+    h = (np.arange(1, n + 1, dtype=np.float64) * 0.618033988749895) % 1.0
+    s, v = 0.85, 1.0
+    sector = np.floor(h * 6.0)
+    f = h * 6.0 - sector
+    p = np.full_like(h, v * (1.0 - s))
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    ones = np.full_like(h, v)
+    sector = sector.astype(np.int64) % 6
+    r = np.choose(sector, [ones, q, p, p, t, ones])
+    g = np.choose(sector, [t, ones, ones, q, p, p])
+    b = np.choose(sector, [p, p, t, ones, ones, q])
+    # int() truncation, matching colorsys.hsv_to_rgb + int(x * 255)
+    out[1:, 0] = (b * 255).astype(np.uint8)
+    out[1:, 1] = (g * 255).astype(np.uint8)
+    out[1:, 2] = (r * 255).astype(np.uint8)
     return out
 
 
@@ -64,6 +79,32 @@ def segmentation_overlay(
         edges = _boundaries(lab)
         img[edges] = palette[lab[edges]]
     return img
+
+
+def write_mosaic_figure(
+    figures_dir: Path | str,
+    objects_name: str,
+    mosaic: np.ndarray,
+    labels: np.ndarray,
+    shard: str,
+    max_dim: int = 2048,
+) -> Path:
+    """One whole-well overlay PNG for the spatial layout:
+    ``<objects>_<shard>.png``.  Plate-scale mosaics are nearest-
+    subsampled to ``max_dim`` first (a QC artifact, not an exact label
+    render — boundaries thinner than the stride may drop out)."""
+    import cv2
+
+    mosaic = np.asarray(mosaic)
+    step = max(1, -(-max(mosaic.shape) // max_dim))  # ceil div
+    overlay = segmentation_overlay(
+        mosaic[::step, ::step], np.asarray(labels)[::step, ::step]
+    )
+    out_dir = Path(figures_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / f"{objects_name}_{shard}.png"
+    cv2.imwrite(str(path), overlay)
+    return path
 
 
 def write_figures(

@@ -53,6 +53,13 @@ def _mosaic_intensity_stats(labels, vals_mosaic, count):
 _CORRECT_JIT = None
 
 
+def _well_shard(batch: dict) -> str:
+    """The ONE home of the per-well shard token used by feature-table
+    shards, polygon filenames and figure filenames alike."""
+    plate, well_row, well_col = batch["well"]
+    return f"well_{plate}_{well_row:02d}_{well_col:02d}"
+
+
 def _best_spatial_grid(requested: int, hm: int, wm: int) -> tuple[int, int]:
     """Largest ``nr * nc <= requested`` with ``nr`` dividing the mosaic
     rows and ``nc`` the columns; equal products prefer more rows (the
@@ -162,8 +169,10 @@ class ImageAnalysisRunner(Step):
         Argument("as_polygons", bool, default=False,
                  help="also trace object outlines host-side"),
         Argument("figures", bool, default=False,
-                 help="write per-site segmentation-overlay PNGs "
-                      "(reference: jterator module plot/Figure artifacts)"),
+                 help="write segmentation-overlay PNGs: per site in the "
+                      "sites layout, one downsampled whole-well mosaic per "
+                      "object family in the spatial layout (reference: "
+                      "jterator module plot/Figure artifacts)"),
     )
 
     def __init__(self, store):
@@ -304,8 +313,8 @@ class ImageAnalysisRunner(Step):
         cannot do.  Cycle-alignment shifts stored by the align step are
         applied per site during stitching (shift-only — see
         :meth:`_stitched_channel`), so multiplexing cycles segment in
-        the aligned frame; ``figures`` is a sites-layout feature
-        (warned, not silently ignored)."""
+        the aligned frame; ``--figures`` writes one downsampled
+        whole-well overlay PNG per object family."""
         import jax
         import jax.numpy as jnp
         import pandas as pd
@@ -320,11 +329,6 @@ class ImageAnalysisRunner(Step):
 
         ch_name = args["spatial_channel"] or exp.channels[0].name
         idx = exp.channel_index(ch_name)
-        if args.get("figures"):
-            logger.warning(
-                "--figures is not supported in the spatial layout "
-                "(overlays are per-site artifacts); skipping"
-            )
         refs = list(exp.sites())
         srefs = [refs[i] for i in sites]
         h, w = exp.site_height, exp.site_width
@@ -416,12 +420,25 @@ class ImageAnalysisRunner(Step):
                 stitched[i] = m
             return m
 
+        shard = _well_shard(batch)
+
+        def emit_figure(fam_name, fam_mosaic, fam_labels):
+            if not args.get("figures"):
+                return
+            from tmlibrary_tpu.jterator.figures import write_mosaic_figure
+
+            write_mosaic_figure(
+                self.store.root / "figures", fam_name, fam_mosaic,
+                fam_labels, shard,
+            )
+
         name = args["spatial_objects"]
         self._persist_mosaic_objects(
             name, labels, count, batch, args, sites, srefs, get_channel,
-            tpoint, zplane,
+            tpoint, zplane, shard,
         )
         objects = {name: count}
+        emit_figure(name, mosaic, labels)
 
         # secondary objects over the whole mosaic: primary labels seed a
         # distributed watershed through a second channel (the sites
@@ -461,9 +478,10 @@ class ImageAnalysisRunner(Step):
             # the primary's, so features join across the two families
             self._persist_mosaic_objects(
                 sec_name, sec_labels, count, batch, args, sites, srefs,
-                get_channel, tpoint, zplane,
+                get_channel, tpoint, zplane, shard,
             )
             objects[sec_name] = count
+            emit_figure(sec_name, sec_np, sec_labels)
 
         return {
             "n_sites": len(sites),
@@ -475,7 +493,7 @@ class ImageAnalysisRunner(Step):
 
     def _persist_mosaic_objects(
         self, name, labels, count, batch, args, sites, srefs,
-        get_channel, tpoint, zplane,
+        get_channel, tpoint, zplane, shard,
     ) -> None:
         """Persist one mosaic-scale object family: per-site label stacks
         carrying the global ids, the ragged host-side feature table
@@ -594,7 +612,6 @@ class ImageAnalysisRunner(Step):
             for z_idx, (n_z, m_z, _) in enumerate(_zernike_coeffs(z_degree)):
                 cols[f"Zernike_{n_z}_{m_z}"] = zern[:, z_idx].astype(np.float64)
         table = pd.DataFrame(cols)
-        shard = f"well_{plate}_{well_row:02d}_{well_col:02d}"
         self.store.append_features(name, table, shard=shard)
 
         if args.get("as_polygons"):
