@@ -1,0 +1,178 @@
+"""First-party DeltaVision ``.dv``/``.r3d`` container support (the
+MRC-variant stack format of GE/Applied Precision widefield scopes).
+
+Fixtures are written by ``write_dv`` below: the 1024-byte fixed header
+(dims at 0, mode at 12, extended-header size at 92, DVID magic at 96,
+NumTimes/ImgSequence/NumWaves shorts at 180/182/196) followed by the
+extended header and row-major section planes in the declared interleave
+order.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.readers import DVReader
+
+
+def write_dv(path, planes, sequence=0, byte_order="<", mode=6,
+             ext_size=96, declare_sections=None):
+    """``planes``: (W, Z, T, H, W) uint16-ish array indexed [c][z][t]."""
+    n_w, n_z, n_t, h, w = planes.shape
+    nsec = declare_sections if declare_sections is not None else n_w * n_z * n_t
+    header = bytearray(1024)
+    struct.pack_into(f"{byte_order}4i", header, 0, w, h, nsec, mode)
+    struct.pack_into(f"{byte_order}i", header, 92, ext_size)
+    struct.pack_into(f"{byte_order}h", header, 96, -16224)
+    struct.pack_into(f"{byte_order}h", header, 180, n_t)
+    struct.pack_into(f"{byte_order}h", header, 182, sequence)
+    struct.pack_into(f"{byte_order}h", header, 196, n_w)
+    dtype = np.dtype(byte_order + {0: "u1", 1: "i2", 2: "f4", 6: "u2"}[mode])
+
+    def section_index(z, c, t):
+        if sequence == 0:  # ZTW
+            return (c * n_t + t) * n_z + z
+        if sequence == 1:  # WZT
+            return (t * n_z + z) * n_w + c
+        return (t * n_w + c) * n_z + z  # ZWT
+
+    sections = [None] * (n_w * n_z * n_t)
+    for c in range(n_w):
+        for z in range(n_z):
+            for t in range(n_t):
+                sections[section_index(z, c, t)] = planes[c, z, t]
+    blob = bytearray(header) + bytearray(ext_size)
+    for sec in sections:
+        blob += np.ascontiguousarray(sec, dtype).tobytes()
+    path.write_bytes(bytes(blob))
+
+
+@pytest.fixture
+def planes():
+    rng = np.random.default_rng(7)
+    return rng.integers(0, 60000, (2, 3, 2, 16, 20), dtype=np.uint16)
+
+
+@pytest.mark.parametrize("sequence", [0, 1, 2])
+@pytest.mark.parametrize("byte_order", ["<", ">"])
+def test_dv_reader_all_orders(tmp_path, planes, sequence, byte_order):
+    path = tmp_path / "s.dv"
+    write_dv(path, planes, sequence=sequence, byte_order=byte_order)
+    with DVReader(path) as r:
+        assert (r.width, r.height) == (20, 16)
+        assert (r.n_channels, r.n_zplanes, r.n_tpoints) == (2, 3, 2)
+        for c in range(2):
+            for z in range(3):
+                for t in range(2):
+                    np.testing.assert_array_equal(
+                        r.read_plane(z, c, t), planes[c, z, t]
+                    )
+                    page = (c * 3 + z) * 2 + t
+                    np.testing.assert_array_equal(
+                        r.read_plane_linear(page), planes[c, z, t]
+                    )
+
+
+def test_dv_float_mode_and_int16(tmp_path):
+    rng = np.random.default_rng(3)
+    f = rng.random((1, 1, 1, 8, 8)).astype(np.float32)
+    path = tmp_path / "f.dv"
+    write_dv(path, f, mode=2)
+    with DVReader(path) as r:
+        np.testing.assert_array_equal(r.read_plane(0, 0, 0), f[0, 0, 0])
+    # int16 with NEGATIVE values (deconvolved DV output routinely has
+    # them): clipped at 0, never wrapped to ~65535
+    i = rng.integers(-500, 3000, (1, 2, 1, 8, 8)).astype(np.int16)
+    i[0, 1, 0, 0, 0] = -10
+    path2 = tmp_path / "i.r3d"
+    write_dv(path2, i, mode=1)
+    with DVReader(path2) as r:
+        out = r.read_plane(1, 0, 0)
+        assert out.dtype == np.uint16
+        np.testing.assert_array_equal(out, np.clip(i[0, 1, 0], 0, None))
+        assert out[0, 0] == 0
+
+
+def test_dv_rejects_bad_files(tmp_path, planes):
+    p = tmp_path / "bad.dv"
+    p.write_bytes(b"\0" * 500)  # short header
+    with pytest.raises(MetadataError):
+        DVReader(p).__enter__()
+    p2 = tmp_path / "nomagic.dv"
+    p2.write_bytes(b"\0" * 2048)
+    with pytest.raises(MetadataError):
+        DVReader(p2).__enter__()
+    good = tmp_path / "good.dv"
+    write_dv(good, planes)
+    blob = good.read_bytes()
+    trunc = tmp_path / "trunc.dv"
+    trunc.write_bytes(blob[: len(blob) // 2])
+    with pytest.raises(MetadataError):
+        DVReader(trunc).__enter__()
+    nofactor = tmp_path / "nofactor.dv"
+    write_dv(nofactor, planes, declare_sections=11)
+    with pytest.raises(MetadataError):
+        DVReader(nofactor).__enter__()
+
+
+def test_dv_ingest_end_to_end(tmp_path, planes):
+    """Per-well .dv stacks -> metaconfig (auto) -> imextract -> pixels in
+    the canonical store, bit-identical, Z/T preserved."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(11)
+    src = tmp_path / "source"
+    src.mkdir()
+    data = {}
+    for well in ("A01", "B02"):
+        stack = rng.integers(0, 60000, (2, 3, 2, 16, 20), dtype=np.uint16)
+        write_dv(src / f"exp_{well}.dv", stack)
+        data[well] = stack
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="dvtest", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 2 * 3 * 2  # wells x C x Z x T
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 2
+    assert exp.n_zplanes == 3 and exp.n_tpoints == 2
+    assert {c.name for c in exp.channels} == {"C00", "C01"}
+    rows_cols = {(w.row, w.column) for p in exp.plates for w in p.wells}
+    assert rows_cols == {(0, 0), (1, 1)}
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store = ExperimentStore.open(root)
+    for c in range(2):
+        for z in range(3):
+            for t in range(2):
+                px = store.read_sites(None, channel=c, tpoint=t, zplane=z)
+                np.testing.assert_array_equal(px[0], data["A01"][c, z, t])
+                np.testing.assert_array_equal(px[1], data["B02"][c, z, t])
+
+
+def test_dv_handler_skips_unreadable(tmp_path, planes):
+    from tmlibrary_tpu.workflow.steps.vendors import dv_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_dv(src / "ok_A01.dv", planes)
+    (src / "bad_B01.dv").write_bytes(b"\0" * 2048)
+    entries, skipped = dv_sidecar(src)
+    assert skipped == 1
+    assert {e["well_row"] for e in entries} == {0}
+    assert len(entries) == 2 * 3 * 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert dv_sidecar(empty) is None

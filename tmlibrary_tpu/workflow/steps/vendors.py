@@ -1051,9 +1051,13 @@ def _container_sidecar(
     dims_of: Callable, entries_of: Callable,
 ) -> tuple[list[dict], int] | None:
     """Shared scan -> skip-unreadable -> assign-wells -> emit loop of the
-    one-file-per-well container handlers (nd2/czi/lif); only the reader,
-    the dims tuple and the page formula differ per format."""
-    files = sorted(source_dir.rglob(f"*{suffix}"))
+    one-file-per-well container handlers (nd2/czi/lif/dv); only the
+    reader, the dims tuple and the page formula differ per format.
+    ``suffix`` may be one extension or a tuple of them."""
+    suffixes = (suffix,) if isinstance(suffix, str) else suffix
+    files = sorted(
+        p for suf in suffixes for p in source_dir.rglob(f"*{suf}")
+    )
     if not files:
         return None
     readable = []
@@ -1251,3 +1255,32 @@ def ngff_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
             )
         emit(path, info, [well], "plate00")
     return entries, skipped
+
+
+# ------------------------------------------------------------------------ dv
+@register_sidecar_handler("dv")
+def dv_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
+    """DeltaVision ``.dv`` / ``.r3d`` stacks, read by the first-party
+    MRC-variant parser (:class:`tmlibrary_tpu.readers.DVReader`).
+
+    Same conventions as the nd2/czi/lif handlers: one file per well
+    (well-name token in the filename, else the next free column on row
+    A); each stack is a single site with its wavelengths as channels and
+    Z/T preserved; ``page`` encodes ``(c * Z + z) * T + t`` for
+    imextract's plane decode."""
+    from tmlibrary_tpu.readers import DVReader
+
+    def entries_of(path, dims, well):
+        n_c, n_z, n_t = dims
+        return [
+            _container_entry(path, well, site=0, channel=c, zplane=z,
+                             tpoint=t, page=(c * n_z + z) * n_t + t)
+            for c in range(n_c)
+            for z in range(n_z)
+            for t in range(n_t)
+        ]
+
+    return _container_sidecar(
+        source_dir, (".dv", ".r3d"), DVReader, "DV",
+        lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints), entries_of,
+    )
