@@ -1,0 +1,135 @@
+"""First-party Bitplane Imaris ``.ims`` support (HDF5-based container).
+
+Fixtures are written by ``write_ims``: the Imaris layout —
+``DataSet/ResolutionLevel 0/TimePoint t/Channel c/Data`` (Z, Y, X)
+datasets padded to chunk multiples, true sizes as byte-character-array
+attributes on ``DataSetInfo/Image``, channel names on
+``DataSetInfo/Channel c``.
+"""
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.readers import IMSReader
+
+
+def write_ims(path, planes, channel_names=None, pad=7):
+    """``planes``: (C, Z, T, H, W).  ``pad`` extra rows/cols of chunk
+    padding beyond the true size (Imaris pads to chunk multiples)."""
+    import h5py
+
+    n_c, n_z, n_t, h, w = planes.shape
+    with h5py.File(path, "w") as f:
+        info = f.create_group("DataSetInfo/Image")
+        for name, val in (("X", w), ("Y", h), ("Z", n_z)):
+            info.attrs[name] = np.frombuffer(
+                str(val).encode(), dtype="S1"
+            )
+        for c in range(n_c):
+            g = f.create_group(f"DataSetInfo/Channel {c}")
+            if channel_names:
+                g.attrs["Name"] = np.frombuffer(
+                    channel_names[c].encode(), dtype="S1"
+                )
+        for t in range(n_t):
+            for c in range(n_c):
+                padded = np.zeros((n_z, h + pad, w + pad), planes.dtype)
+                padded[:, :h, :w] = planes[c, :, t]
+                f.create_dataset(
+                    f"DataSet/ResolutionLevel 0/TimePoint {t}/"
+                    f"Channel {c}/Data",
+                    data=padded,
+                )
+
+
+@pytest.fixture
+def planes():
+    rng = np.random.default_rng(9)
+    return rng.integers(0, 60000, (2, 3, 2, 18, 22), dtype=np.uint16)
+
+
+def test_ims_reader(tmp_path, planes):
+    path = tmp_path / "s.ims"
+    write_ims(path, planes, ["DAPI", "GFP"])
+    with IMSReader(path) as r:
+        assert (r.width, r.height) == (22, 18)
+        assert (r.n_channels, r.n_zplanes, r.n_tpoints) == (2, 3, 2)
+        assert r.channel_names() == ["DAPI", "GFP"]
+        for c in range(2):
+            for z in range(3):
+                for t in range(2):
+                    np.testing.assert_array_equal(
+                        r.read_plane(z, c, t), planes[c, z, t]
+                    )
+                    np.testing.assert_array_equal(
+                        r.read_plane_linear((c * 3 + z) * 2 + t),
+                        planes[c, z, t],
+                    )
+
+
+def test_ims_uint32_clips_not_wraps(tmp_path):
+    """Imaris routinely stores uint32 Data: values past the store's
+    uint16 range must clip to 65535, not wrap (70000 -> 4464)."""
+    arr = np.zeros((1, 1, 1, 8, 8), np.uint32)
+    arr[0, 0, 0, 0, 0] = 70000
+    arr[0, 0, 0, 0, 1] = 123
+    path = tmp_path / "u32.ims"
+    write_ims(path, arr)
+    with IMSReader(path) as r:
+        plane = r.read_plane(0, 0, 0)
+        assert plane.dtype == np.uint16
+        assert plane[0, 0] == 65535 and plane[0, 1] == 123
+
+
+def test_ims_rejects_non_imaris(tmp_path):
+    import h5py
+
+    p = tmp_path / "x.ims"
+    p.write_bytes(b"not hdf5")
+    with pytest.raises(MetadataError):
+        IMSReader(p).__enter__()
+    p2 = tmp_path / "plain.ims"
+    with h5py.File(p2, "w") as f:
+        f.create_dataset("other", data=np.zeros(3))
+    with pytest.raises(MetadataError):
+        IMSReader(p2).__enter__()
+
+
+def test_ims_ingest_end_to_end(tmp_path, planes):
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_ims(src / "scan_A02.ims", planes, ["DAPI", "GFP"])
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="ims", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 3 * 2
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 1
+    assert {c.name for c in exp.channels} == {"DAPI", "GFP"}
+    assert exp.n_zplanes == 3 and exp.n_tpoints == 2
+    rows_cols = {(w.row, w.column) for p in exp.plates for w in p.wells}
+    assert rows_cols == {(0, 1)}  # A02
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+    store = ExperimentStore.open(root)
+    names = {c.name: i for i, c in enumerate(store.experiment.channels)}
+    for ch_name, c in (("DAPI", 0), ("GFP", 1)):
+        for z in range(3):
+            for t in range(2):
+                px = store.read_sites(
+                    None, channel=names[ch_name], tpoint=t, zplane=z
+                )
+                np.testing.assert_array_equal(px[0], planes[c, z, t])

@@ -1028,6 +1028,15 @@ def assign_container_wells(
     return out
 
 
+def sanitize_channel_label(names, c: int) -> str:
+    """The ONE channel-label policy for container metadata names:
+    sanitize to the ingest pattern's charset, fall back to ``C%02d``
+    when the name is absent or empty."""
+    if names and c < len(names) and names[c]:
+        return re.sub(r"[^A-Za-z0-9\-]", "-", names[c])
+    return f"C{c:02d}"
+
+
 def _container_entry(path: Path, well: tuple[int, int], site: int,
                      channel: int, zplane: int, tpoint: int,
                      page: int) -> dict:
@@ -1196,12 +1205,7 @@ def ngff_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
     bare: list[tuple] = []
 
     def channel_names(nc, labels):
-        return [
-            (re.sub(r"[^A-Za-z0-9\-]", "-", labels[c])
-             if labels and c < len(labels) and labels[c]
-             else f"C{c:02d}")
-            for c in range(nc)
-        ]
+        return [sanitize_channel_label(labels, c) for c in range(nc)]
 
     def emit(path, info, wells, plate_name):
         nf, nt, nc, nz, labels = info
@@ -1283,4 +1287,39 @@ def dv_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
     return _container_sidecar(
         source_dir, (".dv", ".r3d"), DVReader, "DV",
         lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints), entries_of,
+    )
+
+
+# ----------------------------------------------------------------------- ims
+@register_sidecar_handler("ims")
+def ims_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
+    """Bitplane Imaris ``.ims`` files, read by
+    :class:`tmlibrary_tpu.readers.IMSReader` (HDF5 layout; channel names
+    from ``DataSetInfo/Channel <c>`` when present).
+
+    Same conventions as the other container handlers: one file per well
+    (token or next free column on row A), one site per file, Z/T
+    preserved; ``page`` encodes ``(c * Z + z) * T + t``."""
+    from tmlibrary_tpu.readers import IMSReader
+
+    def entries_of(path, dims, well):
+        n_c, n_z, n_t, names = dims
+        out = []
+        for c in range(n_c):
+            label = sanitize_channel_label(names, c)
+            for z in range(n_z):
+                for t in range(n_t):
+                    e = _container_entry(
+                        path, well, site=0, channel=c, zplane=z,
+                        tpoint=t, page=(c * n_z + z) * n_t + t,
+                    )
+                    e["channel"] = label
+                    out.append(e)
+        return out
+
+    return _container_sidecar(
+        source_dir, ".ims", IMSReader, "IMS",
+        lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints,
+                   r.channel_names()),
+        entries_of,
     )
