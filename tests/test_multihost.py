@@ -67,3 +67,7 @@ def test_two_process_pipeline_over_pod_mesh():
     assert len(counts) == 2
     for shard in counts:
         assert len(shard) == 4 and all(c > 0 for c in shard), counts
+    # the 2-D spatially-sharded CC stage crossed the process boundary
+    # on both workers (seam joins + corner merge over gloo)
+    for pid, out in enumerate(outputs):
+        assert f"CC2D_OK process={pid}" in out, out[-2000:]

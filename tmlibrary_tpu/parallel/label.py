@@ -127,9 +127,13 @@ def distributed_connected_components(
         all_roots = jnp.sort(lax.all_gather(roots, axis).reshape(-1))
         rank = jnp.searchsorted(all_roots, labels.reshape(-1)).reshape(labels.shape)
         out = jnp.where(block, rank + 1, 0).astype(jnp.int32)
+        # psum/pmax results are replicated across the mesh — return them
+        # as replicated scalars, not per-shard rows: a multi-host caller
+        # can fetch a replicated array, but a sharded one spans devices
+        # it cannot address
         count = lax.psum(n_local, axis)
         overflow = lax.pmax(n_local, axis)
-        return out, count[None], overflow[None]
+        return out, count, overflow
 
     mapped = jax.shard_map(
         body,
@@ -137,19 +141,19 @@ def distributed_connected_components(
         in_specs=PartitionSpec(axis),
         out_specs=(
             PartitionSpec(axis),
-            PartitionSpec(axis),
-            PartitionSpec(axis),
+            PartitionSpec(),
+            PartitionSpec(),
         ),
     )
     sharded = jax.device_put(mask, NamedSharding(mesh, PartitionSpec(axis)))
-    labels, counts, overflow = jax.jit(mapped)(sharded)
-    max_local = int(np.max(np.asarray(overflow)))
+    labels, count, overflow = jax.jit(mapped)(sharded)
+    max_local = int(overflow)
     if max_local > k:
         raise ShardingError(
             f"a shard holds {max_local} components > "
             f"max_roots_per_shard={k}; raise the bound"
         )
-    return labels, jnp.asarray(counts)[0]
+    return labels, count
 
 
 def _edge_extend(vec_lab, vec_msk, other_axis):
@@ -300,9 +304,10 @@ def distributed_connected_components_2d(
             labels.shape
         )
         out = jnp.where(block, rank + 1, 0).astype(jnp.int32)
+        # replicated scalars (see the 1-D twin's multi-host note)
         count = lax.psum(n_local, axes)
         overflow = lax.pmax(n_local, axes)
-        return out, count[None, None], overflow[None, None]
+        return out, count, overflow
 
     mapped = jax.shard_map(
         body,
@@ -310,21 +315,21 @@ def distributed_connected_components_2d(
         in_specs=PartitionSpec(row_axis, col_axis),
         out_specs=(
             PartitionSpec(row_axis, col_axis),
-            PartitionSpec(row_axis, col_axis),
-            PartitionSpec(row_axis, col_axis),
+            PartitionSpec(),
+            PartitionSpec(),
         ),
     )
     sharded = jax.device_put(
         mask, NamedSharding(mesh, PartitionSpec(row_axis, col_axis))
     )
-    labels, counts, overflow = jax.jit(mapped)(sharded)
-    max_local = int(np.max(np.asarray(overflow)))
+    labels, count, overflow = jax.jit(mapped)(sharded)
+    max_local = int(overflow)
     if max_local > k:
         raise ShardingError(
             f"a shard holds {max_local} components > "
             f"max_roots_per_shard={k}; raise the bound"
         )
-    return labels, jnp.asarray(counts).reshape(-1)[0]
+    return labels, count
 
 
 def sharded_segment_mosaic_2d(
