@@ -244,3 +244,57 @@ def test_status_of_pre_ledger_result_dir(store_with_features):
     assert mgr.status("clustering_legacy") == {
         "request": "clustering_legacy", "state": "done"
     }
+
+
+def test_tools_on_spatial_mosaic_features(tmp_path, devices):
+    """Tools compose with the spatial layout's ragged per-well feature
+    tables (site_index -1, global labels): heatmap + k-means clustering
+    run unchanged on mosaic_cells features."""
+    import numpy as np
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.tools.base import ToolRequestManager
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "tools_sp", well_rows=1, well_cols=1, sites_per_well=(2, 2),
+        channel_names=("DAPI",), site_shape=(32, 32),
+    )
+    st = ExperimentStore.create(tmp_path / "tools_sp_exp", exp)
+    rng = np.random.default_rng(5)
+    yy, xx = np.mgrid[0:64, 0:64]
+    mosaic = rng.normal(300, 15, (64, 64))
+    # two small dim nuclei + two large bright ones -> 2 k-means clusters
+    for cy, cx, amp, s2 in [(16, 16, 5000, 4.0), (48, 16, 5000, 4.0),
+                            (16, 48, 5000, 30.0), (48, 48, 5000, 30.0)]:
+        mosaic += amp * np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * s2))
+    mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+    st.write_sites(np.stack([mosaic[:32, :32], mosaic[:32, 32:],
+                             mosaic[32:, :32], mosaic[32:, 32:]]),
+                   [0, 1, 2, 3], channel=0)
+    jt = get_step("jterator")(st)
+    jt.init({"layout": "spatial", "n_devices": 8})
+    assert jt.run(0)["objects"]["mosaic_cells"] == 4
+
+    mgr = ToolRequestManager(st)
+    heat = mgr.submit("heatmap", {"objects_name": "mosaic_cells",
+                                  "feature": "Morphology_area"})
+    assert heat.layer_type == "continuous"
+    assert len(heat.values) == 4
+    assert (heat.values["site_index"] == -1).all()  # mosaic frame
+    assert heat.attributes["max"] > heat.attributes["min"]
+
+    clus = mgr.submit("clustering", {
+        "objects_name": "mosaic_cells", "k": 2,
+        "features": ["Morphology_area", "Intensity_mean_DAPI"],
+    })
+    labels_by_obj = dict(zip(clus.values["label"], clus.values["value"]))
+    # the two big/bright objects cluster together, apart from the small
+    feats = st.read_features("mosaic_cells").sort_values("label")
+    order = np.argsort(feats["Morphology_area"].to_numpy())
+    small = [int(feats.iloc[i]["label"]) for i in order[:2]]
+    big = [int(feats.iloc[i]["label"]) for i in order[2:]]
+    assert labels_by_obj[small[0]] == labels_by_obj[small[1]]
+    assert labels_by_obj[big[0]] == labels_by_obj[big[1]]
+    assert labels_by_obj[small[0]] != labels_by_obj[big[0]]
