@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Interleaved A/B of CC pallas kernel variants on the current device.
+
+Run-to-run relay variance swamps single measurements; this interleaves
+best-of-N timings of the plain-step kernel (round-3 first version), the
+doubling run-scan kernel (current), and the XLA twin on the SAME batch in
+ONE process so they share whatever the link is doing.
+"""
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tmlibrary_tpu.benchmarks import synthetic_cell_painting_batch
+from tmlibrary_tpu.ops.pallas_kernels import (
+    BIG, CHUNK, _cc_kernel, _shift_fill, _shifts_for,
+)
+from tmlibrary_tpu.ops import label as lab
+from tmlibrary_tpu.ops import threshold as thr
+from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+BATCH = int(os.environ.get("BENCH_BATCH", "64"))
+SIZE = int(os.environ.get("BENCH_SITE_SIZE", "256"))
+REPS = int(os.environ.get("BENCH_REPS", "5"))
+
+
+def _cc_kernel_plain(mask_ref, out_ref, *, connectivity: int):
+    """The round-3 first pallas CC kernel: plain 8-neighbor min steps."""
+    h, w = out_ref.shape
+    mask = mask_ref[:] != 0
+    shifts = _shifts_for(connectivity)
+    rows = lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    labels = jnp.where(mask, rows * w + cols, BIG)
+
+    def step(labv):
+        new = labv
+        for dy, dx in shifts:
+            new = jnp.minimum(new, _shift_fill(labv, dy, dx, BIG, h, w))
+        return jnp.where(mask, new, BIG)
+
+    def body(state):
+        labv, _ = state
+        new = labv
+        for _ in range(CHUNK):
+            new = step(new)
+        return new, jnp.any(new != labv)
+
+    labels, _ = lax.while_loop(lambda s: s[1], body, (labels, jnp.bool_(True)))
+    out_ref[:] = labels
+
+
+def make(kernel):
+    @jax.jit
+    def run(masks):
+        def one(m):
+            return pl.pallas_call(
+                functools.partial(kernel, connectivity=8),
+                out_shape=jax.ShapeDtypeStruct((SIZE, SIZE), jnp.int32),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+                out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            )(m.astype(jnp.int32))
+        return jnp.sum(jax.vmap(one)(masks))
+    return run
+
+
+@jax.jit
+def run_xla(masks):
+    def one(m):
+        labels, _ = lab.connected_components(m, method="xla")
+        return jnp.sum(labels)
+    return jnp.sum(jax.vmap(one)(masks))
+
+
+def main():
+    data = synthetic_cell_painting_batch(BATCH, size=SIZE)
+    dapi = jnp.asarray(data["DAPI"])
+    smoothed = jax.jit(jax.vmap(lambda im: gaussian_smooth(im, 1.5)))(dapi)
+    masks = jax.jit(jax.vmap(thr.threshold_otsu))(smoothed)
+    masks = jax.device_put(np.asarray(masks))
+
+    import tmlibrary_tpu.ops.pallas_kernels as pk
+
+    def make_chunk(c):
+        def kern(mask_ref, out_ref, *, connectivity):
+            old = pk.CHUNK
+            pk.CHUNK = c
+            try:
+                return _cc_kernel(mask_ref, out_ref, connectivity=connectivity)
+            finally:
+                pk.CHUNK = old
+        return make(kern)
+
+    variants = {
+        "chunk16": make_chunk(16),
+        "chunk8": make_chunk(8),
+        "chunk4": make_chunk(4),
+    }
+    for name, fn in variants.items():
+        np.asarray(fn(masks))  # compile + warm
+    best = {name: float("inf") for name in variants}
+    for _ in range(REPS):
+        for name, fn in variants.items():  # interleaved
+            t0 = time.perf_counter()
+            np.asarray(fn(masks))
+            best[name] = min(best[name], time.perf_counter() - t0)
+    for name, t in best.items():
+        print(f"{name:8s} {t * 1e3:9.2f} ms   ({BATCH / t:8.1f} sites/s)")
+
+
+if __name__ == "__main__":
+    main()

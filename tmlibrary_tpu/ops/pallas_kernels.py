@@ -83,6 +83,13 @@ def _shifts_for(connectivity: int) -> list[tuple[int, int]]:
 def _cc_kernel(mask_ref, out_ref, *, connectivity: int):
     h, w = out_ref.shape
     mask = mask_ref[:] != 0
+    # plain synchronous stepping, all shifts reading the same input vector.
+    # Two alternatives MEASURED SLOWER on v5e (interleaved A/B,
+    # scripts/cc_kernel_shootout.py): log-doubling segmented run-scans
+    # (~2.2x slower — large-distance lane rolls cost more than the
+    # convergence iterations they save) and the separable 3x3 window-min
+    # decomposition (~2x slower — the row->col roll dependency chain
+    # beats the VPU's appetite for 8 independent rolls)
     shifts = _shifts_for(connectivity)
 
     rows = lax.broadcasted_iota(jnp.int32, (h, w), 0)
