@@ -58,6 +58,33 @@ CACHE_PATH = os.environ.get(
 )
 
 
+def _tuned_batch(config: str) -> "int | None":
+    """Hardware-measured best site batch for the 2-D segment+measure chain
+    (``tuning/TUNING.json`` ``best_batch``, machine-written by the
+    ``tune_tpu.py`` sweep on a live chip; the round-2 hand-seeded file is
+    rejected by the ``written_by`` gate).  None for configs the sweep
+    doesn't model — their defaults stay static."""
+    if config not in ("3", "4"):
+        return None
+    try:
+        with open(os.path.join(REPO, "tuning", "TUNING.json")) as f:
+            tuning = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if "written_by" not in tuning:
+        return None
+    best = tuning.get("best_batch")
+    if isinstance(best, (int, float)) and int(best) > 0:
+        return int(best)
+    return None
+
+
+def _default_batch(config: str) -> int:
+    if config == "volume":
+        return 16
+    return _tuned_batch(config) or 64
+
+
 # env knob -> (record field, per-config default): a cached record only
 # represents the requested workload when every knob's EFFECTIVE value
 # (env or the same default measure() would use) matches what was
@@ -66,7 +93,7 @@ CACHE_PATH = os.environ.get(
 # max_objects=256 variant) masquerade as the default headline number
 def _workload_knobs(config: str) -> dict:
     return {
-        "BENCH_BATCH": ("batch", 16 if config == "volume" else 64),
+        "BENCH_BATCH": ("batch", _default_batch(config)),
         "BENCH_MAX_OBJECTS": ("max_objects", 64),
         "BENCH_SITE_SIZE": (
             "site_size", 128 if config == "volume" else 256
@@ -149,9 +176,12 @@ def measure(platform: str) -> None:
         jax.config.update("jax_platforms", "cpu")
 
     size = int(os.environ.get("BENCH_SITE_SIZE", "256"))
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
     config = os.environ.get("BENCH_CONFIG", "3")  # BASELINE.md milestone ladder
+    # default batch comes from the machine-written hardware sweep where one
+    # exists (batch 128 beat 64 by ~14% on v5e once a healthy relay window
+    # replaced the noise-cliff measurement)
+    batch = int(os.environ.get("BENCH_BATCH") or _default_batch(config))
+    max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
 
     if config not in ("2", "3", "4", "volume", "corilla", "pyramid"):
         raise SystemExit(
