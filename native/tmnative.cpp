@@ -543,6 +543,30 @@ static int walk(const Buf& b, int32_t page, IFD& ifd) {
 
 extern "C" {
 
+// Raw TIFF-variant LZW strip decode (MSB-first codes, early width change)
+// into a caller-sized buffer.  Exported for the Python container readers
+// (Zeiss LSM strips are usually LZW) — the pure-Python bit-unpacking twin
+// is ~100x slower on megabyte strips.  Returns 1 on success, 0 on corrupt
+// input or short output.
+int32_t tm_lzw_decode(const uint8_t* src, int64_t n, uint8_t* out,
+                      int64_t expect) {
+  if (!src || !out || n < 0 || expect < 0) return 0;
+  std::vector<uint8_t> buf;
+  if (!tifflite::lzw_decode(src, (size_t)n, buf, (size_t)expect)) return 0;
+  std::memcpy(out, buf.data(), (size_t)expect);
+  return 1;
+}
+
+// PackBits strip decode, same contract as tm_lzw_decode.
+int32_t tm_packbits_decode(const uint8_t* src, int64_t n, uint8_t* out,
+                           int64_t expect) {
+  if (!src || !out || n < 0 || expect < 0) return 0;
+  std::vector<uint8_t> buf;
+  if (!tifflite::packbits_decode(src, (size_t)n, buf, (size_t)expect)) return 0;
+  std::memcpy(out, buf.data(), (size_t)expect);
+  return 1;
+}
+
 // out4: [n_pages, height, width, bits] of page 0.  Returns 0, or -1 when
 // the file is not a TIFF this reader handles.
 int32_t tm_tiff_info(const char* path, int32_t* out4) {

@@ -1,0 +1,269 @@
+"""First-party Zeiss ``.lsm`` confocal container support.
+
+``write_lsm`` below builds the real layout: alternating full-resolution /
+thumbnail IFD pairs, planar per-channel strips, the CZ_LSMINFO private
+tag (34412) carrying Z/C/T, and optional LZW strips (the common Zeiss
+setting) via a 9-bit-capped TIFF-LZW encoder.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.native import lzw_decode, _lzw_decode_py
+from tmlibrary_tpu.readers import LSMReader
+
+
+def lzw_encode(data: bytes) -> bytes:
+    """TIFF LZW, kept in 9-bit codes by clearing early (valid, just not
+    maximally compressed — decoders must honor mid-stream Clears)."""
+    codes = [256]
+    d = {bytes([i]): i for i in range(256)}
+    nxt = 258
+    w = b""
+    for byte in data:
+        wc = w + bytes([byte])
+        if wc in d:
+            w = wc
+            continue
+        codes.append(d[w])
+        d[wc] = nxt
+        nxt += 1
+        w = bytes([byte])
+        if nxt >= 509:  # stay below the 9->10 bit switch
+            codes.append(256)
+            d = {bytes([i]): i for i in range(256)}
+            nxt = 258
+    if w:
+        codes.append(d[w])
+    codes.append(257)
+    acc = nbits = 0
+    out = bytearray()
+    for c in codes:
+        acc = (acc << 9) | c
+        nbits += 9
+        while nbits >= 8:
+            nbits -= 8
+            out.append((acc >> nbits) & 0xFF)
+    if nbits:
+        out.append((acc << (8 - nbits)) & 0xFF)
+    return bytes(out)
+
+
+def _entry(tag, typ, count, value):
+    return struct.pack("<HHII", tag, typ, count, value)
+
+
+def write_lsm(path, planes, compression=1, predictor=1, thumbnails=True,
+              magic=0x00400494, declare_z=None):
+    """``planes``: (T, Z, C, H, W) uint16."""
+    n_t, n_z, n_c, h, w = planes.shape
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+
+    cz_off = len(buf)
+    buf += struct.pack(
+        "<IiiiiiI", magic, 40, w, h,
+        declare_z if declare_z is not None else n_z, n_c, n_t,
+    )
+    buf += b"\x00" * 12  # struct tail (unread)
+
+    thumb = np.zeros((2, 2), "<u2").tobytes()
+
+    def encode(plane):
+        arr = np.ascontiguousarray(plane, "<u2")
+        if predictor == 2:
+            d = arr.astype(np.int64)
+            d[:, 1:] = d[:, 1:] - d[:, :-1]
+            arr = (d % 65536).astype("<u2")
+        raw = arr.tobytes()
+        return lzw_encode(raw) if compression == 5 else raw
+
+    ifd_offs, next_pos = [], []
+
+    def emit_ifd(entries):
+        ifd_offs.append(len(buf))
+        buf.extend(struct.pack("<H", len(entries)) + b"".join(entries))
+        next_pos.append(len(buf))
+        buf.extend(b"\x00\x00\x00\x00")
+
+    first = True
+    for t in range(n_t):
+        for z in range(n_z):
+            strips = [encode(planes[t, z, c]) for c in range(n_c)]
+            offs, counts = [], []
+            for s in strips:
+                offs.append(len(buf))
+                counts.append(len(s))
+                buf.extend(s)
+            off_pos = len(buf)
+            for o in offs:
+                buf.extend(struct.pack("<I", o))
+            cnt_pos = len(buf)
+            for c in counts:
+                buf.extend(struct.pack("<I", c))
+            entries = [
+                _entry(254, 4, 1, 0),
+                _entry(256, 3, 1, w),
+                _entry(257, 3, 1, h),
+                _entry(258, 3, 1, 16),
+                _entry(259, 3, 1, compression),
+                _entry(262, 3, 1, 1),
+                _entry(273, 4, n_c, off_pos if n_c > 1 else offs[0]),
+                _entry(277, 3, 1, n_c),
+                _entry(278, 3, 1, h),
+                _entry(279, 4, n_c, cnt_pos if n_c > 1 else counts[0]),
+                _entry(284, 3, 1, 2),
+            ]
+            if predictor != 1:
+                entries.append(_entry(317, 3, 1, predictor))
+            if first:
+                entries.append(_entry(34412, 1, 40, cz_off))
+                first = False
+            entries.sort(key=lambda e: struct.unpack_from("<H", e)[0])
+            emit_ifd(entries)
+            if thumbnails:
+                toff = len(buf)
+                buf.extend(thumb)
+                emit_ifd([
+                    _entry(254, 4, 1, 1),  # reduced-resolution image
+                    _entry(256, 3, 1, 2), _entry(257, 3, 1, 2),
+                    _entry(258, 3, 1, 16), _entry(259, 3, 1, 1),
+                    _entry(273, 4, 1, toff), _entry(277, 3, 1, 1),
+                    _entry(278, 3, 1, 2), _entry(279, 4, 1, len(thumb)),
+                ])
+    struct.pack_into("<I", buf, 4, ifd_offs[0])
+    for p in range(len(ifd_offs) - 1):
+        struct.pack_into("<I", buf, next_pos[p], ifd_offs[p + 1])
+    path.write_bytes(bytes(buf))
+
+
+@pytest.fixture
+def planes():
+    rng = np.random.default_rng(13)
+    return rng.integers(0, 60000, (2, 3, 2, 10, 14), dtype=np.uint16)
+
+
+def _assert_all_planes(r, planes):
+    n_t, n_z, n_c = planes.shape[:3]
+    for t in range(n_t):
+        for z in range(n_z):
+            for c in range(n_c):
+                np.testing.assert_array_equal(
+                    r.read_plane(z, c, t), planes[t, z, c]
+                )
+                page = (c * n_z + z) * n_t + t
+                np.testing.assert_array_equal(
+                    r.read_plane_linear(page), planes[t, z, c]
+                )
+
+
+@pytest.mark.parametrize("thumbnails", [True, False])
+def test_lsm_reader_uncompressed(tmp_path, planes, thumbnails):
+    path = tmp_path / "s.lsm"
+    write_lsm(path, planes, thumbnails=thumbnails)
+    with LSMReader(path) as r:
+        assert (r.width, r.height) == (14, 10)
+        assert (r.n_channels, r.n_zplanes, r.n_tpoints) == (2, 3, 2)
+        _assert_all_planes(r, planes)
+
+
+@pytest.mark.parametrize("predictor", [1, 2])
+def test_lsm_reader_lzw(tmp_path, planes, predictor):
+    path = tmp_path / "z.lsm"
+    write_lsm(path, planes, compression=5, predictor=predictor)
+    with LSMReader(path) as r:
+        _assert_all_planes(r, planes)
+
+
+def test_lzw_native_and_python_agree(planes):
+    raw = planes.tobytes()[:5000]
+    enc = lzw_encode(raw)
+    assert lzw_decode(enc, len(raw)) == raw
+    assert _lzw_decode_py(enc, len(raw)) == raw
+    # corrupt stream: out-of-range code -> None, not garbage
+    assert lzw_decode(b"\xff\xff\xff\xff", 100) in (None,)
+
+
+def test_lsm_rejects_bad_files(tmp_path, planes):
+    p = tmp_path / "bad.lsm"
+    p.write_bytes(b"MM\x00\x2b" + b"\x00" * 64)  # BigTIFF marker
+    with pytest.raises(MetadataError):
+        LSMReader(p).__enter__()
+    nomagic = tmp_path / "nomagic.lsm"
+    write_lsm(nomagic, planes, magic=0xDEAD)
+    with pytest.raises(MetadataError):
+        LSMReader(nomagic).__enter__()
+    # plain TIFF without CZ_LSMINFO must be rejected, not misread
+    from tests.test_stk import write_stk
+    plain = tmp_path / "plain.lsm"
+    write_stk(plain, planes[0, :, 0], paged=True)
+    with pytest.raises(MetadataError):
+        LSMReader(plain).__enter__()
+    mismatch = tmp_path / "mismatch.lsm"
+    write_lsm(mismatch, planes, declare_z=7)
+    with pytest.raises(MetadataError):
+        LSMReader(mismatch).__enter__()
+
+
+def test_lsm_ingest_end_to_end(tmp_path):
+    """Per-well .lsm stacks -> metaconfig (auto) -> imextract ->
+    bit-identical planes in the canonical store, C/Z/T preserved."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(17)
+    src = tmp_path / "source"
+    src.mkdir()
+    data = {}
+    for well in ("A01", "B02"):
+        stack = rng.integers(0, 60000, (2, 3, 2, 10, 14), dtype=np.uint16)
+        write_lsm(src / f"scan_{well}.lsm", stack, compression=5)
+        data[well] = stack
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="lsmtest", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 2 * 3 * 2  # wells x C x Z x T
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 2
+    assert exp.n_zplanes == 3 and exp.n_tpoints == 2
+    assert {c.name for c in exp.channels} == {"C00", "C01"}
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store = ExperimentStore.open(root)
+    for c in range(2):
+        for z in range(3):
+            for t in range(2):
+                px = store.read_sites(None, channel=c, tpoint=t, zplane=z)
+                np.testing.assert_array_equal(px[0], data["A01"][t, z, c])
+                np.testing.assert_array_equal(px[1], data["B02"][t, z, c])
+
+
+def test_decoder_fallbacks_truncate_to_expect(planes):
+    """Python fallback decoders must return EXACTLY expect bytes even when
+    the final LZW entry / PackBits run crosses the boundary (the native
+    path memcpy-truncates; the reshape downstream needs exact sizes)."""
+    from tmlibrary_tpu.native import _packbits_decode_py
+
+    raw = b"ABABABAB" * 40  # repetitive -> multi-byte LZW entries
+    enc = lzw_encode(raw)
+    for cut in (1, 3, 5, 17):
+        out = _lzw_decode_py(enc, len(raw) - cut)
+        assert out is not None and len(out) == len(raw) - cut
+        assert out == raw[:len(raw) - cut]
+    # literal 8 bytes + replicate run of 100 X's (control −99 → 157);
+    # asking for 10 makes the replicate run cross the expect boundary
+    pb = bytes([7]) + b"ABCDEFGH" + bytes([157]) + b"X"
+    out = _packbits_decode_py(pb, 10)
+    assert out == b"ABCDEFGHXX"

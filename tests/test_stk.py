@@ -1,0 +1,260 @@
+"""First-party MetaMorph ``.stk`` container support.
+
+An STK file is a classic TIFF whose first IFD describes plane 0 while the
+remaining Z planes follow contiguously in the pixel data; the plane count
+lives in the UIC2 private tag's COUNT field (33629).  ``write_stk`` below
+builds both layouts: the canonical single-IFD stack and the per-plane
+paged variant some writers emit.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+from tmlibrary_tpu.readers import ImageReader, STKReader
+
+
+def _entry(tag, typ, count, value):
+    return struct.pack("<HHII", tag, typ, count, value)
+
+
+def write_stk(path, planes, paged=False, declare_planes=None, bits=16):
+    """``planes``: (Z, H, W) uint16 (or uint8 with ``bits=8``)."""
+    n_z, h, w = planes.shape
+    dtype = "<u2" if bits == 16 else "<u1"
+    data = b"".join(np.ascontiguousarray(p, dtype).tobytes() for p in planes)
+    plane_bytes = h * w * (bits // 8)
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+    if not paged:
+        data_off = len(buf)
+        buf += data
+        uic_off = len(buf)
+        n_uic = declare_planes if declare_planes is not None else n_z
+        buf += b"\x00" * (8 * n_uic)  # UIC2 RATIONALs (values unused)
+        entries = [
+            _entry(256, 3, 1, w),
+            _entry(257, 3, 1, h),
+            _entry(258, 3, 1, bits),
+            _entry(259, 3, 1, 1),
+            _entry(262, 3, 1, 1),
+            _entry(273, 4, 1, data_off),
+            _entry(277, 3, 1, 1),
+            _entry(278, 3, 1, h),
+            _entry(279, 4, 1, plane_bytes),
+            _entry(33629, 5, n_uic, uic_off),  # UIC2: count = n planes
+        ]
+        ifd_off = len(buf)
+        buf += struct.pack("<H", len(entries)) + b"".join(entries)
+        buf += b"\x00\x00\x00\x00"
+        struct.pack_into("<I", buf, 4, ifd_off)
+    else:
+        offs = []
+        for p in range(n_z):
+            offs.append(len(buf))
+            buf += data[p * plane_bytes:(p + 1) * plane_bytes]
+        ifd_offs, next_pos = [], []
+        for p in range(n_z):
+            entries = [
+                _entry(256, 3, 1, w),
+                _entry(257, 3, 1, h),
+                _entry(258, 3, 1, bits),
+                _entry(259, 3, 1, 1),
+                _entry(273, 4, 1, offs[p]),
+                _entry(277, 3, 1, 1),
+                _entry(278, 3, 1, h),
+                _entry(279, 4, 1, plane_bytes),
+            ]
+            ifd_offs.append(len(buf))
+            buf += struct.pack("<H", len(entries)) + b"".join(entries)
+            next_pos.append(len(buf))
+            buf += b"\x00\x00\x00\x00"
+        struct.pack_into("<I", buf, 4, ifd_offs[0])
+        for p in range(n_z - 1):
+            struct.pack_into("<I", buf, next_pos[p], ifd_offs[p + 1])
+    path.write_bytes(bytes(buf))
+
+
+@pytest.fixture
+def planes():
+    rng = np.random.default_rng(5)
+    return rng.integers(0, 60000, (4, 12, 18), dtype=np.uint16)
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_stk_reader_both_layouts(tmp_path, planes, paged):
+    path = tmp_path / "s.stk"
+    write_stk(path, planes, paged=paged)
+    with STKReader(path) as r:
+        assert (r.width, r.height) == (18, 12)
+        assert (r.n_zplanes, r.n_channels, r.n_tpoints) == (4, 1, 1)
+        for z in range(4):
+            np.testing.assert_array_equal(r.read_plane(z), planes[z])
+            np.testing.assert_array_equal(r.read_plane_linear(z), planes[z])
+
+
+def test_stk_8bit(tmp_path):
+    rng = np.random.default_rng(9)
+    p8 = rng.integers(0, 255, (2, 6, 8), dtype=np.uint8)
+    path = tmp_path / "e.stk"
+    write_stk(path, p8, bits=8)
+    with STKReader(path) as r:
+        out = r.read_plane(1)
+        assert out.dtype == np.uint8
+        np.testing.assert_array_equal(out, p8[1])
+
+
+def test_stk_through_image_reader(tmp_path, planes):
+    """ImageReader routes .stk through the container reader, so the
+    metamorph handler's per-plane ``page`` indices reach planes past 0 —
+    the paged-TIFF/cv2 path could only ever see plane 0 of a canonical
+    single-IFD stack."""
+    path = tmp_path / "s.stk"
+    write_stk(path, planes)
+    with ImageReader(path) as r:
+        for z in range(4):
+            np.testing.assert_array_equal(r.read(page=z), planes[z])
+
+
+def test_stk_rejects_bad_files(tmp_path, planes):
+    bad = tmp_path / "bad.stk"
+    bad.write_bytes(b"not a tiff at all")
+    with pytest.raises(MetadataError):
+        STKReader(bad).__enter__()
+    trunc = tmp_path / "trunc.stk"
+    write_stk(trunc, planes, declare_planes=9)  # claims more than present
+    with pytest.raises(MetadataError):
+        STKReader(trunc).__enter__()
+    path = tmp_path / "s.stk"
+    write_stk(path, planes)
+    with STKReader(path) as r:
+        with pytest.raises(MetadataError):
+            r.read_plane(4)
+
+
+def test_stk_rgb_rejected(tmp_path):
+    # SamplesPerPixel != 1 is out of scope: gate, don't misread
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+    data_off = len(buf)
+    buf += b"\x00" * 12
+    entries = [
+        _entry(256, 3, 1, 2), _entry(257, 3, 1, 2), _entry(258, 3, 1, 8),
+        _entry(259, 3, 1, 1), _entry(273, 4, 1, data_off),
+        _entry(277, 3, 1, 3), _entry(278, 3, 1, 2), _entry(279, 4, 1, 12),
+        _entry(33629, 5, 1, 0),
+    ]
+    ifd_off = len(buf)
+    buf += struct.pack("<H", len(entries)) + b"".join(entries)
+    buf += b"\x00\x00\x00\x00"
+    struct.pack_into("<I", buf, 4, ifd_off)
+    p = tmp_path / "rgb.stk"
+    p.write_bytes(bytes(buf))
+    with pytest.raises(NotSupportedError):
+        STKReader(p).__enter__()
+
+
+def test_stk_ingest_end_to_end(tmp_path):
+    """Per-well standalone .stk stacks -> metaconfig (auto) -> imextract
+    -> bit-identical planes in the canonical store with Z preserved."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(11)
+    src = tmp_path / "source"
+    src.mkdir()
+    data = {}
+    for well in ("A01", "B02"):
+        stack = rng.integers(0, 60000, (3, 12, 18), dtype=np.uint16)
+        write_stk(src / f"exp_{well}.stk", stack)
+        data[well] = stack
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="stktest", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 3  # wells x Z
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 2  # one per well
+    assert exp.n_zplanes == 3
+    rows_cols = {(w.row, w.column) for p in exp.plates for w in p.wells}
+    assert rows_cols == {(0, 0), (1, 1)}
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store = ExperimentStore.open(root)
+    for z in range(3):
+        px = store.read_sites(None, channel=0, zplane=z)
+        np.testing.assert_array_equal(px[0], data["A01"][z])
+        np.testing.assert_array_equal(px[1], data["B02"][z])
+
+
+def test_stk_handler_defers_to_metamorph_nd(tmp_path, planes):
+    """A .nd sidecar in the tree means the metamorph handler owns the
+    stacks; the standalone stk handler must stand down."""
+    from tmlibrary_tpu.workflow.steps.vendors import stk_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_stk(src / "exp_A01.stk", planes)
+    assert stk_sidecar(src) is not None
+    (src / "exp.nd").write_text('"NDInfoFile", Version 1.0\n')
+    assert stk_sidecar(src) is None
+
+
+def test_stk_handler_skips_unsupported_not_just_unreadable(tmp_path, planes):
+    """A NotSupportedError file (RGB .stk) must be SKIPPED like an
+    unreadable one — one odd file must not abort the whole ingest."""
+    from tmlibrary_tpu.workflow.steps.vendors import stk_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_stk(src / "ok_A01.stk", planes)
+    # RGB stk (SamplesPerPixel=3) -> NotSupportedError from the reader
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+    data_off = len(buf)
+    buf += b"\x00" * 12
+    entries = [
+        _entry(256, 3, 1, 2), _entry(257, 3, 1, 2), _entry(258, 3, 1, 8),
+        _entry(259, 3, 1, 1), _entry(273, 4, 1, data_off),
+        _entry(277, 3, 1, 3), _entry(278, 3, 1, 2), _entry(279, 4, 1, 12),
+        _entry(33629, 5, 1, 0),
+    ]
+    ifd_off = len(buf)
+    buf += struct.pack("<H", len(entries)) + b"".join(entries)
+    buf += b"\x00\x00\x00\x00"
+    struct.pack_into("<I", buf, 4, ifd_off)
+    (src / "rgb_B01.stk").write_bytes(bytes(buf))
+    entries_out, skipped = stk_sidecar(src)
+    assert skipped == 1
+    assert len(entries_out) == 4  # the good stack's Z planes
+
+
+def test_stk_tiled_tiff_rejected_cleanly(tmp_path):
+    """A tiled TIFF (TileOffsets, no StripOffsets) renamed .stk must raise
+    MetadataError — not KeyError — and must not leak the mmap."""
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+    data_off = len(buf)
+    buf += b"\x00" * 128
+    entries = [
+        _entry(256, 3, 1, 8), _entry(257, 3, 1, 8), _entry(258, 3, 1, 16),
+        _entry(259, 3, 1, 1), _entry(277, 3, 1, 1),
+        _entry(322, 3, 1, 8), _entry(323, 3, 1, 8),    # tile width/length
+        _entry(324, 4, 1, data_off), _entry(325, 4, 1, 128),  # tile offs
+        _entry(33629, 5, 1, 0),
+    ]
+    ifd_off = len(buf)
+    buf += struct.pack("<H", len(entries)) + b"".join(entries)
+    buf += b"\x00\x00\x00\x00"
+    struct.pack_into("<I", buf, 4, ifd_off)
+    p = tmp_path / "tiled.stk"
+    p.write_bytes(bytes(buf))
+    with pytest.raises(MetadataError):
+        STKReader(p).__enter__()

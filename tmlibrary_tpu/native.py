@@ -118,6 +118,16 @@ def _load_locked():
     except AttributeError:
         logger.info("native library predates the TIFF reader; rebuild native/")
     try:
+        for name in ("tm_lzw_decode", "tm_packbits_decode"):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.c_int32
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+            ]
+    except AttributeError:
+        logger.info("native library predates strip decoders; rebuild native/")
+    try:
         lib.tm_fill_holes.restype = ctypes.c_int32
         lib.tm_fill_holes.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
@@ -351,6 +361,113 @@ def tiff_read(path, page: int, height: int, width: int) -> np.ndarray | None:
         int(height), int(width),
     )
     return out if rc == 0 else None
+
+
+def _lzw_decode_py(src: bytes, expect: int) -> bytes | None:
+    """Pure-Python TIFF LZW (MSB-first codes, 256=Clear, 257=EOI, early
+    code-width change) — fallback twin of ``tm_lzw_decode``.  The bit
+    reader is a small sliding accumulator fed byte-by-byte (O(n); a
+    whole-strip bigint would make every shift O(strip size))."""
+    table: list[bytes] = []
+
+    def reset():
+        table.clear()
+        table.extend(bytes([i]) for i in range(256))
+        table.extend((b"", b""))  # 256 Clear, 257 EOI
+
+    reset()
+    out = bytearray()
+    width = 9
+    prev: bytes | None = None
+    acc = nbits = 0
+    pos = 0
+    n = len(src)
+    while len(out) < expect:
+        while nbits < width and pos < n:
+            acc = (acc << 8) | src[pos]
+            pos += 1
+            nbits += 8
+        if nbits < width:
+            break
+        nbits -= width
+        code = (acc >> nbits) & ((1 << width) - 1)
+        acc &= (1 << nbits) - 1
+        if code == 257:
+            break
+        if code == 256:
+            reset()
+            width = 9
+            prev = None
+            continue
+        if code < len(table) and code != 256 and code != 257:
+            entry = table[code]
+        elif code == len(table) and prev is not None:
+            entry = prev + prev[:1]
+        else:
+            return None  # corrupt stream
+        out += entry
+        if prev is not None:
+            table.append(prev + entry[:1])
+        if len(table) + 1 >= (1 << width) and width < 12:
+            width += 1
+        prev = entry
+    # the final entry can overrun expect; the native path truncates too
+    return bytes(out[:expect]) if len(out) >= expect else None
+
+
+def _packbits_decode_py(src: bytes, expect: int) -> bytes | None:
+    out = bytearray()
+    i = 0
+    n = len(src)
+    while i < n and len(out) < expect:
+        c = src[i]
+        i += 1
+        if c < 128:
+            cnt = c + 1
+            if i + cnt > n:
+                return None
+            out += src[i:i + cnt]
+            i += cnt
+        elif c != 128:
+            if i >= n:
+                return None
+            out += bytes([src[i]]) * (257 - c)
+            i += 1
+    # a literal/replicate run can cross the expect boundary; truncate like
+    # the native path
+    return bytes(out[:expect]) if len(out) >= expect else None
+
+
+def lzw_decode(src: bytes, expect: int) -> bytes | None:
+    """Decode a TIFF LZW strip to exactly ``expect`` bytes (None on corrupt
+    input).  Native fast path, pure-Python fallback — used by the Python
+    container readers (Zeiss LSM) whose strip layout the C++ page reader
+    does not model."""
+    lib = _load()
+    if lib is not None and hasattr(lib, "tm_lzw_decode"):
+        buf = np.frombuffer(src, np.uint8)
+        out = np.empty(expect, np.uint8)
+        rc = lib.tm_lzw_decode(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(src),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), expect,
+        )
+        return out.tobytes() if rc == 1 else None
+    return _lzw_decode_py(src, expect)
+
+
+def packbits_decode(src: bytes, expect: int) -> bytes | None:
+    """Decode a PackBits strip to exactly ``expect`` bytes (None on corrupt
+    input); native fast path with pure-Python fallback."""
+    lib = _load()
+    if lib is not None and hasattr(lib, "tm_packbits_decode"):
+        buf = np.frombuffer(src, np.uint8)
+        out = np.empty(expect, np.uint8)
+        rc = lib.tm_packbits_decode(
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), len(src),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), expect,
+        )
+        return out.tobytes() if rc == 1 else None
+    return _packbits_decode_py(src, expect)
 
 
 def _simplify_numpy(contour: np.ndarray, tolerance: float) -> np.ndarray:

@@ -50,6 +50,10 @@ def _container_reader(path):
         return DVReader
     if name.endswith(".ims"):
         return IMSReader
+    if name.endswith(".stk"):
+        return STKReader
+    if name.endswith(".lsm"):
+        return LSMReader
     if name.endswith(".zarr"):  # OME-NGFF plate directory (covers .ome.zarr)
         from tmlibrary_tpu.ngff import NGFFReader
 
@@ -1214,6 +1218,454 @@ class IMSReader(Reader):
         if plane.dtype.kind in "iu":
             return np.clip(plane, 0, 65535).astype(np.uint16)
         return plane.astype(np.float32)
+
+    def read_plane_linear(self, page: int) -> np.ndarray:
+        ct, t = divmod(page, self.n_tpoints)
+        c, z = divmod(ct, self.n_zplanes)
+        return self.read_plane(z, c, t)
+
+
+# --------------------------------------------------- TIFF-variant containers
+#: TIFF value-type sizes (BYTE, ASCII, SHORT, LONG, RATIONAL, signed/float)
+_TIFF_TYPE_SIZE = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
+                   10: 8, 11: 4, 12: 8}
+
+
+def _tiff_parse(buf) -> tuple[str, list[dict]]:
+    """Minimal classic-TIFF IFD walk over an in-memory buffer.
+
+    Returns ``(byteorder, ifds)`` where each IFD is ``{tag: (type, count,
+    value_field_offset)}``.  Shared by the STK and LSM container readers —
+    their plane layouts (all-planes-in-one-IFD; per-channel strips +
+    thumbnail IFDs) don't fit the native page reader's model, so they
+    need the raw tag table, not decoded pages.
+    """
+    import struct
+
+    from tmlibrary_tpu.errors import MetadataError
+
+    bo = {b"II": "<", b"MM": ">"}.get(bytes(buf[0:2]))
+    if bo is None or len(buf) < 8:
+        raise MetadataError("not a TIFF (bad byte-order mark)")
+    (magic,) = struct.unpack_from(bo + "H", buf, 2)
+    if magic != 42:
+        raise MetadataError(f"not a classic TIFF (magic {magic}; BigTIFF "
+                            "is not supported by the container readers)")
+    ifds: list[dict] = []
+    (off,) = struct.unpack_from(bo + "I", buf, 4)
+    seen: set = set()
+    while off and off not in seen and len(ifds) < 65535:
+        seen.add(off)
+        if off + 2 > len(buf):
+            break
+        (n,) = struct.unpack_from(bo + "H", buf, off)
+        p = off + 2
+        if p + 12 * n + 4 > len(buf):
+            break
+        entries: dict = {}
+        for _ in range(n):
+            tag, typ, cnt = struct.unpack_from(bo + "HHI", buf, p)
+            entries[tag] = (typ, cnt, p + 8)
+            p += 12
+        ifds.append(entries)
+        (off,) = struct.unpack_from(bo + "I", buf, p)
+    if not ifds:
+        raise MetadataError("TIFF contains no parseable IFD")
+    return bo, ifds
+
+
+def _tiff_value_offset(bo: str, buf, entry) -> int:
+    """Offset of an entry's value data (inline when it fits in 4 bytes)."""
+    import struct
+
+    typ, cnt, voff = entry
+    total = _TIFF_TYPE_SIZE.get(typ, 1) * cnt
+    if total <= 4:
+        return voff
+    (off,) = struct.unpack_from(bo + "I", buf, voff)
+    return off
+
+
+def _tiff_ints(bo: str, buf, entry, limit: "int | None" = None) -> list[int]:
+    """Integer values of a BYTE/SHORT/LONG entry."""
+    import struct
+
+    typ, cnt, _ = entry
+    fmt = {1: "B", 3: "H", 4: "I"}.get(typ)
+    if fmt is None:
+        return []
+    if limit is not None:
+        cnt = min(cnt, limit)
+    base = _tiff_value_offset(bo, buf, entry)
+    return list(struct.unpack_from(f"{bo}{cnt}{fmt}", buf, base))
+
+
+def _tiff_int(bo: str, buf, ifd: dict, tag: int, default: int) -> int:
+    entry = ifd.get(tag)
+    if entry is None:
+        return default
+    vals = _tiff_ints(bo, buf, entry, limit=1)
+    return vals[0] if vals else default
+
+
+def _tiff_strips(bo: str, buf, ifd: dict, filename) -> tuple[list, list]:
+    """StripOffsets/StripByteCounts of an IFD, as MetadataError on any
+    structural problem (tiled TIFFs have neither tag; corrupt offsets make
+    struct.unpack_from throw) — ingest must skip such files, not crash."""
+    import struct
+
+    from tmlibrary_tpu.errors import MetadataError
+
+    try:
+        offs = _tiff_ints(bo, buf, ifd[273])
+        counts = _tiff_ints(bo, buf, ifd[279])
+    except KeyError as exc:
+        raise MetadataError(
+            f"TIFF IFD without strip tags (tiled or corrupt): {filename}"
+        ) from exc
+    except struct.error as exc:
+        raise MetadataError(f"corrupt TIFF tag data in {filename}") from exc
+    if not offs or len(offs) != len(counts):
+        raise MetadataError(f"corrupt TIFF strip layout in {filename}")
+    return offs, counts
+
+
+def _decode_strip(chunk: bytes, compression: int, expect: int,
+                  filename) -> bytes:
+    """One TIFF strip -> exactly ``expect`` decoded bytes."""
+    from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+    if compression == 1:
+        if len(chunk) < expect:
+            raise MetadataError(f"truncated strip in {filename}")
+        return chunk[:expect]
+    if compression == 5:
+        from tmlibrary_tpu.native import lzw_decode
+
+        out = lzw_decode(chunk, expect)
+    elif compression == 32773:
+        from tmlibrary_tpu.native import packbits_decode
+
+        out = packbits_decode(chunk, expect)
+    else:
+        raise NotSupportedError(
+            f"unsupported TIFF compression {compression} in {filename}"
+        )
+    if out is None:
+        raise MetadataError(f"corrupt compressed strip in {filename}")
+    return out
+
+
+def _apply_predictor(plane: np.ndarray, predictor: int) -> np.ndarray:
+    """TIFF predictor 2 (horizontal differencing): cumulative sum along
+    rows with the sample width's natural wraparound."""
+    if predictor == 2:
+        return np.cumsum(plane.astype(np.uint32), axis=1).astype(plane.dtype)
+    return plane
+
+
+class STKReader(Reader):
+    """First-party reader for MetaMorph ``.stk`` stacks.
+
+    Sixth entry in the Bio-Formats-gap program (SURVEY.md §3 Readers
+    row).  An STK file is a classic TIFF whose FIRST IFD describes plane
+    0 while the remaining planes of the Z-series follow contiguously in
+    the pixel data — the plane count lives in the UIC2 private tag's
+    ``count`` field (tag 33629), NOT in the IFD chain, so a plain paged
+    TIFF reader sees one page and silently drops the rest of the stack
+    (exactly what the cv2 fallback used to do for the metamorph
+    handler's ``page`` indices).  Some writers emit per-plane IFDs
+    instead; both layouts are handled.
+
+    Linear page convention (shared with the metamorph handler and the
+    ``stk`` container handler): ``page = z``.
+    """
+
+    _UIC2 = 33629
+
+    def __enter__(self):
+        import mmap
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+        # mmap, not read_bytes(): imextract's thread pool opens one reader
+        # per plane, and multi-GB stacks would be read N times over
+        self._file = open(self.filename, "rb")
+        try:
+            self._data = mmap.mmap(self._file.fileno(), 0,
+                                   access=mmap.ACCESS_READ)
+        except ValueError as exc:
+            self._file.close()
+            raise MetadataError(f"empty STK file: {self.filename}") from exc
+        try:
+            bo, ifds = _tiff_parse(self._data)
+            self._parse_stk(bo, ifds)
+        except (MetadataError, NotSupportedError):
+            self.__exit__()
+            raise
+        except (KeyError, IndexError, struct.error) as exc:
+            self.__exit__()
+            raise MetadataError(
+                f"corrupt STK structure in {self.filename}: {exc}"
+            ) from exc
+        return self
+
+    def _parse_stk(self, bo: str, ifds: list) -> None:
+        from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+        self._bo = bo
+        buf = self._data
+        first = ifds[0]
+        self.width = _tiff_int(bo, buf, first, 256, 0)
+        self.height = _tiff_int(bo, buf, first, 257, 0)
+        bits = _tiff_int(bo, buf, first, 258, 8)
+        self._compression = _tiff_int(bo, buf, first, 259, 1)
+        self._predictor = _tiff_int(bo, buf, first, 317, 1)
+        samples = _tiff_int(bo, buf, first, 277, 1)
+        if self.width <= 0 or self.height <= 0:
+            raise MetadataError(f"corrupt STK dimensions in {self.filename}")
+        if bits not in (8, 16) or samples != 1:
+            raise NotSupportedError(
+                f"STK reader handles 8/16-bit grayscale, got {bits}-bit "
+                f"x{samples} in {self.filename}"
+            )
+        self._dtype = np.dtype(bo + ("u1" if bits == 8 else "u2"))
+        uic2 = first.get(self._UIC2)
+        n_uic = uic2[1] if uic2 else 0
+        if len(ifds) == 1 and n_uic >= 1:
+            # canonical STK: one IFD, planes appended after plane 0's data
+            if self._compression != 1:
+                raise NotSupportedError(
+                    f"compressed single-IFD STK is not supported "
+                    f"({self.filename}): plane offsets are only defined "
+                    "for contiguous uncompressed planes"
+                )
+            self.n_zplanes = n_uic
+            self._layout = "contiguous"
+            offs, counts = _tiff_strips(bo, buf, first, self.filename)
+            self._strip_offsets = offs
+            self._strip_counts = counts
+            self._plane_bytes = self.width * self.height * self._dtype.itemsize
+            if sum(counts) < self._plane_bytes:
+                raise MetadataError(f"truncated STK plane 0 in {self.filename}")
+            end = offs[-1] + counts[-1] + (self.n_zplanes - 1) * self._plane_bytes
+            size = len(buf)
+            if end > size:
+                raise MetadataError(
+                    f"truncated STK stack {self.filename}: {size} bytes "
+                    f"< {end} expected for {self.n_zplanes} planes"
+                )
+        else:
+            # per-plane IFDs (paged variant some writers emit)
+            self.n_zplanes = len(ifds)
+            self._layout = "paged"
+            self._ifds = ifds
+        self.n_channels = 1
+        self.n_tpoints = 1
+
+    def __exit__(self, *exc):
+        if getattr(self, "_data", None) is not None:
+            self._data.close()
+            self._data = None
+        if getattr(self, "_file", None) is not None:
+            self._file.close()
+            self._file = None
+        return False
+
+    def _read_ifd_plane(self, ifd: dict) -> np.ndarray:
+        bo, buf = self._bo, self._data
+        offs, counts = _tiff_strips(bo, buf, ifd, self.filename)
+        rows_per_strip = _tiff_int(bo, buf, ifd, 278, self.height)
+        compression = _tiff_int(bo, buf, ifd, 259, 1)
+        predictor = _tiff_int(bo, buf, ifd, 317, 1)
+        row_bytes = self.width * self._dtype.itemsize
+        raw = bytearray()
+        rows_left = self.height
+        for off, cnt in zip(offs, counts):
+            rows = min(rows_per_strip, rows_left)
+            raw += _decode_strip(bytes(buf[off:off + cnt]), compression,
+                                 rows * row_bytes, self.filename)
+            rows_left -= rows
+        plane = np.frombuffer(bytes(raw), self._dtype).reshape(
+            self.height, self.width
+        )
+        return _apply_predictor(plane, predictor)
+
+    def read_plane(self, z: int) -> np.ndarray:
+        from tmlibrary_tpu.errors import MetadataError
+
+        if not 0 <= z < self.n_zplanes:
+            raise MetadataError(
+                f"plane {z} out of range for {self.filename}: "
+                f"Z={self.n_zplanes}"
+            )
+        if self._layout == "paged":
+            return self._read_ifd_plane(self._ifds[z])
+        shift = z * self._plane_bytes
+        raw = bytearray()
+        need = self._plane_bytes
+        for off, cnt in zip(self._strip_offsets, self._strip_counts):
+            take = min(cnt, need - len(raw))
+            base = off + shift
+            raw += self._data[base:base + take]
+            if len(raw) >= need:
+                break
+        plane = np.frombuffer(bytes(raw), self._dtype).reshape(
+            self.height, self.width
+        )
+        return _apply_predictor(plane, self._predictor)
+
+    def read_plane_linear(self, page: int) -> np.ndarray:
+        return self.read_plane(page)
+
+
+class LSMReader(Reader):
+    """First-party reader for Zeiss LSM 510/710 confocal stacks.
+
+    Seventh entry in the Bio-Formats-gap program.  An ``.lsm`` file is a
+    classic TIFF in which every full-resolution plane IFD is followed by
+    a thumbnail IFD (``NewSubfileType`` = 1, skipped here), channels are
+    stored planar (``PlanarConfiguration`` = 2) as one strip per channel
+    inside each plane IFD, and the acquisition dimensions live in the
+    private CZ_LSMINFO tag (34412: DimensionZ / Channels / Time at byte
+    offsets 16/20/24 of the struct).  Full-resolution IFDs are ordered Z
+    fastest, then T — cross-checked against ``Z * T`` at open.
+
+    Linear page convention (shared with the ``lsm`` metaconfig handler,
+    same as DV/IMS): ``page = (c * Z + z) * T + t``.
+    """
+
+    _CZ_LSMINFO = 34412
+    #: CZ_LSMINFO magic numbers (LSM 5 / LSM 7 series)
+    _MAGIC = (0x00300494, 0x00400494)
+
+    def __enter__(self):
+        import mmap
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+        self._file = open(self.filename, "rb")
+        try:
+            self._data = mmap.mmap(self._file.fileno(), 0,
+                                   access=mmap.ACCESS_READ)
+        except ValueError as exc:
+            self._file.close()
+            raise MetadataError(f"empty LSM file: {self.filename}") from exc
+        try:
+            bo, ifds = _tiff_parse(self._data)
+            self._parse_lsm(bo, ifds)
+        except (MetadataError, NotSupportedError):
+            self.__exit__()
+            raise
+        except (KeyError, IndexError, struct.error) as exc:
+            self.__exit__()
+            raise MetadataError(
+                f"corrupt LSM structure in {self.filename}: {exc}"
+            ) from exc
+        return self
+
+    def _parse_lsm(self, bo: str, ifds: list) -> None:
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+        buf = self._data
+        self._bo = bo
+        full = [
+            ifd for ifd in ifds if _tiff_int(bo, buf, ifd, 254, 0) == 0
+        ]
+        if not full:
+            raise MetadataError(f"no full-resolution IFDs in {self.filename}")
+        info = ifds[0].get(self._CZ_LSMINFO)
+        if info is None:
+            raise MetadataError(
+                f"not an LSM file (no CZ_LSMINFO tag): {self.filename}"
+            )
+        info_off = _tiff_value_offset(bo, buf, info)
+        # the CZ_LSMINFO struct is always little-endian (as is every real
+        # LSM file; the tag layout predates any big-endian writer)
+        magic, _size, _x, _y, dim_z, dim_c, dim_t = struct.unpack_from(
+            "<IiiiiiI", buf, info_off
+        )
+        if magic not in self._MAGIC:
+            raise MetadataError(
+                f"bad CZ_LSMINFO magic 0x{magic:08x} in {self.filename}"
+            )
+        first = full[0]
+        self.width = _tiff_int(bo, buf, first, 256, 0)
+        self.height = _tiff_int(bo, buf, first, 257, 0)
+        bits = _tiff_int(bo, buf, first, 258, 8)
+        samples = _tiff_int(bo, buf, first, 277, 1)
+        planar = _tiff_int(bo, buf, first, 284, 1)
+        if self.width <= 0 or self.height <= 0:
+            raise MetadataError(f"corrupt LSM dimensions in {self.filename}")
+        if bits not in (8, 16):
+            raise NotSupportedError(
+                f"LSM reader handles 8/16-bit data, got {bits}-bit "
+                f"in {self.filename}"
+            )
+        if samples > 1 and planar != 2:
+            raise NotSupportedError(
+                f"interleaved (chunky) multi-channel LSM is not supported "
+                f"in {self.filename}"
+            )
+        self.n_channels = max(dim_c, 1)
+        if samples != self.n_channels:
+            raise MetadataError(
+                f"LSM channel mismatch in {self.filename}: CZ_LSMINFO says "
+                f"{self.n_channels}, IFD SamplesPerPixel says {samples}"
+            )
+        self.n_zplanes = max(dim_z, 1)
+        self.n_tpoints = max(dim_t, 1)
+        if len(full) != self.n_zplanes * self.n_tpoints:
+            raise MetadataError(
+                f"LSM plane-count mismatch in {self.filename}: "
+                f"{len(full)} full-resolution IFDs != Z {self.n_zplanes} "
+                f"x T {self.n_tpoints}"
+            )
+        self._dtype = np.dtype(bo + ("u1" if bits == 8 else "u2"))
+        self._full = full
+
+    def __exit__(self, *exc):
+        if getattr(self, "_data", None) is not None:
+            self._data.close()
+            self._data = None
+        if getattr(self, "_file", None) is not None:
+            self._file.close()
+            self._file = None
+        return False
+
+    def read_plane(self, z: int, c: int, t: int) -> np.ndarray:
+        from tmlibrary_tpu.errors import MetadataError
+
+        for name, val, n in (("zplane", z, self.n_zplanes),
+                             ("channel", c, self.n_channels),
+                             ("tpoint", t, self.n_tpoints)):
+            if not 0 <= val < n:
+                raise MetadataError(
+                    f"{name} {val} out of range for {self.filename} "
+                    f"(Z={self.n_zplanes} C={self.n_channels} "
+                    f"T={self.n_tpoints})"
+                )
+        bo, buf = self._bo, self._data
+        ifd = self._full[t * self.n_zplanes + z]
+        offs, counts = _tiff_strips(bo, buf, ifd, self.filename)
+        if len(offs) != self.n_channels:
+            raise MetadataError(
+                f"LSM strip layout in {self.filename}: {len(offs)} strips "
+                f"for {self.n_channels} channels (expected one per channel)"
+            )
+        compression = _tiff_int(bo, buf, ifd, 259, 1)
+        predictor = _tiff_int(bo, buf, ifd, 317, 1)
+        expect = self.width * self.height * self._dtype.itemsize
+        raw = _decode_strip(bytes(buf[offs[c]:offs[c] + counts[c]]),
+                            compression, expect, self.filename)
+        plane = np.frombuffer(raw, self._dtype).reshape(
+            self.height, self.width
+        )
+        return _apply_predictor(plane, predictor)
 
     def read_plane_linear(self, page: int) -> np.ndarray:
         ct, t = divmod(page, self.n_tpoints)

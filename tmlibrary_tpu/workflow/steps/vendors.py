@@ -31,7 +31,7 @@ from typing import Callable
 
 import logging
 
-from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.errors import MetadataError, NotSupportedError
 from tmlibrary_tpu.workflow.steps.omexml import _strip_ns
 
 logger = logging.getLogger(__name__)
@@ -1075,7 +1075,10 @@ def _container_sidecar(
         try:
             with reader_cls(path) as r:
                 dims = dims_of(r)
-        except MetadataError as exc:
+        # NotSupportedError too: a reader gating on a feature it does not
+        # model (RGB .stk, interleaved .lsm) must skip that file like any
+        # unreadable one, not abort the whole ingest
+        except (MetadataError, NotSupportedError) as exc:
             logger.warning("skipping unreadable %s file %s: %s", kind, path, exc)
             skipped += 1
             continue
@@ -1322,4 +1325,63 @@ def ims_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
         lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints,
                    r.channel_names()),
         entries_of,
+    )
+
+
+# ----------------------------------------------------------------------- stk
+@register_sidecar_handler("stk")
+def stk_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
+    """Standalone MetaMorph ``.stk`` stacks, read by
+    :class:`tmlibrary_tpu.readers.STKReader` (the UIC2-tag plane count a
+    paged TIFF reader cannot see).
+
+    Only fires when no ``.nd`` sidecar claims the stacks — MetaMorph
+    acquisitions WITH a ``.nd`` go through the richer ``metamorph``
+    handler (wavelengths, stage labels), which the auto loop tries
+    first.  Conventions: one file per well (token or next free column on
+    row A), one site per file, single channel, planes map to Z;
+    ``page = z``."""
+    if any(source_dir.rglob("*.nd")):
+        return None
+    from tmlibrary_tpu.readers import STKReader
+
+    def entries_of(path, dims, well):
+        (n_z,) = dims
+        return [
+            _container_entry(path, well, site=0, channel=0, zplane=z,
+                             tpoint=0, page=z)
+            for z in range(n_z)
+        ]
+
+    return _container_sidecar(
+        source_dir, ".stk", STKReader, "STK",
+        lambda r: (r.n_zplanes,), entries_of,
+    )
+
+
+# ----------------------------------------------------------------------- lsm
+@register_sidecar_handler("lsm")
+def lsm_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
+    """Zeiss LSM confocal stacks, read by
+    :class:`tmlibrary_tpu.readers.LSMReader` (planar per-channel strips,
+    thumbnail IFDs skipped, dims from CZ_LSMINFO).
+
+    Same conventions as the other container handlers: one file per well
+    (token or next free column on row A), one site per file, C/Z/T
+    preserved; ``page`` encodes ``(c * Z + z) * T + t``."""
+    from tmlibrary_tpu.readers import LSMReader
+
+    def entries_of(path, dims, well):
+        n_c, n_z, n_t = dims
+        return [
+            _container_entry(path, well, site=0, channel=c, zplane=z,
+                             tpoint=t, page=(c * n_z + z) * n_t + t)
+            for c in range(n_c)
+            for z in range(n_z)
+            for t in range(n_t)
+        ]
+
+    return _container_sidecar(
+        source_dir, ".lsm", LSMReader, "LSM",
+        lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints), entries_of,
     )
