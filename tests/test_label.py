@@ -131,6 +131,36 @@ def test_relabel_sequential():
     np.testing.assert_array_equal(out, [[0, 1, 0], [2, 2, 0]])
 
 
+def test_label_reductions_accelerator_paths_match_scatter():
+    """The accelerator fast paths (compare+reduce, byte-split one-hot
+    matmul) must be BIT-identical to the CPU scatter paths — including
+    mapped ids far above 256, which a single bf16 one-hot contraction
+    would silently round (the TPU matmul casts f32 operands to bf16)."""
+    from tmlibrary_tpu.ops.label import (
+        first_pixel_by_label,
+        remap_labels,
+    )
+
+    rng = np.random.default_rng(17)
+    for shape, mo in [((64, 64), 16), ((33, 77), 8), ((256, 256), 600)]:
+        lab = jnp.asarray(rng.integers(0, mo + 1, size=shape, dtype=np.int32))
+        a_s = areas_by_label(lab, mo, method="scatter")
+        a_r = areas_by_label(lab, mo, method="reduce")
+        np.testing.assert_array_equal(np.asarray(a_s), np.asarray(a_r))
+        f_s = first_pixel_by_label(lab, mo, method="scatter")
+        f_r = first_pixel_by_label(lab, mo, method="reduce")
+        np.testing.assert_array_equal(np.asarray(f_s), np.asarray(f_r))
+        mapping = jnp.asarray(
+            rng.integers(0, 65535, size=(mo + 1,), dtype=np.int32)
+        ).at[0].set(0)
+        g = remap_labels(lab, mapping, method="gather")
+        m = remap_labels(lab, mapping, method="matmul")
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(m))
+    with pytest.raises(ValueError, match="2\\^16"):
+        remap_labels(lab, jnp.zeros(((1 << 16) + 1,), jnp.int32),
+                     method="matmul")
+
+
 def test_filter_by_feature_eccentricity():
     """Keep only elongated objects: a circle and a bar, filter on
     eccentricity, cross-checked against skimage-style regionprops math

@@ -196,17 +196,27 @@ def test_stk_ingest_end_to_end(tmp_path):
         np.testing.assert_array_equal(px[1], data["B02"][z])
 
 
-def test_stk_handler_defers_to_metamorph_nd(tmp_path, planes):
-    """A .nd sidecar in the tree means the metamorph handler owns the
-    stacks; the standalone stk handler must stand down."""
-    from tmlibrary_tpu.workflow.steps.vendors import stk_sidecar
+def test_stk_handler_fires_despite_stray_nd(tmp_path, planes):
+    """Auto-mode deference to the metamorph handler comes from registry
+    ORDER (metamorph is registered first and wins when its .nd resolves
+    images), not from a veto inside stk_sidecar: a stray/corrupt .nd in
+    the tree — or an explicit handler='stk' — must still ingest the
+    stacks instead of falling through to 'no files matched'."""
+    from tmlibrary_tpu.workflow.steps.vendors import (
+        SIDECAR_HANDLERS,
+        stk_sidecar,
+    )
+
+    names = list(SIDECAR_HANDLERS)
+    assert names.index("metamorph") < names.index("stk")
 
     src = tmp_path / "source"
     src.mkdir()
     write_stk(src / "exp_A01.stk", planes)
-    assert stk_sidecar(src) is not None
-    (src / "exp.nd").write_text('"NDInfoFile", Version 1.0\n')
-    assert stk_sidecar(src) is None
+    (src / "stray.nd").write_text("not a parseable nd file\n")
+    entries, skipped = stk_sidecar(src)
+    assert skipped == 0
+    assert len(entries) == 4  # the stack's Z planes
 
 
 def test_stk_handler_skips_unsupported_not_just_unreadable(tmp_path, planes):
@@ -235,6 +245,46 @@ def test_stk_handler_skips_unsupported_not_just_unreadable(tmp_path, planes):
     entries_out, skipped = stk_sidecar(src)
     assert skipped == 1
     assert len(entries_out) == 4  # the good stack's Z planes
+
+
+def _write_rgb_stk(path):
+    """A valid TIFF that STKReader declines (SamplesPerPixel=3): 2x2 RGB."""
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+    data_off = len(buf)
+    buf += bytes(range(12))  # 2x2x3 pixel bytes
+    bits_off = len(buf)
+    buf += struct.pack("<HHH", 8, 8, 8)  # BitsPerSample[3] out-of-line
+    buf += b"\x00\x00"  # keep following offsets word-aligned
+    entries = [
+        _entry(256, 3, 1, 2), _entry(257, 3, 1, 2),
+        _entry(258, 3, 3, bits_off),
+        _entry(259, 3, 1, 1), _entry(262, 3, 1, 2),
+        _entry(273, 4, 1, data_off),
+        _entry(277, 3, 1, 3), _entry(278, 3, 1, 2), _entry(279, 4, 1, 12),
+        _entry(284, 3, 1, 1),
+        _entry(33629, 5, 1, 0),
+    ]
+    ifd_off = len(buf)
+    buf += struct.pack("<H", len(entries)) + b"".join(entries)
+    buf += b"\x00\x00\x00\x00"
+    struct.pack_into("<I", buf, 4, ifd_off)
+    path.write_bytes(bytes(buf))
+
+
+def test_unsupported_stk_falls_back_to_plain_decode(tmp_path):
+    """An RGB .stk the dedicated reader declines is still a TIFF: the
+    container dispatch must fall back to the plain cv2/TIFF path (return
+    None from the container probes, grayscale decode through ImageReader)
+    instead of failing imextract/metaconfig with NotSupportedError."""
+    from tmlibrary_tpu.readers import container_dimensions, read_container_plane
+
+    p = tmp_path / "rgb.stk"
+    _write_rgb_stk(p)
+    assert read_container_plane(p, 0) is None
+    assert container_dimensions(p) is None
+    with ImageReader(p) as r:
+        img = r.read()
+    assert img.shape == (2, 2)  # cv2 BGR2GRAY fallback decoded it
 
 
 def test_stk_tiled_tiff_rejected_cleanly(tmp_path):

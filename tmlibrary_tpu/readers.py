@@ -88,11 +88,33 @@ _OPEN_READERS_CAP = 64
 _open_readers_lock = _threading.Lock()
 
 
+#: TIFF-flavored containers: when the dedicated reader rejects one (RGB,
+#: 32-bit, exotic compression), the file is still a TIFF that the plain
+#: native-TIFF/cv2 path may decode — fall back instead of failing ingest.
+_TIFF_FLAVORED = (".stk", ".lsm")
+
+
+def _open_container(path):
+    """``cls(path).__enter__()`` for container paths, or None when the
+    path is a plain image OR a TIFF-flavored container whose dedicated
+    reader declines it (the caller then uses the TIFF/cv2 decode path,
+    which handles RGB and 32-bit single-IFD stacks the STK/LSM readers
+    reject)."""
+    cls = _container_reader(path)
+    if cls is None:
+        return None
+    try:
+        return cls(path).__enter__()
+    except NotSupportedError:
+        if str(path).lower().endswith(_TIFF_FLAVORED):
+            return None
+        raise
+
+
 def _cached_container_reader(path):
     import os
 
-    cls = _container_reader(path)
-    if cls is None:
+    if _container_reader(path) is None:
         return None
     st = os.stat(path)
     key = (str(path), st.st_mtime_ns, st.st_size)
@@ -100,7 +122,9 @@ def _cached_container_reader(path):
         reader = _OPEN_READERS.get(key)
     if reader is not None:
         return reader
-    reader = cls(path).__enter__()
+    reader = _open_container(path)
+    if reader is None:
+        return None
     with _open_readers_lock:
         while len(_OPEN_READERS) >= _OPEN_READERS_CAP:
             _OPEN_READERS.pop(next(iter(_OPEN_READERS)))
@@ -123,11 +147,13 @@ def read_container_plane(path, page: int) -> np.ndarray | None:
 def container_dimensions(path) -> tuple[int, int] | None:
     """(height, width) of a container's planes, or None for non-container
     paths (metaconfig's site-shape probe uses this)."""
-    cls = _container_reader(path)
-    if cls is None:
+    r = _open_container(path)
+    if r is None:
         return None
-    with cls(path) as r:
+    try:
         return r.height, r.width
+    finally:
+        r.__exit__()
 
 
 class ImageReader(Reader):
@@ -139,8 +165,7 @@ class ImageReader(Reader):
     (PNG, RGB, tiled TIFF) through cv2.  uint8/uint16 preserved."""
 
     def __enter__(self):
-        cls = _container_reader(self.filename)
-        self._container = cls(self.filename).__enter__() if cls else None
+        self._container = _open_container(self.filename)
         return self
 
     def __exit__(self, *exc):

@@ -1335,14 +1335,14 @@ def stk_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
     :class:`tmlibrary_tpu.readers.STKReader` (the UIC2-tag plane count a
     paged TIFF reader cannot see).
 
-    Only fires when no ``.nd`` sidecar claims the stacks — MetaMorph
-    acquisitions WITH a ``.nd`` go through the richer ``metamorph``
-    handler (wavelengths, stage labels), which the auto loop tries
-    first.  Conventions: one file per well (token or next free column on
-    row A), one site per file, single channel, planes map to Z;
-    ``page = z``."""
-    if any(source_dir.rglob("*.nd")):
-        return None
+    MetaMorph acquisitions WITH a parseable ``.nd`` go through the richer
+    ``metamorph`` handler (wavelengths, stage labels): it is registered
+    first, so in auto mode it wins whenever its sidecar resolves images
+    and this handler only sees trees whose ``.nd`` is absent or
+    unusable.  No ``.nd`` veto here — an explicit ``handler='stk'`` (or
+    a stray/corrupt ``.nd`` in auto mode) must still ingest the stacks.
+    Conventions: one file per well (token or next free column on row A),
+    one site per file, single channel, planes map to Z; ``page = z``."""
     from tmlibrary_tpu.readers import STKReader
 
     def entries_of(path, dims, well):
