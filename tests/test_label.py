@@ -150,15 +150,24 @@ def test_label_reductions_accelerator_paths_match_scatter():
         f_s = first_pixel_by_label(lab, mo, method="scatter")
         f_r = first_pixel_by_label(lab, mo, method="reduce")
         np.testing.assert_array_equal(np.asarray(f_s), np.asarray(f_r))
+        # mapped values span the full non-negative int32 range (the
+        # 4-byte split must reconstruct values far above 2^16 exactly)
         mapping = jnp.asarray(
-            rng.integers(0, 65535, size=(mo + 1,), dtype=np.int32)
+            rng.integers(0, 2**31 - 1, size=(mo + 1,), dtype=np.int32)
         ).at[0].set(0)
         g = remap_labels(lab, mapping, method="gather")
         m = remap_labels(lab, mapping, method="matmul")
         np.testing.assert_array_equal(np.asarray(g), np.asarray(m))
-    with pytest.raises(ValueError, match="2\\^16"):
-        remap_labels(lab, jnp.zeros(((1 << 16) + 1,), jnp.int32),
-                     method="matmul")
+    # out-of-range label ids clamp into the table identically on BOTH
+    # paths — including -1/-2, which a raw jnp gather would WRAP
+    # Python-style to the table tail while one_hot zeroes them
+    wild = jnp.asarray(np.array([[0, 5, -1], [99, -3, -2]], np.int32))
+    mapping = jnp.asarray(np.array([7, 11, 22], np.int32))
+    g = remap_labels(wild, mapping, method="gather")
+    m = remap_labels(wild, mapping, method="matmul")
+    np.testing.assert_array_equal(np.asarray(g), np.asarray(m))
+    np.testing.assert_array_equal(
+        np.asarray(g), [[7, 22, 7], [22, 7, 7]])
 
 
 def test_filter_by_feature_eccentricity():

@@ -201,6 +201,31 @@ def test_haralick_per_object_quantization_sees_local_contrast(rng):
     assert float(feats["Texture_angular_second_moment"][0]) < 0.5
 
 
+def test_lookup_by_label_matmul_matches_gather(rng):
+    """The one-hot-at-HIGHEST matmul branch (the production TPU path of
+    per-pixel float table lookups) must be BIT-identical to the gather
+    branch for finite tables — including non-chunk-multiple pixel counts
+    (pad/reshape logic) and multi-column tables.  Non-finite sentinel
+    rows are sanitized to 0 on the matmul path (documented contract)."""
+    from tmlibrary_tpu.ops.measure import lookup_by_label
+
+    for shape, mo, cols in [((64, 64), 16, 1), ((33, 77), 8, 3),
+                            ((300, 300), 600, 2)]:
+        labels = jnp.asarray(
+            rng.integers(0, mo + 1, size=shape).astype(np.int32))
+        table = jnp.asarray(
+            (rng.standard_normal((mo + 1, cols)) * 1e3).astype(np.float32))
+        g = np.asarray(lookup_by_label(labels, table, method="gather"))
+        m = np.asarray(lookup_by_label(labels, table, method="matmul"))
+        np.testing.assert_array_equal(g, m)
+    # a ±inf sentinel row must not NaN-poison other pixels' values
+    labels = jnp.asarray(np.array([[0, 1], [2, 1]], np.int32))
+    table = jnp.asarray(np.array([[0.0], [5.0], [np.inf]], np.float32))
+    m = np.asarray(lookup_by_label(labels, table, method="matmul"))
+    np.testing.assert_array_equal(
+        m[..., 0], np.array([[0.0, 5.0], [0.0, 5.0]], np.float32))
+
+
 def test_glcm_matmul_matches_scatter(rng):
     """The fused all-directions matmul kernel (the production TPU path)
     must agree exactly with the per-direction scatter path on every
@@ -334,6 +359,27 @@ def test_zernike_disk_analytic_values():
     feats = zernike_features(jnp.asarray(disk.astype(np.int32)), 4, degree=2)
     np.testing.assert_allclose(float(feats["Zernike_0_0"][0]), 1 / np.pi, rtol=1e-3)
     assert float(feats["Zernike_2_2"][0]) < 0.02
+
+
+def test_zernike_counts_every_object_pixel():
+    """Z_00 must be EXACTLY area/(pi*area) = 1/pi for any shape: every
+    object pixel contributes, including those at exactly the max radius.
+    Guards the TPU regression where x/y lowered to x*(1/y) pushed the
+    extremal rim pixel's rho one ulp above 1.0 and the old ``rho <= 1``
+    mask dropped it (9% shift in Zernike_6_0 of a 177-px object); rho is
+    clamped now, so no pixel can fall out."""
+    rng = np.random.default_rng(23)
+    labels = np.zeros((48, 48), np.int32)
+    labels[2:12, 3:9] = 1                       # bar: max radius on corner
+    yy, xx = np.mgrid[0:48, 0:48]
+    labels[((xx - 30) ** 2 + (yy - 30) ** 2) <= 100] = 2  # disk: rim ring
+    labels[40:41, 2:44] = 3                     # 1-px line: all pixels extremal
+    for method in ("xla", "host"):
+        feats = zernike_features(jnp.asarray(labels), 8, degree=2,
+                                 method=method)
+        z00 = np.asarray(feats["Zernike_0_0"][:3])
+        np.testing.assert_allclose(z00, 1 / np.pi, rtol=1e-5,
+                                   err_msg=method)
 
 
 def test_measure_under_jit_vmap(labeled_scene):

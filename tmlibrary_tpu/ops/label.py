@@ -330,32 +330,42 @@ def remap_labels(
     contraction against the table on v5e (gathers from a tiny table don't
     tile onto the MXU; the indicator matmul does).  The TPU matmul casts
     f32 operands to bf16, which only represents integers ≤ 256 exactly, so
-    the table is split into high/low bytes — two bf16-exact contractions
-    (each dot product has exactly one nonzero term, so accumulation order
-    cannot round) recombined as ``hi*256 + lo``; exact for ids < 2^16.
-    ``method="auto"``: gather on CPU, matmul on accelerators, pixel axis
-    chunked like :func:`areas_by_label`."""
+    the table is split into four bytes — bf16-exact contractions (each
+    dot product has exactly one nonzero term, so accumulation order
+    cannot round) recombined in int32; exact for every non-negative
+    int32 mapped value.  Out-of-range label ids clamp into the table on
+    both paths (explicitly — a raw jnp gather would WRAP negative ids
+    Python-style while one_hot zeroes them).  ``method="auto"``: gather
+    on CPU and for tables past the one-hot sweet spot (> 4096 rows,
+    where the chunk×rows indicator work outgrows the gather), matmul on
+    accelerators otherwise; pixel axis chunked like
+    :func:`areas_by_label`."""
     mapping = jnp.asarray(mapping, jnp.int32)
+    labels = jnp.clip(labels, 0, mapping.shape[0] - 1)
     if method == "auto":
-        method = "gather" if jax.default_backend() == "cpu" else "matmul"
+        method = (
+            "gather"
+            if jax.default_backend() == "cpu" or mapping.shape[0] > (1 << 12)
+            else "matmul"
+        )
     if method == "gather":
         return mapping[labels]
-    if mapping.shape[0] > (1 << 16):
-        raise ValueError(
-            "remap_labels matmul path is byte-split-exact only for mapped "
-            f"ids < 2^16; got a {mapping.shape[0]}-row table"
-        )
     flat = labels.reshape(-1)
     n = flat.shape[0]
     chunks = _chunked_pixels(flat)
-    hi = (mapping >> 8).astype(jnp.float32)
-    lo = (mapping & 0xFF).astype(jnp.float32)
-    table = jnp.stack([hi, lo], axis=-1)  # (K+1, 2)
+    table = jnp.stack(
+        [((mapping >> s) & 0xFF).astype(jnp.float32) for s in (24, 16, 8, 0)],
+        axis=-1,
+    )  # (K+1, 4) byte planes, each entry ≤ 255 → bf16-exact
 
     def body(i, acc):
         oh = jax.nn.one_hot(chunks[i], mapping.shape[0], dtype=jnp.float32)
-        parts = (oh @ table).astype(jnp.int32)  # (chunk, 2)
-        return acc.at[i].set(parts[:, 0] * 256 + parts[:, 1])
+        parts = (oh @ table).astype(jnp.int32)  # (chunk, 4)
+        vals = (
+            ((parts[:, 0] * 256 + parts[:, 1]) * 256 + parts[:, 2]) * 256
+            + parts[:, 3]
+        )
+        return acc.at[i].set(vals)
 
     out = jnp.zeros(chunks.shape, jnp.int32)
     out = jax.lax.fori_loop(0, chunks.shape[0], body, out)

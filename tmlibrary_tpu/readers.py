@@ -111,6 +111,13 @@ def _open_container(path):
         raise
 
 
+#: negative-cache sentinel: a TIFF-flavored container the dedicated
+#: reader declined.  Without it, imextract's per-plane loop would
+#: re-open and re-parse the declined header on EVERY plane read — the
+#: exact O(planes^2) work the reader cache exists to prevent.
+_DECLINED = object()
+
+
 def _cached_container_reader(path):
     import os
 
@@ -120,10 +127,16 @@ def _cached_container_reader(path):
     key = (str(path), st.st_mtime_ns, st.st_size)
     with _open_readers_lock:
         reader = _OPEN_READERS.get(key)
+    if reader is _DECLINED:
+        return None
     if reader is not None:
         return reader
     reader = _open_container(path)
     if reader is None:
+        with _open_readers_lock:
+            while len(_OPEN_READERS) >= _OPEN_READERS_CAP:
+                _OPEN_READERS.pop(next(iter(_OPEN_READERS)))
+            _OPEN_READERS.setdefault(key, _DECLINED)
         return None
     with _open_readers_lock:
         while len(_OPEN_READERS) >= _OPEN_READERS_CAP:
