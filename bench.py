@@ -85,6 +85,22 @@ def _default_batch(config: str) -> int:
     return _tuned_batch(config) or 64
 
 
+def _pipeline_depth(backend: str) -> int:
+    """How many batch executions each timed rep enqueues before the ONE
+    host fetch that fences them all.  Under the axon relay a host fetch
+    costs ~100 ms round-trip regardless of payload (measured noop floor,
+    scripts/profile_bench.py) — a fixed per-rep tax that is an artifact
+    of the tunnel, not of the chip.  Pipelining is the honest
+    steady-state answer: production processes thousands of sites and
+    only ever pays the fetch once per drained queue.  On the CPU backend
+    dispatch is synchronous and there is no relay, so depth defaults
+    to 1."""
+    depth = os.environ.get("BENCH_PIPELINE")
+    if depth:
+        return max(1, int(depth))
+    return 1 if backend == "cpu" else 8
+
+
 # env knob -> (record field, per-config default): a cached record only
 # represents the requested workload when every knob's EFFECTIVE value
 # (env or the same default measure() would use) matches what was
@@ -94,6 +110,11 @@ def _default_batch(config: str) -> int:
 def _workload_knobs(config: str) -> dict:
     return {
         "BENCH_BATCH": ("batch", _default_batch(config)),
+        # methodology knob, but it changes the measured value: a depth-1
+        # record must not be served for an explicit depth-8 request (the
+        # default 8 matches what a TPU-backed measure() would use; cached
+        # records are always TPU-measured)
+        "BENCH_PIPELINE": ("pipeline_depth", 8),
         "BENCH_MAX_OBJECTS": ("max_objects", 64),
         "BENCH_SITE_SIZE": (
             "site_size", 128 if config == "volume" else 256
@@ -258,14 +279,17 @@ def measure(platform: str) -> None:
     result = fn(raw, {}, shifts)
     np.asarray(result.counts[count_key])
 
+    # NOT named `depth`: the volume branch owns that name for the z-stack
+    # depth recorded as record["depth"]
+    pdepth = _pipeline_depth(jax.default_backend())
     reps = int(os.environ.get("BENCH_REPS", "3"))
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        result = fn(raw, {}, shifts)
-        np.asarray(result.counts[count_key])
+        counts = [fn(raw, {}, shifts).counts[count_key] for _ in range(pdepth)]
+        np.asarray(jnp.stack(counts))  # one fetch fences all executions
         best = min(best, time.perf_counter() - t0)
-    device_sites_per_sec = batch / best
+    device_sites_per_sec = pdepth * batch / best
 
     # single-CPU denominator: the SAME workload in scipy/numpy, single
     # thread — up to 8 sites (capped by batch), best-of-3 reps
@@ -310,6 +334,7 @@ def measure(platform: str) -> None:
         "batch": batch,
         "max_objects": max_objects,
         "site_size": size,
+        "pipeline_depth": pdepth,
     }
     if config == "volume":
         record["depth"] = depth
@@ -323,7 +348,9 @@ def measure(platform: str) -> None:
         for c in result.counts.values():
             at_cap |= np.asarray(c) >= max_objects
         record["saturated_sites"] = int(at_cap.sum())
-    record.update(_flops_fields(flops, batch, best, jax.default_backend()))
+    record.update(_flops_fields(
+        flops and flops * pdepth, pdepth * batch, best, jax.default_backend()
+    ))
     print(json.dumps(record), flush=True)
 
 
@@ -412,15 +439,16 @@ def measure_pyramid(size: int) -> None:
     levels = fn(dev_sites)
     np.asarray(levels[-1])  # honest clock under the relay
 
+    depth = _pipeline_depth(jax.default_backend())
     reps = int(os.environ.get("BENCH_REPS", "3"))
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        levels = fn(dev_sites)
-        np.asarray(levels[-1])
+        tops = [fn(dev_sites)[-1] for _ in range(depth)]
+        np.asarray(jnp.stack(tops))  # one fetch fences all executions
         best = min(best, time.perf_counter() - t0)
     mpix = gy * gx * size * size / 1e6
-    device_mpix_per_sec = mpix / best
+    device_mpix_per_sec = depth * mpix / best
 
     cpu_best = float("inf")
     for _ in range(3):
@@ -452,10 +480,11 @@ def measure_pyramid(size: int) -> None:
         "grid_x": gx,
         "site_size": size,
         "n_levels": n_levels,
+        "pipeline_depth": depth,
     }
     record.update(_flops_fields(
-        flops, gy * gx, best, jax.default_backend(),
-        item_key="flops_per_site"))
+        flops and flops * depth, depth * gy * gx, best,
+        jax.default_backend(), item_key="flops_per_site"))
     print(json.dumps(record), flush=True)
 
 
@@ -487,14 +516,15 @@ def measure_corilla(size: int) -> None:
     out = fn(dev_stack)
     np.asarray(out["n"])  # force completion (honest clock under the relay)
 
+    depth = _pipeline_depth(jax.default_backend())
     reps = int(os.environ.get("BENCH_REPS", "3"))
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        out = fn(dev_stack)
-        np.asarray(out["n"])
+        ns = [fn(dev_stack)["n"] for _ in range(depth)]
+        np.asarray(jnp.stack(ns))  # one fetch fences all executions
         best = min(best, time.perf_counter() - t0)
-    device_chans_per_sec = n_channels / best
+    device_chans_per_sec = depth * n_channels / best
 
     # single-thread numpy Welford + histogram, one channel, best-of-3
     cpu_best = float("inf")
@@ -516,10 +546,11 @@ def measure_corilla(size: int) -> None:
         "sites": n_sites,
         "channels": n_channels,
         "site_size": size,
+        "pipeline_depth": depth,
     }
     record.update(_flops_fields(
-        flops, n_channels, best, jax.default_backend(),
-        item_key="flops_per_channel"))
+        flops and flops * depth, depth * n_channels, best,
+        jax.default_backend(), item_key="flops_per_channel"))
     print(json.dumps(record), flush=True)
 
 

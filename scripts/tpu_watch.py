@@ -88,7 +88,13 @@ def save_cache(cache: dict) -> None:
 
 def bench_done(key: str) -> bool:
     entry = (load_json(CACHE_PATH).get("records") or {}).get(key)
-    return bool(entry and entry.get("record"))
+    if not (entry and entry.get("record")):
+        return False
+    # records that predate the pipelined-fetch methodology (no
+    # pipeline_depth field) under-measure by the ~100 ms relay round-trip
+    # per rep: keep serving them from bench.py, but re-measure on the
+    # next window (run_bench_item only replaces a record on success)
+    return entry["record"].get("pipeline_depth") is not None
 
 
 def run_bench_item(key: str, overrides: dict) -> bool:
@@ -148,10 +154,16 @@ def run_bench_item(key: str, overrides: dict) -> bool:
 
 
 def pending_tune_stages() -> list:
+    from scripts.tune_tpu import METHODOLOGY
+
     tuning = load_json(TUNING_PATH)
     if "written_by" not in tuning:
         # pre-round-3 file was hand-transcribed after a relay death; only
         # results written by tune_tpu.write_results() itself count as done
+        return list(TUNE_STAGES)
+    if tuning.get("timing_methodology") != METHODOLOGY:
+        # timed under an older methodology (per-execution relay fetches):
+        # deltas of a few ms were fetch jitter — re-measure everything
         return list(TUNE_STAGES)
     errors = tuning.get("stage_errors", {})
     out = []
