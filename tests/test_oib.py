@@ -1,0 +1,447 @@
+"""Olympus FluoView ``.oif``/``.oib`` container support.
+
+``.oif`` is a UTF-16 INI main file next to a ``.oif.files/`` directory of
+single-plane TIFFs named by axis tokens; ``.oib`` packs the same tree
+into one OLE2 compound document.  ``write_cfb`` below is a minimal CFB
+v3 writer (FAT, directory tree, mini stream) so the first-party parser
+(:mod:`tmlibrary_tpu.cfb`) is tested against synthetic fixtures — real
+containers cannot be fetched in this environment.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.cfb import CompoundFile
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.readers import OIBReader, OIFReader
+
+SECT = 512
+MINI = 64
+FREE = 0xFFFFFFFF
+END = 0xFFFFFFFE
+FATSECT = 0xFFFFFFFD
+
+
+# ------------------------------------------------------------ TIFF fixture
+def _entry(tag, typ, count, value):
+    return struct.pack("<HHII", tag, typ, count, value)
+
+
+def tiff_bytes(plane: np.ndarray) -> bytes:
+    """Minimal single-IFD little-endian grayscale TIFF."""
+    h, w = plane.shape
+    bits = plane.dtype.itemsize * 8
+    data = np.ascontiguousarray(plane).tobytes()
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+    data_off = len(buf)
+    buf += data
+    entries = [
+        _entry(256, 3, 1, w),
+        _entry(257, 3, 1, h),
+        _entry(258, 3, 1, bits),
+        _entry(259, 3, 1, 1),
+        _entry(262, 3, 1, 1),
+        _entry(273, 4, 1, data_off),
+        _entry(277, 3, 1, 1),
+        _entry(278, 3, 1, h),
+        _entry(279, 4, 1, len(data)),
+    ]
+    ifd_off = len(buf)
+    buf += struct.pack("<H", len(entries)) + b"".join(entries)
+    buf += b"\x00\x00\x00\x00"
+    struct.pack_into("<I", buf, 4, ifd_off)
+    return bytes(buf)
+
+
+# ------------------------------------------------------------- CFB writer
+def _pad(b: bytes, unit: int) -> bytes:
+    rem = len(b) % unit
+    return b + b"\x00" * (unit - rem) if rem else b
+
+
+def write_cfb(files: "dict[str, bytes]") -> bytes:
+    """CFB v3 container holding ``files`` ("Storage/Stream" paths allowed,
+    one nesting level).  Streams < 4096 bytes land in the mini stream."""
+    # ---- directory tree -------------------------------------------------
+    entries: list[dict] = [dict(
+        name="Root Entry", type=5, left=FREE, right=FREE, child=FREE,
+        start=END, size=0,
+    )]
+    storages: dict[str, int] = {}
+    children: dict[int, list[int]] = {0: []}
+
+    def add_entry(name, etype, parent) -> int:
+        eid = len(entries)
+        entries.append(dict(name=name, type=etype, left=FREE, right=FREE,
+                            child=FREE, start=END, size=0))
+        children.setdefault(eid, [])
+        children[parent].append(eid)
+        return eid
+
+    stream_ids: dict[str, int] = {}
+    for path in files:
+        parent = 0
+        parts = path.split("/")
+        for storage in parts[:-1]:
+            key = "/".join(parts[: parts.index(storage) + 1])
+            if key not in storages:
+                storages[key] = add_entry(storage, 1, parent)
+            parent = storages[key]
+        stream_ids[path] = add_entry(parts[-1], 2, parent)
+
+    for parent, kids in children.items():
+        if not kids:
+            continue
+        entries[parent]["child"] = kids[0]
+        for a, b in zip(kids, kids[1:]):
+            entries[a]["right"] = b
+
+    # ---- payload placement ---------------------------------------------
+    mini_payload = bytearray()
+    minifat: list[int] = []
+    large: list[tuple[str, bytes]] = []
+    for path, payload in files.items():
+        e = entries[stream_ids[path]]
+        e["size"] = len(payload)
+        if len(payload) < 4096:
+            first = len(minifat)
+            n = max(1, (len(payload) + MINI - 1) // MINI)
+            for i in range(n):
+                minifat.append(first + i + 1 if i < n - 1 else END)
+            e["start"] = first
+            mini_payload += _pad(payload, MINI)
+        else:
+            large.append((path, payload))
+
+    dir_raw = bytearray()
+    for e in entries:
+        name = e["name"].encode("utf-16-le") + b"\x00\x00"
+        ent = bytearray(128)
+        ent[: len(name)] = name
+        struct.pack_into("<H", ent, 64, len(name))
+        ent[66] = e["type"]
+        ent[67] = 1
+        struct.pack_into("<3I", ent, 68, e["left"], e["right"], e["child"])
+        struct.pack_into("<I", ent, 116, e["start"] & 0xFFFFFFFF)
+        struct.pack_into("<Q", ent, 120, e["size"])
+        dir_raw += ent
+    n_dir = len(_pad(bytes(dir_raw), SECT)) // SECT
+
+    minifat_raw = b"".join(struct.pack("<I", v) for v in minifat)
+    n_minifat = len(_pad(minifat_raw, SECT)) // SECT if minifat else 0
+    mini_raw = _pad(bytes(mini_payload), SECT)
+    n_mini = len(mini_raw) // SECT
+    n_large = [len(_pad(p, SECT)) // SECT for _, p in large]
+
+    body = n_dir + n_minifat + n_mini + sum(n_large)
+    n_fat = 1
+    while (body + n_fat + 127) // 128 > n_fat:
+        n_fat += 1
+    total = body + n_fat
+
+    # sector order: [FAT][dir][miniFAT][ministream][large...]
+    fat = [FREE] * (n_fat * 128)
+    nxt = 0
+    for i in range(n_fat):
+        fat[nxt] = FATSECT
+        nxt += 1
+
+    def place(n_sectors) -> int:
+        nonlocal nxt
+        start = nxt
+        for i in range(n_sectors):
+            fat[nxt] = nxt + 1 if i < n_sectors - 1 else END
+            nxt += 1
+        return start
+
+    dir_start = place(n_dir)
+    minifat_start = place(n_minifat) if n_minifat else END
+    mini_start = place(n_mini) if n_mini else END
+    for (path, payload), n in zip(large, n_large):
+        entries[stream_ids[path]]["start"] = place(n)
+    if mini_payload:
+        entries[0]["start"] = mini_start
+        entries[0]["size"] = len(mini_payload)
+
+    # directory raw must be rebuilt: large-stream starts were just placed
+    dir_raw = bytearray()
+    for e in entries:
+        name = e["name"].encode("utf-16-le") + b"\x00\x00"
+        ent = bytearray(128)
+        ent[: len(name)] = name
+        struct.pack_into("<H", ent, 64, len(name))
+        ent[66] = e["type"]
+        ent[67] = 1
+        struct.pack_into("<3I", ent, 68, e["left"], e["right"], e["child"])
+        struct.pack_into("<I", ent, 116, e["start"] & 0xFFFFFFFF)
+        struct.pack_into("<Q", ent, 120, e["size"])
+        dir_raw += ent
+
+    header = bytearray(512)
+    header[:8] = b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1"
+    struct.pack_into("<H", header, 24, 0x3E)
+    struct.pack_into("<H", header, 26, 3)
+    struct.pack_into("<H", header, 28, 0xFFFE)
+    struct.pack_into("<H", header, 30, 9)
+    struct.pack_into("<H", header, 32, 6)
+    struct.pack_into("<I", header, 44, n_fat)
+    struct.pack_into("<I", header, 48, dir_start)
+    struct.pack_into("<I", header, 56, 4096)
+    struct.pack_into("<I", header, 60, minifat_start)
+    struct.pack_into("<I", header, 64, n_minifat)
+    struct.pack_into("<I", header, 68, END)
+    struct.pack_into("<I", header, 72, 0)
+    for i in range(109):
+        struct.pack_into("<I", header, 76 + 4 * i,
+                         i if i < n_fat else FREE)
+
+    out = bytearray(header)
+    out += b"".join(struct.pack("<I", v) for v in fat)
+    out += _pad(bytes(dir_raw), SECT)
+    if n_minifat:
+        out += _pad(minifat_raw, SECT)
+    out += mini_raw
+    for (_, payload), n in zip(large, n_large):
+        out += _pad(payload, SECT)
+    assert len(out) == 512 + total * SECT
+    return bytes(out)
+
+
+# ------------------------------------------------------------ OIF fixture
+def oif_text(w, h, c, z, t) -> str:
+    lines = ["[Version Info]", 'SystemName="FLUOVIEW FV1000"']
+    for i, (code, size) in enumerate(
+        (("X", w), ("Y", h), ("C", c), ("Z", z), ("T", t))
+    ):
+        lines += [
+            f"[Axis {i} Parameters Common]",
+            f'AxisCode="{code}"',
+            f"MaxSize={size}",
+        ]
+    return "\r\n".join(lines) + "\r\n"
+
+
+def plane_name(c, z, t) -> str:
+    return f"s_C{c + 1:03d}Z{z + 1:03d}T{t + 1:03d}.tif"
+
+
+def write_oif(dirpath, stem, stack: np.ndarray):
+    """``stack``: (C, Z, T, H, W) uint16 -> ``<stem>.oif`` + files dir."""
+    n_c, n_z, n_t, h, w = stack.shape
+    main = dirpath / f"{stem}.oif"
+    main.write_bytes(
+        b"\xff\xfe"
+        + oif_text(w, h, n_c, n_z, n_t).encode("utf-16-le")
+    )
+    files = dirpath / f"{stem}.oif.files"
+    files.mkdir()
+    for c in range(n_c):
+        for z in range(n_z):
+            for t in range(n_t):
+                (files / plane_name(c, z, t)).write_bytes(
+                    tiff_bytes(stack[c, z, t])
+                )
+    return main
+
+
+def write_oib(path, stack: np.ndarray, with_info=True, nested=True):
+    """``stack``: (C, Z, T, H, W) -> OIB compound file."""
+    n_c, n_z, n_t, h, w = stack.shape
+    prefix = "Storage00001/" if nested else ""
+    files: dict[str, bytes] = {}
+    info_lines = ["[OibSaveInfo]", 'Version="2.0.0.0"']
+    idx = 0
+    for c in range(n_c):
+        for z in range(n_z):
+            for t in range(n_t):
+                stream = f"Stream{idx:05d}" if with_info else plane_name(c, z, t)
+                files[prefix + stream] = tiff_bytes(stack[c, z, t])
+                if with_info:
+                    info_lines.append(f"{stream}={plane_name(c, z, t)}")
+                idx += 1
+    main_stream = f"Stream{idx:05d}" if with_info else "main.oif"
+    files[prefix + main_stream] = (
+        b"\xff\xfe"
+        + oif_text(w, h, n_c, n_z, n_t).encode("utf-16-le")
+    )
+    if with_info:
+        info_lines.append(f"{main_stream}=main.oif")
+        files["OibInfo.txt"] = (
+            b"\xff\xfe"
+            + "\r\n".join(info_lines).encode("utf-16-le")
+        )
+    path.write_bytes(write_cfb(files))
+    return path
+
+
+@pytest.fixture()
+def stack():
+    rng = np.random.default_rng(23)
+    return rng.integers(0, 60000, (2, 3, 2, 16, 20), dtype=np.uint16)
+
+
+# ------------------------------------------------------------------ tests
+def test_cfb_roundtrip_mini_and_large():
+    small = b"hello mini stream"
+    big = bytes(np.arange(5000, dtype=np.uint8) % 251)
+    blob = write_cfb({"Small.txt": small, "Dir01/Big.bin": big})
+    cf = CompoundFile(blob)
+    assert cf.streams["Small.txt"] == small
+    assert cf.streams["Dir01/Big.bin"] == big
+
+
+def test_cfb_rejects_corruption(tmp_path):
+    with pytest.raises(MetadataError):
+        CompoundFile(b"\x00" * 600)
+    blob = write_cfb({"a.txt": b"x" * 100})
+    with pytest.raises(MetadataError):
+        CompoundFile(blob[:512])  # FAT/directory sectors cut off
+    # directory start pointing into the void
+    bad = bytearray(blob)
+    struct.pack_into("<I", bad, 48, 10_000)
+    with pytest.raises(MetadataError):
+        CompoundFile(bytes(bad))
+
+
+def test_oif_reader_dims_and_planes(tmp_path, stack):
+    main = write_oif(tmp_path, "exp_A01", stack)
+    with OIFReader(main) as r:
+        assert (r.n_channels, r.n_zplanes, r.n_tpoints) == (2, 3, 2)
+        assert (r.height, r.width) == (16, 20)
+        for c in range(2):
+            for z in range(3):
+                for t in range(2):
+                    np.testing.assert_array_equal(
+                        r.read_plane(c, z, t), stack[c, z, t]
+                    )
+        page = (1 * 3 + 2) * 2 + 1  # (c*Z + z)*T + t
+        np.testing.assert_array_equal(
+            r.read_plane_linear(page), stack[1, 2, 1]
+        )
+
+
+@pytest.mark.parametrize("with_info,nested", [(True, True), (False, False)])
+def test_oib_reader(tmp_path, stack, with_info, nested):
+    path = write_oib(tmp_path / "exp.oib", stack, with_info, nested)
+    with OIBReader(path) as r:
+        assert (r.n_channels, r.n_zplanes, r.n_tpoints) == (2, 3, 2)
+        assert (r.height, r.width) == (16, 20)
+        np.testing.assert_array_equal(r.read_plane(1, 2, 1), stack[1, 2, 1])
+        np.testing.assert_array_equal(
+            r.read_plane_linear((0 * 3 + 1) * 2 + 0), stack[0, 1, 0]
+        )
+
+
+def test_oif_rejects_bad_files(tmp_path, stack):
+    missing_dir = tmp_path / "lonely.oif"
+    missing_dir.write_bytes(oif_text(8, 8, 1, 1, 1).encode("utf-16"))
+    with pytest.raises(MetadataError):
+        OIFReader(missing_dir).__enter__()
+    not_oif = tmp_path / "junk.oif"
+    not_oif.write_bytes(b"random bytes, no ini")
+    with pytest.raises(MetadataError):
+        OIFReader(not_oif).__enter__()
+    not_cfb = tmp_path / "junk.oib"
+    not_cfb.write_bytes(b"\x01" * 4096)
+    with pytest.raises(MetadataError):
+        OIBReader(not_cfb).__enter__()
+
+
+def test_olympus_ingest_end_to_end(tmp_path, stack):
+    """Mixed .oif/.oib wells -> metaconfig (auto) -> imextract -> pixels
+    in the canonical store, bit-identical, Z/T preserved."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(31)
+    src = tmp_path / "source"
+    src.mkdir()
+    data = {
+        "A01": rng.integers(0, 60000, (2, 3, 2, 16, 20), dtype=np.uint16),
+        "B02": rng.integers(0, 60000, (2, 3, 2, 16, 20), dtype=np.uint16),
+    }
+    write_oif(src, "exp_A01", data["A01"])
+    write_oib(src / "exp_B02.oib", data["B02"])
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="oibtest", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 2 * 3 * 2  # wells x C x Z x T
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_zplanes == 3 and exp.n_tpoints == 2
+    rows_cols = {(w.row, w.column) for p in exp.plates for w in p.wells}
+    assert rows_cols == {(0, 0), (1, 1)}
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store = ExperimentStore.open(root)
+    for c in range(2):
+        for z in range(3):
+            for t in range(2):
+                px = store.read_sites(None, channel=c, tpoint=t, zplane=z)
+                np.testing.assert_array_equal(px[0], data["A01"][c, z, t])
+                np.testing.assert_array_equal(px[1], data["B02"][c, z, t])
+
+
+def test_olympus_handler_skips_unreadable(tmp_path, stack):
+    from tmlibrary_tpu.workflow.steps.vendors import olympus_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_oif(src, "ok_A01", stack)
+    (src / "bad_B01.oib").write_bytes(b"\0" * 2048)
+    entries, skipped = olympus_sidecar(src)
+    assert skipped == 1
+    assert {e["well_row"] for e in entries} == {0}
+    assert len(entries) == 2 * 3 * 2
+
+
+def test_oif_aborted_scan_trims_trailing_timepoint(tmp_path, stack):
+    """INI declares T=2 but the last timepoint is partial (aborted scan):
+    the reader trims to the complete timepoints instead of failing every
+    missing (c,z,t) at extract time."""
+    main = write_oif(tmp_path, "abort_A01", stack)
+    files = tmp_path / "abort_A01.oif.files"
+    # drop most of t=1 (keep one plane so t=1 is observed but incomplete)
+    for c in range(2):
+        for z in range(3):
+            if (c, z) != (0, 0):
+                (files / plane_name(c, z, 1)).unlink()
+    with OIFReader(main) as r:
+        assert r.n_tpoints == 1
+        assert (r.n_channels, r.n_zplanes) == (2, 3)
+        np.testing.assert_array_equal(r.read_plane(1, 2, 0), stack[1, 2, 0])
+
+
+def test_oif_rejects_mid_grid_hole(tmp_path, stack):
+    main = write_oif(tmp_path, "holey_A01", stack)
+    (tmp_path / "holey_A01.oif.files" / plane_name(0, 1, 0)).unlink()
+    with pytest.raises(MetadataError, match="incomplete"):
+        OIFReader(main).__enter__()
+
+
+def test_oib_duplicate_basename_first_storage_wins(tmp_path):
+    """A later storage's duplicate copy of a plane (preview exports) must
+    not shadow the acquisition plane in the first storage."""
+    rng = np.random.default_rng(5)
+    real = rng.integers(0, 60000, (8, 9), dtype=np.uint16)
+    preview = np.zeros((8, 9), np.uint16)
+    name = plane_name(0, 0, 0)
+    blob = write_cfb({
+        f"Storage00001/{name}": tiff_bytes(real),
+        f"Storage00002/{name}": tiff_bytes(preview),
+    })
+    path = tmp_path / "dup.oib"
+    path.write_bytes(blob)
+    with OIBReader(path) as r:
+        np.testing.assert_array_equal(r.read_plane(0, 0, 0), real)

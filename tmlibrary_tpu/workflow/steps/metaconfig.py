@@ -122,7 +122,9 @@ class MetadataConfigurator(Step):
                  help="directory of microscope image files"),
         Argument("handler", str, default="default",
                  choices=("default", "cellvoyager", "omexml", "metamorph",
-                          "harmony", "imagexpress", "scanr", "leica", "auto"),
+                          "harmony", "imagexpress", "scanr", "leica",
+                          "nd2", "czi", "lif", "ngff", "dv", "ims", "stk",
+                          "lsm", "olympus", "auto"),
                  help="vendor metadata handler (sidecar files preferred, "
                       "filename patterns as fallback)"),
         Argument("pattern", str, default=None,

@@ -1385,3 +1385,43 @@ def lsm_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
         source_dir, ".lsm", LSMReader, "LSM",
         lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints), entries_of,
     )
+
+
+# ------------------------------------------------------------------- olympus
+@register_sidecar_handler("olympus")
+def olympus_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
+    """Olympus FluoView ``.oif`` acquisitions and their single-file
+    ``.oib`` (OLE2 compound document) form, read by
+    :class:`tmlibrary_tpu.readers.OIFReader` /
+    :class:`~tmlibrary_tpu.readers.OIBReader` — the compound container
+    parsed by the first-party :mod:`tmlibrary_tpu.cfb` walker, no JVM.
+
+    Same conventions as the other container handlers: one file per well
+    (token or next free column on row A), one site per file, C/Z/T
+    preserved; ``page`` encodes ``(c * Z + z) * T + t``.  The companion
+    ``.oif.files`` TIFF directories are consumed through their main file
+    only — in auto mode this handler resolves them before the filename
+    fallback could ingest the raw plane TIFFs as separate channels."""
+    from tmlibrary_tpu.readers import OIBReader, OIFReader
+
+    def entries_of(path, dims, well):
+        n_c, n_z, n_t = dims
+        return [
+            _container_entry(path, well, site=0, channel=c, zplane=z,
+                             tpoint=t, page=(c * n_z + z) * n_t + t)
+            for c in range(n_c)
+            for z in range(n_z)
+            for t in range(n_t)
+        ]
+
+    def open_either(path):
+        # ONE shared scan for both suffixes: two token-less files must
+        # take two different free wells, which per-suffix passes (each
+        # with its own assign_container_wells) would not guarantee
+        cls = OIBReader if str(path).lower().endswith(".oib") else OIFReader
+        return cls(path)
+
+    return _container_sidecar(
+        source_dir, (".oif", ".oib"), open_either, "Olympus",
+        lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints), entries_of,
+    )
