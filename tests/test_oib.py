@@ -445,3 +445,41 @@ def test_oib_duplicate_basename_first_storage_wins(tmp_path):
     path.write_bytes(blob)
     with OIBReader(path) as r:
         np.testing.assert_array_equal(r.read_plane(0, 0, 0), real)
+
+
+def test_oib_per_storage_oibinfo_sections(tmp_path):
+    """OibInfo.txt grouped in per-storage sections: equal stream
+    basenames in different storages map to DIFFERENT plane names."""
+    rng = np.random.default_rng(9)
+    p0 = rng.integers(0, 60000, (6, 7), dtype=np.uint16)
+    p1 = rng.integers(0, 60000, (6, 7), dtype=np.uint16)
+    info = "\r\n".join([
+        "[Storage00001]",
+        f"Stream00000={plane_name(0, 0, 0)}",
+        "[Storage00002]",
+        f"Stream00000={plane_name(1, 0, 0)}",
+        "[General]",
+        "Stream00099=main.oif",
+    ])
+    blob = write_cfb({
+        "OibInfo.txt": b"\xff\xfe" + info.encode("utf-16-le"),
+        "Storage00001/Stream00000": tiff_bytes(p0),
+        "Storage00002/Stream00000": tiff_bytes(p1),
+        "Stream00099": b"\xff\xfe"
+        + oif_text(7, 6, 2, 1, 1).encode("utf-16-le"),
+    })
+    path = tmp_path / "sections.oib"
+    path.write_bytes(blob)
+    with OIBReader(path) as r:
+        assert r.n_channels == 2
+        np.testing.assert_array_equal(r.read_plane(0, 0, 0), p0)
+        np.testing.assert_array_equal(r.read_plane(1, 0, 0), p1)
+
+
+def test_cfb_lazy_stream_api():
+    blob = write_cfb({"A/x.bin": b"1" * 5000, "y.txt": b"hi"})
+    cf = CompoundFile(blob)
+    assert set(cf.stream_paths) == {"A/x.bin", "y.txt"}
+    assert cf.read_stream("y.txt") == b"hi"
+    with pytest.raises(MetadataError):
+        cf.read_stream("missing")

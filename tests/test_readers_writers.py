@@ -35,9 +35,28 @@ def test_image_reader_missing(tmp_path):
         ImageReader(tmp_path / "nope.png").read()
 
 
+def test_bfimage_reader_delegates_to_native_readers(tmp_path, rng):
+    """The reference's BFImageReader API reads vendor containers via
+    Bio-Formats; here it is a facade over the first-party parsers."""
+    import cv2
+
+    img = (rng.random((12, 14)) * 60000).astype(np.uint16)
+    png = tmp_path / "p.png"
+    cv2.imwrite(str(png), img)
+    np.testing.assert_array_equal(BFImageReader(png).read(), img)
+
+    from test_oib import write_oib
+
+    stack = (rng.random((1, 1, 1, 8, 9)) * 60000).astype(np.uint16)
+    oib = write_oib(tmp_path / "x.oib", stack)
+    np.testing.assert_array_equal(BFImageReader(oib).read(0), stack[0, 0, 0])
+
+
 def test_bfimage_reader_states_unsupported(tmp_path):
+    junk = tmp_path / "scan.xyz"
+    junk.write_bytes(b"not an image at all")
     with pytest.raises(NotSupportedError, match="Bio-Formats"):
-        BFImageReader(tmp_path / "x.nd2").read()
+        BFImageReader(junk).read()
 
 
 def test_hdf5_roundtrip(tmp_path, rng):
@@ -216,3 +235,8 @@ def test_ome_tiff_writer_odd_sizes_and_short_description(tmp_path):
             if tag == 270:
                 assert cnt == 4  # 'abc\0' stored inline
         (off,) = _s.unpack_from("<I", raw, off + 2 + 12 * count)
+
+
+def test_bfimage_reader_missing_file_is_not_a_format_error(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        BFImageReader(tmp_path / "typo.png").read()

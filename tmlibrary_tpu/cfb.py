@@ -33,11 +33,16 @@ _MAX_DIR_ENTRIES = 1 << 16
 
 
 class CompoundFile:
-    """Parse a CFB container from ``buf`` (bytes or memoryview).
+    """Parse a CFB container from ``buf`` (bytes, memoryview or mmap).
 
-    ``streams`` maps slash-joined storage paths to payload bytes, e.g.
-    ``{"OibInfo.txt": ..., "Storage00001/Stream00000": ...}`` — the root
-    storage itself is not a path component.
+    Stream payloads are extracted LAZILY: the constructor walks only the
+    FAT and the directory tree; ``stream_paths`` lists the slash-joined
+    storage paths (root storage omitted, e.g.
+    ``"Storage00001/Stream00000"``) and :meth:`read_stream` materializes
+    one payload on demand — an open reader over a multi-GB container
+    holds the directory tables, not the pixel data (the reader cache
+    keeps up to 64 containers open during ingest).  ``streams``
+    materializes everything at once for small containers and tests.
     """
 
     def __init__(self, buf, filename="<buf>"):
@@ -64,7 +69,10 @@ class CompoundFile:
         self._fat = self._parse_fat(difat_start, n_difat)
         self._minifat = self._read_fat_table(self._minifat_start)
         entries = self._parse_directory()
-        self.streams = self._flatten(entries)
+        self._root = entries[0]
+        self._ministream: "bytes | None" = None
+        self._paths = self._walk(entries)
+        self.stream_paths = tuple(self._paths)
 
     # ------------------------------------------------------------- sectors
     def _sector(self, sid: int) -> memoryview:
@@ -147,36 +155,14 @@ class CompoundFile:
             raise MetadataError(f"compound file without root entry: {self._name}")
         return entries
 
-    def _flatten(self, entries: list) -> dict[str, bytes]:
-        root = entries[0]
-        ministream = (
-            self._read_chain(root["start"])[: root["size"]]
-            if root["start"] < _SPECIAL and root["size"] else b""
-        )
-
-        def payload(e: dict) -> bytes:
-            size = e["size"]
-            if size == 0:
-                return b""
-            if size < self._cutoff:  # mini stream (64-byte sectors)
-                out = bytearray()
-                for sid in self._chain(e["start"], self._minifat):
-                    lo = sid * self._mini
-                    if lo + self._mini > len(ministream):
-                        raise MetadataError(
-                            f"mini sector {sid} beyond mini stream in {self._name}"
-                        )
-                    out += ministream[lo:lo + self._mini]
-                return bytes(out[:size])
-            return self._read_chain(e["start"])[:size]
-
-        streams: dict[str, bytes] = {}
+    def _walk(self, entries: list) -> dict[str, dict]:
+        paths: dict[str, dict] = {}
         visited: set = set()
         # explicit stack: each storage's children form a binary tree of
         # siblings, and real OIBs hold one stream per plane — a
         # right-leaning chain thousands deep would blow Python's
         # recursion limit
-        stack = [(root["child"], "")]
+        stack = [(entries[0]["child"], "")]
         while stack:
             eid, prefix = stack.pop()
             if eid == _NOSTREAM or eid >= len(entries):
@@ -193,5 +179,36 @@ class CompoundFile:
             if e["type"] == 1:  # storage
                 stack.append((e["child"], path + "/"))
             elif e["type"] == 2:  # stream
-                streams[path] = payload(e)
-        return streams
+                paths.setdefault(path, e)
+        return paths
+
+    def read_stream(self, path: str) -> bytes:
+        """Materialize one stream payload."""
+        e = self._paths.get(path)
+        if e is None:
+            raise MetadataError(f"no stream {path!r} in {self._name}")
+        size = e["size"]
+        if size == 0:
+            return b""
+        if size < self._cutoff:  # mini stream (64-byte sectors)
+            if self._ministream is None:
+                root = self._root
+                self._ministream = (
+                    self._read_chain(root["start"])[: root["size"]]
+                    if root["start"] < _SPECIAL and root["size"] else b""
+                )
+            out = bytearray()
+            for sid in self._chain(e["start"], self._minifat):
+                lo = sid * self._mini
+                if lo + self._mini > len(self._ministream):
+                    raise MetadataError(
+                        f"mini sector {sid} beyond mini stream in {self._name}"
+                    )
+                out += self._ministream[lo:lo + self._mini]
+            return bytes(out[:size])
+        return self._read_chain(e["start"])[:size]
+
+    @property
+    def streams(self) -> dict[str, bytes]:
+        """All payloads at once (small containers, tests)."""
+        return {p: self.read_stream(p) for p in self._paths}

@@ -1,8 +1,8 @@
 """Context-manager readers.
 
 Reference parity: ``tmlib/readers.py`` — ``ImageReader`` (cv2),
-``BFImageReader`` (Bio-Formats via javabridge — out of scope: no JVM;
-vendor ingest goes through metaconfig's filename handlers instead),
+``BFImageReader`` (Bio-Formats via javabridge upstream; here a working
+facade over the first-party container parsers — no JVM),
 ``DatasetReader`` (HDF5/h5py), ``JsonReader``, ``XmlReader``,
 ``TablesReader`` (pandas/HDF) — all usable as context managers.
 
@@ -220,23 +220,41 @@ class ImageReader(Reader):
 
 
 class BFImageReader(Reader):
-    """Bio-Formats reader placeholder.
+    """Bio-Formats-compatible facade over the first-party container
+    readers.
 
     The reference reads vendor microscope formats through the Java
-    Bio-Formats library (``python-bioformats``/``javabridge``).  This image
-    has no JVM; vendor ingest is handled by metaconfig's filename handlers
-    plus plain-TIFF extraction.  Instantiating this reader states that
-    clearly instead of failing deep inside a job.
+    Bio-Formats library (``python-bioformats``/``javabridge``,
+    ``tmlib/readers.py`` ``BFImageReader.read(filename)``).  This image
+    has no JVM; instead the call delegates to the native parsers —
+    Nikon ND2, Zeiss CZI/LSM, Leica LIF, DeltaVision DV/R3D, Imaris IMS,
+    MetaMorph STK, Olympus OIF/OIB, OME-NGFF — and to the plain
+    TIFF/PNG path for everything else, so reference analysis scripts
+    using this class keep working for every format the rebuild models.
+    A genuinely unsupported container still raises a clear
+    :class:`~tmlibrary_tpu.errors.NotSupportedError` up front instead of
+    failing deep inside a job.
     """
 
-    def read(self):
-        raise NotSupportedError(
-            "Bio-Formats is not available (no JVM); Nikon ND2, Zeiss CZI "
-            "and Leica LIF containers read natively (ND2Reader/CZIReader/"
-            "LIFReader + their auto-detected metaconfig handlers) — "
-            "convert other vendor containers to TIFF/PNG and use the "
-            "metaconfig filename handlers"
-        )
+    def read(self, page: int = 0) -> np.ndarray:
+        # MetadataError (corrupt/truncated container) propagates as-is —
+        # it names the structural problem; only "nothing can read this
+        # EXISTING file" becomes the NotSupportedError of the reference's
+        # API contract.  A missing path is a path problem, not a format
+        # problem — advising format conversion for a typo would mislead.
+        try:
+            return ImageReader(self.filename).read(page)
+        except (OSError, ValueError, NotSupportedError) as exc:
+            if not self.filename.exists():
+                raise FileNotFoundError(
+                    f"no such image file: {self.filename}"
+                ) from exc
+            raise NotSupportedError(
+                f"no native reader for {self.filename} (Bio-Formats/JVM "
+                "is not available; supported containers: nd2, czi, lif, "
+                "dv/r3d, ims, stk, lsm, oif/oib, zarr, plus TIFF/PNG) — "
+                "convert other vendor containers to one of these"
+            ) from exc
 
 
 class ND2Reader(Reader):
@@ -1714,18 +1732,24 @@ def _decode_oif_text(raw: bytes) -> str:
 def _parse_oif_dims(text: str) -> dict[str, int]:
     """Axis sizes from an OIF main file: ``[Axis N Parameters Common]``
     sections carry ``AxisCode`` (X/Y/Z/T/C/…) and ``MaxSize``.  Returns
-    ``{axis_code: size}`` with quotes stripped; absent axes are simply
-    missing (callers default C/Z/T to 1)."""
+    ``{axis_code: size}`` for POSITIVE sizes only — FV1000 files declare
+    every axis slot and unused ones carry ``MaxSize=0``, which must not
+    shadow the decode-from-first-plane fallback (X/Y) or the observed
+    plane grid (C/Z/T)."""
     import re as _re
 
     dims: dict[str, int] = {}
     code = size = None
     section_ok = False
+
+    def flush():
+        if section_ok and code and size and size > 0:
+            dims[code] = size
+
     for line in text.splitlines():
         line = line.strip()
         if line.startswith("["):
-            if section_ok and code:
-                dims[code] = size if size and size > 0 else 1
+            flush()
             code = size = None
             section_ok = bool(
                 _re.match(r"\[Axis \d+ Parameters Common\]", line)
@@ -1742,8 +1766,7 @@ def _parse_oif_dims(text: str) -> dict[str, int]:
                 size = int(val)
             except ValueError:
                 size = None
-    if section_ok and code:
-        dims[code] = size if size and size > 0 else 1
+    flush()
     return dims
 
 
@@ -1951,48 +1974,94 @@ class OIBReader(_OlympusBase):
     """
 
     def __enter__(self):
+        import mmap
+
         from tmlibrary_tpu.cfb import CompoundFile
         from tmlibrary_tpu.errors import MetadataError
 
-        # plain bytes, not mmap: every stream is materialized anyway, and
-        # a failed parse would pin the mmap through the exception's
-        # memoryview exports (BufferError on close)
+        # mmap + lazy CompoundFile streams: an open reader holds the
+        # directory tables, not the pixel payloads (the imextract reader
+        # cache keeps up to 64 containers open — see _OPEN_READERS)
+        self._file = open(self.filename, "rb")
         try:
-            raw = self.filename.read_bytes()
-        except OSError as exc:
-            raise MetadataError(
-                f"unreadable OIB file: {self.filename}"
-            ) from exc
-        streams = CompoundFile(raw, self.filename).streams
-        # OibInfo.txt (any storage depth): CFB stream name -> OIF name
-        renames: dict[str, str] = {}
-        for path, payload in streams.items():
-            if path.rsplit("/", 1)[-1].lower() == "oibinfo.txt":
-                for line in _decode_oif_text(payload).splitlines():
-                    key, _, val = line.strip().partition("=")
-                    val = val.strip().strip('"')
-                    if _parse_oif_plane_name(val) or val.lower().endswith(
-                        ".oif"
+            self._data = mmap.mmap(self._file.fileno(), 0,
+                                   access=mmap.ACCESS_READ)
+        except ValueError as exc:
+            self._file.close()
+            self._file = None
+            raise MetadataError(f"empty OIB file: {self.filename}") from exc
+        try:
+            cf = CompoundFile(self._data, self.filename)
+            # OibInfo.txt (any storage depth) maps CFB stream names back
+            # to OIF-tree names.  Keys may be flat (``[OibSaveInfo]``
+            # ``Stream00000=…``) or grouped in per-storage sections
+            # (``[Storage00001]``): when the section names a real
+            # storage, the rename is keyed by the full path so equal
+            # stream basenames in different storages cannot collide.
+            renames: dict[str, str] = {}
+            storages = {
+                p.rsplit("/", 1)[0] for p in cf.stream_paths if "/" in p
+            }
+            for path in cf.stream_paths:
+                if path.rsplit("/", 1)[-1].lower() != "oibinfo.txt":
+                    continue
+                section = ""
+                for line in _decode_oif_text(
+                    cf.read_stream(path)
+                ).splitlines():
+                    line = line.strip()
+                    if line.startswith("[") and line.endswith("]"):
+                        section = line[1:-1]
+                        continue
+                    key, _, val = line.partition("=")
+                    key, val = key.strip(), val.strip().strip('"')
+                    if not (
+                        _parse_oif_plane_name(val)
+                        or val.lower().endswith(".oif")
                     ):
-                        renames.setdefault(key.strip(), val)
-        # first wins, in sorted storage order: OIBs occasionally carry
-        # duplicate preview copies of a plane under a later storage, and
-        # a last-wins dict would silently read those instead
-        named: dict[str, str] = {}
-        for p in sorted(streams):
-            named.setdefault(
-                renames.get(p.rsplit("/", 1)[-1], p.rsplit("/", 1)[-1]), p
+                        continue
+                    full = f"{section}/{key}" if section in storages else key
+                    renames.setdefault(full, val)
+            # resolution: full-path rename, then basename rename, then
+            # the bare basename; first wins in sorted storage order so a
+            # later storage's preview duplicate cannot shadow the
+            # acquisition plane
+            named: dict[str, str] = {}
+            for p in sorted(cf.stream_paths):
+                base = p.rsplit("/", 1)[-1]
+                named.setdefault(renames.get(p, renames.get(base, base)), p)
+            main = next(
+                (n for n in sorted(named) if n.lower().endswith(".oif")),
+                None,
             )
-        main = next(
-            (n for n in sorted(named) if n.lower().endswith(".oif")), None
-        )
-        text = _decode_oif_text(streams[named[main]]) if main else ""
-        self._streams = {name: streams[path] for name, path in named.items()}
-        self._finish_open(text, list(named))
+            text = (
+                _decode_oif_text(cf.read_stream(named[main])) if main else ""
+            )
+            self._cf = cf
+            self._named = named
+            self._finish_open(text, list(named))
+        except MetadataError:
+            self.__exit__()
+            raise
         return self
 
+    def __exit__(self, *exc):
+        self._cf = None
+        if getattr(self, "_data", None) is not None:
+            try:
+                self._data.close()
+            except BufferError:
+                # a failed parse's traceback pins memoryview exports of
+                # the mmap; the mapping is freed when the last view dies
+                pass
+            self._data = None
+        if getattr(self, "_file", None) is not None:
+            self._file.close()
+            self._file = None
+        return False
+
     def _plane_buf(self, name):
-        return self._streams[name], f"{self.filename}:{name}"
+        return self._cf.read_stream(self._named[name]), f"{self.filename}:{name}"
 
 
 class DatasetReader(Reader):
