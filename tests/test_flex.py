@@ -1,0 +1,213 @@
+"""PerkinElmer Opera ``.flex`` container support.
+
+A flex file is one WELL: a paged TIFF whose IFD pages cycle
+channel-fastest through the well's fields, with the FLEX XML document in
+private tag 65200 naming one ``Array`` per page (the ordered unique
+names are the channel set).  ``write_flex`` below builds synthetic
+containers — real ones cannot be fetched in this environment.
+"""
+import struct
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError
+from tmlibrary_tpu.readers import FlexReader
+
+
+def _entry(tag, typ, count, value):
+    return struct.pack("<HHII", tag, typ, count, value)
+
+
+def flex_xml(n_fields, channel_names) -> bytes:
+    arrays = []
+    for _f in range(n_fields):
+        for name in channel_names:
+            arrays.append(f'    <Array Name="{name}"/>')
+    doc = (
+        '<Root xmlns="http://www.perkinelmer.com/flex">\n  <Arrays>\n'
+        + "\n".join(arrays)
+        + "\n  </Arrays>\n</Root>"
+    )
+    return doc.encode()
+
+
+def write_flex(path, planes: np.ndarray, channel_names=("Exp1Cam1",),
+               xml: "bytes | None" = b"auto"):
+    """``planes``: (n_pages, H, W) uint16, channel-fastest page order."""
+    n_pages, h, w = planes.shape
+    if xml == b"auto":
+        assert n_pages % len(channel_names) == 0
+        xml = flex_xml(n_pages // len(channel_names), channel_names)
+    buf = bytearray(b"II*\x00\x00\x00\x00\x00")
+    xml_off = None
+    if xml is not None:
+        xml_off = len(buf)
+        buf += xml
+        if len(buf) % 2:
+            buf += b"\x00"
+    data_offs = []
+    for p in range(n_pages):
+        data_offs.append(len(buf))
+        buf += np.ascontiguousarray(planes[p], "<u2").tobytes()
+    ifd_offs = []
+    next_ptr_pos = []
+    for p in range(n_pages):
+        entries = [
+            _entry(256, 3, 1, w),
+            _entry(257, 3, 1, h),
+            _entry(258, 3, 1, 16),
+            _entry(259, 3, 1, 1),
+            _entry(262, 3, 1, 1),
+            _entry(273, 4, 1, data_offs[p]),
+            _entry(277, 3, 1, 1),
+            _entry(278, 3, 1, h),
+            _entry(279, 4, 1, h * w * 2),
+        ]
+        if xml_off is not None:
+            entries.append(_entry(65200, 2, len(xml), xml_off))
+        entries.sort(key=lambda e: struct.unpack_from("<H", e)[0])
+        ifd_offs.append(len(buf))
+        buf += struct.pack("<H", len(entries)) + b"".join(entries)
+        next_ptr_pos.append(len(buf))
+        buf += b"\x00\x00\x00\x00"
+    struct.pack_into("<I", buf, 4, ifd_offs[0])
+    for p in range(n_pages - 1):
+        struct.pack_into("<I", buf, next_ptr_pos[p], ifd_offs[p + 1])
+    path.write_bytes(bytes(buf))
+    return path
+
+
+@pytest.fixture()
+def planes():
+    rng = np.random.default_rng(41)
+    # 3 fields x 2 channels, channel-fastest
+    return rng.integers(0, 60000, (6, 12, 14), dtype=np.uint16)
+
+
+def test_flex_reader_dims_and_planes(tmp_path, planes):
+    path = write_flex(tmp_path / "001002000.flex", planes,
+                      channel_names=("Exp1Cam1", "Exp2Cam1"))
+    with FlexReader(path) as r:
+        assert (r.n_fields, r.n_channels) == (3, 2)
+        assert r.channel_names == ["Exp1Cam1", "Exp2Cam1"]
+        assert (r.height, r.width) == (12, 14)
+        for f in range(3):
+            for c in range(2):
+                np.testing.assert_array_equal(
+                    r.read_plane(f, c), planes[f * 2 + c]
+                )
+        np.testing.assert_array_equal(r.read_plane_linear(5), planes[5])
+
+
+def test_flex_without_xml_degrades_to_single_channel(tmp_path, planes):
+    path = write_flex(tmp_path / "bare.flex", planes, xml=None)
+    with FlexReader(path) as r:
+        assert (r.n_fields, r.n_channels) == (6, 1)
+        assert r.channel_names is None
+        np.testing.assert_array_equal(r.read_plane(4, 0), planes[4])
+
+
+def test_flex_nonfactoring_xml_degrades(tmp_path, planes):
+    """5 pages with a 2-name XML cannot factor: one channel, 5 fields."""
+    path = write_flex(
+        tmp_path / "odd.flex", planes[:5],
+        xml=flex_xml(2, ("A", "B")) ,
+    )
+    with FlexReader(path) as r:
+        assert (r.n_fields, r.n_channels) == (5, 1)
+
+
+def test_flex_rejects_bad_files(tmp_path, planes):
+    bad = tmp_path / "bad.flex"
+    bad.write_bytes(b"\x00" * 100)
+    with pytest.raises(MetadataError):
+        FlexReader(bad).__enter__()
+    good = write_flex(tmp_path / "good.flex", planes)
+    with FlexReader(good) as r:
+        with pytest.raises(MetadataError):
+            r.read_plane(7, 0)
+        with pytest.raises(MetadataError):
+            r.read_plane_linear(99)
+
+
+def test_flex_ingest_end_to_end(tmp_path, planes):
+    """Opera numeric well names -> metaconfig (auto) -> imextract ->
+    pixels in the canonical store; fields become sites, FLEX Array
+    names become channel labels."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(43)
+    src = tmp_path / "source"
+    src.mkdir()
+    data = {}
+    # Opera numeric names: 001001... -> A01, 002003... -> B03
+    for stem in ("001001000", "002003000"):
+        stack = rng.integers(0, 60000, (6, 12, 14), dtype=np.uint16)
+        write_flex(src / f"{stem}.flex", stack,
+                   channel_names=("Exp1Cam1", "Exp2Cam1"))
+        data[stem] = stack
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="flextest", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    result = meta.run(0)
+    assert result["n_files"] == 2 * 6  # wells x (fields x channels)
+
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 2 * 3
+    assert {c.name for c in exp.channels} == {"Exp1Cam1", "Exp2Cam1"}
+    rows_cols = {(w.row, w.column) for p in exp.plates for w in p.wells}
+    assert rows_cols == {(0, 0), (1, 2)}
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+
+    store = ExperimentStore.open(root)
+    for c in range(2):
+        px = store.read_sites(None, channel=c)
+        assert px.shape == (6, 12, 14)
+        for f in range(3):
+            np.testing.assert_array_equal(
+                px[f], data["001001000"][f * 2 + c]
+            )
+            np.testing.assert_array_equal(
+                px[3 + f], data["002003000"][f * 2 + c]
+            )
+
+
+def test_flex_handler_skips_unreadable(tmp_path, planes):
+    from tmlibrary_tpu.workflow.steps.vendors import flex_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_flex(src / "ok_A01.flex", planes)
+    (src / "003003000.flex").write_bytes(b"\0" * 64)
+    entries, skipped = flex_sidecar(src)
+    assert skipped == 1
+    assert {e["well_row"] for e in entries} == {0}
+    assert len(entries) == 6
+
+
+def test_flex_rgb_falls_back_to_plain_tiff_path(tmp_path):
+    """A .flex the dedicated reader declines (RGB) is still a TIFF: the
+    plain-image path must decode it instead of aborting ingest
+    (_TIFF_FLAVORED fallback, same as .stk/.lsm)."""
+    import cv2
+
+    from tmlibrary_tpu.readers import ImageReader
+
+    rgb = np.zeros((6, 7, 3), np.uint8)
+    rgb[..., 1] = 200
+    path = tmp_path / "rgb.flex"
+    assert cv2.imwrite(str(path.with_suffix(".tif")), rgb)
+    path.with_suffix(".tif").rename(path)
+    out = ImageReader(path).read()
+    assert out.shape == (6, 7)  # cv2 fallback grayscales RGB

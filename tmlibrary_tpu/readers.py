@@ -58,6 +58,8 @@ def _container_reader(path):
         return OIBReader
     if name.endswith(".oif"):
         return OIFReader
+    if name.endswith(".flex"):
+        return FlexReader
     if name.endswith(".zarr"):  # OME-NGFF plate directory (covers .ome.zarr)
         from tmlibrary_tpu.ngff import NGFFReader
 
@@ -96,7 +98,7 @@ _open_readers_lock = _threading.Lock()
 #: TIFF-flavored containers: when the dedicated reader rejects one (RGB,
 #: 32-bit, exotic compression), the file is still a TIFF that the plain
 #: native-TIFF/cv2 path may decode — fall back instead of failing ingest.
-_TIFF_FLAVORED = (".stk", ".lsm")
+_TIFF_FLAVORED = (".stk", ".lsm", ".flex")
 
 
 def _open_container(path):
@@ -228,7 +230,7 @@ class BFImageReader(Reader):
     ``tmlib/readers.py`` ``BFImageReader.read(filename)``).  This image
     has no JVM; instead the call delegates to the native parsers —
     Nikon ND2, Zeiss CZI/LSM, Leica LIF, DeltaVision DV/R3D, Imaris IMS,
-    MetaMorph STK, Olympus OIF/OIB, OME-NGFF — and to the plain
+    MetaMorph STK, Olympus OIF/OIB, Opera FLEX, OME-NGFF — and to the plain
     TIFF/PNG path for everything else, so reference analysis scripts
     using this class keep working for every format the rebuild models.
     A genuinely unsupported container still raises a clear
@@ -252,8 +254,9 @@ class BFImageReader(Reader):
             raise NotSupportedError(
                 f"no native reader for {self.filename} (Bio-Formats/JVM "
                 "is not available; supported containers: nd2, czi, lif, "
-                "dv/r3d, ims, stk, lsm, oif/oib, zarr, plus TIFF/PNG) — "
-                "convert other vendor containers to one of these"
+                "dv/r3d, ims, stk, lsm, oif/oib, flex, zarr, plus "
+                "TIFF/PNG) — convert other vendor containers to one of "
+                "these"
             ) from exc
 
 
@@ -2062,6 +2065,139 @@ class OIBReader(_OlympusBase):
 
     def _plane_buf(self, name):
         return self._cf.read_stream(self._named[name]), f"{self.filename}:{name}"
+
+
+class FlexReader(Reader):
+    """First-party reader for PerkinElmer Opera/Operetta ``.flex``
+    containers — the reference's own instrument class (high-content
+    screening), read upstream through Bio-Formats' FlexReader.
+
+    A ``.flex`` file holds one well: a paged TIFF whose IFD pages cycle
+    channel-fastest through the well's fields, with the acquisition
+    described by the FLEX XML document in private tag 65200.  The
+    channel set is the ordered unique ``Name`` attributes of the XML's
+    ``Array`` elements (one per page, repeating per field); when the XML
+    is absent or does not factor the page count, the file degrades to
+    one channel with pages as fields.
+
+    Linear page convention (shared with the ``flex`` metaconfig
+    handler): ``page = field * n_channels + c`` — the raw IFD index.
+    """
+
+    _FLEX_XML = 65200
+
+    def __enter__(self):
+        import mmap
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+        self._file = open(self.filename, "rb")
+        try:
+            self._data = mmap.mmap(self._file.fileno(), 0,
+                                   access=mmap.ACCESS_READ)
+        except ValueError as exc:
+            self._file.close()
+            self._file = None
+            raise MetadataError(f"empty FLEX file: {self.filename}") from exc
+        try:
+            bo, ifds = _tiff_parse(self._data)
+            self._parse_flex(bo, ifds)
+        except (MetadataError, NotSupportedError):
+            self.__exit__()
+            raise
+        except (KeyError, IndexError, struct.error) as exc:
+            self.__exit__()
+            raise MetadataError(
+                f"corrupt FLEX structure in {self.filename}: {exc}"
+            ) from exc
+        return self
+
+    def _parse_flex(self, bo: str, ifds: list) -> None:
+        from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+        self._bo, self._ifds = bo, ifds
+        buf = self._data
+        first = ifds[0]
+        self.width = _tiff_int(bo, buf, first, 256, 0)
+        self.height = _tiff_int(bo, buf, first, 257, 0)
+        bits = _tiff_int(bo, buf, first, 258, 8)
+        samples = _tiff_int(bo, buf, first, 277, 1)
+        if self.width <= 0 or self.height <= 0:
+            raise MetadataError(f"corrupt FLEX dimensions in {self.filename}")
+        if bits not in (8, 16) or samples != 1:
+            raise NotSupportedError(
+                f"FLEX reader handles 8/16-bit grayscale, got {bits}-bit "
+                f"x{samples} in {self.filename}"
+            )
+        self._dtype = np.dtype(bo + ("u1" if bits == 8 else "u2"))
+        names = self._channel_names_from_xml(bo, buf, first)
+        n_pages = len(ifds)
+        if names and n_pages % len(names) == 0:
+            self.n_channels = len(names)
+            self.channel_names = names
+        else:
+            self.n_channels = 1
+            self.channel_names = None
+        self.n_fields = n_pages // self.n_channels
+
+    def _channel_names_from_xml(self, bo, buf, ifd) -> "list[str] | None":
+        """Ordered unique Array Names of the FLEX document, or None."""
+        entry = ifd.get(self._FLEX_XML)
+        if entry is None:
+            return None
+        typ, cnt, _ = entry
+        if typ not in (1, 2, 7):  # BYTE/ASCII/UNDEFINED
+            return None
+        base = _tiff_value_offset(bo, buf, entry)
+        if base + cnt > len(buf):
+            return None
+        raw = bytes(buf[base:base + cnt]).rstrip(b"\x00")
+        try:
+            root = ElementTree.fromstring(raw.decode("utf-8", "replace"))
+        except ElementTree.ParseError:
+            return None
+        names: list[str] = []
+        for el in root.iter():
+            tag = el.tag.rsplit("}", 1)[-1]
+            if tag == "Array" and el.get("Name"):
+                name = el.get("Name")
+                if name not in names:
+                    names.append(name)
+        return names or None
+
+    def __exit__(self, *exc):
+        if getattr(self, "_data", None) is not None:
+            self._data.close()
+            self._data = None
+        if getattr(self, "_file", None) is not None:
+            self._file.close()
+            self._file = None
+        return False
+
+    def read_plane(self, field: int, channel: int) -> np.ndarray:
+        from tmlibrary_tpu.errors import MetadataError
+
+        if not (0 <= field < self.n_fields
+                and 0 <= channel < self.n_channels):
+            raise MetadataError(
+                f"plane field={field} channel={channel} out of range for "
+                f"{self.filename}: fields={self.n_fields} "
+                f"channels={self.n_channels}"
+            )
+        return self.read_plane_linear(field * self.n_channels + channel)
+
+    def read_plane_linear(self, page: int) -> np.ndarray:
+        from tmlibrary_tpu.errors import MetadataError
+
+        if not 0 <= page < len(self._ifds):
+            raise MetadataError(
+                f"page {page} out of range for {self.filename}: "
+                f"{len(self._ifds)} pages"
+            )
+        return _decode_ifd_plane(self._bo, self._data, self._ifds[page],
+                                 self.width, self.height, self._dtype,
+                                 self.filename)
 
 
 class DatasetReader(Reader):

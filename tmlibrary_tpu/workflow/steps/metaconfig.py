@@ -124,7 +124,7 @@ class MetadataConfigurator(Step):
                  choices=("default", "cellvoyager", "omexml", "metamorph",
                           "harmony", "imagexpress", "scanr", "leica",
                           "nd2", "czi", "lif", "ngff", "dv", "ims", "stk",
-                          "lsm", "olympus", "auto"),
+                          "lsm", "olympus", "flex", "auto"),
                  help="vendor metadata handler (sidecar files preferred, "
                       "filename patterns as fallback)"),
         Argument("pattern", str, default=None,

@@ -1058,11 +1058,13 @@ def _container_entry(path: Path, well: tuple[int, int], site: int,
 def _container_sidecar(
     source_dir: Path, suffix: str, reader_cls, kind: str,
     dims_of: Callable, entries_of: Callable,
+    well_of: "Callable | None" = None,
 ) -> tuple[list[dict], int] | None:
     """Shared scan -> skip-unreadable -> assign-wells -> emit loop of the
     one-file-per-well container handlers (nd2/czi/lif/dv); only the
     reader, the dims tuple and the page formula differ per format.
-    ``suffix`` may be one extension or a tuple of them."""
+    ``suffix`` may be one extension or a tuple of them; ``well_of``
+    overrides the default well-token parse (flex: Opera numeric names)."""
     suffixes = (suffix,) if isinstance(suffix, str) else suffix
     files = sorted(
         p for suf in suffixes for p in source_dir.rglob(f"*{suf}")
@@ -1082,7 +1084,9 @@ def _container_sidecar(
             logger.warning("skipping unreadable %s file %s: %s", kind, path, exc)
             skipped += 1
             continue
-        readable.append((path, dims, parse_well_token(path.stem)))
+        readable.append(
+            (path, dims, (well_of or parse_well_token)(path.stem))
+        )
     entries: list[dict] = []
     for path, dims, well in assign_container_wells(readable, kind):
         entries.extend(entries_of(path, dims, well))
@@ -1424,4 +1428,52 @@ def olympus_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
     return _container_sidecar(
         source_dir, (".oif", ".oib"), open_either, "Olympus",
         lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints), entries_of,
+    )
+
+
+# ---------------------------------------------------------------------- flex
+@register_sidecar_handler("flex")
+def flex_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
+    """PerkinElmer Opera/Operetta ``.flex`` containers, read by
+    :class:`tmlibrary_tpu.readers.FlexReader` (paged TIFF + FLEX XML in
+    tag 65200) — the reference's own instrument class (high-content
+    screening; upstream reads these through Bio-Formats' FlexReader).
+
+    One file per well; unlike the other containers a flex file carries
+    SEVERAL fields (sites) whose pages cycle channel-fastest, so
+    ``site = page // C`` and ``page = field * C + c``.  Wells come from
+    a filename token (``A01``) or the Opera numeric convention
+    (``rrrcccfff…`` digit stems: first three digits = 1-based row, next
+    three = column); token-less files take the next free column on row
+    A.  Channel labels come from the FLEX Array names when present."""
+    from tmlibrary_tpu.readers import FlexReader
+
+    def opera_well(stem: str) -> "tuple[int, int] | None":
+        token = parse_well_token(stem)
+        if token is not None:
+            return token
+        digits = re.match(r"(\d{3})(\d{3})\d*$", stem)
+        if digits:
+            row, col = int(digits.group(1)), int(digits.group(2))
+            if row >= 1 and col >= 1:
+                return row - 1, col - 1
+        return None
+
+    def entries_of(path, dims, well):
+        n_fields, n_c, names = dims
+        out = []
+        for c in range(n_c):
+            label = sanitize_channel_label(names, c)
+            for f in range(n_fields):
+                e = _container_entry(path, well, site=f, channel=c,
+                                     zplane=0, tpoint=0,
+                                     page=f * n_c + c)
+                e["channel"] = label
+                out.append(e)
+        return out
+
+    return _container_sidecar(
+        source_dir, ".flex", FlexReader, "FLEX",
+        lambda r: (r.n_fields, r.n_channels, r.channel_names),
+        entries_of, well_of=opera_well,
     )
