@@ -211,3 +211,43 @@ def test_flex_rgb_falls_back_to_plain_tiff_path(tmp_path):
     path.with_suffix(".tif").rename(path)
     out = ImageReader(path).read()
     assert out.shape == (6, 7)  # cv2 fallback grayscales RGB
+
+
+def test_cli_inspect_reports_container_dims(tmp_path, planes, capsys):
+    """tmx inspect = the Bio-Formats showinf role on the native parsers."""
+    import json
+
+    from tmlibrary_tpu.cli import main
+
+    path = write_flex(tmp_path / "001001000.flex", planes,
+                      channel_names=("DAPI", "GFP"))
+    assert main(["inspect", "--json", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["format"] == "Flex"
+    assert (out["n_fields"], out["n_channels"]) == (3, 2)
+    assert out["channel_names"] == ["DAPI", "GFP"]
+    assert (out["height"], out["width"]) == (12, 14)
+
+    bad = tmp_path / "junk.xyz"
+    bad.write_bytes(b"zz")
+    assert main(["inspect", "--json", str(bad)]) == 1
+    assert "error" in json.loads(capsys.readouterr().out.strip())
+
+
+def test_cli_inspect_declined_flex_falls_back_like_ingest(tmp_path, capsys):
+    """An RGB .flex the dedicated reader declines must inspect through
+    the plain-image fallback, same as ingest."""
+    import json
+
+    import cv2
+
+    from tmlibrary_tpu.cli import main
+
+    rgb = np.zeros((6, 7, 3), np.uint8)
+    path = tmp_path / "rgb.flex"
+    assert cv2.imwrite(str(path.with_suffix(".tif")), rgb)
+    path.with_suffix(".tif").rename(path)
+    assert main(["inspect", "--json", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["format"] == "image"
+    assert (out["height"], out["width"]) == (6, 7)

@@ -54,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(p_create)
     p_create.add_argument("--name", required=True)
 
+    p_inspect = sub.add_parser(
+        "inspect",
+        help="print a microscope file's dimensions/channels (the "
+             "Bio-Formats 'showinf' role, on the native parsers)")
+    p_inspect.add_argument("files", nargs="+")
+    p_inspect.add_argument("--json", action="store_true", dest="as_json",
+                           help="one JSON object per file")
+
     p_log = sub.add_parser("log", help="show the run ledger or captured step logs")
     _add_common(p_log)
     p_log.add_argument("--tail", type=int, default=20)
@@ -252,6 +260,67 @@ def _cleanup_step(step) -> None:
     step.delete_previous_output()
     for p in step.step_dir.glob("batch_*.json"):
         p.unlink()
+
+
+#: reader attributes surfaced by ``tmx inspect`` (whichever exist)
+_INSPECT_ATTRS = (
+    "height", "width", "n_channels", "n_zplanes", "n_tpoints",
+    "n_series", "n_scenes", "n_sequences", "n_components", "n_fields",
+)
+
+
+def cmd_inspect(args) -> int:
+    """Bio-Formats ``showinf`` equivalent over the first-party parsers
+    (reference users inspect vendor files with showinf before ingest;
+    SURVEY.md §3 Readers row).  Prints dims/channels per file; exits
+    non-zero if any file could not be read."""
+    import json as _json
+
+    from tmlibrary_tpu import readers as _readers
+
+    failed = 0
+    for name in args.files:
+        path = Path(name)
+        info: dict = {"file": str(path)}
+        try:
+            # _open_container, not _container_reader: a TIFF-flavored
+            # container the dedicated reader declines (RGB .flex/.stk)
+            # must fall to the plain-image branch exactly like ingest does
+            r = _readers._open_container(path)
+            if r is not None:
+                try:
+                    info["format"] = type(r).__name__.replace("Reader", "")
+                    for attr in _INSPECT_ATTRS:
+                        val = getattr(r, attr, None)
+                        if val is not None:
+                            info[attr] = int(val)
+                    names = getattr(r, "channel_names", None)
+                    if callable(names):
+                        names = names()
+                    if names:
+                        info["channel_names"] = list(names)
+                finally:
+                    r.__exit__()
+            else:
+                plane = _readers.ImageReader(path).read(0)
+                info["format"] = "image"
+                info["height"], info["width"] = map(int, plane.shape[:2])
+                info["dtype"] = str(plane.dtype)
+        except Exception as exc:
+            info["error"] = str(exc)
+            failed += 1
+        if args.as_json:
+            print(_json.dumps(info))
+        else:
+            head = f"{info['file']}: " + (
+                f"ERROR {info['error']}" if "error" in info
+                else info.get("format", "?")
+            )
+            print(head)
+            for key, val in info.items():
+                if key not in ("file", "format", "error"):
+                    print(f"  {key:14s} {val}")
+    return 1 if failed else 0
 
 
 def cmd_create(args) -> int:
@@ -734,6 +803,8 @@ def main(argv=None) -> int:
             return cmd_tool(args)
         if args.command == "project":
             return cmd_project(args)
+        if args.command == "inspect":
+            return cmd_inspect(args)
         if args.command == "log":
             return cmd_log(args)
         if args.command == "export":
