@@ -60,9 +60,12 @@ def _pad(b: bytes, unit: int) -> bytes:
     return b + b"\x00" * (unit - rem) if rem else b
 
 
-def write_cfb(files: "dict[str, bytes]") -> bytes:
-    """CFB v3 container holding ``files`` ("Storage/Stream" paths allowed,
-    one nesting level).  Streams < 4096 bytes land in the mini stream."""
+def write_cfb(files: "dict[str, bytes]", sect: int = SECT) -> bytes:
+    """CFB container holding ``files`` ("Storage/Stream" paths allowed,
+    one nesting level).  Streams < 4096 bytes land in the mini stream.
+    ``sect``: 512 (v3, default) or 4096 (v4)."""
+    assert sect in (512, 4096)
+    per_fat = sect // 4
     # ---- directory tree -------------------------------------------------
     entries: list[dict] = [dict(
         name="Root Entry", type=5, left=FREE, right=FREE, child=FREE,
@@ -126,22 +129,22 @@ def write_cfb(files: "dict[str, bytes]") -> bytes:
         struct.pack_into("<I", ent, 116, e["start"] & 0xFFFFFFFF)
         struct.pack_into("<Q", ent, 120, e["size"])
         dir_raw += ent
-    n_dir = len(_pad(bytes(dir_raw), SECT)) // SECT
+    n_dir = len(_pad(bytes(dir_raw), sect)) // sect
 
     minifat_raw = b"".join(struct.pack("<I", v) for v in minifat)
-    n_minifat = len(_pad(minifat_raw, SECT)) // SECT if minifat else 0
-    mini_raw = _pad(bytes(mini_payload), SECT)
-    n_mini = len(mini_raw) // SECT
-    n_large = [len(_pad(p, SECT)) // SECT for _, p in large]
+    n_minifat = len(_pad(minifat_raw, sect)) // sect if minifat else 0
+    mini_raw = _pad(bytes(mini_payload), sect)
+    n_mini = len(mini_raw) // sect
+    n_large = [len(_pad(p, sect)) // sect for _, p in large]
 
     body = n_dir + n_minifat + n_mini + sum(n_large)
     n_fat = 1
-    while (body + n_fat + 127) // 128 > n_fat:
+    while (body + n_fat + per_fat - 1) // per_fat > n_fat:
         n_fat += 1
     total = body + n_fat
 
     # sector order: [FAT][dir][miniFAT][ministream][large...]
-    fat = [FREE] * (n_fat * 128)
+    fat = [FREE] * (n_fat * per_fat)
     nxt = 0
     for i in range(n_fat):
         fat[nxt] = FATSECT
@@ -178,12 +181,12 @@ def write_cfb(files: "dict[str, bytes]") -> bytes:
         struct.pack_into("<Q", ent, 120, e["size"])
         dir_raw += ent
 
-    header = bytearray(512)
+    header = bytearray(sect)  # v3: header == one 512-byte sector; v4: padded
     header[:8] = b"\xd0\xcf\x11\xe0\xa1\xb1\x1a\xe1"
     struct.pack_into("<H", header, 24, 0x3E)
-    struct.pack_into("<H", header, 26, 3)
+    struct.pack_into("<H", header, 26, 3 if sect == 512 else 4)
     struct.pack_into("<H", header, 28, 0xFFFE)
-    struct.pack_into("<H", header, 30, 9)
+    struct.pack_into("<H", header, 30, 9 if sect == 512 else 12)
     struct.pack_into("<H", header, 32, 6)
     struct.pack_into("<I", header, 44, n_fat)
     struct.pack_into("<I", header, 48, dir_start)
@@ -198,13 +201,13 @@ def write_cfb(files: "dict[str, bytes]") -> bytes:
 
     out = bytearray(header)
     out += b"".join(struct.pack("<I", v) for v in fat)
-    out += _pad(bytes(dir_raw), SECT)
+    out += _pad(bytes(dir_raw), sect)
     if n_minifat:
-        out += _pad(minifat_raw, SECT)
+        out += _pad(minifat_raw, sect)
     out += mini_raw
     for (_, payload), n in zip(large, n_large):
-        out += _pad(payload, SECT)
-    assert len(out) == 512 + total * SECT
+        out += _pad(payload, sect)
+    assert len(out) == sect + total * sect
     return bytes(out)
 
 
@@ -483,3 +486,31 @@ def test_cfb_lazy_stream_api():
     assert cf.read_stream("y.txt") == b"hi"
     with pytest.raises(MetadataError):
         cf.read_stream("missing")
+
+
+def test_cfb_v4_4096_byte_sectors(tmp_path):
+    """Version-4 compound files (4096-byte sectors) parse identically —
+    the OIB path is v3 in practice but the parser claims both."""
+    small = b"mini stream payload"
+    big = bytes(np.arange(9000, dtype=np.uint8) % 253)
+    blob = write_cfb({"S/big.bin": big, "small.txt": small}, sect=4096)
+    cf = CompoundFile(blob)
+    assert cf.read_stream("small.txt") == small
+    assert cf.read_stream("S/big.bin") == big
+
+    rng = np.random.default_rng(51)
+    stack = rng.integers(0, 60000, (1, 2, 1, 8, 9), dtype=np.uint16)
+    # an OIB written as v4 still reads end-to-end
+    prefix = "Storage00001/"
+    files = {
+        prefix + plane_name(0, z, 0): tiff_bytes(stack[0, z, 0])
+        for z in range(2)
+    }
+    files[prefix + "main.oif"] = b"\xff\xfe" + oif_text(
+        9, 8, 1, 2, 1
+    ).encode("utf-16-le")
+    path = tmp_path / "v4.oib"
+    path.write_bytes(write_cfb(files, sect=4096))
+    with OIBReader(path) as r:
+        assert (r.n_channels, r.n_zplanes, r.n_tpoints) == (1, 2, 1)
+        np.testing.assert_array_equal(r.read_plane(0, 1, 0), stack[0, 1, 0])

@@ -274,8 +274,6 @@ def cmd_inspect(args) -> int:
     (reference users inspect vendor files with showinf before ingest;
     SURVEY.md §3 Readers row).  Prints dims/channels per file; exits
     non-zero if any file could not be read."""
-    import json as _json
-
     from tmlibrary_tpu import readers as _readers
 
     failed = 0
@@ -310,7 +308,7 @@ def cmd_inspect(args) -> int:
             info["error"] = str(exc)
             failed += 1
         if args.as_json:
-            print(_json.dumps(info))
+            print(json.dumps(info))
         else:
             head = f"{info['file']}: " + (
                 f"ERROR {info['error']}" if "error" in info
