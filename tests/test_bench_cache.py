@@ -144,3 +144,38 @@ def test_cache_defaulted_workload_mismatch_rejected(tmp_path):
     # the default workload (max_objects=64) must win despite being staler
     assert out["value"] == 100.0
     assert out["max_objects"] == 64
+
+
+def test_cache_rejected_on_pipeline_depth_mismatch(tmp_path):
+    """A depth-8 record must not serve an explicit BENCH_PIPELINE=1
+    request (the methodology changes the measured value)."""
+    path = tmp_path / "BENCH_TPU.json"
+    record = {
+        "metric": "jterator_cell_painting_sites_per_sec_per_chip",
+        "value": 400.0, "vs_baseline": 7.5, "unit": "u",
+        "backend": "axon", "config": "3", "batch": 64,
+        "max_objects": 64, "site_size": 256, "pipeline_depth": 8,
+    }
+    path.write_text(json.dumps({"records": {"3": {
+        "record": record, "measured_at": "t",
+        "measured_at_unix": time.time() - 60, "provenance": "t",
+    }}}))
+    out = _run_bench({
+        "BENCH_TPU_CACHE": str(path),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "64",
+        "BENCH_PIPELINE": "1",
+        "BENCH_REPS": "1",
+    })
+    assert out.get("backend") != "tpu_cached"
+
+    # …but the SAME record serves the default request (depth 8 on TPU)
+    out2 = _run_bench({
+        "BENCH_TPU_CACHE": str(path),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "64",
+    })
+    if out2.get("backend") == "tpu_cached":
+        assert out2["value"] == 400.0
