@@ -30,7 +30,25 @@ def _entry(pixel_type, file_pos, compression, dims) -> bytes:
     return out
 
 
-def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0) -> None:
+def _compress(data: bytes, compression: int, hilo: bool = False) -> bytes:
+    """Test-side encode for zstd0 (5) / zstd1 (6, with optional hi-lo
+    byte packing) subblock payloads."""
+    import zstandard
+
+    if compression == 0:
+        return data
+    if hilo:
+        a = np.frombuffer(data, "<u2")
+        data = (a & 0xFF).astype(np.uint8).tobytes() + (a >> 8).astype(
+            np.uint8).tobytes()
+    frame = zstandard.ZstdCompressor().compress(data)
+    if compression == 6:
+        return bytes([3, 1, int(hilo)]) + frame
+    return frame
+
+
+def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0,
+              hilo=False) -> None:
     """``planes``: (S, C, H, W) uint16 — one z-plane, one tpoint."""
     n_s, n_c, h, w = planes.shape
     blob = bytearray()
@@ -45,7 +63,7 @@ def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0) -> None:
                     ("T", 0, 1), ("S", s, 1)]
             file_pos = len(blob)
             entry = _entry(pixel_type, file_pos, compression, dims)
-            data = planes[s, c].tobytes()
+            data = _compress(planes[s, c].tobytes(), compression, hilo)
             sub_payload = bytearray(struct.pack("<iiq", 0, 0, len(data)))
             sub_payload += entry
             pad = max(256, 16 + len(entry)) - len(sub_payload)
@@ -195,3 +213,44 @@ def test_czi_nonzero_based_z_normalized(tmp_path):
         assert r.n_zplanes == 3
         for zi in range(3):
             np.testing.assert_array_equal(r.read_plane(0, 0, zplane=zi), vols[zi])
+
+
+@pytest.mark.parametrize("compression,hilo", [(5, False), (6, False), (6, True)])
+def test_czi_zstd_subblocks_round_trip(tmp_path, planes, compression, hilo):
+    """zstd0 and zstd1 (with and without hi-lo byte packing) decode
+    bit-identically — the modern ZEN compression default."""
+    path = tmp_path / "z.czi"
+    write_czi(path, planes, compression=compression, hilo=hilo)
+    with CZIReader(path) as r:
+        for s in range(3):
+            for c in range(2):
+                np.testing.assert_array_equal(
+                    r.read_plane(s, c), planes[s, c]
+                )
+
+
+def test_czi_corrupt_zstd_rejected(tmp_path, planes):
+    path = tmp_path / "bad.czi"
+    write_czi(path, planes, compression=5)
+    blob = bytearray(path.read_bytes())
+    # stomp on the first subblock's compressed bytes
+    pos = blob.find(b"ZISRAWSUBBLOCK") + 300
+    blob[pos:pos + 40] = b"\xff" * 40
+    path.write_bytes(bytes(blob))
+    with CZIReader(path) as r:
+        with pytest.raises(MetadataError):
+            r.read_plane(0, 0)
+
+
+def test_czi_zstd_bomb_rejected_before_allocation(tmp_path):
+    """A small frame declaring a huge decompressed size must be rejected
+    up front — max_output_size does NOT cap frames with an embedded
+    content size, so the naive path would allocate it in full."""
+    import zstandard
+
+    from tmlibrary_tpu.readers import _czi_zstd_plane
+
+    bomb = zstandard.ZstdCompressor().compress(b"\x00" * 50_000_000)
+    assert len(bomb) < 10_000  # it really is a bomb
+    with pytest.raises(MetadataError, match="declares"):
+        _czi_zstd_plane(bomb, 8, 8, False, "bomb.czi")

@@ -532,12 +532,78 @@ class ND2Reader(Reader):
             ) from exc
 
 
+def _czi_zstd_plane(raw: bytes, h: int, w: int, zstd1: bool,
+                    filename) -> np.ndarray:
+    """Decode a zstd-compressed Gray16 CZI subblock payload.
+
+    ``zstd0`` (compression id 5) is a bare zstd frame.  ``zstd1``
+    (id 6, the modern ZEN default) prefixes a small header — byte 0 is
+    the header size including itself, followed by (field-id, value)
+    byte pairs — whose field 1 is the hi-lo-byte-packing flag: when
+    set, the UNCOMPRESSED stream stores all low bytes then all high
+    bytes (libCZI's hiLoByteUnpackPreprocessing) and must be
+    re-interleaved.  Layout per the public libCZI zstd conventions.
+    """
+    from tmlibrary_tpu.errors import MetadataError
+
+    try:
+        import zstandard
+    except ImportError as exc:  # keep the skip-on-MetadataError contract
+        raise MetadataError(
+            f"zstd-compressed subblock in {filename} but the zstandard "
+            "codec is not installed"
+        ) from exc
+
+    expect = 2 * h * w
+    hilo = False
+    if zstd1:
+        if not raw or raw[0] < 1 or raw[0] > len(raw):
+            raise MetadataError(f"corrupt zstd1 subblock header in {filename}")
+        fields = raw[1:raw[0]]
+        for i in range(0, len(fields) - 1, 2):
+            if fields[i] == 1:
+                hilo = bool(fields[i + 1])
+        raw = raw[raw[0]:]
+    try:
+        # max_output_size only caps frames WITHOUT an embedded content
+        # size — a few-KB frame declaring multi-GB would be allocated in
+        # full before the length check, OOM-killing the ingest worker.
+        # Reject a wrong declared size up front (-1 = not declared).
+        declared = zstandard.frame_content_size(raw)
+        if declared not in (-1, expect):
+            raise MetadataError(
+                f"zstd subblock in {filename} declares {declared} bytes, "
+                f"expected {expect}"
+            )
+        out = zstandard.ZstdDecompressor().decompress(
+            raw, max_output_size=expect
+        )
+    except zstandard.ZstdError as exc:
+        raise MetadataError(
+            f"corrupt zstd subblock in {filename}: {exc}"
+        ) from exc
+    if len(out) != expect:
+        raise MetadataError(
+            f"zstd subblock in {filename} decodes to {len(out)} bytes, "
+            f"expected {expect}"
+        )
+    if hilo:
+        half = expect // 2
+        lo = np.frombuffer(out, np.uint8, count=half)
+        hi = np.frombuffer(out, np.uint8, count=half, offset=half)
+        return (
+            lo.astype(np.uint16) | (hi.astype(np.uint16) << 8)
+        ).reshape(h, w)
+    return np.frombuffer(out, "<u2").reshape(h, w).copy()
+
+
 class CZIReader(Reader):
     """First-party reader for Zeiss ``.czi`` containers (ZISRAW layout).
 
     Second entry in the Bio-Formats-gap program (after
     :class:`ND2Reader`): covers the common high-content layout — scene
-    (S) × channel (C) × z (Z) × time (T) uncompressed Gray16 subblocks.
+    (S) × channel (C) × z (Z) × time (T) Gray16 subblocks
+    (uncompressed or zstd).
 
     Container structure parsed here:
 
@@ -553,8 +619,10 @@ class CZIReader(Reader):
       data_size`` + its own directory entry; pixel data starts at payload
       offset ``max(256, 16 + entry_size) + metadata_size``.
 
-    Only uncompressed Gray16 planes decode; compressed (JPEG-XR/zstd),
-    float, or mosaic-tiled (M-dimension) files raise
+    Gray16 planes decode uncompressed or zstd-compressed (zstd0/zstd1
+    with hi-lo byte packing — the modern ZEN default, see
+    :func:`_czi_zstd_plane`); JPEG/JPEG-XR-compressed, float, or
+    mosaic-tiled (M-dimension) files raise
     :class:`~tmlibrary_tpu.errors.MetadataError` with a clear message.
     """
 
@@ -722,10 +790,14 @@ class CZIReader(Reader):
                 f"{self.filename}: no subblock for "
                 f"scene={scene} channel={channel} z={zplane} t={tpoint}"
             )
-        if plane["compression"] != 0:
+        compression = plane["compression"]
+        if compression not in (0, 5, 6):
+            # 1 = JPEG, 4 = JPEG-XR: no native decoder in this image;
+            # 5/6 = zstd0/zstd1, the modern ZEN default, decoded below
             raise MetadataError(
                 f"{self.filename}: compressed CZI subblocks "
-                f"(compression={plane['compression']}) are not supported"
+                f"(compression={compression}) are not supported "
+                "(zstd0/zstd1 are; JPEG/JPEG-XR are not)"
             )
         if plane["pixel_type"] != self._GRAY16:
             raise MetadataError(
@@ -758,6 +830,16 @@ class CZIReader(Reader):
         data_off = payload_off + max(256, 16 + entry_end) + meta_size
         h, w = plane["h"], plane["w"]
         expect = 2 * h * w
+        if compression != 0:
+            if data_size <= 0 or data_off + data_size > len(self._data):
+                raise MetadataError(
+                    f"{self.filename}: compressed subblock claims "
+                    f"{data_size} bytes, {len(self._data) - data_off} in file"
+                )
+            raw = bytes(self._data[data_off:data_off + data_size])
+            return _czi_zstd_plane(
+                raw, h, w, compression == 6, self.filename
+            )
         if data_size < expect or data_off + expect > len(self._data):
             # data_size is the writer's CLAIM; a truncated file can keep an
             # intact directory while the pixels run past EOF
