@@ -19,10 +19,10 @@ def _segment(sid: bytes, payload: bytes) -> bytes:
     return header + payload
 
 
-def _entry(pixel_type, file_pos, compression, dims) -> bytes:
+def _entry(pixel_type, file_pos, compression, dims, pyramid=0) -> bytes:
     """dims: list of (name, start, size)."""
     out = b"DV" + struct.pack("<iqii", pixel_type, file_pos, 0, compression)
-    out += b"\x00" * 6  # PyramidType + reserved
+    out += bytes([pyramid]) + b"\x00" * 5  # PyramidType + reserved
     out += struct.pack("<i", len(dims))
     for name, start, size in dims:
         out += name.encode().ljust(4, b"\x00")
@@ -48,29 +48,48 @@ def _compress(data: bytes, compression: int, hilo: bool = False) -> bytes:
 
 
 def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0,
-              hilo=False) -> None:
-    """``planes``: (S, C, H, W) uint16 — one z-plane, one tpoint."""
-    n_s, n_c, h, w = planes.shape
+              hilo=False, n_tiles=1, with_pyramid=False,
+              global_m=False) -> None:
+    """``planes``: (S, C, H, W) uint16 — one z-plane, one tpoint.  With
+    ``n_tiles`` > 1 the S axis is reinterpreted as S*M (mosaic tiles,
+    S fastest-outer): planes[s*M+m] carries dims S=s, M=m.  With
+    ``with_pyramid`` a half-size pyramid copy of each subblock is
+    interleaved (must be skipped by the reader)."""
+    n_sm, n_c, h, w = planes.shape
+    assert n_sm % n_tiles == 0
     blob = bytearray()
     # file header segment: payload with directory position at offset 36
     file_payload = bytearray(512)
     blob.extend(_segment(b"ZISRAWFILE", bytes(file_payload)))
 
+    def add_subblock(data, dims, pyramid=0):
+        file_pos = len(blob)
+        entry = _entry(pixel_type, file_pos, compression, dims, pyramid)
+        sub_payload = bytearray(struct.pack("<iiq", 0, 0, len(data)))
+        sub_payload += entry
+        pad = max(256, 16 + len(entry)) - len(sub_payload)
+        sub_payload += b"\x00" * pad
+        sub_payload += data
+        blob.extend(_segment(b"ZISRAWSUBBLOCK", bytes(sub_payload)))
+        entries.append(_entry(pixel_type, file_pos, compression, dims, pyramid))
+
     entries = []
-    for s in range(n_s):
+    for sm in range(n_sm):
+        s, m = divmod(sm, n_tiles)
         for c in range(n_c):
             dims = [("X", 0, w), ("Y", 0, h), ("C", c, 1), ("Z", 0, 1),
                     ("T", 0, 1), ("S", s, 1)]
-            file_pos = len(blob)
-            entry = _entry(pixel_type, file_pos, compression, dims)
-            data = _compress(planes[s, c].tobytes(), compression, hilo)
-            sub_payload = bytearray(struct.pack("<iiq", 0, 0, len(data)))
-            sub_payload += entry
-            pad = max(256, 16 + len(entry)) - len(sub_payload)
-            sub_payload += b"\x00" * pad
-            sub_payload += data
-            blob.extend(_segment(b"ZISRAWSUBBLOCK", bytes(sub_payload)))
-            entries.append(_entry(pixel_type, file_pos, compression, dims))
+            if n_tiles > 1:
+                dims.append(("M", sm if global_m else m, 1))
+            add_subblock(
+                _compress(planes[sm, c].tobytes(), compression, hilo), dims)
+            if with_pyramid:
+                half = planes[sm, c][::2, ::2]
+                pdims = [("X", 0, half.shape[1]), ("Y", 0, half.shape[0]),
+                         ("C", c, 1), ("Z", 0, 1), ("T", 0, 1), ("S", s, 1)]
+                add_subblock(
+                    _compress(half.tobytes(), compression, hilo), pdims,
+                    pyramid=1)
 
     dir_pos = len(blob)
     dir_payload = struct.pack("<i", len(entries)) + b"\x00" * 124
@@ -254,3 +273,102 @@ def test_czi_zstd_bomb_rejected_before_allocation(tmp_path):
     assert len(bomb) < 10_000  # it really is a bomb
     with pytest.raises(MetadataError, match="declares"):
         _czi_zstd_plane(bomb, 8, 8, False, "bomb.czi")
+
+
+def test_czi_mosaic_tiles_map_to_planes(tmp_path):
+    """M-dimension mosaic tiles (slide scans) read per tile and through
+    the (((s*M+m)*C+c)*Z+z)*T+t linear convention."""
+    rng = np.random.default_rng(47)
+    planes = rng.integers(0, 4000, (4, 2, 10, 12), dtype=np.uint16)
+    path = tmp_path / "mosaic.czi"
+    write_czi(path, planes, n_tiles=2)  # 2 scenes x 2 tiles
+    with CZIReader(path) as r:
+        assert (r.n_scenes, r.n_tiles, r.n_channels) == (2, 2, 2)
+        for s in range(2):
+            for m in range(2):
+                for c in range(2):
+                    np.testing.assert_array_equal(
+                        r.read_plane(s, c, tile=m), planes[s * 2 + m, c]
+                    )
+                    np.testing.assert_array_equal(
+                        r.read_plane_linear((s * 2 + m) * 2 + c),
+                        planes[s * 2 + m, c],
+                    )
+
+
+def test_czi_pyramid_subblocks_skipped(tmp_path):
+    rng = np.random.default_rng(48)
+    planes = rng.integers(0, 4000, (2, 1, 10, 12), dtype=np.uint16)
+    path = tmp_path / "pyr.czi"
+    write_czi(path, planes, with_pyramid=True)
+    with CZIReader(path) as r:
+        assert (r.n_scenes, r.n_tiles, r.n_channels) == (2, 1, 1)
+        assert (r.height, r.width) == (10, 12)  # not the half-size copy
+        for s in range(2):
+            np.testing.assert_array_equal(r.read_plane(s, 0), planes[s, 0])
+
+
+def test_czi_mosaic_ingest_end_to_end(tmp_path):
+    """Mosaic tiles become sites in the canonical store."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(49)
+    planes = rng.integers(0, 60000, (3, 1, 16, 20), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    write_czi(src / "slide_A01.czi", planes, n_tiles=3)  # 1 scene x 3 tiles
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="mosaic", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    meta.run(0)
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 3
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+    st = ExperimentStore.open(root)
+    px = st.read_sites(None, channel=0)
+    for m in range(3):
+        np.testing.assert_array_equal(px[m], planes[m, 0])
+
+
+def test_czi_global_tile_numbering_ranks_per_scene(tmp_path):
+    """ZEN commonly numbers M globally across scenes (scene 0: 0..1,
+    scene 1: 2..3); tiles must rank per scene, not globally."""
+    rng = np.random.default_rng(53)
+    planes = rng.integers(0, 4000, (4, 1, 10, 12), dtype=np.uint16)
+    path = tmp_path / "global_m.czi"
+    write_czi(path, planes, n_tiles=2, global_m=True)
+    with CZIReader(path) as r:
+        assert (r.n_scenes, r.n_tiles) == (2, 2)
+        for s in range(2):
+            for m in range(2):
+                np.testing.assert_array_equal(
+                    r.read_plane(s, 0, tile=m), planes[s * 2 + m, 0]
+                )
+
+
+def test_czi_sparse_grid_rejected_at_open(tmp_path):
+    """A missing (scene, tile) subblock must fail the OPEN (handler
+    skips with a logged reason), not crash mid-extract."""
+    rng = np.random.default_rng(54)
+    planes = rng.integers(0, 4000, (4, 1, 10, 12), dtype=np.uint16)
+    path = tmp_path / "sparse.czi"
+    write_czi(path, planes, n_tiles=2)
+    blob = bytearray(path.read_bytes())
+    # chop the LAST directory entry by rewriting the count
+    dirpos = blob.rfind(b"ZISRAWDIRECTORY")
+    payload = dirpos + 32
+    (count,) = struct.unpack_from("<i", blob, payload)
+    struct.pack_into("<i", blob, payload, count - 1)
+    path.write_bytes(bytes(blob))
+    with pytest.raises(MetadataError, match="sparse"):
+        CZIReader(path).__enter__()

@@ -265,7 +265,8 @@ def _cleanup_step(step) -> None:
 #: reader attributes surfaced by ``tmx inspect`` (whichever exist)
 _INSPECT_ATTRS = (
     "height", "width", "n_channels", "n_zplanes", "n_tpoints",
-    "n_series", "n_scenes", "n_sequences", "n_components", "n_fields",
+    "n_series", "n_scenes", "n_tiles", "n_sequences", "n_components",
+    "n_fields",
 )
 
 

@@ -70,7 +70,7 @@ def _container_reader(path):
 def _container_plane(reader, page: int) -> np.ndarray:
     """One plane from an OPEN container reader by the linear page index
     its metaconfig handler writes (the single home of that convention:
-    ND2 ``seq * n_components + comp``, CZI ``((s*C+c)*Z+z)*T+t``,
+    ND2 ``seq * n_components + comp``, CZI ``(((s*M+m)*C+c)*Z+z)*T+t``,
     LIF ``series * C*Z*T + (c*Z+z)*T + t``)."""
     if isinstance(reader, ND2Reader):
         seq, comp = divmod(page, reader.n_components)
@@ -621,8 +621,9 @@ class CZIReader(Reader):
 
     Gray16 planes decode uncompressed or zstd-compressed (zstd0/zstd1
     with hi-lo byte packing — the modern ZEN default, see
-    :func:`_czi_zstd_plane`); JPEG/JPEG-XR-compressed, float, or
-    mosaic-tiled (M-dimension) files raise
+    :func:`_czi_zstd_plane`); mosaic tiles (M dimension, slide scans)
+    read per tile with pyramid copies skipped; JPEG/JPEG-XR-compressed
+    or float files raise
     :class:`~tmlibrary_tpu.errors.MetadataError` with a clear message.
     """
 
@@ -650,18 +651,64 @@ class CZIReader(Reader):
             # primary_guid(16) file_guid(16) file_part(4) = 52 bytes,
             # then DirectoryPosition(i64)
             (dir_pos,) = struct.unpack_from("<q", payload, 52)
-            self._planes = self._parse_directory(dir_pos)
+            all_planes = self._parse_directory(dir_pos)
+            # pyramidal files interleave subsampled copies with the
+            # acquisition planes; only pyramid-0 subblocks are data
+            self._planes = [p for p in all_planes if not p["pyramid"]]
+            if not self._planes:
+                raise MetadataError(
+                    f"{self.filename}: only pyramid subblocks present"
+                )
             # raw dimension starts need not be 0-based (substack
             # acquisitions): normalize EVERY axis through sorted id lists
             self._scene_ids = sorted({p["S"] for p in self._planes})
             self._channel_ids = sorted({p["C"] for p in self._planes})
             self._z_ids = sorted({p["Z"] for p in self._planes})
             self._t_ids = sorted({p["T"] for p in self._planes})
+            # mosaic tiles rank PER SCENE: ZEN commonly numbers M
+            # globally across scenes (scene 0: 0..5, scene 1: 6..11), so
+            # a global id list would leave most (scene, tile) pairs empty
+            tiles_by_scene: dict = {}
+            for p in self._planes:
+                tiles_by_scene.setdefault(p["S"], set()).add(p["M"])
+            tile_counts = {len(v) for v in tiles_by_scene.values()}
+            if len(tile_counts) != 1:
+                raise MetadataError(
+                    f"{self.filename}: scenes carry differing mosaic "
+                    f"tile counts {sorted(len(v) for v in tiles_by_scene.values())}"
+                )
+            self.n_tiles = tile_counts.pop()
+            tile_rank = {
+                (s, m): i
+                for s, ms in tiles_by_scene.items()
+                for i, m in enumerate(sorted(ms))
+            }
             # O(1) lookups: a linear scan per plane would be O(planes^2)
             # over a production-scale subblock directory
             self._plane_index = {
-                (p["S"], p["C"], p["Z"], p["T"]): p for p in self._planes
+                (p["S"], tile_rank[(p["S"], p["M"])],
+                 p["C"], p["Z"], p["T"]): p
+                for p in self._planes
             }
+            # a sparse or duplicated (scene, tile, c, z, t) grid would
+            # fail mid-extract with half the sites written; fail the OPEN
+            # instead so the handler skips the file with a logged reason
+            expected = (
+                len(self._scene_ids) * self.n_tiles
+                * len(self._channel_ids) * len(self._z_ids)
+                * len(self._t_ids)
+            )
+            if len(self._plane_index) != len(self._planes):
+                raise MetadataError(
+                    f"{self.filename}: duplicate subblocks for one "
+                    "(scene, tile, channel, z, t) coordinate"
+                )
+            if len(self._planes) != expected:
+                raise MetadataError(
+                    f"{self.filename}: sparse subblock grid "
+                    f"({len(self._planes)} planes for {expected} "
+                    "coordinates)"
+                )
             self.width = self._planes[0]["w"]
             self.height = self._planes[0]["h"]
         except MetadataError:
@@ -724,7 +771,10 @@ class CZIReader(Reader):
             "pixel_type": pixel_type,
             "compression": compression,
             "file_pos": file_pos,
-            "C": 0, "Z": 0, "T": 0, "S": 0,
+            # pyramid byte follows compression: non-zero marks a
+            # subsampled copy of tiles, not an acquisition plane
+            "pyramid": buf[pos + 22] != 0,
+            "C": 0, "Z": 0, "T": 0, "S": 0, "M": 0,
         }
         p = pos + 32
         for _ in range(dim_count):
@@ -734,12 +784,10 @@ class CZIReader(Reader):
                 plane["w"] = size
             elif name == "Y":
                 plane["h"] = size
-            elif name in ("C", "Z", "T", "S"):
+            elif name in ("C", "Z", "T", "S", "M"):
+                # M = mosaic tile index (slide scans / large areas): each
+                # tile is exposed as its own plane, tiles -> sites
                 plane[name] = start
-            elif name == "M" and size > 1:
-                raise MetadataError(
-                    "mosaic-tiled CZI (M dimension) is not supported"
-                )
             p += 20
         return plane, p
 
@@ -761,7 +809,8 @@ class CZIReader(Reader):
 
     # ------------------------------------------------------------- pixels
     def read_plane(
-        self, scene: int = 0, channel: int = 0, zplane: int = 0, tpoint: int = 0
+        self, scene: int = 0, channel: int = 0, zplane: int = 0,
+        tpoint: int = 0, tile: int = 0
     ) -> np.ndarray:
         import struct
 
@@ -769,6 +818,7 @@ class CZIReader(Reader):
 
         for name, idx, n in (
             ("scene", scene, self.n_scenes),
+            ("tile", tile, self.n_tiles),
             ("channel", channel, self.n_channels),
             ("zplane", zplane, self.n_zplanes),
             ("tpoint", tpoint, self.n_tpoints),
@@ -781,6 +831,7 @@ class CZIReader(Reader):
                 )
         plane = self._plane_index.get((
             self._scene_ids[scene],
+            tile,  # already a per-scene rank (see __enter__)
             self._channel_ids[channel],
             self._z_ids[zplane],
             self._t_ids[tpoint],
@@ -788,7 +839,8 @@ class CZIReader(Reader):
         if plane is None:
             raise MetadataError(
                 f"{self.filename}: no subblock for "
-                f"scene={scene} channel={channel} z={zplane} t={tpoint}"
+                f"scene={scene} tile={tile} channel={channel} "
+                f"z={zplane} t={tpoint}"
             )
         compression = plane["compression"]
         if compression not in (0, 5, 6):
@@ -854,12 +906,15 @@ class CZIReader(Reader):
 
     def read_plane_linear(self, page: int) -> np.ndarray:
         """Decode by linear page index, the encoding the czi metaconfig
-        handler writes: ``((s * C + c) * Z + z) * T + t``."""
-        per_scene = self.n_channels * self.n_zplanes * self.n_tpoints
-        s, rem = divmod(page, per_scene)
+        handler writes: ``(((s * M + m) * C + c) * Z + z) * T + t``
+        (sites = scenes × mosaic tiles; M = 1 reduces to the pre-mosaic
+        convention)."""
+        per_site = self.n_channels * self.n_zplanes * self.n_tpoints
+        sm, rem = divmod(page, per_site)
+        s, m = divmod(sm, self.n_tiles)
         c, rem = divmod(rem, self.n_zplanes * self.n_tpoints)
         z, t = divmod(rem, self.n_tpoints)
-        return self.read_plane(s, c, z, t)
+        return self.read_plane(s, c, z, t, tile=m)
 
 
 class LIFReader(Reader):

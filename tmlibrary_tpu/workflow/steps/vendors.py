@@ -1130,16 +1130,20 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
 
     Same conventions as the nd2 handler: one file per well (well-name
     token in the filename, else the next free column on row A), scenes
-    (S) map to sites, channels to ``C00``/…, with Z/T preserved;
-    ``page`` encodes ``((s * C + c) * Z + z) * T + t`` for imextract."""
+    (S) × mosaic tiles (M, slide scans) map to sites, channels to
+    ``C00``/…, with Z/T preserved; ``page`` encodes
+    ``(((s * M + m) * C + c) * Z + z) * T + t`` for imextract."""
     from tmlibrary_tpu.readers import CZIReader
 
     def entries_of(path, dims, well):
-        n_s, n_c, n_z, n_t = dims
+        n_s, n_m, n_c, n_z, n_t = dims
         return [
-            _container_entry(path, well, site=s, channel=c, zplane=z,
-                             tpoint=t, page=((s * n_c + c) * n_z + z) * n_t + t)
+            _container_entry(
+                path, well, site=s * n_m + m, channel=c, zplane=z,
+                tpoint=t,
+                page=(((s * n_m + m) * n_c + c) * n_z + z) * n_t + t)
             for s in range(n_s)
+            for m in range(n_m)
             for c in range(n_c)
             for z in range(n_z)
             for t in range(n_t)
@@ -1147,7 +1151,8 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
 
     return _container_sidecar(
         source_dir, ".czi", CZIReader, "CZI",
-        lambda r: (r.n_scenes, r.n_channels, r.n_zplanes, r.n_tpoints),
+        lambda r: (r.n_scenes, r.n_tiles, r.n_channels, r.n_zplanes,
+                   r.n_tpoints),
         entries_of,
     )
 
