@@ -28,10 +28,34 @@ def _lv_u32(name: str, value: int) -> bytes:
     )
 
 
+def _lv_compound(name: str, inner: bytes) -> bytes:
+    encoded = (name + "\x00").encode("utf-16-le")
+    return (
+        struct.pack("<BB", 11, len(name) + 1) + encoded
+        + struct.pack("<IQ", 1, len(inner)) + inner
+    )
+
+
+def experiment_chunk(loops) -> bytes:
+    """LV payload for ImageMetadataLV!: nested SLxExperiment levels,
+    ``loops`` = [(eType, size), ...] outermost first."""
+    inner = b""
+    for etype, size in reversed(loops):
+        level = (
+            _lv_u32("eType", etype) + _lv_u32("uiLoopSize", size)
+        )
+        if inner:
+            level += _lv_compound("ppNextLevelEx", inner)
+        inner = level
+    return _lv_compound("SLxExperiment", inner)
+
+
 def write_nd2(path, planes: np.ndarray, timestamps=None,
-              declare_sequences=None) -> None:
+              declare_sequences=None, loops=None) -> None:
     """``planes``: (n_seq, H, W, C) uint16.  ``declare_sequences``
-    overstates ``uiSequenceCount`` to mimic an aborted acquisition."""
+    overstates ``uiSequenceCount`` to mimic an aborted acquisition.
+    ``loops``: [(eType, size), ...] emits an ImageMetadataLV!
+    SLxExperiment tree (outermost first)."""
     n_seq, h, w, c = planes.shape
     inner = (
         _lv_u32("uiWidth", w)
@@ -57,6 +81,8 @@ def write_nd2(path, planes: np.ndarray, timestamps=None,
 
     emit(ND2Reader.SIG_FILE, b"\x03\x00")
     emit(b"ImageAttributesLV!", attrs)
+    if loops is not None:
+        emit(b"ImageMetadataLV!", experiment_chunk(loops))
     for s in range(n_seq):
         ts = float(timestamps[s]) if timestamps is not None else 1000.0 * s
         payload = struct.pack("<d", ts) + planes[s].tobytes()
@@ -225,3 +251,92 @@ def test_nd2_well_collision_surfaces_through_auto(tmp_path, planes):
     meta.init({"source_dir": str(src), "handler": "auto"})
     with pytest.raises(VendorConflictError, match="both claim well"):
         meta.run(0)
+
+
+def test_nd2_loop_shape_decodes_tzxy(tmp_path):
+    """Time x XY x Z nesting from the SLxExperiment tree: XY positions
+    become sites, Z/T preserved (innermost loop varies fastest)."""
+    rng = np.random.default_rng(71)
+    # T=2 (outer), XY=3, Z=2 (inner): 12 sequences, 1 component
+    planes = rng.integers(0, 60000, (12, 6, 7, 1), dtype=np.uint16)
+    path = tmp_path / "loops.nd2"
+    write_nd2(path, planes, loops=[(1, 2), (2, 3), (4, 2)])
+    with ND2Reader(path) as r:
+        assert r.loop_shape() == [("T", 2), ("XY", 3), ("Z", 2)]
+        # seq = (t*3 + xy)*2 + z
+        assert r.seq_coords(0) == (0, 0, 0)
+        assert r.seq_coords(1) == (0, 1, 0)
+        assert r.seq_coords(7) == (0, 1, 1)  # 7 = (1*3 + 0)*2 + 1
+        # verify decode against the linearization directly
+        for t in range(2):
+            for xy in range(3):
+                for z in range(2):
+                    seq = (t * 3 + xy) * 2 + z
+                    assert r.seq_coords(seq) == (xy, z, t)
+
+
+def test_nd2_loop_fallback_when_product_mismatches(tmp_path):
+    rng = np.random.default_rng(72)
+    planes = rng.integers(0, 60000, (4, 6, 7, 1), dtype=np.uint16)
+    path = tmp_path / "bad_loops.nd2"
+    write_nd2(path, planes, loops=[(1, 3), (2, 3)])  # product 9 != 4
+    with ND2Reader(path) as r:
+        assert r.loop_shape() is None
+        assert r.seq_coords(3) == (3, 0, 0)  # flat fallback
+
+
+def test_nd2_loop_ingest_end_to_end(tmp_path):
+    """A T/XY/Z ND2 ingests with sites=XY and Z/T preserved."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(73)
+    planes = rng.integers(0, 60000, (12, 6, 7, 1), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    write_nd2(src / "tl_A01.nd2", planes, loops=[(1, 2), (2, 3), (4, 2)])
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="nd2loops", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    meta.run(0)
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 3
+    assert exp.n_zplanes == 2 and exp.n_tpoints == 2
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+    st = ExperimentStore.open(root)
+    for t in range(2):
+        for z in range(2):
+            px = st.read_sites(None, channel=0, tpoint=t, zplane=z)
+            for xy in range(3):
+                seq = (t * 3 + xy) * 2 + z
+                np.testing.assert_array_equal(px[xy], planes[seq, :, :, 0])
+
+
+def test_nd2_loop_decode_ignores_unrelated_etype_blocks(tmp_path):
+    """An earlier metadata compound with its own eType field must not
+    defeat loop decode — the search anchors on SLxExperiment."""
+    rng = np.random.default_rng(74)
+    planes = rng.integers(0, 60000, (4, 6, 7, 1), dtype=np.uint16)
+    path = tmp_path / "decoy.nd2"
+    write_nd2(path, planes, loops=[(2, 4)])
+    decoy = _lv_compound(
+        "SLxPictureMetadata", _lv_u32("eType", 99) + _lv_u32("uiLoopSize", 7)
+    )
+    payload = decoy + experiment_chunk([(2, 4)])
+    with ND2Reader(path) as r:
+        # serve the decoy-first payload for the metadata chunk
+        orig = r._chunk_payload
+        meta_off = r._chunks[b"ImageMetadataLV!"]
+        r._chunk_payload = (
+            lambda off: payload if off == meta_off else orig(off)
+        )
+        assert r.loop_shape() == [("XY", 4)]

@@ -284,8 +284,11 @@ class ND2Reader(Reader):
       acquisition timestamp (f64) followed by row-major uint16 samples
       interleaved across components.
 
-    Files using loop shapes beyond positions x channels (time/Z loops),
-    compressed payloads, or non-uint16 samples raise
+    Acquisition loops (time / XY-position / Z-stack nesting) decode from
+    the ``ImageMetadataLV!`` SLxExperiment tree (:meth:`loop_shape` /
+    :meth:`seq_coords`), with an unmodeled or inconsistent experiment
+    falling back to flat sequences-as-sites; compressed payloads or
+    non-uint16 samples raise
     :class:`~tmlibrary_tpu.errors.MetadataError` with a clear message
     rather than mis-decoding.
     """
@@ -472,6 +475,95 @@ class ND2Reader(Reader):
         if "uiWidth" in tree:
             return tree
         raise MetadataError(f"{self.filename}: uiWidth missing from attributes")
+
+    # -------------------------------------------------------- loop shape
+    #: SLxExperiment eType -> axis kind (values per the public nd2
+    #: loop-type enum: TimeLoop=1, XYPosLoop=2, ZStackLoop=4,
+    #: NETimeLoop=8); anything else is unmodeled
+    _LOOP_KINDS = {1: "T", 2: "XY", 4: "Z", 8: "T"}
+
+    def loop_shape(self) -> "list[tuple[str, int]] | None":
+        """Ordered acquisition loops (outermost first, innermost varies
+        fastest in the sequence index): ``[("T"|"XY"|"Z", size), ...]``
+        from the ``ImageMetadataLV!`` SLxExperiment tree — or None when
+        the chunk is absent, a loop type is unmodeled, a kind repeats,
+        or the loop product does not equal the written sequence count
+        (callers then fall back to sequences = flat sites, the
+        pre-loop-support behavior).  Parsed once per open reader."""
+        if not hasattr(self, "_loops"):
+            self._loops = self._compute_loop_shape()
+        return self._loops
+
+    def _compute_loop_shape(self) -> "list[tuple[str, int]] | None":
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        off = self._chunks.get(b"ImageMetadataLV!")
+        if off is None:
+            return None
+        try:
+            tree = self._parse_lv(self._chunk_payload(off))
+        except (MetadataError, struct.error, OverflowError, IndexError,
+                UnicodeDecodeError):
+            return None
+
+        def find_level(node):
+            if isinstance(node, dict):
+                if "eType" in node:
+                    return node
+                for v in node.values():
+                    found = find_level(v)
+                    if found is not None:
+                        return found
+            return None
+
+        def find_experiment(node):
+            # anchor on the SLxExperiment compound: other metadata
+            # blocks carry their own 'eType' fields, and the first one
+            # in tree order would silently defeat loop decode
+            if isinstance(node, dict):
+                exp = node.get("SLxExperiment")
+                if isinstance(exp, dict):
+                    return exp
+                for v in node.values():
+                    found = find_experiment(v)
+                    if found is not None:
+                        return found
+            return None
+
+        loops: list = []
+        experiment = find_experiment(tree)
+        level = find_level(experiment if experiment is not None else tree)
+        while level is not None:
+            kind = self._LOOP_KINDS.get(level.get("eType"))
+            size = level.get("uiLoopSize") or (
+                level.get("uLoopPars") or {}
+            ).get("uiCount")
+            if kind is None or not isinstance(size, int) or size < 1:
+                return None
+            if any(k == kind for k, _ in loops):
+                return None  # nested loops of one kind are unmodeled
+            loops.append((kind, size))
+            level = find_level(level.get("ppNextLevelEx"))
+        product = 1
+        for _, size in loops:
+            product *= size
+        if not loops or product != self.n_sequences:
+            return None
+        return loops
+
+    def seq_coords(self, sequence: int) -> tuple[int, int, int]:
+        """(xy_position, zplane, tpoint) of a sequence index under
+        :meth:`loop_shape`; flat ``(sequence, 0, 0)`` without loops."""
+        loops = self.loop_shape()
+        if not loops:
+            return sequence, 0, 0
+        coords = {"XY": 0, "Z": 0, "T": 0}
+        rem = sequence
+        for kind, size in reversed(loops):  # innermost varies fastest
+            rem, coords[kind] = divmod(rem, size)
+        return coords["XY"], coords["Z"], coords["T"]
 
     # ------------------------------------------------------------- pixels
     def read_plane(self, sequence: int, component: int = 0) -> np.ndarray:

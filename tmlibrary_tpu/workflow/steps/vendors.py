@@ -1101,24 +1101,32 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     the Bio-Formats gap, SURVEY.md §3 Readers row).
 
     One file per well when a well-name token (``A01``) appears in the
-    filename; otherwise each file becomes its own well on row A.  XY
-    sequences map to sites, interleaved components to channels
-    (``C00``/``C01``/…); ``page`` encodes ``seq * n_components + comp``
-    for imextract's plane decode."""
+    filename; otherwise each file becomes its own well on row A.  The
+    SLxExperiment loop structure assigns each sequence its
+    (XY-position, Z, T) coordinate — XY positions map to sites with
+    time/Z preserved; files without a modeled loop structure keep the
+    flat sequences-as-sites mapping.  Interleaved components map to
+    channels (``C00``/``C01``/…); ``page`` encodes
+    ``seq * n_components + comp`` for imextract's plane decode."""
     from tmlibrary_tpu.readers import ND2Reader
 
     def entries_of(path, dims, well):
-        n_seq, n_comp = dims
-        return [
-            _container_entry(path, well, site=seq, channel=comp,
-                             zplane=0, tpoint=0, page=seq * n_comp + comp)
-            for seq in range(n_seq)
-            for comp in range(n_comp)
-        ]
+        n_seq, n_comp, coords = dims
+        out = []
+        for seq in range(n_seq):
+            xy, z, t = coords[seq]
+            for comp in range(n_comp):
+                e = _container_entry(path, well, site=xy, channel=comp,
+                                     zplane=z, tpoint=t,
+                                     page=seq * n_comp + comp)
+                out.append(e)
+        return out
 
     return _container_sidecar(
         source_dir, ".nd2", ND2Reader, "ND2",
-        lambda r: (r.n_sequences, r.n_components), entries_of,
+        lambda r: (r.n_sequences, r.n_components,
+                   [r.seq_coords(s) for s in range(r.n_sequences)]),
+        entries_of,
     )
 
 
