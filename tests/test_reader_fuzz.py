@@ -1,0 +1,206 @@
+"""Corruption-robustness net for every container parser.
+
+The ingest contract (``_container_sidecar``'s skip-unreadable loop and
+imextract's per-plane decode) is that a broken file raises
+:class:`MetadataError` / :class:`NotSupportedError` — anything else
+(struct.error, IndexError, ZeroDivisionError, …) aborts a whole ingest.
+Each reader is fed deterministic byte-flip and truncation mutations of
+a valid synthetic fixture; opening AND reading every advertised plane
+must either succeed or raise only the contract errors.
+"""
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+ALLOWED = (MetadataError, NotSupportedError)
+N_FLIPS = 60
+N_TRUNC = 20
+
+
+def _mutations(blob: bytes, rng):
+    for _ in range(N_FLIPS):
+        pos = int(rng.integers(0, len(blob)))
+        mutated = bytearray(blob)
+        mutated[pos] ^= int(rng.integers(1, 256))
+        yield bytes(mutated)
+    for _ in range(N_TRUNC):
+        cut = int(rng.integers(1, len(blob)))
+        yield blob[:cut]
+
+
+def _exhaust(reader):
+    """Open + read every plane through the ingest dispatch."""
+    from tmlibrary_tpu.readers import _container_plane
+
+    with reader as r:
+        n_planes = 1
+        for attr in ("n_channels", "n_zplanes", "n_tpoints", "n_fields",
+                     "n_scenes", "n_tiles", "n_series", "n_sequences",
+                     "n_components"):
+            n_planes *= getattr(r, attr, 1) or 1
+        for page in range(min(n_planes, 16)):
+            _container_plane(r, page)
+
+
+def _fuzz(make_valid, reader_cls, tmp_path, suffix, seed):
+    rng = np.random.default_rng(seed)
+    valid = tmp_path / f"valid{suffix}"
+    make_valid(valid, rng)
+    blob = valid.read_bytes()
+    target = tmp_path / f"mut{suffix}"
+    survived = 0
+    for i, mutated in enumerate(_mutations(blob, rng)):
+        target.write_bytes(mutated)
+        try:
+            _exhaust(reader_cls(target))
+            survived += 1
+        except ALLOWED:
+            pass
+        except Exception as exc:  # noqa: BLE001 - the point of the test
+            raise AssertionError(
+                f"mutation {i} leaked {type(exc).__name__}: {exc}"
+            ) from exc
+    # sanity: the valid fixture itself must read
+    _exhaust(reader_cls(valid))
+    return survived
+
+
+def test_fuzz_nd2(tmp_path):
+    from test_nd2 import write_nd2
+
+    from tmlibrary_tpu.readers import ND2Reader
+
+    def make(path, rng):
+        planes = rng.integers(0, 60000, (4, 8, 9, 1), dtype=np.uint16)
+        write_nd2(path, planes, loops=[(2, 4)])
+
+    _fuzz(make, ND2Reader, tmp_path, ".nd2", 1)
+
+
+def test_fuzz_czi(tmp_path):
+    from test_czi import write_czi
+
+    from tmlibrary_tpu.readers import CZIReader
+
+    def make(path, rng):
+        planes = rng.integers(0, 4000, (2, 2, 8, 9), dtype=np.uint16)
+        write_czi(path, planes, compression=6, hilo=True)
+
+    _fuzz(make, CZIReader, tmp_path, ".czi", 2)
+
+
+def test_fuzz_oib(tmp_path):
+    from test_oib import plane_name, tiff_bytes, write_cfb
+
+    from tmlibrary_tpu.readers import OIBReader
+
+    def make(path, rng):
+        stack = rng.integers(0, 60000, (2, 8, 9), dtype=np.uint16)
+        files = {
+            f"Storage00001/{plane_name(c, 0, 0)}": tiff_bytes(stack[c])
+            for c in range(2)
+        }
+        path.write_bytes(write_cfb(files))
+
+    _fuzz(make, OIBReader, tmp_path, ".oib", 3)
+
+
+def test_fuzz_flex(tmp_path):
+    from test_flex import write_flex
+
+    from tmlibrary_tpu.readers import FlexReader
+
+    def make(path, rng):
+        planes = rng.integers(0, 60000, (4, 8, 9), dtype=np.uint16)
+        write_flex(path, planes, channel_names=("A", "B"))
+
+    _fuzz(make, FlexReader, tmp_path, ".flex", 4)
+
+
+def test_fuzz_dv(tmp_path):
+    from test_dv import write_dv
+
+    from tmlibrary_tpu.readers import DVReader
+
+    def make(path, rng):
+        stack = rng.integers(0, 60000, (2, 2, 2, 8, 9), dtype=np.uint16)
+        write_dv(path, stack)
+
+    _fuzz(make, DVReader, tmp_path, ".dv", 5)
+
+
+def test_fuzz_stk(tmp_path):
+    from test_stk import write_stk
+
+    from tmlibrary_tpu.readers import STKReader
+
+    def make(path, rng):
+        planes = rng.integers(0, 60000, (3, 8, 9), dtype=np.uint16)
+        write_stk(path, planes)
+
+    _fuzz(make, STKReader, tmp_path, ".stk", 6)
+
+
+def test_fuzz_lif(tmp_path):
+    from test_lif import write_lif
+
+    from tmlibrary_tpu.readers import LIFReader
+
+    def make(path, rng):
+        arr = rng.integers(0, 60000, (2, 2, 1, 8, 9), dtype=np.uint16)
+        write_lif(path, [arr])
+
+    _fuzz(make, LIFReader, tmp_path, ".lif", 7)
+
+
+def test_fuzz_lsm(tmp_path):
+    from test_lsm import write_lsm
+
+    from tmlibrary_tpu.readers import LSMReader
+
+    def make(path, rng):
+        planes = rng.integers(0, 60000, (1, 2, 2, 8, 9), dtype=np.uint16)
+        write_lsm(path, planes)
+
+    _fuzz(make, LSMReader, tmp_path, ".lsm", 8)
+
+
+def test_fuzz_ims(tmp_path):
+    from test_ims import write_ims
+
+    from tmlibrary_tpu.readers import IMSReader
+
+    def make(path, rng):
+        planes = rng.integers(0, 60000, (2, 2, 1, 8, 9), dtype=np.uint16)
+        write_ims(path, planes)
+
+    _fuzz(make, IMSReader, tmp_path, ".ims", 9)
+
+
+def test_fuzz_oif_main_file(tmp_path):
+    """OIF mutations corrupt the INI main file (the companion plane
+    TIFFs stay valid — their corruption is the OIB/flex fuzzers' job)."""
+    from test_oib import plane_name, tiff_bytes
+
+    from tmlibrary_tpu.readers import OIFReader
+
+    def make(path, rng):
+        from test_oib import oif_text
+
+        stack = rng.integers(0, 60000, (2, 8, 9), dtype=np.uint16)
+        # a companion dir for the MUTATED name too — otherwise every
+        # mutation dies at the missing-directory check and the INI
+        # parser never sees a corrupted byte
+        for stem in (path.name, "mut.oif"):
+            files = path.parent / (stem + ".files")
+            files.mkdir(exist_ok=True)
+            for c in range(2):
+                (files / plane_name(c, 0, 0)).write_bytes(
+                    tiff_bytes(stack[c])
+                )
+        path.write_bytes(
+            b"\xff\xfe" + oif_text(9, 8, 2, 1, 1).encode("utf-16-le")
+        )
+
+    _fuzz(make, OIFReader, tmp_path, ".oif", 10)

@@ -333,10 +333,17 @@ class ND2Reader(Reader):
                 f"corrupt ND2 container {self.filename}: "
                 f"{type(exc).__name__}: {exc}"
             ) from exc
-        self.width = int(attrs["uiWidth"])
-        self.height = int(attrs["uiHeight"])
-        self.n_components = int(attrs.get("uiComp", 1))
-        self.bits = int(attrs.get("uiBpcInMemory", 16))
+        try:
+            # .get + coercion guard: a corrupt LV tree can drop uiHeight
+            # or retype any value to a string/bytes (fuzz-caught) — both
+            # must land in the nonsensical-attributes MetadataError below
+            self.width = int(attrs.get("uiWidth", 0))
+            self.height = int(attrs.get("uiHeight", 0))
+            self.n_components = int(attrs.get("uiComp", 1))
+            self.bits = int(attrs.get("uiBpcInMemory", 16))
+        except (TypeError, ValueError):
+            self.width = self.height = self.n_components = -1
+            self.bits = 16
         if self.width <= 0 or self.height <= 0 or self.n_components < 1:
             # uiComp=0 would reach divmod(page, 0) at decode time
             self.__exit__()
@@ -352,7 +359,12 @@ class ND2Reader(Reader):
                 f"(uiBpcInMemory={self.bits})"
             )
         n_chunks = sum(1 for n in self._chunks if n.startswith(b"ImageDataSeq|"))
-        declared = int(attrs.get("uiSequenceCount", n_chunks))
+        try:
+            declared = int(attrs.get("uiSequenceCount", n_chunks))
+        except (TypeError, ValueError):
+            # same corrupt-retyped-LV-value class as the block above:
+            # fall back to counting what was actually written
+            declared = n_chunks
         # an aborted acquisition can declare more sequences than were
         # written; trusting the attribute would emit phantom planes
         self.n_sequences = min(declared, n_chunks)
@@ -751,6 +763,15 @@ class CZIReader(Reader):
                 raise MetadataError(
                     f"{self.filename}: only pyramid subblocks present"
                 )
+            # every plane needs X/Y dims NOW: a corrupt entry without
+            # them would KeyError at read time, past the skip-unreadable
+            # guard (fuzz-caught)
+            for p in self._planes:
+                if "w" not in p or "h" not in p or p["w"] <= 0 or p["h"] <= 0:
+                    raise MetadataError(
+                        f"{self.filename}: subblock entry without valid "
+                        "X/Y dimensions"
+                    )
             # raw dimension starts need not be 0-based (substack
             # acquisitions): normalize EVERY axis through sorted id lists
             self._scene_ids = sorted({p["S"] for p in self._planes})
@@ -1429,37 +1450,54 @@ class IMSReader(Reader):
                 f"not an HDF5/Imaris file: {self.filename}: {exc}"
             ) from exc
         try:
-            level0 = self._f["DataSet/ResolutionLevel 0"]
-            info = self._f["DataSetInfo/Image"]
-        except KeyError as exc:
+            try:
+                level0 = self._f["DataSet/ResolutionLevel 0"]
+                info = self._f["DataSetInfo/Image"]
+            except KeyError as exc:
+                raise MetadataError(
+                    f"no Imaris DataSet layout in {self.filename}: {exc}"
+                ) from exc
+            try:
+                self.width = int(self._decode_attr(info.attrs["X"]))
+                self.height = int(self._decode_attr(info.attrs["Y"]))
+                self.n_zplanes = int(self._decode_attr(info.attrs["Z"]))
+            except (KeyError, ValueError) as exc:
+                raise MetadataError(
+                    f"bad Imaris image-size attributes in "
+                    f"{self.filename}: {exc}"
+                ) from exc
+            if self.width < 1 or self.height < 1 or self.n_zplanes < 1:
+                # Z=0 would reach divmod(page, 0) in read_plane_linear;
+                # non-positive X/Y would silently truncate every plane
+                raise MetadataError(
+                    f"nonsensical Imaris image size in {self.filename}: "
+                    f"X={self.width} Y={self.height} Z={self.n_zplanes}"
+                )
+            tps = sorted(
+                k for k in level0 if k.startswith("TimePoint ")
+            )
+            if not tps:
+                raise MetadataError(f"no TimePoints in {self.filename}")
+            chans = sorted(
+                k for k in level0[tps[0]] if k.startswith("Channel ")
+            )
+            if not chans:
+                raise MetadataError(f"no Channels in {self.filename}")
+            self.n_tpoints = len(tps)
+            self.n_channels = len(chans)
+        except MetadataError:
+            self.__exit__()
+            raise
+        except (RuntimeError, OSError, KeyError, ValueError, IndexError,
+                TypeError) as exc:
+            # h5py surfaces HDF5-library corruption as RuntimeError/OSError
+            # mid-iteration (fuzz-caught); the skip-unreadable contract
+            # requires MetadataError
             self.__exit__()
             raise MetadataError(
-                f"no Imaris DataSet layout in {self.filename}: {exc}"
+                f"corrupt Imaris file {self.filename}: "
+                f"{type(exc).__name__}: {exc}"
             ) from exc
-
-        try:
-            self.width = int(self._decode_attr(info.attrs["X"]))
-            self.height = int(self._decode_attr(info.attrs["Y"]))
-            self.n_zplanes = int(self._decode_attr(info.attrs["Z"]))
-        except (KeyError, ValueError) as exc:
-            self.__exit__()
-            raise MetadataError(
-                f"bad Imaris image-size attributes in {self.filename}: {exc}"
-            ) from exc
-        tps = sorted(
-            k for k in level0 if k.startswith("TimePoint ")
-        )
-        if not tps:
-            self.__exit__()
-            raise MetadataError(f"no TimePoints in {self.filename}")
-        chans = sorted(
-            k for k in level0[tps[0]] if k.startswith("Channel ")
-        )
-        if not chans:
-            self.__exit__()
-            raise MetadataError(f"no Channels in {self.filename}")
-        self.n_tpoints = len(tps)
-        self.n_channels = len(chans)
         return self
 
     def __exit__(self, *exc):
@@ -1499,15 +1537,22 @@ class IMSReader(Reader):
         path = f"DataSet/ResolutionLevel 0/TimePoint {t}/Channel {c}/Data"
         try:
             data = self._f[path]
+            # crop chunk padding down to the true image size.  Imaris
+            # Data may be uint32 (routine, unlike DV's 8/16-bit modes) —
+            # clip to the store's uint16 range instead of silently
+            # wrapping 70000 to 4464
+            plane = np.asarray(data[z, : self.height, : self.width])
         except KeyError as exc:
             raise MetadataError(
                 f"missing {path} in {self.filename}"
             ) from exc
-        # crop chunk padding down to the true image size.  Imaris Data
-        # may be uint32 (routine, unlike DV's 8/16-bit modes) — clip to
-        # the store's uint16 range instead of silently wrapping 70000
-        # to 4464
-        plane = np.asarray(data[z, : self.height, : self.width])
+        except (RuntimeError, OSError, ValueError, IndexError,
+                TypeError) as exc:
+            # HDF5-library corruption at dataset-read time (fuzz-caught)
+            raise MetadataError(
+                f"corrupt Imaris data in {self.filename}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
         if plane.dtype.kind in "iu":
             return np.clip(plane, 0, 65535).astype(np.uint16)
         return plane.astype(np.float32)
@@ -1955,7 +2000,10 @@ def _decode_oif_text(raw: bytes) -> str:
     """Olympus INI text is UTF-16-LE with BOM on real scopes; tolerate
     BOM-less UTF-16 and plain 8-bit too (fixtures, resaved files)."""
     if raw[:2] in (b"\xff\xfe", b"\xfe\xff"):
-        return raw.decode("utf-16")
+        # "replace", not strict: a corrupt odd-length tail must degrade
+        # to unparseable text (-> MetadataError downstream), not leak
+        # UnicodeDecodeError past the skip-unreadable guard (fuzz-caught)
+        return raw.decode("utf-16", "replace")
     if b"\x00" in raw[:64]:
         return raw.decode("utf-16-le", "replace")
     return raw.decode("utf-8", "replace")
