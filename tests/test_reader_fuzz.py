@@ -204,3 +204,86 @@ def test_fuzz_oif_main_file(tmp_path):
         )
 
     _fuzz(make, OIFReader, tmp_path, ".oif", 10)
+
+
+def test_fuzz_ngff_plate(tmp_path):
+    """NGFF is a directory container: every metadata document and a
+    chunk file get byte-flip + truncation mutations; the reader and the
+    ingest plane decode must hold the contract for each."""
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.ngff import NGFFReader, write_ngff_plate
+
+    exp = grid_experiment(
+        "fz", well_rows=1, well_cols=1, sites_per_well=(1, 1),
+        channel_names=("DAPI",), site_shape=(16, 16),
+    )
+    st = ExperimentStore.create(tmp_path / "exp", exp)
+    rng = np.random.default_rng(11)
+    st.write_sites(
+        rng.integers(0, 60000, (1, 16, 16), dtype=np.uint16), [0], channel=0
+    )
+    plate = write_ngff_plate(st, tmp_path / "plate.zarr", n_levels=1)
+
+    targets = [p for p in sorted(plate.rglob("*")) if p.is_file()]
+    assert len(targets) >= 4
+    for target in targets:
+        blob = target.read_bytes()
+        orig = blob
+        for mutated in _mutations(blob, rng):
+            target.write_bytes(mutated)
+            try:
+                _exhaust(NGFFReader(plate))
+            except ALLOWED:
+                pass
+            except Exception as exc:  # noqa: BLE001
+                raise AssertionError(
+                    f"{target.relative_to(plate)} mutation leaked "
+                    f"{type(exc).__name__}: {exc}"
+                ) from exc
+        target.write_bytes(orig)
+    _exhaust(NGFFReader(plate))
+
+    # semantic mutations: byte flips in valid JSON break the SYNTAX
+    # first, so type corruption ("rowIndex": null, "omero": "x") needs
+    # its own pass — every value in every metadata document is replaced
+    # by each of a few wrong-typed probes
+    import json as _json
+
+    def probe_points(node, prefix=()):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                yield from probe_points(v, prefix + (k,))
+        elif isinstance(node, list):
+            for i, v in enumerate(node):
+                yield from probe_points(v, prefix + (i,))
+        yield prefix
+
+    def set_at(node, path, value):
+        for key in path[:-1]:
+            node = node[key]
+        node[path[-1]] = value
+
+    for target in targets:
+        if not target.name.startswith(".z"):
+            continue
+        orig = target.read_bytes()
+        doc = _json.loads(orig)
+        for point in list(probe_points(doc)):
+            if not point:
+                continue
+            for wrong in (None, "x", [], {"a": 1}, -3):
+                mutated = _json.loads(orig)
+                set_at(mutated, point, wrong)
+                target.write_text(_json.dumps(mutated))
+                try:
+                    _exhaust(NGFFReader(plate))
+                except ALLOWED:
+                    pass
+                except Exception as exc:  # noqa: BLE001
+                    raise AssertionError(
+                        f"{target.relative_to(plate)} {point}={wrong!r} "
+                        f"leaked {type(exc).__name__}: {exc}"
+                    ) from exc
+        target.write_bytes(orig)
+    _exhaust(NGFFReader(plate))

@@ -105,19 +105,53 @@ def zarr_write_array(
 
 def _zarray_meta(path: Path) -> dict:
     try:
-        return json.loads((Path(path) / ".zarray").read_text())
+        meta = json.loads((Path(path) / ".zarray").read_text())
     except (OSError, ValueError) as exc:
         raise MetadataError(f"not a zarr array: {path}: {exc}") from exc
+    # validate structure HERE so every consumer can index freely: a
+    # corrupted document would otherwise leak KeyError/TypeError past
+    # the ingest skip-unreadable contract (fuzz-caught)
+    try:
+        shape = [int(x) for x in meta["shape"]]
+        chunks = [int(x) for x in meta["chunks"]]
+        np.dtype(meta["dtype"])
+    except (KeyError, TypeError, ValueError) as exc:
+        raise MetadataError(f"corrupt zarr metadata at {path}: {exc}") from exc
+    total = 1
+    for s in shape:
+        total *= max(s, 1)
+    chunk_elems = 1
+    for c in chunks:
+        chunk_elems *= max(c, 1)
+    # magnitude sanity (generous: 2G elements total, 128M per chunk): a
+    # corrupt/malicious document declaring absurd dims would otherwise
+    # reach np.zeros(shape) and leak ValueError/MemoryError — or OOM —
+    # past the skip-unreadable contract
+    if (len(shape) != len(chunks) or not chunks
+            or any(c < 1 for c in chunks) or any(s < 0 for s in shape)
+            or total > (1 << 31) or chunk_elems > (1 << 27)):
+        raise MetadataError(f"nonsensical zarr shape/chunks at {path}")
+    comp = meta.get("compressor")
+    if comp is not None and not isinstance(comp, dict):
+        raise MetadataError(f"corrupt zarr compressor entry at {path}")
+    meta["shape"], meta["chunks"] = shape, chunks
+    meta["dimension_separator"] = str(meta.get("dimension_separator", "."))
+    return meta
 
 
 def _read_chunk(path: Path, meta: dict, idx: tuple[int, ...]) -> np.ndarray:
     chunks = meta["chunks"]
     dtype = np.dtype(meta["dtype"])
-    sep = meta.get("dimension_separator", ".")
+    sep = meta["dimension_separator"]
     key = sep.join(str(i) for i in idx)
     f = Path(path) / key
     if not f.exists():
-        return np.full(chunks, meta.get("fill_value") or 0, dtype)
+        try:
+            return np.full(chunks, meta.get("fill_value") or 0, dtype)
+        except (TypeError, ValueError) as exc:  # corrupt fill_value
+            raise MetadataError(
+                f"corrupt zarr fill_value at {path}: {exc}"
+            ) from exc
     raw = f.read_bytes()
     comp = meta.get("compressor")
     if comp is not None:
@@ -126,11 +160,21 @@ def _read_chunk(path: Path, meta: dict, idx: tuple[int, ...]) -> np.ndarray:
                 f"unsupported zarr compressor {comp.get('id')!r} "
                 f"(first-party reader handles zlib/raw)"
             )
-        raw = zlib.decompress(raw)
+        try:
+            raw = zlib.decompress(raw)
+        except zlib.error as exc:
+            raise MetadataError(
+                f"corrupt zarr chunk {key} at {path}: {exc}"
+            ) from exc
     if meta.get("filters"):
         raise MetadataError("zarr filters are not supported")
     order = meta.get("order", "C")
-    return np.frombuffer(raw, dtype).reshape(chunks, order=order)
+    try:
+        return np.frombuffer(raw, dtype).reshape(chunks, order=order)
+    except (ValueError, TypeError) as exc:  # wrong byte count / order
+        raise MetadataError(
+            f"corrupt zarr chunk {key} at {path}: {exc}"
+        ) from exc
 
 
 def zarr_read_array(path: Path) -> np.ndarray:
@@ -470,6 +514,23 @@ class NGFFReader:
         return self
 
     def __enter__(self):
+        # one broad guard over BOTH the plate and bare-image parsing:
+        # valid-JSON type corruption ("rowIndex": null, "omero": "x",
+        # string channel entries) raises TypeError/AttributeError at
+        # scattered consumers — all of it must surface as the
+        # MetadataError the ingest skip-unreadable contract expects
+        try:
+            return self._enter_impl()
+        except MetadataError:
+            raise
+        except (KeyError, TypeError, ValueError, AttributeError,
+                IndexError) as exc:
+            raise MetadataError(
+                f"malformed NGFF metadata in {self.path}: "
+                f"{type(exc).__name__}: {exc}"
+            ) from exc
+
+    def _enter_impl(self):
         attrs_file = self.path / ".zattrs"
         try:
             attrs = json.loads(attrs_file.read_text())
