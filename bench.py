@@ -58,22 +58,26 @@ CACHE_PATH = os.environ.get(
 )
 
 
-def _tuned_batch(config: str) -> "int | None":
-    """Hardware-measured best site batch for the 2-D segment+measure chain
-    (``tuning/TUNING.json`` ``best_batch``, machine-written by the
-    ``tune_tpu.py`` sweep on a live chip; the round-2 hand-seeded file is
-    rejected by the ``written_by`` gate).  None for configs the sweep
-    doesn't model — their defaults stay static."""
-    if config not in ("3", "4"):
-        return None
+def _load_tuning() -> "dict | None":
+    """The machine-written tuning verdict, or None.  ONE provenance gate
+    for every tuned default: only a file ``tune_tpu.py write_results``
+    itself produced counts (the round-2 hand-seeded file is rejected)."""
     try:
         with open(os.path.join(REPO, "tuning", "TUNING.json")) as f:
             tuning = json.load(f)
     except (OSError, ValueError):
         return None
-    if "written_by" not in tuning:
+    return tuning if "written_by" in tuning else None
+
+
+def _tuned_batch(config: str) -> "int | None":
+    """Hardware-measured best site batch for the 2-D segment+measure
+    chain (``best_batch``).  None for configs the sweep doesn't model —
+    their defaults stay static."""
+    if config not in ("3", "4"):
         return None
-    best = tuning.get("best_batch")
+    tuning = _load_tuning()
+    best = tuning.get("best_batch") if tuning else None
     if isinstance(best, (int, float)) and int(best) > 0:
         return int(best)
     return None
@@ -85,6 +89,14 @@ def _default_batch(config: str) -> int:
     return _tuned_batch(config) or 64
 
 
+def _tuned_pipeline_default() -> int:
+    """Device-backend pipeline depth: the machine-written tuning sweep's
+    ``best_pipeline`` when one exists, else 8."""
+    tuning = _load_tuning()
+    best = tuning.get("best_pipeline") if tuning else None
+    return int(best) if isinstance(best, (int, float)) and int(best) > 0 else 8
+
+
 def _pipeline_depth(backend: str) -> int:
     """How many batch executions each timed rep enqueues before the ONE
     host fetch that fences them all.  Under the axon relay a host fetch
@@ -94,11 +106,11 @@ def _pipeline_depth(backend: str) -> int:
     steady-state answer: production processes thousands of sites and
     only ever pays the fetch once per drained queue.  On the CPU backend
     dispatch is synchronous and there is no relay, so depth defaults
-    to 1."""
+    to 1; on device the default is the hardware-swept ``best_pipeline``."""
     depth = os.environ.get("BENCH_PIPELINE")
     if depth:
         return max(1, int(depth))
-    return 1 if backend == "cpu" else 8
+    return 1 if backend == "cpu" else _tuned_pipeline_default()
 
 
 # env knob -> (record field, per-config default): a cached record only
@@ -110,11 +122,12 @@ def _pipeline_depth(backend: str) -> int:
 def _workload_knobs(config: str) -> dict:
     return {
         "BENCH_BATCH": ("batch", _default_batch(config)),
-        # methodology knob, but it changes the measured value: a depth-1
-        # record must not be served for an explicit depth-8 request (the
-        # default 8 matches what a TPU-backed measure() would use; cached
-        # records are always TPU-measured)
-        "BENCH_PIPELINE": ("pipeline_depth", 8),
+        # env-ONLY knob (default None): records self-describe their
+        # measured depth, and serving an on-hardware record taken at a
+        # superseded default beats a cpu_fallback — only an EXPLICIT
+        # BENCH_PIPELINE request must match (the watcher separately
+        # re-measures records whose depth lags the tuned default)
+        "BENCH_PIPELINE": ("pipeline_depth", None),
         "BENCH_MAX_OBJECTS": ("max_objects", 64),
         "BENCH_SITE_SIZE": (
             "site_size", 128 if config == "volume" else 256
@@ -153,16 +166,26 @@ def emit_cached_tpu(live_error: str) -> bool:
         rec = cand.get("record") or {}
         if rec.get("config") != config:
             continue
-        def _effective(knob: str, default: int) -> int:
-            try:
-                return int(os.environ.get(knob) or default)
-            except ValueError:
-                # an unparseable knob must not crash the parent: the
-                # child already failed with it, and a no-match here lets
-                # the fallback path still emit a structured record
-                return -1
+        def _mismatch(knob: str, field: str, default) -> bool:
+            if field not in rec:
+                return False
+            env = os.environ.get(knob)
+            if env is None:
+                if default is None:  # env-only knob: unset = no constraint
+                    return False
+                effective = default
+            else:
+                try:
+                    effective = int(env)
+                except ValueError:
+                    # an unparseable knob must not crash the parent: the
+                    # child already failed with it, and a no-match here
+                    # lets the fallback still emit a structured record
+                    effective = -1
+            return effective != rec[field]
+
         if any(
-            field in rec and _effective(knob, default) != rec[field]
+            _mismatch(knob, field, default)
             for knob, (field, default) in knobs.items()
         ):
             continue

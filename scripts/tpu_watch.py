@@ -52,6 +52,7 @@ BENCH_ITEMS = [
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed
     "sweep": "batch_sweep",
+    "pipeline": "pipeline_sweep",
     "kernels": "kernels_ms",
     "glcm": "glcm_ms",
     "pallas_bench": "bench_with_pallas",
@@ -87,14 +88,19 @@ def save_cache(cache: dict) -> None:
 
 
 def bench_done(key: str) -> bool:
+    from bench import _tuned_pipeline_default
+
     entry = (load_json(CACHE_PATH).get("records") or {}).get(key)
     if not (entry and entry.get("record")):
         return False
-    # records that predate the pipelined-fetch methodology (no
-    # pipeline_depth field) under-measure by the ~100 ms relay round-trip
-    # per rep: keep serving them from bench.py, but re-measure on the
-    # next window (run_bench_item only replaces a record on success)
-    return entry["record"].get("pipeline_depth") is not None
+    # a record is only done when measured at the CURRENT default
+    # pipeline depth: pre-pipelining records (no field) under-measure by
+    # the relay round-trip per rep, and records at a superseded
+    # best_pipeline would stop matching emit_cached_tpu's knob check —
+    # orphaned forever unless re-measured here.  Stale records keep
+    # serving from bench.py until the successful re-measure replaces
+    # them (run_bench_item only writes on success).
+    return entry["record"].get("pipeline_depth") == _tuned_pipeline_default()
 
 
 def run_bench_item(key: str, overrides: dict) -> bool:
@@ -172,6 +178,10 @@ def pending_tune_stages() -> list:
             continue  # tune_tpu only runs it when pallas wins
         if key not in tuning or stage in errors:
             out.append(stage)
+    # the pipeline sweep depends on best_batch: whenever sweep reruns,
+    # pipeline must rerun with it (tune_tpu also drops the stale verdict)
+    if "sweep" in out and "pipeline" not in out:
+        out.append("pipeline")
     return out
 
 

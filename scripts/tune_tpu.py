@@ -1,13 +1,16 @@
 #!/usr/bin/env python
 """One-command TPU tuning sweep (run when the chip is available):
 
-1. bench batch-size sweep (64/128/256) for the default config;
-2. XLA vs pallas kernel timing for CC labeling, watershed and the
+1. bench batch-size sweep (64/128/256/512) for the default config,
+   pinned at pipeline depth ``PIPELINE`` so points stay comparable;
+2. pipeline-depth sweep (4/8/16) at the winning batch — the measured
+   default for ``bench._pipeline_depth`` on device backends;
+3. XLA vs pallas kernel timing for CC labeling, watershed and the
    distance transform;
-3. GLCM accumulation shootout: one-hot matmul (MXU) vs scatter-add;
-4. writes every number to ``tuning/TUNING.json`` (committed — it is the
-   data-driven default for ``pallas_enabled()`` and the GLCM method) and
-   prints the recommended defaults.
+4. GLCM accumulation shootout: one-hot matmul (MXU) vs scatter-add;
+5. writes every number to ``tuning/TUNING.json`` (committed — it is the
+   data-driven default for ``pallas_enabled()``, the GLCM method, the
+   batch and the pipeline depth) and prints the recommended defaults.
 
 Usage: python scripts/tune_tpu.py
 """
@@ -172,7 +175,7 @@ def main():
     """Each stage is guarded and results are flushed to TUNING.json after
     every stage — a flaky TPU relay mid-sweep (it happens) must not lose
     the stages that DID complete.  ``TUNE_SKIP=<stage,stage>`` (sweep |
-    kernels | glcm | pallas_bench) reruns the rest; pre-existing committed
+    pipeline | kernels | glcm | pallas_bench) reruns the rest; pre-existing committed
     values for skipped stages are preserved."""
     import jax
 
@@ -209,9 +212,15 @@ def main():
     RESULTS.get("stage_errors", {}).pop("backend_init", None)
     # stale-failure hygiene: a stage that is about to rerun must not
     # inherit its previous failure records from the committed file
-    for name in ("sweep", "kernels", "glcm", "pallas_bench"):
+    for name in ("sweep", "pipeline", "kernels", "glcm", "pallas_bench"):
         if name not in skip:
             RESULTS.get("stage_errors", {}).pop(name, None)
+    # the pipeline sweep is parameterized by best_batch: a sweep rerun
+    # invalidates any committed pipeline verdict measured at the old
+    # (or fallback) batch
+    if "sweep" not in skip:
+        RESULTS.pop("pipeline_sweep", None)
+        RESULTS.pop("best_pipeline", None)
     # kernel_errors entries belong to the kernels stage (cc_/ws_/dt_*)
     # or the glcm stage (glcm_*) — keep only the skipped stage's
     keep = {
@@ -243,8 +252,12 @@ def main():
     def do_sweep():
         best = None
         sweep = {}
-        for batch in (64, 128, 256):
-            r = run_bench({"BENCH_BATCH": batch, "BENCH_ATTEMPTS": "1"})
+        for batch in (64, 128, 256, 512):
+            # BENCH_PIPELINE pinned: the children would otherwise read
+            # whatever best_pipeline is committed at that moment, mixing
+            # depths across points and across runs of one methodology
+            r = run_bench({"BENCH_BATCH": batch, "BENCH_ATTEMPTS": "1",
+                           "BENCH_PIPELINE": PIPELINE})
             print(f"  batch={batch}: {r['value']} sites/s")
             sweep[batch] = r["value"]
             if best is None or r["value"] > best[1]:
@@ -252,6 +265,26 @@ def main():
         RESULTS["batch_sweep"] = sweep
         RESULTS["best_batch"] = best[0]
         print(f"best batch: {best[0]} ({best[1]} sites/s)")
+
+    def do_pipeline():
+        # fetch-amortization sweep at the winning batch: the depth is a
+        # methodology default (bench._pipeline_depth), so it must be
+        # measured, not guessed
+        best = None
+        sweep = {}
+        for depth in (4, 8, 16):
+            r = run_bench({
+                "BENCH_BATCH": RESULTS.get("best_batch", 64),
+                "BENCH_PIPELINE": depth,
+                "BENCH_ATTEMPTS": "1",
+            })
+            print(f"  pipeline={depth}: {r['value']} sites/s")
+            sweep[depth] = r["value"]
+            if best is None or r["value"] > best[1]:
+                best = (depth, r["value"])
+        RESULTS["pipeline_sweep"] = sweep
+        RESULTS["best_pipeline"] = best[0]
+        print(f"best pipeline depth: {best[0]} ({best[1]} sites/s)")
 
     def do_kernels():
         RESULTS["pallas_wins"] = bool(kernel_shootout())
@@ -265,11 +298,13 @@ def main():
         if not RESULTS.get("pallas_wins"):
             return
         r = run_bench({"BENCH_BATCH": RESULTS.get("best_batch", 64),
+                       "BENCH_PIPELINE": PIPELINE,
                        "TMX_PALLAS": "1", "BENCH_ATTEMPTS": "1"})
         RESULTS["bench_with_pallas"] = r["value"]
         print(f"bench with TMX_PALLAS=1: {r['value']} sites/s")
 
     stage("sweep", do_sweep)
+    stage("pipeline", do_pipeline)
     stage("kernels", do_kernels)
     stage("glcm", do_glcm)
     stage("pallas_bench", do_pallas_bench)
