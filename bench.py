@@ -170,8 +170,8 @@ def emit_cached_tpu(live_error: str) -> bool:
             if field not in rec:
                 return False
             env = os.environ.get(knob)
-            if env is None:
-                if default is None:  # env-only knob: unset = no constraint
+            if not env:  # unset OR empty: measure() treats both as default
+                if default is None:  # env-only knob: no constraint
                     return False
                 effective = default
             else:

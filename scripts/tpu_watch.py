@@ -88,19 +88,26 @@ def save_cache(cache: dict) -> None:
 
 
 def bench_done(key: str) -> bool:
-    from bench import _tuned_pipeline_default
+    from bench import _default_batch, _tuned_pipeline_default
 
     entry = (load_json(CACHE_PATH).get("records") or {}).get(key)
     if not (entry and entry.get("record")):
         return False
-    # a record is only done when measured at the CURRENT default
-    # pipeline depth: pre-pipelining records (no field) under-measure by
-    # the relay round-trip per rep, and records at a superseded
-    # best_pipeline would stop matching emit_cached_tpu's knob check —
-    # orphaned forever unless re-measured here.  Stale records keep
-    # serving from bench.py until the successful re-measure replaces
-    # them (run_bench_item only writes on success).
-    return entry["record"].get("pipeline_depth") == _tuned_pipeline_default()
+    # a record is only done when measured at the CURRENT defaults: a
+    # superseded best_pipeline or best_batch makes emit_cached_tpu's
+    # knob check (batch) or the headline methodology (depth) diverge
+    # from the record — orphaned forever unless re-measured here.
+    # Stale records keep serving from bench.py until the successful
+    # re-measure replaces them (run_bench_item only writes on success).
+    rec = entry["record"]
+    if rec.get("pipeline_depth") != _tuned_pipeline_default():
+        return False
+    config = rec.get("config")
+    if config and "batch" in rec and rec["batch"] != _default_batch(
+        str(config)
+    ):
+        return False
+    return True
 
 
 def run_bench_item(key: str, overrides: dict) -> bool:
