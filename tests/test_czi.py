@@ -49,7 +49,7 @@ def _compress(data: bytes, compression: int, hilo: bool = False) -> bytes:
 
 def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0,
               hilo=False, n_tiles=1, with_pyramid=False,
-              global_m=False) -> None:
+              global_m=False, tile_origins=None) -> None:
     """``planes``: (S, C, H, W) uint16 — one z-plane, one tpoint.  With
     ``n_tiles`` > 1 the S axis is reinterpreted as S*M (mosaic tiles,
     S fastest-outer): planes[s*M+m] carries dims S=s, M=m.  With
@@ -77,7 +77,8 @@ def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0,
     for sm in range(n_sm):
         s, m = divmod(sm, n_tiles)
         for c in range(n_c):
-            dims = [("X", 0, w), ("Y", 0, h), ("C", c, 1), ("Z", 0, 1),
+            y0, x0 = (tile_origins[m] if tile_origins else (0, 0))
+            dims = [("X", x0, w), ("Y", y0, h), ("C", c, 1), ("Z", 0, 1),
                     ("T", 0, 1), ("S", s, 1)]
             if n_tiles > 1:
                 dims.append(("M", sm if global_m else m, 1))
@@ -372,3 +373,60 @@ def test_czi_sparse_grid_rejected_at_open(tmp_path):
     path.write_bytes(bytes(blob))
     with pytest.raises(MetadataError, match="sparse"):
         CZIReader(path).__enter__()
+
+
+def test_czi_mosaic_tile_origins_drive_the_well_grid(tmp_path):
+    """Single-scene mosaics with dense pixel origins ingest in
+    acquisition geometry: site = grid(y, x) from the subblock origins,
+    not the raw M order."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(57)
+    planes = rng.integers(0, 60000, (4, 1, 10, 12), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    # acquisition order serpentine: M0=(0,0), M1=(0,12), M2=(10,12), M3=(10,0)
+    origins = [(0, 0), (0, 12), (10, 12), (10, 0)]
+    write_czi(src / "slide_A01.czi", planes, n_tiles=4,
+              tile_origins=origins)
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="geo", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "czi"})
+    meta.run(0)
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 4
+
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+    px = ExperimentStore.open(root).read_sites(None, channel=0)
+    # row-major grid linearisation: site 0=(0,0)=M0, 1=(0,1)=M1,
+    # 2=(1,0)=M3, 3=(1,1)=M2
+    np.testing.assert_array_equal(px[0], planes[0, 0])
+    np.testing.assert_array_equal(px[1], planes[1, 0])
+    np.testing.assert_array_equal(px[2], planes[3, 0])
+    np.testing.assert_array_equal(px[3], planes[2, 0])
+
+
+def test_czi_sparse_origins_fall_back_to_m_order(tmp_path):
+    """Origins that do not form a dense rectangle (L-shaped scan) keep
+    the raw M-order site mapping."""
+    from tmlibrary_tpu.workflow.steps.vendors import czi_sidecar
+
+    rng = np.random.default_rng(58)
+    planes = rng.integers(0, 60000, (3, 1, 10, 12), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    write_czi(src / "L_A01.czi", planes, n_tiles=3,
+              tile_origins=[(0, 0), (0, 12), (10, 0)])
+    entries, skipped = czi_sidecar(src)
+    assert skipped == 0
+    assert all("site_y" not in e for e in entries)
+    assert [e["site"] for e in entries] == [0, 1, 2]

@@ -803,6 +803,14 @@ class CZIReader(Reader):
                  p["C"], p["Z"], p["T"]): p
                 for p in self._planes
             }
+            # per-(scene, tile) mosaic pixel origin (first plane wins;
+            # c/z/t share the tile's frame) — adjacency for slide scans
+            self._tile_origins: dict = {}
+            for p in self._planes:
+                key = (p["S"], tile_rank[(p["S"], p["M"])])
+                self._tile_origins.setdefault(
+                    key, (p.get("y0", 0), p.get("x0", 0))
+                )
             # a sparse or duplicated (scene, tile, c, z, t) grid would
             # fail mid-extract with half the sites written; fail the OPEN
             # instead so the handler skips the file with a logged reason
@@ -894,9 +902,13 @@ class CZIReader(Reader):
             name = buf[p:p + 4].rstrip(b"\x00").decode("ascii", "replace")
             start, size = struct.unpack_from("<ii", buf, p + 4)
             if name == "X":
+                # start = the tile's pixel origin in the mosaic frame —
+                # the adjacency information the spatial layout needs
                 plane["w"] = size
+                plane["x0"] = start
             elif name == "Y":
                 plane["h"] = size
+                plane["y0"] = start
             elif name in ("C", "Z", "T", "S", "M"):
                 # M = mosaic tile index (slide scans / large areas): each
                 # tile is exposed as its own plane, tiles -> sites
@@ -1016,6 +1028,23 @@ class CZIReader(Reader):
             self._data, np.uint16, count=h * w, offset=data_off
         )
         return samples.reshape(h, w).copy()
+
+    def tile_origin(self, scene: int, tile: int) -> tuple[int, int]:
+        """(y0, x0) mosaic pixel origin of a tile (0-based per-scene
+        rank), for grid derivation; (0, 0) when the directory carried no
+        origins."""
+        from tmlibrary_tpu.errors import MetadataError
+
+        if not (0 <= scene < self.n_scenes and 0 <= tile < self.n_tiles):
+            # same contract as read_plane: a negative index must not
+            # silently wrap through the sorted id lists
+            raise MetadataError(
+                f"{self.filename}: tile origin ({scene}, {tile}) out of "
+                f"range ({self.n_scenes} scenes, {self.n_tiles} tiles)"
+            )
+        return self._tile_origins.get(
+            (self._scene_ids[scene], tile), (0, 0)
+        )
 
     def read_plane_linear(self, page: int) -> np.ndarray:
         """Decode by linear page index, the encoding the czi metaconfig

@@ -131,21 +131,33 @@ def derive_well_grids(
         per_well[(e["well_row"], e["well_col"])].append(e)
     grids: dict[tuple[int, int], tuple[dict, dict]] = {}
     for key, group in per_well.items():
-        xs = [e["stage_x"] for e in group if e["stage_x"] is not None]
-        ys = [e["stage_y"] for e in group if e["stage_y"] is not None]
-        y_index = positions_to_grid(ys)
-        x_index = positions_to_grid(xs)
-        fields = {e["site"] for e in group}
-        cells = {
-            (y_index[e["stage_y"]], x_index[e["stage_x"]])
-            for e in group
+        pairs = [
+            (e["stage_y"], e["stage_x"]) for e in group
             if e["stage_x"] is not None and e["stage_y"] is not None
-        }
-        ny = len(set(y_index.values()))
-        nx = len(set(x_index.values()))
-        if len(cells) == len(fields) and ny * nx == len(fields):
-            grids[key] = (y_index, x_index)
+        ]
+        fields = {e["site"] for e in group}
+        res = dense_grid(
+            [p[0] for p in pairs], [p[1] for p in pairs], len(fields)
+        )
+        if res is not None:
+            grids[key] = (res[1], res[2])
     return grids
+
+
+def dense_grid(ys, xs, n) -> "tuple[list, dict, dict] | None":
+    """(cells, y_index, x_index) when the coordinates form a dense
+    rectangle addressing exactly ``n`` items, else None — the ONE home
+    of the cross-check shared by stage-position well grids and CZI
+    mosaic tile origins (a misclustered grid must fall back, never
+    emit wrong geometry)."""
+    y_index = positions_to_grid(ys)
+    x_index = positions_to_grid(xs)
+    cells = [(y_index[y], x_index[x]) for y, x in zip(ys, xs)]
+    ny = len(set(y_index.values()))
+    nx = len(set(x_index.values()))
+    if len(set(cells)) != n or ny * nx != n:
+        return None
+    return cells, y_index, x_index
 
 
 # --------------------------------------------------------------- cellvoyager
@@ -1140,27 +1152,51 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     token in the filename, else the next free column on row A), scenes
     (S) × mosaic tiles (M, slide scans) map to sites, channels to
     ``C00``/…, with Z/T preserved; ``page`` encodes
-    ``(((s * M + m) * C + c) * Z + z) * T + t`` for imextract."""
+    ``(((s * M + m) * C + c) * Z + z) * T + t`` for imextract.
+
+    Single-scene mosaics additionally carry each tile's within-well
+    grid coordinate (``site_y``/``site_x`` from the subblock directory's
+    mosaic pixel origins) whenever the origins form a dense rectangle —
+    the adjacency ``--layout spatial`` needs to stitch a slide scan in
+    acquisition geometry rather than a square-ish default grid."""
     from tmlibrary_tpu.readers import CZIReader
 
+    def tile_grid(n_m, origins) -> "list[tuple[int, int]] | None":
+        """(y, x) grid index per tile rank, or None when origins are
+        absent or not a dense rectangle (shared cross-check)."""
+        if origins is None:
+            return None
+        res = dense_grid(
+            [float(y) for y, _ in origins],
+            [float(x) for _, x in origins], n_m,
+        )
+        return None if res is None else res[0]
+
     def entries_of(path, dims, well):
-        n_s, n_m, n_c, n_z, n_t = dims
-        return [
-            _container_entry(
-                path, well, site=s * n_m + m, channel=c, zplane=z,
-                tpoint=t,
-                page=(((s * n_m + m) * n_c + c) * n_z + z) * n_t + t)
-            for s in range(n_s)
-            for m in range(n_m)
-            for c in range(n_c)
-            for z in range(n_z)
-            for t in range(n_t)
-        ]
+        n_s, n_m, n_c, n_z, n_t, origins = dims
+        grid = tile_grid(n_m, origins) if n_s == 1 and n_m > 1 else None
+        out = []
+        for s in range(n_s):
+            for m in range(n_m):
+                for c in range(n_c):
+                    for z in range(n_z):
+                        for t in range(n_t):
+                            e = _container_entry(
+                                path, well, site=s * n_m + m, channel=c,
+                                zplane=z, tpoint=t,
+                                page=(((s * n_m + m) * n_c + c) * n_z + z)
+                                * n_t + t)
+                            if grid is not None:
+                                e["site_y"], e["site_x"] = grid[m]
+                            out.append(e)
+        return out
 
     return _container_sidecar(
         source_dir, ".czi", CZIReader, "CZI",
         lambda r: (r.n_scenes, r.n_tiles, r.n_channels, r.n_zplanes,
-                   r.n_tpoints),
+                   r.n_tpoints,
+                   [r.tile_origin(0, m) for m in range(r.n_tiles)]
+                   if r.n_scenes == 1 else None),
         entries_of,
     )
 
