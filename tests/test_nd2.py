@@ -28,6 +28,14 @@ def _lv_u32(name: str, value: int) -> bytes:
     )
 
 
+def _lv_f64(name: str, value: float) -> bytes:
+    encoded = (name + "\x00").encode("utf-16-le")
+    return (
+        struct.pack("<BB", 5, len(name) + 1) + encoded
+        + struct.pack("<d", value)
+    )
+
+
 def _lv_compound(name: str, inner: bytes) -> bytes:
     encoded = (name + "\x00").encode("utf-16-le")
     return (
@@ -38,12 +46,22 @@ def _lv_compound(name: str, inner: bytes) -> bytes:
 
 def experiment_chunk(loops) -> bytes:
     """LV payload for ImageMetadataLV!: nested SLxExperiment levels,
-    ``loops`` = [(eType, size), ...] outermost first."""
+    ``loops`` = [(eType, size)] or [(eType, size, points)] outermost
+    first; ``points`` = [(y, x), ...] emits XYPosLoop stage coords in
+    uLoopPars."""
     inner = b""
-    for etype, size in reversed(loops):
-        level = (
-            _lv_u32("eType", etype) + _lv_u32("uiLoopSize", size)
-        )
+    for spec in reversed(loops):
+        etype, size = spec[0], spec[1]
+        level = _lv_u32("eType", etype) + _lv_u32("uiLoopSize", size)
+        if len(spec) > 2 and spec[2] is not None:
+            pts = b"".join(
+                _lv_compound(
+                    f"i{i:010d}",
+                    _lv_f64("dPosX", x) + _lv_f64("dPosY", y),
+                )
+                for i, (y, x) in enumerate(spec[2])
+            )
+            level += _lv_compound("uLoopPars", _lv_compound("Points", pts))
         if inner:
             level += _lv_compound("ppNextLevelEx", inner)
         inner = level
@@ -340,3 +358,69 @@ def test_nd2_loop_decode_ignores_unrelated_etype_blocks(tmp_path):
             lambda off: payload if off == meta_off else orig(off)
         )
         assert r.loop_shape() == [("XY", 4)]
+
+
+def test_nd2_xy_positions_drive_the_well_grid(tmp_path):
+    """XYPosLoop stage coordinates linearize multi-point wells in
+    acquisition geometry (serpentine order reassembles row-major)."""
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    rng = np.random.default_rng(75)
+    planes = rng.integers(0, 60000, (4, 6, 7, 1), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    # serpentine: pos0=(0,0) pos1=(0,500) pos2=(300,500) pos3=(300,0)
+    pts = [(0.0, 0.0), (0.0, 500.0), (300.0, 500.0), (300.0, 0.0)]
+    write_nd2(src / "grid_A01.nd2", planes, loops=[(2, 4, pts)])
+    with ND2Reader(src / "grid_A01.nd2") as r:
+        assert r.xy_positions() == pts
+
+    root = tmp_path / "exp"
+    store = ExperimentStore.create(
+        root, Experiment(name="nd2geo", plates=[], channels=[],
+                         site_height=1, site_width=1))
+    meta = get_step("metaconfig")(store)
+    meta.init({"source_dir": str(src), "handler": "auto"})
+    meta.run(0)
+    ime = get_step("imextract")(store)
+    ime.init({})
+    for j in ime.list_batches():
+        ime.run(j)
+    px = ExperimentStore.open(root).read_sites(None, channel=0)
+    # row-major: site 0=pos0, 1=pos1, 2=pos3, 3=pos2
+    np.testing.assert_array_equal(px[0], planes[0, :, :, 0])
+    np.testing.assert_array_equal(px[1], planes[1, :, :, 0])
+    np.testing.assert_array_equal(px[2], planes[3, :, :, 0])
+    np.testing.assert_array_equal(px[3], planes[2, :, :, 0])
+
+
+def test_nd2_nonrect_positions_fall_back(tmp_path):
+    from tmlibrary_tpu.workflow.steps.vendors import nd2_sidecar
+
+    rng = np.random.default_rng(76)
+    planes = rng.integers(0, 60000, (3, 6, 7, 1), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    pts = [(0.0, 0.0), (0.0, 500.0), (300.0, 0.0)]  # L-shape
+    write_nd2(src / "L_A01.nd2", planes, loops=[(2, 3, pts)])
+    entries, skipped = nd2_sidecar(src)
+    assert skipped == 0
+    assert all("site_y" not in e for e in entries)
+
+
+def test_nd2_zero_sequences_yield_no_entries(tmp_path):
+    """An aborted acquisition with zero written sequences must not crash
+    the handler (max() over empty coords)."""
+    from tmlibrary_tpu.workflow.steps.vendors import nd2_sidecar
+
+    rng = np.random.default_rng(77)
+    planes = rng.integers(0, 60000, (2, 6, 7, 1), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    write_nd2(src / "empty_A01.nd2", planes[:0])  # zero ImageDataSeq chunks
+    write_nd2(src / "ok_B01.nd2", planes)
+    entries, skipped = nd2_sidecar(src)
+    assert len(entries) == 2
+    assert {e["well_row"] for e in entries} == {1}

@@ -556,6 +556,8 @@ class ND2Reader(Reader):
                 return None
             if any(k == kind for k, _ in loops):
                 return None  # nested loops of one kind are unmodeled
+            if kind == "XY":
+                self._xy_level = level  # stage positions live here
             loops.append((kind, size))
             level = find_level(level.get("ppNextLevelEx"))
         product = 1
@@ -564,6 +566,30 @@ class ND2Reader(Reader):
         if not loops or product != self.n_sequences:
             return None
         return loops
+
+    def xy_positions(self) -> "list[tuple[float, float]] | None":
+        """(stage_y, stage_x) per XY position, from the XYPosLoop's
+        ``uLoopPars`` point list — or None when the loop structure is
+        unmodeled or the point count disagrees with the loop size.  The
+        nd2 handler turns these into within-well grid coordinates."""
+        loops = self.loop_shape()  # also binds self._xy_level
+        level = getattr(self, "_xy_level", None)
+        if not loops or level is None:
+            return None
+        n_xy = dict(loops).get("XY")
+
+        def collect(node, out):
+            if isinstance(node, dict):
+                x, y = node.get("dPosX"), node.get("dPosY")
+                if isinstance(x, (int, float)) and isinstance(y, (int, float)):
+                    out.append((float(y), float(x)))
+                    return  # a point's children are calibration noise
+                for key in sorted(node):
+                    collect(node[key], out)
+
+        points: list = []
+        collect(level.get("uLoopPars"), points)
+        return points if n_xy and len(points) == n_xy else None
 
     def seq_coords(self, sequence: int) -> tuple[int, int, int]:
         """(xy_position, zplane, tpoint) of a sequence index under

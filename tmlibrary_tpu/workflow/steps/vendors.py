@@ -1117,13 +1117,28 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     SLxExperiment loop structure assigns each sequence its
     (XY-position, Z, T) coordinate — XY positions map to sites with
     time/Z preserved; files without a modeled loop structure keep the
-    flat sequences-as-sites mapping.  Interleaved components map to
+    flat sequences-as-sites mapping.  When the XYPosLoop's stage
+    coordinates form a dense rectangle, each site also carries its
+    within-well grid coordinate (``site_y``/``site_x``) so multi-point
+    wells linearize in acquisition geometry (same dense-grid
+    cross-check as CZI mosaic origins).  Interleaved components map to
     channels (``C00``/``C01``/…); ``page`` encodes
     ``seq * n_components + comp`` for imextract's plane decode."""
     from tmlibrary_tpu.readers import ND2Reader
 
     def entries_of(path, dims, well):
-        n_seq, n_comp, coords = dims
+        n_seq, n_comp, coords, positions = dims
+        if not coords:
+            # zero-sequence file (aborted acquisition): no entries, and
+            # max() below must not crash the whole ingest
+            return []
+        n_xy = max(xy for xy, _, _ in coords) + 1
+        grid = None
+        if positions is not None and len(positions) == n_xy and n_xy > 1:
+            res = dense_grid(
+                [p[0] for p in positions], [p[1] for p in positions], n_xy
+            )
+            grid = None if res is None else res[0]
         out = []
         for seq in range(n_seq):
             xy, z, t = coords[seq]
@@ -1131,13 +1146,16 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                 e = _container_entry(path, well, site=xy, channel=comp,
                                      zplane=z, tpoint=t,
                                      page=seq * n_comp + comp)
+                if grid is not None:
+                    e["site_y"], e["site_x"] = grid[xy]
                 out.append(e)
         return out
 
     return _container_sidecar(
         source_dir, ".nd2", ND2Reader, "ND2",
         lambda r: (r.n_sequences, r.n_components,
-                   [r.seq_coords(s) for s in range(r.n_sequences)]),
+                   [r.seq_coords(s) for s in range(r.n_sequences)],
+                   r.xy_positions()),
         entries_of,
     )
 
