@@ -424,3 +424,19 @@ def test_nd2_zero_sequences_yield_no_entries(tmp_path):
     entries, skipped = nd2_sidecar(src)
     assert len(entries) == 2
     assert {e["well_row"] for e in entries} == {1}
+
+
+def test_cli_inspect_reports_nd2_loops(tmp_path, capsys):
+    import json
+
+    from tmlibrary_tpu.cli import main
+
+    rng = np.random.default_rng(78)
+    planes = rng.integers(0, 60000, (12, 6, 7, 1), dtype=np.uint16)
+    path = tmp_path / "loops.nd2"
+    write_nd2(path, planes, loops=[(1, 2), (2, 3), (4, 2)])
+    assert main(["inspect", "--json", str(path)]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["format"] == "ND2"
+    assert out["loops"] == [["T", 2], ["XY", 3], ["Z", 2]]
+    assert out["n_sequences"] == 12

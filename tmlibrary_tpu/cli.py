@@ -298,6 +298,11 @@ def cmd_inspect(args) -> int:
                         names = names()
                     if names:
                         info["channel_names"] = list(names)
+                    loops = getattr(r, "loop_shape", None)
+                    if callable(loops):
+                        loops = loops()
+                    if loops:  # ND2 acquisition nesting, outermost first
+                        info["loops"] = [[kind, size] for kind, size in loops]
                 finally:
                     r.__exit__()
             else:
