@@ -1,6 +1,7 @@
 """Compile smoke tests for scripts/ — nothing imports these at test time,
 so a syntax error there ships silently (round-2 advisor finding: a stray
 indent made ``tune_tpu.py`` unrunnable while CI stayed green)."""
+import json
 import pathlib
 import py_compile
 
@@ -18,3 +19,86 @@ def test_script_compiles(path):
 
 def test_scripts_found():
     assert len(SCRIPTS) >= 3
+
+
+def _watch(monkeypatch, tmp_path, cache=None, tuning=None):
+    """Import tpu_watch with CACHE/TUNING paths redirected to tmp."""
+    monkeypatch.syspath_prepend(str(SCRIPTS[0].parent.parent))
+    import bench
+    from scripts import tpu_watch
+
+    (tmp_path / "tuning").mkdir(exist_ok=True)
+    cache_path = tmp_path / "tuning" / "BENCH_TPU.json"
+    tuning_path = tmp_path / "tuning" / "TUNING.json"
+    if cache is not None:
+        cache_path.write_text(json.dumps(cache))
+    if tuning is not None:
+        tuning_path.write_text(json.dumps(tuning))
+    monkeypatch.setattr(tpu_watch, "CACHE_PATH", str(cache_path))
+    monkeypatch.setattr(tpu_watch, "TUNING_PATH", str(tuning_path))
+    # bench's tuned defaults read the repo TUNING.json via bench.REPO
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    return tpu_watch
+
+
+def _record(value=300.0, depth=8, batch=64, config="3"):
+    return {"record": {
+        "metric": "m", "value": value, "vs_baseline": 5.0,
+        "backend": "axon", "config": config, "batch": batch,
+        "pipeline_depth": depth,
+    }, "measured_at": "t", "measured_at_unix": 1.0, "provenance": "t"}
+
+
+MACHINE = {"written_by": "scripts/tune_tpu.py write_results"}
+
+
+def test_bench_done_tracks_tuned_defaults(monkeypatch, tmp_path):
+    """A cached record is 'done' only at the CURRENT tuned pipeline
+    depth and batch — superseded defaults trigger re-measurement."""
+    w = _watch(
+        monkeypatch, tmp_path,
+        cache={"records": {"3": _record(depth=8, batch=64)}},
+        tuning={**MACHINE, "best_pipeline": 8, "best_batch": 64,
+                "timing_methodology": "x"},
+    )
+    assert w.bench_done("3") is True
+
+    (tmp_path / "tuning" / "TUNING.json").write_text(
+        json.dumps(
+            {**MACHINE, "best_pipeline": 16, "best_batch": 64}))
+    assert w.bench_done("3") is False  # depth superseded
+
+    (tmp_path / "tuning" / "TUNING.json").write_text(
+        json.dumps(
+            {**MACHINE, "best_pipeline": 8, "best_batch": 128}))
+    assert w.bench_done("3") is False  # batch superseded
+
+    # config the sweep doesn't model: batch changes don't orphan it
+    w2 = _watch(
+        monkeypatch, tmp_path,
+        cache={"records": {"volume": _record(
+            depth=8, batch=16, config="volume")}},
+        tuning={**MACHINE, "best_pipeline": 8, "best_batch": 128},
+    )
+    assert w2.bench_done("volume") is True
+
+
+def test_pending_tune_couples_pipeline_to_sweep(monkeypatch, tmp_path):
+    from scripts.tune_tpu import METHODOLOGY
+
+    complete = {
+        **MACHINE, "timing_methodology": METHODOLOGY,
+        "batch_sweep": {"64": 1}, "pipeline_sweep": {"8": 1},
+        "kernels_ms": {}, "glcm_ms": {}, "bench_with_pallas": 1,
+        "pallas_wins": True,
+    }
+    w = _watch(monkeypatch, tmp_path, tuning=complete)
+    assert w.pending_tune_stages() == []
+
+    partial = dict(complete)
+    del partial["batch_sweep"]
+    (tmp_path / "tuning" / "TUNING.json").write_text(
+        json.dumps(partial))
+    pending = w.pending_tune_stages()
+    assert "sweep" in pending
+    assert "pipeline" in pending  # rerunning sweep invalidates pipeline
