@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""One-command demo: the full TmLibrary user journey on synthetic data.
+
+    python scripts/demo.py [WORKDIR]
+
+Generates a two-well microscopy experiment (noisy blob nuclei, two
+channels), then drives the REAL ``tmx`` CLI surface end to end:
+
+  create -> metaconfig -> imextract -> corilla -> jterator -> run log
+  -> tool (k-means request lifecycle) -> exports (feature CSV,
+  simplified GeoJSON polygons with joined features, OME-NGFF plate,
+  illumination-stats HDF5) -> inspect of the exported plate
+
+Runs on the CPU backend by default so it works anywhere; set
+``TMX_DEMO_DEVICE=1`` to use the session's default JAX backend.
+Everything lands under WORKDIR (default: a fresh temp dir), which the
+script prints so you can poke at the artifacts.
+"""
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+if not os.environ.get("TMX_DEMO_DEVICE"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def synth_source(src: Path, rng) -> None:
+    """Two wells x 4 sites x 2 channels of blobby uint16 PNGs named by
+    the default filename pattern."""
+    import cv2
+
+    yy, xx = np.mgrid[0:96, 0:96]
+    for well in ("A01", "B02"):
+        for site in range(4):
+            dapi = rng.normal(300, 20, (96, 96))
+            for _ in range(7):
+                cy, cx = rng.integers(12, 84, 2)
+                dapi += 2500.0 * np.exp(
+                    -((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 3.5**2)
+                )
+            actin = np.clip(dapi * 0.6 + rng.normal(200, 30, (96, 96)), 0, None)
+            for chan, img in (("DAPI", dapi), ("Actin", actin)):
+                cv2.imwrite(
+                    str(src / f"{well}_s{site}_c0_{chan}.png"),
+                    np.clip(img, 0, 65535).astype(np.uint16),
+                )
+
+
+PIPE_YAML = """\
+description: demo — smooth, segment nuclei, measure intensity
+input:
+  channels:
+    - {name: DAPI, correct: true, align: false}
+    - {name: Actin, correct: false, align: false}
+pipeline:
+  - handles:
+      module: smooth
+      input:
+        - {name: intensity_image, type: IntensityImage, key: DAPI}
+        - {name: sigma, type: Numeric, value: 1.5}
+      output:
+        - {name: smoothed_image, type: IntensityImage, key: sm}
+  - handles:
+      module: segment_primary
+      input:
+        - {name: intensity_image, type: IntensityImage, key: sm}
+        - {name: threshold_method, type: Character, value: otsu}
+        - {name: smooth_sigma, type: Numeric, value: 0.0}
+        - {name: min_area, type: Numeric, value: 10}
+      output:
+        - {name: objects, type: SegmentedObjects, key: nuclei, objects: nuclei}
+  - handles:
+      module: measure_intensity
+      input:
+        - {name: objects_image, type: LabelImage, key: nuclei}
+        - {name: intensity_image, type: IntensityImage, key: Actin}
+      output:
+        - {name: measurements, type: Measurement, objects: nuclei, channel: Actin}
+output:
+  objects:
+    - {name: nuclei, as_polygons: true}
+"""
+
+
+def run(argv) -> None:
+    from tmlibrary_tpu.cli import main
+
+    argv = [str(a) for a in argv]
+    print("  $ tmx " + " ".join(argv))
+    rc = main(argv)
+    if rc != 0:
+        raise SystemExit(f"demo step failed (rc={rc}): tmx {' '.join(argv)}")
+
+
+def main() -> None:
+    work = Path(sys.argv[1]) if len(sys.argv) > 1 else Path(
+        tempfile.mkdtemp(prefix="tmx-demo-")
+    )
+    work.mkdir(parents=True, exist_ok=True)
+    src = work / "microscope"
+    src.mkdir(exist_ok=True)
+    root = work / "experiment"
+    rng = np.random.default_rng(7)
+
+    print(f"== demo workspace: {work}")
+    synth_source(src, rng)
+    print(f"== synthetic source: {len(list(src.iterdir()))} files in {src}")
+
+    run(["create", "--root", root, "--name", "demo"])
+    run(["metaconfig", "init", "--root", root, "--source-dir", src,
+         "--handler", "auto"])
+    run(["metaconfig", "run", "--root", root])
+    run(["imextract", "init", "--root", root])
+    run(["imextract", "run", "--root", root])
+    run(["corilla", "init", "--root", root])
+    run(["corilla", "run", "--root", root])
+    run(["corilla", "collect", "--root", root])
+
+    pipe = work / "nuclei.pipe.yaml"
+    pipe.write_text(PIPE_YAML)
+    run(["jterator", "init", "--root", root, "--pipe", pipe,
+         "--max-objects", "64", "--as-polygons"])
+    run(["jterator", "run", "--root", root])
+    run(["jterator", "collect", "--root", root])
+    run(["log", "--root", root, "--tail", "6"])
+
+    run(["tool", "submit", "--root", root, "--name", "clustering",
+         "--payload",
+         '{"objects_name": "nuclei", "k": 2}'])
+    run(["tool", "list", "--root", root])
+
+    out = work / "exports"
+    out.mkdir(exist_ok=True)
+    run(["export", "--root", root, "--objects", "nuclei",
+         "--out", out / "nuclei.csv"])
+    run(["export", "--root", root, "--objects", "nuclei",
+         "--out", out / "nuclei.geojson", "--simplify", "0.8",
+         "--join-features", "Intensity_mean_Actin"])
+    run(["export", "--root", root, "--illumstats", "0",
+         "--out", out / "illumstats_c0.h5"])
+    run(["export", "--root", root, "--ngff",
+         "--out", out / "demo.zarr"])
+    run(["inspect", out / "demo.zarr"])
+
+    print("== demo artifacts ==")
+    for p in sorted(out.iterdir()):
+        size = sum(
+            f.stat().st_size for f in p.rglob("*") if f.is_file()
+        ) if p.is_dir() else p.stat().st_size
+        print(f"  {p.name:20s} {size:>10,} bytes")
+    print(f"== done; everything is under {work}")
+
+
+if __name__ == "__main__":
+    main()
