@@ -425,3 +425,18 @@ def test_ngff_handler_skips_broken_plate(tmp_path):
     empty = tmp_path / "empty"
     empty.mkdir()
     assert ngff_sidecar(empty) is None  # no plates at all
+
+
+def test_cli_inspect_reads_ngff_plate(blob_store, tmp_path, capsys):
+    import json
+
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.ngff import write_ngff_plate
+
+    st, _ = blob_store
+    plate = write_ngff_plate(st, tmp_path / "p.zarr", n_levels=1)
+    assert main(["inspect", "--json", str(plate)]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["format"] == "NGFF"
+    assert out["n_fields"] == 2 and out["n_channels"] == 2
+    assert out["channel_names"] == ["DAPI", "Actin"]  # store order

@@ -102,3 +102,26 @@ def test_pending_tune_couples_pipeline_to_sweep(monkeypatch, tmp_path):
     pending = w.pending_tune_stages()
     assert "sweep" in pending
     assert "pipeline" in pending  # rerunning sweep invalidates pipeline
+
+
+def test_demo_pipe_yaml_stays_valid():
+    """The demo script's embedded pipeline must parse and validate
+    against the real description schema."""
+    import importlib.util
+
+    import yaml
+
+    spec = importlib.util.spec_from_file_location(
+        "tmx_demo", SCRIPTS[0].parent / "demo.py"
+    )
+    # import executes jax.config.update('jax_platforms','cpu'): fine
+    # under the test conftest, which forces cpu anyway
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    from tmlibrary_tpu.jterator.description import PipelineDescription
+
+    desc = PipelineDescription.from_dict(yaml.safe_load(mod.PIPE_YAML))
+    desc.validate()
+    assert [m.module for m in desc.modules] == [
+        "smooth", "segment_primary", "measure_intensity"
+    ]
