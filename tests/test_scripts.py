@@ -104,23 +104,19 @@ def test_pending_tune_couples_pipeline_to_sweep(monkeypatch, tmp_path):
     assert "pipeline" in pending  # rerunning sweep invalidates pipeline
 
 
-def test_demo_pipe_yaml_stays_valid():
+def test_demo_pipe_yaml_stays_valid(monkeypatch):
     """The demo script's embedded pipeline must parse and validate
     against the real description schema."""
-    import importlib.util
-
     import yaml
 
-    spec = importlib.util.spec_from_file_location(
-        "tmx_demo", SCRIPTS[0].parent / "demo.py"
-    )
-    # import executes jax.config.update('jax_platforms','cpu'): fine
-    # under the test conftest, which forces cpu anyway
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
+    monkeypatch.syspath_prepend(str(SCRIPTS[0].parent.parent))
+    # importing demo runs jax.config.update('jax_platforms','cpu'):
+    # fine under the test conftest, which forces cpu anyway
+    from scripts import demo
+
     from tmlibrary_tpu.jterator.description import PipelineDescription
 
-    desc = PipelineDescription.from_dict(yaml.safe_load(mod.PIPE_YAML))
+    desc = PipelineDescription.from_dict(yaml.safe_load(demo.PIPE_YAML))
     desc.validate()
     assert [m.module for m in desc.modules] == [
         "smooth", "segment_primary", "measure_intensity"
