@@ -121,3 +121,35 @@ def test_demo_pipe_yaml_stays_valid(monkeypatch):
     assert [m.module for m in desc.modules] == [
         "smooth", "segment_primary", "measure_intensity"
     ]
+
+
+def test_update_baseline_table_idempotent(monkeypatch, tmp_path):
+    import json
+
+    monkeypatch.syspath_prepend(str(SCRIPTS[0].parent.parent))
+    from scripts import update_baseline_table as u
+
+    baseline = tmp_path / "BASELINE.md"
+    baseline.write_text("# baseline\n\nprose\n")
+    cache = tmp_path / "BENCH_TPU.json"
+    cache.write_text(json.dumps({"records": {"3": {
+        "record": {"value": 400.0, "unit": "sites/s", "vs_baseline": 7.5,
+                   "batch": 128, "pipeline_depth": 8},
+        "measured_at": "2026-07-31T00:00:00+00:00",
+    }}}))
+    monkeypatch.setattr(u, "BASELINE", baseline)
+    monkeypatch.setattr(u, "CACHE", cache)
+    assert u.main() == 0
+    once = baseline.read_text()
+    assert "400.0" in once and once.count(u.BEGIN) == 1
+    assert "prose" in once  # surrounding text untouched
+    # update in place, no duplication
+    cache.write_text(json.dumps({"records": {"3": {
+        "record": {"value": 450.0, "unit": "sites/s", "vs_baseline": 8.5,
+                   "batch": 128, "pipeline_depth": 8},
+        "measured_at": "t2",
+    }}}))
+    assert u.main() == 0
+    twice = baseline.read_text()
+    assert "450.0" in twice and "400.0" not in twice
+    assert twice.count(u.BEGIN) == 1
