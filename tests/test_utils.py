@@ -112,3 +112,25 @@ def test_api_doc_is_current(tmp_path):
     assert scratch.read_text(encoding="utf-8") == committed, (
         "docs/API.md is stale — run: python scripts/gen_api_doc.py"
     )
+
+
+def test_logging_verbosity_mapping():
+    """Reference tmlib/log.py parity: -v count -> level, idempotent
+    handler installation."""
+    import logging
+
+    import pytest
+
+    from tmlibrary_tpu.log import configure_logging, map_logging_verbosity
+
+    assert map_logging_verbosity(0) == logging.WARNING
+    assert map_logging_verbosity(1) == logging.INFO
+    assert map_logging_verbosity(2) == logging.DEBUG
+    assert map_logging_verbosity(5) == logging.DEBUG
+    with pytest.raises(ValueError):
+        map_logging_verbosity(-1)
+
+    lg = configure_logging(1)
+    n = len(lg.handlers)
+    assert configure_logging(2).handlers == lg.handlers[:n]  # no duplicates
+    assert lg.level == logging.DEBUG  # reconfigure adjusts the level
