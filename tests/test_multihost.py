@@ -6,6 +6,7 @@ global hybrid mesh.  This is the path a v5e pod launch takes — every
 prior distributed test ran single-process on a forced 8-device backend;
 this one crosses actual process boundaries."""
 import ast
+import re
 import os
 import socket
 import subprocess
@@ -58,8 +59,13 @@ def test_two_process_pipeline_over_pod_mesh():
         assert f"WORKER_OK process={pid}" in out, out[-2000:]
     # both workers computed over the same global mesh: each host's shard
     # holds 4 real (non-zero) per-site counts for ITS slice
+    # regex-bounded: stderr is merged into stdout and gloo's info
+    # chatter can land on the SAME line as the worker's print — a bare
+    # split would feed the chatter to literal_eval (flaked under load)
     counts = [
-        ast.literal_eval(line.split("counts=")[1])
+        ast.literal_eval(
+            re.search(r"counts=(\[[0-9,\s]*\])", line).group(1)
+        )
         for out in outputs
         for line in out.splitlines()
         if "WORKER_OK" in line
