@@ -251,3 +251,30 @@ def test_cli_inspect_declined_flex_falls_back_like_ingest(tmp_path, capsys):
     out = json.loads(capsys.readouterr().out.strip())
     assert out["format"] == "image"
     assert (out["height"], out["width"]) == (6, 7)
+
+
+def test_cli_inspect_previews_source_dir(tmp_path, planes, capsys):
+    """tmx inspect DIR = dry-run ingest preview: resolved handler plus
+    the layout metaconfig would produce, no store created."""
+    import json
+
+    from tmlibrary_tpu.cli import main
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_flex(src / "001001000.flex", planes,
+               channel_names=("DAPI", "GFP"))
+    write_flex(src / "002002000.flex", planes,
+               channel_names=("DAPI", "GFP"))
+    assert main(["inspect", "--json", str(src)]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["handler"] == "flex"
+    assert out["n_wells"] == 2 and out["n_sites"] == 6
+    assert out["channels"] == ["DAPI", "GFP"]
+    assert out["n_planes"] == 12
+
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert main(["inspect", "--json", str(empty)]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["handler"] is None

@@ -62,14 +62,14 @@ def test_two_process_pipeline_over_pod_mesh():
     # regex-bounded: stderr is merged into stdout and gloo's info
     # chatter can land on the SAME line as the worker's print — a bare
     # split would feed the chatter to literal_eval (flaked under load)
-    counts = [
-        ast.literal_eval(
-            re.search(r"counts=(\[[0-9,\s]*\])", line).group(1)
-        )
-        for out in outputs
-        for line in out.splitlines()
-        if "WORKER_OK" in line
-    ]
+    counts = []
+    for out in outputs:
+        for line in out.splitlines():
+            if "WORKER_OK" not in line:
+                continue
+            m = re.search(r"counts=(\[[0-9,\s]*\])", line)
+            assert m, f"WORKER_OK line without parseable counts: {line!r}"
+            counts.append(ast.literal_eval(m.group(1)))
     assert len(counts) == 2
     for shard in counts:
         assert len(shard) == 4 and all(c > 0 for c in shard), counts

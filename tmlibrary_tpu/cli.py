@@ -270,17 +270,76 @@ _INSPECT_ATTRS = (
 )
 
 
+def _inspect_source_dir(src: Path) -> dict:
+    """Dry-run ingest preview of a source DIRECTORY: which sidecar
+    handler resolves it (metaconfig's auto order) and the layout it
+    would produce — without creating a store."""
+    from tmlibrary_tpu.errors import VendorConflictError
+    from tmlibrary_tpu.workflow.steps.vendors import (
+        SIDECAR_HANDLERS,
+        resolve_sidecars,
+    )
+
+    try:
+        # the SAME resolution loop metaconfig's auto mode runs — a
+        # separate copy here would drift from real ingest behavior
+        resolved = resolve_sidecars(src, list(SIDECAR_HANDLERS), True)
+    except VendorConflictError as exc:
+        return {"format": "source-dir", "error": str(exc)}
+    if resolved is None:
+        return {
+            "format": "source-dir",
+            "handler": None,
+            "note": "no sidecar handler resolved this directory; "
+                    "metaconfig would fall back to filename patterns",
+        }
+    handler, entries, skipped = resolved
+    wells = {(e["plate"], e["well_row"], e["well_col"]) for e in entries}
+    return {
+        "format": "source-dir",
+        "handler": handler,
+        "n_planes": len(entries),
+        "n_skipped_files": skipped,
+        "n_wells": len(wells),
+        "n_sites": len({
+            (e["plate"], e["well_row"], e["well_col"], e["site"])
+            for e in entries
+        }),
+        "channels": sorted({e["channel"] for e in entries}),
+        "n_zplanes": max(e["zplane"] for e in entries) + 1,
+        "n_tpoints": max(e["tpoint"] for e in entries) + 1,
+        "n_cycles": max(e["cycle"] for e in entries) + 1,
+    }
+
+
 def cmd_inspect(args) -> int:
     """Bio-Formats ``showinf`` equivalent over the first-party parsers
     (reference users inspect vendor files with showinf before ingest;
-    SURVEY.md §3 Readers row).  Prints dims/channels per file; exits
-    non-zero if any file could not be read."""
+    SURVEY.md §3 Readers row).  Prints dims/channels per file — or, for
+    a source DIRECTORY, a dry-run ingest preview (resolved handler +
+    layout).  Exits non-zero if anything could not be read."""
     from tmlibrary_tpu import readers as _readers
 
     failed = 0
     for name in args.files:
         path = Path(name)
         info: dict = {"file": str(path)}
+        if path.is_dir() and not str(path).lower().endswith(".zarr"):
+            preview = _inspect_source_dir(path)
+            info.update(preview)
+            # an unresolved dir is a legitimate answer (filename-pattern
+            # fallback), NOT a failure; a well conflict is
+            if "error" in preview:
+                failed += 1
+            if args.as_json:
+                print(json.dumps(info))
+            else:
+                print(f"{info['file']}: source dir "
+                      f"(handler={info.get('handler')})")
+                for key, val in info.items():
+                    if key not in ("file", "format", "handler"):
+                        print(f"  {key:16s} {val}")
+            continue
         try:
             # _open_container, not _container_reader: a TIFF-flavored
             # container the dedicated reader declines (RGB .flex/.stk)

@@ -27,7 +27,7 @@ import re
 from collections import defaultdict
 from pathlib import Path
 
-from tmlibrary_tpu.errors import MetadataError, VendorConflictError
+from tmlibrary_tpu.errors import MetadataError
 from tmlibrary_tpu.models.experiment import Channel, Experiment, Plate, Site, Well
 from tmlibrary_tpu.models.store import ExperimentStore
 from tmlibrary_tpu.workflow.api import Step
@@ -166,32 +166,13 @@ class MetadataConfigurator(Step):
             args["handler"] in SIDECAR_HANDLERS or args["handler"] == "auto"
         )
         if use_sidecars:
+            from tmlibrary_tpu.workflow.steps.vendors import resolve_sidecars
+
             is_auto = args["handler"] == "auto"
             names = list(SIDECAR_HANDLERS) if is_auto else [args["handler"]]
-            for name in names:
-                try:
-                    result = SIDECAR_HANDLERS[name](src)
-                except VendorConflictError:
-                    # a data-integrity conflict (e.g. two containers claim
-                    # one well) must surface, not be laundered into a
-                    # "no files matched" fallback error
-                    raise
-                except MetadataError:
-                    if not is_auto:
-                        raise
-                    continue  # auto: a broken sidecar should not end ingest
-                if result is None:
-                    continue  # this vendor's sidecar files are absent
-                found, skipped = result
-                if found:
-                    entries = found
-                    break
-                if not is_auto:
-                    raise MetadataError(
-                        f"'{name}' sidecar files exist under {src} but no "
-                        "image could be resolved from them (unrecognised "
-                        "image names or missing pixel files)"
-                    )
+            resolved = resolve_sidecars(src, names, is_auto)
+            if resolved is not None:
+                _, entries, skipped = resolved
         if entries is None and use_sidecars and args["handler"] == "omexml":
             raise MetadataError(f"no companion OME-XML files found under {src}")
 

@@ -31,7 +31,11 @@ from typing import Callable
 
 import logging
 
-from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+from tmlibrary_tpu.errors import (
+    MetadataError,
+    NotSupportedError,
+    VendorConflictError,
+)
 from tmlibrary_tpu.workflow.steps.omexml import _strip_ns
 
 logger = logging.getLogger(__name__)
@@ -1544,3 +1548,42 @@ def flex_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
         lambda r: (r.n_fields, r.n_channels, r.channel_names),
         entries_of, well_of=opera_well,
     )
+
+
+def resolve_sidecars(
+    src: Path, names: "list[str]", is_auto: bool,
+) -> "tuple[str, list[dict], int] | None":
+    """The ONE home of metaconfig's sidecar-resolution policy, shared
+    with ``tmx inspect DIR``'s dry-run preview (a separate copy would
+    silently drift from real ingest behavior).
+
+    Tries ``names`` in order; returns ``(handler, entries, skipped)``
+    for the first handler that resolves images, or None when none did
+    (callers fall back to filename patterns).  A data-integrity conflict
+    (:class:`~tmlibrary_tpu.errors.VendorConflictError`) always
+    surfaces; in non-auto mode a broken or image-less sidecar raises
+    instead of being skipped.
+    """
+    for name in names:
+        try:
+            result = SIDECAR_HANDLERS[name](src)
+        except VendorConflictError:
+            # e.g. two containers claim one well: must surface, not be
+            # laundered into a "no files matched" fallback error
+            raise
+        except MetadataError:
+            if not is_auto:
+                raise
+            continue  # auto: a broken sidecar should not end ingest
+        if result is None:
+            continue  # this vendor's sidecar files are absent
+        found, skipped = result
+        if found:
+            return name, found, skipped
+        if not is_auto:
+            raise MetadataError(
+                f"'{name}' sidecar files exist under {src} but no "
+                "image could be resolved from them (unrecognised "
+                "image names or missing pixel files)"
+            )
+    return None
