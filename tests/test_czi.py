@@ -47,9 +47,21 @@ def _compress(data: bytes, compression: int, hilo: bool = False) -> bytes:
     return frame
 
 
+def metadata_xml(channel_names) -> bytes:
+    chans = "".join(
+        f'<Channel Id="Channel:{i}" Name="{n}"/>'
+        for i, n in enumerate(channel_names)
+    )
+    doc = ("<ImageMetadata><Metadata><Information><Image><Dimensions>"
+           f"<Channels>{chans}</Channels>"
+           "</Dimensions></Image></Information></Metadata></ImageMetadata>")
+    return doc.encode()
+
+
 def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0,
               hilo=False, n_tiles=1, with_pyramid=False,
-              global_m=False, tile_origins=None) -> None:
+              global_m=False, tile_origins=None,
+              channel_names=None) -> None:
     """``planes``: (S, C, H, W) uint16 — one z-plane, one tpoint.  With
     ``n_tiles`` > 1 the S axis is reinterpreted as S*M (mosaic tiles,
     S fastest-outer): planes[s*M+m] carries dims S=s, M=m.  With
@@ -92,13 +104,21 @@ def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0,
                     _compress(half.tobytes(), compression, hilo), pdims,
                     pyramid=1)
 
+    meta_pos = 0
+    if channel_names is not None:
+        meta_pos = len(blob)
+        xml = metadata_xml(channel_names)
+        meta_payload = struct.pack("<ii", len(xml), 0) + b"\x00" * 248 + xml
+        blob.extend(_segment(b"ZISRAWMETADATA", meta_payload))
     dir_pos = len(blob)
     dir_payload = struct.pack("<i", len(entries)) + b"\x00" * 124
     dir_payload += b"".join(entries)
     blob.extend(_segment(b"ZISRAWDIRECTORY", dir_payload))
-    # patch DirectoryPosition into the file header payload at the spec
-    # offset: major(4) minor(4) reserved(8) guids(32) file_part(4) = 52
+    # patch DirectoryPosition (and MetadataPosition, which follows it)
+    # into the file header payload at the spec offset:
+    # major(4) minor(4) reserved(8) guids(32) file_part(4) = 52
     struct.pack_into("<q", blob, 32 + 52, dir_pos)
+    struct.pack_into("<q", blob, 32 + 60, meta_pos)
     path.write_bytes(bytes(blob))
 
 
@@ -430,3 +450,56 @@ def test_czi_sparse_origins_fall_back_to_m_order(tmp_path):
     assert skipped == 0
     assert all("site_y" not in e for e in entries)
     assert [e["site"] for e in entries] == [0, 1, 2]
+
+
+def test_czi_channel_names_from_metadata(tmp_path, planes):
+    """ZISRAWMETADATA channel names label the ingest channels (sanitized
+    to the pattern charset); files without metadata keep C00..."""
+    path = tmp_path / "named.czi"
+    write_czi(path, planes, channel_names=("DAPI", "Alexa 488"))
+    with CZIReader(path) as r:
+        assert r.channel_names == ["DAPI", "Alexa 488"]
+
+    from tmlibrary_tpu.workflow.steps.vendors import czi_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_czi(src / "w_A01.czi", planes, channel_names=("DAPI", "Alexa 488"))
+    entries, _ = czi_sidecar(src)
+    assert {e["channel"] for e in entries} == {"DAPI", "Alexa-488"}
+
+    bare = tmp_path / "bare.czi"
+    write_czi(bare, planes)
+    with CZIReader(bare) as r:
+        assert r.channel_names is None
+
+
+def test_czi_channel_names_guarded(tmp_path, planes):
+    """Name-count mismatch (substack export keeps the full XML list) and
+    decoy Channels blocks must not mislabel channels; an XML encoding
+    declaration must not drop valid names."""
+    # 3 names for 2 subblock channels -> degrade to C00...
+    path = tmp_path / "mismatch.czi"
+    write_czi(path, planes, channel_names=("A", "B", "C"))
+    with CZIReader(path) as r:
+        assert r.channel_names is None
+
+    # decoy DisplaySetting/Channels BEFORE the Information path + an
+    # encoding declaration: the explicit path must still win
+    doc = (
+        '<?xml version="1.0" encoding="utf-8"?>'
+        "<ImageMetadata><Metadata>"
+        "<DisplaySetting><Channels>"
+        '<Channel Name="WRONG1"/><Channel Name="WRONG2"/>'
+        "</Channels></DisplaySetting>"
+        "<Information><Image><Dimensions><Channels>"
+        '<Channel Id="Channel:0" Name="DAPI"/>'
+        '<Channel Id="Channel:1" Name="GFP"/>'
+        "</Channels></Dimensions></Image></Information>"
+        "</Metadata></ImageMetadata>"
+    ).encode()
+    payload = struct.pack("<ii", len(doc), 0) + b"\x00" * 248 + doc
+    r = CZIReader.__new__(CZIReader)
+    r.filename = tmp_path / "x.czi"
+    r._segment_payload = lambda off, expect: memoryview(payload)
+    assert r._channel_names_from_xml(1) == ["DAPI", "GFP"]

@@ -781,6 +781,12 @@ class CZIReader(Reader):
             # primary_guid(16) file_guid(16) file_part(4) = 52 bytes,
             # then DirectoryPosition(i64)
             (dir_pos,) = struct.unpack_from("<q", payload, 52)
+            # MetadataPosition follows DirectoryPosition; 0/absent = none
+            (meta_pos,) = (
+                struct.unpack_from("<q", payload, 60)
+                if len(payload) >= 68 else (0,)
+            )
+            self.channel_names = self._channel_names_from_xml(meta_pos)
             all_planes = self._parse_directory(dir_pos)
             # pyramidal files interleave subsampled copies with the
             # acquisition planes; only pyramid-0 subblocks are data
@@ -872,6 +878,13 @@ class CZIReader(Reader):
         self.n_channels = len(self._channel_ids)
         self.n_zplanes = len(self._z_ids)
         self.n_tpoints = len(self._t_ids)
+        if self.channel_names is not None and len(self.channel_names) != (
+            self.n_channels
+        ):
+            # a substack/split export keeps the full acquisition's XML
+            # channel list: labeling rank c with names[c] would silently
+            # mislabel scientific data — degrade to C00… instead
+            self.channel_names = None
         return self
 
     def __exit__(self, *exc):
@@ -941,6 +954,51 @@ class CZIReader(Reader):
                 plane[name] = start
             p += 20
         return plane, p
+
+    def _channel_names_from_xml(self, meta_pos: int) -> "list[str] | None":
+        """Channel names from the ZISRAWMETADATA document
+        (``Information/Image/Dimensions/Channels/Channel`` ``Name``
+        attributes, in element order = C index order), or None — names
+        are a courtesy, so ANY parse problem degrades to the ``C00``
+        fallback rather than failing the open."""
+        import struct
+
+        if meta_pos <= 0:
+            return None
+        try:
+            payload = self._segment_payload(meta_pos, b"ZISRAWMETADATA")
+            # MetadataSegment data: xml_size(i32) attachment_size(i32)
+            # + 248 spare bytes, then the XML document
+            (xml_size,) = struct.unpack_from("<i", payload, 0)
+            # bytes, not a decoded str: an XML encoding declaration makes
+            # fromstring(str) raise and would silently drop valid names
+            root = ElementTree.fromstring(bytes(payload[256:256 + xml_size]))
+        except Exception:
+            return None
+
+        def child(node, local):
+            for el in node:
+                if el.tag.rsplit("}", 1)[-1] == local:
+                    return el
+            return None
+
+        # the EXPLICIT Information/Image/Dimensions/Channels path: ZEN
+        # documents carry other Channels lists (DisplaySetting,
+        # acquisition blocks) that can precede it in document order
+        node = root
+        if node.tag.rsplit("}", 1)[-1] != "Metadata":
+            meta = child(node, "Metadata")
+            node = node if meta is None else meta  # Element truthiness trap
+        for local in ("Information", "Image", "Dimensions", "Channels"):
+            node = child(node, local)
+            if node is None:
+                return None
+        names = [
+            ch.get("Name") or ""
+            for ch in node
+            if ch.tag.rsplit("}", 1)[-1] == "Channel"
+        ]
+        return names if any(names) else None
 
     def _parse_directory(self, dir_pos: int) -> list[dict]:
         import struct
@@ -2486,8 +2544,10 @@ class FlexReader(Reader):
             return None
         raw = bytes(buf[base:base + cnt]).rstrip(b"\x00")
         try:
-            root = ElementTree.fromstring(raw.decode("utf-8", "replace"))
-        except ElementTree.ParseError:
+            # bytes, not a decoded str: an XML encoding declaration makes
+            # fromstring(str) raise (same latent issue as the CZI helper)
+            root = ElementTree.fromstring(raw)
+        except (ElementTree.ParseError, ValueError):
             return None
         names: list[str] = []
         for el in root.iter():

@@ -1195,12 +1195,13 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
         return None if res is None else res[0]
 
     def entries_of(path, dims, well):
-        n_s, n_m, n_c, n_z, n_t, origins = dims
+        n_s, n_m, n_c, n_z, n_t, origins, names = dims
         grid = tile_grid(n_m, origins) if n_s == 1 and n_m > 1 else None
         out = []
         for s in range(n_s):
             for m in range(n_m):
                 for c in range(n_c):
+                    label = sanitize_channel_label(names, c)
                     for z in range(n_z):
                         for t in range(n_t):
                             e = _container_entry(
@@ -1208,6 +1209,7 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                                 zplane=z, tpoint=t,
                                 page=(((s * n_m + m) * n_c + c) * n_z + z)
                                 * n_t + t)
+                            e["channel"] = label
                             if grid is not None:
                                 e["site_y"], e["site_x"] = grid[m]
                             out.append(e)
@@ -1218,7 +1220,8 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
         lambda r: (r.n_scenes, r.n_tiles, r.n_channels, r.n_zplanes,
                    r.n_tpoints,
                    [r.tile_origin(0, m) for m in range(r.n_tiles)]
-                   if r.n_scenes == 1 else None),
+                   if r.n_scenes == 1 else None,
+                   r.channel_names),
         entries_of,
     )
 
