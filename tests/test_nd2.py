@@ -36,6 +36,14 @@ def _lv_f64(name: str, value: float) -> bytes:
     )
 
 
+def _lv_str(name: str, value: str) -> bytes:
+    encoded = (name + "\x00").encode("utf-16-le")
+    return (
+        struct.pack("<BB", 6, len(name) + 1) + encoded
+        + (value + "\x00").encode("utf-16-le")
+    )
+
+
 def _lv_compound(name: str, inner: bytes) -> bytes:
     encoded = (name + "\x00").encode("utf-16-le")
     return (
@@ -69,7 +77,8 @@ def experiment_chunk(loops) -> bytes:
 
 
 def write_nd2(path, planes: np.ndarray, timestamps=None,
-              declare_sequences=None, loops=None) -> None:
+              declare_sequences=None, loops=None,
+              channel_names=None) -> None:
     """``planes``: (n_seq, H, W, C) uint16.  ``declare_sequences``
     overstates ``uiSequenceCount`` to mimic an aborted acquisition.
     ``loops``: [(eType, size), ...] emits an ImageMetadataLV!
@@ -101,6 +110,14 @@ def write_nd2(path, planes: np.ndarray, timestamps=None,
     emit(b"ImageAttributesLV!", attrs)
     if loops is not None:
         emit(b"ImageMetadataLV!", experiment_chunk(loops))
+    if channel_names is not None:
+        plane_meta = b"".join(
+            _lv_compound(f"a{i}", _lv_str("sDescription", n))
+            for i, n in enumerate(channel_names)
+        )
+        emit(b"ImageMetadataSeqLV|0!", _lv_compound(
+            "SLxPictureMetadata",
+            _lv_compound("sPicturePlanes", plane_meta)))
     for s in range(n_seq):
         ts = float(timestamps[s]) if timestamps is not None else 1000.0 * s
         payload = struct.pack("<d", ts) + planes[s].tobytes()
@@ -440,3 +457,39 @@ def test_cli_inspect_reports_nd2_loops(tmp_path, capsys):
     assert out["format"] == "ND2"
     assert out["loops"] == [["T", 2], ["XY", 3], ["Z", 2]]
     assert out["n_sequences"] == 12
+
+
+def test_nd2_channel_names_from_picture_planes(tmp_path):
+    rng = np.random.default_rng(79)
+    planes = rng.integers(0, 60000, (2, 6, 7, 2), dtype=np.uint16)
+    path = tmp_path / "named.nd2"
+    write_nd2(path, planes, channel_names=("DAPI", "FITC 488"))
+    with ND2Reader(path) as r:
+        assert r.channel_names() == ["DAPI", "FITC 488"]
+
+    from tmlibrary_tpu.workflow.steps.vendors import nd2_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_nd2(src / "n_A01.nd2", planes, channel_names=("DAPI", "FITC 488"))
+    entries, _ = nd2_sidecar(src)
+    assert {e["channel"] for e in entries} == {"DAPI", "FITC-488"}
+
+    # count mismatch degrades to C00...
+    bad = tmp_path / "bad.nd2"
+    write_nd2(bad, planes, channel_names=("only-one",))
+    with ND2Reader(bad) as r:
+        assert r.channel_names() is None
+
+
+def test_nd2_channel_names_beyond_ten_keep_component_order(tmp_path):
+    """'a10' must not sort before 'a2': insertion order is component
+    order (lexicographic key sorting mislabeled channels >= 10)."""
+    rng = np.random.default_rng(80)
+    n = 12
+    planes = rng.integers(0, 60000, (1, 6, 7, n), dtype=np.uint16)
+    path = tmp_path / "many.nd2"
+    names = [f"ch{i}" for i in range(n)]
+    write_nd2(path, planes, channel_names=names)
+    with ND2Reader(path) as r:
+        assert r.channel_names() == names

@@ -591,6 +591,51 @@ class ND2Reader(Reader):
         collect(level.get("uLoopPars"), points)
         return points if n_xy and len(points) == n_xy else None
 
+    def channel_names(self) -> "list[str] | None":
+        """Component names from ``ImageMetadataSeqLV|0!``'s
+        ``SLxPictureMetadata.sPicturePlanes`` plane descriptions
+        (``sDescription`` per plane compound, key order = component
+        order) — or None when absent or disagreeing with the component
+        count.  Names are a courtesy: any parse problem degrades to the
+        ``C00``… fallback."""
+        import struct
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        off = self._chunks.get(b"ImageMetadataSeqLV|0!")
+        if off is None:
+            return None
+        try:
+            tree = self._parse_lv(self._chunk_payload(off))
+        except (MetadataError, struct.error, OverflowError, IndexError,
+                UnicodeDecodeError):
+            return None
+
+        def find(node, key):
+            if isinstance(node, dict):
+                if key in node and isinstance(node[key], dict):
+                    return node[key]
+                for v in node.values():
+                    found = find(v, key)
+                    if found is not None:
+                        return found
+            return None
+
+        planes = find(tree, "sPicturePlanes")
+        if planes is None:
+            return None
+        # insertion order IS component order (_parse_lv preserves the
+        # document order); sorting keys would put "a10" before "a2" and
+        # silently mislabel every channel past the ninth
+        names = [
+            str(v["sDescription"])
+            for v in planes.values()
+            if isinstance(v, dict) and isinstance(v.get("sDescription"), str)
+        ]
+        if len(names) != self.n_components or not any(names):
+            return None
+        return names
+
     def seq_coords(self, sequence: int) -> tuple[int, int, int]:
         """(xy_position, zplane, tpoint) of a sequence index under
         :meth:`loop_shape`; flat ``(sequence, 0, 0)`` without loops."""

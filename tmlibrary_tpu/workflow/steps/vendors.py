@@ -1131,7 +1131,7 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     from tmlibrary_tpu.readers import ND2Reader
 
     def entries_of(path, dims, well):
-        n_seq, n_comp, coords, positions = dims
+        n_seq, n_comp, coords, positions, names = dims
         if not coords:
             # zero-sequence file (aborted acquisition): no entries, and
             # max() below must not crash the whole ingest
@@ -1143,6 +1143,7 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                 [p[0] for p in positions], [p[1] for p in positions], n_xy
             )
             grid = None if res is None else res[0]
+        labels = [sanitize_channel_label(names, c) for c in range(n_comp)]
         out = []
         for seq in range(n_seq):
             xy, z, t = coords[seq]
@@ -1150,6 +1151,7 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                 e = _container_entry(path, well, site=xy, channel=comp,
                                      zplane=z, tpoint=t,
                                      page=seq * n_comp + comp)
+                e["channel"] = labels[comp]
                 if grid is not None:
                     e["site_y"], e["site_x"] = grid[xy]
                 out.append(e)
@@ -1159,7 +1161,7 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
         source_dir, ".nd2", ND2Reader, "ND2",
         lambda r: (r.n_sequences, r.n_components,
                    [r.seq_coords(s) for s in range(r.n_sequences)],
-                   r.xy_positions()),
+                   r.xy_positions(), r.channel_names()),
         entries_of,
     )
 
