@@ -16,13 +16,16 @@ from tmlibrary_tpu.readers import LIFReader
 
 
 def _series_xml(name: str, block_id: str, h: int, w: int, n_c: int,
-                n_z: int = 1, n_t: int = 1, bits: int = 16) -> str:
+                n_z: int = 1, n_t: int = 1, bits: int = 16,
+                lut_names=None) -> str:
     """One Element with planar channel layout: C outermost, then Z, T."""
     item = bits // 8
     plane = h * w * item
     chans = "".join(
         f'<ChannelDescription Resolution="{bits}" '
-        f'BytesInc="{c * n_z * n_t * plane}"/>'
+        f'BytesInc="{c * n_z * n_t * plane}"'
+        + (f' LUTName="{lut_names[c]}"' if lut_names else "")
+        + "/>"
         for c in range(n_c)
     )
     dims = (
@@ -44,13 +47,15 @@ def _series_xml(name: str, block_id: str, h: int, w: int, n_c: int,
     )
 
 
-def write_lif(path, series: list[np.ndarray], bits: int = 16) -> None:
+def write_lif(path, series: list[np.ndarray], bits: int = 16,
+              lut_names=None) -> None:
     """``series``: list of (C, Z, T, H, W) uint16 arrays (planar layout)."""
     elements = []
     for i, arr in enumerate(series):
         n_c, n_z, n_t, h, w = arr.shape
         elements.append(
-            _series_xml(f"Series{i}", f"MemBlock_{i}", h, w, n_c, n_z, n_t, bits)
+            _series_xml(f"Series{i}", f"MemBlock_{i}", h, w, n_c, n_z,
+                        n_t, bits, lut_names=lut_names)
         )
     xml = (
         '<LMSDataContainerHeader Version="2"><Element Name="root"><Children>'
@@ -206,3 +211,46 @@ def test_lif_mixed_plane_shapes_rejected(tmp_path):
     with LIFReader(path) as r:
         with pytest.raises(MetadataError, match="plane shape"):
             r.uniform_dims()
+
+
+def test_lif_channel_names_from_lutnames(tmp_path):
+    rng = np.random.default_rng(81)
+    arr = rng.integers(0, 60000, (2, 1, 1, 8, 9), dtype=np.uint16)
+    path = tmp_path / "named.lif"
+    write_lif(path, [arr], lut_names=("Green", "Red"))
+    with LIFReader(path) as r:
+        assert r.channel_names() == ["Green", "Red"]
+
+    from tmlibrary_tpu.workflow.steps.vendors import lif_sidecar
+
+    src = tmp_path / "source"
+    src.mkdir()
+    write_lif(src / "w_A01.lif", [arr], lut_names=("Green", "Red"))
+    entries, _ = lif_sidecar(src)
+    assert {e["channel"] for e in entries} == {"Green", "Red"}
+
+    bare = tmp_path / "bare.lif"
+    write_lif(bare, [arr])
+    with LIFReader(bare) as r:
+        assert r.channel_names() is None
+
+
+def test_duplicate_channel_labels_fall_back(tmp_path):
+    """Two detectors sharing one LUT name must NOT collapse into one
+    store channel — the whole set falls back to C00/C01."""
+    rng = np.random.default_rng(82)
+    arr = rng.integers(0, 60000, (2, 1, 1, 8, 9), dtype=np.uint16)
+    src = tmp_path / "source"
+    src.mkdir()
+    write_lif(src / "w_A01.lif", [arr], lut_names=("Gray", "Gray"))
+
+    from tmlibrary_tpu.workflow.steps.vendors import lif_sidecar
+
+    entries, _ = lif_sidecar(src)
+    assert {e["channel"] for e in entries} == {"C00", "C01"}
+
+    # distinct names merged BY SANITIZATION collide too
+    from tmlibrary_tpu.workflow.steps.vendors import channel_labels
+
+    assert channel_labels(["A B", "A.B"], 2) == ["C00", "C01"]
+    assert channel_labels(["DAPI", "GFP"], 2) == ["DAPI", "GFP"]

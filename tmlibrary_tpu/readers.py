@@ -1346,6 +1346,9 @@ class LIFReader(Reader):
                 {
                     "bits": int(c.get("Resolution", "16")),
                     "bytes_inc": int(c.get("BytesInc", "0")),
+                    # LUTName is how Leica labels acquisition channels
+                    # (Bio-Formats surfaces the same attribute)
+                    "name": c.get("LUTName") or "",
                 }
                 for c in desc.iter("ChannelDescription")
             ]
@@ -1434,6 +1437,17 @@ class LIFReader(Reader):
         c, rem = divmod(page, s["n_zplanes"] * s["n_tpoints"])
         z, t = divmod(rem, s["n_tpoints"])
         return self.read_plane(series, c, z, t)
+
+    def channel_names(self) -> "list[str] | None":
+        """Per-channel ``LUTName`` labels when every series agrees — or
+        None (names are a courtesy; the ``C00``… fallback applies)."""
+        if not self.series:
+            return None
+        first = [c.get("name", "") for c in self.series[0]["channels"]]
+        for s in self.series[1:]:
+            if [c.get("name", "") for c in s["channels"]] != first:
+                return None
+        return first if any(first) else None
 
     def uniform_dims(self) -> tuple[int, int, int]:
         """(C, Z, T), required identical across series — as is the plane

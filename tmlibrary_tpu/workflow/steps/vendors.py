@@ -1047,10 +1047,25 @@ def assign_container_wells(
 def sanitize_channel_label(names, c: int) -> str:
     """The ONE channel-label policy for container metadata names:
     sanitize to the ingest pattern's charset, fall back to ``C%02d``
-    when the name is absent or empty."""
+    when the name is absent or empty.  Prefer :func:`channel_labels`
+    for a whole channel set — it adds the collision guard."""
     if names and c < len(names) and names[c]:
         return re.sub(r"[^A-Za-z0-9\-]", "-", names[c])
     return f"C{c:02d}"
+
+
+def channel_labels(names, n: int) -> list[str]:
+    """Sanitized labels for ``n`` channels with a collision guard:
+    duplicate labels (two detectors sharing one LUT name, or distinct
+    names merged by sanitization) would collapse distinct channels into
+    ONE store channel downstream — metaconfig builds channels from a
+    set and imextract groups planes by channel label, so one channel's
+    pixels would silently overwrite the other's.  Any collision drops
+    the whole set to the ``C%02d`` fallback."""
+    labels = [sanitize_channel_label(names, c) for c in range(n)]
+    if len(set(labels)) != n:
+        return [f"C{c:02d}" for c in range(n)]
+    return labels
 
 
 def _container_entry(path: Path, well: tuple[int, int], site: int,
@@ -1143,7 +1158,7 @@ def nd2_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
                 [p[0] for p in positions], [p[1] for p in positions], n_xy
             )
             grid = None if res is None else res[0]
-        labels = [sanitize_channel_label(names, c) for c in range(n_comp)]
+        labels = channel_labels(names, n_comp)
         out = []
         for seq in range(n_seq):
             xy, z, t = coords[seq]
@@ -1199,11 +1214,12 @@ def czi_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     def entries_of(path, dims, well):
         n_s, n_m, n_c, n_z, n_t, origins, names = dims
         grid = tile_grid(n_m, origins) if n_s == 1 and n_m > 1 else None
+        labels = channel_labels(names, n_c)
         out = []
         for s in range(n_s):
             for m in range(n_m):
                 for c in range(n_c):
-                    label = sanitize_channel_label(names, c)
+                    label = labels[c]
                     for z in range(n_z):
                         for t in range(n_t):
                             e = _container_entry(
@@ -1235,27 +1251,33 @@ def lif_sidecar(source_dir: Path) -> tuple[list[dict], int] | None:
     (:class:`tmlibrary_tpu.readers.LIFReader`).
 
     Same conventions as the nd2/czi handlers: one file per well (token or
-    next free column on row A), image series map to sites, channels to
-    ``C00``/…, Z/T preserved; ``page`` encodes the whole-file linear index
+    next free column on row A), image series map to sites, channel labels
+    from the LUTName attributes (``C00``/… fallback), Z/T preserved;
+    ``page`` encodes the whole-file linear index
     ``series * C*Z*T + (c*Z + z)*T + t`` for imextract.  Files whose
     series disagree on (C, Z, T) are skipped with a logged reason."""
     from tmlibrary_tpu.readers import LIFReader
 
     def entries_of(path, dims, well):
-        n_series, n_c, n_z, n_t = dims
-        return [
-            _container_entry(path, well, site=s, channel=c, zplane=z,
-                             tpoint=t,
-                             page=(s * n_c + c) * n_z * n_t + z * n_t + t)
-            for s in range(n_series)
-            for c in range(n_c)
-            for z in range(n_z)
-            for t in range(n_t)
-        ]
+        n_series, n_c, n_z, n_t, names = dims
+        labels = channel_labels(names, n_c)
+        out = []
+        for s in range(n_series):
+            for c in range(n_c):
+                for z in range(n_z):
+                    for t in range(n_t):
+                        e = _container_entry(
+                            path, well, site=s, channel=c, zplane=z,
+                            tpoint=t,
+                            page=(s * n_c + c) * n_z * n_t + z * n_t + t)
+                        e["channel"] = labels[c]
+                        out.append(e)
+        return out
 
     return _container_sidecar(
         source_dir, ".lif", LIFReader, "LIF",
-        lambda r: (r.n_series, *r.uniform_dims()), entries_of,
+        lambda r: (r.n_series, *r.uniform_dims(), r.channel_names()),
+        entries_of,
     )
 
 
@@ -1288,7 +1310,7 @@ def ngff_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
     bare: list[tuple] = []
 
     def channel_names(nc, labels):
-        return [sanitize_channel_label(labels, c) for c in range(nc)]
+        return channel_labels(labels, nc)
 
     def emit(path, info, wells, plate_name):
         nf, nt, nc, nz, labels = info
@@ -1387,9 +1409,10 @@ def ims_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
 
     def entries_of(path, dims, well):
         n_c, n_z, n_t, names = dims
+        labels = channel_labels(names, n_c)
         out = []
         for c in range(n_c):
-            label = sanitize_channel_label(names, c)
+            label = labels[c]
             for z in range(n_z):
                 for t in range(n_t):
                     e = _container_entry(
@@ -1537,9 +1560,10 @@ def flex_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
 
     def entries_of(path, dims, well):
         n_fields, n_c, names = dims
+        labels = channel_labels(names, n_c)
         out = []
         for c in range(n_c):
-            label = sanitize_channel_label(names, c)
+            label = labels[c]
             for f in range(n_fields):
                 e = _container_entry(path, well, site=f, channel=c,
                                      zplane=0, tpoint=0,
