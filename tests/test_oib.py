@@ -514,3 +514,30 @@ def test_cfb_v4_4096_byte_sectors(tmp_path):
     with OIBReader(path) as r:
         assert (r.n_channels, r.n_zplanes, r.n_tpoints) == (1, 2, 1)
         np.testing.assert_array_equal(r.read_plane(0, 1, 0), stack[0, 1, 0])
+
+
+def test_olympus_channel_names_from_dye_sections(tmp_path, stack):
+    """[Channel N Parameters] DyeName labels the ingest channels."""
+    extra = "\r\n".join([
+        "[Channel 1 Parameters]", 'DyeName="DAPI"',
+        "[Channel 2 Parameters]", 'DyeName="Alexa 568"',
+    ])
+    main = write_oif(tmp_path, "dyes_A01", stack)
+    main.write_bytes(
+        main.read_bytes() + ("\r\n" + extra).encode("utf-16-le")
+    )
+    with OIFReader(main) as r:
+        assert r.channel_names == ["DAPI", "Alexa 568"]
+
+    from tmlibrary_tpu.workflow.steps.vendors import olympus_sidecar
+
+    entries, _ = olympus_sidecar(tmp_path)
+    # order-sensitive: channel index c must carry labels[c] (a set
+    # comparison could not catch a label/index misalignment)
+    by_page = {e["page"]: e["channel"] for e in entries}
+    n_z, n_t = 3, 2
+    for c, label in enumerate(["DAPI", "Alexa-568"]):
+        for z in range(n_z):
+            for t in range(n_t):
+                assert by_page[(c * n_z + z) * n_t + t] == label
+

@@ -2289,6 +2289,32 @@ def _tiff_single_plane(buf, filename) -> np.ndarray:
     return _decode_ifd_plane(bo, buf, ifd, width, height, dtype, filename)
 
 
+def _parse_oif_channel_names(text: str) -> "list[str] | None":
+    """Dye names from ``[Channel N Parameters]`` sections (``DyeName``,
+    ``CH Name`` fallback), ordered by channel number — or None."""
+    import re as _re
+
+    by_num: dict[int, str] = {}
+    num = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("["):
+            m = _re.match(r"\[Channel (\d+) Parameters\]", line)
+            num = int(m.group(1)) if m else None
+            continue
+        if num is None or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key, val = key.strip(), val.strip().strip('"')
+        if key == "DyeName" and val:
+            by_num[num] = val
+        elif key == "CH Name" and val:
+            by_num.setdefault(num, val)
+    if not by_num:
+        return None
+    return [by_num[n] for n in sorted(by_num)]
+
+
 class _OlympusBase(Reader):
     """Shared OIF/OIB logic: dims from the main-file INI, plane lookup
     from C/Z/T filename tokens, the linear page convention
@@ -2346,6 +2372,11 @@ class _OlympusBase(Reader):
                 *self._plane_buf(self._planes[min(self._planes)])
             )
             self.height, self.width = first.shape
+        # dye names, count-guarded against the observed channel grid
+        names = _parse_oif_channel_names(text)
+        self.channel_names = (
+            names if names and len(names) == self.n_channels else None
+        )
 
     def _plane_buf(self, key):  # pragma: no cover - abstract
         raise NotImplementedError

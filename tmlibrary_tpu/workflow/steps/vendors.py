@@ -1508,14 +1508,18 @@ def olympus_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
     from tmlibrary_tpu.readers import OIBReader, OIFReader
 
     def entries_of(path, dims, well):
-        n_c, n_z, n_t = dims
-        return [
-            _container_entry(path, well, site=0, channel=c, zplane=z,
-                             tpoint=t, page=(c * n_z + z) * n_t + t)
-            for c in range(n_c)
-            for z in range(n_z)
-            for t in range(n_t)
-        ]
+        n_c, n_z, n_t, names = dims
+        labels = channel_labels(names, n_c)
+        out = []
+        for c in range(n_c):
+            for z in range(n_z):
+                for t in range(n_t):
+                    e = _container_entry(
+                        path, well, site=0, channel=c, zplane=z,
+                        tpoint=t, page=(c * n_z + z) * n_t + t)
+                    e["channel"] = labels[c]
+                    out.append(e)
+        return out
 
     def open_either(path):
         # ONE shared scan for both suffixes: two token-less files must
@@ -1526,7 +1530,9 @@ def olympus_sidecar(source_dir: Path) -> "tuple[list[dict], int] | None":
 
     return _container_sidecar(
         source_dir, (".oif", ".oib"), open_either, "Olympus",
-        lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints), entries_of,
+        lambda r: (r.n_channels, r.n_zplanes, r.n_tpoints,
+                   r.channel_names),
+        entries_of,
     )
 
 
