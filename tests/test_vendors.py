@@ -956,3 +956,37 @@ def test_metaconfig_leica_auto(tmp_path):
     step.collect()
     exp = ExperimentStore.open(store.root).experiment
     assert exp.n_sites == 2
+
+
+def test_resolve_sidecars_policy(tmp_path):
+    """The ONE resolution loop (shared by metaconfig auto and tmx
+    inspect DIR): auto skips broken sidecars, explicit mode raises on
+    broken or image-less ones, first resolving handler wins."""
+    import numpy as np
+    import pytest
+
+    from tmlibrary_tpu.errors import MetadataError
+    from tmlibrary_tpu.workflow.steps.vendors import (
+        SIDECAR_HANDLERS,
+        resolve_sidecars,
+    )
+    from test_dv import write_dv
+
+    src = tmp_path / "src"
+    src.mkdir()
+    rng = np.random.default_rng(3)
+    write_dv(src / "ok_A01.dv",
+             rng.integers(0, 60000, (1, 1, 1, 8, 9), dtype=np.uint16))
+    (src / "broken.nd2").write_bytes(b"\0" * 2048)  # sidecar-less garbage
+
+    name, entries, skipped = resolve_sidecars(
+        src, list(SIDECAR_HANDLERS), True
+    )
+    assert name == "dv" and len(entries) == 1
+
+    # explicit mode: a handler whose files are absent resolves None
+    assert resolve_sidecars(src, ["czi"], False) is None
+    # explicit mode: present-but-unreadable files mean zero images ->
+    # raises instead of silently falling through
+    with pytest.raises(MetadataError):
+        resolve_sidecars(src, ["nd2"], False)
