@@ -131,6 +131,27 @@ def test_flex_rejects_bad_files(tmp_path, planes):
             r.read_plane_linear(99)
 
 
+def test_flex_mismatched_page_geometry_rejected(tmp_path, planes):
+    """Every page is decoded with page-0 geometry, so a page whose
+    width/height/bits differ must fail loudly instead of silently
+    scrambling rows (Bio-Formats models per-plane sizes; this reader
+    declares them unsupported)."""
+    from tmlibrary_tpu.errors import NotSupportedError
+
+    path = write_flex(tmp_path / "geom.flex", planes)
+    buf = bytearray(path.read_bytes())
+    ifd_off = struct.unpack_from("<I", buf, 4)[0]
+    n = struct.unpack_from("<H", buf, ifd_off)[0]
+    second = struct.unpack_from("<I", buf, ifd_off + 2 + 12 * n)[0]
+    # first entry of the (sorted) IFD is tag 256 = ImageWidth; its
+    # inline value sits at +2 (count) +2 (tag) +2 (type) +4 (count)
+    assert struct.unpack_from("<H", buf, second + 2)[0] == 256
+    struct.pack_into("<I", buf, second + 2 + 8, 13)
+    path.write_bytes(bytes(buf))
+    with pytest.raises(NotSupportedError):
+        FlexReader(path).__enter__()
+
+
 def test_flex_ingest_end_to_end(tmp_path, planes):
     """Opera numeric well names -> metaconfig (auto) -> imextract ->
     pixels in the canonical store; fields become sites, FLEX Array

@@ -54,20 +54,24 @@ def _lv_compound(name: str, inner: bytes) -> bytes:
 
 def experiment_chunk(loops) -> bytes:
     """LV payload for ImageMetadataLV!: nested SLxExperiment levels,
-    ``loops`` = [(eType, size)] or [(eType, size, points)] outermost
-    first; ``points`` = [(y, x), ...] emits XYPosLoop stage coords in
-    uLoopPars."""
+    ``loops`` = [(eType, size)] or [(eType, size, points)] or
+    [(eType, size, points, keys)] outermost first; ``points`` =
+    [(y, x), ...] emits XYPosLoop stage coords in uLoopPars, ``keys``
+    overrides the per-point compound names (default zero-padded)."""
     inner = b""
     for spec in reversed(loops):
         etype, size = spec[0], spec[1]
         level = _lv_u32("eType", etype) + _lv_u32("uiLoopSize", size)
         if len(spec) > 2 and spec[2] is not None:
+            keys = spec[3] if len(spec) > 3 else [
+                f"i{i:010d}" for i in range(len(spec[2]))
+            ]
             pts = b"".join(
                 _lv_compound(
-                    f"i{i:010d}",
+                    key,
                     _lv_f64("dPosX", x) + _lv_f64("dPosY", y),
                 )
-                for i, (y, x) in enumerate(spec[2])
+                for key, (y, x) in zip(keys, spec[2])
             )
             level += _lv_compound("uLoopPars", _lv_compound("Points", pts))
         if inner:
@@ -425,6 +429,34 @@ def test_nd2_nonrect_positions_fall_back(tmp_path):
     entries, skipped = nd2_sidecar(src)
     assert skipped == 0
     assert all("site_y" not in e for e in entries)
+
+
+def test_nd2_xy_positions_keep_document_order_not_sorted(tmp_path):
+    """Point keys are not guaranteed zero-padded: 'p10' sorts before
+    'p2', so a sorted() walk would reorder stage positions (the
+    dense-grid cross-check passes under any permutation, silently
+    assigning wrong grid coordinates)."""
+    rng = np.random.default_rng(77)
+    planes = rng.integers(0, 60000, (3, 6, 7, 1), dtype=np.uint16)
+    pts = [(0.0, 0.0), (0.0, 500.0), (0.0, 1000.0)]
+    write_nd2(tmp_path / "order_A01.nd2", planes,
+              loops=[(2, 3, pts, ["p2", "p10", "p30"])])
+    with ND2Reader(tmp_path / "order_A01.nd2") as r:
+        assert r.xy_positions() == pts
+
+
+def test_nd2_repeated_point_keys_all_survive(tmp_path):
+    """Real XYPosLoop Points entries commonly share one name; each must
+    survive LV parsing (not overwrite the last) or the point-count
+    guard degrades multi-point wells to the flat fallback."""
+    rng = np.random.default_rng(78)
+    planes = rng.integers(0, 60000, (3, 6, 7, 1), dtype=np.uint16)
+    pts = [(0.0, 0.0), (0.0, 500.0), (0.0, 1000.0)]
+    write_nd2(tmp_path / "rep_A01.nd2", planes,
+              loops=[(2, 3, pts, ["Point", "Point", "Point"])])
+    with ND2Reader(tmp_path / "rep_A01.nd2") as r:
+        assert r.loop_shape() == [("XY", 3)]
+        assert r.xy_positions() == pts
 
 
 def test_nd2_zero_sequences_yield_no_entries(tmp_path):

@@ -429,6 +429,21 @@ class ND2Reader(Reader):
         import struct
 
         out: dict = {}
+        next_suffix: dict = {}
+
+        def store(name, value):
+            # list compounds (e.g. XYPosLoop Points) repeat one name per
+            # element; index-suffix later occurrences so every element
+            # survives into the dict in document order instead of each
+            # overwriting the last
+            if name in out:
+                i = next_suffix.get(name, 1)
+                while f"{name}~{i}" in out:
+                    i += 1
+                next_suffix[name] = i + 1
+                name = f"{name}~{i}"
+            out[name] = value
+
         end = len(buf) if end is None else end
         while pos < end - 1:
             vtype, name_chars = struct.unpack_from("<BB", buf, pos)
@@ -436,34 +451,34 @@ class ND2Reader(Reader):
             name = buf[pos:pos + 2 * name_chars].decode("utf-16-le").rstrip("\x00")
             pos += 2 * name_chars
             if vtype == 1:
-                out[name] = buf[pos]
+                store(name, buf[pos])
                 pos += 1
             elif vtype == 2:
-                out[name] = struct.unpack_from("<i", buf, pos)[0]
+                store(name, struct.unpack_from("<i", buf, pos)[0])
                 pos += 4
             elif vtype == 3:
-                out[name] = struct.unpack_from("<I", buf, pos)[0]
+                store(name, struct.unpack_from("<I", buf, pos)[0])
                 pos += 4
             elif vtype == 4:
-                out[name] = struct.unpack_from("<Q", buf, pos)[0]
+                store(name, struct.unpack_from("<Q", buf, pos)[0])
                 pos += 8
             elif vtype == 5:
-                out[name] = struct.unpack_from("<d", buf, pos)[0]
+                store(name, struct.unpack_from("<d", buf, pos)[0])
                 pos += 8
             elif vtype == 6:
                 stop = pos
                 while stop < end and buf[stop:stop + 2] != b"\x00\x00":
                     stop += 2
-                out[name] = buf[pos:stop].decode("utf-16-le")
+                store(name, buf[pos:stop].decode("utf-16-le"))
                 pos = stop + 2
             elif vtype == 8:
                 (blen,) = struct.unpack_from("<Q", buf, pos)
-                out[name] = buf[pos + 8:pos + 8 + blen]
+                store(name, buf[pos + 8:pos + 8 + blen])
                 pos += 8 + blen
             elif vtype == 11:
                 _count, blen = struct.unpack_from("<IQ", buf, pos)
                 pos += 12
-                out[name] = cls._parse_lv(buf, pos, pos + blen)
+                store(name, cls._parse_lv(buf, pos, pos + blen))
                 pos += blen
             else:
                 from tmlibrary_tpu.errors import MetadataError
@@ -584,8 +599,11 @@ class ND2Reader(Reader):
                 if isinstance(x, (int, float)) and isinstance(y, (int, float)):
                     out.append((float(y), float(x)))
                     return  # a point's children are calibration noise
-                for key in sorted(node):
-                    collect(node[key], out)
+                # document order, NOT sorted(): point keys are not
+                # guaranteed zero-padded, and 'a10' sorts before 'a2' —
+                # same convention as channel_names' plane iteration
+                for v in node.values():
+                    collect(v, out)
 
         points: list = []
         collect(level.get("uLoopPars"), points)
@@ -2611,6 +2629,21 @@ class FlexReader(Reader):
                 f"x{samples} in {self.filename}"
             )
         self._dtype = np.dtype(bo + ("u1" if bits == 8 else "u2"))
+        for i, ifd in enumerate(ifds[1:], start=1):
+            # Bio-Formats' FlexReader models per-plane sizes; this one
+            # assumes page-0 geometry for every page, so a mismatched
+            # page must fail loudly here rather than decode later pages
+            # with misaligned rows (silently scrambled pixels)
+            page = (_tiff_int(bo, buf, ifd, 256, 0),
+                    _tiff_int(bo, buf, ifd, 257, 0),
+                    _tiff_int(bo, buf, ifd, 258, 8),
+                    _tiff_int(bo, buf, ifd, 277, 1))
+            if page != (self.width, self.height, bits, samples):
+                raise NotSupportedError(
+                    f"FLEX page {i} geometry {page} differs from page 0 "
+                    f"{(self.width, self.height, bits, samples)} in "
+                    f"{self.filename}; per-page sizes are not supported"
+                )
         names = self._channel_names_from_xml(bo, buf, first)
         n_pages = len(ifds)
         if names and n_pages % len(names) == 0:
