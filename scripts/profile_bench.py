@@ -31,6 +31,10 @@ MAXOBJ = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
 PIPELINE = int(os.environ.get("PROFILE_PIPELINE", "8"))
 
 
+#: stage name -> best ms, in measurement order (dict preserves insertion)
+STAGES: "dict[str, float]" = {}
+
+
 def timeit(name, fn, *args):
     """Pipelined timing: PIPELINE executions per ONE fenced fetch, so the
     ~100 ms relay round-trip (the measured noop floor) is amortized out
@@ -41,6 +45,7 @@ def timeit(name, fn, *args):
         t0 = time.perf_counter()
         np.asarray(jnp.stack([fn(*args) for _ in range(PIPELINE)]))
         best = min(best, (time.perf_counter() - t0) / PIPELINE)
+    STAGES[name] = best * 1e3
     print(f"{name:35s} {best*1e3:9.2f} ms  ({BATCH/best:8.1f} sites/s)")
 
 
@@ -111,6 +116,34 @@ def main():
             l, im, MAXOBJ, levels=16, glcm_method=method))), nuclei, actin)
     timeit("zernike deg=6", scalar(v(lambda l: zernike_features(l, MAXOBJ, degree=6))),
            nuclei)
+
+    out_path = os.environ.get("PROFILE_OUT")
+    if out_path:
+        # machine-readable capture for the watcher: BASELINE.md's
+        # per-stage table is rendered from this file by
+        # scripts/update_baseline_table.py
+        import json
+
+        payload = {
+            "stages_ms": {k: round(v, 3) for k, v in STAGES.items()},
+            "batch": BATCH,
+            "site_size": SIZE,
+            "max_objects": MAXOBJ,
+            "pipeline": PIPELINE,
+            "backend": jax.default_backend(),
+            "device": str(jax.devices()[0]),
+            "written_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%S+00:00", time.gmtime()
+            ),
+            "written_by": "scripts/profile_bench.py",
+        }
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            # no sort_keys: stages_ms insertion order IS the pipeline
+            # order and the renderer preserves it
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, out_path)
+        print(f"wrote {out_path}")
 
 
 if __name__ == "__main__":

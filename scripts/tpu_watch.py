@@ -37,6 +37,7 @@ sys.path.insert(0, REPO)
 from bench import CACHE_PATH, probe_accelerator  # noqa: E402
 
 TUNING_PATH = os.path.join(REPO, "tuning", "TUNING.json")
+PROFILE_PATH = os.path.join(REPO, "tuning", "PROFILE_TPU.json")
 PID_PATH = os.path.join(REPO, "tuning", "watch.pid")
 
 # (cache key, bench env) in priority order — headline first.
@@ -166,6 +167,61 @@ def run_bench_item(key: str, overrides: dict) -> bool:
     return True
 
 
+def profile_done() -> bool:
+    """The per-stage profile is done when captured at the CURRENT tuned
+    defaults (same staleness rule as bench_done): it is the artifact
+    BASELINE.md's stage table and binding-resource line render from."""
+    from bench import _default_batch, _tuned_pipeline_default
+
+    prof = load_json(PROFILE_PATH)
+    return bool(
+        prof.get("stages_ms")
+        and prof.get("pipeline") == _tuned_pipeline_default()
+        and prof.get("batch") == _default_batch("3")
+    )
+
+
+def run_profile() -> bool:
+    from bench import _default_batch, _tuned_pipeline_default
+
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("BENCH_", "TMX_", "TUNE_", "PROFILE_"))
+    }
+    env.update(
+        BENCH_BATCH=str(_default_batch("3")),
+        PROFILE_PIPELINE=str(_tuned_pipeline_default()),
+        PROFILE_OUT=PROFILE_PATH,
+    )
+    log("profile_bench: running")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "profile_bench.py")],
+            env=env, capture_output=True, text=True, timeout=1800,
+        )
+    except subprocess.TimeoutExpired:
+        log("profile_bench: timed out")
+        return False
+    tail = "\n".join(r.stdout.splitlines()[-22:])
+    log(f"profile_bench rc={r.returncode}:\n{tail}")
+    return r.returncode == 0 and profile_done()
+
+
+def render_baseline() -> None:
+    """Best-effort re-render of BASELINE.md's generated block so the
+    driver-visible file mirrors whatever this window captured."""
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "update_baseline_table.py")],
+            capture_output=True, text=True, timeout=120,
+        )
+        log(f"update_baseline_table rc={r.returncode}: "
+            f"{(r.stdout or r.stderr).strip()[-200:]}")
+    except (subprocess.TimeoutExpired, OSError) as exc:
+        log(f"update_baseline_table failed: {exc}")
+
+
 def pending_tune_stages() -> list:
     from scripts.tune_tpu import METHODOLOGY
 
@@ -212,6 +268,8 @@ def run_tune() -> bool:
 def all_pending() -> list:
     items = [f"bench:{k}" for k, _ in BENCH_ITEMS if not bench_done(k)]
     items += [f"tune:{s}" for s in pending_tune_stages()]
+    if not profile_done():
+        items.append("profile")
     return items
 
 
@@ -258,13 +316,24 @@ def main() -> None:
             time.sleep(poll_s)
             continue
         log(f"relay ALIVE — firing pending work: {pending}")
+        captured = False
         for key, overrides in BENCH_ITEMS:
             if not bench_done(key):
                 if not run_bench_item(key, overrides):
                     break  # relay likely died; back to probing
+                captured = True
         else:
             if pending_tune_stages():
                 run_tune()
+                captured = True  # tune flushes TUNING.json per stage
+            # profile last: it informs BASELINE.md's stage table but the
+            # headline records and tuned defaults matter more if the
+            # window dies mid-way.  Tuning may have changed the defaults,
+            # so bench/profile staleness is re-evaluated next loop pass.
+            if not pending_tune_stages() and not profile_done():
+                captured |= run_profile()
+        if captured:  # don't churn BASELINE.md on no-progress passes
+            render_baseline()
         time.sleep(10)
 
 

@@ -36,6 +36,8 @@ def _watch(monkeypatch, tmp_path, cache=None, tuning=None):
         tuning_path.write_text(json.dumps(tuning))
     monkeypatch.setattr(tpu_watch, "CACHE_PATH", str(cache_path))
     monkeypatch.setattr(tpu_watch, "TUNING_PATH", str(tuning_path))
+    monkeypatch.setattr(tpu_watch, "PROFILE_PATH",
+                        str(tmp_path / "tuning" / "PROFILE_TPU.json"))
     # bench's tuned defaults read the repo TUNING.json via bench.REPO
     monkeypatch.setattr(bench, "REPO", str(tmp_path))
     return tpu_watch
@@ -104,6 +106,84 @@ def test_pending_tune_couples_pipeline_to_sweep(monkeypatch, tmp_path):
     assert "pipeline" in pending  # rerunning sweep invalidates pipeline
 
 
+def test_profile_done_tracks_tuned_defaults(monkeypatch, tmp_path):
+    """The per-stage profile is re-captured whenever the tuned batch or
+    pipeline depth it was measured at is superseded."""
+    w = _watch(
+        monkeypatch, tmp_path,
+        tuning={**MACHINE, "best_pipeline": 8, "best_batch": 64},
+    )
+    assert w.profile_done() is False  # no capture yet
+
+    prof = tmp_path / "tuning" / "PROFILE_TPU.json"
+    prof.write_text(json.dumps(
+        {"stages_ms": {"noop (fetch floor)": 0.1}, "pipeline": 8,
+         "batch": 64}))
+    assert w.profile_done() is True
+    assert "profile" not in w.all_pending()
+
+    (tmp_path / "tuning" / "TUNING.json").write_text(json.dumps(
+        {**MACHINE, "best_pipeline": 16, "best_batch": 64}))
+    assert w.profile_done() is False  # depth superseded
+    assert "profile" in w.all_pending()
+
+
+def test_render_tuning_writes_one_cliff_verdict(monkeypatch, tmp_path):
+    """The batch-128 narrative is computed from the measured sweep —
+    both branches — so BASELINE.md can never tell two stories again."""
+    from scripts import update_baseline_table as u
+
+    monkeypatch.setattr(u, "TUNING", tmp_path / "TUNING.json")
+    base = {"written_by": "scripts/tune_tpu.py write_results",
+            "timing_methodology": "pipelined-depth8", "best_batch": 128}
+
+    (tmp_path / "TUNING.json").write_text(json.dumps(
+        {**base, "batch_sweep": {"64": 264.5, "128": 329.8, "256": 297.1}}))
+    text = "\n".join(u.render_tuning())
+    assert "NOT PRESENT" in text and "REPRODUCED" not in text
+
+    (tmp_path / "TUNING.json").write_text(json.dumps(
+        {**base, "best_batch": 64,
+         "batch_sweep": {"64": 264.5, "128": 8.8, "256": 206.0}}))
+    text = "\n".join(u.render_tuning())
+    assert "REPRODUCED" in text and "NOT PRESENT" not in text
+
+    # hand-written tuning files never render
+    (tmp_path / "TUNING.json").write_text(json.dumps(
+        {"batch_sweep": {"64": 1.0, "128": 2.0}}))
+    assert u.render_tuning() == []
+
+
+def test_render_profile_names_binding_stage(monkeypatch, tmp_path):
+    from scripts import update_baseline_table as u
+
+    monkeypatch.setattr(u, "PROFILE", tmp_path / "PROFILE_TPU.json")
+    (tmp_path / "PROFILE_TPU.json").write_text(json.dumps({
+        "stages_ms": {
+            "noop (fetch floor)": 0.1,
+            "segment_primary (full)": 30.0,
+            "segment_secondary (xla)": 47.0,
+            "segment_secondary (pallas)": 53.0,
+            "measure_intensity(nuclei)": 5.0,
+            "measure_intensity(cells)": 5.0,
+        },
+        "batch": 128, "site_size": 256, "max_objects": 64,
+        "pipeline": 8, "device": "TPU v5 lite0",
+    }))
+    text = "\n".join(u.render_profile())
+    # per-kernel auto dispatch takes the faster secondary variant (xla)
+    assert "Binding stage for config 3: segment_secondary" in text
+    assert "54%" in text  # 47 / (30+47+5+5)
+    # a capture missing every optional key (device, written_at, batch…)
+    # still renders the stage table without crashing
+    (tmp_path / "PROFILE_TPU.json").write_text(json.dumps(
+        {"stages_ms": {"smooth(gauss 1.5)": 1.0}}))
+    sparse = "\n".join(u.render_profile())
+    assert "smooth(gauss 1.5)" in sparse
+    (tmp_path / "PROFILE_TPU.json").write_text(json.dumps({}))
+    assert u.render_profile() == []
+
+
 def test_demo_pipe_yaml_stays_valid(monkeypatch):
     """The demo script's embedded pipeline must parse and validate
     against the real description schema."""
@@ -139,6 +219,9 @@ def test_update_baseline_table_idempotent(monkeypatch, tmp_path):
     }}}))
     monkeypatch.setattr(u, "BASELINE", baseline)
     monkeypatch.setattr(u, "CACHE", cache)
+    # absent in tmp: the sweep/profile sections must simply not render
+    monkeypatch.setattr(u, "TUNING", tmp_path / "TUNING.json")
+    monkeypatch.setattr(u, "PROFILE", tmp_path / "PROFILE_TPU.json")
     assert u.main() == 0
     once = baseline.read_text()
     assert "400.0" in once and once.count(u.BEGIN) == 1
