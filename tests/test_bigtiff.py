@@ -1,0 +1,175 @@
+"""BigTIFF (magic 43) and deflate-strip decoding.
+
+The first-party chain for plain TIFF pages is native C++ (classic,
+none/LZW/PackBits) -> ``read_tiff_page_py`` (BigTIFF, deflate) -> cv2.
+The writer here emits minimal single-strip-per-page files in both the
+classic and BigTIFF layouts so the Python fallback is exercised without
+any third-party encoder.
+"""
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu.readers import ImageReader, read_tiff_page_py
+
+
+def _entry(bo, big, tag, typ, vals, fmt):
+    """One IFD entry with the value(s) packed inline, left-justified in
+    the 4/8-byte value field (the TIFF rule for both byte orders)."""
+    cap = 8 if big else 4
+    packed = struct.pack(bo + fmt * len(vals), *vals)
+    assert len(packed) <= cap, "inline-only writer"
+    head = struct.pack(bo + ("HHQ" if big else "HHI"), tag, typ, len(vals))
+    return head + packed.ljust(cap, b"\x00")
+
+
+def write_tiff(path, planes, big=True, compression=1, predictor=1, bo="<"):
+    """``planes``: (n, h, w) uint8/uint16; one strip per page."""
+    n, h, w = planes.shape
+    bits = planes.dtype.itemsize * 8
+    order = b"II" if bo == "<" else b"MM"
+    if big:
+        buf = bytearray(struct.pack(bo + "2sHHHQ", order, 43, 8, 0, 0))
+        first_ifd_at, off_fmt = 8, "Q"
+    else:
+        buf = bytearray(struct.pack(bo + "2sHI", order, 42, 0))
+        first_ifd_at, off_fmt = 4, "I"
+
+    strips = []
+    for p in range(n):
+        plane = np.ascontiguousarray(planes[p], dtype=bo + (
+            "u1" if bits == 8 else "u2"))
+        if predictor == 2:
+            plane = np.concatenate(
+                [plane[:, :1], np.diff(plane.astype(np.int64), axis=1)],
+                axis=1,
+            ).astype(plane.dtype)
+        raw = plane.tobytes()
+        if compression in (8, 32946):
+            raw = zlib.compress(raw)
+        elif compression != 1:
+            raise AssertionError("writer supports none/deflate only")
+        strips.append((len(buf), len(raw)))
+        buf += raw
+
+    ifd_offs, next_ptr_pos = [], []
+    for p in range(n):
+        entries = [
+            _entry(bo, big, 256, 3, [w], "H"),
+            _entry(bo, big, 257, 3, [h], "H"),
+            _entry(bo, big, 258, 3, [bits], "H"),
+            _entry(bo, big, 259, 3, [compression], "H"),
+            _entry(bo, big, 262, 3, [1], "H"),
+            _entry(bo, big, 273, 16 if big else 4, [strips[p][0]],
+                   "Q" if big else "I"),
+            _entry(bo, big, 277, 3, [1], "H"),
+            _entry(bo, big, 278, 3, [h], "H"),
+            _entry(bo, big, 279, 16 if big else 4, [strips[p][1]],
+                   "Q" if big else "I"),
+        ]
+        if predictor != 1:
+            entries.append(_entry(bo, big, 317, 3, [predictor], "H"))
+        entries.sort(key=lambda e: struct.unpack_from(bo + "H", e)[0])
+        ifd_offs.append(len(buf))
+        buf += struct.pack(bo + ("Q" if big else "H"), len(entries))
+        buf += b"".join(entries)
+        next_ptr_pos.append(len(buf))
+        buf += struct.pack(bo + off_fmt, 0)
+    struct.pack_into(bo + off_fmt, buf, first_ifd_at, ifd_offs[0])
+    for p in range(n - 1):
+        struct.pack_into(bo + off_fmt, buf, next_ptr_pos[p], ifd_offs[p + 1])
+    path.write_bytes(bytes(buf))
+    return path
+
+
+@pytest.fixture()
+def planes():
+    rng = np.random.default_rng(57)
+    return rng.integers(0, 60000, (3, 10, 13), dtype=np.uint16)
+
+
+@pytest.mark.parametrize("bo", ["<", ">"])
+def test_bigtiff_pages_round_trip(tmp_path, planes, bo):
+    path = write_tiff(tmp_path / "big.tif", planes, big=True, bo=bo)
+    for p in range(3):
+        np.testing.assert_array_equal(read_tiff_page_py(path, p), planes[p])
+    assert read_tiff_page_py(path, 3) is None  # out of range -> cv2's turn
+
+
+@pytest.mark.parametrize("big", [False, True])
+@pytest.mark.parametrize("compression", [8, 32946])
+def test_deflate_strips_round_trip(tmp_path, planes, big, compression):
+    path = write_tiff(tmp_path / "z.tif", planes, big=big,
+                      compression=compression)
+    for p in range(3):
+        np.testing.assert_array_equal(read_tiff_page_py(path, p), planes[p])
+
+
+def test_deflate_with_horizontal_predictor(tmp_path, planes):
+    path = write_tiff(tmp_path / "pred.tif", planes, big=True,
+                      compression=8, predictor=2)
+    np.testing.assert_array_equal(read_tiff_page_py(path, 1), planes[1])
+
+
+def test_bigtiff_uint8(tmp_path):
+    rng = np.random.default_rng(58)
+    planes8 = rng.integers(0, 255, (2, 7, 9), dtype=np.uint8)
+    path = write_tiff(tmp_path / "b8.tif", planes8, big=True, compression=8)
+    out = read_tiff_page_py(path, 1)
+    assert out.dtype == np.uint8
+    np.testing.assert_array_equal(out, planes8[1])
+
+
+def test_image_reader_falls_through_to_bigtiff(tmp_path, planes):
+    """The public ImageReader boundary: native C++ declines magic 43,
+    the Python fallback decodes it first-party (no cv2)."""
+    path = write_tiff(tmp_path / "big.tif", planes, big=True, compression=8)
+    with ImageReader(path) as r:
+        np.testing.assert_array_equal(r.read(2), planes[2])
+
+
+def test_imextract_read_plane_decodes_bigtiff(tmp_path, planes):
+    from tmlibrary_tpu.workflow.steps.imextract import ImageExtractor
+
+    path = write_tiff(tmp_path / "big.tif", planes, big=True)
+    out = ImageExtractor._read_plane(str(path), 1, *planes.shape[1:])
+    np.testing.assert_array_equal(out, planes[1])
+
+
+def test_rgb_and_tiled_fall_through(tmp_path, planes):
+    """A file the fallback can't model returns None (cv2's turn), it
+    never guesses."""
+    path = write_tiff(tmp_path / "big.tif", planes, big=True)
+    buf = bytearray(path.read_bytes())
+    # patch SamplesPerPixel (tag 277) of IFD 0 to 3
+    (ifd0,) = struct.unpack_from("<Q", buf, 8)
+    (n,) = struct.unpack_from("<Q", buf, ifd0)
+    for i in range(n):
+        p = ifd0 + 8 + 20 * i
+        if struct.unpack_from("<H", buf, p)[0] == 277:
+            struct.pack_into("<H", buf, p + 12, 3)
+    path.write_bytes(bytes(buf))
+    assert read_tiff_page_py(path, 0) is None
+
+
+def test_fuzz_bigtiff_page_fallback(tmp_path, planes):
+    """read_tiff_page_py's contract is narrower than the readers': it
+    returns None (or a decoded array) on ANY input, never raises — a
+    leak here would crash ingest's plain-TIFF path."""
+    valid = write_tiff(tmp_path / "v.tif", planes, big=True,
+                       compression=8).read_bytes()
+    rng = np.random.default_rng(59)
+    target = tmp_path / "m.tif"
+    for _ in range(60):
+        mutated = bytearray(valid)
+        mutated[int(rng.integers(0, len(valid)))] ^= int(
+            rng.integers(1, 256))
+        target.write_bytes(bytes(mutated))
+        for page in range(3):
+            read_tiff_page_py(target, page)
+    for _ in range(20):
+        target.write_bytes(valid[:int(rng.integers(1, len(valid)))])
+        for page in range(3):
+            read_tiff_page_py(target, page)

@@ -82,11 +82,15 @@ def experiment_chunk(loops) -> bytes:
 
 def write_nd2(path, planes: np.ndarray, timestamps=None,
               declare_sequences=None, loops=None,
-              channel_names=None) -> None:
+              channel_names=None, compression=None) -> None:
     """``planes``: (n_seq, H, W, C) uint16.  ``declare_sequences``
     overstates ``uiSequenceCount`` to mimic an aborted acquisition.
     ``loops``: [(eType, size), ...] emits an ImageMetadataLV!
-    SLxExperiment tree (outermost first)."""
+    SLxExperiment tree (outermost first).  ``compression``:
+    None (raw) | "lossless" (eCompression=0, zlib payloads) |
+    "lossy" (eCompression=1, which the reader must refuse)."""
+    import zlib
+
     n_seq, h, w, c = planes.shape
     inner = (
         _lv_u32("uiWidth", w)
@@ -95,6 +99,10 @@ def write_nd2(path, planes: np.ndarray, timestamps=None,
         + _lv_u32("uiBpcInMemory", 16)
         + _lv_u32("uiSequenceCount", declare_sequences or n_seq)
     )
+    if compression is not None:
+        inner += _lv_u32(
+            "eCompression", {"lossless": 0, "lossy": 1}[compression]
+        )
     attr_name = ("SLxImageAttributes" + "\x00").encode("utf-16-le")
     attrs = (
         struct.pack("<BB", 11, len("SLxImageAttributes") + 1)
@@ -124,7 +132,10 @@ def write_nd2(path, planes: np.ndarray, timestamps=None,
             _lv_compound("sPicturePlanes", plane_meta)))
     for s in range(n_seq):
         ts = float(timestamps[s]) if timestamps is not None else 1000.0 * s
-        payload = struct.pack("<d", ts) + planes[s].tobytes()
+        pixels = planes[s].tobytes()
+        if compression == "lossless":
+            pixels = zlib.compress(pixels)
+        payload = struct.pack("<d", ts) + pixels
         emit(b"ImageDataSeq|%d!" % s, payload)
 
     cmap = bytearray()
@@ -457,6 +468,45 @@ def test_nd2_repeated_point_keys_all_survive(tmp_path):
     with ND2Reader(tmp_path / "rep_A01.nd2") as r:
         assert r.loop_shape() == [("XY", 3)]
         assert r.xy_positions() == pts
+
+
+def test_nd2_lossless_round_trip(tmp_path, planes):
+    """eCompression=0 sequences carry a zlib stream after the 8-byte
+    timestamp (the public nd2 lossless convention); pixels and
+    timestamps must round-trip bit-exactly."""
+    write_nd2(tmp_path / "z_A01.nd2", planes, compression="lossless")
+    with ND2Reader(tmp_path / "z_A01.nd2") as r:
+        assert r.n_sequences == 3
+        for s in range(3):
+            for c in range(2):
+                np.testing.assert_array_equal(
+                    r.read_plane(s, c), planes[s, :, :, c]
+                )
+            assert r.timestamp(s) == 1000.0 * s
+
+
+def test_nd2_lossy_refused_up_front(tmp_path, planes):
+    from tmlibrary_tpu.errors import NotSupportedError
+
+    write_nd2(tmp_path / "j_A01.nd2", planes, compression="lossy")
+    with pytest.raises(NotSupportedError):
+        ND2Reader(tmp_path / "j_A01.nd2").__enter__()
+
+
+def test_nd2_corrupt_lossless_stream_is_metadata_error(tmp_path, planes):
+    from tmlibrary_tpu.errors import MetadataError
+
+    path = tmp_path / "c_A01.nd2"
+    write_nd2(path, planes, compression="lossless")
+    blob = bytearray(path.read_bytes())
+    # corrupt the middle of the first zlib stream (past the chunk
+    # header and timestamp, well before the chunk map at the tail)
+    marker = blob.find(b"ImageDataSeq|0!")
+    blob[marker + 40] ^= 0xFF
+    path.write_bytes(bytes(blob))
+    with ND2Reader(path) as r:
+        with pytest.raises(MetadataError):
+            r.read_plane(0, 0)
 
 
 def test_nd2_zero_sequences_yield_no_entries(tmp_path):

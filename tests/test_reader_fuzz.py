@@ -78,6 +78,18 @@ def test_fuzz_nd2(tmp_path):
     _fuzz(make, ND2Reader, tmp_path, ".nd2", 1)
 
 
+def test_fuzz_nd2_lossless(tmp_path):
+    from test_nd2 import write_nd2
+
+    from tmlibrary_tpu.readers import ND2Reader
+
+    def make(path, rng):
+        planes = rng.integers(0, 60000, (3, 8, 9, 2), dtype=np.uint16)
+        write_nd2(path, planes, compression="lossless")
+
+    _fuzz(make, ND2Reader, tmp_path, ".nd2", 12)
+
+
 def test_fuzz_czi(tmp_path):
     from test_czi import write_czi
 

@@ -176,13 +176,60 @@ def container_dimensions(path) -> tuple[int, int] | None:
         r.__exit__()
 
 
+#: (path, mtime_ns, size) -> (byteorder, ifds) — the value offsets in
+#: the parsed entries are plain ints, independent of any open buffer, so
+#: the parse survives across per-plane re-opens.  Bounded FIFO: without
+#: it, imextract's per-plane loop re-walks every IFD of a multi-page
+#: stack for every plane (the O(planes^2) work the reader cache exists
+#: to prevent).
+_TIFF_PY_PARSE_CACHE: "dict[tuple, tuple[str, list]]" = {}
+_TIFF_PY_PARSE_CACHE_MAX = 8
+
+
+def read_tiff_page_py(path, page: int) -> "np.ndarray | None":
+    """First-party Python fallback for TIFF pages the native C++ page
+    reader declines — BigTIFF (magic 43) and deflate-compressed strips —
+    limited to 8/16-bit grayscale strip layouts.  Returns None when the
+    file is not such a TIFF (caller falls through to cv2), so a failure
+    here can never mask a format cv2 could still decode."""
+    import mmap
+    import os
+    import struct
+
+    from tmlibrary_tpu.errors import MetadataError, NotSupportedError
+
+    try:
+        with open(path, "rb") as f, mmap.mmap(
+            f.fileno(), 0, access=mmap.ACCESS_READ
+        ) as m:
+            st = os.fstat(f.fileno())
+            key = (str(path), st.st_mtime_ns, st.st_size)
+            hit = _TIFF_PY_PARSE_CACHE.get(key)
+            if hit is None:
+                hit = _tiff_parse(m)
+                while len(_TIFF_PY_PARSE_CACHE) >= _TIFF_PY_PARSE_CACHE_MAX:
+                    _TIFF_PY_PARSE_CACHE.pop(
+                        next(iter(_TIFF_PY_PARSE_CACHE)))
+                _TIFF_PY_PARSE_CACHE[key] = hit
+            bo, ifds = hit
+            if not 0 <= page < len(ifds):
+                return None
+            return _gray_ifd_plane(bo, m, ifds[page], path,
+                                   "plain TIFF pages")
+    except (OSError, ValueError, MetadataError, NotSupportedError,
+            struct.error):
+        return None
+
+
 class ImageReader(Reader):
     """Read 2-D image files; grayscale TIFFs decode through the
-    first-party native reader (``native.tiff_read``), Nikon ND2 / Zeiss
-    CZI containers through the first-party chunk parsers (``page`` is the
-    linear plane index their metaconfig handlers write; the parsed
-    chunk map is cached for the context's lifetime), everything else
-    (PNG, RGB, tiled TIFF) through cv2.  uint8/uint16 preserved."""
+    first-party native reader (``native.tiff_read``) with the Python
+    paged fallback (:func:`read_tiff_page_py`: BigTIFF, deflate), Nikon
+    ND2 / Zeiss CZI containers through the first-party chunk parsers
+    (``page`` is the linear plane index their metaconfig handlers write;
+    the parsed chunk map is cached for the context's lifetime),
+    everything else (PNG, RGB, tiled TIFF) through cv2.  uint8/uint16
+    preserved."""
 
     def __enter__(self):
         self._container = _open_container(self.filename)
@@ -210,6 +257,9 @@ class ImageReader(Reader):
                 img = tiff_read(self.filename, page, h, w)
                 if img is not None:
                     return img.astype(np.uint8) if bits == 8 else img
+            img = read_tiff_page_py(self.filename, page)
+            if img is not None:
+                return img
 
         import cv2
 
@@ -357,6 +407,19 @@ class ND2Reader(Reader):
             raise MetadataError(
                 f"{self.filename}: only uint16 ND2 payloads are supported "
                 f"(uiBpcInMemory={self.bits})"
+            )
+        # eCompression per the public nd2 attribute convention:
+        # 0 = lossless (zlib stream after the 8-byte timestamp),
+        # 1 = lossy (JPEG2000 — no first-party decoder), else/absent = raw
+        comp = attrs.get("eCompression")
+        self._lossless = comp == 0
+        if comp == 1:
+            self.__exit__()
+            from tmlibrary_tpu.errors import NotSupportedError
+
+            raise NotSupportedError(
+                f"{self.filename}: lossy-compressed ND2 (eCompression=1) "
+                "is not supported (lossless zlib and uncompressed are)"
             )
         n_chunks = sum(1 for n in self._chunks if n.startswith(b"ImageDataSeq|"))
         try:
@@ -695,6 +758,28 @@ class ND2Reader(Reader):
                 f"{self.filename}: corrupt sequence chunk {sequence}: {exc}"
             ) from exc
         n_px = self.height * self.width * self.n_components
+        if getattr(self, "_lossless", False):
+            import zlib
+
+            try:
+                # max_length bounds the expansion: a crafted chunk must
+                # fail the size check below, not OOM the ingest job
+                decoded = zlib.decompressobj().decompress(
+                    payload[8:], 2 * n_px)
+            except zlib.error as exc:
+                raise MetadataError(
+                    f"{self.filename}: corrupt lossless sequence "
+                    f"{sequence}: {exc}"
+                ) from exc
+            if len(decoded) < 2 * n_px:
+                raise MetadataError(
+                    f"{self.filename}: lossless sequence {sequence} "
+                    f"decodes to {len(decoded)} bytes, expected {2 * n_px}"
+                )
+            samples = np.frombuffer(decoded, np.uint16, count=n_px)
+            plane = samples.reshape(self.height, self.width,
+                                    self.n_components)
+            return np.ascontiguousarray(plane[:, :, component])
         expect = 8 + 2 * n_px  # f64 timestamp + uint16 samples
         if len(payload) < expect:
             raise MetadataError(
@@ -1754,19 +1839,23 @@ class IMSReader(Reader):
 
 
 # --------------------------------------------------- TIFF-variant containers
-#: TIFF value-type sizes (BYTE, ASCII, SHORT, LONG, RATIONAL, signed/float)
+#: TIFF value-type sizes (BYTE, ASCII, SHORT, LONG, RATIONAL, signed/float,
+#: IFD, and the BigTIFF 8-byte types LONG8/SLONG8/IFD8)
 _TIFF_TYPE_SIZE = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
-                   10: 8, 11: 4, 12: 8}
+                   10: 8, 11: 4, 12: 8, 13: 4, 16: 8, 17: 8, 18: 8}
 
 
 def _tiff_parse(buf) -> tuple[str, list[dict]]:
-    """Minimal classic-TIFF IFD walk over an in-memory buffer.
+    """Minimal TIFF IFD walk over an in-memory buffer — classic (magic
+    42) and BigTIFF (magic 43, 8-byte offsets/counts, 20-byte entries).
 
     Returns ``(byteorder, ifds)`` where each IFD is ``{tag: (type, count,
-    value_field_offset)}``.  Shared by the STK and LSM container readers —
-    their plane layouts (all-planes-in-one-IFD; per-channel strips +
-    thumbnail IFDs) don't fit the native page reader's model, so they
-    need the raw tag table, not decoded pages.
+    value_data_offset)}``.  The value offset is RESOLVED at parse time
+    (inline position when the value fits in the entry's 4/8-byte value
+    field, else the dereferenced pointer), so downstream helpers are
+    format-agnostic.  Shared by the STK/LSM/FLEX/Olympus container
+    readers — their plane layouts don't fit the native page reader's
+    model, so they need the raw tag table, not decoded pages.
     """
     import struct
 
@@ -1776,50 +1865,68 @@ def _tiff_parse(buf) -> tuple[str, list[dict]]:
     if bo is None or len(buf) < 8:
         raise MetadataError("not a TIFF (bad byte-order mark)")
     (magic,) = struct.unpack_from(bo + "H", buf, 2)
-    if magic != 42:
-        raise MetadataError(f"not a classic TIFF (magic {magic}; BigTIFF "
-                            "is not supported by the container readers)")
+    if magic == 42:
+        big = False
+        (off,) = struct.unpack_from(bo + "I", buf, 4)
+    elif magic == 43:
+        if len(buf) < 16:
+            raise MetadataError("truncated BigTIFF header")
+        osize, zero = struct.unpack_from(bo + "HH", buf, 4)
+        if osize != 8 or zero != 0:
+            raise MetadataError(
+                f"BigTIFF with unsupported offset size {osize}"
+            )
+        big = True
+        (off,) = struct.unpack_from(bo + "Q", buf, 8)
+    else:
+        raise MetadataError(f"not a TIFF (magic {magic})")
+    # per-format geometry: (IFD-count fmt, entry-count fmt, entry size,
+    # value-field offset within an entry, inline capacity, offset fmt)
+    nfmt, cfmt, esize, vfield, inline, off_fmt = (
+        ("Q", "Q", 20, 12, 8, "Q") if big else ("H", "I", 12, 8, 4, "I")
+    )
+    csize = struct.calcsize(nfmt)
     ifds: list[dict] = []
-    (off,) = struct.unpack_from(bo + "I", buf, 4)
     seen: set = set()
     while off and off not in seen and len(ifds) < 65535:
         seen.add(off)
-        if off + 2 > len(buf):
+        if off + csize > len(buf):
             break
-        (n,) = struct.unpack_from(bo + "H", buf, off)
-        p = off + 2
-        if p + 12 * n + 4 > len(buf):
+        (n,) = struct.unpack_from(bo + nfmt, buf, off)
+        p = off + csize
+        nextsize = struct.calcsize(off_fmt)
+        if n > (len(buf) - p) // esize or p + esize * n + nextsize > len(buf):
             break
         entries: dict = {}
         for _ in range(n):
-            tag, typ, cnt = struct.unpack_from(bo + "HHI", buf, p)
-            entries[tag] = (typ, cnt, p + 8)
-            p += 12
+            tag, typ = struct.unpack_from(bo + "HH", buf, p)
+            (cnt,) = struct.unpack_from(bo + cfmt, buf, p + 4)
+            total = _TIFF_TYPE_SIZE.get(typ, 1) * cnt
+            if total <= inline:
+                voff = p + vfield
+            else:
+                (voff,) = struct.unpack_from(bo + off_fmt, buf, p + vfield)
+            entries[tag] = (typ, cnt, voff)
+            p += esize
         ifds.append(entries)
-        (off,) = struct.unpack_from(bo + "I", buf, p)
+        (off,) = struct.unpack_from(bo + off_fmt, buf, p)
     if not ifds:
         raise MetadataError("TIFF contains no parseable IFD")
     return bo, ifds
 
 
 def _tiff_value_offset(bo: str, buf, entry) -> int:
-    """Offset of an entry's value data (inline when it fits in 4 bytes)."""
-    import struct
-
-    typ, cnt, voff = entry
-    total = _TIFF_TYPE_SIZE.get(typ, 1) * cnt
-    if total <= 4:
-        return voff
-    (off,) = struct.unpack_from(bo + "I", buf, voff)
-    return off
+    """Offset of an entry's value data (already resolved at parse time:
+    inline when it fit the entry's value field, dereferenced otherwise)."""
+    return entry[2]
 
 
 def _tiff_ints(bo: str, buf, entry, limit: "int | None" = None) -> list[int]:
-    """Integer values of a BYTE/SHORT/LONG entry."""
+    """Integer values of a BYTE/SHORT/LONG/LONG8 entry."""
     import struct
 
     typ, cnt, _ = entry
-    fmt = {1: "B", 3: "H", 4: "I"}.get(typ)
+    fmt = {1: "B", 3: "H", 4: "I", 16: "Q"}.get(typ)
     if fmt is None:
         return []
     if limit is not None:
@@ -1871,6 +1978,17 @@ def _decode_strip(chunk: bytes, compression: int, expect: int,
         from tmlibrary_tpu.native import lzw_decode
 
         out = lzw_decode(chunk, expect)
+    elif compression in (8, 32946):
+        # Adobe deflate (8) and the old deflate id (32946): one zlib
+        # stream per strip.  max_length bounds the expansion — a crafted
+        # strip must fail the size check, not OOM the ingest job
+        import zlib
+
+        try:
+            raw = zlib.decompressobj().decompress(chunk, expect)
+        except zlib.error:
+            raw = None
+        out = raw if raw is not None and len(raw) >= expect else None
     elif compression == 32773:
         from tmlibrary_tpu.native import packbits_decode
 
@@ -2284,14 +2402,13 @@ def _decode_ifd_plane(bo, buf, ifd, width, height, dtype, filename) -> np.ndarra
     return _apply_predictor(plane, predictor)
 
 
-def _tiff_single_plane(buf, filename) -> np.ndarray:
-    """Decode IFD 0 of a single-plane grayscale TIFF held in ``buf``
-    (bytes/mmap) — the payload format of Olympus plane files, shared by
-    the on-disk ``.oif.files`` TIFFs and the in-memory OIB streams."""
+def _gray_ifd_plane(bo, buf, ifd, filename, what) -> np.ndarray:
+    """Validate one IFD as 8/16-bit single-sample grayscale and strip-
+    decode it — the ONE guard+decode body shared by the Olympus plane
+    path and the plain-TIFF Python fallback (``what`` names the caller's
+    format in the error)."""
     from tmlibrary_tpu.errors import MetadataError, NotSupportedError
 
-    bo, ifds = _tiff_parse(buf)
-    ifd = ifds[0]
     width = _tiff_int(bo, buf, ifd, 256, 0)
     height = _tiff_int(bo, buf, ifd, 257, 0)
     bits = _tiff_int(bo, buf, ifd, 258, 8)
@@ -2300,11 +2417,20 @@ def _tiff_single_plane(buf, filename) -> np.ndarray:
         raise MetadataError(f"corrupt TIFF dimensions in {filename}")
     if bits not in (8, 16) or samples != 1:
         raise NotSupportedError(
-            f"Olympus plane TIFFs are 8/16-bit grayscale; got {bits}-bit "
+            f"{what} are 8/16-bit grayscale; got {bits}-bit "
             f"x{samples} in {filename}"
         )
     dtype = np.dtype(bo + ("u1" if bits == 8 else "u2"))
     return _decode_ifd_plane(bo, buf, ifd, width, height, dtype, filename)
+
+
+def _tiff_single_plane(buf, filename) -> np.ndarray:
+    """Decode IFD 0 of a single-plane grayscale TIFF held in ``buf``
+    (bytes/mmap) — the payload format of Olympus plane files, shared by
+    the on-disk ``.oif.files`` TIFFs and the in-memory OIB streams."""
+    bo, ifds = _tiff_parse(buf)
+    return _gray_ifd_plane(bo, buf, ifds[0], filename,
+                           "Olympus plane TIFFs")
 
 
 def _parse_oif_channel_names(text: str) -> "list[str] | None":

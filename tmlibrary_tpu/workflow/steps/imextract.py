@@ -36,11 +36,12 @@ class ImageExtractor(Step):
     @staticmethod
     def _read_plane(path: str, page: int | None, height: int, width: int):
         """One grayscale plane as uint16: first-party native TIFF reader
-        (classic strip TIFF, none/LZW/PackBits — the native data-loader),
-        the first-party ND2 chunk-map reader for ``.nd2`` containers
+        (classic strip TIFF, none/LZW/PackBits — the native data-loader)
+        with the Python paged fallback (BigTIFF, deflate strips), the
+        first-party ND2 chunk-map reader for ``.nd2`` containers
         (``page`` encodes sequence * n_components + component, as written
         by the nd2 metaconfig handler), cv2 for everything else (PNG,
-        tiled/BigTIFF, RGB, ...)."""
+        tiled TIFF, RGB, ...)."""
         from tmlibrary_tpu.readers import read_container_plane
 
         container = read_container_plane(path, page or 0)
@@ -52,6 +53,13 @@ class ImageExtractor(Step):
         img = tiff_read(path, page or 0, height, width)
         if img is not None:
             return img
+
+        if path.lower().endswith((".tif", ".tiff")):
+            from tmlibrary_tpu.readers import read_tiff_page_py
+
+            img = read_tiff_page_py(path, page or 0)
+            if img is not None:
+                return img
 
         import cv2
 
