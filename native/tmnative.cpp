@@ -18,6 +18,7 @@
 #include <cstdint>
 #include <array>
 #include <cstring>
+#include <limits>
 #include <utility>
 #include <vector>
 
@@ -1001,6 +1002,74 @@ int32_t tm_watershed_levels3d(const float* intensity, const int32_t* seeds,
   wsnative::watershed_levels_impl(intensity, seeds, mask, n,
                                   wsnative::Geo3{nz, h, w},
                                   levels, n_levels, out);
+  return 0;
+}
+
+// Per-label intensity accumulators over a (possibly plate-scale) label
+// mosaic in ONE pass: sum, sum-of-squares (float64 accumulation, exactly
+// matching the numpy float64 bincount twin), min, max.  Arrays are sized
+// count + 1 with index 0 = background.  Returns 0, or -1 on bad args /
+// a label outside [0, count] (corrupt input must not scribble memory).
+int32_t tm_mosaic_intensity(const int32_t* labels, const float* vals,
+                            int64_t n, int32_t count, double* sum_out,
+                            double* sq_out, double* min_out,
+                            double* max_out) {
+  if (!labels || !vals || !sum_out || !sq_out || !min_out || !max_out ||
+      n < 0 || count < 0)
+    return -1;
+  const double inf = std::numeric_limits<double>::infinity();
+  for (int32_t k = 0; k <= count; ++k) {
+    sum_out[k] = 0.0;
+    sq_out[k] = 0.0;
+    min_out[k] = inf;
+    max_out[k] = -inf;
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t l = labels[i];
+    if (l < 0 || l > count) return -1;
+    const double v = static_cast<double>(vals[i]);
+    sum_out[l] += v;
+    sq_out[l] += v * v;
+    if (v < min_out[l]) min_out[l] = v;
+    if (v > max_out[l]) max_out[l] = v;
+  }
+  return 0;
+}
+
+// Per-label morphology accumulators over a label mosaic in ONE pass:
+// pixel area, centroid sums, and bounding boxes.  Arrays sized count + 1
+// (index 0 = background); ymin/xmin start at h/w and ymax/xmax at -1 so
+// absent labels keep the numpy twin's sentinels.  Returns 0 / -1.
+int32_t tm_mosaic_morph(const int32_t* labels, int32_t h, int32_t w,
+                        int32_t count, int64_t* area_out, double* cy_out,
+                        double* cx_out, int64_t* ymin_out, int64_t* ymax_out,
+                        int64_t* xmin_out, int64_t* xmax_out) {
+  if (!labels || !area_out || !cy_out || !cx_out || !ymin_out || !ymax_out ||
+      !xmin_out || !xmax_out || h <= 0 || w <= 0 || count < 0)
+    return -1;
+  for (int32_t k = 0; k <= count; ++k) {
+    area_out[k] = 0;
+    cy_out[k] = 0.0;
+    cx_out[k] = 0.0;
+    ymin_out[k] = h;
+    ymax_out[k] = -1;
+    xmin_out[k] = w;
+    xmax_out[k] = -1;
+  }
+  for (int32_t y = 0; y < h; ++y) {
+    const int32_t* row = labels + static_cast<int64_t>(y) * w;
+    for (int32_t x = 0; x < w; ++x) {
+      const int32_t l = row[x];
+      if (l < 0 || l > count) return -1;
+      area_out[l] += 1;
+      cy_out[l] += y;
+      cx_out[l] += x;
+      if (y < ymin_out[l]) ymin_out[l] = y;
+      if (y > ymax_out[l]) ymax_out[l] = y;
+      if (x < xmin_out[l]) xmin_out[l] = x;
+      if (x > xmax_out[l]) xmax_out[l] = x;
+    }
+  }
   return 0;
 }
 

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Communication budget of the sharded programs, from their compiled HLO.
+
+Round-3 VERDICT next-step #4b: the "87x if linear" extrapolation needs
+an argument, not a hope.  This script compiles each of the framework's
+sharded programs over an 8-virtual-CPU-device mesh (the same GSPMD
+partitioning a pod would get), walks the optimized HLO for collective
+ops, and prints bytes-moved-per-batch per collective.  With
+``--write-doc`` it re-renders the marked section of docs/DISTRIBUTED.md.
+
+Byte counts are the summed output shapes of collective instructions —
+the payload a chip contributes per executed program, the right order of
+magnitude for an ICI budget (actual wire traffic depends on the
+algorithm XLA picks per topology).
+"""
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_DEV = int(os.environ.get("BUDGET_DEVICES", "8"))
+
+#: optimized-HLO opcodes that move data between devices
+_COLLECTIVES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-permute",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2, "f16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def _force_devices(n: int) -> None:
+    import jax
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n}"
+    )
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+
+
+def _shape_bytes(shapes: str) -> int:
+    """Total bytes of every typed shape in an HLO result declaration
+    (tuples contribute each element)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shapes):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue
+        numel = 1
+        for d in filter(None, dims.split(",")):
+            numel *= int(d)
+        total += numel * size
+    return total
+
+
+def collective_budget(hlo_text: str) -> dict:
+    """{opcode: {"count": n, "bytes": total_output_bytes}} over one
+    executed program."""
+    out: dict = {}
+    for line in hlo_text.splitlines():
+        stripped = line.strip()
+        # instruction lines look like:  %name = f32[2,64]{1,0} all-gather(...)
+        m = re.match(r"%?[\w.\-]+ = (.+?) ([\w\-]+)\(", stripped)
+        if not m:
+            continue
+        shapes, op = m.groups()
+        base = op.rstrip(".0123456789")
+        if base.endswith("-start"):
+            base = base[: -len("-start")]
+        if base not in _COLLECTIVES:
+            continue
+        slot = out.setdefault(base, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += _shape_bytes(shapes)
+    return out
+
+
+def _programs():
+    """(name, workload description, compiled) for each sharded program,
+    on tiny-but-representative shapes (bytes scale with the noted
+    workload fields)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    from tmlibrary_tpu.benchmarks import (
+        cell_painting_description,
+        synthetic_cell_painting_batch,
+    )
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+    from tmlibrary_tpu.parallel.mesh import site_mesh
+
+    devs = jax.devices()[:N_DEV]
+    mesh = site_mesh(N_DEV)
+    batch, size = 2 * N_DEV, 64
+
+    # 1a. jterator batch via GSPMD-through-vmap (what naive sharding
+    # gets: the vmapped while loops force batch all-gathers every trip)
+    pipe = ImageAnalysisPipeline(cell_painting_description(), max_objects=16)
+    fn = pipe.build_batch_fn(jit=False)
+    data = synthetic_cell_painting_batch(batch, size=size, n_cells=4)
+    shard = NamedSharding(mesh, PartitionSpec("sites"))
+    raw = {k: jax.device_put(jnp.asarray(v), shard) for k, v in data.items()}
+    shifts = jax.device_put(jnp.zeros((batch, 2), jnp.int32), shard)
+    yield (
+        "jterator batch, GSPMD-through-vmap",
+        f"batch={batch} sites of {size}x{size}, 2ch",
+        jax.jit(fn).lower(raw, {}, shifts).compile(),
+    )
+
+    # 1b. the production multi-chip path: shard_map keeps every while
+    # loop device-local — expected budget: ZERO collectives
+    yield (
+        "jterator batch, shard_map (production)",
+        f"batch={batch} sites of {size}x{size}, 2ch",
+        pipe.build_sharded_batch_fn(mesh).lower(raw, {}, shifts).compile(),
+    )
+
+    # 2. corilla cross-shard Welford reduction
+    from tmlibrary_tpu.parallel.stats import sharded_channel_stats
+
+    import functools
+
+    stack = jax.device_put(
+        jnp.asarray(
+            np.abs(np.random.default_rng(0).normal(500, 50, (batch, size, size)))
+        ),
+        shard,
+    )
+    jitted = jax.jit(
+        functools.partial(sharded_channel_stats, mesh=mesh)
+    )
+    yield (
+        "corilla sharded Welford + histogram merge",
+        f"{batch} sites of {size}x{size}, one channel",
+        jitted.lower(stack).compile(),
+    )
+
+    # 3. distributed CC over a 1-D row-sharded mosaic (the inner
+    # shard_map program — the host wrapper only adds the overflow fetch)
+    from tmlibrary_tpu.parallel.label import _cc_1d_program
+
+    rows_mesh = Mesh(np.asarray(devs), ("rows",))
+    hm, wm = 16 * N_DEV, 128
+    mask = jax.device_put(
+        jnp.zeros((hm, wm), bool).at[:, 7].set(True),
+        NamedSharding(rows_mesh, PartitionSpec("rows")),
+    )
+    program = _cc_1d_program(
+        rows_mesh, hm // N_DEV, wm, 8, 4096, "rows"
+    )
+    yield (
+        "distributed CC (1-D row shards)",
+        f"{hm}x{wm} mosaic over {N_DEV} row shards",
+        jax.jit(program).lower(mask).compile(),
+    )
+
+    # 4. all_to_all reshard (site-parallel <-> spatial rows)
+    from tmlibrary_tpu.parallel.mesh import shard_batch
+    from tmlibrary_tpu.parallel.reshard import sites_to_rows
+
+    small = shard_batch(
+        jnp.asarray(
+            np.random.default_rng(1).normal(0, 1, (N_DEV, 8 * N_DEV, 32)),
+            jnp.float32,
+        ),
+        mesh,
+    )
+    jr = jax.jit(functools.partial(sites_to_rows, mesh=mesh))
+    yield (
+        "sites->rows all_to_all reshard",
+        f"({N_DEV}, {8 * N_DEV}, 32) f32 stack",
+        jr.lower(small).compile(),
+    )
+
+
+def main() -> int:
+    _force_devices(N_DEV)
+    rows = []
+    for item in _programs():
+        if item is None:
+            continue
+        name, workload, compiled = item
+        budget = collective_budget(compiled.as_text())
+        rows.append((name, workload, budget))
+
+    lines = [
+        f"Compiled over {N_DEV} virtual host devices (GSPMD partitioning "
+        "is topology-independent; byte counts are per executed batch "
+        "program, summed collective OUTPUT shapes).",
+        "",
+        "| program | workload | collective | ops | bytes/batch |",
+        "|---|---|---|---|---|",
+    ]
+    for name, workload, budget in rows:
+        if not budget:
+            lines.append(f"| {name} | {workload} | — none — | 0 | 0 |")
+        for op, slot in sorted(budget.items()):
+            lines.append(
+                f"| {name} | {workload} | {op} | {slot['count']} "
+                f"| {slot['bytes']:,} |"
+            )
+    table = "\n".join(lines)
+    print(table)
+    print()
+    print(json.dumps(
+        {name: budget for name, _, budget in rows}, indent=2
+    ))
+
+    if "--write-doc" in sys.argv:
+        doc = os.path.join(REPO, "docs", "DISTRIBUTED.md")
+        begin = "<!-- COMM-BUDGET:BEGIN (generated by scripts/comm_budget.py) -->"
+        end = "<!-- COMM-BUDGET:END -->"
+        block = (
+            f"{begin}\n\n## Communication budget (auto-generated)\n\n"
+            f"{table}\n\n"
+            "Reading the table: naive GSPMD sharding of the vmapped "
+            "batch is NOT communication-free — the iterative ops "
+            "(CC/watershed/distance) are `while` loops under `vmap`, "
+            "and the partitioner synchronizes them across shards by "
+            "all-gathering the batch-sharded loop state every trip.  "
+            "The production multi-chip path "
+            "(`ImageAnalysisPipeline.build_sharded_batch_fn`, used by "
+            "`python bench.py --mesh` and the driver dryrun) wraps the "
+            "same program in `shard_map`, keeping every loop "
+            "device-local: its measured budget is ZERO collectives, so "
+            "per-chip throughput is communication-free by construction "
+            "and site sharding scales with chip count until ingest/IO "
+            "binds — this row is what BASELINE.md's linear-scaling "
+            "extrapolation rests on.  The Welford merge moves kilobytes "
+            "per CHANNEL (not per site), once per corilla reduction.  "
+            "Distributed CC's collective-permute traffic scales with "
+            "mosaic WIDTH (seam rows), not area; the all_to_all reshard "
+            "moves the full stack once per layout switch.\n\n"
+            f"{end}"
+        )
+        with open(doc) as f:
+            text = f.read()
+        head, _, rest = text.partition(begin)
+        if rest and end in rest:
+            _, _, tail = rest.partition(end)
+            text = head + block + tail
+        else:
+            text = text.rstrip() + "\n\n" + block + "\n"
+        with open(doc, "w") as f:
+            f.write(text)
+        print(f"wrote {doc}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,7 @@ BENCH_ITEMS = [
     ("volume", {"BENCH_CONFIG": "volume"}),
     ("2", {"BENCH_CONFIG": "2"}),
     ("pyramid", {"BENCH_CONFIG": "pyramid"}),
+    ("spatial", {"BENCH_CONFIG": "spatial"}),
 ]
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed
@@ -101,7 +102,12 @@ def bench_done(key: str) -> bool:
     # Stale records keep serving from bench.py until the successful
     # re-measure replaces them (run_bench_item only writes on success).
     rec = entry["record"]
-    if rec.get("pipeline_depth") != _tuned_pipeline_default():
+    # host-synchronous configs (record carries pipelined: false) have no
+    # depth to lag behind; everything else re-measures when the tuned
+    # pipeline depth supersedes the recorded one
+    if rec.get("pipelined") is not False and (
+        rec.get("pipeline_depth") != _tuned_pipeline_default()
+    ):
         return False
     config = rec.get("config")
     if config and "batch" in rec and rec["batch"] != _default_batch(

@@ -154,6 +154,36 @@ def test_rgb_and_tiled_fall_through(tmp_path, planes):
     assert read_tiff_page_py(path, 0) is None
 
 
+def test_parse_cache_detects_same_size_in_place_rewrite(tmp_path, planes):
+    """A same-size rewrite inside one mtime tick must not serve a stale
+    IFD parse: the validation key crcs the head/tail regions, which hold
+    every parse-relevant byte in this layout."""
+
+    def _entry_value_pos(buf, ifd_off, tag):
+        (n,) = struct.unpack_from("<Q", buf, ifd_off)
+        for i in range(n):
+            p = ifd_off + 8 + 20 * i
+            if struct.unpack_from("<H", buf, p)[0] == tag:
+                return p + 12
+        raise AssertionError(f"tag {tag} missing")
+
+    path = write_tiff(tmp_path / "c.tif", planes, big=True)
+    np.testing.assert_array_equal(read_tiff_page_py(path, 0), planes[0])
+
+    buf = bytearray(path.read_bytes())
+    (ifd0,) = struct.unpack_from("<Q", buf, 8)
+    (n,) = struct.unpack_from("<Q", buf, ifd0)
+    (ifd1,) = struct.unpack_from("<Q", buf, ifd0 + 8 + 20 * n)
+    v0 = _entry_value_pos(buf, ifd0, 273)
+    v1 = _entry_value_pos(buf, ifd1, 273)
+    (o0,) = struct.unpack_from("<Q", buf, v0)
+    (o1,) = struct.unpack_from("<Q", buf, v1)
+    struct.pack_into("<Q", buf, v0, o1)
+    struct.pack_into("<Q", buf, v1, o0)
+    path.write_bytes(bytes(buf))  # same size, possibly same mtime tick
+    np.testing.assert_array_equal(read_tiff_page_py(path, 0), planes[1])
+
+
 def test_fuzz_bigtiff_page_fallback(tmp_path, planes):
     """read_tiff_page_py's contract is narrower than the readers': it
     returns None (or a decoded array) on ANY input, never raises — a

@@ -303,3 +303,62 @@ def test_simplify_polygon_never_degenerate():
     # the kept vertices are a subset of the input ring
     in_set = {tuple(p) for p in ring.tolist()}
     assert all(tuple(p) in in_set for p in s.tolist())
+
+
+def test_mosaic_stats_native_matches_fallback_and_golden(rng):
+    """tm_mosaic_intensity / tm_mosaic_morph vs the chunked-numpy twins
+    vs direct per-label numpy — the spatial layout's feature
+    accumulators (one C pass instead of an O(H) interpreter loop)."""
+    from tmlibrary_tpu import native
+
+    labels = rng.integers(0, 7, (40, 55)).astype(np.int32)
+    labels[labels == 5] = 0  # absent id keeps sentinels
+    vals = rng.normal(500, 90, (40, 55)).astype(np.float32)
+    count = 8  # ids 7..8 absent too
+
+    s, q, mn, mx = native.mosaic_intensity_host(labels, vals, count)
+    s2, q2, mn2, mx2 = native._mosaic_intensity_py(labels, vals, count)
+    np.testing.assert_allclose(s, s2, rtol=1e-12)
+    np.testing.assert_allclose(q, q2, rtol=1e-12)
+    np.testing.assert_array_equal(mn, mn2)
+    np.testing.assert_array_equal(mx, mx2)
+
+    morph_n = native.mosaic_morph_host(labels, count)
+    morph_p = native._mosaic_morph_py(labels, count)
+    for got, want in zip(morph_n, morph_p):
+        np.testing.assert_array_equal(got, want)
+
+    v64 = vals.astype(np.float64)
+    area, cy, cx, ymin, ymax, xmin, xmax = morph_n
+    for l in range(count + 1):
+        sel = v64[labels == l]
+        if not len(sel):
+            assert s[l] == 0 and mn[l] == np.inf and mx[l] == -np.inf
+            assert area[l] == 0 and ymax[l] == -1 and xmin[l] == 55
+            continue
+        np.testing.assert_allclose(s[l], sel.sum(), rtol=1e-12)
+        np.testing.assert_allclose(q[l], (sel * sel).sum(), rtol=1e-12)
+        assert mn[l] == sel.min() and mx[l] == sel.max()
+        ys, xs = np.nonzero(labels == l)
+        assert area[l] == len(ys)
+        assert cy[l] == ys.sum() and cx[l] == xs.sum()
+        assert (ymin[l], ymax[l], xmin[l], xmax[l]) == (
+            ys.min(), ys.max(), xs.min(), xs.max())
+
+
+def test_mosaic_morph_fallback_chunks_on_wide_mosaics(rng):
+    """A mosaic wide enough to force multiple row blocks through the
+    fallback (rows_per = 4M // W) must agree with the native pass."""
+    from tmlibrary_tpu import native
+
+    w = (1 << 21) + 7  # rows_per == 1: every row is its own block
+    labels = np.zeros((3, w), np.int32)
+    labels[0, :100] = 1
+    labels[1, 50:200] = 2
+    labels[2, w - 5:] = 1
+    got = native._mosaic_morph_py(labels, 2)
+    want = native.mosaic_morph_host(labels, 2)
+    for g, x in zip(got, want):
+        np.testing.assert_array_equal(g, x)
+    area, cy, cx, ymin, ymax, xmin, xmax = got
+    assert area[1] == 105 and ymax[1] == 2 and xmax[1] == w - 1

@@ -106,6 +106,22 @@ def test_pending_tune_couples_pipeline_to_sweep(monkeypatch, tmp_path):
     assert "pipeline" in pending  # rerunning sweep invalidates pipeline
 
 
+def test_bench_done_exempts_unpipelined_records(monkeypatch, tmp_path):
+    """A host-synchronous config (spatial: pipelined=false, no depth)
+    must count as done — without the exemption the watcher would
+    re-measure it forever inside one window."""
+    rec = {"record": {
+        "metric": "m", "value": 1.0, "vs_baseline": 1.0, "backend": "axon",
+        "config": "spatial", "site_size": 256, "pipelined": False,
+    }, "measured_at": "t", "measured_at_unix": 1.0}
+    w = _watch(
+        monkeypatch, tmp_path,
+        cache={"records": {"spatial": rec}},
+        tuning={**MACHINE, "best_pipeline": 8, "best_batch": 64},
+    )
+    assert w.bench_done("spatial") is True
+
+
 def test_profile_done_tracks_tuned_defaults(monkeypatch, tmp_path):
     """The per-stage profile is re-captured whenever the tuned batch or
     pipeline depth it was measured at is superseded."""

@@ -749,3 +749,65 @@ def cpu_reference_pyramid(
         cur = cur.reshape(cur.shape[0] // 2, 2, cur.shape[1] // 2, 2).mean((1, 3))
         levels.append(stretch(cur))
     return levels
+
+
+# ------------------------------------------------------------ spatial config
+def synthetic_mosaic_well(
+    grid_y: int, grid_x: int, size: int = 256, cells_per_site: float = 8.0,
+    seed: int = 0,
+) -> tuple[np.ndarray, np.ndarray]:
+    """One well's mosaic with blobs scattered ACROSS site seams (the case
+    the spatial layout exists for), plus its site tiles.
+
+    Returns ``(mosaic (Hm, Wm) uint16, tiles (gy*gx, size, size) uint16)``
+    with tiles in row-major site order.
+    """
+    rng = np.random.default_rng(seed)
+    hm, wm = grid_y * size, grid_x * size
+    mosaic = rng.normal(300.0, 25.0, (hm, wm)).astype(np.float32)
+    n_cells = int(cells_per_site * grid_y * grid_x)
+    ys = rng.uniform(4, hm - 4, n_cells)
+    xs = rng.uniform(4, wm - 4, n_cells)
+    rr = rng.uniform(3.5, 5.5, n_cells)
+    # local splats only: a full (Hm, Wm) gaussian per cell would make the
+    # generator quadratic in mosaic area
+    for y, x, r in zip(ys, xs, rr):
+        rad = int(4 * r)
+        y0, y1 = max(0, int(y) - rad), min(hm, int(y) + rad + 1)
+        x0, x1 = max(0, int(x) - rad), min(wm, int(x) + rad + 1)
+        yy, xx = np.mgrid[y0:y1, x0:x1].astype(np.float32)
+        mosaic[y0:y1, x0:x1] += 4000.0 * np.exp(
+            -((yy - y) ** 2 + (xx - x) ** 2) / (2 * r**2)
+        )
+    mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+    tiles = (
+        mosaic.reshape(grid_y, size, grid_x, size)
+        .transpose(0, 2, 1, 3)
+        .reshape(grid_y * grid_x, size, size)
+    )
+    return mosaic, np.ascontiguousarray(tiles)
+
+
+def cpu_reference_mosaic(mosaic: np.ndarray) -> int:
+    """Single-threaded scipy twin of the spatial-layout chain on one
+    stitched mosaic: smooth -> otsu -> 8-connected global label ->
+    per-object morphology (area/centroid/bbox) + intensity stats
+    (mean/std/min/max/sum).  The denominator for BENCH_CONFIG=spatial."""
+    import scipy.ndimage as ndi
+
+    img = mosaic.astype(np.float32)
+    sm = ndi.gaussian_filter(img, 1.5, mode="reflect")
+    t = _otsu_numpy(sm)
+    labels, n = ndi.label(sm > t, ndi.generate_binary_structure(2, 2))
+    if n:
+        ids = np.arange(1, n + 1)
+        np.bincount(labels.ravel())
+        ndi.center_of_mass(np.ones_like(labels), labels, ids)
+        ndi.find_objects(labels)
+        img64 = img.astype(np.float64)
+        ndi.mean(img64, labels, ids)
+        ndi.standard_deviation(img64, labels, ids)
+        ndi.minimum(img64, labels, ids)
+        ndi.maximum(img64, labels, ids)
+        ndi.sum(img64, labels, ids)
+    return n

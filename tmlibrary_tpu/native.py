@@ -151,6 +151,24 @@ def _load_locked():
             "rebuild native/"
         )
     try:
+        _d = ctypes.POINTER(ctypes.c_double)
+        _i64 = ctypes.POINTER(ctypes.c_int64)
+        lib.tm_mosaic_intensity.restype = ctypes.c_int32
+        lib.tm_mosaic_intensity.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64, ctypes.c_int32, _d, _d, _d, _d,
+        ]
+        lib.tm_mosaic_morph.restype = ctypes.c_int32
+        lib.tm_mosaic_morph.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, _i64, _d, _d, _i64, _i64, _i64, _i64,
+        ]
+    except AttributeError:
+        logger.info(
+            "native library predates the mosaic stats kernels; "
+            "rebuild native/"
+        )
+    try:
         lib.tm_cc_label3d.restype = ctypes.c_int32
         lib.tm_cc_label3d.argtypes = [
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32, ctypes.c_int32,
@@ -718,3 +736,112 @@ def watershed_levels3d_host(
     if rc != 0:
         raise ValueError("tm_watershed_levels3d: invalid arguments")
     return out
+
+
+def _mosaic_intensity_py(labels: np.ndarray, vals: np.ndarray, count: int):
+    """Chunked-vectorized twin of ``tm_mosaic_intensity``: whole row
+    blocks per bincount (a handful of interpreter iterations on a
+    plate-scale mosaic, not O(H)) with float64 accumulation and
+    O(chunk + count) transients."""
+    i_sum = np.zeros(count + 1)
+    i_sq = np.zeros(count + 1)
+    i_min = np.full(count + 1, np.inf)
+    i_max = np.full(count + 1, -np.inf)
+    flat_l = labels.reshape(-1)
+    flat_v = vals.reshape(-1)
+    step = 1 << 22  # ~4M pixels per block bounds the float64 transients
+    for start in range(0, flat_l.size, step):
+        ll = flat_l[start:start + step]
+        vv = flat_v[start:start + step].astype(np.float64)
+        i_sum += np.bincount(ll, weights=vv, minlength=count + 1)
+        i_sq += np.bincount(ll, weights=vv * vv, minlength=count + 1)
+        np.minimum.at(i_min, ll, vv)
+        np.maximum.at(i_max, ll, vv)
+    return i_sum, i_sq, i_min, i_max
+
+
+def mosaic_intensity_host(labels: np.ndarray, vals: np.ndarray, count: int):
+    """Per-label intensity accumulators over a label mosaic:
+    ``(sum, sq_sum, min, max)``, each ``(count + 1,)`` float64 with
+    index 0 = background (included in every accumulator; callers slice
+    ``[1:]``).  One native C pass, chunked-numpy fallback."""
+    labels32 = np.ascontiguousarray(labels, np.int32)
+    vals32 = np.ascontiguousarray(vals, np.float32)
+    lib = _load()
+    if lib is not None and hasattr(lib, "tm_mosaic_intensity"):
+        s = np.empty(count + 1)
+        q = np.empty(count + 1)
+        mn = np.empty(count + 1)
+        mx = np.empty(count + 1)
+        dp = ctypes.POINTER(ctypes.c_double)
+        rc = lib.tm_mosaic_intensity(
+            labels32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals32.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels32.size, count,
+            s.ctypes.data_as(dp), q.ctypes.data_as(dp),
+            mn.ctypes.data_as(dp), mx.ctypes.data_as(dp),
+        )
+        if rc == 0:
+            return s, q, mn, mx
+    return _mosaic_intensity_py(labels32, vals32, count)
+
+
+def _mosaic_morph_py(labels: np.ndarray, count: int):
+    """Chunked-vectorized twin of ``tm_mosaic_morph``."""
+    h, w = labels.shape
+    area = np.zeros(count + 1, np.int64)
+    cy = np.zeros(count + 1)
+    cx = np.zeros(count + 1)
+    ymin = np.full(count + 1, h, np.int64)
+    ymax = np.full(count + 1, -1, np.int64)
+    xmin = np.full(count + 1, w, np.int64)
+    xmax = np.full(count + 1, -1, np.int64)
+    rows_per = max(1, (1 << 22) // max(w, 1))
+    for y0 in range(0, h, rows_per):
+        block = labels[y0:y0 + rows_per]
+        hb = block.shape[0]
+        flat = block.reshape(-1)
+        area += np.bincount(flat, minlength=count + 1).astype(np.int64)
+        yi = np.repeat(np.arange(y0, y0 + hb, dtype=np.int64), w)
+        xi = np.tile(np.arange(w, dtype=np.int64), hb)
+        cy += np.bincount(flat, weights=yi.astype(np.float64),
+                          minlength=count + 1)
+        cx += np.bincount(flat, weights=xi.astype(np.float64),
+                          minlength=count + 1)
+        np.minimum.at(ymin, flat, yi)
+        np.maximum.at(ymax, flat, yi)
+        np.minimum.at(xmin, flat, xi)
+        np.maximum.at(xmax, flat, xi)
+    return area, cy, cx, ymin, ymax, xmin, xmax
+
+
+def mosaic_morph_host(labels: np.ndarray, count: int):
+    """Per-label morphology accumulators over a label mosaic:
+    ``(area, cy_sum, cx_sum, ymin, ymax, xmin, xmax)``, each
+    ``(count + 1,)`` (index 0 = background; absent labels keep the
+    ``h/-1/w/-1`` bbox sentinels).  One native C pass, chunked-numpy
+    fallback."""
+    labels32 = np.ascontiguousarray(labels, np.int32)
+    h, w = labels32.shape
+    lib = _load()
+    if lib is not None and hasattr(lib, "tm_mosaic_morph"):
+        area = np.empty(count + 1, np.int64)
+        cy = np.empty(count + 1)
+        cx = np.empty(count + 1)
+        ymin = np.empty(count + 1, np.int64)
+        ymax = np.empty(count + 1, np.int64)
+        xmin = np.empty(count + 1, np.int64)
+        xmax = np.empty(count + 1, np.int64)
+        dp = ctypes.POINTER(ctypes.c_double)
+        ip = ctypes.POINTER(ctypes.c_int64)
+        rc = lib.tm_mosaic_morph(
+            labels32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            h, w, count,
+            area.ctypes.data_as(ip), cy.ctypes.data_as(dp),
+            cx.ctypes.data_as(dp), ymin.ctypes.data_as(ip),
+            ymax.ctypes.data_as(ip), xmin.ctypes.data_as(ip),
+            xmax.ctypes.data_as(ip),
+        )
+        if rc == 0:
+            return area, cy, cx, ymin, ymax, xmin, xmax
+    return _mosaic_morph_py(labels32, count)

@@ -97,6 +97,22 @@ def distributed_connected_components(
         raise ValueError("connectivity must be 4 or 8")
     rows = h // n
     k = max_roots_per_shard
+    mapped = _cc_1d_program(mesh, rows, w, connectivity, k, axis)
+    sharded = jax.device_put(mask, NamedSharding(mesh, PartitionSpec(axis)))
+    labels, count, overflow = jax.jit(mapped)(sharded)
+    max_local = int(overflow)
+    if max_local > k:
+        raise ShardingError(
+            f"a shard holds {max_local} components > "
+            f"max_roots_per_shard={k}; raise the bound"
+        )
+    return labels, count
+
+
+def _cc_1d_program(mesh, rows, w, connectivity, k, axis):
+    """The jittable shard_map program behind
+    :func:`distributed_connected_components` — split out so tooling
+    (scripts/comm_budget.py) can lower and inspect its HLO."""
 
     def body(block):
         idx = lax.axis_index(axis)
@@ -135,7 +151,7 @@ def distributed_connected_components(
         overflow = lax.pmax(n_local, axis)
         return out, count, overflow
 
-    mapped = jax.shard_map(
+    return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(axis),
@@ -145,15 +161,6 @@ def distributed_connected_components(
             PartitionSpec(),
         ),
     )
-    sharded = jax.device_put(mask, NamedSharding(mesh, PartitionSpec(axis)))
-    labels, count, overflow = jax.jit(mapped)(sharded)
-    max_local = int(overflow)
-    if max_local > k:
-        raise ShardingError(
-            f"a shard holds {max_local} components > "
-            f"max_roots_per_shard={k}; raise the bound"
-        )
-    return labels, count
 
 
 def _edge_extend(vec_lab, vec_msk, other_axis):

@@ -176,14 +176,37 @@ def container_dimensions(path) -> tuple[int, int] | None:
         r.__exit__()
 
 
-#: (path, mtime_ns, size) -> (byteorder, ifds) — the value offsets in
+#: path -> (validation_key, (byteorder, ifds)) — the value offsets in
 #: the parsed entries are plain ints, independent of any open buffer, so
-#: the parse survives across per-plane re-opens.  Bounded FIFO: without
-#: it, imextract's per-plane loop re-walks every IFD of a multi-page
-#: stack for every plane (the O(planes^2) work the reader cache exists
-#: to prevent).
-_TIFF_PY_PARSE_CACHE: "dict[tuple, tuple[str, list]]" = {}
-_TIFF_PY_PARSE_CACHE_MAX = 8
+#: the parse survives across per-plane re-opens.  Bounded per-path LRU
+#: (capacity >= imextract's default batch grouping, which cycles page 0
+#: of every file before page 1): without it, the per-plane loop re-walks
+#: every IFD of a multi-page stack for every plane — O(planes^2).
+#: Accessed from imextract's decode thread pool, so all dict mutation
+#: sits under the lock.
+import collections as _collections
+import threading as _threading
+
+_TIFF_PY_PARSE_CACHE: "_collections.OrderedDict[str, tuple]" = (
+    _collections.OrderedDict()
+)
+_TIFF_PY_PARSE_CACHE_MAX = 64
+_TIFF_PY_PARSE_LOCK = _threading.Lock()
+
+
+def _tiff_parse_validation_key(m, st) -> tuple:
+    """Freshness key for a cached parse: stat identity PLUS a crc of the
+    head and tail regions.  mtime alone misses same-size in-place
+    rewrites inside one filesystem timestamp tick; the crcs cover the
+    byte ranges a parse depends on (header at the head, IFD chains at
+    the head or tail in every layout this fallback decodes)."""
+    import zlib
+
+    n = len(m)
+    span = 1 << 13
+    head = zlib.crc32(m[:span])
+    tail = zlib.crc32(m[max(0, n - span):]) if n > span else 0
+    return (st.st_mtime_ns, st.st_size, st.st_ino, head, tail)
 
 
 def read_tiff_page_py(path, page: int) -> "np.ndarray | None":
@@ -203,14 +226,23 @@ def read_tiff_page_py(path, page: int) -> "np.ndarray | None":
             f.fileno(), 0, access=mmap.ACCESS_READ
         ) as m:
             st = os.fstat(f.fileno())
-            key = (str(path), st.st_mtime_ns, st.st_size)
-            hit = _TIFF_PY_PARSE_CACHE.get(key)
+            key = _tiff_parse_validation_key(m, st)
+            spath = str(path)
+            with _TIFF_PY_PARSE_LOCK:
+                entry = _TIFF_PY_PARSE_CACHE.get(spath)
+                if entry is not None and entry[0] == key:
+                    _TIFF_PY_PARSE_CACHE.move_to_end(spath)
+                    hit = entry[1]
+                else:
+                    hit = None
             if hit is None:
-                hit = _tiff_parse(m)
-                while len(_TIFF_PY_PARSE_CACHE) >= _TIFF_PY_PARSE_CACHE_MAX:
-                    _TIFF_PY_PARSE_CACHE.pop(
-                        next(iter(_TIFF_PY_PARSE_CACHE)))
-                _TIFF_PY_PARSE_CACHE[key] = hit
+                hit = _tiff_parse(m)  # outside the lock: parse is pure
+                with _TIFF_PY_PARSE_LOCK:
+                    _TIFF_PY_PARSE_CACHE[spath] = (key, hit)
+                    _TIFF_PY_PARSE_CACHE.move_to_end(spath)
+                    while (len(_TIFF_PY_PARSE_CACHE)
+                           > _TIFF_PY_PARSE_CACHE_MAX):
+                        _TIFF_PY_PARSE_CACHE.popitem(last=False)
             bo, ifds = hit
             if not 0 <= page < len(ifds):
                 return None
