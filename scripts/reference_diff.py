@@ -1,0 +1,487 @@
+#!/usr/bin/env python
+"""Reference-arrival harness (round-3 VERDICT next-step #5).
+
+`/root/reference` has been empty since the survey (SURVEY.md §0).  The
+moment it is populated, this script turns the acceptance gate
+(BASELINE.json: "bit-identical object counts vs the reference modules")
+into one command:
+
+    python scripts/reference_diff.py freeze   # once, from THIS framework
+    python scripts/reference_diff.py check    # whenever a reference exists
+
+``freeze`` runs the Cell Painting chain on frozen synthetic inputs and
+ships the inputs + this framework's outputs as golden fixtures under
+``tests/golden/`` (committed).  ``check``:
+
+1. inventories the reference tree against SURVEY §2/§3's component map
+   (the §0 re-verification protocol, step 1-2);
+2. locates the reference's jtmodules (segment_primary, segment_secondary,
+   smooth/threshold/fill/label fallback chain, measure_intensity) and
+   runs them on the frozen inputs via a signature-introspecting binder —
+   module APIs are [M]-confidence, so every binding failure is reported,
+   never swallowed;
+3. diffs object counts (THE gate), label images (agreement %, exact where
+   the masks coincide), and per-object mean intensities vs the goldens.
+
+Output: human summary + ``REFDIFF.json``.  Exit codes: 0 gate passed,
+1 mismatch/failure, 2 reference tree absent or empty.
+
+Tested against a mock reference tree: ``tests/test_reference_diff.py``.
+"""
+from __future__ import annotations
+
+import inspect
+import importlib.util
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+GOLDEN = REPO / "tests" / "golden"
+DEFAULT_REFERENCE = Path("/root/reference")
+OUT_PATH = REPO / "REFDIFF.json"
+
+#: SURVEY §2/§3 inventory: (component, path glob under the reference
+#: root, names to grep).  Confidence [M] — rows that fail to match are
+#: reported as survey drift, not fatal.
+INVENTORY = [
+    ("config", "**/tmlib/config.py", ["LibraryConfig"]),
+    ("log", "**/tmlib/log.py", ["configure_logging"]),
+    ("errors", "**/tmlib/errors.py", ["MetadataError", "PipelineError"]),
+    ("utils", "**/tmlib/utils.py", ["create_partitions"]),
+    ("image classes", "**/tmlib/image.py",
+     ["ChannelImage", "SegmentationImage", "IllumstatsContainer"]),
+    ("metadata", "**/tmlib/metadata.py", ["ChannelImageMetadata"]),
+    ("readers", "**/tmlib/readers.py", ["ImageReader", "BFImageReader"]),
+    ("writers", "**/tmlib/writers.py", ["ImageWriter"]),
+    ("ORM base", "**/tmlib/models/base.py", ["ExperimentModel"]),
+    ("experiment models", "**/tmlib/models/experiment.py", ["Experiment"]),
+    ("file models", "**/tmlib/models/file.py", ["ChannelImageFile"]),
+    ("mapobjects", "**/tmlib/models/mapobject.py",
+     ["Mapobject", "MapobjectSegmentation"]),
+    ("feature models", "**/tmlib/models/feature.py", ["FeatureValues"]),
+    ("workflow engine", "**/tmlib/workflow/workflow.py",
+     ["Workflow", "WorkflowStep"]),
+    ("workflow jobs", "**/tmlib/workflow/jobs.py", ["RunJob"]),
+    ("step API base", "**/tmlib/workflow/api.py", ["create_run_batches"]),
+    ("args system", "**/tmlib/workflow/args.py", ["Argument"]),
+    ("CLI base", "**/tmlib/workflow/cli.py", ["CommandLineInterface"]),
+    ("metaconfig", "**/tmlib/workflow/metaconfig/*.py", ["MetadataHandler"]),
+    ("imextract", "**/tmlib/workflow/imextract/api.py", ["ImageExtractor"]),
+    ("corilla", "**/tmlib/workflow/corilla/*.py", ["OnlineStatistics"]),
+    ("align", "**/tmlib/workflow/align/*.py", ["registration"]),
+    ("illuminati", "**/tmlib/workflow/illuminati/api.py", ["PyramidBuilder"]),
+    ("jterator api", "**/tmlib/workflow/jterator/api.py",
+     ["ImageAnalysisPipeline"]),
+    ("jterator handles", "**/tmlib/workflow/jterator/handles.py",
+     ["SegmentedObjects"]),
+    ("jtmodules", "**/jtmodules/*.py",
+     ["segment_primary", "segment_secondary", "measure_intensity"]),
+    ("tools", "**/tmlib/tools/*.py", ["Tool"]),
+]
+
+#: candidate parameter names the binder can satisfy per fixture value
+_PARAM_SOURCES = {
+    "dapi": ("image", "input_image", "intensity_image", "img", "DAPI"),
+    "actin": ("intensity_image", "image", "channel", "Actin"),
+    "labels": ("label_image", "labels", "labeled_image", "input_label_image",
+               "objects", "mask", "nuclei"),
+    "mask": ("mask", "binary_image", "image"),
+}
+
+#: output attribute names, in preference order, per expected kind
+_OUTPUT_NAMES = {
+    "label": ("label_image", "objects", "labeled_image", "output_label_image",
+              "nuclei", "cells"),
+    "mask": ("mask", "binary_image", "thresholded_image", "output_mask"),
+    "image": ("smoothed_image", "filtered_image", "output_image", "image"),
+    "measurement": ("measurements", "values", "features"),
+}
+
+
+def load_module(py_path: Path):
+    spec = importlib.util.spec_from_file_location(
+        f"refmod_{py_path.stem}", py_path
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def find_module(root: Path, name: str) -> "Path | None":
+    hits = sorted(root.glob(f"**/{name}.py"))
+    # prefer paths under a jtmodules/ directory
+    for h in hits:
+        if "jtmodules" in h.parts:
+            return h
+    return hits[0] if hits else None
+
+
+def bind_and_run(py_path: Path, available: dict) -> dict:
+    """Import a reference module and call ``main`` with arguments bound
+    by parameter name from ``available`` (fixture kinds -> arrays).
+    Returns {"outputs": {name: value}, "bound": {...}} or {"error": ...}."""
+    import numpy as np
+
+    try:
+        mod = load_module(py_path)
+        main = getattr(mod, "main")
+    except Exception as exc:  # noqa: BLE001 — report, never crash the harness
+        return {"error": f"import failed: {type(exc).__name__}: {exc}"}
+    try:
+        sig = inspect.signature(main)
+    except (TypeError, ValueError) as exc:
+        return {"error": f"uninspectable main(): {exc}"}
+
+    by_param: dict = {}
+    for kind, value in available.items():
+        for cand in _PARAM_SOURCES.get(kind, (kind,)):
+            if cand in sig.parameters and cand not in by_param:
+                by_param[cand] = value
+                break
+    kwargs = {}
+    for pname, param in sig.parameters.items():
+        if pname in by_param:
+            kwargs[pname] = by_param[pname]
+        elif pname == "plot":
+            kwargs[pname] = False
+        elif param.default is not inspect.Parameter.empty:
+            continue  # module default
+        elif param.kind in (inspect.Parameter.VAR_POSITIONAL,
+                            inspect.Parameter.VAR_KEYWORD):
+            continue
+        else:
+            return {"error": f"unbound required parameter '{pname}' "
+                             f"(signature: {sig})"}
+    try:
+        out = main(**kwargs)
+    except Exception as exc:  # noqa: BLE001
+        return {"error": f"main() raised {type(exc).__name__}: {exc}"}
+
+    outputs: dict = {}
+    if hasattr(out, "_asdict"):
+        outputs = dict(out._asdict())
+    elif isinstance(out, dict):
+        outputs = dict(out)
+    elif isinstance(out, np.ndarray):
+        outputs = {"output": out}
+    elif isinstance(out, tuple):
+        outputs = {f"out{i}": v for i, v in enumerate(out)}
+    else:
+        for name in dir(out):
+            if name.startswith("_"):
+                continue
+            try:
+                outputs[name] = getattr(out, name)
+            except Exception:  # noqa: BLE001 — a raising lazy property
+                continue  # must not abort the harness
+    return {"outputs": outputs, "bound": sorted(kwargs)}
+
+
+def pick_output(outputs: dict, kind: str):
+    import numpy as np
+
+    for name in _OUTPUT_NAMES.get(kind, ()):
+        if name in outputs and isinstance(outputs[name], np.ndarray):
+            return outputs[name]
+    arrays = [v for v in outputs.values() if isinstance(v, np.ndarray)]
+    return arrays[0] if len(arrays) == 1 else None
+
+
+# ----------------------------------------------------------------- fixtures
+def _synthetic_inputs():
+    import numpy as np
+
+    from tmlibrary_tpu.benchmarks import synthetic_cell_painting_batch
+
+    data = synthetic_cell_painting_batch(4, size=128, n_cells=6, seed=123)
+    return (np.asarray(data["DAPI"], np.uint16),
+            np.asarray(data["Actin"], np.uint16))
+
+
+def freeze(force: bool = False) -> int:
+    """Write the golden fixtures from THIS framework's CPU chain."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from tmlibrary_tpu.benchmarks import cell_painting_description
+    from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
+
+    out_path = GOLDEN / "cell_painting.npz"
+    if out_path.exists() and not force:
+        print(f"{out_path} exists; use --force to regenerate")
+        return 1
+    dapi, actin = _synthetic_inputs()
+    pipe = ImageAnalysisPipeline(cell_painting_description(), max_objects=32)
+    fn = pipe.build_batch_fn()
+    import jax.numpy as jnp
+
+    res = fn({"DAPI": jnp.asarray(dapi), "Actin": jnp.asarray(actin)}, {},
+             jnp.zeros((4, 2), jnp.int32))
+    GOLDEN.mkdir(parents=True, exist_ok=True)
+    nuclei = np.asarray(res.objects["nuclei"], np.int32)
+    cells = np.asarray(res.objects["cells"], np.int32)
+    mean_dapi = np.asarray(res.measurements["nuclei"]["Intensity_mean_DAPI"])
+    np.savez_compressed(
+        out_path,
+        dapi=dapi, actin=actin,
+        nuclei_labels=nuclei, cells_labels=cells,
+        nuclei_counts=np.asarray(res.counts["nuclei"], np.int32),
+        cells_counts=np.asarray(res.counts["cells"], np.int32),
+        nuclei_mean_dapi=mean_dapi,
+    )
+    print(f"froze {out_path}: counts nuclei="
+          f"{np.asarray(res.counts['nuclei']).tolist()} cells="
+          f"{np.asarray(res.counts['cells']).tolist()}")
+    return 0
+
+
+# -------------------------------------------------------------------- check
+def inventory(root: Path) -> dict:
+    rows = []
+    for component, pattern, names in INVENTORY:
+        files = sorted(root.glob(pattern))
+        loc = 0
+        # names may be classes/functions (grep content) or module names
+        # (match filenames) — search both
+        text = " ".join(f.stem for f in files)
+        capped = len(files) > 500
+        for f in files[:500]:
+            try:
+                content = f.read_text(errors="replace")
+            except OSError:
+                continue
+            loc += content.count("\n")
+            text += content
+        row = {
+            "component": component,
+            "pattern": pattern,
+            "files": len(files),
+            "loc": loc,
+            "names_found": [n for n in names if n in text],
+            "names_missing": [n for n in names if n not in text],
+        }
+        if capped:
+            row["scan_capped_at"] = 500
+        rows.append(row)
+    return {
+        "py_files": sum(1 for _ in root.glob("**/*.py")),
+        "rows": rows,
+    }
+
+
+def _n_objects(labels) -> int:
+    """Distinct non-background ids — NOT max(): reference chains may
+    leave gaps (e.g. seed-aligned secondary ids with empty cells)."""
+    import numpy as np
+
+    ids = np.unique(labels)
+    return int((ids > 0).sum())
+
+
+def resolve_modules(root: Path) -> dict:
+    """One recursive lookup per module name, shared across sites."""
+    names = ("segment_primary", "segment_secondary", "measure_intensity",
+             "smooth", "threshold", "threshold_otsu", "fill", "label")
+    return {n: find_module(root, n) for n in names}
+
+
+def segment_with_reference(mods: dict, dapi_site, actin_site) -> dict:
+    """Best effort: the reference's segmentation chain on ONE site.
+    Strategy A: segment_primary (+ segment_secondary).  Strategy B:
+    smooth -> threshold -> fill -> label module chain."""
+    import numpy as np
+
+    report: dict = {"strategy": None, "steps": {}}
+    sp = mods.get("segment_primary")
+    if sp is not None:
+        r = bind_and_run(sp, {"dapi": dapi_site})
+        report["steps"]["segment_primary"] = {
+            k: v for k, v in r.items() if k != "outputs"
+        }
+        if "error" not in r:
+            labels = pick_output(r["outputs"], "label")
+            if labels is not None:
+                report["strategy"] = "segment_primary"
+                out = {"nuclei": np.asarray(labels)}
+                ss = mods.get("segment_secondary")
+                if ss is not None:
+                    r2 = bind_and_run(
+                        ss, {"labels": out["nuclei"], "actin": actin_site}
+                    )
+                    report["steps"]["segment_secondary"] = {
+                        k: v for k, v in r2.items() if k != "outputs"
+                    }
+                    if "error" not in r2:
+                        cells = pick_output(r2["outputs"], "label")
+                        if cells is not None:
+                            out["cells"] = np.asarray(cells)
+                else:
+                    report["steps"]["segment_secondary"] = {
+                        "error": "module not found"
+                    }
+                report["labels"] = out
+                return report
+
+    # strategy B: compose the primitive modules
+    chain_ok = True
+    current = dapi_site.astype(np.float64)
+    for step, kind in (("smooth", "image"), ("threshold", "mask"),
+                       ("fill", "mask"), ("label", "label")):
+        path = mods.get(step) or (
+            mods.get("threshold_otsu") if step == "threshold" else None
+        )
+        if path is None:
+            report["steps"][step] = {"error": "module not found"}
+            chain_ok = False
+            break
+        r = bind_and_run(path, {"dapi": current, "mask": current})
+        report["steps"][step] = {k: v for k, v in r.items() if k != "outputs"}
+        if "error" in r:
+            chain_ok = False
+            break
+        nxt = pick_output(r["outputs"], kind)
+        if nxt is None:
+            report["steps"][step]["error"] = (
+                f"no {kind} output among {sorted(r['outputs'])}"
+            )
+            chain_ok = False
+            break
+        current = nxt
+    if chain_ok:
+        report["strategy"] = "module chain"
+        report["labels"] = {"nuclei": np.asarray(current)}
+    return report
+
+
+def check(root: Path) -> int:
+    import numpy as np
+
+    if not root.is_dir() or not any(root.iterdir()):
+        print(f"reference tree {root} is absent or empty (SURVEY.md §0 "
+              "still holds) — nothing to diff")
+        return 2
+
+    fixture = GOLDEN / "cell_painting.npz"
+    if not fixture.exists():
+        print("golden fixtures missing — run: "
+              "python scripts/reference_diff.py freeze")
+        return 1
+    gold = np.load(fixture)
+
+    inv = inventory(root)
+    print(f"reference: {inv['py_files']} python files")
+    drift = [r for r in inv["rows"] if r["names_missing"] or not r["files"]]
+    for r in inv["rows"]:
+        mark = "OK " if r not in drift else "?? "
+        print(f"  {mark}{r['component']:20s} files={r['files']:3d} "
+              f"loc={r['loc']:6d} missing={r['names_missing']}")
+
+    mods = resolve_modules(root)
+    results = {"inventory": inv, "sites": []}
+    gate_pass = True
+    ran_any = False
+    intensity_checked = intensity_ok = True
+    for s in range(gold["dapi"].shape[0]):
+        seg = segment_with_reference(mods, gold["dapi"][s], gold["actin"][s])
+        site_res: dict = {"site": s, "strategy": seg["strategy"],
+                          "steps": seg["steps"]}
+        if seg.get("labels", {}).get("nuclei") is not None:
+            ran_any = True
+            ref_n = seg["labels"]["nuclei"]
+            ref_count = _n_objects(ref_n)
+            want = int(gold["nuclei_counts"][s])
+            site_res["nuclei_count"] = {"reference": ref_count,
+                                        "ours": want,
+                                        "match": ref_count == want}
+            gate_pass &= ref_count == want
+            ours = gold["nuclei_labels"][s]
+            if ref_n.shape == ours.shape:
+                site_res["nuclei_label_agreement"] = float(
+                    (ref_n == ours).mean()
+                )
+            if "cells" in seg.get("labels", {}):
+                ref_c = _n_objects(seg["labels"]["cells"])
+                want_c = int(gold["cells_counts"][s])
+                site_res["cells_count"] = {"reference": ref_c, "ours": want_c,
+                                           "match": ref_c == want_c}
+                gate_pass &= ref_c == want_c
+            else:
+                # the gate covers BOTH object families: an absent or
+                # unbindable segment_secondary cannot pass silently
+                site_res["cells_count"] = {
+                    "error": "segment_secondary produced no label image"
+                }
+                gate_pass = False
+        else:
+            gate_pass = False
+
+        # measurement parity: the reference's measure_intensity on OUR
+        # golden nuclei labels must reproduce the frozen per-object
+        # means (reported; the count gate stays the hard gate)
+        mi = mods.get("measure_intensity")
+        if mi is None:
+            intensity_checked = False
+            site_res["intensity"] = {"error": "measure_intensity not found"}
+        else:
+            r = bind_and_run(mi, {"labels": gold["nuclei_labels"][s],
+                                  "dapi": gold["dapi"][s]})
+            if "error" in r:
+                intensity_checked = False
+                site_res["intensity"] = {"error": r["error"]}
+            else:
+                vals = pick_output(r["outputs"], "measurement")
+                n = int(gold["nuclei_counts"][s])
+                want_means = np.asarray(gold["nuclei_mean_dapi"][s][:n])
+                got = (np.asarray(vals).reshape(-1)[:n]
+                       if vals is not None else None)
+                if got is None or got.shape != want_means.shape:
+                    intensity_checked = False
+                    site_res["intensity"] = {
+                        "error": f"no comparable measurement among "
+                                 f"{sorted(r['outputs'])}"
+                    }
+                else:
+                    close = bool(np.allclose(got, want_means, rtol=1e-6))
+                    intensity_ok &= close
+                    site_res["intensity"] = {"mean_dapi_allclose": close}
+        results["sites"].append(site_res)
+
+    results["gate"] = {
+        "ran_reference_modules": ran_any,
+        "bit_identical_counts": bool(gate_pass and ran_any),
+        "intensity_checked": intensity_checked,
+        "intensity_allclose": bool(intensity_checked and intensity_ok),
+        "inventory_drift_rows": [r["component"] for r in drift],
+    }
+    out = OUT_PATH
+    out.write_text(json.dumps(results, indent=2, default=str))
+    print(f"\nwrote {out}")
+    print(f"GATE: bit-identical counts = "
+          f"{results['gate']['bit_identical_counts']}")
+    print(f"intensity parity: checked={intensity_checked} "
+          f"allclose={results['gate']['intensity_allclose']}")
+    return 0 if results["gate"]["bit_identical_counts"] else 1
+
+
+def main() -> int:
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    cmd = args[0] if args else "check"
+    if cmd == "freeze":
+        return freeze(force="--force" in sys.argv)
+    if cmd == "check":
+        root = Path(args[1]) if len(args) > 1 else Path(
+            os.environ.get("REFERENCE_ROOT", DEFAULT_REFERENCE)
+        )
+        return check(root)
+    print(__doc__)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,219 @@
+"""The reference-arrival harness against a MOCK reference tree.
+
+`/root/reference` is still empty (SURVEY.md §0), so the harness is
+proven here against a synthetic tmlib/jtmodules tree whose modules
+implement the upstream API shape (``main(**kwargs)`` returning a
+namedtuple) with an INDEPENDENT scipy implementation of the Cell
+Painting chain — the same semantics the real reference's
+segment_primary/segment_secondary have, per BASELINE.json.
+"""
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+# the console-script `pytest` runner does not put the repo root on
+# sys.path (python -m pytest does); scripts/ must import either way
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from scripts import reference_diff as rd  # noqa: E402
+
+
+_SEGMENT_PRIMARY = '''
+import collections
+import numpy as np
+import scipy.ndimage as ndi
+
+Output = collections.namedtuple("Output", ["label_image", "figure"])
+
+def _otsu(img, bins=256):
+    lo, hi = float(img.min()), float(img.max())
+    span = max(hi - lo, 1e-6)
+    idx = np.clip(((img - lo) / span * bins).astype(np.int32), 0, bins - 1)
+    hist = np.bincount(idx.ravel(), minlength=bins).astype(np.float64)
+    centers = lo + (np.arange(bins) + 0.5) / bins * span
+    w0 = np.cumsum(hist)
+    w1 = w0[-1] - w0
+    s0 = np.cumsum(hist * centers)
+    mu0 = s0 / np.maximum(w0, 1e-12)
+    mu1 = (s0[-1] - s0) / np.maximum(w1, 1e-12)
+    between = np.where((w0 > 0) & (w1 > 0), w0 * w1 * (mu0 - mu1) ** 2, -1.0)
+    return float(centers[int(np.argmax(between))])
+
+def main(image, sigma=1.5, min_area=20, plot=False):
+    sm = ndi.gaussian_filter(image.astype(np.float32), sigma, mode="reflect")
+    mask = ndi.binary_fill_holes(sm > _otsu(sm))
+    labels, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    sizes = np.bincount(labels.ravel(), minlength=n + 1)
+    keep = np.flatnonzero(sizes >= min_area)
+    keep = keep[keep > 0]
+    remap = np.zeros(n + 1, np.int32)
+    remap[keep] = np.arange(1, len(keep) + 1, dtype=np.int32)
+    return Output(remap[labels], None)
+'''
+
+_SEGMENT_SECONDARY = '''
+import collections
+import numpy as np
+import scipy.ndimage as ndi
+from segment_primary_impl import _otsu
+
+Output = collections.namedtuple("Output", ["label_image", "figure"])
+
+def main(label_image, intensity_image, correction_factor=0.8, plot=False):
+    img = intensity_image.astype(np.float32)
+    cell_mask = img > _otsu(img) * correction_factor
+    dist, (iy, ix) = ndi.distance_transform_edt(
+        label_image == 0, return_indices=True
+    )
+    cells = np.where(cell_mask, label_image[iy, ix], 0)
+    # keep ids aligned with the seeds (no renumber)
+    return Output(cells.astype(np.int32), None)
+'''
+
+_MEASURE_INTENSITY = '''
+import collections
+import numpy as np
+import scipy.ndimage as ndi
+
+Output = collections.namedtuple("Output", ["measurements", "figure"])
+
+def main(label_image, intensity_image, plot=False):
+    n = int(label_image.max())
+    ids = np.arange(1, n + 1)
+    means = ndi.mean(intensity_image.astype(np.float64), label_image, ids)
+    return Output(np.asarray(means), None)
+'''
+
+#: minimal inventory stubs so the SURVEY rows resolve
+_STUBS = {
+    "tmlib/config.py": "class LibraryConfig:\n    pass\n",
+    "tmlib/log.py": "def configure_logging():\n    pass\n",
+    "tmlib/errors.py":
+        "class MetadataError(Exception):\n    pass\n"
+        "class PipelineError(Exception):\n    pass\n",
+    "tmlib/utils.py": "def create_partitions(x, n):\n    return []\n",
+    "tmlib/image.py":
+        "class ChannelImage:\n    pass\n"
+        "class SegmentationImage:\n    pass\n"
+        "class IllumstatsContainer:\n    pass\n",
+    "tmlib/workflow/jterator/api.py":
+        "class ImageAnalysisPipeline:\n    pass\n",
+}
+
+
+@pytest.fixture()
+def mock_reference(tmp_path):
+    root = tmp_path / "reference"
+    jt = root / "jtmodules"
+    jt.mkdir(parents=True)
+    # segment_secondary imports the otsu twin through a sibling module
+    (jt / "segment_primary_impl.py").write_text(
+        textwrap.dedent(_SEGMENT_PRIMARY)
+    )
+    (jt / "segment_primary.py").write_text(textwrap.dedent(_SEGMENT_PRIMARY))
+    sec = textwrap.dedent(_SEGMENT_SECONDARY).replace(
+        "from segment_primary_impl import _otsu",
+        "import sys, importlib.util\n"
+        "_spec = importlib.util.spec_from_file_location(\n"
+        "    'segment_primary_impl',\n"
+        f"    r'{jt / 'segment_primary_impl.py'}')\n"
+        "_m = importlib.util.module_from_spec(_spec)\n"
+        "_spec.loader.exec_module(_m)\n"
+        "_otsu = _m._otsu",
+    )
+    (jt / "segment_secondary.py").write_text(sec)
+    (jt / "measure_intensity.py").write_text(
+        textwrap.dedent(_MEASURE_INTENSITY)
+    )
+    for rel, content in _STUBS.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+    return root
+
+
+def test_check_against_mock_reference(mock_reference, tmp_path, monkeypatch):
+    """End to end: inventory resolves, the binder runs the mock
+    jtmodules on the frozen fixtures, and the count gate passes (the
+    independent scipy chain reproduces this framework's counts)."""
+    monkeypatch.setattr(rd, "OUT_PATH", tmp_path / "REFDIFF.json")
+    assert rd.check(mock_reference) == 0
+    report = json.loads((tmp_path / "REFDIFF.json").read_text())
+    assert report["gate"]["bit_identical_counts"] is True
+    assert report["gate"]["ran_reference_modules"] is True
+    # every site segmented via strategy A with matching counts
+    assert report["gate"]["intensity_checked"] is True
+    assert report["gate"]["intensity_allclose"] is True
+    for site in report["sites"]:
+        assert site["strategy"] == "segment_primary"
+        assert site["nuclei_count"]["match"] is True
+        assert site["cells_count"]["match"] is True
+        assert site["intensity"]["mean_dapi_allclose"] is True
+        # label agreement is reported (scipy chain vs ours: same scan
+        # order given the same mask, so near-total agreement expected)
+        assert site["nuclei_label_agreement"] > 0.99
+    # inventory: jtmodules row fully resolved
+    row = next(r for r in report["inventory"]["rows"]
+               if r["component"] == "jtmodules")
+    assert row["names_missing"] == []
+
+
+def test_check_reports_count_mismatch(mock_reference, tmp_path, monkeypatch):
+    """A reference whose chain finds different objects must FAIL the
+    gate (exit 1), not pass silently."""
+    monkeypatch.setattr(rd, "OUT_PATH", tmp_path / "REFDIFF.json")
+    sp = mock_reference / "jtmodules" / "segment_primary.py"
+    sp.write_text(sp.read_text().replace("min_area=20", "min_area=100000"))
+    assert rd.check(mock_reference) == 1
+    report = json.loads((tmp_path / "REFDIFF.json").read_text())
+    assert report["gate"]["bit_identical_counts"] is False
+
+
+def test_missing_segment_secondary_fails_the_gate(
+    mock_reference, tmp_path, monkeypatch
+):
+    """The gate covers BOTH object families: nuclei matching while
+    segment_secondary is absent must not report success."""
+    monkeypatch.setattr(rd, "OUT_PATH", tmp_path / "REFDIFF.json")
+    (mock_reference / "jtmodules" / "segment_secondary.py").unlink()
+    assert rd.check(mock_reference) == 1
+    report = json.loads((tmp_path / "REFDIFF.json").read_text())
+    assert report["gate"]["bit_identical_counts"] is False
+    assert "error" in report["sites"][0]["cells_count"]
+
+
+def test_counts_use_distinct_ids_not_max(tmp_path):
+    """Reference label ids may be non-contiguous (seed-aligned secondary
+    with empty cells): 5 distinct ids with max 6 is 5 objects."""
+    labels = np.zeros((8, 8), np.int32)
+    for i, lid in enumerate((1, 2, 4, 5, 6)):
+        labels[i, :2] = lid
+    assert rd._n_objects(labels) == 5
+
+
+def test_check_absent_reference_is_exit_2(tmp_path, monkeypatch):
+    monkeypatch.setattr(rd, "OUT_PATH", tmp_path / "REFDIFF.json")
+    assert rd.check(tmp_path / "nope") == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert rd.check(empty) == 2
+
+
+def test_binder_reports_unbindable_module(tmp_path):
+    """A module whose main() needs an argument the harness cannot
+    supply is reported, never crashed through."""
+    bad = tmp_path / "strange.py"
+    bad.write_text("def main(quantum_flux):\n    return quantum_flux\n")
+    r = rd.bind_and_run(bad, {"dapi": np.zeros((4, 4))})
+    assert "unbound required parameter 'quantum_flux'" in r["error"]
+
+
+def test_golden_fixture_is_committed_and_self_consistent():
+    gold = np.load(rd.GOLDEN / "cell_painting.npz")
+    assert gold["dapi"].shape == (4, 128, 128)
+    for s in range(4):
+        assert gold["nuclei_labels"][s].max() == gold["nuclei_counts"][s]
