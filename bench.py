@@ -228,11 +228,13 @@ def measure(platform: str) -> None:
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
 
     if config not in ("2", "3", "4", "volume", "corilla", "pyramid",
-                      "spatial", "mesh"):
+                      "spatial", "mesh", "ingest"):
         raise SystemExit(
             f"BENCH_CONFIG must be '2', '3', '4', 'volume', 'corilla', "
-            f"'pyramid', 'spatial' or 'mesh', got '{config}'"
+            f"'pyramid', 'spatial', 'mesh' or 'ingest', got '{config}'"
         )
+    if config == "ingest":
+        return measure_ingest(size)
     if config == "corilla":
         return measure_corilla(size)
     if config == "pyramid":
@@ -515,6 +517,115 @@ def measure_pyramid(size: int) -> None:
     record.update(_flops_fields(
         flops and flops * depth, depth * gy * gx, best,
         jax.default_backend(), item_key="flops_per_site"))
+    print(json.dumps(record), flush=True)
+
+
+def measure_ingest(size: int) -> None:
+    """Ingest throughput (round-3 VERDICT next-step #6): imextract's
+    thread-pooled decode -> canonical store path, in Mpix/s, over the
+    native TIFF loader and two first-party container parsers (ND2, CZI)
+    on synthetic fixtures.  Host-side work — no device, no relay — so
+    ``backend: host``.  Denominator: the same path with the pool pinned
+    to ONE worker (``TMX_INGEST_WORKERS=1``): the ratio is the pool
+    scaling the framework contributes over a single-threaded reader."""
+    import shutil
+    import sys as _sys
+    import tempfile
+    from pathlib import Path
+
+    import numpy as np
+
+    _sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_czi import write_czi
+    from test_nd2 import write_nd2
+
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+    from tmlibrary_tpu.writers import ImageWriter
+
+    n_sites = int(os.environ.get("BENCH_SITES", "96"))
+    rng = np.random.default_rng(11)
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    tmpdir = tempfile.mkdtemp(prefix="bench_ingest_")
+
+    def build_source(fmt: str) -> str:
+        src = os.path.join(tmpdir, f"src_{fmt}")
+        os.makedirs(src)
+        planes = rng.integers(0, 60000, (n_sites, size, size), np.uint16)
+        if fmt == "tiff":
+            for i in range(n_sites):
+                with ImageWriter(
+                    os.path.join(src, f"img_A01_s{i}_C00.tif")
+                ) as wr:
+                    wr.write(planes[i])
+        elif fmt == "nd2":
+            write_nd2(Path(src) / "plate_A01.nd2", planes[:, :, :, None])
+        else:  # czi
+            write_czi(Path(src) / "scan_A01.czi", planes[:, None, :, :])
+        return src
+
+    def run_ingest(fmt: str, src: str, workers: "int | None") -> float:
+        """Best-of-reps wall seconds for the full imextract phase."""
+        if workers is not None:
+            os.environ["TMX_INGEST_WORKERS"] = str(workers)
+        else:
+            os.environ.pop("TMX_INGEST_WORKERS", None)
+        best = float("inf")
+        for _ in range(reps):
+            root = os.path.join(
+                tmpdir, f"exp_{fmt}_{workers}_{time.monotonic_ns()}"
+            )
+            store = ExperimentStore.create(root, Experiment(
+                name="b", plates=[], channels=[],
+                site_height=1, site_width=1))
+            meta = get_step("metaconfig")(store)
+            meta.init({"source_dir": src, "handler": "auto"})
+            meta.run(0)
+            ime = get_step("imextract")(store)
+            ime.init({})
+            batches = ime.list_batches()
+            t0 = time.perf_counter()
+            for j in batches:
+                ime.run(j)
+            best = min(best, time.perf_counter() - t0)
+            shutil.rmtree(root, ignore_errors=True)
+        return best
+
+    mpix = n_sites * size * size / 1e6
+    per_format: dict = {}
+    try:
+        for fmt in ("tiff", "nd2", "czi"):
+            src = build_source(fmt)
+            pooled = run_ingest(fmt, src, None)
+            single = run_ingest(fmt, src, 1)
+            per_format[fmt] = {
+                "mpix_per_sec": round(mpix / pooled, 2),
+                "single_thread_mpix_per_sec": round(mpix / single, 2),
+                "pool_speedup": round(single / pooled, 2),
+            }
+    finally:
+        os.environ.pop("TMX_INGEST_WORKERS", None)
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    total = round(sum(f["mpix_per_sec"] for f in per_format.values()), 2)
+    mean_speedup = round(
+        sum(f["pool_speedup"] for f in per_format.values()) / len(per_format),
+        2,
+    )
+    record = {
+        "metric": "imextract_ingest_mpix_per_sec",
+        "value": total,
+        "unit": f"Mpix/sec summed over native TIFF + ND2 + CZI parsers "
+                f"({n_sites} sites of {size}x{size} each, decode -> store)",
+        "vs_baseline": mean_speedup,
+        "backend": "host",
+        "config": "ingest",
+        "sites": n_sites,
+        "site_size": size,
+        "per_format": per_format,
+        "pipelined": False,
+    }
     print(json.dumps(record), flush=True)
 
 

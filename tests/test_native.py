@@ -362,3 +362,100 @@ def test_mosaic_morph_fallback_chunks_on_wide_mosaics(rng):
         np.testing.assert_array_equal(g, x)
     area, cy, cx, ymin, ymax, xmin, xmax = got
     assert area[1] == 105 and ymax[1] == 2 and xmax[1] == w - 1
+
+
+def _tiff_lzw_encode(data: bytes) -> bytes:
+    """Full TIFF-LZW encoder: exists so the decoder's 10-12-bit widths,
+    wide-width KwKwK, and table-cap paths have in-suite coverage — the
+    round-trip fixtures written by cv2 never leave 9-bit codes.
+
+    The code width used for each emission is decided by SIMULATING the
+    decoder's state (its table lags the encoder's by one code, which is
+    exactly what the TIFF early-change convention compensates for), so
+    encoder and decoder agree by construction."""
+    out = bytearray()
+    acc = 0
+    nbits = 0
+    # decoder-side state the emitter mirrors
+    dec_next = 258
+    dec_width = 9
+    dec_prev = False
+
+    def emit_raw(code):
+        nonlocal acc, nbits
+        acc = (acc << dec_width) | code
+        nbits += dec_width
+        while nbits >= 8:
+            nbits -= 8
+            out.append((acc >> nbits) & 0xFF)
+
+    def emit_data(code):
+        nonlocal dec_next, dec_width, dec_prev
+        emit_raw(code)
+        # what our decoder does after consuming a data code
+        if dec_prev and dec_next < 4096:
+            dec_next += 1
+            if dec_next + 1 >= (1 << dec_width) and dec_width < 12:
+                dec_width += 1
+        dec_prev = True
+
+    def emit_clear():
+        nonlocal dec_next, dec_width, dec_prev
+        emit_raw(256)
+        dec_next, dec_width, dec_prev = 258, 9, False
+
+    def fresh_table():
+        return {bytes([i]): i for i in range(256)}
+
+    table = fresh_table()
+    next_code = 258
+    emit_clear()
+    w = b""
+    for byte in data:
+        wc = w + bytes([byte])
+        if wc in table:
+            w = wc
+            continue
+        emit_data(table[w])
+        table[wc] = next_code
+        next_code += 1
+        if next_code >= 4093:  # table nearly full: restart
+            emit_clear()
+            table = fresh_table()
+            next_code = 258
+        w = bytes([byte])
+    if w:
+        emit_data(table[w])
+    emit_raw(257)  # EOI
+    if nbits:
+        out.append((acc << (8 - nbits)) & 0xFF)
+    return bytes(out)
+
+
+def test_lzw_full_width_round_trip(rng):
+    """Native and Python LZW decoders on streams that grow the code
+    width to 12 bits, hit the table cap (mid-stream Clear), and contain
+    KwKwK chains — none of which the cv2-written fixtures exercise."""
+    from tmlibrary_tpu import native
+
+    random_part = bytes(rng.integers(0, 256, 30000, dtype=np.uint8))
+    kwkwk_part = b"abababab" * 64 + bytes([7]) * 512
+    for data in (
+        random_part,                      # table cap + width 12 + Clear
+        kwkwk_part,                       # KwKwK chains
+        kwkwk_part + random_part,         # both, across a Clear
+        b"",                              # empty stream
+    ):
+        encoded = _tiff_lzw_encode(data)
+        got_native = native.lzw_decode(encoded, len(data))
+        got_py = native._lzw_decode_py(encoded, len(data))
+        assert got_native == data, f"native mismatch on {len(data)}-byte input"
+        assert got_py == data, f"python twin mismatch on {len(data)}-byte input"
+
+    # truncations of a wide-width stream must fail cleanly, never crash,
+    # and native/python must agree
+    encoded = _tiff_lzw_encode(random_part)
+    for cut in (1, 100, len(encoded) // 2, len(encoded) - 2):
+        n = native.lzw_decode(encoded[:cut], len(random_part))
+        p = native._lzw_decode_py(encoded[:cut], len(random_part))
+        assert n == p

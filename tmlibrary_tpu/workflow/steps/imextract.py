@@ -95,7 +95,16 @@ class ImageExtractor(Step):
         # bound; the native TIFF reader and cv2 both release the GIL, so a
         # thread pool loads one plane-group's files concurrently (the
         # reference fanned per-file-mapping batches out to cluster jobs)
-        workers = min(8, os.cpu_count() or 1)
+        # TMX_INGEST_WORKERS pins the pool (bench.py's ingest config uses
+        # 1 as its single-thread denominator); anything unparseable or
+        # non-positive falls back to the default rather than failing
+        # every ingest batch
+        try:
+            workers = int(os.environ.get("TMX_INGEST_WORKERS", ""))
+        except ValueError:
+            workers = 0
+        if workers < 1:
+            workers = min(8, os.cpu_count() or 1)
         n_written = 0
         with cf.ThreadPoolExecutor(max_workers=workers) as pool:
             # submit every decode up front (concurrency spans plane
