@@ -814,7 +814,7 @@ def test_object_cap_saturation_is_loud(tmp_path, caplog):
 
     jt = get_step("jterator")(st)
     jt.init({"pipe": "sat.pipe.yaml", "batch_size": 4, "max_objects": 16,
-             "n_devices": 1})
+             "n_devices": 1, "auto_resegment": False})
     with caplog.at_level(logging.WARNING):
         result = jt.run(0)
     assert result["saturated"] == {"nuclei": 1}
@@ -842,12 +842,86 @@ def test_object_cap_saturation_is_loud(tmp_path, caplog):
     st.write_sites(img[None], [0], channel=0)
     jt2 = get_step("jterator")(st)
     jt2.init({"pipe": "sat.pipe.yaml", "batch_size": 4, "max_objects": 16,
-              "n_devices": 1})
+              "n_devices": 1, "auto_resegment": False})
     jt2.run(0)
     assert get_step("jterator")(st).collect()["saturated_sites"] == {"nuclei": 1}
     jt2.init({"pipe": "sat.pipe.yaml", "batch_size": 4, "max_objects": 64,
-              "n_devices": 1})
+              "n_devices": 1, "auto_resegment": False})
     assert "saturated_sites" not in get_step("jterator")(st).collect()
+
+
+def test_collect_auto_resegments_saturated_batches(tmp_path, caplog):
+    """The default flow closes the saturation loop with NO manual step
+    (round-3 VERDICT next-step #7): a 300-object site at max_objects=64
+    ends with the correct counts after collect, via bounded doublings
+    (64 -> 128 -> 256 -> 512), the raised cap written back into the
+    batch file, and the escalation recorded in the collect summary."""
+    import json as _json
+    import logging
+
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    exp = grid_experiment(
+        "autoreseg", well_rows=1, well_cols=1, sites_per_well=(1, 1),
+        channel_names=("DAPI",), site_shape=(256, 256),
+    )
+    st = ExperimentStore.create(tmp_path / "ar_exp", exp)
+    # 18x17 grid of bright 3x3 squares, first 300 = 300 objects
+    img = np.full((256, 256), 300, np.uint16)
+    n_obj = 0
+    for gy in range(18):
+        for gx in range(17):
+            if n_obj == 300:
+                break
+            y, x = 4 + 14 * gy, 4 + 14 * gx
+            img[y:y + 3, x:x + 3] = 40000
+            n_obj += 1
+    st.write_sites(img[None], [0], channel=0)
+
+    pipe = dict(PIPE_YAML)
+    pipe["input"] = {"channels": [{"name": "DAPI", "correct": False,
+                                   "align": False}]}
+    (st.root / "ar.pipe.yaml").write_text(yaml.safe_dump(pipe))
+
+    jt = get_step("jterator")(st)
+    jt.init({"pipe": "ar.pipe.yaml", "batch_size": 4, "max_objects": 64,
+             "n_devices": 1})
+    result = jt.run(0)
+    assert result["saturated"] == {"nuclei": 1}
+
+    # collect from a FRESH instance (per-verb CLI process boundary)
+    with caplog.at_level(logging.WARNING):
+        collected = get_step("jterator")(st).collect()
+    assert collected["resegmented"] == {"0": 512}
+    assert "saturated_sites" not in collected
+    assert collected["objects_total"]["nuclei"] == 300
+    feats = st.read_features("nuclei")
+    assert len(feats) == 300
+    labels = st.read_labels(None, "nuclei")
+    assert labels.max() == 300
+    # the raised cap persisted in the SIDE override file — NOT the batch
+    # file, whose args must keep matching the planned description or the
+    # engine's resume staleness check would re-plan and wipe everything
+    jt_fresh = get_step("jterator")(st)
+    batch = _json.loads(
+        (jt_fresh.step_dir / "batch_000.json").read_text()
+    )
+    assert batch["args"]["max_objects"] == 64
+    overrides = _json.loads(
+        (jt_fresh.step_dir / "cap_overrides.json").read_text()
+    )
+    assert overrides == {"0": 512}
+    # engine resume comparison (engine._run_step): planned args still
+    # resolve identically, so resume keeps the completed batches
+    assert jt_fresh.batch_args.resolve(
+        {"pipe": "ar.pipe.yaml", "batch_size": 4, "max_objects": 64,
+         "n_devices": 1}
+    ) == batch["args"]
+    # and a resumed re-run of the batch applies the override
+    rerun = jt_fresh.run(0)
+    assert rerun["objects"]["nuclei"] == 300
+    assert any("auto-resegmenting" in r.message for r in caplog.records)
 
 
 def test_no_saturation_signal_below_cap(tmp_path):

@@ -152,6 +152,10 @@ class ImageAnalysisRunner(Step):
         Argument("batch_size", int, default=32, help="sites per device batch"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
+        Argument("auto_resegment", bool, default=True,
+                 help="collect re-runs saturated batches at doubled "
+                      "max_objects (bounded at 4096) until counts fit; "
+                      "disable to keep the manual warn-and-rerun flow"),
         Argument("n_devices", int, default=0, help="mesh size (0 = all)"),
         Argument("cycle", int, default=0),
         Argument("tpoint", int, default=0),
@@ -168,6 +172,7 @@ class ImageAnalysisRunner(Step):
     def __init__(self, store):
         super().__init__(store)
         self._compiled = None
+        self._compiled_cap: int | None = None
         self._desc = None
         self._window: tuple[int, int, int, int] | None = None
 
@@ -201,7 +206,11 @@ class ImageAnalysisRunner(Step):
             if not pipe_path.is_absolute():
                 pipe_path = self.store.root / pipe_path
             self._desc = PipelineDescription.load(pipe_path)
-        if self._compiled is None:
+        # cache keyed by the object cap: batches normally share one cap,
+        # but collect's auto-resegmentation re-runs a batch at a doubled
+        # max_objects — reusing the old compiled program would silently
+        # keep the old cap while the saturation check uses the new one
+        if self._compiled is None or self._compiled_cap != args["max_objects"]:
             # aligned multiplexing experiments crop every channel to the
             # inter-cycle intersection (reference SiteIntersection); the
             # window is experiment-static, so it compiles into the program
@@ -215,10 +224,20 @@ class ImageAnalysisRunner(Step):
                     self._window = None
             pipe = ImageAnalysisPipeline(self._desc, max_objects=args["max_objects"])
             self._compiled = pipe.build_batch_fn(window=self._window)
+            self._compiled_cap = args["max_objects"]
         return self._desc, self._compiled
 
     # -------------------------------------------------------------------- run
     def run_batch(self, batch: dict) -> dict:
+        # collect's auto-resegmentation escalates a batch's object cap in
+        # a SIDE file rather than rewriting batch_*.json: the engine's
+        # resume staleness check compares planned batch args against the
+        # description's, and a rewritten cap would read as "args changed"
+        # and trigger a from-scratch re-plan that wipes every output
+        override = self._cap_overrides().get(str(batch["index"]))
+        if override and override > batch["args"].get("max_objects", 0):
+            batch = {**batch, "args": {**batch["args"],
+                                       "max_objects": int(override)}}
         # .get: batch JSONs persisted by a pre-layout init lack the key
         if batch["args"].get("layout", "sites") == "spatial":
             return self._run_spatial(batch)
@@ -904,6 +923,11 @@ class ImageAnalysisRunner(Step):
         )
         from tmlibrary_tpu.ops.pyramid import n_pyramid_levels
 
+        # resegment FIRST: the registry pass below derives min_poly_zoom
+        # from mean object area, which the capped feature shards would
+        # misstate for exactly the object types that saturated
+        resegmented = self._resegment_saturated()
+
         registry = MapobjectTypeRegistry(self.store.root)
         # zoom levels are defined over the viewer pyramid, which illuminati
         # builds from the full plate mosaic — use the largest plate's
@@ -938,6 +962,8 @@ class ImageAnalysisRunner(Step):
                 )
             )
         out = {"objects_total": summary}
+        if resegmented:
+            out["resegmented"] = resegmented
         totals = self._saturation_totals()
         if totals:
             # repeat the saturation warning at collect so it is the LAST
@@ -953,6 +979,83 @@ class ImageAnalysisRunner(Step):
         return out
 
     # ------------------------------------------------- saturation bookkeeping
+    #: bounded escalation: up to 4 doublings of the init-time cap, never
+    #: past the absolute ceiling (a runaway segmentation must not compile
+    #: ever-larger programs forever)
+    _RESEGMENT_DOUBLINGS = 4
+    _RESEGMENT_CEILING = 4096
+
+    def _resegment_saturated(self) -> dict:
+        """Close the saturation loop without a manual step (round-3
+        VERDICT next-step #7): re-run JUST the saturated batches at a
+        doubled ``max_objects`` until their counts fit, the doubling
+        budget runs out, or the ceiling is hit.  The raised cap lives in
+        ``cap_overrides.json`` (NOT the batch file — the engine's resume
+        staleness check would read a rewritten cap as a changed plan and
+        wipe all outputs), is applied by :meth:`run_batch`, and survives
+        for resume; each re-run goes through :meth:`run` (per-batch log
+        captured) and the escalations land in the collect summary — and
+        therefore the run ledger — as ``resegmented``."""
+        from tmlibrary_tpu.errors import JobDescriptionError
+
+        done: dict[str, int] = {}
+        for _ in range(self._RESEGMENT_DOUBLINGS):
+            state = self._saturation_state()
+            if not state:
+                break
+            progressed = False
+            for bidx_str in sorted(state):
+                try:
+                    batch = self.load_batch(int(bidx_str))
+                except JobDescriptionError:
+                    continue  # batches re-planned since; stale entry
+                args = batch.get("args", {})
+                if not args.get("auto_resegment", True):
+                    return done  # manual mode: leave the warning flow
+                if args.get("layout", "sites") == "spatial":
+                    continue  # ragged mosaic path has no object cap
+                cap = max(
+                    int(args.get("max_objects", 256)),
+                    self._cap_overrides().get(bidx_str, 0),
+                )
+                new_cap = min(cap * 2, self._RESEGMENT_CEILING)
+                if new_cap <= cap:
+                    continue  # ceiling reached; the collect warning fires
+                self._write_cap_override(bidx_str, new_cap)
+                logger.warning(
+                    "auto-resegmenting batch %d at max_objects=%d "
+                    "(saturated: %s)",
+                    batch["index"], new_cap, state[bidx_str],
+                )
+                self.run(batch["index"])  # re-records/clears saturation
+                done[bidx_str] = new_cap
+                progressed = True
+            if not progressed:
+                break
+        return done
+
+    @property
+    def _cap_override_path(self):
+        return self.step_dir / "cap_overrides.json"
+
+    def _cap_overrides(self) -> dict:
+        import json
+
+        try:
+            return json.loads(self._cap_override_path.read_text())
+        except (OSError, ValueError):
+            return {}
+
+    def _write_cap_override(self, bidx_str: str, cap: int) -> None:
+        import json
+        import os
+
+        state = self._cap_overrides()
+        state[bidx_str] = int(cap)
+        tmp = self._cap_override_path.with_suffix(f".tmp{os.getpid()}")
+        tmp.write_text(json.dumps(state, sort_keys=True))
+        os.replace(tmp, self._cap_override_path)
+
     @property
     def _saturation_path(self):
         return self.step_dir / "saturation.json"
@@ -986,22 +1089,25 @@ class ImageAnalysisRunner(Step):
             tmp.write_text(json.dumps(state, sort_keys=True))
             os.replace(tmp, path)
 
-    def _saturation_totals(self) -> dict:
+    def _saturation_state(self) -> dict:
+        """Raw per-batch saturation map: {batch_index_str: {objects: n}}."""
         import json
 
         path = self._saturation_path
         if not path.exists():
             return {}
         try:
-            state = json.loads(path.read_text())
+            return json.loads(path.read_text())
         except ValueError:
             logger.warning(
                 "saturation.json is unreadable (crashed writer?) — "
                 "per-batch saturation truth remains in the run ledger"
             )
             return {}
+
+    def _saturation_totals(self) -> dict:
         totals: dict[str, int] = {}
-        for per_batch in state.values():
+        for per_batch in self._saturation_state().values():
             for k, n in per_batch.items():
                 totals[k] = totals.get(k, 0) + n
         return totals
@@ -1014,6 +1120,8 @@ class ImageAnalysisRunner(Step):
             if d.exists():
                 shutil.rmtree(d)
             d.mkdir()
-        # stale saturation signal belongs to the deleted outputs
+        # stale saturation signal and cap escalations belong to the
+        # deleted outputs (a fresh plan restarts from the init-time cap)
         self._saturation_path.unlink(missing_ok=True)
         self._saturation_path.with_suffix(".lock").unlink(missing_ok=True)
+        self._cap_override_path.unlink(missing_ok=True)
