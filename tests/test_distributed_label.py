@@ -137,3 +137,56 @@ def test_distributed_watershed_bit_identical(mesh, rng):
     )
     assert np.array_equal(sharded, golden)
     assert sharded.max() > 0
+
+
+def test_single_device_mesh_takes_native_shortcut(rng):
+    """A 1-device CPU mesh routes CC and watershed through the native
+    host kernels (the XLA fixpoint is pathological on CPU) and must be
+    bit-identical to the 8-shard distributed result."""
+    import scipy.ndimage as ndi
+    from jax.sharding import Mesh
+
+    from tmlibrary_tpu.parallel.label import (
+        _native_cc_available,
+        distributed_connected_components,
+        distributed_connected_components_2d,
+        distributed_watershed_from_seeds,
+    )
+
+    if not _native_cc_available():
+        # without this gate the test would silently re-test the XLA path
+        pytest.skip("native library unavailable: shortcut cannot engage")
+
+    mask = rng.random((64, 48)) > 0.7
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("rows",))
+    mesh8 = Mesh(np.asarray(jax.devices()[:8]), ("rows",))
+    l1, c1 = distributed_connected_components(mask, mesh1)
+    l8, c8 = distributed_connected_components(mask, mesh8)
+    assert int(c1) == int(c8)
+    np.testing.assert_array_equal(np.asarray(l1), np.asarray(l8))
+    golden, n = ndi.label(mask, ndi.generate_binary_structure(2, 2))
+    assert int(c1) == n
+    np.testing.assert_array_equal(np.asarray(l1), golden)
+
+    intensity = rng.random((64, 48)).astype(np.float32) * 100
+    seeds = np.where(np.asarray(l1) <= 3, np.asarray(l1), 0)
+    grow = mask | (rng.random((64, 48)) > 0.5)
+    w1 = distributed_watershed_from_seeds(intensity, seeds, grow, mesh1)
+    w8 = distributed_watershed_from_seeds(intensity, seeds, grow, mesh8)
+    np.testing.assert_array_equal(np.asarray(w1), np.asarray(w8))
+
+    # the degenerate 1x1 2-D mesh hits the same pathology: same shortcut
+    mesh11 = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1),
+                  ("rows", "cols"))
+    l11, c11 = distributed_connected_components_2d(mask, mesh11)
+    assert int(c11) == int(c1)
+    np.testing.assert_array_equal(np.asarray(l11), np.asarray(l1))
+
+    from tmlibrary_tpu.parallel.label import (
+        distributed_watershed_from_seeds_2d,
+    )
+
+    w11 = distributed_watershed_from_seeds_2d(
+        intensity, seeds, grow, mesh11
+    )
+    np.testing.assert_array_equal(np.asarray(w11), np.asarray(w1))

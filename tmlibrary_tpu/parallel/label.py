@@ -84,9 +84,11 @@ def distributed_connected_components(
     """Label a row-sharded (H, W) bool mask; ids 1..N in scipy scan order.
 
     Returns ``(labels, count)`` with ``labels`` sharded like the input.
-    Raises :class:`ShardingError` when rows don't divide the mesh, or when
-    a shard holds more than ``max_roots_per_shard`` components (the static
-    root-table bound; raise it for dense masks).
+    Raises :class:`ShardingError` when rows don't divide the mesh, or —
+    on the sharded path — when a shard holds more than
+    ``max_roots_per_shard`` components (the static root-table bound;
+    raise it for dense masks).  A 1-device CPU mesh routes through the
+    native union-find instead, which has no root bound.
     """
     mask = jnp.asarray(mask, bool)
     h, w = mask.shape
@@ -95,6 +97,14 @@ def distributed_connected_components(
         raise ShardingError(f"mask rows {h} not divisible by mesh size {n}")
     if connectivity not in (4, 8):
         raise ValueError("connectivity must be 4 or 8")
+    # a 1-device CPU mesh has no seams to join: the associative-scan
+    # fixpoint is pathological on XLA-CPU (the same pathology the sites
+    # layout's native fallback exists for), and the native union-find is
+    # bit-identical (scipy scan order — exactly what the distributed
+    # path is tested against)
+    if n == 1 and _native_cc_available():
+        return _native_cc_shortcut(mask, mesh, connectivity,
+                                   PartitionSpec(axis))
     rows = h // n
     k = max_roots_per_shard
     mapped = _cc_1d_program(mesh, rows, w, connectivity, k, axis)
@@ -107,6 +117,31 @@ def distributed_connected_components(
             f"max_roots_per_shard={k}; raise the bound"
         )
     return labels, count
+
+
+def _native_cc_available() -> bool:
+    from tmlibrary_tpu import native as native_mod
+
+    # cpu_native_enabled already requires the loaded library (and the
+    # cpu backend + the TMX_NATIVE kill switch)
+    return native_mod.cpu_native_enabled()
+
+
+def _native_cc_shortcut(mask, mesh, connectivity, spec):
+    """1-device mesh: no seams to join, and the XLA associative-scan
+    fixpoint is pathological on CPU — the native union-find is
+    bit-identical (scipy scan order, exactly what the distributed paths
+    are tested against)."""
+    from tmlibrary_tpu import native as native_mod
+
+    labels_np, count = native_mod.cc_label_host(
+        np.asarray(mask), connectivity
+    )
+    return (
+        jax.device_put(jnp.asarray(labels_np, jnp.int32),
+                       NamedSharding(mesh, spec)),
+        jnp.asarray(count, jnp.int32),
+    )
 
 
 def _cc_1d_program(mesh, rows, w, connectivity, k, axis):
@@ -273,6 +308,10 @@ def distributed_connected_components_2d(
         )
     if connectivity not in (4, 8):
         raise ValueError("connectivity must be 4 or 8")
+    if nr * nc == 1 and _native_cc_available():
+        # same degenerate-mesh pathology as the 1-D entry point
+        return _native_cc_shortcut(mask, mesh, connectivity,
+                                   PartitionSpec(row_axis, col_axis))
     rows, cols = h // nr, w // nc
     k = max_roots_per_shard
     axes = (row_axis, col_axis)
@@ -462,6 +501,17 @@ def distributed_watershed_from_seeds_2d(
         raise ShardingError(
             f"mosaic {h}x{w} not divisible by mesh {nr}x{nc}"
         )
+    if nr * nc == 1 and _native_cc_available():
+        from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+
+        out = watershed_from_seeds(
+            intensity, seeds, mask,
+            n_levels=n_levels, connectivity=connectivity,
+        )
+        return jax.device_put(
+            out,
+            NamedSharding(mesh, PartitionSpec(row_axis, col_axis)),
+        )
     axes = (row_axis, col_axis)
 
     def body(int_block, seed_block, mask_block):
@@ -555,6 +605,19 @@ def distributed_watershed_from_seeds(
     n = mesh.devices.size
     if h % n != 0:
         raise ShardingError(f"rows {h} not divisible by mesh size {n}")
+    if n == 1 and _native_cc_available():
+        # 1-device CPU mesh: the single-device twin IS the semantics
+        # this function is tested bit-identical against, and its auto
+        # dispatch routes to the native frontier flood on cpu
+        from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
+
+        out = watershed_from_seeds(
+            intensity, seeds, mask,
+            n_levels=n_levels, connectivity=connectivity,
+        )
+        return jax.device_put(
+            out, NamedSharding(mesh, PartitionSpec(axis))
+        )
 
     def body(int_block, seed_block, mask_block):
         mask_b = mask_block | (seed_block > 0)
