@@ -96,6 +96,18 @@ def main() -> None:
         flush=True,
     )
 
+    # the PRODUCTION multi-chip form (shard_map over the pod mesh's
+    # batch axes — zero collectives by construction) across the real
+    # process boundary; must equal the GSPMD result above
+    sfn = pipe.build_sharded_batch_fn(mesh, axis=("wells", "sites"))
+    sm_result = sfn(raw, {}, shifts)
+    np.testing.assert_array_equal(
+        global_to_host_local(sm_result.counts["nuclei"], mesh),
+        local_counts,
+    )
+    sync_hosts("shardmap-done")
+    print(f"SHARDMAP_OK process={jax.process_index()}", flush=True)
+
     # 2-D spatially-sharded CC across the REAL process boundary: the
     # 2x2 rows x cols mesh puts host 0 on row 0 and host 1 on row 1, so
     # every row seam join (and the corner-diagonal merge) crosses

@@ -77,3 +77,6 @@ def test_two_process_pipeline_over_pod_mesh():
     # on both workers (seam joins + corner merge over gloo)
     for pid, out in enumerate(outputs):
         assert f"CC2D_OK process={pid}" in out, out[-2000:]
+    # the shard_map production batch path ran over the pod mesh too
+    for pid, out in enumerate(outputs):
+        assert f"SHARDMAP_OK process={pid}" in out, out[-2000:]

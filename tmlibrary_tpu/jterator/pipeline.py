@@ -226,7 +226,7 @@ class ImageAnalysisPipeline:
     def build_sharded_batch_fn(
         self,
         mesh,
-        axis: str = "sites",
+        axis: str | tuple[str, ...] = "sites",
         window: tuple[int, int, int, int] | None = None,
     ) -> Callable:
         """``jit(shard_map(vmap(site_fn)))`` over a site mesh — the
@@ -244,15 +244,23 @@ class ImageAnalysisPipeline:
 
         The batch axis must divide the mesh size.  ``stats`` is
         replicated; every result leaf keeps its leading (sharded) batch
-        axis.
+        axis.  ``axis`` may be a tuple of mesh axis names to shard the
+        batch over their product (e.g. ``("wells", "sites")`` on a pod
+        mesh).
         """
         from jax.sharding import PartitionSpec as P
 
         batched = self.build_batch_fn(window, jit=False)
+        # check_vma off: the iterative ops' while loops carry literal
+        # bool flags, which the varying-axes checker rejects under
+        # shard_map (carry starts unvarying, body output is varying).
+        # The program is embarrassingly parallel — no collectives, so
+        # the replication check has nothing to protect.
         mapped = jax.shard_map(
             batched,
             mesh=mesh,
             in_specs=(P(axis), P(), P(axis)),
             out_specs=P(axis),
+            check_vma=False,
         )
         return jax.jit(mapped)
