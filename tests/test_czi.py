@@ -30,13 +30,20 @@ def _entry(pixel_type, file_pos, compression, dims, pyramid=0) -> bytes:
     return out
 
 
-def _compress(data: bytes, compression: int, hilo: bool = False) -> bytes:
+def _compress(data: bytes, compression: int, hilo: bool = False,
+              plane: "np.ndarray | None" = None) -> bytes:
     """Test-side encode for zstd0 (5) / zstd1 (6, with optional hi-lo
-    byte packing) subblock payloads."""
+    byte packing) and JPEG (1, needs ``plane``) subblock payloads."""
     import zstandard
 
     if compression == 0:
         return data
+    if compression == 1:
+        import cv2
+
+        ok, buf = cv2.imencode(".jpg", plane)
+        assert ok
+        return buf.tobytes()
     if hilo:
         a = np.frombuffer(data, "<u2")
         data = (a & 0xFF).astype(np.uint8).tobytes() + (a >> 8).astype(
@@ -95,13 +102,15 @@ def write_czi(path, planes: np.ndarray, pixel_type=1, compression=0,
             if n_tiles > 1:
                 dims.append(("M", sm if global_m else m, 1))
             add_subblock(
-                _compress(planes[sm, c].tobytes(), compression, hilo), dims)
+                _compress(planes[sm, c].tobytes(), compression, hilo,
+                          plane=planes[sm, c]), dims)
             if with_pyramid:
                 half = planes[sm, c][::2, ::2]
                 pdims = [("X", 0, half.shape[1]), ("Y", 0, half.shape[0]),
                          ("C", c, 1), ("Z", 0, 1), ("T", 0, 1), ("S", s, 1)]
                 add_subblock(
-                    _compress(half.tobytes(), compression, hilo), pdims,
+                    _compress(half.tobytes(), compression, hilo,
+                              plane=half), pdims,
                     pyramid=1)
 
     meta_pos = 0
@@ -503,3 +512,57 @@ def test_czi_channel_names_guarded(tmp_path, planes):
     r.filename = tmp_path / "x.czi"
     r._segment_payload = lambda off, expect: memoryview(payload)
     assert r._channel_names_from_xml(1) == ["DAPI", "GFP"]
+
+
+def test_czi_gray8_round_trip(tmp_path):
+    """pixel_type 0 (Gray8) decodes uncompressed and zstd0."""
+    rng = np.random.default_rng(81)
+    planes8 = rng.integers(0, 255, (2, 1, 16, 20), dtype=np.uint8)
+    for comp in (0, 5):
+        path = tmp_path / f"g8_{comp}.czi"
+        write_czi(path, planes8, pixel_type=0, compression=comp)
+        with CZIReader(path) as r:
+            for s in range(2):
+                out = r.read_plane(s, 0, 0, 0, 0)
+                assert out.dtype == np.uint8
+                np.testing.assert_array_equal(out, planes8[s, 0])
+
+
+def test_czi_jpeg_subblocks_decode_via_cv2(tmp_path):
+    """compression=1 (legacy lossy JPEG) decodes; pixels equal cv2's own
+    decode of the embedded stream (JPEG is lossy, so the original plane
+    is only the approximate golden)."""
+    import cv2
+
+    rng = np.random.default_rng(82)
+    planes8 = rng.integers(0, 255, (1, 1, 24, 24), dtype=np.uint8)
+    path = tmp_path / "j.czi"
+    write_czi(path, planes8, pixel_type=0, compression=1)
+    ok, stream = cv2.imencode(".jpg", planes8[0, 0])
+    golden = cv2.imdecode(stream, cv2.IMREAD_UNCHANGED)
+    with CZIReader(path) as r:
+        out = r.read_plane(0, 0, 0, 0, 0)
+    np.testing.assert_array_equal(out, golden)
+    # lossy but close to the source
+    assert np.abs(out.astype(int) - planes8[0, 0].astype(int)).mean() < 12
+
+
+def test_czi_zstd1_hilo_on_gray8_is_rejected(tmp_path):
+    """hi-lo packing is 16-bit-specific; an 8-bit subblock claiming it
+    must fail loudly, not deinterleave garbage."""
+    from tmlibrary_tpu.errors import MetadataError
+
+    rng = np.random.default_rng(83)
+    planes8 = rng.integers(0, 255, (1, 1, 8, 10), dtype=np.uint8)
+    path = tmp_path / "h8.czi"
+    # write with compression=6/hilo=False, then flip the zstd1 header's
+    # hilo byte in place (payload bytes are identical)
+    write_czi(path, planes8, pixel_type=0, compression=6, hilo=False)
+    blob = bytearray(path.read_bytes())
+    marker = blob.find(b"\x03\x01\x00")
+    assert marker > 0
+    blob[marker + 2] = 1
+    path.write_bytes(bytes(blob))
+    with CZIReader(path) as r:
+        with pytest.raises(MetadataError):
+            r.read_plane(0, 0, 0, 0, 0)

@@ -102,6 +102,18 @@ def test_fuzz_czi(tmp_path):
     _fuzz(make, CZIReader, tmp_path, ".czi", 2)
 
 
+def test_fuzz_czi_gray8_jpeg(tmp_path):
+    from test_czi import write_czi
+
+    from tmlibrary_tpu.readers import CZIReader
+
+    def make(path, rng):
+        planes = rng.integers(0, 255, (2, 1, 12, 14), dtype=np.uint8)
+        write_czi(path, planes, pixel_type=0, compression=1)
+
+    _fuzz(make, CZIReader, tmp_path, ".czi", 13)
+
+
 def test_fuzz_oib(tmp_path):
     from test_oib import plane_name, tiff_bytes, write_cfb
 

@@ -413,3 +413,30 @@ def test_kbest_keeps_perfect_separator():
     # a fully constant column still scores 0 (not selected over noise)
     x2 = np.column_stack([np.ones(n), perfect])
     assert list(_kbest_anova(x2, y, 2, 1)) == [1]
+
+
+def test_heatmap_plate_plot_and_robust_window(store_with_features):
+    mgr = ToolRequestManager(store_with_features)
+    result = mgr.submit(
+        "heatmap", {"objects_name": "nuclei", "feature": "Morphology_area"}
+    )
+    attrs = result.attributes
+    assert attrs["n_objects"] == 80
+    assert attrs["min"] <= attrs["p01"] < attrs["p99"] <= attrs["max"]
+    (plot,) = result.plots
+    assert plot.type == "plate_heatmap"
+    wells = plot.figure["wells"]
+    assert len(wells) == 1  # one well in the fixture
+    table = store_with_features.read_features("nuclei")
+    np.testing.assert_allclose(
+        wells[0]["mean"], table["Morphology_area"].mean()
+    )
+
+
+def test_clustering_reports_sizes_and_inertia(store_with_features):
+    mgr = ToolRequestManager(store_with_features)
+    result = mgr.submit("clustering", {"objects_name": "nuclei", "k": 2})
+    attrs = result.attributes
+    sizes = attrs["cluster_sizes"]
+    assert sorted(sizes.values()) == [40, 40]  # two equal populations
+    assert attrs["inertia"] > 0

@@ -843,8 +843,8 @@ class ND2Reader(Reader):
 
 
 def _czi_zstd_plane(raw: bytes, h: int, w: int, zstd1: bool,
-                    filename) -> np.ndarray:
-    """Decode a zstd-compressed Gray16 CZI subblock payload.
+                    filename, itemsize: int = 2) -> np.ndarray:
+    """Decode a zstd-compressed Gray8/Gray16 CZI subblock payload.
 
     ``zstd0`` (compression id 5) is a bare zstd frame.  ``zstd1``
     (id 6, the modern ZEN default) prefixes a small header — byte 0 is
@@ -864,7 +864,7 @@ def _czi_zstd_plane(raw: bytes, h: int, w: int, zstd1: bool,
             "codec is not installed"
         ) from exc
 
-    expect = 2 * h * w
+    expect = itemsize * h * w
     hilo = False
     if zstd1:
         if not raw or raw[0] < 1 or raw[0] > len(raw):
@@ -898,13 +898,19 @@ def _czi_zstd_plane(raw: bytes, h: int, w: int, zstd1: bool,
             f"expected {expect}"
         )
     if hilo:
+        if itemsize != 2:
+            raise MetadataError(
+                f"zstd1 hi-lo packing on a non-16-bit subblock in "
+                f"{filename}"
+            )
         half = expect // 2
         lo = np.frombuffer(out, np.uint8, count=half)
         hi = np.frombuffer(out, np.uint8, count=half, offset=half)
         return (
             lo.astype(np.uint16) | (hi.astype(np.uint16) << 8)
         ).reshape(h, w)
-    return np.frombuffer(out, "<u2").reshape(h, w).copy()
+    dtype = np.uint8 if itemsize == 1 else np.dtype("<u2")
+    return np.frombuffer(out, dtype).reshape(h, w).copy()
 
 
 class CZIReader(Reader):
@@ -929,16 +935,18 @@ class CZIReader(Reader):
       data_size`` + its own directory entry; pixel data starts at payload
       offset ``max(256, 16 + entry_size) + metadata_size``.
 
-    Gray16 planes decode uncompressed or zstd-compressed (zstd0/zstd1
-    with hi-lo byte packing — the modern ZEN default, see
-    :func:`_czi_zstd_plane`); mosaic tiles (M dimension, slide scans)
-    read per tile with pyramid copies skipped; JPEG/JPEG-XR-compressed
-    or float files raise
-    :class:`~tmlibrary_tpu.errors.MetadataError` with a clear message.
+    Gray8/Gray16 planes decode uncompressed, zstd-compressed
+    (zstd0/zstd1 with hi-lo byte packing — the modern ZEN default, see
+    :func:`_czi_zstd_plane`), or JPEG-compressed (the legacy lossy
+    option, via cv2); mosaic tiles (M dimension, slide scans) read per
+    tile with pyramid copies skipped; JPEG-XR-compressed or float files
+    raise :class:`~tmlibrary_tpu.errors.MetadataError` with a clear
+    message (see docs/FORMATS.md for the JPEG-XR rationale).
     """
 
-    #: DirectoryEntryDV pixel types handled (Gray16)
-    _GRAY16 = 1
+    #: DirectoryEntryDV pixel types handled -> numpy dtype
+    #: (0 = Gray8, 1 = Gray16 per the public ZISRAW enum)
+    _PIXEL_DTYPES = {0: np.dtype(np.uint8), 1: np.dtype("<u2")}
 
     def __enter__(self):
         import mmap
@@ -1232,18 +1240,20 @@ class CZIReader(Reader):
                 f"z={zplane} t={tpoint}"
             )
         compression = plane["compression"]
-        if compression not in (0, 5, 6):
-            # 1 = JPEG, 4 = JPEG-XR: no native decoder in this image;
-            # 5/6 = zstd0/zstd1, the modern ZEN default, decoded below
+        if compression not in (0, 1, 5, 6):
+            # 4 = JPEG-XR: no conformant decoder buildable here (see
+            # docs/FORMATS.md); 1 = JPEG decoded via cv2 below;
+            # 5/6 = zstd0/zstd1, the modern ZEN default
             raise MetadataError(
                 f"{self.filename}: compressed CZI subblocks "
                 f"(compression={compression}) are not supported "
-                "(zstd0/zstd1 are; JPEG/JPEG-XR are not)"
+                "(zstd0/zstd1 and JPEG are; JPEG-XR is not)"
             )
-        if plane["pixel_type"] != self._GRAY16:
+        dtype = self._PIXEL_DTYPES.get(plane["pixel_type"])
+        if dtype is None:
             raise MetadataError(
-                f"{self.filename}: only Gray16 subblocks are supported "
-                f"(pixel_type={plane['pixel_type']})"
+                f"{self.filename}: only Gray8/Gray16 subblocks are "
+                f"supported (pixel_type={plane['pixel_type']})"
             )
         payload_off = plane["file_pos"] + 32
         sid = bytes(self._data[plane["file_pos"]:plane["file_pos"] + 16])
@@ -1270,7 +1280,7 @@ class CZIReader(Reader):
             ) from exc
         data_off = payload_off + max(256, 16 + entry_end) + meta_size
         h, w = plane["h"], plane["w"]
-        expect = 2 * h * w
+        expect = dtype.itemsize * h * w
         if compression != 0:
             if data_size <= 0 or data_off + data_size > len(self._data):
                 raise MetadataError(
@@ -1278,8 +1288,11 @@ class CZIReader(Reader):
                     f"{data_size} bytes, {len(self._data) - data_off} in file"
                 )
             raw = bytes(self._data[data_off:data_off + data_size])
+            if compression == 1:
+                return self._jpeg_plane(raw, h, w, dtype)
             return _czi_zstd_plane(
-                raw, h, w, compression == 6, self.filename
+                raw, h, w, compression == 6, self.filename,
+                itemsize=dtype.itemsize,
             )
         if data_size < expect or data_off + expect > len(self._data):
             # data_size is the writer's CLAIM; a truncated file can keep an
@@ -1289,9 +1302,41 @@ class CZIReader(Reader):
                 f"({len(self._data) - data_off} in file), expected {expect}"
             )
         samples = np.frombuffer(
-            self._data, np.uint16, count=h * w, offset=data_off
+            self._data, dtype, count=h * w, offset=data_off
         )
         return samples.reshape(h, w).copy()
+
+    def _jpeg_plane(self, raw: bytes, h: int, w: int, dtype) -> np.ndarray:
+        """JPEG (compression=1) subblock via cv2 — the legacy ZEN lossy
+        option.  Grayscale only; a decode failure or geometry mismatch
+        keeps the skip-on-MetadataError contract."""
+        import cv2
+
+        from tmlibrary_tpu.errors import MetadataError
+
+        try:
+            # cv2 returns None for most bad input but RAISES for e.g. a
+            # SOF declaring CV_IO_MAX_IMAGE_PIXELS-busting dimensions —
+            # both must land in the skip-on-MetadataError contract
+            img = cv2.imdecode(
+                np.frombuffer(raw, np.uint8), cv2.IMREAD_UNCHANGED
+            )
+        except cv2.error as exc:
+            raise MetadataError(
+                f"{self.filename}: corrupt JPEG subblock: {exc}"
+            ) from exc
+        if img is None:
+            raise MetadataError(
+                f"{self.filename}: corrupt JPEG subblock"
+            )
+        if img.ndim == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2GRAY)
+        if img.shape != (h, w):
+            raise MetadataError(
+                f"{self.filename}: JPEG subblock decodes to {img.shape}, "
+                f"directory says {(h, w)}"
+            )
+        return np.asarray(img, dtype)
 
     def tile_origin(self, scene: int, tile: int) -> tuple[int, int]:
         """(y0, x0) mosaic pixel origin of a tile (0-based per-scene

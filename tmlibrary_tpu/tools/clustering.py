@@ -66,7 +66,16 @@ class Clustering(Tool):
         features = payload.get("features")
         ids, x, feat_cols = self.load_feature_matrix(objects_name, features)
         assign, centroids = jax.jit(kmeans, static_argnums=(1,))(jnp.asarray(x), k)
-        ids["value"] = np.asarray(assign).astype(np.int32)
+        assign_np = np.asarray(assign).astype(np.int32)
+        ids["value"] = assign_np
+        cent_np = np.asarray(centroids)
+        # reported fit quality (same spirit as classification's training
+        # metrics): per-cluster sizes + total within-cluster sum of
+        # squares (sklearn's inertia_) over the standardized features
+        sizes = np.bincount(assign_np, minlength=k)
+        inertia = float(
+            ((x - cent_np[assign_np]) ** 2).sum()
+        ) if len(x) else 0.0
         return ToolResult(
             tool=self.name,
             objects_name=objects_name,
@@ -75,6 +84,9 @@ class Clustering(Tool):
             attributes={
                 "k": k,
                 "features": feat_cols,
-                "centroids": np.asarray(centroids).tolist(),
+                "centroids": cent_np.tolist(),
+                "cluster_sizes": {str(i): int(n) for i, n in
+                                  enumerate(sizes)},
+                "inertia": round(inertia, 4),
             },
         )

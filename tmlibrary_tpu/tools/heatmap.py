@@ -10,7 +10,7 @@ from __future__ import annotations
 import numpy as np
 
 from tmlibrary_tpu.errors import NotSupportedError
-from tmlibrary_tpu.tools.base import Tool, ToolResult, register_tool
+from tmlibrary_tpu.tools.base import Plot, Tool, ToolResult, register_tool
 
 
 @register_tool("heatmap")
@@ -29,6 +29,36 @@ class Heatmap(Tool):
         ids = table[["site_index", "label", "plate", "well_row", "well_col"]].copy()
         vals = table[feature].to_numpy(np.float64)
         ids["value"] = vals
+
+        # the classic plate heatmap: per-well mean of the feature, as a
+        # serializable Plot (reference heatmap results feed the UI's
+        # plate view) + robust display window in the attributes
+        plots = []
+        if len(vals):
+            # finite-only means: an all-NaN well (degenerate-object
+            # features) must not leak literal NaN through json.dumps
+            # into result.json; such wells carry mean null instead
+            finite_ids = ids[np.isfinite(vals)]
+            well_mean = (
+                finite_ids.groupby(["plate", "well_row", "well_col"])
+                ["value"].mean().reset_index()
+            )
+            plots.append(Plot(
+                type="plate_heatmap",
+                figure={
+                    "feature": feature,
+                    "wells": [
+                        {
+                            "plate": r.plate,
+                            "well_row": int(r.well_row),
+                            "well_col": int(r.well_col),
+                            "mean": float(r.value),
+                        }
+                        for r in well_mean.itertuples()
+                    ],
+                },
+            ))
+        finite = vals[np.isfinite(vals)]
         return ToolResult(
             tool=self.name,
             objects_name=objects_name,
@@ -36,7 +66,12 @@ class Heatmap(Tool):
             values=ids,
             attributes={
                 "feature": feature,
-                "min": float(np.nanmin(vals)) if len(vals) else 0.0,
-                "max": float(np.nanmax(vals)) if len(vals) else 0.0,
+                "min": float(finite.min()) if len(finite) else 0.0,
+                "max": float(finite.max()) if len(finite) else 0.0,
+                # robust window: the UI stretch the reference applies
+                "p01": float(np.percentile(finite, 1)) if len(finite) else 0.0,
+                "p99": float(np.percentile(finite, 99)) if len(finite) else 0.0,
+                "n_objects": int(len(vals)),
             },
+            plots=plots,
         )
