@@ -243,8 +243,10 @@ def main() -> int:
             "per-chip throughput is communication-free by construction "
             "and site sharding scales with chip count until ingest/IO "
             "binds — this row is what BASELINE.md's linear-scaling "
-            "extrapolation rests on.  The Welford merge moves kilobytes "
-            "per CHANNEL (not per site), once per corilla reduction.  "
+            "extrapolation rests on.  The Welford merge's traffic is "
+            "dominated by the exact 65536-bin percentile histogram "
+            "(~2.4 MB per CHANNEL reduction, independent of site "
+            "count — paid once per corilla channel, not per site).  "
             "Distributed CC's collective-permute traffic scales with "
             "mosaic WIDTH (seam rows), not area; the all_to_all reshard "
             "moves the full stack once per layout switch.\n\n"
