@@ -56,10 +56,20 @@ def test_cached_tpu_emitted_when_relay_down(cache_file):
         "BENCH_TPU_CACHE": cache_file,
         "BENCH_PROBE_TIMEOUT": "3",
         "BENCH_ATTEMPTS": "1",
+        # pin the batch to the fixture record's: the REPO's committed
+        # TUNING.json otherwise sets the default batch, and a tuned
+        # best_batch != 64 makes the knob check reject the fixture —
+        # this test would then silently skip forever
+        "BENCH_BATCH": "64",
         # break real TPU use even if the relay happens to be alive in CI:
         # probe timeout of 3s fails fast either way on this relay
     })
-    if out.get("backend") not in ("tpu_cached",):
+    if out.get("backend") == "cpu_fallback":
+        # with the batch pinned to the fixture record, a cpu_fallback
+        # means the cached-emission path itself regressed — fail loudly,
+        # don't skip with a misleading "relay alive" message
+        pytest.fail(f"cache rejected the pinned fixture record: {out}")
+    if out.get("backend") != "tpu_cached":
         # relay alive and fast enough to beat a 3s probe: the live path
         # legitimately wins; nothing to assert about the cache then
         pytest.skip(f"relay answered live: {out.get('backend')}")
@@ -138,7 +148,13 @@ def test_cache_defaulted_workload_mismatch_rejected(tmp_path):
         "BENCH_TPU_CACHE": str(path),
         "BENCH_PROBE_TIMEOUT": "3",
         "BENCH_ATTEMPTS": "1",
+        # pin to the fixture records' batch (see the cached-emission
+        # test: the repo TUNING.json's best_batch would otherwise make
+        # the knob check reject both records and skip forever)
+        "BENCH_BATCH": "64",
     })
+    if out.get("backend") == "cpu_fallback":
+        pytest.fail(f"cache rejected the pinned fixture records: {out}")
     if out.get("backend") != "tpu_cached":
         pytest.skip(f"relay answered live: {out.get('backend')}")
     # the default workload (max_objects=64) must win despite being staler
