@@ -73,8 +73,11 @@ def _load_tuning() -> "dict | None":
 def _tuned_batch(config: str) -> "int | None":
     """Hardware-measured best site batch for the 2-D segment+measure
     chain (``best_batch``).  None for configs the sweep doesn't model —
-    their defaults stay static."""
-    if config not in ("3", "4"):
+    their defaults stay static.  ``mesh`` runs config 3's chain per
+    device, so it shares the tuned batch (and the watcher's staleness
+    check must agree with measure_mesh's default or it re-measures
+    forever)."""
+    if config not in ("3", "4", "mesh"):
         return None
     tuning = _load_tuning()
     best = tuning.get("best_batch") if tuning else None
@@ -129,6 +132,11 @@ def _workload_knobs(config: str) -> dict:
         # re-measures records whose depth lags the tuned default)
         "BENCH_PIPELINE": ("pipeline_depth", None),
         "BENCH_MAX_OBJECTS": ("max_objects", 64),
+        # env-ONLY knob like BENCH_PIPELINE: unset means "all visible
+        # devices" (unknowable without a backend), so only an EXPLICIT
+        # request constrains — a cached n=1 mesh record must not serve a
+        # BENCH_MESH_DEVICES=4 request
+        "BENCH_MESH_DEVICES": ("n_devices", None),
         "BENCH_SITE_SIZE": (
             "site_size", 128 if config == "volume" else 256
         ),
@@ -665,7 +673,7 @@ def measure_mesh(size: int) -> None:
 
     devs = jax.devices()
     n = min(want, len(devs)) if want else len(devs)
-    per_device = int(os.environ.get("BENCH_BATCH") or _default_batch("3"))
+    per_device = int(os.environ.get("BENCH_BATCH") or _default_batch("mesh"))
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
     batch = per_device * n
     mesh = site_mesh(n)

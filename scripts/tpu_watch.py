@@ -50,6 +50,10 @@ BENCH_ITEMS = [
     ("2", {"BENCH_CONFIG": "2"}),
     ("pyramid", {"BENCH_CONFIG": "pyramid"}),
     ("spatial", {"BENCH_CONFIG": "spatial"}),
+    # proves the shard_map production multi-chip path on the real chip
+    # (n=1: scaling efficiency is trivially ~1, but the compiled program
+    # and its throughput under shard_map are hardware evidence)
+    ("mesh", {"BENCH_CONFIG": "mesh"}),
 ]
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed

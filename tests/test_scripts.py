@@ -252,3 +252,19 @@ def test_update_baseline_table_idempotent(monkeypatch, tmp_path):
     twice = baseline.read_text()
     assert "450.0" in twice and "400.0" not in twice
     assert twice.count(u.BEGIN) == 1
+
+
+def test_bench_done_mesh_uses_config3_tuned_batch(monkeypatch, tmp_path):
+    """The mesh config runs config 3's chain per device at the tuned
+    batch; the staleness check must agree or the watcher re-measures
+    the mesh record forever inside one window."""
+    w = _watch(
+        monkeypatch, tmp_path,
+        cache={"records": {"mesh": _record(
+            depth=8, batch=128, config="mesh")}},
+        tuning={**MACHINE, "best_pipeline": 8, "best_batch": 128},
+    )
+    assert w.bench_done("mesh") is True
+    (tmp_path / "tuning" / "TUNING.json").write_text(json.dumps(
+        {**MACHINE, "best_pipeline": 8, "best_batch": 64}))
+    assert w.bench_done("mesh") is False  # batch superseded
