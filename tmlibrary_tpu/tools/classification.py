@@ -86,6 +86,13 @@ def _kbest_anova(
 
 @register_tool("classification")
 class Classification(Tool):
+    """Supervised per-object classification (logreg on the MXU, or
+    sklearn svm/randomforest).  Payload: ``objects_name``,
+    ``training_examples`` ([{site_index, label, class}, ...]),
+    optional ``method``, ``features``, ``select_k_best`` (ANOVA-F
+    univariate selection).  Reports training_accuracy + per-class
+    counts in the result attributes."""
+
     def process(self, payload: dict) -> ToolResult:
         objects_name = payload["objects_name"]
         method = payload.get("method", "logreg")

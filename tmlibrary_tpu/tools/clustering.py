@@ -60,6 +60,10 @@ def kmeans(
 
 @register_tool("clustering")
 class Clustering(Tool):
+    """k-means over object features (JAX Lloyd's, deterministic
+    seeding).  Payload: ``objects_name``, optional ``k`` (default 3)
+    and ``features``.  Reports per-cluster sizes + inertia."""
+
     def process(self, payload: dict) -> ToolResult:
         objects_name = payload["objects_name"]
         k = int(payload.get("k", 3))

@@ -15,6 +15,10 @@ from tmlibrary_tpu.tools.base import Plot, Tool, ToolResult, register_tool
 
 @register_tool("heatmap")
 class Heatmap(Tool):
+    """One feature as a continuous per-object layer plus a per-well
+    plate_heatmap Plot.  Payload: ``objects_name``, ``feature``.
+    Attributes carry min/max and the robust p01/p99 display window."""
+
     def process(self, payload: dict) -> ToolResult:
         objects_name = payload["objects_name"]
         feature = payload.get("feature")
