@@ -58,12 +58,30 @@ CACHE_PATH = os.environ.get(
 )
 
 
+def tuning_json_path() -> str:
+    """ONE definition of the tuning-results location (and its rehearsal
+    redirect) shared by bench, tune_tpu, tpu_watch and
+    update_baseline_table — resolved at call time so env changes (the
+    rehearsal bootstrap) take effect without re-imports."""
+    return os.environ.get(
+        "TMX_TUNING_JSON", os.path.join(REPO, "tuning", "TUNING.json")
+    )
+
+
+def profile_json_path() -> str:
+    """Same contract for the per-stage profile capture."""
+    return os.environ.get(
+        "TMX_PROFILE_JSON", os.path.join(REPO, "tuning", "PROFILE_TPU.json")
+    )
+
+
 def _load_tuning() -> "dict | None":
     """The machine-written tuning verdict, or None.  ONE provenance gate
     for every tuned default: only a file ``tune_tpu.py write_results``
-    itself produced counts (the round-2 hand-seeded file is rejected)."""
+    itself produced counts (the round-2 hand-seeded file is rejected).
+    ``TMX_TUNING_JSON`` redirects the file (watcher rehearsal)."""
     try:
-        with open(os.path.join(REPO, "tuning", "TUNING.json")) as f:
+        with open(tuning_json_path()) as f:
             tuning = json.load(f)
     except (OSError, ValueError):
         return None
@@ -929,7 +947,12 @@ def main() -> None:
             if line.startswith("{"):
                 # error record from a cpu fallback gets annotated below
                 out = json.loads(line)
-                if platform == "cpu":
+                if platform == "cpu" and forced_cpu:
+                    # a REQUESTED cpu run (rehearsal) is not a failure:
+                    # no error stamp, but a backend name that still can
+                    # never pass the on-hardware checks
+                    out["backend"] = "cpu_forced"
+                elif platform == "cpu":
                     out["backend"] = "cpu_fallback"
                     out["error"] = f"tpu unavailable: {last_err}"
                 print(json.dumps(out), flush=True)
@@ -941,6 +964,13 @@ def main() -> None:
         print(f"bench: {last_err}", file=sys.stderr, flush=True)
         return False
 
+    forced_cpu = bool(os.environ.get("BENCH_FORCE_CPU"))
+    if forced_cpu:
+        # rehearsal/test hook: skip the device ladder AND the cache so
+        # the run measures fresh on CPU; the record says cpu_forced, so
+        # it can never pass as hardware evidence
+        last_err = "BENCH_FORCE_CPU=1 (rehearsal)"
+        attempts = 0
     for i in range(attempts):
         if try_once("default"):
             return
@@ -948,7 +978,7 @@ def main() -> None:
             time.sleep(backoff_s * (i + 1))
     # chip never came up: prefer the watcher's cached ON-HARDWARE number
     # (honest provenance beats a fresh-but-wrong-backend measurement) …
-    if emit_cached_tpu(last_err):
+    if attempts and emit_cached_tpu(last_err):
         return
     # … and only then fall back to the CPU backend so the round still
     # produces a measured number, annotated as a fallback

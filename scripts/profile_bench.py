@@ -12,6 +12,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+if os.environ.get("BENCH_FORCE_CPU"):
+    # rehearsal: never touch the device backend — the relay may be
+    # hanging, and JAX caches a failed init for the process lifetime
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 

@@ -5,18 +5,34 @@ The axon relay in this environment drops for hours at a time and
 ``jax.devices()`` can hang — or even return lazily while real compute
 still hangs — when it is down.  This watcher loops a *real-computation*
 probe (see ``bench.PROBE_CODE``) and, on the first live window, runs the
-pending on-hardware work in priority order, flushing results to disk
-after every item so a mid-window relay death loses nothing:
+pending on-hardware work in VALUE order, flushing results to disk after
+every item so a mid-window relay death loses nothing.
 
-1. headline bench configs (3, 3 at the production max_objects=256, 4,
-   corilla, volume, 2) -> ``tuning/BENCH_TPU.json`` records with full
-   provenance (timestamp, wall time, env, raw record);
-2. the tuning sweep (``scripts/tune_tpu.py``, itself stage-resilient)
-   -> ``tuning/TUNING.json``; already-completed stages are skipped via
-   ``TUNE_SKIP`` so a second window only runs what is still missing.
+Queue order (round-4 VERDICT weak #6: windows last minutes; the first
+one must not be burned on the long tail):
 
-``bench.py`` emits the freshest cached record (``backend: tpu_cached``)
-whenever the driver runs it while the relay is down.
+1. ``tune:pipeline`` — the fetch-amortization depth sweep.  Every other
+   record's staleness is judged against ``best_pipeline``, so it goes
+   first; it runs at the best KNOWN batch (carried from the previous
+   methodology's sweep until the new sweep reruns — see tune_tpu.py).
+2. ``bench:3`` / ``bench:3@mo256`` — the headline Cell Painting numbers.
+3. ``profile`` — the per-stage breakdown BASELINE.md's binding-resource
+   line renders from.
+4. the remaining bench configs (cheap, each flushed on capture).
+5. the remaining tune stages (sweep/kernels/glcm — the long tail).  A
+   sweep rerun that changes ``best_batch`` re-pends ``tune:pipeline``
+   and the affected bench records; the loop re-evaluates every pass.
+
+Per-item spend caps: priority bench items get the full 900 s attempt
+budget; tail items are capped tighter so one hung config cannot eat a
+whole window.
+
+Rehearsal mode (``--rehearse DIR``): runs the priority capture path —
+tune:pipeline -> bench:3 -> profile -> BASELINE re-render — end to end
+on the CPU backend against a fake always-alive relay, with every
+artifact redirected into DIR.  ``tests/test_watch_rehearsal.py`` runs it
+in the suite so a plumbing bug surfaces there instead of burning the
+first real relay window.
 
 Launch detached:  nohup python scripts/tpu_watch.py >> tuning/watch.log 2>&1 &
 Idempotent: a second copy exits if the pidfile's process is still alive.
@@ -34,13 +50,19 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-from bench import CACHE_PATH, probe_accelerator  # noqa: E402
+from bench import (  # noqa: E402
+    CACHE_PATH,
+    probe_accelerator,
+    profile_json_path,
+    tuning_json_path,
+)
 
-TUNING_PATH = os.path.join(REPO, "tuning", "TUNING.json")
-PROFILE_PATH = os.path.join(REPO, "tuning", "PROFILE_TPU.json")
+TUNING_PATH = tuning_json_path()
+PROFILE_PATH = profile_json_path()
 PID_PATH = os.path.join(REPO, "tuning", "watch.pid")
 
-# (cache key, bench env) in priority order — headline first.
+# (cache key, bench env); PRIORITY_BENCH members are fired first with the
+# full spend budget, the rest follow capped tighter (see all_pending()).
 BENCH_ITEMS = [
     ("3", {"BENCH_CONFIG": "3"}),
     ("3@mo256", {"BENCH_CONFIG": "3", "BENCH_MAX_OBJECTS": "256"}),
@@ -55,6 +77,7 @@ BENCH_ITEMS = [
     # and its throughput under shard_map are hardware evidence)
     ("mesh", {"BENCH_CONFIG": "mesh"}),
 ]
+PRIORITY_BENCH = ("3", "3@mo256")
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed
     "sweep": "batch_sweep",
@@ -70,10 +93,26 @@ def log(msg: str) -> None:
     print(f"[watch {stamp}] {msg}", flush=True)
 
 
+def _rehearsal() -> bool:
+    return bool(os.environ.get("WATCH_REHEARSAL"))
+
+
+def _extra_env() -> dict:
+    """Env re-applied to every child AFTER the BENCH_*/TMX_*/TUNE_* strip:
+    the rehearsal's CPU forcing and artifact redirection ride this; empty
+    (no behavior change) in production."""
+    try:
+        return json.loads(os.environ.get("WATCH_EXTRA_ENV", "") or "{}")
+    except ValueError:
+        return {}
+
+
 def probe(timeout: int = 120) -> bool:
     # shared with bench.py: requires a round-tripped computation on a
     # NON-CPU backend (a cpu backend passing the computation would loop
     # the watcher forever re-measuring benchmarks it then discards)
+    if _rehearsal():
+        return True
     return probe_accelerator(timeout)
 
 
@@ -99,6 +138,11 @@ def bench_done(key: str) -> bool:
     entry = (load_json(CACHE_PATH).get("records") or {}).get(key)
     if not (entry and entry.get("record")):
         return False
+    if _rehearsal():
+        # one capture proves the plumbing; the staleness chain below is
+        # unit-tested separately (test_scripts.py) and would otherwise
+        # loop the rehearsal forever (CPU records have depth 1)
+        return True
     # a record is only done when measured at the CURRENT defaults: a
     # superseded best_pipeline or best_batch makes emit_cached_tpu's
     # knob check (batch) or the headline methodology (depth) diverge
@@ -121,10 +165,13 @@ def bench_done(key: str) -> bool:
     return True
 
 
-def run_bench_item(key: str, overrides: dict) -> bool:
+def run_bench_item(
+    key: str, overrides: dict, timeout_s: int = 1500,
+    attempt_timeout_s: int = 900,
+) -> bool:
     """One live measurement of ``bench.py``; returns False (relay gone or
     measurement failed) without touching the cache unless the record is a
-    genuine on-hardware one."""
+    genuine on-hardware one (or a rehearsal capture, marked as such)."""
     # strip inherited BENCH_*/TMX_* knobs: a stray export in the launching
     # shell must not change the measured workload while entry['env'] claims
     # only the overrides were set
@@ -132,16 +179,17 @@ def run_bench_item(key: str, overrides: dict) -> bool:
         k: v for k, v in os.environ.items()
         if not k.startswith(("BENCH_", "TMX_", "TUNE_"))
     }
+    env.update(_extra_env())
     env.update(
         BENCH_ATTEMPTS="1",          # the watcher IS the retry loop
-        BENCH_ATTEMPT_TIMEOUT="900",
+        BENCH_ATTEMPT_TIMEOUT=str(attempt_timeout_s),
         **{k: str(v) for k, v in overrides.items()},
     )
     t0 = time.time()
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
-            env=env, capture_output=True, text=True, timeout=1500,
+            env=env, capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
         log(f"bench[{key}]: timed out")
@@ -155,7 +203,11 @@ def run_bench_item(key: str, overrides: dict) -> bool:
             f"stderr: {r.stderr[-200:]}")
         return False
     backend = record.get("backend", "")
-    if backend.startswith("cpu") or backend == "tpu_cached" or "error" in record:
+    on_hardware = not (
+        backend.startswith("cpu") or backend == "tpu_cached"
+        or "error" in record
+    )
+    if not on_hardware and not _rehearsal():
         log(f"bench[{key}]: not on-hardware (backend={backend}) — relay died?")
         return False
     cache = load_json(CACHE_PATH)
@@ -167,9 +219,12 @@ def run_bench_item(key: str, overrides: dict) -> bool:
         "wall_s": round(time.time() - t0, 1),
         "env": overrides,
         "provenance": (
+            "REHEARSAL capture (cpu, fake relay) — never hardware evidence"
+            if _rehearsal() else
             "measured live by scripts/tpu_watch.py during a relay-up window; "
             "BENCH_ATTEMPTS=1 per window, watcher retries across windows"
         ),
+        **({"rehearsal": True} if _rehearsal() else {}),
     }
     save_cache(cache)
     log(f"bench[{key}]: CAPTURED {record.get('value')} {record.get('unit', '')}"
@@ -184,6 +239,8 @@ def profile_done() -> bool:
     from bench import _default_batch, _tuned_pipeline_default
 
     prof = load_json(PROFILE_PATH)
+    if _rehearsal():
+        return bool(prof.get("stages_ms"))
     return bool(
         prof.get("stages_ms")
         and prof.get("pipeline") == _tuned_pipeline_default()
@@ -198,6 +255,7 @@ def run_profile() -> bool:
         k: v for k, v in os.environ.items()
         if not k.startswith(("BENCH_", "TMX_", "TUNE_", "PROFILE_"))
     }
+    env.update(_extra_env())
     env.update(
         BENCH_BATCH=str(_default_batch("3")),
         PROFILE_PIPELINE=str(_tuned_pipeline_default()),
@@ -232,7 +290,13 @@ def render_baseline() -> None:
         log(f"update_baseline_table failed: {exc}")
 
 
-def pending_tune_stages() -> list:
+def _direct_pending_tune() -> list:
+    """Stages whose OWN verdict is missing/stale — without the
+    sweep->pipeline coupling below.  run_tune judges success against
+    this (a stage-limited tune:pipeline run that lands its verdict must
+    not read as failed just because the sweep is still pending), and
+    all_pending uses it to decide when tune:pipeline deserves the front
+    of the queue."""
     from scripts.tune_tpu import METHODOLOGY
 
     tuning = load_json(TUNING_PATH)
@@ -251,36 +315,145 @@ def pending_tune_stages() -> list:
             continue  # tune_tpu only runs it when pallas wins
         if key not in tuning or stage in errors:
             out.append(stage)
+    return out
+
+
+def pending_tune_stages() -> list:
+    out = _direct_pending_tune()
     # the pipeline sweep depends on best_batch: whenever sweep reruns,
-    # pipeline must rerun with it (tune_tpu also drops the stale verdict)
+    # pipeline must rerun with it (tune_tpu itself drops the stale
+    # verdict when the sweep executes, which re-pends it directly — this
+    # coupled entry just reports the consequence up front)
     if "sweep" in out and "pipeline" not in out:
         out.append("pipeline")
     return out
 
 
-def run_tune() -> bool:
-    skip = [s for s in TUNE_STAGES if s not in pending_tune_stages()]
+def run_tune(stages: "list | None" = None, timeout_s: int = 7200) -> bool:
+    """Run tune_tpu restricted to ``stages`` (None = every pending one);
+    success means none of the TARGET stages is still pending after."""
+    targets = set(stages if stages is not None else pending_tune_stages())
+    skip = [s for s in TUNE_STAGES if s not in targets]
     env = dict(os.environ, TUNE_SKIP=",".join(skip))
-    log(f"tune_tpu: running (skip={skip or 'none'})")
+    env.update(_extra_env())
+    log(f"tune_tpu: running (stages={sorted(targets)})")
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "scripts", "tune_tpu.py")],
-            env=env, capture_output=True, text=True, timeout=7200,
+            env=env, capture_output=True, text=True, timeout=timeout_s,
         )
     except subprocess.TimeoutExpired:
         log("tune_tpu: timed out (partial stages are already flushed)")
         return False
     tail = "\n".join(r.stdout.splitlines()[-12:])
     log(f"tune_tpu rc={r.returncode}:\n{tail}")
-    return r.returncode == 0 and not pending_tune_stages()
+    # success = every TARGET stage landed its own verdict; the coupled
+    # pending list would mark a successful pipeline-only run failed
+    # whenever the sweep is still pending
+    return r.returncode == 0 and not (targets & set(_direct_pending_tune()))
 
 
 def all_pending() -> list:
-    items = [f"bench:{k}" for k, _ in BENCH_ITEMS if not bench_done(k)]
-    items += [f"tune:{s}" for s in pending_tune_stages()]
+    """Pending work labels in FIRE order (the value-first queue from the
+    module docstring); WATCH_ONLY=<label,label> restricts it."""
+    tune_pending = _direct_pending_tune()
+    labels = []
+    if "pipeline" in tune_pending:
+        labels.append("tune:pipeline")
+    for k in PRIORITY_BENCH:
+        if not bench_done(k):
+            labels.append(f"bench:{k}")
     if not profile_done():
-        items.append("profile")
-    return items
+        labels.append("profile")
+    labels += [
+        f"bench:{k}" for k, _ in BENCH_ITEMS
+        if k not in PRIORITY_BENCH and not bench_done(k)
+    ]
+    labels += [f"tune:{s}" for s in tune_pending if s != "pipeline"]
+    only = set(filter(None, os.environ.get("WATCH_ONLY", "").split(",")))
+    if only:
+        labels = [l for l in labels if l in only]
+    return labels
+
+
+def fire_pending(pending: list) -> bool:
+    """One pass over the queue; returns True if anything was captured.
+    Stops early when the relay looks dead (a failed bench/profile item)
+    and after a multi-stage tune run (it may invalidate earlier items —
+    the caller's next pass re-evaluates)."""
+    items = dict(BENCH_ITEMS)
+    captured = False
+    for label in pending:
+        if label == "tune:pipeline":
+            # a failure here must NOT block the headline bench items:
+            # they can still measure at the previous depth default
+            captured |= run_tune(["pipeline"], timeout_s=2400)
+        elif label == "profile":
+            ok = run_profile()
+            captured |= ok
+            if not ok:
+                break
+        elif label.startswith("bench:"):
+            key = label[6:]
+            fast = key in PRIORITY_BENCH
+            ok = run_bench_item(
+                key, items[key],
+                timeout_s=1500 if fast else 700,
+                attempt_timeout_s=900 if fast else 600,
+            )
+            captured |= ok
+            if not ok:
+                break  # relay likely died; back to probing
+        elif label.startswith("tune:"):
+            stages = [l[5:] for l in pending if l.startswith("tune:")
+                      and l != "tune:pipeline"]
+            captured |= run_tune(stages, timeout_s=7200)
+            break  # sweep may have re-pended pipeline/bench: re-evaluate
+    return captured
+
+
+def rehearse_setup(wdir: str) -> None:
+    """Redirect every capture artifact into ``wdir``, fake the relay
+    probe, and force every child onto the CPU backend so the priority
+    capture path runs end to end with no hardware (module docstring)."""
+    global CACHE_PATH, TUNING_PATH, PROFILE_PATH, PID_PATH
+    from scripts.tune_tpu import METHODOLOGY
+
+    os.makedirs(wdir, exist_ok=True)
+    extra = {
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_REPS": "1",
+        "BENCH_SITE_SIZE": os.environ.get("WATCH_REHEARSE_SITE", "128"),
+        "TMX_TUNING_JSON": os.path.join(wdir, "TUNING.json"),
+        "BENCH_TPU_CACHE": os.path.join(wdir, "BENCH_TPU.json"),
+        "TMX_PROFILE_JSON": os.path.join(wdir, "PROFILE.json"),
+        "TMX_BASELINE_MD": os.path.join(wdir, "BASELINE.md"),
+    }
+    os.environ.update(extra)
+    os.environ["WATCH_EXTRA_ENV"] = json.dumps(extra)
+    os.environ.setdefault("WATCH_ONLY", "tune:pipeline,bench:3,profile")
+    os.environ.update(
+        WATCH_REHEARSAL="1", WATCH_ONESHOT="1", WATCH_POLL_S="1"
+    )
+    CACHE_PATH = extra["BENCH_TPU_CACHE"]
+    TUNING_PATH = extra["TMX_TUNING_JSON"]
+    PROFILE_PATH = extra["TMX_PROFILE_JSON"]
+    PID_PATH = os.path.join(wdir, "watch.pid")
+    # seed: a machine-provenance tuning file at a tiny batch (small
+    # compiles) whose methodology matches tune_tpu's, so exactly the
+    # pipeline stage reads as pending — the first-window shape
+    with open(TUNING_PATH, "w") as f:
+        json.dump({
+            "written_by": "scripts/tpu_watch.py --rehearse (seed)",
+            "timing_methodology": METHODOLOGY,
+            "batch_sweep": {"8": 0.0},
+            "best_batch": 8,
+            "backend": "cpu",
+            "device": "rehearsal-seed",
+        }, f)
+    with open(extra["TMX_BASELINE_MD"], "w") as f:
+        f.write("# rehearsal baseline\n")
+    log(f"rehearsal: artifacts in {wdir}, queue {os.environ['WATCH_ONLY']}")
 
 
 def main() -> None:
@@ -315,7 +488,8 @@ def main() -> None:
     atexit.register(_cleanup_pidfile)
     signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))
 
-    log(f"watcher up (pid {os.getpid()}); pending: {all_pending()}")
+    log(f"watcher up (pid {os.getpid()}); pending (fire order): "
+        f"{all_pending()}")
     poll_s = int(os.environ.get("WATCH_POLL_S", "60"))
     while True:
         pending = all_pending()
@@ -325,27 +499,21 @@ def main() -> None:
         if not probe():
             time.sleep(poll_s)
             continue
-        log(f"relay ALIVE — firing pending work: {pending}")
-        captured = False
-        for key, overrides in BENCH_ITEMS:
-            if not bench_done(key):
-                if not run_bench_item(key, overrides):
-                    break  # relay likely died; back to probing
-                captured = True
-        else:
-            if pending_tune_stages():
-                run_tune()
-                captured = True  # tune flushes TUNING.json per stage
-            # profile last: it informs BASELINE.md's stage table but the
-            # headline records and tuned defaults matter more if the
-            # window dies mid-way.  Tuning may have changed the defaults,
-            # so bench/profile staleness is re-evaluated next loop pass.
-            if not pending_tune_stages() and not profile_done():
-                captured |= run_profile()
-        if captured:  # don't churn BASELINE.md on no-progress passes
+        log(f"relay ALIVE — firing pending work (priority order): {pending}")
+        if fire_pending(pending):  # don't churn BASELINE.md on no-progress
             render_baseline()
+        if os.environ.get("WATCH_ONESHOT"):
+            log("oneshot: exiting after first fire pass")
+            break
         time.sleep(10)
 
 
 if __name__ == "__main__":
+    if "--rehearse" in sys.argv:
+        idx = sys.argv.index("--rehearse")
+        try:
+            wdir = sys.argv[idx + 1]
+        except IndexError:
+            sys.exit("--rehearse needs a workdir argument")
+        rehearse_setup(os.path.abspath(wdir))
     main()

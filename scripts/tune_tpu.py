@@ -23,6 +23,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from bench import tuning_json_path  # noqa: E402  (one shared definition)
+
+TUNING_PATH = tuning_json_path()
 RESULTS: dict = {}
 
 # Timing methodology marker.  Each kernel timing enqueues PIPELINE
@@ -48,9 +52,18 @@ def run_bench(env_overrides):
         if line.startswith("{"):
             rec = json.loads(line)
             backend = rec.get("backend", "")
+            if "error" in rec:
+                # an all-backends-failed record carries value 0.0 —
+                # recording it would turn the sweep into garbage verdicts
+                raise RuntimeError(f"bench errored: {rec['error']}")
             # a sweep point must be a LIVE on-hardware measurement — a
             # cached or cpu-fallback record would silently repeat one
-            # stale number for every batch size
+            # stale number for every batch size.  The ONE exception is
+            # the forced-CPU rehearsal (backend cpu_forced, error-free),
+            # whose artifacts never leave its temp dir
+            # (scripts/tpu_watch.py --rehearse).
+            if os.environ.get("BENCH_FORCE_CPU") and backend == "cpu_forced":
+                return rec
             if backend.startswith("cpu") or backend == "tpu_cached":
                 raise RuntimeError(
                     f"bench fell back to {backend} (relay died?) — "
@@ -180,9 +193,9 @@ def main():
     import jax
 
     skip = set(filter(None, os.environ.get("TUNE_SKIP", "").split(",")))
-    prior_path = os.path.join(REPO, "tuning", "TUNING.json")
-    if os.path.exists(prior_path):
-        with open(prior_path) as f:
+    prior = {}
+    if os.path.exists(TUNING_PATH):
+        with open(TUNING_PATH) as f:
             prior = json.load(f)
         # only merge results that write_results() itself produced: merging
         # a hand-transcribed file and then stamping it written_by would
@@ -196,6 +209,14 @@ def main():
             and prior.get("timing_methodology") == METHODOLOGY
         ):
             RESULTS.update(prior)
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # rehearsal: never touch the device backend in-process — the
+        # relay may be hanging, and JAX caches a failed init for the
+        # process lifetime
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
 
     # backend init is the flakiest part of the relay (it can raise seconds
     # after a successful device probe), and JAX caches the failure for the
@@ -221,6 +242,19 @@ def main():
     if "sweep" not in skip:
         RESULTS.pop("pipeline_sweep", None)
         RESULTS.pop("best_pipeline", None)
+    elif (
+        "best_batch" not in RESULTS
+        and prior.get("written_by") == "scripts/tune_tpu.py write_results"
+        and isinstance(prior.get("best_batch"), int)
+    ):
+        # parameter carry, NOT a result: a stage-limited run (the
+        # watcher's first-window ``tune:pipeline`` priority item) still
+        # needs the best KNOWN batch.  The previous methodology's sweep
+        # winner is the best estimate; the flag marks it un-measured
+        # under this methodology, and do_sweep clears it when the real
+        # sweep reruns.
+        RESULTS["best_batch"] = prior["best_batch"]
+        RESULTS["best_batch_carried"] = True
     # kernel_errors entries belong to the kernels stage (cc_/ws_/dt_*)
     # or the glcm stage (glcm_*) — keep only the skipped stage's
     keep = {
@@ -264,6 +298,7 @@ def main():
                 best = (batch, r["value"])
         RESULTS["batch_sweep"] = sweep
         RESULTS["best_batch"] = best[0]
+        RESULTS.pop("best_batch_carried", None)
         print(f"best batch: {best[0]} ({best[1]} sites/s)")
 
     def do_pipeline():
@@ -323,12 +358,10 @@ def write_results():
 
     RESULTS["written_by"] = "scripts/tune_tpu.py write_results"
     RESULTS["written_at"] = time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime())
-    out_dir = os.path.join(REPO, "tuning")
-    os.makedirs(out_dir, exist_ok=True)
-    out_path = os.path.join(out_dir, "TUNING.json")
-    with open(out_path, "w") as f:
+    os.makedirs(os.path.dirname(TUNING_PATH), exist_ok=True)
+    with open(TUNING_PATH, "w") as f:
         json.dump(clean(RESULTS), f, indent=2, sort_keys=True, allow_nan=False)
-    print(f"wrote {out_path} — commit it to make these the defaults")
+    print(f"wrote {TUNING_PATH} — commit it to make these the defaults")
 
 
 if __name__ == "__main__":

@@ -106,6 +106,35 @@ def test_pending_tune_couples_pipeline_to_sweep(monkeypatch, tmp_path):
     assert "pipeline" in pending  # rerunning sweep invalidates pipeline
 
 
+def test_pipeline_only_tune_run_counts_as_success(monkeypatch, tmp_path):
+    """First-window shape: with a methodology-stale TUNING.json the
+    queue leads with tune:pipeline; once the pipeline-only run lands its
+    verdict (sweep still pending), the stage reads done DIRECTLY — the
+    sweep->pipeline coupling must not re-queue it at the front or make
+    run_tune report the successful run as failed."""
+    from scripts.tune_tpu import METHODOLOGY
+
+    w = _watch(
+        monkeypatch, tmp_path,
+        tuning={**MACHINE, "timing_methodology": "per-execution (old)"},
+    )
+    assert w.all_pending()[0] == "tune:pipeline"
+
+    # simulate what the stage-limited tune run writes: a new-methodology
+    # file with ONLY the pipeline verdict plus the carried batch
+    (tmp_path / "tuning" / "TUNING.json").write_text(json.dumps({
+        **MACHINE, "timing_methodology": METHODOLOGY,
+        "pipeline_sweep": {"8": 100.0}, "best_pipeline": 8,
+        "best_batch": 128, "best_batch_carried": True,
+    }))
+    assert "pipeline" not in w._direct_pending_tune()
+    assert "pipeline" in w.pending_tune_stages()  # coupled: sweep pending
+    pending = w.all_pending()
+    assert "tune:pipeline" not in pending
+    assert "tune:sweep" in pending
+    assert pending[0].startswith("bench:")  # headline bench now leads
+
+
 def test_bench_done_exempts_unpipelined_records(monkeypatch, tmp_path):
     """A host-synchronous config (spatial: pipelined=false, no depth)
     must count as done — without the exemption the watcher would

@@ -1,0 +1,70 @@
+"""End-to-end rehearsal of the watcher's first-window capture path.
+
+Round-4 VERDICT next-step #2: relay windows last minutes and the queue
+is long — the first real window must not be burned by a plumbing bug in
+the capture chain.  ``scripts/tpu_watch.py --rehearse DIR`` runs the
+priority path (tune:pipeline -> bench:3 -> profile -> BASELINE render)
+against a fake always-alive relay on the CPU backend, with every
+artifact redirected into DIR; this test asserts each artifact landed
+with the shape the real window would produce.
+"""
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_watch_rehearsal_captures_priority_queue(tmp_path):
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(
+            ("WATCH_", "BENCH_", "TMX_", "TUNE_", "PROFILE_")
+        )
+    }
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "tpu_watch.py"),
+         "--rehearse", str(tmp_path)],
+        capture_output=True, text=True, timeout=900, env=env,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    # the queue fired in priority order
+    fire = next(
+        (l for l in r.stdout.splitlines() if "firing pending work" in l), ""
+    )
+    assert fire.index("tune:pipeline") < fire.index("bench:3") < fire.index(
+        "profile"
+    ), fire
+
+    # 1. tune:pipeline -> a machine-written depth verdict at the seeded batch
+    tuning = json.loads((tmp_path / "TUNING.json").read_text())
+    assert tuning["written_by"] == "scripts/tune_tpu.py write_results"
+    assert tuning["pipeline_sweep"] and tuning["best_pipeline"] >= 1
+    # every sweep point is a REAL measurement — an all-backends-failed
+    # 0.0 record slipping through would make the depth verdict garbage
+    assert all(v > 0 for v in tuning["pipeline_sweep"].values())
+    assert "pipeline" not in tuning.get("stage_errors", {})
+    assert tuning["best_batch"] == 8  # seed preserved through the merge
+
+    # 2. bench:3 -> a cache record at the tuned batch, marked rehearsal
+    cache = json.loads((tmp_path / "BENCH_TPU.json").read_text())
+    entry = cache["records"]["3"]
+    assert entry["rehearsal"] is True
+    assert "never hardware evidence" in entry["provenance"]
+    assert entry["record"]["backend"] == "cpu_forced"
+    assert "error" not in entry["record"]
+    assert entry["record"]["value"] > 0
+    assert entry["record"]["batch"] == 8  # tuned default flowed through
+
+    # 3. profile -> per-stage breakdown at the tuned defaults
+    prof = json.loads((tmp_path / "PROFILE.json").read_text())
+    assert prof["stages_ms"] and prof["batch"] == 8
+    assert prof["pipeline"] == tuning["best_pipeline"]
+
+    # 4. BASELINE re-render pulled all three artifacts together
+    baseline = (tmp_path / "BASELINE.md").read_text()
+    assert "Cell Painting" in baseline
+    assert "| pipeline depth | sites/s |" in baseline
+    assert "Binding stage for config 3" in baseline
