@@ -74,7 +74,10 @@ def collective_budget(hlo_text: str) -> dict:
     for line in hlo_text.splitlines():
         stripped = line.strip()
         # instruction lines look like:  %name = f32[2,64]{1,0} all-gather(...)
-        m = re.match(r"%?[\w.\-]+ = (.+?) ([\w\-]+)\(", stripped)
+        # — and a computation's last instruction is prefixed "ROOT ": a
+        # collective emitted as the ROOT must still count, or the
+        # "communication-free" assertion could false-pass
+        m = re.match(r"(?:ROOT )?%?[\w.\-]+ = (.+?) ([\w\-]+)\(", stripped)
         if not m:
             continue
         shapes, op = m.groups()

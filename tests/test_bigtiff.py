@@ -156,8 +156,10 @@ def test_rgb_and_tiled_fall_through(tmp_path, planes):
 
 def test_parse_cache_detects_same_size_in_place_rewrite(tmp_path, planes):
     """A same-size rewrite inside one mtime tick must not serve a stale
-    IFD parse: the validation key crcs the head/tail regions, which hold
-    every parse-relevant byte in this layout."""
+    IFD parse: the validation key crcs the header plus EVERY walked IFD
+    table span (wherever it sits in the file — mid-file IFDs included,
+    round-4 advisor), so any parse-relevant byte change invalidates.
+    The mtime is pinned across the rewrite to force the crc path."""
 
     def _entry_value_pos(buf, ifd_off, tag):
         (n,) = struct.unpack_from("<Q", buf, ifd_off)
@@ -167,8 +169,11 @@ def test_parse_cache_detects_same_size_in_place_rewrite(tmp_path, planes):
                 return p + 12
         raise AssertionError(f"tag {tag} missing")
 
+    import os
+
     path = write_tiff(tmp_path / "c.tif", planes, big=True)
     np.testing.assert_array_equal(read_tiff_page_py(path, 0), planes[0])
+    st = os.stat(path)
 
     buf = bytearray(path.read_bytes())
     (ifd0,) = struct.unpack_from("<Q", buf, 8)
@@ -180,7 +185,8 @@ def test_parse_cache_detects_same_size_in_place_rewrite(tmp_path, planes):
     (o1,) = struct.unpack_from("<Q", buf, v1)
     struct.pack_into("<Q", buf, v0, o1)
     struct.pack_into("<Q", buf, v1, o0)
-    path.write_bytes(bytes(buf))  # same size, possibly same mtime tick
+    path.write_bytes(bytes(buf))  # same size …
+    os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))  # … same mtime
     np.testing.assert_array_equal(read_tiff_page_py(path, 0), planes[1])
 
 

@@ -91,6 +91,23 @@ def test_sync_hosts_single_host_noop():
     sync_hosts("test")  # must not raise or hang on one host
 
 
+def test_collective_budget_counts_root_collectives():
+    """A collective emitted as a computation ROOT still counts (round-4
+    advisor: the old regex required the line to START with the name, so
+    `ROOT %x = ... all-gather(...)` was silently uncounted and the
+    zero-collectives guarantee could false-pass)."""
+    from scripts.comm_budget import collective_budget
+
+    hlo = "\n".join([
+        "  %x = f32[8]{0} add(%a, %b)",
+        "  ROOT %ag = f32[2,64]{1,0} all-gather(%x), dimensions={0}",
+        "  %ar.1 = f32[4]{0} all-reduce-start(%y), to_apply=%sum",
+    ])
+    budget = collective_budget(hlo)
+    assert budget["all-gather"] == {"count": 1, "bytes": 2 * 64 * 4}
+    assert budget["all-reduce"]["count"] == 1
+
+
 def test_sharded_batch_fn_is_communication_free(devices):
     """The production multi-chip jterator path
     (``build_sharded_batch_fn``) must compile to ZERO collectives —

@@ -305,6 +305,25 @@ def test_simplify_polygon_never_degenerate():
     assert all(tuple(p) in in_set for p in s.tolist())
 
 
+def test_mosaic_stats_reject_out_of_range_labels():
+    """rc=-1 from the native kernels means CORRUPT INPUT (a label
+    outside [0, count]), not 'kernel unavailable' — the hosts must raise
+    a clear ValueError instead of paying a second plate-scale pass and
+    dying with an incidental bincount/ufunc error (round-4 advisor)."""
+    from tmlibrary_tpu import native
+
+    lib = native._load()
+    if lib is None or not hasattr(lib, "tm_mosaic_intensity"):
+        pytest.skip("native library unavailable")
+    labels = np.zeros((4, 5), np.int32)
+    labels[1, 2] = 9  # > count
+    vals = np.ones((4, 5), np.float32)
+    with pytest.raises(ValueError, match="outside"):
+        native.mosaic_intensity_host(labels, vals, 3)
+    with pytest.raises(ValueError, match="outside"):
+        native.mosaic_morph_host(labels, 3)
+
+
 def test_mosaic_stats_native_matches_fallback_and_golden(rng):
     """tm_mosaic_intensity / tm_mosaic_morph vs the chunked-numpy twins
     vs direct per-label numpy — the spatial layout's feature

@@ -90,6 +90,31 @@ def test_fuzz_nd2_lossless(tmp_path):
     _fuzz(make, ND2Reader, tmp_path, ".nd2", 12)
 
 
+def test_nd2_lossless_rejects_oversized_stream(tmp_path, monkeypatch):
+    """A lossless stream that inflates to MORE than the declared
+    geometry means mis-modeled width/height/components — it must raise
+    MetadataError, not be truncated into plausible-looking pixels
+    (DESIGN.md 9e; round-4 advisor)."""
+    import zlib
+
+    from test_nd2 import write_nd2
+
+    from tmlibrary_tpu.errors import MetadataError
+    from tmlibrary_tpu.readers import ND2Reader
+
+    planes = np.full((1, 4, 5, 1), 7, dtype=np.uint16)
+    path = tmp_path / "a.nd2"
+    write_nd2(path, planes, compression="lossless")
+    with ND2Reader(str(path)) as r:
+        assert r.read_plane(0).shape == (4, 5)  # sane baseline
+        oversized = zlib.compress(planes[0].tobytes() + b"\x00\x00")
+        monkeypatch.setattr(
+            r, "_chunk_payload", lambda off: b"\x00" * 8 + oversized
+        )
+        with pytest.raises(MetadataError, match="expected"):
+            r.read_plane(0)
+
+
 def test_fuzz_czi(tmp_path):
     from test_czi import write_czi
 

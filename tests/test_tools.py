@@ -433,6 +433,40 @@ def test_heatmap_plate_plot_and_robust_window(store_with_features):
     )
 
 
+def test_heatmap_emits_all_nan_well_with_null_mean(tmp_path, rng):
+    """An all-NaN well (every object's feature degenerate) stays in the
+    plate_heatmap wells list with ``mean: null`` — dropping it would be
+    indistinguishable from a well outside the plate (round-4 advisor)."""
+    exp = grid_experiment(name="nanwell", well_rows=1, well_cols=2,
+                          sites_per_well=(1, 1), site_shape=(16, 16))
+    store = ExperimentStore.create(tmp_path / "exp", exp)
+    rows = []
+    for well_col in (0, 1):
+        for label in range(1, 4):
+            rows.append({
+                "site_index": well_col,
+                "plate": "plate00",
+                "well_row": 0,
+                "well_col": well_col,
+                "site_y": 0,
+                "site_x": 0,
+                "label": label,
+                "Morphology_area": np.nan if well_col else 100.0 + label,
+            })
+    store.append_features("nuclei", pd.DataFrame(rows), shard="batch_000")
+    result = ToolRequestManager(store).submit(
+        "heatmap", {"objects_name": "nuclei", "feature": "Morphology_area"}
+    )
+    (plot,) = result.plots
+    wells = {w["well_col"]: w["mean"] for w in plot.figure["wells"]}
+    assert wells[1] is None
+    np.testing.assert_allclose(wells[0], 102.0)
+    # and the serialized payload is strict JSON (no literal NaN)
+    import json
+
+    json.loads(json.dumps(plot.figure))
+
+
 def test_clustering_reports_sizes_and_inertia(store_with_features):
     mgr = ToolRequestManager(store_with_features)
     result = mgr.submit("clustering", {"objects_name": "nuclei", "k": 2})

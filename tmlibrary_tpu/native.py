@@ -783,6 +783,14 @@ def mosaic_intensity_host(labels: np.ndarray, vals: np.ndarray, count: int):
         )
         if rc == 0:
             return s, q, mn, mx
+        # rc=-1 is the kernel DETECTING corrupt input (a label outside
+        # [0, count]), not the kernel being unavailable: falling through
+        # to the numpy twin would pay a second plate-scale pass and then
+        # die with an incidental bincount/ufunc error
+        raise ValueError(
+            f"mosaic_intensity_host: label outside [0, {count}] "
+            "(corrupt label mosaic)"
+        )
     return _mosaic_intensity_py(labels32, vals32, count)
 
 
@@ -844,4 +852,10 @@ def mosaic_morph_host(labels: np.ndarray, count: int):
         )
         if rc == 0:
             return area, cy, cx, ymin, ymax, xmin, xmax
+        # same contract as mosaic_intensity_host: rc=-1 means corrupt
+        # labels, not an unavailable kernel
+        raise ValueError(
+            f"mosaic_morph_host: label outside [0, {count}] "
+            "(corrupt label mosaic)"
+        )
     return _mosaic_morph_py(labels32, count)

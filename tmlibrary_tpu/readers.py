@@ -194,19 +194,20 @@ _TIFF_PY_PARSE_CACHE_MAX = 64
 _TIFF_PY_PARSE_LOCK = _threading.Lock()
 
 
-def _tiff_parse_validation_key(m, st) -> tuple:
-    """Freshness key for a cached parse: stat identity PLUS a crc of the
-    head and tail regions.  mtime alone misses same-size in-place
-    rewrites inside one filesystem timestamp tick; the crcs cover the
-    byte ranges a parse depends on (header at the head, IFD chains at
-    the head or tail in every layout this fallback decodes)."""
+def _tiff_parse_spans_key(m, spans) -> tuple:
+    """Freshness key for a cached parse: a crc per parse-relevant byte
+    range — the header plus every IFD table span recorded by
+    ``_tiff_parse``.  mtime alone misses same-size in-place rewrites
+    inside one filesystem timestamp tick, and a fixed head/tail probe
+    misses mid-file IFDs (multi-page BigTIFFs interleave IFDs with pixel
+    data; round-4 advisor finding).  Value arrays the IFD entries point
+    at are NOT covered — they are dereferenced against the live mmap at
+    decode time, so the parse can never serve stale bytes from them."""
     import zlib
 
-    n = len(m)
-    span = 1 << 13
-    head = zlib.crc32(m[:span])
-    tail = zlib.crc32(m[max(0, n - span):]) if n > span else 0
-    return (st.st_mtime_ns, st.st_size, st.st_ino, head, tail)
+    return tuple(
+        (s, e, zlib.crc32(m[s:e])) for s, e in [(0, min(len(m), 16))] + spans
+    )
 
 
 def read_tiff_page_py(path, page: int) -> "np.ndarray | None":
@@ -226,19 +227,30 @@ def read_tiff_page_py(path, page: int) -> "np.ndarray | None":
             f.fileno(), 0, access=mmap.ACCESS_READ
         ) as m:
             st = os.fstat(f.fileno())
-            key = _tiff_parse_validation_key(m, st)
+            stat_key = (st.st_mtime_ns, st.st_size, st.st_ino)
             spath = str(path)
             with _TIFF_PY_PARSE_LOCK:
                 entry = _TIFF_PY_PARSE_CACHE.get(spath)
-                if entry is not None and entry[0] == key:
-                    _TIFF_PY_PARSE_CACHE.move_to_end(spath)
-                    hit = entry[1]
-                else:
-                    hit = None
+            hit = None
+            if entry is not None and entry[0] == stat_key:
+                # re-crc the exact ranges the cached parse read (outside
+                # the lock: mmap reads of an unchanged file are pure)
+                import zlib
+
+                if all(
+                    e <= len(m) and zlib.crc32(m[s:e]) == c
+                    for s, e, c in entry[1]
+                ):
+                    hit = entry[2]
+                    with _TIFF_PY_PARSE_LOCK:
+                        if spath in _TIFF_PY_PARSE_CACHE:
+                            _TIFF_PY_PARSE_CACHE.move_to_end(spath)
             if hit is None:
-                hit = _tiff_parse(m)  # outside the lock: parse is pure
+                spans: list = []
+                hit = _tiff_parse(m, spans)  # outside the lock: pure
+                key = _tiff_parse_spans_key(m, spans)
                 with _TIFF_PY_PARSE_LOCK:
-                    _TIFF_PY_PARSE_CACHE[spath] = (key, hit)
+                    _TIFF_PY_PARSE_CACHE[spath] = (stat_key, key, hit)
                     _TIFF_PY_PARSE_CACHE.move_to_end(spath)
                     while (len(_TIFF_PY_PARSE_CACHE)
                            > _TIFF_PY_PARSE_CACHE_MAX):
@@ -795,18 +807,24 @@ class ND2Reader(Reader):
 
             try:
                 # max_length bounds the expansion: a crafted chunk must
-                # fail the size check below, not OOM the ingest job
+                # fail the size check below, not OOM the ingest job.
+                # Requested one byte PAST the expectation so an oversized
+                # stream is detectable — it means mis-modeled geometry or
+                # component count, and truncating it would hand back
+                # plausible-looking wrong pixels (DESIGN.md 9e: overflow
+                # and shortfall are both MetadataError)
                 decoded = zlib.decompressobj().decompress(
-                    payload[8:], 2 * n_px)
+                    payload[8:], 2 * n_px + 1)
             except zlib.error as exc:
                 raise MetadataError(
                     f"{self.filename}: corrupt lossless sequence "
                     f"{sequence}: {exc}"
                 ) from exc
-            if len(decoded) < 2 * n_px:
+            if len(decoded) != 2 * n_px:
                 raise MetadataError(
                     f"{self.filename}: lossless sequence {sequence} "
-                    f"decodes to {len(decoded)} bytes, expected {2 * n_px}"
+                    f"decodes to {'>' if len(decoded) > 2 * n_px else ''}"
+                    f"{len(decoded)} bytes, expected {2 * n_px}"
                 )
             samples = np.frombuffer(decoded, np.uint16, count=n_px)
             plane = samples.reshape(self.height, self.width,
@@ -1922,7 +1940,7 @@ _TIFF_TYPE_SIZE = {1: 1, 2: 1, 3: 2, 4: 4, 5: 8, 6: 1, 7: 1, 8: 2, 9: 4,
                    10: 8, 11: 4, 12: 8, 13: 4, 16: 8, 17: 8, 18: 8}
 
 
-def _tiff_parse(buf) -> tuple[str, list[dict]]:
+def _tiff_parse(buf, spans: "list | None" = None) -> tuple[str, list[dict]]:
     """Minimal TIFF IFD walk over an in-memory buffer — classic (magic
     42) and BigTIFF (magic 43, 8-byte offsets/counts, 20-byte entries).
 
@@ -1933,6 +1951,10 @@ def _tiff_parse(buf) -> tuple[str, list[dict]]:
     format-agnostic.  Shared by the STK/LSM/FLEX/Olympus container
     readers — their plane layouts don't fit the native page reader's
     model, so they need the raw tag table, not decoded pages.
+
+    When ``spans`` is a list, the byte range of every IFD table walked
+    (count field through next-IFD pointer) is appended to it — the
+    parse-cache freshness key crcs exactly these ranges.
     """
     import struct
 
@@ -1974,6 +1996,8 @@ def _tiff_parse(buf) -> tuple[str, list[dict]]:
         nextsize = struct.calcsize(off_fmt)
         if n > (len(buf) - p) // esize or p + esize * n + nextsize > len(buf):
             break
+        if spans is not None:
+            spans.append((off, p + esize * n + nextsize))
         entries: dict = {}
         for _ in range(n):
             tag, typ = struct.unpack_from(bo + "HH", buf, p)
@@ -2058,14 +2082,17 @@ def _decode_strip(chunk: bytes, compression: int, expect: int,
     elif compression in (8, 32946):
         # Adobe deflate (8) and the old deflate id (32946): one zlib
         # stream per strip.  max_length bounds the expansion — a crafted
-        # strip must fail the size check, not OOM the ingest job
+        # strip must fail the size check, not OOM the ingest job; one
+        # byte PAST the expectation is requested so an oversized stream
+        # (mis-modeled strip geometry) is rejected rather than silently
+        # truncated into plausible pixels (DESIGN.md 9e)
         import zlib
 
         try:
-            raw = zlib.decompressobj().decompress(chunk, expect)
+            raw = zlib.decompressobj().decompress(chunk, expect + 1)
         except zlib.error:
             raw = None
-        out = raw if raw is not None and len(raw) >= expect else None
+        out = raw if raw is not None and len(raw) == expect else None
     elif compression == 32773:
         from tmlibrary_tpu.native import packbits_decode
 

@@ -41,11 +41,19 @@ class Heatmap(Tool):
         if len(vals):
             # finite-only means: an all-NaN well (degenerate-object
             # features) must not leak literal NaN through json.dumps
-            # into result.json; such wells carry mean null instead
+            # into result.json; such wells carry mean null instead.
+            # Group the UNFILTERED ids so every observed well stays in
+            # the list — a consumer must be able to tell an all-NaN well
+            # from one outside the plate.
+            keys = ["plate", "well_row", "well_col"]
             finite_ids = ids[np.isfinite(vals)]
             well_mean = (
-                finite_ids.groupby(["plate", "well_row", "well_col"])
-                ["value"].mean().reset_index()
+                ids[keys].drop_duplicates()
+                .merge(
+                    finite_ids.groupby(keys)["value"].mean().reset_index(),
+                    on=keys, how="left",
+                )
+                .sort_values(keys)
             )
             plots.append(Plot(
                 type="plate_heatmap",
@@ -56,7 +64,10 @@ class Heatmap(Tool):
                             "plate": r.plate,
                             "well_row": int(r.well_row),
                             "well_col": int(r.well_col),
-                            "mean": float(r.value),
+                            "mean": (
+                                float(r.value)
+                                if np.isfinite(r.value) else None
+                            ),
                         }
                         for r in well_mean.itertuples()
                     ],
