@@ -1088,3 +1088,260 @@ int32_t tm_mosaic_morph(const int32_t* labels, int32_t h, int32_t w,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Round-5: per-SITE measurement accumulators.  The CPU backend's measure
+// stage was scatter-bound (XLA-CPU lowers segment_sum/min/max to serial
+// element scatters, ~2.3 ms/site at 256^2); one fused C pass computes all
+// five per-label statistics for a whole site batch.
+
+extern "C" {
+
+// Per-label count / sum / sum-of-squares / min / max over a batch of label
+// sites in ONE pass.  Accumulation is float32 in row-major pixel order —
+// deliberately reproducing XLA-CPU's segment_sum/segment_min/segment_max
+// (same adds, same order, multiply rounded before accumulate), so swapping
+// the dispatch cannot move any downstream feature value.  Outputs are
+// (n_sites, count + 1) row-major; index 0 = background; min/max start at
+// +/-inf (the XLA reduction identities, kept for absent labels).  Labels
+// outside [0, count] are DROPPED like the XLA scatter twin drops
+// out-of-range segment ids (NOT an error: saturated sites legitimately
+// carry clipped ids at the cap).  Returns 0, or -1 on null/negative args.
+int32_t tm_site_stats(const int32_t* labels, const float* vals,
+                      int64_t n_sites, int64_t px, int32_t count,
+                      float* cnt_out, float* sum_out, float* sq_out,
+                      float* min_out, float* max_out) {
+  if (!labels || !vals || !cnt_out || !sum_out || !sq_out || !min_out ||
+      !max_out || n_sites < 0 || px < 0 || count < 0)
+    return -1;
+  const float inf = std::numeric_limits<float>::infinity();
+  const int64_t k1 = static_cast<int64_t>(count) + 1;
+  for (int64_t s = 0; s < n_sites; ++s) {
+    float* cnt = cnt_out + s * k1;
+    float* sum = sum_out + s * k1;
+    float* sq = sq_out + s * k1;
+    float* mn = min_out + s * k1;
+    float* mx = max_out + s * k1;
+    for (int64_t k = 0; k < k1; ++k) {
+      cnt[k] = 0.0f;
+      sum[k] = 0.0f;
+      sq[k] = 0.0f;
+      mn[k] = inf;
+      mx[k] = -inf;
+    }
+    const int32_t* lab = labels + s * px;
+    const float* val = vals + s * px;
+    for (int64_t i = 0; i < px; ++i) {
+      const int32_t l = lab[i];
+      if (l < 0 || l > count) continue;  // drop, like the XLA scatter
+      const float x = val[i];
+      const float xx = x * x;  // named temp: rounded, never fused (fma)
+      cnt[l] += 1.0f;
+      sum[l] += x;
+      sq[l] += xx;
+      if (x < mn[l]) mn[l] = x;
+      if (x > mx[l]) mx[l] = x;
+    }
+  }
+  return 0;
+}
+
+// Exact per-site histograms of int32 bin indices: counts accumulate as
+// float32 (+1.0 adds are exact to 2^24 pixels/site); a negative index is
+// normalized Python-style ONCE (+bins) and indices still out of range
+// after that are dropped — all matching jnp's ``.at[idx].add`` scatter
+// (ops/histogram.py method="scatter") bit-for-bit.  Outputs
+// (n_sites, bins) row-major.  Returns 0 / -1 on null/invalid args.
+int32_t tm_hist_counts(const int32_t* idx, int64_t n_sites, int64_t px,
+                       int32_t bins, float* out) {
+  if (!idx || !out || n_sites < 0 || px < 0 || bins <= 0) return -1;
+  for (int64_t s = 0; s < n_sites; ++s) {
+    float* row = out + s * static_cast<int64_t>(bins);
+    for (int32_t b = 0; b < bins; ++b) row[b] = 0.0f;
+    const int32_t* ix = idx + s * px;
+    for (int64_t i = 0; i < px; ++i) {
+      int32_t b = ix[i];
+      if (b < 0) b += bins;  // jnp negative-index normalization
+      if (b >= 0 && b < bins) row[b] += 1.0f;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Fused per-site Otsu histogram: min/max plus the fixed-bin histogram of
+// ((x - lo) / max(hi - lo, 1e-6)) * bins, truncated to int32 and clamped
+// to [0, bins), in ONE pass over the pixels.  Every float operation is
+// float32 with the same expression tree as the XLA path in
+// ops/threshold.py otsu_value (sub, div, mul each rounded; int conversion
+// truncates toward zero like XLA's ConvertElementType), and the build
+// pins -ffp-contract=off, so the resulting histogram — and therefore the
+// Otsu cut — is bit-identical.  Outputs: hist (n_sites, bins) float32,
+// lo/hi (n_sites,) float32.  Returns 0 / -1 on bad args.
+int32_t tm_otsu_hist(const float* img, int64_t n_sites, int64_t px,
+                     int32_t bins, float* hist_out, float* lo_out,
+                     float* hi_out) {
+  if (!img || !hist_out || !lo_out || !hi_out || n_sites < 0 || px <= 0 ||
+      bins <= 0)
+    return -1;
+  for (int64_t s = 0; s < n_sites; ++s) {
+    const float* x = img + s * px;
+    float lo = x[0], hi = x[0];
+    for (int64_t i = 1; i < px; ++i) {
+      if (x[i] < lo) lo = x[i];
+      if (x[i] > hi) hi = x[i];
+    }
+    lo_out[s] = lo;
+    hi_out[s] = hi;
+    const float span_raw = hi - lo;
+    const float span = span_raw > 1e-6f ? span_raw : 1e-6f;
+    const float fbins = static_cast<float>(bins);
+    float* hist = hist_out + s * static_cast<int64_t>(bins);
+    for (int32_t b = 0; b < bins; ++b) hist[b] = 0.0f;
+    for (int64_t i = 0; i < px; ++i) {
+      const float a = x[i] - lo;     // each step rounded f32, like XLA
+      const float r = a / span;
+      const float c = r * fbins;
+      int32_t b = static_cast<int32_t>(c);  // trunc toward zero
+      if (b < 0) b = 0;
+      if (b >= bins) b = bins - 1;
+      hist[b] += 1.0f;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Separable 2-D correlation over a batch of sites, bit-identical to the
+// shifted-slice accumulation in ops/smooth.py _conv1d/uniform_smooth:
+// per axis, out accumulates ky[i] * padded_slice_i with i ascending —
+// each multiply rounded f32, each add rounded f32 (the build pins
+// -ffp-contract=off), symmetric (numpy "symmetric") edge padding with
+// ly/lx taps of pad on the leading side.  The kernels arrive as float32
+// arrays COMPUTED BY the jitted caller, so there is no coefficient
+// drift either.  Outputs (n_sites, h, w) float32.  Returns 0 / -1.
+int32_t tm_sep_filter(const float* img, int64_t n_sites, int32_t h,
+                      int32_t w, const float* ky, int32_t ny, int32_t ly,
+                      const float* kx, int32_t nx, int32_t lx,
+                      float* out) {
+  if (!img || !ky || !kx || !out || n_sites < 0 || h <= 0 || w <= 0 ||
+      ny <= 0 || nx <= 0 || ly < 0 || lx < 0 || ny - ly > h + 1 ||
+      nx - lx > w + 1 || ly > h || lx > w)
+    return -1;
+  const int64_t px = static_cast<int64_t>(h) * w;
+  std::vector<float> tmp(px);
+  std::vector<float> row(static_cast<size_t>(w) + nx - 1);
+  // numpy "symmetric": -1 -> 0, -2 -> 1, h -> h-1, h+1 -> h-2
+  auto mirror = [](int32_t p, int32_t n) {
+    if (p < 0) p = -p - 1;
+    if (p >= n) p = 2 * n - 1 - p;
+    return p;
+  };
+  for (int64_t s = 0; s < n_sites; ++s) {
+    const float* in = img + s * px;
+    // axis 0: tmp[y][x] = sum_i ky[i] * in[mirror(y + i - ly)][x]
+    for (int32_t y = 0; y < h; ++y) {
+      float* o = tmp.data() + static_cast<int64_t>(y) * w;
+      for (int32_t x = 0; x < w; ++x) o[x] = 0.0f;
+      for (int32_t i = 0; i < ny; ++i) {
+        const float kv = ky[i];
+        const float* src =
+            in + static_cast<int64_t>(mirror(y + i - ly, h)) * w;
+        for (int32_t x = 0; x < w; ++x) {
+          const float prod = kv * src[x];  // rounded, never fused
+          o[x] += prod;
+        }
+      }
+    }
+    // axis 1: out[y][x] = sum_i kx[i] * tmp[y][mirror(x + i - lx)]
+    for (int32_t y = 0; y < h; ++y) {
+      const float* t = tmp.data() + static_cast<int64_t>(y) * w;
+      for (int32_t i = 0; i < nx - 1 + w; ++i)
+        row[i] = t[mirror(i - lx, w)];
+      float* o = out + s * px + static_cast<int64_t>(y) * w;
+      for (int32_t x = 0; x < w; ++x) o[x] = 0.0f;
+      for (int32_t i = 0; i < nx; ++i) {
+        const float kv = kx[i];
+        const float* src = row.data() + i;
+        for (int32_t x = 0; x < w; ++x) {
+          const float prod = kv * src[x];
+          o[x] += prod;
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Separable box (mean) filter over a batch of sites, scipy
+// uniform_filter semantics: per-axis running mean with "reflect"
+// (numpy symmetric) borders, even windows biased one tap left, the
+// axis-0 intermediate rounded to float32 like scipy's same-dtype
+// intermediate.  O(1) work per pixel via double running sums (the
+// unrolled XLA tap pass is O(size) — 31-tap windows dominated the
+// adaptive-threshold module).  NOT bit-identical to the XLA taps
+// (different accumulation order/precision) — threshold_adaptive's local
+// mean is a tolerance-tier quantity, like the zernike host twin.
+// Returns 0 / -1 on bad args (size must fit the image so a single
+// mirror reflection covers the window).
+int32_t tm_box_mean(const float* img, int64_t n_sites, int32_t h,
+                    int32_t w, int32_t size, float* out) {
+  if (!img || !out || n_sites < 0 || h <= 0 || w <= 0 || size <= 0 ||
+      size > h || size > w)
+    return -1;
+  const int32_t left = size / 2;
+  const int32_t right = size - left - 1;
+  const double inv = 1.0 / static_cast<double>(size);
+  const int64_t px = static_cast<int64_t>(h) * w;
+  std::vector<float> tmp(px);
+  std::vector<double> acc(w);
+  auto mirror = [](int32_t p, int32_t n) {
+    if (p < 0) p = -p - 1;
+    if (p >= n) p = 2 * n - 1 - p;
+    return p;
+  };
+  for (int64_t s = 0; s < n_sites; ++s) {
+    const float* in = img + s * px;
+    // axis 0: running column sums over the mirrored row window
+    for (int32_t x = 0; x < w; ++x) acc[x] = 0.0;
+    for (int32_t r = -left; r <= right; ++r) {
+      const float* row = in + static_cast<int64_t>(mirror(r, h)) * w;
+      for (int32_t x = 0; x < w; ++x) acc[x] += row[x];
+    }
+    for (int32_t y = 0; y < h; ++y) {
+      float* t = tmp.data() + static_cast<int64_t>(y) * w;
+      for (int32_t x = 0; x < w; ++x)
+        t[x] = static_cast<float>(acc[x] * inv);
+      if (y + 1 < h) {
+        const float* add = in + static_cast<int64_t>(mirror(y + 1 + right, h)) * w;
+        const float* sub = in + static_cast<int64_t>(mirror(y - left, h)) * w;
+        for (int32_t x = 0; x < w; ++x) acc[x] += add[x] - sub[x];
+      }
+    }
+    // axis 1: running sum along each (rounded) intermediate row
+    for (int32_t y = 0; y < h; ++y) {
+      const float* t = tmp.data() + static_cast<int64_t>(y) * w;
+      float* o = out + s * px + static_cast<int64_t>(y) * w;
+      double run = 0.0;
+      for (int32_t c = -left; c <= right; ++c) run += t[mirror(c, w)];
+      for (int32_t x = 0; x < w; ++x) {
+        o[x] = static_cast<float>(run * inv);
+        if (x + 1 < w)
+          run += t[mirror(x + 1 + right, w)] - t[mirror(x - left, w)];
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -31,7 +31,7 @@ class BuildWithNative(build_py):
         so = out_dir / "libtmnative.so"
         try:
             subprocess.run(
-                ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
+                ["g++", "-O3", "-ffp-contract=off", "-fPIC", "-std=c++17", "-shared",
                  "-o", str(so), str(src)],
                 check=True, timeout=300,
             )

@@ -1,3 +1,4 @@
+import pathlib
 import numpy as np
 import pytest
 import scipy.ndimage as ndi
@@ -478,3 +479,93 @@ def test_lzw_full_width_round_trip(rng):
         n = native.lzw_decode(encoded[:cut], len(random_part))
         p = native._lzw_decode_py(encoded[:cut], len(random_part))
         assert n == p
+
+
+def test_site_stats_kernels_bit_identical_to_xla(rng):
+    """The round-5 measurement kernels (tm_site_stats, tm_hist_counts,
+    tm_otsu_hist) promise BIT parity with their XLA twins — the dispatch
+    swap must not be able to move a single feature value or threshold.
+    Covers out-of-range labels (dropped like segment ids), negative
+    histogram indices (jnp wraps once), and the Otsu span floor."""
+    from tmlibrary_tpu import native
+    from tmlibrary_tpu.ops.histogram import histogram_fixed_bins
+    from tmlibrary_tpu.ops.measure import intensity_features
+    from tmlibrary_tpu.ops.threshold import otsu_value
+
+    if not native.has_site_stats():
+        pytest.skip("native measurement kernels unavailable")
+    import jax
+
+    labels = rng.integers(0, 70, (3, 64, 64)).astype(np.int32)  # ids > 48
+    img = rng.normal(500, 100, (3, 64, 64)).astype(np.float32)
+    f_nat = jax.jit(jax.vmap(
+        lambda l, i: intensity_features(l, i, 48, method="native")
+    ))(labels, img)
+    f_xla = jax.jit(jax.vmap(
+        lambda l, i: intensity_features(l, i, 48, method="xla")
+    ))(labels, img)
+    for k in f_nat:
+        np.testing.assert_array_equal(np.asarray(f_nat[k]), np.asarray(f_xla[k]))
+
+    idx = rng.integers(-600, 600, (3, 64, 64)).astype(np.int32)
+    h_nat = jax.jit(jax.vmap(
+        lambda a: histogram_fixed_bins(a, 256, method="native")
+    ))(idx)
+    h_sca = jax.jit(jax.vmap(
+        lambda a: histogram_fixed_bins(a, 256, method="scatter")
+    ))(idx)
+    np.testing.assert_array_equal(np.asarray(h_nat), np.asarray(h_sca))
+
+    probes = [
+        img,
+        np.zeros((1, 8, 8), np.float32),           # span floor
+        np.full((1, 8, 8), 7.25, np.float32),      # constant image
+    ]
+    for p in probes:
+        a = jax.vmap(lambda x: otsu_value(x, method="native"))(p)
+        b = jax.vmap(lambda x: otsu_value(x, method="xla"))(p)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # unbatched (no vmap) shape contract
+    a = otsu_value(img[0], method="native")
+    b = otsu_value(img[0], method="xla")
+    assert np.asarray(a) == np.asarray(b)
+
+
+def test_batched_callbacks_single_device_subprocess():
+    """Under the suite's 8-virtual-device backend the measurement
+    callbacks must pick the SPMD-safe ``sequential`` method (expand_dims
+    deadlocks the partitioner's collective rendezvous — round-5 abort in
+    test_determinism), while a single-device process gets the batched
+    ``expand_dims`` fast path.  The subprocess runs WITHOUT the
+    8-device flag to pin the fast path's correctness."""
+    import os
+    import subprocess
+    import sys
+
+    from tmlibrary_tpu import native as nat
+
+    assert nat.callback_vmap_method() == "sequential"  # 8-device suite env
+    if not nat.has_site_stats():
+        pytest.skip("native measurement kernels unavailable")
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from tmlibrary_tpu import native
+from tmlibrary_tpu.ops.measure import intensity_features
+assert native.callback_vmap_method() == "expand_dims", jax.devices()
+rng = np.random.default_rng(3)
+labels = rng.integers(0, 20, (4, 32, 32)).astype(np.int32)
+img = rng.normal(100, 10, (4, 32, 32)).astype(np.float32)
+nat = jax.jit(jax.vmap(lambda l, i: intensity_features(l, i, 16, method="native")))(labels, img)
+xla = jax.jit(jax.vmap(lambda l, i: intensity_features(l, i, 16, method="xla")))(labels, img)
+for k in nat:
+    np.testing.assert_array_equal(np.asarray(nat[k]), np.asarray(xla[k]))
+print("OK")
+"""
+    r = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=300, cwd=str(pathlib.Path(__file__).parent.parent),
+    )
+    assert r.returncode == 0 and "OK" in r.stdout, r.stderr[-1500:]

@@ -40,8 +40,11 @@ def _build() -> bool:
         return False
     try:
         subprocess.run(
-            ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
-             "-o", str(_SO_PATH), str(src)],
+            # -ffp-contract=off: several kernels promise bit-parity with
+            # an XLA or numpy float twin (tm_site_stats most strictly);
+            # a fused multiply-add would round differently than the twin
+            ["g++", "-O3", "-ffp-contract=off", "-fPIC", "-std=c++17",
+             "-shared", "-o", str(_SO_PATH), str(src)],
             check=True, capture_output=True, timeout=120,
         )
         return True
@@ -166,6 +169,34 @@ def _load_locked():
     except AttributeError:
         logger.info(
             "native library predates the mosaic stats kernels; "
+            "rebuild native/"
+        )
+    try:
+        _f = ctypes.POINTER(ctypes.c_float)
+        lib.tm_site_stats.restype = ctypes.c_int32
+        lib.tm_site_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), _f,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            _f, _f, _f, _f, _f,
+        ]
+        lib.tm_hist_counts.restype = ctypes.c_int32
+        lib.tm_hist_counts.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, _f,
+        ]
+        lib.tm_otsu_hist.restype = ctypes.c_int32
+        lib.tm_otsu_hist.argtypes = [
+            _f, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            _f, _f, _f,
+        ]
+        lib.tm_box_mean.restype = ctypes.c_int32
+        lib.tm_box_mean.argtypes = [
+            _f, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, _f,
+        ]
+    except AttributeError:
+        logger.info(
+            "native library predates the site stats kernels; "
             "rebuild native/"
         )
     try:
@@ -582,6 +613,186 @@ def simplify_polygon_host(contour: np.ndarray, tolerance: float) -> np.ndarray:
     if abs((b[0] - a[0]) * (c[1] - a[1]) - (b[1] - a[1]) * (c[0] - a[0])) < 1e-12:
         return contour
     return picked
+
+
+# --------------------------------------------- per-site measurement kernels
+def callback_vmap_method() -> str:
+    """``vmap_method`` for the measurement host callbacks.
+
+    ``expand_dims`` turns the whole vmapped site batch into ONE host call
+    (the per-site dispatch overhead of ``sequential`` is most of a
+    sequential callback's cost) — but it DEADLOCKS XLA-CPU's SPMD
+    partitioner when the jitted program executes over sharded inputs:
+    the partitioner reshards the batch to device 0 around the callback
+    with cross-device collectives, device 0 parks inside the callback,
+    the other devices' rendezvous times out, and the runtime aborts the
+    process ("Termination timeout for all reduce ... only 7 of them
+    arrived").  Any multi-device process might hand this traced program
+    sharded inputs (workflow steps shard whenever >1 device is visible),
+    so batched callbacks are reserved for single-device processes — the
+    single-chip bench and production single-device runs.  ``sequential``
+    is the SPMD-safe method the segmentation callbacks have always used.
+    """
+    import jax
+
+    return "expand_dims" if len(jax.devices()) == 1 else "sequential"
+
+
+def batch_sites(*arg_ndims: int):
+    """Wrap a per-site host function so a ``pure_callback`` can use it
+    under BOTH vmap methods: with ``sequential`` it sees bare site
+    shapes; with ``expand_dims`` (single-device fast path —
+    :func:`callback_vmap_method`) every argument arrives with shared
+    leading vmap axes, which this wrapper flattens, loops over, and
+    stacks back — turning a whole site batch into ONE callback dispatch.
+    ``arg_ndims[i]`` is argument ``i``'s trailing per-site rank."""
+    def wrap(site_fn):
+        def host(*args):
+            arrs = [np.asarray(a) for a in args]
+            lead = arrs[0].shape[: arrs[0].ndim - arg_ndims[0]]
+            n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+            flat = [
+                a.reshape((n,) + a.shape[a.ndim - nd:])
+                for a, nd in zip(arrs, arg_ndims)
+            ]
+            outs = [site_fn(*(f[i] for f in flat)) for i in range(n)]
+            single = not isinstance(outs[0], tuple)
+            if single:
+                outs = [(o,) for o in outs]
+            stacked = tuple(
+                np.stack([np.asarray(o[j]) for o in outs]).reshape(
+                    lead + np.asarray(outs[0][j]).shape
+                )
+                for j in range(len(outs[0]))
+            )
+            return stacked[0] if single else stacked
+        return host
+    return wrap
+
+
+def has_site_stats() -> bool:
+    """Whether the loaded library carries the round-5 measurement kernels
+    (``tm_site_stats`` + ``tm_hist_counts`` + ``tm_otsu_hist``).
+    ``TMX_SITE_STATS=0`` disables them independently of the segmentation
+    kernels (diagnostic kill switch)."""
+    import os
+
+    if os.environ.get("TMX_SITE_STATS") == "0":
+        return False
+    lib = _load()
+    return (
+        lib is not None
+        and hasattr(lib, "tm_site_stats")
+        and hasattr(lib, "tm_hist_counts")
+        and hasattr(lib, "tm_otsu_hist")
+    )
+
+
+def site_stats_host(
+    labels: np.ndarray, vals: np.ndarray, count: int
+) -> tuple[np.ndarray, ...]:
+    """Per-label (count, sum, sq_sum, min, max) for a batch of flattened
+    sites — ``labels``/``vals`` are ``(n_sites, px)``; each output is
+    ``(n_sites, count)`` float32 for label ids 1..count (background
+    dropped).  Bit-identical to XLA-CPU's segment_sum/min/max over the
+    same pixels (see ``tm_site_stats``); no numpy fallback — callers gate
+    on :func:`has_site_stats` and keep the XLA path as the portable twin.
+    """
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_site_stats"):
+        raise RuntimeError("native tm_site_stats unavailable")
+    labels32 = np.ascontiguousarray(labels, np.int32)
+    vals32 = np.ascontiguousarray(vals, np.float32)
+    n, px = labels32.shape
+    k1 = count + 1
+    outs = [np.empty((n, k1), np.float32) for _ in range(5)]
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.tm_site_stats(
+        labels32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals32.ctypes.data_as(fp), n, px, count,
+        *(o.ctypes.data_as(fp) for o in outs),
+    )
+    if rc != 0:
+        raise ValueError("tm_site_stats: invalid arguments")
+    return tuple(np.ascontiguousarray(o[:, 1:]) for o in outs)
+
+
+def has_box_mean() -> bool:
+    """Whether the loaded library carries ``tm_box_mean`` (honors the
+    ``TMX_SITE_STATS=0`` kill switch with the other measurement
+    kernels)."""
+    import os
+
+    if os.environ.get("TMX_SITE_STATS") == "0":
+        return False
+    lib = _load()
+    return lib is not None and hasattr(lib, "tm_box_mean")
+
+
+def box_mean_host(img: np.ndarray, size: int) -> np.ndarray:
+    """scipy-``uniform_filter``-semantics box mean for a site batch —
+    ``img`` is ``(n_sites, h, w)`` float32; O(1) per pixel (see
+    ``tm_box_mean``; tolerance-tier vs the XLA tap pass)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_box_mean"):
+        raise RuntimeError("native tm_box_mean unavailable")
+    img32 = np.ascontiguousarray(img, np.float32)
+    n, h, w = img32.shape
+    out = np.empty_like(img32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.tm_box_mean(
+        img32.ctypes.data_as(fp), n, h, w, size, out.ctypes.data_as(fp)
+    )
+    if rc != 0:
+        raise ValueError("tm_box_mean: invalid arguments")
+    return out
+
+
+def otsu_hist_host(
+    img: np.ndarray, bins: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Fused per-site (histogram, lo, hi) for the Otsu cut — ``img`` is
+    ``(n_sites, px)`` float32; returns ``((n_sites, bins) f32 hist,
+    (n_sites,) lo, (n_sites,) hi)``.  Bit-identical to the XLA
+    normalize+histogram path in ``ops/threshold.py`` (see
+    ``tm_otsu_hist``)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_otsu_hist"):
+        raise RuntimeError("native tm_otsu_hist unavailable")
+    img32 = np.ascontiguousarray(img, np.float32)
+    n, px = img32.shape
+    hist = np.empty((n, bins), np.float32)
+    lo = np.empty((n,), np.float32)
+    hi = np.empty((n,), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.tm_otsu_hist(
+        img32.ctypes.data_as(fp), n, px, bins,
+        hist.ctypes.data_as(fp), lo.ctypes.data_as(fp),
+        hi.ctypes.data_as(fp),
+    )
+    if rc != 0:
+        raise ValueError("tm_otsu_hist: invalid arguments")
+    return hist, lo, hi
+
+
+def hist_counts_host(idx: np.ndarray, bins: int) -> np.ndarray:
+    """Per-site exact histograms of int32 bin indices — ``idx`` is
+    ``(n_sites, px)``; returns ``(n_sites, bins)`` float32 counts.
+    Bit-identical to the XLA scatter histogram (out-of-range indices
+    dropped, float32 +1.0 adds)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_hist_counts"):
+        raise RuntimeError("native tm_hist_counts unavailable")
+    idx32 = np.ascontiguousarray(idx, np.int32)
+    n, px = idx32.shape
+    out = np.empty((n, bins), np.float32)
+    rc = lib.tm_hist_counts(
+        idx32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, px, bins, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    if rc != 0:
+        raise ValueError("tm_hist_counts: invalid arguments")
+    return out
 
 
 # ------------------------------------------- CPU-fallback segmentation path

@@ -36,14 +36,52 @@ def histogram_fixed_bins(
     """Histogram of int32 bin indices in ``[0, bins)`` → (bins,) float32.
 
     ``method="matmul"`` uses the factored one-hot contraction (MXU);
-    ``"scatter"`` uses one scatter-add (fastest on CPU); ``"auto"`` picks
-    by backend.  ``weights`` (same shape as ``idx``) turns the count into
-    a weighted sum per bin.
+    ``"scatter"`` uses one scatter-add; ``"native"`` one C pass per
+    batched callback (``tm_hist_counts`` — XLA-CPU lowers the scatter to
+    serial element updates, ~1.5 ms/site at 256²; the C pass is
+    bit-identical, including dropped out-of-range indices).  ``"auto"``:
+    native on the CPU backend when available (unweighted only), scatter
+    otherwise there, matmul on accelerators.  ``weights`` (same shape as
+    ``idx``) turns the count into a weighted sum per bin.
     """
     flat = idx.reshape(-1)
     w = None if weights is None else jnp.asarray(weights, jnp.float32).reshape(-1)
     if method == "auto":
-        method = "scatter" if jax.default_backend() == "cpu" else "matmul"
+        if jax.default_backend() == "cpu":
+            from tmlibrary_tpu import native
+
+            method = (
+                "native"
+                if weights is None
+                and native.cpu_native_enabled()
+                and native.has_site_stats()
+               
+                else "scatter"
+            )
+        else:
+            method = "matmul"
+    if method == "native":
+        import numpy as np
+
+        nd = idx.ndim  # unbatched rank at trace time
+
+        def host(a):
+            from tmlibrary_tpu import native
+
+            a = np.asarray(a)
+            lead = a.shape[: a.ndim - nd]
+            n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+            out = native.hist_counts_host(a.reshape(n, -1), bins)
+            return out.reshape(lead + (bins,))
+
+        from tmlibrary_tpu import native
+
+        return jax.pure_callback(
+            host,
+            jax.ShapeDtypeStruct((bins,), jnp.float32),
+            idx,
+            vmap_method=native.callback_vmap_method(),
+        )
     if method == "scatter":
         init = jnp.zeros((bins,), jnp.float32)
         return init.at[flat].add(1.0 if w is None else w)

@@ -134,6 +134,7 @@ def connected_components(
 
         from tmlibrary_tpu import native
 
+        @native.batch_sites(2)
         def _cc_host(m):
             labels, count = native.cc_label_host(np.asarray(m), connectivity)
             return labels, np.int32(count)
@@ -145,7 +146,7 @@ def connected_components(
                 jax.ShapeDtypeStruct((), jnp.int32),
             ),
             mask,
-            vmap_method="sequential",
+            vmap_method=native.callback_vmap_method(),
         )
     if method == "pallas":
         from tmlibrary_tpu.ops.pallas_kernels import cc_min_propagate
@@ -236,10 +237,12 @@ def fill_holes(
         from tmlibrary_tpu import native
 
         return jax.pure_callback(
-            lambda m: native.fill_holes_host(np.asarray(m), connectivity),
+            native.batch_sites(2)(
+                lambda m: native.fill_holes_host(np.asarray(m), connectivity)
+            ),
             jax.ShapeDtypeStruct((h, w), jnp.bool_),
             mask,
-            vmap_method="sequential",
+            vmap_method=native.callback_vmap_method(),
         )
     bg = ~mask
     border = jnp.zeros_like(mask).at[0, :].set(True).at[-1, :].set(True)

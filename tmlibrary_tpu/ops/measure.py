@@ -244,26 +244,75 @@ def grouped_minmax_multi(
 
 
 # ------------------------------------------------------------------ intensity
+def _native_site_stats(
+    labels: jax.Array, img: jax.Array, max_objects: int
+) -> tuple[jax.Array, ...]:
+    """One fused native pass over the pixels for (count, sum, sq, min,
+    max) per label — ``vmap_method="expand_dims"`` (single-device), so a vmapped site
+    batch costs ONE host callback total, not one per site (the round-3
+    sequential host twin lost to XLA for exactly that reason)."""
+    nd = labels.ndim  # site rank at trace time (2-D site or 3-D volume)
+    k = max_objects
+
+    def host(lab, im):
+        from tmlibrary_tpu import native
+
+        lab = np.asarray(lab)
+        lead = lab.shape[: lab.ndim - nd]
+        n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+        outs = native.site_stats_host(
+            lab.reshape(n, -1), np.asarray(im).reshape(n, -1), k
+        )
+        return tuple(o.reshape(lead + (k,)) for o in outs)
+
+    shapes = tuple(
+        jax.ShapeDtypeStruct((k,), jnp.float32) for _ in range(5)
+    )
+    from tmlibrary_tpu import native
+
+    return jax.pure_callback(
+        host, shapes, labels, img,
+        vmap_method=native.callback_vmap_method(),
+    )
+
+
 def intensity_features(
-    labels: jax.Array, intensity: jax.Array, max_objects: int
+    labels: jax.Array, intensity: jax.Array, max_objects: int,
+    method: str = "auto",
 ) -> dict[str, jax.Array]:
     """Reference feature set of ``jtlib/features/intensity.py``:
     max, mean, min, sum, std per object.
 
-    Stays pure-XLA on every backend: a host twin was measured SLOWER
-    in-pipeline on CPU despite the standalone scatter being ~4x slower
-    than scipy — the ``pure_callback`` graph break forces a full-image
-    device→host transfer per site and serializes against the otherwise
-    fused program (the zernike host twin wins only because it replaces
-    ~60 full-image basis evaluations, not one scatter)."""
+    ``method="auto"``: on the CPU backend one fused native C pass
+    computes all five accumulators (XLA-CPU lowers the segment reductions
+    to serial element scatters — ~2.3 ms/site at 256², ~5x the C pass;
+    the round-3 note that a host twin measured SLOWER was about a
+    PER-SITE sequential callback — the batched ``expand_dims`` callback
+    pays the graph break once per batch).  Accelerators stay pure-XLA
+    (one-hot MXU contractions); the native pass reproduces the XLA
+    reductions bit-for-bit (``tm_site_stats``), so dispatch cannot move
+    feature values."""
     labels = jnp.asarray(labels, jnp.int32)
     img = jnp.asarray(intensity, jnp.float32)
-    sums = grouped_sums(labels, [jnp.ones_like(img), img, img * img], max_objects)
-    count, total, sq = sums[:, 0], sums[:, 1], sums[:, 2]
+    if method == "auto":
+        from tmlibrary_tpu import native
+
+        method = (
+            "native"
+            if native.cpu_native_enabled() and native.has_site_stats()
+            else "xla"
+        )
+    if method == "native":
+        count, total, sq, mn, mx = _native_site_stats(labels, img, max_objects)
+    else:
+        sums = grouped_sums(
+            labels, [jnp.ones_like(img), img, img * img], max_objects
+        )
+        count, total, sq = sums[:, 0], sums[:, 1], sums[:, 2]
+        mn, mx = grouped_minmax(labels, img, max_objects)
     safe_n = jnp.maximum(count, 1.0)
     mean = total / safe_n
     var = jnp.maximum(sq / safe_n - mean * mean, 0.0)
-    mn, mx = grouped_minmax(labels, img, max_objects)
     present = count > 0
     return {
         "Intensity_max": jnp.where(present, mx, 0.0),
@@ -934,11 +983,15 @@ def zernike_features(
         method = "host" if jax.default_backend() == "cpu" and _host_ok() else "xla"
     if method == "host":
         table = _zernike_coeffs(degree)
+        from tmlibrary_tpu import native
+
         proj = jax.pure_callback(
-            lambda lb: _zernike_host(lb, max_objects, degree),
+            native.batch_sites(2)(
+                lambda lb: _zernike_host(lb, max_objects, degree)
+            ),
             jax.ShapeDtypeStruct((max_objects, len(table)), jnp.float32),
             labels,
-            vmap_method="sequential",
+            vmap_method=native.callback_vmap_method(),
         )
         return {
             f"Zernike_{n}_{m_}": proj[:, idx]

@@ -51,10 +51,12 @@ def distance_transform_approx(
         from tmlibrary_tpu import native
 
         return jax.pure_callback(
-            lambda m: native.chebyshev_dt_host(np.asarray(m), max_distance),
+            native.batch_sites(2)(
+                lambda m: native.chebyshev_dt_host(np.asarray(m), max_distance)
+            ),
             jax.ShapeDtypeStruct(mask.shape, jnp.float32),
             mask,
-            vmap_method="sequential",
+            vmap_method=native.callback_vmap_method(),
         )
     if method == "pallas":
         from tmlibrary_tpu.ops.pallas_kernels import distance_transform

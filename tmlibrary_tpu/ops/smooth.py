@@ -64,7 +64,14 @@ def gaussian_smooth(img: jax.Array, sigma: float, truncate: float = 4.0) -> jax.
     """
     radius = gaussian_radius(sigma, truncate)
     k = _gaussian_kernel1d(float(sigma), radius)
-    out = _conv1d(jnp.asarray(img, jnp.float32), k, axis=0)
+    img = jnp.asarray(img, jnp.float32)
+    # NO native fast path here, deliberately: gaussian_smooth feeds the
+    # Otsu cut in the bit-identical Cell Painting label gate, and
+    # XLA-CPU contracts the unrolled multiply-adds into FMAs a C twin
+    # cannot reproduce with separate rounding (measured 1-2 ulp apart) —
+    # while the callback round-trip made the C pass a net LOSS anyway
+    # (117 ms vs 77 ms per 128-site batch).
+    out = _conv1d(img, k, axis=0)
     return _conv1d(out, k, axis=1)
 
 
@@ -77,6 +84,33 @@ def uniform_smooth(img: jax.Array, size: int) -> jax.Array:
     right = size - left - 1
     img = jnp.asarray(img, jnp.float32)
     h, w = img.shape
+    if size <= min(h, w):
+        from tmlibrary_tpu import native
+
+        if native.cpu_native_enabled() and native.has_box_mean():
+            # O(1)-per-pixel double running sums in C (tm_box_mean) —
+            # the 31-tap XLA pass cost ~0.64 ms/site on 1 CPU core.
+            # Tolerance-tier vs the XLA taps (like the zernike host
+            # twin), within the scipy golden contract.  An XLA
+            # prefix-sum version was tried first and measured SLOWER
+            # than the taps (cumsum lowers to log-depth passes, and x64
+            # is disabled so its accumulator silently ran f32).
+            import numpy as np
+
+            def host(a):
+                a = np.asarray(a)
+                lead = a.shape[: a.ndim - 2]
+                n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+                return native.box_mean_host(
+                    a.reshape((n, h, w)), size
+                ).reshape(a.shape)
+
+            return jax.pure_callback(
+                host,
+                jax.ShapeDtypeStruct((h, w), jnp.float32),
+                img,
+                vmap_method=native.callback_vmap_method(),
+            )
     k = jnp.full((size,), 1.0 / size, jnp.float32)
     # shifted-slice accumulation for the same reason as _conv1d (slow
     # XLA-CPU conv path for single-channel shapes)

@@ -21,22 +21,78 @@ def threshold_manual(img: jax.Array, value) -> jax.Array:
     return jnp.asarray(img) > value
 
 
-def otsu_value(img: jax.Array, bins: int = 256) -> jax.Array:
+def otsu_value(img: jax.Array, bins: int = 256, method: str = "auto") -> jax.Array:
     """Otsu threshold value over a fixed-bin histogram.
 
     Matches the classic formulation (maximize between-class variance) used by
     mahotas/cv2 in the reference; with ``bins=256`` on 8-bit-scaled data the
     cut matches cv2's within one bin.  Returns a scalar in image units.
+
+    ``method="auto"``: on the CPU backend the min/max + normalize +
+    histogram run as ONE fused native pass (``tm_otsu_hist`` — the
+    elementwise normalization alone cost ~0.8 ms/site as XLA-CPU passes;
+    the C pass is bit-identical, so the cut cannot move); accelerators
+    keep the factored one-hot matmul histogram (MXU).  The between-class
+    argmax stays in XLA on the (bins,) histogram either way.
     """
     img_f = jnp.asarray(img, jnp.float32)
-    lo = jnp.min(img_f)
-    hi = jnp.max(img_f)
-    span = jnp.maximum(hi - lo, 1e-6)
-    idx = jnp.clip(((img_f - lo) / span * bins).astype(jnp.int32), 0, bins - 1)
-    # factored one-hot matmul histogram (MXU) on TPU, scatter on CPU
-    from tmlibrary_tpu.ops.histogram import histogram_fixed_bins
+    if method == "auto":
+        from tmlibrary_tpu import native
 
-    hist = histogram_fixed_bins(idx, bins)
+        method = (
+            "native"
+            if native.cpu_native_enabled() and native.has_site_stats()
+            else "xla"
+        )
+    if method == "native":
+        import numpy as np
+
+        from tmlibrary_tpu import native
+
+        nd = img_f.ndim  # unbatched rank at trace time
+
+        def host(a):
+            from tmlibrary_tpu import native
+
+            a = np.asarray(a)
+            lead = a.shape[: a.ndim - nd]
+            n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+            hist, lo, hi = native.otsu_hist_host(a.reshape(n, -1), bins)
+            return (
+                hist.reshape(lead + (bins,)),
+                lo.reshape(lead),
+                hi.reshape(lead),
+            )
+
+        hist, lo, hi = jax.pure_callback(
+            host,
+            (
+                jax.ShapeDtypeStruct((bins,), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+            ),
+            img_f,
+            vmap_method=native.callback_vmap_method(),
+        )
+        span = jnp.maximum(hi - lo, 1e-6)
+    else:
+        lo = jnp.min(img_f)
+        hi = jnp.max(img_f)
+        span = jnp.maximum(hi - lo, 1e-6)
+        idx = jnp.clip(
+            ((img_f - lo) / span * bins).astype(jnp.int32), 0, bins - 1
+        )
+        # factored one-hot matmul histogram (MXU) on TPU, scatter on CPU.
+        # The method is pinned callback-free: ``method="xla"`` promises a
+        # pure-XLA program (the distributed paths call it on globally
+        # SHARDED arrays, where a host callback cannot run), so the
+        # histogram must not re-introduce one via its own auto dispatch.
+        from tmlibrary_tpu.ops.histogram import histogram_fixed_bins
+
+        hist = histogram_fixed_bins(
+            idx, bins,
+            method="scatter" if jax.default_backend() == "cpu" else "matmul",
+        )
     centers = lo + (jnp.arange(bins, dtype=jnp.float32) + 0.5) / bins * span
 
     w0 = jnp.cumsum(hist)

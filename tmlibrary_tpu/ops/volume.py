@@ -94,6 +94,7 @@ def connected_components_3d(
 
         from tmlibrary_tpu import native
 
+        @native.batch_sites(3)
         def _cc3d_host(m):
             labels, count = native.cc_label3d_host(np.asarray(m), connectivity)
             return labels, np.int32(count)
@@ -105,7 +106,7 @@ def connected_components_3d(
                 jax.ShapeDtypeStruct((), jnp.int32),
             ),
             mask,
-            vmap_method="sequential",
+            vmap_method=native.callback_vmap_method(),
         )
     shifts = _diag_shifts_3d(connectivity)
     linear = jnp.arange(z * h * w, dtype=jnp.int32).reshape(z, h, w)
@@ -193,12 +194,15 @@ def watershed_from_seeds_3d(
         i = jnp.arange(n_levels, dtype=jnp.int32)
         levels = hi - span * (i + 1) / n_levels
         return jax.pure_callback(
-            lambda im, sd, mk, lv: native.watershed_levels3d_host(
-                np.asarray(im), np.asarray(sd), np.asarray(mk), np.asarray(lv)
+            native.batch_sites(3, 3, 3, 1)(
+                lambda im, sd, mk, lv: native.watershed_levels3d_host(
+                    np.asarray(im), np.asarray(sd), np.asarray(mk),
+                    np.asarray(lv),
+                )
             ),
             jax.ShapeDtypeStruct(intensity.shape, jnp.int32),
             intensity, seeds, mask, levels,
-            vmap_method="sequential",
+            vmap_method=native.callback_vmap_method(),
         )
 
     def level_body(i, labels):
