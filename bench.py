@@ -568,23 +568,37 @@ def measure_ingest(size: int) -> None:
     from tmlibrary_tpu.models.experiment import Experiment
     from tmlibrary_tpu.models.store import ExperimentStore
     from tmlibrary_tpu.workflow.registry import get_step
-    from tmlibrary_tpu.writers import ImageWriter
 
     n_sites = int(os.environ.get("BENCH_SITES", "96"))
-    rng = np.random.default_rng(11)
     reps = int(os.environ.get("BENCH_REPS", "3"))
     tmpdir = tempfile.mkdtemp(prefix="bench_ingest_")
+
+    # blobby sites like every other bench config — random NOISE planes
+    # are LZW's pathological case (the dictionary never finds a match,
+    # so the decode is pure per-code overhead and the file EXPANDS) and
+    # misrepresent the zstd CZI path the same way
+    from tmlibrary_tpu.benchmarks import synthetic_cell_painting_batch
+
+    planes = np.asarray(
+        synthetic_cell_painting_batch(n_sites, size=size, dapi_only=True)
+        ["DAPI"], np.uint16,
+    )
 
     def build_source(fmt: str) -> str:
         src = os.path.join(tmpdir, f"src_{fmt}")
         os.makedirs(src)
-        planes = rng.integers(0, 60000, (n_sites, size, size), np.uint16)
-        if fmt == "tiff":
+        if fmt in ("tiff", "tiff_raw"):
+            import cv2
+
+            params = (
+                [] if fmt == "tiff"  # cv2 default = LZW
+                else [cv2.IMWRITE_TIFF_COMPRESSION, 1]
+            )
             for i in range(n_sites):
-                with ImageWriter(
-                    os.path.join(src, f"img_A01_s{i}_C00.tif")
-                ) as wr:
-                    wr.write(planes[i])
+                cv2.imwrite(
+                    os.path.join(src, f"img_A01_s{i}_C00.tif"),
+                    planes[i], params,
+                )
         elif fmt == "nd2":
             write_nd2(Path(src) / "plate_A01.nd2", planes[:, :, :, None])
         else:  # czi
@@ -621,7 +635,7 @@ def measure_ingest(size: int) -> None:
     mpix = n_sites * size * size / 1e6
     per_format: dict = {}
     try:
-        for fmt in ("tiff", "nd2", "czi"):
+        for fmt in ("tiff", "tiff_raw", "nd2", "czi"):
             src = build_source(fmt)
             pooled = run_ingest(fmt, src, None)
             single = run_ingest(fmt, src, 1)
@@ -642,8 +656,9 @@ def measure_ingest(size: int) -> None:
     record = {
         "metric": "imextract_ingest_mpix_per_sec",
         "value": total,
-        "unit": f"Mpix/sec summed over native TIFF + ND2 + CZI parsers "
-                f"({n_sites} sites of {size}x{size} each, decode -> store)",
+        "unit": f"Mpix/sec summed over native TIFF-LZW + raw TIFF + ND2 + "
+                f"CZI parsers ({n_sites} blob sites of {size}x{size} each, "
+                f"decode -> store)",
         "vs_baseline": mean_speedup,
         "backend": "host",
         "config": "ingest",

@@ -118,6 +118,12 @@ def _load_locked():
             ctypes.c_char_p, ctypes.c_int32,
             ctypes.POINTER(ctypes.c_uint16), ctypes.c_int32, ctypes.c_int32,
         ]
+        lib.tm_tiff_read2.restype = ctypes.c_int32
+        lib.tm_tiff_read2.argtypes = [
+            ctypes.c_char_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32),
+        ]
     except AttributeError:
         logger.info("native library predates the TIFF reader; rebuild native/")
     try:
@@ -410,6 +416,44 @@ def tiff_read(path, page: int, height: int, width: int) -> np.ndarray | None:
         int(height), int(width),
     )
     return out if rc == 0 else None
+
+
+#: scratch for tiff_read_page — sized for a 2048² page up front, grown on
+#: demand; one allocation reused across the whole ingest run
+_TIFF_SCRATCH = threading.local()
+
+
+def tiff_read_page(path, page: int) -> "np.ndarray | None":
+    """Decode one grayscale TIFF page with dims discovered in the SAME
+    file load (``tm_tiff_read2``) — the ``tiff_info`` + ``tiff_read``
+    protocol loaded and walked the file twice per page.  None =
+    unsupported file; caller falls back."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_tiff_read2"):
+        return None
+    scratch = getattr(_TIFF_SCRATCH, "buf", None)
+    if scratch is None:
+        scratch = np.empty(2048 * 2048, np.uint16)
+        _TIFF_SCRATCH.buf = scratch
+    hwb = np.zeros((3,), np.int32)
+    for _ in range(2):
+        rc = lib.tm_tiff_read2(
+            str(path).encode(), int(page),
+            scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            scratch.shape[0],
+            hwb.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        if rc == 0:
+            h, w = int(hwb[0]), int(hwb[1])
+            out = scratch[: h * w].reshape(h, w)
+            return (
+                out.astype(np.uint8) if int(hwb[2]) == 8 else out.copy()
+            )
+        if rc != -2:
+            return None
+        scratch = np.empty(int(hwb[0]) * int(hwb[1]), np.uint16)
+        _TIFF_SCRATCH.buf = scratch
+    return None
 
 
 def _lzw_decode_py(src: bytes, expect: int) -> bytes | None:

@@ -293,14 +293,11 @@ class ImageReader(Reader):
         if out is not None:
             return out
         if str(self.filename).lower().endswith((".tif", ".tiff")):
-            from tmlibrary_tpu.native import tiff_info, tiff_read
+            from tmlibrary_tpu.native import tiff_read_page
 
-            info = tiff_info(self.filename)
-            if info is not None:
-                _, h, w, bits = info
-                img = tiff_read(self.filename, page, h, w)
-                if img is not None:
-                    return img.astype(np.uint8) if bits == 8 else img
+            img = tiff_read_page(self.filename, page)  # ONE file load
+            if img is not None:
+                return img
             img = read_tiff_page_py(self.filename, page)
             if img is not None:
                 return img
