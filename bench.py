@@ -166,6 +166,24 @@ def _workload_knobs(config: str) -> dict:
     }
 
 
+
+#: ledger fields every record must carry so stale-vs-tuned comparisons
+#: stay machine-checkable (round-4 VERDICT next-step #8): the pipelined
+#: configs self-describe their fetch-amortization depth and methodology
+#: version, host-synchronous ones say so explicitly
+def _ledger_fields(pdepth: "int | None", max_objects: "int | None" = None) -> dict:
+    out = {
+        "timing_methodology": (
+            f"pipelined-fetch-depth{pdepth}" if pdepth else "host-synchronous"
+        ),
+        "pipeline_depth": pdepth,
+        "pipelined": pdepth is not None,
+    }
+    if max_objects is not None:
+        out["max_objects"] = max_objects
+    return out
+
+
 def emit_cached_tpu(live_error: str) -> bool:
     """When the relay is down at driver time, emit the most recent
     ON-HARDWARE measurement cached by scripts/tpu_watch.py instead of a
@@ -390,9 +408,8 @@ def measure(platform: str) -> None:
         "cpu_denominator_sites_per_sec": round(cpu_sites_per_sec, 3),
         "config": config,
         "batch": batch,
-        "max_objects": max_objects,
         "site_size": size,
-        "pipeline_depth": pdepth,
+        **_ledger_fields(pdepth, max_objects),
     }
     if config == "volume":
         record["depth"] = depth
@@ -538,7 +555,7 @@ def measure_pyramid(size: int) -> None:
         "grid_x": gx,
         "site_size": size,
         "n_levels": n_levels,
-        "pipeline_depth": depth,
+        **_ledger_fields(depth),
     }
     record.update(_flops_fields(
         flops and flops * depth, depth * gy * gx, best,
@@ -665,7 +682,7 @@ def measure_ingest(size: int) -> None:
         "sites": n_sites,
         "site_size": size,
         "per_format": per_format,
-        "pipelined": False,
+        **_ledger_fields(None),
     }
     print(json.dumps(record), flush=True)
 
@@ -766,9 +783,8 @@ def measure_mesh(size: int) -> None:
         "backend": jax.default_backend(),
         "config": "mesh",
         "batch": per_device,
-        "max_objects": max_objects,
         "site_size": size,
-        "pipeline_depth": pdepth,
+        **_ledger_fields(pdepth, max_objects),
         "synthetic_cpu_mesh": backend_is_cpu,
     }
     print(json.dumps(record), flush=True)
@@ -853,7 +869,7 @@ def measure_spatial(size: int) -> None:
         "grid_x": gx,
         "site_size": size,
         "objects": int(count),
-        "pipelined": False,
+        **_ledger_fields(None),
     }
     print(json.dumps(record), flush=True)
 
@@ -916,7 +932,7 @@ def measure_corilla(size: int) -> None:
         "sites": n_sites,
         "channels": n_channels,
         "site_size": size,
-        "pipeline_depth": depth,
+        **_ledger_fields(depth),
     }
     record.update(_flops_fields(
         flops and flops * depth, depth * n_channels, best,
