@@ -345,7 +345,7 @@ def measure(platform: str) -> None:
     raw = {k: jnp.asarray(v) for k, v in data.items()}
     shifts = jnp.zeros((batch, 2), jnp.int32)
 
-    flops = _cost_flops(fn, raw, {}, shifts)
+    flops, cost_bytes = _cost_flops(fn, raw, {}, shifts)
 
     # compile + warm up.  NOTE: completion is forced by a host fetch of the
     # counts — under the axon relay, block_until_ready returns before the
@@ -424,42 +424,59 @@ def measure(platform: str) -> None:
             at_cap |= np.asarray(c) >= max_objects
         record["saturated_sites"] = int(at_cap.sum())
     record.update(_flops_fields(
-        flops and flops * pdepth, pdepth * batch, best, jax.default_backend()
+        flops and flops * pdepth, pdepth * batch, best,
+        jax.default_backend(), nbytes=cost_bytes and cost_bytes * pdepth,
     ))
     print(json.dumps(record), flush=True)
 
 
 def _cost_flops(jitted_fn, *args):
-    """Total FLOPs of one compiled batch step via XLA's cost model, or None
-    if the backend does not report it (round-2 VERDICT weak-spot: "fast"
-    was only ever judged against scipy, never against the roofline)."""
+    """(total FLOPs, total bytes accessed) of one compiled batch step via
+    XLA's cost model — (None, None) if the backend does not report it
+    (round-2 VERDICT weak-spot: "fast" was only ever judged against
+    scipy, never against the roofline; round-4 next-step #3: MFU alone
+    is the wrong lens for this memory/latency-shaped workload, so the
+    bytes side of the roofline must travel with every record)."""
     try:
         analysis = jitted_fn.lower(*args).compile().cost_analysis()
         if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
             analysis = analysis[0] if analysis else {}
         flops = float(analysis.get("flops", 0.0))
-        return flops if flops > 0 else None
+        nbytes = float(analysis.get("bytes accessed", 0.0))
+        return (flops if flops > 0 else None,
+                nbytes if nbytes > 0 else None)
     except Exception:
-        return None
+        return (None, None)
 
 
 # MXU peak of one TPU v5e (v5 lite) chip in bf16; the pipeline runs mostly
 # f32 (correctness gate: HIGHEST-precision convs), so MFU against the bf16
 # peak is a conservative lower bound.
 _V5E_BF16_PEAK_FLOPS = 197e12
+#: HBM bandwidth of one v5e chip (public spec: 819 GB/s)
+_V5E_HBM_PEAK_BPS = 819e9
 
 
-def _flops_fields(flops, n_items, best_s, backend, item_key="flops_per_site"):
-    if not flops:
-        return {}
-    achieved = flops / best_s
-    out = {
-        item_key: round(flops / n_items),
-        "achieved_tflops_per_sec": round(achieved / 1e12, 4),
-    }
-    out["mfu_vs_v5e_bf16_peak"] = (
-        round(achieved / _V5E_BF16_PEAK_FLOPS, 6) if backend != "cpu" else None
-    )
+def _flops_fields(flops, n_items, best_s, backend, item_key="flops_per_site",
+                  nbytes=None):
+    out = {}
+    on_device = backend != "cpu"
+    if flops:
+        achieved = flops / best_s
+        out[item_key] = round(flops / n_items)
+        out["achieved_tflops_per_sec"] = round(achieved / 1e12, 4)
+        out["mfu_vs_v5e_bf16_peak"] = (
+            round(achieved / _V5E_BF16_PEAK_FLOPS, 6) if on_device else None
+        )
+    if nbytes:
+        bps = nbytes / best_s
+        out["bytes_per_" + item_key.split("_per_")[-1]] = round(
+            nbytes / n_items
+        )
+        out["achieved_gbytes_per_sec"] = round(bps / 1e9, 3)
+        out["hbm_frac_vs_v5e_peak"] = (
+            round(bps / _V5E_HBM_PEAK_BPS, 6) if on_device else None
+        )
     return out
 
 
@@ -510,7 +527,7 @@ def measure_pyramid(size: int) -> None:
 
     fn = jax.jit(chain)
     dev_sites = jnp.asarray(sites)
-    flops = _cost_flops(fn, dev_sites)
+    flops, cost_bytes = _cost_flops(fn, dev_sites)
     levels = fn(dev_sites)
     np.asarray(levels[-1])  # honest clock under the relay
 
@@ -559,7 +576,8 @@ def measure_pyramid(size: int) -> None:
     }
     record.update(_flops_fields(
         flops and flops * depth, depth * gy * gx, best,
-        jax.default_backend(), item_key="flops_per_site"))
+        jax.default_backend(), item_key="flops_per_site",
+        nbytes=cost_bytes and cost_bytes * depth))
     print(json.dumps(record), flush=True)
 
 
@@ -898,7 +916,7 @@ def measure_corilla(size: int) -> None:
         jax.vmap(lambda s: welford_finalize(welford_scan(s)))
     )
     dev_stack = jnp.asarray(stack)
-    flops = _cost_flops(fn, dev_stack)
+    flops, cost_bytes = _cost_flops(fn, dev_stack)
     out = fn(dev_stack)
     np.asarray(out["n"])  # force completion (honest clock under the relay)
 
@@ -936,7 +954,8 @@ def measure_corilla(size: int) -> None:
     }
     record.update(_flops_fields(
         flops and flops * depth, depth * n_channels, best,
-        jax.default_backend(), item_key="flops_per_channel"))
+        jax.default_backend(), item_key="flops_per_channel",
+        nbytes=cost_bytes and cost_bytes * depth))
     print(json.dumps(record), flush=True)
 
 

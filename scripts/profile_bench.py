@@ -39,12 +39,25 @@ PIPELINE = int(os.environ.get("PROFILE_PIPELINE", "8"))
 
 #: stage name -> best ms, in measurement order (dict preserves insertion)
 STAGES: "dict[str, float]" = {}
+#: stage name -> (flops, bytes accessed) from XLA's cost model — the
+#: bytes side of the roofline (round-4 VERDICT next-step #3: MFU alone
+#: is the wrong lens for this memory/latency-shaped workload)
+STAGE_COST: "dict[str, tuple]" = {}
 
 
 def timeit(name, fn, *args):
     """Pipelined timing: PIPELINE executions per ONE fenced fetch, so the
     ~100 ms relay round-trip (the measured noop floor) is amortized out
     of every stage number instead of dominating it."""
+    try:
+        an = fn.lower(*args).compile().cost_analysis()
+        if isinstance(an, (list, tuple)):
+            an = an[0] if an else {}
+        STAGE_COST[name] = (
+            float(an.get("flops", 0.0)), float(an.get("bytes accessed", 0.0))
+        )
+    except Exception:
+        STAGE_COST[name] = (0.0, 0.0)
     np.asarray(fn(*args))  # compile + warm
     best = float("inf")
     for _ in range(3):
@@ -52,7 +65,9 @@ def timeit(name, fn, *args):
         np.asarray(jnp.stack([fn(*args) for _ in range(PIPELINE)]))
         best = min(best, (time.perf_counter() - t0) / PIPELINE)
     STAGES[name] = best * 1e3
-    print(f"{name:35s} {best*1e3:9.2f} ms  ({BATCH/best:8.1f} sites/s)")
+    gbps = STAGE_COST[name][1] / best / 1e9
+    print(f"{name:35s} {best*1e3:9.2f} ms  ({BATCH/best:8.1f} sites/s, "
+          f"{gbps:6.1f} GB/s)")
 
 
 def scalar(fn):
@@ -132,6 +147,12 @@ def main():
 
         payload = {
             "stages_ms": {k: round(v, 3) for k, v in STAGES.items()},
+            "stages_flops": {
+                k: round(v[0]) for k, v in STAGE_COST.items()
+            },
+            "stages_bytes": {
+                k: round(v[1]) for k, v in STAGE_COST.items()
+            },
             "batch": BATCH,
             "site_size": SIZE,
             "max_objects": MAXOBJ,

@@ -238,7 +238,94 @@ def freeze(force: bool = False) -> int:
     print(f"froze {out_path}: counts nuclei="
           f"{np.asarray(res.counts['nuclei']).tolist()} cells="
           f"{np.asarray(res.counts['cells']).tolist()}")
+    freeze_families(dapi, nuclei, force=force)
     return 0
+
+
+#: per-family comparison tolerance, documented once (PARITY.md fidelity
+#: ledger): scipy-exact families compare tight, independent-numpy
+#: families (Haralick/Zernike — mahotas was never installable here, so
+#: the twins were verified against independent numpy reimplementations)
+#: compare at the ledgered 2e-3 tier
+FAMILY_TIERS = {
+    "morphology": {"rtol": 1e-5, "atol": 1e-6,
+                   "tier": "scipy-exact family (rtol 1e-5)"},
+    "haralick": {"rtol": 2e-3, "atol": 1e-5,
+                 "tier": "independent-numpy family (rtol 2e-3)"},
+    "zernike": {"rtol": 2e-3, "atol": 1e-5,
+                "tier": "independent-numpy family (rtol 2e-3)"},
+    "corilla": {"rtol": 1e-4, "atol": 1e-6,
+                "tier": "online-stats family (rtol 1e-4; log or linear "
+                        "domain, whichever the reference produces)"},
+    "align": {"tier": "integer shifts, exact (±1 px slack for "
+                      "subpixel-refined references)"},
+}
+
+#: deterministic whole-pixel shifts frozen for the align family
+_ALIGN_SHIFTS = ((0, 0), (2, -3), (5, 1), (-4, 4))
+
+
+def freeze_families(dapi, nuclei, force: bool = False) -> None:
+    """Freeze the remaining fidelity-ledger families (round-4 VERDICT
+    next-step #5) computed on the SAME frozen inputs/labels:
+    morphology + Haralick + Zernike per-object features, corilla
+    illumination statistics (log-domain Welford grids + exact
+    percentiles, plus linear-domain grids for references that skip the
+    log transform), and align shifts for known whole-pixel rolls."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmlibrary_tpu.ops.measure import (
+        haralick_features,
+        morphology_features,
+        zernike_features,
+    )
+    from tmlibrary_tpu.ops.registration import phase_correlation
+    from tmlibrary_tpu.ops.stats import welford_finalize, welford_scan
+
+    fam_path = GOLDEN / "feature_families.npz"
+    if fam_path.exists() and not force:
+        print(f"{fam_path} exists; use --force to regenerate")
+        return
+    labels = jnp.asarray(nuclei, jnp.int32)
+    img = jnp.asarray(dapi, jnp.float32)
+    v = jax.vmap
+    arrays: dict = {}
+    for key, val in v(lambda l: morphology_features(l, 32))(labels).items():
+        arrays[f"morph_{key.removeprefix('Morphology_')}"] = np.asarray(val)
+    for key, val in v(
+        lambda l, im: haralick_features(l, im, 32, levels=16)
+    )(labels, img).items():
+        arrays[f"har_{key.removeprefix('Texture_')}"] = np.asarray(val)
+    for key, val in v(
+        lambda l: zernike_features(l, 32, degree=6)
+    )(labels).items():
+        arrays[f"zer_{key.removeprefix('Zernike_')}"] = np.asarray(val)
+
+    fin = welford_finalize(welford_scan(img))
+    arrays["corilla_mean_log"] = np.asarray(fin["mean_log"])
+    arrays["corilla_std_log"] = np.asarray(fin["std_log"])
+    arrays["corilla_percentile_keys"] = np.asarray(fin["percentile_keys"])
+    arrays["corilla_percentile_values"] = np.asarray(fin["percentile_values"])
+    # linear-domain twin grids, straight numpy: a reference that
+    # accumulates raw intensities binds against these instead
+    d64 = np.asarray(dapi, np.float64)
+    arrays["corilla_mean_linear"] = d64.mean(axis=0)
+    arrays["corilla_std_linear"] = d64.std(axis=0)
+
+    ref_img = np.asarray(dapi[0], np.float32)
+    shifts = []
+    for dy, dx in _ALIGN_SHIFTS:
+        target = np.roll(ref_img, (dy, dx), axis=(0, 1))
+        sy, sx = phase_correlation(jnp.asarray(ref_img), jnp.asarray(target))
+        shifts.append((int(sy), int(sx)))
+    arrays["align_true"] = np.asarray(_ALIGN_SHIFTS, np.int32)
+    arrays["align_shifts"] = np.asarray(shifts, np.int32)
+
+    np.savez_compressed(fam_path, **arrays)
+    print(f"froze {fam_path}: {len(arrays)} arrays "
+          f"(align shifts {shifts})")
 
 
 # -------------------------------------------------------------------- check
@@ -359,6 +446,246 @@ def segment_with_reference(mods: dict, dapi_site, actin_site) -> dict:
     return report
 
 
+def _norm_name(name: str) -> str:
+    return "".join(c for c in str(name).lower() if c.isalnum())
+
+
+def _columns_of(outputs: dict) -> dict:
+    """Named 1-D columns from a reference measurement output — accepts a
+    pandas DataFrame, a dict of arrays, or a 2-D array with a parallel
+    ``names`` entry.  {} when nothing column-like is found."""
+    import numpy as np
+
+    for val in outputs.values():
+        cols = getattr(val, "columns", None)
+        if cols is not None:  # DataFrame-like
+            return {str(c): np.asarray(val[c]) for c in cols}
+    named = {
+        str(k): np.asarray(val)
+        for k, val in outputs.items()
+        if isinstance(val, np.ndarray) and np.asarray(val).ndim == 1
+    }
+    return named
+
+
+def _diff_feature_family(
+    family: str, module, gold, gold_fam, prefix: str, inputs_for_site
+) -> dict:
+    """Run one reference measure module per site and diff every column
+    whose normalized name matches a frozen feature of this family, at
+    the family's tier.  Every binding failure is reported, never
+    swallowed — the first real reference will likely need binder work
+    (round-4 VERDICT weak #5), and this tells the operator exactly
+    where."""
+    import numpy as np
+
+    tier = FAMILY_TIERS[family]
+    if module is None:
+        return {"checked": False, "tier": tier["tier"],
+                "error": "module not found in reference tree"}
+    ours = {
+        _norm_name(k[len(prefix):]): k
+        for k in gold_fam.files if k.startswith(prefix)
+    }
+    matched: set = set()
+    mismatches: list = []
+    errors: list = []
+    for s in range(gold["dapi"].shape[0]):
+        r = bind_and_run(module, inputs_for_site(s))
+        if "error" in r:
+            errors.append({"site": s, "error": r["error"]})
+            continue
+        cols = _columns_of(r["outputs"])
+        n = int(gold["nuclei_counts"][s])
+        for cname, cvals in cols.items():
+            nc = _norm_name(cname)
+            # EXACT normalized match first; containment only as a
+            # fallback, longest candidate wins (plain containment paired
+            # a reference "sum_entropy" column with our "entropy")
+            key = ours.get(nc)
+            if key is None:
+                cands = [o for o in ours if o and (o in nc or nc in o)]
+                key = ours[max(cands, key=len)] if cands else None
+            if key is None or len(cvals) < n:
+                continue
+            matched.add(key)
+            want = np.asarray(gold_fam[key][s][:n], np.float64)
+            got = np.asarray(cvals[:n], np.float64)
+            if not np.allclose(got, want, rtol=tier["rtol"],
+                               atol=tier["atol"], equal_nan=True):
+                mismatches.append({"site": s, "column": cname,
+                                   "feature": key,
+                                   "max_rel": float(np.nanmax(
+                                       np.abs(got - want)
+                                       / np.maximum(np.abs(want), 1e-9)))})
+    checked = bool(matched) and not errors
+    return {
+        "checked": checked,
+        "tier": tier["tier"],
+        "features_matched": sorted(matched),
+        "features_unmatched": sorted(
+            set(k for k in gold_fam.files if k.startswith(prefix))
+            - matched
+        ),
+        "mismatches": mismatches,
+        "errors": errors,
+        "pass": bool(checked and not mismatches) if checked else None,
+    }
+
+
+def _diff_corilla(root: Path, gold, gold_fam) -> dict:
+    """Feed the frozen site stack to the reference's OnlineStatistics
+    and diff the resulting mean/std grids — log- OR linear-domain,
+    whichever the reference accumulates."""
+    import numpy as np
+
+    tier = FAMILY_TIERS["corilla"]
+    candidates = [
+        p for p in sorted(root.glob("**/corilla/*.py"))
+        if "OnlineStatistics" in p.read_text(errors="replace")
+    ]
+    if not candidates:
+        return {"checked": False, "tier": tier["tier"],
+                "error": "no corilla module defines OnlineStatistics"}
+    try:
+        mod = load_module(candidates[0])
+        cls = getattr(mod, "OnlineStatistics")
+    except Exception as exc:  # noqa: BLE001 — report, don't crash
+        return {"checked": False, "tier": tier["tier"],
+                "error": f"import failed: {type(exc).__name__}: {exc}"}
+    stack = np.asarray(gold["dapi"], np.float64)
+    h, w = stack.shape[1:]
+    stats = None
+    for ctor_args in ((), ((h, w),), (h,), ({"image_dimensions": (h, w)},)):
+        try:
+            stats = (cls(**ctor_args[0]) if ctor_args
+                     and isinstance(ctor_args[0], dict) else cls(*ctor_args))
+            break
+        except Exception:  # noqa: BLE001 — try the next signature
+            continue
+    if stats is None:
+        return {"checked": False, "tier": tier["tier"],
+                "error": f"could not construct OnlineStatistics "
+                         f"(tried 4 signatures) from {candidates[0]}"}
+    try:
+        for s in range(stack.shape[0]):
+            stats.update(stack[s])
+        ref_mean = np.asarray(stats.mean, np.float64)
+        ref_std = np.asarray(stats.std, np.float64)
+    except Exception as exc:  # noqa: BLE001
+        return {"checked": False, "tier": tier["tier"],
+                "error": f"update/mean/std failed: "
+                         f"{type(exc).__name__}: {exc}"}
+    verdicts = {}
+    for domain in ("log", "linear"):
+        ok_mean = bool(np.allclose(
+            ref_mean, gold_fam[f"corilla_mean_{domain}"],
+            rtol=tier["rtol"], atol=tier["atol"]))
+        ok_std = bool(np.allclose(
+            ref_std, gold_fam[f"corilla_std_{domain}"],
+            rtol=tier["rtol"], atol=1e-3))
+        verdicts[domain] = {"mean": ok_mean, "std": ok_std}
+    best = max(verdicts, key=lambda d: sum(verdicts[d].values()))
+    return {
+        "checked": True,
+        "tier": tier["tier"],
+        "domain": best,
+        "per_domain": verdicts,
+        "pass": all(verdicts[best].values()),
+    }
+
+
+def _diff_align(root: Path, gold, gold_fam) -> dict:
+    """Run the reference's registration on the frozen whole-pixel rolls
+    and diff the recovered shifts (±1 px slack)."""
+    import numpy as np
+
+    tier = FAMILY_TIERS["align"]
+    fn = None
+    for p in sorted(root.glob("**/align/**/*.py")) + sorted(
+        root.glob("**/align/*.py")
+    ):
+        try:
+            mod = load_module(p)
+        except Exception:  # noqa: BLE001 — a later candidate may import
+            continue
+        for name in ("calculate_shift", "compute_shift", "register",
+                     "registration", "shift"):
+            cand = getattr(mod, name, None)
+            if callable(cand):
+                fn = cand
+                break
+        if fn is not None:
+            break
+    if fn is None:
+        return {"checked": False, "tier": tier["tier"],
+                "error": "no registration callable found under align/"}
+    ref_img = np.asarray(gold["dapi"][0], np.float64)
+    results = []
+    ok = True
+    for (dy, dx), want in zip(_ALIGN_SHIFTS, gold_fam["align_shifts"]):
+        target = np.roll(ref_img, (dy, dx), axis=(0, 1))
+        try:
+            out = fn(target, ref_img)
+        except TypeError:
+            try:
+                out = fn(ref_img, target)
+            except Exception as exc:  # noqa: BLE001
+                return {"checked": False, "tier": tier["tier"],
+                        "error": f"registration call failed: {exc}"}
+        except Exception as exc:  # noqa: BLE001
+            return {"checked": False, "tier": tier["tier"],
+                    "error": f"registration call failed: {exc}"}
+        got = np.asarray(out).reshape(-1)[:2]
+        # sign convention unknown until arrival: accept either
+        match = bool(
+            np.all(np.abs(np.abs(got) - np.abs(np.asarray(want))) <= 1)
+        )
+        ok &= match
+        results.append({"true": (dy, dx), "ours": [int(v) for v in want],
+                        "reference": [float(v) for v in got],
+                        "match": match})
+    return {"checked": True, "tier": tier["tier"], "shifts": results,
+            "pass": ok}
+
+
+def check_families(root: Path, mods: dict, gold) -> dict:
+    """Per-family fidelity verdicts (round-4 VERDICT next-step #5) —
+    reference arrival adjudicates the WHOLE ledger in one run."""
+    import numpy as np
+
+    fam_path = GOLDEN / "feature_families.npz"
+    if not fam_path.exists():
+        return {"error": "feature_families.npz missing — rerun freeze"}
+    gold_fam = np.load(fam_path)
+    fam_mods = {
+        name: find_module(root, name)
+        for name in ("measure_morphology", "measure_texture",
+                     "measure_zernike")
+    }
+    out = {
+        "morphology": _diff_feature_family(
+            "morphology", fam_mods["measure_morphology"], gold, gold_fam,
+            "morph_",
+            lambda s: {"labels": gold["nuclei_labels"][s]},
+        ),
+        "haralick": _diff_feature_family(
+            "haralick", fam_mods["measure_texture"], gold, gold_fam,
+            "har_",
+            lambda s: {"labels": gold["nuclei_labels"][s],
+                       "dapi": gold["dapi"][s]},
+        ),
+        "zernike": _diff_feature_family(
+            "zernike", fam_mods["measure_zernike"], gold, gold_fam,
+            "zer_",
+            lambda s: {"labels": gold["nuclei_labels"][s]},
+        ),
+        "corilla": _diff_corilla(root, gold, gold_fam),
+        "align": _diff_align(root, gold, gold_fam),
+    }
+    return out
+
+
 def check(root: Path) -> int:
     import numpy as np
 
@@ -452,6 +779,7 @@ def check(root: Path) -> int:
                     site_res["intensity"] = {"mean_dapi_allclose": close}
         results["sites"].append(site_res)
 
+    results["families"] = check_families(root, mods, gold)
     results["gate"] = {
         "ran_reference_modules": ran_any,
         "bit_identical_counts": bool(gate_pass and ran_any),
@@ -466,6 +794,21 @@ def check(root: Path) -> int:
           f"{results['gate']['bit_identical_counts']}")
     print(f"intensity parity: checked={intensity_checked} "
           f"allclose={results['gate']['intensity_allclose']}")
+    fams = results["families"]
+    if "error" in fams:
+        print(f"families: {fams['error']}")
+    else:
+        for name, fam in fams.items():
+            if fam.get("checked"):
+                verdict = "PASS" if fam.get("pass") else "MISMATCH"
+                extra = (
+                    f" ({len(fam.get('features_matched', []))} features)"
+                    if "features_matched" in fam else ""
+                )
+            else:
+                verdict = f"UNCHECKED — {fam.get('error', '?')}"
+                extra = ""
+            print(f"family {name:12s} [{fam['tier']}]: {verdict}{extra}")
     return 0 if results["gate"]["bit_identical_counts"] else 1
 
 

@@ -203,6 +203,9 @@ def test_render_profile_names_binding_stage(monkeypatch, tmp_path):
     from scripts import update_baseline_table as u
 
     monkeypatch.setattr(u, "PROFILE", tmp_path / "PROFILE_TPU.json")
+    # the CPU-capture fallback must not leak the repo's committed file
+    # into this test's empty-profile case
+    monkeypatch.setattr(u, "PROFILE_CPU", tmp_path / "PROFILE_CPU.json")
     (tmp_path / "PROFILE_TPU.json").write_text(json.dumps({
         "stages_ms": {
             "noop (fetch floor)": 0.1,
@@ -267,6 +270,7 @@ def test_update_baseline_table_idempotent(monkeypatch, tmp_path):
     # absent in tmp: the sweep/profile sections must simply not render
     monkeypatch.setattr(u, "TUNING", tmp_path / "TUNING.json")
     monkeypatch.setattr(u, "PROFILE", tmp_path / "PROFILE_TPU.json")
+    monkeypatch.setattr(u, "PROFILE_CPU", tmp_path / "PROFILE_CPU.json")
     assert u.main() == 0
     once = baseline.read_text()
     assert "400.0" in once and once.count(u.BEGIN) == 1
