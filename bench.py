@@ -640,12 +640,25 @@ def measure_ingest(size: int) -> None:
             write_czi(Path(src) / "scan_A01.czi", planes[:, None, :, :])
         return src
 
-    def run_ingest(fmt: str, src: str, workers: "int | None") -> float:
-        """Best-of-reps wall seconds for the full imextract phase."""
+    def run_ingest(
+        fmt: str, src: str, workers: "int | None",
+        throttle_ms: "float | None" = None,
+    ) -> float:
+        """Best-of-reps wall seconds for the full imextract phase.
+        ``throttle_ms`` arms the cold-source simulation (a per-plane
+        worker sleep standing in for network-filestore latency — see
+        imextract._read_plane): the pool overlaps those stalls exactly
+        like real blocked IO, which is its reason to exist (round-4
+        VERDICT next-step #7: with warm local files the pool measured
+        ~1.0x and its value was asserted, not measured)."""
         if workers is not None:
             os.environ["TMX_INGEST_WORKERS"] = str(workers)
         else:
             os.environ.pop("TMX_INGEST_WORKERS", None)
+        if throttle_ms is not None:
+            os.environ["TMX_INGEST_THROTTLE_MS"] = str(throttle_ms)
+        else:
+            os.environ.pop("TMX_INGEST_THROTTLE_MS", None)
         best = float("inf")
         for _ in range(reps):
             root = os.path.join(
@@ -670,17 +683,30 @@ def measure_ingest(size: int) -> None:
     mpix = n_sites * size * size / 1e6
     per_format: dict = {}
     try:
+        cold_ms = float(os.environ.get("BENCH_INGEST_COLD_MS", "2"))
         for fmt in ("tiff", "tiff_raw", "nd2", "czi"):
             src = build_source(fmt)
             pooled = run_ingest(fmt, src, None)
             single = run_ingest(fmt, src, 1)
+            cold_pooled = run_ingest(fmt, src, None, throttle_ms=cold_ms)
+            cold_single = run_ingest(fmt, src, 1, throttle_ms=cold_ms)
             per_format[fmt] = {
                 "mpix_per_sec": round(mpix / pooled, 2),
                 "single_thread_mpix_per_sec": round(mpix / single, 2),
                 "pool_speedup": round(single / pooled, 2),
+                # cold-source rows: per-plane latency simulated in the
+                # worker (TMX_INGEST_THROTTLE_MS), where the pool's IO
+                # overlap is the whole point
+                "cold_source_ms_per_plane": cold_ms,
+                "cold_mpix_per_sec": round(mpix / cold_pooled, 2),
+                "cold_single_thread_mpix_per_sec": round(
+                    mpix / cold_single, 2
+                ),
+                "cold_pool_speedup": round(cold_single / cold_pooled, 2),
             }
     finally:
         os.environ.pop("TMX_INGEST_WORKERS", None)
+        os.environ.pop("TMX_INGEST_THROTTLE_MS", None)
         shutil.rmtree(tmpdir, ignore_errors=True)
 
     total = round(sum(f["mpix_per_sec"] for f in per_format.values()), 2)

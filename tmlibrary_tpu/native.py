@@ -351,15 +351,24 @@ def hull_pixel_counts_host(labels: np.ndarray, max_label: int) -> np.ndarray:
     return out
 
 
-def solidity_host(labels: np.ndarray, max_label: int) -> np.ndarray:
+def solidity_host(
+    labels: np.ndarray, max_label: int, areas: "np.ndarray | None" = None
+) -> np.ndarray:
     """Per-object solidity = area / convex_hull_pixel_count → (max_label,)
-    float32; absent labels get 0."""
+    float32; absent labels get 0.  ``areas`` (``(max_label,)`` pixel
+    counts for ids 1..max_label) skips the label-mask + bincount passes
+    when the caller already accumulated them (the mosaic persist path
+    has them from ``mosaic_morph_host`` — three full-mosaic passes saved
+    at plate scale)."""
     labels = np.asarray(labels)
-    flat = labels.ravel()
-    # ids beyond max_label are dropped (hull counting skips them too);
-    # clipping would alias their pixels onto object max_label's area
-    flat = np.where((flat >= 0) & (flat <= max_label), flat, 0)
-    areas = np.bincount(flat, minlength=max_label + 1)[1:].astype(np.float64)
+    if areas is None:
+        flat = labels.ravel()
+        # ids beyond max_label are dropped (hull counting skips them
+        # too); clipping would alias their pixels onto object
+        # max_label's area
+        flat = np.where((flat >= 0) & (flat <= max_label), flat, 0)
+        areas = np.bincount(flat, minlength=max_label + 1)[1:]
+    areas = np.asarray(areas, np.float64)
     hull = hull_pixel_counts_host(labels, max_label).astype(np.float64)
     return np.where(hull > 0, areas / np.maximum(hull, 1.0), 0.0).astype(np.float32)
 

@@ -51,6 +51,25 @@ def otsu_value(img: jax.Array, bins: int = 256, method: str = "auto") -> jax.Arr
 
         nd = img_f.ndim  # unbatched rank at trace time
 
+        if not isinstance(img_f, jax.core.Tracer):
+            # EAGER caller (the spatial mosaic paths compute their
+            # global threshold outside jit): one direct C pass — routing
+            # an eager op through the pure_callback machinery measured
+            # pathologically slow at mosaic scale (minutes for a 4 Mpix
+            # well)
+            hist_h, lo_h, hi_h = native.otsu_hist_host(
+                np.asarray(img_f).reshape(1, -1), bins
+            )
+            hist = jnp.asarray(hist_h[0])
+            lo = jnp.asarray(lo_h[0])
+            hi = jnp.asarray(hi_h[0])
+            span = jnp.maximum(hi - lo, 1e-6)
+            centers = (
+                lo + (jnp.arange(bins, dtype=jnp.float32) + 0.5)
+                / bins * span
+            )
+            return _otsu_argmax(hist, centers)
+
         def host(a):
             from tmlibrary_tpu import native
 
@@ -94,7 +113,12 @@ def otsu_value(img: jax.Array, bins: int = 256, method: str = "auto") -> jax.Arr
             method="scatter" if jax.default_backend() == "cpu" else "matmul",
         )
     centers = lo + (jnp.arange(bins, dtype=jnp.float32) + 0.5) / bins * span
+    return _otsu_argmax(hist, centers)
 
+
+def _otsu_argmax(hist: jax.Array, centers: jax.Array) -> jax.Array:
+    """Between-class-variance argmax over a (bins,) histogram — shared
+    by the traced and eager otsu paths (bit-identical math)."""
     w0 = jnp.cumsum(hist)
     w1 = w0[-1] - w0
     sum0 = jnp.cumsum(hist * centers)

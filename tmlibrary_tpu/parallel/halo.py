@@ -109,6 +109,26 @@ def sharded_halo_map_2d(
     return jax.jit(mapped)(image)
 
 
+@functools.lru_cache(maxsize=64)
+def _cached_gaussian_halo_2d(mesh: Mesh, sigma: float, radius: int,
+                             row_axis: str, col_axis: str):
+    """Compiled 2-D halo smooth, cached by (mesh, sigma, axes) — a fresh
+    ``jit(shard_map(partial(...)))`` per call retraced AND recompiled the
+    program every well (~230 ms of XLA compile per spatial run)."""
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+    def body(block):
+        extended = halo_exchange_2d(block, radius, row_axis, col_axis)
+        return gaussian_smooth(extended, sigma)[radius:-radius, radius:-radius]
+
+    return jax.jit(jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(row_axis, col_axis),
+        out_specs=PartitionSpec(row_axis, col_axis),
+    ))
+
+
 def sharded_gaussian_smooth_2d(
     image: jax.Array,
     mesh: Mesh,
@@ -118,13 +138,19 @@ def sharded_gaussian_smooth_2d(
 ) -> jax.Array:
     """Gaussian blur over an image sharded on both spatial axes,
     bit-matching the single-device ``ops.smooth.gaussian_smooth``."""
-    from tmlibrary_tpu.ops.smooth import gaussian_radius, gaussian_smooth
+    from tmlibrary_tpu.ops.smooth import gaussian_radius
 
     radius = gaussian_radius(sigma)
-    return sharded_halo_map_2d(
-        functools.partial(gaussian_smooth, sigma=sigma),
-        image, mesh, radius, row_axis, col_axis,
-    )
+    h, w = image.shape
+    nr = mesh.shape[row_axis]
+    nc = mesh.shape[col_axis]
+    if h % nr or w % nc:
+        raise ShardingError(
+            f"image {h}x{w} not divisible by mesh {nr}x{nc}"
+        )
+    return _cached_gaussian_halo_2d(
+        mesh, float(sigma), radius, row_axis, col_axis
+    )(image)
 
 
 def sharded_halo_map(
@@ -160,17 +186,37 @@ def sharded_halo_map(
     return jax.jit(mapped)(image)
 
 
+@functools.lru_cache(maxsize=64)
+def _cached_gaussian_halo(mesh: Mesh, sigma: float, radius: int, axis: str):
+    """Compiled row-sharded halo smooth, cached by (mesh, sigma, axis) —
+    see :func:`_cached_gaussian_halo_2d` for why."""
+    from tmlibrary_tpu.ops.smooth import gaussian_smooth
+
+    def body(block):
+        extended = halo_exchange(block, radius, axis)
+        return gaussian_smooth(extended, sigma)[radius:-radius]
+
+    return jax.jit(jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=PartitionSpec(axis),
+        out_specs=PartitionSpec(axis),
+    ))
+
+
 def sharded_gaussian_smooth(
     image: jax.Array, mesh: Mesh, sigma: float, axis: str = "rows"
 ) -> jax.Array:
     """Row-sharded Gaussian blur, bit-matching the single-device
     ``ops.smooth.gaussian_smooth`` (and thus scipy) including edges."""
-    from tmlibrary_tpu.ops.smooth import gaussian_radius, gaussian_smooth
+    from tmlibrary_tpu.ops.smooth import gaussian_radius
 
     radius = gaussian_radius(sigma)
-    return sharded_halo_map(
-        functools.partial(gaussian_smooth, sigma=sigma), image, mesh, radius, axis
-    )
+    h = image.shape[0]
+    n = mesh.devices.size
+    if h % n != 0:
+        raise ShardingError(f"image rows {h} not divisible by mesh size {n}")
+    return _cached_gaussian_halo(mesh, float(sigma), radius, axis)(image)
 
 
 def sharded_downsample_2x(image: jax.Array, mesh: Mesh, axis: str = "rows") -> jax.Array:

@@ -41,7 +41,20 @@ class ImageExtractor(Step):
         first-party ND2 chunk-map reader for ``.nd2`` containers
         (``page`` encodes sequence * n_components + component, as written
         by the nd2 metaconfig handler), cv2 for everything else (PNG,
-        tiled TIFF, RGB, ...)."""
+        tiled TIFF, RGB, ...).
+
+        ``TMX_INGEST_THROTTLE_MS`` sleeps that long per plane read in
+        the WORKER, simulating a slow/cold source (network filestore
+        latency) deterministically: sleeps release the GIL, so the pool
+        can overlap them exactly like real blocked IO — the measurable
+        reason the decode pool exists (bench ``ingest`` cold rows)."""
+        import os as _os
+
+        throttle = _os.environ.get("TMX_INGEST_THROTTLE_MS")
+        if throttle:
+            import time as _time
+
+            _time.sleep(float(throttle) / 1e3)
         from tmlibrary_tpu.readers import read_container_plane
 
         container = read_container_plane(path, page or 0)
@@ -104,7 +117,12 @@ class ImageExtractor(Step):
         except ValueError:
             workers = 0
         if workers < 1:
-            workers = min(8, os.cpu_count() or 1)
+            # IO-bound sizing, NOT cpu_count-bound: the pool exists to
+            # overlap storage stalls (cold network filestores), where
+            # threads spend most of their life blocked outside the GIL —
+            # a 1-core host still wants several in flight.  The floor of
+            # 4 is what makes the cold-source bench rows meaningful.
+            workers = max(4, min(8, os.cpu_count() or 1))
         n_written = 0
         with cf.ThreadPoolExecutor(max_workers=workers) as pool:
             # submit every decode up front (concurrency spans plane

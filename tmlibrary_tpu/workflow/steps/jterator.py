@@ -545,7 +545,9 @@ class ImageAnalysisRunner(Step):
         from tmlibrary_tpu import native as native_mod
 
         if count and native_mod.available():
-            solidity = native_mod.solidity_host(labels, count).astype(np.float64)
+            solidity = native_mod.solidity_host(
+                labels, count, areas=area
+            ).astype(np.float64)
         else:
             if count:
                 logger.info(
