@@ -1406,3 +1406,71 @@ int32_t tm_box_mean(const float* img, int64_t n_sites, int32_t h,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// Multi-channel per-label sums over a batch of flattened sites:
+// labels (n_sites, px) int32, vals (n_sites, n_channels, px) float32 →
+// sums (n_sites, n_channels, count + 1) float32.  Accumulation is
+// float32 in row-major pixel order per channel — XLA-CPU's
+// segment_sum over (px, channels) stacks accumulates each channel
+// column independently in pixel order, so this is bit-identical.
+// Out-of-range labels are DROPPED like segment ids.  Returns 0 / -1.
+int32_t tm_site_channel_sums(const int32_t* labels, const float* vals,
+                             int64_t n_sites, int64_t n_channels,
+                             int64_t px, int32_t count, float* sums_out) {
+  if (!labels || !vals || !sums_out || n_sites < 0 || n_channels <= 0 ||
+      px < 0 || count < 0)
+    return -1;
+  const int64_t k1 = static_cast<int64_t>(count) + 1;
+  for (int64_t s = 0; s < n_sites; ++s) {
+    const int32_t* lab = labels + s * px;
+    for (int64_t c = 0; c < n_channels; ++c) {
+      const float* v = vals + (s * n_channels + c) * px;
+      float* out = sums_out + (s * n_channels + c) * k1;
+      for (int64_t k = 0; k < k1; ++k) out[k] = 0.0f;
+      for (int64_t i = 0; i < px; ++i) {
+        const int32_t l = lab[i];
+        if (l < 0 || l > count) continue;
+        out[l] += v[i];
+      }
+    }
+  }
+  return 0;
+}
+
+// Multi-channel per-label (min, max), same layout/semantics as
+// tm_site_channel_sums; absent labels keep the XLA reduction
+// identities (+inf / -inf).  Returns 0 / -1.
+int32_t tm_site_channel_minmax(const int32_t* labels, const float* vals,
+                               int64_t n_sites, int64_t n_channels,
+                               int64_t px, int32_t count, float* min_out,
+                               float* max_out) {
+  if (!labels || !vals || !min_out || !max_out || n_sites < 0 ||
+      n_channels <= 0 || px < 0 || count < 0)
+    return -1;
+  const float inf = std::numeric_limits<float>::infinity();
+  const int64_t k1 = static_cast<int64_t>(count) + 1;
+  for (int64_t s = 0; s < n_sites; ++s) {
+    const int32_t* lab = labels + s * px;
+    for (int64_t c = 0; c < n_channels; ++c) {
+      const float* v = vals + (s * n_channels + c) * px;
+      float* mn = min_out + (s * n_channels + c) * k1;
+      float* mx = max_out + (s * n_channels + c) * k1;
+      for (int64_t k = 0; k < k1; ++k) {
+        mn[k] = inf;
+        mx[k] = -inf;
+      }
+      for (int64_t i = 0; i < px; ++i) {
+        const int32_t l = lab[i];
+        if (l < 0 || l > count) continue;
+        const float x = v[i];
+        if (x < mn[l]) mn[l] = x;
+        if (x > mx[l]) mx[l] = x;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

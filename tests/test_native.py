@@ -569,3 +569,33 @@ print("OK")
         text=True, timeout=300, cwd=str(pathlib.Path(__file__).parent.parent),
     )
     assert r.returncode == 0 and "OK" in r.stdout, r.stderr[-1500:]
+
+
+def test_channel_sums_minmax_bit_identical_to_scatter(rng):
+    """The multi-channel reduction kernels (tm_site_channel_sums /
+    tm_site_channel_minmax) are bit-identical to the XLA segment
+    scatters.  They are EXPLICIT opt-in (method="native") — auto-routing
+    them hung XLA-CPU inside morphology's program (see grouped_sums) —
+    but the kernels themselves stay correct and covered."""
+    import jax
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu import native as nat
+    from tmlibrary_tpu.ops.measure import grouped_minmax_multi, grouped_sums
+
+    if not nat.has_site_stats():
+        pytest.skip("native measurement kernels unavailable")
+    labels = rng.integers(0, 20, (3, 64, 64)).astype(np.int32)
+    a = rng.normal(100, 10, (3, 64, 64)).astype(np.float32)
+    b = rng.normal(5, 2, (3, 64, 64)).astype(np.float32)
+    gs_n = jax.jit(jax.vmap(lambda l, x, y: grouped_sums(
+        l, [jnp.ones_like(x), x, y], 16, method="native")))(labels, a, b)
+    gs_s = jax.jit(jax.vmap(lambda l, x, y: grouped_sums(
+        l, [jnp.ones_like(x), x, y], 16, method="scatter")))(labels, a, b)
+    np.testing.assert_array_equal(np.asarray(gs_n), np.asarray(gs_s))
+    mm_n = jax.jit(jax.vmap(lambda l, x, y: grouped_minmax_multi(
+        l, [x, y], 16, method="native")))(labels, a, b)
+    mm_s = jax.jit(jax.vmap(lambda l, x, y: grouped_minmax_multi(
+        l, [x, y], 16, method="scatter")))(labels, a, b)
+    for got, want in zip(mm_n, mm_s):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -200,6 +200,17 @@ def _load_locked():
             _f, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, _f,
         ]
+        _i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.tm_site_channel_sums.restype = ctypes.c_int32
+        lib.tm_site_channel_sums.argtypes = [
+            _i32p, _f, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, _f,
+        ]
+        lib.tm_site_channel_minmax.restype = ctypes.c_int32
+        lib.tm_site_channel_minmax.argtypes = [
+            _i32p, _f, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, _f, _f,
+        ]
     except AttributeError:
         logger.info(
             "native library predates the site stats kernels; "
@@ -691,23 +702,50 @@ def callback_vmap_method() -> str:
     return "expand_dims" if len(jax.devices()) == 1 else "sequential"
 
 
+def align_batch(
+    args: "list[tuple]",
+) -> "tuple[tuple, list[np.ndarray]]":
+    """Flatten the shared vmap lead axes of callback operands to ONE
+    batch axis.  ``expand_dims`` inserts SIZE-1 lead dims for operands
+    that are constant across the vmapped axis (e.g. coordinate grids),
+    so per-operand lead sizes may be 1 — those broadcast to the true
+    batch size (vmap semantics: the constant operand is shared).
+    ``args`` is ``[(array, per_site_ndim), ...]``; returns the batched
+    operand's lead shape (for reshaping results) and the aligned
+    ``(n, *site_shape)`` arrays."""
+    flats = []
+    leads = []
+    for a, nd in args:
+        a = np.asarray(a)
+        lead = a.shape[: a.ndim - nd]
+        m = int(np.prod(lead, dtype=np.int64)) if lead else 1
+        flats.append(a.reshape((m,) + a.shape[a.ndim - nd:]))
+        leads.append(lead)
+    n = max(f.shape[0] for f in flats)
+    out_lead = next(
+        (l for l, f in zip(leads, flats) if f.shape[0] == n), ()
+    )
+    aligned = [
+        np.broadcast_to(f, (n,) + f.shape[1:])
+        if f.shape[0] == 1 and n > 1 else f
+        for f in flats
+    ]
+    return out_lead, aligned
+
+
 def batch_sites(*arg_ndims: int):
     """Wrap a per-site host function so a ``pure_callback`` can use it
     under BOTH vmap methods: with ``sequential`` it sees bare site
     shapes; with ``expand_dims`` (single-device fast path —
     :func:`callback_vmap_method`) every argument arrives with shared
-    leading vmap axes, which this wrapper flattens, loops over, and
+    leading vmap axes, which this wrapper flattens (via
+    :func:`align_batch` — size-1 leads broadcast), loops over, and
     stacks back — turning a whole site batch into ONE callback dispatch.
     ``arg_ndims[i]`` is argument ``i``'s trailing per-site rank."""
     def wrap(site_fn):
         def host(*args):
-            arrs = [np.asarray(a) for a in args]
-            lead = arrs[0].shape[: arrs[0].ndim - arg_ndims[0]]
-            n = int(np.prod(lead, dtype=np.int64)) if lead else 1
-            flat = [
-                a.reshape((n,) + a.shape[a.ndim - nd:])
-                for a, nd in zip(arrs, arg_ndims)
-            ]
+            lead, flat = align_batch(list(zip(args, arg_ndims)))
+            n = flat[0].shape[0]
             outs = [site_fn(*(f[i] for f in flat)) for i in range(n)]
             single = not isinstance(outs[0], tuple)
             if single:
@@ -738,6 +776,7 @@ def has_site_stats() -> bool:
         and hasattr(lib, "tm_site_stats")
         and hasattr(lib, "tm_hist_counts")
         and hasattr(lib, "tm_otsu_hist")
+        and hasattr(lib, "tm_site_channel_sums")
     )
 
 
@@ -799,6 +838,59 @@ def box_mean_host(img: np.ndarray, size: int) -> np.ndarray:
     if rc != 0:
         raise ValueError("tm_box_mean: invalid arguments")
     return out
+
+
+def site_channel_sums_host(
+    labels: np.ndarray, vals: np.ndarray, count: int
+) -> np.ndarray:
+    """Per-label sums of several pixel channels — ``labels`` is
+    ``(n, px)``, ``vals`` ``(n, C, px)``; returns ``(n, C, count)``
+    float32 for label ids 1..count.  Bit-identical to XLA-CPU's
+    ``segment_sum`` over the stacked channels (see
+    ``tm_site_channel_sums``)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_site_channel_sums"):
+        raise RuntimeError("native tm_site_channel_sums unavailable")
+    labels32 = np.ascontiguousarray(labels, np.int32)
+    vals32 = np.ascontiguousarray(vals, np.float32)
+    n, c, px = vals32.shape
+    out = np.empty((n, c, count + 1), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.tm_site_channel_sums(
+        labels32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals32.ctypes.data_as(fp), n, c, px, count,
+        out.ctypes.data_as(fp),
+    )
+    if rc != 0:
+        raise ValueError("tm_site_channel_sums: invalid arguments")
+    return np.ascontiguousarray(out[:, :, 1:])
+
+
+def site_channel_minmax_host(
+    labels: np.ndarray, vals: np.ndarray, count: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Per-label (min, max) of several pixel channels — same layout as
+    :func:`site_channel_sums_host`; absent labels keep (+inf, -inf)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_site_channel_minmax"):
+        raise RuntimeError("native tm_site_channel_minmax unavailable")
+    labels32 = np.ascontiguousarray(labels, np.int32)
+    vals32 = np.ascontiguousarray(vals, np.float32)
+    n, c, px = vals32.shape
+    mn = np.empty((n, c, count + 1), np.float32)
+    mx = np.empty((n, c, count + 1), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.tm_site_channel_minmax(
+        labels32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        vals32.ctypes.data_as(fp), n, c, px, count,
+        mn.ctypes.data_as(fp), mx.ctypes.data_as(fp),
+    )
+    if rc != 0:
+        raise ValueError("tm_site_channel_minmax: invalid arguments")
+    return (
+        np.ascontiguousarray(mn[:, :, 1:]),
+        np.ascontiguousarray(mx[:, :, 1:]),
+    )
 
 
 def otsu_hist_host(

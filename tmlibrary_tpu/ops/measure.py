@@ -63,7 +63,40 @@ def grouped_sums(
         [jnp.asarray(c, jnp.float32).reshape(-1) for c in channels], axis=-1
     )  # (P, S)
     if method == "auto":
+        # scatter stays the CPU auto choice: auto-routing this callback
+        # hung XLA-CPU's runtime inside morphology_features' program at
+        # batch 128 (np.asarray of the callback operand never returned;
+        # minimal reproductions with the same shapes pass, so the
+        # interaction is with the surrounding program, not the kernel).
+        # "native" remains an explicit opt-in — the kernel itself is
+        # bit-identical and parity-tested.
         method = "scatter" if jax.default_backend() == "cpu" else "matmul"
+    if method == "native":
+        # one fused C pass over the pixels for ALL channels
+        # (tm_site_channel_sums — bit-identical to the segment_sum
+        # below), batched like the other measurement callbacks
+        from tmlibrary_tpu import native
+
+        n_ch = stacked.shape[-1]
+        nd = flat.ndim  # 1 at trace time
+
+        def host(lab, v):
+            # align_batch: an operand constant across the vmapped axis
+            # arrives with a SIZE-1 lead dim under expand_dims
+            lead, (labf, vf) = native.align_batch([(lab, nd), (v, 2)])
+            out = native.site_channel_sums_host(
+                labf, vf.transpose(0, 2, 1), max_objects
+            )  # (n, C, K)
+            return out.transpose(0, 2, 1).reshape(
+                lead + (max_objects, n_ch)
+            )
+
+        return jax.pure_callback(
+            host,
+            jax.ShapeDtypeStruct((max_objects, n_ch), jnp.float32),
+            flat, stacked,
+            vmap_method=native.callback_vmap_method(),
+        )
     if method == "scatter":
         out = jax.ops.segment_sum(stacked, flat, num_segments=max_objects + 1)
         return out[1:]
@@ -161,6 +194,7 @@ def grouped_minmax(
     flat_l = labels.reshape(-1)
     flat_v = jnp.asarray(values, jnp.float32).reshape(-1)
     if method == "auto":
+        # see grouped_minmax_multi: native is explicit opt-in on CPU
         method = "scatter" if jax.default_backend() == "cpu" else "reduce"
     if method == "scatter":
         mn = jax.ops.segment_min(flat_v, flat_l, num_segments=max_objects + 1)
@@ -208,7 +242,40 @@ def grouped_minmax_multi(
         [jnp.asarray(v, jnp.float32).reshape(-1) for v in values], axis=-1
     )  # (P, K)
     if method == "auto":
+        # scatter stays the CPU auto choice here: routing this through
+        # the native callback alongside grouped_sums' callback in ONE
+        # jitted program hung XLA-CPU's runtime on mosaic-scale batches
+        # (the second callback never returned from materializing its
+        # operands); "native" remains an explicit opt-in until that
+        # interaction is understood
         method = "scatter" if jax.default_backend() == "cpu" else "reduce"
+    if method == "native":
+        # fused C pass (tm_site_channel_minmax), bit-identical to the
+        # segment scatters below
+        from tmlibrary_tpu import native
+
+        nd = flat_l.ndim  # 1 at trace time
+
+        def host(lab, v):
+            lead, (labf, vf) = native.align_batch([(lab, nd), (v, 2)])
+            mn, mx = native.site_channel_minmax_host(
+                labf, vf.transpose(0, 2, 1), max_objects
+            )  # (n, C, M) each
+            shape = lead + (max_objects, k)
+            return (
+                mn.transpose(0, 2, 1).reshape(shape),
+                mx.transpose(0, 2, 1).reshape(shape),
+            )
+
+        return jax.pure_callback(
+            host,
+            (
+                jax.ShapeDtypeStruct((max_objects, k), jnp.float32),
+                jax.ShapeDtypeStruct((max_objects, k), jnp.float32),
+            ),
+            flat_l, stacked,
+            vmap_method=native.callback_vmap_method(),
+        )
     if method == "scatter":
         mn = jax.ops.segment_min(stacked, flat_l, num_segments=max_objects + 1)
         mx = jax.ops.segment_max(stacked, flat_l, num_segments=max_objects + 1)
@@ -257,11 +324,10 @@ def _native_site_stats(
     def host(lab, im):
         from tmlibrary_tpu import native
 
-        lab = np.asarray(lab)
-        lead = lab.shape[: lab.ndim - nd]
-        n = int(np.prod(lead, dtype=np.int64)) if lead else 1
+        lead, (labf, imf) = native.align_batch([(lab, nd), (im, nd)])
+        n = labf.shape[0]
         outs = native.site_stats_host(
-            lab.reshape(n, -1), np.asarray(im).reshape(n, -1), k
+            labf.reshape(n, -1), imf.reshape(n, -1), k
         )
         return tuple(o.reshape(lead + (k,)) for o in outs)
 
