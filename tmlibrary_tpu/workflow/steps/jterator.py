@@ -306,7 +306,14 @@ class ImageAnalysisRunner(Step):
         return valid
 
     def _run_spatial(self, batch: dict) -> dict:
-        """Whole-mosaic segmentation of one well (``--layout spatial``).
+        return self._persist_spatial(batch, self._launch_spatial(batch))
+
+    def _launch_spatial(self, batch: dict) -> dict:
+        """Whole-mosaic segmentation of one well (``--layout spatial``) —
+        the LAUNCH half: host stitch + async device dispatch (primary
+        segmentation and, when configured, the chained secondary
+        watershed).  Returns a context of un-fetched device arrays for
+        :meth:`_persist_spatial`.
 
         Stitch the well's sites into one mosaic (illumination-corrected
         when corilla statistics exist — same op as the sites layout's
@@ -409,9 +416,6 @@ class ImageAnalysisRunner(Step):
                 jnp.asarray(mosaic), mesh, sigma=args["spatial_sigma"],
                 threshold=threshold,
             )
-        labels = np.asarray(labels)
-        count = int(count)
-
         # with a secondary channel every stitched mosaic is used at least
         # twice (watershed input + both families' intensity loops), so
         # memoize — accepting a peak of one mosaic per channel.  Without
@@ -429,30 +433,13 @@ class ImageAnalysisRunner(Step):
                 stitched[i] = m
             return m
 
-        shard = _well_shard(batch)
-
-        def emit_figure(fam_name, fam_mosaic, fam_labels):
-            if not args.get("figures"):
-                return
-            from tmlibrary_tpu.jterator.figures import write_mosaic_figure
-
-            write_mosaic_figure(
-                self.store.root / "figures", fam_name, fam_mosaic,
-                fam_labels, shard,
-            )
-
-        name = args["spatial_objects"]
-        self._persist_mosaic_objects(
-            name, labels, count, batch, args, sites, srefs, get_channel,
-            tpoint, zplane, shard,
-        )
-        objects = {name: count}
-        emit_figure(name, mosaic, labels)
-
         # secondary objects over the whole mosaic: primary labels seed a
         # distributed watershed through a second channel (the sites
         # layout's segment_secondary chain — otsu mask, level flooding,
-        # seed ids preserved), so cells keep their nucleus' GLOBAL id
+        # seed ids preserved), so cells keep their nucleus' GLOBAL id.
+        # Chained DEVICE-side on the un-fetched primary labels, so the
+        # whole well is one async dispatch chain.
+        sec = None
         if sec_ch:
             from tmlibrary_tpu.ops import threshold as threshold_ops
             from tmlibrary_tpu.parallel.label import (
@@ -478,11 +465,54 @@ class ImageAnalysisRunner(Step):
                 distributed_watershed_from_seeds_2d if use_grid
                 else distributed_watershed_from_seeds
             )
-            sec_labels = np.asarray(flood(
-                img, jnp.asarray(labels), mask, mesh,
+            sec = (args["spatial_secondary_objects"], sec_np, flood(
+                img, labels, mask, mesh,
                 n_levels=args["spatial_secondary_levels"],
             ))
-            sec_name = args["spatial_secondary_objects"]
+
+        return {
+            "batch": batch, "labels_dev": labels, "count_dev": count,
+            "sec": sec, "mosaic": mosaic, "get_channel": get_channel,
+            "sites": sites, "srefs": srefs, "mesh_shape": mesh_shape,
+            "tpoint": tpoint, "zplane": zplane,
+        }
+
+    def _persist_spatial(self, batch: dict, ctx: dict) -> dict:
+        """Fetch one launched well's device results and write them out —
+        the host half of the stitch → device → write overlap
+        (``run_batches_pipelined`` launches well N+1's stitch while this
+        blocks on well N's arrays).  Peak memory holds two wells'
+        mosaics while the pipeline is full."""
+        args = batch["args"]
+        sites = ctx["sites"]
+        srefs = ctx["srefs"]
+        tpoint, zplane = ctx["tpoint"], ctx["zplane"]
+        get_channel = ctx["get_channel"]
+        labels = np.asarray(ctx["labels_dev"])
+        count = int(ctx["count_dev"])
+        shard = _well_shard(batch)
+
+        def emit_figure(fam_name, fam_mosaic, fam_labels):
+            if not args.get("figures"):
+                return
+            from tmlibrary_tpu.jterator.figures import write_mosaic_figure
+
+            write_mosaic_figure(
+                self.store.root / "figures", fam_name, fam_mosaic,
+                fam_labels, shard,
+            )
+
+        name = args["spatial_objects"]
+        self._persist_mosaic_objects(
+            name, labels, count, batch, args, sites, srefs, get_channel,
+            tpoint, zplane, shard,
+        )
+        objects = {name: count}
+        emit_figure(name, ctx["mosaic"], labels)
+
+        if ctx["sec"] is not None:
+            sec_name, sec_np, sec_labels_dev = ctx["sec"]
+            sec_labels = np.asarray(sec_labels_dev)
             # watershed preserves seed ids: the id space (and count) is
             # the primary's, so features join across the two families
             self._persist_mosaic_objects(
@@ -497,7 +527,7 @@ class ImageAnalysisRunner(Step):
             "objects": objects,
             "mosaic_shape": [int(labels.shape[0]), int(labels.shape[1])],
             "layout": "spatial",
-            "mesh_shape": mesh_shape,
+            "mesh_shape": ctx["mesh_shape"],
         }
 
     def _persist_mosaic_objects(
@@ -636,29 +666,38 @@ class ImageAnalysisRunner(Step):
         crossing points) without threads or process fan-out.
         """
         batches = list(batches)
-        if batches and batches[0]["args"].get("layout", "sites") == "spatial":
-            # the spatial path is one fused sharded program per well with
-            # host stitching on both ends — nothing to overlap
-            for b in batches:
-                yield b, self.run_batch(b)
-            return
-        prev: tuple[dict, object] | None = None
+
+        def _launch_one(b):
+            override = self._cap_overrides().get(str(b["index"]))
+            if override and override > b["args"].get("max_objects", 0):
+                b = {**b, "args": {**b["args"],
+                                   "max_objects": int(override)}}
+            if b["args"].get("layout", "sites") == "spatial":
+                return b, "spatial", self._launch_spatial(b)
+            return b, "sites", self._launch(b)
+
+        def _persist_one(b, kind, ctx):
+            if kind == "spatial":
+                return self._persist_spatial(b, ctx)
+            return self._persist(b, ctx)
+
+        prev: tuple | None = None
         for batch in batches:
             try:
-                launched = self._launch(batch)  # async dispatch
+                eff, kind, launched = _launch_one(batch)  # async dispatch
             except Exception:
                 # don't lose the already-computed previous batch: persist
                 # (and let the caller ledger) it before propagating, so
                 # resume granularity matches the sequential path
                 if prev is not None:
-                    yield prev[0], self._persist(*prev)
+                    yield prev[0], _persist_one(prev[1], prev[2], prev[3])
                     prev = None
                 raise
             if prev is not None:
-                yield prev[0], self._persist(*prev)
-            prev = (batch, launched)
+                yield prev[0], _persist_one(prev[1], prev[2], prev[3])
+            prev = (batch, eff, kind, launched)
         if prev is not None:
-            yield prev[0], self._persist(*prev)
+            yield prev[0], _persist_one(prev[1], prev[2], prev[3])
 
     def _launch(self, batch: dict):
         """Load inputs (host IO) and dispatch the device computation;
