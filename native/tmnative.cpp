@@ -15,6 +15,7 @@
 // image). C ABI only.
 
 #include <algorithm>
+#include <cmath>
 #include <cstdint>
 #include <array>
 #include <cstring>
@@ -1467,6 +1468,109 @@ int32_t tm_site_channel_minmax(const int32_t* labels, const float* vals,
         const float x = v[i];
         if (x < mn[l]) mn[l] = x;
         if (x > mx[l]) mx[l] = x;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+// Per-object quantization + 4-direction GLCM accumulation in one native
+// pass over a site batch.  Quantization replicates
+// ops/measure.py quantize_per_object exactly: per-object min/max (pass
+// 1), then floor(((v - lo) * (levels-1)) / max(span, 1e-6)) with each
+// f32 step rounded separately (-ffp-contract=off) and clamped to
+// [0, levels-1]; objects with no pixels never contribute.  GLCM counts
+// are EXACT integers (f32 +1.0 adds, order-independent), accumulated
+// for pixel pairs ((y, x), (y - dy, x - dx)) with equal nonzero labels
+// — the same pairs ops/measure.py _glcm_scatter counts — and
+// symmetrized (g + g^T).  Output layout:
+// (n_sites, 4, count, levels, levels) float32, direction order
+// (0,d), (d,0), (d,d), (d,-d).  Returns 0 / -1 on bad args.
+int32_t tm_site_glcm(const int32_t* labels, const float* img,
+                     int64_t n_sites, int32_t h, int32_t w, int32_t count,
+                     int32_t levels, int32_t distance, float* glcm_out) {
+  if (!labels || !img || !glcm_out || n_sites < 0 || h <= 0 || w <= 0 ||
+      count < 0 || levels <= 1 || distance <= 0)
+    return -1;
+  const int64_t px = static_cast<int64_t>(h) * w;
+  const int64_t ll = static_cast<int64_t>(levels) * levels;
+  const int64_t per_site = 4 * static_cast<int64_t>(count) * ll;
+  const float inf = std::numeric_limits<float>::infinity();
+  std::vector<float> lo(count + 1), hi(count + 1);
+  std::vector<uint8_t> q(px);
+  const int32_t d = distance;
+  const int32_t dys[4] = {0, d, d, d};
+  const int32_t dxs[4] = {d, 0, d, -d};
+  for (int64_t s = 0; s < n_sites; ++s) {
+    const int32_t* lab = labels + s * px;
+    const float* v = img + s * px;
+    for (int32_t k = 0; k <= count; ++k) {
+      lo[k] = inf;
+      hi[k] = -inf;
+    }
+    for (int64_t i = 0; i < px; ++i) {
+      const int32_t l = lab[i];
+      if (l < 1 || l > count) continue;
+      const float x = v[i];
+      if (x < lo[l]) lo[l] = x;
+      if (x > hi[l]) hi[l] = x;
+    }
+    // per-object stretch (quantize_per_object: lo=0/span=1 for absent,
+    // span floor 1e-6; each op rounded f32)
+    for (int64_t i = 0; i < px; ++i) {
+      const int32_t l = lab[i];
+      if (l < 1 || l > count) {
+        q[i] = 0;  // background quantization is never read (pairs
+                   // require equal labels > 0)
+        continue;
+      }
+      const float present = hi[l] >= lo[l] ? 1.0f : 0.0f;
+      const float lov = present ? lo[l] : 0.0f;
+      const float span_raw = present ? (hi[l] - lov) : 1.0f;
+      const float span = span_raw > 1e-6f ? span_raw : 1e-6f;
+      const float a = v[i] - lov;
+      const float b = a * static_cast<float>(levels - 1);
+      const float c = b / span;
+      float f = std::floor(c);
+      if (f < 0.0f) f = 0.0f;
+      if (f > static_cast<float>(levels - 1))
+        f = static_cast<float>(levels - 1);
+      q[i] = static_cast<uint8_t>(f);
+    }
+    float* gsite = glcm_out + s * per_site;
+    for (int64_t i = 0; i < per_site; ++i) gsite[i] = 0.0f;
+    for (int32_t dir = 0; dir < 4; ++dir) {
+      const int32_t dy = dys[dir], dx = dxs[dir];
+      float* g = gsite + static_cast<int64_t>(dir) * count * ll;
+      for (int32_t y = 0; y < h; ++y) {
+        const int32_t y2 = y - dy;
+        if (y2 < 0 || y2 >= h) continue;
+        const int32_t x_begin = dx > 0 ? dx : 0;
+        const int32_t x_end = dx < 0 ? w + dx : w;
+        const int32_t* lrow = lab + static_cast<int64_t>(y) * w;
+        const int32_t* lrow2 = lab + static_cast<int64_t>(y2) * w;
+        const uint8_t* qrow = q.data() + static_cast<int64_t>(y) * w;
+        const uint8_t* qrow2 = q.data() + static_cast<int64_t>(y2) * w;
+        for (int32_t x = x_begin; x < x_end; ++x) {
+          const int32_t l = lrow[x];
+          if (l < 1 || l > count || lrow2[x - dx] != l) continue;
+          g[(static_cast<int64_t>(l) - 1) * ll + qrow[x] * levels +
+            qrow2[x - dx]] += 1.0f;
+        }
+      }
+      // symmetrize in place: g = g + g^T per object
+      for (int32_t k = 0; k < count; ++k) {
+        float* gm = g + static_cast<int64_t>(k) * ll;
+        for (int32_t i = 0; i < levels; ++i)
+          for (int32_t j = i; j < levels; ++j) {
+            const float sum = gm[i * levels + j] + gm[j * levels + i];
+            gm[i * levels + j] = sum;
+            gm[j * levels + i] = sum;
+          }
       }
     }
   }

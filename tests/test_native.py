@@ -599,3 +599,26 @@ def test_channel_sums_minmax_bit_identical_to_scatter(rng):
         l, [x, y], 16, method="scatter")))(labels, a, b)
     for got, want in zip(mm_n, mm_s):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_site_glcm_bit_identical_to_scatter(rng):
+    """tm_site_glcm (fused per-object quantization + 4-direction GLCMs)
+    is bit-identical to the scatter path — GLCM counts are exact
+    integers and the stretch replicates quantize_per_object's f32
+    expression tree.  Explicit opt-in (see _resolve_glcm_method)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu import native as nat
+    from tmlibrary_tpu.ops.measure import haralick_features
+
+    if not nat.has_site_glcm():
+        pytest.skip("native GLCM kernel unavailable")
+    labels = rng.integers(0, 70, (4, 96, 96)).astype(np.int32)  # ids > 48
+    img = rng.normal(500, 100, (4, 96, 96)).astype(np.float32)
+    f_nat = jax.jit(jax.vmap(lambda l, i: haralick_features(
+        l, i, 48, levels=16, glcm_method="native")))(labels, img)
+    f_sca = jax.jit(jax.vmap(lambda l, i: haralick_features(
+        l, i, 48, levels=16, glcm_method="scatter")))(labels, img)
+    for k in f_nat:
+        np.testing.assert_array_equal(np.asarray(f_nat[k]), np.asarray(f_sca[k]))

@@ -211,6 +211,11 @@ def _load_locked():
             _i32p, _f, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int32, _f, _f,
         ]
+        lib.tm_site_glcm.restype = ctypes.c_int32
+        lib.tm_site_glcm.argtypes = [
+            _i32p, _f, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, _f,
+        ]
     except AttributeError:
         logger.info(
             "native library predates the site stats kernels; "
@@ -891,6 +896,44 @@ def site_channel_minmax_host(
         np.ascontiguousarray(mn[:, :, 1:]),
         np.ascontiguousarray(mx[:, :, 1:]),
     )
+
+
+def has_site_glcm() -> bool:
+    """Whether the loaded library carries ``tm_site_glcm`` (honors the
+    ``TMX_SITE_STATS=0`` kill switch)."""
+    import os
+
+    if os.environ.get("TMX_SITE_STATS") == "0":
+        return False
+    lib = _load()
+    return lib is not None and hasattr(lib, "tm_site_glcm")
+
+
+def site_glcm_host(
+    labels: np.ndarray, img: np.ndarray, count: int, levels: int,
+    distance: int,
+) -> np.ndarray:
+    """Per-object quantization + 4-direction symmetrized GLCMs for a
+    site batch — ``labels``/``img`` are ``(n, h, w)``; returns
+    ``(n, 4, count, levels, levels)`` float32 counts, bit-identical to
+    the scatter path (integer counts; quantization replicated —
+    see ``tm_site_glcm``)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "tm_site_glcm"):
+        raise RuntimeError("native tm_site_glcm unavailable")
+    labels32 = np.ascontiguousarray(labels, np.int32)
+    img32 = np.ascontiguousarray(img, np.float32)
+    n, h, w = labels32.shape
+    out = np.empty((n, 4, count, levels, levels), np.float32)
+    fp = ctypes.POINTER(ctypes.c_float)
+    rc = lib.tm_site_glcm(
+        labels32.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        img32.ctypes.data_as(fp), n, h, w, count, levels, distance,
+        out.ctypes.data_as(fp),
+    )
+    if rc != 0:
+        raise ValueError("tm_site_glcm: invalid arguments")
+    return out
 
 
 def otsu_hist_host(

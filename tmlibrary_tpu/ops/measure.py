@@ -673,6 +673,14 @@ def _resolve_glcm_method(method: str) -> str:
         return method
     backend = jax.default_backend()
     if backend == "cpu":
+        # "native" (tm_site_glcm: quantization + all 4 GLCMs in one C
+        # pass, bit-identical — counts are exact integers) stays an
+        # EXPLICIT opt-in like the channel-sum kernels: auto-routing it
+        # stalled XLA-CPU's runtime from batch 16 up regardless of vmap
+        # method (batch 8 and the whole existing callback family run
+        # fine; the direct C call does the full batch-128 workload in
+        # 0.12 s), so the stall is a runtime interaction this release
+        # does not ship on by default.
         return "scatter"
     if backend == "tpu":
         # the committed tuning verdict was measured on a TPU — scope it
@@ -740,30 +748,67 @@ def haralick_features(
     """
     labels = jnp.asarray(labels, jnp.int32)
     img = jnp.asarray(intensity, jnp.float32)
-    if quantization == "object":
-        q = quantize_per_object(labels, img, max_objects, levels)
-    elif quantization == "global":
-        lo = jnp.min(img)
-        hi = jnp.max(img)
-        span = jnp.maximum(hi - lo, 1e-6)
-        q = jnp.clip(((img - lo) / span * levels).astype(jnp.int32), 0, levels - 1)
-    else:
-        raise ValueError(f"unknown quantization '{quantization}'")
-
+    method = _resolve_glcm_method(glcm_method)
     offsets = [(0, distance), (distance, 0), (distance, distance), (distance, -distance)]
     i_idx = jnp.arange(levels, dtype=jnp.float32)[None, :, None]
     j_idx = jnp.arange(levels, dtype=jnp.float32)[None, None, :]
     eps = 1e-10
 
-    method = _resolve_glcm_method(glcm_method)
-    if method == "matmul":
-        # all 4 directions share each chunk's row one-hot in one pass
-        glcms = _glcm_matmul_all(labels, q, max_objects, levels, offsets)
+    if method == "native" and quantization == "object":
+        # quantization + all 4 directions in one C pass (bit-identical:
+        # GLCM counts are exact integers, the per-object stretch is the
+        # same f32 expression tree) — labels + image are the only
+        # operands, both batched under the site vmap
+        from tmlibrary_tpu import native
+
+        nd = labels.ndim  # 2 at trace time
+
+        def host(lab, im):
+            lead, (labf, imf) = native.align_batch([(lab, nd), (im, nd)])
+            out = native.site_glcm_host(
+                labf, imf, max_objects, levels, distance
+            )
+            return out.reshape(lead + out.shape[1:])
+
+        # vmap_method pinned to the SPMD-safe sequential form: the
+        # batched expand_dims variant of THIS callback (like
+        # morphology's) stalls XLA-CPU's runtime at batch 128 — the
+        # callback never returns from materializing its operands, while
+        # minimal reproductions with identical shapes/results pass.
+        # Sequential still collapses the whole quantize+GLCM chain into
+        # one C call per site (~10x the scatter stage).
+        packed = jax.pure_callback(
+            host,
+            jax.ShapeDtypeStruct(
+                (4, max_objects, levels, levels), jnp.float32
+            ),
+            labels, img,
+            vmap_method="sequential",
+        )
+        glcms = [packed[d] for d in range(4)]
     else:
-        glcms = [
-            _glcm_scatter(labels, q, max_objects, levels, off)
-            for off in offsets
-        ]
+        if method == "native":
+            method = "scatter"  # global quantization: no native path
+        if quantization == "object":
+            q = quantize_per_object(labels, img, max_objects, levels)
+        elif quantization == "global":
+            lo = jnp.min(img)
+            hi = jnp.max(img)
+            span = jnp.maximum(hi - lo, 1e-6)
+            q = jnp.clip(
+                ((img - lo) / span * levels).astype(jnp.int32), 0, levels - 1
+            )
+        else:
+            raise ValueError(f"unknown quantization '{quantization}'")
+
+        if method == "matmul":
+            # all 4 directions share each chunk's row one-hot in one pass
+            glcms = _glcm_matmul_all(labels, q, max_objects, levels, offsets)
+        else:
+            glcms = [
+                _glcm_scatter(labels, q, max_objects, levels, off)
+                for off in offsets
+            ]
 
     acc: dict[str, jax.Array] = {}
     for glcm in glcms:
