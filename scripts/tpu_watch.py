@@ -72,6 +72,10 @@ BENCH_ITEMS = [
     ("2", {"BENCH_CONFIG": "2"}),
     ("pyramid", {"BENCH_CONFIG": "pyramid"}),
     ("spatial", {"BENCH_CONFIG": "spatial"}),
+    # the framework-composition number: the whole canonical workflow
+    # (metaconfig -> imextract -> corilla -> illuminati -> jterator)
+    # end-to-end with persistence inside the clock
+    ("workflow", {"BENCH_CONFIG": "workflow"}),
     # proves the shard_map production multi-chip path on the real chip
     # (n=1: scaling efficiency is trivially ~1, but the compiled program
     # and its throughput under shard_map are hardware evidence)

@@ -31,6 +31,59 @@ from tmlibrary_tpu.jterator.description import PipelineDescription
 from tmlibrary_tpu.ops import image_ops
 
 
+#: process-level compiled-program cache for the sites-layout batch fn
+#: (DESIGN round-5 discipline: compiled-program caching — the spatial
+#: layout's sharded programs already cache this way).  A fresh
+#: Workflow/Step instance re-running the same pipeline (engine re-runs,
+#: bench reps, tool requests, auto-resegmentation retries) would
+#: otherwise pay a full re-trace + XLA load per instance, which at
+#: plate-batch granularity is pure overhead (~1 s/run measured on the
+#: CPU backend).  Keyed by the description's full content, the object
+#: cap, the crop window, the backend, and every env knob that changes
+#: what the trace emits (TMX_PALLAS kernel override, TMX_NATIVE CPU
+#: kill switch, TMX_SITE_STATS measure-kernel gate).  Bounded FIFO: a
+#: long-lived service crossing many experiments (each align crop window
+#: is a distinct key) must not retain every compiled program forever.
+_BATCH_FN_CACHE: dict[tuple, Callable] = {}
+_BATCH_FN_CACHE_MAX = 16
+
+
+def _description_cache_key(description: PipelineDescription) -> str:
+    import json
+
+    return json.dumps(
+        dataclasses.asdict(description), sort_keys=True, default=repr
+    )
+
+
+def cached_batch_fn(
+    description: PipelineDescription,
+    max_objects: int,
+    window: "tuple[int, int, int, int] | None" = None,
+) -> Callable:
+    """Memoized :meth:`ImageAnalysisPipeline.build_batch_fn` — same
+    compiled program for the same (description, cap, window, backend)."""
+    import os
+
+    key = (
+        _description_cache_key(description),
+        max_objects,
+        window,
+        jax.default_backend(),
+        os.environ.get("TMX_PALLAS"),
+        os.environ.get("TMX_NATIVE"),
+        os.environ.get("TMX_SITE_STATS"),
+    )
+    fn = _BATCH_FN_CACHE.get(key)
+    if fn is None:
+        pipe = ImageAnalysisPipeline(description, max_objects=max_objects)
+        fn = pipe.build_batch_fn(window=window)
+        while len(_BATCH_FN_CACHE) >= _BATCH_FN_CACHE_MAX:
+            _BATCH_FN_CACHE.pop(next(iter(_BATCH_FN_CACHE)))
+        _BATCH_FN_CACHE[key] = fn
+    return fn
+
+
 @dataclasses.dataclass
 class SiteResult:
     """Pytree of one site's (or one batch's, when vmapped) pipeline output."""

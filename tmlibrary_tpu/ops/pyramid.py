@@ -55,15 +55,22 @@ def downsample_2x(img: jax.Array) -> jax.Array:
     return summed / 4.0
 
 
+#: module-level jit (public: parallel/halo.py shares it): a per-call
+#: ``jax.jit(downsample_2x)`` would create a fresh wrapper with an empty
+#: cache and re-trace every level shape on every illuminati batch
+#: (measured as re-run overhead in the workflow bench); one shared
+#: wrapper re-traces each level shape once per process
+downsample_2x_jit = jax.jit(downsample_2x)
+
+
 def pyramid_levels(mosaic: jax.Array, n_levels: int | None = None) -> list[jax.Array]:
     """Full level chain, level 0 (native) first.  ``n_levels=None`` builds
     until the image fits in a single tile."""
     levels = [jnp.asarray(mosaic, _display_dtype())]
     if n_levels is None:
         n_levels = n_pyramid_levels(*mosaic.shape)
-    fn = jax.jit(downsample_2x)
     for _ in range(n_levels - 1):
-        levels.append(fn(levels[-1]))
+        levels.append(downsample_2x_jit(levels[-1]))
     return levels
 
 

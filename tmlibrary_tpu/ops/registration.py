@@ -12,6 +12,8 @@ integer-shift semantics.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -114,28 +116,38 @@ def phase_correlation_subpixel(
     return dy, dx
 
 
-def batch_phase_correlation(
-    reference_stack: jax.Array, target_stack: jax.Array
-) -> jax.Array:
-    """vmap over the site axis → (B, 2) int32 shifts."""
-
+@functools.lru_cache(maxsize=1)
+def _batch_pc_jit():
+    # shared jit wrappers: per-call ``jax.jit(vmap(...))`` creates a fresh
+    # cache and re-traces every batch shape on every align run
     def one(a, b):
         dy, dx = phase_correlation(a, b)
         return jnp.stack([dy, dx])
 
-    return jax.jit(jax.vmap(one))(reference_stack, target_stack)
+    return jax.jit(jax.vmap(one))
+
+
+@functools.lru_cache(maxsize=1)
+def _batch_pcq_jit():
+    def one(a, b):
+        dy, dx, q = phase_correlation_quality(a, b)
+        return jnp.stack([dy, dx]), q
+
+    return jax.jit(jax.vmap(one))
+
+
+def batch_phase_correlation(
+    reference_stack: jax.Array, target_stack: jax.Array
+) -> jax.Array:
+    """vmap over the site axis → (B, 2) int32 shifts."""
+    return _batch_pc_jit()(reference_stack, target_stack)
 
 
 def batch_phase_correlation_quality(
     reference_stack: jax.Array, target_stack: jax.Array
 ) -> tuple[jax.Array, jax.Array]:
     """vmap over the site axis → ((B, 2) int32 shifts, (B,) quality)."""
-
-    def one(a, b):
-        dy, dx, q = phase_correlation_quality(a, b)
-        return jnp.stack([dy, dx]), q
-
-    return jax.jit(jax.vmap(one))(reference_stack, target_stack)
+    return _batch_pcq_jit()(reference_stack, target_stack)
 
 
 def intersection_window(all_shifts: jax.Array) -> dict[str, int]:

@@ -272,7 +272,7 @@ def sharded_pyramid_levels(
     if h % n == 0:
         mosaic = jax.device_put(mosaic, NamedSharding(mesh, PartitionSpec(axis)))
     levels = [mosaic]
-    plain = jax.jit(downsample_2x)
+    from tmlibrary_tpu.ops.pyramid import downsample_2x_jit as plain
     for _ in range(n_levels - 1):
         cur = levels[-1]
         h = cur.shape[0]

@@ -31,6 +31,21 @@ from tmlibrary_tpu.workflow.api import Step
 from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
 from tmlibrary_tpu.workflow.registry import register_step
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _welford_scan_jit():
+    """Shared jit wrapper: a per-run ``jax.jit(welford_scan)`` would
+    re-trace every chunk shape on every step instance (re-run overhead
+    measured by the workflow bench)."""
+    return jax.jit(welford_scan)
+
+
+@functools.lru_cache(maxsize=1)
+def _welford_merge_jit():
+    return jax.jit(welford_merge)
+
 
 @register_step("corilla")
 class IlluminationStatisticsCalculator(Step):
@@ -77,8 +92,8 @@ class IlluminationStatisticsCalculator(Step):
                 )
                 site_indices = site_indices[even:]
 
-        scan_jit = jax.jit(welford_scan)
-        merge_jit = jax.jit(welford_merge)
+        scan_jit = _welford_scan_jit()
+        merge_jit = _welford_merge_jit()
         dev_state = None
         for part in create_partitions(site_indices, chunk):
             stack = self.store.read_sites(part, cycle=cycle, channel=channel)

@@ -199,7 +199,6 @@ class ImageAnalysisRunner(Step):
         from pathlib import Path
 
         from tmlibrary_tpu.jterator.description import PipelineDescription
-        from tmlibrary_tpu.jterator.pipeline import ImageAnalysisPipeline
 
         if self._desc is None:
             pipe_path = Path(args["pipe"])
@@ -222,8 +221,14 @@ class ImageAnalysisRunner(Step):
                     self._window = None  # align step didn't run: no crop
                 if self._window == (0, 0, 0, 0):
                     self._window = None
-            pipe = ImageAnalysisPipeline(self._desc, max_objects=args["max_objects"])
-            self._compiled = pipe.build_batch_fn(window=self._window)
+            # process-level cache: a re-built Step (fresh Workflow, engine
+            # re-run, tool request) running the same description reuses
+            # the traced+compiled program instead of re-paying trace+load
+            from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
+
+            self._compiled = cached_batch_fn(
+                self._desc, args["max_objects"], self._window
+            )
             self._compiled_cap = args["max_objects"]
         return self._desc, self._compiled
 
