@@ -85,6 +85,8 @@ def _load_tuning() -> "dict | None":
             tuning = json.load(f)
     except (OSError, ValueError):
         return None
+    if "SMOKE(" in str(tuning.get("timing_methodology", "")):
+        return None  # dry-run sweep artifacts never set production defaults
     return tuning if "written_by" in tuning else None
 
 

@@ -38,8 +38,16 @@ RESULTS: dict = {}
 # methodology are re-measured by scripts/tpu_watch.py.
 PIPELINE = max(1, int(os.environ.get("TUNE_PIPELINE", "8")))
 # derived from PIPELINE so a TUNE_PIPELINE override can never stamp its
-# (incomparable) numbers with the default methodology marker
+# (incomparable) numbers with the default methodology marker; same rule
+# for the dry-run workload shrinkers — smoke-scale numbers must never
+# be mistaken for (or merged into) full-workload hardware results
 METHODOLOGY = f"pipelined-depth{PIPELINE}"
+_SMOKE = [
+    f"{k}={os.environ[k]}" for k in ("TUNE_BATCH", "TUNE_SITE_SIZE")
+    if os.environ.get(k)
+]
+if _SMOKE:
+    METHODOLOGY += " SMOKE(" + ",".join(_SMOKE) + ")"
 
 
 def run_bench(env_overrides):
@@ -114,18 +122,50 @@ def kernel_shootout():
     from tmlibrary_tpu.ops.segment_secondary import watershed_from_seeds
     from tmlibrary_tpu.ops.smooth import gaussian_smooth
 
-    B = 64
-    data = synthetic_cell_painting_batch(B, size=256)
+    # TUNE_BATCH/TUNE_SITE_SIZE shrink the workload so the stage's
+    # plumbing can be dry-run off-hardware (interpret-mode pallas) —
+    # a stage bug must surface in a test, not burn a relay window
+    B = int(os.environ.get("TUNE_BATCH", "64"))
+    size = int(os.environ.get("TUNE_SITE_SIZE", "256"))
+    data = synthetic_cell_painting_batch(B, size=size)
     dapi = jnp.asarray(data["DAPI"])
     actin = jnp.asarray(data["Actin"])
     v = jax.vmap
+    interp = jax.default_backend() == "cpu"
 
     sm = jax.jit(v(lambda im: gaussian_smooth(im, 1.5)))(dapi)
     masks = jax.jit(v(thr.threshold_otsu))(sm)
 
+    # convergence-check interval sweep (kernel-level, CC is the dominant
+    # VMEM kernel): chunk is output-invariant — the fixpoint is
+    # idempotent — so this is purely a trip-count/check-cost trade the
+    # hardware must pick.  The winner is committed as ``pallas_chunk``
+    # and both VMEM kernels read it at dispatch time.
+    from tmlibrary_tpu.ops.pallas_kernels import cc_min_propagate
+
+    bool_masks = masks != 0
+    best_chunk, best_ct = None, float("inf")
+    chunk_ms = {}
+    for c in (4, 8, 16, 32):
+        t_c = _bench_fn(
+            f"cc_chunk{c}",
+            v(lambda m, _c=c: cc_min_propagate(
+                m, 8, interpret=interp, chunk=_c)),
+            bool_masks, batch=B,
+        )
+        chunk_ms[str(c)] = t_c * 1e3
+        if t_c < best_ct:
+            best_chunk, best_ct = c, t_c
+    RESULTS["pallas_chunk"] = best_chunk
+    RESULTS["pallas_chunk_ms"] = chunk_ms
+    print(f"best pallas chunk: {best_chunk}")
+
     print("CC labeling:")
     t_x = _bench_fn("cc_xla", v(lambda m: connected_components(m, method='xla')[0]), masks, batch=B)
-    t_p = _bench_fn("cc_pallas", v(lambda m: connected_components(m, method='pallas')[0]), masks, batch=B)
+    t_p = _bench_fn(
+        "cc_pallas",
+        v(lambda m: connected_components(m, method='pallas', chunk=best_chunk)[0]),
+        masks, batch=B)
     nuclei = jax.jit(v(lambda m: connected_components(m, method='xla')[0]))(masks)
     print("watershed (16 levels):")
     w_x = _bench_fn(
@@ -139,7 +179,7 @@ def kernel_shootout():
         "ws_pallas",
         v(lambda l, im: watershed_from_seeds(
             im, l, thr.threshold_otsu(im, correction_factor=0.8),
-            n_levels=16, method='pallas')),
+            n_levels=16, method='pallas', chunk=best_chunk)),
         nuclei, actin, batch=B,
     )
     print("distance transform:")
@@ -358,10 +398,20 @@ def write_results():
 
     RESULTS["written_by"] = "scripts/tune_tpu.py write_results"
     RESULTS["written_at"] = time.strftime("%Y-%m-%dT%H:%M:%S+00:00", time.gmtime())
-    os.makedirs(os.path.dirname(TUNING_PATH), exist_ok=True)
-    with open(TUNING_PATH, "w") as f:
+    path = TUNING_PATH
+    if _SMOKE and not os.environ.get("TMX_TUNING_JSON"):
+        # dry-run artifacts must not shadow the production defaults file
+        # (the watcher's stage-done checks and every tuned-default loader
+        # read TUNING_PATH; loaders also reject SMOKE methodology as a
+        # second line of defense)
+        path = TUNING_PATH + ".smoke"
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
         json.dump(clean(RESULTS), f, indent=2, sort_keys=True, allow_nan=False)
-    print(f"wrote {TUNING_PATH} — commit it to make these the defaults")
+    if path == TUNING_PATH:
+        print(f"wrote {path} — commit it to make these the defaults")
+    else:
+        print(f"wrote {path} (SMOKE dry run — never production defaults)")
 
 
 if __name__ == "__main__":

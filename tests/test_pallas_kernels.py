@@ -47,6 +47,41 @@ def test_cc_min_propagate_matches_xla(rng, connectivity):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("chunk", [1, 4, 16, 32])
+def test_chunk_is_output_invariant(rng, chunk):
+    """The convergence-check interval (the tune_tpu ``pallas_chunk``
+    sweep dimension) is purely a performance knob: the propagation
+    fixpoint is idempotent, so every chunk value must produce
+    BIT-identical labels — CC and watershed both."""
+    img = blobs(rng, n=8)
+    mask = img > 0.3
+
+    base = np.asarray(cc_min_propagate(mask, 8, interpret=True))
+    got = np.asarray(cc_min_propagate(mask, 8, interpret=True, chunk=chunk))
+    np.testing.assert_array_equal(got, base)
+
+    seeds_src = connected_components(img > 0.6, 8, method="xla")[0]
+    ws_base = np.asarray(watershed_flood(
+        img, seeds_src, mask, n_levels=8, interpret=True))
+    ws_got = np.asarray(watershed_flood(
+        img, seeds_src, mask, n_levels=8, interpret=True, chunk=chunk))
+    np.testing.assert_array_equal(ws_got, ws_base)
+
+
+def test_tuned_chunk_resolution(monkeypatch):
+    """Env override beats the committed sweep beats the default."""
+    from tmlibrary_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {"pallas_chunk": 16})
+    monkeypatch.delenv("TMX_PALLAS_CHUNK", raising=False)
+    assert pk._tuned_chunk() == 16
+    monkeypatch.setenv("TMX_PALLAS_CHUNK", "4")
+    assert pk._tuned_chunk() == 4
+    monkeypatch.setattr(pk, "_tuning_results", lambda: {})
+    monkeypatch.delenv("TMX_PALLAS_CHUNK", raising=False)
+    assert pk._tuned_chunk() == pk.CHUNK
+
+
 def test_cc_pallas_through_dispatch(rng):
     """connected_components(method='pallas') — the real dispatch branch,
     kernel via interpret mode on CPU — compacts to scipy order."""

@@ -91,7 +91,8 @@ def _run_min_scan(labels: jax.Array, mask: jax.Array, axis: int) -> jax.Array:
 
 
 def connected_components(
-    mask: jax.Array, connectivity: int = 8, method: str = "auto"
+    mask: jax.Array, connectivity: int = 8, method: str = "auto",
+    chunk: "int | None" = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Label connected foreground components.
 
@@ -151,9 +152,12 @@ def connected_components(
     if method == "pallas":
         from tmlibrary_tpu.ops.pallas_kernels import cc_min_propagate
 
-        # interpret mode keeps the pallas path testable off-TPU
+        # interpret mode keeps the pallas path testable off-TPU; chunk
+        # (convergence-check interval, output-invariant) defaults to the
+        # committed hardware sweep inside cc_min_propagate
         labels = cc_min_propagate(
-            mask, connectivity, interpret=jax.default_backend() == "cpu"
+            mask, connectivity, interpret=jax.default_backend() == "cpu",
+            chunk=chunk,
         )
         labels = jnp.where(mask, labels, _BIG)
     else:

@@ -44,8 +44,29 @@ from jax.experimental.pallas import tpu as pltpu
 #: don't close over a traced constant)
 BIG = 2**30
 
-#: propagation steps between convergence checks
+#: propagation steps between convergence checks (default; the measured
+#: per-hardware value from the tune_tpu chunk sweep overrides via
+#: TUNING.json ``pallas_chunk`` — purely a performance knob: the
+#: fixpoint is idempotent, so extra steps after convergence cannot
+#: change a label and outputs are bit-identical for any chunk ≥ 1)
 CHUNK = 8
+
+
+def _tuned_chunk() -> int:
+    """Resolution: explicit arg (callers/tuner) → TMX_PALLAS_CHUNK env →
+    committed ``pallas_chunk`` sweep result → the default."""
+    import os
+
+    env = os.environ.get("TMX_PALLAS_CHUNK")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    tuned = _tuning_results().get("pallas_chunk")
+    if isinstance(tuned, (int, float)) and tuned >= 1:
+        return int(tuned)
+    return CHUNK
 
 
 def _shift_fill(a: jax.Array, dy: int, dx: int, fill, h: int, w: int) -> jax.Array:
@@ -80,7 +101,7 @@ def _shifts_for(connectivity: int) -> list[tuple[int, int]]:
 
 
 # ----------------------------------------------------------- CC min-propagate
-def _cc_kernel(mask_ref, out_ref, *, connectivity: int):
+def _cc_kernel(mask_ref, out_ref, *, connectivity: int, chunk: int):
     h, w = out_ref.shape
     mask = mask_ref[:] != 0
     # plain synchronous stepping, all shifts reading the same input vector.
@@ -106,7 +127,7 @@ def _cc_kernel(mask_ref, out_ref, *, connectivity: int):
     def body(state):
         lab, _ = state
         new = lab
-        for _ in range(CHUNK):
+        for _ in range(chunk):
             new = step(new)
         return new, jnp.any(new != lab)
 
@@ -117,19 +138,28 @@ def _cc_kernel(mask_ref, out_ref, *, connectivity: int):
     out_ref[:] = labels
 
 
-@functools.partial(jax.jit, static_argnames=("connectivity", "interpret"))
-def cc_min_propagate(
-    mask: jax.Array, connectivity: int = 8, interpret: bool = False
-) -> jax.Array:
-    """Converged min-linear-index labels for one (H, W) bool mask.
+def _resolve_chunk(chunk: "int | None") -> int:
+    """Explicit value (validated ≥ 1) or the tuned default — resolved
+    OUTSIDE jit so a changed TMX_PALLAS_CHUNK / re-written TUNING.json
+    is picked up per call instead of being baked into the first trace."""
+    if chunk is None:
+        return _tuned_chunk()
+    if not isinstance(chunk, int) or chunk < 1:
+        raise ValueError(f"chunk must be an int >= 1, got {chunk!r}")
+    return chunk
 
-    Background pixels hold ``BIG``.  Identical fixpoint to the XLA path in
-    ``ops.label.connected_components`` (which then compacts to scipy
-    order).
-    """
+
+@functools.partial(
+    jax.jit, static_argnames=("connectivity", "interpret", "chunk")
+)
+def _cc_min_propagate_jit(
+    mask: jax.Array, connectivity: int, interpret: bool, chunk: int
+) -> jax.Array:
     h, w = mask.shape
     return pl.pallas_call(
-        functools.partial(_cc_kernel, connectivity=connectivity),
+        functools.partial(
+            _cc_kernel, connectivity=connectivity, chunk=chunk,
+        ),
         out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -137,9 +167,25 @@ def cc_min_propagate(
     )(jnp.asarray(mask, jnp.int32))
 
 
+def cc_min_propagate(
+    mask: jax.Array, connectivity: int = 8, interpret: bool = False,
+    chunk: "int | None" = None,
+) -> jax.Array:
+    """Converged min-linear-index labels for one (H, W) bool mask.
+
+    Background pixels hold ``BIG``.  Identical fixpoint to the XLA path in
+    ``ops.label.connected_components`` (which then compacts to scipy
+    order).  ``chunk`` (propagation steps per convergence check) is a
+    pure performance knob — same labels for any value ≥ 1.
+    """
+    return _cc_min_propagate_jit(
+        mask, connectivity, interpret, _resolve_chunk(chunk)
+    )
+
+
 # -------------------------------------------------------------- watershed
 def _watershed_kernel(intensity_ref, seeds_ref, mask_ref, out_ref,
-                      *, n_levels: int, connectivity: int):
+                      *, n_levels: int, connectivity: int, chunk: int):
     h, w = out_ref.shape
     intensity = intensity_ref[:]
     seeds = seeds_ref[:]
@@ -162,7 +208,7 @@ def _watershed_kernel(intensity_ref, seeds_ref, mask_ref, out_ref,
         def body(state):
             lab, _ = state
             new = lab
-            for _ in range(CHUNK):
+            for _ in range(chunk):
                 new = adopt(new, allowed)
             return new, jnp.any(new != lab)
 
@@ -180,25 +226,22 @@ def _watershed_kernel(intensity_ref, seeds_ref, mask_ref, out_ref,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("n_levels", "connectivity", "interpret")
+    jax.jit, static_argnames=("n_levels", "connectivity", "interpret", "chunk")
 )
-def watershed_flood(
+def _watershed_flood_jit(
     intensity: jax.Array,
     seeds: jax.Array,
     mask: jax.Array,
-    n_levels: int = 32,
-    connectivity: int = 8,
-    interpret: bool = False,
+    n_levels: int,
+    connectivity: int,
+    interpret: bool,
+    chunk: int,
 ) -> jax.Array:
-    """Level-ordered watershed flooding of one (H, W) site, all in VMEM.
-
-    Same schedule and tie-breaking as
-    ``ops.segment_secondary.watershed_from_seeds``.
-    """
     h, w = intensity.shape
     return pl.pallas_call(
         functools.partial(
-            _watershed_kernel, n_levels=n_levels, connectivity=connectivity
+            _watershed_kernel, n_levels=n_levels, connectivity=connectivity,
+            chunk=chunk,
         ),
         out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
         in_specs=[
@@ -212,6 +255,27 @@ def watershed_flood(
         jnp.asarray(intensity, jnp.float32),
         jnp.asarray(seeds, jnp.int32),
         jnp.asarray(mask, jnp.int32),
+    )
+
+
+def watershed_flood(
+    intensity: jax.Array,
+    seeds: jax.Array,
+    mask: jax.Array,
+    n_levels: int = 32,
+    connectivity: int = 8,
+    interpret: bool = False,
+    chunk: "int | None" = None,
+) -> jax.Array:
+    """Level-ordered watershed flooding of one (H, W) site, all in VMEM.
+
+    Same schedule and tie-breaking as
+    ``ops.segment_secondary.watershed_from_seeds``.  ``chunk`` is the
+    convergence-check interval — bit-identical output for any value ≥ 1.
+    """
+    return _watershed_flood_jit(
+        intensity, seeds, mask, n_levels, connectivity, interpret,
+        _resolve_chunk(chunk),
     )
 
 
@@ -278,9 +342,13 @@ def _tuning_results() -> dict:
         / "TUNING.json"
     )
     try:
-        return json.loads(path.read_text())
+        tuning = json.loads(path.read_text())
     except (OSError, ValueError):
         return {}
+    # a dry-run (smoke-scale) sweep must never drive production dispatch
+    if "SMOKE(" in str(tuning.get("timing_methodology", "")):
+        return {}
+    return tuning
 
 
 def pallas_enabled(kernel: str | None = None) -> bool:

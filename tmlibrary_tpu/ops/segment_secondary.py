@@ -72,6 +72,7 @@ def watershed_from_seeds(
     n_levels: int = 32,
     connectivity: int = 8,
     method: str = "auto",
+    chunk: "int | None" = None,
 ) -> jax.Array:
     """Level-ordered flooding of ``seeds`` through ``mask``.
 
@@ -108,6 +109,7 @@ def watershed_from_seeds(
         return watershed_flood(
             intensity, seeds, mask, n_levels=n_levels, connectivity=connectivity,
             interpret=jax.default_backend() == "cpu",
+            chunk=chunk,
         )
     intensity = jnp.asarray(intensity, jnp.float32)
     seeds = jnp.asarray(seeds, jnp.int32)
