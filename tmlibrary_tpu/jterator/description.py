@@ -96,6 +96,28 @@ class PipelineDescription:
                 hd = item["handles"]  # inline dict (convenient for tests)
             else:
                 raise PipelineDescriptionError("pipeline item needs 'handles'")
+            if not isinstance(hd, dict):
+                raise PipelineDescriptionError(
+                    f"handles for {item.get('source') or item.get('handles')!r}"
+                    f" must be a mapping, got {type(hd).__name__}"
+                    " (empty or malformed handles file?)"
+                )
+            # reference compat: upstream .pipe.yaml names the module via
+            # ``source: [python/jtmodules/]<name>.py`` next to a handles
+            # PATH, and upstream handles files carry no module name —
+            # derive it from the source basename (tmlib/workflow/jterator/
+            # description.py pairs source+handles the same way).  An
+            # explicit ``module`` in the handles dict still wins.
+            if "module" not in hd and item.get("source"):
+                src = str(item["source"]).replace("\\", "/").rsplit("/", 1)[-1]
+                stem, dot, ext = src.rpartition(".")
+                if dot and ext.lower() in ("m", "r", "jl"):
+                    raise PipelineDescriptionError(
+                        f"non-Python module source '{item['source']}': "
+                        "Matlab/R bridges are out of scope (SURVEY §8); "
+                        "port the module to a registered JAX twin"
+                    )
+                hd = {**hd, "module": stem if dot else src}
             modules.append(HandleCollection.from_dict(hd))
         out = d.get("output", {}) or {}
         objects_out = [
