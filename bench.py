@@ -189,6 +189,25 @@ def _ledger_fields(pdepth: "int | None", max_objects: "int | None" = None) -> di
     return out
 
 
+def _iso_newer(a: "str | None", b: "str | None") -> bool:
+    """True when ISO timestamp ``a`` is strictly newer than ``b`` —
+    compared as aware datetimes (offsets honored), not lexicographically;
+    unparseable/missing values compare False (no annotation)."""
+    import datetime
+
+    try:
+        ta = datetime.datetime.fromisoformat(str(a))
+        tb = datetime.datetime.fromisoformat(str(b))
+    except ValueError:
+        return False
+    utc = datetime.timezone.utc
+    if ta.tzinfo is None:
+        ta = ta.replace(tzinfo=utc)
+    if tb.tzinfo is None:
+        tb = tb.replace(tzinfo=utc)
+    return ta > tb
+
+
 def emit_cached_tpu(live_error: str) -> bool:
     """When the relay is down at driver time, emit the most recent
     ON-HARDWARE measurement cached by scripts/tpu_watch.py instead of a
@@ -252,6 +271,33 @@ def emit_cached_tpu(live_error: str) -> bool:
         record["cache_age_hours"] = round((time.time() - measured_unix) / 3600, 2)
     record["live_error"] = f"tpu unavailable now: {live_error}"
     record["provenance"] = entry.get("provenance")
+    # when the machine-written tuning sweep measured the SAME workload on
+    # hardware more recently than the cached record (a short relay window
+    # that fit the sweep but not a full bench re-certification), surface
+    # it: the sweep's sites/s is the same chain at the same batch, timed
+    # by tune_tpu's pipelined methodology
+    tuning = _load_tuning()
+    if (
+        record.get("config") == "3"
+        and tuning
+        and tuning.get("pipeline_sweep")
+        and tuning.get("best_batch") == record.get("batch")
+        and _iso_newer(tuning.get("written_at"), record.get("measured_at"))
+    ):
+        best_depth = tuning.get("best_pipeline")
+        best = tuning["pipeline_sweep"].get(str(best_depth))
+        if best:
+            record["newer_tuning_sweep"] = {
+                "sites_per_sec": best,
+                "pipeline_depth": best_depth,
+                # each sweep point is measured AT its depth — the file's
+                # global marker describes the batch sweep's default
+                "timing_methodology": f"pipelined-depth{best_depth}",
+                "swept_at": tuning.get("written_at"),
+                "note": "same config-3 workload measured on hardware by "
+                        "scripts/tune_tpu.py during a relay window too "
+                        "short for a full bench re-certification",
+            }
     print(json.dumps(record), flush=True)
     return True
 

@@ -195,3 +195,59 @@ def test_cache_rejected_on_pipeline_depth_mismatch(tmp_path):
     })
     if out2.get("backend") == "tpu_cached":
         assert out2["value"] == 400.0
+
+
+def test_cached_record_carries_newer_sweep_annotation(tmp_path):
+    """A cached config-3 record older than the committed tuning sweep of
+    the same workload (same batch) must surface the sweep's sites/s as
+    ``newer_tuning_sweep`` — a short relay window that fit the depth
+    sweep but not a full re-certification is still hardware evidence."""
+    cache = tmp_path / "BENCH_TPU.json"
+    cache.write_text(json.dumps({"records": {"3": {
+        "record": {
+            "metric": "jterator_cell_painting_sites_per_sec_per_chip",
+            "value": 300.0, "unit": "u", "vs_baseline": 5.0,
+            "backend": "axon", "config": "3", "batch": 128,
+            "site_size": 256, "max_objects": 64,
+        },
+        "measured_at": "2026-07-30T23:36:40+00:00",
+        "measured_at_unix": time.time() - 7200,
+        "provenance": "t",
+    }}}))
+    tuning = tmp_path / "TUNING.json"
+    tuning.write_text(json.dumps({
+        "written_by": "scripts/tune_tpu.py write_results",
+        "written_at": "2026-08-01T08:33:01+00:00",
+        "best_batch": 128, "best_pipeline": 16,
+        "pipeline_sweep": {"4": 500.0, "8": 590.0, "16": 606.5},
+        "timing_methodology": "pipelined-depth8",
+    }))
+    out = _run_bench({
+        "BENCH_TPU_CACHE": str(cache),
+        "TMX_TUNING_JSON": str(tuning),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "128",
+    })
+    if out.get("backend") != "tpu_cached":
+        pytest.skip(f"relay answered live (backend={out.get('backend')})")
+    sweep = out["newer_tuning_sweep"]
+    assert sweep["sites_per_sec"] == 606.5
+    assert sweep["pipeline_depth"] == 16
+    assert sweep["timing_methodology"] == "pipelined-depth16"
+
+    # a record NEWER than the sweep must not be annotated
+    rec = json.loads(cache.read_text())
+    rec["records"]["3"]["record"]["value"] = 650.0
+    rec["records"]["3"]["measured_at"] = "2026-08-02T00:00:00+00:00"
+    cache.write_text(json.dumps(rec))
+    out = _run_bench({
+        "BENCH_TPU_CACHE": str(cache),
+        "TMX_TUNING_JSON": str(tuning),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "128",
+    })
+    if out.get("backend") != "tpu_cached":
+        pytest.skip(f"relay answered live (backend={out.get('backend')})")
+    assert "newer_tuning_sweep" not in out
