@@ -1267,7 +1267,18 @@ def main() -> None:
 
     def try_once(platform: str) -> bool:
         nonlocal last_err
-        if platform == "default" and not probe_device():
+        # BENCH_ASSUME_ALIVE: the watcher fires bench only after its own
+        # probe round-tripped a computation — re-probing here burned a
+        # live window once (2026-08-01: probe timed out under host CPU
+        # contention, the attempt fell to cache).  If the relay died in
+        # between, the measurement attempt itself times out and the
+        # watcher retries at the next window — same outcome, one step
+        # later, only in the rare death-within-a-minute case.
+        if (
+            platform == "default"
+            and not os.environ.get("BENCH_ASSUME_ALIVE")
+            and not probe_device()
+        ):
             last_err = "default: device probe timed out (relay down?)"
             print(f"bench: {last_err}", file=sys.stderr, flush=True)
             return False
