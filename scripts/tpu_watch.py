@@ -409,18 +409,24 @@ def fire_pending(pending: list) -> bool:
         return False
 
     for label in pending:
+        # every child (bench, profile, tune) skips or lacks its own
+        # probe — gate each on a fresh proof of life, and treat every
+        # successful capture as the freshest proof there is
+        if not still_alive():
+            break
         if label == "tune:pipeline":
             # a failure here must NOT block the headline bench items:
             # they can still measure at the previous depth default
-            captured |= run_tune(["pipeline"], timeout_s=2400)
+            if run_tune(["pipeline"], timeout_s=2400):
+                captured = True
+                last_alive = time.time()
         elif label == "profile":
             ok = run_profile()
             captured |= ok
             if not ok:
                 break
+            last_alive = time.time()
         elif label.startswith("bench:"):
-            if not still_alive():
-                break
             key = label[6:]
             fast = key in PRIORITY_BENCH
             ok = run_bench_item(
@@ -431,6 +437,7 @@ def fire_pending(pending: list) -> bool:
             captured |= ok
             if not ok:
                 break  # relay likely died; back to probing
+            last_alive = time.time()
         elif label.startswith("tune:"):
             stages = [l[5:] for l in pending if l.startswith("tune:")
                       and l != "tune:pipeline"]
