@@ -990,3 +990,60 @@ def test_resolve_sidecars_policy(tmp_path):
     # raises instead of silently falling through
     with pytest.raises(MetadataError):
         resolve_sidecars(src, ["nd2"], False)
+
+
+# ---------------------------------------------------------------- InCell
+def test_incell_filename_parsing():
+    """GE/Cytiva InCell export convention: 'A - 1(fld 1 wv Blue - FITC)
+    .tif', with z/tp tokens in either order around wv."""
+    from tmlibrary_tpu.workflow.steps.metaconfig import (
+        INCELL_PATTERN,
+        FilenameHandler,
+    )
+
+    h = FilenameHandler(INCELL_PATTERN, "incell")
+    p = h.parse("A - 1(fld 1 wv Blue - FITC).tif")
+    assert p == {
+        "plate": "plate00", "well_row": 0, "well_col": 0, "site": 0,
+        "channel": "Blue - FITC", "cycle": 0, "tpoint": 0, "zplane": 0,
+    }
+    p = h.parse("B - 10(fld 3 wv UV - DAPI z 2).tif")
+    assert (p["well_row"], p["well_col"], p["site"]) == (1, 9, 2)
+    assert p["channel"] == "UV - DAPI"
+    assert p["zplane"] == 1
+    p = h.parse("P - 24(fld 9 tp 4 wv Red - Cy5).tif")
+    assert (p["well_row"], p["well_col"]) == (15, 23)
+    assert p["tpoint"] == 3
+    assert p["channel"] == "Red - Cy5"
+    # non-InCell names are skipped, not crashed on
+    assert h.parse("A01_s0_DAPI.tif") is None
+    assert h.parse("A - 1(nothing here).tif") is None
+
+
+def test_metaconfig_incell_end_to_end(tmp_path):
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    for well in ("A - 1", "B - 2"):
+        for fld in (1, 2):
+            for wv in ("Blue - FITC", "UV - DAPI"):
+                cv2.imwrite(
+                    str(src / f"{well}(fld {fld} wv {wv}).tif"),
+                    np.full((16, 16), 9, np.uint16),
+                )
+    root = tmp_path / "exp"
+    store = _empty_store(root, "incelltest")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "incell"})
+    result = step.run(0)
+    assert result["n_files"] == 8
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 4
+    assert sorted(c.name for c in exp.channels) == [
+        "Blue - FITC", "UV - DAPI"]
+    wells = [w for p in exp.plates for w in p.wells]
+    assert sorted((w.row, w.column) for w in wells) == [(0, 0), (1, 1)]

@@ -58,6 +58,36 @@ CELLVOYAGER_PATTERN = (
 )
 
 
+#: GE/Cytiva InCell Analyzer export convention ("A - 1(fld 1 wv
+#: Blue - FITC).tif"; z-stack/timelapse exports add "z N" / "tp N"
+#: tokens inside the parens, order varying by InCell version — the
+#: style branch tokenizes the paren body instead of pinning an order)
+INCELL_PATTERN = (
+    r"^(?P<wrow>[A-Z]{1,2}) - (?P<wcol>\d{1,2})"
+    r"\((?P<tokens>[^)]*\bfld\b[^)]*)\)"
+    r"\.(?:tif|tiff)$"
+)
+
+
+def _parse_incell_tokens(tokens: str) -> "dict | None":
+    """'fld 1 wv Blue - FITC z 3' → {site, channel, zplane, tpoint}.
+    The wv value runs until a trailing ``z N``/``tp N`` token or the
+    end (channel names like 'Blue - FITC' contain spaces/dashes but
+    never a bare z/tp-digit token)."""
+    site = re.search(r"\bfld (\d+)", tokens)
+    wv = re.search(r"\bwv (.+?)(?= \b(?:z|tp) \d|$)", tokens)
+    if not site or not wv:
+        return None
+    z = re.search(r"\bz (\d+)", tokens)
+    tp = re.search(r"\btp (\d+)", tokens)
+    return {
+        "site": int(site.group(1)),
+        "channel": wv.group(1).strip(),
+        "zplane": int(z.group(1)) if z else 1,
+        "tpoint": int(tp.group(1)) if tp else 1,
+    }
+
+
 #: the well-name grammar ('B03', 'AA12'): single source of truth shared by
 #: parse_well_name and the vendor sidecar handlers' token search
 WELL_NAME_PATTERN = r"([A-Z]{1,2})(\d{1,2})"
@@ -95,6 +125,23 @@ class FilenameHandler:
         if not m:
             return None
         g = m.groupdict()
+        if self.style == "incell":
+            row = 0
+            for ch in g["wrow"]:
+                row = row * 26 + (ord(ch) - ord("A") + 1)
+            parsed = _parse_incell_tokens(g["tokens"])
+            if parsed is None:
+                return None
+            return {
+                "plate": "plate00",
+                "well_row": row - 1,
+                "well_col": int(g["wcol"]) - 1,
+                "site": parsed["site"] - 1,  # fld is 1-based
+                "channel": parsed["channel"],
+                "cycle": 0,
+                "tpoint": parsed["tpoint"] - 1,
+                "zplane": parsed["zplane"] - 1,
+            }
         if self.style == "cellvoyager":
             row, col = well_num_to_rowcol(int(g["well_num"]), self.plate_cols)
         else:
@@ -121,10 +168,10 @@ class MetadataConfigurator(Step):
         Argument("source_dir", str, required=True,
                  help="directory of microscope image files"),
         Argument("handler", str, default="default",
-                 choices=("default", "cellvoyager", "omexml", "metamorph",
-                          "harmony", "imagexpress", "scanr", "leica",
-                          "nd2", "czi", "lif", "ngff", "dv", "ims", "stk",
-                          "lsm", "olympus", "flex", "auto"),
+                 choices=("default", "cellvoyager", "incell", "omexml",
+                          "metamorph", "harmony", "imagexpress", "scanr",
+                          "leica", "nd2", "czi", "lif", "ngff", "dv",
+                          "ims", "stk", "lsm", "olympus", "flex", "auto"),
                  help="vendor metadata handler (sidecar files preferred, "
                       "filename patterns as fallback)"),
         Argument("pattern", str, default=None,
@@ -178,10 +225,15 @@ class MetadataConfigurator(Step):
 
         if entries is None:  # filename-pattern fallback
             skipped = 0  # drop any count carried over from a failed sidecar
-            style = "cellvoyager" if args["handler"] == "cellvoyager" else "default"
-            pattern = args["pattern"] or (
-                CELLVOYAGER_PATTERN if style == "cellvoyager" else DEFAULT_PATTERN
+            style = (
+                args["handler"]
+                if args["handler"] in ("cellvoyager", "incell")
+                else "default"
             )
+            pattern = args["pattern"] or {
+                "cellvoyager": CELLVOYAGER_PATTERN,
+                "incell": INCELL_PATTERN,
+            }.get(style, DEFAULT_PATTERN)
             handler = FilenameHandler(pattern, style, args["plate_cols"])
             entries = []
             for path in sorted(src.rglob("*")):
