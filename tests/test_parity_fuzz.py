@@ -105,3 +105,72 @@ def test_secondary_chain_parity_random_params(seed):
         actin, seeds, mask, n_levels=n_levels, method="xla"
     ))
     np.testing.assert_array_equal(cells, again)
+
+
+# ------------------------------------------------- measurement fuzz
+def _random_labels(rng, size):
+    img = _blob_image(rng, size, int(rng.integers(3, 10)),
+                      float(rng.uniform(3.0, 6.0)))
+    sm = np.asarray(gaussian_smooth(img, 1.5))
+    labels = np.asarray(
+        segment_primary(sm, threshold_method="otsu", smooth_sigma=0.0,
+                        min_area=10)[0]
+    )
+    return labels, np.asarray(img, np.float32)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_measurement_parity_random_scenes(seed):
+    """Intensity + morphology basics vs scipy.ndimage on random
+    segmentations — the golden-fixture assertions, at fuzz breadth."""
+    from tmlibrary_tpu.ops.measure import (
+        intensity_features,
+        morphology_features,
+    )
+
+    rng = np.random.default_rng(3000 + seed)
+    size = int(rng.choice([96, 128, 160]))
+    labels, img = _random_labels(rng, size)
+    n = int(labels.max())
+    if n == 0:
+        pytest.skip("draw produced no objects")
+    cap = max(8, n + 2)
+
+    ints = intensity_features(labels, img, cap)
+    morph = morphology_features(labels, cap)
+    idx = np.arange(1, n + 1)
+
+    np.testing.assert_allclose(
+        np.asarray(ints["Intensity_mean"])[:n],
+        ndi.mean(img, labels, idx), rtol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(ints["Intensity_sum"])[:n],
+        ndi.sum(img, labels, idx), rtol=2e-5)
+    np.testing.assert_array_equal(
+        np.asarray(ints["Intensity_max"])[:n],
+        ndi.maximum(img, labels, idx))
+    np.testing.assert_array_equal(
+        np.asarray(ints["Intensity_min"])[:n],
+        ndi.minimum(img, labels, idx))
+    np.testing.assert_allclose(
+        np.asarray(ints["Intensity_std"])[:n],
+        ndi.standard_deviation(img, labels, idx), rtol=1e-3, atol=1e-4)
+
+    areas = np.array([(labels == l).sum() for l in idx], np.float64)
+    np.testing.assert_array_equal(
+        np.asarray(morph["Morphology_area"])[:n], areas)
+    cy = ndi.center_of_mass(np.ones_like(labels), labels, idx)
+    np.testing.assert_allclose(
+        np.asarray(morph["Morphology_centroid_y"])[:n],
+        [c[0] for c in cy], rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(morph["Morphology_centroid_x"])[:n],
+        [c[1] for c in cy], rtol=1e-5, atol=1e-4)
+    # bbox vs find_objects
+    sl = ndi.find_objects(labels)
+    bh = [s[0].stop - s[0].start for s in sl if s is not None]
+    bw = [s[1].stop - s[1].start for s in sl if s is not None]
+    np.testing.assert_array_equal(
+        np.asarray(morph["Morphology_bbox_height"])[:n], bh)
+    np.testing.assert_array_equal(
+        np.asarray(morph["Morphology_bbox_width"])[:n], bw)

@@ -1047,3 +1047,56 @@ def test_metaconfig_incell_end_to_end(tmp_path):
         "Blue - FITC", "UV - DAPI"]
     wells = [w for p in exp.plates for w in p.wells]
     assert sorted((w.row, w.column) for w in wells) == [(0, 0), (1, 1)]
+
+
+def test_auto_handler_detects_incell_filenames(tmp_path):
+    """--handler auto with no sidecars tries default, then cellvoyager,
+    then incell filename styles — an InCell export dir just works."""
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    for well in ("A - 1", "A - 2"):
+        for fld in (1, 2):
+            cv2.imwrite(
+                str(src / f"{well}(fld {fld} wv UV - DAPI).tif"),
+                np.full((16, 16), 5, np.uint16),
+            )
+    root = tmp_path / "exp"
+    store = _empty_store(root, "autoincell")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "auto"})
+    result = step.run(0)
+    assert result["n_files"] == 4
+    exp = ExperimentStore.open(root).experiment
+    assert exp.n_sites == 4
+    assert [c.name for c in exp.channels] == ["UV - DAPI"]
+
+
+def test_auto_handler_prefers_majority_style(tmp_path):
+    """A stray default-named file in an InCell export dir must not win
+    auto-detection — the style matching the most files does."""
+    import cv2
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    src = tmp_path / "source"
+    src.mkdir()
+    plane = np.full((16, 16), 5, np.uint16)
+    for fld in (1, 2, 3):
+        cv2.imwrite(str(src / f"A - 1(fld {fld} wv UV - DAPI).tif"), plane)
+    cv2.imwrite(str(src / "B03_s1_GFP.tif"), plane)  # the stray
+
+    root = tmp_path / "exp"
+    store = _empty_store(root, "majority")
+    step = get_step("metaconfig")(store)
+    step.init({"source_dir": str(src), "handler": "auto"})
+    result = step.run(0)
+    assert result["n_files"] == 3
+    assert result["n_skipped"] == 1
+    exp = ExperimentStore.open(root).experiment
+    assert [c.name for c in exp.channels] == ["UV - DAPI"]

@@ -224,27 +224,39 @@ class MetadataConfigurator(Step):
             raise MetadataError(f"no companion OME-XML files found under {src}")
 
         if entries is None:  # filename-pattern fallback
-            skipped = 0  # drop any count carried over from a failed sidecar
             style = (
                 args["handler"]
                 if args["handler"] in ("cellvoyager", "incell")
                 else "default"
             )
-            pattern = args["pattern"] or {
-                "cellvoyager": CELLVOYAGER_PATTERN,
-                "incell": INCELL_PATTERN,
-            }.get(style, DEFAULT_PATTERN)
-            handler = FilenameHandler(pattern, style, args["plate_cols"])
-            entries = []
-            for path in sorted(src.rglob("*")):
-                if not path.is_file():
-                    continue
-                parsed = handler.parse(path.name)
-                if parsed is None:
-                    skipped += 1
-                    continue
-                parsed["path"] = str(path)
-                entries.append(parsed)
+            # --handler auto with no sidecars: try every filename style
+            # and keep the one matching the MOST files (InCell and
+            # CellVoyager export names cannot match the default pattern;
+            # first-match-wins would let one stray default-named file in
+            # a vendor export dir shadow the real style)
+            styles = (
+                [("default", DEFAULT_PATTERN),
+                 ("cellvoyager", CELLVOYAGER_PATTERN),
+                 ("incell", INCELL_PATTERN)]
+                if args["handler"] == "auto" and not args.get("pattern")
+                else [(style, args["pattern"] or {
+                    "cellvoyager": CELLVOYAGER_PATTERN,
+                    "incell": INCELL_PATTERN,
+                }.get(style, DEFAULT_PATTERN))]
+            )
+            files = [p for p in sorted(src.rglob("*")) if p.is_file()]
+            entries, skipped = [], len(files)
+            for sname, pattern in styles:
+                handler = FilenameHandler(pattern, sname, args["plate_cols"])
+                cand = []
+                for path in files:
+                    parsed = handler.parse(path.name)
+                    if parsed is None:
+                        continue
+                    parsed["path"] = str(path)
+                    cand.append(parsed)
+                if len(cand) > len(entries):
+                    entries, skipped = cand, len(files) - len(cand)
         if not entries:
             raise MetadataError(
                 f"no files in {src} matched the '{args['handler']}' pattern"
