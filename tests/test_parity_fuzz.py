@@ -174,3 +174,34 @@ def test_measurement_parity_random_scenes(seed):
         np.asarray(morph["Morphology_bbox_height"])[:n], bh)
     np.testing.assert_array_equal(
         np.asarray(morph["Morphology_bbox_width"])[:n], bw)
+
+
+# ------------------------------------------------- 3-D volume fuzz
+@pytest.mark.parametrize("seed", range(4))
+def test_volume_cc_parity_random_draws(seed):
+    """3-D connected components vs scipy.ndimage at random blob draws,
+    all three connectivities, both the auto (native-on-cpu) and xla
+    paths — bit-identical label volumes."""
+    from tmlibrary_tpu.ops.volume import connected_components_3d
+
+    rng = np.random.default_rng(4000 + seed)
+    nz = int(rng.choice([6, 10, 14]))
+    size = int(rng.choice([48, 64]))
+    zz, yy, xx = np.mgrid[0:nz, 0:size, 0:size].astype(np.float32)
+    vol = rng.normal(0.0, 0.05, (nz, size, size)).astype(np.float32)
+    for _ in range(int(rng.integers(3, 8))):
+        z, y, x = rng.integers(2, nz - 2), *rng.integers(8, size - 8, 2)
+        r = float(rng.uniform(2.0, 4.0))
+        vol += np.exp(-(((zz - z) * 2.0) ** 2 + (yy - y) ** 2
+                        + (xx - x) ** 2) / (2 * r**2))
+    mask = vol > 0.35
+
+    for conn in (6, 18, 26):
+        struct = ndi.generate_binary_structure(3, {6: 1, 18: 2, 26: 3}[conn])
+        want, n_want = ndi.label(mask, structure=struct)
+        for method in ("auto", "xla"):
+            got, n = connected_components_3d(mask, conn, method=method)
+            assert int(n) == n_want, (seed, conn, method)
+            np.testing.assert_array_equal(
+                np.asarray(got), want,
+                err_msg=f"seed={seed} conn={conn} method={method}")
