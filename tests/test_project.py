@@ -269,3 +269,51 @@ def test_upstream_matlab_source_rejected(tmp_path):
     }))
     with pytest.raises(PipelineDescriptionError, match="Matlab/R"):
         PipelineDescription.load(tmp_path / "p.pipe.yaml")
+
+
+def test_project_check_verb(tmp_path, capsys):
+    """``tmx project check``: a valid pipe passes; unknown modules, bad
+    parameter names, and broken dataflow are each reported with exit 1
+    (reference jterator's pipeline-check role)."""
+    from tmlibrary_tpu.cli import main
+
+    good = {
+        "description": "ok",
+        "input": {"channels": [{"name": "DAPI", "correct": False}]},
+        "pipeline": [
+            {"handles": {
+                "module": "smooth",
+                "input": [
+                    {"name": "intensity_image", "type": "IntensityImage",
+                     "key": "DAPI"},
+                    {"name": "sigma", "type": "Numeric", "value": 1.0},
+                ],
+                "output": [
+                    {"name": "smoothed_image", "type": "IntensityImage",
+                     "key": "sm"},
+                ],
+            }},
+        ],
+        "output": {"objects": []},
+    }
+    p = tmp_path / "good.pipe.yaml"
+    p.write_text(yaml.safe_dump(good))
+    assert main(["project", "check", "--pipe", str(p)]) == 0
+    assert "OK: 1 modules" in capsys.readouterr().out
+
+    bad_param = yaml.safe_load(yaml.safe_dump(good))
+    bad_param["pipeline"][0]["handles"]["input"][1]["name"] = "sgima"
+    p.write_text(yaml.safe_dump(bad_param))
+    assert main(["project", "check", "--pipe", str(p)]) == 1
+    assert "no parameter 'sgima'" in capsys.readouterr().out
+
+    bad_module = yaml.safe_load(yaml.safe_dump(good))
+    bad_module["pipeline"][0]["handles"]["module"] = "smoooth"
+    p.write_text(yaml.safe_dump(bad_module))
+    assert main(["project", "check", "--pipe", str(p)]) == 1
+
+    bad_flow = yaml.safe_load(yaml.safe_dump(good))
+    bad_flow["pipeline"][0]["handles"]["input"][0]["key"] = "Actin"
+    p.write_text(yaml.safe_dump(bad_flow))
+    assert main(["project", "check", "--pipe", str(p)]) == 1
+    assert "no upstream produces" in capsys.readouterr().out

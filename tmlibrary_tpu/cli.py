@@ -226,6 +226,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_pshow = proj_sub.add_parser("show", help="modules in pipeline order")
     p_pshow.add_argument("--dir", required=True)
     proj_sub.add_parser("modules", help="registered module names")
+    p_pcheck = proj_sub.add_parser(
+        "check", help="validate a pipeline without running it: dataflow, "
+                      "module names, parameter names (reference jterator's "
+                      "pipeline check role)")
+    p_pcheck.add_argument("--pipe", required=True, help="path to .pipe.yaml")
 
     for name in list_steps():
         step_cls = get_step(name)
@@ -526,6 +531,54 @@ def cmd_project(args) -> int:
     if args.verb == "create":
         Project.create(Path(args.dir), description=args.description)
         print(f"created project at {args.dir}")
+        return 0
+    if args.verb == "check":
+        import yaml
+
+        from tmlibrary_tpu.errors import (
+            PipelineDescriptionError,
+            PipelineError,
+            RegistryError,
+        )
+        from tmlibrary_tpu.jterator.description import PipelineDescription
+        from tmlibrary_tpu.jterator.modules import get_module, module_accepts
+
+        try:
+            desc = PipelineDescription.load(Path(args.pipe))
+        except (PipelineError, OSError, ValueError, KeyError,
+                yaml.YAMLError) as e:
+            # PipelineError covers the description AND handle-type
+            # errors; KeyError = a handle dict missing a required field
+            print(f"FAIL: cannot load pipeline: {e}")
+            return 1
+        problems: list[str] = []
+        try:
+            desc.validate()
+        except PipelineDescriptionError as e:
+            problems.append(str(e))
+        for mod in desc.modules:
+            try:
+                get_module(mod.module, mod.backend)
+            except RegistryError as e:
+                problems.append(str(e))
+                continue
+            # exactly the names the runner will bind (constants + traced
+            # arrays; Plot/Figure handles are display-only and unbound)
+            bound = list(mod.constants()) + list(mod.array_inputs())
+            for name in bound:
+                if not module_accepts(mod.module, mod.backend, name):
+                    problems.append(
+                        f"module '{mod.module}' has no parameter "
+                        f"'{name}'"
+                    )
+        if problems:
+            for p in problems:
+                print(f"FAIL: {p}")
+            return 1
+        print(
+            f"OK: {len(desc.modules)} modules, dataflow valid, every "
+            "module and parameter resolves"
+        )
         return 0
     proj = Project(Path(args.dir))
     if args.verb == "add-module":
