@@ -71,14 +71,17 @@ def _native_3d() -> bool:
 
 
 def connected_components_3d(
-    mask: jax.Array, connectivity: int = 26, method: str = "auto"
+    mask: jax.Array, connectivity: int = 26, method: str = "auto",
+    chunk: "int | None" = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Label 3-D connected components; scipy scan order, like the 2-D op.
 
     ``connectivity``: 6 (faces), 18 (faces+edges), 26 (full).
-    ``method="auto"`` routes to the native union-find (``tm_cc_label3d``)
-    on the cpu backend — same dispatch order as the 2-D ops (native →
-    xla; no pallas twin in 3-D yet).
+    ``method="auto"`` resolution order (same as the 2-D ops): the native
+    union-find (``tm_cc_label3d``) on the cpu backend → the VMEM pallas
+    kernel (``pallas_kernels.cc3d_min_propagate``) on TPU when the
+    hardware shootout says it wins (``pallas_enabled("cc3d")``) → xla.
+    All three produce the identical scipy-scan-order labeling.
     """
     mask = jnp.asarray(mask, bool)
     z, h, w = mask.shape
@@ -88,7 +91,12 @@ def connected_components_3d(
         # while the native kernel rejects it — backend-dependent behavior
         raise ValueError("3-D connectivity must be 6, 18 or 26")
     if method == "auto":
-        method = "native" if _native_3d() else "xla"
+        if _native_3d():
+            method = "native"
+        else:
+            from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+
+            method = "pallas" if pallas_enabled("cc3d") else "xla"
     if method == "native":
         import numpy as np
 
@@ -108,26 +116,38 @@ def connected_components_3d(
             mask,
             vmap_method=native.callback_vmap_method(),
         )
-    shifts = _diag_shifts_3d(connectivity)
     linear = jnp.arange(z * h * w, dtype=jnp.int32).reshape(z, h, w)
-    init = jnp.where(mask, linear, _BIG)
 
-    def cond(state):
-        return state[1]
+    if method == "pallas":
+        from tmlibrary_tpu.ops.pallas_kernels import cc3d_min_propagate
 
-    def body(state):
-        labels, _ = state
-        new = labels
-        if shifts:
-            for s in shifts:
-                new = jnp.minimum(new, shift3d(labels, *s, _BIG))
-            new = jnp.where(mask, new, _BIG)
-        new = _run_min_scan_3d(new, mask, axis=2)
-        new = _run_min_scan_3d(new, mask, axis=1)
-        new = _run_min_scan_3d(new, mask, axis=0)
-        return new, jnp.any(new != labels)
+        # identical min-linear-index fixpoint in VMEM; compaction to
+        # scipy scan order below is shared with the xla path
+        labels = cc3d_min_propagate(
+            mask, connectivity, interpret=jax.default_backend() == "cpu",
+            chunk=chunk,
+        )
+        labels = jnp.where(mask, labels, _BIG)
+    else:
+        shifts = _diag_shifts_3d(connectivity)
+        init = jnp.where(mask, linear, _BIG)
 
-    labels, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
+        def cond(state):
+            return state[1]
+
+        def body(state):
+            labels, _ = state
+            new = labels
+            if shifts:
+                for s in shifts:
+                    new = jnp.minimum(new, shift3d(labels, *s, _BIG))
+                new = jnp.where(mask, new, _BIG)
+            new = _run_min_scan_3d(new, mask, axis=2)
+            new = _run_min_scan_3d(new, mask, axis=1)
+            new = _run_min_scan_3d(new, mask, axis=0)
+            return new, jnp.any(new != labels)
+
+        labels, _ = lax.while_loop(cond, body, (init, jnp.bool_(True)))
 
     is_root = mask & (labels == linear)
     ranks = jnp.cumsum(is_root.reshape(-1).astype(jnp.int32))
@@ -170,22 +190,41 @@ def watershed_from_seeds_3d(
     mask: jax.Array,
     n_levels: int = 16,
     method: str = "auto",
+    chunk: "int | None" = None,
 ) -> jax.Array:
     """3-D level-ordered flooding (same scheme as the 2-D watershed).
 
     ``method="auto"`` routes to the native frontier flood
-    (``tm_watershed_levels3d``) on the cpu backend; the level thresholds
-    are computed by the same jitted expression either way, so band
-    membership is decided by exact float comparisons (bit-identical)."""
+    (``tm_watershed_levels3d``) on the cpu backend, the VMEM pallas
+    kernel on TPU per ``pallas_enabled("watershed3d")``, else xla; the
+    level thresholds are computed by the same expression every way, so
+    band membership is decided by exact float comparisons
+    (bit-identical)."""
     intensity = jnp.asarray(intensity, jnp.float32)
     seeds = jnp.asarray(seeds, jnp.int32)
     mask = jnp.asarray(mask, bool) | (seeds > 0)
+
+    if method == "auto":
+        if _native_3d():
+            method = "native"
+        else:
+            from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+
+            method = "pallas" if pallas_enabled("watershed3d") else "xla"
+    if method == "pallas":
+        from tmlibrary_tpu.ops.pallas_kernels import watershed3d_flood
+
+        # the kernel computes lo/hi/span in VMEM itself
+        return watershed3d_flood(
+            intensity, seeds, mask, n_levels=n_levels,
+            interpret=jax.default_backend() == "cpu",
+            chunk=chunk,
+        )
+
     lo = jnp.min(jnp.where(mask, intensity, jnp.inf))
     hi = jnp.max(jnp.where(mask, intensity, -jnp.inf))
     span = jnp.maximum(hi - lo, 1e-6)
 
-    if method == "auto":
-        method = "native" if _native_3d() else "xla"
     if method == "native":
         import numpy as np
 
