@@ -186,6 +186,18 @@ def kernel_shootout():
     d_x = _bench_fn("dt_xla", v(lambda m: distance_transform_approx(m, method='xla')), masks, batch=B)
     d_p = _bench_fn("dt_pallas", v(lambda m: distance_transform_approx(m, method='pallas')), masks, batch=B)
 
+    print("fill holes:")
+    from tmlibrary_tpu.ops.label import fill_holes
+    from tmlibrary_tpu.ops.pallas_kernels import fill_holes_flood
+
+    f_x = _bench_fn(
+        "fill_xla", v(lambda m: fill_holes(m, method='xla')), masks, batch=B)
+    f_p = _bench_fn(
+        "fill_pallas",
+        v(lambda m, _c=best_chunk: fill_holes_flood(
+            m, interpret=interp, chunk=_c)),
+        masks, batch=B)
+
     # 3-D twins (volume config), timed at this run's freshly-swept chunk
     # so the committed verdict matches what production will dispatch.
     # The whole section is guarded: a 3-D-only failure must not discard
@@ -229,6 +241,7 @@ def kernel_shootout():
 
     RESULTS["kernels_ms"] = {
         "cc_xla": t_x * 1e3, "cc_pallas": t_p * 1e3,
+        "fill_xla": f_x * 1e3, "fill_pallas": f_p * 1e3,
         "cc3d_xla": c3_x * 1e3, "cc3d_pallas": c3_p * 1e3,
         "watershed3d_xla": w3_x * 1e3, "watershed3d_pallas": w3_p * 1e3,
         "watershed_xla": w_x * 1e3, "watershed_pallas": w_p * 1e3,

@@ -266,8 +266,10 @@ def test_pallas_enabled_per_kernel(monkeypatch):
     # null timing (kernel FAILED on hardware during the shootout) ->
     # never auto-dispatch to the failed kernel
     assert pk.pallas_enabled("distance") is False
-    # unknown kernel name (no shootout entry at all) -> aggregate fallback
-    assert pk.pallas_enabled("nope") is True
+    # unknown/unmeasured kernel name (no shootout entry at all) -> NEVER
+    # auto-dispatch: only the trio the aggregate was computed from may
+    # ride it (a stale file must not route through an unmeasured kernel)
+    assert pk.pallas_enabled("nope") is False
     # env override still beats the per-kernel data, both directions
     monkeypatch.setenv("TMX_PALLAS", "0")
     assert pk.pallas_enabled("cc") is False
@@ -344,3 +346,57 @@ def test_cc3d_chunk_output_invariant(rng):
         got = np.asarray(cc3d_min_propagate(mask, 26, interpret=True,
                                             chunk=chunk))
         np.testing.assert_array_equal(got, base)
+
+
+@pytest.mark.parametrize("connectivity", [4, 8])
+def test_fill_holes_pallas_matches_xla_and_scipy(rng, connectivity):
+    """fill_holes(method='pallas') — VMEM border flood via interpret mode
+    — is bit-identical to the XLA flood; at background connectivity 4 it
+    also equals scipy.binary_fill_holes (the complement of 8-connected
+    foreground, the jtmodules fill semantics)."""
+    from tmlibrary_tpu.ops.label import fill_holes
+
+    img = blobs(rng, n=6, r=7)
+    mask = img > 0.25
+    # punch interior holes so there is something to fill
+    mask[20:24, 20:24] = False
+    mask[40:43, 10:12] = False
+
+    got = np.asarray(fill_holes(mask, connectivity, method="pallas"))
+    want = np.asarray(fill_holes(mask, connectivity, method="xla"))
+    np.testing.assert_array_equal(got, want)
+    if connectivity == 4:
+        np.testing.assert_array_equal(
+            got, ndi.binary_fill_holes(mask))
+
+
+def test_fill_holes_chunk_output_invariant(rng):
+    from tmlibrary_tpu.ops.pallas_kernels import fill_holes_flood
+
+    img = blobs(rng, n=6, r=7)
+    mask = img > 0.25
+    mask[30:33, 30:33] = False
+    base = np.asarray(fill_holes_flood(mask, interpret=True))
+    for chunk in (1, 16):
+        got = np.asarray(fill_holes_flood(mask, interpret=True, chunk=chunk))
+        np.testing.assert_array_equal(got, base)
+
+
+def test_unmeasured_kernel_never_rides_aggregate(monkeypatch):
+    """A stale pre-fill/pre-3D TUNING.json with pallas_wins=true must not
+    auto-dispatch the kernels it never measured."""
+    from tmlibrary_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk.jax, "default_backend", lambda: "tpu")
+    monkeypatch.delenv("TMX_PALLAS", raising=False)
+    stale = {
+        "pallas_wins": True,
+        "kernels_ms": {"cc_pallas": 80.0, "cc_xla": 180.0,
+                       "watershed_pallas": 50.0, "watershed_xla": 45.0},
+    }
+    monkeypatch.setattr(pk, "_tuning_results", lambda: stale)
+    assert pk.pallas_enabled("cc") is True          # measured win
+    assert pk.pallas_enabled("watershed") is False  # measured loss
+    assert pk.pallas_enabled("distance") is True    # trio rides aggregate
+    for newer in ("fill", "cc3d", "watershed3d"):
+        assert pk.pallas_enabled(newer) is False, newer

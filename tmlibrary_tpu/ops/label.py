@@ -226,15 +226,27 @@ def fill_holes(
 
     ``method="auto"`` routes to the native border-BFS
     (``tm_fill_holes``) on the cpu backend (see
-    :func:`~tmlibrary_tpu.native.cpu_native_enabled`), the XLA flood
-    otherwise.
+    :func:`~tmlibrary_tpu.native.cpu_native_enabled`), the VMEM pallas
+    flood on TPU when the committed shootout says it wins
+    (``pallas_enabled("fill")``), the XLA flood otherwise.
     """
     mask = jnp.asarray(mask, bool)
     h, w = mask.shape
     if method == "auto":
         from tmlibrary_tpu import native
 
-        method = "native" if native.cpu_native_enabled() else "xla"
+        if native.cpu_native_enabled():
+            method = "native"
+        else:
+            from tmlibrary_tpu.ops.pallas_kernels import pallas_enabled
+
+            method = "pallas" if pallas_enabled("fill") else "xla"
+    if method == "pallas":
+        from tmlibrary_tpu.ops.pallas_kernels import fill_holes_flood
+
+        return fill_holes_flood(
+            mask, connectivity, interpret=jax.default_backend() == "cpu"
+        )
     if method == "native":
         import numpy as np
 

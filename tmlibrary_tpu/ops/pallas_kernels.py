@@ -282,6 +282,69 @@ def watershed_flood(
     )
 
 
+# -------------------------------------------------------------- fill holes
+def _fill_kernel(mask_ref, out_ref, *, connectivity: int, chunk: int):
+    h, w = out_ref.shape
+    mask = mask_ref[:] != 0
+    bg = ~mask
+    rows = lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    border = (rows == 0) | (rows == h - 1) | (cols == 0) | (cols == w - 1)
+    # reached-from-border flood through background; carried as int32 0/1
+    # (Mosaic cannot legalize vector<i1> while_loop carries — see the
+    # distance kernel) and OR over {0,1} is exactly max
+    reach = (bg & border).astype(jnp.int32)
+    shifts = _shifts_for(connectivity)
+
+    def step(r):
+        new = r
+        for dy, dx in shifts:
+            new = jnp.maximum(new, _shift_fill(r, dy, dx, 0, h, w))
+        return jnp.where(bg, new, 0)
+
+    def body(state):
+        r, _ = state
+        new = r
+        for _ in range(chunk):
+            new = step(new)
+        return new, jnp.any(new != r)
+
+    reach, _ = lax.while_loop(lambda s: s[1], body, (reach, jnp.bool_(True)))
+    out_ref[:] = (mask | (bg & (reach == 0))).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("connectivity", "interpret", "chunk")
+)
+def _fill_holes_jit(
+    mask: jax.Array, connectivity: int, interpret: bool, chunk: int
+) -> jax.Array:
+    h, w = mask.shape
+    return pl.pallas_call(
+        functools.partial(
+            _fill_kernel, connectivity=connectivity, chunk=chunk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((h, w), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(jnp.asarray(mask, jnp.int32))
+
+
+def fill_holes_flood(
+    mask: jax.Array, connectivity: int = 4, interpret: bool = False,
+    chunk: "int | None" = None,
+) -> jax.Array:
+    """VMEM hole filling: flood "reached" from the border through the
+    background, fill what the flood never touched — identical fixpoint
+    to the XLA path in ``ops.label.fill_holes`` (scipy
+    ``binary_fill_holes`` semantics; ``connectivity`` is the BACKGROUND
+    connectivity, 4 = complement of 8-connected foreground)."""
+    return _fill_holes_jit(
+        mask, connectivity, interpret, _resolve_chunk(chunk)
+    ) != 0
+
+
 # ------------------------------------------------------------- 3-D twins
 def _shift_fill_3d(a: jax.Array, dz: int, dy: int, dx: int, fill,
                    z: int, h: int, w: int) -> jax.Array:
@@ -554,9 +617,14 @@ def pallas_enabled(kernel: str | None = None) -> bool:
     (explicit global override) → the committed per-kernel shootout
     (``tuning/TUNING.json`` ``kernels_ms``: ``{kernel}_pallas`` vs
     ``{kernel}_xla``, when ``kernel`` is one of ``"cc"`` /
-    ``"watershed"`` / ``"distance"`` / ``"cc3d"`` / ``"watershed3d"``
-    and both timings are present) → the
-    aggregate ``pallas_wins`` verdict → off.  The per-kernel gate matters
+    ``"watershed"`` / ``"distance"`` / ``"fill"`` / ``"cc3d"`` /
+    ``"watershed3d"`` and both timings are present) → for the original
+    trio only (cc/watershed/distance — the kernels the aggregate verdict
+    was computed FROM), the aggregate ``pallas_wins`` verdict → off.
+    Kernels added after a committed tune run (fill, the 3-D twins) are
+    NEVER auto-dispatched without their own measured win: a stale
+    aggregate must not route production through a kernel that has never
+    compiled on the deployment's hardware.  The per-kernel gate matters
     because the hardware verdict is split: on TPU v5e the CC fixpoint is
     ~2.1x faster in VMEM while the watershed/distance fixpoints measured
     slightly faster as XLA loops — a single global flag would pick wrong
@@ -581,5 +649,9 @@ def pallas_enabled(kernel: str | None = None) -> bool:
         # as null — never auto-dispatch to it, even if the aggregate
         # verdict says pallas wins overall
         if t_pallas is None and f"{kernel}_pallas" in ms:
+            return False
+        # unmeasured kernel: only the original trio may ride the
+        # aggregate verdict (it was computed from exactly them)
+        if kernel not in ("cc", "watershed", "distance"):
             return False
     return bool(tuning.get("pallas_wins", False))
