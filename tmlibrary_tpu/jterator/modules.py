@@ -17,7 +17,7 @@ compile-time constant from the handle description.
 from __future__ import annotations
 
 import inspect
-from typing import Any, Callable
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -30,7 +30,7 @@ import os
 import jax
 import numpy as np
 from jax.experimental import mesh_utils, multihost_utils
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
 
 from tmlibrary_tpu.errors import ShardingError
 

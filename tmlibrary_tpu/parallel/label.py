@@ -28,7 +28,6 @@ block is the (devices x max_roots_per_shard) root table.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
