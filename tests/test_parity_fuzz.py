@@ -205,3 +205,41 @@ def test_volume_cc_parity_random_draws(seed):
             np.testing.assert_array_equal(
                 np.asarray(got), want,
                 err_msg=f"seed={seed} conn={conn} method={method}")
+
+
+# --------------------------------------------- third-party cross-checks
+@pytest.mark.parametrize("seed", range(4))
+def test_cc_count_matches_opencv_too(seed):
+    """Component counts vs BOTH scipy and OpenCV (independent lineages)
+    on random draws — connectivity 8 and 4."""
+    import cv2
+
+    rng = np.random.default_rng(6000 + seed)
+    mask = (_blob_image(rng, 96, int(rng.integers(3, 10)), 4.0) > 650)
+
+    for conn in (8, 4):
+        _, n_ours = connected_components(mask, conn, method="xla")
+        struct = ndi.generate_binary_structure(2, 2 if conn == 8 else 1)
+        n_scipy = ndi.label(mask, struct)[1]
+        n_cv = cv2.connectedComponents(
+            mask.astype(np.uint8), connectivity=conn)[0] - 1
+        assert int(n_ours) == n_scipy == n_cv, (seed, conn)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_distance_matches_opencv_chessboard(seed):
+    """Chessboard distance vs cv2.distanceTransform(DIST_C) on interior
+    pixels (borders differ by design: erosion counting treats
+    out-of-image as foreground)."""
+    import cv2
+
+    from tmlibrary_tpu.ops.segment_primary import distance_transform_approx
+
+    rng = np.random.default_rng(7000 + seed)
+    mask = _blob_image(rng, 96, 6, 6.0) > 600
+    got = np.asarray(distance_transform_approx(mask, method="xla"))
+    want = cv2.distanceTransform(
+        mask.astype(np.uint8), cv2.DIST_C, cv2.DIST_MASK_PRECISE)
+    interior = np.zeros_like(mask)
+    interior[10:-10, 10:-10] = True
+    np.testing.assert_array_equal(got[interior], want[interior])
