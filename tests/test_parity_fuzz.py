@@ -243,3 +243,26 @@ def test_distance_matches_opencv_chessboard(seed):
     interior = np.zeros_like(mask)
     interior[10:-10, 10:-10] = True
     np.testing.assert_array_equal(got[interior], want[interior])
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_otsu_matches_opencv_within_a_bin(seed):
+    """Otsu threshold vs cv2.THRESH_OTSU on uint8 draws: ours bins over
+    the data's [min, max] and returns a fractional edge while cv2 uses
+    fixed integer 0-255 bins, so agreement within ~1.5 gray levels is
+    the exact-match expectation — a real divergence would be tens of
+    levels."""
+    import cv2
+
+    from tmlibrary_tpu.ops.threshold import otsu_value
+
+    rng = np.random.default_rng(8000 + seed)
+    yy, xx = np.mgrid[0:96, 0:96].astype(np.float32)
+    img = rng.normal(80, 10, (96, 96)).astype(np.float32)
+    for _ in range(6):
+        y, x = rng.integers(10, 86, 2)
+        img += 120 * np.exp(-((yy - y) ** 2 + (xx - x) ** 2) / 18)
+    u8 = np.clip(img, 0, 255).astype(np.uint8)
+    ours = float(np.asarray(otsu_value(u8.astype(np.float32))))
+    cvt, _ = cv2.threshold(u8, 0, 255, cv2.THRESH_BINARY + cv2.THRESH_OTSU)
+    assert abs(ours - float(cvt)) <= 1.5, (seed, ours, cvt)
