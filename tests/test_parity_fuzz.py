@@ -266,3 +266,19 @@ def test_otsu_matches_opencv_within_a_bin(seed):
     ours = float(np.asarray(otsu_value(u8.astype(np.float32))))
     cvt, _ = cv2.threshold(u8, 0, 255, cv2.THRESH_BINARY + cv2.THRESH_OTSU)
     assert abs(ours - float(cvt)) <= 1.5, (seed, ours, cvt)
+
+
+@pytest.mark.parametrize("size", [3, 5])
+def test_median_matches_opencv_interior(size):
+    """Median filter vs cv2.medianBlur on uint8 (exact on interior
+    pixels; border conventions differ — scipy reflects, cv2 replicates)."""
+    import cv2
+
+    from tmlibrary_tpu.ops.smooth import median_smooth
+
+    rng = np.random.default_rng(9000 + size)
+    u8 = rng.integers(0, 256, (64, 64), np.uint8)
+    ours = np.asarray(median_smooth(u8.astype(np.float32), size))
+    cvm = cv2.medianBlur(u8, size).astype(np.float32)
+    k = size // 2
+    np.testing.assert_array_equal(ours[k:-k, k:-k], cvm[k:-k, k:-k])
