@@ -1,0 +1,146 @@
+"""Chaos suite: the full canonical pipeline under deterministic injected
+faults (``tmlibrary_tpu.faults``).
+
+The property these tests pin down is *convergence*: a run that loses
+batches to injected device/IO faults, quarantines them, and is then
+resumed must end in exactly the fault-free final state — same label
+stacks, same feature tables.  That is the contract that makes quarantine
+safe to enable by default.
+
+Marked ``chaos`` (registered in pyproject); the suite stays fast enough
+to live inside the tier-1 gate.
+"""
+
+import numpy as np
+import pytest
+
+from test_resilience import dummy_description, fast_resilience
+from test_workflow import make_description, source_dir, synth_site_image  # noqa: F401 — fixture re-export
+
+from tmlibrary_tpu import faults
+from tmlibrary_tpu.models.experiment import Experiment
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.resilience import DeviceHealthGuard, RetryPolicy
+from tmlibrary_tpu.workflow.engine import Workflow
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _make_store(tmp_path, name):
+    placeholder = Experiment(
+        name=name, plates=[], channels=[], site_height=1, site_width=1
+    )
+    return ExperimentStore.create(tmp_path / name, placeholder)
+
+
+def _chaos_description(source_dir, store):
+    """The canonical test workflow with jterator re-batched to 4 batches
+    of 4 sites, so two quarantines sit exactly at the 0.5 budget."""
+    desc = make_description(source_dir, store)
+    for stage in desc.stages:
+        for step in stage.steps:
+            if step.name == "jterator":
+                step.args["batch_size"] = 4
+    return desc
+
+
+def test_faulted_run_plus_resume_converges(tmp_path, source_dir):
+    """Device loss on jterator batch 1 and an IO fault on batch 3 (both
+    outlasting every retry) quarantine those batches; clearing the fault
+    plan and resuming must reproduce the fault-free run bit-for-bit."""
+    ref = _make_store(tmp_path, "reference")
+    Workflow(ref, _chaos_description(source_dir, ref),
+             resilience=fast_resilience()).run()
+    ref_labels = ref.read_labels(None, "nuclei")
+    ref_feats = ref.read_features("nuclei")
+
+    chaotic = _make_store(tmp_path, "chaotic")
+    faults.install(faults.FaultPlan([
+        faults.FaultSpec(site="batch_run", kind="device_loss",
+                         step="jterator", batch=1, times=99),
+        faults.FaultSpec(site="batch_run", kind="io_error",
+                         step="jterator", batch=3, times=99),
+    ], seed=7))
+    res = fast_resilience(max_batch_failures=0.5, attempts=2)
+    summary = Workflow(chaotic, _chaos_description(source_dir, chaotic),
+                       resilience=res).run()
+    # 4 jterator batches, budget floor(0.5 * 4) = 2: the run survives
+    assert summary["jterator"]["quarantined"] == [1, 3]
+    ledger = Workflow(chaotic, _chaos_description(source_dir, chaotic),
+                      resilience=res).ledger
+    failures = {e["batch"]: e for e in ledger.events()
+                if e.get("event") == "batch_failed"}
+    assert failures[1]["exception"] == "TransientDeviceError"
+    assert failures[3]["exception"] == "OSError"
+    assert faults.active().fire_counts() == {
+        "batch_run/device_loss": 2,  # attempts=2: first try + one retry
+        "batch_run/io_error": 2,
+    }
+
+    # the faults clear (relay back, disk back) — resume converges
+    faults.clear()
+    summary = Workflow(chaotic, _chaos_description(source_dir, chaotic),
+                       resilience=res).run(resume=True)
+    assert "quarantined" not in summary["jterator"]
+
+    assert np.array_equal(chaotic.read_labels(None, "nuclei"), ref_labels)
+    key = ["site_index", "label"]
+    got = chaotic.read_features("nuclei").sort_values(key).reset_index(drop=True)
+    want = ref_feats.sort_values(key).reset_index(drop=True)
+    import pandas.testing
+
+    pandas.testing.assert_frame_equal(got, want)
+
+
+def test_down_relay_probe_degrades_instead_of_hanging(tmp_path):
+    """A down TPU relay makes the device probe *hang*, not error.  The
+    guard's timeout converts the hang into breaker failures; the breaker
+    trips, the run degrades to CPU with a ``backend_degraded`` ledger
+    event, and the workflow still finishes — the pre-resilience behavior
+    was an indefinite hang."""
+    import test_resilience  # registers the dummy step  # noqa: F401
+
+    faults.install(faults.FaultPlan([
+        faults.FaultSpec(site="device_probe", kind="hang", seconds=3.0,
+                         times=99),
+    ]))
+    res = fast_resilience()
+    # default probe (jax.devices() behind the fault hook), short deadline
+    res.guard = DeviceHealthGuard(timeout=0.05, failure_threshold=1,
+                                  cooldown=3600.0)
+    store = _make_store(tmp_path, "relaydown")
+    summary = Workflow(store, dummy_description(), resilience=res).run()
+    assert summary["chaosdummy"]["n_batches"] == 4
+    assert store.workflow_dir.joinpath("ledger.jsonl").exists()
+    ev = Workflow(store, dummy_description(), resilience=res) \
+        .ledger.degraded_backend()
+    assert ev is not None and ev["backend"] == "cpu" and ev["where"] == "run"
+    assert res.guard.degraded
+
+
+def test_fault_plan_env_activation(tmp_path, monkeypatch):
+    """``TMX_FAULT_PLAN`` arms the harness without code changes — the
+    path ``scripts/chaos_run.py`` and operators use."""
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(
+        '{"seed": 3, "faults": [{"site": "batch_run", "kind": "device_loss",'
+        ' "step": "chaosdummy", "batch": 0, "times": 99}]}'
+    )
+    monkeypatch.setenv("TMX_FAULT_PLAN", str(plan_file))
+    # reset the lazy env check that clear() disarmed
+    faults._ENV_CHECKED = False
+    plan = faults.active()
+    assert plan is not None and plan.seed == 3
+    assert plan.specs[0].step == "chaosdummy"
+
+    store = _make_store(tmp_path, "envplan")
+    summary = Workflow(store, dummy_description(),
+                       resilience=fast_resilience()).run()
+    assert summary["chaosdummy"]["quarantined"] == [0]

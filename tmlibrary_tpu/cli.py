@@ -152,6 +152,28 @@ def build_parser() -> argparse.ArgumentParser:
     )
     shared.add_argument("--profile", metavar="DIR", default=None,
                         help="write a jax.profiler device trace to DIR")
+    # fault-tolerance knobs (resilience.py; defaults from LibraryConfig /
+    # TM_RETRY_ATTEMPTS, TM_MAX_BATCH_FAILURES, ... env)
+    shared.add_argument(
+        "--max-batch-failures", type=float, default=None, metavar="X",
+        help="per-step quarantine budget before the step fails: a value "
+             "< 1 is a fraction of the step's batches, >= 1 an absolute "
+             "count (default 0.5); 0 disables quarantine (first failure "
+             "aborts the step, the pre-resilience behavior)",
+    )
+    shared.add_argument(
+        "--retry-attempts", type=int, default=None, metavar="N",
+        help="total tries per batch for transient faults (1 = no retry)",
+    )
+    shared.add_argument(
+        "--retry-delay", type=float, default=None, metavar="SECONDS",
+        help="first backoff delay; doubles per retry, with jitter",
+    )
+    shared.add_argument(
+        "--probe-timeout", type=float, default=None, metavar="SECONDS",
+        help="device health probe deadline before the circuit breaker "
+             "counts a failure (a down TPU relay hangs, not errors)",
+    )
     p_submit = wf_sub.add_parser("submit", help="run the workflow",
                                  parents=[shared])
     _add_common(p_submit)
@@ -420,9 +442,16 @@ def cmd_workflow(args) -> int:
             frac = f"{done}/{total}" if total is not None else str(done)
             line = f"{step:12s} {entry['state']:8s} batches {frac} " \
                    f"({entry['elapsed']:.1f}s)"
+            if entry.get("quarantined"):
+                line += f" quarantined: {sorted(entry['quarantined'])}"
             if entry.get("error"):
                 line += f" error: {entry['error']}"
             print(line)
+        degraded = RunLedger(store.workflow_dir / "ledger.jsonl").degraded_backend()
+        if degraded:
+            print(f"backend degraded to {degraded.get('backend')} "
+                  f"(at step '{degraded.get('where')}' after "
+                  f"{degraded.get('failures')} failed device probes)")
         # tool request lifecycle (reference ToolRequestManager submissions
         # surface in the same status view the UI polls)
         for req in tool_requests:
@@ -467,9 +496,27 @@ def cmd_workflow(args) -> int:
                   "workflow.yaml in the store's workflow dir)", file=sys.stderr)
             return 1
     from tmlibrary_tpu.profiling import device_trace
+    from tmlibrary_tpu.resilience import ResilienceConfig
 
+    resilience = ResilienceConfig.from_library_config()
+    if args.max_batch_failures is not None:
+        resilience.max_batch_failures = args.max_batch_failures
+    if args.retry_attempts is not None or args.retry_delay is not None:
+        import dataclasses as _dc
+
+        resilience.policy = _dc.replace(
+            resilience.policy,
+            **{k: v for k, v in (
+                ("max_attempts", args.retry_attempts),
+                ("base_delay", args.retry_delay),
+            ) if v is not None},
+        )
+    if args.probe_timeout is not None and resilience.guard is not None:
+        resilience.guard.timeout = args.probe_timeout
     with device_trace(args.profile):
-        summary = Workflow(store, desc).run(resume=args.resume)
+        summary = Workflow(store, desc, resilience=resilience).run(
+            resume=args.resume
+        )
     print(json.dumps(summary, default=str, indent=2))
     return 0
 

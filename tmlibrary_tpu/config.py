@@ -96,6 +96,29 @@ class LibraryConfig:
     verbosity: int = dataclasses.field(
         default_factory=lambda: int(_setting("verbosity", "0"))
     )
+    # ----------------------------------------------------- fault tolerance
+    # (resilience.py / workflow engine; env: TM_RETRY_ATTEMPTS etc.)
+    #: total tries per batch (1 = no retry) for transient faults
+    retry_attempts: int = dataclasses.field(
+        default_factory=lambda: int(_setting("retry_attempts", "3"))
+    )
+    #: first backoff delay in seconds (doubles per retry, jittered)
+    retry_base_delay: float = dataclasses.field(
+        default_factory=lambda: float(_setting("retry_base_delay", "0.25"))
+    )
+    #: quarantine budget per step — fraction of batches if < 1, else count
+    max_batch_failures: float = dataclasses.field(
+        default_factory=lambda: float(_setting("max_batch_failures", "0.5"))
+    )
+    #: device health probe deadline (a down relay hangs; this bounds it)
+    device_probe_timeout: float = dataclasses.field(
+        default_factory=lambda: float(_setting("device_probe_timeout", "30"))
+    )
+    #: fsync the run ledger on every append (crash-safe, slower)
+    ledger_fsync: bool = dataclasses.field(
+        default_factory=lambda: _setting("ledger_fsync", "0").lower()
+        in ("1", "true", "yes")
+    )
 
     def experiment_location(self, experiment_name: str) -> Path:
         return Path(self.storage_home) / "experiments" / experiment_name

@@ -57,3 +57,32 @@ class WorkflowError(TmError):
 
 class ShardingError(TmError):
     """Error constructing or using a device mesh / sharding."""
+
+
+class TransientDeviceError(TmError):
+    """A device-side fault that is expected to clear on its own: the TPU
+    relay dropped, a device probe timed out, a collective was preempted,
+    or the backend reported UNAVAILABLE/DEADLINE_EXCEEDED.  The retry
+    policy treats this class (and look-alike messages from the runtime)
+    as retryable; everything data-shaped stays permanent."""
+
+
+class ProbeTimeoutError(TransientDeviceError):
+    """A device health probe did not answer within its deadline — the
+    signature of a down relay, which *hangs* instead of erroring.  Raised
+    by ``resilience.call_with_timeout``; trips the circuit breaker."""
+
+
+class FaultInjected(TmError):
+    """An artificial fault raised by the deterministic fault-injection
+    harness (``tmlibrary_tpu.faults``).  Never raised in production —
+    only when a fault plan is installed.  ``transient`` mirrors how the
+    error classifier should treat it; ``fatal=True`` simulates a hard
+    process crash the engine must NOT absorb into batch quarantine."""
+
+    def __init__(self, message: str, kind: str = "injected",
+                 transient: bool = True, fatal: bool = False):
+        super().__init__(message)
+        self.kind = kind
+        self.transient = transient
+        self.fatal = fatal

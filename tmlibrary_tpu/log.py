@@ -38,3 +38,18 @@ def configure_logging(verbosity: int = 0, stream=None) -> logging.Logger:
         handler.setFormatter(logging.Formatter(FORMAT))
         logger.addHandler(handler)
     return logger
+
+
+#: keys already warned about by :func:`warn_once` this process
+_WARNED: set = set()
+
+
+def warn_once(logger: logging.Logger, key: str, message: str, *args) -> None:
+    """Emit ``message`` at WARNING level at most once per process per
+    ``key``.  Used for conditions that re-trigger on every poll — e.g. a
+    corrupt ledger line re-read by every ``status``/``resume`` call —
+    where repeating the warning drowns the signal it carries."""
+    if key in _WARNED:
+        return
+    _WARNED.add(key)
+    logger.warning(message, *args)

@@ -1,0 +1,385 @@
+"""Fault-tolerant execution primitives: retry, classification, circuit
+breaking, and graceful CPU degradation.
+
+The workflow engine replaced GC3Pie's process fan-out with in-process
+batched device programs (DESIGN.md §1), which removed the scheduler's
+free fault isolation: one bad batch used to kill one cluster job, now it
+kills the whole step.  This module restores that isolation in-process:
+
+- :class:`RetryPolicy` — exponential backoff with deterministic seeded
+  jitter and an overall deadline.
+- :func:`classify` — splits *transient* faults (device/relay loss,
+  timeouts, IO flakes, OOM) from *permanent* ones (corrupt data, bad
+  pipeline descriptions, vendor conflicts).  Only transients retry.
+- :class:`CircuitBreaker` — consecutive-failure counter with a cooldown
+  that doubles while a dependency stays down.
+- :class:`DeviceHealthGuard` — wraps the device probe in a timeout +
+  breaker and degrades to the CPU backend when the relay is down (the
+  probe *hangs* rather than erroring — BENCH history), re-probing with
+  backoff.
+- :class:`ResilienceConfig` — the engine-facing bundle (policy, batch
+  failure threshold, guard knobs), defaulted from ``LibraryConfig``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable
+
+from tmlibrary_tpu.errors import (
+    FaultInjected,
+    JobDescriptionError,
+    MetadataError,
+    PipelineError,
+    ProbeTimeoutError,
+    RegistryError,
+    TransientDeviceError,
+    WorkflowError,
+)
+
+logger = logging.getLogger(__name__)
+
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+#: exception types that always retry
+_TRANSIENT_TYPES = (
+    TransientDeviceError,
+    TimeoutError,
+    ConnectionError,
+    BrokenPipeError,
+    InterruptedError,
+)
+
+#: exception types that never retry — retrying corrupt data or a bad
+#: description only burns the deadline re-raising the same error
+_PERMANENT_TYPES = (
+    MetadataError,  # includes VendorConflictError
+    PipelineError,
+    JobDescriptionError,
+    RegistryError,
+    WorkflowError,
+    ValueError,
+    TypeError,
+    KeyError,
+    AssertionError,
+)
+
+#: runtime error messages that signal a flaky device/relay rather than a
+#: code bug (XLA/jaxlib surface these as bare RuntimeError/XlaRuntimeError)
+_TRANSIENT_PATTERNS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "device halted",
+    "device lost",
+    "relay",
+    "connection reset",
+    "timed out",
+    "socket closed",
+    "failed to connect",
+)
+
+
+def classify(exc: BaseException) -> str:
+    """``transient`` (worth retrying) or ``permanent`` (fail fast).
+
+    Unknown errors default to PERMANENT: retrying a genuine bug hides it
+    behind backoff sleeps, while a mis-classified transient still gets a
+    second chance on ``resume``.
+    """
+    if isinstance(exc, FaultInjected):
+        return TRANSIENT if exc.transient else PERMANENT
+    if isinstance(exc, _TRANSIENT_TYPES):
+        return TRANSIENT
+    if isinstance(exc, _PERMANENT_TYPES):
+        return PERMANENT
+    if isinstance(exc, OSError):
+        # IO flake (NFS hiccup, EBUSY, disk pressure) — retryable
+        return TRANSIENT
+    if isinstance(exc, MemoryError):
+        return TRANSIENT
+    msg = str(exc).lower()
+    if any(p in msg for p in _TRANSIENT_PATTERNS):
+        return TRANSIENT
+    return PERMANENT
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff + seeded jitter + deadline.
+
+    ``max_attempts`` counts *total* tries (1 = no retry).  Jitter is a
+    symmetric fraction of the computed delay, drawn from a generator
+    seeded by ``(seed, attempt)`` so a replayed run sleeps identically.
+    """
+
+    max_attempts: int = 3
+    base_delay: float = 0.25
+    max_delay: float = 8.0
+    jitter: float = 0.25
+    deadline: float | None = None
+    seed: int = 0
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        d = min(self.max_delay, self.base_delay * (2.0 ** (attempt - 1)))
+        if self.jitter > 0 and d > 0:
+            r = random.Random(f"{self.seed}:{attempt}").uniform(-1.0, 1.0)
+            d = max(0.0, d * (1.0 + self.jitter * r))
+        return d
+
+
+@dataclasses.dataclass
+class RetryOutcome:
+    value: Any = None
+    error: BaseException | None = None
+    attempts: int = 0
+    classification: str = PERMANENT
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    policy: RetryPolicy,
+    describe: str = "call",
+    sleep: Callable[[float], None] = time.sleep,
+) -> RetryOutcome:
+    """Run ``fn`` under the policy.  Never raises: the outcome carries
+    either the value or the final exception + its classification, so the
+    caller (the engine's quarantine logic) decides what failure means."""
+    t0 = time.monotonic()
+    last: BaseException | None = None
+    cls = PERMANENT
+    for attempt in range(1, max(1, policy.max_attempts) + 1):
+        try:
+            return RetryOutcome(value=fn(), attempts=attempt)
+        except FaultInjected as e:
+            if e.fatal:
+                raise  # simulated process death — nothing may absorb it
+            last, cls = e, classify(e)
+        except Exception as e:
+            last, cls = e, classify(e)
+        if cls is PERMANENT:
+            logger.warning("%s failed permanently (%s: %s) — not retrying",
+                           describe, type(last).__name__, last)
+            break
+        if attempt >= policy.max_attempts:
+            break
+        pause = policy.delay(attempt)
+        if (policy.deadline is not None
+                and time.monotonic() - t0 + pause > policy.deadline):
+            logger.warning("%s: retry deadline (%.1fs) exhausted",
+                           describe, policy.deadline)
+            break
+        logger.warning("%s failed (%s: %s) — retry %d/%d in %.2fs",
+                       describe, type(last).__name__, last,
+                       attempt, policy.max_attempts - 1, pause)
+        sleep(pause)
+    return RetryOutcome(error=last, attempts=attempt, classification=cls)
+
+
+def call_with_timeout(fn: Callable[[], Any], timeout: float,
+                      describe: str = "call") -> Any:
+    """Run ``fn`` on a daemon thread; :class:`ProbeTimeoutError` if it
+    does not answer in time.  This is how a *hanging* dependency (a down
+    TPU relay never errors, it just stops answering) is converted into
+    an exception the classifier and breaker can act on.  The runaway
+    thread is abandoned — acceptable for probes, do not use for work
+    holding locks."""
+    box: dict[str, Any] = {}
+
+    def target():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"timeout:{describe}")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise ProbeTimeoutError(
+            f"{describe} did not answer within {timeout:.1f}s"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+class CircuitBreaker:
+    """Consecutive-failure breaker with doubling cooldown.
+
+    CLOSED → normal.  After ``failure_threshold`` consecutive failures
+    the circuit OPENs: ``allow()`` is False until ``cooldown`` elapses,
+    then one half-open probe is allowed; another failure re-opens with
+    the cooldown doubled (capped), a success closes it.
+    """
+
+    def __init__(self, failure_threshold: int = 3, cooldown: float = 30.0,
+                 max_cooldown: float = 600.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.failure_threshold = max(1, failure_threshold)
+        self.base_cooldown = cooldown
+        self.max_cooldown = max_cooldown
+        self._clock = clock
+        self.failures = 0
+        self.opened_at: float | None = None
+        self.cooldown = cooldown
+
+    @property
+    def state(self) -> str:
+        if self.opened_at is None:
+            return "closed"
+        if self._clock() - self.opened_at >= self.cooldown:
+            return "half-open"
+        return "open"
+
+    def allow(self) -> bool:
+        return self.state != "open"
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self.opened_at = None
+        self.cooldown = self.base_cooldown
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self.opened_at is not None:
+            # a failed half-open probe: re-open and back off harder
+            self.cooldown = min(self.max_cooldown, self.cooldown * 2.0)
+            self.opened_at = self._clock()
+        elif self.failures >= self.failure_threshold:
+            self.opened_at = self._clock()
+
+
+def _default_probe() -> bool:
+    """A cheap end-to-end device-path check: enumerating devices is the
+    exact call that hangs when the relay is down."""
+    from tmlibrary_tpu import faults
+
+    faults.maybe_fire("device_probe")
+    import jax
+
+    return len(jax.devices()) > 0
+
+
+class DeviceHealthGuard:
+    """Probe-with-timeout + breaker + CPU fallback.
+
+    ``ensure_backend(ledger)`` is called by the engine at run start and
+    before each step.  While healthy it costs one cached probe per
+    ``probe_ttl`` seconds.  When probes fail/hang past the breaker
+    threshold it *degrades*: pins the backend to CPU (honoring the same
+    in-process override the CLI's ``TMX_PLATFORM`` uses) and logs a
+    ``backend_degraded`` ledger event — the run continues slower instead
+    of hanging for hours.  Half-open re-probes keep checking whether the
+    device came back, with doubling backoff.
+    """
+
+    def __init__(self, probe: Callable[[], Any] | None = None,
+                 timeout: float = 30.0, probe_ttl: float = 60.0,
+                 failure_threshold: int = 2, cooldown: float = 60.0):
+        self.probe = probe or _default_probe
+        self.timeout = timeout
+        self.probe_ttl = probe_ttl
+        self.breaker = CircuitBreaker(failure_threshold=failure_threshold,
+                                      cooldown=cooldown)
+        self.degraded = False
+        self._last_ok: float | None = None
+
+    def healthy(self) -> bool:
+        """One guarded probe (no caching, no side effects on backends)."""
+        try:
+            call_with_timeout(self.probe, self.timeout, "device probe")
+        except Exception as e:  # noqa: BLE001 — any probe failure counts
+            logger.warning("device probe failed: %s: %s",
+                           type(e).__name__, e)
+            self.breaker.record_failure()
+            return False
+        self.breaker.record_success()
+        self._last_ok = time.monotonic()
+        return True
+
+    def ensure_backend(self, ledger=None, where: str = "run") -> str:
+        """Return the backend to use now (``device`` or ``cpu``),
+        probing as the breaker/TTL allow and degrading on a tripped
+        circuit."""
+        if self.degraded:
+            if self.breaker.allow() and self.healthy():
+                # device came back: stay degraded for THIS run (mixing
+                # backends mid-run risks divergent numerics) but stop
+                # re-probing
+                logger.info("device recovered; next run will use it")
+            return "cpu"
+        if (self._last_ok is not None
+                and time.monotonic() - self._last_ok < self.probe_ttl):
+            return "device"
+        # probe until the breaker trips or a probe answers
+        while not self.healthy():
+            if not self.breaker.allow():
+                self._degrade(ledger, where)
+                return "cpu"
+        return "device"
+
+    def _degrade(self, ledger, where: str) -> None:
+        self.degraded = True
+        logger.error(
+            "device path is down (breaker open after %d failures) — "
+            "degrading to the CPU backend", self.breaker.failures,
+        )
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            # backends already initialized: the override cannot take
+            # effect in-process; surfaced in the ledger either way
+            logger.warning("could not re-pin jax_platforms in-process")
+        if ledger is not None:
+            ledger.append(event="backend_degraded", backend="cpu",
+                          where=where, failures=self.breaker.failures)
+
+
+@dataclasses.dataclass
+class ResilienceConfig:
+    """Engine-facing bundle of fault-tolerance knobs.
+
+    ``max_batch_failures``: values in [0, 1) are a *fraction* of the
+    step's batches; values >= 1 are an absolute count.  A step fails only
+    when quarantined batches exceed this threshold.
+    """
+
+    policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
+    max_batch_failures: float = 0.5
+    guard: DeviceHealthGuard | None = None
+    enabled: bool = True
+
+    def failure_budget(self, n_batches: int) -> int:
+        if self.max_batch_failures < 1.0:
+            return int(self.max_batch_failures * n_batches)
+        return int(self.max_batch_failures)
+
+    @classmethod
+    def from_library_config(cls) -> "ResilienceConfig":
+        from tmlibrary_tpu.config import cfg
+
+        return cls(
+            policy=RetryPolicy(
+                max_attempts=cfg.retry_attempts,
+                base_delay=cfg.retry_base_delay,
+            ),
+            max_batch_failures=cfg.max_batch_failures,
+            guard=DeviceHealthGuard(timeout=cfg.device_probe_timeout),
+        )

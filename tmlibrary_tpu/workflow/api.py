@@ -132,9 +132,16 @@ class Step(abc.ABC):
             pkg.setLevel(prev_level)
 
     # -------------------------------------------------------------- collect
-    def collect(self) -> dict:
+    def collect(self, results: list[dict] | None = None) -> dict:
         """Merge phase after all batches ran (reference ``collect_job``).
-        Default: nothing to merge."""
+        Default: nothing to merge.
+
+        Steps that declare a ``results`` parameter receive the batch
+        result summaries that *survived* the run — under fault quarantine
+        (``resilience.py``) that may be fewer than the planned batches, so
+        a merge that assumes completeness can check instead of silently
+        producing a short table.  Legacy ``collect(self)`` overrides are
+        still called without arguments by the engine."""
         return {}
 
     # ----------------------------------------------------------- idempotence

@@ -19,16 +19,29 @@ reference's contract.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import inspect
 import json
 import logging
+import os
 import time
 from pathlib import Path
 from typing import Any
 
 import yaml
 
-from tmlibrary_tpu.errors import WorkflowError
+from tmlibrary_tpu import faults
+from tmlibrary_tpu.errors import FaultInjected, WorkflowError
+from tmlibrary_tpu.log import warn_once
 from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.resilience import (
+    PERMANENT,
+    ResilienceConfig,
+    RetryOutcome,
+    RetryPolicy,
+    classify,
+    retry_call,
+)
 from tmlibrary_tpu.workflow.registry import get_step, list_steps
 
 logger = logging.getLogger(__name__)
@@ -174,23 +187,50 @@ class WorkflowDescription:
 
 class RunLedger:
     """Append-only JSON-lines event log (replaces the reference's
-    ``Submission``/``Task`` tables)."""
+    ``Submission``/``Task`` tables).
 
-    def __init__(self, path: Path):
+    ``fsync=True`` makes every append crash-durable at the cost of one
+    fsync per event; without it a crash mid-append can leave a truncated
+    trailing line, which :meth:`events` skips with a warning instead of
+    poisoning every later ``resume``/``status`` call."""
+
+    def __init__(self, path: Path, fsync: bool = False):
         self.path = Path(path)
+        self.fsync = fsync
 
     def append(self, **event) -> None:
         event["ts"] = time.time()
+        line = json.dumps(event)
+        spec = faults.match("ledger_append", step=event.get("step"),
+                            event=event.get("event"))
         with open(self.path, "a") as f:
-            f.write(json.dumps(event) + "\n")
+            if spec is not None:
+                # simulate the process dying mid-write: half a line, no
+                # newline, then the injected crash propagates
+                f.write(line[: max(1, len(line) // 2)])
+                f.flush()
+                faults.raise_for(spec, "ledger_append", event)
+            f.write(line + "\n")
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
 
     def events(self) -> list[dict]:
         if not self.path.exists():
             return []
         out = []
-        for line in self.path.read_text().splitlines():
-            if line.strip():
+        for lineno, line in enumerate(self.path.read_text().splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError:
+                warn_once(
+                    logger, f"{self.path}:{lineno}",
+                    "ledger %s line %d is not valid JSON (crash mid-append?)"
+                    " — skipping it; resume treats the event as never "
+                    "recorded", str(self.path), lineno,
+                )
         return out
 
     def completed_steps(self) -> set[str]:
@@ -208,6 +248,28 @@ class RunLedger:
                 done.clear()
         return done
 
+    def quarantined_batches(self, step: str) -> set[int]:
+        """Batches recorded ``batch_failed`` and not completed since; a
+        re-init clears the set like it clears completions."""
+        q: set[int] = set()
+        for e in self.events():
+            if e.get("step") != step:
+                continue
+            if e.get("event") == "batch_failed":
+                q.add(e["batch"])
+            elif e.get("event") == "batch_done":
+                q.discard(e["batch"])
+            elif e.get("event") == "init_done":
+                q.clear()
+        return q
+
+    def last_description_hash(self) -> str | None:
+        h = None
+        for e in self.events():
+            if e.get("event") == "run_started":
+                h = e.get("description_hash", h)
+        return h
+
     def status(self) -> dict[str, Any]:
         steps: dict[str, dict] = {}
         for e in self.events():
@@ -216,14 +278,21 @@ class RunLedger:
                 continue
             entry = steps.setdefault(
                 s, {"state": "pending", "batches_done": 0, "n_batches": None,
-                    "elapsed": 0.0}
+                    "elapsed": 0.0, "quarantined": []}
             )
             if e["event"] == "init_done":
                 entry.update(state="running", n_batches=e.get("n_batches"),
-                             batches_done=0)
+                             batches_done=0, quarantined=[])
             elif e["event"] == "batch_done":
                 entry["batches_done"] += 1
                 entry["elapsed"] += e.get("elapsed", 0.0)
+                if e.get("batch") in entry["quarantined"]:
+                    entry["quarantined"].remove(e["batch"])
+            elif e["event"] == "batch_failed":
+                if e.get("batch") not in entry["quarantined"]:
+                    entry["quarantined"].append(e.get("batch"))
+            elif e["event"] == "step_partial":
+                entry["state"] = "partial"
             elif e["event"] == "step_done":
                 entry["state"] = "done"
             elif e["event"] == "step_failed":
@@ -231,22 +300,71 @@ class RunLedger:
                 entry["error"] = e.get("error")
         return steps
 
+    def degraded_backend(self) -> dict | None:
+        """The most recent ``backend_degraded`` event, if any."""
+        last = None
+        for e in self.events():
+            if e.get("event") == "backend_degraded":
+                last = e
+        return last
+
 
 class Workflow:
-    """Execute a workflow description against an experiment store."""
+    """Execute a workflow description against an experiment store.
 
-    def __init__(self, store: ExperimentStore, description: WorkflowDescription):
+    Fault tolerance (``resilience.py``): each batch runs under the retry
+    policy; a batch that keeps failing is *quarantined* (a
+    ``batch_failed`` ledger event) while the step continues, and the
+    step only fails once quarantined batches exceed the configured
+    budget.  ``resume`` re-attempts quarantined batches first.  A device
+    health guard probes the device path before every step and degrades
+    to the CPU backend when the relay is down."""
+
+    def __init__(self, store: ExperimentStore,
+                 description: WorkflowDescription,
+                 resilience: ResilienceConfig | None = None):
+        from tmlibrary_tpu.config import cfg
+
         description.validate()
         self.store = store
         self.description = description
-        self.ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+        self.ledger = RunLedger(store.workflow_dir / "ledger.jsonl",
+                                fsync=cfg.ledger_fsync)
+        self.resilience = (resilience if resilience is not None
+                           else ResilienceConfig.from_library_config())
 
+    # ------------------------------------------------------------- identity
+    def description_hash(self) -> str:
+        """Stable digest of the whole workflow description, recorded in
+        ``run_started`` so resume detects drift anywhere in the plan —
+        not just in the per-step ``args`` the batch files capture."""
+        canon = json.dumps(self.description.to_dict(), sort_keys=True)
+        return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+    # ------------------------------------------------------------------ run
     def run(self, resume: bool = False) -> dict:
         """Run all active steps in order; with ``resume=True`` skip completed
         steps and completed batches of the interrupted step (reference
         ``resume`` CLI verb backed by DB task state)."""
         if not resume and self.ledger.path.exists():
             self.ledger.path.unlink()
+        desc_hash = self.description_hash()
+        if resume:
+            prev = self.ledger.last_description_hash()
+            if prev is not None and prev != desc_hash:
+                logger.warning(
+                    "resume: workflow description changed since the last "
+                    "run (%s -> %s) — steps whose args changed will "
+                    "re-plan; review the plan if that is unexpected",
+                    prev, desc_hash,
+                )
+                self.ledger.append(event="description_drift",
+                                   previous=prev, current=desc_hash)
+        self.ledger.append(event="run_started", description_hash=desc_hash,
+                           resume=resume)
+        guard = self.resilience.guard if self.resilience.enabled else None
+        if guard is not None:
+            guard.ensure_backend(self.ledger, where="run")
         done_steps = self.ledger.completed_steps() if resume else set()
         summary = {}
         for stage in self.description.stages:
@@ -256,18 +374,115 @@ class Workflow:
                 if sd.name in done_steps:
                     logger.info("resume: skipping completed step %s", sd.name)
                     continue
+                if guard is not None:
+                    guard.ensure_backend(self.ledger, where=sd.name)
                 summary[sd.name] = self._run_step(sd, resume)
         return summary
 
+    # ---------------------------------------------------------- batch level
+    def _exec_batch(self, step, batch: dict) -> dict:
+        faults.maybe_fire("batch_run", step=step.name, batch=batch["index"])
+        return step.run_batch(batch)
+
+    def _retry_after(self, step, batch: dict, first_exc: Exception,
+                     policy: RetryPolicy) -> RetryOutcome:
+        """Fold an already-observed failure into the retry budget and run
+        the remaining attempts sequentially."""
+        cls = classify(first_exc)
+        if cls is PERMANENT or policy.max_attempts <= 1:
+            return RetryOutcome(error=first_exc, attempts=1,
+                                classification=cls)
+        remaining = dataclasses.replace(
+            policy, max_attempts=policy.max_attempts - 1
+        )
+        out = retry_call(
+            lambda: self._exec_batch(step, batch), remaining,
+            describe=f"{step.name} batch {batch['index']}",
+        )
+        out.attempts += 1
+        return out
+
+    def _iter_outcomes(self, step, pending: list[dict],
+                       policy: RetryPolicy):
+        """Yield ``(batch, RetryOutcome)`` for every pending batch.
+
+        Prefers the step's pipelined runner (host IO in the shadow of
+        device compute); after a pipeline fault the failing batch is
+        retried and the remainder degrades to sequential execution —
+        per-batch isolation beats overlap once the device is flaky.
+        With a fault plan armed the sequential path is used from the
+        start, so injected faults fire *before* a batch persists (the
+        pipelined runner persists a batch before the engine sees it)."""
+        gen = None
+        if (hasattr(step, "run_batches_pipelined") and pending
+                and faults.active() is None):
+            gen = iter(step.run_batches_pipelined(pending))
+        pos = 0
+        while pos < len(pending):
+            if gen is not None:
+                try:
+                    batch, result = next(gen)
+                except StopIteration:
+                    break
+                except Exception as e:
+                    if isinstance(e, FaultInjected) and e.fatal:
+                        raise
+                    # the pipeline died mid-flight: the first unyielded
+                    # batch is the one it was working on
+                    logger.warning(
+                        "%s: pipelined runner failed at batch %d — "
+                        "degrading to sequential execution",
+                        step.name, pending[pos]["index"],
+                    )
+                    gen = None
+                    yield pending[pos], self._retry_after(
+                        step, pending[pos], e, policy
+                    )
+                    pos += 1
+                    continue
+                yield batch, RetryOutcome(value=result, attempts=1)
+                pos += 1
+            else:
+                batch = pending[pos]
+                try:
+                    yield batch, RetryOutcome(
+                        value=self._exec_batch(step, batch), attempts=1
+                    )
+                except Exception as e:
+                    if isinstance(e, FaultInjected) and e.fatal:
+                        raise
+                    yield batch, self._retry_after(step, batch, e, policy)
+                pos += 1
+
+    @staticmethod
+    def _call_collect(step, results: list[dict]):
+        """Pass the surviving batch results to ``collect`` when the step
+        accepts them (newer signature); legacy ``collect(self)`` steps
+        keep working."""
+        try:
+            params = inspect.signature(step.collect).parameters
+        except (TypeError, ValueError):
+            params = {}
+        if "results" in params:
+            return step.collect(results=results)
+        return step.collect()
+
+    # ----------------------------------------------------------- step level
     def _run_step(self, sd: WorkflowStepDescription, resume: bool) -> dict:
         step_cls = get_step(sd.name)
         step = step_cls(self.store)
+        res = self.resilience
+        policy = (res.policy if res.enabled
+                  else RetryPolicy(max_attempts=1, base_delay=0.0))
         t0 = time.time()
+        current_batch: int | None = None
         try:
             existing = step.list_batches() if resume else []
+            quarantined: set[int] = set()
             if existing:
                 batches = [step.load_batch(i) for i in existing]
                 done = self.ledger.completed_batches(sd.name)
+                quarantined = self.ledger.quarantined_batches(sd.name)
                 # if the description's args changed since the batches were
                 # planned, the old plan is stale — re-init from scratch
                 if batches and step.batch_args.resolve(sd.args) != batches[0]["args"]:
@@ -277,29 +492,90 @@ class Workflow:
                 batches = step.init(sd.args)
                 batches = [step.load_batch(i) for i in range(len(batches))]
                 done = set()
+                quarantined = set()
                 self.ledger.append(step=sd.name, event="init_done",
                                    n_batches=len(batches))
-            results = []
             pending = [b for b in batches if b["index"] not in done]
-            if hasattr(step, "run_batches_pipelined"):
-                # device-async pipelining: host IO of adjacent batches runs
-                # in the shadow of device compute (see the step's docstring)
-                runs = step.run_batches_pipelined(pending)
-            else:
-                runs = ((b, step.run_batch(b)) for b in pending)
+            # quarantined batches first: the most suspect work re-runs at
+            # the start of the resume, while everything else still follows
+            pending.sort(key=lambda b: (b["index"] not in quarantined,
+                                        b["index"]))
+            if quarantined:
+                logger.info("resume: re-attempting quarantined batches %s "
+                            "of %s first", sorted(quarantined), sd.name)
+            results: list[dict] = []
+            failed: list[dict] = []
+            budget = res.failure_budget(len(batches)) if res.enabled else 0
             bt0 = time.time()
             with step.capture_logs("run"):  # per-step log file (§6)
-                for batch, result in runs:
-                    self.ledger.append(step=sd.name, event="batch_done",
-                                       batch=batch["index"],
-                                       elapsed=time.time() - bt0, result=result)
-                    results.append(result)
+                for batch, outcome in self._iter_outcomes(step, pending, policy):
+                    current_batch = batch["index"]
+                    if outcome.ok:
+                        self.ledger.append(step=sd.name, event="batch_done",
+                                           batch=batch["index"],
+                                           elapsed=time.time() - bt0,
+                                           attempts=outcome.attempts,
+                                           result=outcome.value)
+                        results.append(outcome.value)
+                        bt0 = time.time()
+                        continue
+                    failure = {
+                        "batch": batch["index"],
+                        "error": str(outcome.error),
+                        "exception": type(outcome.error).__name__,
+                        "attempts": outcome.attempts,
+                        "classification": outcome.classification,
+                    }
+                    self.ledger.append(step=sd.name, event="batch_failed",
+                                       **failure)
+                    failed.append(failure)
                     bt0 = time.time()
-                # collect is part of the step execution the log file covers
-                collected = step.collect()
+                    if len(failed) > budget:
+                        raise WorkflowError(
+                            f"step '{sd.name}': {len(failed)} failed "
+                            f"batches exceeds the quarantine budget "
+                            f"({budget} of {len(batches)})"
+                        ) from outcome.error
+                    logger.error(
+                        "%s: batch %d quarantined after %d attempt(s) "
+                        "(%s: %s) — step continues (%d/%d budget used)",
+                        sd.name, batch["index"], outcome.attempts,
+                        failure["exception"], failure["error"],
+                        len(failed), budget,
+                    )
+                # collect is part of the step execution the log file
+                # covers; it sees only the surviving results
+                collected = self._call_collect(step, results)
+            if failed:
+                # no step_done: resume re-attempts the quarantined
+                # batches first, then re-collects
+                self.ledger.append(
+                    step=sd.name, event="step_partial",
+                    elapsed=time.time() - t0, collected=collected,
+                    quarantined=sorted(f["batch"] for f in failed),
+                )
+                return {"n_batches": len(batches), "collected": collected,
+                        "quarantined": sorted(f["batch"] for f in failed)}
             self.ledger.append(step=sd.name, event="step_done",
                                elapsed=time.time() - t0, collected=collected)
             return {"n_batches": len(batches), "collected": collected}
+        except FaultInjected as e:
+            if e.fatal:
+                raise  # simulated hard crash: no further ledger writes
+            self.ledger.append(step=sd.name, event="step_failed",
+                               error=str(e), exception=type(e).__name__,
+                               batch=current_batch)
+            raise WorkflowError(f"step '{sd.name}' failed: {e}") from e
+        except WorkflowError as e:
+            # e.g. the quarantine budget overflow above; keep the original
+            # exception class visible in the ledger via __cause__
+            self.ledger.append(step=sd.name, event="step_failed",
+                               error=str(e),
+                               exception=type(e.__cause__ or e).__name__,
+                               batch=current_batch)
+            raise
         except Exception as e:
-            self.ledger.append(step=sd.name, event="step_failed", error=str(e))
+            self.ledger.append(step=sd.name, event="step_failed",
+                               error=str(e), exception=type(e).__name__,
+                               batch=current_batch)
             raise WorkflowError(f"step '{sd.name}' failed: {e}") from e
