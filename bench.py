@@ -58,36 +58,20 @@ CACHE_PATH = os.environ.get(
 )
 
 
-def tuning_json_path() -> str:
-    """ONE definition of the tuning-results location (and its rehearsal
-    redirect) shared by bench, tune_tpu, tpu_watch and
-    update_baseline_table — resolved at call time so env changes (the
-    rehearsal bootstrap) take effect without re-imports."""
-    return os.environ.get(
-        "TMX_TUNING_JSON", os.path.join(REPO, "tuning", "TUNING.json")
-    )
+# ONE definition of the tuning artifact path + provenance gate, now in the
+# installable package (tmlibrary_tpu.tuning) because the production engine
+# consumes the tuned defaults too; re-exported here so tune_tpu, tpu_watch
+# and update_baseline_table keep importing them from bench
+from tmlibrary_tpu.tuning import load_tuning as _load_tuning  # noqa: E402
+from tmlibrary_tpu.tuning import tuning_json_path  # noqa: E402,F401
 
 
 def profile_json_path() -> str:
-    """Same contract for the per-stage profile capture."""
+    """Same env-redirect contract as ``tuning_json_path`` for the
+    per-stage profile capture."""
     return os.environ.get(
         "TMX_PROFILE_JSON", os.path.join(REPO, "tuning", "PROFILE_TPU.json")
     )
-
-
-def _load_tuning() -> "dict | None":
-    """The machine-written tuning verdict, or None.  ONE provenance gate
-    for every tuned default: only a file ``tune_tpu.py write_results``
-    itself produced counts (the round-2 hand-seeded file is rejected).
-    ``TMX_TUNING_JSON`` redirects the file (watcher rehearsal)."""
-    try:
-        with open(tuning_json_path()) as f:
-            tuning = json.load(f)
-    except (OSError, ValueError):
-        return None
-    if "SMOKE(" in str(tuning.get("timing_methodology", "")):
-        return None  # dry-run sweep artifacts never set production defaults
-    return tuning if "written_by" in tuning else None
 
 
 def _tuned_batch(config: str) -> "int | None":
@@ -307,9 +291,10 @@ def measure(platform: str) -> None:
     the result JSON line."""
     import jax
 
+    from tmlibrary_tpu.config import cfg
     from tmlibrary_tpu.utils import enable_compilation_cache
 
-    enable_compilation_cache()
+    enable_compilation_cache(cfg.compile_cache_dir or None)
 
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
@@ -1045,6 +1030,12 @@ def measure_workflow(size: int) -> None:
                 )
                 assert ok, "fixture TIFF write failed"
 
+        # the engine's own pipelined executor runs the measurement — the
+        # bench records the depth the production path actually used
+        # (BENCH_PIPELINE overrides; device backends default to the
+        # tuning sweep's best_pipeline)
+        pdepth = _pipeline_depth(jax.default_backend())
+
         def build_workflow(root: str) -> Workflow:
             placeholder = Experiment(
                 name="bench_wf", plates=[], channels=[],
@@ -1068,7 +1059,7 @@ def measure_workflow(size: int) -> None:
                     "max_objects": max_objects, "n_devices": 1,
                 },
             })
-            return Workflow(store, desc)
+            return Workflow(store, desc, pipeline_depth=pdepth)
 
         # rep 0 is the warm-up (same geometry → the timed reps hit the
         # compiled-program caches exactly like steady-state production)
@@ -1176,7 +1167,10 @@ def measure_workflow(size: int) -> None:
         "batch": batch_size,
         "stage_seconds": stage_s,
         "objects": counts,
-        **_ledger_fields(None, max_objects),
+        "executor": "engine",
+        # depth 1 is the sequential engine path — record it as
+        # host-synchronous, same as the pre-executor bench did
+        **_ledger_fields(pdepth if pdepth > 1 else None, max_objects),
     }
     print(json.dumps(record), flush=True)
 

@@ -125,6 +125,64 @@ def test_down_relay_probe_degrades_instead_of_hanging(tmp_path):
     assert res.guard.degraded
 
 
+def test_pipelined_quarantine_resume_converges(tmp_path, source_dir,
+                                               monkeypatch):
+    """Depth > 1 does not weaken the fault model: with ``TMX_FAULT_PLAN``
+    armed the engine forces the sequential path (injected faults must
+    land before a batch persists), quarantines the faulted batches, and
+    a resume at ``pipeline_depth=4`` — now genuinely pipelined — still
+    converges bit-for-bit to the fault-free reference."""
+    ref = _make_store(tmp_path, "pipe_reference")
+    Workflow(ref, _chaos_description(source_dir, ref),
+             resilience=fast_resilience()).run()
+    ref_labels = ref.read_labels(None, "nuclei")
+    ref_feats = ref.read_features("nuclei")
+
+    plan_file = tmp_path / "pipe_plan.json"
+    plan_file.write_text(
+        '{"seed": 11, "faults": ['
+        '{"site": "batch_run", "kind": "device_loss",'
+        ' "step": "jterator", "batch": 1, "times": 99},'
+        '{"site": "batch_run", "kind": "io_error",'
+        ' "step": "jterator", "batch": 3, "times": 99}]}'
+    )
+    monkeypatch.setenv("TMX_FAULT_PLAN", str(plan_file))
+    faults._ENV_CHECKED = False  # re-arm the lazy env check
+    assert faults.active() is not None
+
+    chaotic = _make_store(tmp_path, "pipe_chaotic")
+    res = fast_resilience(max_batch_failures=0.5, attempts=2)
+    summary = Workflow(chaotic, _chaos_description(source_dir, chaotic),
+                       resilience=res, pipeline_depth=4).run()
+    assert summary["jterator"]["quarantined"] == [1, 3]
+    wf = Workflow(chaotic, _chaos_description(source_dir, chaotic),
+                  resilience=res, pipeline_depth=4)
+    partial = [e for e in wf.ledger.events()
+               if e.get("event") == "step_partial"
+               and e.get("step") == "jterator"]
+    # the armed plan forced the sequential path: no executor, no stats
+    assert partial and "pipeline_stats" not in partial[0]
+
+    # faults clear (relay back): resume runs the quarantined batches
+    # through the REAL pipelined executor at depth 4 and converges
+    monkeypatch.delenv("TMX_FAULT_PLAN")
+    faults.clear()
+    summary = wf.run(resume=True)
+    assert "quarantined" not in summary["jterator"]
+    done = [e for e in wf.ledger.events()
+            if e.get("event") == "step_done" and e.get("step") == "jterator"]
+    assert done and done[-1]["pipeline_stats"]["depth"] == 4
+    assert done[-1]["pipeline_stats"]["source"] == "cli"
+
+    assert np.array_equal(chaotic.read_labels(None, "nuclei"), ref_labels)
+    key = ["site_index", "label"]
+    got = chaotic.read_features("nuclei").sort_values(key).reset_index(drop=True)
+    want = ref_feats.sort_values(key).reset_index(drop=True)
+    import pandas.testing
+
+    pandas.testing.assert_frame_equal(got, want)
+
+
 def test_fault_plan_env_activation(tmp_path, monkeypatch):
     """``TMX_FAULT_PLAN`` arms the harness without code changes — the
     path ``scripts/chaos_run.py`` and operators use."""

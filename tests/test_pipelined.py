@@ -1,0 +1,437 @@
+"""Deep pipelined batch executor (``workflow/pipelined.py``).
+
+Two layers of guarantees:
+
+- Executor mechanics on a fake step: yields stay in submission order, a
+  mid-window launch failure drains the WHOLE window before propagating
+  (regression: flushing only the previous batch dropped completed
+  batches' ledger events at depth > 1), HBM exhaustion halves the depth
+  and retries instead of failing, and the depth/source resolution obeys
+  the cli > config > tuning > default precedence.
+- Bit-identity on the real jterator step: the pipelined executor at
+  depths 2/4/8 must persist exactly the sequential path's label stacks
+  and feature tables, for BOTH the sites and the spatial layout — the
+  property that makes deep pipelining safe to enable by default.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_workflow import (  # noqa: F401 — fixture re-export
+    make_description,
+    source_dir,
+    store,
+    synth_site_image,
+)
+
+from tmlibrary_tpu.profiling import PipelineStats
+from tmlibrary_tpu.workflow.engine import Workflow
+from tmlibrary_tpu.workflow.pipelined import (
+    PipelinedExecutor,
+    is_resource_exhausted,
+    prefetch_iter,
+    resolve_pipeline_depth,
+    supports_pipelining,
+)
+
+
+# --------------------------------------------------------------- fake step
+class FakeStep:
+    """Minimal launch/persist step: records call order and thread names,
+    optionally failing a launch (once or forever) to exercise the drain
+    and clamp paths."""
+
+    name = "fake"
+
+    def __init__(self, fail_at=None, fail_exc=None, fail_times=1):
+        self.fail_at = fail_at
+        self.fail_exc = fail_exc or ValueError("launch failed")
+        self.fail_remaining = fail_times
+        self.launched: list[int] = []
+        self.persisted: list[int] = []
+        self.prefetch_threads: list[str] = []
+
+    def prefetch_batch(self, batch):
+        self.prefetch_threads.append(threading.current_thread().name)
+        return {"loaded": batch["index"]}
+
+    def launch_batch(self, batch, prefetched=None):
+        i = batch["index"]
+        if i == self.fail_at and self.fail_remaining > 0:
+            self.fail_remaining -= 1
+            raise self.fail_exc
+        self.launched.append(i)
+        if prefetched is not None:
+            assert prefetched == {"loaded": i}
+        return batch, {"payload": i * 10}
+
+    def persist_batch(self, batch, ctx):
+        self.persisted.append(batch["index"])
+        return {"value": ctx["payload"], "index": batch["index"]}
+
+
+def _batches(n):
+    return [{"index": i} for i in range(n)]
+
+
+def test_supports_pipelining_detection():
+    assert supports_pipelining(FakeStep())
+
+    class Legacy:
+        def run_batch(self, batch):
+            return {}
+
+    assert not supports_pipelining(Legacy())
+
+
+def test_executor_yields_in_order_with_prefetch():
+    step = FakeStep()
+    ex = PipelinedExecutor(step, depth=4)
+    out = list(ex.run(_batches(10)))
+    assert [b["index"] for b, _ in out] == list(range(10))
+    assert [r["value"] for _, r in out] == [i * 10 for i in range(10)]
+    # dispatch stays on the calling thread in batch order
+    assert step.launched == list(range(10))
+    # one persist worker drains in submission order
+    assert step.persisted == list(range(10))
+    # prefetch really ran on the worker pool, once per batch
+    assert len(step.prefetch_threads) == 10
+    assert all(t.startswith("tmx-prefetch") for t in step.prefetch_threads)
+
+
+def test_midwindow_launch_failure_drains_whole_window():
+    """Regression: with depth 4 the window holds batches 0 and 1 un-yielded
+    when batch 2's launch dies; BOTH must come out (so the engine ledgers
+    their ``batch_done``) before the failure propagates — the old code
+    flushed only the immediately-previous batch."""
+    step = FakeStep(fail_at=2, fail_exc=ValueError("boom"), fail_times=99)
+    ex = PipelinedExecutor(step, depth=4)
+    gen = ex.run(_batches(6))
+    yielded = []
+    with pytest.raises(ValueError, match="boom"):
+        for b, r in gen:
+            yielded.append(b["index"])
+    assert yielded == [0, 1]
+    assert step.persisted == [0, 1]
+    # nothing past the failure launched
+    assert step.launched == [0, 1]
+
+
+def test_oom_clamps_depth_and_retries():
+    """RESOURCE_EXHAUSTED at depth > 1 is a pressure signal, not a step
+    failure: the window drains, the depth halves, a ``depth_clamped``
+    event fires, and the failed batch retries at the lower depth."""
+    step = FakeStep(
+        fail_at=3,
+        fail_exc=RuntimeError("RESOURCE_EXHAUSTED: out of memory (HBM)"),
+        fail_times=1,
+    )
+    events = []
+    stats = PipelineStats(8, "cli")
+    ex = PipelinedExecutor(
+        step, depth=8, depth_source="cli",
+        on_event=lambda **ev: events.append(ev), stats=stats,
+    )
+    out = list(ex.run(_batches(6)))
+    assert [b["index"] for b, _ in out] == list(range(6))
+    assert step.persisted == list(range(6))
+    assert events == [{
+        "event": "depth_clamped", "from_depth": 8, "to_depth": 4,
+        "batch": 3, "error": "RESOURCE_EXHAUSTED: out of memory (HBM)",
+    }]
+    summary = stats.summary()
+    assert summary["depth"] == 4
+    assert summary["depth_clamps"] == [{"from": 8, "to": 4}]
+    assert summary["n_batches"] == 6
+
+
+def test_oom_at_depth_one_propagates():
+    """Depth 1 has nothing left to clamp: memory pressure is a real
+    failure and must surface to the engine's retry/quarantine path."""
+    step = FakeStep(fail_at=1, fail_exc=MemoryError("host OOM"),
+                    fail_times=99)
+    ex = PipelinedExecutor(step, depth=1)
+    yielded = []
+    with pytest.raises(MemoryError):
+        for b, _ in ex.run(_batches(4)):
+            yielded.append(b["index"])
+    assert yielded == [0]
+
+
+def test_non_oom_failure_never_clamps():
+    step = FakeStep(fail_at=2, fail_exc=OSError("disk gone"), fail_times=99)
+    events = []
+    ex = PipelinedExecutor(step, depth=4,
+                           on_event=lambda **ev: events.append(ev))
+    with pytest.raises(OSError):
+        list(ex.run(_batches(5)))
+    assert events == []
+
+
+def test_is_resource_exhausted_classifier():
+    assert is_resource_exhausted(MemoryError())
+    assert is_resource_exhausted(RuntimeError("RESOURCE_EXHAUSTED: ..."))
+    assert is_resource_exhausted(RuntimeError("Resource exhausted: HBM"))
+    assert is_resource_exhausted(RuntimeError("ran Out of Memory on chip"))
+    assert not is_resource_exhausted(ValueError("bad geometry"))
+    assert not is_resource_exhausted(OSError("connection reset"))
+
+
+# ----------------------------------------------------------- prefetch_iter
+def test_prefetch_iter_preserves_order():
+    done = []
+
+    def load(i):
+        # later items finish FIRST: order must still be preserved
+        time.sleep(0.02 * (5 - i))
+        done.append(i)
+        return i * 2
+
+    assert list(prefetch_iter(range(5), load, depth=5)) == [0, 2, 4, 6, 8]
+
+
+def test_prefetch_iter_exception_surfaces_in_position():
+    def load(i):
+        if i == 3:
+            raise OSError("read failed")
+        return i
+
+    got = []
+    with pytest.raises(OSError, match="read failed"):
+        for v in prefetch_iter(range(6), load, depth=4):
+            got.append(v)
+    assert got == [0, 1, 2]
+
+
+def test_prefetch_iter_single_item_short_circuits():
+    # no pool spin-up for a single chunk
+    assert list(prefetch_iter([7], lambda x: x + 1)) == [8]
+    assert list(prefetch_iter([], lambda x: x)) == []
+
+
+# --------------------------------------------------------- depth resolution
+@pytest.fixture
+def _clean_depth_env(monkeypatch, tmp_path):
+    """Hermetic resolution: no ambient env/INI/tuning artifacts."""
+    monkeypatch.delenv("TM_PIPELINE_DEPTH", raising=False)
+    monkeypatch.setenv("TM_CONFIG_FILE", str(tmp_path / "absent.cfg"))
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tmp_path / "absent.json"))
+    return tmp_path
+
+
+def _write_tuning(path, methodology="median-of-3 steady-state", **extra):
+    path.write_text(json.dumps({
+        "best_batch": 128, "best_pipeline": 16,
+        "written_by": "scripts/tune_tpu.py write_results",
+        "timing_methodology": methodology, **extra,
+    }))
+
+
+def test_resolve_depth_explicit_wins(_clean_depth_env, monkeypatch):
+    monkeypatch.setenv("TM_PIPELINE_DEPTH", "5")
+    assert resolve_pipeline_depth(explicit=3, backend="tpu") == (3, "cli")
+
+
+def test_resolve_depth_config_beats_tuning(_clean_depth_env, monkeypatch):
+    tuning = _clean_depth_env / "TUNING.json"
+    _write_tuning(tuning)
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning))
+    monkeypatch.setenv("TM_PIPELINE_DEPTH", "5")
+    assert resolve_pipeline_depth(backend="tpu") == (5, "config")
+
+
+def test_resolve_depth_tuning_on_device_backend(_clean_depth_env, monkeypatch):
+    tuning = _clean_depth_env / "TUNING.json"
+    _write_tuning(tuning)
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning))
+    assert resolve_pipeline_depth(backend="tpu") == (16, "tuning")
+    # the sweep measured the device: CPU keeps its own safe default
+    assert resolve_pipeline_depth(backend="cpu") == (2, "default")
+
+
+def test_resolve_depth_defaults_without_tuning(_clean_depth_env):
+    assert resolve_pipeline_depth(backend="tpu") == (8, "default")
+    assert resolve_pipeline_depth(backend="cpu") == (2, "default")
+
+
+def test_resolve_depth_rejects_smoke_tuning(_clean_depth_env, monkeypatch):
+    """Dry-run (SMOKE) sweep artifacts never set production defaults."""
+    tuning = _clean_depth_env / "TUNING.json"
+    _write_tuning(tuning, methodology="SMOKE(dry-run, 1 repeat)")
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning))
+    assert resolve_pipeline_depth(backend="tpu") == (8, "default")
+
+
+def test_resolve_depth_rejects_unprovenanced_tuning(
+    _clean_depth_env, monkeypatch
+):
+    tuning = _clean_depth_env / "TUNING.json"
+    tuning.write_text(json.dumps({"best_pipeline": 16}))  # hand-seeded
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning))
+    assert resolve_pipeline_depth(backend="tpu") == (8, "default")
+
+
+# ---------------------------------------------------- bit-identity: sites
+def _run_prep_steps(desc, store):
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    for name in ("metaconfig", "imextract", "corilla"):
+        sd = next(s for stage in desc.stages for s in stage.steps
+                  if s.name == name)
+        step = get_step(name)(store)
+        step.init(sd.args)
+        for j in step.list_batches():
+            step.run(j)
+
+
+def _read_features_sorted(store, name):
+    return (store.read_features(name)
+            .sort_values(["site_index", "label"])
+            .reset_index(drop=True))
+
+
+def test_sites_layout_bit_identical_across_depths(source_dir, store):
+    """The engine executor at depths 2/4/8 persists exactly the sequential
+    path's label stacks AND feature tables (16 sites in 8 batches of 2)."""
+    import pandas.testing
+
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    desc = make_description(source_dir, store)
+    _run_prep_steps(desc, store)
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    args = {**jd.args, "batch_size": 2}  # 16 sites -> 8 batches
+
+    jt = get_step("jterator")(store)
+    jt.init(args)
+    for j in jt.list_batches():
+        jt.run(j)
+    ref_labels = store.read_labels(None, "nuclei").copy()
+    ref_feats = _read_features_sorted(store, "nuclei")
+
+    for depth in (2, 4, 8):
+        jt2 = get_step("jterator")(store)
+        jt2.delete_previous_output()
+        jt2.init(args)
+        batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+        out = list(PipelinedExecutor(jt2, depth=depth).run(batches))
+        assert [b["index"] for b, _ in out] == list(range(8))
+        assert all(r["n_sites"] == 2 for _, r in out)
+        assert np.array_equal(store.read_labels(None, "nuclei"), ref_labels), \
+            f"labels diverged at depth {depth}"
+        pandas.testing.assert_frame_equal(
+            _read_features_sorted(store, "nuclei"), ref_feats
+        )
+
+
+# -------------------------------------------------- bit-identity: spatial
+@pytest.fixture
+def spatial_store(tmp_path, devices):
+    """Two wells of 2x2 50px sites (site indices 0-3 and 4-7), each well a
+    100x100 mosaic with blobs straddling site seams."""
+    from tmlibrary_tpu.models.experiment import grid_experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    exp = grid_experiment(
+        "pipespatial", well_rows=1, well_cols=2, sites_per_well=(2, 2),
+        channel_names=("DAPI",), site_shape=(50, 50),
+    )
+    st = ExperimentStore.create(tmp_path / "pipespatial_exp", exp)
+    rng = np.random.default_rng(23)
+    yy, xx = np.mgrid[0:100, 0:100]
+    tiles, sites = [], []
+    for w, centers in enumerate(
+        [[(50, 50), (20, 24), (80, 70)], [(48, 52), (75, 20), (25, 80)]]
+    ):
+        mosaic = rng.normal(300, 15, (100, 100))
+        for cy, cx in centers:
+            mosaic += 4000 * np.exp(
+                -((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 3.5**2)
+            )
+        mosaic = np.clip(mosaic, 0, 65535).astype(np.uint16)
+        tiles += [mosaic[0:50, 0:50], mosaic[0:50, 50:100],
+                  mosaic[50:100, 0:50], mosaic[50:100, 50:100]]
+        sites += [w * 4 + i for i in range(4)]
+    st.write_sites(np.stack(tiles), sites, channel=0)
+    return st
+
+
+def test_spatial_layout_bit_identical_across_depths(spatial_store):
+    """One batch per well: the pipelined executor overlaps well B's stitch
+    with well A's device segmentation, and the persisted global-id label
+    stacks must stay bit-identical to the sequential run."""
+    import pandas.testing
+
+    from tmlibrary_tpu.workflow.registry import get_step
+
+    st = spatial_store
+    args = {"layout": "spatial", "n_devices": 8}
+    jt = get_step("jterator")(st)
+    jt.init(args)
+    for j in jt.list_batches():
+        jt.run(j)
+    ref_labels = st.read_labels(None, "mosaic_cells").copy()
+    ref_feats = _read_features_sorted(st, "mosaic_cells")
+    assert ref_labels.max() > 0  # segmentation found the blobs
+
+    for depth in (2, 4):
+        jt2 = get_step("jterator")(st)
+        jt2.delete_previous_output()
+        jt2.init(args)
+        batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+        out = list(PipelinedExecutor(jt2, depth=depth).run(batches))
+        assert [b["index"] for b, _ in out] == [0, 1]
+        assert all(r["layout"] == "spatial" for _, r in out)
+        assert np.array_equal(
+            st.read_labels(None, "mosaic_cells"), ref_labels
+        ), f"mosaic labels diverged at depth {depth}"
+        pandas.testing.assert_frame_equal(
+            _read_features_sorted(st, "mosaic_cells"), ref_feats
+        )
+
+
+# ------------------------------------------------------------ engine wiring
+def test_engine_records_pipeline_stats_in_ledger(source_dir, store):
+    """A full engine run drives jterator through the pipelined executor
+    and lands the phase timers in the ``step_done`` ledger event (and
+    ``status()``), with the explicitly requested depth marked ``cli``."""
+    desc = make_description(source_dir, store)
+    wf = Workflow(store, desc, pipeline_depth=2)
+    wf.run()
+
+    done = [e for e in wf.ledger.events()
+            if e.get("event") == "step_done" and e.get("step") == "jterator"]
+    assert len(done) == 1
+    ps = done[0]["pipeline_stats"]
+    assert ps["depth"] == 2
+    assert ps["source"] == "cli"
+    assert ps["n_batches"] == 2  # 16 sites / batch_size 8
+    assert set(ps["phases"]) >= {"dispatch", "device_block", "persist"}
+    for phase in ps["phases"].values():
+        assert phase["total_s"] >= 0.0
+        assert phase["max_s"] <= phase["total_s"] + 1e-9
+
+    status = wf.ledger.status()
+    assert status["jterator"]["pipeline_stats"]["depth"] == 2
+    # steps without the launch/persist split carry no stats
+    assert "pipeline_stats" not in status["metaconfig"]
+
+
+def test_engine_ledger_batch_order_preserved(source_dir, store):
+    """Pipelined ``batch_done`` events keep batch-index order — resume
+    replay depends on it."""
+    desc = make_description(source_dir, store)
+    for stage in desc.stages:
+        for step in stage.steps:
+            if step.name == "jterator":
+                step.args["batch_size"] = 4  # 4 batches
+    wf = Workflow(store, desc, pipeline_depth=4)
+    wf.run()
+    order = [e["batch"] for e in wf.ledger.events()
+             if e.get("event") == "batch_done" and e.get("step") == "jterator"]
+    assert order == [0, 1, 2, 3]

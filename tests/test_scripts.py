@@ -38,7 +38,11 @@ def _watch(monkeypatch, tmp_path, cache=None, tuning=None):
     monkeypatch.setattr(tpu_watch, "TUNING_PATH", str(tuning_path))
     monkeypatch.setattr(tpu_watch, "PROFILE_PATH",
                         str(tmp_path / "tuning" / "PROFILE_TPU.json"))
-    # bench's tuned defaults read the repo TUNING.json via bench.REPO
+    # bench's tuned defaults resolve the tuning artifact through
+    # tmlibrary_tpu.tuning.tuning_json_path(), whose rehearsal redirect
+    # is the TMX_TUNING_JSON env var (bench.REPO only covers the
+    # profile/cache paths that still live in bench.py)
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning_path))
     monkeypatch.setattr(bench, "REPO", str(tmp_path))
     return tpu_watch
 

@@ -14,9 +14,18 @@ import pathlib
 import subprocess
 import sys
 
+import pytest
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
+# The full rehearsal replays the tune:pipeline sweep (three bench
+# subprocesses at depths 4/8/16), bench:3, profile and the BASELINE
+# render — minutes of wall clock, ~30% of the tier-1 time budget for a
+# single test.  The watcher's queue/capture logic stays under tier-1 via
+# the stubbed fast paths in test_scripts.py; the end-to-end replay runs
+# with the slow suite.
+@pytest.mark.slow
 def test_watch_rehearsal_captures_priority_queue(tmp_path):
     env = {
         k: v for k, v in os.environ.items()

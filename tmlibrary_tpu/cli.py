@@ -152,6 +152,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     shared.add_argument("--profile", metavar="DIR", default=None,
                         help="write a jax.profiler device trace to DIR")
+    shared.add_argument(
+        "--pipeline-depth", type=int, default=None, metavar="N",
+        help="in-flight device batches for the pipelined executor "
+             "(default: TM_PIPELINE_DEPTH / config, else the tuning "
+             "sweep's best_pipeline on device backends, else a safe "
+             "per-backend default; 1 = minimal overlap)",
+    )
     # fault-tolerance knobs (resilience.py; defaults from LibraryConfig /
     # TM_RETRY_ATTEMPTS, TM_MAX_BATCH_FAILURES, ... env)
     shared.add_argument(
@@ -447,6 +454,18 @@ def cmd_workflow(args) -> int:
             if entry.get("error"):
                 line += f" error: {entry['error']}"
             print(line)
+            ps = entry.get("pipeline_stats")
+            if ps:
+                phases = " ".join(
+                    f"{ph}={v['total_s']:.2f}s"
+                    for ph, v in ps.get("phases", {}).items()
+                )
+                print(f"{'':12s} pipeline depth {ps.get('depth')} "
+                      f"({ps.get('source')}) over {ps.get('n_batches')} "
+                      f"batches: {phases}")
+            for clamp in entry.get("depth_clamps", []):
+                print(f"{'':12s} depth clamped {clamp.get('from')} -> "
+                      f"{clamp.get('to')} (resource exhausted)")
         degraded = RunLedger(store.workflow_dir / "ledger.jsonl").degraded_backend()
         if degraded:
             print(f"backend degraded to {degraded.get('backend')} "
@@ -514,7 +533,8 @@ def cmd_workflow(args) -> int:
     if args.probe_timeout is not None and resilience.guard is not None:
         resilience.guard.timeout = args.probe_timeout
     with device_trace(args.profile):
-        summary = Workflow(store, desc, resilience=resilience).run(
+        summary = Workflow(store, desc, resilience=resilience,
+                           pipeline_depth=args.pipeline_depth).run(
             resume=args.resume
         )
     print(json.dumps(summary, default=str, indent=2))
@@ -954,9 +974,13 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", platform)
     args = build_parser().parse_args(argv)
     configure_logging(getattr(args, "verbosity", 0))
+    from tmlibrary_tpu.config import cfg
     from tmlibrary_tpu.utils import enable_compilation_cache
 
-    enable_compilation_cache()
+    # install config (TM_COMPILE_CACHE_DIR / INI) can pin the persistent
+    # cache location, e.g. shared scratch on a pod host; unset, the helper
+    # falls back to TMX_COMPILE_CACHE_DIR then ~/.cache
+    enable_compilation_cache(cfg.compile_cache_dir or None)
     try:
         if args.command == "create":
             return cmd_create(args)

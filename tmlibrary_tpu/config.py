@@ -119,6 +119,18 @@ class LibraryConfig:
         default_factory=lambda: _setting("ledger_fsync", "0").lower()
         in ("1", "true", "yes")
     )
+    # ------------------------------------------------------- pipelining
+    #: in-flight batch window for the pipelined executor; 0 = auto
+    #: (tuning/TUNING.json best_pipeline on device backends, else a safe
+    #: per-backend default — see workflow/pipelined.resolve_pipeline_depth)
+    pipeline_depth: int = dataclasses.field(
+        default_factory=lambda: int(_setting("pipeline_depth", "0"))
+    )
+    #: persistent JAX compilation cache directory; "" = the library
+    #: default under ~/.cache (utils.enable_compilation_cache)
+    compile_cache_dir: str = dataclasses.field(
+        default_factory=lambda: _setting("compile_cache_dir", "")
+    )
 
     def experiment_location(self, experiment_name: str) -> Path:
         return Path(self.storage_home) / "experiments" / experiment_name

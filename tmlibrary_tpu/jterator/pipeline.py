@@ -29,6 +29,7 @@ from tmlibrary_tpu.errors import PipelineError
 from tmlibrary_tpu.jterator import modules as module_registry
 from tmlibrary_tpu.jterator.description import PipelineDescription
 from tmlibrary_tpu.ops import image_ops
+from tmlibrary_tpu.parallel.compat import shard_map
 
 
 #: process-level compiled-program cache for the sites-layout batch fn
@@ -310,7 +311,7 @@ class ImageAnalysisPipeline:
         # shard_map (carry starts unvarying, body output is varying).
         # The program is embarrassingly parallel — no collectives, so
         # the replication check has nothing to protect.
-        mapped = jax.shard_map(
+        mapped = shard_map(
             batched,
             mesh=mesh,
             in_specs=(P(axis), P(), P(axis)),

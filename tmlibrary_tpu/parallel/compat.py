@@ -1,0 +1,59 @@
+"""JAX version compatibility for the sharding layer.
+
+The parallel modules are written against the current ``jax.shard_map``
+API (top-level export, ``check_vma`` flag, ``lax.pcast`` for marking
+values device-varying).  Older JAX (< 0.6) ships the same machinery as
+``jax.experimental.shard_map`` with the replication checker spelled
+``check_rep`` and no varying-axis typing at all.  These wrappers are the
+ONE place that difference lives, so every ``shard_map`` program in the
+library runs unchanged on either line.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+_HAS_TOP_LEVEL = hasattr(jax, "shard_map")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the current keyword surface on any JAX.
+
+    On old JAX the replication checker is always disabled rather than
+    mapped from ``check_vma``: these programs satisfy the modern
+    varying-axis checker, but the legacy ``check_rep`` analysis predates
+    it and rejects some valid all_gather/fold patterns (false
+    positives) — and it is purely advisory for correctness.
+    """
+    if _HAS_TOP_LEVEL:
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
+def axis_size(name) -> int:
+    """Static size of a named mesh axis inside a ``shard_map`` body.
+    ``lax.axis_size`` where it exists; on old JAX the axis environment
+    frame carries the same static int."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(name)
+    from jax._src.core import axis_frame
+
+    return axis_frame(name)
+
+
+def pcast_varying(x, names):
+    """Mark ``x`` device-varying over ``names`` where the vma type system
+    exists; identity on old JAX (no varying-axis typing to satisfy —
+    the value is already per-device inside ``shard_map``)."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, names, to="varying")
+    return x

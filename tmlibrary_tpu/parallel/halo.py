@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
+from tmlibrary_tpu.parallel.compat import axis_size, shard_map
+
 from tmlibrary_tpu.errors import ShardingError
 
 
@@ -30,7 +32,7 @@ def halo_exchange(block: jax.Array, halo: int, axis_name: str) -> jax.Array:
     ``mode='symmetric'`` pad (the scipy-compatible boundary the ops use).
     Returns ``(rows + 2*halo, W)``.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     # neighbor edges travel one hop down/up the ring
     from_prev = lax.ppermute(
@@ -61,7 +63,7 @@ def halo_exchange_2d(
     ``mode='symmetric'`` pad.  Returns ``(rows + 2*halo, cols + 2*halo)``.
     """
     ext = halo_exchange(block, halo, row_axis)
-    n = lax.axis_size(col_axis)
+    n = axis_size(col_axis)
     idx = lax.axis_index(col_axis)
     from_prev = lax.ppermute(
         ext[:, -halo:], col_axis, [(i, (i + 1) % n) for i in range(n)]
@@ -100,7 +102,7 @@ def sharded_halo_map_2d(
         out = fn(extended)
         return out[halo:-halo, halo:-halo]
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(row_axis, col_axis),
@@ -121,7 +123,7 @@ def _cached_gaussian_halo_2d(mesh: Mesh, sigma: float, radius: int,
         extended = halo_exchange_2d(block, radius, row_axis, col_axis)
         return gaussian_smooth(extended, sigma)[radius:-radius, radius:-radius]
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(row_axis, col_axis),
@@ -177,7 +179,7 @@ def sharded_halo_map(
         out = fn(extended)
         return out[halo:-halo]
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(axis),
@@ -196,7 +198,7 @@ def _cached_gaussian_halo(mesh: Mesh, sigma: float, radius: int, axis: str):
         extended = halo_exchange(block, radius, axis)
         return gaussian_smooth(extended, sigma)[radius:-radius]
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(axis),
@@ -231,7 +233,7 @@ def sharded_downsample_2x(image: jax.Array, mesh: Mesh, axis: str = "rows") -> j
             f"rows {h} must split into even-sized shards over {n} devices"
         )
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         downsample_2x,
         mesh=mesh,
         in_specs=PartitionSpec(axis),

@@ -37,6 +37,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from tmlibrary_tpu.errors import ShardingError
 from tmlibrary_tpu.ops.label import _propagate_min, _run_min_scan
+from tmlibrary_tpu.parallel.compat import axis_size, pcast_varying, shard_map
 
 _BIG = jnp.iinfo(jnp.int32).max
 
@@ -58,7 +59,7 @@ def _local_fixpoint(labels, mask, connectivity, axis_name=None):
         # output (vma typing); axis_name may be one name or a tuple (the
         # 2-D spatial layout is varying over both mesh axes)
         names = (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
-        init_flag = lax.pcast(init_flag, names, to="varying")
+        init_flag = pcast_varying(init_flag, names)
     out, _ = lax.while_loop(lambda s: s[1], body, (labels, init_flag))
     return out
 
@@ -185,7 +186,7 @@ def _cc_1d_program(mesh, rows, w, connectivity, k, axis):
         overflow = lax.pmax(n_local, axis)
         return out, count, overflow
 
-    return jax.shard_map(
+    return shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(axis),
@@ -211,7 +212,7 @@ def _edge_extend(vec_lab, vec_msk, other_axis):
             jnp.concatenate([pad_l, vec_lab, pad_l]),
             jnp.concatenate([pad_m, vec_msk, pad_m]),
         )
-    n = lax.axis_size(other_axis)
+    n = axis_size(other_axis)
     idx = lax.axis_index(other_axis)
     right = [(i, (i + 1) % n) for i in range(n)]
     left = [(i, (i - 1) % n) for i in range(n)]
@@ -231,7 +232,7 @@ def _seam_join_2d_axis(labels, mask, axis_name, other_axis, connectivity):
     ``axis_name``, with the exchanged rows corner-extended along
     ``other_axis`` so diagonal adjacencies across four-shard corners are
     seen.  Transpose the block to reuse this for column seams."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     down = [(i, (i + 1) % n) for i in range(n)]
     up = [(i, (i - 1) % n) for i in range(n)]
@@ -354,7 +355,7 @@ def distributed_connected_components_2d(
         overflow = lax.pmax(n_local, axes)
         return out, count, overflow
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(row_axis, col_axis),
@@ -458,7 +459,7 @@ def _halo1_zero(x, axis_name):
     ``_shift_with_fill(…, 0)``, unlike :func:`halo.halo_exchange`'s
     symmetric reflection).  Returns ``(rows + 2, cols)``.  Shared by the
     1-D and 2-D sharded adopt steps — one home for the border rule."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     down = [(i, (i + 1) % n) for i in range(n)]
     up = [(i, (i - 1) % n) for i in range(n)]
@@ -566,7 +567,7 @@ def distributed_watershed_from_seeds_2d(
         labels = flood(labels, mask_b)
         return jnp.where(mask_b, labels, 0)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -669,7 +670,7 @@ def distributed_watershed_from_seeds(
         labels = flood(labels, mask_b)
         return jnp.where(mask_b, labels, 0)
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=(PartitionSpec(axis), PartitionSpec(axis), PartitionSpec(axis)),

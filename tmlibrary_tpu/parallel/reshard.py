@@ -21,6 +21,8 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
+from tmlibrary_tpu.parallel.compat import shard_map
+
 from tmlibrary_tpu.errors import ShardingError
 
 
@@ -46,7 +48,7 @@ def sites_to_rows(batch: jax.Array, mesh: Mesh, axis: str = "sites") -> jax.Arra
         # split rows into n bands and exchange: concat sites, keep own band
         return lax.all_to_all(block, axis, split_axis=1, concat_axis=0, tiled=True)
 
-    out = jax.shard_map(
+    out = shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(axis),
@@ -63,7 +65,7 @@ def rows_to_sites(batch: jax.Array, mesh: Mesh, axis: str = "sites") -> jax.Arra
     def body(block):  # block: (B, H/n, W)
         return lax.all_to_all(block, axis, split_axis=0, concat_axis=1, tiled=True)
 
-    out = jax.shard_map(
+    out = shard_map(
         body,
         mesh=mesh,
         in_specs=PartitionSpec(None, axis),

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
+from tmlibrary_tpu.parallel.compat import pcast_varying, shard_map
+
 from tmlibrary_tpu.ops.stats import (
     WelfordState,
     welford_finalize,
@@ -34,7 +36,7 @@ def _scan_and_merge(stack_shard: jax.Array, axis: str) -> WelfordState:
     # the scan carry must be marked device-varying to satisfy shard_map's
     # varying-axis check (each shard accumulates different values)
     init = jax.tree.map(
-        lambda x: lax.pcast(x, (axis,), to="varying"),
+        lambda x: pcast_varying(x, (axis,)),
         welford_init(stack_shard.shape[1:]),
     )
     local = welford_scan(stack_shard, init)
@@ -56,7 +58,7 @@ def sharded_welford(stack: jax.Array, mesh: Mesh, axis: str = "sites") -> Welfor
     """Merged :class:`WelfordState` over a (B, H, W) stack sharded on the
     leading axis.  ``B`` must be divisible by the mesh size (the workflow
     layer plans batches that way)."""
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_scan_and_merge, axis=axis),
         mesh=mesh,
         in_specs=PartitionSpec(axis),

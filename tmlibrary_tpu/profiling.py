@@ -11,7 +11,74 @@ TPU can be inspected with TensorBoard/XProf.
 from __future__ import annotations
 
 import contextlib
+import threading
 from pathlib import Path
+
+#: pipeline phases in execution order; keys of ``PipelineStats.summary()``
+PIPELINE_PHASES = ("prefetch_wait", "dispatch", "device_block", "persist")
+
+
+class PipelineStats:
+    """Per-batch phase timers for the pipelined batch executor.
+
+    Each batch flows through up to four phases — waiting on the prefetch
+    worker (``prefetch_wait``), async device dispatch on the main thread
+    (``dispatch``), blocking on device arrays (``device_block``) and
+    host-side writes (``persist``) — and the executor records each
+    duration here.  The summary lands in the ``step_done`` ledger event
+    as ``pipeline_stats`` and in ``tmx … status``, so a stalled pipeline
+    (device starved on prefetch, or persist eating the window) is
+    diagnosable from the ledger alone, without an XProf trace.
+
+    Thread-safe: dispatch timings come from the main thread while
+    device-block/persist timings come from persist workers.
+    """
+
+    def __init__(self, depth: int, source: str = "explicit"):
+        self.depth = int(depth)
+        self.source = source
+        self._lock = threading.Lock()
+        self._total = {phase: 0.0 for phase in PIPELINE_PHASES}
+        self._max = {phase: 0.0 for phase in PIPELINE_PHASES}
+        self._count = {phase: 0 for phase in PIPELINE_PHASES}
+        self._batches = 0
+        self._clamps: list[dict] = []
+
+    def record(self, phase: str, seconds: float) -> None:
+        with self._lock:
+            self._total[phase] += seconds
+            self._count[phase] += 1
+            if seconds > self._max[phase]:
+                self._max[phase] = seconds
+
+    def batch_done(self) -> None:
+        with self._lock:
+            self._batches += 1
+
+    def record_clamp(self, from_depth: int, to_depth: int) -> None:
+        with self._lock:
+            self._clamps.append({"from": int(from_depth), "to": int(to_depth)})
+            self.depth = int(to_depth)
+
+    def summary(self) -> dict:
+        """JSON-ready roll-up for the run ledger."""
+        with self._lock:
+            out = {
+                "depth": self.depth,
+                "source": self.source,
+                "n_batches": self._batches,
+                "phases": {
+                    phase: {
+                        "total_s": round(self._total[phase], 4),
+                        "max_s": round(self._max[phase], 4),
+                    }
+                    for phase in PIPELINE_PHASES
+                    if self._count[phase]
+                },
+            }
+            if self._clamps:
+                out["depth_clamps"] = list(self._clamps)
+            return out
 
 
 @contextlib.contextmanager

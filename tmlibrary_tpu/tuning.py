@@ -1,0 +1,63 @@
+"""Machine-written tuning defaults (``tuning/TUNING.json``).
+
+The hardware sweep (``scripts/tune_tpu.py``) writes its verdict —
+``best_batch`` for the segment+measure chain and ``best_pipeline`` for the
+fetch-amortization depth — into ``tuning/TUNING.json``.  This module is the
+ONE runtime consumer shared by the production engine (the pipelined batch
+executor's default depth, jterator's auto batch size) and ``bench.py``
+(which re-exports these loaders so the watcher scripts keep one definition
+of the artifact path).
+
+Provenance gate: only a file ``tune_tpu.py write_results`` itself produced
+counts.  Hand-seeded or dry-run (``SMOKE``) artifacts never set production
+defaults — a tuned default the hardware never measured is worse than a
+static one.  ``TMX_TUNING_JSON`` redirects the file (watcher rehearsal).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+
+def tuning_json_path() -> str:
+    """ONE definition of the tuning-results location (and its rehearsal
+    redirect) — resolved at call time so env changes take effect without
+    re-imports."""
+    return os.environ.get(
+        "TMX_TUNING_JSON",
+        str(Path(__file__).resolve().parent.parent / "tuning" / "TUNING.json"),
+    )
+
+
+def load_tuning() -> dict | None:
+    """The machine-written tuning verdict, or None when absent, unreadable,
+    or failing the provenance gate (no ``written_by``, or a SMOKE dry-run
+    methodology)."""
+    try:
+        with open(tuning_json_path()) as f:
+            tuning = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if "SMOKE(" in str(tuning.get("timing_methodology", "")):
+        return None  # dry-run sweep artifacts never set production defaults
+    return tuning if "written_by" in tuning else None
+
+
+def _positive_int(value) -> int | None:
+    if isinstance(value, (int, float)) and int(value) > 0:
+        return int(value)
+    return None
+
+
+def tuned_pipeline_depth() -> int | None:
+    """The hardware-swept ``best_pipeline`` in-flight depth, or None."""
+    tuning = load_tuning()
+    return _positive_int(tuning.get("best_pipeline")) if tuning else None
+
+
+def tuned_batch_size() -> int | None:
+    """The hardware-swept ``best_batch`` site batch, or None."""
+    tuning = load_tuning()
+    return _positive_int(tuning.get("best_batch")) if tuning else None

@@ -42,6 +42,12 @@ from tmlibrary_tpu.resilience import (
     classify,
     retry_call,
 )
+from tmlibrary_tpu.profiling import PipelineStats
+from tmlibrary_tpu.workflow.pipelined import (
+    PipelinedExecutor,
+    resolve_pipeline_depth,
+    supports_pipelining,
+)
 from tmlibrary_tpu.workflow.registry import get_step, list_steps
 
 logger = logging.getLogger(__name__)
@@ -293,11 +299,19 @@ class RunLedger:
                     entry["quarantined"].append(e.get("batch"))
             elif e["event"] == "step_partial":
                 entry["state"] = "partial"
+                if e.get("pipeline_stats"):
+                    entry["pipeline_stats"] = e["pipeline_stats"]
             elif e["event"] == "step_done":
                 entry["state"] = "done"
+                if e.get("pipeline_stats"):
+                    entry["pipeline_stats"] = e["pipeline_stats"]
             elif e["event"] == "step_failed":
                 entry["state"] = "failed"
                 entry["error"] = e.get("error")
+            elif e["event"] == "depth_clamped":
+                entry.setdefault("depth_clamps", []).append(
+                    {"from": e.get("from_depth"), "to": e.get("to_depth")}
+                )
         return steps
 
     def degraded_backend(self) -> dict | None:
@@ -322,7 +336,8 @@ class Workflow:
 
     def __init__(self, store: ExperimentStore,
                  description: WorkflowDescription,
-                 resilience: ResilienceConfig | None = None):
+                 resilience: ResilienceConfig | None = None,
+                 pipeline_depth: int | None = None):
         from tmlibrary_tpu.config import cfg
 
         description.validate()
@@ -332,6 +347,9 @@ class Workflow:
                                 fsync=cfg.ledger_fsync)
         self.resilience = (resilience if resilience is not None
                            else ResilienceConfig.from_library_config())
+        #: explicit in-flight depth for the pipelined executor; None means
+        #: resolve per step (config > tuning > per-backend default)
+        self.pipeline_depth = pipeline_depth
 
     # ------------------------------------------------------------- identity
     def description_hash(self) -> str:
@@ -403,18 +421,30 @@ class Workflow:
         return out
 
     def _iter_outcomes(self, step, pending: list[dict],
-                       policy: RetryPolicy):
+                       policy: RetryPolicy,
+                       pstats: PipelineStats | None = None):
         """Yield ``(batch, RetryOutcome)`` for every pending batch.
 
-        Prefers the step's pipelined runner (host IO in the shadow of
-        device compute); after a pipeline fault the failing batch is
-        retried and the remainder degrades to sequential execution —
-        per-batch isolation beats overlap once the device is flaky.
-        With a fault plan armed the sequential path is used from the
-        start, so injected faults fire *before* a batch persists (the
-        pipelined runner persists a batch before the engine sees it)."""
+        Prefers the deep pipelined executor (``pstats`` carries the
+        resolved depth) for steps exposing the launch/persist split, then
+        the step's own ``run_batches_pipelined`` generator; after a
+        pipeline fault the failing batch is retried and the remainder
+        degrades to sequential execution — per-batch isolation beats
+        overlap once the device is flaky.  With a fault plan armed the
+        sequential path is used from the start, so injected faults fire
+        *before* a batch persists (the pipelined paths persist a batch
+        before the engine sees it)."""
         gen = None
-        if (hasattr(step, "run_batches_pipelined") and pending
+        if pstats is not None and pending:
+            executor = PipelinedExecutor(
+                step, depth=pstats.depth, depth_source=pstats.source,
+                on_event=lambda **ev: self.ledger.append(
+                    step=step.name, **ev
+                ),
+                stats=pstats,
+            )
+            gen = executor.run(pending)
+        elif (hasattr(step, "run_batches_pipelined") and pending
                 and faults.active() is None):
             gen = iter(step.run_batches_pipelined(pending))
         pos = 0
@@ -506,9 +536,21 @@ class Workflow:
             results: list[dict] = []
             failed: list[dict] = []
             budget = res.failure_budget(len(batches)) if res.enabled else 0
+            pstats = None
+            if (pending and supports_pipelining(step)
+                    and faults.active() is None):
+                depth, source = resolve_pipeline_depth(
+                    explicit=self.pipeline_depth
+                )
+                pstats = PipelineStats(depth, source)
+                logger.info(
+                    "%s: pipelined executor, in-flight depth %d (source: "
+                    "%s)", sd.name, depth, source,
+                )
             bt0 = time.time()
             with step.capture_logs("run"):  # per-step log file (§6)
-                for batch, outcome in self._iter_outcomes(step, pending, policy):
+                for batch, outcome in self._iter_outcomes(step, pending,
+                                                          policy, pstats):
                     current_batch = batch["index"]
                     if outcome.ok:
                         self.ledger.append(step=sd.name, event="batch_done",
@@ -546,6 +588,8 @@ class Workflow:
                 # collect is part of the step execution the log file
                 # covers; it sees only the surviving results
                 collected = self._call_collect(step, results)
+            extra = ({"pipeline_stats": pstats.summary()}
+                     if pstats is not None else {})
             if failed:
                 # no step_done: resume re-attempts the quarantined
                 # batches first, then re-collects
@@ -553,11 +597,13 @@ class Workflow:
                     step=sd.name, event="step_partial",
                     elapsed=time.time() - t0, collected=collected,
                     quarantined=sorted(f["batch"] for f in failed),
+                    **extra,
                 )
                 return {"n_batches": len(batches), "collected": collected,
                         "quarantined": sorted(f["batch"] for f in failed)}
             self.ledger.append(step=sd.name, event="step_done",
-                               elapsed=time.time() - t0, collected=collected)
+                               elapsed=time.time() - t0, collected=collected,
+                               **extra)
             return {"n_batches": len(batches), "collected": collected}
         except FaultInjected as e:
             if e.fatal:

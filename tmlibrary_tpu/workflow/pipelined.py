@@ -1,0 +1,303 @@
+"""Deep pipelined batch executor: multi-batch in-flight depth with
+threaded prefetch and persist.
+
+XLA dispatch is asynchronous — a device call returns futures immediately
+and only the host fetch blocks — so the old depth-1 generator in
+``jterator.py`` already overlapped ONE batch's host IO with device
+compute.  The hardware tuning sweep (``tuning/TUNING.json``) shows the
+device is still starved at that depth: batch N+1's store reads serialize
+against batch N-1's Parquet/polygon persists on the single host thread.
+This module generalizes the overlap into an executor any step can use by
+exposing the launch/persist split:
+
+- ``prefetch_batch(batch)`` (optional) — pure host-side input loading
+  (``store.read_sites``, illumstats, shift tables, mosaic stitching),
+  safe to run on a worker thread ahead of dispatch.
+- ``launch_batch(batch, prefetched=None) -> (effective_batch, ctx)`` —
+  async device dispatch; returns un-fetched device results.  The
+  effective batch may differ from the planned one (jterator's cap
+  overrides), and is what ``persist_batch`` receives.
+- ``block_batch(ctx)`` (optional) — block until the launched device
+  arrays are ready, so the device-block phase is timed separately from
+  the writes.
+- ``persist_batch(effective_batch, ctx) -> result`` — fetch + write
+  (feature shards, label stacks, polygons, figures).
+
+Semantics the engine depends on (and the equivalence tests pin down):
+
+- **Ordering**: ``run()`` yields ``(batch, result)`` strictly in
+  submission order, so ledger ``batch_done``/``batch_failed`` events keep
+  batch-index order and resume replay is unchanged.
+- **Window drain**: a launch failure mid-window first persists and
+  yields EVERY already-launched batch (not just the previous one), then
+  propagates — resume granularity matches the sequential path and no
+  completed work loses its ledger event.
+- **Depth auto-clamp**: a ``RESOURCE_EXHAUSTED``/OOM failure with
+  depth > 1 drains the window, halves the depth, reports a
+  ``depth_clamped`` event through ``on_event``, and retries the failed
+  batch at the lower depth instead of failing the step — HBM pressure
+  from too many in-flight batches degrades throughput, not correctness.
+- **Bit-identity**: dispatch happens on the calling thread in batch
+  order and persists default to ONE worker draining in submission
+  order, so results are bit-identical to sequential execution.
+
+Fault plans (``faults.py``) force the engine onto the sequential path
+*before* this executor is constructed — injected faults must land before
+a batch persists to mean anything (DESIGN.md §11).
+"""
+
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import logging
+import time
+from typing import Any, Callable, Iterable, Iterator
+
+logger = logging.getLogger(__name__)
+
+#: messages that signal HBM/host-memory pressure from too-deep pipelining
+#: (XLA surfaces these as bare RuntimeError/XlaRuntimeError text)
+_RESOURCE_PATTERNS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+)
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True when the error smells like memory pressure — the one failure
+    class where *reducing the in-flight depth* is the fix, not a retry at
+    the same depth."""
+    if isinstance(exc, MemoryError):
+        return True
+    msg = str(exc).lower()
+    return any(p in msg for p in _RESOURCE_PATTERNS)
+
+
+def supports_pipelining(step) -> bool:
+    """A step drives through :class:`PipelinedExecutor` when it exposes
+    the launch/persist split."""
+    return hasattr(step, "launch_batch") and hasattr(step, "persist_batch")
+
+
+def resolve_pipeline_depth(
+    explicit: int | None = None, backend: str | None = None
+) -> tuple[int, str]:
+    """The in-flight depth to run and where it came from.
+
+    Precedence (highest first): an explicit request (CLI
+    ``--pipeline-depth`` / ``Workflow(pipeline_depth=...)``), the
+    install config (``TM_PIPELINE_DEPTH`` env / INI ``pipeline_depth``),
+    the machine-written tuning sweep's ``best_pipeline`` (device
+    backends only — the sweep measured the device), then a safe
+    per-backend default: 8 on device, 2 on CPU (dispatch is cheap there
+    and a shallow window still overlaps persist IO with compute without
+    holding many batches of host arrays).
+
+    Returns ``(depth, source)`` with source in ``cli | config | tuning |
+    default`` so the chosen depth's provenance can be logged and
+    recorded in the run ledger.
+    """
+    if explicit is not None and int(explicit) > 0:
+        return max(1, int(explicit)), "cli"
+    from tmlibrary_tpu.config import _setting
+
+    try:
+        configured = int(_setting("pipeline_depth", "0"))
+    except ValueError:
+        configured = 0
+    if configured > 0:
+        return configured, "config"
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    if backend != "cpu":
+        from tmlibrary_tpu.tuning import tuned_pipeline_depth
+
+        tuned = tuned_pipeline_depth()
+        if tuned:
+            return tuned, "tuning"
+        return 8, "default"
+    return 2, "default"
+
+
+def prefetch_iter(
+    items: Iterable[Any],
+    load: Callable[[Any], Any],
+    depth: int = 2,
+) -> Iterator[Any]:
+    """Yield ``load(item)`` for every item IN ORDER, with up to ``depth``
+    loads running ahead on worker threads.
+
+    This is the executor's prefetch stage as a standalone primitive, for
+    steps whose unit of work is smaller than a batch — corilla's
+    chunk-scan loop reads site chunks through it so store IO for chunk
+    N+1 hides behind chunk N's device scan.  Order (and therefore any
+    order-dependent fold over the results) is preserved exactly; a
+    loader exception surfaces at the failing item's position.
+    """
+    items = list(items)
+    depth = max(1, int(depth))
+    if len(items) <= 1:
+        for item in items:
+            yield load(item)
+        return
+    pool = concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(depth, len(items)), thread_name_prefix="tmx-prefetch"
+    )
+    futures: collections.deque = collections.deque()
+    try:
+        pos = 0
+        while pos < len(items) or futures:
+            while pos < len(items) and len(futures) < depth:
+                futures.append(pool.submit(load, items[pos]))
+                pos += 1
+            yield futures.popleft().result()
+    finally:
+        for f in futures:
+            f.cancel()
+        pool.shutdown(wait=True)
+
+
+class PipelinedExecutor:
+    """Bounded in-flight window over a step's launch/persist split.
+
+    ``run(batches)`` is a generator of ``(batch, result)`` in submission
+    order.  ``on_event(**event)`` receives ``depth_clamped`` events (the
+    engine appends them to the run ledger); ``stats`` is an optional
+    :class:`tmlibrary_tpu.profiling.PipelineStats` collecting the
+    per-batch phase timers.
+    """
+
+    def __init__(
+        self,
+        step,
+        depth: int | None = None,
+        depth_source: str | None = None,
+        persist_workers: int = 1,
+        on_event: Callable[..., None] | None = None,
+        stats=None,
+    ):
+        if depth is None:
+            depth, depth_source = resolve_pipeline_depth()
+        self.step = step
+        self.depth = max(1, int(depth))
+        self.depth_source = depth_source or "explicit"
+        # >1 persist workers would reorder writes across batches; every
+        # persisted artifact is batch-sharded so that is SAFE, but one
+        # worker keeps the write order deterministic and is already off
+        # the critical path — more only helps when persist dominates
+        self.persist_workers = max(1, int(persist_workers))
+        self.on_event = on_event
+        self.stats = stats
+
+    # ------------------------------------------------------------------ run
+    def run(self, batches: Iterable[dict]) -> Iterator[tuple[dict, dict]]:
+        batches = list(batches)
+        pos = 0
+        while pos < len(batches):
+            try:
+                for out in self._run_window(batches[pos:]):
+                    pos += 1
+                    yield out
+                return
+            except Exception as exc:  # noqa: BLE001 — classified below
+                if self.depth > 1 and is_resource_exhausted(exc):
+                    new_depth = max(1, self.depth // 2)
+                    failing = batches[pos]["index"] if pos < len(batches) else None
+                    logger.warning(
+                        "pipelined executor: %s at depth %d — clamping to "
+                        "depth %d and retrying batch %s",
+                        exc, self.depth, new_depth, failing,
+                    )
+                    if self.on_event is not None:
+                        self.on_event(
+                            event="depth_clamped", from_depth=self.depth,
+                            to_depth=new_depth, batch=failing, error=str(exc),
+                        )
+                    if self.stats is not None:
+                        self.stats.record_clamp(self.depth, new_depth)
+                    self.depth = new_depth
+                    continue  # _run_window drained: pos is the failed batch
+                raise
+
+    # --------------------------------------------------------------- window
+    def _run_window(self, batches: list[dict]) -> Iterator[tuple[dict, dict]]:
+        step = self.step
+        stats = self.stats
+        has_prefetch = hasattr(step, "prefetch_batch")
+        prefetcher = None
+        if has_prefetch and len(batches) > 1:
+            prefetcher = concurrent.futures.ThreadPoolExecutor(
+                max_workers=min(self.depth, 4, len(batches)),
+                thread_name_prefix="tmx-prefetch",
+            )
+        persister = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.persist_workers, thread_name_prefix="tmx-persist"
+        )
+        # launched-but-not-yet-yielded batches, in submission order
+        window: collections.deque = collections.deque()
+        prefetched: dict[int, concurrent.futures.Future] = {}
+
+        def persist_task(eff: dict, ctx) -> dict:
+            if hasattr(step, "block_batch"):
+                t0 = time.perf_counter()
+                step.block_batch(ctx)
+                if stats is not None:
+                    stats.record("device_block", time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            result = step.persist_batch(eff, ctx)
+            if stats is not None:
+                stats.record("persist", time.perf_counter() - t0)
+                stats.batch_done()
+            return result
+
+        def pop_one() -> tuple[dict, dict]:
+            batch, fut = window.popleft()
+            return batch, fut.result()
+
+        try:
+            for i, batch in enumerate(batches):
+                if prefetcher is not None:
+                    # keep up to `depth` loads ahead of the dispatch point
+                    for j in range(i, min(i + self.depth, len(batches))):
+                        if j not in prefetched:
+                            prefetched[j] = prefetcher.submit(
+                                step.prefetch_batch, batches[j]
+                            )
+                try:
+                    pre = None
+                    if i in prefetched:
+                        t0 = time.perf_counter()
+                        pre = prefetched.pop(i).result()
+                        if stats is not None:
+                            stats.record(
+                                "prefetch_wait", time.perf_counter() - t0
+                            )
+                    t0 = time.perf_counter()
+                    eff, ctx = step.launch_batch(batch, pre)
+                    if stats is not None:
+                        stats.record("dispatch", time.perf_counter() - t0)
+                except Exception:
+                    # drain the WHOLE window: every already-launched batch
+                    # persists (and the caller ledgers it) before the
+                    # failure propagates — with depth > 1 flushing only
+                    # the previous batch would drop completed work
+                    while window:
+                        yield pop_one()
+                    raise
+                window.append((batch, persister.submit(persist_task, batch if eff is None else eff, ctx)))
+                while len(window) > self.depth:
+                    yield pop_one()
+            while window:
+                yield pop_one()
+        finally:
+            for f in prefetched.values():
+                f.cancel()
+            if prefetcher is not None:
+                prefetcher.shutdown(wait=False)
+            # wait=True: no persist worker may still be writing while the
+            # engine's sequential fallback re-runs the failed batch
+            persister.shutdown(wait=True)

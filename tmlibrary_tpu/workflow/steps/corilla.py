@@ -28,6 +28,7 @@ from tmlibrary_tpu.parallel.mesh import shard_batch, site_mesh
 from tmlibrary_tpu.parallel.stats import sharded_welford
 from tmlibrary_tpu.utils import create_partitions
 from tmlibrary_tpu.workflow.api import Step
+from tmlibrary_tpu.workflow.pipelined import prefetch_iter
 from tmlibrary_tpu.workflow.args import Argument, ArgumentCollection
 from tmlibrary_tpu.workflow.registry import register_step
 
@@ -56,6 +57,9 @@ class IlluminationStatisticsCalculator(Step):
                  help="mesh size (0 = all visible devices)"),
         Argument("smooth_sigma", float, default=0.0,
                  help="pre-smooth stat fields before storing (0 = off)"),
+        Argument("prefetch_chunks", int, default=2,
+                 help="site chunks read ahead on worker threads while the "
+                      "device scans the current chunk (1 = sequential)"),
     )
 
     def create_batches(self, args):
@@ -95,8 +99,18 @@ class IlluminationStatisticsCalculator(Step):
         scan_jit = _welford_scan_jit()
         merge_jit = _welford_merge_jit()
         dev_state = None
-        for part in create_partitions(site_indices, chunk):
-            stack = self.store.read_sites(part, cycle=cycle, channel=channel)
+        # store reads for chunk N+1 run on prefetch workers while the
+        # device scans chunk N; prefetch_iter preserves chunk order, so
+        # the Welford merge chain (order-sensitive in floating point) is
+        # bit-identical to the sequential loop
+        chunks = create_partitions(site_indices, chunk)
+        loaded = prefetch_iter(
+            chunks,
+            lambda part: self.store.read_sites(part, cycle=cycle,
+                                               channel=channel),
+            depth=max(args.get("prefetch_chunks", 2), 1),
+        )
+        for stack in loaded:
             if dev_state is None:
                 dev_state = scan_jit(jnp.asarray(stack))
             else:

@@ -15,6 +15,8 @@ host-traced polygons.  Metric: sites/sec/chip (BASELINE.json).
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 import logging
@@ -149,7 +151,9 @@ class ImageAnalysisRunner(Step):
                  help="apply align-step shifts when stitching (the sites "
                       "layout gates this per pipe channel; disable if the "
                       "stored registration is untrusted)"),
-        Argument("batch_size", int, default=32, help="sites per device batch"),
+        Argument("batch_size", int, default=0,
+                 help="sites per device batch (0 = auto: the tuning "
+                      "sweep's best_batch on device backends, else 32)"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
         Argument("auto_resegment", bool, default=True,
@@ -175,6 +179,11 @@ class ImageAnalysisRunner(Step):
         self._compiled_cap: int | None = None
         self._desc = None
         self._window: tuple[int, int, int, int] | None = None
+        # prefetch workers read the pipeline description (and the figures
+        # path re-resolves the compiled program) concurrently with the
+        # main thread's launch; the lock keeps the compile cache coherent
+        # when two threads race on different max_objects caps
+        self._compile_lock = threading.Lock()
 
     def create_batches(self, args):
         if args["layout"] == "spatial":
@@ -190,64 +199,139 @@ class ImageAnalysisRunner(Step):
         if not args["pipe"]:
             raise ValueError("--pipe is required for --layout sites")
         sites = list(range(self.store.n_sites))
+        batch_size = args["batch_size"] or self._auto_batch_size()
         return [
-            {"sites": part} for part in create_partitions(sites, args["batch_size"])
+            {"sites": part} for part in create_partitions(sites, batch_size)
         ]
 
+    @staticmethod
+    def _auto_batch_size() -> int:
+        """``batch_size=0``: the hardware-swept ``best_batch`` on device
+        backends (the sweep measured the device, so a CPU run keeps the
+        static default)."""
+        import jax
+
+        if jax.default_backend() != "cpu":
+            from tmlibrary_tpu.tuning import tuned_batch_size
+
+            tuned = tuned_batch_size()
+            if tuned:
+                logger.info(
+                    "batch_size auto: %d sites/batch (source: tuning "
+                    "best_batch)", tuned,
+                )
+                return tuned
+        return 32
+
     # ---------------------------------------------------------------- compile
-    def _pipeline(self, args):
+    def _description(self, args):
+        """The parsed pipeline description alone — prefetch workers need
+        the channel/object lists to plan store reads without forcing a
+        compile on their thread."""
         from pathlib import Path
 
         from tmlibrary_tpu.jterator.description import PipelineDescription
 
-        if self._desc is None:
-            pipe_path = Path(args["pipe"])
-            if not pipe_path.is_absolute():
-                pipe_path = self.store.root / pipe_path
-            self._desc = PipelineDescription.load(pipe_path)
-        # cache keyed by the object cap: batches normally share one cap,
-        # but collect's auto-resegmentation re-runs a batch at a doubled
-        # max_objects — reusing the old compiled program would silently
-        # keep the old cap while the saturation check uses the new one
-        if self._compiled is None or self._compiled_cap != args["max_objects"]:
-            # aligned multiplexing experiments crop every channel to the
-            # inter-cycle intersection (reference SiteIntersection); the
-            # window is experiment-static, so it compiles into the program
-            if any(ch.align for ch in self._desc.channels):
-                try:
-                    w = self.store.read_intersection()
-                    self._window = (w["top"], w["bottom"], w["left"], w["right"])
-                except StoreError:
-                    self._window = None  # align step didn't run: no crop
-                if self._window == (0, 0, 0, 0):
-                    self._window = None
-            # process-level cache: a re-built Step (fresh Workflow, engine
-            # re-run, tool request) running the same description reuses
-            # the traced+compiled program instead of re-paying trace+load
-            from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
+        with self._compile_lock:
+            if self._desc is None:
+                pipe_path = Path(args["pipe"])
+                if not pipe_path.is_absolute():
+                    pipe_path = self.store.root / pipe_path
+                self._desc = PipelineDescription.load(pipe_path)
+            return self._desc
 
-            self._compiled = cached_batch_fn(
-                self._desc, args["max_objects"], self._window
-            )
-            self._compiled_cap = args["max_objects"]
-        return self._desc, self._compiled
+    def _pipeline(self, args):
+        self._description(args)
+        with self._compile_lock:
+            # cache keyed by the object cap: batches normally share one cap,
+            # but collect's auto-resegmentation re-runs a batch at a doubled
+            # max_objects — reusing the old compiled program would silently
+            # keep the old cap while the saturation check uses the new one
+            if self._compiled is None or self._compiled_cap != args["max_objects"]:
+                # aligned multiplexing experiments crop every channel to the
+                # inter-cycle intersection (reference SiteIntersection); the
+                # window is experiment-static, so it compiles into the program
+                if any(ch.align for ch in self._desc.channels):
+                    try:
+                        w = self.store.read_intersection()
+                        self._window = (w["top"], w["bottom"], w["left"], w["right"])
+                    except StoreError:
+                        self._window = None  # align step didn't run: no crop
+                    if self._window == (0, 0, 0, 0):
+                        self._window = None
+                # process-level cache: a re-built Step (fresh Workflow, engine
+                # re-run, tool request) running the same description reuses
+                # the traced+compiled program instead of re-paying trace+load
+                from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
+
+                self._compiled = cached_batch_fn(
+                    self._desc, args["max_objects"], self._window
+                )
+                self._compiled_cap = args["max_objects"]
+            return self._desc, self._compiled
 
     # -------------------------------------------------------------------- run
-    def run_batch(self, batch: dict) -> dict:
-        # collect's auto-resegmentation escalates a batch's object cap in
-        # a SIDE file rather than rewriting batch_*.json: the engine's
-        # resume staleness check compares planned batch args against the
-        # description's, and a rewritten cap would read as "args changed"
-        # and trigger a from-scratch re-plan that wipes every output
+    def _effective_batch(self, batch: dict) -> dict:
+        """Fold in collect's auto-resegmentation cap escalation.  The
+        override lives in a SIDE file rather than a rewritten
+        batch_*.json: the engine's resume staleness check compares
+        planned batch args against the description's, and a rewritten
+        cap would read as "args changed" and trigger a from-scratch
+        re-plan that wipes every output."""
         override = self._cap_overrides().get(str(batch["index"]))
         if override and override > batch["args"].get("max_objects", 0):
-            batch = {**batch, "args": {**batch["args"],
-                                       "max_objects": int(override)}}
+            return {**batch, "args": {**batch["args"],
+                                      "max_objects": int(override)}}
+        return batch
+
+    def run_batch(self, batch: dict) -> dict:
+        batch = self._effective_batch(batch)
         # .get: batch JSONs persisted by a pre-layout init lack the key
         if batch["args"].get("layout", "sites") == "spatial":
             return self._run_spatial(batch)
         result = self._launch(batch)
         return self._persist(batch, result)
+
+    # ------------------------------------------------- launch/persist split
+    # (the pipelined executor's step protocol — workflow/pipelined.py)
+    def prefetch_batch(self, batch: dict):
+        """Host-side input loading only (store reads, illumstats, shift
+        tables, mosaic stitching) — safe on a prefetch worker thread."""
+        batch = self._effective_batch(batch)
+        if batch["args"].get("layout", "sites") == "spatial":
+            return self._prefetch_spatial(batch)
+        return self._load_inputs(batch)
+
+    def launch_batch(self, batch: dict, prefetched=None):
+        """Async device dispatch; returns ``(effective_batch, ctx)`` with
+        un-fetched device arrays inside ``ctx``."""
+        batch = self._effective_batch(batch)
+        if batch["args"].get("layout", "sites") == "spatial":
+            return batch, ("spatial", self._launch_spatial(batch, prefetched))
+        return batch, ("sites", self._launch(batch, prefetched))
+
+    def block_batch(self, ctx) -> None:
+        """Wait for the launched device arrays (distinct pipeline-stats
+        phase from the persist writes that follow)."""
+        import jax
+
+        kind, payload = ctx
+        if kind == "sites":
+            # SiteResult is a registered pytree: block on all leaves
+            jax.block_until_ready(payload)
+            return
+        jax.block_until_ready(payload["labels_dev"])
+        jax.block_until_ready(payload["count_dev"])
+        if payload["sec"] is not None:
+            jax.block_until_ready(payload["sec"][2])
+
+    def persist_batch(self, batch: dict, ctx) -> dict:
+        """Fetch + write one launched batch (the effective batch from
+        :meth:`launch_batch`)."""
+        kind, payload = ctx
+        if kind == "spatial":
+            return self._persist_spatial(batch, payload)
+        return self._persist(batch, payload)
 
     # ------------------------------------------------------------ spatial run
     def _stitched_channel(
@@ -313,7 +397,28 @@ class ImageAnalysisRunner(Step):
     def _run_spatial(self, batch: dict) -> dict:
         return self._persist_spatial(batch, self._launch_spatial(batch))
 
-    def _launch_spatial(self, batch: dict) -> dict:
+    def _prefetch_spatial(self, batch: dict) -> dict:
+        """Host half of the spatial launch: resolve the well geometry and
+        stitch the segmentation channel's mosaic (store reads + host
+        assembly) ahead of device dispatch."""
+        args = batch["args"]
+        sites = batch["sites"]
+        exp = self.store.experiment
+        ch_name = args["spatial_channel"] or exp.channels[0].name
+        idx = exp.channel_index(ch_name)
+        refs = list(exp.sites())
+        srefs = [refs[i] for i in sites]
+        h, w = exp.site_height, exp.site_width
+        n_sy = max(r.site_y for r in srefs) + 1
+        n_sx = max(r.site_x for r in srefs) + 1
+        mosaic = self._stitched_channel(sites, srefs, idx, args, n_sy, n_sx, h, w)
+        valid = self._stitch_validity(sites, srefs, args, n_sy, n_sx, h, w)
+        return {
+            "idx": idx, "srefs": srefs, "h": h, "w": w,
+            "n_sy": n_sy, "n_sx": n_sx, "mosaic": mosaic, "valid": valid,
+        }
+
+    def _launch_spatial(self, batch: dict, prefetched: dict | None = None) -> dict:
         """Whole-mosaic segmentation of one well (``--layout spatial``) —
         the LAUNCH half: host stitch + async device dispatch (primary
         segmentation and, when configured, the chained secondary
@@ -348,21 +453,22 @@ class ImageAnalysisRunner(Step):
         exp = self.store.experiment
         tpoint, zplane = args["tpoint"], args["zplane"]
 
-        ch_name = args["spatial_channel"] or exp.channels[0].name
-        idx = exp.channel_index(ch_name)
-        refs = list(exp.sites())
-        srefs = [refs[i] for i in sites]
-        h, w = exp.site_height, exp.site_width
-        n_sy = max(r.site_y for r in srefs) + 1
-        n_sx = max(r.site_x for r in srefs) + 1
-        mosaic = self._stitched_channel(sites, srefs, idx, args, n_sy, n_sx, h, w)
+        if prefetched is None:
+            prefetched = self._prefetch_spatial(batch)
+        idx = prefetched["idx"]
+        srefs = prefetched["srefs"]
+        h, w = prefetched["h"], prefetched["w"]
+        n_sy, n_sx = prefetched["n_sy"], prefetched["n_sx"]
+        mosaic = prefetched["mosaic"]
 
         # alignment zero-fills shifted-in edges INSIDE the mosaic; those
         # stripes would feed the global Otsu histogram as an artificial
         # zero mode (the sites layout crops them away via the
         # intersection window), so when any exist the threshold is
         # computed over the VALID pixels only and passed in explicitly
-        valid = self._stitch_validity(sites, srefs, args, n_sy, n_sx, h, w)
+        # (stitch + validity come prefetched; the device-side smoothing
+        # and Otsu stay on the dispatching thread)
+        valid = prefetched["valid"]
         threshold = None
         if valid is not None:
             from tmlibrary_tpu.ops.smooth import gaussian_smooth
@@ -658,63 +764,35 @@ class ImageAnalysisRunner(Step):
                        / f"{name}_polygons_{shard}.parquet")
                 df.to_parquet(out, index=False)
 
-    def run_batches_pipelined(self, batches):
+    def run_batches_pipelined(self, batches, depth: int | None = None):
         """Generator over ``(batch, result_summary)`` with host work
         overlapped against device compute.
 
-        XLA dispatch is asynchronous: ``fn(...)`` returns device futures
-        immediately, so launching batch N, then persisting batch N-1
-        (which blocks only on N-1's arrays) and loading batch N+1 puts the
-        host IO — store reads, Parquet writes, polygon tracing — in the
-        shadow of batch N's device execution.  This recovers the
-        reference's overlap of cluster jobs with DB writes (SURVEY.md §4.3
-        crossing points) without threads or process fan-out.
+        XLA dispatch is asynchronous: device calls return futures
+        immediately and only the host fetch blocks, so keeping a bounded
+        window of launched batches in flight puts the host IO — store
+        reads, Parquet writes, polygon tracing — in the shadow of device
+        execution.  This recovers the reference's overlap of cluster
+        jobs with DB writes (SURVEY.md §4.3 crossing points) without
+        process fan-out.  Delegates to the shared
+        :class:`~tmlibrary_tpu.workflow.pipelined.PipelinedExecutor`
+        (``depth=None`` resolves config > tuning > per-backend default);
+        yields stay in batch order and bit-identical to sequential runs.
         """
-        batches = list(batches)
+        from tmlibrary_tpu.workflow.pipelined import PipelinedExecutor
 
-        def _launch_one(b):
-            override = self._cap_overrides().get(str(b["index"]))
-            if override and override > b["args"].get("max_objects", 0):
-                b = {**b, "args": {**b["args"],
-                                   "max_objects": int(override)}}
-            if b["args"].get("layout", "sites") == "spatial":
-                return b, "spatial", self._launch_spatial(b)
-            return b, "sites", self._launch(b)
+        yield from PipelinedExecutor(self, depth=depth).run(batches)
 
-        def _persist_one(b, kind, ctx):
-            if kind == "spatial":
-                return self._persist_spatial(b, ctx)
-            return self._persist(b, ctx)
-
-        prev: tuple | None = None
-        for batch in batches:
-            try:
-                eff, kind, launched = _launch_one(batch)  # async dispatch
-            except Exception:
-                # don't lose the already-computed previous batch: persist
-                # (and let the caller ledger) it before propagating, so
-                # resume granularity matches the sequential path
-                if prev is not None:
-                    yield prev[0], _persist_one(prev[1], prev[2], prev[3])
-                    prev = None
-                raise
-            if prev is not None:
-                yield prev[0], _persist_one(prev[1], prev[2], prev[3])
-            prev = (batch, eff, kind, launched)
-        if prev is not None:
-            yield prev[0], _persist_one(prev[1], prev[2], prev[3])
-
-    def _launch(self, batch: dict):
-        """Load inputs (host IO) and dispatch the device computation;
-        returns without waiting for device completion."""
+    def _load_inputs(self, batch: dict) -> dict:
+        """Host-side input loading for a sites-layout batch: store reads,
+        illumination statistics and shift tables, all as numpy — no
+        device transfers, so a prefetch worker can run it while the
+        device chews on earlier batches."""
         import jax
-        import jax.numpy as jnp
-
-        from tmlibrary_tpu.parallel.mesh import batch_sharding, site_mesh
 
         args = batch["args"]
         sites = batch["sites"]
-        desc, fn = self._pipeline(args)
+        desc = self._description(args)
         exp = self.store.experiment
         cycle, tpoint, zplane = args["cycle"], args["tpoint"], args["zplane"]
 
@@ -726,10 +804,6 @@ class ImageAnalysisRunner(Step):
         padded_sites = list(sites)
         if n_valid % n_dev:
             padded_sites += [sites[0]] * (n_dev - n_valid % n_dev)
-
-        sharding = None
-        if n_dev > 1:
-            sharding = batch_sharding(site_mesh(n_dev))
 
         raw = {}
         for ch in desc.channels:
@@ -744,13 +818,10 @@ class ImageAnalysisRunner(Step):
             else:
                 stack = self.store.read_sites(padded_sites, cycle=cycle, channel=idx,
                                               tpoint=tpoint, zplane=zplane)
-            arr = jnp.asarray(stack)
-            raw[ch.name] = jax.device_put(arr, sharding) if sharding else arr
+            raw[ch.name] = stack
         for obj in desc.objects_in:
-            stack = self.store.read_labels(padded_sites, obj.name,
-                                           tpoint=tpoint, zplane=zplane)
-            arr = jnp.asarray(stack)
-            raw[obj.name] = jax.device_put(arr, sharding) if sharding else arr
+            raw[obj.name] = self.store.read_labels(padded_sites, obj.name,
+                                                   tpoint=tpoint, zplane=zplane)
 
         stats = {}
         for ch in desc.channels:
@@ -768,15 +839,45 @@ class ImageAnalysisRunner(Step):
                 )
                 stats[ch.name] = (cont.mean_log, cont.std_log)
 
+        shifts_np = None
         if any(ch.align for ch in desc.channels) and self.store.has_shifts(cycle):
             table = self.store.read_shifts(cycle)
-            shifts = jnp.asarray(table[np.asarray(padded_sites)])
+            shifts_np = table[np.asarray(padded_sites)]
+
+        return {"padded_sites": padded_sites, "n_dev": n_dev,
+                "raw": raw, "stats": stats, "shifts_np": shifts_np}
+
+    def _launch(self, batch: dict, inputs: dict | None = None):
+        """Transfer the (possibly prefetched) inputs and dispatch the
+        device computation; returns without waiting for completion."""
+        import jax
+        import jax.numpy as jnp
+
+        from tmlibrary_tpu.parallel.mesh import batch_sharding, site_mesh
+
+        _, fn = self._pipeline(batch["args"])
+        if inputs is None:
+            inputs = self._load_inputs(batch)
+        padded_sites = inputs["padded_sites"]
+        n_dev = inputs["n_dev"]
+
+        sharding = None
+        if n_dev > 1:
+            sharding = batch_sharding(site_mesh(n_dev))
+
+        raw = {}
+        for name, stack in inputs["raw"].items():
+            arr = jnp.asarray(stack)
+            raw[name] = jax.device_put(arr, sharding) if sharding else arr
+
+        if inputs["shifts_np"] is not None:
+            shifts = jnp.asarray(inputs["shifts_np"])
         else:
             shifts = jnp.zeros((len(padded_sites), 2), jnp.int32)
         if sharding is not None:
             shifts = jax.device_put(shifts, sharding)
 
-        return fn(raw, stats, shifts)
+        return fn(raw, inputs["stats"], shifts)
 
     def _persist(self, batch: dict, result) -> dict:
         """Fetch one launched batch's device results and write them out."""
