@@ -58,6 +58,25 @@ CACHE_PATH = os.environ.get(
 )
 
 
+def emit_record(record: dict) -> None:
+    """Print the one-line JSON record and mirror its headline value into
+    the telemetry registry (``tmx_bench_<metric>`` gauge) so a process
+    embedding bench — the watcher, a notebook — can scrape the same
+    number the stdout contract carries."""
+    try:
+        from tmlibrary_tpu import telemetry
+
+        metric = record.get("metric")
+        if telemetry.enabled() and metric:
+            telemetry.get_registry().gauge(
+                f"tmx_bench_{metric}",
+                backend=str(record.get("backend", "unknown")),
+            ).set(float(record.get("value", 0.0)))
+    except Exception:
+        pass  # telemetry must never break the stdout contract
+    print(json.dumps(record), flush=True)
+
+
 # ONE definition of the tuning artifact path + provenance gate, now in the
 # installable package (tmlibrary_tpu.tuning) because the production engine
 # consumes the tuned defaults too; re-exported here so tune_tpu, tpu_watch
@@ -282,7 +301,7 @@ def emit_cached_tpu(live_error: str) -> bool:
                         "scripts/tune_tpu.py during a relay window too "
                         "short for a full bench re-certification",
             }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
     return True
 
 
@@ -466,7 +485,7 @@ def measure(platform: str) -> None:
         flops and flops * pdepth, pdepth * batch, best,
         jax.default_backend(), nbytes=cost_bytes and cost_bytes * pdepth,
     ))
-    print(json.dumps(record), flush=True)
+    emit_record(record)
 
 
 def _cost_flops(jitted_fn, *args):
@@ -617,7 +636,7 @@ def measure_pyramid(size: int) -> None:
         flops and flops * depth, depth * gy * gx, best,
         jax.default_backend(), item_key="flops_per_site",
         nbytes=cost_bytes and cost_bytes * depth))
-    print(json.dumps(record), flush=True)
+    emit_record(record)
 
 
 def measure_ingest(size: int) -> None:
@@ -767,7 +786,7 @@ def measure_ingest(size: int) -> None:
         "per_format": per_format,
         **_ledger_fields(None),
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
 
 
 def measure_mesh(size: int) -> None:
@@ -870,7 +889,7 @@ def measure_mesh(size: int) -> None:
         **_ledger_fields(pdepth, max_objects),
         "synthetic_cpu_mesh": backend_is_cpu,
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
 
 
 def measure_spatial(size: int) -> None:
@@ -954,7 +973,7 @@ def measure_spatial(size: int) -> None:
         "objects": int(count),
         **_ledger_fields(None),
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
 
 
 def measure_workflow(size: int) -> None:
@@ -1172,7 +1191,7 @@ def measure_workflow(size: int) -> None:
         # host-synchronous, same as the pre-executor bench did
         **_ledger_fields(pdepth if pdepth > 1 else None, max_objects),
     }
-    print(json.dumps(record), flush=True)
+    emit_record(record)
 
 
 def measure_corilla(size: int) -> None:
@@ -1239,7 +1258,7 @@ def measure_corilla(size: int) -> None:
         flops and flops * depth, depth * n_channels, best,
         jax.default_backend(), item_key="flops_per_channel",
         nbytes=cost_bytes and cost_bytes * depth))
-    print(json.dumps(record), flush=True)
+    emit_record(record)
 
 
 def main() -> None:
@@ -1299,7 +1318,7 @@ def main() -> None:
                 elif platform == "cpu":
                     out["backend"] = "cpu_fallback"
                     out["error"] = f"tpu unavailable: {last_err}"
-                print(json.dumps(out), flush=True)
+                emit_record(out)
                 return True
         last_err = (
             f"{platform}: rc={proc.returncode}, "

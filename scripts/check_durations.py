@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""CI gate: no single non-slow test may exceed the tier-1 time budget.
+
+    python scripts/check_durations.py LOGFILE [--limit SECONDS]
+
+Parses the ``--durations`` section pytest appends to the tier-1 log
+(lines like ``  12.34s call     tests/test_x.py::test_y``) and fails
+when any ``call`` phase exceeds the limit (default 60s).  A test that
+creeps past the budget pushes the whole suite toward the gate timeout
+long before it actually times out — this catches the creep at the
+commit that introduces it.
+"""
+import argparse
+import re
+import sys
+
+DURATION_RE = re.compile(
+    r"^\s*(?P<seconds>\d+(?:\.\d+)?)s\s+(?P<phase>call|setup|teardown)\s+"
+    r"(?P<test>\S+)"
+)
+
+
+def check(lines, limit: float):
+    """Return (checked, offenders) from pytest --durations output lines."""
+    checked, offenders = 0, []
+    for line in lines:
+        m = DURATION_RE.match(line)
+        if not m or m.group("phase") != "call":
+            continue
+        checked += 1
+        seconds = float(m.group("seconds"))
+        if seconds > limit:
+            offenders.append((seconds, m.group("test")))
+    return checked, offenders
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("logfile")
+    parser.add_argument("--limit", type=float, default=60.0,
+                        help="per-test call budget in seconds (default 60)")
+    args = parser.parse_args()
+    with open(args.logfile, errors="replace") as fh:
+        checked, offenders = check(fh, args.limit)
+    if not checked:
+        print("check_durations: no duration lines found — run pytest with "
+              "--durations=N", file=sys.stderr)
+        return 2
+    if offenders:
+        print(f"check_durations: {len(offenders)} test(s) over the "
+              f"{args.limit:g}s budget:", file=sys.stderr)
+        for seconds, test in sorted(offenders, reverse=True):
+            print(f"  {seconds:8.2f}s  {test}", file=sys.stderr)
+        return 1
+    print(f"check_durations: {checked} timed calls, all within "
+          f"{args.limit:g}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -128,6 +128,32 @@ def load_json(path: str) -> dict:
         return {}
 
 
+def check_run_heartbeat() -> str | None:
+    """Inspect a live workflow run's resource-sampler heartbeat
+    (``WATCH_RUN_ROOT`` = its experiment store root) and report staleness.
+
+    The sampler (``telemetry.ResourceSampler``) refreshes the heartbeat
+    every period; a heartbeat older than 2x the period while the run's
+    process is supposedly working means the run is HUNG (relay wedge, GIL
+    deadlock), not slow — worth logging from the watcher box because the
+    hung process itself can no longer tell anyone."""
+    root = os.environ.get("WATCH_RUN_ROOT")
+    if not root:
+        return None
+    hb = load_json(os.path.join(root, "workflow", "heartbeat.json"))
+    if not hb or "ts" not in hb:
+        return None
+    age = time.time() - float(hb["ts"])
+    period = float(hb.get("period", 0) or 0)
+    if period > 0 and age > 2 * period:
+        msg = (f"run heartbeat at {root} is STALE: {age:.0f}s old "
+               f"(sampler period {period:g}s, pid {hb.get('pid')}) — "
+               "the run looks hung")
+        log(msg)
+        return msg
+    return None
+
+
 def save_cache(cache: dict) -> None:
     os.makedirs(os.path.dirname(CACHE_PATH), exist_ok=True)
     tmp = CACHE_PATH + ".tmp"
@@ -526,6 +552,7 @@ def main() -> None:
         f"{all_pending()}")
     poll_s = int(os.environ.get("WATCH_POLL_S", "60"))
     while True:
+        check_run_heartbeat()
         pending = all_pending()
         if not pending:
             log("all pending work done; exiting")

@@ -21,6 +21,8 @@ jax.config.update("jax_enable_x64", False)
 import numpy as np
 import pytest
 
+from tmlibrary_tpu import log as tm_log
+
 
 @pytest.fixture(scope="session")
 def devices():
@@ -32,3 +34,12 @@ def devices():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _reset_warn_once():
+    """warn_once's suppression set is process-global: a warning consumed
+    by one test would silently hide the assertion target of another."""
+    tm_log.reset_warned()
+    yield
+    tm_log.reset_warned()

@@ -305,3 +305,54 @@ def test_bench_done_mesh_uses_config3_tuned_batch(monkeypatch, tmp_path):
     (tmp_path / "tuning" / "TUNING.json").write_text(json.dumps(
         {**MACHINE, "best_pipeline": 8, "best_batch": 64}))
     assert w.bench_done("mesh") is False  # batch superseded
+
+
+def test_check_durations_parses_and_flags(tmp_path):
+    """The CI durations gate reads pytest's --durations section and flags
+    only over-budget ``call`` phases (setup/teardown time is pytest's
+    own bookkeeping, not the test's)."""
+    import sys
+
+    sys.path.insert(0, str(SCRIPTS[0].parent))
+    try:
+        from check_durations import check
+    finally:
+        sys.path.pop(0)
+
+    log = [
+        "============ slowest 40 durations ============\n",
+        "  61.20s call     tests/test_big.py::test_huge\n",
+        "  70.00s setup    tests/test_big.py::test_huge\n",
+        "   5.01s call     tests/test_small.py::test_fast\n",
+        "some unrelated line\n",
+    ]
+    checked, offenders = check(log, limit=60.0)
+    assert checked == 2
+    assert offenders == [(61.2, "tests/test_big.py::test_huge")]
+    checked, offenders = check(log, limit=120.0)
+    assert offenders == []
+    # no duration lines at all -> caller reports a broken invocation
+    assert check(["garbage\n"], limit=60.0) == (0, [])
+
+
+def test_watch_flags_stale_run_heartbeat(monkeypatch, tmp_path):
+    """The watcher logs a hung run when the workflow heartbeat is older
+    than 2x the sampler period — the hung process can't report itself."""
+    import time as _time
+
+    w = _watch(monkeypatch, tmp_path)
+    root = tmp_path / "exp"
+    (root / "workflow").mkdir(parents=True)
+    monkeypatch.setenv("WATCH_RUN_ROOT", str(root))
+    # no heartbeat file yet: silently skipped
+    assert w.check_run_heartbeat() is None
+    hb = root / "workflow" / "heartbeat.json"
+    hb.write_text(json.dumps(
+        {"ts": _time.time() - 100.0, "pid": 123, "period": 5.0}))
+    msg = w.check_run_heartbeat()
+    assert msg is not None and "STALE" in msg and "hung" in msg
+    # fresh heartbeat: healthy
+    hb.write_text(json.dumps({"ts": _time.time(), "pid": 123, "period": 5.0}))
+    assert w.check_run_heartbeat() is None
+    monkeypatch.delenv("WATCH_RUN_ROOT")
+    assert w.check_run_heartbeat() is None

@@ -141,6 +141,35 @@ def build_parser() -> argparse.ArgumentParser:
              "geometry simplification for viewer-scale objects)",
     )
 
+    p_metrics = sub.add_parser(
+        "metrics",
+        help="export run metrics (Prometheus textfile or JSON) from the "
+             "live registry snapshot or derived from any run ledger",
+    )
+    _add_common(p_metrics)
+    p_metrics.add_argument(
+        "--format", choices=("prom", "json"), default="prom",
+        help="Prometheus textfile exposition format (default) or JSON",
+    )
+    p_metrics.add_argument(
+        "--source", choices=("auto", "snapshot", "ledger"), default="auto",
+        help="'snapshot' reads the registry snapshot the last submit wrote "
+             "(workflow/metrics.json); 'ledger' derives metrics from the "
+             "run ledger (works for runs that predate telemetry); 'auto' "
+             "prefers the snapshot and falls back to the ledger",
+    )
+    p_metrics.add_argument("--out", default=None,
+                           help="write to this file instead of stdout")
+
+    p_trace = sub.add_parser(
+        "trace",
+        help="dump the run's span tree (run > step > batch > phase) with "
+             "critical-path annotation from the run ledger",
+    )
+    _add_common(p_trace)
+    p_trace.add_argument("--json", action="store_true", dest="as_json",
+                         help="emit the annotated tree as JSON")
+
     p_wf = sub.add_parser("workflow", help="full workflow orchestration")
     wf_sub = p_wf.add_subparsers(dest="verb", required=True)
     # submit and resume (the reference's verb) share the same options and
@@ -180,6 +209,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--probe-timeout", type=float, default=None, metavar="SECONDS",
         help="device health probe deadline before the circuit breaker "
              "counts a failure (a down TPU relay hangs, not errors)",
+    )
+    shared.add_argument(
+        "--no-telemetry", action="store_true",
+        help="disable the metrics registry, span events and resource "
+             "sampler for this run (also: TM_TELEMETRY=0)",
+    )
+    shared.add_argument(
+        "--sample-resources", type=float, default=None, metavar="SECONDS",
+        help="resource sampler period (RSS/fds/device-memory gauges + "
+             "heartbeat file; default from TM_RESOURCE_SAMPLE_PERIOD, "
+             "0 disables)",
     )
     p_submit = wf_sub.add_parser("submit", help="run the workflow",
                                  parents=[shared])
@@ -471,6 +511,24 @@ def cmd_workflow(args) -> int:
             print(f"backend degraded to {degraded.get('backend')} "
                   f"(at step '{degraded.get('where')}' after "
                   f"{degraded.get('failures')} failed device probes)")
+        # resource-sampler heartbeat: a running step with a stale heartbeat
+        # is a HUNG run (sampler thread dead/blocked), not a slow one
+        from tmlibrary_tpu import telemetry
+
+        hb = telemetry.read_heartbeat(
+            store.workflow_dir / telemetry.HEARTBEAT_FILENAME
+        )
+        if hb and "ts" in hb:
+            import time as _time
+
+            age = _time.time() - float(hb["ts"])
+            period = float(hb.get("period", 0) or 0)
+            line = f"heartbeat: {age:.1f}s ago (sampler period {period:g}s)"
+            running = any(e.get("state") == "running"
+                          for e in status.values())
+            if running and period > 0 and age > 2 * period:
+                line += " — STALE: run appears hung"
+            print(line)
         # tool request lifecycle (reference ToolRequestManager submissions
         # surface in the same status view the UI polls)
         for req in tool_requests:
@@ -514,9 +572,16 @@ def cmd_workflow(args) -> int:
             print("error: no workflow description (pass --description or put "
                   "workflow.yaml in the store's workflow dir)", file=sys.stderr)
             return 1
+    from tmlibrary_tpu import telemetry
     from tmlibrary_tpu.profiling import device_trace
     from tmlibrary_tpu.resilience import ResilienceConfig
 
+    if args.no_telemetry:
+        telemetry.set_enabled(False)
+    if args.sample_resources is not None:
+        from tmlibrary_tpu.config import cfg as _cfg
+
+        _cfg.resource_sample_period = args.sample_resources
     resilience = ResilienceConfig.from_library_config()
     if args.max_batch_failures is not None:
         resilience.max_batch_failures = args.max_batch_failures
@@ -962,6 +1027,75 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_metrics(args) -> int:
+    """Export run metrics as Prometheus textfile format or JSON.
+
+    Sources: the registry snapshot the last ``workflow submit`` wrote
+    (``workflow/metrics.json``), or a ledger→metrics derivation that works
+    on any ledger — including runs that predate telemetry."""
+    from tmlibrary_tpu import telemetry
+
+    store = _open_store(args)
+    snapshot = None
+    snap_path = store.workflow_dir / "metrics.json"
+    if args.source in ("auto", "snapshot") and snap_path.exists():
+        try:
+            snapshot = json.loads(snap_path.read_text())
+        except ValueError:
+            print(f"warning: ignoring corrupt snapshot {snap_path}",
+                  file=sys.stderr)
+    if snapshot is None:
+        if args.source == "snapshot":
+            print(f"error: no metrics snapshot at {snap_path} (run "
+                  "`tmx workflow submit` first, or use --source ledger)",
+                  file=sys.stderr)
+            return 1
+        ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+        events = ledger.events()
+        if not events:
+            print("no metrics snapshot and no run ledger — nothing to "
+                  "export", file=sys.stderr)
+            return 1
+        snapshot = telemetry.registry_from_ledger(events).snapshot()
+    if args.format == "json":
+        text = telemetry.render_json(snapshot) + "\n"
+    else:
+        text = telemetry.render_prometheus(snapshot)
+    if args.out:
+        Path(args.out).write_text(text)
+        print(f"wrote {args.format} metrics to {args.out}")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Dump the span tree (run > step > batch > phase) with the critical
+    path marked ``*`` at every level — the chain the run's wall time
+    actually went to."""
+    from tmlibrary_tpu import telemetry
+
+    store = _open_store(args)
+    events = RunLedger(store.workflow_dir / "ledger.jsonl").events()
+    if not events:
+        print("no run ledger — nothing to trace", file=sys.stderr)
+        return 1
+    tree = telemetry.annotate_critical_path(
+        telemetry.build_span_tree(events)
+    )
+    if args.as_json:
+        print(json.dumps(tree, indent=2))
+        return 0
+    print(telemetry.render_span_tree(tree))
+    totals = telemetry.phase_totals(events)
+    if totals:
+        phases = "  ".join(f"{k}={v:.3f}s"
+                           for k, v in sorted(totals.items(),
+                                              key=lambda kv: -kv[1]))
+        print(f"\nphase totals (critical resource): {phases}")
+    return 0
+
+
 def main(argv=None) -> int:
     # TMX_PLATFORM=cpu forces the backend IN-PROCESS before first use:
     # plain JAX_PLATFORMS is overridden by TPU-relay site configs, and a
@@ -996,6 +1130,10 @@ def main(argv=None) -> int:
             return cmd_log(args)
         if args.command == "export":
             return cmd_export(args)
+        if args.command == "metrics":
+            return cmd_metrics(args)
+        if args.command == "trace":
+            return cmd_trace(args)
         return cmd_step(args)
     except Exception as e:
         print(f"error: {e}", file=sys.stderr)

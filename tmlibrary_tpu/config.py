@@ -131,6 +131,20 @@ class LibraryConfig:
     compile_cache_dir: str = dataclasses.field(
         default_factory=lambda: _setting("compile_cache_dir", "")
     )
+    # ------------------------------------------------------- telemetry
+    #: master switch for the metrics registry + span tracing
+    #: (telemetry.py); off hands out null instruments — zero cost
+    telemetry: bool = dataclasses.field(
+        default_factory=lambda: _setting("telemetry", "1").lower()
+        in ("1", "true", "yes")
+    )
+    #: resource sampler period in seconds (RSS/fds/device memory gauges +
+    #: heartbeat file); 0 disables the sampler thread
+    resource_sample_period: float = dataclasses.field(
+        default_factory=lambda: float(
+            _setting("resource_sample_period", "5")
+        )
+    )
 
     def experiment_location(self, experiment_name: str) -> Path:
         return Path(self.storage_home) / "experiments" / experiment_name

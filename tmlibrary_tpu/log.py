@@ -53,3 +53,12 @@ def warn_once(logger: logging.Logger, key: str, message: str, *args) -> None:
         return
     _WARNED.add(key)
     logger.warning(message, *args)
+
+
+def reset_warned() -> None:
+    """Clear the :func:`warn_once` suppression set.
+
+    The set is process-global, so a warning suppressed in one test would
+    otherwise hide the assertion target of another — ``tests/conftest.py``
+    calls this between tests."""
+    _WARNED.clear()

@@ -14,6 +14,8 @@ import contextlib
 import threading
 from pathlib import Path
 
+from tmlibrary_tpu import telemetry
+
 #: pipeline phases in execution order; keys of ``PipelineStats.summary()``
 PIPELINE_PHASES = ("prefetch_wait", "dispatch", "device_block", "persist")
 
@@ -30,26 +32,54 @@ class PipelineStats:
     (device starved on prefetch, or persist eating the window) is
     diagnosable from the ledger alone, without an XProf trace.
 
+    Phase timings are held in bounded-reservoir histograms
+    (``telemetry.Histogram``), so the summary carries p50/p95 alongside
+    the original ``total_s``/``max_s`` keys (ledger shape stays
+    backward-compatible).  When the telemetry registry is enabled the
+    same observations are mirrored into ``tmx_pipeline_phase_seconds``
+    registry histograms, and per-batch (phase, seconds, t0) records are
+    buffered for the executor to flush as ``span`` ledger events.
+
     Thread-safe: dispatch timings come from the main thread while
     device-block/persist timings come from persist workers.
     """
 
-    def __init__(self, depth: int, source: str = "explicit"):
+    def __init__(self, depth: int, source: str = "explicit", step: str = ""):
         self.depth = int(depth)
         self.source = source
+        self.step = step
         self._lock = threading.Lock()
-        self._total = {phase: 0.0 for phase in PIPELINE_PHASES}
-        self._max = {phase: 0.0 for phase in PIPELINE_PHASES}
-        self._count = {phase: 0 for phase in PIPELINE_PHASES}
+        self._hist = {
+            phase: telemetry.Histogram(phase, {}) for phase in PIPELINE_PHASES
+        }
+        reg = telemetry.get_registry()
+        self._reg_hist = {
+            phase: reg.histogram(
+                "tmx_pipeline_phase_seconds", step=step or "unknown",
+                phase=phase,
+            )
+            for phase in PIPELINE_PHASES
+        }
         self._batches = 0
         self._clamps: list[dict] = []
+        #: batch index → [(phase, seconds, wall t0)], drained by the
+        #: executor on the calling thread to emit ``span`` ledger events
+        self._batch_spans: dict[int, list[tuple[str, float, float]]] = {}
 
-    def record(self, phase: str, seconds: float) -> None:
+    def record(self, phase: str, seconds: float,
+               batch: int | None = None, t0: float | None = None) -> None:
+        self._hist[phase].observe(seconds)
+        self._reg_hist[phase].observe(seconds)
+        if batch is not None and telemetry.enabled():
+            with self._lock:
+                self._batch_spans.setdefault(batch, []).append(
+                    (phase, seconds, t0 if t0 is not None else 0.0)
+                )
+
+    def pop_batch_spans(self, batch: int) -> list[tuple[str, float, float]]:
+        """Drain the buffered phase records for ``batch`` (span emission)."""
         with self._lock:
-            self._total[phase] += seconds
-            self._count[phase] += 1
-            if seconds > self._max[phase]:
-                self._max[phase] = seconds
+            return self._batch_spans.pop(batch, [])
 
     def batch_done(self) -> None:
         with self._lock:
@@ -61,24 +91,36 @@ class PipelineStats:
             self.depth = int(to_depth)
 
     def summary(self) -> dict:
-        """JSON-ready roll-up for the run ledger."""
+        """JSON-ready roll-up for the run ledger.
+
+        ``total_s``/``max_s`` keys are load-bearing (pinned by
+        ``tests/test_pipelined.py`` and rendered by ``tmx … status``);
+        ``p50_s``/``p95_s``/``count`` are additive.
+        """
         with self._lock:
-            out = {
-                "depth": self.depth,
-                "source": self.source,
-                "n_batches": self._batches,
-                "phases": {
-                    phase: {
-                        "total_s": round(self._total[phase], 4),
-                        "max_s": round(self._max[phase], 4),
-                    }
-                    for phase in PIPELINE_PHASES
-                    if self._count[phase]
-                },
+            batches = self._batches
+            clamps = list(self._clamps)
+        phases = {}
+        for phase in PIPELINE_PHASES:
+            hist = self._hist[phase]
+            if not hist.count:
+                continue
+            phases[phase] = {
+                "total_s": round(hist.sum, 4),
+                "max_s": round(hist.max, 4),
+                "p50_s": round(hist.quantile(0.5), 4),
+                "p95_s": round(hist.quantile(0.95), 4),
+                "count": hist.count,
             }
-            if self._clamps:
-                out["depth_clamps"] = list(self._clamps)
-            return out
+        out = {
+            "depth": self.depth,
+            "source": self.source,
+            "n_batches": batches,
+            "phases": phases,
+        }
+        if clamps:
+            out["depth_clamps"] = clamps
+        return out
 
 
 @contextlib.contextmanager
@@ -87,7 +129,10 @@ def device_trace(log_dir: str | Path | None):
 
     No-op when ``log_dir`` is None so call sites can pass the CLI flag
     straight through.  The trace directory is TensorBoard-compatible
-    (``tensorboard --logdir <dir>`` → Profile tab / xprof).
+    (``tensorboard --logdir <dir>`` → Profile tab / xprof).  While the
+    trace is active, telemetry spans double as
+    ``jax.profiler.TraceAnnotation`` scopes so host spans line up with
+    device timelines in XProf.
     """
     if log_dir is None:
         yield
@@ -96,7 +141,9 @@ def device_trace(log_dir: str | Path | None):
 
     path = Path(log_dir)
     path.mkdir(parents=True, exist_ok=True)
-    with jax.profiler.trace(str(path)):
-        yield
-
-
+    telemetry.set_trace_bridge(True)
+    try:
+        with jax.profiler.trace(str(path)):
+            yield
+    finally:
+        telemetry.set_trace_bridge(False)

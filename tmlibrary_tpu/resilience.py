@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from tmlibrary_tpu import telemetry
 from tmlibrary_tpu.errors import (
     FaultInjected,
     JobDescriptionError,
@@ -185,6 +186,7 @@ def retry_call(
         logger.warning("%s failed (%s: %s) — retry %d/%d in %.2fs",
                        describe, type(last).__name__, last,
                        attempt, policy.max_attempts - 1, pause)
+        telemetry.get_registry().counter("tmx_retry_attempts_total").inc()
         sleep(pause)
     return RetryOutcome(error=last, attempts=attempt, classification=cls)
 
@@ -250,6 +252,10 @@ class CircuitBreaker:
         return self.state != "open"
 
     def record_success(self) -> None:
+        if self.opened_at is not None:
+            telemetry.get_registry().counter(
+                "tmx_breaker_transitions_total", to="closed"
+            ).inc()
         self.failures = 0
         self.opened_at = None
         self.cooldown = self.base_cooldown
@@ -260,8 +266,14 @@ class CircuitBreaker:
             # a failed half-open probe: re-open and back off harder
             self.cooldown = min(self.max_cooldown, self.cooldown * 2.0)
             self.opened_at = self._clock()
+            telemetry.get_registry().counter(
+                "tmx_breaker_transitions_total", to="open"
+            ).inc()
         elif self.failures >= self.failure_threshold:
             self.opened_at = self._clock()
+            telemetry.get_registry().counter(
+                "tmx_breaker_transitions_total", to="open"
+            ).inc()
 
 
 def _default_probe() -> bool:
@@ -335,6 +347,9 @@ class DeviceHealthGuard:
 
     def _degrade(self, ledger, where: str) -> None:
         self.degraded = True
+        telemetry.get_registry().counter(
+            "tmx_backend_degradations_total"
+        ).inc()
         logger.error(
             "device path is down (breaker open after %d failures) — "
             "degrading to the CPU backend", self.breaker.failures,

@@ -1,0 +1,900 @@
+"""Unified telemetry: metrics registry, span tracing, resource sampling.
+
+Reference parity: the reference stack has no first-class telemetry — GC3Pie
+keeps per-job wall/cpu time in submission tables and everything else is
+hand-read from logs (SURVEY.md §6).  The TPU rebuild's run ledger already
+captures per-batch wall time; this module aggregates it into queryable
+metrics and adds what the ledger alone cannot show:
+
+* a process-wide :class:`MetricsRegistry` — counters, gauges and
+  bounded-reservoir histograms (p50/p95/max) — fed by the workflow engine,
+  the pipelined executor, ``resilience.py`` and the throughput-critical
+  steps (corilla/illuminati/jterator);
+* lightweight nested **spans** (run → step → batch → phase) recorded as
+  ``span`` events in the run ledger and, while ``profiling.device_trace``
+  is active, bridged into ``jax.profiler.TraceAnnotation`` so host spans
+  line up with device traces in XProf;
+* a :class:`ResourceSampler` daemon thread (RSS, open file handles, jax
+  device memory when available) that also maintains a heartbeat timestamp
+  file consumed by ``tmx workflow status`` and ``scripts/tpu_watch.py``;
+* export surfaces: Prometheus textfile format and JSON, renderable from
+  the live registry or derived post-hoc from any ledger
+  (:func:`registry_from_ledger`), plus a span-tree builder with
+  critical-path annotation for ``tmx trace``.
+
+Telemetry is zero-cost-when-disabled: a disabled registry hands out shared
+null instruments whose methods are no-ops, and :func:`span` yields without
+touching clocks.  Nothing here may perturb numeric results — a
+telemetry-on run stays bit-identical to telemetry-off (pinned by
+``tests/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any, Callable, Iterable, Iterator
+
+from tmlibrary_tpu.errors import FaultInjected
+
+logger = logging.getLogger(__name__)
+
+#: cap on per-histogram reservoir samples; bounds memory for long runs
+RESERVOIR_SIZE = 512
+
+HEARTBEAT_FILENAME = "heartbeat.json"
+
+
+# ---------------------------------------------------------------------------
+# instruments
+
+
+def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonically increasing count."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-written instantaneous value."""
+
+    __slots__ = ("name", "labels", "_lock", "_value")
+
+    def __init__(self, name: str, labels: dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Bounded-reservoir distribution: exact count/sum/max, sampled quantiles.
+
+    The reservoir keeps the most recent :data:`RESERVOIR_SIZE` observations
+    (ring buffer) — enough for stable p50/p95 on per-batch timings while
+    bounding memory on runs with hundreds of thousands of batches.
+    """
+
+    __slots__ = ("name", "labels", "_lock", "_count", "_sum", "_max",
+                 "_reservoir", "_next")
+
+    def __init__(self, name: str, labels: dict[str, str]):
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._reservoir: list[float] = []
+        self._next = 0
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += value
+            if value > self._max:
+                self._max = value
+            if len(self._reservoir) < RESERVOIR_SIZE:
+                self._reservoir.append(value)
+            else:
+                self._reservoir[self._next] = value
+                self._next = (self._next + 1) % RESERVOIR_SIZE
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def max(self) -> float:
+        with self._lock:
+            return self._max
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            sample = sorted(self._reservoir)
+        if not sample:
+            return 0.0
+        idx = min(len(sample) - 1, max(0, int(round(q * (len(sample) - 1)))))
+        return sample[idx]
+
+    def summary(self) -> dict:
+        with self._lock:
+            sample = sorted(self._reservoir)
+            count, total, vmax = self._count, self._sum, self._max
+        out = {"count": count, "sum": round(total, 6), "max": round(vmax, 6)}
+        if sample:
+            def _q(q: float) -> float:
+                idx = min(len(sample) - 1,
+                          max(0, int(round(q * (len(sample) - 1)))))
+                return round(sample[idx], 6)
+            out["p50"] = _q(0.5)
+            out["p95"] = _q(0.95)
+        return out
+
+
+class ThroughputTracker:
+    """Units/sec gauge using the same wall-clock math as ``bench.py``.
+
+    ``bench.py`` divides units of work by ``time.perf_counter`` wall time;
+    call sites here do the same per batch — measure the batch with
+    ``perf_counter`` and :meth:`add` ``(units, seconds)`` — so the gauge
+    (cumulative units / cumulative seconds) converges to the bench figure
+    for the same workload.
+    """
+
+    __slots__ = ("_gauge", "_counter", "_lock", "_seconds", "_units")
+
+    def __init__(self, gauge: "Gauge | _NullGauge",
+                 counter: "Counter | _NullCounter"):
+        self._gauge = gauge
+        self._counter = counter
+        self._lock = threading.Lock()
+        self._seconds = 0.0
+        self._units = 0.0
+
+    def add(self, units: float, seconds: float) -> None:
+        with self._lock:
+            self._units += units
+            self._seconds += seconds
+            rate = self._units / self._seconds if self._seconds > 0 else 0.0
+        self._counter.inc(units)
+        self._gauge.set(rate)
+
+
+class _NullInstrument:
+    """Shared no-op instrument for the disabled registry."""
+
+    __slots__ = ()
+    name = ""
+    labels: dict[str, str] = {}
+    value = 0.0
+    count = 0
+    sum = 0.0
+    max = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def add(self, units: float, seconds: float = 0.0) -> None:
+        pass
+
+    def quantile(self, q: float) -> float:
+        return 0.0
+
+    def summary(self) -> dict:
+        return {"count": 0, "sum": 0.0, "max": 0.0}
+
+
+_NullCounter = _NullGauge = _NullHistogram = _NullInstrument
+_NULL = _NullInstrument()
+
+
+class MetricsRegistry:
+    """Thread-safe, process-wide instrument store.
+
+    When ``enabled`` is False every accessor returns the shared null
+    instrument, so instrumented call sites cost one attribute lookup and a
+    no-op method call — nothing allocates and no lock is taken.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._instruments: dict[tuple, Any] = {}
+        self._trackers: dict[str, ThroughputTracker] = {}
+
+    def _get(self, cls, name: str, labels: dict[str, str]):
+        if not self.enabled:
+            return _NULL
+        key = (cls.__name__, name, _label_key(labels))
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                inst = cls(name, labels)
+                self._instruments[key] = inst
+            return inst
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels: str) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def throughput(self, name: str, **labels: str) -> ThroughputTracker:
+        """Units/sec gauge ``<name>`` backed by counter ``<name>_units_total``."""
+        if not self.enabled:
+            return _NULL
+        key = f"{name}|{_label_key(labels)}"
+        with self._lock:
+            tracker = self._trackers.get(key)
+        if tracker is None:
+            tracker = ThroughputTracker(
+                self.gauge(name, **labels),
+                self.counter(name + "_units_total", **labels),
+            )
+            with self._lock:
+                tracker = self._trackers.setdefault(key, tracker)
+        return tracker
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+            self._trackers.clear()
+
+    def snapshot(self) -> dict:
+        """JSON-ready dump of every instrument, stable ordering."""
+        with self._lock:
+            instruments = sorted(self._instruments.items())
+        out: dict[str, list] = {"counters": [], "gauges": [], "histograms": []}
+        for (kind, _name, _labels), inst in instruments:
+            entry = {"name": inst.name, "labels": dict(inst.labels)}
+            if kind == "Counter":
+                entry["value"] = inst.value
+                out["counters"].append(entry)
+            elif kind == "Gauge":
+                entry["value"] = round(inst.value, 6)
+                out["gauges"].append(entry)
+            else:
+                entry.update(inst.summary())
+                out["histograms"].append(entry)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# module-level registry
+
+_registry: MetricsRegistry | None = None
+_registry_lock = threading.Lock()
+
+
+def _default_enabled() -> bool:
+    from tmlibrary_tpu.config import cfg
+
+    return bool(getattr(cfg, "telemetry", True))
+
+
+def get_registry() -> MetricsRegistry:
+    global _registry
+    reg = _registry
+    if reg is None:
+        with _registry_lock:
+            reg = _registry
+            if reg is None:
+                reg = _registry = MetricsRegistry(enabled=_default_enabled())
+    return reg
+
+
+def enabled() -> bool:
+    return get_registry().enabled
+
+
+def set_enabled(flag: bool) -> None:
+    get_registry().enabled = bool(flag)
+
+
+def reset_registry(enabled: bool | None = None) -> MetricsRegistry:
+    """Replace the process registry (tests, fresh CLI runs)."""
+    global _registry
+    with _registry_lock:
+        _registry = MetricsRegistry(
+            enabled=_default_enabled() if enabled is None else enabled
+        )
+    return _registry
+
+
+# ---------------------------------------------------------------------------
+# span tracing
+
+_trace_bridge = threading.Event()
+
+
+def set_trace_bridge(active: bool) -> None:
+    """Toggled by ``profiling.device_trace`` so spans double as
+    ``jax.profiler.TraceAnnotation`` scopes only while a device trace is
+    being captured (TraceAnnotation outside a trace is wasted work)."""
+    if active:
+        _trace_bridge.set()
+    else:
+        _trace_bridge.clear()
+
+
+_span_local = threading.local()
+
+
+def _span_stack() -> list[str]:
+    stack = getattr(_span_local, "stack", None)
+    if stack is None:
+        stack = _span_local.stack = []
+    return stack
+
+
+@contextlib.contextmanager
+def span(name: str, emit: Callable[..., Any] | None = None,
+         **attrs: Any) -> Iterator[None]:
+    """Nested host span; records a ``span`` ledger event via ``emit``.
+
+    ``emit`` is typically ``RunLedger.append`` partial-applied with the
+    step/batch context.  Zero-cost when telemetry is disabled.
+    """
+    if not enabled():
+        yield
+        return
+    stack = _span_stack()
+    stack.append(name)
+    path = "/".join(stack)
+    annotation = None
+    if _trace_bridge.is_set():
+        try:
+            import jax
+
+            annotation = jax.profiler.TraceAnnotation(path)
+            annotation.__enter__()
+        except Exception:  # pragma: no cover - profiler unavailable
+            annotation = None
+    t0 = time.time()
+    p0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - p0
+        if annotation is not None:
+            with contextlib.suppress(Exception):
+                annotation.__exit__(None, None, None)
+        stack.pop()
+        # a fatal injected fault simulates hard process death — a dead
+        # process writes nothing, so the span must not land either (the
+        # chaos suite pins that the torn ledger line stays trailing)
+        exc = sys.exc_info()[1]
+        if isinstance(exc, FaultInjected) and exc.fatal:
+            emit = None
+        if emit is not None:
+            try:
+                emit(event="span", span=name, path=path, t0=round(t0, 6),
+                     elapsed=round(elapsed, 6), **attrs)
+            except Exception:
+                logger.debug("span emit failed for %s", path, exc_info=True)
+
+
+# ---------------------------------------------------------------------------
+# resource sampler
+
+
+def _rss_bytes() -> int | None:
+    try:
+        with open("/proc/self/statm") as fh:
+            fields = fh.read().split()
+        return int(fields[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        try:
+            import resource
+
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # pragma: no cover - non-POSIX
+            return None
+
+
+def _open_fds() -> int | None:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:  # pragma: no cover - non-Linux
+        return None
+
+
+def _device_memory_bytes() -> int | None:
+    """Sum of ``bytes_in_use`` across local devices, None when unknown.
+
+    Only consulted when jax is already imported — the sampler must never
+    be the thing that initialises a backend.
+    """
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax
+
+        total = 0
+        seen = False
+        for dev in jax.local_devices():
+            stats = dev.memory_stats()
+            if stats and "bytes_in_use" in stats:
+                total += int(stats["bytes_in_use"])
+                seen = True
+        return total if seen else None
+    except Exception:
+        return None
+
+
+def write_heartbeat(path: Path, period: float,
+                    extra: dict | None = None) -> None:
+    """Atomically write the heartbeat timestamp file."""
+    payload = {"ts": time.time(), "pid": os.getpid(), "period": period}
+    if extra:
+        payload.update(extra)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(payload))
+    tmp.replace(path)
+
+
+def read_heartbeat(path: Path) -> dict | None:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+
+
+def heartbeat_age(path: Path, now: float | None = None) -> float | None:
+    hb = read_heartbeat(path)
+    if hb is None or "ts" not in hb:
+        return None
+    return (time.time() if now is None else now) - float(hb["ts"])
+
+
+class ResourceSampler:
+    """Daemon thread sampling process/device resources on a fixed period.
+
+    Each tick sets gauges (``tmx_process_rss_bytes``,
+    ``tmx_process_open_fds``, ``tmx_device_bytes_in_use``) and refreshes the
+    heartbeat file so ``tmx workflow status`` and ``scripts/tpu_watch.py``
+    can tell a hung run from a slow one.
+    """
+
+    def __init__(self, period: float, heartbeat_path: Path | None = None,
+                 registry: MetricsRegistry | None = None):
+        self.period = max(float(period), 0.1)
+        self.heartbeat_path = (
+            Path(heartbeat_path) if heartbeat_path is not None else None
+        )
+        self.registry = registry if registry is not None else get_registry()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def sample_once(self) -> dict:
+        sample: dict[str, Any] = {}
+        rss = _rss_bytes()
+        if rss is not None:
+            sample["rss_bytes"] = rss
+            self.registry.gauge("tmx_process_rss_bytes").set(rss)
+        fds = _open_fds()
+        if fds is not None:
+            sample["open_fds"] = fds
+            self.registry.gauge("tmx_process_open_fds").set(fds)
+        dev = _device_memory_bytes()
+        if dev is not None:
+            sample["device_bytes_in_use"] = dev
+            self.registry.gauge("tmx_device_bytes_in_use").set(dev)
+        if self.heartbeat_path is not None:
+            try:
+                write_heartbeat(self.heartbeat_path, self.period, extra=sample)
+            except OSError:
+                logger.debug("heartbeat write failed", exc_info=True)
+        return sample
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sample_once()
+            except Exception:  # pragma: no cover - defensive
+                logger.debug("resource sample failed", exc_info=True)
+            self._stop.wait(self.period)
+
+    def start(self) -> "ResourceSampler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tmx-resource-sampler", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def __enter__(self) -> "ResourceSampler":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+# ---------------------------------------------------------------------------
+# export: Prometheus textfile + JSON
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_line(name: str, labels: dict[str, str], value: float,
+               extra_labels: dict[str, str] | None = None) -> str:
+    merged = dict(labels)
+    if extra_labels:
+        merged.update(extra_labels)
+    if merged:
+        inner = ",".join(
+            f'{k}="{_prom_escape(str(v))}"' for k, v in sorted(merged.items())
+        )
+        return f"{name}{{{inner}}} {value:g}"
+    return f"{name} {value:g}"
+
+
+def render_prometheus(snapshot: dict) -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` as Prometheus textfile
+    exposition format (counters, gauges, histograms-as-summaries)."""
+    lines: list[str] = []
+    seen_types: set[str] = set()
+
+    def _header(name: str, kind: str) -> None:
+        if name not in seen_types:
+            seen_types.add(name)
+            lines.append(f"# TYPE {name} {kind}")
+
+    for entry in snapshot.get("counters", []):
+        _header(entry["name"], "counter")
+        lines.append(_prom_line(entry["name"], entry["labels"], entry["value"]))
+    for entry in snapshot.get("gauges", []):
+        _header(entry["name"], "gauge")
+        lines.append(_prom_line(entry["name"], entry["labels"], entry["value"]))
+    for entry in snapshot.get("histograms", []):
+        name = entry["name"]
+        _header(name, "summary")
+        labels = entry["labels"]
+        for q_key, q in (("p50", "0.5"), ("p95", "0.95")):
+            if q_key in entry:
+                lines.append(
+                    _prom_line(name, labels, entry[q_key], {"quantile": q})
+                )
+        lines.append(_prom_line(name + "_sum", labels, entry["sum"]))
+        lines.append(_prom_line(name + "_count", labels, entry["count"]))
+        lines.append(_prom_line(name + "_max", labels, entry["max"]))
+    return "\n".join(lines) + "\n"
+
+
+def render_json(snapshot: dict) -> str:
+    return json.dumps(snapshot, indent=2, sort_keys=True)
+
+
+def _prom_unescape(value: str) -> str:
+    """Inverse of :func:`_prom_escape` (``\\\\``, ``\\"``, ``\\n``)."""
+    out: list[str] = []
+    i = 0
+    while i < len(value):
+        ch = value[i]
+        if ch == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+            if nxt in ('"', "\\"):
+                out.append(nxt)
+                i += 2
+                continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+def parse_prometheus(text: str) -> list[tuple[str, dict[str, str], float]]:
+    """Minimal exposition-format parser (used by tests to validate output).
+
+    Returns ``(name, labels, value)`` samples; raises ``ValueError`` on any
+    malformed line so tests can assert validity of the rendered output.
+    """
+    samples: list[tuple[str, dict[str, str], float]] = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) < 4 or parts[1] not in ("TYPE", "HELP"):
+                raise ValueError(f"line {lineno}: bad comment {line!r}")
+            continue
+        name, labels, rest = line, {}, None
+        if "{" in line:
+            name, _, tail = line.partition("{")
+            body, _, rest = tail.rpartition("}")
+            if not rest or not rest.strip():
+                raise ValueError(f"line {lineno}: bad sample {line!r}")
+            for item in body.split(","):
+                if not item:
+                    continue
+                k, _, v = item.partition("=")
+                if not (v.startswith('"') and v.endswith('"')):
+                    raise ValueError(f"line {lineno}: bad label {item!r}")
+                labels[k] = _prom_unescape(v[1:-1])
+        else:
+            name, _, rest = line.partition(" ")
+        if not name or not name.replace("_", "").replace(":", "").isalnum():
+            raise ValueError(f"line {lineno}: bad metric name {name!r}")
+        try:
+            value = float(rest.strip().split()[0])
+        except (ValueError, IndexError, AttributeError):
+            raise ValueError(f"line {lineno}: bad value in {line!r}")
+        samples.append((name, labels, value))
+    return samples
+
+
+# ---------------------------------------------------------------------------
+# ledger → metrics derivation (post-hoc inspection of any run, incl. seed-era)
+
+
+def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
+    """Derive a metrics registry from run-ledger events.
+
+    Works on seed-era ledgers (``batch_done``/``step_done`` only) as well
+    as telemetry-era ledgers carrying ``span`` events — old runs stay
+    inspectable with the same ``tmx metrics`` surface.
+    """
+    reg = MetricsRegistry(enabled=True)
+    step_units: dict[str, dict[str, float]] = {}
+    for ev in events:
+        kind = ev.get("event")
+        step = str(ev.get("step", "")) or "unknown"
+        if kind == "run_started":
+            reg.counter("tmx_runs_total").inc()
+        elif kind == "batch_done":
+            reg.counter("tmx_batches_done_total", step=step).inc()
+            if "elapsed" in ev:
+                reg.histogram("tmx_batch_seconds", step=step).observe(
+                    float(ev["elapsed"])
+                )
+            attempts = int(ev.get("attempts", 1) or 1)
+            if attempts > 1:
+                reg.counter("tmx_batch_retries_total", step=step).inc(
+                    attempts - 1
+                )
+            result = ev.get("result") or {}
+            if isinstance(result, dict):
+                acc = step_units.setdefault(
+                    step, {"units": 0.0, "seconds": 0.0}
+                )
+                acc["seconds"] += float(ev.get("elapsed", 0.0) or 0.0)
+                for key in ("n_sites", "n_tiles"):
+                    if key in result:
+                        acc["units"] += float(result[key])
+                        break
+                else:
+                    acc["units"] += 1.0
+        elif kind == "batch_failed":
+            reg.counter("tmx_batches_failed_total", step=step).inc()
+        elif kind in ("step_done", "step_partial"):
+            if kind == "step_partial":
+                reg.counter("tmx_steps_partial_total", step=step).inc()
+            else:
+                reg.counter("tmx_steps_done_total", step=step).inc()
+            if "elapsed" in ev:
+                reg.histogram("tmx_step_seconds", step=step).observe(
+                    float(ev["elapsed"])
+                )
+            quarantined = ev.get("quarantined") or []
+            if quarantined:
+                reg.counter("tmx_batches_quarantined_total", step=step).inc(
+                    len(quarantined)
+                )
+            ps = ev.get("pipeline_stats")
+            if isinstance(ps, dict):
+                reg.gauge("tmx_pipeline_depth", step=step).set(
+                    ps.get("depth", 0)
+                )
+                for phase, vals in (ps.get("phases") or {}).items():
+                    reg.gauge(
+                        "tmx_pipeline_phase_seconds_total",
+                        step=step, phase=phase,
+                    ).set(vals.get("total_s", 0.0))
+                    reg.gauge(
+                        "tmx_pipeline_phase_seconds_max",
+                        step=step, phase=phase,
+                    ).set(vals.get("max_s", 0.0))
+        elif kind == "step_failed":
+            reg.counter("tmx_steps_failed_total", step=step).inc()
+        elif kind == "depth_clamped":
+            reg.counter("tmx_depth_clamps_total", step=step).inc()
+        elif kind == "backend_degraded":
+            reg.counter("tmx_backend_degradations_total").inc()
+        elif kind == "span":
+            name = str(ev.get("span", "")) or "unknown"
+            if "elapsed" in ev:
+                reg.histogram("tmx_span_seconds", span=name).observe(
+                    float(ev["elapsed"])
+                )
+    for step, acc in sorted(step_units.items()):
+        if acc["seconds"] > 0:
+            reg.gauge("tmx_step_units_per_sec", step=step).set(
+                acc["units"] / acc["seconds"]
+            )
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# span tree + critical path (tmx trace)
+
+
+def build_span_tree(events: Iterable[dict]) -> dict:
+    """Assemble the run → step → batch → phase tree from ledger events.
+
+    Structure comes from event fields (``step``/``batch``/``span``), not
+    from span nesting paths, so phase spans recorded on executor worker
+    threads land under the right batch.  Ledgers without ``span`` events
+    (seed-era) still produce a tree from ``batch_done``/``step_done``
+    timing.
+    """
+    root: dict[str, Any] = {"name": "run", "elapsed": 0.0, "children": []}
+    steps: dict[str, dict] = {}
+    batches: dict[tuple[str, Any], dict] = {}
+
+    def _step_node(step: str) -> dict:
+        node = steps.get(step)
+        if node is None:
+            node = {"name": f"step:{step}", "elapsed": 0.0, "children": []}
+            steps[step] = node
+            root["children"].append(node)
+        return node
+
+    def _batch_node(step: str, batch: Any) -> dict:
+        key = (step, batch)
+        node = batches.get(key)
+        if node is None:
+            node = {"name": f"batch:{batch}", "elapsed": 0.0, "children": []}
+            batches[key] = node
+            _step_node(step)["children"].append(node)
+        return node
+
+    for ev in events:
+        kind = ev.get("event")
+        step = str(ev.get("step", "")) or "unknown"
+        if kind == "span":
+            name = str(ev.get("span", ""))
+            elapsed = float(ev.get("elapsed", 0.0) or 0.0)
+            if name == "run":
+                root["elapsed"] = elapsed
+            elif name == "step":
+                _step_node(step)["elapsed"] = elapsed
+            elif name == "batch":
+                node = _batch_node(step, ev.get("batch"))
+                node["elapsed"] = elapsed
+            else:  # phase span (prefetch_wait/dispatch/device_block/persist)
+                parent = _batch_node(step, ev.get("batch"))
+                parent["children"].append(
+                    {"name": f"phase:{name}", "elapsed": elapsed,
+                     "children": []}
+                )
+        elif kind == "batch_done":
+            node = _batch_node(step, ev.get("batch"))
+            if not node["elapsed"]:
+                node["elapsed"] = float(ev.get("elapsed", 0.0) or 0.0)
+        elif kind in ("step_done", "step_partial"):
+            node = _step_node(step)
+            if not node["elapsed"]:
+                node["elapsed"] = float(ev.get("elapsed", 0.0) or 0.0)
+    if not root["elapsed"]:
+        root["elapsed"] = round(
+            sum(c["elapsed"] for c in root["children"]), 6
+        )
+    return root
+
+
+def annotate_critical_path(node: dict) -> dict:
+    """Mark the longest child at every level with ``critical: True``.
+
+    The chain of critical nodes is the dominant cost path — for a
+    pipelined step it identifies the phase the window spends its time in
+    (matching the largest ``total_s`` in ``pipeline_stats``).
+    """
+    node.setdefault("critical", True)
+    children = node.get("children") or []
+    if children:
+        longest = max(children, key=lambda c: c.get("elapsed", 0.0))
+        for child in children:
+            child["critical"] = child is longest
+            if child is longest:
+                annotate_critical_path(child)
+            else:
+                _clear_critical(child)
+    return node
+
+
+def _clear_critical(node: dict) -> None:
+    node["critical"] = False
+    for child in node.get("children") or []:
+        _clear_critical(child)
+
+
+def render_span_tree(node: dict, indent: int = 0) -> str:
+    marker = "*" if node.get("critical") else " "
+    lines = [
+        f"{marker} {'  ' * indent}{node['name']:<24} "
+        f"{node.get('elapsed', 0.0):10.4f}s"
+    ]
+    for child in node.get("children") or []:
+        lines.append(render_span_tree(child, indent + 1))
+    return "\n".join(lines)
+
+
+def phase_totals(events: Iterable[dict]) -> dict[str, float]:
+    """Sum phase-span durations per phase name (critical-path accounting
+    cross-checkable against ``pipeline_stats`` totals)."""
+    totals: dict[str, float] = {}
+    for ev in events:
+        if ev.get("event") != "span":
+            continue
+        name = str(ev.get("span", ""))
+        if name in ("run", "step", "batch"):
+            continue
+        totals[name] = totals.get(name, 0.0) + float(ev.get("elapsed", 0.0))
+    return totals

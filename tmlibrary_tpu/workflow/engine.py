@@ -19,6 +19,7 @@ reference's contract.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import inspect
 import json
@@ -30,7 +31,7 @@ from typing import Any
 
 import yaml
 
-from tmlibrary_tpu import faults
+from tmlibrary_tpu import faults, telemetry
 from tmlibrary_tpu.errors import FaultInjected, WorkflowError
 from tmlibrary_tpu.log import warn_once
 from tmlibrary_tpu.models.store import ExperimentStore
@@ -203,12 +204,18 @@ class RunLedger:
     def __init__(self, path: Path, fsync: bool = False):
         self.path = Path(path)
         self.fsync = fsync
+        #: (mtime_ns, size) → parsed events; ``status()`` and
+        #: ``completed_batches()`` poll :meth:`events` repeatedly and the
+        #: file only grows via :meth:`append`, so re-parsing the whole
+        #: JSON-lines file on every call is pure waste
+        self._cache: tuple[tuple[int, int], list[dict]] | None = None
 
     def append(self, **event) -> None:
         event["ts"] = time.time()
         line = json.dumps(event)
         spec = faults.match("ledger_append", step=event.get("step"),
                             event=event.get("event"))
+        self._cache = None
         with open(self.path, "a") as f:
             if spec is not None:
                 # simulate the process dying mid-write: half a line, no
@@ -222,8 +229,16 @@ class RunLedger:
                 os.fsync(f.fileno())
 
     def events(self) -> list[dict]:
-        if not self.path.exists():
+        """Parsed ledger events; treat the returned list as read-only
+        (it is cached until the file changes on disk)."""
+        try:
+            st = self.path.stat()
+        except OSError:
             return []
+        key = (st.st_mtime_ns, st.st_size)
+        cached = self._cache
+        if cached is not None and cached[0] == key:
+            return cached[1]
         out = []
         for lineno, line in enumerate(self.path.read_text().splitlines(), 1):
             if not line.strip():
@@ -237,6 +252,7 @@ class RunLedger:
                     " — skipping it; resume treats the event as never "
                     "recorded", str(self.path), lineno,
                 )
+        self._cache = (key, out)
         return out
 
     def completed_steps(self) -> set[str]:
@@ -380,22 +396,67 @@ class Workflow:
                                    previous=prev, current=desc_hash)
         self.ledger.append(event="run_started", description_hash=desc_hash,
                            resume=resume)
+        telemetry.get_registry().counter("tmx_runs_total").inc()
+        sampler = self._start_sampler()
         guard = self.resilience.guard if self.resilience.enabled else None
         if guard is not None:
             guard.ensure_backend(self.ledger, where="run")
         done_steps = self.ledger.completed_steps() if resume else set()
         summary = {}
-        for stage in self.description.stages:
-            for sd in stage.steps:
-                if not sd.active:
-                    continue
-                if sd.name in done_steps:
-                    logger.info("resume: skipping completed step %s", sd.name)
-                    continue
-                if guard is not None:
-                    guard.ensure_backend(self.ledger, where=sd.name)
-                summary[sd.name] = self._run_step(sd, resume)
+        try:
+            with telemetry.span("run", emit=self.ledger.append):
+                for stage in self.description.stages:
+                    for sd in stage.steps:
+                        if not sd.active:
+                            continue
+                        if sd.name in done_steps:
+                            logger.info(
+                                "resume: skipping completed step %s", sd.name
+                            )
+                            continue
+                        if guard is not None:
+                            guard.ensure_backend(self.ledger, where=sd.name)
+                        with telemetry.span(
+                            "step",
+                            emit=functools.partial(self.ledger.append,
+                                                   step=sd.name),
+                        ):
+                            summary[sd.name] = self._run_step(sd, resume)
+        finally:
+            if sampler is not None:
+                sampler.stop()
+            self._write_metrics_snapshot()
         return summary
+
+    def _write_metrics_snapshot(self) -> None:
+        """Persist the live registry next to the ledger so ``tmx metrics``
+        exports the run's exact counters without re-deriving — written on
+        failure too (a failed run's metrics are the interesting ones)."""
+        if not telemetry.enabled():
+            return
+        try:
+            path = self.store.workflow_dir / "metrics.json"
+            path.write_text(
+                telemetry.render_json(telemetry.get_registry().snapshot())
+            )
+        except OSError:
+            logger.debug("metrics snapshot write failed", exc_info=True)
+
+    def _start_sampler(self):
+        """Start the resource sampler thread for this run when telemetry
+        is on and a sample period is configured; the heartbeat file lands
+        next to the ledger so ``tmx workflow status`` and
+        ``scripts/tpu_watch.py`` can spot a hung run."""
+        from tmlibrary_tpu.config import cfg
+
+        period = float(getattr(cfg, "resource_sample_period", 0) or 0)
+        if not telemetry.enabled() or period <= 0:
+            return None
+        return telemetry.ResourceSampler(
+            period,
+            heartbeat_path=(self.store.workflow_dir
+                            / telemetry.HEARTBEAT_FILENAME),
+        ).start()
 
     # ---------------------------------------------------------- batch level
     def _exec_batch(self, step, batch: dict) -> dict:
@@ -542,22 +603,38 @@ class Workflow:
                 depth, source = resolve_pipeline_depth(
                     explicit=self.pipeline_depth
                 )
-                pstats = PipelineStats(depth, source)
+                pstats = PipelineStats(depth, source, step=sd.name)
                 logger.info(
                     "%s: pipelined executor, in-flight depth %d (source: "
                     "%s)", sd.name, depth, source,
                 )
+            metrics = telemetry.get_registry()
             bt0 = time.time()
             with step.capture_logs("run"):  # per-step log file (§6)
                 for batch, outcome in self._iter_outcomes(step, pending,
                                                           policy, pstats):
                     current_batch = batch["index"]
                     if outcome.ok:
+                        b_elapsed = time.time() - bt0
+                        if telemetry.enabled():
+                            self.ledger.append(
+                                step=sd.name, event="span", span="batch",
+                                batch=batch["index"], t0=round(bt0, 6),
+                                elapsed=round(b_elapsed, 6),
+                            )
                         self.ledger.append(step=sd.name, event="batch_done",
                                            batch=batch["index"],
-                                           elapsed=time.time() - bt0,
+                                           elapsed=b_elapsed,
                                            attempts=outcome.attempts,
                                            result=outcome.value)
+                        metrics.counter("tmx_batches_done_total",
+                                        step=sd.name).inc()
+                        metrics.histogram("tmx_batch_seconds",
+                                          step=sd.name).observe(b_elapsed)
+                        if outcome.attempts > 1:
+                            metrics.counter("tmx_batch_retries_total",
+                                            step=sd.name).inc(
+                                                outcome.attempts - 1)
                         results.append(outcome.value)
                         bt0 = time.time()
                         continue
@@ -570,6 +647,10 @@ class Workflow:
                     }
                     self.ledger.append(step=sd.name, event="batch_failed",
                                        **failure)
+                    metrics.counter("tmx_batches_failed_total",
+                                    step=sd.name).inc()
+                    metrics.counter("tmx_batches_quarantined_total",
+                                    step=sd.name).inc()
                     failed.append(failure)
                     bt0 = time.time()
                     if len(failed) > budget:
@@ -588,6 +669,9 @@ class Workflow:
                 # collect is part of the step execution the log file
                 # covers; it sees only the surviving results
                 collected = self._call_collect(step, results)
+            metrics.histogram("tmx_step_seconds", step=sd.name).observe(
+                time.time() - t0
+            )
             extra = ({"pipeline_stats": pstats.summary()}
                      if pstats is not None else {})
             if failed:
@@ -599,11 +683,14 @@ class Workflow:
                     quarantined=sorted(f["batch"] for f in failed),
                     **extra,
                 )
+                metrics.counter("tmx_steps_partial_total",
+                                step=sd.name).inc()
                 return {"n_batches": len(batches), "collected": collected,
                         "quarantined": sorted(f["batch"] for f in failed)}
             self.ledger.append(step=sd.name, event="step_done",
                                elapsed=time.time() - t0, collected=collected,
                                **extra)
+            metrics.counter("tmx_steps_done_total", step=sd.name).inc()
             return {"n_batches": len(batches), "collected": collected}
         except FaultInjected as e:
             if e.fatal:
@@ -611,6 +698,8 @@ class Workflow:
             self.ledger.append(step=sd.name, event="step_failed",
                                error=str(e), exception=type(e).__name__,
                                batch=current_batch)
+            telemetry.get_registry().counter("tmx_steps_failed_total",
+                                             step=sd.name).inc()
             raise WorkflowError(f"step '{sd.name}' failed: {e}") from e
         except WorkflowError as e:
             # e.g. the quarantine budget overflow above; keep the original
@@ -619,9 +708,13 @@ class Workflow:
                                error=str(e),
                                exception=type(e.__cause__ or e).__name__,
                                batch=current_batch)
+            telemetry.get_registry().counter("tmx_steps_failed_total",
+                                             step=sd.name).inc()
             raise
         except Exception as e:
             self.ledger.append(step=sd.name, event="step_failed",
                                error=str(e), exception=type(e).__name__,
                                batch=current_batch)
+            telemetry.get_registry().counter("tmx_steps_failed_total",
+                                             step=sd.name).inc()
             raise WorkflowError(f"step '{sd.name}' failed: {e}") from e

@@ -223,6 +223,23 @@ class PipelinedExecutor:
                     continue  # _run_window drained: pos is the failed batch
                 raise
 
+    # ---------------------------------------------------------------- spans
+    def _flush_spans(self, batch: dict) -> None:
+        """Emit the buffered phase timings for a completed batch as
+        ``span`` events — on the calling (engine) thread, right before the
+        batch's ``(batch, result)`` is yielded, so every ledger append
+        stays on one thread and span events precede ``batch_done``."""
+        if self.stats is None or self.on_event is None:
+            return
+        idx = batch.get("index")
+        if idx is None:
+            return
+        for phase, seconds, t0 in self.stats.pop_batch_spans(idx):
+            self.on_event(
+                event="span", span=phase, batch=idx,
+                t0=round(t0, 6), elapsed=round(seconds, 6),
+            )
+
     # --------------------------------------------------------------- window
     def _run_window(self, batches: list[dict]) -> Iterator[tuple[dict, dict]]:
         step = self.step
@@ -241,22 +258,28 @@ class PipelinedExecutor:
         window: collections.deque = collections.deque()
         prefetched: dict[int, concurrent.futures.Future] = {}
 
-        def persist_task(eff: dict, ctx) -> dict:
+        def persist_task(eff: dict, ctx, idx: int) -> dict:
             if hasattr(step, "block_batch"):
+                w0 = time.time()
                 t0 = time.perf_counter()
                 step.block_batch(ctx)
                 if stats is not None:
-                    stats.record("device_block", time.perf_counter() - t0)
+                    stats.record("device_block", time.perf_counter() - t0,
+                                 batch=idx, t0=w0)
+            w0 = time.time()
             t0 = time.perf_counter()
             result = step.persist_batch(eff, ctx)
             if stats is not None:
-                stats.record("persist", time.perf_counter() - t0)
+                stats.record("persist", time.perf_counter() - t0,
+                             batch=idx, t0=w0)
                 stats.batch_done()
             return result
 
         def pop_one() -> tuple[dict, dict]:
             batch, fut = window.popleft()
-            return batch, fut.result()
+            result = fut.result()
+            self._flush_spans(batch)
+            return batch, result
 
         try:
             for i, batch in enumerate(batches):
@@ -267,19 +290,24 @@ class PipelinedExecutor:
                             prefetched[j] = prefetcher.submit(
                                 step.prefetch_batch, batches[j]
                             )
+                bidx = batch.get("index", i)
                 try:
                     pre = None
                     if i in prefetched:
+                        w0 = time.time()
                         t0 = time.perf_counter()
                         pre = prefetched.pop(i).result()
                         if stats is not None:
                             stats.record(
-                                "prefetch_wait", time.perf_counter() - t0
+                                "prefetch_wait", time.perf_counter() - t0,
+                                batch=bidx, t0=w0,
                             )
+                    w0 = time.time()
                     t0 = time.perf_counter()
                     eff, ctx = step.launch_batch(batch, pre)
                     if stats is not None:
-                        stats.record("dispatch", time.perf_counter() - t0)
+                        stats.record("dispatch", time.perf_counter() - t0,
+                                     batch=bidx, t0=w0)
                 except Exception:
                     # drain the WHOLE window: every already-launched batch
                     # persists (and the caller ledgers it) before the
@@ -288,7 +316,9 @@ class PipelinedExecutor:
                     while window:
                         yield pop_one()
                     raise
-                window.append((batch, persister.submit(persist_task, batch if eff is None else eff, ctx)))
+                window.append((batch, persister.submit(
+                    persist_task, batch if eff is None else eff, ctx, bidx
+                )))
                 while len(window) > self.depth:
                     yield pop_one()
             while window:

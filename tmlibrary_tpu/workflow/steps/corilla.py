@@ -73,6 +73,11 @@ class IlluminationStatisticsCalculator(Step):
         ]
 
     def run_batch(self, batch: dict) -> dict:
+        import time
+
+        from tmlibrary_tpu import telemetry
+
+        bt0 = time.perf_counter()
         args = batch["args"]
         cycle, channel = batch["cycle"], batch["channel"]
         exp = self.store.experiment
@@ -142,6 +147,11 @@ class IlluminationStatisticsCalculator(Step):
             )
         out.pop("hist", None)
         self.store.write_illumstats(out, cycle=cycle, channel=channel)
+        # one batch == one channel; same perf_counter wall-time math as
+        # bench.py's channels/sec metric (BASELINE.json)
+        telemetry.get_registry().throughput(
+            "tmx_corilla_channels_per_sec"
+        ).add(1, time.perf_counter() - bt0)
         return {"cycle": cycle, "channel": channel, "n_sites": int(out["n"])}
 
     def delete_previous_output(self) -> None:

@@ -55,6 +55,11 @@ class PyramidBuilder(Step):
 
     # ------------------------------------------------------------------ run
     def run_batch(self, batch: dict) -> dict:
+        import time
+
+        from tmlibrary_tpu import telemetry
+
+        bt0 = time.perf_counter()
         args = batch["args"]
         exp = self.store.experiment
         channel = batch["channel"]
@@ -163,6 +168,9 @@ class PyramidBuilder(Step):
         import json
 
         (out_dir / "layer.json").write_text(json.dumps(layer.to_dict()))
+        telemetry.get_registry().throughput(
+            "tmx_illuminati_tiles_per_sec"
+        ).add(n_tiles, time.perf_counter() - bt0)
         return {
             "channel": channel,
             "mosaic_shape": list(mosaic.shape),

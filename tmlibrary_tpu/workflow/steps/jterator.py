@@ -16,11 +16,13 @@ host-traced polygons.  Metric: sites/sec/chip (BASELINE.json).
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
 import logging
 
+from tmlibrary_tpu import telemetry
 from tmlibrary_tpu.errors import PipelineError, StoreError
 from tmlibrary_tpu.models.image import IllumstatsContainer
 from tmlibrary_tpu.utils import create_partitions
@@ -285,12 +287,36 @@ class ImageAnalysisRunner(Step):
         return batch
 
     def run_batch(self, batch: dict) -> dict:
+        self._mark_work_start()
         batch = self._effective_batch(batch)
         # .get: batch JSONs persisted by a pre-layout init lack the key
         if batch["args"].get("layout", "sites") == "spatial":
             return self._run_spatial(batch)
         result = self._launch(batch)
         return self._persist(batch, result)
+
+    # -------------------------------------------------- throughput gauge
+    # sites/sec over cumulative wall time since the first batch — the same
+    # total-units / perf_counter-wall math bench.py's
+    # jterator_*_sites_per_sec metrics use, so the live gauge converges to
+    # the bench figure for the same workload (pipelined overlap included)
+    def _mark_work_start(self) -> None:
+        if telemetry.enabled() and getattr(self, "_sites_t0", None) is None:
+            self._sites_lock = threading.Lock()
+            self._sites_t0 = time.perf_counter()
+            self._sites_done = 0
+
+    def _note_sites(self, n: int) -> None:
+        if not telemetry.enabled() or getattr(self, "_sites_t0", None) is None:
+            return
+        with self._sites_lock:
+            self._sites_done += int(n)
+            elapsed = time.perf_counter() - self._sites_t0
+            done = self._sites_done
+        reg = telemetry.get_registry()
+        reg.counter("tmx_jterator_sites_total").inc(n)
+        if elapsed > 0:
+            reg.gauge("tmx_jterator_sites_per_sec").set(done / elapsed)
 
     # ------------------------------------------------- launch/persist split
     # (the pipelined executor's step protocol — workflow/pipelined.py)
@@ -305,6 +331,7 @@ class ImageAnalysisRunner(Step):
     def launch_batch(self, batch: dict, prefetched=None):
         """Async device dispatch; returns ``(effective_batch, ctx)`` with
         un-fetched device arrays inside ``ctx``."""
+        self._mark_work_start()
         batch = self._effective_batch(batch)
         if batch["args"].get("layout", "sites") == "spatial":
             return batch, ("spatial", self._launch_spatial(batch, prefetched))
@@ -633,6 +660,7 @@ class ImageAnalysisRunner(Step):
             objects[sec_name] = count
             emit_figure(sec_name, sec_np, sec_labels)
 
+        self._note_sites(len(sites))
         return {
             "n_sites": len(sites),
             "objects": objects,
@@ -1003,6 +1031,7 @@ class ImageAnalysisRunner(Step):
                 max_obj,
                 ", ".join(f"{n} site(s) of '{k}'" for k, n in saturated.items()),
             )
+        self._note_sites(n_valid)
         return summary
 
     # ---------------------------------------------------------------- helpers
