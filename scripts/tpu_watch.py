@@ -19,7 +19,11 @@ one must not be burned on the long tail):
 3. ``profile`` — the per-stage breakdown BASELINE.md's binding-resource
    line renders from.
 4. the remaining bench configs (cheap, each flushed on capture).
-5. the remaining tune stages (sweep/kernels/glcm — the long tail).  A
+5. ``sweep:<config>`` — the per-config strategy x depth pipelined sweeps
+   (``bench.py --sweep``); their artifact is TUNING.json's
+   ``config_sweeps`` + per-backend ``reduction_strategy`` verdict, not
+   the headline cache, so they ride behind every headline number.
+6. the remaining tune stages (sweep/kernels/glcm — the long tail).  A
    sweep rerun that changes ``best_batch`` re-pends ``tune:pipeline``
    and the affected bench records; the loop re-evaluates every pass.
 
@@ -82,6 +86,11 @@ BENCH_ITEMS = [
     ("mesh", {"BENCH_CONFIG": "mesh"}),
 ]
 PRIORITY_BENCH = ("3", "3@mo256")
+
+#: configs the per-config pipelined sweep (bench.py --sweep) covers, in
+#: fire order — queued BEHIND the headline bench items: a sweep verdict
+#: improves future defaults, a headline number is evidence now
+SWEEP_CONFIGS = ("3", "2", "4", "volume", "corilla", "pyramid", "spatial")
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed
     "sweep": "batch_sweep",
@@ -266,6 +275,62 @@ def run_bench_item(
     return True
 
 
+def sweep_done(config: str) -> bool:
+    """A config's strategy x depth sweep is done when TUNING.json carries
+    its ``config_sweeps`` entry measured on a device backend (a CPU
+    sweep's verdict only sets CPU defaults — the watcher exists to get
+    hardware verdicts)."""
+    entry = (load_json(TUNING_PATH).get("config_sweeps") or {}).get(config)
+    if not entry:
+        return False
+    if _rehearsal():
+        return True
+    return entry.get("backend") not in (None, "cpu")
+
+
+def run_sweep_item(config: str, timeout_s: int = 900) -> bool:
+    """One ``bench.py --sweep`` run for ``config``; success means the
+    on-hardware verdict actually landed in TUNING.json (the sweep writes
+    its own artifact — nothing to cache here)."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith(("BENCH_", "TMX_", "TUNE_"))
+    }
+    env.update(_extra_env())
+    env.update(
+        BENCH_ATTEMPTS="1",
+        BENCH_ATTEMPT_TIMEOUT=str(max(60, timeout_s - 60)),
+        BENCH_ASSUME_ALIVE="1",
+        BENCH_SWEEP="1",
+        BENCH_CONFIG=config,
+    )
+    log(f"sweep[{config}]: running")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"sweep[{config}]: timed out")
+        return False
+    record = None
+    for line in r.stdout.splitlines():
+        if line.startswith("{"):
+            record = json.loads(line)
+    if record is None:
+        log(f"sweep[{config}]: no JSON line (rc={r.returncode}) "
+            f"stderr: {r.stderr[-200:]}")
+        return False
+    backend = record.get("backend", "")
+    if backend.startswith("cpu") and not _rehearsal():
+        log(f"sweep[{config}]: not on-hardware (backend={backend})")
+        return False
+    log(f"sweep[{config}]: verdict strategy={record.get('best_strategy')} "
+        f"depth={record.get('best_pipeline')} "
+        f"best={record.get('value')} {record.get('unit', '')}")
+    return sweep_done(config)
+
+
 def profile_done() -> bool:
     """The per-stage profile is done when captured at the CURRENT tuned
     defaults (same staleness rule as bench_done): it is the artifact
@@ -403,6 +468,7 @@ def all_pending() -> list:
         f"bench:{k}" for k, _ in BENCH_ITEMS
         if k not in PRIORITY_BENCH and not bench_done(k)
     ]
+    labels += [f"sweep:{k}" for k in SWEEP_CONFIGS if not sweep_done(k)]
     labels += [f"tune:{s}" for s in tune_pending if s != "pipeline"]
     only = set(filter(None, os.environ.get("WATCH_ONLY", "").split(",")))
     if only:
@@ -463,6 +529,12 @@ def fire_pending(pending: list) -> bool:
             captured |= ok
             if not ok:
                 break  # relay likely died; back to probing
+            last_alive = time.time()
+        elif label.startswith("sweep:"):
+            ok = run_sweep_item(label[6:])
+            captured |= ok
+            if not ok:
+                break
             last_alive = time.time()
         elif label.startswith("tune:"):
             stages = [l[5:] for l in pending if l.startswith("tune:")

@@ -251,3 +251,70 @@ def test_cached_record_carries_newer_sweep_annotation(tmp_path):
     if out.get("backend") != "tpu_cached":
         pytest.skip(f"relay answered live (backend={out.get('backend')})")
     assert "newer_tuning_sweep" not in out
+
+
+def test_cached_record_staleness_recomputed_at_emit(tmp_path):
+    """Age and the ``stale`` flag are EMIT-time properties: a 100h-old
+    record is stale past the (configurable) threshold, fresh under a
+    raised one, and the emission stamps ``emitted_at``."""
+    path = tmp_path / "BENCH_TPU.json"
+    record = {
+        "metric": "jterator_cell_painting_sites_per_sec_per_chip",
+        "value": 200.0, "vs_baseline": 4.0, "unit": "u",
+        "backend": "axon", "config": "3", "batch": 64,
+        "max_objects": 64, "site_size": 256,
+    }
+    path.write_text(json.dumps({"records": {"3": {
+        "record": record, "measured_at": "2026-08-02T00:00:00+00:00",
+        "measured_at_unix": time.time() - 100 * 3600, "provenance": "t",
+    }}}))
+    base = {
+        "BENCH_TPU_CACHE": str(path),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "64",
+    }
+    out = _run_bench(base)
+    if out.get("backend") != "tpu_cached":
+        pytest.skip(f"relay answered live: {out.get('backend')}")
+    assert 99.0 < out["cache_age_hours"] < 101.0
+    assert out["stale"] is True  # default threshold: 72h
+    assert "emitted_at" in out
+
+    out = _run_bench({**base, "BENCH_STALE_HOURS": "200"})
+    if out.get("backend") != "tpu_cached":
+        pytest.skip(f"relay answered live: {out.get('backend')}")
+    assert out["stale"] is False
+
+
+def test_cached_record_age_recovered_from_iso(tmp_path):
+    """Older cache entries carry only the ISO ``measured_at``: the age
+    must still be computed (from the parsed stamp) instead of silently
+    omitted."""
+    import datetime
+
+    path = tmp_path / "BENCH_TPU.json"
+    measured = datetime.datetime.now(
+        datetime.timezone.utc
+    ) - datetime.timedelta(hours=2)
+    record = {
+        "metric": "jterator_cell_painting_sites_per_sec_per_chip",
+        "value": 200.0, "vs_baseline": 4.0, "unit": "u",
+        "backend": "axon", "config": "3", "batch": 64,
+        "max_objects": 64, "site_size": 256,
+    }
+    path.write_text(json.dumps({"records": {"3": {
+        "record": record,
+        "measured_at": measured.isoformat(timespec="seconds"),
+        "provenance": "t",  # NOTE: no measured_at_unix
+    }}}))
+    out = _run_bench({
+        "BENCH_TPU_CACHE": str(path),
+        "BENCH_PROBE_TIMEOUT": "3",
+        "BENCH_ATTEMPTS": "1",
+        "BENCH_BATCH": "64",
+    })
+    if out.get("backend") != "tpu_cached":
+        pytest.skip(f"relay answered live: {out.get('backend')}")
+    assert 1.8 < out["cache_age_hours"] < 2.3
+    assert out["stale"] is False

@@ -1,0 +1,129 @@
+"""The per-config pipelined sweep (``bench.py --sweep``): strategy x depth
+rows timed through the production PipelinedExecutor, the verdict merged
+into TUNING.json (``config_sweeps`` + the per-backend
+``reduction_strategy`` entry the "auto" resolver consumes), one summary
+JSON line on stdout."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_sweep(env, timeout=420):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--sweep", "--child", "cpu"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu", **env},
+        capture_output=True, text=True, timeout=timeout,
+    )
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("{")]
+    assert lines, f"no JSON line: rc={proc.returncode} err={proc.stderr[-600:]}"
+    return json.loads(lines[-1])
+
+
+def test_sweep_grid_and_tuning_verdict(tmp_path):
+    """Config 3 on CPU: every (strategy, depth) cell gets a row, and the
+    winning cell's verdict lands in TUNING.json where
+    ``tuned_reduction_strategy`` finds it — the acceptance pin for the
+    sweep half of the strategy layer."""
+    tuning = tmp_path / "TUNING.json"
+    out = _run_sweep({
+        "BENCH_CONFIG": "3",
+        "BENCH_SITE_SIZE": "64",
+        "BENCH_BATCH": "4",
+        "BENCH_MAX_OBJECTS": "16",
+        "BENCH_SWEEP_DEPTHS": "1,2",
+        "BENCH_REPS": "1",
+        "TMX_TUNING_JSON": str(tuning),
+    })
+    assert out["sweep"] is True
+    assert out["config"] == "3"
+    assert out["backend"] == "cpu"
+    cells = {(r["strategy"], r["pipeline_depth"]) for r in out["rows"]}
+    assert cells == {
+        (s, d) for s in ("onehot", "sort", "scatter") for d in (1, 2)
+    }
+    assert all(r["items_per_sec"] > 0 for r in out["rows"])
+    assert out["best_strategy"] in ("onehot", "sort", "scatter")
+    assert out["best_pipeline"] in (1, 2)
+
+    doc = json.loads(tuning.read_text())
+    assert doc["written_by"] == "bench.py --sweep"
+    sweep = doc["config_sweeps"]["3"]
+    assert sweep["best_strategy"] == out["best_strategy"]
+    assert len(sweep["rows"]) == 6
+    assert doc["reduction_strategy"] == {"cpu": out["best_strategy"]}
+
+    # the runtime resolver consumes exactly what the sweep wrote
+    from tmlibrary_tpu.tuning import tuned_reduction_strategy
+
+    os.environ["TMX_TUNING_JSON"] = str(tuning)
+    try:
+        assert tuned_reduction_strategy("cpu") == out["best_strategy"]
+        assert tuned_reduction_strategy("tpu") is None
+    finally:
+        del os.environ["TMX_TUNING_JSON"]
+
+
+def test_sweep_strategy_invariant_config(tmp_path):
+    """corilla's chain has no grouped reductions: one strategy column
+    (marked invariant), depth still swept, and NO reduction_strategy
+    verdict written — sweeping noise must not set a tuned default."""
+    tuning = tmp_path / "TUNING.json"
+    out = _run_sweep({
+        "BENCH_CONFIG": "corilla",
+        "BENCH_SITE_SIZE": "32",
+        "BENCH_SITES": "8",
+        "BENCH_CHANNELS": "2",
+        "BENCH_SWEEP_DEPTHS": "1,2",
+        "BENCH_REPS": "1",
+        "TMX_TUNING_JSON": str(tuning),
+    })
+    assert out["best_strategy"] is None
+    assert [r["pipeline_depth"] for r in out["rows"]] == [1, 2]
+    assert all(r.get("strategy_invariant") for r in out["rows"])
+    doc = json.loads(tuning.read_text())
+    assert "reduction_strategy" not in doc
+    assert doc["config_sweeps"]["corilla"]["best_strategy"] is None
+
+
+def test_sweep_preserves_tune_tpu_provenance(tmp_path):
+    """A sweep merging into a file tune_tpu.py wrote must keep the
+    hardware sweep's authorship and verdicts."""
+    tuning = tmp_path / "TUNING.json"
+    tuning.write_text(json.dumps({
+        "written_by": "scripts/tune_tpu.py write_results",
+        "best_batch": 128, "best_pipeline": 16,
+        "timing_methodology": "pipelined-depth8",
+    }))
+    _run_sweep({
+        "BENCH_CONFIG": "2",
+        "BENCH_SITE_SIZE": "64",
+        "BENCH_BATCH": "4",
+        "BENCH_SWEEP_DEPTHS": "1",
+        "BENCH_REPS": "1",
+        "TMX_TUNING_JSON": str(tuning),
+    })
+    doc = json.loads(tuning.read_text())
+    assert doc["written_by"] == "scripts/tune_tpu.py write_results"
+    assert doc["best_batch"] == 128
+    assert doc["best_pipeline"] == 16
+    assert "2" in doc["config_sweeps"]
+
+
+def test_sweep_rejects_unknown_strategy(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--sweep", "--child", "cpu"],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "BENCH_CONFIG": "3",
+             "BENCH_SWEEP_STRATEGIES": "quantum",
+             "TMX_TUNING_JSON": str(tmp_path / "TUNING.json")},
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode != 0
+    assert "quantum" in proc.stderr

@@ -356,3 +356,32 @@ def test_watch_flags_stale_run_heartbeat(monkeypatch, tmp_path):
     assert w.check_run_heartbeat() is None
     monkeypatch.delenv("WATCH_RUN_ROOT")
     assert w.check_run_heartbeat() is None
+
+
+def test_sweep_queue_rides_behind_headline_bench(monkeypatch, tmp_path):
+    """The per-config strategy x depth sweeps queue behind every bench
+    item (a sweep verdict improves future defaults; a headline number is
+    evidence now), and only a DEVICE-backend verdict marks one done —
+    a CPU sweep sets CPU defaults, not the hardware answer the watcher
+    exists to capture."""
+    w = _watch(monkeypatch, tmp_path, tuning={
+        **MACHINE,
+        "config_sweeps": {
+            "3": {"backend": "axon", "best_pipeline": 8},
+            "2": {"backend": "cpu", "best_pipeline": 2},
+        },
+    })
+    assert w.sweep_done("3") is True     # device verdict
+    assert w.sweep_done("2") is False    # cpu verdict: still pending
+    assert w.sweep_done("volume") is False  # no entry
+
+    pending = w.all_pending()
+    sweep_labels = [l for l in pending if l.startswith("sweep:")]
+    assert "sweep:2" in sweep_labels and "sweep:3" not in sweep_labels
+    last_bench = max(
+        i for i, l in enumerate(pending) if l.startswith("bench:")
+    )
+    first_sweep = min(
+        i for i, l in enumerate(pending) if l.startswith("sweep:")
+    )
+    assert first_sweep > last_bench

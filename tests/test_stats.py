@@ -129,6 +129,51 @@ def test_welford_merge_numerically_hard(devices):
                                atol=1e-8)
 
 
+def test_sharded_welford_ragged_tail(stack, devices):
+    """A site count NOT divisible by the mesh size must still produce the
+    full-stack statistics: the divisible head rides the sharded path, the
+    ragged tail folds in via welford_merge (parallel/stats.py)."""
+    from tmlibrary_tpu.parallel.stats import sharded_welford
+
+    mesh = site_mesh(8)
+    ragged = jnp.asarray(stack[:27])  # 27 = 3*8 + 3
+    state = sharded_welford(ragged, mesh)
+    assert float(state.n) == 27
+
+    # exact contract: head through the sharded fold, tail scanned locally,
+    # one merge — bit-identical to composing those pieces by hand
+    head = sharded_welford(shard_batch(jnp.asarray(stack[:24]), mesh), mesh)
+    expect = welford_merge(head, welford_scan(jnp.asarray(stack[24:27])))
+    np.testing.assert_array_equal(np.asarray(state.mean), np.asarray(expect.mean))
+    np.testing.assert_array_equal(np.asarray(state.m2), np.asarray(expect.m2))
+
+    # statistical contract: tracks the sequential full-stack scan
+    out = welford_finalize(state)
+    seq = welford_finalize(welford_scan(ragged))
+    np.testing.assert_allclose(
+        np.asarray(out["mean_log"]), np.asarray(seq["mean_log"]), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["std_log"]), np.asarray(seq["std_log"]), rtol=5e-3, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(out["hist"]), np.asarray(seq["hist"])
+    )
+
+
+def test_sharded_welford_fewer_sites_than_devices(stack, devices):
+    """B < mesh size degrades to the plain local scan (no shard has a full
+    row), still bit-identical to welford_scan."""
+    from tmlibrary_tpu.parallel.stats import sharded_welford
+
+    mesh = site_mesh(8)
+    state = sharded_welford(jnp.asarray(stack[:5]), mesh)
+    expect = welford_scan(jnp.asarray(stack[:5]))
+    assert float(state.n) == 5
+    np.testing.assert_array_equal(np.asarray(state.mean), np.asarray(expect.mean))
+    np.testing.assert_array_equal(np.asarray(state.m2), np.asarray(expect.m2))
+
+
 def test_corilla_bench_cpu_reference_matches_device():
     """The corilla benchmark's numpy denominator computes the SAME
     statistics as the device welford_scan path (fair vs_baseline)."""

@@ -188,6 +188,16 @@ def build_parser() -> argparse.ArgumentParser:
              "sweep's best_pipeline on device backends, else a safe "
              "per-backend default; 1 = minimal overlap)",
     )
+    shared.add_argument(
+        "--reduction-strategy", default=None,
+        choices=("auto", "onehot", "sort", "scatter"),
+        help="grouped-reduction strategy for the measurement stack "
+             "(default: TMX_REDUCTION_STRATEGY / TM_REDUCTION_STRATEGY "
+             "config, else the bench sweep's tuned verdict in "
+             "tuning/TUNING.json, else scatter on CPU and one-hot "
+             "matmuls on accelerators; 'sort' is the exactly "
+             "deterministic path)",
+    )
     # fault-tolerance knobs (resilience.py; defaults from LibraryConfig /
     # TM_RETRY_ATTEMPTS, TM_MAX_BATCH_FAILURES, ... env)
     shared.add_argument(
@@ -578,6 +588,17 @@ def cmd_workflow(args) -> int:
 
     if args.no_telemetry:
         telemetry.set_enabled(False)
+    if getattr(args, "reduction_strategy", None):
+        import os as _os
+
+        # the env (not a plumbed parameter) because compiled programs
+        # trace lazily at first call: the request must outlive this
+        # function and be visible to every build site (ops/reduction.py
+        # resolution order; "auto" clears a stale request)
+        if args.reduction_strategy == "auto":
+            _os.environ.pop("TMX_REDUCTION_STRATEGY", None)
+        else:
+            _os.environ["TMX_REDUCTION_STRATEGY"] = args.reduction_strategy
     if args.sample_resources is not None:
         from tmlibrary_tpu.config import cfg as _cfg
 

@@ -131,6 +131,21 @@ class LibraryConfig:
     compile_cache_dir: str = dataclasses.field(
         default_factory=lambda: _setting("compile_cache_dir", "")
     )
+    # ------------------------------------------------- grouped reductions
+    #: grouped-reduction strategy for the measurement stack
+    #: ("auto" | "onehot" | "sort" | "scatter"); "auto" falls through to
+    #: the tuned TUNING.json verdict, then a backend-safe default
+    #: (ops/reduction.py documents the full resolution order — the
+    #: TMX_REDUCTION_STRATEGY env set by the CLI knob beats this setting)
+    reduction_strategy: str = dataclasses.field(
+        default_factory=lambda: _setting("reduction_strategy", "auto")
+    )
+    #: donate raw-image/stats buffers to engine-built batch programs so
+    #: XLA reuses their device memory for outputs
+    donate_buffers: bool = dataclasses.field(
+        default_factory=lambda: _setting("donate_buffers", "1").lower()
+        in ("1", "true", "yes")
+    )
     # ------------------------------------------------------- telemetry
     #: master switch for the metrics registry + span tracing
     #: (telemetry.py); off hands out null instruments — zero cost

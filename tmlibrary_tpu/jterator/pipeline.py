@@ -40,9 +40,10 @@ from tmlibrary_tpu.parallel.compat import shard_map
 #: otherwise pay a full re-trace + XLA load per instance, which at
 #: plate-batch granularity is pure overhead (~1 s/run measured on the
 #: CPU backend).  Keyed by the description's full content, the object
-#: cap, the crop window, the backend, and every env knob that changes
-#: what the trace emits (TMX_PALLAS kernel override, TMX_NATIVE CPU
-#: kill switch, TMX_SITE_STATS measure-kernel gate).  Bounded FIFO: a
+#: cap, the crop window, the backend, the donation flag, the resolved
+#: reduction-strategy request, and every env knob that changes what the
+#: trace emits (TMX_PALLAS kernel override, TMX_NATIVE CPU kill switch,
+#: TMX_SITE_STATS measure-kernel gate).  Bounded FIFO: a
 #: long-lived service crossing many experiments (each align crop window
 #: is a distinct key) must not retain every compiled program forever.
 _BATCH_FN_CACHE: dict[tuple, Callable] = {}
@@ -57,20 +58,51 @@ def _description_cache_key(description: PipelineDescription) -> str:
     )
 
 
+def donation_enabled() -> bool:
+    """Whether engine-built batch programs donate their input buffers by
+    default (``TM_DONATE_BUFFERS`` env / INI ``donate_buffers``; on unless
+    explicitly disabled).  Donation lets XLA reuse the raw-image HBM for
+    outputs — safe in the engine because every launch transfers fresh host
+    arrays; callers that re-invoke the program on the SAME device buffers
+    (bench's fetch-amortized timing loop) must build with
+    ``donate=False``."""
+    from tmlibrary_tpu.config import _setting
+
+    value = str(_setting("donate_buffers", "1")).strip().lower()
+    return value not in ("0", "false", "no", "off")
+
+
 def cached_batch_fn(
     description: PipelineDescription,
     max_objects: int,
     window: "tuple[int, int, int, int] | None" = None,
+    donate: "bool | None" = None,
+    reduction_strategy: "str | None" = None,
 ) -> Callable:
     """Memoized :meth:`ImageAnalysisPipeline.build_batch_fn` — same
-    compiled program for the same (description, cap, window, backend)."""
+    compiled program for the same (description, cap, window, backend,
+    donation, reduction-strategy request).  ``donate=None`` resolves the
+    :func:`donation_enabled` config default; ``reduction_strategy=None``
+    resolves the live request chain (env/config/tuned verdict) so a CLI
+    ``--reduction-strategy`` run never reuses a program compiled for a
+    different strategy."""
     import os
 
+    from tmlibrary_tpu.ops import reduction
+
+    donate = donation_enabled() if donate is None else bool(donate)
+    requested = (
+        reduction_strategy
+        if reduction_strategy not in (None, "auto")
+        else reduction.requested_reduction_strategy()
+    )
     key = (
         _description_cache_key(description),
         max_objects,
         window,
         jax.default_backend(),
+        donate,
+        requested,
         os.environ.get("TMX_PALLAS"),
         os.environ.get("TMX_NATIVE"),
         os.environ.get("TMX_SITE_STATS"),
@@ -79,7 +111,9 @@ def cached_batch_fn(
     fn = _BATCH_FN_CACHE.get(key)
     if fn is None:
         pipe = ImageAnalysisPipeline(description, max_objects=max_objects)
-        fn = pipe.build_batch_fn(window=window)
+        fn = pipe.build_batch_fn(
+            window=window, donate=donate, reduction_strategy=requested
+        )
         while len(_BATCH_FN_CACHE) >= _BATCH_FN_CACHE_MAX:
             _BATCH_FN_CACHE.pop(next(iter(_BATCH_FN_CACHE)))
         _BATCH_FN_CACHE[key] = fn
@@ -250,7 +284,11 @@ class ImageAnalysisPipeline:
 
     # ------------------------------------------------------------ batch fn
     def build_batch_fn(
-        self, window: tuple[int, int, int, int] | None = None, jit: bool = True
+        self,
+        window: tuple[int, int, int, int] | None = None,
+        jit: bool = True,
+        donate: bool = False,
+        reduction_strategy: str | None = None,
     ) -> Callable:
         """jit(vmap(preprocess ∘ site_fn)) over the site-batch axis.
 
@@ -259,30 +297,55 @@ class ImageAnalysisPipeline:
         leaf.  ``stats`` fields broadcast (shared per channel).
         ``jit=False`` returns the traceable vmapped function (for callers
         composing their own jit, e.g. with explicit shardings).
+
+        ``donate=True`` donates all three arguments (raw images, stats,
+        shifts) to the compiled program so XLA reuses their device memory
+        for outputs — the inputs are dead after the call, which is true
+        for the engine's launch path (fresh host→device transfers each
+        batch) but NOT for timing loops that re-invoke on the same
+        buffers.
+
+        ``reduction_strategy`` pins the grouped-reduction request for the
+        whole program at build time (``ops/reduction.py``); ``None``/
+        ``"auto"`` captures the live request chain once, so the lazy
+        first-call trace cannot diverge from the build-time decision the
+        compiled-program cache keyed on.
         """
+        from tmlibrary_tpu.ops import reduction
+
+        requested = (
+            reduction_strategy
+            if reduction_strategy not in (None, "auto")
+            else reduction.requested_reduction_strategy()
+        )
         site_fn = self.build_site_fn()
         preprocess = self.build_preprocess_fn(window)
 
         def one_site(raw, stats, shift):
-            images = preprocess(raw, stats, shift)
-            # pass loaded objects (if any) through; label images loaded
-            # from the store live in the uncropped site frame, so they get
-            # the same intersection crop as the pixel channels
-            for key, val in raw.items():
-                if key not in images:
-                    if window is not None and jnp.ndim(val) == 2:
-                        val = image_ops.crop_window(val, *window)
-                    images[key] = val
-            return site_fn(images)
+            with reduction.strategy_scope(requested):
+                images = preprocess(raw, stats, shift)
+                # pass loaded objects (if any) through; label images loaded
+                # from the store live in the uncropped site frame, so they
+                # get the same intersection crop as the pixel channels
+                for key, val in raw.items():
+                    if key not in images:
+                        if window is not None and jnp.ndim(val) == 2:
+                            val = image_ops.crop_window(val, *window)
+                        images[key] = val
+                return site_fn(images)
 
         batched = jax.vmap(one_site, in_axes=(0, None, 0))
-        return jax.jit(batched) if jit else batched
+        if not jit:
+            return batched
+        return jax.jit(batched, donate_argnums=(0, 1, 2) if donate else ())
 
     def build_sharded_batch_fn(
         self,
         mesh,
         axis: str | tuple[str, ...] = "sites",
         window: tuple[int, int, int, int] | None = None,
+        donate: bool = False,
+        reduction_strategy: str | None = None,
     ) -> Callable:
         """``jit(shard_map(vmap(site_fn)))`` over a site mesh — the
         multi-chip form of :meth:`build_batch_fn`.
@@ -305,7 +368,9 @@ class ImageAnalysisPipeline:
         """
         from jax.sharding import PartitionSpec as P
 
-        batched = self.build_batch_fn(window, jit=False)
+        batched = self.build_batch_fn(
+            window, jit=False, reduction_strategy=reduction_strategy
+        )
         # check_vma off: the iterative ops' while loops carry literal
         # bool flags, which the varying-axes checker rejects under
         # shard_map (carry starts unvarying, body output is varying).
@@ -318,4 +383,4 @@ class ImageAnalysisPipeline:
             out_specs=P(axis),
             check_vma=False,
         )
-        return jax.jit(mapped)
+        return jax.jit(mapped, donate_argnums=(0, 1, 2) if donate else ())

@@ -24,6 +24,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from tmlibrary_tpu.ops.label import shift_with_fill
+from tmlibrary_tpu.ops.reduction import (
+    explicit_reduction_request,
+    resolve_reduction_strategy,
+    segmented_max,
+    segmented_min,
+    segmented_sum,
+)
 
 
 def _seg_sum(values: jax.Array, labels: jax.Array, max_objects: int) -> jax.Array:
@@ -53,24 +60,31 @@ def grouped_sums(
     site-batch vmap multiplies it).  Returns ``(max_objects, n_channels)``
     float32 (label ids 1..max_objects; background dropped).
 
-    ``method="auto"`` picks the matmul on accelerators and a plain
-    ``segment_sum`` scatter on CPU, where scatters are cheap and the
-    one-hot materialization is the bottleneck (~25x for the measurement
-    stack on the test backend).
+    ``method`` is a reduction-strategy name (``ops/reduction.py``):
+    ``"onehot"`` (alias ``"matmul"``) is the chunked MXU contraction,
+    ``"scatter"`` the segment scatter-add, ``"sort"`` the deterministic
+    sorted-run reduction, ``"native"`` the explicit-opt-in C callback.
+    ``"auto"`` resolves through the strategy layer — by default the
+    matmul on accelerators and the scatter on CPU, where scatters are
+    cheap and the one-hot materialization is the bottleneck (~25x for
+    the measurement stack on the test backend).
     """
     flat = labels.reshape(-1)
     stacked = jnp.stack(
         [jnp.asarray(c, jnp.float32).reshape(-1) for c in channels], axis=-1
     )  # (P, S)
     if method == "auto":
-        # scatter stays the CPU auto choice: auto-routing this callback
-        # hung XLA-CPU's runtime inside morphology_features' program at
-        # batch 128 (np.asarray of the callback operand never returned;
-        # minimal reproductions with the same shapes pass, so the
-        # interaction is with the surrounding program, not the kernel).
-        # "native" remains an explicit opt-in — the kernel itself is
-        # bit-identical and parity-tested.
-        method = "scatter" if jax.default_backend() == "cpu" else "matmul"
+        # scatter stays the CPU auto choice: auto-routing the native
+        # callback hung XLA-CPU's runtime inside morphology_features'
+        # program at batch 128 (np.asarray of the callback operand never
+        # returned; minimal reproductions with the same shapes pass, so
+        # the interaction is with the surrounding program, not the
+        # kernel).  "native" remains an explicit opt-in — the kernel
+        # itself is bit-identical and parity-tested — and the strategy
+        # resolver never selects it.
+        method = resolve_reduction_strategy()
+    if method == "onehot":
+        method = "matmul"
     if method == "native":
         # one fused C pass over the pixels for ALL channels
         # (tm_site_channel_sums — bit-identical to the segment_sum
@@ -97,9 +111,11 @@ def grouped_sums(
             flat, stacked,
             vmap_method=native.callback_vmap_method(),
         )
-    if method == "scatter":
-        out = jax.ops.segment_sum(stacked, flat, num_segments=max_objects + 1)
+    if method in ("scatter", "sort"):
+        out = segmented_sum(stacked, flat, max_objects + 1, method)
         return out[1:]
+    if method != "matmul":
+        raise ValueError(f"unknown grouped_sums method '{method}'")
     p = flat.shape[0]
     pad = (-p) % _SUM_CHUNK
     if pad:
@@ -188,18 +204,25 @@ def grouped_minmax(
     than two segment_min/max scatters on TPU).  The pixel axis is chunked
     like :func:`grouped_sums` so the broadcast operand stays bounded on
     large sites / 3-D volumes under the site-batch vmap.  Rows for absent
-    labels come back as (+inf, -inf).  ``method="auto"``: segment_min/max
-    scatters on CPU (see :func:`grouped_sums`), the masked reduce
-    elsewhere."""
+    labels come back as (+inf, -inf).  ``method="auto"`` resolves through
+    the strategy layer: segment_min/max scatters on CPU (see
+    :func:`grouped_sums`), the masked reduce elsewhere.  ``"onehot"``
+    aliases ``"reduce"`` — min/max have no matmul form, so the dense
+    masked broadcast is that strategy's shape here; all strategies agree
+    bit-exactly (min/max are accumulation-order-free)."""
     flat_l = labels.reshape(-1)
     flat_v = jnp.asarray(values, jnp.float32).reshape(-1)
     if method == "auto":
         # see grouped_minmax_multi: native is explicit opt-in on CPU
-        method = "scatter" if jax.default_backend() == "cpu" else "reduce"
-    if method == "scatter":
-        mn = jax.ops.segment_min(flat_v, flat_l, num_segments=max_objects + 1)
-        mx = jax.ops.segment_max(flat_v, flat_l, num_segments=max_objects + 1)
+        method = resolve_reduction_strategy()
+    if method == "onehot":
+        method = "reduce"
+    if method in ("scatter", "sort"):
+        mn = segmented_min(flat_v, flat_l, max_objects + 1, method)
+        mx = segmented_max(flat_v, flat_l, max_objects + 1, method)
         return mn[1:], mx[1:]
+    if method != "reduce":
+        raise ValueError(f"unknown grouped_minmax method '{method}'")
     p = flat_l.shape[0]
     pad = (-p) % _SUM_CHUNK
     if pad:
@@ -247,8 +270,11 @@ def grouped_minmax_multi(
         # jitted program hung XLA-CPU's runtime on mosaic-scale batches
         # (the second callback never returned from materializing its
         # operands); "native" remains an explicit opt-in until that
-        # interaction is understood
-        method = "scatter" if jax.default_backend() == "cpu" else "reduce"
+        # interaction is understood, and the strategy resolver never
+        # selects it
+        method = resolve_reduction_strategy()
+    if method == "onehot":
+        method = "reduce"
     if method == "native":
         # fused C pass (tm_site_channel_minmax), bit-identical to the
         # segment scatters below
@@ -276,10 +302,12 @@ def grouped_minmax_multi(
             flat_l, stacked,
             vmap_method=native.callback_vmap_method(),
         )
-    if method == "scatter":
-        mn = jax.ops.segment_min(stacked, flat_l, num_segments=max_objects + 1)
-        mx = jax.ops.segment_max(stacked, flat_l, num_segments=max_objects + 1)
+    if method in ("scatter", "sort"):
+        mn = segmented_min(stacked, flat_l, max_objects + 1, method)
+        mx = segmented_max(stacked, flat_l, max_objects + 1, method)
         return mn[1:], mx[1:]
+    if method != "reduce":
+        raise ValueError(f"unknown grouped_minmax_multi method '{method}'")
     p = flat_l.shape[0]
     pad = (-p) % _SUM_CHUNK
     if pad:
@@ -395,6 +423,7 @@ def intensity_quantiles(
     max_objects: int,
     qs: tuple[float, ...] = (0.25, 0.5, 0.75),
     bins: int = 256,
+    method: str = "auto",
 ) -> dict[str, jax.Array]:
     """Per-object intensity quantiles (p25 / median / p75 by default).
 
@@ -410,6 +439,12 @@ def intensity_quantiles(
     object's CDF crosses ``q``, mapped back to gray units.  Exact when an
     object's gray span has ≤ ``bins`` distinct levels (the common case for
     stained cells); otherwise quantized to span/bins granularity.
+
+    ``method`` selects the histogram-accumulation strategy
+    (``ops/reduction.py``): ``"onehot"`` the dual one-hot contraction,
+    ``"scatter"``/``"sort"`` a fused (label*bins + bucket) index into one
+    segmented count.  Counts are integers < 2^24 → exact in f32, so every
+    strategy returns bit-identical quantiles.
     """
     labels = jnp.asarray(labels, jnp.int32)
     img = jnp.asarray(intensity, jnp.float32)
@@ -428,11 +463,12 @@ def intensity_quantiles(
     # plain fused-index scatter is the fast path (see grouped_sums).
     lab_flat = labels.reshape(-1)
     q_flat = q_pix.reshape(-1)
-    if jax.default_backend() == "cpu":
+    strategy = resolve_reduction_strategy(method)
+    if strategy in ("scatter", "sort"):
         idx = lab_flat * bins + q_flat
-        counts = jax.ops.segment_sum(
+        counts = segmented_sum(
             jnp.ones_like(idx, jnp.float32), idx,
-            num_segments=(max_objects + 1) * bins,
+            (max_objects + 1) * bins, strategy,
         ).reshape(max_objects + 1, bins)[1:]
         return _quantiles_from_counts(counts, lo, span, present, qs, bins)
     p = lab_flat.shape[0]
@@ -645,32 +681,46 @@ def _glcm_scatter(
     max_objects: int,
     levels: int,
     offset: tuple[int, int],
+    strategy: str = "scatter",
 ) -> jax.Array:
-    """GLCM accumulation via one scatter-add per direction (portable
-    fallback; fastest on CPU where scatters are cheap)."""
+    """GLCM accumulation via one segmented count per direction over fused
+    (label, q1, q2) cell indices — ``strategy="scatter"`` (portable
+    fallback; fastest on CPU where scatters are cheap) or ``"sort"`` (the
+    deterministic sorted-run form; counts are order-free integers, so the
+    result is bit-identical either way)."""
     dy, dx = offset
     lab2 = shift_with_fill(labels, -dy, -dx, 0)
     q2 = shift_with_fill(quantized, -dy, -dx, 0)
     valid = (labels > 0) & (lab2 == labels)
-    # scatter-add into (label, q1, q2) cells
+    # count into (label, q1, q2) cells
     idx = (
         labels.astype(jnp.int32) * (levels * levels)
         + quantized * levels
         + q2
     )
     idx = jnp.where(valid, idx, 0)
-    counts = jax.ops.segment_sum(
+    counts = segmented_sum(
         valid.reshape(-1).astype(jnp.float32),
         idx.reshape(-1),
-        num_segments=(max_objects + 1) * levels * levels,
+        (max_objects + 1) * levels * levels,
+        strategy,
     )
     glcm = counts.reshape(max_objects + 1, levels, levels)[1:]
     return glcm + jnp.swapaxes(glcm, 1, 2)
 
 
 def _resolve_glcm_method(method: str) -> str:
+    if method == "onehot":
+        return "matmul"
     if method != "auto":
         return method
+    # an explicit strategy request (CLI env, config, the tuned
+    # reduction_strategy verdict, or a build-time pin) overrides the
+    # backend heuristics below — including GLCM's own matmul-vs-scatter
+    # verdict, which only decides genuinely-unrequested "auto"
+    requested = explicit_reduction_request()
+    if requested is not None:
+        return "matmul" if requested == "onehot" else requested
     backend = jax.default_backend()
     if backend == "cpu":
         # "native" (tm_site_glcm: quantization + all 4 GLCMs in one C
@@ -804,11 +854,13 @@ def haralick_features(
         if method == "matmul":
             # all 4 directions share each chunk's row one-hot in one pass
             glcms = _glcm_matmul_all(labels, q, max_objects, levels, offsets)
-        else:
+        elif method in ("scatter", "sort"):
             glcms = [
-                _glcm_scatter(labels, q, max_objects, levels, off)
+                _glcm_scatter(labels, q, max_objects, levels, off, method)
                 for off in offsets
             ]
+        else:
+            raise ValueError(f"unknown glcm method '{method}'")
 
     acc: dict[str, jax.Array] = {}
     for glcm in glcms:

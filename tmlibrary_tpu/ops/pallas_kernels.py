@@ -588,26 +588,34 @@ def distance_transform(
 
 
 # ------------------------------------------------------------------ dispatch
-@functools.lru_cache(maxsize=1)
-def _tuning_results() -> dict:
-    """Committed hardware-tuning measurements (``tuning/TUNING.json``,
-    written by ``scripts/tune_tpu.py`` on a real chip); {} if absent."""
+@functools.lru_cache(maxsize=4)
+def _tuning_results_at(path: str) -> dict:
     import json
-    import pathlib
 
-    path = (
-        pathlib.Path(__file__).resolve().parent.parent.parent
-        / "tuning"
-        / "TUNING.json"
-    )
     try:
-        tuning = json.loads(path.read_text())
+        with open(path) as f:
+            tuning = json.load(f)
     except (OSError, ValueError):
         return {}
     # a dry-run (smoke-scale) sweep must never drive production dispatch
     if "SMOKE(" in str(tuning.get("timing_methodology", "")):
         return {}
     return tuning
+
+
+def _tuning_results() -> dict:
+    """Hardware-tuning measurements (``tuning/TUNING.json``, written by
+    ``scripts/tune_tpu.py`` on a real chip); {} if absent.  Resolves the
+    file through :func:`tmlibrary_tpu.tuning.tuning_json_path` so the
+    ``TMX_TUNING_JSON`` rehearsal redirect applies to kernel dispatch the
+    same way it does to the tuned engine defaults (the cache is keyed on
+    the resolved path)."""
+    from tmlibrary_tpu.tuning import tuning_json_path
+
+    return _tuning_results_at(tuning_json_path())
+
+
+_tuning_results.cache_clear = _tuning_results_at.cache_clear
 
 
 def pallas_enabled(kernel: str | None = None) -> bool:

@@ -54,10 +54,32 @@ def _scan_and_merge(stack_shard: jax.Array, axis: str) -> WelfordState:
     return lax.fori_loop(1, n_shards, fold, first)
 
 
+def _mesh_axis_size(mesh: Mesh, axis: "str | tuple[str, ...]") -> int:
+    if isinstance(axis, str):
+        return mesh.shape[axis]
+    out = 1
+    for name in axis:
+        out *= mesh.shape[name]
+    return out
+
+
 def sharded_welford(stack: jax.Array, mesh: Mesh, axis: str = "sites") -> WelfordState:
     """Merged :class:`WelfordState` over a (B, H, W) stack sharded on the
-    leading axis.  ``B`` must be divisible by the mesh size (the workflow
-    layer plans batches that way)."""
+    leading axis.
+
+    The workflow layer plans batches divisible by the mesh size, but the
+    LAST batch of a plate is whatever is left over — so a ragged ``B`` is
+    handled here rather than trusted away: the divisible head goes
+    through the sharded scan+fold, the tail is scanned locally
+    (replicated — one shard's worth of extra work at most, once per
+    plate), and the two states combine with the same parallel-variance
+    merge the shards use.  Bit-identical to padding with mask bookkeeping
+    and cheaper than it; a pad+mask path would also poison ``n`` unless
+    every downstream consumer threads the mask."""
+    stack = jnp.asarray(stack)
+    size = _mesh_axis_size(mesh, axis)
+    b = stack.shape[0]
+    head = (b // size) * size
     fn = shard_map(
         functools.partial(_scan_and_merge, axis=axis),
         mesh=mesh,
@@ -67,7 +89,19 @@ def sharded_welford(stack: jax.Array, mesh: Mesh, axis: str = "sites") -> Welfor
         # varying-axis checker can't prove it statically
         check_vma=False,
     )
-    return jax.jit(fn)(jnp.asarray(stack))
+    if head == b:
+        return jax.jit(fn)(stack)
+    if head == 0:
+        # fewer sites than devices: plain local scan (no shard has a
+        # full row to work on)
+        return welford_scan(stack)
+    # tail scan + merge stay un-jitted: once per ragged batch, and eager
+    # op-by-op execution keeps them bit-reproducible against the same
+    # composition written by hand (jit refuses nothing but fuses
+    # differently)
+    head_state = jax.jit(fn)(stack[:head])
+    tail_state = welford_scan(stack[head:])
+    return welford_merge(head_state, tail_state)
 
 
 def sharded_channel_stats(

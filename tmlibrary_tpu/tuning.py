@@ -61,3 +61,76 @@ def tuned_batch_size() -> int | None:
     """The hardware-swept ``best_batch`` site batch, or None."""
     tuning = load_tuning()
     return _positive_int(tuning.get("best_batch")) if tuning else None
+
+
+_REDUCTION_STRATEGIES = ("onehot", "sort", "scatter")
+
+
+def tuned_reduction_strategy(backend: str | None = None) -> str | None:
+    """The swept grouped-reduction strategy verdict for ``backend``, or
+    None.  Two shapes are accepted: a per-backend dict
+    (``{"cpu": "scatter", "tpu": "onehot"}`` — what ``bench.py --sweep``
+    writes via :func:`record_config_sweep`) or a plain string scoped by
+    the file's top-level ``backend`` field.  A verdict measured on one
+    backend never sets another backend's default, and malformed values
+    degrade to None (the static default) rather than erroring."""
+    tuning = load_tuning()
+    if not tuning:
+        return None
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    entry = tuning.get("reduction_strategy")
+    if isinstance(entry, dict):
+        value = entry.get(backend)
+    elif isinstance(entry, str) and tuning.get("backend") == backend:
+        value = entry
+    else:
+        value = None
+    return value if value in _REDUCTION_STRATEGIES else None
+
+
+def record_config_sweep(config: str, entry: dict) -> dict:
+    """Merge one per-config sweep verdict into the tuning file.
+
+    ``bench.py --sweep`` calls this once per ``BENCH_CONFIG`` with a row
+    like ``{"backend": ..., "best_pipeline": N, "best_strategy": ...,
+    "rows": [...]}``.  Existing keys written by ``tune_tpu.py`` (the
+    top-level ``best_batch``/``best_pipeline`` and their provenance
+    stamps) are preserved — the sweep only owns ``config_sweeps[config]``
+    and the per-backend ``reduction_strategy`` verdict.  Returns the
+    merged document."""
+    path = tuning_json_path()
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict):
+            data = {}
+    except (OSError, ValueError):
+        data = {}
+    # provenance: only stamp authorship when this write creates the file;
+    # never claim tune_tpu.py's measurements as our own
+    data.setdefault("written_by", "bench.py --sweep")
+    data.setdefault("config_sweeps", {})[str(config)] = entry
+    backend = entry.get("backend")
+    strategy = entry.get("best_strategy")
+    if backend and strategy in _REDUCTION_STRATEGIES:
+        verdicts = data.get("reduction_strategy")
+        if not isinstance(verdicts, dict):
+            # migrate a legacy plain-string verdict under its backend scope
+            legacy = verdicts if verdicts in _REDUCTION_STRATEGIES else None
+            verdicts = (
+                {data["backend"]: legacy}
+                if legacy and data.get("backend")
+                else {}
+            )
+        verdicts[backend] = strategy
+        data["reduction_strategy"] = verdicts
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return data

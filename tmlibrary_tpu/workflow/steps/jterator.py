@@ -158,6 +158,19 @@ class ImageAnalysisRunner(Step):
                       "sweep's best_batch on device backends, else 32)"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
+        Argument("reduction_strategy", str, default="auto",
+                 choices=("auto", "onehot", "sort", "scatter"),
+                 help="grouped-reduction strategy for the measurement "
+                      "stack (ops/reduction.py): one-hot MXU matmuls, "
+                      "deterministic sort+segment reductions, or direct "
+                      "scatters; 'auto' follows TMX_REDUCTION_STRATEGY / "
+                      "config / the tuned verdict, then a backend-safe "
+                      "default"),
+        Argument("donate_buffers", bool, default=True,
+                 help="donate each batch's raw-image/stats/shift device "
+                      "buffers to the compiled program so XLA reuses "
+                      "their memory for outputs (safe: the engine "
+                      "transfers fresh arrays per batch)"),
         Argument("auto_resegment", bool, default=True,
                  help="collect re-runs saturated batches at doubled "
                       "max_objects (bounded at 4096) until counts fit; "
@@ -267,7 +280,12 @@ class ImageAnalysisRunner(Step):
                 from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
 
                 self._compiled = cached_batch_fn(
-                    self._desc, args["max_objects"], self._window
+                    self._desc, args["max_objects"], self._window,
+                    # arg True defers to the config default (so
+                    # TM_DONATE_BUFFERS=0 still disables it); arg False
+                    # forces donation off for this run
+                    donate=None if args.get("donate_buffers", True) else False,
+                    reduction_strategy=args.get("reduction_strategy", "auto"),
                 )
                 self._compiled_cap = args["max_objects"]
             return self._desc, self._compiled
