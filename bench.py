@@ -320,6 +320,40 @@ def emit_cached_tpu(live_error: str) -> bool:
                         "scripts/tune_tpu.py during a relay window too "
                         "short for a full bench re-certification",
             }
+            # the sweep is the FRESHER hardware evidence for the same
+            # workload — promote it to the headline instead of reporting
+            # a superseded number as `value` with the better one buried
+            # in an annotation nobody's dashboards read.  The displaced
+            # figure stays alongside with its own provenance.
+            record["superseded_value"] = record.get("value")
+            record["superseded_timing_methodology"] = record.get(
+                "timing_methodology"
+            )
+            record["superseded_measured_at"] = record.get("measured_at")
+            record["value"] = best
+            record["timing_methodology"] = f"pipelined-depth{best_depth}"
+            record["pipeline_depth"] = best_depth
+            record["measured_at"] = tuning.get("written_at")
+            record["value_provenance"] = (
+                "tuning_sweep(scripts/tune_tpu.py write_results)"
+            )
+            denom = record.get("cpu_denominator_sites_per_sec")
+            if denom:
+                record["vs_baseline"] = round(best / denom, 2)
+            # the headline now dates from the sweep: age/staleness follow
+            try:
+                dt = datetime.datetime.fromisoformat(
+                    str(tuning.get("written_at"))
+                )
+                if dt.tzinfo is None:
+                    dt = dt.replace(tzinfo=datetime.timezone.utc)
+                age = round((time.time() - dt.timestamp()) / 3600, 2)
+                record["cache_age_hours"] = age
+                record["stale"] = age > float(
+                    os.environ.get("BENCH_STALE_HOURS", "72")
+                )
+            except ValueError:
+                pass
     emit_record(record)
     return True
 
@@ -421,6 +455,19 @@ def measure_sweep() -> None:
     if strategy_invariant:
         strategies = [None]  # one cell per depth, at the ambient resolution
 
+    # the object-capacity bucket axis: off by default so historic sweep
+    # grids (and their recorded cells) stay comparable — "auto" puts the
+    # whole capacity ladder on the grid, a comma list picks exact caps.
+    # Only meaningful for configs with per-object reductions; elsewhere
+    # capacity changes nothing but padding, so one cap per grid.
+    env_caps = os.environ.get("BENCH_SWEEP_CAPACITIES")
+    if env_caps and not strategy_invariant:
+        from tmlibrary_tpu.capacity import resolve_bucket_ladder
+
+        capacities = list(resolve_bucket_ladder(max_objects, env_caps))
+    else:
+        capacities = [max_objects]
+
     knobs = dict(
         size=size, batch=batch, max_objects=max_objects,
         sites=int(os.environ.get("BENCH_SITES", "96")),
@@ -434,42 +481,51 @@ def measure_sweep() -> None:
     rows = []
     item_unit = None
     for strat in strategies:
-        wl = sweep_workload(config, reduction_strategy=strat, **knobs)
-        label = strat or resolve_reduction_strategy()
-        item_unit = wl.item_unit
-        try:
-            wl.fetch(wl.launch())  # compile + warm outside the clock
-            for depth in depths:
-                best = float("inf")
-                for _ in range(reps):
-                    ex = PipelinedExecutor(
-                        _SweepStep(wl), depth=depth, depth_source="sweep"
+        for cap in capacities:
+            wl = sweep_workload(
+                config, reduction_strategy=strat,
+                **{**knobs, "max_objects": cap},
+            )
+            label = strat or resolve_reduction_strategy()
+            item_unit = wl.item_unit
+            try:
+                wl.fetch(wl.launch())  # compile + warm outside the clock
+                for depth in depths:
+                    best = float("inf")
+                    for _ in range(reps):
+                        ex = PipelinedExecutor(
+                            _SweepStep(wl), depth=depth, depth_source="sweep"
+                        )
+                        t0 = time.perf_counter()
+                        for _ in ex.run(
+                            [{"index": i} for i in range(n_exec)]
+                        ):
+                            pass
+                        best = min(best, time.perf_counter() - t0)
+                    value = n_exec * wl.n_items / best
+                    row = {
+                        "strategy": label,
+                        "pipeline_depth": depth,
+                        "capacity": cap,
+                        "items_per_sec": round(value, 3),
+                        "best_s": round(best, 4),
+                    }
+                    if strategy_invariant:
+                        row["strategy_invariant"] = True
+                    rows.append(row)
+                    _mirror_gauge(
+                        "tmx_bench_sweep_cell_items_per_sec", value,
+                        backend=backend, config=config, strategy=label,
+                        depth=str(depth), capacity=str(cap),
                     )
-                    t0 = time.perf_counter()
-                    for _ in ex.run([{"index": i} for i in range(n_exec)]):
-                        pass
-                    best = min(best, time.perf_counter() - t0)
-                value = n_exec * wl.n_items / best
-                row = {
-                    "strategy": label,
-                    "pipeline_depth": depth,
-                    "items_per_sec": round(value, 3),
-                    "best_s": round(best, 4),
-                }
-                if strategy_invariant:
-                    row["strategy_invariant"] = True
-                rows.append(row)
-                _mirror_gauge(
-                    "tmx_bench_sweep_cell_items_per_sec", value,
-                    backend=backend, config=config, strategy=label,
-                    depth=str(depth),
-                )
-        finally:
-            wl.close()
+            finally:
+                wl.close()
 
     best_row = max(rows, key=lambda r: r["items_per_sec"])
     base_row = min(
-        (r for r in rows if r["strategy"] == rows[0]["strategy"]),
+        (r for r in rows
+         if r["strategy"] == rows[0]["strategy"]
+         and r["capacity"] == rows[0]["capacity"]),
         key=lambda r: r["pipeline_depth"],
     )
     import datetime
@@ -488,6 +544,12 @@ def measure_sweep() -> None:
         # None for strategy-invariant configs: record_config_sweep then
         # skips the per-backend verdict instead of recording noise
         "best_strategy": None if strategy_invariant else best_row["strategy"],
+        # None when the capacity axis wasn't swept: a single-cap grid
+        # carries no evidence about bucket routing, so no verdict
+        "best_capacity": (
+            best_row["capacity"] if len(capacities) > 1 else None
+        ),
+        "capacities": capacities,
         "best_items_per_sec": best_row["items_per_sec"],
         "n_exec": n_exec,
         "timing_methodology": (
@@ -501,7 +563,12 @@ def measure_sweep() -> None:
         "metric": "sweep_best_items_per_sec",
         "value": best_row["items_per_sec"],
         "unit": f"{item_unit}/sec, best cell of a "
-                f"{len(strategies)}-strategy x {len(depths)}-depth grid",
+                f"{len(strategies)}-strategy x {len(depths)}-depth"
+                + (
+                    f" x {len(capacities)}-capacity" if len(capacities) > 1
+                    else ""
+                )
+                + " grid",
         # the gain the tuned (strategy, depth) cell buys over the
         # depth-1 first-strategy cell of the same grid
         "vs_baseline": round(
@@ -514,6 +581,7 @@ def measure_sweep() -> None:
         "site_size": size,
         "best_strategy": entry["best_strategy"],
         "best_pipeline": entry["best_pipeline"],
+        "best_capacity": entry["best_capacity"],
         "rows": rows,
         "tuning_json": tuning_mod.tuning_json_path(),
         **_ledger_fields(best_row["pipeline_depth"], max_objects),
@@ -633,6 +701,38 @@ def measure(platform: str) -> None:
     result = fn(raw, {}, shifts)
     np.asarray(result.counts[count_key])
 
+    # object-capacity bucket routing (BENCH_OBJECT_BUCKETS, default off
+    # so the headline stays comparable with historic records): observe
+    # the warmup's object counts, pick the smallest bucket that holds
+    # them, and re-time at that capacity — bit-identical results (the
+    # capacity is pure padding once counts fit; see capacity.py), fewer
+    # padded-slot FLOPs.  Config 2's counts are foreground pixels, not
+    # objects, so the knob does not apply there.
+    peak_objects = None
+    routed_capacity = None
+    buckets_spec = os.environ.get("BENCH_OBJECT_BUCKETS", "off")
+    if config != "2":
+        peak_objects = max(
+            int(np.asarray(c).max(initial=0))
+            for c in result.counts.values()
+        )
+        if buckets_spec.strip().lower() not in (
+            "", "off", "0", "none", "false", "no"
+        ):
+            from tmlibrary_tpu.capacity import (
+                resolve_bucket_ladder, select_capacity,
+            )
+
+            ladder = resolve_bucket_ladder(max_objects, buckets_spec)
+            cap = select_capacity(peak_objects, ladder)
+            if cap < max_objects:
+                routed_capacity = cap
+                pipe = ImageAnalysisPipeline(desc, max_objects=cap)
+                fn = pipe.build_batch_fn()
+                flops, cost_bytes = _cost_flops(fn, raw, {}, shifts)
+                result = fn(raw, {}, shifts)  # compile + warm the bucket
+                np.asarray(result.counts[count_key])
+
     # NOT named `depth`: the volume branch owns that name for the z-stack
     # depth recorded as record["depth"]
     pdepth = _pipeline_depth(jax.default_backend())
@@ -701,6 +801,21 @@ def measure(platform: str) -> None:
         for c in result.counts.values():
             at_cap |= np.asarray(c) >= max_objects
         record["saturated_sites"] = int(at_cap.sum())
+        # padding waste, per record (ISSUE 5 satellite): objects used /
+        # capacity slots — 0 saturated sites with occupancy ≪ 1 is the
+        # signature of FLOPs burned on empty object slots
+        cap_used = routed_capacity or max_objects
+        total_objects = sum(
+            float(np.asarray(c).sum()) for c in result.counts.values()
+        )
+        slots = len(result.counts) * batch * cap_used
+        record["slot_occupancy"] = (
+            round(total_objects / slots, 4) if slots else 0.0
+        )
+        record["max_observed_objects"] = peak_objects
+        if routed_capacity:
+            record["routed_capacity"] = routed_capacity
+            record["object_buckets"] = buckets_spec
     record.update(_flops_fields(
         flops and flops * pdepth, pdepth * batch, best,
         jax.default_backend(), nbytes=cost_bytes and cost_bytes * pdepth,

@@ -23,6 +23,10 @@ one must not be burned on the long tail):
    (``bench.py --sweep``); their artifact is TUNING.json's
    ``config_sweeps`` + per-backend ``reduction_strategy`` verdict, not
    the headline cache, so they ride behind every headline number.
+   ``sweep-capacity:<config>`` reruns the same sweep with the
+   object-capacity bucket ladder on the grid
+   (``BENCH_SWEEP_CAPACITIES=auto``) for the grouped-reduction configs,
+   landing the per-backend ``object_capacity`` routing verdict.
 6. the remaining tune stages (sweep/kernels/glcm — the long tail).  A
    sweep rerun that changes ``best_batch`` re-pends ``tune:pipeline``
    and the affected bench records; the loop re-evaluates every pass.
@@ -91,6 +95,12 @@ PRIORITY_BENCH = ("3", "3@mo256")
 #: fire order — queued BEHIND the headline bench items: a sweep verdict
 #: improves future defaults, a headline number is evidence now
 SWEEP_CONFIGS = ("3", "2", "4", "volume", "corilla", "pyramid", "spatial")
+
+#: configs where the object-capacity axis is meaningful (grouped
+#: reductions scale with capacity); matches bench.py's strategy-variant
+#: set — the capacity sweep on an invariant config would time identical
+#: programs
+SWEEP_CAPACITY_CONFIGS = ("3", "4", "volume")
 
 TUNE_STAGES = {  # stage name -> TUNING.json key proving it completed
     "sweep": "batch_sweep",
@@ -288,10 +298,31 @@ def sweep_done(config: str) -> bool:
     return entry.get("backend") not in (None, "cpu")
 
 
-def run_sweep_item(config: str, timeout_s: int = 900) -> bool:
+def sweep_capacity_done(config: str) -> bool:
+    """The capacity-axis sweep is done when the config's device-backend
+    ``config_sweeps`` entry actually carried the bucket ladder (more
+    than one capacity timed, or a ``best_capacity`` verdict) — a plain
+    ``sweep:<config>`` entry does not satisfy it."""
+    entry = (load_json(TUNING_PATH).get("config_sweeps") or {}).get(config)
+    if not entry:
+        return False
+    has_axis = (len(entry.get("capacities") or []) > 1
+                or entry.get("best_capacity"))
+    if not has_axis:
+        return False
+    if _rehearsal():
+        return True
+    return entry.get("backend") not in (None, "cpu")
+
+
+def run_sweep_item(config: str, timeout_s: int = 900,
+                   capacities: bool = False) -> bool:
     """One ``bench.py --sweep`` run for ``config``; success means the
     on-hardware verdict actually landed in TUNING.json (the sweep writes
-    its own artifact — nothing to cache here)."""
+    its own artifact — nothing to cache here).  ``capacities=True`` puts
+    the object-capacity bucket ladder on the grid
+    (``BENCH_SWEEP_CAPACITIES=auto``) so the winning ``best_capacity``
+    lands as the per-backend ``object_capacity`` routing verdict."""
     env = {
         k: v for k, v in os.environ.items()
         if not k.startswith(("BENCH_", "TMX_", "TUNE_"))
@@ -304,7 +335,10 @@ def run_sweep_item(config: str, timeout_s: int = 900) -> bool:
         BENCH_SWEEP="1",
         BENCH_CONFIG=config,
     )
-    log(f"sweep[{config}]: running")
+    if capacities:
+        env.update(BENCH_SWEEP_CAPACITIES="auto")
+    log(f"sweep[{config}]: running"
+        + (" (capacity axis)" if capacities else ""))
     try:
         r = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
@@ -327,8 +361,9 @@ def run_sweep_item(config: str, timeout_s: int = 900) -> bool:
         return False
     log(f"sweep[{config}]: verdict strategy={record.get('best_strategy')} "
         f"depth={record.get('best_pipeline')} "
+        f"capacity={record.get('best_capacity')} "
         f"best={record.get('value')} {record.get('unit', '')}")
-    return sweep_done(config)
+    return sweep_capacity_done(config) if capacities else sweep_done(config)
 
 
 def profile_done() -> bool:
@@ -469,6 +504,8 @@ def all_pending() -> list:
         if k not in PRIORITY_BENCH and not bench_done(k)
     ]
     labels += [f"sweep:{k}" for k in SWEEP_CONFIGS if not sweep_done(k)]
+    labels += [f"sweep-capacity:{k}" for k in SWEEP_CAPACITY_CONFIGS
+               if not sweep_capacity_done(k)]
     labels += [f"tune:{s}" for s in tune_pending if s != "pipeline"]
     only = set(filter(None, os.environ.get("WATCH_ONLY", "").split(",")))
     if only:
@@ -532,6 +569,12 @@ def fire_pending(pending: list) -> bool:
             last_alive = time.time()
         elif label.startswith("sweep:"):
             ok = run_sweep_item(label[6:])
+            captured |= ok
+            if not ok:
+                break
+            last_alive = time.time()
+        elif label.startswith("sweep-capacity:"):
+            ok = run_sweep_item(label[15:], capacities=True)
             captured |= ok
             if not ok:
                 break

@@ -1,0 +1,33 @@
+"""Worker body for the host-death resume test (launched by
+``tests/test_multihost_resume.py``, one subprocess per workflow phase).
+
+Runs the full canonical workflow against a store the parent prepared on
+disk.  Phase ``run`` is launched with ``TMX_FAULT_PLAN`` arming a
+``kill`` fault at a jterator batch — the process hard-exits
+(``os._exit(41)``) mid-step with no exception propagation and no
+cleanup, leaving a partial run ledger exactly as a preempted worker
+host would.  Phase ``resume`` re-launches against the same store with
+no plan and ``resume=True``: it must reconstruct progress from the
+ledger alone and finish only the remaining work.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    store_root, desc_path, phase = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.engine import Workflow, WorkflowDescription
+
+    store = ExperimentStore.open(store_root)
+    desc = WorkflowDescription.load(desc_path)
+    summary = Workflow(store, desc).run(resume=(phase == "resume"))
+    print(f"WORKER_DONE phase={phase} steps={sorted(summary)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -197,11 +197,13 @@ def test_cache_rejected_on_pipeline_depth_mismatch(tmp_path):
         assert out2["value"] == 400.0
 
 
-def test_cached_record_carries_newer_sweep_annotation(tmp_path):
+def test_cached_record_promotes_newer_sweep_to_headline(tmp_path):
     """A cached config-3 record older than the committed tuning sweep of
-    the same workload (same batch) must surface the sweep's sites/s as
-    ``newer_tuning_sweep`` — a short relay window that fit the depth
-    sweep but not a full re-certification is still hardware evidence."""
+    the same workload (same batch) must PROMOTE the sweep's sites/s to
+    the headline ``value`` (with the sweep's methodology and provenance)
+    — the fresher hardware evidence wins, and the displaced number stays
+    alongside as ``superseded_value`` instead of the better one being
+    buried under an annotation."""
     cache = tmp_path / "BENCH_TPU.json"
     cache.write_text(json.dumps({"records": {"3": {
         "record": {
@@ -209,6 +211,7 @@ def test_cached_record_carries_newer_sweep_annotation(tmp_path):
             "value": 300.0, "unit": "u", "vs_baseline": 5.0,
             "backend": "axon", "config": "3", "batch": 128,
             "site_size": 256, "max_objects": 64,
+            "cpu_denominator_sites_per_sec": 55.0,
         },
         "measured_at": "2026-07-30T23:36:40+00:00",
         "measured_at_unix": time.time() - 7200,
@@ -231,6 +234,17 @@ def test_cached_record_carries_newer_sweep_annotation(tmp_path):
     })
     if out.get("backend") != "tpu_cached":
         pytest.skip(f"relay answered live (backend={out.get('backend')})")
+    # headline promotion
+    assert out["value"] == 606.5
+    assert out["timing_methodology"] == "pipelined-depth16"
+    assert out["pipeline_depth"] == 16
+    assert out["measured_at"] == "2026-08-01T08:33:01+00:00"
+    assert "tune_tpu" in out["value_provenance"]
+    assert out["vs_baseline"] == round(606.5 / 55.0, 2)
+    # displaced figure keeps its own provenance
+    assert out["superseded_value"] == 300.0
+    assert out["superseded_measured_at"] == "2026-07-30T23:36:40+00:00"
+    # compat annotation still present for existing consumers
     sweep = out["newer_tuning_sweep"]
     assert sweep["sites_per_sec"] == 606.5
     assert sweep["pipeline_depth"] == 16
@@ -251,6 +265,8 @@ def test_cached_record_carries_newer_sweep_annotation(tmp_path):
     if out.get("backend") != "tpu_cached":
         pytest.skip(f"relay answered live (backend={out.get('backend')})")
     assert "newer_tuning_sweep" not in out
+    assert "superseded_value" not in out
+    assert out["value"] == 650.0
 
 
 def test_cached_record_staleness_recomputed_at_emit(tmp_path):

@@ -1,0 +1,289 @@
+"""Adaptive object-capacity bucketing (``capacity.py`` + jterator routing).
+
+Three layers of guarantees:
+
+- Ladder resolution and routing policy as pure functions: spec parsing
+  (auto / off / explicit lists, loud failures on malformed input), the
+  strict-inequality capacity pick (a count AT the cap may have been
+  clipped there), and the tuning-verdict hint loader.
+- The bit-identity contract that makes bucketing safe to enable: the
+  persisted label stacks and feature tables are byte-identical across
+  bucket specs, through the pipelined executor at depth > 1, for both
+  the sites and the spatial layout — including when an undersized
+  bucket saturates and the router escalates before persisting.
+- Surfacing: ``bucket_capacity``/``slot_occupancy`` ride the batch
+  summaries into the run ledger, ``status()`` aggregates them, and the
+  ledger→metrics derivation exports the routing counters and the
+  occupancy gauge.
+"""
+
+import numpy as np
+import pytest
+
+from test_pipelined import (  # noqa: F401 — fixture re-export
+    _read_features_sorted,
+    _run_prep_steps,
+    spatial_store,
+)
+from test_workflow import (  # noqa: F401 — fixture re-export
+    make_description,
+    source_dir,
+    store,
+    synth_site_image,
+)
+
+from tmlibrary_tpu import telemetry
+from tmlibrary_tpu.capacity import (
+    resolve_bucket_ladder,
+    select_capacity,
+    slot_occupancy,
+)
+from tmlibrary_tpu.workflow.engine import Workflow
+from tmlibrary_tpu.workflow.pipelined import PipelinedExecutor
+from tmlibrary_tpu.workflow.registry import get_step
+
+
+@pytest.fixture(autouse=True)
+def _isolate_tuning(tmp_path, monkeypatch):
+    """Routing must not pick up a ``tuned_object_capacity`` hint from the
+    repo's TUNING.json — tests pin the first-batch bucket explicitly."""
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tmp_path / "no_tuning.json"))
+    monkeypatch.delenv("TMX_OBJECT_BUCKETS", raising=False)
+
+
+# ------------------------------------------------------------ pure policy
+def test_auto_ladder_is_pow2_up_to_ceiling():
+    assert resolve_bucket_ladder(64, "auto") == (8, 16, 32, 64)
+    assert resolve_bucket_ladder(64, None) == (8, 16, 32, 64)
+    # non-pow2 ceiling is kept as the final rung, not rounded
+    assert resolve_bucket_ladder(100, "auto") == (8, 16, 32, 64, 100)
+    # ceiling at or below the minimum bucket collapses to a single rung
+    assert resolve_bucket_ladder(6, "auto") == (6,)
+    assert resolve_bucket_ladder(8, "auto") == (8,)
+
+
+def test_off_spec_disables_bucketing():
+    for spec in ("off", "none", "0", "false", "no", "OFF"):
+        assert resolve_bucket_ladder(64, spec) == (64,)
+
+
+def test_explicit_ladder_sorted_deduped_ceiling_appended():
+    assert resolve_bucket_ladder(64, "8,32") == (8, 32, 64)
+    assert resolve_bucket_ladder(64, "32, 8, 32") == (8, 32, 64)
+    # rungs above the ceiling are dropped, ceiling always present
+    assert resolve_bucket_ladder(16, "8,32,64") == (8, 16)
+
+
+def test_malformed_specs_fail_loudly():
+    for spec in ("8,banana", "-4", "8;16"):
+        with pytest.raises(ValueError):
+            resolve_bucket_ladder(64, spec)
+    with pytest.raises(ValueError):
+        resolve_bucket_ladder(0, "auto")
+
+
+def test_select_capacity_strict_inequality():
+    ladder = (8, 16, 64)
+    # a count AT the cap may have been clipped there -> go one rung up
+    assert select_capacity(7, ladder) == 8
+    assert select_capacity(8, ladder) == 16
+    assert select_capacity(16, ladder) == 64
+    assert select_capacity(200, ladder) == 64  # ceiling is the fallback
+    assert select_capacity(0, ladder) == 8
+
+
+def test_slot_occupancy_guards_zero_slots():
+    assert slot_occupancy(6, 24) == 0.25
+    assert slot_occupancy(0, 0) == 0.0
+
+
+def test_tuned_object_capacity_loader(tmp_path, monkeypatch):
+    import json
+
+    from tmlibrary_tpu.tuning import tuned_object_capacity
+
+    path = tmp_path / "TUNING.json"
+    path.write_text(json.dumps({
+        "backend": "cpu",
+        "written_by": "scripts/tune_tpu.py write_results",
+        "object_capacity": {"cpu": 16},
+    }))
+    monkeypatch.setenv("TMX_TUNING_JSON", str(path))
+    assert tuned_object_capacity("cpu") == 16
+    assert tuned_object_capacity("tpu") is None
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tmp_path / "missing.json"))
+    assert tuned_object_capacity("cpu") is None
+
+
+# ------------------------------------------- bit-identity: sites layout
+def test_sites_bit_identical_across_bucket_specs(source_dir, store):
+    """Labels and features persisted with bucketing on (routed at
+    capacity 8, far below the 64 ceiling) are byte-identical to the
+    unbucketed run, through the pipelined executor at depth 4."""
+    import pandas.testing
+
+    desc = make_description(source_dir, store)
+    _run_prep_steps(desc, store)
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    args = {**jd.args, "batch_size": 2, "object_buckets": "off"}
+
+    jt = get_step("jterator")(store)
+    jt.init(args)
+    summaries = [jt.run(j) for j in jt.list_batches()]
+    assert all(s["bucket_capacity"] == 64 for s in summaries)
+    ref_labels = store.read_labels(None, "nuclei").copy()
+    ref_feats = _read_features_sorted(store, "nuclei")
+    # the synthetic sites are sparse: peak count fits the smallest bucket
+    peak = int(max(lab.max() for lab in ref_labels))
+    assert 0 < peak < 8
+
+    # "8" routes at the smallest rung, "16,32" at a mid-ladder rung —
+    # two genuinely different compiled capacities vs the 64 reference
+    # ("auto" resolves to the same rung as "8"; the ladder unit tests
+    # above pin that resolution)
+    for spec in ("8", "16,32"):
+        jt2 = get_step("jterator")(store)
+        jt2.delete_previous_output()
+        jt2.init({**args, "object_buckets": spec})
+        batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+        out = list(PipelinedExecutor(jt2, depth=4).run(batches))
+        caps = [r["bucket_capacity"] for _, r in out]
+        # routing engaged: every batch ran below the 64-slot ceiling
+        assert all(c < 64 for c in caps), (spec, caps)
+        assert all("bucket_escalations" not in r for _, r in out)
+        occs = [r["slot_occupancy"] for _, r in out]
+        assert all(0.0 < o <= 1.0 for o in occs)
+        assert np.array_equal(store.read_labels(None, "nuclei"),
+                              ref_labels), f"labels diverged: {spec}"
+        pandas.testing.assert_frame_equal(
+            _read_features_sorted(store, "nuclei"), ref_feats
+        )
+
+
+def test_saturated_bucket_escalates_then_matches(source_dir, store):
+    """An undersized first rung (capacity 2 for ~6-object sites) clips
+    the on-device counts, so the router must relaunch one rung up before
+    persisting — and the escalated results still match the unbucketed
+    run exactly."""
+    import pandas.testing
+
+    desc = make_description(source_dir, store)
+    _run_prep_steps(desc, store)
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    args = {**jd.args, "batch_size": 4, "object_buckets": "off"}
+
+    jt = get_step("jterator")(store)
+    jt.init(args)
+    for j in jt.list_batches():
+        jt.run(j)
+    ref_labels = store.read_labels(None, "nuclei").copy()
+    ref_feats = _read_features_sorted(store, "nuclei")
+
+    jt2 = get_step("jterator")(store)
+    jt2.delete_previous_output()
+    jt2.init({**args, "object_buckets": "2"})  # ladder (2, 64)
+    batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+    out = list(PipelinedExecutor(jt2, depth=2).run(batches))
+
+    # the first batch routed at 2, saturated, escalated to the ceiling;
+    # batches inside the initial launch window (depth 2 keeps up to
+    # depth+1 dispatches ahead of the first persist) may pay the same
+    # relaunch before the routing history exists
+    first = out[0][1]
+    assert first["bucket_capacity"] == 64
+    assert first.get("bucket_escalations", 0) >= 1
+    assert all(r["bucket_capacity"] == 64 for _, r in out)
+    # batches past the initial window learn from history and route at
+    # the ceiling directly — no repeated relaunch tax
+    assert all("bucket_escalations" not in r for _, r in out[3:])
+
+    assert np.array_equal(store.read_labels(None, "nuclei"), ref_labels)
+    pandas.testing.assert_frame_equal(
+        _read_features_sorted(store, "nuclei"), ref_feats
+    )
+
+
+# ----------------------------------------- bit-identity: spatial layout
+def test_spatial_layout_bit_identical_with_buckets(spatial_store,
+                                                   monkeypatch):
+    """The spatial (mosaic) layout routes through the same persist path;
+    bucketing via the environment spec must leave its global-id label
+    stacks untouched at depth 2."""
+    import pandas.testing
+
+    st = spatial_store
+    args = {"layout": "spatial", "n_devices": 8, "object_buckets": "off"}
+    jt = get_step("jterator")(st)
+    jt.init(args)
+    for j in jt.list_batches():
+        jt.run(j)
+    ref_labels = st.read_labels(None, "mosaic_cells").copy()
+    ref_feats = _read_features_sorted(st, "mosaic_cells")
+    assert ref_labels.max() > 0
+
+    monkeypatch.setenv("TMX_OBJECT_BUCKETS", "8")
+    jt2 = get_step("jterator")(st)
+    jt2.delete_previous_output()
+    # arg left at its "auto" default -> the env spec decides the ladder
+    jt2.init({"layout": "spatial", "n_devices": 8})
+    batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+    out = list(PipelinedExecutor(jt2, depth=2).run(batches))
+    assert len(out) == 2
+    assert np.array_equal(st.read_labels(None, "mosaic_cells"), ref_labels)
+    pandas.testing.assert_frame_equal(
+        _read_features_sorted(st, "mosaic_cells"), ref_feats
+    )
+
+
+# ------------------------------------------------- ledger + metrics path
+def test_engine_ledger_aggregates_buckets_and_exports_metrics(
+        source_dir, store, monkeypatch, tmp_path, capsys):
+    """A full engine run with bucketing on lands ``bucket_capacity`` /
+    ``slot_occupancy`` in the ``batch_done`` events, ``status()`` rolls
+    them up, and ``tmx metrics --source ledger`` exports the routing
+    counter and occupancy gauge."""
+    from tmlibrary_tpu.cli import main
+
+    monkeypatch.setenv("TMX_OBJECT_BUCKETS", "8")
+    desc = make_description(source_dir, store)
+    wf = Workflow(store, desc, pipeline_depth=2)
+    wf.run()
+
+    events = wf.ledger.events()
+    done = [e for e in events if e.get("event") == "batch_done"
+            and e.get("step") == "jterator"]
+    assert done, "no jterator batch_done events"
+    for e in done:
+        res = e.get("result") or {}
+        assert res.get("bucket_capacity") == 8
+        assert 0.0 < res.get("slot_occupancy", 0.0) <= 1.0
+
+    buckets = wf.ledger.status()["jterator"]["buckets"]
+    assert buckets["routed"] == {"8": len(done)}
+    assert buckets["escalations"] == 0
+    assert buckets["occupancy_n"] == len(done)
+    assert buckets["occupancy_sum"] > 0.0
+
+    reg = telemetry.registry_from_ledger(events)
+    prom = telemetry.render_prometheus(reg.snapshot())
+    assert 'tmx_jterator_bucket_routed_total{capacity="8"}' in prom
+    assert "tmx_jterator_slot_occupancy" in prom
+
+    prom_file = tmp_path / "metrics.prom"
+    assert main(["metrics", "--root", str(store.root), "--source",
+                 "ledger", "--out", str(prom_file)]) == 0
+    samples = telemetry.parse_prometheus(prom_file.read_text())
+    by_key = {(n, lbl.get("capacity")): v for n, lbl, v in samples}
+    assert by_key.get(("tmx_jterator_bucket_routed_total", "8")) == \
+        float(len(done))
+    assert ("tmx_jterator_slot_occupancy", None) in by_key
+
+    # the status CLI renders the same aggregate as a buckets line
+    # (same run — a second engine run would only re-prove the above)
+    assert main(["workflow", "status", "--root", str(store.root)]) == 0
+    text = capsys.readouterr().out
+    assert "buckets:" in text
+    assert "cap8x" in text
+    assert "slot occupancy" in text

@@ -1,0 +1,112 @@
+"""REAL host-death resume test: a subprocess worker is hard-killed
+(``os._exit``, via the fault harness's ``kill`` kind) in the middle of
+the jterator step, then a second subprocess resumes from the on-disk
+run ledger.  Every prior chaos test injected *exceptions* into one
+process — catchable, unwindable, ``finally``-visible.  A preempted TPU
+VM offers none of that: the ledger's crash-durability and the resume
+replay are the only recovery surface, and this test crosses a real
+process boundary to prove they suffice.
+
+Convergence bar: the killed-then-resumed store must match a fault-free
+reference run bit for bit — same label stacks, same feature tables —
+and the resume must not redo work the ledger already recorded.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from test_pipelined import _read_features_sorted  # noqa: F401
+from test_workflow import (  # noqa: F401 — fixture re-export
+    make_description,
+    source_dir,
+    store,
+    synth_site_image,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "multihost_resume_worker.py")
+
+
+def _launch(store_root, desc_path, phase, extra_env=None):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("TMX_FAULT_PLAN", None)  # never inherit a plan by accident
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, WORKER, str(store_root), str(desc_path), phase],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=240,
+    )
+
+
+def test_killed_worker_resume_converges(tmp_path, source_dir, store):
+    import pandas.testing
+
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.engine import RunLedger, Workflow
+
+    desc = make_description(source_dir, store)
+    desc_path = store.root / "workflow.yaml"
+    desc.save(desc_path)
+
+    # ---- phase 1: worker dies mid-step (kill = os._exit, rc 41) ----
+    plan = {"faults": [{"site": "batch_run", "step": "jterator",
+                        "batch": 1, "kind": "kill"}]}
+    plan_file = tmp_path / "plan.json"
+    plan_file.write_text(json.dumps(plan))
+    p1 = _launch(store.root, desc_path, "run",
+                 {"TMX_FAULT_PLAN": str(plan_file)})
+    assert p1.returncode == 41, \
+        f"expected injected host death, got rc {p1.returncode}:\n" \
+        f"{p1.stdout[-3000:]}"
+    assert "WORKER_DONE" not in p1.stdout
+
+    # the ledger survived the death mid-step: prep steps done, jterator
+    # batch 0 recorded, batch 1 and step_done missing
+    ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+    assert {"metaconfig", "imextract", "corilla"} <= \
+        ledger.completed_steps()
+    assert "jterator" not in ledger.completed_steps()
+    assert ledger.completed_batches("jterator") == {0}
+
+    # ---- phase 2: a fresh process resumes from the ledger alone ----
+    p2 = _launch(store.root, desc_path, "resume")
+    assert p2.returncode == 0, f"resume failed:\n{p2.stdout[-3000:]}"
+    assert "WORKER_DONE phase=resume" in p2.stdout
+
+    ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+    assert "jterator" in ledger.completed_steps()
+    assert ledger.completed_batches("jterator") == {0, 1}
+    # resume did NOT redo batch 0 — one batch_done per batch across both
+    # processes' appends
+    done = [e["batch"] for e in ledger.events()
+            if e.get("event") == "batch_done"
+            and e.get("step") == "jterator"]
+    assert sorted(done) == [0, 1]
+    # both the killed run and the resume stamped run_started; the resume
+    # flagged itself
+    starts = [e for e in ledger.events() if e.get("event") == "run_started"]
+    assert [s.get("resume") for s in starts] == [False, True]
+
+    # ---- convergence: identical to a never-faulted reference run ----
+    ref_store = ExperimentStore.create(
+        tmp_path / "ref_exp",
+        Experiment(name="wf", plates=[], channels=[], site_height=1,
+                   site_width=1),
+    )
+    ref_desc = make_description(source_dir, ref_store)
+    Workflow(ref_store, ref_desc).run()
+
+    # reopen: metaconfig rewrote the manifest in the worker processes,
+    # and the parent's in-memory store predates it
+    resumed = ExperimentStore.open(store.root)
+    assert np.array_equal(resumed.read_labels(None, "nuclei"),
+                          ref_store.read_labels(None, "nuclei"))
+    pandas.testing.assert_frame_equal(
+        _read_features_sorted(resumed, "nuclei"),
+        _read_features_sorted(ref_store, "nuclei"),
+    )

@@ -1,0 +1,126 @@
+"""Adaptive object-capacity bucketing.
+
+The static-shape policy pads every object-indexed output to a per-site
+``max_objects`` capacity so one fused XLA program serves all sites — but
+a sparse plate (BENCH_r05: ``saturated_sites: 0`` at cap 64) then spends
+most of its per-object FLOPs on empty slots: the one-hot contractions,
+quantile histograms and GLCM tables all scale with the capacity, not
+with the objects that exist.
+
+This module defines the *bucket ladder*: a small family of power-of-two
+capacities (via :func:`tmlibrary_tpu.utils.next_power_of_two`) ending at
+the configured ``max_objects`` ceiling.  The jterator step compiles one
+batch program per bucket it actually needs (the process-level
+``cached_batch_fn`` cache keys on the capacity) and routes each batch at
+launch time by the object counts observed so far; a batch whose counts
+reach its routed capacity is re-run one bucket up before anything is
+persisted, and only saturation at the *ceiling* falls through to the
+existing auto-resegmentation path.
+
+Bit-identity contract (pinned by ``tests/test_buckets.py``): for a site
+with ``count`` objects, every capacity ``c > count`` produces identical
+labels, counts and measurement rows ``1..count`` — the segmented
+reductions compute each object's row independently, and label ids are
+assigned in scan order regardless of the cap.  Routing is therefore a
+pure performance decision; persisting from a non-saturated run is what
+keeps the contract airtight (``clip_label_count`` only alters results
+once ``count`` hits the capacity, and the router never persists that
+state below the ceiling).
+
+Resolution order for the bucket spec (highest first): the step's
+explicit ``object_buckets`` arg when not ``"auto"``, the
+``TMX_OBJECT_BUCKETS`` env (the CLI ``--object-buckets`` knob), the
+install config (``TM_OBJECT_BUCKETS`` / INI ``object_buckets``), then
+``"auto"``.  Spec grammar: ``"auto"`` (the pow2 ladder), ``"off"``
+(single bucket at the ceiling — the pre-bucketing behavior), or an
+explicit comma list of capacities (``"8,32"``; the ceiling is always
+appended so escalation can reach it).
+"""
+
+from __future__ import annotations
+
+import os
+
+from tmlibrary_tpu.utils import next_power_of_two
+
+#: smallest bucket the auto ladder starts at — below this the padded
+#: program is too small for bucketing to pay for an extra compile
+DEFAULT_MIN_BUCKET = 8
+
+#: spec values that disable bucketing (single bucket at the ceiling)
+_OFF_VALUES = ("off", "none", "0", "false", "no")
+
+
+def requested_object_buckets() -> str:
+    """The ambient bucket spec: ``TMX_OBJECT_BUCKETS`` env (the CLI
+    knob) beats the install config beats ``"auto"``."""
+    env = os.environ.get("TMX_OBJECT_BUCKETS")
+    if env:
+        return env
+    from tmlibrary_tpu.config import _setting
+
+    return _setting("object_buckets", "auto") or "auto"
+
+
+def resolve_bucket_ladder(
+    max_objects: int, spec: "str | None" = None
+) -> tuple[int, ...]:
+    """The ascending capacity ladder for a ``max_objects`` ceiling.
+
+    ``spec=None`` or ``"auto"`` resolves the ambient request
+    (:func:`requested_object_buckets`); the ladder always ends at the
+    ceiling, so routing can never pick a capacity the configured cap
+    does not allow.  Malformed explicit specs fail LOUD — a typo'd knob
+    silently disabling the optimization would be invisible.
+    """
+    ceiling = int(max_objects)
+    if ceiling < 1:
+        raise ValueError(f"max_objects must be >= 1, got {max_objects}")
+    if spec is None or str(spec).strip().lower() in ("", "auto"):
+        spec = requested_object_buckets()
+    text = str(spec).strip().lower()
+    if text in _OFF_VALUES:
+        return (ceiling,)
+    if text in ("", "auto"):
+        caps = []
+        c = min(DEFAULT_MIN_BUCKET, ceiling)
+        while c < ceiling:
+            caps.append(c)
+            c = next_power_of_two(c + 1)
+        return tuple(caps) + (ceiling,)
+    caps = set()
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            val = int(part)
+        except ValueError:
+            raise ValueError(
+                f"object_buckets spec '{spec}' is not 'auto', 'off' or a "
+                f"comma list of capacities"
+            ) from None
+        if val < 1:
+            raise ValueError(
+                f"object_buckets capacity must be >= 1, got {val}"
+            )
+        if val < ceiling:
+            caps.add(val)
+    return tuple(sorted(caps)) + (ceiling,)
+
+
+def select_capacity(observed: int, ladder: tuple[int, ...]) -> int:
+    """The smallest ladder capacity that holds ``observed`` objects
+    *without saturating* (``observed < capacity`` — a count AT the cap
+    may have been clipped there), falling back to the ceiling."""
+    for cap in ladder:
+        if observed < cap:
+            return cap
+    return ladder[-1]
+
+
+def slot_occupancy(total_objects: float, n_slots: float) -> float:
+    """Fraction of padded object slots actually used (0 when there are
+    no slots) — the padding-waste signal carried by bench records and
+    the ``tmx_jterator_slot_occupancy`` gauge."""
+    return float(total_objects) / n_slots if n_slots else 0.0

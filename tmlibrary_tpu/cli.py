@@ -198,6 +198,17 @@ def build_parser() -> argparse.ArgumentParser:
              "matmuls on accelerators; 'sort' is the exactly "
              "deterministic path)",
     )
+    shared.add_argument(
+        "--object-buckets", default=None, metavar="SPEC",
+        help="object-capacity bucket ladder for the jterator step "
+             "(capacity.py): 'auto' compiles power-of-two capacity "
+             "buckets up to max_objects and routes each batch by its "
+             "observed object counts (bit-identical results, fewer "
+             "padded-slot FLOPs), 'off' pins every batch at "
+             "max_objects, or a comma list of capacities like '8,32' "
+             "(default: TMX_OBJECT_BUCKETS / TM_OBJECT_BUCKETS config, "
+             "else auto)",
+    )
     # fault-tolerance knobs (resilience.py; defaults from LibraryConfig /
     # TM_RETRY_ATTEMPTS, TM_MAX_BATCH_FAILURES, ... env)
     shared.add_argument(
@@ -516,6 +527,20 @@ def cmd_workflow(args) -> int:
             for clamp in entry.get("depth_clamps", []):
                 print(f"{'':12s} depth clamped {clamp.get('from')} -> "
                       f"{clamp.get('to')} (resource exhausted)")
+            buckets = entry.get("buckets")
+            if buckets:
+                routed = " ".join(
+                    f"cap{c}x{n}" for c, n in sorted(
+                        buckets["routed"].items(), key=lambda kv: int(kv[0])
+                    )
+                )
+                line = f"{'':12s} buckets: {routed}"
+                if buckets.get("occupancy_n"):
+                    occ = buckets["occupancy_sum"] / buckets["occupancy_n"]
+                    line += f" slot occupancy {occ:.1%}"
+                if buckets.get("escalations"):
+                    line += f" escalations {buckets['escalations']}"
+                print(line)
         degraded = RunLedger(store.workflow_dir / "ledger.jsonl").degraded_backend()
         if degraded:
             print(f"backend degraded to {degraded.get('backend')} "
@@ -599,6 +624,17 @@ def cmd_workflow(args) -> int:
             _os.environ.pop("TMX_REDUCTION_STRATEGY", None)
         else:
             _os.environ["TMX_REDUCTION_STRATEGY"] = args.reduction_strategy
+    if getattr(args, "object_buckets", None):
+        import os as _os
+
+        # same env pattern as --reduction-strategy: the bucket router
+        # resolves the spec at every launch (capacity.py resolution
+        # order), so the request must outlive this function; "auto"
+        # clears any stale explicit request
+        if args.object_buckets == "auto":
+            _os.environ.pop("TMX_OBJECT_BUCKETS", None)
+        else:
+            _os.environ["TMX_OBJECT_BUCKETS"] = args.object_buckets
     if args.sample_resources is not None:
         from tmlibrary_tpu.config import cfg as _cfg
 

@@ -24,6 +24,12 @@ Hook sites (``site`` field of a spec):
     fired inside the device health probe — ``kind="hang"`` sleeps
     past the probe deadline (a down relay hangs, it doesn't error).
 
+The ``kill`` kind is special: instead of raising it hard-exits the
+process (``os._exit(41)``) — no exception propagation, no cleanup —
+simulating a preempted/OOM-killed worker host.  Only meaningful in
+subprocess harnesses (``tests/test_multihost_resume.py``) where a
+parent process observes the death and re-launches with ``resume``.
+
 Activation: programmatic ``install(plan)`` / ``clear()`` (tests,
 ``scripts/chaos_run.py``) or the ``TMX_FAULT_PLAN`` environment
 variable holding inline JSON or a path to a JSON file.  With no plan
@@ -45,7 +51,7 @@ from tmlibrary_tpu.errors import FaultInjected, TransientDeviceError
 logger = logging.getLogger(__name__)
 
 #: exception factories per fault kind
-_KINDS = ("device_loss", "io_error", "crash", "crash_append", "hang")
+_KINDS = ("device_loss", "io_error", "crash", "crash_append", "hang", "kill")
 
 
 @dataclasses.dataclass
@@ -162,6 +168,13 @@ def raise_for(spec: FaultSpec, site: str, ctx: dict) -> None:
     where = f"{site} step={ctx.get('step')} batch={ctx.get('batch')}"
     logger.warning("fault injection firing: %s at %s (%d/%d)",
                    spec.kind, where, spec.fired, spec.times)
+    if spec.kind == "kill":
+        # hard host death: no exception to catch, no finally blocks, no
+        # atexit — exactly what a preempted TPU VM looks like to the
+        # surviving run ledger.  41 marks an injected (not organic) death.
+        logger.warning("fault injection: hard-killing process at %s", where)
+        logging.shutdown()
+        os._exit(41)
     if spec.kind == "hang":
         time.sleep(spec.seconds)
         raise TransientDeviceError(f"injected hang ({spec.seconds}s) at {where}")

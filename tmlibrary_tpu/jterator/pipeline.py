@@ -14,7 +14,13 @@ work is only store IO and ragged exports (polygons, Parquet).
 
 Static-shape policy: object-indexed outputs are padded to ``max_objects``
 per site; measurement rows beyond the site's object count are garbage and
-masked on export using the returned counts.
+masked on export using the returned counts.  The capacity is a pure
+padding choice: any two programs built at capacities that both exceed a
+site's object count produce bit-identical labels, counts and measurement
+rows — the contract the object-capacity bucket router
+(``tmlibrary_tpu.capacity``) relies on when it compiles a small family of
+programs over power-of-two caps and routes batches to the smallest one
+that fits.
 """
 
 from __future__ import annotations
@@ -46,8 +52,11 @@ from tmlibrary_tpu.parallel.compat import shard_map
 #: TMX_SITE_STATS measure-kernel gate).  Bounded FIFO: a
 #: long-lived service crossing many experiments (each align crop window
 #: is a distinct key) must not retain every compiled program forever.
+#: Sized for the bucket router: one pipeline now legitimately holds a
+#: whole capacity ladder (8/16/32/... up to max_objects) of programs at
+#: once, so the bound leaves room for two experiments' ladders.
 _BATCH_FN_CACHE: dict[tuple, Callable] = {}
-_BATCH_FN_CACHE_MAX = 16
+_BATCH_FN_CACHE_MAX = 32
 
 
 def _description_cache_key(description: PipelineDescription) -> str:

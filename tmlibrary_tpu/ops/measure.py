@@ -25,6 +25,7 @@ import numpy as np
 
 from tmlibrary_tpu.ops.label import shift_with_fill
 from tmlibrary_tpu.ops.reduction import (
+    capacity_segments,
     explicit_reduction_request,
     resolve_reduction_strategy,
     segmented_max,
@@ -37,7 +38,9 @@ def _seg_sum(values: jax.Array, labels: jax.Array, max_objects: int) -> jax.Arra
     """segment_sum over label ids; returns per-object rows 1..max_objects."""
     flat = labels.reshape(-1)
     vals = values.reshape(-1)
-    out = jax.ops.segment_sum(vals, flat, num_segments=max_objects + 1)
+    out = jax.ops.segment_sum(
+        vals, flat, num_segments=capacity_segments(max_objects)
+    )
     return out[1:]
 
 
@@ -112,7 +115,7 @@ def grouped_sums(
             vmap_method=native.callback_vmap_method(),
         )
     if method in ("scatter", "sort"):
-        out = segmented_sum(stacked, flat, max_objects + 1, method)
+        out = segmented_sum(stacked, flat, capacity_segments(max_objects), method)
         return out[1:]
     if method != "matmul":
         raise ValueError(f"unknown grouped_sums method '{method}'")
@@ -129,12 +132,16 @@ def grouped_sums(
     stacked = stacked.reshape(n_chunks, _SUM_CHUNK, -1)
 
     def body(i, acc):
-        oh = jax.nn.one_hot(flat[i], max_objects + 1, dtype=jnp.float32)
+        oh = jax.nn.one_hot(
+            flat[i], capacity_segments(max_objects), dtype=jnp.float32
+        )
         return acc + jnp.einsum(
             "ps,pk->ks", stacked[i], oh, precision=jax.lax.Precision.HIGHEST
         )
 
-    init = jnp.zeros((max_objects + 1, stacked.shape[-1]), jnp.float32)
+    init = jnp.zeros(
+        (capacity_segments(max_objects), stacked.shape[-1]), jnp.float32
+    )
     out = jax.lax.fori_loop(0, n_chunks, body, init)
     return out[1:]
 
@@ -218,8 +225,9 @@ def grouped_minmax(
     if method == "onehot":
         method = "reduce"
     if method in ("scatter", "sort"):
-        mn = segmented_min(flat_v, flat_l, max_objects + 1, method)
-        mx = segmented_max(flat_v, flat_l, max_objects + 1, method)
+        segs = capacity_segments(max_objects)
+        mn = segmented_min(flat_v, flat_l, segs, method)
+        mx = segmented_max(flat_v, flat_l, segs, method)
         return mn[1:], mx[1:]
     if method != "reduce":
         raise ValueError(f"unknown grouped_minmax method '{method}'")
@@ -303,8 +311,9 @@ def grouped_minmax_multi(
             vmap_method=native.callback_vmap_method(),
         )
     if method in ("scatter", "sort"):
-        mn = segmented_min(stacked, flat_l, max_objects + 1, method)
-        mx = segmented_max(stacked, flat_l, max_objects + 1, method)
+        segs = capacity_segments(max_objects)
+        mn = segmented_min(stacked, flat_l, segs, method)
+        mx = segmented_max(stacked, flat_l, segs, method)
         return mn[1:], mx[1:]
     if method != "reduce":
         raise ValueError(f"unknown grouped_minmax_multi method '{method}'")
@@ -466,10 +475,11 @@ def intensity_quantiles(
     strategy = resolve_reduction_strategy(method)
     if strategy in ("scatter", "sort"):
         idx = lab_flat * bins + q_flat
+        segs = capacity_segments(max_objects)
         counts = segmented_sum(
             jnp.ones_like(idx, jnp.float32), idx,
-            (max_objects + 1) * bins, strategy,
-        ).reshape(max_objects + 1, bins)[1:]
+            segs * bins, strategy,
+        ).reshape(segs, bins)[1:]
         return _quantiles_from_counts(counts, lo, span, present, qs, bins)
     p = lab_flat.shape[0]
     pad = (-p) % _GLCM_CHUNK
@@ -481,14 +491,17 @@ def intensity_quantiles(
     q_flat = q_flat.reshape(n_chunks, _GLCM_CHUNK)
 
     def body(i, acc):
-        oh_l = jax.nn.one_hot(lab_flat[i], max_objects + 1, dtype=jnp.float32)
+        oh_l = jax.nn.one_hot(
+            lab_flat[i], capacity_segments(max_objects), dtype=jnp.float32
+        )
         oh_q = jax.nn.one_hot(q_flat[i], bins, dtype=jnp.float32)
         return acc + jnp.einsum(
             "pm,pb->mb", oh_l, oh_q, precision=jax.lax.Precision.HIGHEST
         )
 
     counts = jax.lax.fori_loop(
-        0, n_chunks, body, jnp.zeros((max_objects + 1, bins), jnp.float32)
+        0, n_chunks, body,
+        jnp.zeros((capacity_segments(max_objects), bins), jnp.float32),
     )[1:]
     return _quantiles_from_counts(counts, lo, span, present, qs, bins)
 
@@ -642,7 +655,7 @@ def _glcm_matmul_all(
         (c.reshape(n_chunks, _GLCM_CHUNK), v.reshape(n_chunks, _GLCM_CHUNK))
         for c, v in cols
     ]
-    n_rows = (max_objects + 1) * levels
+    n_rows = capacity_segments(max_objects) * levels
     k = len(offsets)
 
     def body(i, acc):
@@ -669,7 +682,7 @@ def _glcm_matmul_all(
     out = []
     for d in range(k):
         glcm = counts[:, d * levels : (d + 1) * levels].reshape(
-            max_objects + 1, levels, levels
+            capacity_segments(max_objects), levels, levels
         )[1:]
         out.append(glcm + jnp.swapaxes(glcm, 1, 2))
     return out
@@ -702,10 +715,10 @@ def _glcm_scatter(
     counts = segmented_sum(
         valid.reshape(-1).astype(jnp.float32),
         idx.reshape(-1),
-        (max_objects + 1) * levels * levels,
+        capacity_segments(max_objects) * levels * levels,
         strategy,
     )
-    glcm = counts.reshape(max_objects + 1, levels, levels)[1:]
+    glcm = counts.reshape(capacity_segments(max_objects), levels, levels)[1:]
     return glcm + jnp.swapaxes(glcm, 1, 2)
 
 

@@ -62,6 +62,22 @@ import jax.numpy as jnp
 #: the explicit strategies; "auto" resolves to one of these
 STRATEGIES = ("onehot", "sort", "scatter")
 
+
+def capacity_segments(capacity: int) -> int:
+    """Segment count for an object-capacity of ``capacity``: one row per
+    object id plus row 0 for background — the ONE place the capacity →
+    ``num_segments`` convention lives for all three strategies.
+
+    Capacity-invariance contract (pinned by ``tests/test_reduction.py``
+    and relied on by the bucket router in ``tmlibrary_tpu.capacity``):
+    every strategy computes each segment's row independently of how many
+    padded rows follow it, so for ids bounded by ``n``, any two
+    capacities ``>= n`` yield bit-identical rows ``0..n``.  That makes
+    the padded capacity a pure cost knob — the one-hot contraction,
+    histogram and GLCM shapes all scale with it while the results do
+    not."""
+    return int(capacity) + 1
+
 _PIN = threading.local()
 _UNSET = object()
 

@@ -703,6 +703,7 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
     """
     reg = MetricsRegistry(enabled=True)
     step_units: dict[str, dict[str, float]] = {}
+    occ_acc = [0.0, 0.0]  # running (sum, n) of per-batch slot occupancy
     for ev in events:
         kind = ev.get("event")
         step = str(ev.get("step", "")) or "unknown"
@@ -731,6 +732,28 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                         break
                 else:
                     acc["units"] += 1.0
+                # object-capacity bucket routing (capacity.py): batch
+                # summaries self-describe their routed capacity + slot
+                # occupancy, so ledger-derived metrics expose the same
+                # gauges the live registry does
+                cap = result.get("bucket_capacity")
+                if cap is not None:
+                    reg.counter(
+                        "tmx_jterator_bucket_routed_total",
+                        capacity=str(cap),
+                    ).inc()
+                    esc = int(result.get("bucket_escalations", 0) or 0)
+                    if esc:
+                        reg.counter(
+                            "tmx_jterator_bucket_saturated_total"
+                        ).inc(esc)
+                    occ = result.get("slot_occupancy")
+                    if occ is not None:
+                        occ_acc[0] += float(occ)
+                        occ_acc[1] += 1.0
+                        reg.gauge("tmx_jterator_slot_occupancy").set(
+                            occ_acc[0] / occ_acc[1]
+                        )
         elif kind == "batch_failed":
             reg.counter("tmx_batches_failed_total", step=step).inc()
         elif kind in ("step_done", "step_partial"):

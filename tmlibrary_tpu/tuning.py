@@ -91,6 +91,29 @@ def tuned_reduction_strategy(backend: str | None = None) -> str | None:
     return value if value in _REDUCTION_STRATEGIES else None
 
 
+def tuned_object_capacity(backend: str | None = None) -> int | None:
+    """The swept object-capacity bucket verdict for ``backend``, or None.
+
+    ``bench.py --sweep`` records the winning capacity (``best_capacity``)
+    when ``BENCH_SWEEP_CAPACITIES`` puts the bucket ladder on the grid;
+    the jterator step uses it as the first-batch routing hint before any
+    on-run object counts exist.  Same provenance and backend-scoping
+    rules as :func:`tuned_reduction_strategy`."""
+    tuning = load_tuning()
+    if not tuning:
+        return None
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    entry = tuning.get("object_capacity")
+    if isinstance(entry, dict):
+        return _positive_int(entry.get(backend))
+    if tuning.get("backend") == backend:
+        return _positive_int(entry)
+    return None
+
+
 def record_config_sweep(config: str, entry: dict) -> dict:
     """Merge one per-config sweep verdict into the tuning file.
 
@@ -127,6 +150,13 @@ def record_config_sweep(config: str, entry: dict) -> dict:
             )
         verdicts[backend] = strategy
         data["reduction_strategy"] = verdicts
+    capacity = _positive_int(entry.get("best_capacity"))
+    if backend and capacity:
+        caps = data.get("object_capacity")
+        if not isinstance(caps, dict):
+            caps = {}
+        caps[backend] = capacity
+        data["object_capacity"] = caps
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:

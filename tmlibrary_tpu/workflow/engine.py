@@ -310,6 +310,27 @@ class RunLedger:
                 entry["elapsed"] += e.get("elapsed", 0.0)
                 if e.get("batch") in entry["quarantined"]:
                     entry["quarantined"].remove(e["batch"])
+                # object-capacity bucket routing (capacity.py): the batch
+                # summary self-describes its routed capacity and slot
+                # occupancy — aggregate so `tmx workflow status` shows
+                # padding waste without re-reading any outputs
+                result = e.get("result") or {}
+                cap = result.get("bucket_capacity")
+                if cap is not None:
+                    buckets = entry.setdefault(
+                        "buckets",
+                        {"routed": {}, "escalations": 0,
+                         "occupancy_sum": 0.0, "occupancy_n": 0},
+                    )
+                    key = str(cap)
+                    buckets["routed"][key] = buckets["routed"].get(key, 0) + 1
+                    buckets["escalations"] += int(
+                        result.get("bucket_escalations", 0)
+                    )
+                    occ = result.get("slot_occupancy")
+                    if occ is not None:
+                        buckets["occupancy_sum"] += float(occ)
+                        buckets["occupancy_n"] += 1
             elif e["event"] == "batch_failed":
                 if e.get("batch") not in entry["quarantined"]:
                     entry["quarantined"].append(e.get("batch"))

@@ -158,6 +158,15 @@ class ImageAnalysisRunner(Step):
                       "sweep's best_batch on device backends, else 32)"),
         Argument("max_objects", int, default=256,
                  help="static per-site object capacity"),
+        Argument("object_buckets", str, default="auto",
+                 help="object-capacity bucket ladder (capacity.py): "
+                      "'auto' compiles power-of-two buckets up to "
+                      "max_objects and routes each batch by observed "
+                      "object counts; 'off' pins every batch at "
+                      "max_objects; or an explicit comma list of "
+                      "capacities, e.g. '8,32'. Results are bit-identical "
+                      "across bucket choices — routing is purely a "
+                      "performance decision"),
         Argument("reduction_strategy", str, default="auto",
                  choices=("auto", "onehot", "sort", "scatter"),
                  help="grouped-reduction strategy for the measurement "
@@ -190,15 +199,24 @@ class ImageAnalysisRunner(Step):
 
     def __init__(self, store):
         super().__init__(store)
-        self._compiled = None
-        self._compiled_cap: int | None = None
+        # capacity -> compiled batch fn: the bucket router compiles one
+        # program per object-capacity bucket it actually routes to (each
+        # is also process-cached in jterator.pipeline.cached_batch_fn)
+        self._compiled: dict[int, object] = {}
         self._desc = None
         self._window: tuple[int, int, int, int] | None = None
+        self._window_resolved = False
         # prefetch workers read the pipeline description (and the figures
         # path re-resolves the compiled program) concurrently with the
         # main thread's launch; the lock keeps the compile cache coherent
-        # when two threads race on different max_objects caps
+        # when two threads race on different capacities
         self._compile_lock = threading.Lock()
+        # highest per-site object count observed so far (per object
+        # family max, folded together) — drives launch-time bucket
+        # routing; lock-protected because persist runs on the pipelined
+        # executor's worker thread while launch runs on the engine's
+        self._bucket_lock = threading.Lock()
+        self._bucket_max_count: int | None = None
 
     def create_batches(self, args):
         if args["layout"] == "spatial":
@@ -255,40 +273,44 @@ class ImageAnalysisRunner(Step):
                 self._desc = PipelineDescription.load(pipe_path)
             return self._desc
 
-    def _pipeline(self, args):
+    def _pipeline(self, args, capacity: int | None = None):
+        """The compiled batch program for ``capacity`` (default: the
+        ``max_objects`` ceiling).  One entry per object-capacity bucket —
+        the router picks the capacity at launch time, and collect's
+        auto-resegmentation re-runs a batch at a doubled ceiling, so the
+        cache is keyed by the cap a program was actually built for."""
         self._description(args)
+        cap = int(capacity if capacity is not None else args["max_objects"])
         with self._compile_lock:
-            # cache keyed by the object cap: batches normally share one cap,
-            # but collect's auto-resegmentation re-runs a batch at a doubled
-            # max_objects — reusing the old compiled program would silently
-            # keep the old cap while the saturation check uses the new one
-            if self._compiled is None or self._compiled_cap != args["max_objects"]:
+            if cap not in self._compiled:
                 # aligned multiplexing experiments crop every channel to the
                 # inter-cycle intersection (reference SiteIntersection); the
                 # window is experiment-static, so it compiles into the program
-                if any(ch.align for ch in self._desc.channels):
-                    try:
-                        w = self.store.read_intersection()
-                        self._window = (w["top"], w["bottom"], w["left"], w["right"])
-                    except StoreError:
-                        self._window = None  # align step didn't run: no crop
-                    if self._window == (0, 0, 0, 0):
-                        self._window = None
+                if not self._window_resolved:
+                    if any(ch.align for ch in self._desc.channels):
+                        try:
+                            w = self.store.read_intersection()
+                            self._window = (w["top"], w["bottom"],
+                                            w["left"], w["right"])
+                        except StoreError:
+                            self._window = None  # align step didn't run: no crop
+                        if self._window == (0, 0, 0, 0):
+                            self._window = None
+                    self._window_resolved = True
                 # process-level cache: a re-built Step (fresh Workflow, engine
                 # re-run, tool request) running the same description reuses
                 # the traced+compiled program instead of re-paying trace+load
                 from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
 
-                self._compiled = cached_batch_fn(
-                    self._desc, args["max_objects"], self._window,
+                self._compiled[cap] = cached_batch_fn(
+                    self._desc, cap, self._window,
                     # arg True defers to the config default (so
                     # TM_DONATE_BUFFERS=0 still disables it); arg False
                     # forces donation off for this run
                     donate=None if args.get("donate_buffers", True) else False,
                     reduction_strategy=args.get("reduction_strategy", "auto"),
                 )
-                self._compiled_cap = args["max_objects"]
-            return self._desc, self._compiled
+            return self._desc, self._compiled[cap]
 
     # -------------------------------------------------------------------- run
     def _effective_batch(self, batch: dict) -> dict:
@@ -304,14 +326,46 @@ class ImageAnalysisRunner(Step):
                                       "max_objects": int(override)}}
         return batch
 
+    def _route_capacity(self, batch: dict) -> int:
+        """Pick the object-capacity bucket for a batch at launch time.
+
+        Ordering matters for the pipelined executor: routing happens on
+        the engine thread at launch, reading the peak per-site count the
+        persist worker has recorded so far — the first batch has no
+        history, so it starts from the hardware-swept capacity verdict
+        (``TUNING.json``) when one is on the ladder, else the ladder's
+        smallest bucket.  A mis-route only costs a re-launch one bucket
+        up (:meth:`_persist` escalates before persisting), never a
+        wrong result."""
+        args = batch["args"]
+        ceiling = int(args["max_objects"])
+        from tmlibrary_tpu.capacity import resolve_bucket_ladder, select_capacity
+
+        ladder = resolve_bucket_ladder(
+            ceiling, args.get("object_buckets", "auto")
+        )
+        if len(ladder) == 1:
+            return ceiling
+        with self._bucket_lock:
+            observed = self._bucket_max_count
+        if observed is None:
+            from tmlibrary_tpu.tuning import tuned_object_capacity
+
+            hint = tuned_object_capacity()
+            if hint and hint in ladder:
+                return int(hint)
+            return ladder[0]
+        return select_capacity(observed, ladder)
+
     def run_batch(self, batch: dict) -> dict:
         self._mark_work_start()
         batch = self._effective_batch(batch)
         # .get: batch JSONs persisted by a pre-layout init lack the key
         if batch["args"].get("layout", "sites") == "spatial":
             return self._run_spatial(batch)
-        result = self._launch(batch)
-        return self._persist(batch, result)
+        cap = self._route_capacity(batch)
+        result = self._launch(batch, capacity=cap)
+        return self._persist(batch, result, capacity=cap)
 
     # -------------------------------------------------- throughput gauge
     # sites/sec over cumulative wall time since the first batch — the same
@@ -336,6 +390,39 @@ class ImageAnalysisRunner(Step):
         if elapsed > 0:
             reg.gauge("tmx_jterator_sites_per_sec").set(done / elapsed)
 
+    def _note_bucket(
+        self, cap: int, ceiling: int, objects: int, slots: int,
+        escalations: int,
+    ) -> None:
+        """Bucket-router telemetry: routed/saturated counters plus the
+        run-cumulative slot-occupancy and padded-FLOPs-avoided gauges
+        (the per-object measure FLOPs scale with the capacity, so the
+        slot ratio routed/ceiling IS the padded-work fraction saved)."""
+        if not telemetry.enabled():
+            return
+        reg = telemetry.get_registry()
+        reg.counter(
+            "tmx_jterator_bucket_routed_total", capacity=str(cap)
+        ).inc()
+        if escalations:
+            reg.counter("tmx_jterator_bucket_saturated_total").inc(escalations)
+        with self._bucket_lock:
+            self._occ_objects = getattr(self, "_occ_objects", 0) + objects
+            self._occ_slots = getattr(self, "_occ_slots", 0) + slots
+            ceiling_slots = (slots // cap) * ceiling if cap else 0
+            self._occ_ceiling_slots = (
+                getattr(self, "_occ_ceiling_slots", 0) + ceiling_slots
+            )
+            occ_o, occ_s, occ_c = (
+                self._occ_objects, self._occ_slots, self._occ_ceiling_slots
+            )
+        if occ_s:
+            reg.gauge("tmx_jterator_slot_occupancy").set(occ_o / occ_s)
+        if occ_c:
+            reg.gauge("tmx_jterator_padded_flops_avoided_frac").set(
+                1.0 - occ_s / occ_c
+            )
+
     # ------------------------------------------------- launch/persist split
     # (the pipelined executor's step protocol — workflow/pipelined.py)
     def prefetch_batch(self, batch: dict):
@@ -353,7 +440,10 @@ class ImageAnalysisRunner(Step):
         batch = self._effective_batch(batch)
         if batch["args"].get("layout", "sites") == "spatial":
             return batch, ("spatial", self._launch_spatial(batch, prefetched))
-        return batch, ("sites", self._launch(batch, prefetched))
+        cap = self._route_capacity(batch)
+        return batch, (
+            "sites", (self._launch(batch, prefetched, capacity=cap), cap)
+        )
 
     def block_batch(self, ctx) -> None:
         """Wait for the launched device arrays (distinct pipeline-stats
@@ -363,7 +453,7 @@ class ImageAnalysisRunner(Step):
         kind, payload = ctx
         if kind == "sites":
             # SiteResult is a registered pytree: block on all leaves
-            jax.block_until_ready(payload)
+            jax.block_until_ready(payload[0])
             return
         jax.block_until_ready(payload["labels_dev"])
         jax.block_until_ready(payload["count_dev"])
@@ -376,7 +466,8 @@ class ImageAnalysisRunner(Step):
         kind, payload = ctx
         if kind == "spatial":
             return self._persist_spatial(batch, payload)
-        return self._persist(batch, payload)
+        result, cap = payload
+        return self._persist(batch, result, capacity=cap)
 
     # ------------------------------------------------------------ spatial run
     def _stitched_channel(
@@ -893,7 +984,10 @@ class ImageAnalysisRunner(Step):
         return {"padded_sites": padded_sites, "n_dev": n_dev,
                 "raw": raw, "stats": stats, "shifts_np": shifts_np}
 
-    def _launch(self, batch: dict, inputs: dict | None = None):
+    def _launch(
+        self, batch: dict, inputs: dict | None = None,
+        capacity: int | None = None,
+    ):
         """Transfer the (possibly prefetched) inputs and dispatch the
         device computation; returns without waiting for completion."""
         import jax
@@ -901,7 +995,7 @@ class ImageAnalysisRunner(Step):
 
         from tmlibrary_tpu.parallel.mesh import batch_sharding, site_mesh
 
-        _, fn = self._pipeline(batch["args"])
+        _, fn = self._pipeline(batch["args"], capacity)
         if inputs is None:
             inputs = self._load_inputs(batch)
         padded_sites = inputs["padded_sites"]
@@ -925,12 +1019,47 @@ class ImageAnalysisRunner(Step):
 
         return fn(raw, inputs["stats"], shifts)
 
-    def _persist(self, batch: dict, result) -> dict:
+    def _persist(self, batch: dict, result, capacity: int | None = None) -> dict:
         """Fetch one launched batch's device results and write them out."""
         args = batch["args"]
         sites = batch["sites"]
         tpoint, zplane = args["tpoint"], args["zplane"]
         n_valid = len(sites)
+        ceiling = int(args["max_objects"])
+        cap = int(capacity) if capacity is not None else ceiling
+        escalations = 0
+        if cap < ceiling:
+            # Escalate until the routed capacity holds the batch.  A
+            # count AT the cap may have been clipped there, so nothing
+            # below the ceiling is ever persisted from a saturated run —
+            # this is the bit-identity contract (capacity.py): below the
+            # ceiling, routing can cost a re-launch one bucket up, never
+            # a different result.  Ceiling saturation keeps its existing
+            # warn/auto-resegment flow below.
+            from tmlibrary_tpu.capacity import (
+                resolve_bucket_ladder, select_capacity,
+            )
+
+            ladder = resolve_bucket_ladder(
+                ceiling, args.get("object_buckets", "auto")
+            )
+            while cap < ceiling:
+                peak = max(
+                    (int(np.asarray(v)[:n_valid].max(initial=0))
+                     for v in result.counts.values()),
+                    default=0,
+                )
+                if peak < cap:
+                    break
+                new_cap = select_capacity(cap, ladder)
+                logger.info(
+                    "batch %s saturated its routed object-capacity bucket "
+                    "(count hit %d) — re-running at capacity %d",
+                    batch.get("index"), cap, new_cap,
+                )
+                escalations += 1
+                cap = new_cap
+                result = self._launch(batch, capacity=cap)
         counts = {k: np.asarray(v)[:n_valid] for k, v in result.counts.items()}
         objects = {k: np.asarray(v)[:n_valid] for k, v in result.objects.items()}
         measurements = {
@@ -999,7 +1128,7 @@ class ImageAnalysisRunner(Step):
             # the first input channel
             from tmlibrary_tpu.jterator.figures import write_figures
 
-            desc, _ = self._pipeline(args)
+            desc = self._description(args)
             first_ch = next((c for c in desc.channels if not c.zstack), None)
             if first_ch is not None:
                 idx = self.store.experiment.channel_index(first_ch.name)
@@ -1026,6 +1155,24 @@ class ImageAnalysisRunner(Step):
             "n_sites": n_valid,
             "objects": {k: int(v.sum()) for k, v in counts.items()},
         }
+        # bucket bookkeeping: feed the router's count history, and carry
+        # capacity + slot occupancy in the batch summary so the ledger
+        # (tmx workflow status, registry_from_ledger) sees padding waste
+        from tmlibrary_tpu.capacity import slot_occupancy
+
+        peak = max(
+            (int(v.max(initial=0)) for v in counts.values()), default=0
+        )
+        with self._bucket_lock:
+            prior = self._bucket_max_count
+            self._bucket_max_count = peak if prior is None else max(prior, peak)
+        total_objects = sum(summary["objects"].values())
+        slots = len(counts) * n_valid * cap
+        summary["bucket_capacity"] = cap
+        summary["slot_occupancy"] = round(slot_occupancy(total_objects, slots), 4)
+        if escalations:
+            summary["bucket_escalations"] = escalations
+        self._note_bucket(cap, ceiling, total_objects, slots, escalations)
         # object-capacity saturation must be LOUD: clip_label_count silently
         # zeroes labels past max_objects, so a site whose count sits AT the
         # cap may have lost objects — surface it per batch in the ledger,
