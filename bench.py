@@ -74,6 +74,18 @@ def emit_record(record: dict) -> None:
             ).set(float(record.get("value", 0.0)))
     except Exception:
         pass  # telemetry must never break the stdout contract
+    try:
+        # append-only history for the regression sentinel
+        # (scripts/bench_regression.py, `tmx perf history`).  Parent-only:
+        # the --child process prints into a captured pipe and the parent
+        # re-emits the parsed record, so appending in both would double
+        # every line.
+        if "--child" not in sys.argv:
+            from tmlibrary_tpu.tuning import append_bench_history
+
+            append_bench_history(record)
+    except Exception:
+        pass  # history is observability, same contract
     print(json.dumps(record), flush=True)
 
 
@@ -823,54 +835,17 @@ def measure(platform: str) -> None:
     emit_record(record)
 
 
-def _cost_flops(jitted_fn, *args):
-    """(total FLOPs, total bytes accessed) of one compiled batch step via
-    XLA's cost model — (None, None) if the backend does not report it
-    (round-2 VERDICT weak-spot: "fast" was only ever judged against
-    scipy, never against the roofline; round-4 next-step #3: MFU alone
-    is the wrong lens for this memory/latency-shaped workload, so the
-    bytes side of the roofline must travel with every record)."""
-    try:
-        analysis = jitted_fn.lower(*args).compile().cost_analysis()
-        if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
-            analysis = analysis[0] if analysis else {}
-        flops = float(analysis.get("flops", 0.0))
-        nbytes = float(analysis.get("bytes accessed", 0.0))
-        return (flops if flops > 0 else None,
-                nbytes if nbytes > 0 else None)
-    except Exception:
-        return (None, None)
-
-
-# MXU peak of one TPU v5e (v5 lite) chip in bf16; the pipeline runs mostly
-# f32 (correctness gate: HIGHEST-precision convs), so MFU against the bf16
-# peak is a conservative lower bound.
-_V5E_BF16_PEAK_FLOPS = 197e12
-#: HBM bandwidth of one v5e chip (public spec: 819 GB/s)
-_V5E_HBM_PEAK_BPS = 819e9
-
-
-def _flops_fields(flops, n_items, best_s, backend, item_key="flops_per_site",
-                  nbytes=None):
-    out = {}
-    on_device = backend != "cpu"
-    if flops:
-        achieved = flops / best_s
-        out[item_key] = round(flops / n_items)
-        out["achieved_tflops_per_sec"] = round(achieved / 1e12, 4)
-        out["mfu_vs_v5e_bf16_peak"] = (
-            round(achieved / _V5E_BF16_PEAK_FLOPS, 6) if on_device else None
-        )
-    if nbytes:
-        bps = nbytes / best_s
-        out["bytes_per_" + item_key.split("_per_")[-1]] = round(
-            nbytes / n_items
-        )
-        out["achieved_gbytes_per_sec"] = round(bps / 1e9, 3)
-        out["hbm_frac_vs_v5e_peak"] = (
-            round(bps / _V5E_HBM_PEAK_BPS, 6) if on_device else None
-        )
-    return out
+# ONE definition of the XLA cost model + roofline math, now in the
+# installable package (tmlibrary_tpu.perf) because the production engine
+# attaches the same cost profile to every cached batch fn; re-exported
+# here under the old names so every measure_* call site (and anything
+# importing the peaks from bench) keeps working.
+from tmlibrary_tpu.perf import (  # noqa: E402
+    V5E_BF16_PEAK_FLOPS as _V5E_BF16_PEAK_FLOPS,
+    V5E_HBM_PEAK_BPS as _V5E_HBM_PEAK_BPS,
+    cost_flops as _cost_flops,
+    flops_fields as _flops_fields,
+)
 
 
 def measure_pyramid(size: int) -> None:

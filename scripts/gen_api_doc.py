@@ -112,6 +112,30 @@ def telemetry_section() -> list[str]:
     return out
 
 
+def perf_section() -> list[str]:
+    from tmlibrary_tpu import perf
+
+    out = ["## Performance attribution", "",
+           (inspect.getdoc(perf) or "").split("\n")[0],
+           "",
+           "Surfaced via `tmx perf --root DIR [--top N] [--json]`, "
+           "`tmx perf history`, `tmx_perf_*` metrics in `tmx metrics`, "
+           "and the CI/watcher sentinel `scripts/bench_regression.py` "
+           "(exit 0 ok / 1 regression / 2 stale / 3 no baseline).",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for name in sorted(n for n in dir(perf) if not n.startswith("_")):
+        obj = getattr(perf, name)
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "") != perf.__name__:
+            continue
+        doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append(f"| `perf.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def main() -> None:
     lines = [
         "# tmlibrary_tpu API reference",
@@ -124,6 +148,7 @@ def main() -> None:
         *tool_section(),
         *ops_section(),
         *telemetry_section(),
+        *perf_section(),
     ]
     # optional output override so a freshness check can generate into a
     # scratch path without clobbering the committed file

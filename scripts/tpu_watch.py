@@ -487,6 +487,40 @@ def run_tune(stages: "list | None" = None, timeout_s: int = 7200) -> bool:
     return r.returncode == 0 and not (targets & set(_direct_pending_tune()))
 
 
+def recapture_pending() -> list:
+    """Validated re-capture labels queued by the regression sentinel
+    (``scripts/bench_regression.py`` → ``tuning/RECAPTURE.json``): a
+    sentinel-flagged record jumps the staleness checks — the whole point
+    is re-measuring something ``bench_done`` still calls fresh.  Labels
+    that don't name a known bench/sweep item are ignored (a stale queue
+    file must not wedge the watcher)."""
+    try:
+        from tmlibrary_tpu import perf
+
+        known_bench = {k for k, _ in BENCH_ITEMS}
+        out = []
+        for label in perf.load_recapture():
+            if label.startswith("bench:") and label[6:] in known_bench:
+                out.append(label)
+            elif label.startswith("sweep:") and label[6:] in SWEEP_CONFIGS:
+                out.append(label)
+            elif (label.startswith("sweep-capacity:")
+                    and label[15:] in SWEEP_CAPACITY_CONFIGS):
+                out.append(label)
+        return out
+    except Exception:
+        return []
+
+
+def _clear_recapture(label: str) -> None:
+    try:
+        from tmlibrary_tpu import perf
+
+        perf.clear_recapture(label)
+    except Exception:
+        pass
+
+
 def all_pending() -> list:
     """Pending work labels in FIRE order (the value-first queue from the
     module docstring); WATCH_ONLY=<label,label> restricts it."""
@@ -494,6 +528,9 @@ def all_pending() -> list:
     labels = []
     if "pipeline" in tune_pending:
         labels.append("tune:pipeline")
+    # sentinel re-captures fire right after the depth tune: they are
+    # flagged regressions/stale evidence, the most valuable fresh numbers
+    labels += [l for l in recapture_pending() if l not in labels]
     for k in PRIORITY_BENCH:
         if not bench_done(k):
             labels.append(f"bench:{k}")
@@ -507,6 +544,7 @@ def all_pending() -> list:
     labels += [f"sweep-capacity:{k}" for k in SWEEP_CAPACITY_CONFIGS
                if not sweep_capacity_done(k)]
     labels += [f"tune:{s}" for s in tune_pending if s != "pipeline"]
+    labels = list(dict.fromkeys(labels))  # recapture may duplicate an item
     only = set(filter(None, os.environ.get("WATCH_ONLY", "").split(",")))
     if only:
         labels = [l for l in labels if l in only]
@@ -566,18 +604,21 @@ def fire_pending(pending: list) -> bool:
             captured |= ok
             if not ok:
                 break  # relay likely died; back to probing
+            _clear_recapture(label)
             last_alive = time.time()
         elif label.startswith("sweep:"):
             ok = run_sweep_item(label[6:])
             captured |= ok
             if not ok:
                 break
+            _clear_recapture(label)
             last_alive = time.time()
         elif label.startswith("sweep-capacity:"):
             ok = run_sweep_item(label[15:], capacities=True)
             captured |= ok
             if not ok:
                 break
+            _clear_recapture(label)
             last_alive = time.time()
         elif label.startswith("tune:"):
             stages = [l[5:] for l in pending if l.startswith("tune:")
@@ -603,6 +644,8 @@ def rehearse_setup(wdir: str) -> None:
         "BENCH_TPU_CACHE": os.path.join(wdir, "BENCH_TPU.json"),
         "TMX_PROFILE_JSON": os.path.join(wdir, "PROFILE.json"),
         "TMX_BASELINE_MD": os.path.join(wdir, "BASELINE.md"),
+        "BENCH_HISTORY": os.path.join(wdir, "BENCH_HISTORY.jsonl"),
+        "WATCH_RECAPTURE": os.path.join(wdir, "RECAPTURE.json"),
     }
     os.environ.update(extra)
     os.environ["WATCH_EXTRA_ENV"] = json.dumps(extra)

@@ -6,6 +6,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 import pytest
@@ -14,6 +15,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run_bench(env, timeout=420):
+    # history appends go to a throwaway file, never the repo's committed
+    # tuning/BENCH_HISTORY.jsonl (tests must not dirty the working tree)
+    env = {"BENCH_HISTORY": os.path.join(
+        tempfile.mkdtemp(prefix="bench-hist-"), "h.jsonl"), **env}
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         env={**os.environ, **env},

@@ -621,3 +621,126 @@ def test_cli_status_flags_stale_heartbeat(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "heartbeat:" in out
     assert "STALE" not in out
+
+
+# ---------------------------------------- bucketed ledgers (PR-5 era on)
+def _bucketed_events(with_ceiling):
+    """A capacity-bucketed run ledger: PR-5-era batch summaries carry
+    bucket_capacity/slot_occupancy/bucket_escalations; bucket_ceiling
+    joined later for the padding-waste derivation."""
+    def result(cap, occ, esc=0):
+        r = {"n_sites": 4, "bucket_capacity": cap, "slot_occupancy": occ,
+             "bucket_escalations": esc}
+        if with_ceiling:
+            r["bucket_ceiling"] = 32
+        return r
+
+    return [
+        {"event": "run_started", "t": 1.0},
+        {"event": "init_done", "step": "jterator", "n_batches": 3},
+        {"event": "batch_done", "step": "jterator", "batch": 0,
+         "elapsed": 1.0, "result": result(8, 0.5)},
+        {"event": "batch_done", "step": "jterator", "batch": 1,
+         "elapsed": 1.0, "result": result(8, 0.7, esc=2)},
+        {"event": "batch_done", "step": "jterator", "batch": 2,
+         "elapsed": 1.0, "result": result(32, 0.9)},
+        {"event": "step_done", "step": "jterator", "elapsed": 3.0,
+         "pipeline_stats": {
+             "depth": 2, "source": "tuned", "n_batches": 3,
+             "phases": {"dispatch": {"total_s": 1.0, "max_s": 0.5},
+                        "device_block": {"total_s": 0.5, "max_s": 0.3},
+                        "persist": {"total_s": 1.5, "max_s": 0.9}}}},
+    ]
+
+
+def test_registry_from_pr5_era_bucketed_ledger():
+    """Satellite: bucket routing/saturation/occupancy gauges must be
+    derivable from a ledger that predates the bucket_ceiling field."""
+    reg = telemetry.registry_from_ledger(_bucketed_events(False))
+    assert reg.counter("tmx_jterator_bucket_routed_total",
+                       capacity="8").value == 2.0
+    assert reg.counter("tmx_jterator_bucket_routed_total",
+                       capacity="32").value == 1.0
+    assert reg.counter("tmx_jterator_bucket_saturated_total").value == 2.0
+    assert reg.gauge("tmx_jterator_slot_occupancy").value == pytest.approx(
+        (0.5 + 0.7 + 0.9) / 3)
+    # no ceiling -> no padding-waste estimate (never a crash, never a lie)
+    names = {g["name"] for g in reg.snapshot()["gauges"]}
+    assert "tmx_jterator_padded_flops_avoided_frac" not in names
+    telemetry.parse_prometheus(telemetry.render_prometheus(reg.snapshot()))
+
+
+def test_registry_from_ledger_padding_waste_gauge():
+    reg = telemetry.registry_from_ledger(_bucketed_events(True))
+    # capacities 8+8+32 routed against a 32 ceiling each:
+    # 1 - 48/96 = 0.5 of the ceiling's padded FLOPs never executed
+    assert reg.gauge(
+        "tmx_jterator_padded_flops_avoided_frac"
+    ).value == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------- tmx perf
+def test_cli_perf_renders_roofline_table(tmp_path, capsys, monkeypatch):
+    """Acceptance: ``tmx perf`` renders the per-program roofline table
+    (FLOPs, bytes, intensity, bound-by) with one row per capacity bucket,
+    the phase device/host split, and the padding gauge."""
+    from tmlibrary_tpu import perf
+    from tmlibrary_tpu.cli import main
+
+    monkeypatch.setenv("BENCH_HISTORY", str(tmp_path / "h.jsonl"))
+    st = _minimal_run_store(tmp_path)
+    perf.reset_profiles()
+    for cap in (8, 32):
+        perf.record_compile(
+            program="jterator_batch@abc123", capacity=cap,
+            strategy="onehot", backend="cpu", compile_s=0.5,
+            cost=perf.ProgramCost(2e9, 4e7),
+        )
+    (st.workflow_dir / "perf.json").write_text(
+        json.dumps(perf.perf_snapshot()))
+    perf.reset_profiles()
+    with (st.workflow_dir / "ledger.jsonl").open("w") as fh:
+        for ev in _bucketed_events(True):
+            fh.write(json.dumps(ev) + "\n")
+
+    assert main(["perf", "--root", str(st.root)]) == 0
+    out = capsys.readouterr().out
+    assert "jterator_batch@abc123" in out
+    # one row per capacity bucket rung
+    assert len([l for l in out.splitlines()
+                if "jterator_batch@abc123" in l]) == 2
+    assert "bound-by" in out and "memory" in out  # 50 flops/B < ridge
+    assert "device=" in out and "host=" in out
+    assert "padded-FLOPs-avoided: 50.0%" in out
+
+    assert main(["perf", "--root", str(st.root), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["programs"]) == 2
+    row = doc["programs"][0]
+    assert row["flops"] == 2e9 and row["bytes"] == 4e7
+    assert row["arithmetic_intensity"] == pytest.approx(50.0)
+    assert row["bound_by"] == "memory"
+    assert doc["padded_flops_avoided_frac"] == pytest.approx(0.5)
+    assert doc["latest_bench"] is None  # empty history redirect
+
+
+def test_cli_perf_requires_root_or_history_verb(tmp_path, capsys,
+                                                monkeypatch):
+    from tmlibrary_tpu.cli import main
+
+    assert main(["perf"]) == 2
+
+    hist = tmp_path / "h.jsonl"
+    monkeypatch.setenv("BENCH_HISTORY", str(hist))
+    assert main(["perf", "history"]) == 1  # empty history is an error
+    capsys.readouterr()
+    from tmlibrary_tpu import tuning
+    tuning.append_bench_history(
+        {"metric": "m", "config": "3", "backend": "tpu", "value": 100.0})
+    tuning.append_bench_history(
+        {"metric": "m", "config": "3", "backend": "tpu", "value": 80.0})
+    assert main(["perf", "history", "--tail", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "2 records" in out
+    assert "verdict: regression" in out
+    assert "recapture -> bench:3" in out

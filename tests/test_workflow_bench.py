@@ -14,9 +14,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 STEPS = ("metaconfig", "imextract", "corilla", "illuminati", "jterator")
 
 
-def test_workflow_bench_end_to_end():
+def test_workflow_bench_end_to_end(tmp_path):
+    history = tmp_path / "BENCH_HISTORY.jsonl"
     env = {
         **os.environ,
+        "BENCH_HISTORY": str(history),
         "BENCH_FORCE_CPU": "1",
         "BENCH_CONFIG": "workflow",
         "BENCH_WELLS": "1",
@@ -50,3 +52,11 @@ def test_workflow_bench_end_to_end():
     assert rec["pipelined"] is False
     assert rec["timing_methodology"] == "host-synchronous"
     assert rec["max_objects"] == 32
+    # every bench run appends its emitted record to the history the
+    # regression sentinel reads (exactly once: the parent process owns
+    # the append, the captured child does not)
+    lines = [json.loads(l) for l in history.read_text().splitlines() if l]
+    assert len(lines) == 1
+    assert lines[0]["metric"] == rec["metric"]
+    assert lines[0]["value"] == rec["value"]
+    assert lines[0]["recorded_at_unix"] > 0

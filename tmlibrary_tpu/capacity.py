@@ -124,3 +124,12 @@ def slot_occupancy(total_objects: float, n_slots: float) -> float:
     no slots) — the padding-waste signal carried by bench records and
     the ``tmx_jterator_slot_occupancy`` gauge."""
     return float(total_objects) / n_slots if n_slots else 0.0
+
+
+def ceiling_slots(slots: int, cap: int, ceiling: int) -> int:
+    """Slot count the same batches would have carried at the unbucketed
+    ``ceiling`` capacity.  ``1 - slots / ceiling_slots`` is the
+    padded-FLOPs-avoided fraction (per-object measure FLOPs scale with
+    the capacity), shared by the live ``tmx_jterator_padded_flops_avoided_frac``
+    gauge and ``telemetry.registry_from_ledger``'s post-hoc derivation."""
+    return (int(slots) // int(cap)) * int(ceiling) if cap else 0

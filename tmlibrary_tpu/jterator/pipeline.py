@@ -26,6 +26,7 @@ that fits.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Any, Callable
 
 import jax
@@ -57,6 +58,11 @@ from tmlibrary_tpu.parallel.compat import shard_map
 #: once, so the bound leaves room for two experiments' ladders.
 _BATCH_FN_CACHE: dict[tuple, Callable] = {}
 _BATCH_FN_CACHE_MAX = 32
+#: same key -> perf-attribution wrapper around the cached raw fn, so
+#: repeated ``cached_batch_fn`` calls return the identical object (the
+#: cache-identity contract test_batch_fn_cache pins) while the raw cache
+#: above stays wrapper-free for telemetry-disabled callers
+_WRAPPED_FN_CACHE: dict[tuple, Callable] = {}
 
 
 def _description_cache_key(description: PipelineDescription) -> str:
@@ -126,7 +132,35 @@ def cached_batch_fn(
         while len(_BATCH_FN_CACHE) >= _BATCH_FN_CACHE_MAX:
             _BATCH_FN_CACHE.pop(next(iter(_BATCH_FN_CACHE)))
         _BATCH_FN_CACHE[key] = fn
-    return fn
+    from tmlibrary_tpu import telemetry
+
+    if not telemetry.enabled():
+        return fn  # zero-cost contract: disabled telemetry gets the raw fn
+    # Attach the perf-attribution wrapper OUTSIDE the cache: the cache
+    # holds the raw jitted program (so an enabled->disabled flip never
+    # pays wrapper overhead), while every enabled caller shares compile /
+    # cost state keyed by (program, capacity, strategy) in perf's global
+    # store.  The wrapper AOT-compiles on first call per signature — one
+    # compile, same executable jit would build — so attribution adds no
+    # extra compiles and cannot perturb results.
+    from tmlibrary_tpu import perf
+
+    wrapped = _WRAPPED_FN_CACHE.get(key)
+    if wrapped is None or wrapped.__wrapped__ is not fn:
+        digest = hashlib.sha1(
+            repr(key[0]).encode() + repr(window).encode()
+        ).hexdigest()[:8]
+        wrapped = perf.instrument_batch_fn(
+            fn,
+            program=f"jterator_batch@{digest}",
+            step="jterator",
+            capacity=max_objects,
+            strategy=requested or "default",
+        )
+        while len(_WRAPPED_FN_CACHE) >= _BATCH_FN_CACHE_MAX:
+            _WRAPPED_FN_CACHE.pop(next(iter(_WRAPPED_FN_CACHE)))
+        _WRAPPED_FN_CACHE[key] = wrapped
+    return wrapped
 
 
 @dataclasses.dataclass

@@ -1,0 +1,629 @@
+"""Performance attribution: roofline cost model, compile telemetry, history.
+
+The repo's headline metric is jterator sites/sec/chip, but throughput alone
+cannot say *where* the gap to the hardware ceiling lives (ROADMAP item 3:
+MFU 0.000246 with no per-program attribution).  This module is the one
+place the XLA cost model is read and interpreted:
+
+* :func:`program_cost` / :func:`cost_from_compiled` — FLOPs + bytes
+  accessed from ``lowered.compile().cost_analysis()``, hardened so a
+  backend/JAX version that raises or reports nothing yields ``None``
+  fields instead of crashing a bench or a run;
+* the **roofline** verdict — arithmetic intensity (FLOPs/byte) against
+  the v5e ridge point (:data:`V5E_BF16_PEAK_FLOPS` /
+  :data:`V5E_HBM_PEAK_BPS` ≈ 240 FLOPs/byte): programs below the ridge
+  are memory-bound, above it compute-bound.  The v5e roofline is the
+  *reference target* even when the measurement ran on CPU — the question
+  "where would this program sit on the chip" is exactly what a
+  CPU-rehearsed profile is for;
+* :func:`instrument_batch_fn` — wraps a ``cached_batch_fn`` program so
+  its first call per input signature is an AOT ``lower().compile()``
+  (timed → compile histogram; cost analysis read off the same compiled
+  object, so attribution adds **zero extra compiles**) and subsequent
+  calls execute that compiled object directly.  New signatures count as
+  recompiles.  Any failure in the AOT path falls back to the plain jit
+  call — instrumentation may never break a run;
+* a process-wide profile store (:func:`perf_profiles` /
+  :func:`perf_snapshot`) keyed by (program, step, capacity, strategy),
+  mirrored into ``tmx_perf_*`` registry metrics and persisted by the
+  engine as ``workflow/perf.json`` for ``tmx perf``;
+* the **bench-history sentinel** (:func:`compare_history`) behind
+  ``scripts/bench_regression.py`` and ``tmx perf history``: latest
+  record vs the best certified one per (metric, config, backend class),
+  with distinct exit codes for regression / staleness / missing
+  baseline, and re-capture queue labels for ``scripts/tpu_watch.py``;
+* :func:`bench_record_staleness` — `cache_age_hours` of the cached
+  on-hardware records surfaced live as ``tmx_bench_record_age_hours`` /
+  ``tmx_bench_record_stale`` gauges in ``tmx metrics`` and a one-line
+  warning in ``tmx workflow status``.
+
+Everything here is observability: zero-cost when telemetry is disabled
+(wrappers return the raw fn) and forbidden from perturbing numeric
+results — the AOT-executed program is the same executable jit would have
+built, pinned by the telemetry-on/off parity test.
+
+jax is imported lazily so ``bench.py``'s parent process (which must not
+initialise a backend before choosing one) can import this module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Callable
+
+from tmlibrary_tpu import tuning
+
+# ---------------------------------------------------------------------------
+# Roofline peaks (moved from bench.py; bench re-exports for compat)
+
+#: MXU peak of one TPU v5e (v5 lite) chip in bf16; the pipeline runs mostly
+#: f32 (correctness gate: HIGHEST-precision convs), so MFU against the bf16
+#: peak is a conservative lower bound.
+V5E_BF16_PEAK_FLOPS = 197e12
+#: HBM bandwidth of one v5e chip (public spec: 819 GB/s)
+V5E_HBM_PEAK_BPS = 819e9
+
+#: Per-backend (peak FLOPs/s, peak bytes/s).  "axon" is the TPU relay
+#: backend name the bench records carry.  CPU has no published peak here —
+#: MFU fields stay None off-device, matching :func:`flops_fields`.
+BACKEND_PEAKS: dict[str, tuple[float, float]] = {
+    "tpu": (V5E_BF16_PEAK_FLOPS, V5E_HBM_PEAK_BPS),
+    "axon": (V5E_BF16_PEAK_FLOPS, V5E_HBM_PEAK_BPS),
+}
+
+
+def backend_peaks(backend: str | None) -> tuple[float | None, float | None]:
+    """(peak FLOPs/s, peak bytes/s) for ``backend``, (None, None) when the
+    backend has no modeled roofline (cpu, unknown)."""
+    return BACKEND_PEAKS.get(str(backend).lower(), (None, None))
+
+
+def ridge_point(peak_flops: float = V5E_BF16_PEAK_FLOPS,
+                peak_bps: float = V5E_HBM_PEAK_BPS) -> float:
+    """Arithmetic intensity (FLOPs/byte) where the roofline transitions
+    from memory- to compute-bound."""
+    return peak_flops / peak_bps
+
+
+# ---------------------------------------------------------------------------
+# Cost model
+
+@dataclasses.dataclass
+class ProgramCost:
+    """XLA cost-model readout for one compiled program.  Fields are None
+    when the backend does not report them — never a crash (satellite:
+    hardened ``cost_analysis()`` failure path)."""
+
+    flops: float | None = None
+    bytes: float | None = None
+
+    @property
+    def arithmetic_intensity(self) -> float | None:
+        if self.flops and self.bytes:
+            return self.flops / self.bytes
+        return None
+
+    def bound_by(self, peak_flops: float = V5E_BF16_PEAK_FLOPS,
+                 peak_bps: float = V5E_HBM_PEAK_BPS) -> str | None:
+        """"memory" below the roofline ridge, "compute" above, None when
+        the cost model reported nothing."""
+        ai = self.arithmetic_intensity
+        if ai is None:
+            return None
+        return "memory" if ai < peak_flops / peak_bps else "compute"
+
+
+def cost_from_compiled(compiled: Any) -> ProgramCost:
+    """Read FLOPs + bytes accessed off an already-compiled XLA program.
+
+    Backends/JAX versions where ``cost_analysis()`` raises, returns an
+    empty list, or reports zeros all degrade to None fields."""
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, (list, tuple)):  # older jax returns [dict]
+            analysis = analysis[0] if analysis else {}
+        if not isinstance(analysis, dict):
+            return ProgramCost()
+        flops = float(analysis.get("flops", 0.0))
+        nbytes = float(analysis.get("bytes accessed", 0.0))
+        return ProgramCost(flops if flops > 0 else None,
+                           nbytes if nbytes > 0 else None)
+    except Exception:
+        return ProgramCost()
+
+
+def program_cost(jitted_fn: Callable, *args, **kwargs) -> ProgramCost:
+    """Compile ``jitted_fn`` for ``args`` and read its cost.  Never raises
+    — a backend that cannot lower/compile/analyze yields empty cost."""
+    try:
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+    except Exception:
+        return ProgramCost()
+    return cost_from_compiled(compiled)
+
+
+def cost_flops(jitted_fn: Callable, *args) -> tuple[float | None, float | None]:
+    """(total FLOPs, total bytes accessed) of one compiled batch step via
+    XLA's cost model — (None, None) if the backend does not report it.
+    Tuple form kept for bench.py's call sites."""
+    cost = program_cost(jitted_fn, *args)
+    return (cost.flops, cost.bytes)
+
+
+def flops_fields(flops, n_items, best_s, backend, item_key="flops_per_site",
+                 nbytes=None) -> dict:
+    """Roofline record fields from a measured best wall time (moved from
+    bench.py; the bytes side travels with every record because MFU alone
+    is the wrong lens for this memory/latency-shaped workload)."""
+    out = {}
+    on_device = backend != "cpu"
+    if flops:
+        achieved = flops / best_s
+        out[item_key] = round(flops / n_items)
+        out["achieved_tflops_per_sec"] = round(achieved / 1e12, 4)
+        out["mfu_vs_v5e_bf16_peak"] = (
+            round(achieved / V5E_BF16_PEAK_FLOPS, 6) if on_device else None
+        )
+    if nbytes:
+        bps = nbytes / best_s
+        out["bytes_per_" + item_key.split("_per_")[-1]] = round(
+            nbytes / n_items
+        )
+        out["achieved_gbytes_per_sec"] = round(bps / 1e9, 3)
+        out["hbm_frac_vs_v5e_peak"] = (
+            round(bps / V5E_HBM_PEAK_BPS, 6) if on_device else None
+        )
+    if flops and nbytes:
+        out["arithmetic_intensity"] = round(flops / nbytes, 3)
+        out["bound_by"] = ProgramCost(flops, nbytes).bound_by()
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-program attribution store + compile telemetry
+
+_LOCK = threading.Lock()
+#: (program, step, capacity, strategy) -> serializable profile dict
+_PROFILES: dict[tuple, dict] = {}
+#: same key -> runtime state {"sigs": {signature: compiled|None}, "dead": bool}
+_RUNTIME: dict[tuple, dict] = {}
+#: beyond this many distinct input signatures per program the AOT path
+#: stops caching executables (a shape zoo would churn memory for no
+#: attribution value); calls fall through to the plain jit fn
+_MAX_SIGNATURES = 8
+
+
+def reset_profiles() -> None:
+    """Drop all recorded program profiles (tests, fresh runs)."""
+    with _LOCK:
+        _PROFILES.clear()
+        _RUNTIME.clear()
+
+
+def perf_profiles() -> list[dict]:
+    """Recorded program profiles, costliest (by FLOPs) first."""
+    with _LOCK:
+        entries = [dict(e) for e in _PROFILES.values()]
+    entries.sort(key=lambda e: (e.get("flops") or 0.0), reverse=True)
+    return entries
+
+
+def perf_snapshot() -> dict:
+    """Serializable snapshot for ``workflow/perf.json`` / ``tmx perf``."""
+    return {
+        "generated_at_unix": time.time(),
+        "programs": perf_profiles(),
+    }
+
+
+def record_compile(*, program: str, step: str = "jterator",
+                   capacity: int | None = None, strategy: str | None = None,
+                   backend: str = "unknown", compile_s: float | None = None,
+                   cost: ProgramCost | None = None,
+                   recompile: bool = False) -> dict:
+    """Record one compile event for a program variant: update the profile
+    store and mirror ``tmx_perf_*`` metrics (compile counter + compile-time
+    histogram per capacity rung, recompile counter, static cost gauges).
+    Telemetry failures never propagate."""
+    cost = cost or ProgramCost()
+    key = (program, step, capacity, strategy)
+    with _LOCK:
+        entry = _PROFILES.setdefault(key, {
+            "program": program,
+            "step": step,
+            "capacity": capacity,
+            "strategy": strategy,
+            "backend": backend,
+            "flops": None,
+            "bytes": None,
+            "arithmetic_intensity": None,
+            "bound_by": None,
+            "compiles": 0,
+            "recompiles": 0,
+            "compile_seconds_total": 0.0,
+            "last_compile_s": None,
+        })
+        entry["backend"] = backend
+        entry["compiles"] += 1
+        if recompile:
+            entry["recompiles"] += 1
+        if compile_s is not None:
+            entry["compile_seconds_total"] += compile_s
+            entry["last_compile_s"] = round(compile_s, 4)
+        if cost.flops is not None:
+            entry["flops"] = cost.flops
+        if cost.bytes is not None:
+            entry["bytes"] = cost.bytes
+        ai = cost.arithmetic_intensity
+        if ai is not None:
+            entry["arithmetic_intensity"] = round(ai, 3)
+            entry["bound_by"] = cost.bound_by()
+        result = dict(entry)
+    try:
+        from tmlibrary_tpu import telemetry
+
+        if telemetry.enabled():
+            reg = telemetry.get_registry()
+            labels = {
+                "program": str(program),
+                "step": str(step),
+                "capacity": str(capacity) if capacity else "none",
+                "strategy": str(strategy) if strategy else "auto",
+            }
+            reg.counter("tmx_perf_compiles_total", **labels).inc()
+            if recompile:
+                reg.counter("tmx_perf_recompiles_total", **labels).inc()
+            if compile_s is not None:
+                reg.histogram(
+                    "tmx_perf_compile_seconds", capacity=labels["capacity"],
+                ).observe(compile_s)
+            if cost.flops:
+                reg.gauge("tmx_perf_program_flops", **labels).set(cost.flops)
+            if cost.bytes:
+                reg.gauge("tmx_perf_program_bytes", **labels).set(cost.bytes)
+            if ai:
+                reg.gauge(
+                    "tmx_perf_program_arithmetic_intensity", **labels
+                ).set(ai)
+    except Exception:
+        pass  # observability must never break the run
+    return result
+
+
+def _args_signature(args, kwargs):
+    """Hashable (treedef, leaf shapes/dtypes) signature of a call — the
+    same thing jit keys its executable cache on, minus static/weak-type
+    subtleties.  A signature change means XLA recompiled."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (
+        treedef,
+        tuple(
+            (getattr(leaf, "shape", None),
+             str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for leaf in leaves
+        ),
+    )
+
+
+def instrument_batch_fn(fn: Callable, *, program: str,
+                        step: str = "jterator",
+                        capacity: int | None = None,
+                        strategy: str | None = None) -> Callable:
+    """Wrap a jitted batch fn with compile/cost attribution.
+
+    First call per input signature: ``fn.lower(...).compile()`` timed
+    (the compile histogram), cost analysis read from the same compiled
+    object, and the compiled executable cached and invoked — so the
+    instrumented path performs exactly ONE compile, same as plain jit.
+    Later signatures count as recompiles.  Any AOT failure (backend
+    without lower(), layout mismatch, donation quirk) permanently falls
+    back to ``fn`` for that signature.  With telemetry disabled the call
+    is a passthrough."""
+    key = (program, step, capacity, strategy)
+
+    def wrapped(*args, **kwargs):
+        from tmlibrary_tpu import telemetry
+
+        if not telemetry.enabled():
+            return fn(*args, **kwargs)
+        return _instrumented_call(fn, key, args, kwargs)
+
+    wrapped.__wrapped__ = fn
+    wrapped.perf_key = key
+    return wrapped
+
+
+def _instrumented_call(fn, key, args, kwargs):
+    program, step, capacity, strategy = key
+    try:
+        sig = _args_signature(args, kwargs)
+    except Exception:
+        return fn(*args, **kwargs)
+    with _LOCK:
+        state = _RUNTIME.setdefault(key, {"sigs": {}, "dead": False})
+        known = sig in state["sigs"]
+        compiled = state["sigs"].get(sig)
+        dead = state["dead"]
+    if dead and not known:
+        return fn(*args, **kwargs)
+    if not known:
+        compile_s = None
+        t0 = time.perf_counter()
+        try:
+            compiled = fn.lower(*args, **kwargs).compile()
+            compile_s = time.perf_counter() - t0
+        except Exception:
+            compiled = None
+        cost = cost_from_compiled(compiled) if compiled is not None \
+            else ProgramCost()
+        with _LOCK:
+            recompile = bool(state["sigs"])
+            if len(state["sigs"]) >= _MAX_SIGNATURES:
+                state["dead"] = True
+            else:
+                state["sigs"][sig] = compiled
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = "unknown"
+        record_compile(program=program, step=step, capacity=capacity,
+                       strategy=strategy, backend=backend,
+                       compile_s=compile_s, cost=cost, recompile=recompile)
+    if compiled is not None:
+        try:
+            return compiled(*args, **kwargs)
+        except Exception:
+            # layout/donation edge: drop the executable, trust jit forever
+            with _LOCK:
+                state["sigs"][sig] = None
+    return fn(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Bench-record staleness (live gauges for tmx metrics / workflow status)
+
+#: hours after which a cached on-hardware bench record stops being
+#: trustworthy evidence (same default bench.py's emit_cached_tpu uses)
+STALE_HOURS_DEFAULT = 72.0
+
+
+def stale_hours() -> float:
+    try:
+        return float(os.environ.get("BENCH_STALE_HOURS", STALE_HOURS_DEFAULT))
+    except ValueError:
+        return STALE_HOURS_DEFAULT
+
+
+def bench_record_staleness(now: float | None = None) -> list[dict]:
+    """Age of every cached on-hardware bench record (``tuning/
+    BENCH_TPU.json``): ``[{config, metric, age_hours, stale, measured_at},
+    ...]``.  Empty when no cache exists; never raises."""
+    try:
+        with open(tuning.bench_cache_path()) as f:
+            cache = json.load(f)
+        records = cache.get("records", {})
+        if not isinstance(records, dict):
+            return []
+    except (OSError, ValueError):
+        return []
+    now = time.time() if now is None else now
+    threshold = stale_hours()
+    out = []
+    for config, entry in sorted(records.items()):
+        if not isinstance(entry, dict):
+            continue
+        measured = entry.get("measured_at_unix")
+        if not isinstance(measured, (int, float)):
+            continue
+        age_h = max(0.0, (now - float(measured)) / 3600.0)
+        out.append({
+            "config": str(config),
+            "metric": str(entry.get("record", {}).get("metric", "")),
+            "age_hours": round(age_h, 1),
+            "stale": age_h > threshold,
+            "measured_at": entry.get("measured_at"),
+        })
+    return out
+
+
+def set_bench_staleness_gauges(registry=None, now: float | None = None) -> list[dict]:
+    """Mirror :func:`bench_record_staleness` into ``tmx_bench_record_age_hours``
+    and ``tmx_bench_record_stale`` gauges.  Returns the staleness rows."""
+    rows = bench_record_staleness(now=now)
+    try:
+        from tmlibrary_tpu import telemetry
+
+        reg = registry if registry is not None else telemetry.get_registry()
+        for row in rows:
+            reg.gauge(
+                "tmx_bench_record_age_hours", config=row["config"],
+            ).set(row["age_hours"])
+            reg.gauge(
+                "tmx_bench_record_stale", config=row["config"],
+            ).set(1.0 if row["stale"] else 0.0)
+    except Exception:
+        pass
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Bench history sentinel
+
+EXIT_OK = 0           # latest matches or improves on the baseline
+EXIT_REGRESSION = 1   # latest below baseline by more than the threshold
+EXIT_STALE = 2        # latest is fine but older than the staleness budget
+EXIT_NO_BASELINE = 3  # nothing comparable to judge against
+
+#: sentinel statuses that exit 0
+_OK_STATUSES = ("ok", "improvement")
+
+
+def _backend_class(backend) -> str:
+    """Collapse backend spellings into comparable classes: cpu_forced /
+    cpu_fallback are still CPU numbers; tpu_cached is hardware evidence."""
+    b = str(backend or "unknown").lower()
+    if b.startswith("cpu"):
+        return "cpu"
+    if b == "tpu_cached":
+        return "tpu"
+    return b
+
+
+def _record_time(rec: dict) -> float | None:
+    for field in ("recorded_at_unix", "measured_at_unix"):
+        value = rec.get(field)
+        if isinstance(value, (int, float)):
+            return float(value)
+    return None
+
+
+def _comparable(rec: dict) -> bool:
+    if not isinstance(rec, dict) or rec.get("error"):
+        return False
+    value = rec.get("value")
+    return isinstance(value, (int, float)) and value > 0
+
+
+def _history_key(rec: dict) -> tuple:
+    return (
+        str(rec.get("metric", "")),
+        str(rec.get("config", "")),
+        _backend_class(rec.get("backend")),
+    )
+
+
+def compare_history(history: list[dict], *, baseline: list[dict] | None = None,
+                    config: str | None = None, metric: str | None = None,
+                    threshold: float = 0.05,
+                    stale_hours: float = STALE_HOURS_DEFAULT,
+                    now: float | None = None) -> dict:
+    """Judge the latest bench record against the best comparable one.
+
+    ``history`` is the parsed ``tuning/BENCH_HISTORY.jsonl``; ``baseline``
+    optionally supplies the comparison pool from a separate file (CI's
+    committed baseline) instead of earlier history entries.  Records are
+    comparable when they share (metric, config, backend class) and carry a
+    positive error-free value.  Returns a verdict dict with ``status``
+    (improvement/ok/regression/stale/no_baseline), the matching ``exit_code``
+    (regression outranks stale: it is the more actionable signal), the
+    latest/baseline records, ``delta_frac``, ``age_hours``, and
+    ``recapture`` watcher queue labels when action is needed."""
+    now = time.time() if now is None else now
+
+    def matches(rec):
+        if not _comparable(rec):
+            return False
+        if config is not None and str(rec.get("config", "")) != str(config):
+            return False
+        if metric is not None and rec.get("metric") != metric:
+            return False
+        return True
+
+    pool = [r for r in history if matches(r)]
+    if not pool:
+        return {"status": "no_baseline", "exit_code": EXIT_NO_BASELINE,
+                "reason": "no comparable records in history",
+                "latest": None, "baseline": None,
+                "delta_frac": None, "age_hours": None, "recapture": []}
+    latest = pool[-1]
+    key = _history_key(latest)
+    if baseline is not None:
+        candidates = [r for r in baseline
+                      if _comparable(r) and _history_key(r) == key]
+    else:
+        candidates = [r for r in pool[:-1] if _history_key(r) == key]
+
+    age_hours = None
+    ts = _record_time(latest)
+    if ts is not None:
+        age_hours = round(max(0.0, (now - ts) / 3600.0), 1)
+    is_stale = age_hours is not None and age_hours > stale_hours
+
+    label = f"sweep:{latest.get('config')}" if latest.get("sweep") \
+        else f"bench:{latest.get('config')}"
+
+    if not candidates:
+        return {"status": "no_baseline", "exit_code": EXIT_NO_BASELINE,
+                "reason": f"no baseline for {key}",
+                "latest": latest, "baseline": None, "delta_frac": None,
+                "age_hours": age_hours,
+                "recapture": [label] if is_stale else []}
+
+    best = max(candidates, key=lambda r: r["value"])
+    delta = (latest["value"] - best["value"]) / best["value"]
+    if delta < -threshold:
+        status, code = "regression", EXIT_REGRESSION
+    elif is_stale:
+        status, code = "stale", EXIT_STALE
+    elif delta > threshold:
+        status, code = "improvement", EXIT_OK
+    else:
+        status, code = "ok", EXIT_OK
+    return {"status": status, "exit_code": code,
+            "latest": latest, "baseline": best,
+            "delta_frac": round(delta, 4), "age_hours": age_hours,
+            "recapture": [label] if code in (EXIT_REGRESSION, EXIT_STALE)
+            else []}
+
+
+# ---------------------------------------------------------------------------
+# Re-capture queue handoff (sentinel -> tpu_watch)
+
+def load_recapture(path: str | None = None) -> list[str]:
+    """Pending re-capture labels written by the regression sentinel.
+    Unknown shapes and unreadable files degrade to an empty list."""
+    path = path or tuning.recapture_path()
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return []
+    items = doc.get("items") if isinstance(doc, dict) else doc
+    if not isinstance(items, list):
+        return []
+    return [str(i) for i in items if isinstance(i, str) and i]
+
+
+def write_recapture(labels: list[str], path: str | None = None,
+                    reason: str = "") -> str:
+    """Merge ``labels`` into the re-capture queue file (deduplicated,
+    order-preserving).  Returns the path written."""
+    path = path or tuning.recapture_path()
+    existing = load_recapture(path)
+    merged = existing + [l for l in labels if l not in existing]
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"items": merged, "reason": reason,
+                   "written_at_unix": time.time()}, f, indent=2)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def clear_recapture(label: str, path: str | None = None) -> None:
+    """Drop one satisfied label from the re-capture queue (the watcher
+    calls this after a successful capture); removes the file when the
+    queue empties."""
+    path = path or tuning.recapture_path()
+    remaining = [l for l in load_recapture(path) if l != label]
+    try:
+        if remaining:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump({"items": remaining,
+                           "written_at_unix": time.time()}, f, indent=2)
+                f.write("\n")
+            os.replace(tmp, path)
+        elif os.path.exists(path):
+            os.remove(path)
+    except OSError:
+        pass

@@ -19,6 +19,18 @@ from tmlibrary_tpu import telemetry
 #: pipeline phases in execution order; keys of ``PipelineStats.summary()``
 PIPELINE_PHASES = ("prefetch_wait", "dispatch", "device_block", "persist")
 
+#: which resource each phase spends — the basis of the device/host time
+#: split in ``tmx perf`` and the ``tmx_perf_{device,host}_seconds_total``
+#: gauges.  ``dispatch`` is async launch work attributable to keeping the
+#: device fed; ``device_block`` is literal device wait; prefetch/persist
+#: are pure host IO.
+PHASE_RESOURCE = {
+    "prefetch_wait": "host",
+    "dispatch": "device",
+    "device_block": "device",
+    "persist": "host",
+}
+
 
 class PipelineStats:
     """Per-batch phase timers for the pipelined batch executor.
@@ -118,6 +130,32 @@ class PipelineStats:
             "n_batches": batches,
             "phases": phases,
         }
+        device_s = sum(
+            p["total_s"] for ph, p in phases.items()
+            if PHASE_RESOURCE.get(ph) == "device"
+        )
+        host_s = sum(
+            p["total_s"] for ph, p in phases.items()
+            if PHASE_RESOURCE.get(ph) == "host"
+        )
+        if phases:
+            # additive (ledger shape stays backward-compatible): the
+            # device/host attribution consumed by `tmx perf`
+            out["device_s"] = round(device_s, 4)
+            out["host_s"] = round(host_s, 4)
+            if telemetry.enabled():
+                reg = telemetry.get_registry()
+                label = self.step or "unknown"
+                reg.gauge(
+                    "tmx_perf_device_seconds_total", step=label
+                ).set(round(device_s, 4))
+                reg.gauge(
+                    "tmx_perf_host_seconds_total", step=label
+                ).set(round(host_s, 4))
+                if device_s + host_s > 0:
+                    reg.gauge("tmx_perf_device_frac", step=label).set(
+                        round(device_s / (device_s + host_s), 4)
+                    )
         if clamps:
             out["depth_clamps"] = clamps
         return out

@@ -704,6 +704,11 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
     reg = MetricsRegistry(enabled=True)
     step_units: dict[str, dict[str, float]] = {}
     occ_acc = [0.0, 0.0]  # running (sum, n) of per-batch slot occupancy
+    # running (routed capacity, ladder ceiling) sums: per batch the slot
+    # ratio cap/ceiling is the padded-work fraction kept, so the sums
+    # reconstruct padded-FLOPs-avoided from the ledger alone (batches
+    # predating the bucket_ceiling field simply don't contribute)
+    pad_acc = [0.0, 0.0]
     for ev in events:
         kind = ev.get("event")
         step = str(ev.get("step", "")) or "unknown"
@@ -754,6 +759,13 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                         reg.gauge("tmx_jterator_slot_occupancy").set(
                             occ_acc[0] / occ_acc[1]
                         )
+                    ceiling = result.get("bucket_ceiling")
+                    if ceiling:
+                        pad_acc[0] += float(cap)
+                        pad_acc[1] += float(ceiling)
+                        reg.gauge(
+                            "tmx_jterator_padded_flops_avoided_frac"
+                        ).set(1.0 - pad_acc[0] / pad_acc[1])
         elif kind == "batch_failed":
             reg.counter("tmx_batches_failed_total", step=step).inc()
         elif kind in ("step_done", "step_partial"):

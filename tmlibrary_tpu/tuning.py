@@ -16,8 +16,10 @@ static one.  ``TMX_TUNING_JSON`` redirects the file (watcher rehearsal).
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
+import time
 from pathlib import Path
 
 
@@ -29,6 +31,83 @@ def tuning_json_path() -> str:
         "TMX_TUNING_JSON",
         str(Path(__file__).resolve().parent.parent / "tuning" / "TUNING.json"),
     )
+
+
+def _tuning_dir() -> str:
+    return os.path.dirname(os.path.abspath(tuning_json_path()))
+
+
+def bench_cache_path() -> str:
+    """The watcher-written cache of freshest on-hardware bench records
+    (``tuning/BENCH_TPU.json``); ``BENCH_TPU_CACHE`` redirects it — same
+    contract bench.py's CACHE_PATH has always had, now importable by the
+    perf layer without importing bench."""
+    return os.environ.get(
+        "BENCH_TPU_CACHE", os.path.join(_tuning_dir(), "BENCH_TPU.json")
+    )
+
+
+def bench_history_path() -> str:
+    """Append-only bench history (``tuning/BENCH_HISTORY.jsonl``) — one
+    JSON line per emitted bench/sweep record, the regression sentinel's
+    input.  ``BENCH_HISTORY`` redirects it (tests, CI smoke); with no
+    redirect it follows ``TMX_TUNING_JSON``'s directory so watcher
+    rehearsal redirects the whole artifact family at once."""
+    return os.environ.get(
+        "BENCH_HISTORY", os.path.join(_tuning_dir(), "BENCH_HISTORY.jsonl")
+    )
+
+
+def recapture_path() -> str:
+    """Re-capture queue the regression sentinel writes and
+    ``scripts/tpu_watch.py`` drains (``tuning/RECAPTURE.json``)."""
+    return os.environ.get(
+        "WATCH_RECAPTURE", os.path.join(_tuning_dir(), "RECAPTURE.json")
+    )
+
+
+def append_bench_history(record: dict, path: str | None = None) -> str | None:
+    """Append one bench record to the history, stamped with the append
+    time.  Returns the path written, or None on any failure — history is
+    observability and must never break the bench stdout contract."""
+    try:
+        path = path or bench_history_path()
+        now = time.time()
+        line = {
+            "recorded_at": datetime.datetime.now(
+                datetime.timezone.utc
+            ).isoformat(timespec="seconds"),
+            "recorded_at_unix": now,
+            **record,
+        }
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(line, sort_keys=True) + "\n")
+        return path
+    except Exception:
+        return None
+
+
+def load_bench_history(path: str | None = None) -> list[dict]:
+    """Parsed history lines, oldest first; corrupt lines are skipped (an
+    interrupted append must not poison the whole history)."""
+    path = path or bench_history_path()
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
 
 
 def load_tuning() -> dict | None:

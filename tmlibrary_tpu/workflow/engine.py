@@ -462,6 +462,17 @@ class Workflow:
             )
         except OSError:
             logger.debug("metrics snapshot write failed", exc_info=True)
+        try:
+            # per-program roofline/compile attribution for `tmx perf`
+            from tmlibrary_tpu import perf
+
+            snap = perf.perf_snapshot()
+            if snap["programs"]:
+                (self.store.workflow_dir / "perf.json").write_text(
+                    json.dumps(snap, indent=2) + "\n"
+                )
+        except OSError:
+            logger.debug("perf snapshot write failed", exc_info=True)
 
     def _start_sampler(self):
         """Start the resource sampler thread for this run when telemetry

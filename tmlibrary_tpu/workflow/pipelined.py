@@ -54,6 +54,8 @@ import logging
 import time
 from typing import Any, Callable, Iterable, Iterator
 
+from tmlibrary_tpu import profiling
+
 logger = logging.getLogger(__name__)
 
 #: messages that signal HBM/host-memory pressure from too-deep pipelining
@@ -238,6 +240,7 @@ class PipelinedExecutor:
             self.on_event(
                 event="span", span=phase, batch=idx,
                 t0=round(t0, 6), elapsed=round(seconds, 6),
+                resource=profiling.PHASE_RESOURCE.get(phase, "host"),
             )
 
     # --------------------------------------------------------------- window

@@ -406,12 +406,14 @@ class ImageAnalysisRunner(Step):
         ).inc()
         if escalations:
             reg.counter("tmx_jterator_bucket_saturated_total").inc(escalations)
+        from tmlibrary_tpu.capacity import ceiling_slots
+
         with self._bucket_lock:
             self._occ_objects = getattr(self, "_occ_objects", 0) + objects
             self._occ_slots = getattr(self, "_occ_slots", 0) + slots
-            ceiling_slots = (slots // cap) * ceiling if cap else 0
             self._occ_ceiling_slots = (
-                getattr(self, "_occ_ceiling_slots", 0) + ceiling_slots
+                getattr(self, "_occ_ceiling_slots", 0)
+                + ceiling_slots(slots, cap, ceiling)
             )
             occ_o, occ_s, occ_c = (
                 self._occ_objects, self._occ_slots, self._occ_ceiling_slots
@@ -1169,6 +1171,10 @@ class ImageAnalysisRunner(Step):
         total_objects = sum(summary["objects"].values())
         slots = len(counts) * n_valid * cap
         summary["bucket_capacity"] = cap
+        # the ladder ceiling travels with every batch summary so a ledger
+        # alone can reconstruct padded-FLOPs-avoided post hoc
+        # (telemetry.registry_from_ledger) — additive, PR-5 readers ignore it
+        summary["bucket_ceiling"] = ceiling
         summary["slot_occupancy"] = round(slot_occupancy(total_objects, slots), 4)
         if escalations:
             summary["bucket_escalations"] = escalations
