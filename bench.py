@@ -1170,6 +1170,16 @@ def measure_mesh(size: int) -> None:
 
     mesh_sites_per_sec = timed(fn_mesh, raw, shifts, batch)
 
+    # per-device provenance: one extra timed launch, stamping each
+    # device's completion against the dispatch instant (fleet
+    # observability — the certified v5e-8 capture carries these)
+    from tmlibrary_tpu import telemetry
+
+    launch_t0 = time.perf_counter()
+    dev_times = telemetry.device_wall_times(
+        fn_mesh(raw, {}, shifts).counts["cells"], launch_t0
+    )
+
     # single-device reference at the SAME per-device batch: efficiency =
     # sharded-per-chip / single-chip (linear scaling == 1.0)
     raw1 = {
@@ -1199,6 +1209,13 @@ def measure_mesh(size: int) -> None:
         **_ledger_fields(pdepth, max_objects),
         "synthetic_cpu_mesh": backend_is_cpu,
     }
+    if dev_times:
+        vals = [t for _, t in dev_times]
+        record["device_wall_times_s"] = {
+            d: round(float(t), 6) for d, t in dev_times
+        }
+        record["straggler_skew_s"] = round(max(vals) - min(vals), 6)
+        telemetry.record_device_times(dev_times, step="bench_mesh")
     emit_record(record)
 
 

@@ -34,6 +34,19 @@ def check(lines, limit: float):
     return checked, offenders
 
 
+def slowest(lines, n: int = 10):
+    """The n slowest call-phase tests, slowest first — printed on every
+    run (pass or fail) so budget creep shows up in CI logs long before
+    a test actually crosses the limit."""
+    timed = []
+    for line in lines:
+        m = DURATION_RE.match(line)
+        if not m or m.group("phase") != "call":
+            continue
+        timed.append((float(m.group("seconds")), m.group("test")))
+    return sorted(timed, reverse=True)[:n]
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("logfile")
@@ -41,11 +54,17 @@ def main() -> int:
                         help="per-test call budget in seconds (default 60)")
     args = parser.parse_args()
     with open(args.logfile, errors="replace") as fh:
-        checked, offenders = check(fh, args.limit)
+        lines = fh.readlines()
+    checked, offenders = check(lines, args.limit)
     if not checked:
         print("check_durations: no duration lines found — run pytest with "
               "--durations=N", file=sys.stderr)
         return 2
+    top = slowest(lines)
+    if top:
+        print("check_durations: top slowest tests (call phase):")
+        for seconds, test in top:
+            print(f"  {seconds:8.2f}s  {test}")
     if offenders:
         print(f"check_durations: {len(offenders)} test(s) over the "
               f"{args.limit:g}s budget:", file=sys.stderr)

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""CI artifact: simulated 2-host fleet run + merged telemetry snapshot.
+
+    python scripts/ci_fleet_snapshot.py OUTDIR [WORKDIR]
+
+Launches TWO concurrent ``tmx workflow submit`` processes — host0 and
+host1 via ``TMX_HOST_ID``, each on its own store with 2 forced CPU
+devices — then assembles one fleet run root from their per-host
+``metrics.<host>.json`` snapshots, heartbeats and interleaved ledgers,
+and proves the fleet surface end to end:
+
+- ``tmx metrics --merge`` renders one Prometheus view that parses and
+  carries ``host`` AND ``device`` labels;
+- ``tmx top --once`` renders a dashboard from the same files.
+
+Writes ``OUTDIR/fleet_metrics.prom`` + ``OUTDIR/fleet_top.txt`` and
+leaves the assembled run root at ``OUTDIR/fleet/`` for upload.
+"""
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "scripts"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import yaml  # noqa: E402
+
+from ci_metrics_snapshot import PIPE_YAML, synth_source  # noqa: E402
+
+
+def _submit_cmd(root: Path, desc: Path) -> list[str]:
+    return [
+        sys.executable, "-m", "tmlibrary_tpu.cli", "workflow", "submit",
+        "--root", str(root), "--description", str(desc),
+        "--pipeline-depth", "2", "--sample-resources", "1",
+    ]
+
+
+def _host_env(host: str) -> dict:
+    env = dict(os.environ)
+    env["TMX_HOST_ID"] = host
+    env["JAX_PLATFORMS"] = "cpu"
+    # two virtual devices per host so per-device series + straggler skew
+    # have something to measure
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2")
+    return env
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    outdir = Path(sys.argv[1])
+    outdir.mkdir(parents=True, exist_ok=True)
+    work = Path(sys.argv[2]) if len(sys.argv) > 2 else Path(
+        tempfile.mkdtemp(prefix="tmx-ci-fleet-")
+    )
+    work.mkdir(parents=True, exist_ok=True)
+    src = work / "microscope"
+    src.mkdir(exist_ok=True)
+    synth_source(src)
+    pipe = work / "nuclei.pipe.yaml"
+    pipe.write_text(yaml.safe_dump(PIPE_YAML))
+
+    from tmlibrary_tpu.workflow.engine import WorkflowDescription
+
+    desc = work / "workflow.yaml"
+    WorkflowDescription.canonical({
+        "metaconfig": {"source_dir": str(src)},
+        "imextract": {},
+        "corilla": {"chunk_size": 8, "n_devices": 1},
+        "jterator": {"pipe": str(pipe), "batch_size": 4, "max_objects": 64,
+                     "n_devices": 2},
+    }).save(desc)
+
+    # each simulated host gets its own store (on a real pod every host
+    # sees one shared FS; two stores + a copy step model that in CI)
+    procs = []
+    roots = {}
+    for host in ("host0", "host1"):
+        root = work / f"experiment-{host}"
+        roots[host] = root
+        subprocess.run(
+            [sys.executable, "-m", "tmlibrary_tpu.cli", "create",
+             "--root", str(root), "--name", f"ci_fleet_{host}"],
+            check=True, env=_host_env(host),
+        )
+        print(f"== submitting {host}", flush=True)
+        procs.append((host, subprocess.Popen(
+            _submit_cmd(root, desc), env=_host_env(host),
+        )))
+    for host, proc in procs:
+        rc = proc.wait()
+        if rc != 0:
+            raise SystemExit(f"{host} submit failed (rc={rc})")
+
+    # assemble the fleet run root: per-host snapshots + heartbeats side
+    # by side, ledgers interleaved into one multi-host ledger
+    fleet_wf = outdir / "fleet" / "workflow"
+    if fleet_wf.parent.exists():
+        shutil.rmtree(fleet_wf.parent)
+    fleet_wf.mkdir(parents=True)
+    with (fleet_wf / "ledger.jsonl").open("w") as merged_ledger:
+        for host, root in roots.items():
+            wf = root / "workflow"
+            for f in wf.glob("metrics*.json"):
+                shutil.copy(f, fleet_wf / f.name)
+            for f in wf.glob("heartbeat*.json"):
+                shutil.copy(f, fleet_wf / f.name)
+            merged_ledger.write((wf / "ledger.jsonl").read_text())
+
+    from tmlibrary_tpu import telemetry
+    from tmlibrary_tpu.cli import main as tmx
+
+    fleet_root = fleet_wf.parent
+    prom_out = outdir / "fleet_metrics.prom"
+    rc = tmx(["metrics", "--merge", str(fleet_root), "--format", "prom",
+              "--out", str(prom_out)])
+    if rc != 0:
+        raise SystemExit(f"tmx metrics --merge failed (rc={rc})")
+    text = prom_out.read_text()
+    telemetry.parse_prometheus(text)  # must be valid exposition format
+    for needle in ('host="host0"', 'host="host1"', 'device="'):
+        if needle not in text:
+            raise SystemExit(
+                f"merged fleet snapshot is missing {needle!r} — fleet "
+                "labels broken"
+            )
+
+    top_out = outdir / "fleet_top.txt"
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = tmx(["top", "--root", str(fleet_root), "--once"])
+    top_out.write_text(buf.getvalue())
+    if rc != 0 or "tmx top" not in buf.getvalue():
+        raise SystemExit(f"tmx top --once failed (rc={rc})")
+    print(buf.getvalue())
+    print(f"== wrote {prom_out} and {top_out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -96,7 +96,11 @@ def telemetry_section() -> list[str]:
            "",
            "Exported via `tmx metrics --root DIR [--format prom|json] "
            "[--source auto|snapshot|ledger]` and `tmx trace --root DIR "
-           "[--json]`; disable with `--no-telemetry` / `TM_TELEMETRY=0`.",
+           "[--json]`; disable with `--no-telemetry` / `TM_TELEMETRY=0`. "
+           "Fleet runs additionally get `tmx metrics --merge RUN_ROOT` "
+           "(one view over every per-host `metrics.<host>.json`) and the "
+           "live dashboard `tmx top --root DIR [--once] "
+           "[--interval SECS]`.",
            "",
            "| symbol | role |", "|---|---|"]
     for name in sorted(getattr(telemetry, "__all__", None) or
@@ -108,6 +112,25 @@ def telemetry_section() -> list[str]:
             continue
         doc = (inspect.getdoc(obj) or "").split("\n")[0]
         out.append(f"| `telemetry.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
+def top_section() -> list[str]:
+    from tmlibrary_tpu import top
+
+    out = ["## Fleet dashboard (`tmx top`)", "",
+           (inspect.getdoc(top) or "").split("\n")[0],
+           "",
+           "| symbol | role |", "|---|---|"]
+    for name in sorted(n for n in dir(top) if not n.startswith("_")):
+        obj = getattr(top, name)
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "") != top.__name__:
+            continue
+        doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append(f"| `top.{name}` | {doc} |")
     out.append("")
     return out
 
@@ -148,6 +171,7 @@ def main() -> None:
         *tool_section(),
         *ops_section(),
         *telemetry_section(),
+        *top_section(),
         *perf_section(),
     ]
     # optional output override so a freshness check can generate into a

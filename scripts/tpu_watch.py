@@ -159,10 +159,19 @@ def check_run_heartbeat() -> str | None:
     root = os.environ.get("WATCH_RUN_ROOT")
     if not root:
         return None
-    hb = load_json(os.path.join(root, "workflow", "heartbeat.json"))
+    hb_path = os.path.join(root, "workflow", "heartbeat.json")
+    hb = load_json(hb_path)
     if not hb or "ts" not in hb:
         return None
+    # fresher-of(embedded ts, file mtime): the run may live on a host
+    # whose clock is skewed from the watcher box — a live sampler still
+    # touches the file, so mtime keeps a healthy run from reading STALE
     age = time.time() - float(hb["ts"])
+    try:
+        age = min(age, time.time() - os.stat(hb_path).st_mtime)
+    except OSError:
+        pass
+    age = max(0.0, age)
     period = float(hb.get("period", 0) or 0)
     if period > 0 and age > 2 * period:
         msg = (f"run heartbeat at {root} is STALE: {age:.0f}s old "

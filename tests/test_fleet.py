@@ -1,0 +1,495 @@
+"""Fleet observability: labeled per-device series, multi-host merge,
+ledger host attribution, heartbeat clock-skew tolerance, `tmx top`.
+
+What is pinned and why (ISSUE 7):
+
+- Labeled instruments keep the null-instrument guarantee: a disabled
+  registry returns the shared no-op for labeled calls too, so
+  telemetry-off runs pay nothing for the new label dimensions.
+- ``device_wall_times`` + ``record_device_times`` produce real
+  per-device series on the 8-virtual-device test mesh — the same path
+  the jterator shard_map step and the MULTICHIP dryrun use.
+- ``merge_snapshots`` renders one fleet view from per-host snapshots:
+  every series gains a ``host`` label, colliding series fold instead of
+  clobbering, and the Prometheus rendering still parses.
+- ``registry_from_ledger`` over an interleaved 2-host ledger: per-host
+  attribution, order independence, exact-duplicate dedup, and the
+  ``straggler`` event.
+- ``heartbeat_age`` takes the fresher of embedded ts and file mtime so
+  cross-host clock skew cannot flag a live run STALE.
+- ``tmx top --once`` and ``tmx metrics --merge`` work end to end
+  against fabricated run files.
+"""
+
+import json
+import os
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tmlibrary_tpu import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    telemetry.reset_registry(enabled=True)
+    yield
+    telemetry.reset_registry()
+
+
+# --------------------------------------------------- fleet identity (env)
+def test_host_id_resolution(monkeypatch):
+    monkeypatch.delenv("TMX_HOST_ID", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    assert telemetry.host_id() == "host0"
+    monkeypatch.setenv("JAX_PROCESS_ID", "3")
+    assert telemetry.host_id() == "host3"
+    # explicit operator identity wins over the jax process index
+    monkeypatch.setenv("TMX_HOST_ID", "podslice-a")
+    assert telemetry.host_id() == "podslice-a"
+
+
+def test_fleet_active_only_multiprocess(monkeypatch):
+    monkeypatch.delenv("TMX_HOST_ID", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    assert not telemetry.fleet_active()
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "1")
+    assert not telemetry.fleet_active()
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    assert telemetry.fleet_active()
+    monkeypatch.delenv("JAX_NUM_PROCESSES")
+    monkeypatch.setenv("TMX_HOST_ID", "host7")
+    assert telemetry.fleet_active()
+
+
+# ------------------------------------------- labeled null-instrument path
+def test_disabled_registry_labeled_calls_are_null():
+    """The zero-cost-when-disabled guarantee extends to every label
+    dimension: labeled lookups on a disabled registry return the one
+    shared null instrument and record nothing."""
+    reg = telemetry.MetricsRegistry(enabled=False)
+    null = reg.counter("plain")
+    assert reg.counter("tmx_device_batch_seconds", device="3",
+                       host="host1", step="jterator") is null
+    assert reg.gauge("tmx_straggler_skew_seconds", host="host0") is null
+    assert reg.histogram("tmx_collective_seconds",
+                         collective="halo_exchange") is null
+    null.inc()
+    null.set(1.0)
+    null.observe(2.0)
+    assert reg.snapshot() == {"counters": [], "gauges": [], "histograms": []}
+
+
+def test_collective_span_disabled_is_noop_and_enabled_observes():
+    telemetry.reset_registry(enabled=False)
+    with telemetry.collective_span("all_to_all_sites_to_rows"):
+        pass
+    assert telemetry.get_registry().snapshot()["histograms"] == []
+    telemetry.reset_registry(enabled=True)
+    with telemetry.collective_span("all_to_all_sites_to_rows"):
+        time.sleep(0.002)
+    hists = telemetry.get_registry().snapshot()["histograms"]
+    assert len(hists) == 1
+    h = hists[0]
+    assert h["name"] == "tmx_collective_seconds"
+    assert h["labels"]["collective"] == "all_to_all_sites_to_rows"
+    assert "host" in h["labels"]
+    assert h["count"] == 1 and h["max"] > 0
+
+
+# ----------------------------------------- per-device wall-time capture
+def test_device_wall_times_on_test_mesh(devices):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from tmlibrary_tpu.parallel.mesh import site_mesh
+
+    mesh = site_mesh(8)
+    arr = jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+        NamedSharding(mesh, PartitionSpec("sites")),
+    )
+    t0 = time.perf_counter()
+    times = telemetry.device_wall_times(arr, t0)
+    assert len(times) == 8
+    # device ids in order, every stamp non-negative
+    assert [d for d, _ in times] == sorted(
+        (str(d.id) for d in mesh.devices.flat), key=lambda s: int(s)
+    )
+    assert all(t >= 0.0 for _, t in times)
+
+    skew = telemetry.record_device_times(times, step="jterator", batch=0)
+    snap = telemetry.get_registry().snapshot()
+    dev_gauges = [g for g in snap["gauges"]
+                  if g["name"] == "tmx_device_batch_seconds"]
+    assert len(dev_gauges) == 8
+    assert {g["labels"]["device"] for g in dev_gauges} == {
+        str(i) for i in range(8)
+    }
+    assert all(g["labels"]["step"] == "jterator" and "host" in g["labels"]
+               for g in dev_gauges)
+    skew_gauges = [g for g in snap["gauges"]
+                   if g["name"] == "tmx_straggler_skew_seconds"]
+    assert len(skew_gauges) == 1
+    assert skew_gauges[0]["value"] == pytest.approx(skew, abs=1e-6)
+
+
+def test_device_wall_times_unsharded_returns_empty():
+    # single-device (or host) arrays give no per-device series — the
+    # instrumentation must silently do nothing on single-chip runs
+    t0 = time.perf_counter()
+    assert telemetry.device_wall_times(np.zeros(8), t0) == []
+    assert telemetry.device_wall_times({"a": 1}, t0) == []
+    assert telemetry.record_device_times([], step="x") == 0.0
+
+
+def test_straggler_threshold_env(monkeypatch):
+    monkeypatch.delenv("TMX_STRAGGLER_MIN_S", raising=False)
+    monkeypatch.delenv("TMX_STRAGGLER_REL", raising=False)
+    # floor dominates for fast batches; relative fraction for slow ones
+    assert telemetry.straggler_threshold(0.01) == pytest.approx(0.05)
+    assert telemetry.straggler_threshold(1.0) == pytest.approx(0.25)
+    monkeypatch.setenv("TMX_STRAGGLER_MIN_S", "0.2")
+    monkeypatch.setenv("TMX_STRAGGLER_REL", "0.5")
+    assert telemetry.straggler_threshold(1.0) == pytest.approx(0.5)
+    assert telemetry.straggler_threshold(0.1) == pytest.approx(0.2)
+
+
+# ----------------------------------------------------- snapshot merging
+def _host_snapshot(host: str, batches: int, site_rate: float) -> dict:
+    reg = telemetry.MetricsRegistry(enabled=True)
+    reg.counter("tmx_batches_done_total", step="jterator").inc(batches)
+    reg.gauge("tmx_jterator_sites_per_sec").set(site_rate)
+    reg.histogram("tmx_batch_seconds", step="jterator").observe(0.5)
+    for dev in ("0", "1"):
+        reg.gauge("tmx_device_batch_seconds", device=dev, host=host,
+                  step="jterator").set(0.1 + 0.05 * int(dev))
+    return reg.snapshot()
+
+
+def test_merge_snapshots_tags_hosts_and_parses(tmp_path):
+    merged = telemetry.merge_snapshots([
+        ("host0", _host_snapshot("host0", 4, 50.0)),
+        ("host1", _host_snapshot("host1", 3, 60.0)),
+    ])
+    counters = [c for c in merged["counters"]
+                if c["name"] == "tmx_batches_done_total"]
+    assert {c["labels"]["host"] for c in counters} == {"host0", "host1"}
+    assert {c["value"] for c in counters} == {4, 3}
+    # device series already carried their host label: not re-tagged,
+    # and both hosts' devices stay distinct
+    dev = [g for g in merged["gauges"]
+           if g["name"] == "tmx_device_batch_seconds"]
+    assert len(dev) == 4
+    assert {(g["labels"]["host"], g["labels"]["device"]) for g in dev} == {
+        ("host0", "0"), ("host0", "1"), ("host1", "0"), ("host1", "1"),
+    }
+    prom = telemetry.render_prometheus(merged)
+    telemetry.parse_prometheus(prom)  # valid exposition format
+    assert 'host="host0"' in prom and 'host="host1"' in prom
+    assert 'device="1"' in prom
+
+
+def test_merge_snapshots_folds_colliding_series():
+    """The same host contributing the same series twice (snapshot read
+    twice, or a host restarted mid-run) folds instead of duplicating:
+    counters/histograms add, gauges keep the last write."""
+    snap = _host_snapshot("host0", 4, 50.0)
+    merged = telemetry.merge_snapshots([("host0", snap), ("host0", snap)])
+    counters = [c for c in merged["counters"]
+                if c["name"] == "tmx_batches_done_total"]
+    assert len(counters) == 1 and counters[0]["value"] == 8
+    hists = [h for h in merged["histograms"]
+             if h["name"] == "tmx_batch_seconds"]
+    assert len(hists) == 1 and hists[0]["count"] == 2
+    gauges = [g for g in merged["gauges"]
+              if g["name"] == "tmx_jterator_sites_per_sec"]
+    assert len(gauges) == 1 and gauges[0]["value"] == 50.0
+
+
+def test_load_fleet_snapshots_legacy_and_per_host(tmp_path):
+    wf = tmp_path / "workflow"
+    wf.mkdir()
+    legacy = {"counters": [], "gauges": [
+        {"name": "g", "labels": {}, "value": 1.0}], "histograms": []}
+    (wf / "metrics.json").write_text(json.dumps(legacy))
+    (wf / "metrics.host1.json").write_text(json.dumps(legacy))
+    # legacy metrics.json maps to host0 when no per-host host0 file exists
+    pairs = telemetry.load_fleet_snapshots(tmp_path)
+    assert [h for h, _ in pairs] == ["host0", "host1"]
+    # ... and is skipped once the per-host host0 snapshot exists (host0
+    # writes both files with identical content — no double counting)
+    (wf / "metrics.host0.json").write_text(json.dumps(legacy))
+    pairs = telemetry.load_fleet_snapshots(tmp_path)
+    assert [h for h, _ in pairs] == ["host0", "host1"]
+    # unreadable snapshots are skipped, not fatal
+    (wf / "metrics.host2.json").write_text("{broken")
+    assert [h for h, _ in telemetry.load_fleet_snapshots(tmp_path)] == [
+        "host0", "host1"]
+
+
+# -------------------------------------- multi-host ledger derivation
+def _two_host_events():
+    """An interleaved 2-host ledger: both hosts run the same step, host1
+    lags (straggler), batch summaries carry device wall times."""
+    t = 1000.0
+    ev = []
+    ev.append({"event": "run_started", "ts": t, "host": "host0"})
+    ev.append({"event": "run_started", "ts": t, "host": "host1"})
+    for i, host in enumerate(["host0", "host1", "host0", "host1"]):
+        ev.append({
+            "event": "batch_done", "step": "jterator", "batch": i,
+            "elapsed": 1.0 if host == "host0" else 2.0,
+            "ts": t + i, "host": host,
+            "result": {
+                "n_sites": 8,
+                "device_wall_times": {"0": 0.10, "1": 0.30},
+                "straggler_skew_s": 0.20,
+            },
+        })
+    ev.append({"event": "straggler", "step": "jterator", "batch": 3,
+               "skew_s": 0.2, "ts": t + 9, "host": "host1",
+               "device_wall_times": {"0": 0.1, "1": 0.3}})
+    ev.append({"event": "span", "step": "jterator", "span": "device_block",
+               "elapsed": 0.4, "ts": t + 5, "host": "host0"})
+    ev.append({"event": "step_done", "step": "jterator", "elapsed": 4.0,
+               "ts": t + 10, "host": "host0"})
+    return ev
+
+
+def test_registry_from_ledger_two_host_attribution():
+    reg = telemetry.registry_from_ledger(_two_host_events())
+    snap = reg.snapshot()
+    done = {c["labels"].get("host"): c["value"] for c in snap["counters"]
+            if c["name"] == "tmx_batches_done_total"}
+    assert done == {"host0": 2, "host1": 2}
+    # per-host throughput: same units, host1 took twice as long
+    rates = {g["labels"].get("host"): g["value"] for g in snap["gauges"]
+             if g["name"] == "tmx_step_units_per_sec"}
+    assert rates["host0"] == pytest.approx(8.0)
+    assert rates["host1"] == pytest.approx(4.0)
+    # straggler event -> counter + skew gauge on the right host
+    stragglers = [c for c in snap["counters"]
+                  if c["name"] == "tmx_stragglers_total"]
+    assert len(stragglers) == 1
+    assert stragglers[0]["labels"]["host"] == "host1"
+    # device wall times in batch summaries -> labeled device gauges
+    dev = [g for g in snap["gauges"]
+           if g["name"] == "tmx_device_batch_seconds"]
+    assert {(g["labels"]["host"], g["labels"]["device"]) for g in dev} == {
+        ("host0", "0"), ("host0", "1"), ("host1", "0"), ("host1", "1"),
+    }
+    skews = [g for g in snap["gauges"]
+             if g["name"] == "tmx_straggler_skew_seconds"]
+    assert all(g["value"] == pytest.approx(0.2) for g in skews)
+
+
+def test_registry_from_ledger_order_independent_and_dedups():
+    events = _two_host_events()
+    base = telemetry.registry_from_ledger(events).snapshot()
+    # interleaving order must not matter (hosts' appends race on a pod)
+    shuffled = list(reversed(events))
+    assert telemetry.registry_from_ledger(shuffled).snapshot() == base
+    # exact duplicates (one physical event copied into both per-host
+    # ledgers, then both ledgers concatenated) are dropped
+    assert telemetry.registry_from_ledger(events + events).snapshot() == base
+
+
+def test_registry_from_ledger_seed_era_unchanged():
+    """Host-free (seed-era) ledgers keep their exact legacy series: no
+    host labels appear and repeated events are NOT deduped (they carry
+    no identity to dedup on)."""
+    events = [
+        {"event": "run_started", "ts": 1.0},
+        {"event": "batch_done", "step": "s", "elapsed": 1.0, "batch": 0,
+         "ts": 2.0, "result": {"n_sites": 4}},
+        {"event": "batch_done", "step": "s", "elapsed": 1.0, "batch": 0,
+         "ts": 2.0, "result": {"n_sites": 4}},
+    ]
+    snap = telemetry.registry_from_ledger(events).snapshot()
+    done = [c for c in snap["counters"]
+            if c["name"] == "tmx_batches_done_total"]
+    assert len(done) == 1 and done[0]["value"] == 2
+    assert "host" not in done[0]["labels"]
+
+
+# ------------------------------------------- heartbeat clock-skew rule
+def test_heartbeat_age_uses_fresher_of_ts_and_mtime(tmp_path):
+    hb = tmp_path / "heartbeat.json"
+    # writer clock 100s behind the reader: embedded ts looks ancient,
+    # but the file was JUST written — the run is alive
+    hb.write_text(json.dumps({"ts": time.time() - 100.0, "period": 5.0}))
+    age = telemetry.heartbeat_age(hb)
+    assert age is not None and age < 5.0
+    # genuinely stale: ts AND mtime are old
+    stale_t = time.time() - 100.0
+    os.utime(hb, (stale_t, stale_t))
+    assert telemetry.heartbeat_age(hb) > 90.0
+    # writer clock AHEAD of reader: clamped at zero, never negative
+    hb.write_text(json.dumps({"ts": time.time() + 50.0, "period": 5.0}))
+    assert telemetry.heartbeat_age(hb) == 0.0
+
+
+def test_heartbeat_carries_host_and_per_host_path(tmp_path, monkeypatch):
+    monkeypatch.delenv("TMX_HOST_ID", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    assert telemetry.heartbeat_path(tmp_path).name == "heartbeat.json"
+    monkeypatch.setenv("TMX_HOST_ID", "host2")
+    path = telemetry.heartbeat_path(tmp_path)
+    assert path.name == "heartbeat.host2.json"
+    telemetry.write_heartbeat(path, period=1.0)
+    assert telemetry.read_heartbeat(path)["host"] == "host2"
+    assert telemetry.snapshot_path(tmp_path).name == "metrics.host2.json"
+
+
+# ------------------------------------------ sampler CPU-only warn-once
+def test_sampler_warns_once_without_device_memory(monkeypatch, caplog):
+    monkeypatch.setattr(telemetry, "_device_memory_bytes", lambda: None)
+    sampler = telemetry.ResourceSampler(
+        period=1.0, registry=telemetry.MetricsRegistry(enabled=True)
+    )
+    with caplog.at_level("WARNING", logger="tmlibrary_tpu.telemetry"):
+        sampler.sample_once()
+        sampler.sample_once()
+        sampler.sample_once()
+    hits = [r for r in caplog.records
+            if "device memory stats unavailable" in r.getMessage()]
+    assert len(hits) == 1
+
+
+# --------------------------------------------------- CLI: merge + top
+def _fabricate_fleet_root(tmp_path) -> Path:
+    root = tmp_path / "run"
+    wf = root / "workflow"
+    wf.mkdir(parents=True)
+    for host, rate in (("host0", 50.0), ("host1", 42.0)):
+        (wf / f"metrics.{host}.json").write_text(
+            telemetry.render_json(_host_snapshot(host, 4, rate))
+        )
+    telemetry.write_heartbeat(wf / "heartbeat.json", period=2.0,
+                              extra={"rss_bytes": 1 << 20, "open_fds": 12})
+    (wf / "heartbeat.host1.json").write_text(json.dumps(
+        {"ts": time.time(), "pid": 2, "period": 2.0, "host": "host1"}
+    ))
+    with (wf / "ledger.jsonl").open("w") as fh:
+        fh.write(json.dumps({"event": "run_started", "ts": 1.0}) + "\n")
+        fh.write(json.dumps({"event": "init_done", "step": "jterator",
+                             "n_batches": 4, "ts": 2.0}) + "\n")
+        fh.write(json.dumps({"event": "batch_done", "step": "jterator",
+                             "batch": 0, "elapsed": 1.0, "ts": 3.0}) + "\n")
+    return root
+
+
+def test_cli_metrics_merge(tmp_path, capsys):
+    from tmlibrary_tpu.cli import main
+
+    root = _fabricate_fleet_root(tmp_path)
+    assert main(["metrics", "--merge", str(root)]) == 0
+    prom = capsys.readouterr().out
+    telemetry.parse_prometheus(prom)
+    assert 'host="host0"' in prom and 'host="host1"' in prom
+    assert 'device="' in prom
+    # --out + json variant
+    out = tmp_path / "fleet.json"
+    assert main(["metrics", "--merge", str(root), "--format", "json",
+                 "--out", str(out)]) == 0
+    merged = json.loads(out.read_text())
+    assert {c["labels"]["host"] for c in merged["counters"]} == {
+        "host0", "host1"}
+    # neither --root nor --merge: usage error, not a crash
+    assert main(["metrics"]) == 1
+    # empty root: clean error
+    assert main(["metrics", "--merge", str(tmp_path / "nothing")]) == 1
+
+
+def test_cli_top_once_renders_dashboard(tmp_path, capsys):
+    from tmlibrary_tpu.cli import main
+
+    root = _fabricate_fleet_root(tmp_path)
+    assert main(["top", "--root", str(root), "--once"]) == 0
+    out = capsys.readouterr().out
+    # no cursor-control escapes in --once mode (CI-log friendly)
+    assert "\x1b" not in out
+    assert "tmx top" in out
+    assert "host0" in out and "host1" in out
+    assert "jterator" in out and "1/4 batches" in out
+    # per-device bars from the snapshot gauges
+    assert "host0/d0" in out and "host1/d1" in out
+    assert "█" in out
+    assert main(["top", "--root", str(tmp_path / "missing"), "--once"]) == 1
+
+
+def test_top_dashboard_flags_stale_host(tmp_path):
+    from tmlibrary_tpu import top
+
+    root = _fabricate_fleet_root(tmp_path)
+    hb = root / "workflow" / "heartbeat.host1.json"
+    stale_t = time.time() - 100.0
+    hb.write_text(json.dumps(
+        {"ts": stale_t, "pid": 2, "period": 2.0, "host": "host1"}
+    ))
+    os.utime(hb, (stale_t, stale_t))
+    view = top.collect_fleet(root)
+    by_host = {h["host"]: h for h in view["hosts"]}
+    assert not by_host["host0"]["stale"]
+    assert by_host["host1"]["stale"]
+    assert "STALE" in top.render_dashboard(view)
+
+
+def test_run_top_iterations_loop(tmp_path):
+    import io
+
+    from tmlibrary_tpu import top
+
+    root = _fabricate_fleet_root(tmp_path)
+    buf = io.StringIO()
+    assert top.run_top(root, interval=0.01, iterations=2, out=buf) == 0
+    assert buf.getvalue().count("tmx top") == 2
+
+
+# --------------------------------- engine integration: straggler event
+def test_engine_note_straggler_appends_ledger_event(tmp_path):
+    from tmlibrary_tpu.workflow.engine import RunLedger, Workflow
+
+    ledger = RunLedger(tmp_path / "ledger.jsonl", host="host0")
+    wf = Workflow.__new__(Workflow)
+    wf.ledger = ledger
+    # skew over threshold -> event with host attribution
+    wf._note_straggler("jterator", 2, {
+        "device_wall_times": {"0": 0.1, "1": 1.0},
+        "straggler_skew_s": 0.9,
+    })
+    # below threshold -> no event
+    wf._note_straggler("jterator", 3, {
+        "device_wall_times": {"0": 1.0, "1": 1.01},
+        "straggler_skew_s": 0.01,
+    })
+    # no device provenance -> no event
+    wf._note_straggler("jterator", 4, {"n_sites": 8})
+    events = ledger.events()
+    stragglers = [e for e in events if e["event"] == "straggler"]
+    assert len(stragglers) == 1
+    assert stragglers[0]["batch"] == 2
+    assert stragglers[0]["host"] == "host0"
+    assert stragglers[0]["skew_s"] == pytest.approx(0.9)
+    # and the derived registry picks it up with the host label
+    snap = telemetry.registry_from_ledger(events).snapshot()
+    assert any(c["name"] == "tmx_stragglers_total"
+               and c["labels"].get("host") == "host0"
+               for c in snap["counters"])
+
+
+def test_ledger_host_field_optional(tmp_path):
+    from tmlibrary_tpu.workflow.engine import RunLedger
+
+    plain = RunLedger(tmp_path / "a.jsonl")
+    plain.append(event="run_started")
+    assert "host" not in plain.events()[0]
+    fleet = RunLedger(tmp_path / "b.jsonl", host="host1")
+    fleet.append(event="run_started")
+    assert fleet.events()[0]["host"] == "host1"
+    # an explicit host on the event wins (replayed foreign events)
+    fleet.append(event="batch_done", host="host0")
+    assert fleet.events()[1]["host"] == "host0"

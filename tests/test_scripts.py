@@ -2,6 +2,7 @@
 so a syntax error there ships silently (round-2 advisor finding: a stray
 indent made ``tune_tpu.py`` unrunnable while CI stayed green)."""
 import json
+import os
 import pathlib
 import py_compile
 
@@ -347,10 +348,19 @@ def test_watch_flags_stale_run_heartbeat(monkeypatch, tmp_path):
     # no heartbeat file yet: silently skipped
     assert w.check_run_heartbeat() is None
     hb = root / "workflow" / "heartbeat.json"
+    stale_t = _time.time() - 100.0
     hb.write_text(json.dumps(
-        {"ts": _time.time() - 100.0, "pid": 123, "period": 5.0}))
+        {"ts": stale_t, "pid": 123, "period": 5.0}))
+    # staleness is fresher-of(ts, mtime): a genuinely hung run stops
+    # touching the file, so backdate the mtime too
+    os.utime(hb, (stale_t, stale_t))
     msg = w.check_run_heartbeat()
     assert msg is not None and "STALE" in msg and "hung" in msg
+    # skewed clock, live sampler: embedded ts looks ancient but the file
+    # is freshly written — must NOT flag
+    hb.write_text(json.dumps(
+        {"ts": stale_t, "pid": 123, "period": 5.0}))
+    assert w.check_run_heartbeat() is None
     # fresh heartbeat: healthy
     hb.write_text(json.dumps({"ts": _time.time(), "pid": 123, "period": 5.0}))
     assert w.check_run_heartbeat() is None

@@ -20,6 +20,7 @@ Four layers of guarantees:
 
 import json
 import logging
+import os
 import time
 
 import numpy as np
@@ -296,9 +297,11 @@ def test_heartbeat_roundtrip_and_age(tmp_path):
     assert hb["rss_bytes"] == 42
     age = telemetry.heartbeat_age(hb_path)
     assert 0.0 <= age < 5.0
-    # stale relative to an artificial 'now'
+    # stale relative to an artificial 'now' — the fresher-of rule takes
+    # the file mtime (written a hair after the embedded ts), so the age
+    # is ~100s, not exactly 100s
     assert telemetry.heartbeat_age(hb_path, now=hb["ts"] + 100) == \
-        pytest.approx(100.0, abs=1e-6)
+        pytest.approx(100.0, abs=1.0)
     assert telemetry.read_heartbeat(tmp_path / "missing.json") is None
 
 
@@ -607,9 +610,13 @@ def test_cli_status_flags_stale_heartbeat(tmp_path, capsys):
         fh.write(json.dumps({"event": "init_done", "step": "jterator",
                              "n_batches": 4}) + "\n")
     hb_path = st.workflow_dir / telemetry.HEARTBEAT_FILENAME
+    stale_t = time.time() - 100.0
     hb_path.write_text(json.dumps(
-        {"ts": time.time() - 100.0, "pid": 1, "period": 5.0}
+        {"ts": stale_t, "pid": 1, "period": 5.0}
     ))
+    # staleness is fresher-of(ts, mtime): backdate the mtime too, or the
+    # fresh file mtime would (correctly) mark the heartbeat live
+    os.utime(hb_path, (stale_t, stale_t))
     assert main(["workflow", "status", "--root", str(st.root)]) == 0
     out = capsys.readouterr().out
     assert "heartbeat:" in out

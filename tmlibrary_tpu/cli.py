@@ -146,7 +146,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="export run metrics (Prometheus textfile or JSON) from the "
              "live registry snapshot or derived from any run ledger",
     )
-    _add_common(p_metrics)
+    # --root is optional here (unlike _add_common): `tmx metrics --merge`
+    # takes the run root positionally and needs no open store
+    p_metrics.add_argument("--root", default=None,
+                           help="experiment store directory")
+    p_metrics.add_argument("-v", "--verbosity", action="count", default=0)
+    p_metrics.add_argument(
+        "--merge", default=None, metavar="RUN_ROOT",
+        help="merge every per-host workflow/metrics.<host>.json under this "
+             "run root into one fleet view (adds host labels)",
+    )
     p_metrics.add_argument(
         "--format", choices=("prom", "json"), default="prom",
         help="Prometheus textfile exposition format (default) or JSON",
@@ -160,6 +169,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p_metrics.add_argument("--out", default=None,
                            help="write to this file instead of stdout")
+
+    p_top = sub.add_parser(
+        "top",
+        help="live fleet dashboard over a run's heartbeat + metrics "
+             "snapshot files (curses-free repaint loop; --once for CI)",
+    )
+    _add_common(p_top)
+    p_top.add_argument("--interval", type=float, default=2.0,
+                       help="repaint period in seconds (default 2.0)")
+    p_top.add_argument("--once", action="store_true",
+                       help="render a single frame and exit (tests/CI)")
 
     p_trace = sub.add_parser(
         "trace",
@@ -589,17 +609,19 @@ def cmd_workflow(args) -> int:
         # is a HUNG run (sampler thread dead/blocked), not a slow one
         from tmlibrary_tpu import telemetry
 
-        hb = telemetry.read_heartbeat(
-            store.workflow_dir / telemetry.HEARTBEAT_FILENAME
-        )
-        if hb and "ts" in hb:
-            import time as _time
-
-            age = _time.time() - float(hb["ts"])
+        running = any(e.get("state") == "running" for e in status.values())
+        for hb_path in sorted(store.workflow_dir.glob("heartbeat*.json")):
+            hb = telemetry.read_heartbeat(hb_path)
+            if not hb or "ts" not in hb:
+                continue
+            # fresher-of(embedded ts, file mtime): cross-host clock skew
+            # must not flag a live remote host's run as hung
+            age = telemetry.heartbeat_age(hb_path)
             period = float(hb.get("period", 0) or 0)
-            line = f"heartbeat: {age:.1f}s ago (sampler period {period:g}s)"
-            running = any(e.get("state") == "running"
-                          for e in status.values())
+            host = str(hb.get("host") or "host0")
+            tag = "" if host == "host0" else f"[{host}]"
+            line = (f"heartbeat{tag}: {age:.1f}s ago "
+                    f"(sampler period {period:g}s)")
             if running and period > 0 and age > 2 * period:
                 line += " — STALE: run appears hung"
             print(line)
@@ -1146,6 +1168,28 @@ def cmd_metrics(args) -> int:
     on any ledger — including runs that predate telemetry."""
     from tmlibrary_tpu import telemetry
 
+    if getattr(args, "merge", None):
+        pairs = telemetry.load_fleet_snapshots(Path(args.merge))
+        if not pairs:
+            print(f"error: no workflow/metrics*.json snapshots under "
+                  f"{args.merge}", file=sys.stderr)
+            return 1
+        merged = telemetry.merge_snapshots(pairs)
+        if args.format == "json":
+            text = telemetry.render_json(merged) + "\n"
+        else:
+            text = telemetry.render_prometheus(merged)
+        if args.out:
+            Path(args.out).write_text(text)
+            print(f"wrote merged {args.format} metrics for "
+                  f"{len(pairs)} host(s) to {args.out}")
+        else:
+            sys.stdout.write(text)
+        return 0
+    if not args.root:
+        print("error: --root is required (or use --merge RUN_ROOT)",
+              file=sys.stderr)
+        return 1
     store = _open_store(args)
     snapshot = None
     snap_path = store.workflow_dir / "metrics.json"
@@ -1199,6 +1243,17 @@ def cmd_metrics(args) -> int:
     else:
         sys.stdout.write(text)
     return 0
+
+
+def cmd_top(args) -> int:
+    """Live fleet dashboard (``tmx top``): poll heartbeats + per-host
+    metrics snapshots under the run root and repaint a terminal view —
+    throughput, pipeline depth, bucket occupancy, per-device utilization,
+    straggler skew, degradation state."""
+    from tmlibrary_tpu import top
+
+    return top.run_top(Path(args.root), interval=args.interval,
+                       once=args.once)
 
 
 def cmd_trace(args) -> int:
@@ -1447,6 +1502,8 @@ def main(argv=None) -> int:
             return cmd_export(args)
         if args.command == "metrics":
             return cmd_metrics(args)
+        if args.command == "top":
+            return cmd_top(args)
         if args.command == "trace":
             return cmd_trace(args)
         if args.command == "perf":

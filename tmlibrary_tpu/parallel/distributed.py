@@ -32,6 +32,7 @@ import numpy as np
 from jax.experimental import mesh_utils, multihost_utils
 from jax.sharding import Mesh, PartitionSpec
 
+from tmlibrary_tpu import telemetry
 from tmlibrary_tpu.errors import ShardingError
 
 logger = logging.getLogger(__name__)
@@ -77,6 +78,10 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+    # mirror the resolved identity into env so telemetry.host_id() stays
+    # env-only (it must never touch the jax backend itself)
+    os.environ.setdefault("JAX_PROCESS_ID", str(jax.process_index()))
+    os.environ.setdefault("JAX_NUM_PROCESSES", str(jax.process_count()))
     logger.info(
         "multi-host runtime up: process %d/%d, %d local / %d global devices",
         jax.process_index(),
@@ -152,23 +157,26 @@ def host_local_to_global(local_batch: np.ndarray, mesh: Mesh):
     """Assemble per-host site batches into one globally-sharded array
     without gathering everything onto any single host
     (``multihost_utils.host_local_array_to_global_array``)."""
-    return multihost_utils.host_local_array_to_global_array(
-        local_batch, mesh, batch_spec(mesh)
-    )
+    with telemetry.collective_span("host_local_to_global"):
+        return multihost_utils.host_local_array_to_global_array(
+            local_batch, mesh, batch_spec(mesh)
+        )
 
 
 def global_to_host_local(global_array, mesh: Mesh) -> np.ndarray:
     """Inverse of :func:`host_local_to_global`: this host's shard as a
     host-local numpy batch (for per-host feature/label writes)."""
-    return np.asarray(
-        multihost_utils.global_array_to_host_local_array(
-            global_array, mesh, batch_spec(mesh)
+    with telemetry.collective_span("global_to_host_local"):
+        return np.asarray(
+            multihost_utils.global_array_to_host_local_array(
+                global_array, mesh, batch_spec(mesh)
+            )
         )
-    )
 
 
 def sync_hosts(name: str = "barrier") -> None:
     """Cross-host barrier (reference: GC3Pie waits for all jobs of a step
     before starting the next step's jobs)."""
     if jax.process_count() > 1:
-        multihost_utils.sync_global_devices(name)
+        with telemetry.collective_span("sync_hosts", barrier=name):
+            multihost_utils.sync_global_devices(name)

@@ -21,6 +21,7 @@ from jax.sharding import Mesh, PartitionSpec
 
 from tmlibrary_tpu.parallel.compat import axis_size, shard_map
 
+from tmlibrary_tpu import telemetry
 from tmlibrary_tpu.errors import ShardingError
 
 
@@ -108,7 +109,8 @@ def sharded_halo_map_2d(
         in_specs=PartitionSpec(row_axis, col_axis),
         out_specs=PartitionSpec(row_axis, col_axis),
     )
-    return jax.jit(mapped)(image)
+    with telemetry.collective_span("halo_exchange_2d"):
+        return jax.jit(mapped)(image)
 
 
 @functools.lru_cache(maxsize=64)
@@ -150,9 +152,10 @@ def sharded_gaussian_smooth_2d(
         raise ShardingError(
             f"image {h}x{w} not divisible by mesh {nr}x{nc}"
         )
-    return _cached_gaussian_halo_2d(
-        mesh, float(sigma), radius, row_axis, col_axis
-    )(image)
+    with telemetry.collective_span("halo_exchange_2d", op="gaussian_smooth"):
+        return _cached_gaussian_halo_2d(
+            mesh, float(sigma), radius, row_axis, col_axis
+        )(image)
 
 
 def sharded_halo_map(
@@ -185,7 +188,8 @@ def sharded_halo_map(
         in_specs=PartitionSpec(axis),
         out_specs=PartitionSpec(axis),
     )
-    return jax.jit(mapped)(image)
+    with telemetry.collective_span("halo_exchange"):
+        return jax.jit(mapped)(image)
 
 
 @functools.lru_cache(maxsize=64)
@@ -218,7 +222,8 @@ def sharded_gaussian_smooth(
     n = mesh.devices.size
     if h % n != 0:
         raise ShardingError(f"image rows {h} not divisible by mesh size {n}")
-    return _cached_gaussian_halo(mesh, float(sigma), radius, axis)(image)
+    with telemetry.collective_span("halo_exchange", op="gaussian_smooth"):
+        return _cached_gaussian_halo(mesh, float(sigma), radius, axis)(image)
 
 
 def sharded_downsample_2x(image: jax.Array, mesh: Mesh, axis: str = "rows") -> jax.Array:
@@ -239,7 +244,8 @@ def sharded_downsample_2x(image: jax.Array, mesh: Mesh, axis: str = "rows") -> j
         in_specs=PartitionSpec(axis),
         out_specs=PartitionSpec(axis),
     )
-    return jax.jit(mapped)(image)
+    with telemetry.collective_span("downsample_2x"):
+        return jax.jit(mapped)(image)
 
 
 def sharded_pyramid_levels(

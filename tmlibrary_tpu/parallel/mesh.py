@@ -18,6 +18,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from tmlibrary_tpu import telemetry
 from tmlibrary_tpu.errors import ShardingError
 
 
@@ -52,4 +53,5 @@ def shard_batch(array, mesh: Mesh, axis: str = "sites"):
         raise ShardingError(
             f"batch axis {array.shape[0]} not divisible by mesh size {n}"
         )
-    return jax.device_put(array, batch_sharding(mesh, axis))
+    with telemetry.collective_span("shard_batch"):
+        return jax.device_put(array, batch_sharding(mesh, axis))

@@ -23,6 +23,7 @@ from jax.sharding import Mesh, PartitionSpec
 
 from tmlibrary_tpu.parallel.compat import shard_map
 
+from tmlibrary_tpu import telemetry
 from tmlibrary_tpu.errors import ShardingError
 
 
@@ -48,12 +49,13 @@ def sites_to_rows(batch: jax.Array, mesh: Mesh, axis: str = "sites") -> jax.Arra
         # split rows into n bands and exchange: concat sites, keep own band
         return lax.all_to_all(block, axis, split_axis=1, concat_axis=0, tiled=True)
 
-    out = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=PartitionSpec(axis),
-        out_specs=PartitionSpec(None, axis),
-    )(batch)
+    with telemetry.collective_span("all_to_all_sites_to_rows"):
+        out = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=PartitionSpec(axis),
+            out_specs=PartitionSpec(None, axis),
+        )(batch)
     return out
 
 
@@ -65,12 +67,13 @@ def rows_to_sites(batch: jax.Array, mesh: Mesh, axis: str = "sites") -> jax.Arra
     def body(block):  # block: (B, H/n, W)
         return lax.all_to_all(block, axis, split_axis=0, concat_axis=1, tiled=True)
 
-    out = shard_map(
-        body,
-        mesh=mesh,
-        in_specs=PartitionSpec(None, axis),
-        out_specs=PartitionSpec(axis),
-    )(batch)
+    with telemetry.collective_span("all_to_all_rows_to_sites"):
+        out = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=PartitionSpec(None, axis),
+            out_specs=PartitionSpec(axis),
+        )(batch)
     return out
 
 

@@ -42,6 +42,7 @@ from pathlib import Path
 from typing import Any, Callable, Iterable, Iterator
 
 from tmlibrary_tpu.errors import FaultInjected
+from tmlibrary_tpu.log import warn_once
 
 logger = logging.getLogger(__name__)
 
@@ -357,6 +358,166 @@ def reset_registry(enabled: bool | None = None) -> MetricsRegistry:
 
 
 # ---------------------------------------------------------------------------
+# fleet identity (multi-host label semantics)
+#
+# Label conventions for fleet-scope series (DESIGN.md §17):
+#   host   — one value per process in the run ("host0", "host1", ...)
+#   device — a local device id within a host ("0".."7")
+#   step   — the workflow step that produced the observation
+# The labels ride the existing instrument kwargs, so a disabled registry
+# still hands out the shared null instrument: labeled metrics cost nothing
+# when telemetry is off.
+
+
+def host_id() -> str:
+    """Stable identity of this process within a (possibly multi-host) run.
+
+    Resolution order: explicit ``TMX_HOST_ID`` (the simulated-fleet knob
+    CI uses), the standard ``JAX_PROCESS_ID`` a pod launcher exports
+    (``parallel.distributed.initialize`` mirrors its resolved process id
+    into the env), else ``host0``.  Env-only on purpose: querying jax for
+    ``process_index`` would initialize a backend, and telemetry must
+    never be the thing that does that.
+    """
+    explicit = os.environ.get("TMX_HOST_ID")
+    if explicit:
+        return explicit
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if pid is not None:
+        try:
+            return f"host{int(pid)}"
+        except ValueError:
+            return f"host-{pid}"
+    return "host0"
+
+
+def fleet_active() -> bool:
+    """True when this process is one of several in a fleet — a real
+    multi-host launch (``JAX_NUM_PROCESSES`` > 1) or a simulated one
+    (``TMX_HOST_ID`` set).  Gates the per-event ``host`` field in the run
+    ledger so single-host ledgers keep their seed-era shape."""
+    if os.environ.get("TMX_HOST_ID"):
+        return True
+    try:
+        return int(os.environ.get("JAX_NUM_PROCESSES", "1") or 1) > 1
+    except ValueError:
+        return False
+
+
+@contextlib.contextmanager
+def collective_span(name: str, **labels: str) -> Iterator[None]:
+    """Bracket the host-side donated call that launches a collective
+    (psum/all_gather/all_to_all/ppermute halo exchange/reshard).
+
+    Dispatch is async, so this times what the host actually pays to get
+    the collective in flight — observed into
+    ``tmx_collective_seconds{collective=...,host=...}``.  Zero-cost when
+    telemetry is disabled: no clock is read and no instrument allocated.
+    """
+    if not enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        get_registry().histogram(
+            "tmx_collective_seconds", collective=name, host=host_id(),
+            **labels,
+        ).observe(time.perf_counter() - t0)
+
+
+def device_wall_times(outputs: Any, t0: float) -> list[tuple[str, float]]:
+    """Per-device wall time (seconds since ``t0``, a ``perf_counter``
+    reading taken at launch) until each device's shard of a dispatched
+    computation is ready.
+
+    Picks the first leaf of ``outputs`` sharded over more than one device
+    and blocks its addressable shards in device-id order, stamping the
+    clock as each completes — a host-visible per-device completion
+    profile of the shard_map program (the straggler is the device whose
+    shard is ready last).  Returns ``[]`` when nothing is sharded or
+    shard introspection is unavailable, so call sites can gate on
+    ``telemetry.enabled()`` and fall through to a plain block.
+    """
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(outputs)
+    except Exception:
+        return []
+    for leaf in leaves:
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            continue
+        try:
+            shards = sorted(shards, key=lambda s: s.device.id)
+        except Exception:
+            continue
+        if len(shards) < 2:
+            continue
+        times: list[tuple[str, float]] = []
+        try:
+            for shard in shards:
+                shard.data.block_until_ready()
+                times.append(
+                    (str(shard.device.id), time.perf_counter() - t0)
+                )
+        except Exception:
+            return []
+        return times
+    return []
+
+
+def straggler_threshold(slowest: float) -> float:
+    """Skew above which a batch counts as straggling: the larger of an
+    absolute floor (``TMX_STRAGGLER_MIN_S``, default 0.05 s — CPU-sim
+    noise stays below it) and a fraction of the slowest device's wall
+    time (``TMX_STRAGGLER_REL``, default 0.25)."""
+    try:
+        floor = float(os.environ.get("TMX_STRAGGLER_MIN_S", "0.05"))
+    except ValueError:
+        floor = 0.05
+    try:
+        rel = float(os.environ.get("TMX_STRAGGLER_REL", "0.25"))
+    except ValueError:
+        rel = 0.25
+    return max(floor, rel * float(slowest))
+
+
+def record_device_times(times: list[tuple[str, float]], step: str = "",
+                        batch: Any = None) -> float:
+    """Feed per-device batch wall times into the labeled registry series
+    and return the straggler skew (max − min over devices).
+
+    Sets ``tmx_device_batch_seconds{device=,host=,step=}`` per device
+    (plus a ``_hist`` histogram so p50/p95 survive the last-write gauge)
+    and ``tmx_straggler_skew_seconds{host=,step=}``; bumps
+    ``tmx_stragglers_total`` when the skew clears
+    :func:`straggler_threshold`.  The *ledger* ``straggler`` event is the
+    caller's job (the engine appends it on its own thread from the batch
+    summary) — this function only touches the thread-safe registry, so
+    it is safe from executor worker threads.
+    """
+    if not enabled() or not times:
+        return 0.0
+    reg = get_registry()
+    h = host_id()
+    step = step or "unknown"
+    vals = [float(t) for _, t in times]
+    skew = max(vals) - min(vals)
+    for dev, t in times:
+        reg.gauge("tmx_device_batch_seconds", device=str(dev), host=h,
+                  step=step).set(float(t))
+        reg.histogram("tmx_device_batch_seconds_hist", device=str(dev),
+                      host=h, step=step).observe(float(t))
+    reg.gauge("tmx_straggler_skew_seconds", host=h, step=step).set(skew)
+    if skew > straggler_threshold(max(vals)):
+        reg.counter("tmx_stragglers_total", host=h, step=step).inc()
+    return skew
+
+
+# ---------------------------------------------------------------------------
 # span tracing
 
 _trace_bridge = threading.Event()
@@ -477,10 +638,26 @@ def _device_memory_bytes() -> int | None:
         return None
 
 
+def heartbeat_path(workflow_dir: Path, host: str | None = None) -> Path:
+    """Where this host's heartbeat lives: the legacy single-host name for
+    ``host0`` (so existing status/watcher consumers keep working), a
+    per-host ``heartbeat.<host>.json`` for every other fleet member."""
+    h = host or host_id()
+    if h == "host0":
+        return Path(workflow_dir) / HEARTBEAT_FILENAME
+    return Path(workflow_dir) / f"heartbeat.{h}.json"
+
+
+def snapshot_path(workflow_dir: Path, host: str | None = None) -> Path:
+    """This host's registry-snapshot file (``metrics.<host>.json``)."""
+    return Path(workflow_dir) / f"metrics.{host or host_id()}.json"
+
+
 def write_heartbeat(path: Path, period: float,
                     extra: dict | None = None) -> None:
     """Atomically write the heartbeat timestamp file."""
-    payload = {"ts": time.time(), "pid": os.getpid(), "period": period}
+    payload = {"ts": time.time(), "pid": os.getpid(), "period": period,
+               "host": host_id()}
     if extra:
         payload.update(extra)
     tmp = path.with_name(path.name + ".tmp")
@@ -496,10 +673,25 @@ def read_heartbeat(path: Path) -> dict | None:
 
 
 def heartbeat_age(path: Path, now: float | None = None) -> float | None:
+    """Seconds since the heartbeat was last refreshed.
+
+    Uses the fresher of the embedded writer timestamp and the file's
+    mtime: on a shared filesystem the mtime comes from one clock while
+    the embedded ``ts`` comes from the writing host's, so cross-host
+    clock skew can make either look stale on its own — a LIVE run must
+    never be flagged hung because two clocks disagree.  Both stale means
+    genuinely stale.  Clamped at zero (a writer clock ahead of the
+    reader's would otherwise go negative)."""
     hb = read_heartbeat(path)
     if hb is None or "ts" not in hb:
         return None
-    return (time.time() if now is None else now) - float(hb["ts"])
+    now = time.time() if now is None else now
+    age = now - float(hb["ts"])
+    try:
+        age = min(age, now - Path(path).stat().st_mtime)
+    except OSError:
+        pass
+    return max(0.0, age)
 
 
 class ResourceSampler:
@@ -535,6 +727,16 @@ class ResourceSampler:
         if dev is not None:
             sample["device_bytes_in_use"] = dev
             self.registry.gauge("tmx_device_bytes_in_use").set(dev)
+        elif "jax" in sys.modules:
+            # CPU-only hosts have a backend but no memory stats — say so
+            # once, not every sample period (log.reset_warned clears the
+            # suppression between tests)
+            warn_once(
+                logger, "resource-sampler-device-memory",
+                "resource sampler: device memory stats unavailable on "
+                "this host (CPU-only backend?) — tmx_device_bytes_in_use "
+                "will not be exported",
+            )
         if self.heartbeat_path is not None:
             try:
                 write_heartbeat(self.heartbeat_path, self.period, extra=sample)
@@ -691,6 +893,94 @@ def parse_prometheus(text: str) -> list[tuple[str, dict[str, str], float]]:
 
 
 # ---------------------------------------------------------------------------
+# multi-host aggregation: per-host snapshots → one fleet view
+
+
+def load_fleet_snapshots(run_root: Path) -> list[tuple[str, dict]]:
+    """Discover per-host registry snapshots under a run root.
+
+    Accepts the experiment-store root or its ``workflow/`` directory and
+    returns sorted ``(host, snapshot)`` pairs from every readable
+    ``metrics.<host>.json``.  The legacy single-host ``metrics.json``
+    maps to ``host0`` and is skipped when a per-host host0 snapshot also
+    exists (each host0 run writes both with identical content)."""
+    root = Path(run_root)
+    if (root / "workflow").is_dir():
+        root = root / "workflow"
+    hosts: dict[str, dict] = {}
+    legacy: dict | None = None
+    for path in sorted(root.glob("metrics*.json")):
+        stem = path.name[len("metrics"):-len(".json")].strip(".")
+        try:
+            snap = json.loads(path.read_text())
+        except (OSError, ValueError):
+            logger.warning("skipping unreadable snapshot %s", path)
+            continue
+        if not isinstance(snap, dict):
+            continue
+        if stem:
+            hosts[stem] = snap
+        else:
+            legacy = snap
+    if legacy is not None and "host0" not in hosts:
+        hosts["host0"] = legacy
+    return sorted(hosts.items())
+
+
+def merge_snapshots(
+    host_snapshots: Iterable[tuple[str, dict]]
+) -> dict:
+    """Merge per-host :meth:`MetricsRegistry.snapshot` dumps into one
+    fleet view.
+
+    Every series gains a ``host`` label (a host label the series already
+    carries wins, so device series recorded with explicit host labels
+    are not re-tagged).  Series that still collide on (kind, name,
+    labels) — the same host contributing twice — are folded: counters
+    and histogram count/sum add, gauges keep the last value, max keeps
+    the max, and histogram quantiles follow the larger sample.  The
+    result renders through :func:`render_prometheus` /
+    :func:`render_json` unchanged."""
+    out: dict[str, list] = {"counters": [], "gauges": [], "histograms": []}
+    index: dict[tuple, dict] = {}
+    for host, snap in host_snapshots:
+        for kind in ("counters", "gauges", "histograms"):
+            for entry in snap.get(kind, []) or []:
+                labels = dict(entry.get("labels") or {})
+                labels.setdefault("host", str(host))
+                key = (kind, entry.get("name"), _label_key(labels))
+                merged = index.get(key)
+                if merged is None:
+                    merged = dict(entry)
+                    merged["labels"] = labels
+                    index[key] = merged
+                    out[kind].append(merged)
+                elif kind == "counters":
+                    merged["value"] = (merged.get("value", 0.0)
+                                       + entry.get("value", 0.0))
+                elif kind == "gauges":
+                    merged["value"] = entry.get("value",
+                                                merged.get("value", 0.0))
+                else:
+                    if entry.get("count", 0) > merged.get("count", 0):
+                        for q in ("p50", "p95"):
+                            if q in entry:
+                                merged[q] = entry[q]
+                    merged["count"] = (merged.get("count", 0)
+                                       + entry.get("count", 0))
+                    merged["sum"] = round(
+                        merged.get("sum", 0.0) + entry.get("sum", 0.0), 6
+                    )
+                    merged["max"] = max(merged.get("max", 0.0),
+                                        entry.get("max", 0.0))
+    for kind in out:
+        out[kind].sort(
+            key=lambda e: (e.get("name", ""), sorted(e["labels"].items()))
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # ledger → metrics derivation (post-hoc inspection of any run, incl. seed-era)
 
 
@@ -699,36 +989,51 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
 
     Works on seed-era ledgers (``batch_done``/``step_done`` only) as well
     as telemetry-era ledgers carrying ``span`` events — old runs stay
-    inspectable with the same ``tmx metrics`` surface.
+    inspectable with the same ``tmx metrics`` surface.  Fleet-era events
+    carry a ``host`` field; those series gain a ``host`` label so
+    interleaved multi-host ledgers aggregate without collisions, and
+    exact-duplicate records (the same host's ledger read twice, or one
+    physical event copied into several per-host ledgers) are dropped.
     """
     reg = MetricsRegistry(enabled=True)
-    step_units: dict[str, dict[str, float]] = {}
+    step_units: dict[tuple[str, str], dict[str, float]] = {}
     occ_acc = [0.0, 0.0]  # running (sum, n) of per-batch slot occupancy
     # running (routed capacity, ladder ceiling) sums: per batch the slot
     # ratio cap/ceiling is the padded-work fraction kept, so the sums
     # reconstruct padded-FLOPs-avoided from the ledger alone (batches
     # predating the bucket_ceiling field simply don't contribute)
     pad_acc = [0.0, 0.0]
+    seen: set[tuple] = set()
     for ev in events:
         kind = ev.get("event")
         step = str(ev.get("step", "")) or "unknown"
+        host = str(ev.get("host", "")) if ev.get("host") else ""
+        if host:
+            # dedup only host-attributed events: seed-era ledgers have no
+            # host field and legitimately repeat (event, step) shapes
+            fp = (host, ev.get("ts"), kind, step, ev.get("batch"),
+                  ev.get("span"))
+            if fp in seen:
+                continue
+            seen.add(fp)
+        hl = {"host": host} if host else {}
         if kind == "run_started":
-            reg.counter("tmx_runs_total").inc()
+            reg.counter("tmx_runs_total", **hl).inc()
         elif kind == "batch_done":
-            reg.counter("tmx_batches_done_total", step=step).inc()
+            reg.counter("tmx_batches_done_total", step=step, **hl).inc()
             if "elapsed" in ev:
-                reg.histogram("tmx_batch_seconds", step=step).observe(
+                reg.histogram("tmx_batch_seconds", step=step, **hl).observe(
                     float(ev["elapsed"])
                 )
             attempts = int(ev.get("attempts", 1) or 1)
             if attempts > 1:
-                reg.counter("tmx_batch_retries_total", step=step).inc(
+                reg.counter("tmx_batch_retries_total", step=step, **hl).inc(
                     attempts - 1
                 )
             result = ev.get("result") or {}
             if isinstance(result, dict):
                 acc = step_units.setdefault(
-                    step, {"units": 0.0, "seconds": 0.0}
+                    (step, host), {"units": 0.0, "seconds": 0.0}
                 )
                 acc["seconds"] += float(ev.get("elapsed", 0.0) or 0.0)
                 for key in ("n_sites", "n_tiles"):
@@ -766,22 +1071,43 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                         reg.gauge(
                             "tmx_jterator_padded_flops_avoided_frac"
                         ).set(1.0 - pad_acc[0] / pad_acc[1])
+                # fleet-era batch summaries embed per-device wall times
+                # measured at block time, so ledger-derived metrics carry
+                # the same device series the live registry does
+                dev_times = result.get("device_wall_times")
+                if isinstance(dev_times, dict) and dev_times:
+                    for dev, secs in sorted(dev_times.items()):
+                        reg.gauge(
+                            "tmx_device_batch_seconds",
+                            device=str(dev), step=step, **hl,
+                        ).set(float(secs))
+                skew = result.get("straggler_skew_s")
+                if skew is not None:
+                    reg.gauge(
+                        "tmx_straggler_skew_seconds", step=step, **hl
+                    ).set(float(skew))
+        elif kind == "straggler":
+            reg.counter("tmx_stragglers_total", step=step, **hl).inc()
+            if "skew_s" in ev:
+                reg.gauge(
+                    "tmx_straggler_skew_seconds", step=step, **hl
+                ).set(float(ev["skew_s"]))
         elif kind == "batch_failed":
-            reg.counter("tmx_batches_failed_total", step=step).inc()
+            reg.counter("tmx_batches_failed_total", step=step, **hl).inc()
         elif kind in ("step_done", "step_partial"):
             if kind == "step_partial":
-                reg.counter("tmx_steps_partial_total", step=step).inc()
+                reg.counter("tmx_steps_partial_total", step=step, **hl).inc()
             else:
-                reg.counter("tmx_steps_done_total", step=step).inc()
+                reg.counter("tmx_steps_done_total", step=step, **hl).inc()
             if "elapsed" in ev:
-                reg.histogram("tmx_step_seconds", step=step).observe(
+                reg.histogram("tmx_step_seconds", step=step, **hl).observe(
                     float(ev["elapsed"])
                 )
             quarantined = ev.get("quarantined") or []
             if quarantined:
-                reg.counter("tmx_batches_quarantined_total", step=step).inc(
-                    len(quarantined)
-                )
+                reg.counter(
+                    "tmx_batches_quarantined_total", step=step, **hl
+                ).inc(len(quarantined))
             ps = ev.get("pipeline_stats")
             if isinstance(ps, dict):
                 reg.gauge("tmx_pipeline_depth", step=step).set(
@@ -797,20 +1123,21 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                         step=step, phase=phase,
                     ).set(vals.get("max_s", 0.0))
         elif kind == "step_failed":
-            reg.counter("tmx_steps_failed_total", step=step).inc()
+            reg.counter("tmx_steps_failed_total", step=step, **hl).inc()
         elif kind == "depth_clamped":
-            reg.counter("tmx_depth_clamps_total", step=step).inc()
+            reg.counter("tmx_depth_clamps_total", step=step, **hl).inc()
         elif kind == "backend_degraded":
-            reg.counter("tmx_backend_degradations_total").inc()
+            reg.counter("tmx_backend_degradations_total", **hl).inc()
         elif kind == "span":
             name = str(ev.get("span", "")) or "unknown"
             if "elapsed" in ev:
-                reg.histogram("tmx_span_seconds", span=name).observe(
+                reg.histogram("tmx_span_seconds", span=name, **hl).observe(
                     float(ev["elapsed"])
                 )
-    for step, acc in sorted(step_units.items()):
+    for (step, host), acc in sorted(step_units.items()):
         if acc["seconds"] > 0:
-            reg.gauge("tmx_step_units_per_sec", step=step).set(
+            hl = {"host": host} if host else {}
+            reg.gauge("tmx_step_units_per_sec", step=step, **hl).set(
                 acc["units"] / acc["seconds"]
             )
     return reg

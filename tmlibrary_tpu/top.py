@@ -1,0 +1,258 @@
+"""``tmx top`` — live fleet dashboard over a run's telemetry files.
+
+The operator console the future streaming service needs (ROADMAP item 1,
+acia-workflows' service-grade monitoring): one terminal view of a running
+(or finished) workflow assembled purely from the files every run already
+writes next to its ledger — per-host ``heartbeat*.json``, per-host
+``metrics.<host>.json`` registry snapshots, and the run ledger itself.
+
+Deliberately curses-free: a plain ANSI clear-and-repaint loop degrades to
+sensible output in CI logs and over ssh, and ``--once`` renders a single
+frame for tests.  Nothing here ever initializes a jax backend — the
+dashboard must be runnable from a watcher box that has no accelerator.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from pathlib import Path
+from typing import Any, TextIO
+
+from tmlibrary_tpu import telemetry
+
+#: per-device utilization bar width (characters)
+_BAR_WIDTH = 24
+
+
+def _workflow_dir(root: Path) -> Path:
+    root = Path(root)
+    return root / "workflow" if (root / "workflow").is_dir() else root
+
+
+def collect_fleet(root: Path) -> dict[str, Any]:
+    """Poll one run root into a render-ready fleet view dict.
+
+    Pure file reads (heartbeats, snapshots, ledger) — safe to call at any
+    repaint frequency against a live run."""
+    wf = _workflow_dir(root)
+    view: dict[str, Any] = {"root": str(root), "hosts": [], "merged": None,
+                            "status": {}, "degraded": None}
+    for hb_path in sorted(wf.glob("heartbeat*.json")):
+        hb = telemetry.read_heartbeat(hb_path)
+        if not hb or "ts" not in hb:
+            continue
+        age = telemetry.heartbeat_age(hb_path)
+        period = float(hb.get("period", 0) or 0)
+        view["hosts"].append({
+            "host": str(hb.get("host") or "host0"),
+            "age_s": age,
+            "period_s": period,
+            "stale": bool(period > 0 and age is not None
+                          and age > 2 * period),
+            "rss_bytes": hb.get("rss_bytes"),
+            "open_fds": hb.get("open_fds"),
+            "device_bytes_in_use": hb.get("device_bytes_in_use"),
+        })
+    view["hosts"].sort(key=lambda h: h["host"])
+    pairs = telemetry.load_fleet_snapshots(wf)
+    if pairs:
+        view["merged"] = telemetry.merge_snapshots(pairs)
+    ledger_path = wf / "ledger.jsonl"
+    if ledger_path.exists():
+        from tmlibrary_tpu.workflow.engine import RunLedger
+
+        ledger = RunLedger(ledger_path)
+        view["status"] = ledger.status()
+        view["degraded"] = ledger.degraded_backend()
+    return view
+
+
+def _gauges(merged: dict, name: str) -> list[dict]:
+    return [g for g in merged.get("gauges", []) if g.get("name") == name]
+
+
+def _counter_sum(merged: dict, name: str) -> float:
+    return sum(c.get("value", 0.0) for c in merged.get("counters", [])
+               if c.get("name") == name)
+
+
+def _bar(frac: float, width: int = _BAR_WIDTH) -> str:
+    frac = min(max(frac, 0.0), 1.0)
+    filled = int(round(frac * width))
+    return "█" * filled + "·" * (width - filled)
+
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def render_dashboard(view: dict, width: int = 80) -> str:
+    """One frame of the dashboard as plain text (no cursor control —
+    the caller owns screen clearing)."""
+    lines: list[str] = []
+    lines.append(f"tmx top — {view['root']}")
+    lines.append("=" * min(width, 72))
+
+    # ---- hosts: heartbeat health + sampled process resources
+    if view["hosts"]:
+        lines.append("hosts:")
+        for h in view["hosts"]:
+            age = f"{h['age_s']:.1f}s" if h["age_s"] is not None else "?"
+            flag = "  ** STALE — run appears hung **" if h["stale"] else ""
+            lines.append(
+                f"  ♥ {h['host']:<8} heartbeat {age} ago"
+                f" (period {h['period_s']:g}s)"
+                f"  rss {_fmt_bytes(h['rss_bytes'])}"
+                f"  fds {h['open_fds'] if h['open_fds'] is not None else '-'}"
+                f"  devmem {_fmt_bytes(h['device_bytes_in_use'])}{flag}"
+            )
+    else:
+        lines.append("hosts: no heartbeat files (run not started, or "
+                     "sampler disabled)")
+
+    # ---- step progress from the ledger
+    if view["status"]:
+        lines.append("steps:")
+        for name, entry in view["status"].items():
+            done = entry.get("batches_done", 0)
+            total = entry.get("n_batches")
+            state = entry.get("state", "?")
+            frac = done / total if total else 0.0
+            prog = f"{done}/{total}" if total else str(done)
+            lines.append(
+                f"  {name:<16} {state:<9} [{_bar(frac, 16)}] {prog} batches"
+            )
+
+    merged = view["merged"]
+    if merged:
+        # ---- throughput + pipeline depth
+        thr = _gauges(merged, "tmx_step_units_per_sec")
+        sites = _gauges(merged, "tmx_jterator_sites_per_sec")
+        for g in thr:
+            step = g["labels"].get("step", "?")
+            host = g["labels"].get("host", "")
+            tag = f" [{host}]" if host else ""
+            lines.append(
+                f"throughput: {step}{tag} {g.get('value', 0.0):.2f} units/s"
+            )
+        for g in sites:
+            host = g["labels"].get("host", "")
+            tag = f" [{host}]" if host else ""
+            lines.append(
+                f"throughput: jterator{tag} "
+                f"{g.get('value', 0.0):.2f} sites/s"
+            )
+        for g in _gauges(merged, "tmx_pipeline_inflight"):
+            host = g["labels"].get("host", "")
+            tag = f" [{host}]" if host else ""
+            lines.append(
+                f"pipeline: {g['labels'].get('step', '?')}{tag} "
+                f"{int(g.get('value', 0))} batch(es) in flight"
+            )
+        for g in _gauges(merged, "tmx_pipeline_depth"):
+            host = g["labels"].get("host", "")
+            tag = f" [{host}]" if host else ""
+            lines.append(
+                f"pipeline: {g['labels'].get('step', '?')}{tag} "
+                f"depth {int(g.get('value', 0))}"
+            )
+
+        # ---- bucket occupancy
+        occ = _gauges(merged, "tmx_jterator_slot_occupancy")
+        routed = _counter_sum(merged, "tmx_jterator_bucket_routed_total")
+        if occ:
+            val = occ[0].get("value", 0.0)
+            lines.append(
+                f"buckets: occupancy [{_bar(val, 16)}] {val * 100:.0f}%"
+                + (f"  routed {int(routed)}" if routed else "")
+            )
+
+        # ---- per-device utilization bars: each device's last batch wall
+        # time relative to the slowest device (1.0 == the straggler)
+        dev = _gauges(merged, "tmx_device_batch_seconds")
+        if dev:
+            slowest = max(g.get("value", 0.0) for g in dev) or 1.0
+            lines.append("devices (last batch wall time, relative to "
+                         "slowest):")
+            for g in sorted(dev, key=lambda g: (
+                    g["labels"].get("host", ""),
+                    # numeric device-id order when possible
+                    (g["labels"].get("device", "") or "").zfill(6))):
+                labels = g["labels"]
+                t = g.get("value", 0.0)
+                name = f"{labels.get('host', '')}/d{labels.get('device')}"
+                lines.append(
+                    f"  {name:<14} [{_bar(t / slowest)}] {t * 1e3:8.1f}ms"
+                )
+
+        # ---- straggler skew
+        for g in _gauges(merged, "tmx_straggler_skew_seconds"):
+            host = g["labels"].get("host", "")
+            tag = f" [{host}]" if host else ""
+            lines.append(
+                f"straggler skew{tag}: {g.get('value', 0.0) * 1e3:.1f}ms "
+                f"(step {g['labels'].get('step', '?')})"
+            )
+        n_straggle = _counter_sum(merged, "tmx_stragglers_total")
+        if n_straggle:
+            lines.append(f"stragglers flagged: {int(n_straggle)}")
+
+        coll = [h for h in merged.get("histograms", [])
+                if h.get("name") == "tmx_collective_seconds"]
+        for h in coll:
+            lines.append(
+                f"collective: {h['labels'].get('collective', '?'):<24} "
+                f"n={h.get('count', 0)} p50={h.get('p50', 0) * 1e3:.1f}ms "
+                f"p95={h.get('p95', 0) * 1e3:.1f}ms"
+            )
+    else:
+        lines.append("metrics: no snapshot yet (telemetry off, or first "
+                     "snapshot not written)")
+
+    # ---- breaker / degradation state
+    deg = view["degraded"]
+    if deg:
+        lines.append(
+            f"DEGRADED: backend fell back to {deg.get('backend')} at "
+            f"'{deg.get('where')}' after {deg.get('failures')} failed "
+            "device probes"
+        )
+    return "\n".join(lines) + "\n"
+
+
+def run_top(root: Path, interval: float = 2.0, once: bool = False,
+            iterations: int | None = None,
+            out: TextIO | None = None) -> int:
+    """Dashboard loop.  ``once`` renders a single frame (tests/CI);
+    ``iterations`` bounds the loop for tests; Ctrl-C exits cleanly."""
+    out = out or sys.stdout
+    root = Path(root)
+    if not _workflow_dir(root).is_dir():
+        print(f"error: no workflow directory under {root}",
+              file=sys.stderr)
+        return 1
+    n = 0
+    try:
+        while True:
+            frame = render_dashboard(collect_fleet(root))
+            if once or iterations is not None:
+                out.write(frame)
+            else:
+                # ANSI clear + home, then the frame — a repaint, not a
+                # scroll, but still plain text when piped to a file
+                out.write("\x1b[2J\x1b[H" + frame)
+            out.flush()
+            n += 1
+            if once or (iterations is not None and n >= iterations):
+                return 0
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0

@@ -201,9 +201,14 @@ class RunLedger:
     trailing line, which :meth:`events` skips with a warning instead of
     poisoning every later ``resume``/``status`` call."""
 
-    def __init__(self, path: Path, fsync: bool = False):
+    def __init__(self, path: Path, fsync: bool = False,
+                 host: str | None = None):
         self.path = Path(path)
         self.fsync = fsync
+        #: fleet attribution: when set, every appended event carries a
+        #: ``host`` field so interleaved multi-host ledgers stay
+        #: separable in ``registry_from_ledger`` / ``tmx metrics``
+        self.host = host
         #: (mtime_ns, size) → parsed events; ``status()`` and
         #: ``completed_batches()`` poll :meth:`events` repeatedly and the
         #: file only grows via :meth:`append`, so re-parsing the whole
@@ -212,6 +217,8 @@ class RunLedger:
 
     def append(self, **event) -> None:
         event["ts"] = time.time()
+        if self.host is not None:
+            event.setdefault("host", self.host)
         line = json.dumps(event)
         spec = faults.match("ledger_append", step=event.get("step"),
                             event=event.get("event"))
@@ -380,8 +387,14 @@ class Workflow:
         description.validate()
         self.store = store
         self.description = description
-        self.ledger = RunLedger(store.workflow_dir / "ledger.jsonl",
-                                fsync=cfg.ledger_fsync)
+        self.ledger = RunLedger(
+            store.workflow_dir / "ledger.jsonl",
+            fsync=cfg.ledger_fsync,
+            # single-host runs keep host-free events (seed-compatible
+            # ledgers, bit-identical telemetry-off behaviour); fleet runs
+            # attribute every event to this host
+            host=(telemetry.host_id() if telemetry.fleet_active() else None),
+        )
         self.resilience = (resilience if resilience is not None
                            else ResilienceConfig.from_library_config())
         #: explicit in-flight depth for the pipelined executor; None means
@@ -456,10 +469,19 @@ class Workflow:
         if not telemetry.enabled():
             return
         try:
-            path = self.store.workflow_dir / "metrics.json"
-            path.write_text(
-                telemetry.render_json(telemetry.get_registry().snapshot())
+            rendered = telemetry.render_json(
+                telemetry.get_registry().snapshot()
             )
+            # per-host snapshot always (fleet merge input); the legacy
+            # single-file name stays for host0 so existing tooling and
+            # single-host runs see no change
+            telemetry.snapshot_path(self.store.workflow_dir).write_text(
+                rendered
+            )
+            if telemetry.host_id() == "host0":
+                (self.store.workflow_dir / "metrics.json").write_text(
+                    rendered
+                )
         except OSError:
             logger.debug("metrics snapshot write failed", exc_info=True)
         try:
@@ -486,9 +508,32 @@ class Workflow:
             return None
         return telemetry.ResourceSampler(
             period,
-            heartbeat_path=(self.store.workflow_dir
-                            / telemetry.HEARTBEAT_FILENAME),
+            heartbeat_path=telemetry.heartbeat_path(self.store.workflow_dir),
         ).start()
+
+    def _note_straggler(self, step_name: str, batch_index, result) -> None:
+        """Emit a ``straggler`` ledger event when a batch summary carries
+        device wall times whose max−min skew crosses the threshold.
+
+        Runs on the engine thread right after the ``batch_done`` append —
+        executor worker threads must never touch the ledger, so the device
+        timings ride the batch result dict instead of being appended from
+        ``block_batch``.  The live-registry counter is already bumped by
+        :func:`telemetry.record_device_times` at block time; this only
+        records the durable evidence."""
+        if not telemetry.enabled() or not isinstance(result, dict):
+            return
+        times = result.get("device_wall_times")
+        skew = result.get("straggler_skew_s")
+        if not times or skew is None:
+            return
+        slowest = max(float(t) for t in times.values())
+        if float(skew) <= telemetry.straggler_threshold(slowest):
+            return
+        self.ledger.append(
+            step=step_name, event="straggler", batch=batch_index,
+            skew_s=float(skew), device_wall_times=times,
+        )
 
     # ---------------------------------------------------------- batch level
     def _exec_batch(self, step, batch: dict) -> dict:
@@ -659,6 +704,8 @@ class Workflow:
                                            elapsed=b_elapsed,
                                            attempts=outcome.attempts,
                                            result=outcome.value)
+                        self._note_straggler(sd.name, batch["index"],
+                                             outcome.value)
                         metrics.counter("tmx_batches_done_total",
                                         step=sd.name).inc()
                         metrics.histogram("tmx_batch_seconds",

@@ -54,7 +54,7 @@ import logging
 import time
 from typing import Any, Callable, Iterable, Iterator
 
-from tmlibrary_tpu import profiling
+from tmlibrary_tpu import profiling, telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -278,8 +278,18 @@ class PipelinedExecutor:
                 stats.batch_done()
             return result
 
+        def note_inflight() -> None:
+            # live window depth for `tmx top` (gauge only — no ledger
+            # traffic; this runs on the engine thread either way)
+            if telemetry.enabled():
+                telemetry.get_registry().gauge(
+                    "tmx_pipeline_inflight",
+                    step=getattr(step, "name", "") or "unknown",
+                ).set(len(window))
+
         def pop_one() -> tuple[dict, dict]:
             batch, fut = window.popleft()
+            note_inflight()
             result = fut.result()
             self._flush_spans(batch)
             return batch, result
@@ -322,6 +332,7 @@ class PipelinedExecutor:
                 window.append((batch, persister.submit(
                     persist_task, batch if eff is None else eff, ctx, bidx
                 )))
+                note_inflight()
                 while len(window) > self.depth:
                     yield pop_one()
             while window:

@@ -443,8 +443,12 @@ class ImageAnalysisRunner(Step):
         if batch["args"].get("layout", "sites") == "spatial":
             return batch, ("spatial", self._launch_spatial(batch, prefetched))
         cap = self._route_capacity(batch)
+        # meta travels alongside the device arrays so block_batch can stamp
+        # per-device completion times against the true dispatch instant
+        meta = {"t0": time.perf_counter(), "index": batch.get("index")}
         return batch, (
-            "sites", (self._launch(batch, prefetched, capacity=cap), cap)
+            "sites",
+            (self._launch(batch, prefetched, capacity=cap), cap, meta),
         )
 
     def block_batch(self, ctx) -> None:
@@ -454,6 +458,14 @@ class ImageAnalysisRunner(Step):
 
         kind, payload = ctx
         if kind == "sites":
+            meta = payload[2] if len(payload) > 2 else None
+            if meta is not None and telemetry.enabled():
+                times = telemetry.device_wall_times(payload[0], meta["t0"])
+                if len(times) > 1:
+                    meta["device_times"] = times
+                    meta["skew"] = telemetry.record_device_times(
+                        times, step=self.name, batch=meta.get("index")
+                    )
             # SiteResult is a registered pytree: block on all leaves
             jax.block_until_ready(payload[0])
             return
@@ -468,8 +480,18 @@ class ImageAnalysisRunner(Step):
         kind, payload = ctx
         if kind == "spatial":
             return self._persist_spatial(batch, payload)
-        result, cap = payload
-        return self._persist(batch, result, capacity=cap)
+        result, cap = payload[0], payload[1]
+        meta = payload[2] if len(payload) > 2 else None
+        out = self._persist(batch, result, capacity=cap)
+        if meta and meta.get("device_times"):
+            # ride the batch summary so the ledger's batch_done record (and
+            # registry_from_ledger) carry device provenance; the ledger
+            # append itself stays on the engine thread
+            out["device_wall_times"] = {
+                d: round(float(t), 6) for d, t in meta["device_times"]
+            }
+            out["straggler_skew_s"] = round(float(meta.get("skew", 0.0)), 6)
+        return out
 
     # ------------------------------------------------------------ spatial run
     def _stitched_channel(
