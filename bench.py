@@ -75,6 +75,18 @@ def emit_record(record: dict) -> None:
     except Exception:
         pass  # telemetry must never break the stdout contract
     try:
+        # embed a compact QC summary (worst focus, NaN column count) so
+        # `tmx perf history` can correlate a throughput shift with a
+        # data-quality shift in the same record
+        if "qc" not in record:
+            from tmlibrary_tpu import qc as _qc
+
+            qc_summary = _qc.record_summary()
+            if qc_summary:
+                record["qc"] = qc_summary
+    except Exception:
+        pass  # QC is observability, same contract
+    try:
         # append-only history for the regression sentinel
         # (scripts/bench_regression.py, `tmx perf history`).  Parent-only:
         # the --child process prints into a captured pipe and the parent

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""CI artifact: run a tiny synthetic workflow with QC on and judge drift.
+
+    python scripts/ci_qc_smoke.py OUTDIR [WORKDIR]
+    python scripts/ci_qc_smoke.py --write-baseline PATH [WORKDIR]
+
+Drives the REAL surface end to end — ``tmx workflow submit --qc`` on a
+one-well synthetic experiment (same seed-11 source as
+ci_metrics_snapshot.py), then asserts ``workflow/qc.json`` parses and
+runs the ``tmx qc`` drift sentinel against the committed CPU baseline
+(``tuning/QC_CPU_BASELINE.json``) expecting exit 0.  The qc.json profile
+and the rendered ``tmx qc`` frame land in OUTDIR for artifact upload.
+
+``--write-baseline`` reruns the same workflow and saves its profile as
+the new committed baseline instead of judging drift (use after a change
+that legitimately shifts the synthetic QC profile).
+"""
+import contextlib
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import yaml  # noqa: E402
+
+from ci_metrics_snapshot import PIPE_YAML, synth_source  # noqa: E402
+
+#: generous — CI machines differ in BLAS/float details, and the seeded
+#: synthetic profile only needs to catch gross shifts (a broken focus
+#: metric, new NaN columns), not per-ulp drift
+THRESHOLD = 0.5
+
+# the metrics-snapshot pipeline plus a measurement stage, so the feature
+# sketches (observe_batch measurements path) are exercised end to end
+QC_PIPE_YAML = json.loads(json.dumps(PIPE_YAML))
+QC_PIPE_YAML["description"] = "ci qc smoke — smooth, segment, measure"
+QC_PIPE_YAML["pipeline"].append({
+    "handles": {
+        "module": "measure_intensity",
+        "input": [
+            {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+            {"name": "intensity_image", "type": "IntensityImage",
+             "key": "DAPI"},
+        ],
+        "output": [
+            {"name": "measurements", "type": "Measurement",
+             "objects": "nuclei", "channel": "DAPI"},
+        ],
+    }
+})
+
+
+def run(argv, capture: bool = False) -> "tuple[int, str]":
+    from tmlibrary_tpu.cli import main
+
+    argv = [str(a) for a in argv]
+    print("  $ tmx " + " ".join(argv))
+    if capture:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(argv)
+        sys.stdout.write(buf.getvalue())
+        return rc, buf.getvalue()
+    return main(argv), ""
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    baseline_out = None
+    if argv and argv[0] == "--write-baseline":
+        if len(argv) < 2:
+            raise SystemExit(__doc__)
+        baseline_out = Path(argv[1])
+        argv = argv[2:]
+        outdir = None
+    else:
+        if not argv:
+            raise SystemExit(__doc__)
+        outdir = Path(argv[0])
+        outdir.mkdir(parents=True, exist_ok=True)
+        argv = argv[1:]
+    work = Path(argv[0]) if argv else Path(
+        tempfile.mkdtemp(prefix="tmx-ci-qc-")
+    )
+    work.mkdir(parents=True, exist_ok=True)
+    src = work / "microscope"
+    src.mkdir(exist_ok=True)
+    root = work / "experiment"
+    synth_source(src)
+
+    run(["create", "--root", root, "--name", "ci_qc"])
+    pipe = work / "nuclei.pipe.yaml"
+    pipe.write_text(yaml.safe_dump(QC_PIPE_YAML))
+    from tmlibrary_tpu.workflow.engine import WorkflowDescription
+
+    desc = work / "workflow.yaml"
+    WorkflowDescription.canonical({
+        "metaconfig": {"source_dir": str(src)},
+        "imextract": {},
+        "corilla": {"chunk_size": 8, "n_devices": 1},
+        "jterator": {"pipe": str(pipe), "batch_size": 4, "max_objects": 64,
+                     "n_devices": 1},
+    }).save(desc)
+    run(["workflow", "submit", "--root", root, "--description", desc,
+         "--qc", "--pipeline-depth", "4"])
+
+    qc_path = root / "workflow" / "qc.json"
+    profile = json.loads(qc_path.read_text())
+    if not profile.get("steps"):
+        raise SystemExit(f"{qc_path} has no per-step QC evidence")
+    if not profile.get("channels"):
+        raise SystemExit(f"{qc_path} has no per-channel image stats")
+    print(f"== {qc_path} parses: steps={sorted(profile['steps'])} "
+          f"channels={sorted(profile['channels'])}")
+
+    if baseline_out is not None:
+        baseline_out.parent.mkdir(parents=True, exist_ok=True)
+        baseline_out.write_text(json.dumps(profile, indent=2,
+                                           sort_keys=True) + "\n")
+        print(f"== wrote baseline {baseline_out}")
+        return
+
+    shutil.copyfile(qc_path, outdir / "qc.json")
+    baseline = Path(__file__).resolve().parent.parent / "tuning" / (
+        "QC_CPU_BASELINE.json"
+    )
+    rc, frame = run(["qc", "--root", root, "--reference", baseline,
+                     "--threshold", THRESHOLD], capture=True)
+    (outdir / "qc_frame.txt").write_text(frame)
+    if rc != 0:
+        raise SystemExit(
+            f"tmx qc exited {rc} vs {baseline} — drift in the seeded "
+            "synthetic QC profile (recapture with --write-baseline if "
+            "the shift is intended)"
+        )
+    print(f"== drift sentinel ok (exit 0) — artifacts in {outdir}")
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,31 @@ def top_section() -> list[str]:
     return out
 
 
+def qc_section() -> list[str]:
+    from tmlibrary_tpu import qc
+
+    out = ["## Quality control", "",
+           (inspect.getdoc(qc) or "").split("\n")[0],
+           "",
+           "Collected when `tmx workflow submit --qc` (or `TMX_QC=1` / "
+           "`TM_QC=1`) is set; reported via `tmx qc --root DIR "
+           "[--reference PATH] [--threshold F] [--stale-hours H] "
+           "[--worst N] [--json]` with the drift-sentinel exit codes "
+           "0 ok / 1 drift / 2 stale / 3 no reference.",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for name in sorted(n for n in dir(qc) if not n.startswith("_")):
+        obj = getattr(qc, name)
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "") != qc.__name__:
+            continue
+        doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append(f"| `qc.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def perf_section() -> list[str]:
     from tmlibrary_tpu import perf
 
@@ -172,6 +197,7 @@ def main() -> None:
         *ops_section(),
         *telemetry_section(),
         *top_section(),
+        *qc_section(),
         *perf_section(),
     ]
     # optional output override so a freshness check can generate into a

@@ -43,3 +43,17 @@ def _reset_warn_once():
     tm_log.reset_warned()
     yield
     tm_log.reset_warned()
+
+
+@pytest.fixture(autouse=True)
+def _reset_qc():
+    """The QC session singleton and its enable override are
+    process-global; leak state and one test's sketches/flags bleed into
+    another's profile assertions."""
+    from tmlibrary_tpu import qc
+
+    qc.set_enabled(None)
+    qc.reset_session()
+    yield
+    qc.set_enabled(None)
+    qc.reset_session()

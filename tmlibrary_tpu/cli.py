@@ -180,6 +180,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="repaint period in seconds (default 2.0)")
     p_top.add_argument("--once", action="store_true",
                        help="render a single frame and exit (tests/CI)")
+    p_top.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the fleet view as JSON (implies --once) "
+                            "so CI and tpu_watch can assert on dashboard "
+                            "state without screen-scraping")
 
     p_trace = sub.add_parser(
         "trace",
@@ -228,6 +232,32 @@ def build_parser() -> argparse.ArgumentParser:
                               "or 72)")
     p_phist.add_argument("--tail", type=int, default=10,
                          help="history lines to print (default 10)")
+
+    p_qc = sub.add_parser(
+        "qc",
+        help="data-quality report for a run (per-step table, worst-focus "
+             "sites, flagged sites) + drift verdict vs a reference "
+             "profile; exit codes: 0 ok, 1 drift, 2 stale reference, "
+             "3 no reference",
+    )
+    _add_common(p_qc)
+    p_qc.add_argument("--json", action="store_true", dest="as_json",
+                      help="emit the QC report + verdict as JSON")
+    p_qc.add_argument("--worst", type=int, default=5, metavar="N",
+                      help="worst-focus sites to list (default 5)")
+    p_qc.add_argument("--reference", default=None, metavar="PATH",
+                      help="reference qc.json profile for the drift "
+                           "sentinel (default: TMX_QC_BASELINE env, then "
+                           "tuning/QC_BASELINE.json if present)")
+    p_qc.add_argument("--threshold", type=float, default=0.25,
+                      help="drift threshold: allowed median shift as a "
+                           "fraction of the reference spread "
+                           "(default 0.25)")
+    p_qc.add_argument("--stale-hours", type=float, default=None,
+                      dest="stale_hours",
+                      help="reference staleness budget in hours (default "
+                           "TMX_QC_STALE_HOURS, 0 = no staleness check — "
+                           "committed baselines age by design)")
 
     p_wf = sub.add_parser("workflow", help="full workflow orchestration")
     wf_sub = p_wf.add_subparsers(dest="verb", required=True)
@@ -300,6 +330,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="resource sampler period (RSS/fds/device-memory gauges + "
              "heartbeat file; default from TM_RESOURCE_SAMPLE_PERIOD, "
              "0 disables)",
+    )
+    shared.add_argument(
+        "--qc", action=argparse.BooleanOptionalAction, default=None,
+        help="collect data-quality evidence for this run (qc.py): fused "
+             "on-device image stats, NaN/outlier guards, feature "
+             "sketches -> workflow/qc.json + qc_* ledger events, "
+             "inspected with `tmx qc` (default: TMX_QC / TM_QC config, "
+             "off; --no-qc forces off)",
     )
     p_submit = wf_sub.add_parser("submit", help="run the workflow",
                                  parents=[shared])
@@ -600,6 +638,17 @@ def cmd_workflow(args) -> int:
                 if buckets.get("escalations"):
                     line += f" escalations {buckets['escalations']}"
                 print(line)
+            qc_entry = entry.get("qc")
+            if qc_entry:
+                line = (f"{'':12s} qc: flagged "
+                        f"{qc_entry.get('flagged', 0)} site(s)")
+                if qc_entry.get("nan_columns"):
+                    line += f" nan columns {qc_entry['nan_columns']}"
+                if qc_entry.get("worst_focus") is not None:
+                    line += f" worst focus {qc_entry['worst_focus']:.4g}"
+                if qc_entry.get("budget_exceeded"):
+                    line += " ** OVER FLAG BUDGET — inspect with tmx qc **"
+                print(line)
         degraded = RunLedger(store.workflow_dir / "ledger.jsonl").degraded_backend()
         if degraded:
             print(f"backend degraded to {degraded.get('backend')} "
@@ -711,6 +760,15 @@ def cmd_workflow(args) -> int:
             _os.environ.pop("TMX_OBJECT_BUCKETS", None)
         else:
             _os.environ["TMX_OBJECT_BUCKETS"] = args.object_buckets
+    if getattr(args, "qc", None) is not None:
+        import os as _os
+
+        # env (not a plumbed parameter), same pattern as
+        # --reduction-strategy: the QC gate is part of the compiled-
+        # program cache key (jterator.pipeline.cached_batch_fn) and is
+        # re-read at every build site, so the request must outlive this
+        # function; an explicit --no-qc writes "0" to beat the config
+        _os.environ["TMX_QC"] = "1" if args.qc else "0"
     if args.sample_resources is not None:
         from tmlibrary_tpu.config import cfg as _cfg
 
@@ -1249,11 +1307,12 @@ def cmd_top(args) -> int:
     """Live fleet dashboard (``tmx top``): poll heartbeats + per-host
     metrics snapshots under the run root and repaint a terminal view —
     throughput, pipeline depth, bucket occupancy, per-device utilization,
-    straggler skew, degradation state."""
+    straggler skew, QC state, degradation state."""
     from tmlibrary_tpu import top
 
     return top.run_top(Path(args.root), interval=args.interval,
-                       once=args.once)
+                       once=args.once,
+                       as_json=getattr(args, "as_json", False))
 
 
 def cmd_trace(args) -> int:
@@ -1281,6 +1340,123 @@ def cmd_trace(args) -> int:
                                               key=lambda kv: -kv[1]))
         print(f"\nphase totals (critical resource): {phases}")
     return 0
+
+
+def cmd_qc(args) -> int:
+    """Data-quality report for a run: per-step table, per-channel image
+    stats, numerics guards, worst-focus sites, flagged sites — plus the
+    drift-sentinel verdict vs a reference profile.
+
+    Exit codes (pinned, same discipline as scripts/bench_regression.py):
+    0 ok · 1 drift · 2 stale reference · 3 no reference."""
+    from tmlibrary_tpu import qc as qc_mod
+
+    root = Path(args.root)
+    wf = _open_store(args).workflow_dir
+    pairs = qc_mod.load_run_profiles(wf)
+    if pairs:
+        profile = (qc_mod.merge_profiles(pairs) if len(pairs) > 1
+                   else pairs[0][1])
+        source = (f"qc.json x{len(pairs)} host(s)" if len(pairs) > 1
+                  else "qc.json")
+    else:
+        events = RunLedger(wf / "ledger.jsonl").events()
+        profile = qc_mod.qc_from_ledger(events) if events else {}
+        source = "ledger"
+    if not (profile.get("steps") or profile.get("channels")):
+        print("no QC evidence for this run — submit with --qc (or "
+              "TMX_QC=1) to collect it", file=sys.stderr)
+        return 1
+
+    ref_path = args.reference or os.environ.get("TMX_QC_BASELINE")
+    if not ref_path and Path("tuning/QC_BASELINE.json").exists():
+        ref_path = "tuning/QC_BASELINE.json"
+    reference = qc_mod.load_profile(Path(ref_path)) if ref_path else None
+    verdict = qc_mod.compare_profiles(
+        profile, reference, threshold=args.threshold,
+        stale_hours=args.stale_hours,
+    )
+
+    if getattr(args, "as_json", False):
+        print(json.dumps({"root": str(root), "source": source,
+                          "profile": profile, "reference": ref_path,
+                          "verdict": verdict},
+                         indent=2, default=float))
+        return verdict["exit_code"]
+
+    print(f"tmx qc — {root}  (source: {source})")
+    steps = profile.get("steps") or {}
+    if steps:
+        print(f"  {'step':<16} {'batches':>7} {'sites':>7} {'flagged':>7}")
+        for name, e in sorted(steps.items()):
+            print(f"  {name:<16} {e.get('batches', 0):>7} "
+                  f"{e.get('sites', 0):>7} {e.get('flagged', 0):>7}")
+    channels = profile.get("channels") or {}
+    if channels:
+        print("channels:")
+        for ch, metrics in sorted(channels.items()):
+            foc = metrics.get("focus_tenengrad") or {}
+            sat = metrics.get("saturation_frac") or {}
+            bg = metrics.get("background") or {}
+            bits = [f"  {ch:<12}"]
+            if foc.get("min") is not None:
+                bits.append(f"focus min {foc['min']:.4g}")
+            if sat.get("max") is not None:
+                bits.append(f"saturation max {sat['max']:.2%}")
+            if bg.get("mean") is not None:
+                bits.append(f"background {bg['mean']:.1f}")
+            print("  ".join(bits))
+    guards = profile.get("guards") or {}
+    nan_cols = guards.get("nan_columns") or []
+    line = (f"guards: nan columns {len(nan_cols)}  nan/inf values "
+            f"{int(guards.get('nan_values') or 0) + int(guards.get('inf_values') or 0)}"
+            f"  count z max {float(guards.get('count_z_max') or 0.0):.2f}")
+    if guards.get("capacity_saturated_batches"):
+        line += (f"  capacity-saturated batches "
+                 f"{guards['capacity_saturated_batches']}")
+    print(line)
+    if nan_cols:
+        print(f"  non-finite feature columns: {', '.join(nan_cols[:8])}"
+              + (" ..." if len(nan_cols) > 8 else ""))
+    worst = (profile.get("worst_sites") or [])[:max(args.worst, 0)]
+    if worst:
+        print(f"worst {len(worst)} site(s) by focus:")
+        for w in worst:
+            print(f"  site {w.get('site', '?'):>5}  "
+                  f"{str(w.get('channel', '?')):<12} "
+                  f"focus {w.get('focus', 0.0):.4g}")
+    flagged_total = int(profile.get("flagged_total") or 0)
+    if flagged_total:
+        print(f"flagged: {flagged_total} site(s)")
+        for f in (profile.get("flagged") or [])[:max(args.worst, 0)]:
+            bits = [f"  site {f.get('site', '?'):>5}",
+                    str(f.get('reason', '?'))]
+            if f.get("channel"):
+                bits.append(f"[{f['channel']}]")
+            if f.get("value") is not None:
+                bits.append(f"value {f['value']:.4g}")
+            if f.get("z") is not None:
+                bits.append(f"z {f['z']:+.1f}")
+            print("  ".join(bits))
+
+    line = f"drift verdict: {verdict['status']} (exit {verdict['exit_code']})"
+    if reference is not None:
+        line += f"  vs {ref_path}  checked {verdict.get('checked', 0)}"
+    if verdict.get("age_hours") is not None:
+        line += f"  reference age {verdict['age_hours']:.1f}h"
+    print(line)
+    for d in verdict.get("drifted", [])[:10]:
+        if d.get("kind") == "median_shift":
+            print(f"  DRIFT {d['feature']}: p50 "
+                  f"{d['reference_p50']:.4g} -> {d['current_p50']:.4g} "
+                  f"(|Δ| {d['delta']:.4g} > allowed {d['allowed']:.4g})")
+        elif d.get("kind") == "new_nan":
+            print(f"  DRIFT {d['feature']}: {d['current_nan']} non-finite "
+                  "value(s) not present in the reference")
+        elif d.get("kind") == "saturation":
+            print(f"  DRIFT channel {d['channel']}: saturation max "
+                  f"{d['reference_max']:.2%} -> {d['current_max']:.2%}")
+    return verdict["exit_code"]
 
 
 def _snapshot_gauge(snapshot: dict, name: str) -> "float | None":
@@ -1444,6 +1620,12 @@ def _perf_history(args, perf, tuning) -> int:
             bits.append("sweep")
         if rec.get("error"):
             bits.append("ERROR")
+        qc_rec = rec.get("qc")
+        if isinstance(qc_rec, dict):
+            if qc_rec.get("worst_focus") is not None:
+                bits.append(f"qc_focus={qc_rec['worst_focus']:.4g}")
+            if qc_rec.get("nan_columns"):
+                bits.append(f"qc_nan_cols={qc_rec['nan_columns']}")
         print("  " + "  ".join(bits) + f"  {rec.get('metric')}")
     stale_hours = getattr(args, "stale_hours", None)
     verdict = perf.compare_history(
@@ -1506,6 +1688,8 @@ def main(argv=None) -> int:
             return cmd_top(args)
         if args.command == "trace":
             return cmd_trace(args)
+        if args.command == "qc":
+            return cmd_qc(args)
         if args.command == "perf":
             return cmd_perf(args)
         return cmd_step(args)

@@ -160,6 +160,20 @@ class LibraryConfig:
             _setting("resource_sample_period", "5")
         )
     )
+    # ------------------------------------------------------- data quality
+    #: QC subsystem gate (qc.py): fused on-device image stats, numerics
+    #: guards, feature sketches.  Off by default; the TMX_QC env var
+    #: (set by `tmx workflow submit --qc`) beats this setting because
+    #: the gate is part of the compiled-program cache key
+    qc: bool = dataclasses.field(
+        default_factory=lambda: _setting("qc", "0").lower()
+        in ("1", "true", "yes")
+    )
+    #: fraction of a step's planned sites QC may flag before the engine
+    #: logs a qc_budget_exceeded ledger event (warn-only)
+    qc_flag_budget: float = dataclasses.field(
+        default_factory=lambda: float(_setting("qc_flag_budget", "0.5"))
+    )
 
     def experiment_location(self, experiment_name: str) -> Path:
         return Path(self.storage_home) / "experiments" / experiment_name

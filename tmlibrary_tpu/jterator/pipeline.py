@@ -93,17 +93,22 @@ def cached_batch_fn(
     window: "tuple[int, int, int, int] | None" = None,
     donate: "bool | None" = None,
     reduction_strategy: "str | None" = None,
+    qc: "bool | None" = None,
 ) -> Callable:
     """Memoized :meth:`ImageAnalysisPipeline.build_batch_fn` — same
     compiled program for the same (description, cap, window, backend,
-    donation, reduction-strategy request).  ``donate=None`` resolves the
-    :func:`donation_enabled` config default; ``reduction_strategy=None``
-    resolves the live request chain (env/config/tuned verdict) so a CLI
-    ``--reduction-strategy`` run never reuses a program compiled for a
-    different strategy."""
+    donation, reduction-strategy request, QC gate).  ``donate=None``
+    resolves the :func:`donation_enabled` config default;
+    ``reduction_strategy=None`` resolves the live request chain
+    (env/config/tuned verdict) so a CLI ``--reduction-strategy`` run
+    never reuses a program compiled for a different strategy;
+    ``qc=None`` resolves :func:`tmlibrary_tpu.qc.enabled` — the gate is
+    part of the cache key because a QC-on program returns
+    ``(SiteResult, qc_stats)`` instead of a bare ``SiteResult``."""
     import os
 
     from tmlibrary_tpu.ops import reduction
+    from tmlibrary_tpu import qc as qc_mod
 
     donate = donation_enabled() if donate is None else bool(donate)
     requested = (
@@ -111,6 +116,7 @@ def cached_batch_fn(
         if reduction_strategy not in (None, "auto")
         else reduction.requested_reduction_strategy()
     )
+    qc = qc_mod.enabled() if qc is None else bool(qc)
     key = (
         _description_cache_key(description),
         max_objects,
@@ -118,6 +124,7 @@ def cached_batch_fn(
         jax.default_backend(),
         donate,
         requested,
+        qc,
         os.environ.get("TMX_PALLAS"),
         os.environ.get("TMX_NATIVE"),
         os.environ.get("TMX_SITE_STATS"),
@@ -127,7 +134,8 @@ def cached_batch_fn(
     if fn is None:
         pipe = ImageAnalysisPipeline(description, max_objects=max_objects)
         fn = pipe.build_batch_fn(
-            window=window, donate=donate, reduction_strategy=requested
+            window=window, donate=donate, reduction_strategy=requested,
+            qc=qc,
         )
         while len(_BATCH_FN_CACHE) >= _BATCH_FN_CACHE_MAX:
             _BATCH_FN_CACHE.pop(next(iter(_BATCH_FN_CACHE)))
@@ -147,8 +155,15 @@ def cached_batch_fn(
 
     wrapped = _WRAPPED_FN_CACHE.get(key)
     if wrapped is None or wrapped.__wrapped__ is not fn:
+        # the digest names the perf-attribution program, which keys the
+        # AOT executable cache in perf._RUNTIME together with (step,
+        # capacity, strategy) — the QC gate MUST join it, because QC-on
+        # and QC-off programs share description/window/shapes but return
+        # different pytrees, and a stale executable from the other gate
+        # would silently drop (or fabricate) the qc_stats leaf
         digest = hashlib.sha1(
             repr(key[0]).encode() + repr(window).encode()
+            + (b"+qc" if qc else b"")
         ).hexdigest()[:8]
         wrapped = perf.instrument_batch_fn(
             fn,
@@ -332,6 +347,7 @@ class ImageAnalysisPipeline:
         jit: bool = True,
         donate: bool = False,
         reduction_strategy: str | None = None,
+        qc: bool = False,
     ) -> Callable:
         """jit(vmap(preprocess ∘ site_fn)) over the site-batch axis.
 
@@ -353,6 +369,14 @@ class ImageAnalysisPipeline:
         ``"auto"`` captures the live request chain once, so the lazy
         first-call trace cannot diverge from the build-time decision the
         compiled-program cache keyed on.
+
+        ``qc=True`` additionally computes the fused per-site image QC
+        statistics (``tmlibrary_tpu.ops.qc``) from the RAW channel
+        images — before correction/alignment, so the stats describe the
+        acquisition, not the preprocessing — and the function returns
+        ``(SiteResult, {channel: {metric: (B,) array}})``.  The QC
+        branch only *reads* ``raw``; the pipeline dataflow is untouched,
+        which is what keeps outputs bit-identical with QC on/off.
         """
         from tmlibrary_tpu.ops import reduction
 
@@ -363,6 +387,7 @@ class ImageAnalysisPipeline:
         )
         site_fn = self.build_site_fn()
         preprocess = self.build_preprocess_fn(window)
+        desc = self.description
 
         def one_site(raw, stats, shift):
             with reduction.strategy_scope(requested):
@@ -375,7 +400,16 @@ class ImageAnalysisPipeline:
                         if window is not None and jnp.ndim(val) == 2:
                             val = image_ops.crop_window(val, *window)
                         images[key] = val
-                return site_fn(images)
+                result = site_fn(images)
+                if not qc:
+                    return result
+                from tmlibrary_tpu.ops import qc as qc_ops
+
+                qc_stats = {
+                    ch.name: qc_ops.site_qc_stats(raw[ch.name])
+                    for ch in desc.channels
+                }
+                return result, qc_stats
 
         batched = jax.vmap(one_site, in_axes=(0, None, 0))
         if not jit:

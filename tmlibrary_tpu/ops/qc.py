@@ -1,0 +1,119 @@
+"""On-device image quality-control statistics.
+
+The numeric core of the QC subsystem (``tmlibrary_tpu.qc``): a handful of
+cheap, fully fused per-site statistics computed from the *raw* channel
+image inside the jterator batch program, so quality observability rides
+the existing device pass at zero marginal transfer cost — the scalars
+come back with the batch result instead of forcing a second read of the
+image data.
+
+Every statistic is a deterministic element-wise/reduction composition
+(no data-dependent control flow, no iota-free gathers), so fusing them
+into the batch fn cannot perturb the segmentation/measurement outputs:
+the pipeline's own arrays never flow *through* these ops, they are only
+read.  Bit-identity of pipeline outputs with QC on/off is pinned by
+``tests/test_qc.py``.
+
+Statistics
+----------
+``saturation_frac``
+    Fraction of pixels at/above the sensor ceiling (uint16 → 65535).
+    Clipped highlights destroy intensity features silently.
+``background``
+    Minimum of 8×8 block means — a robust dark-level estimate that
+    ignores foreground blobs (TissueMAPS estimated background from
+    low-order percentiles; block-min-of-means is its streaming-friendly
+    cousin and needs no histogram).
+``focus_tenengrad``
+    Mean squared Sobel gradient magnitude normalized by squared mean
+    intensity — the classic Tenengrad autofocus proxy; out-of-focus
+    sites score near zero regardless of exposure.
+``laplacian_var``
+    Variance of the 4-neighbour Laplacian, same normalization — the
+    variance-of-Laplacian focus measure, sensitive to a different blur
+    band than Tenengrad.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: uint16 sensor ceiling — pixels at/above this count as saturated
+SATURATION_LEVEL = 65535.0
+
+#: block edge (pixels) for the background block-mean grid
+BACKGROUND_BLOCK = 8
+
+#: the per-site statistics ``site_qc_stats`` emits, in a stable order
+QC_IMAGE_METRICS = (
+    "saturation_frac",
+    "background",
+    "focus_tenengrad",
+    "laplacian_var",
+)
+
+
+def saturation_fraction(img: jnp.ndarray,
+                        level: float = SATURATION_LEVEL) -> jnp.ndarray:
+    """Fraction of pixels at or above ``level`` (scalar float32)."""
+    img = jnp.asarray(img, jnp.float32)
+    return jnp.mean((img >= level).astype(jnp.float32))
+
+
+def background_level(img: jnp.ndarray,
+                     block: int = BACKGROUND_BLOCK) -> jnp.ndarray:
+    """Minimum of ``block``×``block`` tile means (scalar float32).
+
+    The image is cropped to a whole number of tiles; images smaller
+    than one tile degrade to the global mean."""
+    img = jnp.asarray(img, jnp.float32)
+    h, w = img.shape
+    bh, bw = (h // block) * block, (w // block) * block
+    if bh == 0 or bw == 0:
+        return jnp.mean(img)
+    tiles = img[:bh, :bw].reshape(bh // block, block, bw // block, block)
+    return jnp.min(jnp.mean(tiles, axis=(1, 3)))
+
+
+def focus_tenengrad(img: jnp.ndarray) -> jnp.ndarray:
+    """Normalized Tenengrad focus score (scalar float32).
+
+    Sobel gradients via shifted slices of an edge-padded image (pure
+    adds — no convolution lowering), so the statistic fuses into the
+    surrounding batch program."""
+    img = jnp.asarray(img, jnp.float32)
+    p = jnp.pad(img, 1, mode="edge")
+    gx = (p[:-2, 2:] + 2.0 * p[1:-1, 2:] + p[2:, 2:]
+          - p[:-2, :-2] - 2.0 * p[1:-1, :-2] - p[2:, :-2])
+    gy = (p[2:, :-2] + 2.0 * p[2:, 1:-1] + p[2:, 2:]
+          - p[:-2, :-2] - 2.0 * p[:-2, 1:-1] - p[:-2, 2:])
+    # +1 in the denominator keeps all-dark sites finite instead of 0/0
+    denom = jnp.mean(img) ** 2 + 1.0
+    return jnp.mean(gx * gx + gy * gy) / denom
+
+
+def laplacian_variance(img: jnp.ndarray) -> jnp.ndarray:
+    """Normalized variance-of-Laplacian focus score (scalar float32)."""
+    img = jnp.asarray(img, jnp.float32)
+    p = jnp.pad(img, 1, mode="edge")
+    lap = (p[:-2, 1:-1] + p[2:, 1:-1] + p[1:-1, :-2] + p[1:-1, 2:]
+           - 4.0 * img)
+    denom = jnp.mean(img) ** 2 + 1.0
+    return jnp.var(lap) / denom
+
+
+def site_qc_stats(img: jnp.ndarray) -> dict[str, jnp.ndarray]:
+    """All per-site QC statistics for one raw 2-D channel image.
+
+    Returns ``{metric: scalar float32}`` with the keys of
+    ``QC_IMAGE_METRICS``.  Volumetric (z-stack) channels are handled by
+    the caller via max-projection before calling in here."""
+    img = jnp.asarray(img, jnp.float32)
+    if img.ndim == 3:  # defensive: fold an unexpected leading z axis
+        img = jnp.max(img, axis=0)
+    return {
+        "saturation_frac": saturation_fraction(img),
+        "background": background_level(img),
+        "focus_tenengrad": focus_tenengrad(img),
+        "laplacian_var": laplacian_variance(img),
+    }

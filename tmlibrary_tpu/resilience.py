@@ -374,12 +374,19 @@ class ResilienceConfig:
     ``max_batch_failures``: values in [0, 1) are a *fraction* of the
     step's batches; values >= 1 are an absolute count.  A step fails only
     when quarantined batches exceed this threshold.
+
+    ``qc_flag_budget``: fraction of a step's planned sites the QC
+    subsystem (``tmlibrary_tpu.qc``) may flag before the engine logs a
+    ``qc_budget_exceeded`` ledger event.  Warn-only by design — QC
+    evidence never fails a run (quarantine stays reserved for execution
+    failures).
     """
 
     policy: RetryPolicy = dataclasses.field(default_factory=RetryPolicy)
     max_batch_failures: float = 0.5
     guard: DeviceHealthGuard | None = None
     enabled: bool = True
+    qc_flag_budget: float = 0.5
 
     def failure_budget(self, n_batches: int) -> int:
         if self.max_batch_failures < 1.0:
@@ -397,4 +404,5 @@ class ResilienceConfig:
             ),
             max_batch_failures=cfg.max_batch_failures,
             guard=DeviceHealthGuard(timeout=cfg.device_probe_timeout),
+            qc_flag_budget=getattr(cfg, "qc_flag_budget", 0.5),
         )

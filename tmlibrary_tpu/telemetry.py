@@ -1134,6 +1134,53 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                 reg.histogram("tmx_span_seconds", span=name, **hl).observe(
                     float(ev["elapsed"])
                 )
+        elif kind == "qc_batch":
+            # QC summary gauge fields are run-cumulative at append time
+            # (qc.QCSession.observe_batch), so replaying them with
+            # last-write-wins gauge semantics reconstructs exactly what
+            # the live registry showed
+            s = ev.get("summary") or {}
+            if isinstance(s, dict):
+                for ch, entry in sorted((s.get("channels") or {}).items()):
+                    if "focus_min" in entry:
+                        reg.gauge("tmx_qc_worst_focus",
+                                  channel=str(ch), **hl).set(
+                            float(entry["focus_min"]))
+                    if "saturation_max" in entry:
+                        reg.gauge("tmx_qc_max_saturation_frac",
+                                  channel=str(ch), **hl).set(
+                            float(entry["saturation_max"]))
+                    if "background_mean" in entry:
+                        reg.gauge("tmx_qc_background_mean",
+                                  channel=str(ch), **hl).set(
+                            float(entry["background_mean"]))
+                if "nan_columns" in s:
+                    reg.gauge("tmx_qc_nan_columns", **hl).set(
+                        float(s.get("nan_columns") or 0))
+                bad = (int(s.get("nan_values") or 0)
+                       + int(s.get("inf_values") or 0))
+                if bad:
+                    reg.counter("tmx_qc_nan_values_total", **hl).inc(bad)
+                if "count_z_max" in s:
+                    reg.gauge("tmx_qc_count_z_max", **hl).set(
+                        float(s.get("count_z_max") or 0.0))
+        elif kind == "qc_site":
+            reg.counter("tmx_qc_sites_flagged_total", step=step, **hl).inc()
+        elif kind == "qc_budget_exceeded":
+            reg.counter("tmx_qc_budget_exceeded_total",
+                        step=step, **hl).inc()
+        elif kind in ("init_done", "description_drift"):
+            pass  # known structural events with no metric series
+        elif kind:
+            # forward compatibility: a newer writer's ledger may carry
+            # event kinds this checkout has never heard of — surface it
+            # once per kind and keep deriving, never raise (an old
+            # checkout must stay able to read a new ledger)
+            warn_once(
+                logger, f"ledger-kind:{kind}",
+                "ignoring unknown ledger event kind '%s' (written by a "
+                "newer version?)", kind,
+            )
     for (step, host), acc in sorted(step_units.items()):
         if acc["seconds"] > 0:
             hl = {"host": host} if host else {}

@@ -37,7 +37,7 @@ def collect_fleet(root: Path) -> dict[str, Any]:
     repaint frequency against a live run."""
     wf = _workflow_dir(root)
     view: dict[str, Any] = {"root": str(root), "hosts": [], "merged": None,
-                            "status": {}, "degraded": None}
+                            "status": {}, "degraded": None, "qc": None}
     for hb_path in sorted(wf.glob("heartbeat*.json")):
         hb = telemetry.read_heartbeat(hb_path)
         if not hb or "ts" not in hb:
@@ -65,6 +65,14 @@ def collect_fleet(root: Path) -> dict[str, Any]:
         ledger = RunLedger(ledger_path)
         view["status"] = ledger.status()
         view["degraded"] = ledger.degraded_backend()
+    # qc.py is numpy + stdlib only — no jax backend touched (see module
+    # docstring constraint)
+    from tmlibrary_tpu import qc as qc_mod
+
+    qc_pairs = qc_mod.load_run_profiles(wf)
+    if qc_pairs:
+        view["qc"] = (qc_mod.merge_profiles(qc_pairs)
+                      if len(qc_pairs) > 1 else qc_pairs[0][1])
     return view
 
 
@@ -217,6 +225,24 @@ def render_dashboard(view: dict, width: int = 80) -> str:
         lines.append("metrics: no snapshot yet (telemetry off, or first "
                      "snapshot not written)")
 
+    # ---- data quality: one line from the run's qc.json profile(s)
+    qc = view.get("qc")
+    if qc:
+        guards = qc.get("guards") or {}
+        nan_cols = len(guards.get("nan_columns") or [])
+        flagged = int(qc.get("flagged_total") or 0)
+        worst = None
+        for metrics in (qc.get("channels") or {}).values():
+            v = (metrics.get("focus_tenengrad") or {}).get("min")
+            if v is not None and (worst is None or v < worst):
+                worst = v
+        bits = [f"flagged {flagged}", f"nan cols {nan_cols}"]
+        if worst is not None:
+            bits.append(f"worst focus {worst:.4g}")
+        flag = ("  ** NON-FINITE FEATURES — inspect with tmx qc **"
+                if nan_cols else "")
+        lines.append("qc: " + "  ".join(bits) + flag)
+
     # ---- breaker / degradation state
     deg = view["degraded"]
     if deg:
@@ -230,15 +256,24 @@ def render_dashboard(view: dict, width: int = 80) -> str:
 
 def run_top(root: Path, interval: float = 2.0, once: bool = False,
             iterations: int | None = None,
-            out: TextIO | None = None) -> int:
+            out: TextIO | None = None, as_json: bool = False) -> int:
     """Dashboard loop.  ``once`` renders a single frame (tests/CI);
-    ``iterations`` bounds the loop for tests; Ctrl-C exits cleanly."""
+    ``iterations`` bounds the loop for tests; ``as_json`` emits one
+    machine-readable ``collect_fleet`` view instead of the text frame
+    (implies a single frame); Ctrl-C exits cleanly."""
     out = out or sys.stdout
     root = Path(root)
     if not _workflow_dir(root).is_dir():
         print(f"error: no workflow directory under {root}",
               file=sys.stderr)
         return 1
+    if as_json:
+        import json
+
+        out.write(json.dumps(collect_fleet(root), indent=2, default=str)
+                  + "\n")
+        out.flush()
+        return 0
     n = 0
     try:
         while True:

@@ -338,6 +338,18 @@ class RunLedger:
                     if occ is not None:
                         buckets["occupancy_sum"] += float(occ)
                         buckets["occupancy_n"] += 1
+                # QC summary fields are run-cumulative at append time,
+                # so last-write-wins mirrors the live registry gauges
+                qc = result.get("qc")
+                if isinstance(qc, dict):
+                    entry["qc"] = {
+                        "flagged": qc.get("flagged_total", 0),
+                        "nan_columns": qc.get("nan_columns", 0),
+                        "worst_focus": qc.get("worst_focus"),
+                        "count_z_max": qc.get("count_z_max"),
+                    }
+            elif e["event"] == "qc_budget_exceeded":
+                entry.setdefault("qc", {})["budget_exceeded"] = True
             elif e["event"] == "batch_failed":
                 if e.get("batch") not in entry["quarantined"]:
                     entry["quarantined"].append(e.get("batch"))
@@ -466,6 +478,7 @@ class Workflow:
         """Persist the live registry next to the ledger so ``tmx metrics``
         exports the run's exact counters without re-deriving — written on
         failure too (a failed run's metrics are the interesting ones)."""
+        self._write_qc_profile()
         if not telemetry.enabled():
             return
         try:
@@ -495,6 +508,27 @@ class Workflow:
                 )
         except OSError:
             logger.debug("perf snapshot write failed", exc_info=True)
+
+    def _write_qc_profile(self) -> None:
+        """Persist the run's QC profile (``qc.<host>.json``, plus the
+        plain ``qc.json`` convenience copy on host0) — same layout
+        discipline as the metrics snapshots.  QC has its own gate, so
+        this writes even when telemetry is disabled."""
+        from tmlibrary_tpu import qc as qc_mod
+
+        profile = qc_mod.get_session().snapshot()
+        if not profile:
+            return  # QC off, or nothing observed
+        try:
+            qc_mod.write_profile(
+                qc_mod.profile_path(self.store.workflow_dir), profile
+            )
+            if telemetry.host_id() == "host0":
+                qc_mod.write_profile(
+                    self.store.workflow_dir / "qc.json", profile
+                )
+        except OSError:
+            logger.debug("qc profile write failed", exc_info=True)
 
     def _start_sampler(self):
         """Start the resource sampler thread for this run when telemetry
@@ -534,6 +568,34 @@ class Workflow:
             step=step_name, event="straggler", batch=batch_index,
             skew_s=float(skew), device_wall_times=times,
         )
+
+    def _note_qc(self, step_name: str, batch_index, result) -> int:
+        """Emit ``qc_batch`` (+ one ``qc_site`` per flagged site) ledger
+        events when a batch summary carries a QC summary.
+
+        Same thread discipline as :meth:`_note_straggler`: the QC
+        evidence rides the batch result dict from the persist worker,
+        and only the engine thread appends to the ledger.  QC flags are
+        observability, not control flow — they reuse the quarantine
+        machinery's *ledger* surface without ever failing a batch.
+        Returns the number of sites flagged by this batch."""
+        if not isinstance(result, dict):
+            return 0
+        summary = result.get("qc")
+        if not isinstance(summary, dict):
+            return 0
+        flagged = summary.get("flagged_sites") or []
+        self.ledger.append(
+            step=step_name, event="qc_batch", batch=batch_index,
+            summary={k: v for k, v in summary.items()
+                     if k != "flagged_sites"},
+        )
+        for site in flagged:
+            self.ledger.append(
+                step=step_name, event="qc_site", batch=batch_index,
+                **{k: v for k, v in site.items() if k != "step"},
+            )
+        return len(flagged)
 
     # ---------------------------------------------------------- batch level
     def _exec_batch(self, step, batch: dict) -> dict:
@@ -674,6 +736,15 @@ class Workflow:
             results: list[dict] = []
             failed: list[dict] = []
             budget = res.failure_budget(len(batches)) if res.enabled else 0
+            # QC flag budget: a warn-only threshold over the step's
+            # planned site count (resilience.qc_flag_budget fraction)
+            qc_flagged = 0
+            qc_budget_noted = False
+            qc_sites_total = sum(len(b.get("sites") or []) for b in batches)
+            qc_site_budget = (
+                int(res.qc_flag_budget * qc_sites_total)
+                if res.enabled and qc_sites_total else 0
+            )
             pstats = None
             if (pending and supports_pipelining(step)
                     and faults.active() is None):
@@ -706,6 +777,28 @@ class Workflow:
                                            result=outcome.value)
                         self._note_straggler(sd.name, batch["index"],
                                              outcome.value)
+                        qc_flagged += self._note_qc(sd.name, batch["index"],
+                                                    outcome.value)
+                        if (qc_site_budget and not qc_budget_noted
+                                and qc_flagged > qc_site_budget):
+                            # the QC flag budget warns, it never fails:
+                            # bad inputs are a human decision, not a
+                            # scheduler one (quarantine stays reserved
+                            # for execution failures)
+                            qc_budget_noted = True
+                            self.ledger.append(
+                                step=sd.name, event="qc_budget_exceeded",
+                                flagged=qc_flagged, budget=qc_site_budget,
+                            )
+                            metrics.counter(
+                                "tmx_qc_budget_exceeded_total",
+                                step=sd.name).inc()
+                            logger.warning(
+                                "%s: QC flagged %d sites — more than the "
+                                "configured budget (%d); inspect with "
+                                "`tmx qc`", sd.name, qc_flagged,
+                                qc_site_budget,
+                            )
                         metrics.counter("tmx_batches_done_total",
                                         step=sd.name).inc()
                         metrics.histogram("tmx_batch_seconds",

@@ -145,6 +145,19 @@ class IlluminationStatisticsCalculator(Step):
             out["std_log"] = np.asarray(
                 gaussian_smooth(out["std_log"], args["smooth_sigma"])
             )
+        # the finalize already inverted exact raw-intensity percentiles
+        # from the Welford histogram — hand them to the QC session (one
+        # no-op call when QC is off) so the run profile records each
+        # channel's acquisition dynamic range for free
+        from tmlibrary_tpu import qc as qc_mod
+
+        ch_name = next(
+            (c.name for c in exp.channels if c.index == channel),
+            str(channel),
+        )
+        qc_mod.get_session().observe_illumination(
+            ch_name, out["percentile_keys"], out["percentile_values"]
+        )
         out.pop("hist", None)
         self.store.write_illumstats(out, cycle=cycle, channel=channel)
         # one batch == one channel; same perf_counter wall-time math as

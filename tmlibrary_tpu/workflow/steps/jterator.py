@@ -199,10 +199,11 @@ class ImageAnalysisRunner(Step):
 
     def __init__(self, store):
         super().__init__(store)
-        # capacity -> compiled batch fn: the bucket router compiles one
-        # program per object-capacity bucket it actually routes to (each
-        # is also process-cached in jterator.pipeline.cached_batch_fn)
-        self._compiled: dict[int, object] = {}
+        # (capacity, qc gate) -> compiled batch fn: the bucket router
+        # compiles one program per object-capacity bucket it actually
+        # routes to (each is also process-cached in
+        # jterator.pipeline.cached_batch_fn)
+        self._compiled: dict[tuple, object] = {}
         self._desc = None
         self._window: tuple[int, int, int, int] | None = None
         self._window_resolved = False
@@ -281,8 +282,16 @@ class ImageAnalysisRunner(Step):
         cache is keyed by the cap a program was actually built for."""
         self._description(args)
         cap = int(capacity if capacity is not None else args["max_objects"])
+        from tmlibrary_tpu import qc as qc_mod
+
+        # the QC gate joins the instance cache key: a QC-on program
+        # returns (SiteResult, qc_stats) instead of a bare SiteResult,
+        # so a mid-process gate flip (tests, tools) must never reuse a
+        # program built for the other shape
+        qc_on = qc_mod.enabled()
+        cache_key = (cap, qc_on)
         with self._compile_lock:
-            if cap not in self._compiled:
+            if cache_key not in self._compiled:
                 # aligned multiplexing experiments crop every channel to the
                 # inter-cycle intersection (reference SiteIntersection); the
                 # window is experiment-static, so it compiles into the program
@@ -302,15 +311,16 @@ class ImageAnalysisRunner(Step):
                 # the traced+compiled program instead of re-paying trace+load
                 from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
 
-                self._compiled[cap] = cached_batch_fn(
+                self._compiled[cache_key] = cached_batch_fn(
                     self._desc, cap, self._window,
                     # arg True defers to the config default (so
                     # TM_DONATE_BUFFERS=0 still disables it); arg False
                     # forces donation off for this run
                     donate=None if args.get("donate_buffers", True) else False,
                     reduction_strategy=args.get("reduction_strategy", "auto"),
+                    qc=qc_on,
                 )
-            return self._desc, self._compiled[cap]
+            return self._desc, self._compiled[cache_key]
 
     # -------------------------------------------------------------------- run
     def _effective_batch(self, batch: dict) -> dict:
@@ -1045,6 +1055,11 @@ class ImageAnalysisRunner(Step):
 
     def _persist(self, batch: dict, result, capacity: int | None = None) -> dict:
         """Fetch one launched batch's device results and write them out."""
+        # QC-on programs return (SiteResult, fused per-site image stats);
+        # split the pair here so the persist path below is shape-agnostic
+        qc_dev = None
+        if isinstance(result, tuple):
+            result, qc_dev = result
         args = batch["args"]
         sites = batch["sites"]
         tpoint, zplane = args["tpoint"], args["zplane"]
@@ -1084,6 +1099,8 @@ class ImageAnalysisRunner(Step):
                 escalations += 1
                 cap = new_cap
                 result = self._launch(batch, capacity=cap)
+                if isinstance(result, tuple):
+                    result, qc_dev = result
         counts = {k: np.asarray(v)[:n_valid] for k, v in result.counts.items()}
         objects = {k: np.asarray(v)[:n_valid] for k, v in result.objects.items()}
         measurements = {
@@ -1224,6 +1241,25 @@ class ImageAnalysisRunner(Step):
                 max_obj,
                 ", ".join(f"{n} site(s) of '{k}'" for k, n in saturated.items()),
             )
+        if qc_dev is not None:
+            # QC rides the already-fetched arrays: fused image stats from
+            # the device, numerics guards + feature sketches on the numpy
+            # the persist path produced anyway.  The summary travels with
+            # the batch result so the ENGINE thread appends the
+            # qc_batch/qc_site ledger events (same thread discipline as
+            # straggler records) — flags never fail the batch.
+            from tmlibrary_tpu import qc as qc_mod
+
+            image_stats = {
+                ch: {m: np.asarray(v)[:n_valid] for m, v in metrics.items()}
+                for ch, metrics in qc_dev.items()
+            }
+            qc_summary = qc_mod.get_session().observe_batch(
+                self.name, sites, image_stats=image_stats, counts=counts,
+                measurements=measurements, saturated=bool(saturated),
+            )
+            if qc_summary:
+                summary["qc"] = qc_summary
         self._note_sites(n_valid)
         return summary
 
