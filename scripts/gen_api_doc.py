@@ -160,6 +160,33 @@ def qc_section() -> list[str]:
     return out
 
 
+def resilience_section() -> list[str]:
+    from tmlibrary_tpu import resilience
+
+    out = ["## Resilience & survivability", "",
+           (inspect.getdoc(resilience) or "").split("\n")[0],
+           "",
+           "Retry/breaker/CPU-degradation knobs ride `tmx workflow "
+           "submit` (`--retry-attempts`, `--retry-delay`, "
+           "`--max-batch-failures`, `--probe-timeout`).  SIGTERM/SIGINT "
+           "drain the run and exit with the pinned code 75 so wrappers "
+           "re-launch `tmx workflow submit --resume`; phase watchdogs "
+           "arm with `TMX_WATCHDOG=1` + "
+           "`TMX_WATCHDOG_{LAUNCH,BLOCK,PERSIST}_S` (DESIGN.md §19).",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for name in sorted(n for n in dir(resilience) if not n.startswith("_")):
+        obj = getattr(resilience, name)
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "") != resilience.__name__:
+            continue
+        doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append(f"| `resilience.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def perf_section() -> list[str]:
     from tmlibrary_tpu import perf
 
@@ -199,6 +226,7 @@ def main() -> None:
         *top_section(),
         *qc_section(),
         *perf_section(),
+        *resilience_section(),
     ]
     # optional output override so a freshness check can generate into a
     # scratch path without clobbering the committed file

@@ -1,0 +1,72 @@
+"""Worker body for the kill-mid-persist resume test (launched by
+``tests/test_preemption.py``, one subprocess per phase).
+
+Registers a small pipelined step and runs it against a store the parent
+prepared on disk.  Phase ``run`` is launched with ``TMX_FAULT_PLAN``
+arming a ``kill`` fault inside the pipelined persist worker — the
+process hard-exits (``os._exit(41)``) after the device work but before
+that batch's outputs/ledger event are durable, with no exception
+propagation and no cleanup.  Phase ``resume`` re-launches with no plan
+and ``resume=True``: it must reconstruct progress from the ledger alone
+and redo exactly the batches the ledger never recorded.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmlibrary_tpu.workflow.api import Step  # noqa: E402
+from tmlibrary_tpu.workflow.registry import register_step  # noqa: E402
+
+
+@register_step("preemptworker")
+class PreemptWorker(Step):
+    """Six batches through the launch/persist split; a short persist
+    stall keeps the pipelined window alive long enough that the injected
+    kill lands while later batches are still in flight."""
+
+    N_BATCHES = 6
+
+    def create_batches(self, args):
+        return [{} for _ in range(self.N_BATCHES)]
+
+    def run_batch(self, batch):
+        out = self.step_dir / f"out_{batch['index']:03d}.txt"
+        out.write_text(f"payload-{batch['index']}")
+        return {"i": batch["index"]}
+
+    def launch_batch(self, batch, prefetched=None):
+        return batch, {"index": batch["index"]}
+
+    def persist_batch(self, eff, ctx):
+        time.sleep(0.02)
+        return self.run_batch(eff)
+
+
+def main() -> None:
+    store_root, phase = sys.argv[1], sys.argv[2]
+
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.engine import (
+        Workflow,
+        WorkflowDescription,
+        WorkflowStageDescription,
+        WorkflowStepDescription,
+    )
+
+    store = ExperimentStore.open(store_root)
+    desc = WorkflowDescription(
+        stages=[WorkflowStageDescription(
+            name="test", steps=[WorkflowStepDescription(name="preemptworker")]
+        )]
+    )
+    summary = Workflow(store, desc, pipeline_depth=4).run(
+        resume=(phase == "resume")
+    )
+    print(f"WORKER_DONE phase={phase} steps={sorted(summary)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

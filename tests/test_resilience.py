@@ -284,12 +284,76 @@ def test_ledger_survives_truncated_trailing_line(tmp_path):
     assert [e["event"] for e in events] == ["init_done", "batch_done"]
     assert ledger.completed_batches("a") == {0}
     assert ledger.completed_steps() == set()
-    # appending after the torn line produces one more garbage line at
-    # most — later events still parse
+    # the resuming process's writer truncates the torn tail before its
+    # first append, so later events land on a clean line boundary and
+    # are NOT lost
+    resumed = RunLedger(path)
+    resumed.append(step="a", event="batch_done", batch=1)
+    resumed.append(step="a", event="step_done")
+    assert resumed.completed_steps() == {"a"}
+    assert resumed.completed_batches("a") == {0, 1}
+    raw = path.read_text()
+    assert '"event": "batch_do{' not in raw  # the torn fragment is gone
+    assert raw.endswith("\n")
+
+
+def test_ledger_crc_detects_tampered_line(tmp_path):
+    """A line whose payload no longer matches its CRC (bit rot, a torn
+    write that happens to stay valid JSON) is skipped like a torn one."""
+    path = tmp_path / "ledger.jsonl"
+    ledger = RunLedger(path)
+    ledger.append(step="a", event="init_done", n_batches=2)
+    ledger.append(step="a", event="batch_done", batch=0)
     ledger.append(step="a", event="batch_done", batch=1)
-    ledger.append(step="a", event="step_done")
+    lines = path.read_text().splitlines()
+    assert all('"crc": "' in ln for ln in lines)  # every line sealed
+    # corrupt the middle line's payload without touching its CRC: still
+    # valid JSON, but the checksum proves it is not what was written
+    lines[1] = lines[1].replace('"batch": 0', '"batch": 9')
+    path.write_text("\n".join(lines) + "\n")
+    fresh = RunLedger(path)
+    assert fresh.completed_batches("a") == {1}  # tampered line dropped
+    # the reader strips the checksum key from surviving events
+    assert all("crc" not in e for e in fresh.events())
+
+
+def test_ledger_reads_seed_era_crc_less_lines(tmp_path):
+    """Ledgers written before line sealing (no ``crc`` key) stay fully
+    readable — the checksum is only enforced where present."""
+    path = tmp_path / "ledger.jsonl"
+    path.write_text(
+        '{"event": "run_started", "description_hash": "x"}\n'
+        '{"step": "a", "event": "init_done", "n_batches": 1}\n'
+        '{"step": "a", "event": "batch_done", "batch": 0}\n'
+        '{"step": "a", "event": "step_done"}\n'
+    )
+    ledger = RunLedger(path)
     assert ledger.completed_steps() == {"a"}
-    assert ledger.completed_batches("a") == {0}  # batch 1 landed on the torn line
+    assert ledger.completed_batches("a") == {0}
+    # a new-writer append seals its own line without disturbing the old
+    ledger.append(step="b", event="init_done", n_batches=1)
+    raw = path.read_text().splitlines()
+    assert '"crc": "' not in raw[0] and '"crc": "' in raw[-1]
+    assert len(RunLedger(path).events()) == 5
+
+
+def test_ledger_idempotent_batch_done(tmp_path):
+    """Re-recording an already-completed batch is a detected no-op: one
+    ``batch_done`` event per (step, batch), however often persist-side
+    replay re-observes it."""
+    ledger = RunLedger(tmp_path / "l.jsonl")
+    ledger.append(step="s", event="init_done", n_batches=2)
+    assert ledger.append_batch_done("s", 0, elapsed=0.1) is True
+    assert ledger.append_batch_done("s", 0, elapsed=0.2) is False
+    assert ledger.append_batch_done("s", 1) is True
+    done = [e for e in ledger.events() if e.get("event") == "batch_done"]
+    assert [e["batch"] for e in done] == [0, 1]
+    # a second writer instance resolves idempotence from disk
+    again = RunLedger(ledger.path)
+    assert again.append_batch_done("s", 1) is False
+    # a re-init invalidates completions, so the same index records anew
+    ledger.append(step="s", event="init_done", n_batches=2)
+    assert ledger.append_batch_done("s", 0) is True
 
 
 def test_ledger_fsync_flag(tmp_path):

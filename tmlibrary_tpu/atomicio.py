@@ -1,0 +1,57 @@
+"""Crash-consistent file writes: the tmp+rename discipline in one place.
+
+Several subsystems persist small JSON artifacts next to the run ledger
+(metrics snapshots, heartbeats, QC profiles, perf attribution, tuning
+verdicts).  A reader racing a writer — ``tmx top`` polling a live run,
+or a resumed process inspecting the artifacts a killed one left behind —
+must never observe a half-written file, and a hard kill mid-write must
+never corrupt the previous good version.  POSIX ``rename(2)`` within a
+directory is atomic, so every writer here follows the same protocol:
+write the full payload to a sibling temp file, then rename over the
+target.  Readers either see the old complete file or the new complete
+file, nothing in between.
+
+The temp name embeds the writer's PID so two processes targeting the
+same path (a sampler thread and an engine ``finally`` block, or two
+fleet hosts mis-configured onto one file) cannot interleave partial
+writes into one temp file; the last rename wins, which is the same
+last-write-wins semantics whole-file writes always had.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+
+def atomic_write_text(path: Path | str, text: str,
+                      fsync: bool = False) -> None:
+    """Write ``text`` to ``path`` atomically (tmp + rename).
+
+    With ``fsync=True`` the payload is flushed to stable storage before
+    the rename, making the write crash-*durable* as well as
+    crash-consistent — the ledger-adjacent artifacts default to
+    consistency only, matching the ledger's own ``ledger_fsync`` knob.
+    """
+    path = Path(path)
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    try:
+        with open(tmp, "w") as f:
+            f.write(text)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        # a failure between open and replace must not litter temp files
+        if tmp.exists():
+            tmp.unlink(missing_ok=True)
+
+
+def atomic_write_json(path: Path | str, obj: Any,
+                      fsync: bool = False, **dumps_kwargs: Any) -> None:
+    """``atomic_write_text`` for a JSON payload (serialized first, so a
+    serialization error can never leave a partial file either)."""
+    atomic_write_text(path, json.dumps(obj, **dumps_kwargs), fsync=fsync)

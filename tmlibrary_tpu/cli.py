@@ -624,6 +624,9 @@ def cmd_workflow(args) -> int:
             for clamp in entry.get("depth_clamps", []):
                 print(f"{'':12s} depth clamped {clamp.get('from')} -> "
                       f"{clamp.get('to')} (resource exhausted)")
+            if entry.get("watchdog_fires"):
+                print(f"{'':12s} watchdog fired {entry['watchdog_fires']} "
+                      "time(s) — hung phase(s) classified transient")
             buckets = entry.get("buckets")
             if buckets:
                 routed = " ".join(
@@ -649,7 +652,16 @@ def cmd_workflow(args) -> int:
                 if qc_entry.get("budget_exceeded"):
                     line += " ** OVER FLAG BUDGET — inspect with tmx qc **"
                 print(line)
-        degraded = RunLedger(store.workflow_dir / "ledger.jsonl").degraded_backend()
+        ledger = RunLedger(store.workflow_dir / "ledger.jsonl")
+        preempted = ledger.preempted()
+        if preempted:
+            print(f"PREEMPTED ({preempted.get('reason', 'signal')}) at step "
+                  f"'{preempted.get('step')}': drained "
+                  f"{preempted.get('drained', 0)}/"
+                  f"{preempted.get('in_flight', 0)} in-flight, abandoned "
+                  f"{preempted.get('abandoned', 0)} — resume with "
+                  "`tmx workflow submit --resume`")
+        degraded = ledger.degraded_backend()
         if degraded:
             print(f"backend degraded to {degraded.get('backend')} "
                   f"(at step '{degraded.get('where')}' after "
@@ -788,11 +800,31 @@ def cmd_workflow(args) -> int:
         )
     if args.probe_timeout is not None and resilience.guard is not None:
         resilience.guard.timeout = args.probe_timeout
-    with device_trace(args.profile):
-        summary = Workflow(store, desc, resilience=resilience,
-                           pipeline_depth=args.pipeline_depth).run(
-            resume=args.resume
-        )
+    from tmlibrary_tpu.errors import PreemptedError
+    from tmlibrary_tpu.resilience import (
+        EXIT_PREEMPTED,
+        install_preemption_handlers,
+    )
+
+    # SIGTERM/SIGINT ask for a graceful drain instead of killing the
+    # process mid-batch: the engine stops admitting work, persists the
+    # in-flight window, records run_preempted and we exit with the
+    # pinned code so wrappers re-launch `tmx workflow submit --resume`
+    restore = install_preemption_handlers()
+    try:
+        with device_trace(args.profile):
+            summary = Workflow(store, desc, resilience=resilience,
+                               pipeline_depth=args.pipeline_depth).run(
+                resume=args.resume
+            )
+    except PreemptedError as exc:
+        print(f"preempted ({exc.reason}): drained {exc.drained}/"
+              f"{exc.in_flight} in-flight batches at step '{exc.step}', "
+              f"abandoned {exc.abandoned} — resume with "
+              "`tmx workflow submit --resume`", file=sys.stderr)
+        return EXIT_PREEMPTED
+    finally:
+        restore()
     print(json.dumps(summary, default=str, indent=2))
     return 0
 

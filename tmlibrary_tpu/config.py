@@ -119,6 +119,27 @@ class LibraryConfig:
         default_factory=lambda: _setting("ledger_fsync", "0").lower()
         in ("1", "true", "yes")
     )
+    #: phase-watchdog master switch (resilience.PhaseWatchdog): deadlines
+    #: over the pipelined launch/block/persist phases that classify a
+    #: wedged device call as transient instead of hanging forever.  Off
+    #: by default (off = no monitor thread, no arming, no events); the
+    #: TMX_WATCHDOG env set by operators beats this setting
+    watchdog: bool = dataclasses.field(
+        default_factory=lambda: _setting("watchdog", "0").lower()
+        in ("1", "true", "yes")
+    )
+    #: per-phase watchdog deadlines in seconds (0 disarms a phase);
+    #: deliberately generous — these catch *wedged* calls, not slow ones.
+    #: TMX_WATCHDOG_{LAUNCH,BLOCK,PERSIST}_S env knobs beat these fields
+    watchdog_launch_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("watchdog_launch_s", "300"))
+    )
+    watchdog_block_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("watchdog_block_s", "600"))
+    )
+    watchdog_persist_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("watchdog_persist_s", "600"))
+    )
     # ------------------------------------------------------- pipelining
     #: in-flight batch window for the pipelined executor; 0 = auto
     #: (tuning/TUNING.json best_pipeline on device backends, else a safe

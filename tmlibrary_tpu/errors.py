@@ -73,6 +73,37 @@ class ProbeTimeoutError(TransientDeviceError):
     by ``resilience.call_with_timeout``; trips the circuit breaker."""
 
 
+class WatchdogTimeout(TransientDeviceError):
+    """A pipeline phase (launch / device block / persist) overran its
+    watchdog deadline (``resilience.PhaseWatchdog``).  Subclasses
+    :class:`TransientDeviceError` so the classifier treats a hung
+    ``block_until_ready`` exactly like a dropped relay: retryable, and
+    breaker-visible."""
+
+
+class PreemptedError(TmError):
+    """The run was asked to stop (SIGTERM/SIGINT preemption) and has
+    finished draining: every in-flight batch either persisted with its
+    ledger event or was abandoned un-launched.  Deliberately NOT a
+    :class:`WorkflowError` — the engine's step-failure handlers must not
+    record a drained run as a failed step (the ledger boundary is clean
+    and ``resume`` continues from it).
+
+    ``in_flight`` is the pipelined window size when the drain began,
+    ``drained`` how many of those persisted during the drain, and
+    ``abandoned`` how many planned batches were never launched."""
+
+    def __init__(self, message: str, step: str | None = None,
+                 in_flight: int = 0, drained: int = 0, abandoned: int = 0,
+                 reason: str = "signal"):
+        super().__init__(message)
+        self.step = step
+        self.in_flight = in_flight
+        self.drained = drained
+        self.abandoned = abandoned
+        self.reason = reason
+
+
 class FaultInjected(TmError):
     """An artificial fault raised by the deterministic fault-injection
     harness (``tmlibrary_tpu.faults``).  Never raised in production —

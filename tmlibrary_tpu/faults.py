@@ -16,6 +16,14 @@ Hook sites (``site`` field of a spec):
     fired by the engine just before/around executing one batch
     (context: ``step``, ``batch``) — simulates device loss or an IO
     flake inside ``run_batch``.
+``persist``
+    fired inside the pipelined executor's persist worker just before
+    ``persist_batch`` (context: ``step``, ``batch``) — simulates a
+    fault landing *after* the device work finished but before the
+    batch's outputs are durably written.  Unlike ``batch_run`` plans,
+    a plan holding only ``persist``-site specs does NOT force the
+    engine onto the sequential path: the fault's whole point is to
+    land inside the real pipelined persist phase.
 ``ledger_append``
     fired inside :meth:`RunLedger.append` (context: ``step``,
     ``event``) — writes a *truncated* half line first, simulating a
@@ -24,11 +32,18 @@ Hook sites (``site`` field of a spec):
     fired inside the device health probe — ``kind="hang"`` sleeps
     past the probe deadline (a down relay hangs, it doesn't error).
 
-The ``kill`` kind is special: instead of raising it hard-exits the
-process (``os._exit(41)``) — no exception propagation, no cleanup —
-simulating a preempted/OOM-killed worker host.  Only meaningful in
-subprocess harnesses (``tests/test_multihost_resume.py``) where a
-parent process observes the death and re-launches with ``resume``.
+Two kinds are special.  ``kill`` hard-exits the process
+(``os._exit(41)``) instead of raising — no exception propagation, no
+cleanup — simulating a preempted/OOM-killed worker host; only
+meaningful in subprocess harnesses (``tests/test_multihost_resume.py``,
+``tests/test_preemption.py``) where a parent process observes the
+death and re-launches with ``resume``.  ``sigterm`` delivers a real
+``SIGTERM`` to the current process and *returns without raising*: with
+the CLI's drain handler installed that models a preemption notice
+arriving mid-step (the run keeps executing until the engine reaches
+its next drain point), and without a handler it is process death at
+the default disposition — both are exactly what a preempting scheduler
+does.
 
 Activation: programmatic ``install(plan)`` / ``clear()`` (tests,
 ``scripts/chaos_run.py``) or the ``TMX_FAULT_PLAN`` environment
@@ -51,7 +66,15 @@ from tmlibrary_tpu.errors import FaultInjected, TransientDeviceError
 logger = logging.getLogger(__name__)
 
 #: exception factories per fault kind
-_KINDS = ("device_loss", "io_error", "crash", "crash_append", "hang", "kill")
+_KINDS = ("device_loss", "io_error", "crash", "crash_append", "hang", "kill",
+          "sigterm")
+
+#: sites whose faults must land *before* a batch persists to mean
+#: anything — a plan containing any of these forces the engine onto the
+#: sequential path (DESIGN.md §11).  ``persist``-site faults (and the
+#: probe hook) target the pipelined phases themselves and keep the real
+#: executor running.
+_SEQUENTIAL_SITES = frozenset({"batch_run", "ledger_append"})
 
 
 @dataclasses.dataclass
@@ -129,6 +152,12 @@ class FaultPlan:
     def fire_counts(self) -> dict[str, int]:
         return {f"{s.site}/{s.kind}": s.fired for s in self.specs}
 
+    def forces_sequential(self) -> bool:
+        """True when any spec targets a site whose faults only make
+        sense before a batch persists (the engine then degrades to the
+        sequential path for the whole run — see ``_SEQUENTIAL_SITES``)."""
+        return any(s.site in _SEQUENTIAL_SITES for s in self.specs)
+
 
 _PLAN: FaultPlan | None = None
 _ENV_CHECKED = False
@@ -175,6 +204,16 @@ def raise_for(spec: FaultSpec, site: str, ctx: dict) -> None:
         logger.warning("fault injection: hard-killing process at %s", where)
         logging.shutdown()
         os._exit(41)
+    if spec.kind == "sigterm":
+        # a real preemption notice: the signal lands on the main thread
+        # at its next bytecode boundary and this call RETURNS — the
+        # drain handler (resilience.install_preemption_handlers) decides
+        # what happens next, exactly as with an external scheduler
+        import signal as _signal
+
+        logger.warning("fault injection: delivering SIGTERM at %s", where)
+        os.kill(os.getpid(), _signal.SIGTERM)
+        return
     if spec.kind == "hang":
         time.sleep(spec.seconds)
         raise TransientDeviceError(f"injected hang ({spec.seconds}s) at {where}")
@@ -205,3 +244,11 @@ def match(site: str, **ctx) -> FaultSpec | None:
     (the ledger's truncated-write simulation)."""
     plan = active()
     return plan.match(site, **ctx) if plan is not None else None
+
+
+def sequential_forced() -> bool:
+    """True when an armed plan requires the engine's sequential path
+    (see :data:`_SEQUENTIAL_SITES`); no plan, or a plan targeting only
+    pipelined-phase sites, leaves the pipelined executor in play."""
+    plan = active()
+    return plan is not None and plan.forces_sequential()

@@ -56,6 +56,7 @@ import time
 from typing import Any, Callable
 
 from tmlibrary_tpu import tuning
+from tmlibrary_tpu.atomicio import atomic_write_text
 
 # ---------------------------------------------------------------------------
 # Roofline peaks (moved from bench.py; bench re-exports for compat)
@@ -600,12 +601,11 @@ def write_recapture(labels: list[str], path: str | None = None,
     existing = load_recapture(path)
     merged = existing + [l for l in labels if l not in existing]
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump({"items": merged, "reason": reason,
-                   "written_at_unix": time.time()}, f, indent=2)
-        f.write("\n")
-    os.replace(tmp, path)
+    atomic_write_text(
+        path,
+        json.dumps({"items": merged, "reason": reason,
+                    "written_at_unix": time.time()}, indent=2) + "\n",
+    )
     return path
 
 
@@ -617,12 +617,12 @@ def clear_recapture(label: str, path: str | None = None) -> None:
     remaining = [l for l in load_recapture(path) if l != label]
     try:
         if remaining:
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump({"items": remaining,
-                           "written_at_unix": time.time()}, f, indent=2)
-                f.write("\n")
-            os.replace(tmp, path)
+            atomic_write_text(
+                path,
+                json.dumps({"items": remaining,
+                            "written_at_unix": time.time()}, indent=2)
+                + "\n",
+            )
         elif os.path.exists(path):
             os.remove(path)
     except OSError:

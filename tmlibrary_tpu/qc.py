@@ -53,6 +53,7 @@ from typing import Any
 import numpy as np
 
 from tmlibrary_tpu import telemetry
+from tmlibrary_tpu.atomicio import atomic_write_json
 from tmlibrary_tpu.config import _setting
 
 logger = logging.getLogger(__name__)
@@ -620,7 +621,9 @@ def profile_path(workflow_dir: Path, host: str | None = None) -> Path:
 
 
 def write_profile(path: Path, profile: dict) -> None:
-    Path(path).write_text(json.dumps(profile, indent=1, default=float))
+    # atomic (tmp + rename): a kill mid-write must never leave half a
+    # profile where `tmx qc` / the drift sentinel will read it
+    atomic_write_json(path, profile, indent=1, default=float)
 
 
 def load_profile(path: Path) -> dict | None:

@@ -19,16 +19,30 @@ kills the whole step.  This module restores that isolation in-process:
   backoff.
 - :class:`ResilienceConfig` — the engine-facing bundle (policy, batch
   failure threshold, guard knobs), defaulted from ``LibraryConfig``.
+- **Preemption drain** (:func:`install_preemption_handlers`,
+  :func:`preemption_requested`) — a SIGTERM/SIGINT sets a process-wide
+  flag the engine polls at batch boundaries; the run stops admitting
+  new batches, drains the pipelined window, records ``run_preempted``
+  in the ledger and exits with a pinned code so ``resume`` continues
+  from the exact boundary (DESIGN.md §19).
+- :class:`PhaseWatchdog` — a monitor thread arming per-phase deadlines
+  over the pipelined executor's launch/block/persist phases; an overrun
+  is classified transient (:class:`WatchdogTimeout`), counted, ledgered
+  and fed to the device guard's breaker.  Disabled (the default) it
+  costs nothing: no thread, no arming, no events.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import logging
+import os
 import random
+import signal as _signal
 import threading
 import time
-from typing import Any, Callable
+from typing import Any, Callable, Iterator
 
 from tmlibrary_tpu import telemetry
 from tmlibrary_tpu.errors import (
@@ -39,6 +53,7 @@ from tmlibrary_tpu.errors import (
     ProbeTimeoutError,
     RegistryError,
     TransientDeviceError,
+    WatchdogTimeout,
     WorkflowError,
 )
 
@@ -345,6 +360,19 @@ class DeviceHealthGuard:
                 return "cpu"
         return "device"
 
+    def note_watchdog_fire(self, phase: str = "", step: str = "",
+                           batch: int | None = None) -> None:
+        """A phase watchdog observed a wedged pipelined phase — count it
+        against the breaker like a failed probe, so repeated hangs walk
+        the same breaker → CPU-degradation path a dead relay does."""
+        logger.warning(
+            "device guard: watchdog fire (%s phase, step '%s', batch %s) "
+            "recorded as a breaker failure (%d/%d)",
+            phase, step, batch, self.breaker.failures + 1,
+            self.breaker.failure_threshold,
+        )
+        self.breaker.record_failure()
+
     def _degrade(self, ledger, where: str) -> None:
         self.degraded = True
         telemetry.get_registry().counter(
@@ -365,6 +393,259 @@ class DeviceHealthGuard:
         if ledger is not None:
             ledger.append(event="backend_degraded", backend="cpu",
                           where=where, failures=self.breaker.failures)
+
+
+# ---------------------------------------------------------------------------
+# preemption drain: SIGTERM/SIGINT → stop admitting batches, drain, resume
+
+#: pinned exit code for a drained preemption (EX_TEMPFAIL): schedulers and
+#: wrapper scripts key on it to re-launch with ``tmx workflow resume``;
+#: distinct from the fault harness's injected hard-kill code (41)
+EXIT_PREEMPTED = 75
+
+#: process-wide drain request; an Event (not a bool) so executor worker
+#: threads and the engine thread observe one coherent flag
+_PREEMPT = threading.Event()
+_PREEMPT_REASON: list[str] = []
+
+
+def request_preemption(reason: str = "signal") -> None:
+    """Ask the running workflow to drain and stop at the next batch
+    boundary.  Safe from signal handlers and any thread; idempotent."""
+    if not _PREEMPT.is_set():
+        _PREEMPT_REASON.append(reason)
+        _PREEMPT.set()
+        logger.warning(
+            "preemption requested (%s) — the engine will stop admitting "
+            "new batches, drain in-flight work and exit resumably", reason,
+        )
+
+
+def preemption_requested() -> bool:
+    """Zero-cost poll the engine runs at batch boundaries."""
+    return _PREEMPT.is_set()
+
+
+def preemption_reason() -> str:
+    """What tripped the drain flag (a signal name, or ``signal``)."""
+    return _PREEMPT_REASON[-1] if _PREEMPT_REASON else "signal"
+
+
+def clear_preemption() -> None:
+    """Reset the drain flag (tests; a real resume is a fresh process)."""
+    _PREEMPT.clear()
+    _PREEMPT_REASON.clear()
+
+
+def install_preemption_handlers(
+    signals: tuple[int, ...] = (_signal.SIGTERM, _signal.SIGINT),
+) -> Callable[[], None]:
+    """Install drain-on-signal handlers (main thread only — the CLI's
+    ``workflow submit``/``resume`` path).  The first signal requests a
+    graceful drain; further signals are absorbed while the drain runs
+    (SIGKILL remains the force-quit).  Returns a ``restore()`` callable
+    reinstating the previous handlers."""
+
+    def _handler(signum, frame):  # noqa: ARG001 — signal API shape
+        request_preemption(reason=_signal.Signals(signum).name)
+
+    previous = {}
+    for sig in signals:
+        previous[sig] = _signal.signal(sig, _handler)
+
+    def restore() -> None:
+        for sig, old in previous.items():
+            _signal.signal(sig, old)
+
+    return restore
+
+
+# ---------------------------------------------------------------------------
+# phase watchdog: deadlines over the pipelined launch/block/persist phases
+
+
+class PhaseWatchdog:
+    """Monitor thread arming per-phase deadlines.
+
+    The executor wraps each pipelined phase in :meth:`arm`; a monitor
+    thread (started lazily on the first arm, so a watchdog that never
+    arms never spawns a thread) scans the armed set on a poll period
+    derived from the tightest deadline.  When a phase overruns:
+
+    - ``tmx_watchdog_fired_total`` is incremented (step + phase labels),
+    - the fire is queued for the engine thread to append as a
+      ``watchdog`` ledger event (only the engine thread touches the
+      ledger — thread discipline from DESIGN.md §13),
+    - ``on_fire`` (wired to the device guard's breaker) is invoked, so
+      a genuinely wedged device walks the existing breaker →
+      CPU-degradation path,
+    - and when the hung call eventually returns *successfully*, the
+      arm's context manager raises :class:`WatchdogTimeout` — a
+      transient classification, so the batch retries/quarantines like
+      any other device flake instead of silently passing after minutes
+      of hang.  A phase that raised its own error propagates that error
+      untouched.
+
+    The monitor cannot unstick a hung thread (no thread can, in
+    Python); it converts the hang into *evidence* and lets the breaker,
+    quarantine and resume machinery do what they already do.
+    """
+
+    def __init__(self, deadlines: dict[str, float],
+                 on_fire: Callable[..., None] | None = None,
+                 poll: float | None = None):
+        self.deadlines = {str(k): float(v) for k, v in deadlines.items()
+                          if v and float(v) > 0}
+        self.on_fire = on_fire
+        tightest = min(self.deadlines.values(), default=1.0)
+        self.poll = float(poll) if poll else max(0.05, tightest / 4.0)
+        self._lock = threading.Lock()
+        self._armed: dict[int, dict[str, Any]] = {}
+        self._pending_events: list[dict[str, Any]] = []
+        self._next_token = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.fired_total = 0
+
+    # ------------------------------------------------------------ arming
+    @contextlib.contextmanager
+    def arm(self, phase: str, step: str = "",
+            batch: int | None = None) -> Iterator[None]:
+        deadline = self.deadlines.get(phase)
+        if deadline is None:
+            yield
+            return
+        self._ensure_thread()
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            self._armed[token] = {
+                "phase": phase, "step": step, "batch": batch,
+                "t0": time.monotonic(),
+                "deadline": time.monotonic() + deadline,
+                "budget": deadline, "fired": False,
+            }
+        try:
+            yield
+        except BaseException:
+            with self._lock:
+                self._armed.pop(token, None)
+            raise
+        with self._lock:
+            entry = self._armed.pop(token)
+        if entry["fired"]:
+            elapsed = time.monotonic() - entry["t0"]
+            raise WatchdogTimeout(
+                f"{phase} phase of step '{step}' batch {batch} overran its "
+                f"{entry['budget']:.1f}s watchdog deadline "
+                f"(took {elapsed:.1f}s)"
+            )
+
+    # ----------------------------------------------------------- monitor
+    def _ensure_thread(self) -> None:
+        if self._thread is None:
+            with self._lock:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._run, name="tmx-watchdog", daemon=True
+                    )
+                    self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll):
+            now = time.monotonic()
+            fired: list[dict[str, Any]] = []
+            with self._lock:
+                for entry in self._armed.values():
+                    if not entry["fired"] and now >= entry["deadline"]:
+                        entry["fired"] = True
+                        fired.append(dict(entry))
+            for entry in fired:
+                self._note_fire(entry)
+
+    def _note_fire(self, entry: dict[str, Any]) -> None:
+        self.fired_total += 1
+        elapsed = time.monotonic() - entry["t0"]
+        logger.error(
+            "watchdog: %s phase of step '%s' batch %s exceeded its %.1fs "
+            "deadline (%.1fs so far) — classifying as a transient device "
+            "hang", entry["phase"], entry["step"], entry["batch"],
+            entry["budget"], elapsed,
+        )
+        telemetry.get_registry().counter(
+            "tmx_watchdog_fired_total",
+            step=str(entry["step"] or "unknown"), phase=entry["phase"],
+        ).inc()
+        with self._lock:
+            self._pending_events.append({
+                "event": "watchdog", "phase": entry["phase"],
+                "batch": entry["batch"],
+                "budget_s": entry["budget"],
+                "elapsed_s": round(elapsed, 3),
+            })
+        if self.on_fire is not None:
+            try:
+                self.on_fire(phase=entry["phase"], step=entry["step"],
+                             batch=entry["batch"])
+            except Exception:  # pragma: no cover — defensive
+                logger.debug("watchdog on_fire hook failed", exc_info=True)
+
+    def drain_events(self) -> list[dict[str, Any]]:
+        """Queued ``watchdog`` ledger events, consumed by the engine
+        thread (the only thread allowed to append to the ledger)."""
+        with self._lock:
+            out, self._pending_events = self._pending_events, []
+        return out
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+
+def watchdog_enabled() -> bool:
+    """Master gate: ``TMX_WATCHDOG`` env beats the install config
+    (``TM_WATCHDOG`` / INI ``watchdog``); off by default, and off means
+    genuinely zero-cost — no thread, no arming, no events."""
+    env = os.environ.get("TMX_WATCHDOG")
+    if env is not None:
+        return env.lower() in ("1", "true", "yes")
+    from tmlibrary_tpu.config import cfg
+
+    return bool(getattr(cfg, "watchdog", False))
+
+
+def watchdog_from_config(
+    on_fire: Callable[..., None] | None = None,
+) -> PhaseWatchdog | None:
+    """Build the configured watchdog, or ``None`` when disabled.
+
+    Per-phase deadlines: ``TMX_WATCHDOG_LAUNCH_S`` /
+    ``TMX_WATCHDOG_BLOCK_S`` / ``TMX_WATCHDOG_PERSIST_S`` env knobs beat
+    the ``watchdog_*_s`` config fields; a deadline of 0 disarms that
+    phase.  Defaults are deliberately generous (minutes, not seconds) —
+    the watchdog exists to catch *wedged* calls, not slow ones."""
+    if not watchdog_enabled():
+        return None
+    from tmlibrary_tpu.config import cfg
+
+    deadlines: dict[str, float] = {}
+    for phase, attr in (("launch", "watchdog_launch_s"),
+                        ("block", "watchdog_block_s"),
+                        ("persist", "watchdog_persist_s")):
+        env = os.environ.get(f"TMX_WATCHDOG_{phase.upper()}_S")
+        try:
+            val = float(env) if env is not None else float(
+                getattr(cfg, attr, 0) or 0
+            )
+        except ValueError:
+            val = 0.0
+        if val > 0:
+            deadlines[phase] = val
+    if not deadlines:
+        return None
+    return PhaseWatchdog(deadlines, on_fire=on_fire)
 
 
 @dataclasses.dataclass

@@ -655,14 +655,16 @@ def snapshot_path(workflow_dir: Path, host: str | None = None) -> Path:
 
 def write_heartbeat(path: Path, period: float,
                     extra: dict | None = None) -> None:
-    """Atomically write the heartbeat timestamp file."""
+    """Atomically write the heartbeat timestamp file (``atomicio`` —
+    the PID-suffixed tmp name keeps concurrent writers from clobbering
+    each other's staging file)."""
+    from tmlibrary_tpu.atomicio import atomic_write_json
+
     payload = {"ts": time.time(), "pid": os.getpid(), "period": period,
                "host": host_id()}
     if extra:
         payload.update(extra)
-    tmp = path.with_name(path.name + ".tmp")
-    tmp.write_text(json.dumps(payload))
-    tmp.replace(path)
+    atomic_write_json(path, payload)
 
 
 def read_heartbeat(path: Path) -> dict | None:
@@ -1169,6 +1171,13 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
         elif kind == "qc_budget_exceeded":
             reg.counter("tmx_qc_budget_exceeded_total",
                         step=step, **hl).inc()
+        elif kind == "run_preempted":
+            reg.counter("tmx_preemptions_total", **hl).inc()
+        elif kind == "watchdog":
+            reg.counter(
+                "tmx_watchdog_fired_total", step=step,
+                phase=str(ev.get("phase", "")) or "unknown", **hl,
+            ).inc()
         elif kind in ("init_done", "description_drift"):
             pass  # known structural events with no metric series
         elif kind:

@@ -37,7 +37,8 @@ def collect_fleet(root: Path) -> dict[str, Any]:
     repaint frequency against a live run."""
     wf = _workflow_dir(root)
     view: dict[str, Any] = {"root": str(root), "hosts": [], "merged": None,
-                            "status": {}, "degraded": None, "qc": None}
+                            "status": {}, "degraded": None, "qc": None,
+                            "preempted": None}
     for hb_path in sorted(wf.glob("heartbeat*.json")):
         hb = telemetry.read_heartbeat(hb_path)
         if not hb or "ts" not in hb:
@@ -65,6 +66,7 @@ def collect_fleet(root: Path) -> dict[str, Any]:
         ledger = RunLedger(ledger_path)
         view["status"] = ledger.status()
         view["degraded"] = ledger.degraded_backend()
+        view["preempted"] = ledger.preempted()
     # qc.py is numpy + stdlib only — no jax backend touched (see module
     # docstring constraint)
     from tmlibrary_tpu import qc as qc_mod
@@ -135,8 +137,12 @@ def render_dashboard(view: dict, width: int = 80) -> str:
             state = entry.get("state", "?")
             frac = done / total if total else 0.0
             prog = f"{done}/{total}" if total else str(done)
+            extra = ""
+            if entry.get("watchdog_fires"):
+                extra = f"  watchdog x{entry['watchdog_fires']}"
             lines.append(
                 f"  {name:<16} {state:<9} [{_bar(frac, 16)}] {prog} batches"
+                f"{extra}"
             )
 
     merged = view["merged"]
@@ -250,6 +256,16 @@ def render_dashboard(view: dict, width: int = 80) -> str:
             f"DEGRADED: backend fell back to {deg.get('backend')} at "
             f"'{deg.get('where')}' after {deg.get('failures')} failed "
             "device probes"
+        )
+
+    # ---- preemption drain boundary (cleared by the next run_started)
+    pre = view.get("preempted")
+    if pre:
+        lines.append(
+            f"PREEMPTED ({pre.get('reason', 'signal')}): drained "
+            f"{pre.get('drained', 0)}/{pre.get('in_flight', 0)} in-flight "
+            f"at '{pre.get('step')}', abandoned {pre.get('abandoned', 0)} "
+            "— resume with `tmx workflow submit --resume`"
         )
     return "\n".join(lines) + "\n"
 

@@ -22,6 +22,8 @@ import os
 import time
 from pathlib import Path
 
+from tmlibrary_tpu.atomicio import atomic_write_text
+
 
 def tuning_json_path() -> str:
     """ONE definition of the tuning-results location (and its rehearsal
@@ -237,9 +239,7 @@ def record_config_sweep(config: str, entry: dict) -> dict:
         caps[backend] = capacity
         data["object_capacity"] = caps
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(data, f, indent=2, sort_keys=True)
-        f.write("\n")
-    os.replace(tmp, path)
+    atomic_write_text(
+        path, json.dumps(data, indent=2, sort_keys=True) + "\n"
+    )
     return data

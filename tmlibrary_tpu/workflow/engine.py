@@ -26,13 +26,15 @@ import json
 import logging
 import os
 import time
+import zlib
 from pathlib import Path
 from typing import Any
 
 import yaml
 
 from tmlibrary_tpu import faults, telemetry
-from tmlibrary_tpu.errors import FaultInjected, WorkflowError
+from tmlibrary_tpu.atomicio import atomic_write_text
+from tmlibrary_tpu.errors import FaultInjected, PreemptedError, WorkflowError
 from tmlibrary_tpu.log import warn_once
 from tmlibrary_tpu.models.store import ExperimentStore
 from tmlibrary_tpu.resilience import (
@@ -41,7 +43,10 @@ from tmlibrary_tpu.resilience import (
     RetryOutcome,
     RetryPolicy,
     classify,
+    preemption_reason,
+    preemption_requested,
     retry_call,
+    watchdog_from_config,
 )
 from tmlibrary_tpu.profiling import PipelineStats
 from tmlibrary_tpu.workflow.pipelined import (
@@ -192,9 +197,24 @@ class WorkflowDescription:
         return cls.for_type(wtype, step_args)
 
 
+#: separator introducing the per-line checksum :meth:`RunLedger.append`
+#: seals every event line with (the last key of the JSON object)
+_CRC_SEP = ', "crc": "'
+
+
 class RunLedger:
     """Append-only JSON-lines event log (replaces the reference's
     ``Submission``/``Task`` tables).
+
+    Crash consistency (DESIGN.md §19): every appended line is *sealed*
+    with a CRC-32 of the event body embedded as its last JSON key, so a
+    torn write (process killed mid-append) is detectable even when the
+    torn prefix happens to be valid JSON.  Readers skip unverifiable
+    lines; the *writer* additionally truncates a torn tail back to the
+    last intact line boundary before its first append
+    (:meth:`recover`), so a crashed run's ledger converges to exactly
+    the clean-run prefix.  Seed-era ledgers without CRCs stay fully
+    readable — the checksum is only enforced where present.
 
     ``fsync=True`` makes every append crash-durable at the cost of one
     fsync per event; without it a crash mid-append can leave a truncated
@@ -214,15 +234,95 @@ class RunLedger:
         #: file only grows via :meth:`append`, so re-parsing the whole
         #: JSON-lines file on every call is pure waste
         self._cache: tuple[tuple[int, int], list[dict]] | None = None
+        #: torn-tail recovery runs once, lazily, before the first append
+        self._recovered = False
+        #: per-step completed-batch sets maintained by
+        #: :meth:`append_batch_done` so idempotence checks don't re-parse
+        #: the whole ledger once per batch
+        self._done_cache: dict[str, set[int]] = {}
+
+    # ------------------------------------------------------------- sealing
+    @staticmethod
+    def _seal(body: str) -> str:
+        """Append the CRC-32 of ``body`` as its trailing JSON key.  The
+        sealed line is still one valid JSON object, so older checkouts
+        (and any JSON-lines tooling) read it unchanged."""
+        crc = zlib.crc32(body.encode())
+        return f'{body[:-1]}{_CRC_SEP}{crc:08x}"}}'
+
+    @staticmethod
+    def _line_ok(line: str) -> bool:
+        """True when the line parses — and, if sealed, verifies.  The
+        CRC is recomputed over the exact bytes that were sealed (the
+        line with its checksum key stripped), not a re-serialization, so
+        verification is byte-exact."""
+        head, sep, tail = line.rpartition(_CRC_SEP)
+        if sep and tail.endswith('"}'):
+            if f"{zlib.crc32((head + '}').encode()):08x}" != tail[:-2]:
+                return False
+            line = head + "}"
+        try:
+            json.loads(line)
+        except json.JSONDecodeError:
+            return False
+        return True
+
+    def recover(self) -> int:
+        """Truncate a torn tail (crash/kill mid-append) back to the last
+        intact line boundary; returns the number of bytes dropped.
+
+        WRITER PATH ONLY — called automatically before the first
+        :meth:`append`.  Read-only consumers polling a *live* ledger
+        from another process (``tmx top``, ``status``) must never
+        truncate a file someone else is mid-append on; they skip
+        unverifiable lines in :meth:`events` instead."""
+        self._recovered = True
+        try:
+            data = self.path.read_bytes()
+        except OSError:
+            return 0
+        good = len(data)
+        while good > 0:
+            nl = data.rfind(b"\n", 0, good)
+            if nl == good - 1:
+                # newline-terminated tail line: keep it if intact,
+                # otherwise walk back one more line
+                start = data.rfind(b"\n", 0, nl) + 1
+                frag = data[start:nl]
+                if not frag.strip() or self._line_ok(
+                    frag.decode("utf-8", errors="replace")
+                ):
+                    break
+                good = start
+            else:
+                # unterminated fragment — the signature of a torn append
+                good = nl + 1
+        dropped = len(data) - good
+        if dropped:
+            logger.warning(
+                "ledger %s: truncating %d bytes of torn tail (crash "
+                "mid-append) back to the last intact event boundary",
+                self.path, dropped,
+            )
+            with open(self.path, "rb+") as f:
+                f.truncate(good)
+            self._cache = None
+            self._done_cache.clear()
+        return dropped
 
     def append(self, **event) -> None:
+        if not self._recovered:
+            self.recover()
         event["ts"] = time.time()
         if self.host is not None:
             event.setdefault("host", self.host)
-        line = json.dumps(event)
+        line = self._seal(json.dumps(event))
         spec = faults.match("ledger_append", step=event.get("step"),
                             event=event.get("event"))
         self._cache = None
+        if event.get("event") == "init_done":
+            # a re-init invalidates earlier batch completions
+            self._done_cache.clear()
         with open(self.path, "a") as f:
             if spec is not None:
                 # simulate the process dying mid-write: half a line, no
@@ -235,9 +335,31 @@ class RunLedger:
                 f.flush()
                 os.fsync(f.fileno())
 
+    def append_batch_done(self, step: str, batch: int, **fields) -> bool:
+        """Idempotent ``batch_done``: recording a batch whose completion
+        is already in the ledger (a resume that re-ran work which had
+        persisted, a drained window re-observed) is a detected no-op, so
+        replay-derived state (``completed_batches``, ledger metrics)
+        never double-counts.  Returns True when the event was appended."""
+        done = self._done_cache.get(step)
+        if done is None:
+            done = self._done_cache[step] = set(self.completed_batches(step))
+        if batch in done:
+            logger.info(
+                "ledger: batch_done for %s batch %d already recorded — "
+                "idempotent no-op", step, batch,
+            )
+            return False
+        self.append(step=step, event="batch_done", batch=batch, **fields)
+        done.add(batch)
+        return True
+
     def events(self) -> list[dict]:
         """Parsed ledger events; treat the returned list as read-only
-        (it is cached until the file changes on disk)."""
+        (it is cached until the file changes on disk).  Sealed lines
+        failing their CRC are skipped exactly like unparseable ones; the
+        ``crc`` key itself is stripped so consumers see the event as it
+        was appended."""
         try:
             st = self.path.stat()
         except OSError:
@@ -250,15 +372,18 @@ class RunLedger:
         for lineno, line in enumerate(self.path.read_text().splitlines(), 1):
             if not line.strip():
                 continue
-            try:
-                out.append(json.loads(line))
-            except json.JSONDecodeError:
+            if not self._line_ok(line):
                 warn_once(
                     logger, f"{self.path}:{lineno}",
-                    "ledger %s line %d is not valid JSON (crash mid-append?)"
-                    " — skipping it; resume treats the event as never "
-                    "recorded", str(self.path), lineno,
+                    "ledger %s line %d is torn or corrupt (invalid JSON "
+                    "or failed CRC — crash mid-append?) — skipping it; "
+                    "resume treats the event as never recorded",
+                    str(self.path), lineno,
                 )
+                continue
+            parsed = json.loads(line)
+            parsed.pop("crc", None)
+            out.append(parsed)
         self._cache = (key, out)
         return out
 
@@ -368,6 +493,10 @@ class RunLedger:
                 entry.setdefault("depth_clamps", []).append(
                     {"from": e.get("from_depth"), "to": e.get("to_depth")}
                 )
+            elif e["event"] == "watchdog":
+                entry["watchdog_fires"] = entry.get("watchdog_fires", 0) + 1
+            elif e["event"] == "run_preempted":
+                entry["preempted"] = True
         return steps
 
     def degraded_backend(self) -> dict | None:
@@ -376,6 +505,18 @@ class RunLedger:
         for e in self.events():
             if e.get("event") == "backend_degraded":
                 last = e
+        return last
+
+    def preempted(self) -> dict | None:
+        """The trailing ``run_preempted`` event when the most recent run
+        ended in a graceful drain; a later ``run_started`` (the resume)
+        clears it, so status surfaces PREEMPTED only while it is true."""
+        last = None
+        for e in self.events():
+            if e.get("event") == "run_preempted":
+                last = e
+            elif e.get("event") == "run_started":
+                last = None
         return last
 
 
@@ -412,6 +553,9 @@ class Workflow:
         #: explicit in-flight depth for the pipelined executor; None means
         #: resolve per step (config > tuning > per-backend default)
         self.pipeline_depth = pipeline_depth
+        #: resilience.PhaseWatchdog for this run (built in :meth:`run`,
+        #: None when disabled — the zero-cost default)
+        self._watchdog = None
 
     # ------------------------------------------------------------- identity
     def description_hash(self) -> str:
@@ -447,6 +591,10 @@ class Workflow:
         guard = self.resilience.guard if self.resilience.enabled else None
         if guard is not None:
             guard.ensure_backend(self.ledger, where="run")
+        # None when disabled: no monitor thread, no arming, no events
+        self._watchdog = watchdog_from_config(
+            on_fire=guard.note_watchdog_fire if guard is not None else None
+        )
         done_steps = self.ledger.completed_steps() if resume else set()
         summary = {}
         try:
@@ -460,6 +608,15 @@ class Workflow:
                                 "resume: skipping completed step %s", sd.name
                             )
                             continue
+                        if preemption_requested():
+                            # the drain request landed between steps (or
+                            # during the previous step's collect): the
+                            # boundary is already clean — record it and
+                            # stop admitting steps
+                            self._note_preempted(PreemptedError(
+                                f"preempted before step '{sd.name}'",
+                                step=sd.name, reason=preemption_reason(),
+                            ))
                         if guard is not None:
                             guard.ensure_backend(self.ledger, where=sd.name)
                         with telemetry.span(
@@ -469,15 +626,53 @@ class Workflow:
                         ):
                             summary[sd.name] = self._run_step(sd, resume)
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._drain_watchdog()
+                self._watchdog = None
             if sampler is not None:
                 sampler.stop()
             self._write_metrics_snapshot()
         return summary
 
+    def _drain_watchdog(self, step_name: str | None = None) -> None:
+        """Append queued ``watchdog`` events — on the engine thread, the
+        only thread allowed to touch the ledger (the monitor thread just
+        queues)."""
+        wd = self._watchdog
+        if wd is None:
+            return
+        for ev in wd.drain_events():
+            if step_name is not None:
+                ev.setdefault("step", step_name)
+            self.ledger.append(**ev)
+
+    def _note_preempted(self, exc: PreemptedError) -> None:
+        """Record the drain boundary durably (``run_preempted`` event +
+        counter) and re-raise — the CLI maps this to the pinned
+        ``EXIT_PREEMPTED`` code so schedulers re-launch with ``resume``."""
+        self._drain_watchdog(exc.step)
+        self.ledger.append(
+            event="run_preempted", step=exc.step, reason=exc.reason,
+            in_flight=exc.in_flight, drained=exc.drained,
+            abandoned=exc.abandoned,
+        )
+        telemetry.get_registry().counter("tmx_preemptions_total").inc()
+        logger.warning(
+            "run preempted (%s) at step '%s': drained %d/%d in-flight "
+            "batches, abandoned %d un-launched — resume with "
+            "`tmx workflow resume`", exc.reason, exc.step, exc.drained,
+            exc.in_flight, exc.abandoned,
+        )
+        raise exc
+
     def _write_metrics_snapshot(self) -> None:
         """Persist the live registry next to the ledger so ``tmx metrics``
         exports the run's exact counters without re-deriving — written on
-        failure too (a failed run's metrics are the interesting ones)."""
+        failure too (a failed run's metrics are the interesting ones).
+        All writes are atomic (tmp + rename, ``atomicio``): a kill
+        mid-snapshot leaves the previous snapshot intact, never half a
+        JSON file."""
         self._write_qc_profile()
         if not telemetry.enabled():
             return
@@ -488,12 +683,12 @@ class Workflow:
             # per-host snapshot always (fleet merge input); the legacy
             # single-file name stays for host0 so existing tooling and
             # single-host runs see no change
-            telemetry.snapshot_path(self.store.workflow_dir).write_text(
-                rendered
+            atomic_write_text(
+                telemetry.snapshot_path(self.store.workflow_dir), rendered
             )
             if telemetry.host_id() == "host0":
-                (self.store.workflow_dir / "metrics.json").write_text(
-                    rendered
+                atomic_write_text(
+                    self.store.workflow_dir / "metrics.json", rendered
                 )
         except OSError:
             logger.debug("metrics snapshot write failed", exc_info=True)
@@ -503,8 +698,9 @@ class Workflow:
 
             snap = perf.perf_snapshot()
             if snap["programs"]:
-                (self.store.workflow_dir / "perf.json").write_text(
-                    json.dumps(snap, indent=2) + "\n"
+                atomic_write_text(
+                    self.store.workflow_dir / "perf.json",
+                    json.dumps(snap, indent=2) + "\n",
                 )
         except OSError:
             logger.debug("perf snapshot write failed", exc_info=True)
@@ -630,10 +826,13 @@ class Workflow:
         the step's own ``run_batches_pipelined`` generator; after a
         pipeline fault the failing batch is retried and the remainder
         degrades to sequential execution — per-batch isolation beats
-        overlap once the device is flaky.  With a fault plan armed the
-        sequential path is used from the start, so injected faults fire
-        *before* a batch persists (the pipelined paths persist a batch
-        before the engine sees it)."""
+        overlap once the device is flaky.  With a fault plan targeting a
+        pre-persist site armed the sequential path is used from the
+        start, so those faults fire *before* a batch persists (the
+        pipelined paths persist a batch before the engine sees it);
+        ``persist``-site plans keep the real executor.  Both paths poll
+        the preemption flag at batch boundaries and surface a drain as
+        :class:`PreemptedError` — never as a batch failure."""
         gen = None
         if pstats is not None and pending:
             executor = PipelinedExecutor(
@@ -642,10 +841,12 @@ class Workflow:
                     step=step.name, **ev
                 ),
                 stats=pstats,
+                should_stop=preemption_requested,
+                watchdog=self._watchdog,
             )
             gen = executor.run(pending)
         elif (hasattr(step, "run_batches_pipelined") and pending
-                and faults.active() is None):
+                and not faults.sequential_forced()):
             gen = iter(step.run_batches_pipelined(pending))
         pos = 0
         while pos < len(pending):
@@ -657,6 +858,8 @@ class Workflow:
                 except Exception as e:
                     if isinstance(e, FaultInjected) and e.fatal:
                         raise
+                    if isinstance(e, PreemptedError):
+                        raise  # drained cleanly — not a batch failure
                     # the pipeline died mid-flight: the first unyielded
                     # batch is the one it was working on
                     logger.warning(
@@ -674,6 +877,14 @@ class Workflow:
                 pos += 1
             else:
                 batch = pending[pos]
+                if preemption_requested():
+                    raise PreemptedError(
+                        f"preempted before batch {batch['index']} of "
+                        f"'{step.name}': abandoned {len(pending) - pos} "
+                        f"pending batches",
+                        step=step.name, abandoned=len(pending) - pos,
+                        reason=preemption_reason(),
+                    )
                 try:
                     yield batch, RetryOutcome(
                         value=self._exec_batch(step, batch), attempts=1
@@ -747,7 +958,7 @@ class Workflow:
             )
             pstats = None
             if (pending and supports_pipelining(step)
-                    and faults.active() is None):
+                    and not faults.sequential_forced()):
                 depth, source = resolve_pipeline_depth(
                     explicit=self.pipeline_depth
                 )
@@ -762,6 +973,7 @@ class Workflow:
                 for batch, outcome in self._iter_outcomes(step, pending,
                                                           policy, pstats):
                     current_batch = batch["index"]
+                    self._drain_watchdog(sd.name)
                     if outcome.ok:
                         b_elapsed = time.time() - bt0
                         if telemetry.enabled():
@@ -770,11 +982,11 @@ class Workflow:
                                 batch=batch["index"], t0=round(bt0, 6),
                                 elapsed=round(b_elapsed, 6),
                             )
-                        self.ledger.append(step=sd.name, event="batch_done",
-                                           batch=batch["index"],
-                                           elapsed=b_elapsed,
-                                           attempts=outcome.attempts,
-                                           result=outcome.value)
+                        self.ledger.append_batch_done(
+                            sd.name, batch["index"],
+                            elapsed=b_elapsed,
+                            attempts=outcome.attempts,
+                            result=outcome.value)
                         self._note_straggler(sd.name, batch["index"],
                                              outcome.value)
                         qc_flagged += self._note_qc(sd.name, batch["index"],
@@ -864,6 +1076,17 @@ class Workflow:
                                **extra)
             metrics.counter("tmx_steps_done_total", step=sd.name).inc()
             return {"n_batches": len(batches), "collected": collected}
+        except PreemptedError as e:
+            # a drain, not a failure: the ledger boundary is clean, so no
+            # step_failed — record the drain summary and surface the
+            # pinned-exit-code path (cli → EXIT_PREEMPTED → resume)
+            if e.step is None:
+                e.step = sd.name
+            if e.reason == "signal":
+                # the executor's drain path doesn't know which signal
+                # tripped the flag — the process-wide reason does
+                e.reason = preemption_reason()
+            self._note_preempted(e)
         except FaultInjected as e:
             if e.fatal:
                 raise  # simulated hard crash: no further ledger writes

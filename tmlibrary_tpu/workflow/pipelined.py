@@ -41,22 +41,32 @@ Semantics the engine depends on (and the equivalence tests pin down):
   order and persists default to ONE worker draining in submission
   order, so results are bit-identical to sequential execution.
 
-Fault plans (``faults.py``) force the engine onto the sequential path
-*before* this executor is constructed — injected faults must land before
-a batch persists to mean anything (DESIGN.md §11).
+Fault plans (``faults.py``) targeting ``batch_run``/``ledger_append``
+force the engine onto the sequential path *before* this executor is
+constructed — those faults must land before a batch persists to mean
+anything (DESIGN.md §11).  ``persist``-site plans run through the real
+executor: the hook fires in the persist worker, after the device work
+and before the batch's outputs are durable.
 """
 
 from __future__ import annotations
 
 import collections
 import concurrent.futures
+import contextlib
 import logging
 import time
 from typing import Any, Callable, Iterable, Iterator
 
-from tmlibrary_tpu import profiling, telemetry
+from tmlibrary_tpu import faults, profiling, telemetry
+from tmlibrary_tpu.errors import PreemptedError
 
 logger = logging.getLogger(__name__)
+
+#: shared no-op context for disarmed watchdog phases — one object, zero
+#: per-batch allocation when the watchdog is off (zero-cost-when-disabled
+#: discipline, same as telemetry's shared null instrument)
+_NULL_CM = contextlib.nullcontext()
 
 #: messages that signal HBM/host-memory pressure from too-deep pipelining
 #: (XLA surfaces these as bare RuntimeError/XlaRuntimeError text)
@@ -181,6 +191,8 @@ class PipelinedExecutor:
         persist_workers: int = 1,
         on_event: Callable[..., None] | None = None,
         stats=None,
+        should_stop: Callable[[], bool] | None = None,
+        watchdog=None,
     ):
         if depth is None:
             depth, depth_source = resolve_pipeline_depth()
@@ -194,6 +206,15 @@ class PipelinedExecutor:
         self.persist_workers = max(1, int(persist_workers))
         self.on_event = on_event
         self.stats = stats
+        #: graceful drain: polled before each launch — when it flips the
+        #: window drains (every launched batch persists + yields) and a
+        #: :class:`PreemptedError` carries the drain summary out; both
+        #: default to None so the executor costs nothing extra when the
+        #: drain/watchdog layers are off
+        self.should_stop = should_stop
+        #: resilience.PhaseWatchdog (or None): deadlines over the
+        #: launch/block/persist phases
+        self.watchdog = watchdog
 
     # ------------------------------------------------------------------ run
     def run(self, batches: Iterable[dict]) -> Iterator[tuple[dict, dict]]:
@@ -247,6 +268,14 @@ class PipelinedExecutor:
     def _run_window(self, batches: list[dict]) -> Iterator[tuple[dict, dict]]:
         step = self.step
         stats = self.stats
+        step_name = getattr(step, "name", "") or "unknown"
+        watchdog = self.watchdog
+
+        def _arm(phase: str, idx):
+            # shared null context when no watchdog: zero per-batch cost
+            return (_NULL_CM if watchdog is None
+                    else watchdog.arm(phase, step=step_name, batch=idx))
+
         has_prefetch = hasattr(step, "prefetch_batch")
         prefetcher = None
         if has_prefetch and len(batches) > 1:
@@ -265,13 +294,20 @@ class PipelinedExecutor:
             if hasattr(step, "block_batch"):
                 w0 = time.time()
                 t0 = time.perf_counter()
-                step.block_batch(ctx)
+                with _arm("block", idx):
+                    step.block_batch(ctx)
                 if stats is not None:
                     stats.record("device_block", time.perf_counter() - t0,
                                  batch=idx, t0=w0)
             w0 = time.time()
             t0 = time.perf_counter()
-            result = step.persist_batch(eff, ctx)
+            with _arm("persist", idx):
+                # persist-site faults land here: after the device work,
+                # before the outputs are durable (kill-mid-persist,
+                # sigterm, hang) — inside the armed phase so an injected
+                # hang exercises the watchdog like a real wedged write
+                faults.maybe_fire("persist", step=step_name, batch=idx)
+                result = step.persist_batch(eff, ctx)
             if stats is not None:
                 stats.record("persist", time.perf_counter() - t0,
                              batch=idx, t0=w0)
@@ -296,6 +332,24 @@ class PipelinedExecutor:
 
         try:
             for i, batch in enumerate(batches):
+                if self.should_stop is not None and self.should_stop():
+                    # graceful drain: stop admitting batches, let every
+                    # already-launched one persist + yield (the caller
+                    # ledgers each), then surface the drain summary.  The
+                    # ledger boundary is exactly a clean run's after the
+                    # same batches: resume continues bit-identically.
+                    n0 = len(window)
+                    drained = 0
+                    while window:
+                        yield pop_one()
+                        drained += 1
+                    raise PreemptedError(
+                        f"preempted before batch {batch.get('index', i)}: "
+                        f"drained {drained}/{n0} in-flight, abandoned "
+                        f"{len(batches) - i} un-launched",
+                        step=step_name, in_flight=n0, drained=drained,
+                        abandoned=len(batches) - i,
+                    )
                 if prefetcher is not None:
                     # keep up to `depth` loads ahead of the dispatch point
                     for j in range(i, min(i + self.depth, len(batches))):
@@ -317,7 +371,8 @@ class PipelinedExecutor:
                             )
                     w0 = time.time()
                     t0 = time.perf_counter()
-                    eff, ctx = step.launch_batch(batch, pre)
+                    with _arm("launch", bidx):
+                        eff, ctx = step.launch_batch(batch, pre)
                     if stats is not None:
                         stats.record("dispatch", time.perf_counter() - t0,
                                      batch=bidx, t0=w0)
