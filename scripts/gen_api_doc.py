@@ -211,6 +211,36 @@ def perf_section() -> list[str]:
     return out
 
 
+def serve_section() -> list[str]:
+    from tmlibrary_tpu import serve
+    from tmlibrary_tpu.workflow import admission
+
+    out = ["## Serving (`tmx serve`)", "",
+           (inspect.getdoc(serve) or "").split("\n")[0],
+           "",
+           "Driven by `tmx serve run --root DIR [--max-queue N] "
+           "[--tenant-quota N] [--retry-budget N] "
+           "[--tenant-weights T=W,...] [--max-jobs N] [--idle-exit S]`, "
+           "`tmx serve status [--json]` and `tmx enqueue --root DIR "
+           "--experiment EXP [--tenant T] [--priority P] "
+           "[--deadline SECS]`.  Every rejection reason carries a "
+           "pinned `retry_after_s` (DESIGN.md §20 policy table); a "
+           "SIGTERM'd daemon re-spools and exits the pinned code 75.",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for mod, prefix in ((serve, "serve"), (admission, "admission")):
+        for name in sorted(n for n in dir(mod) if not n.startswith("_")):
+            obj = getattr(mod, name)
+            if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+                continue
+            if getattr(obj, "__module__", "") != mod.__name__:
+                continue
+            doc = (inspect.getdoc(obj) or "").split("\n")[0]
+            out.append(f"| `{prefix}.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def main() -> None:
     lines = [
         "# tmlibrary_tpu API reference",
@@ -227,6 +257,7 @@ def main() -> None:
         *qc_section(),
         *perf_section(),
         *resilience_section(),
+        *serve_section(),
     ]
     # optional output override so a freshness check can generate into a
     # scratch path without clobbering the committed file

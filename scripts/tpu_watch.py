@@ -48,6 +48,7 @@ Exits on its own once every pending item is done.
 """
 import atexit
 import datetime
+import glob
 import json
 import os
 import signal
@@ -147,22 +148,7 @@ def load_json(path: str) -> dict:
         return {}
 
 
-def check_run_heartbeat() -> str | None:
-    """Inspect a live workflow run's resource-sampler heartbeat
-    (``WATCH_RUN_ROOT`` = its experiment store root) and report staleness.
-
-    The sampler (``telemetry.ResourceSampler``) refreshes the heartbeat
-    every period; a heartbeat older than 2x the period while the run's
-    process is supposedly working means the run is HUNG (relay wedge, GIL
-    deadlock), not slow — worth logging from the watcher box because the
-    hung process itself can no longer tell anyone."""
-    root = os.environ.get("WATCH_RUN_ROOT")
-    if not root:
-        return None
-    hb_path = os.path.join(root, "workflow", "heartbeat.json")
-    hb = load_json(hb_path)
-    if not hb or "ts" not in hb:
-        return None
+def _heartbeat_age(hb_path: str, hb: dict) -> float:
     # fresher-of(embedded ts, file mtime): the run may live on a host
     # whose clock is skewed from the watcher box — a live sampler still
     # touches the file, so mtime keeps a healthy run from reading STALE
@@ -171,15 +157,68 @@ def check_run_heartbeat() -> str | None:
         age = min(age, time.time() - os.stat(hb_path).st_mtime)
     except OSError:
         pass
-    age = max(0.0, age)
-    period = float(hb.get("period", 0) or 0)
-    if period > 0 and age > 2 * period:
-        msg = (f"run heartbeat at {root} is STALE: {age:.0f}s old "
-               f"(sampler period {period:g}s, pid {hb.get('pid')}) — "
-               "the run looks hung")
-        log(msg)
-        return msg
-    return None
+    return max(0.0, age)
+
+
+def _heartbeat_files(root: str) -> list[str]:
+    """All heartbeat files a run root can legitimately carry.
+
+    Multi-host fleets write one ``heartbeat_<host>.json`` per host next
+    to the legacy host0 ``heartbeat.json``; a ``tmx serve`` root carries
+    the daemon's own heartbeat under ``serve/`` plus one per in-flight
+    job experiment (roots read from the spooled job specs)."""
+    paths: list[str] = []
+    paths.extend(sorted(glob.glob(
+        os.path.join(root, "workflow", "heartbeat*.json"))))
+    serve_hb = os.path.join(root, "serve", "heartbeat.json")
+    if os.path.exists(serve_hb):
+        paths.append(serve_hb)
+        # active jobs run as ordinary workflows under their own
+        # experiment roots; a wedged job is invisible from the daemon
+        # heartbeat (the admission loop keeps beating), so follow the
+        # spooled specs to each job's own sampler heartbeat
+        for state in ("admitted", "incoming"):
+            for spec_path in sorted(glob.glob(
+                    os.path.join(root, "serve", "spool", state, "*.json"))):
+                job_root = load_json(spec_path).get("root")
+                if job_root:
+                    paths.extend(sorted(glob.glob(os.path.join(
+                        str(job_root), "workflow", "heartbeat*.json"))))
+    # de-dup, order-preserving: two spool specs may share an experiment
+    return list(dict.fromkeys(paths))
+
+
+def check_run_heartbeat() -> str | None:
+    """Inspect live workflow runs' resource-sampler heartbeats
+    (``WATCH_RUN_ROOT`` = experiment store root(s), ``os.pathsep``
+    separated) and report staleness.
+
+    The sampler (``telemetry.ResourceSampler``) refreshes the heartbeat
+    every period; a heartbeat older than 2x the period while the run's
+    process is supposedly working means the run is HUNG (relay wedge, GIL
+    deadlock), not slow — worth logging from the watcher box because the
+    hung process itself can no longer tell anyone.  One watcher process
+    covers many run roots (multiple experiments, or a ``tmx serve`` root
+    fanning out to per-job experiments) — the old single-root assumption
+    silently ignored every run but the first."""
+    raw = os.environ.get("WATCH_RUN_ROOT")
+    if not raw:
+        return None
+    stale: list[str] = []
+    for root in [r for r in raw.split(os.pathsep) if r]:
+        for hb_path in _heartbeat_files(root):
+            hb = load_json(hb_path)
+            if not hb or "ts" not in hb:
+                continue
+            age = _heartbeat_age(hb_path, hb)
+            period = float(hb.get("period", 0) or 0)
+            if period > 0 and age > 2 * period:
+                msg = (f"run heartbeat at {hb_path} is STALE: "
+                       f"{age:.0f}s old (sampler period {period:g}s, "
+                       f"pid {hb.get('pid')}) — the run looks hung")
+                log(msg)
+                stale.append(msg)
+    return "; ".join(stale) or None
 
 
 def save_cache(cache: dict) -> None:

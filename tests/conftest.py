@@ -46,6 +46,19 @@ def _reset_warn_once():
 
 
 @pytest.fixture(autouse=True)
+def _reset_routing_history():
+    """Bucket-routing history is process-global (scoped per
+    compiled-program key so serve jobs warm-start each other); tests
+    must each start from a cold router or one test's dense plate would
+    pre-route another's."""
+    from tmlibrary_tpu import capacity
+
+    capacity.reset_routing_history()
+    yield
+    capacity.reset_routing_history()
+
+
+@pytest.fixture(autouse=True)
 def _reset_qc():
     """The QC session singleton and its enable override are
     process-global; leak state and one test's sketches/flags bleed into

@@ -368,6 +368,45 @@ def test_watch_flags_stale_run_heartbeat(monkeypatch, tmp_path):
     assert w.check_run_heartbeat() is None
 
 
+def test_watch_heartbeat_covers_many_roots_and_serve(monkeypatch, tmp_path):
+    """WATCH_RUN_ROOT is pathsep-separated; a serve root fans out to the
+    daemon heartbeat plus each spooled job's own experiment heartbeat —
+    the old code silently watched only one hardcoded file."""
+    import time as _time
+
+    w = _watch(monkeypatch, tmp_path)
+
+    def write_hb(path, ts):
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps({"ts": ts, "pid": 9, "period": 5.0}))
+        os.utime(path, (ts, ts))
+
+    stale_t = _time.time() - 100.0
+    # root A healthy, root B stale: the second root must still be seen
+    write_hb(tmp_path / "a" / "workflow" / "heartbeat.json", _time.time())
+    write_hb(tmp_path / "b" / "workflow" / "heartbeat.json", stale_t)
+    monkeypatch.setenv(
+        "WATCH_RUN_ROOT",
+        os.pathsep.join([str(tmp_path / "a"), str(tmp_path / "b")]))
+    msg = w.check_run_heartbeat()
+    assert msg is not None and str(tmp_path / "b") in msg
+    assert str(tmp_path / "a") not in msg
+
+    # serve root: live daemon heartbeat, but an admitted job's own
+    # experiment sampler went quiet — followed via the spooled spec
+    srv = tmp_path / "srv"
+    write_hb(srv / "serve" / "heartbeat.json", _time.time())
+    job_root = tmp_path / "jobexp"
+    write_hb(job_root / "workflow" / "heartbeat.json", stale_t)
+    spool = srv / "serve" / "spool" / "admitted"
+    spool.mkdir(parents=True)
+    (spool / "j1.json").write_text(json.dumps(
+        {"job_id": "j1", "root": str(job_root), "tenant": "t"}))
+    monkeypatch.setenv("WATCH_RUN_ROOT", str(srv))
+    msg = w.check_run_heartbeat()
+    assert msg is not None and str(job_root) in msg
+
+
 def test_sweep_queue_rides_behind_headline_bench(monkeypatch, tmp_path):
     """The per-config strategy x depth sweeps queue behind every bench
     item (a sweep verdict improves future defaults; a headline number is

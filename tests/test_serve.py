@@ -1,0 +1,548 @@
+"""``tmx serve`` admission + daemon chaos suite (DESIGN.md §20).
+
+Proves the serving tentpole guarantees: overload degrades to pinned,
+deterministic rejection (never a crash), tenants are isolated (quotas,
+retry budgets, scoped breakers, WDRR fairness), and any interruption —
+SIGTERM drain, deadline expiry, injected admission faults — converges
+to the same results as clean sequential runs.  The in-process tests use
+a registered dummy step so the daemon loop stays fast; the real-pipeline
+coalescing proof at the bottom exercises the full jterator stack, and
+the real-process crossing lives in ``scripts/ci_serve_smoke.py``.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from test_workflow import synth_site_image  # noqa: F401 — reused below
+
+from tmlibrary_tpu import faults, resilience, serve, telemetry
+from tmlibrary_tpu.models.experiment import Experiment
+from tmlibrary_tpu.models.store import ExperimentStore
+from tmlibrary_tpu.resilience import EXIT_PREEMPTED
+from tmlibrary_tpu.workflow.admission import (
+    RETRY_AFTER_S,
+    SHED_REASONS,
+    AdmissionConfig,
+    AdmissionQueue,
+    JobSpec,
+)
+from tmlibrary_tpu.workflow.api import Step
+from tmlibrary_tpu.workflow.engine import (
+    RunLedger,
+    Workflow,
+    WorkflowDescription,
+    WorkflowStageDescription,
+    WorkflowStepDescription,
+)
+from tmlibrary_tpu.workflow.registry import register_step
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    resilience.clear_preemption()
+    telemetry.reset_registry(enabled=True)
+    ServeDummy.SLEEP = 0.0
+    yield
+    faults.clear()
+    resilience.clear_preemption()
+    telemetry.reset_registry()
+    ServeDummy.SLEEP = 0.0
+
+
+# --------------------------------------------------------------- dummy step
+@register_step("servedummy")
+class ServeDummy(Step):
+    """Four trivial batches with idempotent marker outputs — a replayed
+    batch after drain/resume must leave identical bytes."""
+
+    N_BATCHES = 4
+    #: per-batch stall so a deadline deterministically lands mid-run
+    SLEEP = 0.0
+
+    def create_batches(self, args):
+        return [{} for _ in range(self.N_BATCHES)]
+
+    def run_batch(self, batch):
+        if ServeDummy.SLEEP:
+            time.sleep(ServeDummy.SLEEP)
+        out = self.step_dir / f"out_{batch['index']:03d}.txt"
+        out.write_text(f"payload-{batch['index']}")
+        return {"i": batch["index"]}
+
+
+def dummy_description():
+    return WorkflowDescription(
+        stages=[WorkflowStageDescription(
+            name="test", steps=[WorkflowStepDescription(name="servedummy")]
+        )]
+    )
+
+
+def make_exp(tmp_path, name):
+    placeholder = Experiment(
+        name=name, plates=[], channels=[], site_height=1, site_width=1
+    )
+    store = ExperimentStore.create(tmp_path / name, placeholder)
+    dummy_description().save(store.workflow_dir / "workflow.yaml")
+    return store
+
+
+def spec(job_id, root, tenant="a", **kw):
+    kw.setdefault("submitted_at", 1000.0)
+    return JobSpec(job_id=job_id, root=str(root), tenant=tenant, **kw)
+
+
+def dummy_outputs(store):
+    step_dir = store.workflow_dir / "servedummy"
+    return {p.name: p.read_text() for p in step_dir.glob("out_*.txt")}
+
+
+# =========================================================== admission unit
+def test_queue_full_shed_hysteresis_and_determinism():
+    """At max_queue the queue sheds with the pinned queue_full
+    retry-after and KEEPS shedding until drained below the low
+    watermark; the whole decision sequence replays identically."""
+
+    def run_sequence():
+        q = AdmissionQueue(AdmissionConfig(
+            max_queue=4, low_watermark=2, tenant_quota=99))
+        decisions = []
+        for i in range(6):
+            decisions.append(q.offer(spec(f"j{i}", "/x", submitted_at=i)))
+        # drain to 3: still above the low watermark -> still shedding
+        q.take()
+        decisions.append(q.offer(spec("late1", "/x", submitted_at=9)))
+        # drain to 2 == low watermark -> hysteresis clears on next offer
+        q.take()
+        decisions.append(q.offer(spec("late2", "/x", submitted_at=10)))
+        return [(d.admitted, d.reason, d.retry_after_s) for d in decisions]
+
+    first = run_sequence()
+    assert first[:4] == [(True, None, 0.0)] * 4
+    assert first[4] == (False, "queue_full", RETRY_AFTER_S["queue_full"])
+    assert first[5] == (False, "queue_full", 30.0)
+    assert first[6] == (False, "queue_full", 30.0)  # hysteresis holds
+    assert first[7] == (True, None, 0.0)  # drained to low watermark
+    assert run_sequence() == first  # bit-for-bit deterministic
+
+
+def test_tenant_quota_and_breaker_isolation():
+    """One tenant's flood (or failure streak) never affects another."""
+    q = AdmissionQueue(AdmissionConfig(
+        max_queue=99, tenant_quota=2, breaker_threshold=2))
+    assert q.offer(spec("a1", "/x", tenant="a")).admitted
+    assert q.offer(spec("a2", "/x", tenant="a")).admitted
+    d = q.offer(spec("a3", "/x", tenant="a"))
+    assert (d.reason, d.retry_after_s) == ("tenant_quota", 15.0)
+    assert q.offer(spec("b1", "/x", tenant="b")).admitted
+
+    # two failures trip a's breaker; b keeps admitting
+    q.record_result("a", ok=False)
+    q.record_result("a", ok=False)
+    q.take(), q.take(), q.take()  # empty the queue
+    d = q.offer(spec("a4", "/x", tenant="a"))
+    assert (d.reason, d.retry_after_s) == ("tenant_breaker_open", 60.0)
+    assert q.offer(spec("b2", "/x", tenant="b")).admitted
+    snap = q.snapshot(now=2000.0)
+    assert snap["tenants"]["a"]["breaker"] == "open"
+    assert snap["tenants"]["b"]["breaker"] == "closed"
+
+
+def test_retry_budget_spend_and_refund():
+    q = AdmissionQueue(AdmissionConfig(
+        max_queue=99, tenant_quota=99, retry_budget=1))
+    # first-attempt jobs never spend the budget
+    assert q.offer(spec("f1", "/x")).admitted
+    # a resubmission spends the single token ...
+    assert q.offer(spec("r1", "/x", attempt=1)).admitted
+    d = q.offer(spec("r2", "/x", attempt=2))
+    assert (d.reason, d.retry_after_s) == ("retry_budget", 120.0)
+    # ... and a success refunds it
+    q.record_result("a", ok=True)
+    assert q.offer(spec("r3", "/x", attempt=1)).admitted
+
+
+def test_deadline_and_duplicate_rejected():
+    q = AdmissionQueue(AdmissionConfig(), clock=lambda: 100.0)
+    d = q.offer(spec("dead", "/x", deadline=99.0))
+    assert (d.admitted, d.reason, d.retry_after_s) == (
+        False, "deadline_expired", 0.0)
+    assert q.offer(spec("j1", "/x")).admitted
+    d = q.offer(spec("j1", "/x"))
+    assert (d.reason, d.retry_after_s) == ("duplicate", 0.0)
+
+
+def test_wdrr_weights_grant_proportional_service():
+    """Weight 2 means two jobs per rotation; weight 0.5 means one every
+    other rotation — and the schedule replays identically."""
+
+    def order(weights):
+        q = AdmissionQueue(AdmissionConfig(
+            max_queue=99, tenant_quota=99, tenant_weights=weights))
+        for i in range(4):
+            q.offer(spec(f"x{i}", "/t", tenant="a", submitted_at=float(i)))
+            q.offer(spec(f"y{i}", "/t", tenant="b", submitted_at=float(i)))
+        out = []
+        while (j := q.take()) is not None:
+            out.append(j.job_id)
+        return out
+
+    assert order({"b": 2.0}) == [
+        "x0", "y0", "y1", "x1", "y2", "y3", "x2", "x3"]
+    assert order({"b": 0.5}) == [
+        "x0", "x1", "y0", "x2", "x3", "y1", "y2", "y3"]
+    assert order({"b": 2.0}) == order({"b": 2.0})
+
+
+def test_within_tenant_priority_order_and_drain():
+    q = AdmissionQueue(AdmissionConfig(max_queue=99, tenant_quota=99))
+    q.offer(spec("lo", "/x", priority=0, submitted_at=1.0))
+    q.offer(spec("hi", "/x", priority=5, submitted_at=2.0, attempt=3))
+    q.offer(spec("mid", "/x", tenant="b", submitted_at=0.5))
+    assert q.take().job_id == "hi"
+    drained = q.drain()
+    # deterministic (tenant, priority) order, attempt counts preserved
+    assert [j.job_id for j in drained] == ["lo", "mid"]
+    assert q.depth() == 0
+
+
+# ============================================ ledger-derived serve metrics
+def test_registry_from_ledger_serve_events():
+    """A multi-tenant serve ledger reconstructs the same tmx_serve_*
+    series the live daemon emits — with per-tenant labels, shed
+    accounting, and duplicate-record drops (same host ledger read
+    twice must not double-count)."""
+    events = [
+        {"host": "h0", "ts": 1.0, "event": "serve_started", "recovered": 0},
+        {"host": "h0", "ts": 2.0, "event": "job_admitted", "job": "a-1",
+         "tenant": "a"},
+        # same ts, different job: must NOT collapse in dedup
+        {"host": "h0", "ts": 2.0, "event": "job_admitted", "job": "a-2",
+         "tenant": "a"},
+        {"host": "h0", "ts": 3.0, "event": "job_rejected", "job": "b-1",
+         "tenant": "b", "reason": "queue_full", "retry_after_s": 30.0},
+        {"host": "h0", "ts": 4.0, "event": "job_rejected", "job": "b-2",
+         "tenant": "b", "reason": "invalid_spec", "retry_after_s": 0.0},
+        {"host": "h0", "ts": 5.0, "event": "job_done", "job": "a-1",
+         "tenant": "a", "elapsed_s": 2.5},
+        {"host": "h0", "ts": 6.0, "event": "job_failed", "job": "a-2",
+         "tenant": "a", "error": "boom"},
+        {"host": "h0", "ts": 7.0, "event": "job_expired", "job": "b-3",
+         "tenant": "b"},
+        {"host": "h0", "ts": 8.0, "event": "job_requeued", "job": "a-3",
+         "tenant": "a", "phase": "drain"},
+        {"host": "h0", "ts": 9.0, "event": "serve_preempted",
+         "reason": "SIGTERM", "requeued": 2},
+    ]
+    reg = telemetry.registry_from_ledger(events + events)  # dup read
+    c = lambda name, **lb: reg.counter(name, **lb).value  # noqa: E731
+    assert c("tmx_serve_admitted_total", tenant="a", host="h0") == 2
+    assert c("tmx_serve_rejected_total", tenant="b", reason="queue_full",
+             host="h0") == 1
+    # only overload reasons count as shed
+    assert "queue_full" in SHED_REASONS and "invalid_spec" not in SHED_REASONS
+    assert c("tmx_serve_shed_total", tenant="b", host="h0") == 1
+    assert c("tmx_serve_jobs_done_total", tenant="a", host="h0") == 1
+    assert c("tmx_serve_jobs_failed_total", tenant="a", host="h0") == 1
+    assert c("tmx_serve_deadline_expired_total", tenant="b", host="h0") == 1
+    assert c("tmx_serve_requeued_total", tenant="a", host="h0") == 1
+    assert c("tmx_serve_preemptions_total", host="h0") == 1
+    h = reg.histogram("tmx_serve_job_seconds", tenant="a", host="h0")
+    assert h.count == 1 and h.sum == pytest.approx(2.5)
+
+
+# ====================================================== daemon end to end
+def test_serve_two_tenants_end_to_end(tmp_path, capsys):
+    """Two tenants' jobs flow incoming -> admitted -> done, the serve
+    ledger narrates each transition, and the status surfaces (CLI +
+    serve_status_view) agree with the spool."""
+    from tmlibrary_tpu.cli import main
+
+    sroot = tmp_path / "srv"
+    exp_a = make_exp(tmp_path, "expa")
+    exp_b = make_exp(tmp_path, "expb")
+    serve.enqueue_job(sroot, spec("a-1", exp_a.root, tenant="a"))
+    # the second submission goes through the real CLI
+    assert main(["enqueue", "--root", str(sroot),
+                 "--experiment", str(exp_b.root),
+                 "--tenant", "b", "--job-id", "b-1"]) == 0
+    assert "enqueued b-1" in capsys.readouterr().out
+
+    rc = serve.run_serve(sroot, poll_s=0.01, max_jobs=2,
+                         install_handlers=False)
+    assert rc == 0
+
+    done = sorted(p.stem for p in serve.spool_dir(sroot, "done")
+                  .glob("*.json"))
+    assert done == ["a-1", "b-1"]
+    assert not list(serve.spool_dir(sroot, "incoming").glob("*.json"))
+    assert not list(serve.spool_dir(sroot, "admitted").glob("*.json"))
+    assert dummy_outputs(exp_a) == {
+        f"out_{i:03d}.txt": f"payload-{i}" for i in range(4)}
+
+    events = RunLedger(serve.ledger_path(sroot)).events()
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "serve_started"
+    for job, tenant in (("a-1", "a"), ("b-1", "b")):
+        for kind in ("job_admitted", "job_started", "job_done"):
+            assert any(e.get("event") == kind and e.get("job") == job
+                       and e.get("tenant") == tenant for e in events)
+    assert not any(e.get("event") == "step_failed" for e in events)
+
+    view = serve.serve_status_view(sroot)
+    assert view["spool"]["done"] == 2
+    assert view["tenants"]["a"]["done"] == 1
+    assert view["tenants"]["b"]["admitted"] == 1
+    assert main(["serve", "status", "--root", str(sroot)]) == 0
+    out = capsys.readouterr().out
+    assert "serve root" in out and "a" in out and "b" in out
+    assert main(["serve", "status", "--root", str(sroot), "--json"]) == 0
+    assert json.loads(capsys.readouterr().out)["spool"]["done"] == 2
+
+
+def test_serve_overload_sheds_never_crashes(tmp_path):
+    """Flooding past the watermark rejects with pinned envelopes and
+    metrics — no exception, no step_failed, queue capped — and the same
+    flood under a seeded probabilistic admission fault plan sheds
+    IDENTICALLY on replay (satellite: shed determinism under faults)."""
+    exp = make_exp(tmp_path, "exp")
+
+    def flood(sroot, with_faults):
+        if with_faults:
+            faults.install(faults.FaultPlan([
+                faults.FaultSpec(site="admission", kind="io_error",
+                                 times=99, probability=0.5),
+            ], seed=7))
+        else:
+            faults.clear()
+        for i in range(8):
+            serve.enqueue_job(sroot, spec(
+                f"f-{i}", exp.root, submitted_at=float(i)))
+        # one bad spec rides along: must reject, not crash the scan
+        (serve.spool_dir(sroot, "incoming") / "bad.json").write_text("{not")
+        daemon = serve.ServeDaemon(
+            sroot, admission=AdmissionConfig(max_queue=3, tenant_quota=99),
+            install_handlers=False)
+        daemon._scan_incoming()  # must not raise
+        rejected = {}
+        for p in serve.spool_dir(sroot, "rejected").glob("*.json"):
+            env = json.loads(p.read_text())
+            rejected[p.stem] = (env["decision"]["reason"],
+                                env["decision"]["retry_after_s"])
+        return daemon, rejected
+
+    daemon, rejected = flood(tmp_path / "s1", with_faults=False)
+    assert daemon.queue.depth() == 3 and daemon.queue.shedding()
+    assert rejected.pop("bad") == ("invalid_spec", 0.0)
+    assert set(rejected.values()) == {("queue_full", 30.0)}
+    assert len(rejected) == 5
+    reg = telemetry.get_registry()
+    assert reg.counter("tmx_serve_rejected_total", tenant="a",
+                       reason="queue_full").value == 5
+    assert reg.counter("tmx_serve_shed_total", tenant="a").value == 5
+    events = RunLedger(serve.ledger_path(tmp_path / "s1")).events()
+    assert sum(e.get("event") == "job_rejected" for e in events) == 6
+    assert not any(e.get("event") == "step_failed" for e in events)
+
+    # seeded fault plan: injected admission faults become pinned
+    # admission_fault rejections, and two replays shed identically
+    _, r1 = flood(tmp_path / "s2", with_faults=True)
+    _, r2 = flood(tmp_path / "s3", with_faults=True)
+    assert r1 == r2
+    assert ("admission_fault", 10.0) in r1.values()
+    assert all(reason in ("admission_fault", "queue_full", "invalid_spec")
+               for reason, _ in r1.values())
+
+
+def test_serve_deadline_expires_mid_run(tmp_path):
+    """An admitted job whose deadline passes mid-run is cancelled at the
+    next batch boundary: partial outputs persist, the job lands in
+    spool/expired, and the daemon keeps serving (exit 0)."""
+    sroot = tmp_path / "srv"
+    exp = make_exp(tmp_path, "exp")
+    ServeDummy.SLEEP = 0.1
+    serve.enqueue_job(sroot, spec(
+        "late-1", exp.root, deadline=time.time() + 0.15))
+    rc = serve.run_serve(sroot, poll_s=0.01, max_jobs=1,
+                         install_handlers=False)
+    assert rc == 0
+    env = json.loads(
+        (serve.spool_dir(sroot, "expired") / "late-1.json").read_text())
+    assert env["reason"] == "deadline"
+    events = RunLedger(serve.ledger_path(sroot)).events()
+    assert any(e.get("event") == "job_expired" and e.get("job") == "late-1"
+               for e in events)
+    # cancelled at a batch boundary, not mid-write: every marker that
+    # exists is complete, and not all of them ran
+    outs = dummy_outputs(exp)
+    assert all(v == f"payload-{int(k[4:7])}" for k, v in outs.items())
+    assert len(outs) < ServeDummy.N_BATCHES
+    assert telemetry.get_registry().counter(
+        "tmx_serve_deadline_expired_total", tenant="a").value == 1
+
+
+def test_serve_sigterm_drain_restart_converges(tmp_path):
+    """THE chaos convergence proof: SIGTERM mid-job drains the engine,
+    re-spools every admitted-but-unfinished job, exits 75; a restarted
+    daemon resumes and the final outputs are bit-identical to clean
+    direct runs — a preemption is routine, not an outage."""
+    sroot = tmp_path / "srv"
+    exp_a = make_exp(tmp_path, "expa")
+    exp_b = make_exp(tmp_path, "expb")
+    serve.enqueue_job(sroot, spec("a-1", exp_a.root, tenant="a"))
+    serve.enqueue_job(sroot, spec("b-1", exp_b.root, tenant="b"))
+    faults.install(faults.FaultPlan([
+        faults.FaultSpec(site="batch_run", kind="sigterm",
+                         step="servedummy", batch=1),
+    ]))
+    rc = serve.run_serve(sroot, poll_s=0.01, install_handlers=True)
+    assert rc == EXIT_PREEMPTED
+    # both jobs back in incoming/ (interrupted + queued), none lost
+    assert sorted(p.stem for p in serve.spool_dir(sroot, "incoming")
+                  .glob("*.json")) == ["a-1", "b-1"]
+    assert not list(serve.spool_dir(sroot, "admitted").glob("*.json"))
+    events = RunLedger(serve.ledger_path(sroot)).events()
+    pre = [e for e in events if e.get("event") == "serve_preempted"]
+    assert len(pre) == 1 and pre[0]["requeued"] == 2
+    assert sum(e.get("event") == "job_requeued" for e in events) == 2
+
+    # restart: recovery + resume, both jobs complete
+    faults.clear()
+    resilience.clear_preemption()
+    rc = serve.run_serve(sroot, poll_s=0.01, max_jobs=2,
+                         install_handlers=True)
+    assert rc == 0
+    assert sorted(p.stem for p in serve.spool_dir(sroot, "done")
+                  .glob("*.json")) == ["a-1", "b-1"]
+
+    # convergence: bit-identical to clean direct sequential runs
+    ref = make_exp(tmp_path, "ref")
+    Workflow(ref, dummy_description()).run()
+    assert dummy_outputs(exp_a) == dummy_outputs(ref)
+    assert dummy_outputs(exp_b) == dummy_outputs(ref)
+    # and no duplicated batches in either job ledger
+    for exp in (exp_a, exp_b):
+        done = [e["batch"]
+                for e in RunLedger(exp.workflow_dir / "ledger.jsonl").events()
+                if e.get("event") == "batch_done"]
+        assert sorted(done) == list(range(ServeDummy.N_BATCHES))
+
+
+def test_serve_hard_crash_recovery_respools_admitted(tmp_path):
+    """Startup recovery is the crash-consistent counterpart of the
+    SIGTERM drain: jobs a dead daemon left in admitted/ re-spool to
+    incoming/ and run to completion."""
+    sroot = tmp_path / "srv"
+    exp = make_exp(tmp_path, "exp")
+    serve.ensure_layout(sroot)
+    # simulate a daemon that died after admitting but before running
+    from tmlibrary_tpu.atomicio import atomic_write_json
+    atomic_write_json(serve.spool_dir(sroot, "admitted") / "a-1.json",
+                      spec("a-1", exp.root).to_dict())
+    rc = serve.run_serve(sroot, poll_s=0.01, max_jobs=1,
+                         install_handlers=False)
+    assert rc == 0
+    assert [p.stem for p in serve.spool_dir(sroot, "done")
+            .glob("*.json")] == ["a-1"]
+    events = RunLedger(serve.ledger_path(sroot)).events()
+    rec = [e for e in events if e.get("event") == "job_requeued"
+           and e.get("phase") == "recovery"]
+    assert len(rec) == 1
+    started = [e for e in events if e.get("event") == "serve_started"]
+    assert started[0]["recovered"] == 1
+
+
+def test_enqueue_fault_site_fails_cleanly(tmp_path, capsys):
+    """An injected enqueue fault surfaces as a CLI error (exit 1), never
+    a traceback or a half-written spec in the spool."""
+    from tmlibrary_tpu.cli import main
+
+    sroot = tmp_path / "srv"
+    exp = make_exp(tmp_path, "exp")
+    faults.install(faults.FaultPlan([
+        faults.FaultSpec(site="enqueue", kind="io_error", step="a"),
+    ]))
+    assert main(["enqueue", "--root", str(sroot),
+                 "--experiment", str(exp.root),
+                 "--tenant", "a", "--job-id", "a-1"]) == 1
+    assert "enqueue failed" in capsys.readouterr().err
+    assert not list(serve.spool_dir(sroot, "incoming").glob("*.json"))
+    # the fault burned its one shot; the retry lands
+    assert main(["enqueue", "--root", str(sroot),
+                 "--experiment", str(exp.root),
+                 "--tenant", "a", "--job-id", "a-1"]) == 0
+
+
+def test_top_dashboard_renders_serve_panel(tmp_path):
+    """`tmx top` over a serve root grows a SERVE panel with queue bar,
+    shedding flag and per-tenant rows."""
+    from tmlibrary_tpu import top
+
+    sroot = tmp_path / "srv"
+    exp = make_exp(tmp_path, "exp")
+    serve.enqueue_job(sroot, spec("a-1", exp.root, tenant="a"))
+    rc = serve.run_serve(sroot, poll_s=0.01, max_jobs=1,
+                         install_handlers=False)
+    assert rc == 0
+    view = top.collect_fleet(sroot)
+    assert view["serve"] is not None
+    text = top.render_dashboard(view)
+    assert "serve" in text and "a" in text
+
+
+# ============================================= cross-tenant coalescing
+def test_cross_tenant_coalescing_no_recompile(tmp_path, rng):
+    """Two tenants running the SAME pipeline content against different
+    experiments share one compiled program: after tenant A's job primes
+    the process-level caches, tenant B's job adds ZERO compiles to the
+    perf profile (the acceptance metric behind keeping the daemon
+    resident)."""
+    import cv2
+
+    from test_workflow import make_description
+
+    from tmlibrary_tpu import perf
+
+    src = tmp_path / "microscope"
+    src.mkdir()
+    for site in range(4):
+        cv2.imwrite(str(src / f"A01_s{site}_DAPI.png"),
+                    synth_site_image(rng))
+
+    def make_real_exp(name):
+        placeholder = Experiment(
+            name=name, plates=[], channels=[], site_height=1, site_width=1
+        )
+        store = ExperimentStore.create(tmp_path / name, placeholder)
+        desc = make_description(src, store)
+        desc.save(store.workflow_dir / "workflow.yaml")
+        return store
+
+    def total_compiles():
+        return sum(p.get("compiles", 0) for p in perf.perf_profiles())
+
+    exp_a = make_real_exp("tenant_a")
+    exp_b = make_real_exp("tenant_b")
+    sroot = tmp_path / "srv"
+    serve.enqueue_job(sroot, spec("a-1", exp_a.root, tenant="a"))
+    assert serve.run_serve(sroot, poll_s=0.01, max_jobs=1,
+                           install_handlers=False) == 0
+    primed = total_compiles()
+
+    serve.enqueue_job(sroot, spec("b-1", exp_b.root, tenant="b"))
+    assert serve.run_serve(sroot, poll_s=0.01, max_jobs=1,
+                           install_handlers=False) == 0
+    assert total_compiles() == primed, (
+        "tenant B's identical pipeline recompiled instead of coalescing")
+
+    done = sorted(p.stem for p in serve.spool_dir(sroot, "done")
+                  .glob("*.json"))
+    assert done == ["a-1", "b-1"]
+    # both tenants produced real features from their own stores
+    for store in (exp_a, exp_b):
+        feats = ExperimentStore.open(store.root).read_features("nuclei")
+        assert len(feats) > 0

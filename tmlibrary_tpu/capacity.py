@@ -39,7 +39,9 @@ appended so escalation can reach it).
 
 from __future__ import annotations
 
+import hashlib
 import os
+import threading
 
 from tmlibrary_tpu.utils import next_power_of_two
 
@@ -133,3 +135,62 @@ def ceiling_slots(slots: int, cap: int, ceiling: int) -> int:
     the capacity), shared by the live ``tmx_jterator_padded_flops_avoided_frac``
     gauge and ``telemetry.registry_from_ledger``'s post-hoc derivation."""
     return (int(slots) // int(cap)) * int(ceiling) if cap else 0
+
+
+# --------------------------------------------------------------- routing
+# Peak-object-count history, scoped PER COMPILED-PROGRAM KEY.  A single
+# ``tmx workflow submit`` only ever ran one pipeline, so the jterator
+# step could keep the peak as an instance attribute — but a long-lived
+# ``tmx serve`` process interleaves many experiments, and a shared (or
+# instance-reset-per-job) history makes tenants with different object
+# densities thrash each other's capacity-rung choices.  Keying the
+# history by (description digest, ceiling, ladder) means: jobs running
+# the SAME compiled-program family warm-start each other's routing,
+# while unrelated pipelines never interact.  Routing is purely a
+# performance decision (bit-identity contract above), so sharing can
+# never change results.
+
+_ROUTING_LOCK = threading.Lock()
+_ROUTING_HISTORY: dict[str, int] = {}
+
+
+def routing_key(description_key: str, ceiling: int,
+                ladder: tuple[int, ...]) -> str:
+    """Stable digest naming one compiled-program family for routing
+    purposes: the pipeline-description content key (see
+    ``jterator.pipeline.description_digest``) plus the capacity ceiling
+    and the resolved ladder (two runs of one description with different
+    bucket specs route independently)."""
+    blob = f"{description_key}|{int(ceiling)}|{tuple(int(c) for c in ladder)}"
+    return hashlib.sha1(blob.encode()).hexdigest()[:16]
+
+
+def observed_peak(key: str) -> "int | None":
+    """Highest per-site object count recorded for ``key`` so far, or
+    None when no batch of this program family has persisted yet."""
+    with _ROUTING_LOCK:
+        return _ROUTING_HISTORY.get(key)
+
+
+def note_observed_peak(key: str, count: int) -> int:
+    """Max-merge ``count`` into ``key``'s history (persist workers call
+    this concurrently with the engine thread's routing reads); returns
+    the new peak."""
+    count = int(count)
+    with _ROUTING_LOCK:
+        prior = _ROUTING_HISTORY.get(key)
+        peak = count if prior is None else max(prior, count)
+        _ROUTING_HISTORY[key] = peak
+        return peak
+
+
+def routing_history_snapshot() -> dict[str, int]:
+    """Copy of the per-program peak table (status/debug surfaces)."""
+    with _ROUTING_LOCK:
+        return dict(_ROUTING_HISTORY)
+
+
+def reset_routing_history() -> None:
+    """Drop all routing history (tests, fresh benchmarking runs)."""
+    with _ROUTING_LOCK:
+        _ROUTING_HISTORY.clear()

@@ -24,6 +24,7 @@ import argparse
 import json
 import os
 import sys
+import time
 from pathlib import Path
 
 import numpy as np
@@ -366,6 +367,77 @@ def build_parser() -> argparse.ArgumentParser:
         default="canonical", help="workflow type (multiplexing adds align)",
     )
 
+    p_serve = sub.add_parser(
+        "serve", help="always-on analysis service (spool-fed job stream "
+                      "with admission control)")
+    serve_sub = p_serve.add_subparsers(dest="verb", required=True)
+    p_srun = serve_sub.add_parser(
+        "run", help="run the serve daemon over a spool root")
+    _add_common(p_srun)
+    p_srun.add_argument("--max-queue", type=int, default=None, metavar="N",
+                        help="admission-queue high watermark: at this depth "
+                             "new jobs are shed with the pinned queue_full "
+                             "retry-after (default TM_SERVE_MAX_QUEUE, 64)")
+    p_srun.add_argument("--low-watermark", type=int, default=None,
+                        metavar="N",
+                        help="shedding stops once the queue drains to this "
+                             "depth (hysteresis; default max-queue/2)")
+    p_srun.add_argument("--tenant-quota", type=int, default=None,
+                        metavar="N",
+                        help="max queued jobs per tenant (default "
+                             "TM_SERVE_TENANT_QUOTA, 16)")
+    p_srun.add_argument("--retry-budget", type=int, default=None,
+                        metavar="N",
+                        help="per-tenant retry budget: resubmissions spend "
+                             "one token, successes refund one (default "
+                             "TM_SERVE_RETRY_BUDGET, 8)")
+    p_srun.add_argument("--tenant-weights", default=None, metavar="T=W,...",
+                        help="weighted deficit-round-robin weights, e.g. "
+                             "'prod=3,dev=1' (default: 1 each)")
+    p_srun.add_argument("--poll", type=float, default=None,
+                        metavar="SECONDS",
+                        help="spool poll period (default TM_SERVE_POLL_S, "
+                             "0.5)")
+    p_srun.add_argument("--max-jobs", type=int, default=0, metavar="N",
+                        help="exit 0 after N completed jobs (0 = serve "
+                             "forever; CI/smoke harnesses)")
+    p_srun.add_argument("--idle-exit", type=float, default=0.0,
+                        metavar="SECONDS",
+                        help="exit 0 after this long with an empty queue "
+                             "(0 = never)")
+    p_srun.add_argument("--no-telemetry", action="store_true",
+                        help="disable the metrics registry for the daemon")
+    p_sstatus = serve_sub.add_parser(
+        "status", help="queue depth, per-tenant admitted/rejected/"
+                       "budget-remaining, oldest-job age")
+    _add_common(p_sstatus)
+    p_sstatus.add_argument("--json", action="store_true", dest="as_json",
+                           help="emit the full status view as JSON")
+
+    p_enq = sub.add_parser(
+        "enqueue", help="submit one job spec to a serve spool")
+    _add_common(p_enq)
+    p_enq.add_argument("--experiment", required=True, metavar="DIR",
+                       help="experiment store root the job runs against")
+    p_enq.add_argument("--tenant", default="default",
+                       help="tenant the job is accounted to")
+    p_enq.add_argument("--job-id", default=None,
+                       help="unique job id (default: generated)")
+    p_enq.add_argument("--description", default=None,
+                       help="workflow YAML (default: the experiment's "
+                            "workflow/workflow.yaml)")
+    p_enq.add_argument("--priority", type=int, default=0,
+                       help="within-tenant priority (higher first)")
+    p_enq.add_argument("--deadline", type=float, default=None,
+                       metavar="SECONDS",
+                       help="relative deadline; an expired job is "
+                            "cancelled at the next batch boundary")
+    p_enq.add_argument("--pipeline-depth", type=int, default=None,
+                       metavar="N", help="per-job pipelined-executor depth")
+    p_enq.add_argument("--attempt", type=int, default=0, metavar="N",
+                       help="resubmission count (attempt > 0 spends one "
+                            "retry-budget token)")
+
     p_tool = sub.add_parser("tool", help="analysis tools over the feature store")
     tool_sub = p_tool.add_subparsers(dest="verb", required=True)
     p_tsubmit = tool_sub.add_parser("submit", help="run one tool request")
@@ -444,6 +516,29 @@ def build_parser() -> argparse.ArgumentParser:
 
 def _open_store(args) -> ExperimentStore:
     return ExperimentStore.open(Path(args.root))
+
+
+def _render_heartbeats(hb_dir: Path, running: bool) -> None:
+    """Heartbeat liveness lines, shared by ``tmx workflow status`` and
+    ``tmx serve status``: a running process with a stale heartbeat is a
+    HUNG one (sampler/daemon thread dead or blocked), not a slow one."""
+    from tmlibrary_tpu import telemetry
+
+    for hb_path in sorted(Path(hb_dir).glob("heartbeat*.json")):
+        hb = telemetry.read_heartbeat(hb_path)
+        if not hb or "ts" not in hb:
+            continue
+        # fresher-of(embedded ts, file mtime): cross-host clock skew
+        # must not flag a live remote host's run as hung
+        age = telemetry.heartbeat_age(hb_path)
+        period = float(hb.get("period", 0) or 0)
+        host = str(hb.get("host") or "host0")
+        tag = "" if host == "host0" else f"[{host}]"
+        line = (f"heartbeat{tag}: {age:.1f}s ago "
+                f"(sampler period {period:g}s)")
+        if running and period > 0 and age > 2 * period:
+            line += " — STALE: run appears hung"
+        print(line)
 
 
 def _cleanup_step(step) -> None:
@@ -666,26 +761,8 @@ def cmd_workflow(args) -> int:
             print(f"backend degraded to {degraded.get('backend')} "
                   f"(at step '{degraded.get('where')}' after "
                   f"{degraded.get('failures')} failed device probes)")
-        # resource-sampler heartbeat: a running step with a stale heartbeat
-        # is a HUNG run (sampler thread dead/blocked), not a slow one
-        from tmlibrary_tpu import telemetry
-
         running = any(e.get("state") == "running" for e in status.values())
-        for hb_path in sorted(store.workflow_dir.glob("heartbeat*.json")):
-            hb = telemetry.read_heartbeat(hb_path)
-            if not hb or "ts" not in hb:
-                continue
-            # fresher-of(embedded ts, file mtime): cross-host clock skew
-            # must not flag a live remote host's run as hung
-            age = telemetry.heartbeat_age(hb_path)
-            period = float(hb.get("period", 0) or 0)
-            host = str(hb.get("host") or "host0")
-            tag = "" if host == "host0" else f"[{host}]"
-            line = (f"heartbeat{tag}: {age:.1f}s ago "
-                    f"(sampler period {period:g}s)")
-            if running and period > 0 and age > 2 * period:
-                line += " — STALE: run appears hung"
-            print(line)
+        _render_heartbeats(store.workflow_dir, running)
         try:
             # one-line bench-record staleness warning: the certified
             # throughput evidence ages even while runs look healthy
@@ -826,6 +903,121 @@ def cmd_workflow(args) -> int:
     finally:
         restore()
     print(json.dumps(summary, default=str, indent=2))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from tmlibrary_tpu import serve as serve_mod
+
+    root = Path(args.root)
+    if args.verb == "status":
+        view = serve_mod.serve_status_view(root)
+        if args.as_json:
+            print(json.dumps(view, indent=2, sort_keys=True))
+            return 0
+        live = "LIVE" if view.get("live") else "not running"
+        print(f"serve root: {view['root']}  [{live}]")
+        status = view.get("status") or {}
+        if status:
+            depth = status.get("depth", 0)
+            line = (f"queue depth {depth}/{status.get('high_watermark', '?')}"
+                    f" (low watermark {status.get('low_watermark', '?')})")
+            if status.get("shedding"):
+                line += " — SHEDDING"
+            print(line)
+            age = status.get("oldest_job_age_s")
+            if age is not None:
+                print(f"oldest queued job: {age:.1f}s ago")
+        spool = view.get("spool") or {}
+        if spool:
+            print("spool: " + "  ".join(
+                f"{state} {n}" for state, n in spool.items()))
+        # per-tenant table: live queue/budget/breaker state from the
+        # daemon's snapshot, lifetime outcomes from the serve ledger
+        live_tenants = (status.get("tenants") or {})
+        ledger_tenants = view.get("tenants") or {}
+        names = sorted(set(live_tenants) | set(ledger_tenants))
+        if names:
+            print(f"{'tenant':16s} {'queued':>6s} {'admitted':>8s} "
+                  f"{'rejected':>8s} {'done':>5s} {'failed':>6s} "
+                  f"{'budget':>6s} breaker")
+            for name in names:
+                lt = live_tenants.get(name, {})
+                gt = ledger_tenants.get(name, {})
+                print(f"{name:16s} {lt.get('queued', 0):>6d} "
+                      f"{gt.get('admitted', lt.get('admitted', 0)):>8d} "
+                      f"{gt.get('rejected', lt.get('rejected', 0)):>8d} "
+                      f"{gt.get('done', 0):>5d} {gt.get('failed', 0):>6d} "
+                      f"{str(lt.get('retry_budget_remaining', '-')):>6s} "
+                      f"{lt.get('breaker', '-')}")
+        if view.get("preemptions"):
+            print(f"preemptions: {view['preemptions']} (drained + "
+                  "re-spooled; jobs converge on restart)")
+        _render_heartbeats(serve_mod.serve_dir(root),
+                           running=bool(view.get("live")))
+        return 0
+    # run
+    from tmlibrary_tpu import telemetry
+    from tmlibrary_tpu.resilience import EXIT_PREEMPTED
+    from tmlibrary_tpu.workflow.admission import AdmissionConfig
+
+    if args.no_telemetry:
+        telemetry.set_enabled(False)
+    admission = AdmissionConfig.from_library_config()
+    if args.max_queue is not None:
+        admission.max_queue = args.max_queue
+    if args.low_watermark is not None:
+        admission.low_watermark = args.low_watermark
+    if args.tenant_quota is not None:
+        admission.tenant_quota = args.tenant_quota
+    if args.retry_budget is not None:
+        admission.retry_budget = args.retry_budget
+    if args.tenant_weights:
+        weights = {}
+        for part in args.tenant_weights.split(","):
+            name, _, w = part.partition("=")
+            if not name or not w:
+                print(f"error: bad --tenant-weights entry '{part}' "
+                      "(expected TENANT=WEIGHT)", file=sys.stderr)
+                return 1
+            weights[name.strip()] = float(w)
+        admission.tenant_weights = weights
+    rc = serve_mod.run_serve(
+        root, admission=admission, poll_s=args.poll,
+        max_jobs=args.max_jobs, idle_exit_s=args.idle_exit,
+    )
+    if rc == EXIT_PREEMPTED:
+        print("serve preempted: queued jobs re-spooled — restart "
+              "`tmx serve run` to resume", file=sys.stderr)
+    return rc
+
+
+def cmd_enqueue(args) -> int:
+    import uuid
+
+    from tmlibrary_tpu import serve as serve_mod
+    from tmlibrary_tpu.workflow.admission import JobSpec
+
+    now = time.time()
+    job_id = args.job_id or f"{args.tenant}-{uuid.uuid4().hex[:10]}"
+    spec = JobSpec(
+        job_id=job_id,
+        tenant=args.tenant,
+        root=str(Path(args.experiment).resolve()),
+        description=args.description,
+        priority=args.priority,
+        deadline=(now + args.deadline) if args.deadline else None,
+        pipeline_depth=args.pipeline_depth,
+        attempt=args.attempt,
+        submitted_at=now,
+    )
+    try:
+        path = serve_mod.enqueue_job(Path(args.root), spec)
+    except Exception as exc:
+        print(f"error: enqueue failed for job {job_id}: {exc}",
+              file=sys.stderr)
+        return 1
+    print(f"enqueued {job_id} (tenant {spec.tenant}) -> {path}")
     return 0
 
 
@@ -1704,6 +1896,10 @@ def main(argv=None) -> int:
             return cmd_create(args)
         if args.command == "workflow":
             return cmd_workflow(args)
+        if args.command == "serve":
+            return cmd_serve(args)
+        if args.command == "enqueue":
+            return cmd_enqueue(args)
         if args.command == "tool":
             return cmd_tool(args)
         if args.command == "project":

@@ -195,6 +195,38 @@ class LibraryConfig:
     qc_flag_budget: float = dataclasses.field(
         default_factory=lambda: float(_setting("qc_flag_budget", "0.5"))
     )
+    # ---------------------------------------------------------- serving
+    # (serve.py / workflow/admission.py; env: TM_SERVE_* — CLI flags on
+    # `tmx serve run` beat these)
+    #: admission-queue high watermark: at this depth new jobs are shed
+    serve_max_queue: int = dataclasses.field(
+        default_factory=lambda: int(_setting("serve_max_queue", "64"))
+    )
+    #: low watermark shedding hysteresis re-admits below; 0 = max/2
+    serve_low_watermark: int = dataclasses.field(
+        default_factory=lambda: int(_setting("serve_low_watermark", "0"))
+    )
+    #: per-tenant cap on queued jobs (fairness floor for everyone else)
+    serve_tenant_quota: int = dataclasses.field(
+        default_factory=lambda: int(_setting("serve_tenant_quota", "16"))
+    )
+    #: per-tenant retry budget: resubmissions (attempt > 0) spend one
+    #: token each; an exhausted budget converts a retry storm into
+    #: early rejection.  A successful job refunds one token.
+    serve_retry_budget: int = dataclasses.field(
+        default_factory=lambda: int(_setting("serve_retry_budget", "8"))
+    )
+    #: spool poll period for the serve daemon, seconds
+    serve_poll_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("serve_poll_s", "0.5"))
+    )
+    #: admission-phase watchdog deadline, seconds (0 disarms; only armed
+    #: when the watchdog master switch is on)
+    serve_admission_deadline_s: float = dataclasses.field(
+        default_factory=lambda: float(
+            _setting("serve_admission_deadline_s", "60")
+        )
+    )
 
     def experiment_location(self, experiment_name: str) -> Path:
         return Path(self.storage_home) / "experiments" / experiment_name

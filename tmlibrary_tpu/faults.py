@@ -31,6 +31,17 @@ Hook sites (``site`` field of a spec):
 ``device_probe``
     fired inside the device health probe — ``kind="hang"`` sleeps
     past the probe deadline (a down relay hangs, it doesn't error).
+``enqueue``
+    fired inside :func:`tmlibrary_tpu.serve.enqueue_job` before the
+    spec hits the spool (context: ``step`` = tenant, ``event`` = job
+    id) — simulates a failing/flooding submission path.
+``admission``
+    fired inside the serve daemon's spool scan, per offered job
+    (context: ``step`` = tenant, ``event`` = job id).  ``hang`` wedges
+    the admission loop (the admission-phase watchdog fires); any
+    non-fatal raising kind converts to a pinned ``admission_fault``
+    rejection — chaos can flood or wedge the queue but never crash
+    the daemon.  Neither site forces the sequential engine path.
 
 Two kinds are special.  ``kill`` hard-exits the process
 (``os._exit(41)``) instead of raising — no exception propagation, no

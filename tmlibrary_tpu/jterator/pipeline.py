@@ -73,6 +73,17 @@ def _description_cache_key(description: PipelineDescription) -> str:
     )
 
 
+def description_digest(description: PipelineDescription) -> str:
+    """Short content digest of a pipeline description — the identity two
+    experiments share when they run the same pipeline (store paths never
+    enter the description, so cross-tenant runs of identical ``.pipe``
+    content coalesce).  Used by ``capacity.routing_key`` to scope the
+    bucket-routing history per compiled-program family."""
+    return hashlib.sha1(
+        _description_cache_key(description).encode()
+    ).hexdigest()[:16]
+
+
 def donation_enabled() -> bool:
     """Whether engine-built batch programs donate their input buffers by
     default (``TM_DONATE_BUFFERS`` env / INI ``donate_buffers``; on unless

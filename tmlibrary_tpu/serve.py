@@ -1,0 +1,557 @@
+"""``tmx serve`` — the always-on analysis service.
+
+A long-lived daemon that accepts a continuous stream of workflow jobs
+across many concurrent experiments.  Jobs are JSON specs dropped into a
+**spool directory** (``tmx enqueue`` writes them atomically), so no
+network stack is needed and the whole submission path inherits the
+crash-consistency story of ``atomicio`` + the CRC-sealed run ledger.
+
+Spool lifecycle (every transition is an atomic write or same-fs rename)::
+
+    spool/incoming/<job>.json      tmx enqueue drops specs here
+        │  admission (bounded queue, quotas, WDRR, retry budgets,
+        │             per-tenant breakers — workflow/admission.py)
+        ├── admitted  → spool/admitted/<job>.json  + job_admitted event
+        └── rejected  → spool/rejected/<job>.json  + job_rejected event
+                        (decision envelope with the pinned retry_after_s)
+    spool/admitted/<job>.json      queued or running
+        ├── success   → spool/done/<job>.json      + job_done event
+        ├── failure   → spool/failed/<job>.json    + job_failed event
+        ├── deadline  → spool/expired/<job>.json   + job_expired event
+        └── SIGTERM   → back to spool/incoming/    + job_requeued event
+
+Execution reuses the whole engine stack: each job is one
+:class:`~tmlibrary_tpu.workflow.engine.Workflow` run against its own
+experiment store (``resume=True`` whenever the job's ledger already
+exists, so re-admitted work converges bit-identically).  Jobs from
+different tenants that route to the same compiled program — same
+pipeline content, capacity rung and strategy — coalesce for free on the
+process-level ``cached_batch_fn`` / AOT caches; keeping the daemon
+resident is precisely what makes cross-job compile reuse possible.
+
+Per-job deadlines ride the engine's cooperative-stop hooks: the
+composite ``should_stop`` trips at the next batch boundary, the
+pipelined executor drains its in-flight window, and the job lands in
+``spool/expired/`` — partial results persisted, nothing corrupted.
+
+Preemption (SIGTERM/SIGINT) is routine: the current job drains through
+PR 9's machinery (its own ``run_preempted`` ledger event), every
+admitted-but-unfinished job is re-spooled to ``incoming/``, a
+``serve_preempted`` event seals the serve ledger, and the daemon exits
+:data:`~tmlibrary_tpu.resilience.EXIT_PREEMPTED` (75) for its wrapper
+to restart.  A hard kill is equally safe: startup recovery re-spools
+whatever was left in ``admitted/``.
+
+Fault-injection sites: ``enqueue`` (fires inside :func:`enqueue_job`)
+and ``admission`` (fires inside the daemon's scan loop, ``step`` = the
+tenant, ``event`` = the job id).  An injected admission fault converts
+to a ``admission_fault`` rejection — overload or chaos must never crash
+the daemon.  The admission loop is armed by the phase watchdog
+(``admission`` phase) when the watchdog master switch is on.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import nullcontext
+from pathlib import Path
+
+from tmlibrary_tpu import faults, telemetry
+from tmlibrary_tpu.atomicio import atomic_write_json
+from tmlibrary_tpu.errors import FaultInjected, PreemptedError
+from tmlibrary_tpu.resilience import (
+    EXIT_PREEMPTED,
+    PhaseWatchdog,
+    install_preemption_handlers,
+    preemption_reason,
+    preemption_requested,
+    watchdog_enabled,
+)
+from tmlibrary_tpu.workflow.admission import (
+    REASON_DUPLICATE,
+    REASON_FAULT,
+    REASON_INVALID,
+    SHED_REASONS,
+    AdmissionConfig,
+    AdmissionDecision,
+    AdmissionQueue,
+    JobSpec,
+    reject,
+)
+
+logger = logging.getLogger(__name__)
+
+#: spool subdirectories, in lifecycle order
+SPOOL_STATES = ("incoming", "admitted", "done", "failed", "rejected",
+                "expired")
+
+
+# ------------------------------------------------------------------ paths
+def spool_dir(serve_root: Path, state: str = "incoming") -> Path:
+    return Path(serve_root) / "spool" / state
+
+
+def serve_dir(serve_root: Path) -> Path:
+    return Path(serve_root) / "serve"
+
+
+def ledger_path(serve_root: Path) -> Path:
+    return serve_dir(serve_root) / "ledger.jsonl"
+
+
+def heartbeat_file(serve_root: Path) -> Path:
+    return serve_dir(serve_root) / "heartbeat.json"
+
+
+def status_file(serve_root: Path) -> Path:
+    return serve_dir(serve_root) / "status.json"
+
+
+def ensure_layout(serve_root: Path) -> None:
+    for state in SPOOL_STATES:
+        spool_dir(serve_root, state).mkdir(parents=True, exist_ok=True)
+    serve_dir(serve_root).mkdir(parents=True, exist_ok=True)
+
+
+def is_serve_root(root: Path) -> bool:
+    """Whether ``root`` looks like a serve root (spool layout present)."""
+    root = Path(root)
+    return (root / "spool").is_dir() or ledger_path(root).exists()
+
+
+# ---------------------------------------------------------------- enqueue
+def enqueue_job(serve_root: Path, spec: JobSpec) -> Path:
+    """Drop one job spec into the spool (the ``tmx enqueue`` backend).
+
+    Atomic write keeps the daemon from ever observing half a spec.  The
+    ``enqueue`` fault site fires here so chaos plans can flood or break
+    the submission path without touching the daemon."""
+    ensure_layout(serve_root)
+    if not spec.submitted_at:
+        spec.submitted_at = time.time()
+    faults.maybe_fire("enqueue", step=spec.tenant, event=spec.job_id)
+    path = spool_dir(serve_root, "incoming") / f"{spec.job_id}.json"
+    atomic_write_json(path, spec.to_dict())
+    return path
+
+
+# ----------------------------------------------------------------- daemon
+class ServeDaemon:
+    """The admission + execution loop behind ``tmx serve run``."""
+
+    def __init__(self, serve_root: Path,
+                 admission: AdmissionConfig | None = None,
+                 poll_s: float | None = None,
+                 max_jobs: int = 0, idle_exit_s: float = 0.0,
+                 install_handlers: bool = True):
+        from tmlibrary_tpu.config import cfg
+        from tmlibrary_tpu.workflow.engine import RunLedger
+
+        self.serve_root = Path(serve_root)
+        ensure_layout(self.serve_root)
+        self.queue = AdmissionQueue(
+            admission or AdmissionConfig.from_library_config()
+        )
+        self.poll_s = float(cfg.serve_poll_s if poll_s is None else poll_s)
+        self.max_jobs = int(max_jobs)
+        self.idle_exit_s = float(idle_exit_s)
+        self.install_handlers = bool(install_handlers)
+        self.ledger = RunLedger(
+            ledger_path(self.serve_root), fsync=cfg.ledger_fsync,
+            host=(telemetry.host_id() if telemetry.fleet_active() else None),
+        )
+        #: admission-phase watchdog — a wedged scan (hung filesystem,
+        #: injected hang) fires telemetry + the breaker path instead of
+        #: stalling silently
+        self._watchdog: PhaseWatchdog | None = None
+        if watchdog_enabled() and float(cfg.serve_admission_deadline_s) > 0:
+            self._watchdog = PhaseWatchdog(
+                {"admission": float(cfg.serve_admission_deadline_s)}
+            )
+        self._jobs_run = 0
+
+    # ------------------------------------------------------------ helpers
+    def _arm(self, phase: str):
+        if self._watchdog is None:
+            return nullcontext()
+        return self._watchdog.arm(phase, step="serve")
+
+    def _metric(self, kind: str, name: str, value: float = 1.0, **labels):
+        reg = telemetry.get_registry()
+        if kind == "counter":
+            reg.counter(name, **labels).inc(value)
+        elif kind == "gauge":
+            reg.gauge(name, **labels).set(value)
+        else:
+            reg.histogram(name, **labels).observe(value)
+
+    def _move_spool(self, job_id: str, dst_state: str,
+                    envelope: dict) -> None:
+        """Land ``job_id``'s spool file in ``dst_state`` with an
+        envelope payload, removing it from every transient state."""
+        atomic_write_json(
+            spool_dir(self.serve_root, dst_state) / f"{job_id}.json",
+            envelope,
+        )
+        for state in ("incoming", "admitted"):
+            f = spool_dir(self.serve_root, state) / f"{job_id}.json"
+            if f.exists() and state != dst_state:
+                f.unlink()
+
+    def _publish_state(self) -> None:
+        """Heartbeat + live status/queue gauges, every loop iteration."""
+        snap = self.queue.snapshot()
+        telemetry.write_heartbeat(
+            heartbeat_file(self.serve_root), period=self.poll_s,
+            extra={"queue_depth": snap["depth"], "role": "serve"},
+        )
+        atomic_write_json(status_file(self.serve_root), {
+            "ts": time.time(), "jobs_run": self._jobs_run, **snap,
+        })
+        self._metric("gauge", "tmx_serve_queue_depth", snap["depth"])
+        age = snap.get("oldest_job_age_s")
+        if age is not None:
+            self._metric("gauge", "tmx_serve_oldest_job_age_seconds", age)
+
+    def _write_metrics(self) -> None:
+        if not telemetry.enabled():
+            return
+        try:
+            atomic_write_json(
+                serve_dir(self.serve_root) / "metrics.json",
+                telemetry.get_registry().snapshot(),
+            )
+        except Exception:
+            logger.debug("serve metrics snapshot failed", exc_info=True)
+
+    # ---------------------------------------------------------- admission
+    def _recover_spool(self) -> int:
+        """Re-spool jobs a previous daemon admitted but never finished
+        (crash or preemption) back into ``incoming/`` — startup is the
+        crash-consistent counterpart of the SIGTERM drain."""
+        recovered = 0
+        for f in sorted(spool_dir(self.serve_root, "admitted").glob("*.json")):
+            target = spool_dir(self.serve_root, "incoming") / f.name
+            if target.exists():
+                f.unlink()  # incoming copy already exists (torn drain)
+            else:
+                f.rename(target)
+            recovered += 1
+            self.ledger.append(event="job_requeued", job=f.stem,
+                               phase="recovery")
+        return recovered
+
+    def _load_spec(self, path: Path) -> "JobSpec | None":
+        import json
+
+        try:
+            return JobSpec.from_dict(json.loads(path.read_text()))
+        except Exception as exc:
+            logger.warning("invalid job spec %s: %s", path.name, exc)
+            return None
+
+    def _offer(self, spec: JobSpec) -> AdmissionDecision:
+        """One admission decision, chaos-safe: the ``admission`` fault
+        site fires first, and any injected (or organic) error becomes a
+        pinned ``admission_fault`` rejection — never a crash.  Fatal
+        injected crashes (simulated host death) do propagate, exactly
+        like a kill."""
+        try:
+            faults.maybe_fire("admission", step=spec.tenant,
+                              event=spec.job_id)
+            if (spool_dir(self.serve_root, "admitted")
+                    / f"{spec.job_id}.json").exists():
+                return reject(REASON_DUPLICATE)
+            return self.queue.offer(spec)
+        except FaultInjected as exc:
+            if exc.fatal:
+                raise
+            return reject(REASON_FAULT)
+        except Exception as exc:
+            logger.warning("admission fault for job %s: %s",
+                           spec.job_id, exc)
+            return reject(REASON_FAULT)
+
+    def _scan_incoming(self) -> None:
+        for path in sorted(spool_dir(self.serve_root, "incoming")
+                           .glob("*.json")):
+            if preemption_requested():
+                return  # drain beats admission; specs stay spooled
+            spec = self._load_spec(path)
+            if spec is None:
+                decision = reject(REASON_INVALID)
+                self._move_spool(path.stem, "rejected", {
+                    "job_id": path.stem, "decision": decision.to_dict(),
+                    "ts": time.time(),
+                })
+                self.ledger.append(
+                    event="job_rejected", job=path.stem, tenant="unknown",
+                    reason=decision.reason,
+                    retry_after_s=decision.retry_after_s,
+                )
+                self._metric("counter", "tmx_serve_rejected_total",
+                             tenant="unknown", reason=decision.reason)
+                continue
+            decision = self._offer(spec)
+            if decision.admitted:
+                atomic_write_json(
+                    spool_dir(self.serve_root, "admitted")
+                    / f"{spec.job_id}.json",
+                    spec.to_dict(),
+                )
+                path.unlink()
+                self.ledger.append(event="job_admitted", job=spec.job_id,
+                                   tenant=spec.tenant, attempt=spec.attempt)
+                self._metric("counter", "tmx_serve_admitted_total",
+                             tenant=spec.tenant)
+            else:
+                self._move_spool(spec.job_id, "rejected", {
+                    "job": spec.to_dict(), "decision": decision.to_dict(),
+                    "ts": time.time(),
+                })
+                self.ledger.append(
+                    event="job_rejected", job=spec.job_id,
+                    tenant=spec.tenant, reason=decision.reason,
+                    retry_after_s=decision.retry_after_s,
+                )
+                self._metric("counter", "tmx_serve_rejected_total",
+                             tenant=spec.tenant, reason=decision.reason)
+                if decision.reason in SHED_REASONS:
+                    self._metric("counter", "tmx_serve_shed_total",
+                                 tenant=spec.tenant)
+
+    # ---------------------------------------------------------- execution
+    def _execute(self, job: JobSpec) -> str:
+        """Run one admitted job to an outcome: ``done``, ``failed``,
+        ``expired`` or ``preempted``."""
+        from tmlibrary_tpu.models.store import ExperimentStore
+        from tmlibrary_tpu.workflow.engine import Workflow, WorkflowDescription
+
+        self.ledger.append(event="job_started", job=job.job_id,
+                           tenant=job.tenant)
+        deadline = float(job.deadline) if job.deadline else None
+
+        def should_stop() -> bool:
+            if preemption_requested():
+                return True
+            return deadline is not None and time.time() >= deadline
+
+        def stop_reason() -> str:
+            if preemption_requested():
+                return preemption_reason()
+            return "deadline"
+
+        t0 = time.monotonic()
+        try:
+            store = ExperimentStore.open(Path(job.root))
+            if job.description:
+                desc_path = Path(job.description)
+                if not desc_path.is_absolute():
+                    desc_path = Path(job.root) / desc_path
+            else:
+                desc_path = store.workflow_dir / "workflow.yaml"
+            desc = WorkflowDescription.load(desc_path)
+            wf = Workflow(store, desc, pipeline_depth=job.pipeline_depth,
+                          should_stop=should_stop, stop_reason=stop_reason)
+            resume = wf.ledger.path.exists()
+            summary = wf.run(resume=resume)
+        except PreemptedError as exc:
+            if exc.reason == "deadline" and not preemption_requested():
+                self.ledger.append(event="job_expired", job=job.job_id,
+                                   tenant=job.tenant, step=exc.step)
+                self._move_spool(job.job_id, "expired", {
+                    "job": job.to_dict(), "reason": "deadline",
+                    "ts": time.time(),
+                })
+                self._metric("counter",
+                             "tmx_serve_deadline_expired_total",
+                             tenant=job.tenant)
+                return "expired"
+            return "preempted"  # caller drains and re-spools
+        except FaultInjected as exc:
+            if exc.fatal:
+                raise  # simulated hard crash: recovery re-spools the job
+            self._job_failed(job, exc)
+            return "failed"
+        except Exception as exc:
+            self._job_failed(job, exc)
+            return "failed"
+        elapsed = time.monotonic() - t0
+        self.ledger.append(event="job_done", job=job.job_id,
+                           tenant=job.tenant, elapsed_s=round(elapsed, 3),
+                           resumed=resume)
+        self._move_spool(job.job_id, "done", {
+            "job": job.to_dict(), "summary": summary,
+            "elapsed_s": round(elapsed, 3), "ts": time.time(),
+        })
+        self.queue.record_result(job.tenant, ok=True)
+        self._metric("counter", "tmx_serve_jobs_done_total",
+                     tenant=job.tenant)
+        self._metric("histogram", "tmx_serve_job_seconds", elapsed,
+                     tenant=job.tenant)
+        return "done"
+
+    def _job_failed(self, job: JobSpec, exc: Exception) -> None:
+        logger.warning("serve job %s failed: %s", job.job_id, exc)
+        self.ledger.append(event="job_failed", job=job.job_id,
+                           tenant=job.tenant, error=str(exc),
+                           exception=type(exc).__name__)
+        self._move_spool(job.job_id, "failed", {
+            "job": job.to_dict(), "error": str(exc),
+            "exception": type(exc).__name__, "ts": time.time(),
+        })
+        self.queue.record_result(job.tenant, ok=False)
+        self._metric("counter", "tmx_serve_jobs_failed_total",
+                     tenant=job.tenant)
+
+    # -------------------------------------------------------------- drain
+    def _drain_and_exit(self, current: JobSpec | None = None) -> int:
+        """The SIGTERM path: re-spool the interrupted job plus every
+        queued job back to ``incoming/`` (attempt counts preserved — a
+        preemption must never charge a tenant's retry budget), seal the
+        serve ledger with ``serve_preempted``, and hand the pinned
+        resume exit code to the wrapper."""
+        requeued = []
+        if current is not None:
+            requeued.append(current)
+        requeued.extend(self.queue.drain())
+        for job in requeued:
+            atomic_write_json(
+                spool_dir(self.serve_root, "incoming")
+                / f"{job.job_id}.json",
+                job.to_dict(),
+            )
+            admitted = (spool_dir(self.serve_root, "admitted")
+                        / f"{job.job_id}.json")
+            if admitted.exists():
+                admitted.unlink()
+            self.ledger.append(event="job_requeued", job=job.job_id,
+                               tenant=job.tenant, phase="drain")
+        self.ledger.append(event="serve_preempted",
+                           reason=preemption_reason(),
+                           requeued=len(requeued))
+        self._metric("counter", "tmx_serve_preemptions_total")
+        logger.warning(
+            "serve preempted (%s): re-spooled %d job(s), exiting %d for "
+            "wrapper restart", preemption_reason(), len(requeued),
+            EXIT_PREEMPTED,
+        )
+        return EXIT_PREEMPTED
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> int:
+        restore = (install_preemption_handlers()
+                   if self.install_handlers else None)
+        idle_since: float | None = None
+        try:
+            recovered = self._recover_spool()
+            self.ledger.append(event="serve_started",
+                               recovered=recovered,
+                               max_queue=self.queue.config.max_queue)
+            while True:
+                try:
+                    with self._arm("admission"):
+                        self._scan_incoming()
+                except FaultInjected as exc:
+                    if exc.fatal:
+                        raise
+                    logger.warning("admission scan fault: %s", exc)
+                except Exception as exc:
+                    # incl. WatchdogTimeout from a wedged scan: count it
+                    # and keep serving — overload/chaos never crash
+                    logger.warning("admission scan error: %s", exc)
+                if self._watchdog is not None:
+                    for ev in self._watchdog.drain_events():
+                        self.ledger.append(event="watchdog", **ev)
+                self._publish_state()
+                if preemption_requested():
+                    return self._drain_and_exit()
+                job = self.queue.take()
+                if job is None:
+                    if self.idle_exit_s > 0:
+                        now = time.monotonic()
+                        if idle_since is None:
+                            idle_since = now
+                        elif now - idle_since >= self.idle_exit_s:
+                            logger.info("serve idle for %.1fs — exiting",
+                                        now - idle_since)
+                            return 0
+                    time.sleep(self.poll_s)
+                    continue
+                idle_since = None
+                outcome = self._execute(job)
+                if outcome == "preempted":
+                    return self._drain_and_exit(current=job)
+                self._jobs_run += 1
+                if self.max_jobs and self._jobs_run >= self.max_jobs:
+                    logger.info("serve reached max-jobs=%d — exiting",
+                                self.max_jobs)
+                    return 0
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+            try:
+                self._publish_state()
+            except Exception:
+                pass
+            self._write_metrics()
+            if restore is not None:
+                restore()
+
+
+def run_serve(serve_root: Path, **kwargs) -> int:
+    """Construct and run a :class:`ServeDaemon` (the CLI entry)."""
+    return ServeDaemon(serve_root, **kwargs).run()
+
+
+# ----------------------------------------------------------------- status
+def serve_status_view(serve_root: Path) -> dict:
+    """Disk-derived status for ``tmx serve status`` and the ``tmx top``
+    SERVE panel: the daemon's last published snapshot (``status.json``),
+    heartbeat liveness, spool counts, and ledger-derived per-tenant
+    counters — readable with or without a live daemon."""
+    serve_root = Path(serve_root)
+    view: dict = {"root": str(serve_root), "live": False}
+    hb_path = heartbeat_file(serve_root)
+    hb = telemetry.read_heartbeat(hb_path)
+    if hb is not None:
+        age = telemetry.heartbeat_age(hb_path)
+        period = float(hb.get("period", 0) or 0)
+        view["heartbeat_age_s"] = None if age is None else round(age, 1)
+        view["live"] = bool(
+            age is not None and (period <= 0 or age <= max(5.0, 4 * period))
+        )
+    import json
+
+    try:
+        view["status"] = json.loads(status_file(serve_root).read_text())
+    except Exception:
+        view["status"] = None
+    view["spool"] = {
+        state: len(list(spool_dir(serve_root, state).glob("*.json")))
+        for state in SPOOL_STATES
+        if spool_dir(serve_root, state).is_dir()
+    }
+    lp = ledger_path(serve_root)
+    tenants: dict[str, dict] = {}
+    preempted = 0
+    if lp.exists():
+        from tmlibrary_tpu.workflow.engine import RunLedger
+
+        for ev in RunLedger(lp).events():
+            kind = ev.get("event")
+            if kind == "serve_preempted":
+                preempted += 1
+                continue
+            if kind not in ("job_admitted", "job_rejected", "job_done",
+                            "job_failed", "job_expired", "job_requeued"):
+                continue
+            t = tenants.setdefault(str(ev.get("tenant", "unknown")), {
+                "admitted": 0, "rejected": 0, "done": 0, "failed": 0,
+                "expired": 0, "requeued": 0,
+            })
+            t[kind.removeprefix("job_")] += 1
+    view["tenants"] = tenants
+    view["preemptions"] = preempted
+    return view

@@ -1014,7 +1014,7 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
             # dedup only host-attributed events: seed-era ledgers have no
             # host field and legitimately repeat (event, step) shapes
             fp = (host, ev.get("ts"), kind, step, ev.get("batch"),
-                  ev.get("span"))
+                  ev.get("span"), ev.get("job"))
             if fp in seen:
                 continue
             seen.add(fp)
@@ -1178,7 +1178,45 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                 "tmx_watchdog_fired_total", step=step,
                 phase=str(ev.get("phase", "")) or "unknown", **hl,
             ).inc()
-        elif kind in ("init_done", "description_drift"):
+        elif kind in ("job_admitted", "job_rejected", "job_done",
+                      "job_failed", "job_expired", "job_requeued",
+                      "serve_preempted"):
+            # serve-ledger events (serve.py): per-tenant admission /
+            # outcome series, mirroring the daemon's live tmx_serve_*
+            # metrics so a serve ledger alone reconstructs them
+            tenant = str(ev.get("tenant", "")) or "unknown"
+            if kind == "job_admitted":
+                reg.counter("tmx_serve_admitted_total",
+                            tenant=tenant, **hl).inc()
+            elif kind == "job_rejected":
+                reason = str(ev.get("reason", "")) or "unknown"
+                reg.counter("tmx_serve_rejected_total", tenant=tenant,
+                            reason=reason, **hl).inc()
+                from tmlibrary_tpu.workflow.admission import SHED_REASONS
+
+                if reason in SHED_REASONS:
+                    reg.counter("tmx_serve_shed_total",
+                                tenant=tenant, **hl).inc()
+            elif kind == "job_done":
+                reg.counter("tmx_serve_jobs_done_total",
+                            tenant=tenant, **hl).inc()
+                if "elapsed_s" in ev:
+                    reg.histogram("tmx_serve_job_seconds",
+                                  tenant=tenant, **hl).observe(
+                        float(ev["elapsed_s"]))
+            elif kind == "job_failed":
+                reg.counter("tmx_serve_jobs_failed_total",
+                            tenant=tenant, **hl).inc()
+            elif kind == "job_expired":
+                reg.counter("tmx_serve_deadline_expired_total",
+                            tenant=tenant, **hl).inc()
+            elif kind == "job_requeued":
+                reg.counter("tmx_serve_requeued_total",
+                            tenant=tenant, **hl).inc()
+            else:  # serve_preempted
+                reg.counter("tmx_serve_preemptions_total", **hl).inc()
+        elif kind in ("init_done", "description_drift", "job_started",
+                      "serve_started"):
             pass  # known structural events with no metric series
         elif kind:
             # forward compatibility: a newer writer's ledger may carry

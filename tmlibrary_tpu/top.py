@@ -75,6 +75,12 @@ def collect_fleet(root: Path) -> dict[str, Any]:
     if qc_pairs:
         view["qc"] = (qc_mod.merge_profiles(qc_pairs)
                       if len(qc_pairs) > 1 else qc_pairs[0][1])
+    # serve roots (serve.py spool layout) gain a SERVE panel — pure file
+    # reads again, works against a live or stopped daemon
+    from tmlibrary_tpu import serve as serve_mod
+
+    view["serve"] = (serve_mod.serve_status_view(root)
+                     if serve_mod.is_serve_root(root) else None)
     return view
 
 
@@ -248,6 +254,38 @@ def render_dashboard(view: dict, width: int = 80) -> str:
         flag = ("  ** NON-FINITE FEATURES — inspect with tmx qc **"
                 if nan_cols else "")
         lines.append("qc: " + "  ".join(bits) + flag)
+
+    # ---- SERVE panel: admission queue + per-tenant accounting
+    srv = view.get("serve")
+    if srv:
+        live = "LIVE" if srv.get("live") else "stopped"
+        status = srv.get("status") or {}
+        depth = status.get("depth", 0)
+        high = status.get("high_watermark") or 1
+        line = (f"serve [{live}]: queue [{_bar(depth / high, 16)}] "
+                f"{depth}/{status.get('high_watermark', '?')}")
+        if status.get("shedding"):
+            line += "  ** SHEDDING **"
+        age = status.get("oldest_job_age_s")
+        if age is not None:
+            line += f"  oldest {age:.1f}s"
+        lines.append(line)
+        live_tenants = status.get("tenants") or {}
+        ledger_tenants = srv.get("tenants") or {}
+        for name in sorted(set(live_tenants) | set(ledger_tenants)):
+            lt = live_tenants.get(name, {})
+            gt = ledger_tenants.get(name, {})
+            lines.append(
+                f"  tenant {name:<12} queued {lt.get('queued', 0):<3d} "
+                f"admitted {gt.get('admitted', lt.get('admitted', 0)):<4d} "
+                f"rejected {gt.get('rejected', lt.get('rejected', 0)):<4d} "
+                f"done {gt.get('done', 0):<4d} "
+                f"budget {lt.get('retry_budget_remaining', '-')} "
+                f"breaker {lt.get('breaker', '-')}"
+            )
+        if srv.get("preemptions"):
+            lines.append(f"  serve preemptions: {srv['preemptions']} "
+                         "(drained + re-spooled)")
 
     # ---- breaker / degradation state
     deg = view["degraded"]

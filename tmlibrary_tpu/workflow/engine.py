@@ -534,12 +534,24 @@ class Workflow:
     def __init__(self, store: ExperimentStore,
                  description: WorkflowDescription,
                  resilience: ResilienceConfig | None = None,
-                 pipeline_depth: int | None = None):
+                 pipeline_depth: int | None = None,
+                 should_stop=None, stop_reason=None):
         from tmlibrary_tpu.config import cfg
 
         description.validate()
         self.store = store
         self.description = description
+        #: cooperative-cancellation hooks, polled at every step and batch
+        #: boundary (and inside the pipelined executor's launch loop).
+        #: Default: the process-wide preemption flag.  ``tmx serve``
+        #: passes a composite that also trips on the per-job deadline,
+        #: so an expired job cancels at the next batch boundary with
+        #: ``PreemptedError(reason="deadline")`` instead of running to
+        #: completion.
+        self._should_stop = (should_stop if should_stop is not None
+                             else preemption_requested)
+        self._stop_reason = (stop_reason if stop_reason is not None
+                             else preemption_reason)
         self.ledger = RunLedger(
             store.workflow_dir / "ledger.jsonl",
             fsync=cfg.ledger_fsync,
@@ -608,14 +620,14 @@ class Workflow:
                                 "resume: skipping completed step %s", sd.name
                             )
                             continue
-                        if preemption_requested():
+                        if self._should_stop():
                             # the drain request landed between steps (or
                             # during the previous step's collect): the
                             # boundary is already clean — record it and
                             # stop admitting steps
                             self._note_preempted(PreemptedError(
                                 f"preempted before step '{sd.name}'",
-                                step=sd.name, reason=preemption_reason(),
+                                step=sd.name, reason=self._stop_reason(),
                             ))
                         if guard is not None:
                             guard.ensure_backend(self.ledger, where=sd.name)
@@ -841,7 +853,7 @@ class Workflow:
                     step=step.name, **ev
                 ),
                 stats=pstats,
-                should_stop=preemption_requested,
+                should_stop=self._should_stop,
                 watchdog=self._watchdog,
             )
             gen = executor.run(pending)
@@ -877,13 +889,13 @@ class Workflow:
                 pos += 1
             else:
                 batch = pending[pos]
-                if preemption_requested():
+                if self._should_stop():
                     raise PreemptedError(
                         f"preempted before batch {batch['index']} of "
                         f"'{step.name}': abandoned {len(pending) - pos} "
                         f"pending batches",
                         step=step.name, abandoned=len(pending) - pos,
-                        reason=preemption_reason(),
+                        reason=self._stop_reason(),
                     )
                 try:
                     yield batch, RetryOutcome(
@@ -1084,8 +1096,9 @@ class Workflow:
                 e.step = sd.name
             if e.reason == "signal":
                 # the executor's drain path doesn't know which signal
-                # tripped the flag — the process-wide reason does
-                e.reason = preemption_reason()
+                # (or deadline) tripped the flag — the stop-reason hook
+                # does
+                e.reason = self._stop_reason()
             self._note_preempted(e)
         except FaultInjected as e:
             if e.fatal:

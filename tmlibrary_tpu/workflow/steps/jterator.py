@@ -212,12 +212,16 @@ class ImageAnalysisRunner(Step):
         # main thread's launch; the lock keeps the compile cache coherent
         # when two threads race on different capacities
         self._compile_lock = threading.Lock()
-        # highest per-site object count observed so far (per object
-        # family max, folded together) — drives launch-time bucket
-        # routing; lock-protected because persist runs on the pipelined
-        # executor's worker thread while launch runs on the engine's
+        # bucket routing reads/writes the process-level per-program
+        # peak-count history (capacity.note_observed_peak) — scoped by
+        # compiled-program key so a long-lived serve process interleaving
+        # tenants with different object densities never thrashes another
+        # experiment's capacity-rung choices.  The lock only guards this
+        # instance's memoized routing-key table (persist runs on the
+        # pipelined executor's worker thread while launch runs on the
+        # engine's).
         self._bucket_lock = threading.Lock()
-        self._bucket_max_count: int | None = None
+        self._routing_keys: dict[tuple, str] = {}
 
     def create_batches(self, args):
         if args["layout"] == "spatial":
@@ -356,8 +360,9 @@ class ImageAnalysisRunner(Step):
         )
         if len(ladder) == 1:
             return ceiling
-        with self._bucket_lock:
-            observed = self._bucket_max_count
+        from tmlibrary_tpu.capacity import observed_peak
+
+        observed = observed_peak(self._routing_key(args, ceiling, ladder))
         if observed is None:
             from tmlibrary_tpu.tuning import tuned_object_capacity
 
@@ -366,6 +371,37 @@ class ImageAnalysisRunner(Step):
                 return int(hint)
             return ladder[0]
         return select_capacity(observed, ladder)
+
+    def _routing_key(self, args, ceiling: int,
+                     ladder: tuple[int, ...]) -> str:
+        """The compiled-program-family key scoping this step's bucket
+        history (memoized per (ceiling, ladder) — the description digest
+        is instance-stable)."""
+        from tmlibrary_tpu.capacity import routing_key
+        from tmlibrary_tpu.jterator.pipeline import description_digest
+
+        desc = self._description(args)
+        cache_key = (int(ceiling), tuple(ladder))
+        with self._bucket_lock:
+            key = self._routing_keys.get(cache_key)
+            if key is None:
+                key = routing_key(description_digest(desc), ceiling, ladder)
+                self._routing_keys[cache_key] = key
+            return key
+
+    def _note_peak(self, args, peak: int) -> None:
+        """Feed one batch's peak per-site object count into the
+        per-program routing history (persist-worker side)."""
+        from tmlibrary_tpu.capacity import (
+            note_observed_peak,
+            resolve_bucket_ladder,
+        )
+
+        ceiling = int(args["max_objects"])
+        ladder = resolve_bucket_ladder(
+            ceiling, args.get("object_buckets", "auto")
+        )
+        note_observed_peak(self._routing_key(args, ceiling, ladder), peak)
 
     def run_batch(self, batch: dict) -> dict:
         self._mark_work_start()
@@ -1204,9 +1240,7 @@ class ImageAnalysisRunner(Step):
         peak = max(
             (int(v.max(initial=0)) for v in counts.values()), default=0
         )
-        with self._bucket_lock:
-            prior = self._bucket_max_count
-            self._bucket_max_count = peak if prior is None else max(prior, peak)
+        self._note_peak(args, peak)
         total_objects = sum(summary["objects"].values())
         slots = len(counts) * n_valid * cap
         summary["bucket_capacity"] = cap
