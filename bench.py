@@ -157,6 +157,11 @@ def _pipeline_depth(backend: str) -> int:
     only ever pays the fetch once per drained queue.  On the CPU backend
     dispatch is synchronous and there is no relay, so depth defaults
     to 1; on device the default is the hardware-swept ``best_pipeline``."""
+    if os.environ.get("BENCH_NO_PIPELINE"):
+        # legacy host-synchronous methodology (--no-pipeline): every rep
+        # pays the full fetch round-trip, for apples-to-apples reruns of
+        # pre-pipelining records
+        return 1
     depth = os.environ.get("BENCH_PIPELINE")
     if depth:
         return max(1, int(depth))
@@ -725,16 +730,23 @@ def measure(platform: str) -> None:
     result = fn(raw, {}, shifts)
     np.asarray(result.counts[count_key])
 
-    # object-capacity bucket routing (BENCH_OBJECT_BUCKETS, default off
-    # so the headline stays comparable with historic records): observe
-    # the warmup's object counts, pick the smallest bucket that holds
-    # them, and re-time at that capacity — bit-identical results (the
-    # capacity is pure padding once counts fit; see capacity.py), fewer
-    # padded-slot FLOPs.  Config 2's counts are foreground pixels, not
-    # objects, so the knob does not apply there.
+    # object-capacity bucket routing (BENCH_OBJECT_BUCKETS): observe the
+    # warmup's object counts, pick the smallest bucket that holds them,
+    # and re-time at that capacity — bit-identical results (the capacity
+    # is pure padding once counts fit; see capacity.py), fewer
+    # padded-slot FLOPs.  Default "auto": pipelined+bucketed IS the
+    # production methodology, so it is the headline one too; history
+    # comparisons stay like-for-like because perf._history_key folds the
+    # methodology class into the comparison key.  --no-pipeline reverts
+    # to the legacy host-synchronous, unbucketed capture.  Config 2's
+    # counts are foreground pixels, not objects, so the knob does not
+    # apply there.
     peak_objects = None
     routed_capacity = None
-    buckets_spec = os.environ.get("BENCH_OBJECT_BUCKETS", "off")
+    no_pipeline = bool(os.environ.get("BENCH_NO_PIPELINE"))
+    buckets_spec = os.environ.get(
+        "BENCH_OBJECT_BUCKETS", "off" if no_pipeline else "auto"
+    )
     if config != "2":
         peak_objects = max(
             int(np.asarray(c).max(initial=0))
@@ -811,8 +823,13 @@ def measure(platform: str) -> None:
         "config": config,
         "batch": batch,
         "site_size": size,
-        **_ledger_fields(pdepth, max_objects),
+        **_ledger_fields(None if no_pipeline else pdepth, max_objects),
     }
+    if routed_capacity:
+        # provenance: a bucket-routed capture is its own methodology
+        # class (bench_regression compares it only against other
+        # bucketed records)
+        record["timing_methodology"] += "+bucketed"
     if config == "volume":
         record["depth"] = depth
     # sites whose object count sits AT the static cap may have silently
@@ -837,9 +854,12 @@ def measure(platform: str) -> None:
             round(total_objects / slots, 4) if slots else 0.0
         )
         record["max_observed_objects"] = peak_objects
+        # always recorded (even when routing found nothing smaller):
+        # the watcher's staleness check keys on this field's presence,
+        # and an absent field would re-queue the same measure forever
+        record["object_buckets"] = buckets_spec
         if routed_capacity:
             record["routed_capacity"] = routed_capacity
-            record["object_buckets"] = buckets_spec
     record.update(_flops_fields(
         flops and flops * pdepth, pdepth * batch, best,
         jax.default_backend(), nbytes=cost_bytes and cost_bytes * pdepth,
@@ -952,7 +972,9 @@ def measure_pyramid(size: int) -> None:
         "grid_x": gx,
         "site_size": size,
         "n_levels": n_levels,
-        **_ledger_fields(depth),
+        **_ledger_fields(
+            None if os.environ.get("BENCH_NO_PIPELINE") else depth
+        ),
     }
     record.update(_flops_fields(
         flops and flops * depth, depth * gy * gx, best,
@@ -1218,7 +1240,10 @@ def measure_mesh(size: int) -> None:
         "config": "mesh",
         "batch": per_device,
         "site_size": size,
-        **_ledger_fields(pdepth, max_objects),
+        **_ledger_fields(
+            None if os.environ.get("BENCH_NO_PIPELINE") else pdepth,
+            max_objects,
+        ),
         "synthetic_cpu_mesh": backend_is_cpu,
     }
     if dev_times:
@@ -1591,7 +1616,9 @@ def measure_corilla(size: int) -> None:
         "sites": n_sites,
         "channels": n_channels,
         "site_size": size,
-        **_ledger_fields(depth),
+        **_ledger_fields(
+            None if os.environ.get("BENCH_NO_PIPELINE") else depth
+        ),
     }
     record.update(_flops_fields(
         flops and flops * depth, depth * n_channels, best,
@@ -1727,6 +1754,12 @@ if __name__ == "__main__":
         # (measure_sweep); env so the child process inherits the mode
         os.environ["BENCH_SWEEP"] = "1"
         sys.argv = [a for a in sys.argv if a != "--sweep"]
+    if "--no-pipeline" in sys.argv:
+        # legacy methodology: host-synchronous timing (fetch every rep),
+        # no bucket routing — for apples-to-apples reruns against
+        # pre-pipelining history; env so the child process inherits it
+        os.environ["BENCH_NO_PIPELINE"] = "1"
+        sys.argv = [a for a in sys.argv if a != "--no-pipeline"]
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         measure(sys.argv[2])
     else:

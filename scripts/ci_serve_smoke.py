@@ -192,10 +192,29 @@ def main(argv=None) -> int:
               f"(retry_after_s={RETRY_AFTER_QUEUE_FULL:g}), "
               f"re-spooled {respooled}")
 
+        # the SIGTERM drain must have dumped the flight recorder ring
+        # (telemetry.flight_dump via _drain_and_exit) next to the ledger
+        flightrecs = sorted((sroot / "serve").glob("flightrec.*.json"))
+        if not flightrecs:
+            print("SERVE FAIL: SIGTERM drain left no flightrec dump under "
+                  f"{sroot / 'serve'}")
+            return 1
+        dump = json.loads(flightrecs[0].read_text())
+        if dump.get("reason", "").split(":")[0] != "preempted" or not \
+                dump.get("events"):
+            print(f"SERVE FAIL: flightrec dump malformed: "
+                  f"reason={dump.get('reason')!r} "
+                  f"events={len(dump.get('events', []))}")
+            return 1
+        print(f"      flight recorder dumped {len(dump['events'])} events "
+              f"(reason {dump['reason']})")
+
         if args.artifacts:
             art = Path(args.artifacts)
             art.mkdir(parents=True, exist_ok=True)
             shutil.copy(serve_ledger, art / "serve_ledger_drained.jsonl")
+            for fr in flightrecs:
+                shutil.copy(fr, art / fr.name)
 
         print("[4/4] fresh daemon resumes from the spool alone")
         with open(root / "serve_resume.log", "w") as out:
@@ -221,6 +240,52 @@ def main(argv=None) -> int:
         if args.artifacts:
             (Path(args.artifacts) / "serve_top.json").write_text(
                 top.stdout or "")
+
+        # end-to-end trace: one schema-valid Chrome trace reconstructed
+        # purely from the ledgers (serve ledger + spooled job roots)
+        trace_out = root / "serve_trace.json"
+        tr = _tmx(["trace", "--root", str(sroot), "--export", "chrome",
+                   str(trace_out)])
+        if tr.returncode != 0:
+            print(f"SERVE FAIL: chrome trace export exited "
+                  f"{tr.returncode}\n{tr.stdout}")
+            return 1
+        doc = json.loads(trace_out.read_text())
+        tev = doc.get("traceEvents") or []
+        flows = [e for e in tev if e.get("ph") in ("s", "t", "f")]
+        slices = [e for e in tev if e.get("ph") == "X"]
+        if not slices or not flows:
+            print(f"SERVE FAIL: chrome trace too thin "
+                  f"({len(slices)} slices, {len(flows)} flow events)")
+            return 1
+        print(f"      chrome trace: {len(tev)} events "
+              f"({len(slices)} slices, {len(flows)} flow events)")
+        if args.artifacts:
+            shutil.copy(trace_out, Path(args.artifacts) / "serve_trace.json")
+
+        # SLO view: both tenants reporting latency, zero burn at the
+        # generous defaults — and `tmx slo` exiting 0 (no breach)
+        slo = _tmx(["slo", "--root", str(sroot), "--json"])
+        if slo.returncode != 0:
+            print(f"SERVE FAIL: tmx slo exited {slo.returncode} "
+                  f"(expected 0 = no burn)\n{slo.stdout}")
+            return 1
+        slo_view = json.loads(slo.stdout)
+        slo_tenants = slo_view.get("tenants") or {}
+        if sorted(slo_tenants) != ["a", "b"]:
+            print(f"SERVE FAIL: tmx slo saw tenants "
+                  f"{sorted(slo_tenants)}, expected ['a', 'b']")
+            return 1
+        for name, t in sorted(slo_tenants.items()):
+            if t.get("latency_p95_s") is None or t.get("breach"):
+                print(f"SERVE FAIL: tenant {name} slo malformed: {t}")
+                return 1
+            print(f"      slo tenant {name}: p95 "
+                  f"{t['latency_p95_s']:.3f}s availability "
+                  f"{t['availability']:.2%} burn {t['burn']}")
+        if args.artifacts:
+            (Path(args.artifacts) / "serve_slo.json").write_text(
+                slo.stdout or "")
 
         from tmlibrary_tpu.models.store import ExperimentStore
 

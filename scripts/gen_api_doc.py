@@ -241,6 +241,36 @@ def serve_section() -> list[str]:
     return out
 
 
+def slo_section() -> list[str]:
+    from tmlibrary_tpu import slo, traceexport
+
+    out = ["## Request-level observability (`tmx slo`, "
+           "`tmx trace --export chrome`)", "",
+           (inspect.getdoc(slo) or "").split("\n")[0],
+           "",
+           "`tmx enqueue` stamps a `trace_id` into every job spec; "
+           "`tmx slo --root DIR [--json]` reports per-tenant p50/p95 "
+           "latency, availability and multi-window burn (exit 0 ok / "
+           "1 burn / 3 no data; objectives from `TM_SLO_*` config with "
+           "`TMX_SLO_*` / per-tenant `TMX_SLO_<KNOB>_<TENANT>` env "
+           "overrides), and `tmx trace --root DIR --export chrome OUT "
+           "[--trace-id ID]` renders the ledger span trees as validated "
+           "Trace Event Format JSON (DESIGN.md §21).",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for mod, prefix in ((slo, "slo"), (traceexport, "traceexport")):
+        for name in sorted(n for n in dir(mod) if not n.startswith("_")):
+            obj = getattr(mod, name)
+            if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+                continue
+            if getattr(obj, "__module__", "") != mod.__name__:
+                continue
+            doc = (inspect.getdoc(obj) or "").split("\n")[0]
+            out.append(f"| `{prefix}.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def main() -> None:
     lines = [
         "# tmlibrary_tpu API reference",
@@ -258,6 +288,7 @@ def main() -> None:
         *perf_section(),
         *resilience_section(),
         *serve_section(),
+        *slo_section(),
     ]
     # optional output override so a freshness check can generate into a
     # scratch path without clobbering the committed file

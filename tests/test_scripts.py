@@ -49,10 +49,12 @@ def _watch(monkeypatch, tmp_path, cache=None, tuning=None):
 
 
 def _record(value=300.0, depth=8, batch=64, config="3"):
+    # object_buckets rides every fresh ladder capture under the
+    # pipelined+bucketed default methodology (bench.py emit path)
     return {"record": {
         "metric": "m", "value": value, "vs_baseline": 5.0,
         "backend": "axon", "config": config, "batch": batch,
-        "pipeline_depth": depth,
+        "pipeline_depth": depth, "object_buckets": "auto",
     }, "measured_at": "t", "measured_at_unix": 1.0, "provenance": "t"}
 
 
@@ -88,6 +90,33 @@ def test_bench_done_tracks_tuned_defaults(monkeypatch, tmp_path):
         tuning={**MACHINE, "best_pipeline": 8, "best_batch": 128},
     )
     assert w2.bench_done("volume") is True
+
+
+def test_bench_done_remeasures_prebucketing_ladder_records(
+        monkeypatch, tmp_path):
+    """A ladder record captured before the pipelined+bucketed default
+    methodology (no ``object_buckets`` field) is stale ONCE — the
+    re-measure writes the field and it counts as done again.  Configs
+    whose dedicated bench paths never record the field (mesh, spatial,
+    ...) are exempt or the watcher would re-queue them forever."""
+    legacy = _record(depth=8, batch=64)
+    del legacy["record"]["object_buckets"]
+    legacy_mesh = _record(depth=8, batch=64, config="mesh")
+    del legacy_mesh["record"]["object_buckets"]
+    w = _watch(
+        monkeypatch, tmp_path,
+        cache={"records": {"3": legacy, "mesh": legacy_mesh}},
+        tuning={**MACHINE, "best_pipeline": 8, "best_batch": 64},
+    )
+    assert w.bench_done("3") is False      # pre-bucketing headline
+    assert w.bench_done("mesh") is True    # dedicated path: exempt
+    # fresh capture carries the field -> done at the same tuned defaults
+    w2 = _watch(
+        monkeypatch, tmp_path,
+        cache={"records": {"3": _record(depth=8, batch=64)}},
+        tuning={**MACHINE, "best_pipeline": 8, "best_batch": 64},
+    )
+    assert w2.bench_done("3") is True
 
 
 def test_pending_tune_couples_pipeline_to_sweep(monkeypatch, tmp_path):

@@ -546,3 +546,159 @@ def test_cross_tenant_coalescing_no_recompile(tmp_path, rng):
     for store in (exp_a, exp_b):
         feats = ExperimentStore.open(store.root).read_features("nuclei")
         assert len(feats) > 0
+
+
+# ========================================== request-level observability
+def test_serve_trace_id_links_enqueue_to_engine_phases(tmp_path, capsys):
+    """Acceptance: one trace_id, stamped at enqueue, labels the serve
+    ledger's lifecycle events AND every engine event in the job's own
+    experiment ledger — and `tmx trace --export chrome` renders the
+    whole chain (queue_wait → sched_delay → job → run/step/batch) as a
+    schema-valid document reconstructed purely from ledgers."""
+    from tmlibrary_tpu import traceexport
+    from tmlibrary_tpu.cli import main
+
+    sroot = tmp_path / "srv"
+    exp = make_exp(tmp_path, "exp")
+    assert main(["enqueue", "--root", str(sroot),
+                 "--experiment", str(exp.root), "--tenant", "a",
+                 "--job-id", "a-1", "--trace-id", "t-fixed"]) == 0
+    assert "trace t-fixed" in capsys.readouterr().out
+    assert serve.run_serve(sroot, poll_s=0.01, max_jobs=1,
+                           install_handlers=False) == 0
+
+    sevents = RunLedger(serve.ledger_path(sroot)).events()
+    admitted = next(e for e in sevents if e.get("event") == "job_admitted")
+    assert admitted["trace_id"] == "t-fixed"
+    assert admitted["queue_wait_s"] >= 0.0
+    started = next(e for e in sevents if e.get("event") == "job_started")
+    assert started["trace_id"] == "t-fixed"
+    assert started["sched_delay_s"] >= 0.0
+    spans = {e["span"]: e for e in sevents if e.get("event") == "span"}
+    assert {"queue_wait", "sched_delay", "job"} <= set(spans)
+    for name in ("queue_wait", "sched_delay", "job"):
+        assert spans[name]["trace_id"] == "t-fixed"
+        assert spans[name]["tenant"] == "a"
+    assert spans["job"]["attempt"] == 0
+
+    # the engine's OWN ledger carries the same trace labels on every
+    # event (RunLedger.append stamps the ambient scope)
+    jevents = RunLedger(exp.workflow_dir / "ledger.jsonl").events()
+    assert jevents and all(e.get("trace_id") == "t-fixed"
+                           and e.get("job") == "a-1"
+                           and e.get("tenant") == "a" for e in jevents)
+    jspans = {e["span"] for e in jevents if e.get("event") == "span"}
+    assert {"run", "step", "batch"} <= jspans
+
+    # chrome export of just this trace, from ledgers alone
+    out = tmp_path / "trace.json"
+    assert main(["trace", "--root", str(sroot), "--export", "chrome",
+                 str(out), "--trace-id", "t-fixed"]) == 0
+    assert "wrote" in capsys.readouterr().out
+    doc = json.loads(out.read_text())
+    assert traceexport.validate_chrome_trace(doc) == []
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"queue_wait", "sched_delay", "job", "run", "step",
+            "batch"} <= names
+    flows = [e for e in doc["traceEvents"] if e["ph"] in ("s", "t", "f")]
+    assert sorted(e["ph"] for e in flows) == ["f", "s", "t"]
+    # the text view accepts the serve root too (satellite: serve-root
+    # tmx trace) and honours the trace filter
+    assert main(["trace", "--root", str(sroot),
+                 "--trace-id", "t-fixed"]) == 0
+    assert "job" in capsys.readouterr().out
+
+
+def test_enqueue_generates_trace_id_when_not_given(tmp_path, capsys):
+    from tmlibrary_tpu.cli import main
+
+    sroot = tmp_path / "srv"
+    exp = make_exp(tmp_path, "exp")
+    assert main(["enqueue", "--root", str(sroot),
+                 "--experiment", str(exp.root), "--tenant", "a",
+                 "--job-id", "a-1"]) == 0
+    assert "trace " in capsys.readouterr().out
+    spec_path = serve.spool_dir(sroot, "incoming") / "a-1.json"
+    stamped = JobSpec.from_dict(json.loads(spec_path.read_text()))
+    assert stamped.trace_id and len(stamped.trace_id) == 32
+
+
+def test_slo_burn_latched_warn_only(tmp_path, monkeypatch):
+    """A sustained breach appends ONE slo_burn event per (tenant,
+    window) episode — latched, warn-only, re-armed when the burn clears."""
+    monkeypatch.setenv("TMX_SLO_AVAILABILITY", "0.99")
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "3600")
+    sroot = tmp_path / "srv"
+    daemon = serve.ServeDaemon(sroot, install_handlers=False)
+    now = time.time()
+    daemon.ledger.append(event="job_failed", job="a-1", tenant="a",
+                         error="boom")
+    # burn check is throttled; force it due
+    daemon._last_slo_check = -1e9
+    daemon._check_slo()
+    daemon._last_slo_check = -1e9
+    daemon._check_slo()  # still burning: must NOT append a second event
+    events = RunLedger(serve.ledger_path(sroot)).events()
+    burns = [e for e in events if e.get("event") == "slo_burn"]
+    assert len(burns) == 1
+    assert burns[0]["tenant"] == "a" and burns[0]["window"] == "3600"
+    assert telemetry.get_registry().counter(
+        "tmx_slo_burn_total", tenant="a", window="3600").value == 1
+    # never a step_failed / abort — warn-only contract
+    assert not any(e.get("event") == "step_failed" for e in events)
+
+    # 100 fresh successes dilute the failure below burn=1: latch re-arms
+    for i in range(100):
+        daemon.ledger.append(event="job_done", job=f"ok-{i}", tenant="a",
+                             elapsed_s=0.01)
+    daemon._last_slo_check = -1e9
+    daemon._check_slo()
+    assert daemon._slo_latched == set()
+    # a NEW breach episode warns again
+    for i in range(100):
+        daemon.ledger.append(event="job_failed", job=f"bad-{i}",
+                             tenant="a", error="boom")
+    daemon._last_slo_check = -1e9
+    daemon._check_slo()
+    burns = [e for e in RunLedger(serve.ledger_path(sroot)).events()
+             if e.get("event") == "slo_burn"]
+    assert len(burns) == 2
+    assert now  # silence lint on the unused anchor
+
+
+def test_serve_status_view_and_top_carry_slo_panel(tmp_path, capsys):
+    """serve_status_view (and therefore `tmx top --once --json`) exposes
+    the SLO report and per-tenant queue-wait quantiles; `tmx slo` renders
+    and exits 0 at the default objectives."""
+    from tmlibrary_tpu.cli import main
+
+    sroot = tmp_path / "srv"
+    exp = make_exp(tmp_path, "exp")
+    serve.enqueue_job(sroot, spec("a-1", exp.root, tenant="a"))
+    assert serve.run_serve(sroot, poll_s=0.01, max_jobs=1,
+                           install_handlers=False) == 0
+
+    view = serve.serve_status_view(sroot)
+    assert view["slo"] is not None
+    t = view["slo"]["tenants"]["a"]
+    assert t["jobs"]["ok"] == 1 and t["breach"] is False
+    assert view["queue_wait_s"]["a"]["n"] == 1
+    assert view["queue_wait_s"]["a"]["p95"] >= 0.0
+
+    assert main(["top", "--root", str(sroot), "--once", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["serve"]["slo"]["tenants"]["a"]["jobs"]["ok"] == 1
+    assert "queue_wait_s" in doc["serve"]
+    # and the rendered dashboard shows the slo row
+    assert main(["top", "--root", str(sroot), "--once"]) == 0
+    text = capsys.readouterr().out
+    assert "slo a" in text and "burn" in text
+
+    assert main(["slo", "--root", str(sroot)]) == 0
+    out = capsys.readouterr().out
+    assert "tenant a" in out and "burn 0.0" in out
+    assert main(["slo", "--root", str(sroot), "--json"]) == 0
+    jdoc = json.loads(capsys.readouterr().out)
+    assert jdoc["tenants"]["a"]["jobs"]["total"] == 1
+    # no serve ledger -> pinned no-data exit
+    assert main(["slo", "--root", str(tmp_path / "nowhere")]) == 3

@@ -1,0 +1,220 @@
+"""Per-tenant SLO accounting (``tmlibrary_tpu/slo.py``, ``tmx slo``).
+
+The hand-computed fixture pins the burn math to the numbers documented in
+DESIGN.md §21 (availability burn = bad-fraction over error budget,
+latency burn = slow-fraction over the p95's implicit 5% budget), the
+replay-parity test proves the live daemon and ``registry_from_ledger``
+feed the identical ``tmx_slo_*`` series, and the exit codes are pinned
+like the other sentinels (qc, bench_regression).
+"""
+
+import json
+import math
+import random
+
+import pytest
+
+from tmlibrary_tpu import slo, telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    """No TMX_SLO_* leakage between tests (or from the invoking shell)."""
+    import os
+
+    for k in list(os.environ):
+        if k.startswith("TMX_SLO_"):
+            monkeypatch.delenv(k, raising=False)
+    yield
+
+
+# ------------------------------------------------------------- objectives
+def test_objectives_defaults_come_from_config():
+    obj = slo.objectives("anyone")
+    assert obj.latency_p95_s == 600.0
+    assert obj.availability == 0.99
+    assert obj.windows == (3600.0, 21600.0)
+
+
+def test_objectives_env_overrides_and_per_tenant(monkeypatch):
+    monkeypatch.setenv("TMX_SLO_LATENCY_P95_S", "10")
+    monkeypatch.setenv("TMX_SLO_LATENCY_P95_S_PROD", "5")
+    monkeypatch.setenv("TMX_SLO_AVAILABILITY", "0.9")
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "60, 120")
+    assert slo.objectives("dev").latency_p95_s == 10.0
+    assert slo.objectives("prod").latency_p95_s == 5.0
+    assert slo.objectives("prod").availability == 0.9
+    assert slo.objectives("dev").windows == (60.0, 120.0)
+    # tenant names normalize to env-var alphabet: team-b -> TEAM_B
+    monkeypatch.setenv("TMX_SLO_LATENCY_P95_S_TEAM_B", "7")
+    assert slo.objectives("team-b").latency_p95_s == 7.0
+
+
+def test_objectives_garbage_env_degrades_to_config(monkeypatch):
+    monkeypatch.setenv("TMX_SLO_LATENCY_P95_S", "not-a-number")
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "bogus,,")
+    obj = slo.objectives()
+    assert obj.latency_p95_s == 600.0
+    assert obj.windows == (3600.0,)  # unparseable spec -> safe fallback
+
+
+# --------------------------------------------------------------- quantile
+def test_quantile_nearest_rank():
+    assert slo.quantile([], 0.5) is None
+    assert slo.quantile([5.0], 0.95) == 5.0
+    assert slo.quantile([4.0, 1.0, 3.0, 2.0], 0.5) == 2.0
+    assert slo.quantile([4.0, 1.0, 3.0, 2.0], 0.95) == 4.0
+    # rank math: ceil(0.95 * 9) = 9 -> the largest of nine
+    assert slo.quantile(list(map(float, range(1, 10))), 0.95) == 9.0
+
+
+# ----------------------------------------------------------------- report
+def _fixture_events():
+    """Ten tenant-a completions inside one 100 s window: 8 fast ok,
+    1 slow ok (3 s > the 2 s objective), 1 failed.  Hand computation at
+    latency_p95_s=2, availability=0.9, window=100:
+
+    * availability burn = (1/10) / (1 - 0.9)  = 1.0
+    * latency burn      = (1/10) / 0.05       = 2.0
+    * tenant burn = max = 2.0  -> breach
+    * p50 over [1.0 x8, 3.0] = 1.0 ; p95 = 3.0 ; availability = 0.9
+    """
+    events = []
+    for i in range(8):
+        events.append({"host": "h0", "ts": 10.0 + i,
+                       "event": "job_done", "job": f"a-{i}",
+                       "tenant": "a", "elapsed_s": 1.0})
+    events.append({"host": "h0", "ts": 50.0, "event": "job_done",
+                   "job": "a-slow", "tenant": "a", "elapsed_s": 3.0})
+    events.append({"host": "h0", "ts": 60.0, "event": "job_failed",
+                   "job": "a-bad", "tenant": "a", "error": "boom"})
+    return events
+
+
+def test_report_hand_computed_burn_fixture(monkeypatch):
+    monkeypatch.setenv("TMX_SLO_LATENCY_P95_S", "2")
+    monkeypatch.setenv("TMX_SLO_AVAILABILITY", "0.9")
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "100")
+    view = slo.report(_fixture_events())
+    assert view["now"] == 60.0  # defaults to the newest completion ts
+    t = view["tenants"]["a"]
+    assert t["jobs"] == {"ok": 9, "failed": 1, "expired": 0, "total": 10}
+    assert t["latency_p50_s"] == 1.0
+    assert t["latency_p95_s"] == 3.0
+    assert t["availability"] == 0.9
+    w = t["windows"]["100"]
+    assert w == {"total": 10, "bad": 1, "slow": 1,
+                 "availability_burn": 1.0, "latency_burn": 2.0,
+                 "burn": 2.0}
+    assert t["burn"] == 2.0 and t["breach"] is True
+    assert slo.breaches(view) == [
+        {"tenant": "a", "window": "100", "burn": 2.0}]
+    assert slo.exit_code(view) == slo.EXIT_BURN
+    assert "** BURN **" in slo.render(view)
+    # the whole view is JSON-serializable (tmx slo --json, top --json)
+    json.dumps(view)
+
+
+def test_report_order_independent_and_host_deduped(monkeypatch):
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "100")
+    events = _fixture_events()
+    base = slo.report(events, now=60.0)
+    shuffled = list(events)
+    random.Random(7).shuffle(shuffled)
+    # shuffled + duplicated (same host ledger read twice) must not move
+    # a single number — the merge discipline fleet ledgers rely on
+    assert slo.report(shuffled + events, now=60.0) == base
+
+
+def test_report_zero_burn_and_no_data(monkeypatch):
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "100")
+    events = [{"host": "h0", "ts": float(i), "event": "job_done",
+               "job": f"j{i}", "tenant": "a", "elapsed_s": 0.5}
+              for i in range(4)]
+    view = slo.report(events)
+    t = view["tenants"]["a"]
+    assert t["burn"] == 0.0 and t["breach"] is False
+    assert slo.exit_code(view) == slo.EXIT_OK
+    assert slo.breaches(view) == []
+    empty = slo.report([])
+    assert slo.exit_code(empty) == slo.EXIT_NO_DATA
+    assert "no job-completion events" in slo.render(empty)
+
+
+def test_availability_burn_inf_at_perfect_objective(monkeypatch):
+    """availability=1.0 leaves zero error budget: one failure is an
+    immediately-infinite burn, rendered as the JSON-safe string 'inf'."""
+    monkeypatch.setenv("TMX_SLO_AVAILABILITY", "1.0")
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "100")
+    events = [
+        {"host": "h0", "ts": 1.0, "event": "job_done", "job": "j1",
+         "tenant": "a", "elapsed_s": 0.1},
+        {"host": "h0", "ts": 2.0, "event": "job_failed", "job": "j2",
+         "tenant": "a"},
+    ]
+    view = slo.report(events)
+    w = view["tenants"]["a"]["windows"]["100"]
+    assert w["availability_burn"] == "inf" and w["burn"] == "inf"
+    assert view["tenants"]["a"]["breach"] is True
+    assert slo.exit_code(view) == slo.EXIT_BURN
+    assert slo._burn_value("inf") == math.inf
+    json.dumps(view)
+
+
+def test_window_scoping_old_completions_age_out(monkeypatch):
+    """Only completions inside each window count toward its burn: a
+    failure 1000 s ago burns the 100 s window not at all and the 2000 s
+    window fully."""
+    monkeypatch.setenv("TMX_SLO_AVAILABILITY", "0.5")
+    monkeypatch.setenv("TMX_SLO_WINDOWS", "100,2000")
+    events = [
+        {"host": "h0", "ts": 1000.0, "event": "job_failed", "job": "old",
+         "tenant": "a"},
+        {"host": "h0", "ts": 1990.0, "event": "job_done", "job": "new",
+         "tenant": "a", "elapsed_s": 0.1},
+    ]
+    view = slo.report(events, now=2000.0)
+    t = view["tenants"]["a"]
+    assert t["windows"]["100"] == {
+        "total": 1, "bad": 0, "slow": 0, "availability_burn": 0.0,
+        "latency_burn": 0.0, "burn": 0.0}
+    # 2000 s window: bad 1 of 2 -> (0.5)/(1-0.5) = 1.0
+    assert t["windows"]["2000"]["burn"] == 1.0
+    assert t["burn"] == 1.0 and t["breach"] is True
+
+
+# ---------------------------------------------------------- replay parity
+def test_replay_parity_observe_job_vs_registry_from_ledger():
+    """The live daemon's observe_job calls and registry_from_ledger over
+    the same ledger must produce identical tmx_slo_* series — one
+    definition, two feeders."""
+    events = [
+        {"host": "h0", "ts": 1.0, "event": "job_done", "job": "a-1",
+         "tenant": "a", "elapsed_s": 2.5},
+        {"host": "h0", "ts": 2.0, "event": "job_done", "job": "b-1",
+         "tenant": "b", "elapsed_s": 0.5},
+        {"host": "h0", "ts": 3.0, "event": "job_failed", "job": "a-2",
+         "tenant": "a", "error": "boom"},
+        {"host": "h0", "ts": 4.0, "event": "job_expired", "job": "b-2",
+         "tenant": "b"},
+    ]
+    live = telemetry.MetricsRegistry(enabled=True)
+    # exactly what serve.py does at each completion
+    slo.observe_job(live, "a", "ok", 2.5, host="h0")
+    slo.observe_job(live, "b", "ok", 0.5, host="h0")
+    slo.observe_job(live, "a", "failed", None, host="h0")
+    slo.observe_job(live, "b", "expired", None, host="h0")
+    replay = telemetry.registry_from_ledger(events)
+    for tenant, outcome in (("a", "ok"), ("b", "ok"),
+                            ("a", "failed"), ("b", "expired")):
+        assert (replay.counter("tmx_slo_jobs_total", tenant=tenant,
+                               outcome=outcome, host="h0").value
+                == live.counter("tmx_slo_jobs_total", tenant=tenant,
+                                outcome=outcome, host="h0").value == 1)
+    for tenant, total in (("a", 2.5), ("b", 0.5)):
+        hr = replay.histogram("tmx_slo_job_latency_seconds",
+                              tenant=tenant, host="h0")
+        hv = live.histogram("tmx_slo_job_latency_seconds",
+                            tenant=tenant, host="h0")
+        assert hr.count == hv.count == 1
+        assert hr.sum == pytest.approx(hv.sum) == pytest.approx(total)

@@ -751,3 +751,121 @@ def test_cli_perf_requires_root_or_history_verb(tmp_path, capsys,
     assert "2 records" in out
     assert "verdict: regression" in out
     assert "recapture -> bench:3" in out
+
+
+# ------------------------------------------- trace context (serving path)
+def test_trace_scope_sets_and_restores_context():
+    assert telemetry.trace_context() == {}
+    with telemetry.trace_scope(trace_id="t-1", job="a-1", tenant="a",
+                               ignored=None):
+        assert telemetry.trace_context() == {
+            "trace_id": "t-1", "job": "a-1", "tenant": "a"}
+        with telemetry.trace_scope(job="a-2"):  # nested scopes merge
+            assert telemetry.trace_context()["job"] == "a-2"
+            assert telemetry.trace_context()["trace_id"] == "t-1"
+        assert telemetry.trace_context()["job"] == "a-1"
+    assert telemetry.trace_context() == {}
+    # exception-safe restore
+    with pytest.raises(RuntimeError):
+        with telemetry.trace_scope(trace_id="t-2"):
+            raise RuntimeError("boom")
+    assert telemetry.trace_context() == {}
+
+
+def test_ledger_append_stamps_trace_context(tmp_path):
+    """RunLedger.append labels every sealed event with the ambient trace
+    context — the one edit point that links enqueue → run → phase — but
+    never overwrites an explicitly-passed label."""
+    led = RunLedger(tmp_path / "ledger.jsonl")
+    with telemetry.trace_scope(trace_id="t-1", job="a-1", tenant="a"):
+        led.append(event="batch_done", step="s", batch=0, elapsed=0.1)
+        led.append(event="job_done", job="explicit", elapsed_s=1.0)
+    led.append(event="step_done", step="s", elapsed=0.2)
+    evs = led.events()
+    assert evs[0]["trace_id"] == "t-1" and evs[0]["job"] == "a-1" \
+        and evs[0]["tenant"] == "a"
+    assert evs[1]["job"] == "explicit"  # setdefault keeps explicit labels
+    assert "trace_id" not in evs[2]  # outside the scope: unstamped
+
+
+# ----------------------------------------------------- flight recorder
+@pytest.fixture()
+def _fresh_flightrec():
+    telemetry.reset_flight_recorder()
+    yield
+    telemetry.reset_flight_recorder()
+
+
+def test_flight_recorder_ring_bounded_and_dump(tmp_path, monkeypatch,
+                                               _fresh_flightrec):
+    monkeypatch.setenv("TMX_FLIGHTREC_N", "8")
+    for i in range(20):
+        telemetry.flight_record({"event": "e", "i": i})
+    evs = telemetry.flight_events()
+    assert [e["i"] for e in evs] == list(range(12, 20))  # last 8 kept
+    out = telemetry.flightrec_path(tmp_path)
+    assert out.name == f"flightrec.{telemetry.host_id()}.json"
+    got = telemetry.flight_dump(out, reason="watchdog",
+                                extra={"step": "jterator"})
+    assert got == str(out)
+    payload = json.loads(out.read_text())
+    assert payload["reason"] == "watchdog"
+    assert payload["step"] == "jterator"
+    assert payload["capacity"] == 8
+    assert payload["pid"] == os.getpid()
+    assert [e["i"] for e in payload["events"]] == list(range(12, 20))
+
+
+def test_flight_dump_empty_ring_returns_none(tmp_path, _fresh_flightrec):
+    assert telemetry.flight_dump(tmp_path / "x.json") is None
+    assert not (tmp_path / "x.json").exists()
+
+
+def test_flight_recorder_zero_cost_when_disabled(_fresh_flightrec):
+    """Telemetry off ⇒ no ring is ever allocated — the pin behind the
+    'disabled runs carry zero new instrument cost' acceptance bar."""
+    telemetry.reset_registry(enabled=False)
+    for i in range(5):
+        telemetry.flight_record({"event": "e", "i": i})
+    assert telemetry.flight_events() == []
+    assert telemetry._flight is None  # not even an empty deque
+
+
+def test_engine_run_feeds_flight_recorder(tmp_path, _fresh_flightrec,
+                                          source_dir, store):
+    """Every ledger append lands in the ring, so a post-mortem dump shows
+    the exact event tail."""
+    desc = make_description(source_dir, store)
+    Workflow(store, desc).run()
+    evs = telemetry.flight_events()
+    assert evs, "run appended nothing to the flight ring"
+    kinds = {e.get("event") for e in evs}
+    assert "run_done" in kinds or "step_done" in kinds
+
+
+# ------------------------------------- ledger replay: serve/slo kinds
+def test_registry_from_ledger_queue_wait_sched_delay_and_burn():
+    events = [
+        {"host": "h0", "ts": 1.0, "event": "job_admitted", "job": "a-1",
+         "tenant": "a", "queue_wait_s": 0.25},
+        {"host": "h0", "ts": 2.0, "event": "job_started", "job": "a-1",
+         "tenant": "a", "sched_delay_s": 0.5},
+        {"host": "h0", "ts": 3.0, "event": "slo_burn", "tenant": "a",
+         "window": "3600", "burn": 2.0},
+        {"host": "h0", "ts": 4.0, "event": "job_done", "job": "a-1",
+         "tenant": "a", "elapsed_s": 1.5},
+    ]
+    reg = telemetry.registry_from_ledger(events + events)  # dup read
+    qw = reg.histogram("tmx_serve_queue_wait_seconds", tenant="a",
+                       host="h0")
+    assert qw.count == 1 and qw.sum == pytest.approx(0.25)
+    sd = reg.histogram("tmx_serve_sched_delay_seconds", tenant="a",
+                       host="h0")
+    assert sd.count == 1 and sd.sum == pytest.approx(0.5)
+    assert reg.counter("tmx_slo_burn_total", tenant="a", window="3600",
+                       host="h0").value == 1
+    assert reg.counter("tmx_slo_jobs_total", tenant="a", outcome="ok",
+                       host="h0").value == 1
+    lat = reg.histogram("tmx_slo_job_latency_seconds", tenant="a",
+                        host="h0")
+    assert lat.count == 1 and lat.sum == pytest.approx(1.5)

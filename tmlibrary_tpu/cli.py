@@ -189,11 +189,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_trace = sub.add_parser(
         "trace",
         help="dump the run's span tree (run > step > batch > phase) with "
-             "critical-path annotation from the run ledger",
+             "critical-path annotation from the run ledger, or export a "
+             "Chrome trace; accepts experiment AND serve roots",
     )
     _add_common(p_trace)
     p_trace.add_argument("--json", action="store_true", dest="as_json",
                          help="emit the annotated tree as JSON")
+    p_trace.add_argument("--export", choices=("chrome",), default=None,
+                         help="export format: 'chrome' writes Trace Event "
+                              "Format JSON (chrome://tracing / Perfetto)")
+    p_trace.add_argument("out", nargs="?", default=None,
+                         help="output path for --export (default "
+                              "trace.json)")
+    p_trace.add_argument("--trace-id", default=None,
+                         help="restrict the export to one job's trace id")
 
     p_perf = sub.add_parser(
         "perf",
@@ -437,6 +446,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_enq.add_argument("--attempt", type=int, default=0, metavar="N",
                        help="resubmission count (attempt > 0 spends one "
                             "retry-budget token)")
+    p_enq.add_argument("--trace-id", default=None,
+                       help="end-to-end trace correlation id (default: "
+                            "generated); every ledger event the job "
+                            "produces carries it, and `tmx trace --export "
+                            "chrome --trace-id ID` renders the full "
+                            "enqueue-to-result timeline")
+
+    p_slo = sub.add_parser(
+        "slo", help="per-tenant SLO report over a serve root: p50/p95 "
+                    "latency, availability, multi-window burn rates "
+                    "(exit 0 ok / 1 burn / 3 no data)")
+    _add_common(p_slo)
+    p_slo.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the report as JSON")
 
     p_tool = sub.add_parser("tool", help="analysis tools over the feature store")
     tool_sub = p_tool.add_subparsers(dest="verb", required=True)
@@ -1000,6 +1023,7 @@ def cmd_enqueue(args) -> int:
 
     now = time.time()
     job_id = args.job_id or f"{args.tenant}-{uuid.uuid4().hex[:10]}"
+    trace_id = getattr(args, "trace_id", None) or uuid.uuid4().hex
     spec = JobSpec(
         job_id=job_id,
         tenant=args.tenant,
@@ -1010,6 +1034,7 @@ def cmd_enqueue(args) -> int:
         pipeline_depth=args.pipeline_depth,
         attempt=args.attempt,
         submitted_at=now,
+        trace_id=trace_id,
     )
     try:
         path = serve_mod.enqueue_job(Path(args.root), spec)
@@ -1017,7 +1042,8 @@ def cmd_enqueue(args) -> int:
         print(f"error: enqueue failed for job {job_id}: {exc}",
               file=sys.stderr)
         return 1
-    print(f"enqueued {job_id} (tenant {spec.tenant}) -> {path}")
+    print(f"enqueued {job_id} (tenant {spec.tenant}, trace {trace_id}) "
+          f"-> {path}")
     return 0
 
 
@@ -1542,14 +1568,37 @@ def cmd_top(args) -> int:
 def cmd_trace(args) -> int:
     """Dump the span tree (run > step > batch > phase) with the critical
     path marked ``*`` at every level — the chain the run's wall time
-    actually went to."""
-    from tmlibrary_tpu import telemetry
+    actually went to.  Accepts serve roots too (the spooled job specs
+    point at their experiment ledgers), and ``--export chrome`` writes
+    the whole thing as Trace Event Format JSON."""
+    from tmlibrary_tpu import serve as serve_mod
+    from tmlibrary_tpu import telemetry, traceexport
 
-    store = _open_store(args)
-    events = RunLedger(store.workflow_dir / "ledger.jsonl").events()
+    root = Path(args.root)
+    if getattr(args, "export", None) == "chrome":
+        out = Path(args.out or "trace.json")
+        try:
+            doc = traceexport.export_chrome_trace(
+                root, out, trace_id=getattr(args, "trace_id", None))
+        except ValueError as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+        n = len(doc.get("traceEvents", []))
+        print(f"wrote {n} trace events -> {out}")
+        return 0 if n else 1
+    if serve_mod.is_serve_root(root):
+        # a serve root has no single span tree — merge every reachable
+        # ledger so the text view still answers "where did time go"
+        events = traceexport.collect_events(root)
+    else:
+        store = _open_store(args)
+        events = RunLedger(store.workflow_dir / "ledger.jsonl").events()
     if not events:
         print("no run ledger — nothing to trace", file=sys.stderr)
         return 1
+    tid = getattr(args, "trace_id", None)
+    if tid:
+        events = [ev for ev in events if ev.get("trace_id") == tid]
     tree = telemetry.annotate_critical_path(
         telemetry.build_span_tree(events)
     )
@@ -1564,6 +1613,31 @@ def cmd_trace(args) -> int:
                                               key=lambda kv: -kv[1]))
         print(f"\nphase totals (critical resource): {phases}")
     return 0
+
+
+def cmd_slo(args) -> int:
+    """Per-tenant SLO report over a serve root's ledger: p50/p95 job
+    latency vs the latency objective, availability vs the availability
+    objective, and multi-window burn rates.
+
+    Exit codes (pinned, same discipline as qc/bench_regression):
+    0 ok · 1 some tenant's burn >= 1 · 3 no job-completion data."""
+    from tmlibrary_tpu import slo as slo_mod
+
+    root = Path(args.root)
+    lp = root / "serve" / "ledger.jsonl"
+    if not lp.exists():
+        # experiment roots have no job completions — say so with the
+        # pinned no-data code rather than a generic error
+        print(f"no serve ledger under {root} — `tmx slo` reads a serve "
+              "root", file=sys.stderr)
+        return slo_mod.EXIT_NO_DATA
+    view = slo_mod.report(RunLedger(lp).events())
+    if getattr(args, "as_json", False):
+        print(json.dumps(view, indent=2))
+    else:
+        print(slo_mod.render(view), end="")
+    return slo_mod.exit_code(view)
 
 
 def cmd_qc(args) -> int:
@@ -1916,6 +1990,8 @@ def main(argv=None) -> int:
             return cmd_top(args)
         if args.command == "trace":
             return cmd_trace(args)
+        if args.command == "slo":
+            return cmd_slo(args)
         if args.command == "qc":
             return cmd_qc(args)
         if args.command == "perf":

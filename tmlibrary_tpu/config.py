@@ -227,6 +227,24 @@ class LibraryConfig:
             _setting("serve_admission_deadline_s", "60")
         )
     )
+    # ---------------------------------------------------------- SLO
+    # (slo.py; env: TM_SLO_* here, with TMX_SLO_* runtime overrides —
+    # including per-tenant TMX_SLO_<KNOB>_<TENANT> — taking precedence)
+    #: per-tenant latency objective: p95 job latency must stay at or
+    #: under this many seconds
+    slo_latency_p95_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("slo_latency_p95_s", "600"))
+    )
+    #: per-tenant availability objective: the fraction of jobs that must
+    #: complete ok (failed + expired spend the error budget)
+    slo_availability: float = dataclasses.field(
+        default_factory=lambda: float(_setting("slo_availability", "0.99"))
+    )
+    #: comma-separated burn-rate windows, seconds (multi-window per the
+    #: usual fast-burn/slow-burn alerting split)
+    slo_windows: str = dataclasses.field(
+        default_factory=lambda: _setting("slo_windows", "3600,21600")
+    )
 
     def experiment_location(self, experiment_name: str) -> Path:
         return Path(self.storage_home) / "experiments" / experiment_name

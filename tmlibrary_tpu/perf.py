@@ -198,11 +198,29 @@ _RUNTIME: dict[tuple, dict] = {}
 _MAX_SIGNATURES = 8
 
 
+#: compile spans buffered for the engine thread — record_compile can run
+#: on persist-worker threads (jterator bucket escalation), and only the
+#: engine thread may append to the run ledger, so spans queue here until
+#: WorkflowEngine._drain_compile_spans pops them
+_COMPILE_SPANS: list[dict] = []
+
+
+def pop_compile_spans() -> list[dict]:
+    """Drain buffered compile spans (engine thread).  Each dict carries
+    step/program/t0/elapsed/recompile, ready to append as a ledger
+    ``span`` event with ``span="compile"``."""
+    with _LOCK:
+        spans = list(_COMPILE_SPANS)
+        _COMPILE_SPANS.clear()
+    return spans
+
+
 def reset_profiles() -> None:
     """Drop all recorded program profiles (tests, fresh runs)."""
     with _LOCK:
         _PROFILES.clear()
         _RUNTIME.clear()
+        _COMPILE_SPANS.clear()
 
 
 def perf_profiles() -> list[dict]:
@@ -282,6 +300,14 @@ def record_compile(*, program: str, step: str = "jterator",
                 reg.histogram(
                     "tmx_perf_compile_seconds", capacity=labels["capacity"],
                 ).observe(compile_s)
+                with _LOCK:
+                    _COMPILE_SPANS.append({
+                        "step": str(step),
+                        "program": str(program),
+                        "t0": round(time.time() - compile_s, 6),
+                        "elapsed": round(compile_s, 6),
+                        "recompile": bool(recompile),
+                    })
             if cost.flops:
                 reg.gauge("tmx_perf_program_flops", **labels).set(cost.flops)
             if cost.bytes:
@@ -493,11 +519,28 @@ def _comparable(rec: dict) -> bool:
     return isinstance(value, (int, float)) and value > 0
 
 
+def _methodology_class(rec: dict) -> str:
+    """Coarse timing-methodology family for like-for-like comparison:
+    the specific fetch depth may drift with tuning, but a pipelined
+    capture must never be judged against a host-synchronous one (the
+    fetch tax makes them different experiments), nor a bucket-routed
+    capture against a full-capacity one.  Records predating the
+    ``timing_methodology`` field form their own ``legacy`` family so
+    old-vs-old still compares."""
+    m = str(rec.get("timing_methodology") or "")
+    if not m:
+        return "legacy"
+    if m.startswith("pipelined"):
+        return "pipelined+bucketed" if "bucketed" in m else "pipelined"
+    return m
+
+
 def _history_key(rec: dict) -> tuple:
     return (
         str(rec.get("metric", "")),
         str(rec.get("config", "")),
         _backend_class(rec.get("backend")),
+        _methodology_class(rec),
     )
 
 

@@ -52,12 +52,14 @@ the daemon.  The admission loop is armed by the phase watchdog
 
 from __future__ import annotations
 
+import functools
 import logging
+import sys
 import time
 from contextlib import nullcontext
 from pathlib import Path
 
-from tmlibrary_tpu import faults, telemetry
+from tmlibrary_tpu import faults, slo, telemetry
 from tmlibrary_tpu.atomicio import atomic_write_json
 from tmlibrary_tpu.errors import FaultInjected, PreemptedError
 from tmlibrary_tpu.resilience import (
@@ -85,6 +87,14 @@ logger = logging.getLogger(__name__)
 #: spool subdirectories, in lifecycle order
 SPOOL_STATES = ("incoming", "admitted", "done", "failed", "rejected",
                 "expired")
+
+#: a scan pass shedding at least this many jobs is a "shed storm" — one
+#: of the flight-recorder dump triggers (latched: one dump per storm,
+#: re-armed by a clean pass)
+SHED_STORM_N = 3
+
+#: throttle for the daemon's periodic SLO burn evaluation (seconds)
+SLO_CHECK_PERIOD_S = 5.0
 
 
 # ------------------------------------------------------------------ paths
@@ -170,6 +180,15 @@ class ServeDaemon:
                 {"admission": float(cfg.serve_admission_deadline_s)}
             )
         self._jobs_run = 0
+        #: job_id → admission wall time, for the WDRR scheduling-delay
+        #: span (admit → execute start)
+        self._admit_ts: dict[str, float] = {}
+        #: (tenant, window) pairs already warned this burn episode —
+        #: slo_burn is warn-only AND latched, so a sustained breach is
+        #: one ledger event, not one per loop iteration
+        self._slo_latched: set[tuple[str, str]] = set()
+        self._shed_latch = False
+        self._last_slo_check = 0.0
 
     # ------------------------------------------------------------ helpers
     def _arm(self, phase: str):
@@ -213,6 +232,39 @@ class ServeDaemon:
         age = snap.get("oldest_job_age_s")
         if age is not None:
             self._metric("gauge", "tmx_serve_oldest_job_age_seconds", age)
+
+    def _check_slo(self) -> None:
+        """Periodic warn-only burn evaluation (throttled): replay the
+        serve ledger's completion events through :mod:`slo` and append a
+        latched ``slo_burn`` event per newly-breached (tenant, window).
+        Same contract as QC: the service reports its own SLO, it never
+        aborts or sheds because of it."""
+        now = time.monotonic()
+        if now - self._last_slo_check < SLO_CHECK_PERIOD_S:
+            return
+        self._last_slo_check = now
+        try:
+            view = slo.report(self.ledger.events(), now=time.time())
+            burning: set[tuple[str, str]] = set()
+            for b in slo.breaches(view):
+                key = (b["tenant"], b["window"])
+                burning.add(key)
+                if key in self._slo_latched:
+                    continue
+                self._slo_latched.add(key)
+                self.ledger.append(event="slo_burn", tenant=b["tenant"],
+                                   window=b["window"], burn=b["burn"])
+                self._metric("counter", "tmx_slo_burn_total",
+                             tenant=b["tenant"], window=str(b["window"]))
+                logger.warning(
+                    "SLO burn for tenant %s over window %ss: burn %s "
+                    "(warn-only — inspect with `tmx slo`)",
+                    b["tenant"], b["window"], b["burn"],
+                )
+            # a (tenant, window) that stopped burning re-arms its latch
+            self._slo_latched &= burning
+        except Exception:
+            logger.debug("slo evaluation failed", exc_info=True)
 
     def _write_metrics(self) -> None:
         if not telemetry.enabled():
@@ -274,11 +326,14 @@ class ServeDaemon:
             return reject(REASON_FAULT)
 
     def _scan_incoming(self) -> None:
+        sheds = 0
         for path in sorted(spool_dir(self.serve_root, "incoming")
                            .glob("*.json")):
             if preemption_requested():
                 return  # drain beats admission; specs stay spooled
-            spec = self._load_spec(path)
+            with telemetry.trace_scope(job=path.stem), \
+                    telemetry.span("spool_pickup", emit=self.ledger.append):
+                spec = self._load_spec(path)
             if spec is None:
                 decision = reject(REASON_INVALID)
                 self._move_spool(path.stem, "rejected", {
@@ -293,43 +348,105 @@ class ServeDaemon:
                 self._metric("counter", "tmx_serve_rejected_total",
                              tenant="unknown", reason=decision.reason)
                 continue
-            decision = self._offer(spec)
-            if decision.admitted:
-                atomic_write_json(
-                    spool_dir(self.serve_root, "admitted")
-                    / f"{spec.job_id}.json",
-                    spec.to_dict(),
-                )
-                path.unlink()
-                self.ledger.append(event="job_admitted", job=spec.job_id,
-                                   tenant=spec.tenant, attempt=spec.attempt)
-                self._metric("counter", "tmx_serve_admitted_total",
-                             tenant=spec.tenant)
-            else:
-                self._move_spool(spec.job_id, "rejected", {
-                    "job": spec.to_dict(), "decision": decision.to_dict(),
-                    "ts": time.time(),
-                })
-                self.ledger.append(
-                    event="job_rejected", job=spec.job_id,
-                    tenant=spec.tenant, reason=decision.reason,
-                    retry_after_s=decision.retry_after_s,
-                )
-                self._metric("counter", "tmx_serve_rejected_total",
-                             tenant=spec.tenant, reason=decision.reason)
-                if decision.reason in SHED_REASONS:
-                    self._metric("counter", "tmx_serve_shed_total",
+            # every event below inherits the job's trace labels
+            # (trace_id stamped by `tmx enqueue`) via RunLedger.append
+            with telemetry.trace_scope(trace_id=spec.trace_id,
+                                       job=spec.job_id,
+                                       tenant=spec.tenant):
+                with telemetry.span("admission", emit=self.ledger.append):
+                    decision = self._offer(spec)
+                if decision.admitted:
+                    atomic_write_json(
+                        spool_dir(self.serve_root, "admitted")
+                        / f"{spec.job_id}.json",
+                        spec.to_dict(),
+                    )
+                    path.unlink()
+                    now = time.time()
+                    wait = (max(0.0, now - float(spec.submitted_at))
+                            if spec.submitted_at else None)
+                    self._admit_ts[spec.job_id] = now
+                    extra = ({"queue_wait_s": round(wait, 3)}
+                             if wait is not None else {})
+                    if wait is not None and telemetry.enabled():
+                        # enqueue → admit, as a span so the Chrome trace
+                        # shows the wait as a real interval
+                        self.ledger.append(
+                            event="span", span="queue_wait",
+                            t0=round(float(spec.submitted_at), 6),
+                            elapsed=round(wait, 6),
+                        )
+                    self.ledger.append(event="job_admitted",
+                                       job=spec.job_id,
+                                       tenant=spec.tenant,
+                                       attempt=spec.attempt, **extra)
+                    self._metric("counter", "tmx_serve_admitted_total",
                                  tenant=spec.tenant)
+                    if wait is not None:
+                        self._metric("histogram",
+                                     "tmx_serve_queue_wait_seconds",
+                                     wait, tenant=spec.tenant)
+                else:
+                    self._move_spool(spec.job_id, "rejected", {
+                        "job": spec.to_dict(),
+                        "decision": decision.to_dict(),
+                        "ts": time.time(),
+                    })
+                    self.ledger.append(
+                        event="job_rejected", job=spec.job_id,
+                        tenant=spec.tenant, reason=decision.reason,
+                        retry_after_s=decision.retry_after_s,
+                    )
+                    self._metric("counter", "tmx_serve_rejected_total",
+                                 tenant=spec.tenant,
+                                 reason=decision.reason)
+                    if decision.reason in SHED_REASONS:
+                        sheds += 1
+                        self._metric("counter", "tmx_serve_shed_total",
+                                     tenant=spec.tenant)
+        if sheds >= SHED_STORM_N and not self._shed_latch:
+            self._shed_latch = True
+            telemetry.flight_dump(
+                telemetry.flightrec_path(serve_dir(self.serve_root)),
+                reason="shed_storm", extra={"sheds": sheds},
+            )
+        elif sheds == 0:
+            self._shed_latch = False
 
     # ---------------------------------------------------------- execution
     def _execute(self, job: JobSpec) -> str:
         """Run one admitted job to an outcome: ``done``, ``failed``,
-        ``expired`` or ``preempted``."""
+        ``expired`` or ``preempted``.
+
+        The whole execution runs under the job's trace scope, so every
+        event the engine seals into the *experiment* ledger (run/step/
+        batch/phase spans, compile spans, batch_done) carries the same
+        ``trace_id``/``job``/``tenant`` labels as the serve ledger's
+        lifecycle events — one trace id, reconstructed purely from
+        ledgers, covers enqueue → result."""
+        with telemetry.trace_scope(trace_id=job.trace_id, job=job.job_id,
+                                   tenant=job.tenant):
+            return self._execute_traced(job)
+
+    def _execute_traced(self, job: JobSpec) -> str:
         from tmlibrary_tpu.models.store import ExperimentStore
         from tmlibrary_tpu.workflow.engine import Workflow, WorkflowDescription
 
+        admit_ts = self._admit_ts.pop(job.job_id, None)
+        delay = (max(0.0, time.time() - admit_ts)
+                 if admit_ts is not None else None)
+        extra = ({"sched_delay_s": round(delay, 3)}
+                 if delay is not None else {})
+        if delay is not None and telemetry.enabled():
+            # admit → execute start: the WDRR scheduling delay
+            self.ledger.append(event="span", span="sched_delay",
+                               t0=round(admit_ts, 6),
+                               elapsed=round(delay, 6))
         self.ledger.append(event="job_started", job=job.job_id,
-                           tenant=job.tenant)
+                           tenant=job.tenant, attempt=job.attempt, **extra)
+        if delay is not None:
+            self._metric("histogram", "tmx_serve_sched_delay_seconds",
+                         delay, tenant=job.tenant)
         deadline = float(job.deadline) if job.deadline else None
 
         def should_stop() -> bool:
@@ -344,18 +461,28 @@ class ServeDaemon:
 
         t0 = time.monotonic()
         try:
-            store = ExperimentStore.open(Path(job.root))
-            if job.description:
-                desc_path = Path(job.description)
-                if not desc_path.is_absolute():
-                    desc_path = Path(job.root) / desc_path
-            else:
-                desc_path = store.workflow_dir / "workflow.yaml"
-            desc = WorkflowDescription.load(desc_path)
-            wf = Workflow(store, desc, pipeline_depth=job.pipeline_depth,
-                          should_stop=should_stop, stop_reason=stop_reason)
-            resume = wf.ledger.path.exists()
-            summary = wf.run(resume=resume)
+            # the job span: per-attempt wall time of the whole execution,
+            # the parent interval the engine's run→step→batch→phase tree
+            # nests under in the exported trace
+            with telemetry.span(
+                "job",
+                emit=functools.partial(self.ledger.append,
+                                       attempt=job.attempt),
+            ):
+                store = ExperimentStore.open(Path(job.root))
+                if job.description:
+                    desc_path = Path(job.description)
+                    if not desc_path.is_absolute():
+                        desc_path = Path(job.root) / desc_path
+                else:
+                    desc_path = store.workflow_dir / "workflow.yaml"
+                desc = WorkflowDescription.load(desc_path)
+                wf = Workflow(store, desc,
+                              pipeline_depth=job.pipeline_depth,
+                              should_stop=should_stop,
+                              stop_reason=stop_reason)
+                resume = wf.ledger.path.exists()
+                summary = wf.run(resume=resume)
         except PreemptedError as exc:
             if exc.reason == "deadline" and not preemption_requested():
                 self.ledger.append(event="job_expired", job=job.job_id,
@@ -367,6 +494,8 @@ class ServeDaemon:
                 self._metric("counter",
                              "tmx_serve_deadline_expired_total",
                              tenant=job.tenant)
+                slo.observe_job(telemetry.get_registry(), job.tenant,
+                                "expired")
                 return "expired"
             return "preempted"  # caller drains and re-spools
         except FaultInjected as exc:
@@ -390,6 +519,10 @@ class ServeDaemon:
                      tenant=job.tenant)
         self._metric("histogram", "tmx_serve_job_seconds", elapsed,
                      tenant=job.tenant)
+        # the same observe_job definition registry_from_ledger replays,
+        # so a live registry and a ledger-replayed one agree exactly
+        slo.observe_job(telemetry.get_registry(), job.tenant, "ok",
+                        round(elapsed, 3))
         return "done"
 
     def _job_failed(self, job: JobSpec, exc: Exception) -> None:
@@ -404,6 +537,7 @@ class ServeDaemon:
         self.queue.record_result(job.tenant, ok=False)
         self._metric("counter", "tmx_serve_jobs_failed_total",
                      tenant=job.tenant)
+        slo.observe_job(telemetry.get_registry(), job.tenant, "failed")
 
     # -------------------------------------------------------------- drain
     def _drain_and_exit(self, current: JobSpec | None = None) -> int:
@@ -431,6 +565,11 @@ class ServeDaemon:
         self.ledger.append(event="serve_preempted",
                            reason=preemption_reason(),
                            requeued=len(requeued))
+        telemetry.flight_dump(
+            telemetry.flightrec_path(serve_dir(self.serve_root)),
+            reason=f"preempted:{preemption_reason()}",
+            extra={"requeued": len(requeued)},
+        )
         self._metric("counter", "tmx_serve_preemptions_total")
         logger.warning(
             "serve preempted (%s): re-spooled %d job(s), exiting %d for "
@@ -462,9 +601,18 @@ class ServeDaemon:
                     # and keep serving — overload/chaos never crash
                     logger.warning("admission scan error: %s", exc)
                 if self._watchdog is not None:
+                    fired = False
                     for ev in self._watchdog.drain_events():
                         self.ledger.append(event="watchdog", **ev)
+                        fired = True
+                    if fired:
+                        telemetry.flight_dump(
+                            telemetry.flightrec_path(
+                                serve_dir(self.serve_root)),
+                            reason="watchdog",
+                        )
                 self._publish_state()
+                self._check_slo()
                 if preemption_requested():
                     return self._drain_and_exit()
                 job = self.queue.take()
@@ -491,6 +639,16 @@ class ServeDaemon:
         finally:
             if self._watchdog is not None:
                 self._watchdog.stop()
+            exc = sys.exc_info()[1]
+            if exc is not None and not (isinstance(exc, FaultInjected)
+                                        and exc.fatal):
+                # unhandled crash: preserve the last-N event ring for the
+                # post-mortem (a FATAL injected fault simulates hard
+                # process death — a dead process writes nothing)
+                telemetry.flight_dump(
+                    telemetry.flightrec_path(serve_dir(self.serve_root)),
+                    reason=f"crash:{type(exc).__name__}",
+                )
             try:
                 self._publish_state()
             except Exception:
@@ -536,10 +694,13 @@ def serve_status_view(serve_root: Path) -> dict:
     lp = ledger_path(serve_root)
     tenants: dict[str, dict] = {}
     preempted = 0
+    view["slo"] = None
     if lp.exists():
         from tmlibrary_tpu.workflow.engine import RunLedger
 
-        for ev in RunLedger(lp).events():
+        events = RunLedger(lp).events()
+        waits: dict[str, list[float]] = {}
+        for ev in events:
             kind = ev.get("event")
             if kind == "serve_preempted":
                 preempted += 1
@@ -552,6 +713,22 @@ def serve_status_view(serve_root: Path) -> dict:
                 "expired": 0, "requeued": 0,
             })
             t[kind.removeprefix("job_")] += 1
+            if kind == "job_admitted" and ev.get("queue_wait_s") is not None:
+                waits.setdefault(str(ev.get("tenant", "unknown")),
+                                 []).append(float(ev["queue_wait_s"]))
+        view["queue_wait_s"] = {
+            tenant: {"n": len(vals),
+                     "p50": slo.quantile(vals, 0.50),
+                     "p95": slo.quantile(vals, 0.95)}
+            for tenant, vals in sorted(waits.items())
+        }
+        try:
+            # the SLO panel `tmx top`/`tmx slo`/CI all consume — derived
+            # from the same ledger events, so it works with or without a
+            # live daemon
+            view["slo"] = slo.report(events)
+        except Exception:
+            logger.debug("slo report failed", exc_info=True)
     view["tenants"] = tenants
     view["preemptions"] = preempted
     return view

@@ -591,6 +591,137 @@ def span(name: str, emit: Callable[..., Any] | None = None,
 
 
 # ---------------------------------------------------------------------------
+# trace context (request-scoped labels for the serving path)
+#
+# `tmx enqueue` stamps a trace_id into the job spec; the serve daemon opens
+# a trace scope around each job execution, and RunLedger.append stamps the
+# scope's labels onto every event it seals — so one trace id covers
+# enqueue → admission → queue wait → run → step → batch → phase without
+# threading job identity through every engine call site.  Process-level on
+# purpose (not thread-local): the daemon executes one job at a time, while
+# span events surface from executor worker threads that must inherit the
+# job's identity.
+
+_trace_ctx: dict[str, Any] = {}
+
+
+def trace_context() -> dict[str, Any]:
+    """The active trace labels (``trace_id``/``job``/``tenant``); empty
+    outside a job scope."""
+    return dict(_trace_ctx)
+
+
+def set_trace_context(**labels: Any) -> None:
+    """Replace the process trace labels (None values dropped; no labels
+    clears the context)."""
+    global _trace_ctx
+    _trace_ctx = {k: v for k, v in labels.items() if v is not None}
+
+
+@contextlib.contextmanager
+def trace_scope(**labels: Any) -> Iterator[None]:
+    """Install trace labels for the duration of one job execution,
+    restoring the previous scope on exit (exception-safe)."""
+    global _trace_ctx
+    prev = _trace_ctx
+    _trace_ctx = {**prev,
+                  **{k: v for k, v in labels.items() if v is not None}}
+    try:
+        yield
+    finally:
+        _trace_ctx = prev
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (bounded ring of the last N ledger events per process)
+#
+# Fed by RunLedger.append, dumped on watchdog fire / preemption drain /
+# shed storm / unhandled crash so a post-mortem sees the exact event tail
+# that preceded the incident even when the process died before sealing a
+# snapshot.  Zero-cost when telemetry is disabled: no ring is allocated,
+# no event is copied (shared null-instrument discipline).
+
+_FLIGHT_DEFAULT_N = 256
+_flight: "Any | None" = None  # collections.deque, lazily allocated
+_flight_lock = threading.Lock()
+
+
+def _flight_capacity() -> int:
+    try:
+        n = int(os.environ.get("TMX_FLIGHTREC_N", "") or _FLIGHT_DEFAULT_N)
+    except ValueError:
+        return _FLIGHT_DEFAULT_N
+    return max(8, n)
+
+
+def flight_record(event: dict) -> None:
+    """Append one event to the flight-recorder ring (no-op when telemetry
+    is disabled)."""
+    if not enabled():
+        return
+    global _flight
+    ring = _flight
+    if ring is None:
+        with _flight_lock:
+            ring = _flight
+            if ring is None:
+                import collections
+
+                ring = _flight = collections.deque(
+                    maxlen=_flight_capacity()
+                )
+    ring.append(event)
+
+
+def flight_events() -> list[dict]:
+    """The ring's current contents, oldest first (tests/inspection)."""
+    ring = _flight
+    return list(ring) if ring else []
+
+
+def reset_flight_recorder() -> None:
+    """Drop the ring (tests, fresh daemon starts)."""
+    global _flight
+    with _flight_lock:
+        _flight = None
+
+
+def flight_dump(path: Path | str, reason: str = "",
+                extra: dict | None = None) -> str | None:
+    """Dump the ring to ``path`` via an atomic write; returns the path, or
+    None when the ring is empty/unallocated or the write failed.  Never
+    raises — the flight recorder is a post-mortem aid, not a failure
+    source."""
+    ring = _flight
+    if not ring:
+        return None
+    payload = {
+        "host": host_id(),
+        "pid": os.getpid(),
+        "reason": reason or "manual",
+        "dumped_at": round(time.time(), 6),
+        "capacity": ring.maxlen,
+        "events": list(ring),
+    }
+    if extra:
+        payload.update(extra)
+    try:
+        from tmlibrary_tpu.atomicio import atomic_write_json
+
+        atomic_write_json(Path(path), payload)
+    except Exception:
+        logger.debug("flight-recorder dump to %s failed", path,
+                     exc_info=True)
+        return None
+    return str(path)
+
+
+def flightrec_path(directory: Path | str) -> Path:
+    """Canonical per-host dump location under a workflow/serve dir."""
+    return Path(directory) / f"flightrec.{host_id()}.json"
+
+
+# ---------------------------------------------------------------------------
 # resource sampler
 
 
@@ -986,6 +1117,17 @@ def merge_snapshots(
 # ledger → metrics derivation (post-hoc inspection of any run, incl. seed-era)
 
 
+def _observe_slo(reg: MetricsRegistry, tenant: str, outcome: str,
+                 elapsed_s, hl: dict) -> None:
+    """Feed the ``tmx_slo_*`` series from one job-completion event — the
+    single definition both the live daemon and ledger replay use, so a
+    replayed registry matches what the daemon showed (slo.py owns the
+    objective/burn math; these are just the raw series)."""
+    from tmlibrary_tpu import slo
+
+    slo.observe_job(reg, tenant, outcome, elapsed_s, **hl)
+
+
 def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
     """Derive a metrics registry from run-ledger events.
 
@@ -1180,14 +1322,30 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
             ).inc()
         elif kind in ("job_admitted", "job_rejected", "job_done",
                       "job_failed", "job_expired", "job_requeued",
-                      "serve_preempted"):
+                      "job_started", "serve_preempted", "slo_burn"):
             # serve-ledger events (serve.py): per-tenant admission /
             # outcome series, mirroring the daemon's live tmx_serve_*
-            # metrics so a serve ledger alone reconstructs them
+            # and tmx_slo_* metrics so a serve ledger alone reconstructs
+            # them (order-independent, like the fleet merge)
             tenant = str(ev.get("tenant", "")) or "unknown"
             if kind == "job_admitted":
                 reg.counter("tmx_serve_admitted_total",
                             tenant=tenant, **hl).inc()
+                if "queue_wait_s" in ev:
+                    reg.histogram("tmx_serve_queue_wait_seconds",
+                                  tenant=tenant, **hl).observe(
+                        float(ev["queue_wait_s"]))
+            elif kind == "job_started":
+                if "sched_delay_s" in ev:
+                    reg.histogram("tmx_serve_sched_delay_seconds",
+                                  tenant=tenant, **hl).observe(
+                        float(ev["sched_delay_s"]))
+            elif kind == "slo_burn":
+                # warn-only breach events (slo.py) — same contract as QC
+                reg.counter(
+                    "tmx_slo_burn_total", tenant=tenant,
+                    window=str(ev.get("window", "")) or "unknown", **hl,
+                ).inc()
             elif kind == "job_rejected":
                 reason = str(ev.get("reason", "")) or "unknown"
                 reg.counter("tmx_serve_rejected_total", tenant=tenant,
@@ -1204,18 +1362,21 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                     reg.histogram("tmx_serve_job_seconds",
                                   tenant=tenant, **hl).observe(
                         float(ev["elapsed_s"]))
+                _observe_slo(reg, tenant, "ok", ev.get("elapsed_s"), hl)
             elif kind == "job_failed":
                 reg.counter("tmx_serve_jobs_failed_total",
                             tenant=tenant, **hl).inc()
+                _observe_slo(reg, tenant, "failed", None, hl)
             elif kind == "job_expired":
                 reg.counter("tmx_serve_deadline_expired_total",
                             tenant=tenant, **hl).inc()
+                _observe_slo(reg, tenant, "expired", None, hl)
             elif kind == "job_requeued":
                 reg.counter("tmx_serve_requeued_total",
                             tenant=tenant, **hl).inc()
-            else:  # serve_preempted
+            elif kind == "serve_preempted":
                 reg.counter("tmx_serve_preemptions_total", **hl).inc()
-        elif kind in ("init_done", "description_drift", "job_started",
+        elif kind in ("init_done", "description_drift",
                       "serve_started"):
             pass  # known structural events with no metric series
         elif kind:

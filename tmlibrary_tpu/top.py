@@ -286,6 +286,25 @@ def render_dashboard(view: dict, width: int = 80) -> str:
         if srv.get("preemptions"):
             lines.append(f"  serve preemptions: {srv['preemptions']} "
                          "(drained + re-spooled)")
+        # ---- SLO panel: per-tenant latency/availability vs objective
+        slo_view = srv.get("slo") or {}
+        waits = srv.get("queue_wait_s") or {}
+        for name, t in sorted((slo_view.get("tenants") or {}).items()):
+            p95 = t.get("latency_p95_s")
+            obj = t.get("objectives") or {}
+            avail = t.get("availability")
+            wait_p95 = (waits.get(name) or {}).get("p95")
+            burn_flag = "  ** SLO BURN **" if t.get("breach") else ""
+            p95_txt = "-" if p95 is None else f"{p95:.3f}s"
+            avail_txt = "-" if avail is None else f"{avail:.2%}"
+            wait_txt = "-" if wait_p95 is None else f"{wait_p95:.3f}s"
+            lines.append(
+                f"  slo {name:<12} "
+                f"p95 {p95_txt}/{float(obj.get('latency_p95_s', 0)):g}s "
+                f"avail {avail_txt}/{float(obj.get('availability', 0)):.2%} "
+                f"wait p95 {wait_txt} "
+                f"burn {t.get('burn')}{burn_flag}"
+            )
 
     # ---- breaker / degradation state
     deg = view["degraded"]

@@ -103,6 +103,10 @@ class JobSpec:
     pipeline_depth: int | None = None
     attempt: int = 0
     submitted_at: float = 0.0
+    #: end-to-end trace correlation id stamped by ``tmx enqueue``; every
+    #: span/ledger event emitted on behalf of this job carries it, so one
+    #: id links enqueue → admission → queue wait → execution phases.
+    trace_id: str | None = None
 
     def sort_key(self) -> tuple:
         """Deterministic within-tenant order: priority desc, then

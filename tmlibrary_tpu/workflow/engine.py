@@ -25,6 +25,7 @@ import inspect
 import json
 import logging
 import os
+import sys
 import time
 import zlib
 from pathlib import Path
@@ -316,6 +317,15 @@ class RunLedger:
         event["ts"] = time.time()
         if self.host is not None:
             event.setdefault("host", self.host)
+        # One edit point labels every event (spans, batch_done, job
+        # lifecycle, compile) with the ambient trace context: the serve
+        # daemon installs trace_id/job/tenant around each execution, so a
+        # single trace_id links enqueue → admission → run → phase without
+        # threading labels through every emitter.  setdefault keeps
+        # explicitly-labeled events (e.g. multi-tenant merges) intact.
+        for k, v in telemetry.trace_context().items():
+            event.setdefault(k, v)
+        telemetry.flight_record(event)
         line = self._seal(json.dumps(event))
         spec = faults.match("ledger_append", step=event.get("step"),
                             event=event.get("event"))
@@ -644,6 +654,18 @@ class Workflow:
                 self._watchdog = None
             if sampler is not None:
                 sampler.stop()
+            self._drain_compile_spans()
+            exc = sys.exc_info()[1]
+            if exc is not None and not isinstance(exc, PreemptedError) \
+                    and not (isinstance(exc, FaultInjected) and exc.fatal):
+                # unhandled crash: preserve the last-N event ring for the
+                # post-mortem (preemption dumps in _note_preempted; a
+                # FATAL injected fault simulates hard process death — a
+                # dead process writes nothing)
+                telemetry.flight_dump(
+                    telemetry.flightrec_path(self.store.workflow_dir),
+                    reason=f"crash:{type(exc).__name__}",
+                )
             self._write_metrics_snapshot()
         return summary
 
@@ -654,10 +676,33 @@ class Workflow:
         wd = self._watchdog
         if wd is None:
             return
+        fired = False
         for ev in wd.drain_events():
             if step_name is not None:
                 ev.setdefault("step", step_name)
             self.ledger.append(**ev)
+            fired = True
+        if fired:
+            # a watchdog fire is one of the flight-recorder dump triggers:
+            # the hang's surrounding events are exactly what a post-mortem
+            # needs, and they may be gone from the ring by process exit
+            telemetry.flight_dump(
+                telemetry.flightrec_path(self.store.workflow_dir),
+                reason="watchdog", extra={"step": step_name},
+            )
+
+    def _drain_compile_spans(self, step_name: str | None = None) -> None:
+        """Append buffered compile spans from perf.py — buffered because
+        ``record_compile`` can run on persist-worker threads (jterator
+        bucket escalation) and only the engine thread may touch the
+        ledger.  No-op (and empties nothing) when telemetry is off."""
+        if not telemetry.enabled():
+            return
+        from tmlibrary_tpu import perf
+        for sp in perf.pop_compile_spans():
+            if step_name is not None:
+                sp.setdefault("step", step_name)
+            self.ledger.append(event="span", span="compile", **sp)
 
     def _note_preempted(self, exc: PreemptedError) -> None:
         """Record the drain boundary durably (``run_preempted`` event +
@@ -668,6 +713,10 @@ class Workflow:
             event="run_preempted", step=exc.step, reason=exc.reason,
             in_flight=exc.in_flight, drained=exc.drained,
             abandoned=exc.abandoned,
+        )
+        telemetry.flight_dump(
+            telemetry.flightrec_path(self.store.workflow_dir),
+            reason=f"preempted:{exc.reason}", extra={"step": exc.step},
         )
         telemetry.get_registry().counter("tmx_preemptions_total").inc()
         logger.warning(
@@ -986,6 +1035,7 @@ class Workflow:
                                                           policy, pstats):
                     current_batch = batch["index"]
                     self._drain_watchdog(sd.name)
+                    self._drain_compile_spans(sd.name)
                     if outcome.ok:
                         b_elapsed = time.time() - bt0
                         if telemetry.enabled():
@@ -1065,6 +1115,7 @@ class Workflow:
                 # collect is part of the step execution the log file
                 # covers; it sees only the surviving results
                 collected = self._call_collect(step, results)
+            self._drain_compile_spans(sd.name)
             metrics.histogram("tmx_step_seconds", step=sd.name).observe(
                 time.time() - t0
             )
