@@ -218,6 +218,21 @@ def _ledger_fields(pdepth: "int | None", max_objects: "int | None" = None) -> di
     }
     if max_objects is not None:
         out["max_objects"] = max_objects
+    # records self-describe the resolved reduction strategy; a fused run
+    # additionally suffixes the methodology so the regression sentinel's
+    # methodology-class keying never compares fused against unfused
+    # history silently (historic records carry neither field nor suffix
+    # and keep matching the unsuffixed classes)
+    try:
+        from tmlibrary_tpu.ops.reduction import resolve_reduction_strategy
+
+        strat = resolve_reduction_strategy()
+    except Exception:
+        strat = None
+    if strat:
+        out["reduction_strategy"] = strat
+        if strat == "fused":
+            out["timing_methodology"] += "+strategy=fused"
     return out
 
 
@@ -539,6 +554,17 @@ def measure_sweep() -> None:
                         "items_per_sec": round(value, 3),
                         "best_s": round(best, 4),
                     }
+                    if not strategy_invariant:
+                        # on-chip working-set estimate for this
+                        # (strategy, capacity) cell, so a rung's VMEM
+                        # pressure reads next to its throughput
+                        from tmlibrary_tpu.ops.fused_measure import (
+                            vmem_bytes_estimate,
+                        )
+
+                        row["vmem_bytes_estimate"] = vmem_bytes_estimate(
+                            cap, strategy=label
+                        )
                     if strategy_invariant:
                         row["strategy_invariant"] = True
                     rows.append(row)
@@ -581,8 +607,17 @@ def measure_sweep() -> None:
         "capacities": capacities,
         "best_items_per_sec": best_row["items_per_sec"],
         "n_exec": n_exec,
+        # the strategy axis is part of the methodology identity: a sweep
+        # grid that includes "fused" is not comparable to a pre-fused
+        # 3-strategy grid, so the sentinel's methodology-class keying
+        # splits them automatically (strategy-invariant configs keep the
+        # unsuffixed string — their history never had a strategy axis)
         "timing_methodology": (
             f"pipelined-executor-sweep(n_exec={n_exec}, best-of-{reps})"
+            + (
+                "" if strategy_invariant
+                else f", strategies={'+'.join(strategies)}"
+            )
         ),
         "swept_at": swept_at,
     }

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""CI artifact: fused-strategy parity through the real workflow surface.
+
+    python scripts/ci_fused_parity.py OUTDIR [WORKDIR]
+
+Runs the SAME one-well synthetic workflow twice — the backend-default
+reduction strategy, then ``--reduction-strategy fused`` (the Pallas
+measure megakernels, interpret mode on the CPU CI backend) — at
+pipeline depth 4 with ``--object-buckets auto``, measuring all four
+feature families (intensity + quantiles, morphology, texture).  The two
+feature tables must agree within the documented strategy tolerances
+(ops/reduction.py): exact for order-free and exact-integer columns,
+1e-5 relative for the fractional-accumulation columns.  The per-column
+diff lands in OUTDIR/parity.json for artifact upload; any column beyond
+tolerance fails the step.
+"""
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import yaml  # noqa: E402
+
+from ci_metrics_snapshot import PIPE_YAML, synth_source  # noqa: E402
+
+#: relative tolerance for fractional-sum-derived columns (mean,
+#: Haralick statistics): the documented cross-strategy accumulation-order
+#: envelope with CI headroom
+RTOL = 1e-5
+
+#: std columns: variance is sumsq/n - mean² — two large near-equal sums,
+#: so cancellation amplifies the 1e-6 sum envelope by mean²/σ²; 1e-3
+#: still catches a broken accumulator (which diverges by orders of
+#: magnitude) without flagging the arithmetic it documents
+RTOL_STD = 1e-3
+
+# the metrics-snapshot pipeline plus all four measure families, so the
+# parity check covers every fused kernel: grouped stats (intensity +
+# morphology), the quantile histogram, and the GLCM pass
+PARITY_PIPE_YAML = json.loads(json.dumps(PIPE_YAML))
+PARITY_PIPE_YAML["description"] = "ci fused parity — all measure families"
+PARITY_PIPE_YAML["pipeline"] += [
+    {"handles": {
+        "module": "measure_intensity",
+        "input": [
+            {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+            {"name": "intensity_image", "type": "IntensityImage",
+             "key": "DAPI"},
+            {"name": "quantiles", "type": "Scalar", "value": True},
+        ],
+        "output": [
+            {"name": "measurements", "type": "Measurement",
+             "objects": "nuclei", "channel": "DAPI"},
+        ],
+    }},
+    {"handles": {
+        "module": "measure_morphology",
+        "input": [
+            {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+        ],
+        "output": [
+            {"name": "measurements", "type": "Measurement",
+             "objects": "nuclei"},
+        ],
+    }},
+    {"handles": {
+        "module": "measure_texture",
+        "input": [
+            {"name": "objects_image", "type": "LabelImage", "key": "nuclei"},
+            {"name": "intensity_image", "type": "IntensityImage",
+             "key": "DAPI"},
+        ],
+        "output": [
+            {"name": "measurements", "type": "Measurement",
+             "objects": "nuclei", "channel": "DAPI"},
+        ],
+    }},
+]
+
+
+def run(argv) -> int:
+    from tmlibrary_tpu.cli import main
+
+    argv = [str(a) for a in argv]
+    print("  $ tmx " + " ".join(argv))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    sys.stdout.write(buf.getvalue())
+    return rc
+
+
+def submit(work: Path, src: Path, name: str, strategy: "str | None"):
+    root = work / f"experiment-{name}"
+    run(["create", "--root", root, "--name", f"ci_fused_{name}"])
+    pipe = work / f"{name}.pipe.yaml"
+    pipe.write_text(yaml.safe_dump(PARITY_PIPE_YAML))
+    from tmlibrary_tpu.workflow.engine import WorkflowDescription
+
+    desc = work / f"workflow-{name}.yaml"
+    WorkflowDescription.canonical({
+        "metaconfig": {"source_dir": str(src)},
+        "imextract": {},
+        "corilla": {"chunk_size": 8, "n_devices": 1},
+        "jterator": {"pipe": str(pipe), "batch_size": 4, "max_objects": 64,
+                     "n_devices": 1},
+    }).save(desc)
+    argv = ["workflow", "submit", "--root", root, "--description", desc,
+            "--pipeline-depth", "4", "--object-buckets", "auto"]
+    if strategy:
+        argv += ["--reduction-strategy", strategy]
+    rc = run(argv)
+    if rc != 0:
+        raise SystemExit(f"workflow submit ({name}) exited {rc}")
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    feats = (ExperimentStore.open(root).read_features("nuclei")
+             .sort_values(["site_index", "label"]).reset_index(drop=True))
+    return feats
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    if not argv:
+        raise SystemExit(__doc__)
+    outdir = Path(argv[0])
+    outdir.mkdir(parents=True, exist_ok=True)
+    work = Path(argv[1]) if len(argv) > 1 else Path(
+        tempfile.mkdtemp(prefix="tmx-ci-fused-")
+    )
+    work.mkdir(parents=True, exist_ok=True)
+    src = work / "microscope"
+    src.mkdir(exist_ok=True)
+    synth_source(src)
+
+    ref = submit(work, src, "reference", None)
+    fused = submit(work, src, "fused", "fused")
+
+    if list(ref.columns) != list(fused.columns):
+        raise SystemExit(
+            f"column sets diverge: {sorted(set(ref) ^ set(fused))}"
+        )
+    if len(ref) != len(fused):
+        raise SystemExit(f"row counts diverge: {len(ref)} vs {len(fused)}")
+
+    report = {"rows": int(len(ref)), "rtol": RTOL, "columns": {}}
+    bad = []
+    for col in ref.columns:
+        if not np.issubdtype(ref[col].dtype, np.number):
+            ok = bool(ref[col].equals(fused[col]))
+            report["columns"][str(col)] = {"exact": ok, "ok": ok}
+            if not ok:
+                bad.append(f"{col}: non-numeric column diverged")
+            continue
+        a = np.asarray(ref[col], np.float64)
+        b = np.asarray(fused[col], np.float64)
+        exact = bool(np.array_equal(a, b, equal_nan=True))
+        with np.errstate(divide="ignore", invalid="ignore"):
+            denom = np.maximum(np.abs(a), np.abs(b))
+            rel = np.abs(a - b) / np.where(denom > 0, denom, 1.0)
+        max_rel = float(np.nanmax(rel)) if rel.size else 0.0
+        rtol = RTOL_STD if "_std" in str(col).lower() else RTOL
+        ok = exact or max_rel <= rtol
+        report["columns"][str(col)] = {
+            "exact": exact, "max_rel_diff": max_rel, "rtol": rtol, "ok": ok,
+        }
+        if not ok:
+            bad.append(f"{col}: max rel diff {max_rel:g}")
+    report["ok"] = not bad
+    (outdir / "parity.json").write_text(
+        json.dumps(report, indent=2, sort_keys=True) + "\n"
+    )
+    n_exact = sum(c["exact"] for c in report["columns"].values())
+    print(f"== fused parity: {len(report['columns'])} columns, "
+          f"{n_exact} bit-exact, rtol {RTOL} — report at "
+          f"{outdir / 'parity.json'}")
+    if bad:
+        raise SystemExit(
+            "fused-strategy parity failure:\n  " + "\n  ".join(bad)
+        )
+
+
+if __name__ == "__main__":
+    main()

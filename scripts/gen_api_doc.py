@@ -88,6 +88,32 @@ def ops_section() -> list[str]:
     return out
 
 
+def fused_section() -> list[str]:
+    from tmlibrary_tpu.ops import fused_measure
+
+    out = ["## Fused measure megakernels (`ops.fused_measure`)", "",
+           (inspect.getdoc(fused_measure) or "").split("\n")[0],
+           "",
+           "The `\"fused\"` reduction strategy (DESIGN.md §22): "
+           "selectable through the full `ops.reduction` precedence "
+           "chain (`--reduction-strategy fused`, `TMX_REDUCTION_"
+           "STRATEGY`, config, or a swept TUNING.json verdict), "
+           "interpret-mode fallback off-TPU, chunk knob via "
+           "`TMX_FUSED_CHUNK` / the tuned `fused_chunk` entry.",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for name in sorted(n for n in dir(fused_measure) if not n.startswith("_")):
+        obj = getattr(fused_measure, name)
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "") != fused_measure.__name__:
+            continue
+        doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append(f"| `fused_measure.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def telemetry_section() -> list[str]:
     from tmlibrary_tpu import telemetry
 
@@ -282,6 +308,7 @@ def main() -> None:
         *module_section(),
         *tool_section(),
         *ops_section(),
+        *fused_section(),
         *telemetry_section(),
         *top_section(),
         *qc_section(),

@@ -393,9 +393,21 @@ def sweep_done(config: str) -> bool:
     """A config's strategy x depth sweep is done when TUNING.json carries
     its ``config_sweeps`` entry measured on a device backend (a CPU
     sweep's verdict only sets CPU defaults — the watcher exists to get
-    hardware verdicts)."""
+    hardware verdicts).  A strategy-bearing entry must also cover the
+    ``fused`` megakernel cell: a verdict swept before the fused strategy
+    existed re-queues so the next relay window re-judges the grid with
+    the new kernel on it."""
     entry = (load_json(TUNING_PATH).get("config_sweeps") or {}).get(config)
     if not entry:
+        return False
+    rows = entry.get("rows") or []
+    strategy_rows = [
+        r for r in rows
+        if isinstance(r, dict) and not r.get("strategy_invariant")
+    ]
+    if strategy_rows and not any(
+        r.get("strategy") == "fused" for r in strategy_rows
+    ):
         return False
     if _rehearsal():
         return True

@@ -43,19 +43,25 @@ def test_sweep_grid_and_tuning_verdict(tmp_path):
     assert out["sweep"] is True
     assert out["config"] == "3"
     assert out["backend"] == "cpu"
+    from tmlibrary_tpu.ops.reduction import STRATEGIES
+
     cells = {(r["strategy"], r["pipeline_depth"]) for r in out["rows"]}
-    assert cells == {
-        (s, d) for s in ("onehot", "sort", "scatter") for d in (1, 2)
-    }
+    assert cells == {(s, d) for s in STRATEGIES for d in (1, 2)}
     assert all(r["items_per_sec"] > 0 for r in out["rows"])
-    assert out["best_strategy"] in ("onehot", "sort", "scatter")
+    # every strategy-bearing row carries its on-chip working-set estimate
+    assert all(r["vmem_bytes_estimate"] > 0 for r in out["rows"])
+    assert out["best_strategy"] in STRATEGIES
     assert out["best_pipeline"] in (1, 2)
 
     doc = json.loads(tuning.read_text())
     assert doc["written_by"] == "bench.py --sweep"
     sweep = doc["config_sweeps"]["3"]
     assert sweep["best_strategy"] == out["best_strategy"]
-    assert len(sweep["rows"]) == 6
+    assert len(sweep["rows"]) == 2 * len(STRATEGIES)
+    # the strategy axis is part of the methodology identity (the
+    # regression sentinel must never compare a fused-bearing grid
+    # against a pre-fused one)
+    assert "strategies=" + "+".join(STRATEGIES) in sweep["timing_methodology"]
     assert doc["reduction_strategy"] == {"cpu": out["best_strategy"]}
 
     # the runtime resolver consumes exactly what the sweep wrote

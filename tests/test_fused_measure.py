@@ -1,0 +1,254 @@
+"""Parity and compile-discipline suite for the fused measure megakernels
+(``ops/fused_measure.py``, the ``"fused"`` reduction strategy).
+
+The full strategy × family matrix on CPU (interpret mode): every
+strategy against the one-hot/scatter references across the intensity,
+morphology, quantile and GLCM families on dense, sparse and
+saturated-rung sites — order-free and exact-integer outputs bit-exact,
+fractional-accumulation outputs inside the documented envelope.  Plus
+the compile discipline: a second pass through an already-jitted
+capacity rung must add zero new compiles, and the kernel chunk knob is
+resolved independently of capacity so bucket routing stays bit-exact.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tmlibrary_tpu.ops import fused_measure as F
+from tmlibrary_tpu.ops import measure as M
+from tmlibrary_tpu.ops import reduction as R
+
+MAX_OBJECTS = 11
+STRATEGIES = R.STRATEGIES
+
+
+def _dense(rng):
+    """Most pixels labeled: 9 fat blobs tiling a 64x64 site."""
+    labels = np.zeros((64, 64), np.int32)
+    k = 1
+    for r in range(0, 63, 21):
+        for c in range(0, 63, 21):
+            labels[r : r + 20, c : c + 20] = k
+            k += 1
+    return labels
+
+
+def _sparse(rng):
+    """Three small objects in a mostly-background site."""
+    labels = np.zeros((64, 64), np.int32)
+    for i, (y, x) in enumerate([(5, 5), (30, 48), (55, 12)], start=1):
+        labels[y : y + 4, x : x + 4] = i
+    return labels
+
+
+def _saturated(rng):
+    """Every object slot up to MAX_OBJECTS populated — the full-rung
+    site the bucket router escalates to."""
+    labels = np.zeros((64, 64), np.int32)
+    ys = rng.integers(4, 58, MAX_OBJECTS)
+    xs = rng.integers(4, 58, MAX_OBJECTS)
+    for i, (y, x) in enumerate(zip(ys, xs), start=1):
+        labels[y : y + 5, x : x + 5] = i
+    return labels
+
+
+SITES = {"dense": _dense, "sparse": _sparse, "saturated": _saturated}
+
+
+@pytest.fixture(params=sorted(SITES))
+def site(request, rng):
+    labels = SITES[request.param](rng)
+    img = rng.integers(0, 4096, (64, 64)).astype(np.float32)
+    return jnp.asarray(labels), jnp.asarray(img)
+
+
+def _assert_family(out, ref, *, loose=()):
+    assert sorted(out) == sorted(ref)
+    for key in ref:
+        a, b = np.asarray(out[key]), np.asarray(ref[key])
+        if any(tag in key for tag in loose):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=0, err_msg=key)
+        else:
+            np.testing.assert_array_equal(a, b, err_msg=key)
+
+
+# -------------------------------------------------- strategy x family matrix
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_intensity_family_parity(site, strategy):
+    """min/max/sum bit-exact across all strategies (order-free or
+    < 2^24 integer sums); mean/std ride the sumsq accumulator, whose
+    order-dependent rounding carries the documented envelope."""
+    labels, img = site
+    ref = M.intensity_features(labels, img, MAX_OBJECTS, method="onehot")
+    out = M.intensity_features(labels, img, MAX_OBJECTS, method=strategy)
+    _assert_family(out, ref, loose=("mean", "std"))
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_morphology_family_parity(site, strategy, monkeypatch):
+    """morphology_features has no method arg — the strategy arrives via
+    the resolver chain (here the env leg), which is exactly how the
+    fused megakernel is selected in production."""
+    labels, _ = site
+    ref = M.morphology_features(labels, MAX_OBJECTS)
+    monkeypatch.setenv("TMX_REDUCTION_STRATEGY", strategy)
+    out = M.morphology_features(labels, MAX_OBJECTS)
+    # area/perimeter/bbox are exact-integer or order-free; the moment
+    # sums behind axis lengths / orientation square pixel coordinates
+    # (order-dependent f32 rounding)
+    _assert_family(
+        out, ref,
+        loose=("axis_length", "eccentricity", "orientation", "form_factor",
+               "extent", "equivalent_diameter", "centroid"),
+    )
+
+
+@pytest.mark.parametrize("strategy", STRATEGIES)
+def test_quantile_family_parity(site, strategy):
+    """Histogram counts are exact integers and the bucket edges share
+    ``quantize_per_object``'s expression tree verbatim, so quantiles are
+    bit-identical for every strategy — fused included."""
+    labels, img = site
+    ref = M.intensity_quantiles(labels, img, MAX_OBJECTS, method="onehot")
+    out = M.intensity_quantiles(labels, img, MAX_OBJECTS, method=strategy)
+    _assert_family(out, ref)
+
+
+@pytest.mark.parametrize("strategy", ("matmul", "scatter", "fused"))
+def test_glcm_family_parity(site, strategy):
+    """Per-object GLCM cells are exact integers in every path; the
+    derived Haralick statistics divide/log them identically, so the
+    whole family is bit-identical across glcm methods."""
+    labels, img = site
+    ref = M.haralick_features(labels, img, MAX_OBJECTS, glcm_method="matmul")
+    out = M.haralick_features(labels, img, MAX_OBJECTS, glcm_method=strategy)
+    _assert_family(out, ref)
+
+
+# ------------------------------------------------------- kernel-level pins
+def test_grouped_stats_matches_two_pass_references(site):
+    labels, img = site
+    chans = [jnp.ones_like(img), img]
+    sums, mins, maxs = F.grouped_stats(labels, chans, MAX_OBJECTS)
+    np.testing.assert_array_equal(
+        np.asarray(sums),
+        np.asarray(M.grouped_sums(labels, chans, MAX_OBJECTS, "scatter")),
+    )
+    ref_mn, ref_mx = M.grouped_minmax_multi(
+        labels, chans, MAX_OBJECTS, method="scatter"
+    )
+    np.testing.assert_array_equal(np.asarray(mins), np.asarray(ref_mn))
+    np.testing.assert_array_equal(np.asarray(maxs), np.asarray(ref_mx))
+
+
+def test_chunking_is_pure_cost_knob(site):
+    """Bit-identical integral outputs across chunk sizes (128 forces a
+    multi-chunk sequential grid on the 64x64 site)."""
+    labels, img = site
+    a = F.grouped_stats(labels, [img], MAX_OBJECTS, chunk=128)
+    b = F.grouped_stats(labels, [img], MAX_OBJECTS, chunk=4096)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_capacity_invariance(site):
+    """Rows 0..n bit-identical for any capacity >= n — the bucket
+    router's contract (``capacity_segments``), held by resolving the
+    chunk independently of capacity."""
+    labels, img = site
+    small = F.grouped_stats(labels, [img], MAX_OBJECTS)
+    big = F.grouped_stats(labels, [img], 64)
+    for s, b in zip(small, big):
+        np.testing.assert_array_equal(
+            np.asarray(s), np.asarray(b)[:MAX_OBJECTS]
+        )
+
+
+def test_fused_chunk_env_and_tuning_resolution(monkeypatch, tmp_path):
+    monkeypatch.setenv("TMX_FUSED_CHUNK", "1000")
+    assert F.fused_chunk() == 896  # rounded down to the 128 lane multiple
+    monkeypatch.delenv("TMX_FUSED_CHUNK")
+    tuning = tmp_path / "TUNING.json"
+    tuning.write_text('{"fused_chunk": 512}')
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning))
+    from tmlibrary_tpu.ops.pallas_kernels import _tuning_results
+
+    _tuning_results.cache_clear()
+    try:
+        assert F.fused_chunk() == 512
+    finally:
+        _tuning_results.cache_clear()
+
+
+# -------------------------------------------------------- compile discipline
+def test_zero_new_compiles_through_cached_rung(rng):
+    """A fused pass through an already-jitted (capacity, chunk, shape)
+    rung adds ZERO new compiles — fresh batch content reuses the traced
+    program; only a new capacity rung compiles again.  Capacities 23/29
+    are private to this test: the jit cache is process-global, so shared
+    rungs (11, 64) may already be warm from other tests."""
+    labels = jnp.asarray(_saturated(rng))
+    img = jnp.asarray(rng.integers(0, 4096, (64, 64)).astype(np.float32))
+    F.grouped_stats(labels, [img], 23)  # warm the rung
+    n0 = F._stats_call._cache_size()
+    other = jnp.asarray(rng.integers(0, 4096, (64, 64)).astype(np.float32))
+    F.grouped_stats(labels, [other], 23)
+    F.grouped_stats(labels, [img * 2.0], 23)
+    assert F._stats_call._cache_size() == n0
+    F.grouped_stats(labels, [img], 29)  # a NEW rung traces once
+    assert F._stats_call._cache_size() == n0 + 1
+
+
+def test_cached_batch_fn_identity_for_fused(monkeypatch):
+    """The process-level compiled-program cache returns the IDENTICAL
+    program for repeated fused requests (same keying discipline as the
+    other strategies), and the fused-chunk knob is part of the key."""
+    from tmlibrary_tpu.benchmarks import smooth_threshold_description
+    from tmlibrary_tpu.jterator import pipeline as jp
+    from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
+
+    monkeypatch.setattr(jp, "_BATCH_FN_CACHE", {})
+    monkeypatch.delenv("TMX_REDUCTION_STRATEGY", raising=False)
+    a = cached_batch_fn(
+        smooth_threshold_description(), 64, reduction_strategy="fused"
+    )
+    b = cached_batch_fn(
+        smooth_threshold_description(), 64, reduction_strategy="fused"
+    )
+    assert a is b
+    assert a is not cached_batch_fn(smooth_threshold_description(), 64)
+    monkeypatch.setenv("TMX_FUSED_CHUNK", "512")
+    c = cached_batch_fn(
+        smooth_threshold_description(), 64, reduction_strategy="fused"
+    )
+    assert c is not a
+
+
+# ------------------------------------------------------------ VMEM estimate
+def test_vmem_bytes_estimate_shapes():
+    for strategy in STRATEGIES:
+        small = F.vmem_bytes_estimate(16, strategy=strategy)
+        big = F.vmem_bytes_estimate(256, strategy=strategy)
+        assert small > 0
+        assert big > small  # monotone in capacity
+
+
+# ------------------------------------------------------- precedence chain
+def test_fused_selectable_through_tuned_verdict(monkeypatch, tmp_path):
+    """The provenance-gated TUNING.json leg of the precedence chain
+    accepts a ``fused`` verdict — the sweep can promote the megakernel
+    to a backend default without any env/config pin."""
+    tuning = tmp_path / "TUNING.json"
+    tuning.write_text(
+        '{"written_by": "bench.py --sweep",'
+        ' "reduction_strategy": {"cpu": "fused"}}'
+    )
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning))
+    monkeypatch.delenv("TMX_REDUCTION_STRATEGY", raising=False)
+    assert R.resolve_reduction_strategy() == "fused"
+    # ... and an explicit request still outranks it
+    assert R.resolve_reduction_strategy("scatter") == "scatter"
+    with R.strategy_scope("sort"):
+        assert R.resolve_reduction_strategy() == "sort"

@@ -463,3 +463,26 @@ def test_sweep_queue_rides_behind_headline_bench(monkeypatch, tmp_path):
         i for i, l in enumerate(pending) if l.startswith("sweep:")
     )
     assert first_sweep > last_bench
+
+
+def test_sweep_requeues_pre_fused_verdict(monkeypatch, tmp_path):
+    """A device verdict whose rows predate the ``fused`` strategy
+    re-queues — the grid must be re-judged with the megakernel cell on
+    it — while fused-bearing and strategy-invariant entries stay done."""
+    pre = [{"strategy": s, "pipeline_depth": 1, "items_per_sec": 1.0}
+           for s in ("onehot", "sort", "scatter")]
+    post = pre + [{"strategy": "fused", "pipeline_depth": 1,
+                   "items_per_sec": 2.0}]
+    w = _watch(monkeypatch, tmp_path, tuning={
+        **MACHINE,
+        "config_sweeps": {
+            "3": {"backend": "axon", "rows": pre},
+            "4": {"backend": "axon", "rows": post},
+            "corilla": {"backend": "axon", "rows": [
+                {"strategy": "scatter", "pipeline_depth": 1,
+                 "strategy_invariant": True}]},
+        },
+    })
+    assert w.sweep_done("3") is False   # pre-fused grid: re-sweep
+    assert w.sweep_done("4") is True    # fused cell present
+    assert w.sweep_done("corilla") is True  # no strategy axis at all

@@ -289,13 +289,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     shared.add_argument(
         "--reduction-strategy", default=None,
-        choices=("auto", "onehot", "sort", "scatter"),
+        choices=("auto", "onehot", "sort", "scatter", "fused"),
         help="grouped-reduction strategy for the measurement stack "
              "(default: TMX_REDUCTION_STRATEGY / TM_REDUCTION_STRATEGY "
              "config, else the bench sweep's tuned verdict in "
              "tuning/TUNING.json, else scatter on CPU and one-hot "
              "matmuls on accelerators; 'sort' is the exactly "
-             "deterministic path)",
+             "deterministic path, 'fused' the single-pass Pallas "
+             "measure megakernels)",
     )
     shared.add_argument(
         "--object-buckets", default=None, metavar="SPEC",
@@ -1764,6 +1765,47 @@ def _snapshot_gauge(snapshot: dict, name: str) -> "float | None":
     return None
 
 
+def _perf_strategy_comparison(programs: list) -> list:
+    """Group program profiles by (program, step, capacity) and keep the
+    groups recorded under two or more reduction strategies — the
+    fused-vs-unfused readout: same program identity, strategies side by
+    side with FLOPs/bytes/arithmetic-intensity/bound_by, so a kernel win
+    (or loss) is readable without re-deriving it from the gauges."""
+    groups: dict = {}
+    for e in programs:
+        if not isinstance(e, dict):
+            continue
+        key = (str(e.get("program") or "?"), str(e.get("step") or "?"),
+               e.get("capacity"))
+        groups.setdefault(key, []).append(e)
+    out = []
+    for (program, step, capacity), entries in groups.items():
+        strategies = {e.get("strategy") for e in entries}
+        if len(strategies) < 2:
+            continue
+        variants = sorted(
+            entries, key=lambda e: str(e.get("strategy") or "")
+        )
+        out.append({
+            "program": program,
+            "step": step,
+            "capacity": capacity,
+            "variants": [
+                {
+                    "strategy": v.get("strategy"),
+                    "flops": v.get("flops"),
+                    "bytes": v.get("bytes"),
+                    "arithmetic_intensity": v.get("arithmetic_intensity"),
+                    "bound_by": v.get("bound_by"),
+                    "compiles": v.get("compiles"),
+                }
+                for v in variants
+            ],
+        })
+    out.sort(key=lambda g: (g["program"], g["step"], g["capacity"] or 0))
+    return out
+
+
 def cmd_perf(args) -> int:
     """Performance attribution: the per-program roofline table the last
     run recorded (``workflow/perf.json``), the pipelined phase device/host
@@ -1844,9 +1886,12 @@ def cmd_perf(args) -> int:
                 and r.get("value") and not r.get("error")]
     latest = measured[-1] if measured else None
 
+    strategy_cmp = _perf_strategy_comparison(programs)
+
     if args.as_json:
         print(json.dumps({
             "programs": programs,
+            "strategy_comparison": strategy_cmp,
             "phases": phases_out,
             "padded_flops_avoided_frac": avoided,
             "slot_occupancy": occupancy,
@@ -1877,6 +1922,27 @@ def cmd_perf(args) -> int:
         print("(roofline verdict vs the v5e reference ridge "
               f"{perf.ridge_point():.0f} FLOPs/byte; MFU/HBM fractions are "
               "runtime numbers — see the bench line below)")
+        if strategy_cmp:
+            print()
+            print("strategy comparison (same program/step/capacity, "
+                  "side by side):")
+            print(f"{'program':<24} {'step':<10} {'cap':>5} "
+                  f"{'strategy':<8} {'gflops':>9} {'mbytes':>9} "
+                  f"{'flops/B':>8} bound-by")
+            for grp in strategy_cmp:
+                for v in grp["variants"]:
+                    flops = v.get("flops")
+                    nbytes = v.get("bytes")
+                    print(
+                        f"{str(grp['program']):<24} "
+                        f"{str(grp['step']):<10} "
+                        f"{str(grp['capacity'] or '-'):>5} "
+                        f"{str(v.get('strategy') or '-'):<8} "
+                        f"{(round(flops / 1e9, 3) if flops else '-'):>9} "
+                        f"{(round(nbytes / 1e6, 2) if nbytes else '-'):>9} "
+                        f"{(v.get('arithmetic_intensity') or '-'):>8} "
+                        f"{v.get('bound_by') or '-'}"
+                    )
     else:
         print("no perf attribution recorded — run `tmx workflow submit` "
               "with telemetry enabled (workflow/perf.json)")

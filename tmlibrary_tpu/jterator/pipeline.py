@@ -140,6 +140,7 @@ def cached_batch_fn(
         os.environ.get("TMX_NATIVE"),
         os.environ.get("TMX_SITE_STATS"),
         os.environ.get("TMX_PALLAS_CHUNK"),
+        os.environ.get("TMX_FUSED_CHUNK"),
     )
     fn = _BATCH_FN_CACHE.get(key)
     if fn is None:

@@ -1,0 +1,516 @@
+"""Fused measure megakernels — the ``"fused"`` reduction strategy.
+
+Roofline motivation (DESIGN.md §16, ROADMAP open item 3): the unfused
+measure family makes one pass over the site tile per reduction family —
+grouped sums, min/max, the quantile histogram, the GLCM cells — and
+every pass re-streams the tile from HBM while its accumulator rows
+round-trip HBM through the ``fori_loop`` carry.  ``tmx perf`` attributes
+those rungs as bandwidth-bound.  The kernels here keep both sides of
+that traffic on chip: the tile streams through VMEM once per kernel and
+the per-object accumulators live in VMEM output blocks revisited across
+a sequential grid (the canonical TPU accumulation pattern), so HBM sees
+one read of the pixels and one write of the ``(segments, ...)`` result.
+
+Three kernels cover the three accumulation shapes of ``ops/measure.py``:
+
+- :func:`grouped_stats` — ONE pass emitting per-object sum, min and max
+  for any stack of pixel channels.  ``intensity_features`` gets
+  count/sum/sumsq/min/max from a single call (channels ``[1, v, v²]``);
+  ``morphology_features`` gets area/centroid/second-moment/perimeter
+  sums AND the bounding box from its 7-channel call — one HBM read where
+  the unfused path takes two full passes per family.
+- :func:`intensity_hist` — the per-(object, bucket) histogram feeding
+  ``intensity_quantiles``: per-pixel bounds lookup, the mahotas-parity
+  quantization expression and the dual one-hot contraction all inside
+  the kernel.
+- :func:`glcm_all` — the second fused pass: all 4 directions' GLCM
+  counts in one kernel (per-object quantization of the shifted and
+  unshifted pixels in VMEM, bf16 one-hot operands contracted into an
+  f32 VMEM accumulator — the exact-integer-counts trick of
+  ``_glcm_matmul_all``).
+
+Parity contract (pinned by ``tests/test_reduction.py`` and
+``tests/test_fused_measure.py``, interpret mode on CPU): min/max,
+counts, histogram and GLCM cells are bit-identical to every reference
+strategy (order-free or exact-integer accumulations); fractional f32
+sums carry the same 1e-6 relative tolerance as sort/scatter vs the
+one-hot reference (different accumulation order).  The quantization
+expression trees are copied verbatim from ``quantize_per_object`` so
+bucket assignment cannot drift.
+
+Capacity invariance: the pixel chunk is resolved independently of the
+object capacity (:func:`fused_chunk`), so rows ``0..n`` are
+bit-identical for any capacity ``>= n`` — the bucket router's contract
+(``ops/reduction.capacity_segments``).  Interpret-mode fallback keeps
+tier-1 hardware-independent: ``interpret=None`` resolves to ``True``
+off-TPU, exactly like ``pallas_kernels``.  The VMEM chunking knob
+follows ``_tuned_chunk`` conventions and shares its memoized
+TUNING.json reader (``TMX_FUSED_CHUNK`` env → committed ``fused_chunk``
+sweep result → the default).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tmlibrary_tpu.ops.label import shift_with_fill
+from tmlibrary_tpu.ops.pallas_kernels import _tuning_results
+from tmlibrary_tpu.ops.reduction import capacity_segments
+
+#: pixels per VMEM chunk (stats/histogram kernels).  Purely a cost knob:
+#: every per-object row accumulates independently of the chunking, so
+#: outputs are bit-identical for any chunk — EXCEPT fractional f32 sums,
+#: whose accumulation order follows the chunk walk; the knob is resolved
+#: once per program (never from the capacity) so the capacity-invariance
+#: contract holds bit-exactly.
+FUSED_CHUNK = 2048
+
+#: the GLCM kernel's chunk is clamped here: its (chunk, segments*levels)
+#: row one-hot is the largest VMEM operand in the family (DESIGN.md §22)
+GLCM_CHUNK_MAX = 512
+
+_LANE = 128  # TPU lane width: lane-dim shapes pad to a multiple of this
+
+
+def fused_chunk() -> int:
+    """Resolution: explicit arg (callers/tests) → ``TMX_FUSED_CHUNK``
+    env → committed ``fused_chunk`` sweep result → the default.  Shares
+    :func:`pallas_kernels._tuning_results` (memoized per (path, mtime))
+    instead of re-reading TUNING.json."""
+    import os
+
+    env = os.environ.get("TMX_FUSED_CHUNK")
+    if env:
+        try:
+            return max(_LANE, (int(env) // _LANE) * _LANE)
+        except ValueError:
+            pass
+    tuned = _tuning_results().get("fused_chunk")
+    if isinstance(tuned, (int, float)) and tuned >= 1:
+        return max(_LANE, (int(tuned) // _LANE) * _LANE)
+    return FUSED_CHUNK
+
+
+def _interpret_default() -> bool:
+    """Interpret-mode fallback off-TPU, like ``pallas_enabled``'s
+    backend gate — tier-1 runs the same kernels on XLA-CPU."""
+    return jax.default_backend() != "tpu"
+
+
+def _resolve(interpret: "bool | None", chunk: "int | None") -> tuple[bool, int]:
+    if interpret is None:
+        interpret = _interpret_default()
+    if chunk is None:
+        chunk = fused_chunk()
+    chunk = max(_LANE, (int(chunk) // _LANE) * _LANE)
+    return bool(interpret), chunk
+
+
+def _pad_lane(n: int) -> int:
+    return ((int(n) + _LANE - 1) // _LANE) * _LANE
+
+
+def _chunked(flat: jax.Array, chunk: int, fill=0) -> jax.Array:
+    """(P,) → (n_chunks, chunk); padded pixels carry ``fill`` (label 0
+    pads land in the dropped background row, value pads are masked by
+    their label-0 one-hot column)."""
+    p = flat.shape[0]
+    pad = (-p) % chunk
+    if pad:
+        flat = jnp.concatenate(
+            [flat, jnp.full((pad,), fill, flat.dtype)]
+        )
+    return flat.reshape(-1, chunk)
+
+
+# ------------------------------------------------------------- stats kernel
+def _stats_kernel(lab_ref, val_ref, sums_ref, mins_ref, maxs_ref):
+    """One chunk's contribution to per-segment (sum, min, max) of every
+    channel.  The (chunk, segments) one-hot is materialized ONCE and
+    shared by the MXU sum contraction and the VPU masked min/max — the
+    fusion the separate grouped_sums/grouped_minmax passes cannot get."""
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        sums_ref[:] = jnp.zeros_like(sums_ref)
+        mins_ref[:] = jnp.full_like(mins_ref, jnp.inf)
+        maxs_ref[:] = jnp.full_like(maxs_ref, -jnp.inf)
+
+    chunk = lab_ref.shape[1]
+    segs_p = sums_ref.shape[1]
+    n_ch = val_ref.shape[0]
+    lab = lab_ref[0, :]
+    ids = lax.broadcasted_iota(jnp.int32, (chunk, segs_p), 1)
+    sel = lab[:, None] == ids  # (chunk, segs_p)
+    vals = val_ref[:, 0, :]  # (n_ch, chunk)
+    # HIGHEST keeps f32 operand precision on the MXU — same contract as
+    # grouped_sums' einsum, so integral sums stay exact / bit-identical
+    sums_ref[:] += lax.dot_general(
+        vals, sel.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        precision=lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    for c in range(n_ch):  # static unroll: n_ch is a trace constant
+        v = vals[c, :][:, None]
+        mins_ref[c, :] = jnp.minimum(
+            mins_ref[c, :], jnp.min(jnp.where(sel, v, jnp.inf), axis=0)
+        )
+        maxs_ref[c, :] = jnp.maximum(
+            maxs_ref[c, :], jnp.max(jnp.where(sel, v, -jnp.inf), axis=0)
+        )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_objects", "interpret", "chunk")
+)
+def _stats_call(flat, stacked, max_objects, interpret, chunk):
+    segs = capacity_segments(max_objects)
+    segs_p = _pad_lane(segs)
+    n_ch = stacked.shape[0]
+    lab = _chunked(flat, chunk)
+    vals = jnp.stack([_chunked(v, chunk) for v in stacked])  # (C, n, chunk)
+    n_chunks = lab.shape[0]
+    sums, mins, maxs = pl.pallas_call(
+        _stats_kernel,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((n_ch, 1, chunk), lambda i: (0, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n_ch, segs_p), lambda i: (0, 0)),
+            pl.BlockSpec((n_ch, segs_p), lambda i: (0, 0)),
+            pl.BlockSpec((n_ch, segs_p), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_ch, segs_p), jnp.float32)
+            for _ in range(3)
+        ],
+        interpret=interpret,
+    )(lab, vals)
+    # drop the background row and the lane padding; rows = objects
+    return (
+        sums[:, 1:segs].T,
+        mins[:, 1:segs].T,
+        maxs[:, 1:segs].T,
+    )
+
+
+def grouped_stats(
+    labels: jax.Array,
+    channels: list[jax.Array],
+    max_objects: int,
+    *,
+    interpret: "bool | None" = None,
+    chunk: "int | None" = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-object (sums, mins, maxs) of several pixel channels in ONE
+    fused pass — each ``(max_objects, n_channels)`` f32, label ids
+    ``1..max_objects`` (background dropped), absent rows (0, +inf,
+    -inf) like the unfused twins."""
+    interpret, chunk = _resolve(interpret, chunk)
+    flat = jnp.asarray(labels, jnp.int32).reshape(-1)
+    stacked = jnp.stack(
+        [jnp.asarray(c, jnp.float32).reshape(-1) for c in channels]
+    )
+    return _stats_call(flat, stacked, max_objects, interpret, chunk)
+
+
+# --------------------------------------------------------- histogram kernel
+def _hist_kernel(lab_ref, img_ref, lo_ref, span_ref, counts_ref, *, bins):
+    """Per-(object, bucket) counts with the per-pixel bounds lookup and
+    quantization INSIDE the kernel.  The bounds lookup is a masked sum
+    over the label one-hot — exact (each pixel selects one finite table
+    entry), mirroring ``lookup_by_label``'s one-nonzero-term guarantee;
+    the quantization expression is ``quantize_per_object``'s verbatim,
+    so bucket assignment (and therefore every count) is bit-identical."""
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    chunk = lab_ref.shape[1]
+    segs_p = lo_ref.shape[1]
+    bins_p = counts_ref.shape[1]
+    lab = lab_ref[0, :]
+    v = img_ref[0, :]
+    ids = lax.broadcasted_iota(jnp.int32, (chunk, segs_p), 1)
+    sel = lab[:, None] == ids
+    lo_pix = jnp.sum(jnp.where(sel, lo_ref[0, :][None, :], 0.0), axis=1)
+    span_pix = jnp.sum(jnp.where(sel, span_ref[0, :][None, :], 0.0), axis=1)
+    span_pix = jnp.maximum(span_pix, 1e-6)
+    q = jnp.floor((v - lo_pix) * (bins - 1) / span_pix)
+    q = jnp.clip(q, 0, bins - 1).astype(jnp.int32)
+    bin_ids = lax.broadcasted_iota(jnp.int32, (chunk, bins_p), 1)
+    oh_q = (q[:, None] == bin_ids).astype(jnp.bfloat16)
+    # bf16 one-hot operands are exact (0.0/1.0) and the MXU accumulates
+    # f32 — integer counts < 2^24, the _glcm_matmul_all trick
+    counts_ref[:] += lax.dot_general(
+        sel.astype(jnp.bfloat16), oh_q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("max_objects", "bins", "interpret", "chunk")
+)
+def _hist_call(flat, img, lo_full, span_full, max_objects, bins,
+               interpret, chunk):
+    segs = capacity_segments(max_objects)
+    segs_p = _pad_lane(segs)
+    bins_p = _pad_lane(bins)
+    lab = _chunked(flat, chunk)
+    vals = _chunked(img, chunk)
+    # lane-pad the bounds tables; padded columns are never selected
+    # (labels <= max_objects), lo=0/span=1 keeps them inert regardless
+    lo_p = jnp.concatenate(
+        [lo_full, jnp.zeros((segs_p - segs,), jnp.float32)]
+    )[None, :]
+    span_p = jnp.concatenate(
+        [span_full, jnp.ones((segs_p - segs,), jnp.float32)]
+    )[None, :]
+    counts = pl.pallas_call(
+        functools.partial(_hist_kernel, bins=bins),
+        grid=(lab.shape[0],),
+        in_specs=[
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((1, segs_p), lambda i: (0, 0)),
+            pl.BlockSpec((1, segs_p), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((segs_p, bins_p), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((segs_p, bins_p), jnp.float32),
+        interpret=interpret,
+    )(lab, vals, lo_p, span_p)
+    return counts[1:segs, :bins]
+
+
+def _masked_bounds(bounds):
+    """(raw_lo, raw_hi) → (lo_full, span_full) with the background row
+    prepended — the exact expression tree of ``quantize_per_object``."""
+    raw_lo, raw_hi = bounds
+    present = raw_hi >= raw_lo
+    lo = jnp.where(present, raw_lo, 0.0)
+    span = jnp.where(present, raw_hi - lo, 1.0)
+    lo_full = jnp.concatenate([jnp.zeros((1,), jnp.float32), lo])
+    span_full = jnp.concatenate([jnp.ones((1,), jnp.float32), span])
+    return lo_full, span_full
+
+
+def intensity_hist(
+    labels: jax.Array,
+    intensity: jax.Array,
+    max_objects: int,
+    bins: int,
+    bounds: tuple[jax.Array, jax.Array],
+    *,
+    interpret: "bool | None" = None,
+    chunk: "int | None" = None,
+) -> jax.Array:
+    """Per-object intensity histogram ``(max_objects, bins)`` for
+    ``intensity_quantiles`` — quantization and accumulation fused in one
+    kernel pass.  ``bounds`` is the raw ``grouped_minmax`` output (±inf
+    for absent objects), normally the fused stats kernel's min/max so
+    the tile is read once for bounds and once for the histogram instead
+    of three-plus times."""
+    interpret, chunk = _resolve(interpret, chunk)
+    flat = jnp.asarray(labels, jnp.int32).reshape(-1)
+    img = jnp.asarray(intensity, jnp.float32).reshape(-1)
+    lo_full, span_full = _masked_bounds(bounds)
+    return _hist_call(
+        flat, img, lo_full, span_full, max_objects, bins, interpret, chunk
+    )
+
+
+# -------------------------------------------------------------- GLCM kernel
+def _glcm_kernel(lab_ref, img_ref, lab2_ref, img2_ref, lo_ref, span_ref,
+                 counts_ref, *, levels, n_dirs):
+    """All directions' GLCM counts for one chunk: quantize the unshifted
+    and each direction's shifted pixels against the per-object bounds,
+    then contract the shared (label, q1) row one-hot against the
+    concatenated per-direction column one-hots — ``_glcm_matmul_all``'s
+    factored contraction with the quantization pulled on chip."""
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        counts_ref[:] = jnp.zeros_like(counts_ref)
+
+    chunk = lab_ref.shape[1]
+    segs_p = lo_ref.shape[1]
+    rows_p, cols_p = counts_ref.shape
+    lo_row = lo_ref[0, :][None, :]
+    span_row = span_ref[0, :][None, :]
+    seg_ids = lax.broadcasted_iota(jnp.int32, (chunk, segs_p), 1)
+
+    def quantize(lab, v):
+        sel = lab[:, None] == seg_ids
+        lo_pix = jnp.sum(jnp.where(sel, lo_row, 0.0), axis=1)
+        span_pix = jnp.maximum(
+            jnp.sum(jnp.where(sel, span_row, 0.0), axis=1), 1e-6
+        )
+        q = jnp.floor((v - lo_pix) * (levels - 1) / span_pix)
+        return jnp.clip(q, 0, levels - 1).astype(jnp.int32)
+
+    lab = lab_ref[0, :]
+    q = quantize(lab, img_ref[0, :])
+    row = jnp.where(lab > 0, lab * levels + q, 0)
+    row_ids = lax.broadcasted_iota(jnp.int32, (chunk, rows_p), 1)
+    oh_r = (row[:, None] == row_ids).astype(jnp.bfloat16)
+    lvl_ids = lax.broadcasted_iota(jnp.int32, (chunk, levels), 1)
+    cols = []
+    for d in range(n_dirs):  # static unroll
+        lab2 = lab2_ref[d, 0, :]
+        q2 = quantize(lab2, img2_ref[d, 0, :])
+        valid = (lab > 0) & (lab2 == lab)
+        col = jnp.where(valid, q2, 0)
+        cols.append(
+            (col[:, None] == lvl_ids).astype(jnp.bfloat16)
+            * valid[:, None].astype(jnp.bfloat16)
+        )
+    if cols_p > n_dirs * levels:
+        cols.append(
+            jnp.zeros((chunk, cols_p - n_dirs * levels), jnp.bfloat16)
+        )
+    oh_c = jnp.concatenate(cols, axis=1)  # (chunk, cols_p)
+    counts_ref[:] += lax.dot_general(
+        oh_r, oh_c, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_objects", "levels", "offsets", "interpret", "chunk"),
+)
+def _glcm_call(labels, img, lo_full, span_full, max_objects, levels,
+               offsets, interpret, chunk):
+    segs = capacity_segments(max_objects)
+    segs_p = _pad_lane(segs)
+    k = len(offsets)
+    rows_p = _pad_lane(segs * levels)
+    cols_p = _pad_lane(k * levels)
+    lab = _chunked(labels.reshape(-1), chunk)
+    vals = _chunked(img.reshape(-1), chunk)
+    lab2 = jnp.stack([
+        _chunked(shift_with_fill(labels, -dy, -dx, 0).reshape(-1), chunk)
+        for dy, dx in offsets
+    ])
+    img2 = jnp.stack([
+        _chunked(shift_with_fill(img, -dy, -dx, 0.0).reshape(-1), chunk)
+        for dy, dx in offsets
+    ])
+    lo_p = jnp.concatenate(
+        [lo_full, jnp.zeros((segs_p - segs,), jnp.float32)]
+    )[None, :]
+    span_p = jnp.concatenate(
+        [span_full, jnp.ones((segs_p - segs,), jnp.float32)]
+    )[None, :]
+    n_chunks = lab.shape[0]
+    counts = pl.pallas_call(
+        functools.partial(_glcm_kernel, levels=levels, n_dirs=k),
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((1, chunk), lambda i: (i, 0)),
+            pl.BlockSpec((k, 1, chunk), lambda i: (0, i, 0)),
+            pl.BlockSpec((k, 1, chunk), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, segs_p), lambda i: (0, 0)),
+            pl.BlockSpec((1, segs_p), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((rows_p, cols_p), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows_p, cols_p), jnp.float32),
+        interpret=interpret,
+    )(lab, vals, lab2, img2, lo_p, span_p)
+    out = []
+    for d in range(k):
+        glcm = counts[: segs * levels, d * levels : (d + 1) * levels]
+        glcm = glcm.reshape(segs, levels, levels)[1:]
+        out.append(glcm + jnp.swapaxes(glcm, 1, 2))
+    return out
+
+
+def glcm_all(
+    labels: jax.Array,
+    intensity: jax.Array,
+    max_objects: int,
+    levels: int,
+    offsets: list[tuple[int, int]],
+    bounds: tuple[jax.Array, jax.Array],
+    *,
+    interpret: "bool | None" = None,
+    chunk: "int | None" = None,
+) -> list[jax.Array]:
+    """All directions' symmetrized per-object GLCMs
+    (``(max_objects, levels, levels)`` each) in one fused pass —
+    quantization included.  ``bounds`` is the raw per-object min/max of
+    ``intensity`` (the fused stats kernel supplies it).  The chunk is
+    clamped to :data:`GLCM_CHUNK_MAX`: the (chunk, segments×levels) row
+    one-hot dominates the kernel's VMEM budget (DESIGN.md §22)."""
+    interpret, chunk = _resolve(interpret, chunk)
+    chunk = min(chunk, GLCM_CHUNK_MAX)
+    labels = jnp.asarray(labels, jnp.int32)
+    img = jnp.asarray(intensity, jnp.float32)
+    lo_full, span_full = _masked_bounds(bounds)
+    return _glcm_call(
+        labels, img, lo_full, span_full, max_objects, levels,
+        tuple(tuple(o) for o in offsets), interpret, chunk,
+    )
+
+
+# ------------------------------------------------------------ VMEM budgeting
+def vmem_bytes_estimate(
+    capacity: int,
+    *,
+    strategy: str = "fused",
+    n_channels: int = 7,
+    bins: int = 256,
+    levels: int = 32,
+    n_directions: int = 4,
+    chunk: "int | None" = None,
+) -> int:
+    """Coarse on-chip working-set estimate (bytes) for one measure pass
+    at ``capacity`` — the number bench sweep rows record so a rung's
+    VMEM pressure is readable next to its throughput.  For ``"fused"``
+    it is the worst kernel's resident bytes (inputs + one-hots +
+    accumulator, per DESIGN.md §22's budget table); for the unfused
+    strategies, the dominant chunked one-hot / accumulator operand of
+    the XLA path (a bound on what XLA must keep live per chunk
+    iteration, not a Pallas budget)."""
+    segs = capacity_segments(capacity)
+    segs_p = _pad_lane(segs)
+    if chunk is None:
+        chunk = fused_chunk()
+    if strategy == "fused":
+        gchunk = min(chunk, GLCM_CHUNK_MAX)
+        stats = (
+            chunk * (1 + n_channels) * 4      # label + channel blocks
+            + chunk * segs_p * 4              # shared one-hot / mask
+            + 3 * n_channels * segs_p * 4     # sum/min/max accumulators
+        )
+        hist = (
+            chunk * 2 * 4                     # label + value blocks
+            + chunk * segs_p * 4              # label one-hot
+            + chunk * _pad_lane(bins) * 2     # bucket one-hot (bf16)
+            + segs_p * _pad_lane(bins) * 4    # counts accumulator
+        )
+        glcm = (
+            gchunk * 2 * (1 + n_directions) * 4       # shifted pixel blocks
+            + gchunk * _pad_lane(segs * levels) * 2   # row one-hot (bf16)
+            + gchunk * _pad_lane(n_directions * levels) * 2
+            + _pad_lane(segs * levels) * _pad_lane(n_directions * levels) * 4
+        )
+        return max(stats, hist, glcm)
+    if strategy == "onehot":
+        # grouped_sums' (chunk, segs) f32 one-hot vs the GLCM bf16 pair
+        from tmlibrary_tpu.ops.measure import _GLCM_CHUNK, _SUM_CHUNK
+
+        return max(
+            _SUM_CHUNK * segs * 4,
+            _GLCM_CHUNK * (segs * levels + n_directions * levels) * 2,
+        )
+    # sort/scatter: flat operands plus the largest segmented accumulator
+    # (the (segs*levels*levels) GLCM cells); no chunked one-hots
+    return segs * levels * levels * 4 + segs * bins * 4

@@ -86,6 +86,11 @@ def grouped_sums(
         # itself is bit-identical and parity-tested — and the strategy
         # resolver never selects it.
         method = resolve_reduction_strategy()
+    if method == "fused":
+        from tmlibrary_tpu.ops.fused_measure import grouped_stats
+
+        sums, _, _ = grouped_stats(labels, channels, max_objects)
+        return sums
     if method == "onehot":
         method = "matmul"
     if method == "native":
@@ -222,6 +227,11 @@ def grouped_minmax(
     if method == "auto":
         # see grouped_minmax_multi: native is explicit opt-in on CPU
         method = resolve_reduction_strategy()
+    if method == "fused":
+        from tmlibrary_tpu.ops.fused_measure import grouped_stats
+
+        _, mn, mx = grouped_stats(labels, [values], max_objects)
+        return mn[:, 0], mx[:, 0]
     if method == "onehot":
         method = "reduce"
     if method in ("scatter", "sort"):
@@ -281,6 +291,11 @@ def grouped_minmax_multi(
         # interaction is understood, and the strategy resolver never
         # selects it
         method = resolve_reduction_strategy()
+    if method == "fused":
+        from tmlibrary_tpu.ops.fused_measure import grouped_stats
+
+        _, mn, mx = grouped_stats(labels, values, max_objects)
+        return mn, mx
     if method == "onehot":
         method = "reduce"
     if method == "native":
@@ -398,15 +413,31 @@ def intensity_features(
     labels = jnp.asarray(labels, jnp.int32)
     img = jnp.asarray(intensity, jnp.float32)
     if method == "auto":
-        from tmlibrary_tpu import native
+        # a pinned/requested "fused" strategy outranks the CPU native
+        # heuristic — the megakernel is the thing being requested
+        if resolve_reduction_strategy() == "fused":
+            method = "fused"
+        else:
+            from tmlibrary_tpu import native
 
-        method = (
-            "native"
-            if native.cpu_native_enabled() and native.has_site_stats()
-            else "xla"
-        )
+            method = (
+                "native"
+                if native.cpu_native_enabled() and native.has_site_stats()
+                else "xla"
+            )
     if method == "native":
         count, total, sq, mn, mx = _native_site_stats(labels, img, max_objects)
+    elif method == "fused":
+        # all five accumulators in ONE megakernel pass: count/sum/sumsq
+        # from the sum columns, min/max of the intensity channel from the
+        # same shared one-hot (the unfused path takes two full passes)
+        from tmlibrary_tpu.ops.fused_measure import grouped_stats
+
+        sums, mns, mxs = grouped_stats(
+            labels, [jnp.ones_like(img), img, img * img], max_objects
+        )
+        count, total, sq = sums[:, 0], sums[:, 1], sums[:, 2]
+        mn, mx = mns[:, 1], mxs[:, 1]
     else:
         sums = grouped_sums(
             labels, [jnp.ones_like(img), img, img * img], max_objects
@@ -461,6 +492,17 @@ def intensity_quantiles(
     present = raw_hi >= raw_lo
     lo = jnp.where(present, raw_lo, 0.0)
     span = jnp.where(present, raw_hi - lo, 1.0)
+    strategy = resolve_reduction_strategy(method)
+    if strategy == "fused":
+        # quantization + accumulation inside the megakernel; the bounds
+        # come from the fused min/max above, so counts (exact integers)
+        # are bit-identical to every other strategy
+        from tmlibrary_tpu.ops.fused_measure import intensity_hist
+
+        counts = intensity_hist(
+            labels, img, max_objects, bins, (raw_lo, raw_hi)
+        )
+        return _quantiles_from_counts(counts, lo, span, present, qs, bins)
 
     q_pix = quantize_per_object(
         labels, img, max_objects, bins, bounds=(raw_lo, raw_hi)
@@ -472,7 +514,6 @@ def intensity_quantiles(
     # plain fused-index scatter is the fast path (see grouped_sums).
     lab_flat = labels.reshape(-1)
     q_flat = q_pix.reshape(-1)
-    strategy = resolve_reduction_strategy(method)
     if strategy in ("scatter", "sort"):
         idx = lab_flat * bins + q_flat
         segs = capacity_segments(max_objects)
@@ -546,20 +587,28 @@ def morphology_features(labels: jax.Array, max_objects: int) -> dict[str, jax.Ar
         boundary = boundary | (shift_with_fill(labels, dy, dx, 0) != labels)
     boundary = boundary & (labels > 0)
 
-    # all per-object sums in one MXU pass
-    sums = grouped_sums(
-        labels,
-        [ones, yy, xx, yy * yy, xx * xx, yy * xx, boundary.astype(jnp.float32)],
-        max_objects,
-    )
+    chans = [
+        ones, yy, xx, yy * yy, xx * xx, yy * xx, boundary.astype(jnp.float32)
+    ]
+    if resolve_reduction_strategy() == "fused":
+        # all 7 per-object sums AND the bounding box from ONE megakernel
+        # pass — the min/max of the yy/xx channels ride the same shared
+        # one-hot as the sums (the unfused path below is two passes)
+        from tmlibrary_tpu.ops.fused_measure import grouped_stats
+
+        sums, mins_all, maxs_all = grouped_stats(labels, chans, max_objects)
+        mins, maxs = mins_all[:, 1:3], maxs_all[:, 1:3]
+    else:
+        # all per-object sums in one MXU pass
+        sums = grouped_sums(labels, chans, max_objects)
+        # bounding box: both axes' min/max in ONE pass over the pixels
+        mins, maxs = grouped_minmax_multi(labels, [yy, xx], max_objects)
     area = sums[:, 0]
     safe_a = jnp.maximum(area, 1.0)
     cy = sums[:, 1] / safe_a
     cx = sums[:, 2] / safe_a
     perimeter = sums[:, 6]
 
-    # bounding box: both axes' min/max in ONE pass over the pixels
-    mins, maxs = grouped_minmax_multi(labels, [yy, xx], max_objects)
     y_min, x_min = mins[:, 0], mins[:, 1]
     y_max, x_max = maxs[:, 0], maxs[:, 1]
     present = area > 0
@@ -849,9 +898,20 @@ def haralick_features(
             vmap_method="sequential",
         )
         glcms = [packed[d] for d in range(4)]
+    elif method == "fused" and quantization == "object":
+        # quantization + all 4 directions in the fused Pallas pass; the
+        # bounds come from the fused stats kernel (counts are exact
+        # integers, the per-object stretch the same f32 expression tree,
+        # so the GLCMs are bit-identical to the matmul/scatter paths)
+        from tmlibrary_tpu.ops.fused_measure import glcm_all
+
+        bounds = grouped_minmax(labels, img, max_objects, method="fused")
+        glcms = glcm_all(labels, img, max_objects, levels, offsets, bounds)
     else:
         if method == "native":
             method = "scatter"  # global quantization: no native path
+        if method == "fused":
+            method = "matmul"  # global quantization: no per-object bounds
         if quantization == "object":
             q = quantize_per_object(labels, img, max_objects, levels)
         elif quantization == "global":

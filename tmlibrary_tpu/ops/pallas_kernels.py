@@ -588,18 +588,38 @@ def distance_transform(
 
 
 # ------------------------------------------------------------------ dispatch
-@functools.lru_cache(maxsize=4)
+#: (path, mtime_ns, size) -> parsed tuning dict.  Keyed on the stat
+#: signature like ``RunLedger.events()``: a sweep rewriting TUNING.json
+#: in place is picked up on the next call (the old lru_cache keyed on
+#: path alone served stale verdicts for the life of the process), while
+#: repeat calls from hot dispatch paths (``_tuned_chunk``, every GLCM
+#: method resolution) cost one ``os.stat`` instead of a JSON parse.
+_TUNING_CACHE: dict = {}
+
+
 def _tuning_results_at(path: str) -> dict:
     import json
+    import os
 
+    try:
+        st = os.stat(path)
+        key = (path, st.st_mtime_ns, st.st_size)
+    except OSError:
+        key = (path, None, None)
+    hit = _TUNING_CACHE.get(key)
+    if hit is not None:
+        return hit
     try:
         with open(path) as f:
             tuning = json.load(f)
     except (OSError, ValueError):
-        return {}
+        tuning = {}
     # a dry-run (smoke-scale) sweep must never drive production dispatch
     if "SMOKE(" in str(tuning.get("timing_methodology", "")):
-        return {}
+        tuning = {}
+    if len(_TUNING_CACHE) > 8:  # stale (path, mtime) keys never re-hit
+        _TUNING_CACHE.clear()
+    _TUNING_CACHE[key] = tuning
     return tuning
 
 
@@ -609,13 +629,13 @@ def _tuning_results() -> dict:
     file through :func:`tmlibrary_tpu.tuning.tuning_json_path` so the
     ``TMX_TUNING_JSON`` rehearsal redirect applies to kernel dispatch the
     same way it does to the tuned engine defaults (the cache is keyed on
-    the resolved path)."""
+    the resolved path + stat signature, so in-place rewrites are seen)."""
     from tmlibrary_tpu.tuning import tuning_json_path
 
     return _tuning_results_at(tuning_json_path())
 
 
-_tuning_results.cache_clear = _tuning_results_at.cache_clear
+_tuning_results.cache_clear = _TUNING_CACHE.clear
 
 
 def pallas_enabled(kernel: str | None = None) -> bool:

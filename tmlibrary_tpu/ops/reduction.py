@@ -27,6 +27,15 @@ PAPERS.md):
 ``"scatter"``
     Direct ``.at[ids].add/min/max`` scatters — cheapest on CPU where
     scatters lower to serial element updates anyway.
+``"fused"``
+    The Pallas measure megakernels (``ops/fused_measure.py``): the site
+    tile streams through VMEM once per kernel while the per-object
+    accumulators (sums, min/max, quantile histogram, GLCM cells) stay
+    resident on chip — one HBM read of the tile instead of one per
+    reduction family.  Off-TPU the kernels run in interpret mode, so
+    the strategy is selectable (and parity-tested) everywhere.  Like
+    ``"onehot"``, its kernels live at the measure call sites; the
+    generic ``segmented_*`` primitives have no fused path.
 
 Determinism contract (pinned by ``tests/test_reduction.py`` on CPU):
 min/max agree bit-exactly across all strategies (order-free); counts and
@@ -34,8 +43,9 @@ integer-valued sums (uint16 microscopy pixels, histogram/GLCM cells) are
 exact in f32 and therefore bit-identical across all strategies; general
 fp32 sums may differ from the one-hot reference in the last ulps
 (documented tolerance 1e-6 relative) because the accumulation order
-differs, while sort-vs-scatter stay bit-identical to each other on CPU
-(same pixel-order accumulation).
+differs — ``fused`` shares that tolerance (chunked MXU accumulation in
+a different order) — while sort-vs-scatter stay bit-identical to each
+other on CPU (same pixel-order accumulation).
 
 ``"auto"`` resolution order (highest first): a pinned build-time scope
 (:func:`strategy_scope` — how compiled batch programs freeze their
@@ -60,7 +70,7 @@ import jax
 import jax.numpy as jnp
 
 #: the explicit strategies; "auto" resolves to one of these
-STRATEGIES = ("onehot", "sort", "scatter")
+STRATEGIES = ("onehot", "sort", "scatter", "fused")
 
 
 def capacity_segments(capacity: int) -> int:

@@ -524,14 +524,18 @@ def _methodology_class(rec: dict) -> str:
     the specific fetch depth may drift with tuning, but a pipelined
     capture must never be judged against a host-synchronous one (the
     fetch tax makes them different experiments), nor a bucket-routed
-    capture against a full-capacity one.  Records predating the
-    ``timing_methodology`` field form their own ``legacy`` family so
-    old-vs-old still compares."""
+    capture against a full-capacity one, nor a fused-megakernel capture
+    against an unfused one (a different measure-family program).
+    Records predating the ``timing_methodology`` field form their own
+    ``legacy`` family so old-vs-old still compares."""
     m = str(rec.get("timing_methodology") or "")
     if not m:
         return "legacy"
     if m.startswith("pipelined"):
-        return "pipelined+bucketed" if "bucketed" in m else "pipelined"
+        cls = "pipelined+bucketed" if "bucketed" in m else "pipelined"
+        if "strategy=fused" in m:
+            cls += "+fused"
+        return cls
     return m
 
 

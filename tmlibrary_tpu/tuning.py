@@ -144,7 +144,7 @@ def tuned_batch_size() -> int | None:
     return _positive_int(tuning.get("best_batch")) if tuning else None
 
 
-_REDUCTION_STRATEGIES = ("onehot", "sort", "scatter")
+_REDUCTION_STRATEGIES = ("onehot", "sort", "scatter", "fused")
 
 
 def tuned_reduction_strategy(backend: str | None = None) -> str | None:

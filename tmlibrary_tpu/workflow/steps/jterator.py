@@ -168,13 +168,14 @@ class ImageAnalysisRunner(Step):
                       "across bucket choices — routing is purely a "
                       "performance decision"),
         Argument("reduction_strategy", str, default="auto",
-                 choices=("auto", "onehot", "sort", "scatter"),
+                 choices=("auto", "onehot", "sort", "scatter", "fused"),
                  help="grouped-reduction strategy for the measurement "
                       "stack (ops/reduction.py): one-hot MXU matmuls, "
-                      "deterministic sort+segment reductions, or direct "
-                      "scatters; 'auto' follows TMX_REDUCTION_STRATEGY / "
-                      "config / the tuned verdict, then a backend-safe "
-                      "default"),
+                      "deterministic sort+segment reductions, direct "
+                      "scatters, or the single-pass Pallas measure "
+                      "megakernels (ops/fused_measure.py); 'auto' "
+                      "follows TMX_REDUCTION_STRATEGY / config / the "
+                      "tuned verdict, then a backend-safe default"),
         Argument("donate_buffers", bool, default=True,
                  help="donate each batch's raw-image/stats/shift device "
                       "buffers to the compiled program so XLA reuses "
