@@ -200,6 +200,12 @@ def _workload_knobs(config: str) -> dict:
         "BENCH_WELLS": ("wells", 1),
         "BENCH_WSITES": ("sites_per_well", 32),
         "BENCH_WSITES_X": ("sites_per_well_x", 8),
+        # env-ONLY string knob for the dl config's weight checkpoint:
+        # unset means the seeded default; an EXPLICIT spec never parses
+        # as an int, so _mismatch conservatively refuses to serve any
+        # cached record for it (records match on the field only when
+        # the requester left the knob at its default)
+        "BENCH_DL_WEIGHTS": ("weights_spec", None),
     }
 
 
@@ -465,7 +471,7 @@ def measure_sweep() -> None:
 
     backend = jax.default_backend()
     config = os.environ.get("BENCH_CONFIG", "3")
-    allowed = ("2", "3", "4", "volume", "corilla", "pyramid", "spatial")
+    allowed = ("2", "3", "4", "dl", "volume", "corilla", "pyramid", "spatial")
     if config not in allowed:
         raise SystemExit(
             f"BENCH_SWEEP supports BENCH_CONFIG in {allowed}, got '{config}'"
@@ -621,6 +627,18 @@ def measure_sweep() -> None:
         ),
         "swept_at": swept_at,
     }
+    if config == "dl":
+        # a sweep grid is only evidence about the checkpoint it ran
+        # with: a retrained net changes object counts and therefore the
+        # measured work, so the digest joins both the stored entry (the
+        # tuned-default reader refuses a mismatched one) and the
+        # methodology class (the sentinel never compares across
+        # checkpoints)
+        from tmlibrary_tpu.nn import weights_digest
+
+        mdigest = weights_digest(os.environ.get("BENCH_DL_WEIGHTS", "seed:0"))
+        entry["model_digest"] = mdigest
+        entry["timing_methodology"] += f"+model={mdigest}"
     tuning_mod.record_config_sweep(config, entry)
 
     record = {
@@ -651,6 +669,8 @@ def measure_sweep() -> None:
         **_ledger_fields(best_row["pipeline_depth"], max_objects),
     }
     record["timing_methodology"] = entry["timing_methodology"]
+    if "model_digest" in entry:
+        record["model_digest"] = entry["model_digest"]
     emit_record(record)
 
 
@@ -678,10 +698,10 @@ def measure(platform: str) -> None:
     batch = int(os.environ.get("BENCH_BATCH") or _default_batch(config))
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
 
-    if config not in ("2", "3", "4", "volume", "corilla", "pyramid",
+    if config not in ("2", "3", "4", "dl", "volume", "corilla", "pyramid",
                       "spatial", "mesh", "ingest", "workflow"):
         raise SystemExit(
-            f"BENCH_CONFIG must be '2', '3', '4', 'volume', 'corilla', "
+            f"BENCH_CONFIG must be '2', '3', '4', 'dl', 'volume', 'corilla', "
             f"'pyramid', 'spatial', 'mesh', 'ingest' or 'workflow', "
             f"got '{config}'"
         )
@@ -739,6 +759,17 @@ def measure(platform: str) -> None:
         desc = smooth_threshold_description()
         metric = "jterator_smooth_threshold_sites_per_sec_per_chip"
         unit = f"sites/sec ({size}x{size}, 1ch, smooth+adaptive threshold)"
+    elif config == "dl":
+        from tmlibrary_tpu.benchmarks import (
+            dl_description,
+            synthetic_cell_painting_batch,
+        )
+
+        dl_weights = os.environ.get("BENCH_DL_WEIGHTS", "seed:0")
+        data = synthetic_cell_painting_batch(batch, size=size, dapi_only=True)
+        desc = dl_description(weights=dl_weights)
+        metric = "jterator_dl_sites_per_sec_per_chip"
+        unit = f"sites/sec ({size}x{size}, 1ch, U-Net segment+measure)"
     else:
         from tmlibrary_tpu.benchmarks import (
             cell_painting_description,
@@ -840,6 +871,11 @@ def measure(platform: str) -> None:
 
             for s in range(n_cpu):
                 cpu_reference_site_full({ch: v[s] for ch, v in data.items()})
+        elif config == "dl":
+            from tmlibrary_tpu.benchmarks import cpu_reference_site_dl
+
+            for s in range(n_cpu):
+                cpu_reference_site_dl(data["DAPI"][s], dl_weights)
         else:
             from tmlibrary_tpu.benchmarks import cpu_reference_site
 
@@ -865,6 +901,24 @@ def measure(platform: str) -> None:
         # class (bench_regression compares it only against other
         # bucketed records)
         record["timing_methodology"] += "+bucketed"
+    if config == "dl":
+        # checkpoint provenance: the regression sentinel must never
+        # compare throughput across weight checkpoints (a retrained net
+        # changes object counts and therefore the measured work), so
+        # the weight content digest joins the methodology class
+        # (perf._methodology_class folds "+model=<digest>" in).  The
+        # analytic conv cost rides along so the roofline attribution
+        # can be cross-checked against the XLA cost model.
+        from tmlibrary_tpu.nn import resolve_weights, unet_flops, unet_io_bytes
+
+        _, mdigest, net_cfg = resolve_weights(dl_weights)
+        record["model_digest"] = mdigest
+        record["weights_spec"] = dl_weights
+        record["timing_methodology"] += f"+model={mdigest}"
+        record["model_flops_per_site"] = unet_flops(net_cfg, size, size)
+        record["model_min_io_bytes_per_site"] = unet_io_bytes(
+            net_cfg, size, size
+        )
     if config == "volume":
         record["depth"] = depth
     # sites whose object count sits AT the static cap may have silently

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""CI artifact: the deep-learning segmenter end to end, twice, bit-identical.
+
+    python scripts/ci_dl_smoke.py OUTDIR [WORKDIR]
+    python scripts/ci_dl_smoke.py --write-baseline PATH [WORKDIR]
+
+Drives the REAL surface — ``tmx workflow submit --qc`` with a
+``segment_dl_primary`` (seeded tiny U-Net) + ``measure_intensity``
+pipeline at ``--pipeline-depth 4`` with the default auto bucket ladder —
+TWICE into separate experiment roots, then asserts:
+
+  1. the decoded label images and feature tables are BIT-identical
+     between the two runs (the dl module family honors the same
+     determinism contract as the classical chain, DESIGN.md §23);
+  2. the second run triggered ZERO new program compiles — the content
+     digest of the seeded weights joins the compiled-program cache key
+     via ``program_digest_extras``, so an unchanged checkpoint must hit;
+  3. ``tmx qc --profile-kind model`` judges the run's flow-magnitude /
+     cell-probability sketches against the committed baseline
+     (``tuning/QC_DL_BASELINE.json``) with exit 0 — the model-drift
+     deploy gate, exercised through its default reference chain.
+
+The model-kind qc frame, the run profile, and the perf profile rows land
+in OUTDIR for artifact upload.  ``--write-baseline`` reruns the workflow
+and saves the model-filtered profile as the committed baseline instead
+(use after retraining or any intended change to the seeded forward).
+"""
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import yaml  # noqa: E402
+
+from ci_metrics_snapshot import synth_source  # noqa: E402
+
+#: generous — the seeded synthetic sketches only need to catch gross
+#: shifts (a changed forward pass, a broken decoder), not per-ulp drift
+THRESHOLD = 0.5
+
+DL_PIPE_YAML = {
+    "description": "ci dl smoke — U-Net segment, measure",
+    "input": {"channels": [{"name": "DAPI", "correct": True,
+                            "align": False}]},
+    "pipeline": [
+        {"handles": {
+            "module": "segment_dl_primary",
+            "input": [
+                {"name": "intensity_image", "type": "IntensityImage",
+                 "key": "DAPI"},
+                {"name": "weights", "type": "Character", "value": "seed:0"},
+                {"name": "prob_threshold", "type": "Numeric", "value": 0.6},
+                {"name": "min_area", "type": "Numeric", "value": 4},
+            ],
+            "output": [{"name": "objects", "type": "SegmentedObjects",
+                        "key": "cells", "objects": "cells"}],
+        }},
+        {"handles": {
+            "module": "measure_intensity",
+            "input": [
+                {"name": "objects_image", "type": "LabelImage",
+                 "key": "cells"},
+                {"name": "intensity_image", "type": "IntensityImage",
+                 "key": "DAPI"},
+            ],
+            "output": [{"name": "measurements", "type": "Measurement",
+                        "objects": "cells", "channel": "DAPI"}],
+        }},
+    ],
+    "output": {"objects": [{"name": "cells"}]},
+}
+
+
+def run(argv, capture: bool = False) -> "tuple[int, str]":
+    from tmlibrary_tpu.cli import main
+
+    argv = [str(a) for a in argv]
+    print("  $ tmx " + " ".join(argv))
+    if capture:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = main(argv)
+        sys.stdout.write(buf.getvalue())
+        return rc, buf.getvalue()
+    return main(argv), ""
+
+
+def submit(work: Path, src: Path, tag: str) -> Path:
+    root = work / f"experiment_{tag}"
+    rc, _ = run(["create", "--root", root, "--name", f"ci_dl_{tag}"])
+    if rc != 0:
+        raise SystemExit(f"create failed (rc={rc})")
+    pipe = work / "dl.pipe.yaml"
+    pipe.write_text(yaml.safe_dump(DL_PIPE_YAML))
+    from tmlibrary_tpu.workflow.engine import WorkflowDescription
+
+    desc = work / "workflow.yaml"
+    WorkflowDescription.canonical({
+        "metaconfig": {"source_dir": str(src)},
+        "imextract": {},
+        "corilla": {"chunk_size": 8, "n_devices": 1},
+        "jterator": {"pipe": str(pipe), "batch_size": 4, "max_objects": 64,
+                     "n_devices": 1},
+    }).save(desc)
+    rc, _ = run(["workflow", "submit", "--root", root, "--description",
+                 desc, "--qc", "--pipeline-depth", "4"])
+    if rc != 0:
+        raise SystemExit(f"workflow submit failed (rc={rc})")
+    return root
+
+
+def labels_digest(root: Path) -> "dict[str, str]":
+    """sha1 of every persisted label plane, keyed by file name."""
+    import hashlib
+
+    out = {}
+    for p in sorted((root / "segmentations").glob("cells_*.npy")):
+        out[p.name] = hashlib.sha1(np.load(p).tobytes()).hexdigest()
+    if not out:
+        raise SystemExit(f"no persisted cells label planes under {root}")
+    return out
+
+
+def features_frame(root: Path):
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    store = ExperimentStore.open(root)
+    df = store.read_features("cells")
+    return df.sort_index(axis=1).sort_values(
+        list(df.sort_index(axis=1).columns)
+    ).reset_index(drop=True)
+
+
+def total_compiles() -> int:
+    from tmlibrary_tpu import perf
+
+    return sum(int(p.get("compiles") or 0) for p in perf.perf_profiles())
+
+
+def main() -> None:
+    argv = sys.argv[1:]
+    baseline_out = None
+    if argv and argv[0] == "--write-baseline":
+        if len(argv) < 2:
+            raise SystemExit(__doc__)
+        baseline_out = Path(argv[1])
+        argv = argv[2:]
+        outdir = None
+    else:
+        if not argv:
+            raise SystemExit(__doc__)
+        outdir = Path(argv[0])
+        outdir.mkdir(parents=True, exist_ok=True)
+        argv = argv[1:]
+    work = Path(argv[0]) if argv else Path(
+        tempfile.mkdtemp(prefix="tmx-ci-dl-")
+    )
+    work.mkdir(parents=True, exist_ok=True)
+    src = work / "microscope"
+    src.mkdir(exist_ok=True)
+    synth_source(src)
+
+    root_a = submit(work, src, "a")
+
+    if baseline_out is not None:
+        from tmlibrary_tpu import qc as qc_mod
+
+        profile = json.loads((root_a / "workflow" / "qc.json").read_text())
+        model = qc_mod.filter_profile_kind(profile, "model")
+        if not model.get("features"):
+            raise SystemExit("run produced no __model__ sketches — is the "
+                             "QC side-channel wired?")
+        baseline_out.parent.mkdir(parents=True, exist_ok=True)
+        baseline_out.write_text(json.dumps(model, indent=2,
+                                           sort_keys=True) + "\n")
+        print(f"== wrote model baseline {baseline_out}")
+        return
+
+    compiles_after_a = total_compiles()
+    if compiles_after_a == 0:
+        raise SystemExit("no compiles attributed at all — is telemetry "
+                         "off? the zero-new-compiles check would be vacuous")
+    root_b = submit(work, src, "b")
+    new_compiles = total_compiles() - compiles_after_a
+    if new_compiles != 0:
+        raise SystemExit(
+            f"second submit compiled {new_compiles} new program(s) — the "
+            "weight digest / program_digest_extras cache key regressed"
+        )
+    print("== zero new compiles on the second run (weight-digest cache hit)")
+
+    dig_a, dig_b = labels_digest(root_a), labels_digest(root_b)
+    if dig_a != dig_b:
+        diff = [k for k in dig_a if dig_a.get(k) != dig_b.get(k)]
+        raise SystemExit(f"label planes differ between runs: {diff}")
+    feats_a, feats_b = features_frame(root_a), features_frame(root_b)
+    if not feats_a.equals(feats_b):
+        raise SystemExit("feature tables differ between the two runs")
+    print(f"== {len(dig_a)} label planes and {feats_a.shape} features "
+          "bit-identical across runs")
+
+    profile_path = root_a / "workflow" / "qc.json"
+    (outdir / "qc.json").write_text(profile_path.read_text())
+    rc, frame = run(["qc", "--root", root_a, "--profile-kind", "model",
+                     "--threshold", THRESHOLD], capture=True)
+    (outdir / "qc_model_frame.txt").write_text(frame)
+    if rc != 0:
+        raise SystemExit(
+            f"tmx qc --profile-kind model exited {rc} — model-output "
+            "drift vs tuning/QC_DL_BASELINE.json (recapture with "
+            "--write-baseline if the shift is intended)"
+        )
+    from tmlibrary_tpu import perf
+
+    (outdir / "perf_profiles.json").write_text(
+        json.dumps(perf.perf_profiles(), indent=2, sort_keys=True) + "\n"
+    )
+    print(f"== model drift gate ok (exit 0) — artifacts in {outdir}")
+
+
+if __name__ == "__main__":
+    main()

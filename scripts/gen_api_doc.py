@@ -114,6 +114,44 @@ def fused_section() -> list[str]:
     return out
 
 
+def nn_section() -> list[str]:
+    import importlib
+    import pkgutil
+
+    import tmlibrary_tpu.nn as nn_pkg
+
+    out = ["## Deep-learning segmentation (`nn/`)", "",
+           (inspect.getdoc(nn_pkg) or "").split("\n")[0],
+           "",
+           "Registered as the `segment_dl_primary` / `segment_dl_"
+           "secondary` jterator modules (DESIGN.md §23).  Weight specs: "
+           "`seed:N[:base=C][:depth=D][:in=C]` (deterministic init), a "
+           "bare checkpoint name resolved in `TMX_WEIGHTS_DIR`, or a "
+           "path to an `.npz`; the checkpoint content digest joins the "
+           "compiled-program cache key via `program_digest_extras` and "
+           "the bench/sweep provenance (`model_digest`, "
+           "`+model=<digest>` methodology).  `tmx qc --profile-kind "
+           "model` gates the `__model__` output sketches against "
+           "`tuning/QC_DL_BASELINE.json`.",
+           ""]
+    for info in sorted(pkgutil.iter_modules(nn_pkg.__path__),
+                       key=lambda m: m.name):
+        mod = importlib.import_module(f"tmlibrary_tpu.nn.{info.name}")
+        doc = (inspect.getdoc(mod) or "").split("\n")[0]
+        out += [f"### `nn.{info.name}`", "", doc, "",
+                "| symbol | role |", "|---|---|"]
+        for name in sorted(n for n in dir(mod) if not n.startswith("_")):
+            obj = getattr(mod, name)
+            if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+                continue
+            if getattr(obj, "__module__", "") != mod.__name__:
+                continue
+            doc_ = (inspect.getdoc(obj) or "").split("\n")[0]
+            out.append(f"| `{info.name}.{name}` | {doc_} |")
+        out.append("")
+    return out
+
+
 def telemetry_section() -> list[str]:
     from tmlibrary_tpu import telemetry
 
@@ -309,6 +347,7 @@ def main() -> None:
         *tool_section(),
         *ops_section(),
         *fused_section(),
+        *nn_section(),
         *telemetry_section(),
         *top_section(),
         *qc_section(),

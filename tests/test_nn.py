@@ -1,0 +1,325 @@
+"""Deep-learning segmentation module family (``tmlibrary_tpu/nn`` +
+jterator/workflow wiring — DESIGN.md §23).
+
+Four layers of guarantees:
+
+- The weight store as pure functions: seeded init determinism, spec
+  parsing, save/load round-trips, content digests that track file
+  content (not names), and the memo invalidating on overwrite.
+- The decoder's determinism contracts: the decoded label image is
+  byte-identical across object-capacity buckets (the raw seed-component
+  count routinely exceeds a bucket, so any capacity-sized table before
+  the final clip is a routing-dependent bug), across
+  ``connected_components`` backend variants, and across repeated traces.
+- The compiled-program cache: the weight CONTENT digest keys
+  ``cached_batch_fn`` via ``program_digest_extras`` — two checkpoints
+  under one name must never share a program (the PR-8 QC-gate lesson).
+- End to end under the production machinery: the ``segment_dl_primary``
+  pipeline through the jterator step persists bit-identical label
+  stacks and feature tables across pipeline depths {1, 4} and bucket
+  specs (off / 8 / auto), mirroring ``tests/test_buckets.py``.
+"""
+
+import numpy as np
+import pytest
+
+from test_pipelined import (  # noqa: F401 — fixture re-export
+    _read_features_sorted,
+    _run_prep_steps,
+)
+from test_workflow import (  # noqa: F401 — fixture re-export
+    source_dir,
+    store,
+    synth_site_image,
+)
+
+from tmlibrary_tpu import nn
+from tmlibrary_tpu.workflow.pipelined import PipelinedExecutor
+from tmlibrary_tpu.workflow.registry import get_step
+
+DL_PIPE_YAML = {
+    "description": "dl nuclei segmentation + intensity",
+    "input": {"channels": [{"name": "DAPI", "correct": True,
+                            "align": False}]},
+    "pipeline": [
+        {"handles": {
+            "module": "segment_dl_primary",
+            "input": [
+                {"name": "intensity_image", "type": "IntensityImage",
+                 "key": "DAPI"},
+                {"name": "weights", "type": "Character", "value": "seed:0"},
+                {"name": "prob_threshold", "type": "Numeric", "value": 0.6},
+                {"name": "min_area", "type": "Numeric", "value": 4},
+            ],
+            "output": [{"name": "objects", "type": "SegmentedObjects",
+                        "key": "cells", "objects": "cells"}],
+        }},
+        {"handles": {
+            "module": "measure_intensity",
+            "input": [
+                {"name": "objects_image", "type": "LabelImage",
+                 "key": "cells"},
+                {"name": "intensity_image", "type": "IntensityImage",
+                 "key": "DAPI"},
+            ],
+            "output": [{"name": "measurements", "type": "Measurement",
+                        "objects": "cells", "channel": "DAPI"}],
+        }},
+    ],
+    "output": {"objects": [{"name": "cells"}]},
+}
+
+
+@pytest.fixture(autouse=True)
+def _isolate_tuning_and_weights(tmp_path, monkeypatch):
+    """No tuned capacity hints, no developer weights cache: routing and
+    spec resolution must behave the same on every machine."""
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tmp_path / "no_tuning.json"))
+    monkeypatch.delenv("TMX_OBJECT_BUCKETS", raising=False)
+    monkeypatch.setenv("TMX_WEIGHTS_DIR", str(tmp_path / "weights"))
+
+
+def make_dl_description(source_dir, store, batch_size=8):
+    import yaml
+
+    from tmlibrary_tpu.workflow.engine import WorkflowDescription
+
+    pipe_path = store.root / "dl.pipe.yaml"
+    pipe_path.write_text(yaml.safe_dump(DL_PIPE_YAML))
+    return WorkflowDescription.canonical({
+        "metaconfig": {"source_dir": str(source_dir)},
+        "imextract": {},
+        "corilla": {"chunk_size": 8, "n_devices": 1},
+        "jterator": {"pipe": "dl.pipe.yaml", "batch_size": batch_size,
+                     "max_objects": 64, "n_devices": 1},
+    })
+
+
+def _site(seed=3):
+    rng = np.random.default_rng(seed)
+    return synth_site_image(rng).astype(np.float32)
+
+
+# ------------------------------------------------------------ weight store
+def test_seeded_init_deterministic():
+    a = nn.init_unet_params(7)
+    b = nn.init_unet_params(7)
+    assert sorted(a) == sorted(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+    assert nn.params_digest(a) == nn.params_digest(b)
+    assert nn.params_digest(nn.init_unet_params(8)) != nn.params_digest(a)
+
+
+def test_seed_spec_options_shape_architecture():
+    params, digest, cfg = nn.resolve_weights("seed:5:base=4:depth=1")
+    assert cfg == nn.UNetConfig(in_channels=1, base_channels=4, depth=1)
+    assert nn.infer_config(params) == cfg
+    assert digest == nn.params_digest(params)
+    # same spec resolves to the identical digest from the memo and fresh
+    assert nn.weights_digest("seed:5:base=4:depth=1") == digest
+
+
+def test_infer_config_roundtrip():
+    for cfg in (nn.UNetConfig(), nn.UNetConfig(2, 4, 1),
+                nn.UNetConfig(1, 6, 3)):
+        assert nn.infer_config(nn.init_unet_params(0, cfg)) == cfg
+
+
+def test_save_load_roundtrip_and_memo_invalidation(tmp_path):
+    params = nn.init_unet_params(1, nn.UNetConfig(1, 4, 1))
+    path = nn.save_weights("ck", params, meta={"note": "t"},
+                           directory=tmp_path)
+    assert path.name == "ck.npz"
+    loaded, meta = nn.load_weights("ck", tmp_path)
+    assert meta["note"] == "t"
+    for k in params:
+        np.testing.assert_array_equal(loaded[k], params[k])
+    spec = str(path)
+    first = nn.weights_digest(spec)
+    assert first == nn.params_digest(params)
+    # overwrite under the SAME name: the file-backed memo must re-read
+    other = nn.init_unet_params(2, nn.UNetConfig(1, 4, 1))
+    nn.save_weights("ck", other, directory=tmp_path)
+    assert nn.weights_digest(spec) == nn.params_digest(other) != first
+
+
+def test_list_weights_inventory(tmp_path):
+    nn.save_weights("a", nn.init_unet_params(0, nn.UNetConfig(1, 4, 1)),
+                    directory=tmp_path)
+    rows = nn.list_weights(tmp_path)
+    assert [r["name"] for r in rows] == ["a"]
+    assert rows[0]["digest"] == nn.weights_digest(str(tmp_path / "a.npz"))
+
+
+def test_store_stage_weights(store):
+    params = nn.init_unet_params(4, nn.UNetConfig(1, 4, 1))
+    path = store.stage_weights("model", params, meta={"epoch": 1})
+    assert path == store.weights_dir / "model.npz"
+    assert nn.weights_digest(str(path)) == nn.params_digest(params)
+
+
+# ----------------------------------------------------------------- forward
+def test_unet_apply_odd_geometry():
+    params = nn.init_unet_params(0, nn.UNetConfig(1, 4, 2))
+    out = nn.unet_apply(params, np.zeros((61, 67), np.float32))
+    assert out.shape == (61, 67, nn.OUT_CHANNELS)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+# ----------------------------------------------------------------- decoder
+def _flows(site=None):
+    import jax.numpy as jnp
+
+    params, _, cfg = nn.resolve_weights("seed:0")
+    img = _site() if site is None else site
+    out = nn.unet_apply(params, nn.normalize_image(jnp.asarray(img)), cfg)
+    import jax
+
+    prob = jax.nn.sigmoid(out[..., 2])
+    return out[..., :2], prob
+
+
+def test_decode_bit_identical_across_capacities():
+    """The routed capacity is pure padding: the raw seed-component count
+    exceeds small buckets, but only the post-filter count matters."""
+    flow, prob = _flows()
+    ref = None
+    for cap in (8, 16, 64, 256):
+        labels, count = nn.decode_flows(flow, prob, prob_threshold=0.6,
+                                        min_area=4, max_objects=cap)
+        labels = np.asarray(labels)
+        if ref is None:
+            ref = labels
+            assert 0 < int(count) <= 8
+        else:
+            np.testing.assert_array_equal(labels, ref)
+
+
+def test_decode_deterministic_across_cc_backends(monkeypatch):
+    """Same flows through the xla fixpoint vs the native union-find (the
+    cpu-backend default when the helper library is built) — identical
+    labels, mirroring the cross-backend pins in tests/test_label.py."""
+    flow, prob = _flows()
+    monkeypatch.setenv("TMX_NATIVE", "0")
+    xla_labels = np.asarray(nn.decode_flows(flow, prob, prob_threshold=0.6,
+                                            min_area=4, max_objects=64)[0])
+    monkeypatch.delenv("TMX_NATIVE")
+    auto_labels = np.asarray(nn.decode_flows(flow, prob, prob_threshold=0.6,
+                                             min_area=4, max_objects=64)[0])
+    np.testing.assert_array_equal(xla_labels, auto_labels)
+
+
+def test_decode_secondary_inherits_primary_ids():
+    flow, prob = _flows()
+    primary, _ = nn.decode_flows(flow, prob, prob_threshold=0.6,
+                                 min_area=4, max_objects=64)
+    cells, count = nn.decode_secondary(primary, prob, prob_threshold=0.6,
+                                       max_objects=64)
+    primary, cells = np.asarray(primary), np.asarray(cells)
+    # every primary id survives, on at least its own footprint
+    inside = primary > 0
+    np.testing.assert_array_equal(cells[inside], primary[inside])
+    assert int(count) == int(primary.max())
+
+
+# --------------------------------------------------- program cache digests
+def test_weight_content_splits_program_cache(tmp_path):
+    """Two checkpoints under ONE file name must never share a compiled
+    program: the content digest (not the spec string) joins the cache
+    key through program_digest_extras."""
+    from tmlibrary_tpu.benchmarks import dl_description
+    from tmlibrary_tpu.jterator.pipeline import (
+        cached_batch_fn,
+        program_digest_extras,
+        weight_digests,
+    )
+
+    cfg = nn.UNetConfig(1, 4, 1)
+    path = nn.save_weights("ck", nn.init_unet_params(1, cfg),
+                           directory=tmp_path)
+    desc = dl_description(weights=str(path))
+    digests = weight_digests(desc)
+    assert [(m, s) for m, s, _ in digests] == [
+        ("segment_dl_primary", str(path))
+    ]
+    extras_a = program_digest_extras(desc)
+    fn_a = cached_batch_fn(desc, 16)
+    assert cached_batch_fn(desc, 16) is fn_a  # unchanged checkpoint hits
+
+    nn.save_weights("ck", nn.init_unet_params(2, cfg), directory=tmp_path)
+    assert program_digest_extras(desc) != extras_a
+    assert cached_batch_fn(desc, 16) is not fn_a
+
+    # the qc gate is part of the same extras tuple
+    assert program_digest_extras(desc, qc=True) != program_digest_extras(
+        desc, qc=False
+    )
+
+
+# ------------------------------------------------------- qc side-channel
+def test_qc_side_channel_dropped_by_default():
+    import jax.numpy as jnp
+
+    from tmlibrary_tpu.benchmarks import dl_description
+    from tmlibrary_tpu.jterator.pipeline import (
+        MODEL_QC_KEY,
+        ImageAnalysisPipeline,
+    )
+
+    desc = dl_description()
+    raw = {"DAPI": jnp.asarray(np.stack([_site(s) for s in range(2)]))}
+    shifts = jnp.zeros((2, 2), jnp.int32)
+    pipe = ImageAnalysisPipeline(desc, max_objects=32)
+    plain = pipe.build_batch_fn(donate=False)(raw, {}, shifts)
+    result, stats = ImageAnalysisPipeline(desc, max_objects=32).build_batch_fn(
+        donate=False, qc=True
+    )(raw, {}, shifts)
+    streams = stats[MODEL_QC_KEY]
+    assert set(streams) == {"flow_mag", "cell_prob"}
+    assert all(np.asarray(v).shape[0] == 2 for v in streams.values())
+    # collecting the diagnostics must not perturb the decoded labels
+    np.testing.assert_array_equal(np.asarray(plain.objects["cells"]),
+                                  np.asarray(result.objects["cells"]))
+
+
+# --------------------------------------- end to end: depths, buckets, step
+def test_dl_step_bit_identical_across_depths_and_buckets(source_dir, store):
+    """The dl pipeline through the production jterator step: label
+    stacks and feature tables byte-identical between the sequential
+    reference and the pipelined executor at depth 4, across bucket
+    specs off / 8 / auto."""
+    import pandas.testing
+
+    desc = make_dl_description(source_dir, store, batch_size=2)
+    _run_prep_steps(desc, store)
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    args = {**jd.args, "object_buckets": "off"}
+
+    jt = get_step("jterator")(store)
+    jt.init(args)
+    summaries = [jt.run(j) for j in jt.list_batches()]
+    assert all(s["bucket_capacity"] == 64 for s in summaries)
+    ref_labels = store.read_labels(None, "cells").copy()
+    ref_feats = _read_features_sorted(store, "cells")
+    peak = int(max(lab.max() for lab in ref_labels))
+    assert 0 < peak < 16
+
+    for spec, depth in (("off", 4), ("16", 4), ("auto", 1), ("auto", 4)):
+        jt2 = get_step("jterator")(store)
+        jt2.delete_previous_output()
+        jt2.init({**args, "object_buckets": spec})
+        batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+        out = list(PipelinedExecutor(jt2, depth=depth).run(batches))
+        if spec != "off":
+            # routing engaged: at least some batches ran below the
+            # ceiling (counts near a rung may legitimately escalate)
+            assert any(r["bucket_capacity"] < 64 for _, r in out)
+        np.testing.assert_array_equal(
+            store.read_labels(None, "cells"), ref_labels,
+            err_msg=f"labels diverged: buckets={spec} depth={depth}",
+        )
+        pandas.testing.assert_frame_equal(
+            _read_features_sorted(store, "cells"), ref_feats
+        )

@@ -125,6 +125,64 @@ def cell_painting_description() -> PipelineDescription:
     return PipelineDescription.from_dict(CELL_PAINTING_PIPE)
 
 
+def dl_description(
+    weights: str = "seed:0",
+    prob_threshold: float = 0.6,
+    min_area: int = 4,
+) -> PipelineDescription:
+    """BENCH_CONFIG ``dl``: deep-learning segmentation + measurement —
+    ``segment_dl_primary`` (the pure-JAX flow-field U-Net +
+    deterministic decoder, ``tmlibrary_tpu.nn``) on DAPI, then
+    ``measure_intensity`` on the decoded nuclei.  The conv workload is
+    the repo's first MXU-resident bench config (``bound_by=compute``
+    roofline rungs); ``weights`` is an ``nn/weights.py`` checkpoint
+    spec, defaulting to deterministic seeded weights so the config runs
+    anywhere without a trained checkpoint."""
+    return PipelineDescription.from_dict({
+        "description": "DL segmentation: U-Net nuclei, measure intensity",
+        "input": {
+            "channels": [{"name": "DAPI", "correct": False, "align": False}]
+        },
+        "pipeline": [
+            {
+                "handles": {
+                    "module": "segment_dl_primary",
+                    "input": [
+                        {"name": "intensity_image", "type": "IntensityImage",
+                         "key": "DAPI"},
+                        {"name": "weights", "type": "Character",
+                         "value": weights},
+                        {"name": "prob_threshold", "type": "Numeric",
+                         "value": prob_threshold},
+                        {"name": "min_area", "type": "Numeric",
+                         "value": min_area},
+                    ],
+                    "output": [
+                        {"name": "objects", "type": "SegmentedObjects",
+                         "key": "cells", "objects": "cells"}
+                    ],
+                }
+            },
+            {
+                "handles": {
+                    "module": "measure_intensity",
+                    "input": [
+                        {"name": "objects_image", "type": "LabelImage",
+                         "key": "cells"},
+                        {"name": "intensity_image", "type": "IntensityImage",
+                         "key": "DAPI"},
+                    ],
+                    "output": [
+                        {"name": "measurements", "type": "Measurement",
+                         "objects": "cells", "channel": "DAPI"}
+                    ],
+                }
+            },
+        ],
+        "output": {"objects": [{"name": "cells"}]},
+    })
+
+
 #: the five canonical Cell Painting stains (BASELINE.json config 4)
 FULL_STACK_CHANNELS = ("DAPI", "Actin", "Tubulin", "ER", "Mito")
 
@@ -486,6 +544,86 @@ def cpu_reference_site(dapi: np.ndarray, actin: np.ndarray) -> tuple[int, int]:
     return n_nuclei, n_cells
 
 
+def _conv2d_numpy(
+    x: np.ndarray, w: np.ndarray, b: np.ndarray, stride: int = 1
+) -> np.ndarray:
+    """SAME-padded (H, W, Cin) conv via im2col + one BLAS matmul — the
+    honest single-thread shape of the same MXU work (numpy matmul may
+    thread; the caller pins OMP threads where that matters, and the
+    denominator convention is "naive library code", not "hand-crippled")."""
+    kh, kw, cin, cout = w.shape
+    h, wd = x.shape[:2]
+    xp = np.pad(x, ((kh // 2, kh // 2), (kw // 2, kw // 2), (0, 0)))
+    oh, ow = -(-h // stride), -(-wd // stride)
+    cols = np.empty((oh, ow, kh * kw * cin), np.float32)
+    i = 0
+    for dy in range(kh):
+        for dx in range(kw):
+            cols[..., i:i + cin] = xp[dy:dy + h:stride, dx:dx + wd:stride]
+            i += cin
+    y = cols.reshape(oh * ow, -1) @ w.reshape(-1, cout) + b
+    return y.reshape(oh, ow, cout).astype(np.float32)
+
+
+def cpu_reference_site_dl(dapi: np.ndarray, weights: str = "seed:0") -> int:
+    """Single-threaded numpy mirror of the ``dl`` config's per-site work
+    — U-Net forward as im2col matmuls, sigmoid mask, flow-followed
+    seeds, scipy connected components, per-object intensity stats
+    (approximate golden, same convention as the other
+    ``cpu_reference_site_*`` denominators).  Returns the object count."""
+    import scipy.ndimage as ndi
+
+    from tmlibrary_tpu.nn import resolve_weights
+
+    params, _digest, cfg = resolve_weights(weights)
+    img = np.asarray(dapi, np.float32)
+    x = (img - img.mean()) / (img.std() + 1e-6)
+    h, w = x.shape
+    mult = 1 << cfg.depth
+    ph, pw = (-h) % mult, (-w) % mult
+    a = np.pad(x[..., None], ((0, ph), (0, pw), (0, 0)), mode="edge")
+
+    def conv(t, name, stride=1):
+        return _conv2d_numpy(
+            t, params[f"{name}/w"], params[f"{name}/b"], stride
+        )
+
+    relu = lambda t: np.maximum(t, 0.0)  # noqa: E731
+    a = relu(conv(a, "enc0/conv1"))
+    a = relu(conv(a, "enc0/conv2"))
+    skips = []
+    for i in range(1, cfg.depth + 1):
+        skips.append(a)
+        a = relu(conv(a, f"down{i}", stride=2))
+        a = relu(conv(a, f"enc{i}/conv1"))
+        a = relu(conv(a, f"enc{i}/conv2"))
+    for i in range(cfg.depth, 0, -1):
+        a = a.repeat(2, axis=0).repeat(2, axis=1)
+        a = relu(conv(a, f"up{i}"))
+        a = np.concatenate([a, skips[i - 1]], axis=-1)
+        a = relu(conv(a, f"dec{i}"))
+    y = conv(a, "head")[:h, :w]
+
+    flow, prob = y[..., :2], 1.0 / (1.0 + np.exp(-y[..., 2]))
+    mask = prob > 0.6
+    py, px = np.mgrid[0:h, 0:w]
+    for _ in range(24):
+        py = np.clip(py + np.sign(flow[py, px, 0]).astype(np.int64), 0, h - 1)
+        px = np.clip(px + np.sign(flow[py, px, 1]).astype(np.int64), 0, w - 1)
+    hits = np.zeros((h, w), np.int64)
+    np.add.at(hits, (py[mask], px[mask]), 1)
+    seeds, _n = ndi.label(hits >= 2, ndi.generate_binary_structure(2, 2))
+    labels = np.where(mask, seeds[py, px], 0)
+    ids = np.unique(labels)[1:]
+    if len(ids):
+        ndi.mean(img, labels, ids)
+        ndi.standard_deviation(img, labels, ids)
+        ndi.maximum(img, labels, ids)
+        ndi.minimum(img, labels, ids)
+        ndi.sum(img, labels, ids)
+    return len(ids)
+
+
 # ------------------------------------------------------------- volume config
 def volume_description(n_levels: int = 8) -> PipelineDescription:
     """BASELINE config 5 (stretch): the 3-D z-stack pipeline — focus-based
@@ -820,7 +958,7 @@ def cpu_reference_mosaic(mosaic: np.ndarray) -> int:
 #: Welford scan, the pyramid is a reduce_window chain, and the spatial
 #: layout's mosaic programs are cached without a strategy key — sweeping
 #: strategies there would record timing noise as a verdict.
-SWEEP_REDUCTION_CONFIGS = ("3", "4", "volume")
+SWEEP_REDUCTION_CONFIGS = ("3", "4", "dl", "volume")
 
 #: configs whose chain is host-synchronous end to end (stitching on both
 #: ends): there is nothing for a deeper in-flight window to overlap, so
@@ -895,6 +1033,15 @@ def sweep_workload(config, *, reduction_strategy=None, size=256, batch=64,
             smooth_threshold_description(),
             synthetic_cell_painting_batch(batch, size=size, dapi_only=True),
             batch, max_objects, "fg", reduction_strategy,
+        )
+    if config == "dl":
+        import os
+
+        return _jterator_sweep_workload(
+            dl_description(weights=os.environ.get("BENCH_DL_WEIGHTS",
+                                                  "seed:0")),
+            synthetic_cell_painting_batch(batch, size=size, dapi_only=True),
+            batch, max_objects, "cells", reduction_strategy,
         )
     if config == "4":
         return _jterator_sweep_workload(

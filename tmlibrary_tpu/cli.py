@@ -21,6 +21,7 @@ Here the per-step scripts fold into one ``tmx`` entry point::
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -268,6 +269,37 @@ def build_parser() -> argparse.ArgumentParser:
                       help="reference staleness budget in hours (default "
                            "TMX_QC_STALE_HOURS, 0 = no staleness check — "
                            "committed baselines age by design)")
+    p_qc.add_argument("--profile-kind", choices=("run", "model"),
+                      default="run", dest="profile_kind",
+                      help="what to compare: 'run' = acquisition + "
+                           "feature drift (the default); 'model' = only "
+                           "the __model__.* sketches (DL flow-magnitude/"
+                           "probability streams) vs the committed "
+                           "checkpoint baseline (default reference "
+                           "TMX_QC_DL_BASELINE env, then "
+                           "tuning/QC_DL_BASELINE.json) — the model "
+                           "deploy gate")
+
+    p_weights = sub.add_parser(
+        "weights",
+        help="DL segmentation checkpoints (tmlibrary_tpu.nn): list the "
+             "weights directory or digest a weight spec",
+    )
+    w_sub = p_weights.add_subparsers(dest="verb", required=True)
+    p_wl = w_sub.add_parser(
+        "list", help="inventory of the weights directory "
+                     "(TMX_WEIGHTS_DIR) with content digests")
+    p_wl.add_argument("--dir", default=None,
+                      help="weights directory (default TMX_WEIGHTS_DIR)")
+    p_wl.add_argument("--json", action="store_true", dest="as_json")
+    p_wd = w_sub.add_parser(
+        "digest", help="resolve a weight spec (name, path or seed:N) and "
+                       "print its content digest — the identity the "
+                       "compiled-program cache and the bench sentinel "
+                       "key on")
+    p_wd.add_argument("spec", help="checkpoint name, .npz path, or "
+                                   "seed:N[:base=C][:depth=D]")
+    p_wd.add_argument("--json", action="store_true", dest="as_json")
 
     p_wf = sub.add_parser("workflow", help="full workflow orchestration")
     wf_sub = p_wf.add_subparsers(dest="verb", required=True)
@@ -1667,10 +1699,25 @@ def cmd_qc(args) -> int:
               "TMX_QC=1) to collect it", file=sys.stderr)
         return 1
 
-    ref_path = args.reference or os.environ.get("TMX_QC_BASELINE")
-    if not ref_path and Path("tuning/QC_BASELINE.json").exists():
-        ref_path = "tuning/QC_BASELINE.json"
+    kind = getattr(args, "profile_kind", "run")
+    if kind == "model":
+        # the model deploy gate: only the __model__.* sketches count,
+        # judged against the committed checkpoint baseline
+        ref_path = args.reference or os.environ.get("TMX_QC_DL_BASELINE")
+        if not ref_path and Path("tuning/QC_DL_BASELINE.json").exists():
+            ref_path = "tuning/QC_DL_BASELINE.json"
+        if not qc_mod.filter_profile_kind(profile, "model").get("features"):
+            print("no model-output sketches in this run's profile — the "
+                  "pipeline has no DL modules or ran without --qc",
+                  file=sys.stderr)
+            return 1
+    else:
+        ref_path = args.reference or os.environ.get("TMX_QC_BASELINE")
+        if not ref_path and Path("tuning/QC_BASELINE.json").exists():
+            ref_path = "tuning/QC_BASELINE.json"
+    profile = qc_mod.filter_profile_kind(profile, kind)
     reference = qc_mod.load_profile(Path(ref_path)) if ref_path else None
+    reference = qc_mod.filter_profile_kind(reference, kind)
     verdict = qc_mod.compare_profiles(
         profile, reference, threshold=args.threshold,
         stale_hours=args.stale_hours,
@@ -1705,6 +1752,14 @@ def cmd_qc(args) -> int:
             if bg.get("mean") is not None:
                 bits.append(f"background {bg['mean']:.1f}")
             print("  ".join(bits))
+    if kind == "model":
+        feats = profile.get("features") or {}
+        if feats:
+            print("model output sketches:")
+            for name, s in sorted(feats.items()):
+                print(f"  {name:<28} n {int(s.get('count') or 0):>8}  "
+                      f"p50 {float(s.get('p50') or 0.0):.4g}  "
+                      f"p95 {float(s.get('p95') or 0.0):.4g}")
     guards = profile.get("guards") or {}
     nan_cols = guards.get("nan_columns") or []
     line = (f"guards: nan columns {len(nan_cols)}  nan/inf values "
@@ -1756,6 +1811,41 @@ def cmd_qc(args) -> int:
             print(f"  DRIFT channel {d['channel']}: saturation max "
                   f"{d['reference_max']:.2%} -> {d['current_max']:.2%}")
     return verdict["exit_code"]
+
+
+def cmd_weights(args) -> int:
+    """DL checkpoint inventory / digests (``tmlibrary_tpu.nn``).
+
+    ``tmx weights list`` — one row per ``.npz`` in the weights
+    directory; ``tmx weights digest SPEC`` — resolve any weight spec
+    (named checkpoint, path, or ``seed:N``) and print the content
+    digest that keys the compiled-program cache and the bench
+    sentinel's provenance."""
+    from tmlibrary_tpu import nn
+
+    if args.verb == "list":
+        rows = nn.list_weights(args.dir)
+        if getattr(args, "as_json", False):
+            print(json.dumps(rows, indent=2, default=str))
+            return 0
+        if not rows:
+            print(f"no checkpoints in {args.dir or nn.weights_dir()}")
+            return 0
+        print(f"{'name':<24} {'digest':<14} {'arrays':>7} {'params':>10}")
+        for r in rows:
+            print(f"{r['name']:<24} {r['digest']:<14} "
+                  f"{r['n_arrays']:>7} {r['n_params']:>10}")
+        return 0
+    # digest
+    _params, digest, config = nn.resolve_weights(args.spec)
+    if getattr(args, "as_json", False):
+        print(json.dumps({"spec": args.spec, "digest": digest,
+                          "config": dataclasses.asdict(config)}))
+        return 0
+    print(f"{args.spec}  digest {digest}  "
+          f"(in={config.in_channels}, base={config.base_channels}, "
+          f"depth={config.depth})")
+    return 0
 
 
 def _snapshot_gauge(snapshot: dict, name: str) -> "float | None":
@@ -2060,6 +2150,8 @@ def main(argv=None) -> int:
             return cmd_slo(args)
         if args.command == "qc":
             return cmd_qc(args)
+        if args.command == "weights":
+            return cmd_weights(args)
         if args.command == "perf":
             return cmd_perf(args)
         return cmd_step(args)

@@ -715,3 +715,98 @@ def detect_blobs(
         max_objects=max_objects,
     )
     return {"objects": blobs, "centers": centers}
+
+
+#: reserved output-key prefix for module-diagnostic QC streams: outputs
+#: named ``__qc__<stat>`` are NOT pipeline handles — ``build_site_fn``
+#: collects them (QC-enabled builds only) and the qc session sketches
+#: them under the ``__model__`` pseudo-objects, giving model-output
+#: drift detection (``tmx qc --profile-kind model``) a zero-copy ride on
+#: the batch program.  QC-off builds ignore the keys, so XLA dead-code
+#: eliminates the stats and the label outputs stay bit-identical.
+MODULE_QC_PREFIX = "__qc__"
+
+
+def _qc_sample(values, k: int = 64):
+    """Deterministic fixed-size sample of a stat image for the QC
+    sketches: ``k`` evenly-strided pixels in scan order (static gather —
+    no data-dependent shapes, no randomness)."""
+    flat = jnp.ravel(jnp.asarray(values, jnp.float32))
+    n = flat.shape[0]
+    idx = (jnp.arange(k, dtype=jnp.int32) * (n // k)) % n
+    return flat[idx]
+
+
+@register_module("segment_dl_primary")
+def segment_dl_primary(
+    intensity_image,
+    weights: str = "seed:0",
+    prob_threshold: float = 0.5,
+    flow_steps: int = 24,
+    min_seed_hits: int = 2,
+    min_area: int = 0,
+    max_objects: int = 256,
+):
+    """Deep-learning primary segmentation (nuclei): the pure-JAX
+    flow-field U-Net + deterministic decoder (``tmlibrary_tpu.nn``,
+    DESIGN.md §23).
+
+    ``weights`` is a checkpoint spec (``nn/weights.py``): a named
+    ``.npz`` in the weights directory, an explicit path, or
+    ``seed:<n>[:base=C][:depth=D]`` for deterministic random weights.
+    The parameters resolve at trace time and close over the program as
+    resident constants — donation-safe (only the image arguments are
+    donated) — while their content digest joins the compiled-program
+    cache key via ``pipeline.program_digest_extras``.
+    """
+    from tmlibrary_tpu import nn
+
+    params, _digest, config = nn.resolve_weights(weights)
+    img = nn.normalize_image(intensity_image)
+    head = nn.unet_apply(params, img, config)
+    flow = head[..., :2]
+    cellprob = jax.nn.sigmoid(head[..., 2])
+    labels, _count = nn.decode_flows(
+        flow,
+        cellprob,
+        prob_threshold=prob_threshold,
+        flow_steps=flow_steps,
+        min_seed_hits=min_seed_hits,
+        min_area=min_area,
+        max_objects=max_objects,
+    )
+    flow_mag = jnp.sqrt(flow[..., 0] ** 2 + flow[..., 1] ** 2)
+    return {
+        "objects": labels,
+        f"{MODULE_QC_PREFIX}flow_mag": _qc_sample(flow_mag),
+        f"{MODULE_QC_PREFIX}cell_prob": _qc_sample(cellprob),
+    }
+
+
+@register_module("segment_dl_secondary")
+def segment_dl_secondary(
+    primary_label_image,
+    intensity_image,
+    weights: str = "seed:0",
+    prob_threshold: float = 0.5,
+    max_objects: int = 256,
+):
+    """Deep-learning secondary segmentation: grow primary objects across
+    the U-Net's cell-probability foreground (``nn.decode_secondary``),
+    keeping primary label ids so feature rows stay aligned."""
+    from tmlibrary_tpu import nn
+
+    params, _digest, config = nn.resolve_weights(weights)
+    img = nn.normalize_image(intensity_image)
+    head = nn.unet_apply(params, img, config)
+    cellprob = jax.nn.sigmoid(head[..., 2])
+    labels, _count = nn.decode_secondary(
+        primary_label_image,
+        cellprob,
+        prob_threshold=prob_threshold,
+        max_objects=max_objects,
+    )
+    return {
+        "objects": labels,
+        f"{MODULE_QC_PREFIX}cell_prob_secondary": _qc_sample(cellprob),
+    }

@@ -65,6 +65,111 @@ _BATCH_FN_CACHE_MAX = 32
 _WRAPPED_FN_CACHE: dict[tuple, Callable] = {}
 
 
+#: qc-stats pseudo-channel carrying module diagnostic streams (the
+#: ``__qc__*`` outputs modules emit, see ``modules.MODULE_QC_PREFIX``):
+#: the workflow step routes this key into the qc session's feature
+#: sketches instead of the per-channel image aggregates
+MODEL_QC_KEY = "__model__"
+
+#: every env knob that changes what a pipeline trace emits — ONE list,
+#: consumed by ``program_digest_extras`` so no cache-key site can forget
+#: a knob (the latent cache-poisoning class the PR-8 QC-gate bug
+#: belonged to)
+_PROGRAM_ENV_KNOBS = (
+    "TMX_PALLAS",        # per-kernel Pallas override
+    "TMX_NATIVE",        # CPU native-helper kill switch
+    "TMX_SITE_STATS",    # measure-kernel gate
+    "TMX_PALLAS_CHUNK",  # Pallas label-kernel chunking
+    "TMX_FUSED_CHUNK",   # fused measure-megakernel chunking
+)
+
+
+def weight_digests(
+    description: PipelineDescription,
+) -> tuple[tuple[str, str, str], ...]:
+    """``(module, weights-spec, content-digest)`` for every module in
+    ``description`` that binds a ``weights`` constant (the DL segmenters;
+    any future model-backed module rides free).  The digest is resolved
+    through ``nn/weights.py`` — file-backed checkpoints re-digest when
+    the file changes."""
+    out = []
+    for mod in description.modules:
+        spec = dict(mod.constants()).get("weights")
+        if isinstance(spec, str) and spec:
+            from tmlibrary_tpu.nn import weights as nn_weights
+
+            out.append((mod.module, spec, nn_weights.weights_digest(spec)))
+    return tuple(out)
+
+
+def _model_sub_costs(digests: tuple) -> "Callable | None":
+    """Analytic roofline rungs for a description's conv forwards, one
+    per model-backed module, costed at the actual call geometry (the
+    ``sub_costs`` hook of :func:`perf.instrument_batch_fn`).
+
+    The whole-program XLA readout averages the U-Net's MXU work into
+    the decoder's integer gather/scatter traffic and calls the program
+    memory-bound; the conv sub-program's own arithmetic intensity
+    (analytic FLOPs over algorithmic-minimum HBM bytes, activations
+    on-chip) is what lands above the ridge — the ``bound_by="compute"``
+    rung the perf profile reports for dl pipelines."""
+    if not digests:
+        return None
+
+    def compute(args, kwargs):
+        from tmlibrary_tpu import nn, perf
+
+        raw = args[0] if args else kwargs.get("raw_images", {})
+        shapes = [
+            tuple(v.shape) for v in raw.values()
+            if hasattr(v, "shape") and len(v.shape) >= 2
+        ]
+        if not shapes:
+            return []
+        batch = shapes[0][0] if len(shapes[0]) >= 3 else 1
+        h, w = shapes[0][-2], shapes[0][-1]
+        out = []
+        for mod_name, spec, wdigest in digests:
+            _, _, net_cfg = nn.resolve_weights(spec)
+            out.append((
+                f"unet[{mod_name}@{wdigest}]",
+                perf.ProgramCost(
+                    float(batch * nn.unet_flops(net_cfg, h, w)),
+                    float(batch * nn.unet_io_bytes(net_cfg, h, w)),
+                ),
+            ))
+        return out
+
+    return compute
+
+
+def program_digest_extras(
+    description: PipelineDescription | None = None, qc: bool = False
+) -> tuple:
+    """Every gate beyond (description, capacity, window, backend,
+    donation, strategy) that must split the compiled-program identity —
+    the QC-shape gate, the trace-shaping env knobs, and the content
+    digests of any model weights the description binds.
+
+    ONE registration point, used verbatim by both the
+    ``cached_batch_fn`` cache key and the perf program digest: the PR-8
+    QC-gate bug happened because a new gate joined the key but not the
+    digest, and the weight digests would have been the third copy of
+    that mistake.  New gates are appended here and nowhere else.
+    """
+    import os
+
+    extras: tuple = (("qc", bool(qc)),)
+    extras += tuple(
+        (knob, os.environ.get(knob)) for knob in _PROGRAM_ENV_KNOBS
+    )
+    if description is not None:
+        digests = weight_digests(description)
+        if digests:
+            extras += (("weights", digests),)
+    return extras
+
+
 def _description_cache_key(description: PipelineDescription) -> str:
     import json
 
@@ -115,9 +220,12 @@ def cached_batch_fn(
     never reuses a program compiled for a different strategy;
     ``qc=None`` resolves :func:`tmlibrary_tpu.qc.enabled` — the gate is
     part of the cache key because a QC-on program returns
-    ``(SiteResult, qc_stats)`` instead of a bare ``SiteResult``."""
-    import os
+    ``(SiteResult, qc_stats)`` instead of a bare ``SiteResult``.
 
+    Everything else that shapes the trace — the QC gate, the
+    trace-shaping env knobs, the content digests of any model weights —
+    joins the key as one :func:`program_digest_extras` tuple, the same
+    tuple the perf program digest hashes."""
     from tmlibrary_tpu.ops import reduction
     from tmlibrary_tpu import qc as qc_mod
 
@@ -128,6 +236,7 @@ def cached_batch_fn(
         else reduction.requested_reduction_strategy()
     )
     qc = qc_mod.enabled() if qc is None else bool(qc)
+    extras = program_digest_extras(description, qc=qc)
     key = (
         _description_cache_key(description),
         max_objects,
@@ -135,12 +244,7 @@ def cached_batch_fn(
         jax.default_backend(),
         donate,
         requested,
-        qc,
-        os.environ.get("TMX_PALLAS"),
-        os.environ.get("TMX_NATIVE"),
-        os.environ.get("TMX_SITE_STATS"),
-        os.environ.get("TMX_PALLAS_CHUNK"),
-        os.environ.get("TMX_FUSED_CHUNK"),
+        extras,
     )
     fn = _BATCH_FN_CACHE.get(key)
     if fn is None:
@@ -169,13 +273,15 @@ def cached_batch_fn(
     if wrapped is None or wrapped.__wrapped__ is not fn:
         # the digest names the perf-attribution program, which keys the
         # AOT executable cache in perf._RUNTIME together with (step,
-        # capacity, strategy) — the QC gate MUST join it, because QC-on
-        # and QC-off programs share description/window/shapes but return
-        # different pytrees, and a stale executable from the other gate
-        # would silently drop (or fabricate) the qc_stats leaf
+        # capacity, strategy) — every program_digest_extras gate MUST
+        # join it: QC-on and QC-off programs share description/window/
+        # shapes but return different pytrees, and two checkpoints of
+        # the same weights name share the whole description, so a stale
+        # executable from the other gate would silently drop the
+        # qc_stats leaf or run the old model
         digest = hashlib.sha1(
             repr(key[0]).encode() + repr(window).encode()
-            + (b"+qc" if qc else b"")
+            + repr(extras).encode()
         ).hexdigest()[:8]
         wrapped = perf.instrument_batch_fn(
             fn,
@@ -183,6 +289,7 @@ def cached_batch_fn(
             step="jterator",
             capacity=max_objects,
             strategy=requested or "default",
+            sub_costs=_model_sub_costs(weight_digests(description)),
         )
         while len(_WRAPPED_FN_CACHE) >= _BATCH_FN_CACHE_MAX:
             _WRAPPED_FN_CACHE.pop(next(iter(_WRAPPED_FN_CACHE)))
@@ -222,8 +329,18 @@ class ImageAnalysisPipeline:
         self._site_fn: Callable | None = None
 
     # ------------------------------------------------------------- site fn
-    def build_site_fn(self) -> Callable[[dict[str, jax.Array]], SiteResult]:
-        """Pure function: {store key: (H, W) array} → :class:`SiteResult`."""
+    def build_site_fn(
+        self, collect_diagnostics: bool = False
+    ) -> Callable[[dict[str, jax.Array]], SiteResult]:
+        """Pure function: {store key: (H, W) array} → :class:`SiteResult`.
+
+        ``collect_diagnostics=True`` (the QC-enabled batch build)
+        additionally gathers module outputs named with the reserved
+        ``__qc__`` prefix (``modules.MODULE_QC_PREFIX`` — model-output
+        stat streams from the DL segmenters) and returns
+        ``(SiteResult, {stat: array})``.  The default build drops the
+        keys unread, so XLA dead-code eliminates the diagnostic math and
+        the pipeline outputs stay bit-identical either way."""
         desc = self.description
         max_objects = self.max_objects
 
@@ -231,6 +348,7 @@ class ImageAnalysisPipeline:
             store: dict[str, Any] = dict(initial_store)
             objects: dict[str, jax.Array] = {}
             measurements: dict[str, dict[str, jax.Array]] = {}
+            diagnostics: dict[str, jax.Array] = {}
 
             for mod in desc.modules:
                 fn = module_registry.get_module(mod.module, mod.backend)
@@ -263,6 +381,13 @@ class ImageAnalysisPipeline:
                     raise PipelineError(
                         f"module '{mod.module}' must return a dict of outputs"
                     )
+                if collect_diagnostics:
+                    prefix = module_registry.MODULE_QC_PREFIX
+                    for k, v in outs.items():
+                        if k.startswith(prefix):
+                            diagnostics[k[len(prefix):]] = jnp.asarray(
+                                v, jnp.float32
+                            )
 
                 for h in mod.output:
                     if h.type in ("Plot", "Figure"):
@@ -295,13 +420,16 @@ class ImageAnalysisPipeline:
                 name: jnp.max(lab).astype(jnp.int32) for name, lab in objects.items()
             }
             wanted = {o.name for o in desc.objects_out} or set(objects)
-            return SiteResult(
+            result = SiteResult(
                 objects={k: v for k, v in objects.items() if k in wanted},
                 counts={k: v for k, v in counts.items() if k in wanted},
                 measurements={
                     k: v for k, v in measurements.items() if k in wanted
                 },
             )
+            if collect_diagnostics:
+                return result, diagnostics
+            return result
 
         return site_fn
 
@@ -386,8 +514,11 @@ class ImageAnalysisPipeline:
         statistics (``tmlibrary_tpu.ops.qc``) from the RAW channel
         images — before correction/alignment, so the stats describe the
         acquisition, not the preprocessing — and the function returns
-        ``(SiteResult, {channel: {metric: (B,) array}})``.  The QC
-        branch only *reads* ``raw``; the pipeline dataflow is untouched,
+        ``(SiteResult, {channel: {metric: (B,) array}})``.  Module
+        diagnostic streams (``__qc__*`` outputs, e.g. the DL segmenters'
+        flow-magnitude/probability samples) join the stats dict under
+        the reserved ``MODEL_QC_KEY`` pseudo-channel.  The QC branch
+        only *reads* the pipeline's arrays; the dataflow is untouched,
         which is what keeps outputs bit-identical with QC on/off.
         """
         from tmlibrary_tpu.ops import reduction
@@ -397,7 +528,7 @@ class ImageAnalysisPipeline:
             if reduction_strategy not in (None, "auto")
             else reduction.requested_reduction_strategy()
         )
-        site_fn = self.build_site_fn()
+        site_fn = self.build_site_fn(collect_diagnostics=qc)
         preprocess = self.build_preprocess_fn(window)
         desc = self.description
 
@@ -412,15 +543,20 @@ class ImageAnalysisPipeline:
                         if window is not None and jnp.ndim(val) == 2:
                             val = image_ops.crop_window(val, *window)
                         images[key] = val
-                result = site_fn(images)
                 if not qc:
-                    return result
+                    return site_fn(images)
+                result, diagnostics = site_fn(images)
                 from tmlibrary_tpu.ops import qc as qc_ops
 
                 qc_stats = {
                     ch.name: qc_ops.site_qc_stats(raw[ch.name])
                     for ch in desc.channels
                 }
+                if diagnostics:
+                    # module diagnostic streams (model-output stats) ride
+                    # the qc pytree under a reserved pseudo-channel; the
+                    # persist path routes them into the feature sketches
+                    qc_stats[MODEL_QC_KEY] = diagnostics
                 return result, qc_stats
 
         batched = jax.vmap(one_site, in_axes=(0, None, 0))

@@ -353,6 +353,29 @@ class ExperimentStore:
             raise StoreError("intersection window missing")
         return json.loads(path.read_text())
 
+    # --------------------------------------------------------------- weights
+    @property
+    def weights_dir(self) -> Path:
+        """Experiment-local model checkpoints (``nn/weights.py`` ``.npz``
+        pytrees).  A pipeline references one by path in its ``weights``
+        constant; the content digest — not the path — keys the
+        compiled-program cache, so copying a checkpoint between
+        experiments never splits the cache."""
+        d = self.root / "weights"
+        d.mkdir(exist_ok=True)
+        return d
+
+    def stage_weights(self, name: str, params: Mapping[str, np.ndarray],
+                      meta: Mapping | None = None) -> Path:
+        """Save a model checkpoint into the experiment and return its
+        ``.npz`` path (usable directly as a module's ``weights`` spec)."""
+        from tmlibrary_tpu.nn import weights as nn_weights
+
+        return nn_weights.save_weights(
+            name, dict(params), meta=dict(meta) if meta else None,
+            directory=self.weights_dir,
+        )
+
     # --------------------------------------------------------------- ledger
     @property
     def workflow_dir(self) -> Path:

@@ -1,0 +1,36 @@
+"""Deep-learning segmentation subsystem (DESIGN.md §23).
+
+A pure-JAX Cellpose-style segmenter packaged as first-class jterator
+machinery: a small flow-field U-Net (``nn/unet.py``), a deterministic
+flow→label decoder built on ``ops/label.py`` (``nn/decode.py``) and a
+named ``.npz`` checkpoint store with content digests (``nn/weights.py``).
+The jterator modules ``segment_dl_primary`` / ``segment_dl_secondary``
+(``jterator/modules.py``) wire it through the batched production path —
+compiled-program cache, capacity buckets, pipelined execution, QC,
+perf roofline — with no special cases.
+"""
+
+from tmlibrary_tpu.nn.decode import (  # noqa: F401
+    decode_flows,
+    decode_secondary,
+    follow_flows,
+)
+from tmlibrary_tpu.nn.unet import (  # noqa: F401
+    OUT_CHANNELS,
+    UNetConfig,
+    infer_config,
+    init_unet_params,
+    normalize_image,
+    unet_apply,
+    unet_flops,
+    unet_io_bytes,
+)
+from tmlibrary_tpu.nn.weights import (  # noqa: F401
+    list_weights,
+    load_weights,
+    params_digest,
+    resolve_weights,
+    save_weights,
+    weights_digest,
+    weights_dir,
+)

@@ -1,0 +1,150 @@
+"""Deterministic flow-field → label-image decoder.
+
+Cellpose recovers instances from its flow head by following each
+pixel's flow to a fixpoint (a cell center) and grouping pixels that
+converge together.  This decoder keeps that structure but restricts
+every step to integer, order-independent primitives so the output obeys
+the repo's bit-identity contracts (bucket ladder, pipeline depth,
+QC on/off — DESIGN.md §15):
+
+1. foreground mask: ``cellprob >= prob_threshold``;
+2. flow following on the **integer grid**: every pixel carries an
+   (y, x) index pair that moves one pixel per step in the sign of the
+   local flow (``lax.fori_loop``, fixed trip count) — no bilinear
+   interpolation, no float position accumulation;
+3. sink detection: an int32 scatter-add histogram of final positions
+   (integer adds commute, so duplicate-index order cannot matter);
+   pixels where at least ``min_seed_hits`` trajectories terminate
+   become seeds;
+4. seed grouping + label assignment through ``ops/label.py``:
+   ``connected_components`` over the seed mask (scipy scan-order ids),
+   then every masked pixel inherits its sink's component by gather;
+5. capacity-INDEPENDENT cleanup: the area filter and the id compaction
+   index tables sized by the site geometry (``h*w``), never by the
+   routed object capacity — the raw seed-component count routinely
+   exceeds the bucket (noise seeds the area filter is about to drop),
+   and any capacity-sized table before the final clip would make the
+   decoded labels depend on the bucket choice;
+6. the bucket clip LAST: by the router's contract a bucket holds the
+   observed (post-filter) count, so the clip is pure padding discipline
+   — any two capacities that both hold a site's count yield
+   byte-identical labels, which is what lets ``segment_dl_*`` ride the
+   bucket router unchanged (DESIGN.md §15).
+
+The flow field only steers **where** trajectories go; all grouping
+arithmetic is int32.  Given identical flow/probability inputs the
+decoder is exact on every backend (the Pallas/native/XLA connected-
+components variants are already pinned label-identical by
+``tests/test_label.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tmlibrary_tpu.ops import label as label_ops
+
+
+def follow_flows(flow: jax.Array, n_steps: int = 24) -> tuple[jax.Array, jax.Array]:
+    """Integer flow following: returns ``(yy, xx)`` int32 index maps of
+    every pixel's position after ``n_steps`` unit steps along the sign
+    of the local flow (clipped to the image)."""
+    flow = jnp.asarray(flow, jnp.float32)
+    h, w = flow.shape[0], flow.shape[1]
+    fy, fx = flow[..., 0], flow[..., 1]
+    yy0, xx0 = jnp.meshgrid(
+        jnp.arange(h, dtype=jnp.int32),
+        jnp.arange(w, dtype=jnp.int32),
+        indexing="ij",
+    )
+
+    def step(_, carry):
+        yy, xx = carry
+        dy = jnp.sign(fy[yy, xx]).astype(jnp.int32)
+        dx = jnp.sign(fx[yy, xx]).astype(jnp.int32)
+        yy = jnp.clip(yy + dy, 0, h - 1)
+        xx = jnp.clip(xx + dx, 0, w - 1)
+        return yy, xx
+
+    return lax.fori_loop(0, n_steps, step, (yy0, xx0))
+
+
+def decode_flows(
+    flow: jax.Array,
+    cellprob: jax.Array,
+    prob_threshold: float = 0.5,
+    flow_steps: int = 24,
+    min_seed_hits: int = 2,
+    connectivity: int = 8,
+    min_area: int = 0,
+    max_objects: int = 256,
+) -> tuple[jax.Array, jax.Array]:
+    """Flow field + cell probability → ``(labels, count)``.
+
+    ``labels`` is int32 in scipy scan order, padded/clipped to the
+    static ``max_objects`` capacity; ``count`` the scalar object count.
+    """
+    cellprob = jnp.asarray(cellprob, jnp.float32)
+    mask = cellprob >= jnp.float32(prob_threshold)
+    yy, xx = follow_flows(flow, flow_steps)
+
+    hits = jnp.zeros(mask.shape, jnp.int32).at[yy, xx].add(
+        mask.astype(jnp.int32)
+    )
+    seed_mask = hits >= jnp.int32(min_seed_hits)
+    seeds, _ = label_ops.connected_components(
+        seed_mask, connectivity=connectivity
+    )
+    labels = jnp.where(mask, seeds[yy, xx], 0).astype(jnp.int32)
+
+    # Geometry-sized (NOT capacity-sized) per-id tables: scatter-adds of
+    # int32 ones, so every entry is order-independent and the result is
+    # identical under any bucket routing.
+    n_ids = mask.size + 1
+    if min_area > 0:
+        areas = jnp.zeros((n_ids,), jnp.int32).at[labels.ravel()].add(1)
+        labels = jnp.where(areas[labels] >= jnp.int32(min_area), labels, 0)
+    # Compact surviving ids to 1..K.  connected_components assigned seed
+    # ids in scan order and filtering only REMOVES ids, so ranking the
+    # present ids by cumulative count preserves that order without any
+    # capacity-sized argsort.
+    flat = labels.ravel()
+    present = jnp.zeros((n_ids,), jnp.int32).at[flat].max(
+        (flat > 0).astype(jnp.int32)
+    )
+    ranks = jnp.cumsum(present).astype(jnp.int32)
+    labels = jnp.where(labels > 0, ranks[labels], 0)
+    # the routed-capacity clip comes last (see module docstring, step 6)
+    labels = label_ops.clip_label_count(labels, max_objects)
+    count = jnp.max(labels)
+    return labels, count
+
+
+def decode_secondary(
+    primary_labels: jax.Array,
+    cellprob: jax.Array,
+    prob_threshold: float = 0.5,
+    connectivity: int = 8,
+    max_objects: int = 256,
+) -> tuple[jax.Array, jax.Array]:
+    """Grow primary objects (nuclei) across the net's foreground into
+    secondary objects (cells): the DL analogue of ``segment_secondary``.
+
+    The foreground is the union of the probability mask and the primary
+    footprint (a cell always contains its nucleus); label ids are
+    inherited from the primary image via the same deterministic
+    max-neighbor propagation the classical watershed path uses
+    (``ops/segment_secondary.propagate_labels``), so primary/secondary
+    rows stay id-aligned in the feature tables.
+    """
+    from tmlibrary_tpu.ops.segment_secondary import propagate_labels
+
+    primary = jnp.asarray(primary_labels, jnp.int32)
+    cellprob = jnp.asarray(cellprob, jnp.float32)
+    mask = (cellprob >= jnp.float32(prob_threshold)) | (primary > 0)
+    labels = propagate_labels(primary, mask, connectivity=connectivity)
+    labels = label_ops.clip_label_count(labels, max_objects)
+    count = jnp.max(labels)
+    return labels.astype(jnp.int32), count

@@ -1,0 +1,222 @@
+"""Pure-JAX flow-field U-Net (the Cellpose-style segmenter's conv-net).
+
+The net is deliberately small and entirely ``lax.conv_general_dilated``
+— no framework, no mutable state, no dropout/batch-norm: parameters are
+a flat ``{name: (kh, kw, cin, cout) | (cout,)}`` dict (an ``.npz``-able
+pytree, see ``nn/weights.py``) and the forward pass is a pure function
+of (params, image), so it traces straight into the jterator batch
+program like any other op.  Output is Cellpose's head: per-pixel flow
+field (dy, dx) pointing toward each cell's center plus a cell-probability
+logit — three ``float32`` channels decoded into an int32 label image by
+``nn/decode.py``.
+
+Why this is the MXU workload (ROADMAP item 4): every conv lowers to MXU
+matmuls with arithmetic intensity ``~cin·cout·18/(4(cin+cout))`` FLOPs
+per byte of activation traffic — past ``base_channels≈32`` the bulk of
+the program sits above the v5e ridge (~241 FLOPs/byte, ``perf.py``)
+where the classical threshold+watershed chain (pure VPU, measured MFU
+0.000246) never goes.
+
+Architecture (``depth`` downsamplings, channels double per level)::
+
+    enc0:  conv3x3(in→C) · conv3x3(C→C)              — skip s0
+    lvl i: conv3x3 stride2(c→2c) · conv3x3 ·  conv3x3 — skip s_i
+    dec i: upsample×2 · conv3x3(2c→c) · concat(s_{i-1}) · conv3x3(2c→c)
+    head:  conv1x1(C→3)   → (flow_dy, flow_dx, cellprob_logit)
+
+Inputs pad (edge mode) to a multiple of ``2**depth`` and crop back, so
+any site geometry runs; all math is float32 for cross-capacity /
+cross-depth bit-identity of the decoded labels (the bucket router's
+contract, DESIGN.md §15).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+#: output channels of the head: (flow_dy, flow_dx, cellprob_logit)
+OUT_CHANNELS = 3
+
+_DIMENSION_NUMBERS = ("NHWC", "HWIO", "NHWC")
+
+
+@dataclasses.dataclass(frozen=True)
+class UNetConfig:
+    """Static architecture hyperparameters (trace-time constants)."""
+
+    in_channels: int = 1
+    base_channels: int = 8
+    depth: int = 2
+
+    def level_channels(self, level: int) -> int:
+        return self.base_channels * (1 << level)
+
+
+def infer_config(params: dict) -> UNetConfig:
+    """Recover the architecture from a parameter pytree's shapes — the
+    checkpoint IS the config, so callers never pass a mismatched pair."""
+    w0 = np.asarray(params["enc0/conv1/w"])
+    depth = 0
+    while f"down{depth + 1}/w" in params:
+        depth += 1
+    return UNetConfig(
+        in_channels=int(w0.shape[2]),
+        base_channels=int(w0.shape[3]),
+        depth=depth,
+    )
+
+
+def _he_std(kh: int, kw: int, cin: int) -> float:
+    return float(np.sqrt(2.0 / (kh * kw * cin)))
+
+
+def init_unet_params(
+    seed: int, config: UNetConfig | None = None
+) -> dict[str, np.ndarray]:
+    """Deterministic He-normal initialization as host numpy float32.
+
+    Host-side ``np.random.default_rng`` rather than a traced JAX PRNG:
+    the same (seed, config) must yield byte-identical parameters on
+    every backend and JAX version, because the weight content digest
+    (``nn/weights.py``) keys the compiled-program cache.
+    """
+    cfg = config or UNetConfig()
+    rng = np.random.default_rng(seed)
+    params: dict[str, np.ndarray] = {}
+
+    def conv(name: str, kh: int, kw: int, cin: int, cout: int) -> None:
+        params[f"{name}/w"] = rng.normal(
+            0.0, _he_std(kh, kw, cin), size=(kh, kw, cin, cout)
+        ).astype(np.float32)
+        params[f"{name}/b"] = np.zeros((cout,), np.float32)
+
+    c = cfg.base_channels
+    conv("enc0/conv1", 3, 3, cfg.in_channels, c)
+    conv("enc0/conv2", 3, 3, c, c)
+    for i in range(1, cfg.depth + 1):
+        conv(f"down{i}", 3, 3, c, 2 * c)
+        c *= 2
+        conv(f"enc{i}/conv1", 3, 3, c, c)
+        conv(f"enc{i}/conv2", 3, 3, c, c)
+    for i in range(cfg.depth, 0, -1):
+        conv(f"up{i}", 3, 3, c, c // 2)
+        c //= 2
+        conv(f"dec{i}", 3, 3, 2 * c, c)
+    conv("head", 1, 1, c, OUT_CHANNELS)
+    return params
+
+
+def _conv(x: jax.Array, params: dict, name: str, stride: int = 1) -> jax.Array:
+    w = jnp.asarray(params[f"{name}/w"], jnp.float32)
+    b = jnp.asarray(params[f"{name}/b"], jnp.float32)
+    y = lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME", dimension_numbers=_DIMENSION_NUMBERS
+    )
+    return y + b
+
+
+def _upsample2(x: jax.Array) -> jax.Array:
+    """Nearest-neighbor ×2 — integer pixel duplication, so the upsample
+    contributes nothing float-order-dependent to the decoded labels."""
+    return jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+
+
+def unet_apply(
+    params: dict, image: jax.Array, config: UNetConfig | None = None
+) -> jax.Array:
+    """Forward pass: (H, W) or (H, W, C) image → (H, W, 3) float32
+    ``(flow_dy, flow_dx, cellprob_logit)``.  Pure; safe under jit/vmap
+    with ``params`` closed over as resident constants."""
+    cfg = config or infer_config(params)
+    x = jnp.asarray(image, jnp.float32)
+    if x.ndim == 2:
+        x = x[..., None]
+    h, w = x.shape[0], x.shape[1]
+    mult = 1 << cfg.depth
+    ph, pw = (-h) % mult, (-w) % mult
+    if ph or pw:
+        x = jnp.pad(x, ((0, ph), (0, pw), (0, 0)), mode="edge")
+    x = x[None]  # (1, H', W', C)
+
+    skips = []
+    x = jax.nn.relu(_conv(x, params, "enc0/conv1"))
+    x = jax.nn.relu(_conv(x, params, "enc0/conv2"))
+    for i in range(1, cfg.depth + 1):
+        skips.append(x)
+        x = jax.nn.relu(_conv(x, params, f"down{i}", stride=2))
+        x = jax.nn.relu(_conv(x, params, f"enc{i}/conv1"))
+        x = jax.nn.relu(_conv(x, params, f"enc{i}/conv2"))
+    for i in range(cfg.depth, 0, -1):
+        x = _upsample2(x)
+        x = jax.nn.relu(_conv(x, params, f"up{i}"))
+        x = jnp.concatenate([x, skips[i - 1]], axis=-1)
+        x = jax.nn.relu(_conv(x, params, f"dec{i}"))
+    y = _conv(x, params, "head")
+    return y[0, :h, :w, :]
+
+
+def normalize_image(image: jax.Array) -> jax.Array:
+    """Per-site standardization (zero mean, unit variance) — the only
+    input conditioning the net sees, so illumination-corrected and raw
+    sites land on the same input scale."""
+    img = jnp.asarray(image, jnp.float32)
+    mean = jnp.mean(img)
+    std = jnp.std(img)
+    return (img - mean) / (std + 1e-6)
+
+
+# --------------------------------------------------------------- cost model
+def unet_flops(config: UNetConfig, h: int, w: int) -> int:
+    """Analytic forward-pass FLOPs (2·kh·kw·cin·cout MACs per output
+    pixel, summed over every conv at its level's resolution)."""
+    mult = 1 << config.depth
+    h = h + ((-h) % mult)
+    w = w + ((-w) % mult)
+    total = 0
+
+    def conv(pixels: int, kh: int, kw: int, cin: int, cout: int) -> int:
+        return 2 * pixels * kh * kw * cin * cout
+
+    c = config.base_channels
+    px = h * w
+    total += conv(px, 3, 3, config.in_channels, c)
+    total += conv(px, 3, 3, c, c)
+    for _ in range(config.depth):
+        px //= 4
+        total += conv(px, 3, 3, c, 2 * c)
+        c *= 2
+        total += 2 * conv(px, 3, 3, c, c)
+    for _ in range(config.depth):
+        px *= 4
+        total += conv(px, 3, 3, c, c // 2)
+        c //= 2
+        total += conv(px, 3, 3, 2 * c, c)
+    total += conv(px, 1, 1, c, OUT_CHANNELS)
+    return int(total)
+
+
+def unet_io_bytes(config: UNetConfig, h: int, w: int) -> int:
+    """Algorithmic-minimum HBM traffic of one forward pass: read the
+    input once, write the head once, stream the parameters once — the
+    roofline denominator for a fused program whose activations stay
+    on-chip (the standard operational-intensity convention; what the
+    dl bench records as provenance next to the XLA cost model)."""
+    cfg = config
+    n_params = 0
+    c = cfg.base_channels
+    n_params += 3 * 3 * cfg.in_channels * c + c + 3 * 3 * c * c + c
+    for _ in range(cfg.depth):
+        n_params += 3 * 3 * c * 2 * c + 2 * c
+        c *= 2
+        n_params += 2 * (3 * 3 * c * c + c)
+    for _ in range(cfg.depth):
+        n_params += 3 * 3 * c * (c // 2) + c // 2
+        c //= 2
+        n_params += 3 * 3 * 2 * c * c + c
+    n_params += c * OUT_CHANNELS + OUT_CHANNELS
+    return 4 * (h * w * cfg.in_channels + h * w * OUT_CHANNELS + n_params)

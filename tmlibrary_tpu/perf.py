@@ -51,6 +51,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Callable
@@ -341,7 +342,8 @@ def _args_signature(args, kwargs):
 def instrument_batch_fn(fn: Callable, *, program: str,
                         step: str = "jterator",
                         capacity: int | None = None,
-                        strategy: str | None = None) -> Callable:
+                        strategy: str | None = None,
+                        sub_costs: Callable | None = None) -> Callable:
     """Wrap a jitted batch fn with compile/cost attribution.
 
     First call per input signature: ``fn.lower(...).compile()`` timed
@@ -351,7 +353,15 @@ def instrument_batch_fn(fn: Callable, *, program: str,
     Later signatures count as recompiles.  Any AOT failure (backend
     without lower(), layout mismatch, donation quirk) permanently falls
     back to ``fn`` for that signature.  With telemetry disabled the call
-    is a passthrough."""
+    is a passthrough.
+
+    ``sub_costs``: optional ``(args, kwargs) -> [(name, ProgramCost)]``
+    invoked once per new signature; each pair lands as its own roofline
+    rung ``{program}:{name}``.  This is how analytically-costed
+    sub-programs (the dl configs' conv forward, whose arithmetic
+    intensity the whole-program XLA readout averages away under the
+    decoder's integer traffic) get their own ``bound_by`` attribution.
+    A failing callback is swallowed — attribution never breaks the run."""
     key = (program, step, capacity, strategy)
 
     def wrapped(*args, **kwargs):
@@ -359,14 +369,15 @@ def instrument_batch_fn(fn: Callable, *, program: str,
 
         if not telemetry.enabled():
             return fn(*args, **kwargs)
-        return _instrumented_call(fn, key, args, kwargs)
+        return _instrumented_call(fn, key, args, kwargs,
+                                  sub_costs=sub_costs)
 
     wrapped.__wrapped__ = fn
     wrapped.perf_key = key
     return wrapped
 
 
-def _instrumented_call(fn, key, args, kwargs):
+def _instrumented_call(fn, key, args, kwargs, sub_costs=None):
     program, step, capacity, strategy = key
     try:
         sig = _args_signature(args, kwargs)
@@ -404,6 +415,17 @@ def _instrumented_call(fn, key, args, kwargs):
         record_compile(program=program, step=step, capacity=capacity,
                        strategy=strategy, backend=backend,
                        compile_s=compile_s, cost=cost, recompile=recompile)
+        if sub_costs is not None:
+            try:
+                for sub_name, sub_cost in sub_costs(args, kwargs):
+                    record_compile(
+                        program=f"{program}:{sub_name}", step=step,
+                        capacity=capacity, strategy=strategy,
+                        backend=backend, cost=sub_cost,
+                        recompile=recompile,
+                    )
+            except Exception:
+                pass
     if compiled is not None:
         try:
             return compiled(*args, **kwargs)
@@ -525,9 +547,12 @@ def _methodology_class(rec: dict) -> str:
     capture must never be judged against a host-synchronous one (the
     fetch tax makes them different experiments), nor a bucket-routed
     capture against a full-capacity one, nor a fused-megakernel capture
-    against an unfused one (a different measure-family program).
-    Records predating the ``timing_methodology`` field form their own
-    ``legacy`` family so old-vs-old still compares."""
+    against an unfused one (a different measure-family program), nor a
+    model-backed capture (the ``dl`` config) against one that ran a
+    different checkpoint — the ``model=<digest>`` provenance token
+    survives the collapse so the sentinel never compares across
+    checkpoints.  Records predating the ``timing_methodology`` field
+    form their own ``legacy`` family so old-vs-old still compares."""
     m = str(rec.get("timing_methodology") or "")
     if not m:
         return "legacy"
@@ -535,6 +560,9 @@ def _methodology_class(rec: dict) -> str:
         cls = "pipelined+bucketed" if "bucketed" in m else "pipelined"
         if "strategy=fused" in m:
             cls += "+fused"
+        model = re.search(r"model=([0-9a-f]+)", m)
+        if model:
+            cls += f"+model={model.group(1)}"
         return cls
     return m
 

@@ -61,6 +61,15 @@ logger = logging.getLogger(__name__)
 #: qc.json schema version (bump on incompatible layout changes)
 QC_SCHEMA_VERSION = 1
 
+#: pseudo-objects name holding MODEL-OUTPUT diagnostic sketches (the DL
+#: segmenters' flow-magnitude / cell-probability sample streams routed
+#: through ``observe_batch(measurements=...)`` by the jterator persist
+#: path).  Profile features under ``__model__.`` describe the deployed
+#: checkpoint's behavior, not the biology — ``tmx qc --profile-kind
+#: model`` compares exactly these against ``tuning/QC_DL_BASELINE.json``
+#: as the model deploy gate, and run-kind comparisons exclude them.
+MODEL_OBJECTS = "__model__"
+
 # ---- drift-sentinel exit codes (pinned; same discipline as
 # ---- scripts/bench_regression.py / tmlibrary_tpu.perf)
 EXIT_OK = 0            #: profile within threshold of the reference
@@ -793,6 +802,28 @@ def stale_hours_default() -> float:
         return float(os.environ.get("TMX_QC_STALE_HOURS", "0") or 0.0)
     except ValueError:
         return 0.0
+
+
+def filter_profile_kind(profile: dict | None, kind: str) -> dict | None:
+    """Restrict a profile to one comparison kind.
+
+    ``kind="model"`` keeps only the ``__model__.`` feature sketches (and
+    drops channels — image acquisition stats say nothing about the
+    checkpoint); ``kind="run"`` drops them, so a DL run compared against
+    a classical baseline never reads model streams as biology drift.
+    Metadata (timestamps, guards) passes through untouched — staleness
+    judgment still applies to either kind."""
+    if not profile:
+        return profile
+    if kind not in ("run", "model"):
+        raise ValueError(f"unknown profile kind '{kind}'")
+    feats = profile.get("features") or {}
+    prefix = MODEL_OBJECTS + "."
+    if kind == "model":
+        kept = {k: v for k, v in feats.items() if k.startswith(prefix)}
+        return {**profile, "features": kept, "channels": {}}
+    kept = {k: v for k, v in feats.items() if not k.startswith(prefix)}
+    return {**profile, "features": kept}
 
 
 def compare_profiles(current: dict | None, reference: dict | None,

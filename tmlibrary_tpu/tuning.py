@@ -243,3 +243,22 @@ def record_config_sweep(config: str, entry: dict) -> dict:
         path, json.dumps(data, indent=2, sort_keys=True) + "\n"
     )
     return data
+
+
+def config_sweep(config: str, *, model_digest: str | None = None) -> dict | None:
+    """The recorded sweep verdict for ``config``, or None.
+
+    For model-backed configs (bench ``dl``), pass the current weight
+    content digest: a sweep recorded against a DIFFERENT checkpoint is
+    treated as absent rather than served — its depth/strategy/capacity
+    verdicts were measured on different work (PR-8's QC-gate digest
+    lesson, applied to tuning state).  An entry recorded without a
+    digest never matches a digest-constrained read."""
+    tuning = load_tuning()
+    sweeps = tuning.get("config_sweeps") if tuning else None
+    entry = sweeps.get(str(config)) if isinstance(sweeps, dict) else None
+    if not isinstance(entry, dict):
+        return None
+    if model_digest is not None and entry.get("model_digest") != model_digest:
+        return None
+    return entry

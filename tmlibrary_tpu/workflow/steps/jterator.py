@@ -314,7 +314,21 @@ class ImageAnalysisRunner(Step):
                 # process-level cache: a re-built Step (fresh Workflow, engine
                 # re-run, tool request) running the same description reuses
                 # the traced+compiled program instead of re-paying trace+load
-                from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
+                from tmlibrary_tpu.jterator.pipeline import (
+                    cached_batch_fn,
+                    weight_digests,
+                )
+
+                # checkpoint provenance, once per step: the resolved
+                # weight content digests this run's programs compiled
+                # against (the same digests keying the program cache)
+                digests = weight_digests(self._desc)
+                if digests and not getattr(self, "_weights_logged", False):
+                    self._weights_logged = True
+                    logger.info(
+                        "model weights resolved: %s",
+                        "; ".join(f"{m} {s} @{d}" for m, s, d in digests),
+                    )
 
                 self._compiled[cache_key] = cached_batch_fn(
                     self._desc, cap, self._window,
@@ -1284,14 +1298,27 @@ class ImageAnalysisRunner(Step):
             # qc_batch/qc_site ledger events (same thread discipline as
             # straggler records) — flags never fail the batch.
             from tmlibrary_tpu import qc as qc_mod
+            from tmlibrary_tpu.jterator.pipeline import MODEL_QC_KEY
 
             image_stats = {
                 ch: {m: np.asarray(v)[:n_valid] for m, v in metrics.items()}
                 for ch, metrics in qc_dev.items()
             }
+            # model diagnostic streams (DL segmenters' flow-magnitude /
+            # probability samples) ride the qc pytree under a reserved
+            # pseudo-channel; they are value STREAMS, not per-site image
+            # scalars, so they route into the feature sketches (every
+            # sample valid — no counts mask) under the "__model__"
+            # pseudo-objects the model drift profile keys on
+            model_stats = image_stats.pop(MODEL_QC_KEY, None)
+            meas_for_qc = measurements
+            if model_stats:
+                meas_for_qc = {
+                    **measurements, qc_mod.MODEL_OBJECTS: model_stats,
+                }
             qc_summary = qc_mod.get_session().observe_batch(
                 self.name, sites, image_stats=image_stats, counts=counts,
-                measurements=measurements, saturated=bool(saturated),
+                measurements=meas_for_qc, saturated=bool(saturated),
             )
             if qc_summary:
                 summary["qc"] = qc_summary
