@@ -699,12 +699,14 @@ def measure(platform: str) -> None:
     max_objects = int(os.environ.get("BENCH_MAX_OBJECTS", "64"))
 
     if config not in ("2", "3", "4", "dl", "volume", "corilla", "pyramid",
-                      "spatial", "mesh", "ingest", "workflow"):
+                      "spatial", "mesh", "ingest", "workflow", "analytics"):
         raise SystemExit(
             f"BENCH_CONFIG must be '2', '3', '4', 'dl', 'volume', 'corilla', "
-            f"'pyramid', 'spatial', 'mesh', 'ingest' or 'workflow', "
-            f"got '{config}'"
+            f"'pyramid', 'spatial', 'mesh', 'ingest', 'workflow' or "
+            f"'analytics', got '{config}'"
         )
+    if config == "analytics":
+        return measure_analytics()
     if config == "ingest":
         return measure_ingest(size)
     if config == "workflow":
@@ -1429,6 +1431,107 @@ def measure_spatial(size: int) -> None:
     emit_record(record)
 
 
+def measure_analytics() -> None:
+    """``BENCH_CONFIG=analytics``: queries/sec per analytics tool over
+    synthetic object populations at N in {1e4, 1e5} (override with a
+    comma list in ``BENCH_ANALYTICS_N``).  Times the device op each tool
+    dispatches — tiled kNN, randomized-SVD PCA, spectral embedding,
+    integral-image density, k-means — on an already-built standardized
+    matrix, i.e. the per-query compute a warm ``tmx query`` cache miss
+    pays (store mmap + Parquet writes excluded; those are ingest-shaped,
+    not query-shaped).  The record carries its OWN metric, config and a
+    non-``pipelined`` ``timing_methodology`` so ``perf._history_key``
+    can never judge it against a sites/sec capture."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tmlibrary_tpu.analytics import ops
+    from tmlibrary_tpu.analytics import spatial as asp
+    from tmlibrary_tpu.tools.clustering import kmeans
+
+    sizes = [
+        int(s) for s in
+        os.environ.get("BENCH_ANALYTICS_N", "10000,100000").split(",") if s
+    ]
+    n_features = int(os.environ.get("BENCH_ANALYTICS_FEATURES", "32"))
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    # embedding keeps a reduced kNN-graph build at 1e5 affordable by
+    # reusing the same tiled kNN the knn tool runs; k matches the tool
+    # defaults so the number answers "what does one default query cost"
+    tool_params = {"knn_k": 10, "embedding_k": 15, "kmeans_k": 5}
+
+    per_tool: dict = {}
+    for n in sizes:
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, n_features)).astype(np.float32)
+        site_index = rng.integers(0, 64, size=n).astype(np.int64)
+        centroids = rng.uniform(0.0, 2048.0, size=(n, 2)).astype(np.float64)
+
+        def run_knn():
+            idx, dist = ops.knn(x, k=tool_params["knn_k"])
+            return idx
+
+        def run_pca():
+            scores, comps, ratio = ops.pca(x, n_components=2)
+            return scores
+
+        def run_embedding():
+            return ops.spectral_embedding(
+                x, n_components=2, k=tool_params["embedding_k"]
+            )
+
+        def run_spatial():
+            index = asp.build_index(site_index, centroids)
+            return asp.density(index, radius_bins=2)
+
+        def run_clustering():
+            assign, cent = jax.jit(kmeans, static_argnums=(1,))(
+                jnp.asarray(x), tool_params["kmeans_k"]
+            )
+            return np.asarray(assign)
+
+        runners = {
+            "knn": run_knn,
+            "pca": run_pca,
+            "embedding": run_embedding,
+            "spatial": run_spatial,
+            "clustering": run_clustering,
+        }
+        for tool, fn in runners.items():
+            fn()  # warm-up: compiles + first dispatch
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                best = min(best, time.perf_counter() - t0)
+            per_tool.setdefault(tool, {})[str(n)] = round(1.0 / best, 3)
+
+    largest = str(max(sizes))
+    record = {
+        "metric": "analytics_queries_per_sec",
+        "value": per_tool["knn"][largest],
+        "unit": (
+            f"queries/sec (knn k={tool_params['knn_k']}, N={largest} x "
+            f"{n_features} features; per-tool breakdown in per_tool)"
+        ),
+        "vs_baseline": None,
+        "backend": jax.default_backend(),
+        "config": "analytics",
+        "n_objects": sizes,
+        "n_features": n_features,
+        "per_tool": per_tool,
+        # deliberately NOT _ledger_fields(): queries/sec is its own
+        # experiment family — the methodology string below is the
+        # _methodology_class verbatim, never "pipelined*" and never
+        # "host-synchronous" (the sites/sec families)
+        "timing_methodology": "analytics-tools-v1",
+        "pipeline_depth": None,
+        "pipelined": False,
+    }
+    emit_record(record)
+
+
 def measure_workflow(size: int) -> None:
     """``BENCH_CONFIG=workflow``: the ENTIRE canonical workflow as ONE
     number — ``metaconfig`` filename parse → ``imextract`` decode into
@@ -1816,13 +1919,18 @@ def main() -> None:
         "volume": "jterator_volume_sites_per_sec_per_chip",
         "corilla": "corilla_channels_per_sec_per_chip",
         "workflow": "workflow_end_to_end_sites_per_sec",
+        "analytics": "analytics_queries_per_sec",
     }.get(config, "jterator_cell_painting_sites_per_sec_per_chip")
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": 0.0,
-                "unit": "channels/sec" if config == "corilla" else "sites/sec",
+                "unit": (
+                    "channels/sec" if config == "corilla"
+                    else "queries/sec" if config == "analytics"
+                    else "sites/sec"
+                ),
                 "vs_baseline": 0.0,
                 "error": f"all backends failed: {last_err}",
             }

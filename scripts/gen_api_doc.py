@@ -335,6 +335,40 @@ def slo_section() -> list[str]:
     return out
 
 
+def analytics_section() -> list[str]:
+    import importlib
+
+    import tmlibrary_tpu.analytics as analytics_pkg
+
+    out = ["## Analytics (`tmx query`)", "",
+           (inspect.getdoc(analytics_pkg) or "").split("\n")[0],
+           "",
+           "`tmx query --root EXP --tool T --objects NAME "
+           "[--payload '{...}'] [--no-cache]` answers one query in "
+           "process; `tmx enqueue --kind query --tool T --objects NAME` "
+           "routes the same payload through the serve daemon "
+           "(admission, WDRR, trace spans, SLO).  Results cache under "
+           "`tools/queries/<key>/` keyed by the feature-store content "
+           "digest + the canonical payload (DESIGN.md §24).",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for modname, prefix in (("store", "analytics.store"),
+                            ("ops", "analytics.ops"),
+                            ("spatial", "analytics.spatial"),
+                            ("query", "analytics.query")):
+        mod = importlib.import_module(f"tmlibrary_tpu.analytics.{modname}")
+        for name in sorted(n for n in dir(mod) if not n.startswith("_")):
+            obj = getattr(mod, name)
+            if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+                continue
+            if getattr(obj, "__module__", "") != mod.__name__:
+                continue
+            doc = (inspect.getdoc(obj) or "").split("\n")[0]
+            out.append(f"| `{prefix}.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def main() -> None:
     lines = [
         "# tmlibrary_tpu API reference",
@@ -355,6 +389,7 @@ def main() -> None:
         *resilience_section(),
         *serve_section(),
         *slo_section(),
+        *analytics_section(),
     ]
     # optional output override so a freshness check can generate into a
     # scratch path without clobbering the committed file

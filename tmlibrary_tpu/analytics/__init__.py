@@ -1,0 +1,32 @@
+"""TPU-native single-cell analytics tier.
+
+The downstream half the reference served from Postgres/Citus, rebuilt
+accelerator-native (the rapids-singlecell pattern): a columnar,
+content-digested feature store over the jterator Parquet output
+(``store.py``), MXU-shaped core ops — tiled brute-force kNN, randomized
+PCA, kNN-graph spectral embedding (``ops.py``) — parallel
+integral-image spatial statistics (``spatial.py``), four registered
+tools exposing them (``tools.py``), and the digest-cached query
+execution path shared by ``tmx query`` and ``kind: query`` serve jobs
+(``query.py``).  See DESIGN.md §24.
+"""
+
+from tmlibrary_tpu.analytics import ops, spatial  # noqa: F401
+from tmlibrary_tpu.analytics import tools as _tools  # noqa: F401 (registers)
+from tmlibrary_tpu.analytics.query import (  # noqa: F401
+    QUERY_TOOLS,
+    canonical_payload,
+    query_key,
+    run_query,
+)
+from tmlibrary_tpu.analytics.store import FeatureStore  # noqa: F401
+
+__all__ = [
+    "FeatureStore",
+    "run_query",
+    "query_key",
+    "canonical_payload",
+    "QUERY_TOOLS",
+    "ops",
+    "spatial",
+]

@@ -1,0 +1,150 @@
+"""Query execution: one tool invocation over the feature store, cached
+by content digest.
+
+``run_query`` is the single backend behind both serving paths:
+
+- ``tmx query`` runs it in-process (one-shot CLI);
+- a ``kind: query`` serve job runs it inside the daemon's job span, so
+  admission, WDRR, trace spans, SLO accounting and the flight recorder
+  all apply unchanged.
+
+Cache
+-----
+The cache key is ``sha256(store_digest || canonical_payload)``: the
+feature-store content digest (see ``analytics/store.py``) plus the
+sorted-key JSON of the payload.  Results persist as ordinary
+``ToolResult`` artifacts under ``<store>/tools/queries/<key>/`` with a
+``query.json`` provenance sidecar, so a repeated query on unchanged
+features is four file reads — and a *changed* store (new shards, new
+digest) can never serve a stale result, because the key changes with
+it.  Every result round-trips through ``ToolResult.save``/``load``.
+
+Telemetry: ``tmx_analytics_queries_total{tool,cache}`` and
+``tmx_analytics_query_seconds{tool}`` feed the registry both from the
+one-shot path and the daemon (the daemon additionally replays them from
+ledger events — see ``telemetry.registry_from_ledger``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import TYPE_CHECKING, Any, Callable
+
+from tmlibrary_tpu import telemetry
+from tmlibrary_tpu.analytics.store import FeatureStore
+from tmlibrary_tpu.atomicio import atomic_write_json
+from tmlibrary_tpu.errors import NotSupportedError
+from tmlibrary_tpu.tools.base import ToolResult, get_tool
+
+if TYPE_CHECKING:  # pragma: no cover
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+#: tools answerable through the query path (all registered tools work;
+#: this list is only documentation + the CLI help string)
+QUERY_TOOLS = ("clustering", "heatmap", "classification",
+               "knn", "pca", "embedding", "spatial")
+
+
+def canonical_payload(payload: dict[str, Any]) -> str:
+    """Sorted-key, minimal-separator JSON: the payload half of the
+    cache key.  Two payloads that parse equal always serialize equal."""
+    return json.dumps(payload, sort_keys=True, separators=(",", ":"))
+
+
+def query_key(store_digest: str, payload: dict[str, Any]) -> str:
+    """The cache key: sha256(store content digest ‖ canonical payload),
+    truncated to 24 hex chars (the result-directory name)."""
+    h = hashlib.sha256()
+    h.update(store_digest.encode())
+    h.update(canonical_payload(payload).encode())
+    return h.hexdigest()[:24]
+
+
+def queries_dir(store: "ExperimentStore") -> Path:
+    """The query-result cache root under the experiment's tools dir."""
+    d = store.tools_dir / "queries"
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _metric(kind: str, name: str, value: float = 1.0, **labels):
+    reg = telemetry.get_registry()
+    if kind == "counter":
+        reg.counter(name, **labels).inc(value)
+    else:
+        reg.histogram(name, **labels).observe(value)
+
+
+def run_query(store: "ExperimentStore", payload: dict[str, Any],
+              use_cache: bool = True,
+              emit: Callable[..., Any] | None = None) -> dict[str, Any]:
+    """Answer one analytics query; returns the summary envelope.
+
+    ``payload`` must carry ``tool`` and ``objects_name``; everything
+    else is the tool's own payload.  ``emit`` (the serve ledger's
+    ``append``) turns the internal phases into trace spans nested under
+    the caller's job span.
+    """
+    payload = dict(payload)
+    tool_name = payload.get("tool")
+    if not tool_name:
+        raise NotSupportedError("query payload needs a 'tool'")
+    if not payload.get("objects_name"):
+        raise NotSupportedError("query payload needs an 'objects_name'")
+    tool_cls = get_tool(tool_name)  # unknown tool: fail before any work
+    t0 = time.monotonic()
+    with telemetry.span("feature_store", emit=emit):
+        fs = FeatureStore.ensure(store, payload["objects_name"])
+    key = query_key(fs.digest, payload)
+    cache_dir = queries_dir(store) / key
+    tool_payload = {k: v for k, v in payload.items() if k != "tool"}
+
+    if use_cache and (cache_dir / "result.json").exists():
+        result = ToolResult.load(cache_dir)
+        # rounded ONCE, here: the ledger event carries this value and
+        # registry_from_ledger replays it, so live and replayed
+        # histograms agree exactly
+        elapsed = round(time.monotonic() - t0, 4)
+        _metric("counter", "tmx_analytics_queries_total",
+                tool=tool_name, cache="hit")
+        _metric("counter", "tmx_analytics_cache_hits_total", tool=tool_name)
+        _metric("histogram", "tmx_analytics_query_seconds", elapsed,
+                tool=tool_name)
+        return _summary(result, key, fs.digest, "hit", elapsed, cache_dir)
+
+    with telemetry.span("query_tool", emit=emit):
+        result = tool_cls(store).process(tool_payload)
+    result.save(cache_dir)
+    elapsed = round(time.monotonic() - t0, 4)
+    atomic_write_json(cache_dir / "query.json", {
+        "key": key,
+        "tool": tool_name,
+        "payload": payload,
+        "store_digest": fs.digest,
+        "elapsed_s": elapsed,
+        "cached_at": time.time(),
+    })
+    _metric("counter", "tmx_analytics_queries_total",
+            tool=tool_name, cache="miss")
+    _metric("histogram", "tmx_analytics_query_seconds", elapsed,
+            tool=tool_name)
+    return _summary(result, key, fs.digest, "miss", elapsed, cache_dir)
+
+
+def _summary(result: ToolResult, key: str, digest: str, cache: str,
+             elapsed: float, cache_dir: Path) -> dict[str, Any]:
+    return {
+        "tool": result.tool,
+        "objects_name": result.objects_name,
+        "layer_type": result.layer_type,
+        "n_objects": int(len(result.values)),
+        "cache": cache,
+        "key": key,
+        "store_digest": digest,
+        "elapsed_s": elapsed,
+        "result_dir": str(cache_dir),
+        "attributes": result.attributes,
+    }

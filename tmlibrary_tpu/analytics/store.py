@@ -1,0 +1,267 @@
+"""Columnar feature store: one memory-mapped matrix per object type.
+
+A jterator run persists per-object features as per-site Parquet shards
+(``<experiment>/features/<objects_name>/*.parquet``).  That layout is
+right for append-only ingest but wrong for analytics: every query would
+re-read and re-concatenate every shard.  The feature store ingests the
+shards ONCE into ``<experiment>/analytics/<objects_name>/``::
+
+    matrix.npy      (N objects, F features) float32, memory-mapped
+    index.parquet   object identity: site_index, label, plate,
+                    well_row, well_col (+ site_y/site_x and the
+                    Morphology centroids when the run measured them)
+    meta.json       feature names (in matrix column order), shapes,
+                    the content digest, and the source-shard digest
+
+so a whole experiment loads as ONE device array — the rapids-singlecell
+pattern of accelerator-native single-cell analytics, on XLA.
+
+Digests
+-------
+``digest`` is a sha256 over the feature names, the raw float32 matrix
+bytes and the identity columns — i.e. over the *content* a query can
+observe.  Two stores built from bit-identical features (e.g. the same
+workflow at different pipeline depths) share a digest, so the query
+cache (``analytics/query.py``) keys results on it.  ``source_digest``
+hashes the raw shard files and is only used for staleness: when a new
+shard lands (or one is rewritten), :meth:`FeatureStore.ensure` rebuilds.
+
+The matrix stores RAW values (as float32, the dtype every tool already
+converts to); standardization (z-score with finite-mean NaN imputation,
+exactly ``Tool.load_feature_matrix``'s contract) happens at read time in
+:meth:`standardized` so categorical/raw consumers (heatmap, spatial)
+share the same store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from pathlib import Path
+from typing import TYPE_CHECKING
+
+import numpy as np
+import pandas as pd
+
+from tmlibrary_tpu.atomicio import atomic_write_json
+from tmlibrary_tpu.errors import RegistryError, StoreError
+
+if TYPE_CHECKING:  # pragma: no cover
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+#: identity columns copied into index.parquet when present (in order)
+ID_COLUMNS = ("site_index", "label", "plate", "well_row", "well_col",
+              "site_y", "site_x",
+              "Morphology_centroid_y", "Morphology_centroid_x")
+
+#: columns never ingested into the feature matrix (same exclusion set as
+#: ``Tool.load_feature_matrix`` — the spatial-layout/well identity is
+#: metadata, not a measurement)
+NON_FEATURE_COLUMNS = ("site_index", "label", "plate", "well_row",
+                       "well_col", "site_y", "site_x")
+
+SCHEMA_VERSION = 1
+
+
+def analytics_dir(store: "ExperimentStore", objects_name: str) -> Path:
+    """Where one object type's feature-store artifacts live."""
+    return Path(store.root) / "analytics" / objects_name
+
+
+def _source_digest(store: "ExperimentStore", objects_name: str) -> str:
+    """sha256 over the raw feature shards (names + bytes): the staleness
+    key.  Any appended or rewritten shard changes it."""
+    h = hashlib.sha256()
+    shards = sorted(store.features_dir(objects_name).glob("*.parquet"))
+    if not shards:
+        raise StoreError(f"no feature shards for '{objects_name}'")
+    for p in shards:
+        h.update(p.name.encode())
+        h.update(p.read_bytes())
+    return h.hexdigest()
+
+
+def _content_digest(features: list[str], matrix: np.ndarray,
+                    index: pd.DataFrame) -> str:
+    """sha256 over what a query can observe: feature names in column
+    order, the float32 matrix bytes, and the identity columns."""
+    h = hashlib.sha256()
+    h.update(json.dumps(features).encode())
+    h.update(np.ascontiguousarray(matrix, np.float32).tobytes())
+    for col in index.columns:
+        h.update(col.encode())
+        vals = index[col].to_numpy()
+        if vals.dtype == object:
+            h.update(json.dumps([str(v) for v in vals]).encode())
+        else:
+            h.update(np.ascontiguousarray(vals).tobytes())
+    return h.hexdigest()
+
+
+class FeatureStore:
+    """The built artifact: open with :meth:`ensure` (builds or reuses)."""
+
+    def __init__(self, root: Path, meta: dict):
+        self.root = Path(root)
+        self.meta = meta
+        self._matrix: np.memmap | None = None
+        self._index: pd.DataFrame | None = None
+
+    # ------------------------------------------------------------ build
+    @classmethod
+    def build(cls, store: "ExperimentStore", objects_name: str,
+              source_digest: str | None = None) -> "FeatureStore":
+        table = store.read_features(objects_name)
+        feat_cols = [
+            c for c in table.columns
+            if c not in NON_FEATURE_COLUMNS
+            and np.issubdtype(table[c].dtype, np.number)
+        ]
+        matrix = table[feat_cols].to_numpy(np.float32)
+        index = table[[c for c in ID_COLUMNS if c in table.columns]].copy()
+        index = index.rename(columns={
+            "Morphology_centroid_y": "centroid_y",
+            "Morphology_centroid_x": "centroid_x",
+        })
+        root = analytics_dir(store, objects_name)
+        root.mkdir(parents=True, exist_ok=True)
+        np.save(root / "matrix.npy", matrix)
+        index.to_parquet(root / "index.parquet", index=False)
+        meta = {
+            "schema_version": SCHEMA_VERSION,
+            "objects_name": objects_name,
+            "features": feat_cols,
+            "columns": [c for c in table.columns],
+            "n_objects": int(matrix.shape[0]),
+            "n_features": int(matrix.shape[1]),
+            "digest": _content_digest(feat_cols, matrix, index),
+            "source_digest": (source_digest
+                              or _source_digest(store, objects_name)),
+            "built_at": time.time(),
+        }
+        atomic_write_json(root / "meta.json", meta)
+        return cls(root, meta)
+
+    @classmethod
+    def ensure(cls, store: "ExperimentStore", objects_name: str,
+               rebuild: bool = False) -> "FeatureStore":
+        """Open the store, (re)building when missing or stale — the
+        single entry point every tool and query goes through."""
+        root = analytics_dir(store, objects_name)
+        meta_path = root / "meta.json"
+        src = _source_digest(store, objects_name)
+        if not rebuild and meta_path.exists():
+            try:
+                meta = json.loads(meta_path.read_text())
+                if (meta.get("schema_version") == SCHEMA_VERSION
+                        and meta.get("source_digest") == src
+                        and (root / "matrix.npy").exists()
+                        and (root / "index.parquet").exists()):
+                    return cls(root, meta)
+            except Exception:
+                pass  # corrupt meta: fall through to rebuild
+        return cls.build(store, objects_name, source_digest=src)
+
+    @classmethod
+    def open(cls, root: Path) -> "FeatureStore":
+        root = Path(root)
+        meta_path = root / "meta.json"
+        if not meta_path.exists():
+            raise StoreError(f"no feature store at {root}")
+        return cls(root, json.loads(meta_path.read_text()))
+
+    # ------------------------------------------------------------- views
+    @property
+    def digest(self) -> str:
+        return self.meta["digest"]
+
+    @property
+    def features(self) -> list[str]:
+        return list(self.meta["features"])
+
+    @property
+    def n_objects(self) -> int:
+        return int(self.meta["n_objects"])
+
+    def matrix(self) -> np.ndarray:
+        """The raw (N, F) float32 matrix, memory-mapped read-only."""
+        if self._matrix is None:
+            self._matrix = np.load(self.root / "matrix.npy", mmap_mode="r")
+        return self._matrix
+
+    def index(self) -> pd.DataFrame:
+        if self._index is None:
+            self._index = pd.read_parquet(self.root / "index.parquet")
+        return self._index
+
+    def identity(self) -> pd.DataFrame:
+        """The (site_index, label, plate, well_row, well_col) frame every
+        ``ToolResult.values`` is built on."""
+        return self.index()[
+            ["site_index", "label", "plate", "well_row", "well_col"]
+        ].copy()
+
+    def column(self, feature: str) -> np.ndarray:
+        """One raw feature column (float32 copy)."""
+        try:
+            j = self.features.index(feature)
+        except ValueError:
+            raise RegistryError(
+                f"feature '{feature}' not in store "
+                f"(have: {sorted(self.features)})"
+            ) from None
+        return np.asarray(self.matrix()[:, j])
+
+    def select(self, features: list[str] | None = None
+               ) -> tuple[np.ndarray, list[str]]:
+        """(raw float32 matrix restricted to ``features``, names).  The
+        full matrix (zero-copy memmap view) when ``features`` is None."""
+        if not features:
+            return self.matrix(), self.features
+        pos = {f: j for j, f in enumerate(self.features)}
+        missing = [f for f in features if f not in pos]
+        if missing:
+            # same contract as the pre-store Tool.load_feature_matrix
+            raise RegistryError(
+                f"features not found for '{self.meta['objects_name']}': "
+                f"{missing} (have: "
+                f"{sorted(c for c in self.meta['columns'] if c not in ('site_index', 'label'))})"
+            )
+        return np.ascontiguousarray(
+            self.matrix()[:, [pos[f] for f in features]]
+        ), list(features)
+
+    def standardized(self, features: list[str] | None = None
+                     ) -> tuple[pd.DataFrame, np.ndarray, list[str]]:
+        """(identity frame, z-scored (N, F) float32 matrix, names) —
+        bit-compatible with the pre-store ``Tool.load_feature_matrix``:
+        NaN/inf cells are imputed with the column's FINITE mean before
+        mu/sd so degenerate objects stay uninformative instead of
+        biasing the statistics."""
+        x, feat_cols = self.select(features)
+        x = np.array(x, np.float32, copy=True)
+        finite = np.isfinite(x)
+        if not finite.all():
+            with np.errstate(invalid="ignore"):
+                fill = np.nanmean(np.where(finite, x, np.nan), axis=0)
+            fill = np.nan_to_num(fill, nan=0.0, posinf=0.0, neginf=0.0)
+            x = np.where(finite, x, fill[None, :]).astype(np.float32)
+        mu = x.mean(axis=0, keepdims=True)
+        sd = x.std(axis=0, keepdims=True)
+        x = (x - mu) / np.where(sd > 1e-9, sd, 1.0)
+        return self.identity(), x, feat_cols
+
+    def centroids(self) -> np.ndarray:
+        """(N, 2) float32 per-object positions for spatial statistics:
+        the measured Morphology centroids when present, else the site
+        grid position (site_y, site_x) as a coarse fallback."""
+        idx = self.index()
+        if {"centroid_y", "centroid_x"} <= set(idx.columns):
+            return idx[["centroid_y", "centroid_x"]].to_numpy(np.float32)
+        if {"site_y", "site_x"} <= set(idx.columns):
+            return idx[["site_y", "site_x"]].to_numpy(np.float32)
+        raise StoreError(
+            "feature store has neither Morphology centroids nor a "
+            "site_y/site_x layout — spatial queries need object positions"
+        )

@@ -1,0 +1,193 @@
+"""Analytics tools: kNN, PCA, embedding, spatial — over the feature store.
+
+Each is a regular registered :class:`~tmlibrary_tpu.tools.base.Tool`, so
+the whole existing surface works unchanged: ``tmx tool submit``, the
+request manager lifecycle, ``ToolResult`` persistence — plus the new
+``tmx query`` path with its digest-keyed cache.  All four read through
+:class:`~tmlibrary_tpu.analytics.store.FeatureStore`, never the raw
+Parquet shards.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tmlibrary_tpu.analytics import ops, spatial
+from tmlibrary_tpu.analytics.store import FeatureStore
+from tmlibrary_tpu.errors import NotSupportedError
+from tmlibrary_tpu.tools.base import Tool, ToolResult, register_tool
+
+
+@register_tool("knn")
+class Knn(Tool):
+    """Tiled brute-force k nearest neighbors over the standardized
+    feature matrix.  Payload: ``objects_name``, optional ``k`` (default
+    10), ``features``, ``tile``.  ``values.value`` is each object's mean
+    distance to its k neighbors (an outlier score, continuous layer);
+    ``nn0..`` / ``nnd0..`` columns carry the neighbor row indices (into
+    the store's canonical object order) and distances."""
+
+    def process(self, payload: dict) -> ToolResult:
+        objects_name = payload["objects_name"]
+        k = int(payload.get("k", 10))
+        fs = FeatureStore.ensure(self.store, objects_name)
+        ids, x, feat_cols = fs.standardized(payload.get("features"))
+        idx, dist = ops.knn(x, k, tile=payload.get("tile"))
+        k_eff = idx.shape[1]
+        ids["value"] = (dist.mean(axis=1).astype(np.float64)
+                        if k_eff else 0.0)
+        for j in range(k_eff):
+            ids[f"nn{j}"] = idx[:, j].astype(np.int32)
+            ids[f"nnd{j}"] = dist[:, j].astype(np.float64)
+        return ToolResult(
+            tool=self.name, objects_name=objects_name,
+            layer_type="continuous", values=ids,
+            attributes={
+                "k": k_eff,
+                "features": feat_cols,
+                "tile_rows": int(payload.get("tile")
+                                 or ops.knn_tile_rows(len(ids))),
+                "mean_distance": (float(dist.mean()) if dist.size else 0.0),
+                "store_digest": fs.digest,
+            },
+        )
+
+
+@register_tool("pca")
+class Pca(Tool):
+    """Randomized-SVD PCA.  Payload: ``objects_name``, optional
+    ``n_components`` (default 2), ``features``.  ``value`` is the PC1
+    score; ``pc0..`` columns carry every requested component."""
+
+    def process(self, payload: dict) -> ToolResult:
+        objects_name = payload["objects_name"]
+        n_components = int(payload.get("n_components", 2))
+        fs = FeatureStore.ensure(self.store, objects_name)
+        ids, x, feat_cols = fs.standardized(payload.get("features"))
+        scores, comps, ratio = ops.pca(x, n_components)
+        ids["value"] = scores[:, 0].astype(np.float64)
+        for j in range(scores.shape[1]):
+            ids[f"pc{j}"] = scores[:, j].astype(np.float64)
+        return ToolResult(
+            tool=self.name, objects_name=objects_name,
+            layer_type="continuous", values=ids,
+            attributes={
+                "n_components": int(scores.shape[1]),
+                "features": feat_cols,
+                "explained_variance_ratio": [round(float(r), 6)
+                                             for r in ratio],
+                "components": np.round(comps, 6).tolist(),
+                "store_digest": fs.digest,
+            },
+        )
+
+
+@register_tool("embedding")
+class Embedding(Tool):
+    """kNN-graph spectral embedding (UMAP-style 2-D layout).  Payload:
+    ``objects_name``, optional ``n_components`` (default 2), ``k``
+    (default 15), ``features``.  ``value`` is the first embedding
+    coordinate; ``emb0..`` columns carry all of them."""
+
+    def process(self, payload: dict) -> ToolResult:
+        objects_name = payload["objects_name"]
+        n_components = int(payload.get("n_components", 2))
+        k = int(payload.get("k", 15))
+        fs = FeatureStore.ensure(self.store, objects_name)
+        ids, x, feat_cols = fs.standardized(payload.get("features"))
+        emb = ops.spectral_embedding(x, n_components=n_components, k=k)
+        ids["value"] = emb[:, 0].astype(np.float64)
+        for j in range(emb.shape[1]):
+            ids[f"emb{j}"] = emb[:, j].astype(np.float64)
+        return ToolResult(
+            tool=self.name, objects_name=objects_name,
+            layer_type="continuous", values=ids,
+            attributes={
+                "n_components": int(emb.shape[1]),
+                "k": k,
+                "features": feat_cols,
+                "method": "spectral",
+                "store_digest": fs.digest,
+            },
+        )
+
+
+@register_tool("spatial")
+class Spatial(Tool):
+    """Integral-image spatial statistics.  Payload: ``objects_name``,
+    ``statistic`` (``density`` — the default — or ``enrichment``),
+    optional ``grid`` (bins per axis, default 64), ``radius`` (window
+    radius in bins, default 2), ``windows`` (explicit
+    ``[site_index, y0, x0, y1, x1]`` bin windows to answer), and for
+    enrichment a ``mark_feature`` + ``mark_threshold`` defining the
+    marked population.  ``value`` is the per-object statistic."""
+
+    def process(self, payload: dict) -> ToolResult:
+        objects_name = payload["objects_name"]
+        statistic = payload.get("statistic", "density")
+        if statistic not in ("density", "enrichment"):
+            raise NotSupportedError(
+                f"spatial statistic '{statistic}' not supported "
+                "(have: density, enrichment)"
+            )
+        grid = int(payload.get("grid", spatial.DEFAULT_GRID))
+        radius = int(payload.get("radius", 2))
+        fs = FeatureStore.ensure(self.store, objects_name)
+        ids = fs.identity()
+        centroids = fs.centroids()
+        mark = None
+        attrs: dict = {
+            "statistic": statistic, "grid": grid, "radius": radius,
+            "store_digest": fs.digest,
+        }
+        if statistic == "enrichment":
+            feature = payload.get("mark_feature")
+            if not feature:
+                raise NotSupportedError(
+                    "spatial enrichment needs a 'mark_feature'"
+                )
+            if feature not in fs.features:
+                raise NotSupportedError(
+                    f"feature '{feature}' not found (have: "
+                    f"{sorted(fs.features)})"
+                )
+            col = fs.column(feature)
+            thresh = payload.get("mark_threshold")
+            if thresh is None:
+                thresh = float(np.nanmedian(col))
+            mark = (col > float(thresh)).astype(np.float32)
+            attrs["mark_feature"] = feature
+            attrs["mark_threshold"] = float(thresh)
+            attrs["marked_fraction"] = round(float(mark.mean()), 6)
+        index = spatial.build_index(
+            ids["site_index"].to_numpy(), centroids, mark=mark, grid=grid,
+        )
+        if statistic == "density":
+            values = spatial.density(index, radius_bins=radius)
+        else:
+            values = spatial.enrichment(index, radius_bins=radius)
+        ids["value"] = values
+        attrs["n_sites"] = int(len(index.site_ids))
+        windows = payload.get("windows")
+        if windows:
+            wins = np.asarray(windows, np.int64)
+            site_to_row = {int(s): i for i, s in enumerate(index.site_ids)}
+            rows = np.array([site_to_row.get(int(s), -1)
+                             for s in wins[:, 0]], np.int64)
+            if (rows < 0).any():
+                bad = sorted({int(s) for s, r in zip(wins[:, 0], rows)
+                              if r < 0})
+                raise NotSupportedError(
+                    f"window sites not in store: {bad}"
+                )
+            q = np.concatenate([rows[:, None], wins[:, 1:]], axis=1)
+            counts = index.window_counts(q)
+            attrs["windows"] = [
+                {"site_index": int(s), "window": [int(v) for v in w],
+                 "count": float(c)}
+                for s, w, c in zip(wins[:, 0], wins[:, 1:], counts)
+            ]
+        return ToolResult(
+            tool=self.name, objects_name=objects_name,
+            layer_type="continuous", values=ids, attributes=attrs,
+        )

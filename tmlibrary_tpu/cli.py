@@ -485,6 +485,42 @@ def build_parser() -> argparse.ArgumentParser:
                             "produces carries it, and `tmx trace --export "
                             "chrome --trace-id ID` renders the full "
                             "enqueue-to-result timeline")
+    p_enq.add_argument("--kind", choices=("workflow", "query"),
+                       default="workflow",
+                       help="job kind: 'workflow' runs the experiment's "
+                            "workflow; 'query' answers one analytics "
+                            "query (digest-cached; see `tmx query`)")
+    p_enq.add_argument("--tool", default=None,
+                       help="query jobs: tool name (clustering, heatmap, "
+                            "classification, knn, pca, embedding, "
+                            "spatial) — merged into the payload")
+    p_enq.add_argument("--objects", default=None, metavar="NAME",
+                       help="query jobs: objects_name shorthand — merged "
+                            "into the payload")
+    p_enq.add_argument("--payload", default=None,
+                       help="query jobs: payload as inline JSON")
+    p_enq.add_argument("--payload-file", default=None,
+                       help="query jobs: payload from a JSON file")
+
+    p_query = sub.add_parser(
+        "query", help="one-shot analytics query over an experiment's "
+                      "feature store (kNN/PCA/embedding/spatial/"
+                      "clustering/heatmap/classification; results are "
+                      "cached by feature-store digest — the daemon path "
+                      "is `tmx enqueue --kind query`)")
+    _add_common(p_query)
+    p_query.add_argument("--tool", required=True,
+                         help="tool name (see 'tmx tool available')")
+    p_query.add_argument("--objects", default=None, metavar="NAME",
+                         help="objects_name shorthand (else put "
+                              "objects_name in the payload)")
+    p_query.add_argument("--payload", default=None,
+                         help="tool payload as inline JSON")
+    p_query.add_argument("--payload-file", default=None,
+                         help="tool payload from a JSON file")
+    p_query.add_argument("--no-cache", action="store_true",
+                         help="recompute even when a digest-keyed cached "
+                              "result exists")
 
     p_slo = sub.add_parser(
         "slo", help="per-tenant SLO report over a serve root: p50/p95 "
@@ -1048,6 +1084,45 @@ def cmd_serve(args) -> int:
     return rc
 
 
+def _query_payload(args) -> dict:
+    """Assemble one analytics-query payload from --tool/--objects plus
+    inline or file JSON (shared by `tmx query` and `tmx enqueue
+    --kind query`).  Explicit payload keys win over the shorthands."""
+    if args.payload_file and args.payload:
+        raise SystemExit("--payload and --payload-file are mutually "
+                         "exclusive")
+    if args.payload_file:
+        payload = json.loads(Path(args.payload_file).read_text())
+    elif args.payload:
+        payload = json.loads(args.payload)
+    else:
+        payload = {}
+    if not isinstance(payload, dict):
+        raise SystemExit("query payload must be a JSON object")
+    if getattr(args, "tool", None):
+        payload.setdefault("tool", args.tool)
+    if getattr(args, "objects", None):
+        payload.setdefault("objects_name", args.objects)
+    if not payload.get("tool"):
+        raise SystemExit("query needs a tool (--tool or payload 'tool')")
+    if not payload.get("objects_name"):
+        raise SystemExit("query needs an objects_name (--objects or "
+                         "payload 'objects_name')")
+    return payload
+
+
+def cmd_query(args) -> int:
+    from tmlibrary_tpu.analytics import query as analytics_query
+
+    store = _open_store(args)
+    payload = _query_payload(args)
+    summary = analytics_query.run_query(
+        store, payload, use_cache=not args.no_cache,
+    )
+    print(json.dumps(summary, default=str))
+    return 0
+
+
 def cmd_enqueue(args) -> int:
     import uuid
 
@@ -1057,6 +1132,10 @@ def cmd_enqueue(args) -> int:
     now = time.time()
     job_id = args.job_id or f"{args.tenant}-{uuid.uuid4().hex[:10]}"
     trace_id = getattr(args, "trace_id", None) or uuid.uuid4().hex
+    kind = getattr(args, "kind", "workflow")
+    payload = None
+    if kind == "query":
+        payload = _query_payload(args)
     spec = JobSpec(
         job_id=job_id,
         tenant=args.tenant,
@@ -1068,6 +1147,8 @@ def cmd_enqueue(args) -> int:
         attempt=args.attempt,
         submitted_at=now,
         trace_id=trace_id,
+        kind=kind,
+        payload=payload,
     )
     try:
         path = serve_mod.enqueue_job(Path(args.root), spec)
@@ -2130,6 +2211,8 @@ def main(argv=None) -> int:
             return cmd_serve(args)
         if args.command == "enqueue":
             return cmd_enqueue(args)
+        if args.command == "query":
+            return cmd_query(args)
         if args.command == "tool":
             return cmd_tool(args)
         if args.command == "project":

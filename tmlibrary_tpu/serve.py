@@ -463,26 +463,31 @@ class ServeDaemon:
         try:
             # the job span: per-attempt wall time of the whole execution,
             # the parent interval the engine's run→step→batch→phase tree
-            # nests under in the exported trace
+            # (or the query's feature_store→query_tool spans) nests under
+            # in the exported trace
             with telemetry.span(
                 "job",
                 emit=functools.partial(self.ledger.append,
                                        attempt=job.attempt),
             ):
                 store = ExperimentStore.open(Path(job.root))
-                if job.description:
-                    desc_path = Path(job.description)
-                    if not desc_path.is_absolute():
-                        desc_path = Path(job.root) / desc_path
+                if job.kind == "query":
+                    resume = False
+                    summary = self._run_query(job, store, deadline)
                 else:
-                    desc_path = store.workflow_dir / "workflow.yaml"
-                desc = WorkflowDescription.load(desc_path)
-                wf = Workflow(store, desc,
-                              pipeline_depth=job.pipeline_depth,
-                              should_stop=should_stop,
-                              stop_reason=stop_reason)
-                resume = wf.ledger.path.exists()
-                summary = wf.run(resume=resume)
+                    if job.description:
+                        desc_path = Path(job.description)
+                        if not desc_path.is_absolute():
+                            desc_path = Path(job.root) / desc_path
+                    else:
+                        desc_path = store.workflow_dir / "workflow.yaml"
+                    desc = WorkflowDescription.load(desc_path)
+                    wf = Workflow(store, desc,
+                                  pipeline_depth=job.pipeline_depth,
+                                  should_stop=should_stop,
+                                  stop_reason=stop_reason)
+                    resume = wf.ledger.path.exists()
+                    summary = wf.run(resume=resume)
         except PreemptedError as exc:
             if exc.reason == "deadline" and not preemption_requested():
                 self.ledger.append(event="job_expired", job=job.job_id,
@@ -507,9 +512,17 @@ class ServeDaemon:
             self._job_failed(job, exc)
             return "failed"
         elapsed = time.monotonic() - t0
+        extra_done = {}
+        if job.kind == "query" and isinstance(summary, dict):
+            # carried so registry_from_ledger can replay the analytics
+            # counters/latency exactly as the live registry observed them
+            extra_done = {"kind": "query",
+                          "tool": summary.get("tool"),
+                          "cache": summary.get("cache"),
+                          "query_elapsed_s": summary.get("elapsed_s")}
         self.ledger.append(event="job_done", job=job.job_id,
                            tenant=job.tenant, elapsed_s=round(elapsed, 3),
-                           resumed=resume)
+                           resumed=resume, **extra_done)
         self._move_spool(job.job_id, "done", {
             "job": job.to_dict(), "summary": summary,
             "elapsed_s": round(elapsed, 3), "ts": time.time(),
@@ -524,6 +537,32 @@ class ServeDaemon:
         slo.observe_job(telemetry.get_registry(), job.tenant, "ok",
                         round(elapsed, 3))
         return "done"
+
+    def _run_query(self, job: JobSpec, store, deadline: float | None
+                   ) -> dict:
+        """Execute one ``kind=query`` job: a single analytics query
+        through :func:`tmlibrary_tpu.analytics.query.run_query`, inside
+        the caller's job span (its ``feature_store``/``query_tool``
+        phases become child spans on the serve ledger).  Queries are
+        short and idempotent (digest-keyed cache), so preemption and
+        deadline are checked once up front instead of per batch — a
+        re-spooled query re-runs as a cache hit."""
+        from tmlibrary_tpu.analytics import query as analytics_query
+
+        if preemption_requested():
+            raise PreemptedError("preempted before query start",
+                                 step="query",
+                                 reason=preemption_reason())
+        if deadline is not None and time.time() >= deadline:
+            raise PreemptedError("query deadline expired before start",
+                                 step="query", reason="deadline")
+        summary = analytics_query.run_query(
+            store, dict(job.payload or {}), emit=self.ledger.append,
+        )
+        self._metric("counter", "tmx_analytics_jobs_total",
+                     tenant=job.tenant,
+                     tool=str(summary.get("tool", "unknown")))
+        return summary
 
     def _job_failed(self, job: JobSpec, exc: Exception) -> None:
         logger.warning("serve job %s failed: %s", job.job_id, exc)

@@ -1363,6 +1363,23 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                                   tenant=tenant, **hl).observe(
                         float(ev["elapsed_s"]))
                 _observe_slo(reg, tenant, "ok", ev.get("elapsed_s"), hl)
+                if ev.get("kind") == "query" and ev.get("tool"):
+                    # analytics query jobs (serve.py _run_query): replay
+                    # the tmx_analytics_* series run_query fed live —
+                    # the event carries the exact observed values
+                    tool = str(ev["tool"])
+                    cache = str(ev.get("cache", "")) or "unknown"
+                    reg.counter("tmx_analytics_queries_total",
+                                tool=tool, cache=cache, **hl).inc()
+                    if cache == "hit":
+                        reg.counter("tmx_analytics_cache_hits_total",
+                                    tool=tool, **hl).inc()
+                    if ev.get("query_elapsed_s") is not None:
+                        reg.histogram("tmx_analytics_query_seconds",
+                                      tool=tool, **hl).observe(
+                            float(ev["query_elapsed_s"]))
+                    reg.counter("tmx_analytics_jobs_total",
+                                tenant=tenant, tool=tool, **hl).inc()
             elif kind == "job_failed":
                 reg.counter("tmx_serve_jobs_failed_total",
                             tenant=tenant, **hl).inc()

@@ -23,6 +23,9 @@ from tmlibrary_tpu.tools.base import (
     register_tool,
 )
 from tmlibrary_tpu.tools import classification, clustering, heatmap  # noqa: F401
+from tmlibrary_tpu.analytics import tools as _analytics_tools  # noqa: F401,E402
+# ^ registers knn/pca/embedding/spatial (analytics/tools.py) so every
+#   consumer of the registry — tmx tool, tmx query, serve — sees them
 
 __all__ = [
     "Tool",

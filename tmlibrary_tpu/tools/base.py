@@ -93,6 +93,24 @@ class ToolResult:
             )
         )
 
+    @classmethod
+    def load(cls, directory) -> "ToolResult":
+        """Inverse of :meth:`save`: rebuild the result from a saved
+        directory (the serving path for cached query results)."""
+        from pathlib import Path
+
+        d = Path(directory)
+        meta = json.loads((d / "result.json").read_text())
+        return cls(
+            tool=meta["tool"],
+            objects_name=meta["objects_name"],
+            layer_type=meta["layer_type"],
+            values=pd.read_parquet(d / "values.parquet"),
+            attributes=meta.get("attributes", {}),
+            plots=[Plot(type=p["type"], figure=p["figure"])
+                   for p in meta.get("plots", [])],
+        )
+
 
 @dataclasses.dataclass(eq=False)
 class LabelLayer:
@@ -206,42 +224,24 @@ class Tool(abc.ABC):
     def __init__(self, store: ExperimentStore):
         self.store = store
 
+    def feature_store(self, objects_name: str):
+        """The experiment's columnar feature store for ``objects_name``
+        (built on first touch, rebuilt when the source shards change)."""
+        from tmlibrary_tpu.analytics.store import FeatureStore
+
+        return FeatureStore.ensure(self.store, objects_name)
+
     def load_feature_matrix(
         self, objects_name: str, features: list[str] | None = None
     ) -> tuple[pd.DataFrame, np.ndarray, list[str]]:
-        """(identity frame, standardized (N, F) matrix, feature names)."""
-        table = self.store.read_features(objects_name)
-        id_cols = ["site_index", "label"]
-        feat_cols = features or [
-            c
-            for c in table.columns
-            if c not in id_cols
-            and c not in ("plate", "well_row", "well_col", "site_y", "site_x")
-            and np.issubdtype(table[c].dtype, np.number)
-        ]
-        missing = [c for c in feat_cols if c not in table.columns]
-        if missing:
-            raise RegistryError(
-                f"features not found for '{objects_name}': {missing} "
-                f"(have: {sorted(c for c in table.columns if c not in id_cols)})"
-            )
-        x = table[feat_cols].to_numpy(np.float32)
-        # sanitize before statistics: NaN/inf features (e.g. solidity of
-        # a degenerate object) would poison every standardized column.
-        # Impute with the column's FINITE mean — z of ~0, "uninformative"
-        # — not raw 0, which would plant the object sigmas away from the
-        # column mean and bias mu/sd themselves
-        finite = np.isfinite(x)
-        if not finite.all():
-            with np.errstate(invalid="ignore"):
-                fill = np.nanmean(np.where(finite, x, np.nan), axis=0)
-            fill = np.nan_to_num(fill, nan=0.0, posinf=0.0, neginf=0.0)
-            x = np.where(finite, x, fill[None, :]).astype(np.float32)
-        # standardize (reference tools z-score before sklearn)
-        mu = x.mean(axis=0, keepdims=True)
-        sd = x.std(axis=0, keepdims=True)
-        x = (x - mu) / np.where(sd > 1e-9, sd, 1.0)
-        return table[id_cols + ["plate", "well_row", "well_col"]].copy(), x, feat_cols
+        """(identity frame, standardized (N, F) matrix, feature names).
+
+        Reads through the columnar feature store (``analytics/store.py``)
+        rather than re-concatenating Parquet shards per request; the
+        standardization contract is unchanged — z-score with finite-mean
+        NaN imputation, float32 — so results are identical to the
+        pre-store path."""
+        return self.feature_store(objects_name).standardized(features)
 
     @abc.abstractmethod
     def process(self, payload: dict[str, Any]) -> ToolResult:

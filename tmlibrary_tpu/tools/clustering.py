@@ -26,14 +26,21 @@ def kmeans(
     n = x.shape[0]
     key = jax.random.PRNGKey(seed)
 
-    # k-means++ style greedy seeding (deterministic given the key)
+    # k-means++ style greedy seeding (deterministic given the key).
+    # One fori_loop over a preallocated (k, F) buffer — the old Python
+    # `for _ in range(k-1)` dispatched (and, unjitted, synced) per
+    # centroid and unrolled to k programs under jit.  Unset rows are
+    # masked to +inf before the min, which is exactly "min over the
+    # first i centroids", so assignments stay bit-identical.
     first = jax.random.randint(key, (), 0, n)
-    centroids = x[first][None]
-    for _ in range(k - 1):
-        d2 = jnp.min(
-            jnp.sum((x[:, None, :] - centroids[None]) ** 2, axis=-1), axis=1
-        )
-        centroids = jnp.concatenate([centroids, x[jnp.argmax(d2)][None]])
+    centroids = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
+
+    def seed_step(i, cent):
+        d2 = jnp.sum((x[:, None, :] - cent[None]) ** 2, axis=-1)  # (n, k)
+        d2 = jnp.where(jnp.arange(k)[None, :] < i, d2, jnp.inf)
+        return cent.at[i].set(x[jnp.argmax(jnp.min(d2, axis=1))])
+
+    centroids = jax.lax.fori_loop(1, k, seed_step, centroids)
 
     def step(carry, _):
         cent = carry

@@ -24,14 +24,14 @@ class Heatmap(Tool):
         feature = payload.get("feature")
         if not feature:
             raise NotSupportedError("heatmap needs a 'feature'")
-        table = self.store.read_features(objects_name)
-        if feature not in table.columns:
+        fs = self.feature_store(objects_name)
+        if feature not in fs.features:
             raise NotSupportedError(
                 f"feature '{feature}' not found (have: "
-                f"{sorted(c for c in table.columns if c.startswith(('Intensity', 'Morphology', 'Texture', 'Zernike')))})"
+                f"{sorted(c for c in fs.features if c.startswith(('Intensity', 'Morphology', 'Texture', 'Zernike')))})"
             )
-        ids = table[["site_index", "label", "plate", "well_row", "well_col"]].copy()
-        vals = table[feature].to_numpy(np.float64)
+        ids = fs.identity()
+        vals = fs.column(feature).astype(np.float64)
         ids["value"] = vals
 
         # the classic plate heatmap: per-well mean of the feature, as a

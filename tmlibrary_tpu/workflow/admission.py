@@ -84,7 +84,8 @@ SHED_REASONS = frozenset(
 # ------------------------------------------------------------------- job
 @dataclasses.dataclass
 class JobSpec:
-    """One spooled serve job: a workflow submission for one experiment.
+    """One spooled serve job: a workflow submission — or, with
+    ``kind="query"``, one analytics query — for one experiment.
 
     ``deadline`` is an *absolute* unix timestamp (computed by ``tmx
     enqueue`` from its ``--deadline`` relative seconds) so the budget
@@ -107,6 +108,14 @@ class JobSpec:
     #: span/ledger event emitted on behalf of this job carries it, so one
     #: id links enqueue → admission → queue wait → execution phases.
     trace_id: str | None = None
+    #: job kind: ``workflow`` (the default — run the experiment's
+    #: workflow) or ``query`` (answer one analytics query; see
+    #: ``analytics/query.py``).  Old spool files carry no ``kind`` and
+    #: deserialize as workflows.
+    kind: str = "workflow"
+    #: the query payload for ``kind="query"`` jobs (tool name +
+    #: tool-specific arguments); ignored for workflow jobs
+    payload: dict | None = None
 
     def sort_key(self) -> tuple:
         """Deterministic within-tenant order: priority desc, then
