@@ -67,6 +67,25 @@ def main(argv=None) -> int:
 
     history_path = args.history or tuning.bench_history_path()
     history = tuning.load_bench_history(history_path)
+    if not history:
+        # absent or empty history is a fresh checkout, not an error:
+        # report the pinned no-baseline verdict with a hint instead of a
+        # confusing "no comparable records" over a file that isn't there
+        state = ("absent" if not os.path.exists(history_path) else "empty")
+        verdict = {"status": "no_baseline", "exit_code": perf.EXIT_NO_BASELINE,
+                   "reason": f"bench history {history_path} is {state} — "
+                             "run bench.py (or scripts/tpu_watch.py) to "
+                             "capture a first record",
+                   "history_path": str(history_path), "history_records": 0,
+                   "latest": None, "baseline": None, "delta_frac": None,
+                   "age_hours": None, "recapture": []}
+        if args.as_json:
+            print(json.dumps(verdict, indent=2))
+        else:
+            print(f"bench_regression: {verdict['status']} "
+                  f"(exit {verdict['exit_code']})")
+            print(f"  reason: {verdict['reason']}")
+        return verdict["exit_code"]
     baseline = None
     if args.baseline:
         baseline = tuning.load_bench_history(args.baseline)

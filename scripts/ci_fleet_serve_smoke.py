@@ -1,0 +1,263 @@
+#!/usr/bin/env python
+"""CI fleet-serving smoke: a two-host spool surviving a SIGKILL'd host.
+
+    python scripts/ci_fleet_serve_smoke.py [ARTIFACT_DIR] [--keep DIR]
+
+``tests/test_fleet_serve.py`` proves the claim/lease/fence/reaper
+contracts inside one pytest process; this harness crosses the real
+boundary the fleet tentpole promises to survive (DESIGN.md §25): two
+separate ``tmx serve run`` daemons share one spool root under distinct
+``--host`` identities, the first is SIGKILL'd (no drain, no cleanup —
+the true dead-host case) while its first job's jterator window is in
+flight, and the survivor must observe the expired lease + stale
+heartbeat, reclaim the orphaned job with a pinned ``job_reclaimed``
+event, and finish every job exactly once.  Convergence bar: each
+tenant store's labels + feature tables must equal a never-interrupted
+in-process reference run bit for bit, and the merged per-host ledgers
+must carry exactly one ``job_done`` per job id.
+
+When ARTIFACT_DIR is given, the merged fleet ledger, the ``tmx serve
+status --json`` fleet view, and a schema-valid Chrome trace are copied
+there for CI artifact upload.  Exit 0 and ``FLEET PASS`` on
+convergence; 1 otherwise.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "scripts"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from chaos_run import make_source, make_store, resilience  # noqa: E402
+
+#: the dead host's lease; the survivor may only reclaim after this has
+#: lapsed AND the owner's heartbeat is this stale — keep it short so the
+#: smoke stays fast, long enough that renewal keeps it alive while live
+LEASE_S = 2.0
+
+
+def _env() -> dict:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO)}
+    env.pop("TMX_FAULT_PLAN", None)
+    return env
+
+
+def _ledger_events(path: Path) -> list:
+    events = []
+    if not path.exists():
+        return events
+    for line in path.read_text().splitlines():
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue
+    return events
+
+
+def _tmx(args: list, out=None, timeout=600) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tmlibrary_tpu.cli", *args],
+        env=_env(), stdout=out or subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=(out is None), timeout=timeout,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("artifacts", nargs="?", default=None,
+                        help="copy the merged ledger + status view + "
+                             "chrome trace here for CI artifact upload")
+    parser.add_argument("--keep", metavar="DIR", default=None,
+                        help="run inside DIR and keep everything "
+                             "(default: a temp dir, removed afterwards)")
+    args = parser.parse_args(argv)
+
+    from tmlibrary_tpu import serve
+    from tmlibrary_tpu.workflow.engine import Workflow
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root = Path(args.keep) if args.keep else Path(tmp)
+        root.mkdir(parents=True, exist_ok=True)
+        source = make_source(root)
+        sroot = root / "serve_root"
+
+        print("[1/4] reference run (uninterrupted, in-process)")
+        ref, desc = make_store(root, "reference", source)
+        Workflow(ref, desc, resilience=resilience()).run()
+        ref_labels = ref.read_labels(None, "nuclei")
+        ref_feats = ref.read_features("nuclei").sort_values(
+            ["site_index", "label"]).reset_index(drop=True)
+
+        print("[2/4] spool two jobs for one shared fleet spool")
+        stores = {}
+        for jid in ("a-1", "a-2"):
+            store, desc = make_store(root, f"job_{jid}", source)
+            desc.save(store.workflow_dir / "workflow.yaml")
+            stores[jid] = store
+            rc = _tmx(["enqueue", "--root", str(sroot),
+                       "--experiment", str(store.root),
+                       "--tenant", "a", "--job-id", jid])
+            if rc.returncode != 0:
+                print(f"FLEET FAIL: enqueue {jid} exited "
+                      f"{rc.returncode}\n{rc.stdout}")
+                return 1
+
+        print("[3/4] host hA starts, gets SIGKILL'd mid-jterator "
+              "(no drain, no cleanup)")
+        log_a = root / "serve_hA.log"
+        with open(log_a, "w") as out:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tmlibrary_tpu.cli", "serve", "run",
+                 "--root", str(sroot), "--poll", "0.1",
+                 "--host", "hA", "--lease", str(LEASE_S)],
+                env=_env(), stdout=out, stderr=subprocess.STDOUT, text=True,
+            )
+            # SIGKILL once the first claimed job's jterator is mid-window:
+            # the claim is live, the lease is being renewed, work is real
+            deadline = time.monotonic() + 300
+            victim = None
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    print(f"FLEET FAIL: hA exited rc {proc.returncode} "
+                          "before the first job started\n"
+                          + log_a.read_text()[-3000:])
+                    return 1
+                for jid, store in stores.items():
+                    led = store.root / "workflow" / "ledger.jsonl"
+                    if any(e.get("step") == "jterator"
+                           and e.get("event") == "init_done"
+                           for e in _ledger_events(led)):
+                        victim = jid
+                        break
+                if victim:
+                    break
+                time.sleep(0.05)
+            else:
+                proc.kill()
+                print("FLEET FAIL: jterator never started in 300s")
+                return 1
+            proc.kill()  # SIGKILL: the host is simply gone
+            proc.wait(timeout=60)
+        claimed = [jid for _, jid, host in serve.job_claims(sroot)
+                   if host == "hA"]
+        print(f"      hA killed mid {victim}; leases left on disk: "
+              f"{sorted(claimed)}")
+        if victim not in claimed:
+            print(f"FLEET FAIL: the killed host left no lease for "
+                  f"{victim} — nothing to reclaim")
+            return 1
+
+        print("[4/4] survivor hB reclaims the dead host's lease and "
+              "finishes every job")
+        log_b = root / "serve_hB.log"
+        with open(log_b, "w") as out:
+            p2 = subprocess.run(
+                [sys.executable, "-m", "tmlibrary_tpu.cli", "serve", "run",
+                 "--root", str(sroot), "--poll", "0.1",
+                 "--host", "hB", "--lease", str(LEASE_S),
+                 "--max-jobs", "2"],
+                env=_env(), stdout=out, stderr=subprocess.STDOUT,
+                text=True, timeout=900,
+            )
+        if p2.returncode != 0:
+            print(f"FLEET FAIL: survivor exited {p2.returncode}\n"
+                  + log_b.read_text()[-3000:])
+            return 1
+
+        events = serve.serve_ledger_events(sroot)
+        done = sorted(e["job"] for e in events
+                      if e.get("event") == "job_done")
+        if done != ["a-1", "a-2"]:
+            print(f"FLEET FAIL: expected exactly one job_done per job, "
+                  f"got {done}")
+            return 1
+        reclaimed = [e for e in events if e.get("event") == "job_reclaimed"]
+        if not any(e.get("from_host") == "hA" for e in reclaimed):
+            print(f"FLEET FAIL: survivor never reclaimed from hA "
+                  f"(job_reclaimed events: {reclaimed})")
+            return 1
+        if serve.job_claims(sroot):
+            print(f"FLEET FAIL: lease residue after convergence: "
+                  f"{serve.job_claims(sroot)}")
+            return 1
+        spooled = sorted(
+            p.stem for p in (sroot / "spool" / "done").glob("*.json"))
+        if spooled != ["a-1", "a-2"]:
+            print(f"FLEET FAIL: done/ holds {spooled}")
+            return 1
+        print(f"      reclaimed {len(reclaimed)} lease(s) from hA; "
+              f"both jobs done exactly once")
+
+        status = _tmx(["serve", "status", "--root", str(sroot), "--json"])
+        if status.returncode != 0:
+            print(f"FLEET FAIL: serve status exited {status.returncode}\n"
+                  f"{status.stdout}")
+            return 1
+        view = json.loads(status.stdout)
+        fleet = view.get("fleet") or {}
+        hosts = sorted((fleet.get("hosts") or {}))
+        if hosts != ["hA", "hB"] or not fleet.get("reclaims_total"):
+            print(f"FLEET FAIL: fleet view malformed: hosts={hosts} "
+                  f"reclaims={fleet.get('reclaims_total')}")
+            return 1
+        print(f"      fleet view: hosts {hosts}, "
+              f"reclaims {fleet['reclaims_total']}, "
+              f"ledgers {fleet.get('ledgers')}")
+
+        trace_out = root / "fleet_trace.json"
+        tr = _tmx(["trace", "--root", str(sroot), "--export", "chrome",
+                   str(trace_out)])
+        if tr.returncode != 0:
+            print(f"FLEET FAIL: chrome trace export exited "
+                  f"{tr.returncode}\n{tr.stdout}")
+            return 1
+        doc = json.loads(trace_out.read_text())
+        if not (doc.get("traceEvents") or []):
+            print("FLEET FAIL: chrome trace is empty")
+            return 1
+
+        if args.artifacts:
+            art = Path(args.artifacts)
+            art.mkdir(parents=True, exist_ok=True)
+            # the merged fleet history, exactly as consumers read it
+            with open(art / "fleet_ledger_merged.jsonl", "w") as f:
+                for ev in events:
+                    f.write(json.dumps(ev) + "\n")
+            (art / "fleet_status.json").write_text(status.stdout or "")
+            shutil.copy(trace_out, art / "fleet_trace.json")
+
+        from tmlibrary_tpu.models.store import ExperimentStore
+
+        ok = True
+        for jid, store in sorted(stores.items()):
+            resumed = ExperimentStore.open(store.root)
+            labels_ok = np.array_equal(
+                resumed.read_labels(None, "nuclei"), ref_labels)
+            got = resumed.read_features("nuclei").sort_values(
+                ["site_index", "label"]).reset_index(drop=True)
+            feats_ok = got.equals(ref_feats)
+            print(f"      job {jid}: labels converged {labels_ok}, "
+                  f"features converged {feats_ok}")
+            ok = ok and labels_ok and feats_ok
+        if ok:
+            print("FLEET PASS: SIGKILL'd host's work reclaimed and "
+                  "converged to the uninterrupted reference")
+            return 0
+        print("FLEET FAIL: served stores diverge from the reference")
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,70 @@
+"""Subprocess daemon body for the fleet serve chaos tests (launched by
+``tests/test_fleet_serve.py``) and reused by
+``scripts/ci_fleet_serve_smoke.py``.
+
+Registers the same idempotent dummy step the in-process tests use and
+runs one real :class:`~tmlibrary_tpu.serve.ServeDaemon` over the spool
+root the parent prepared.  The parent arms ``TMX_FAULT_PLAN`` before
+launching — a ``kill`` kind hard-exits this process (``os._exit(41)``)
+at the armed site with no cleanup, which is exactly the dead-host
+scenario the reaper and the lease-epoch fence must absorb: the parent
+(or a surviving peer daemon) observes the death, reclaims the leases,
+and must still finish every job exactly once.
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tmlibrary_tpu.workflow.api import Step  # noqa: E402
+from tmlibrary_tpu.workflow.registry import register_step  # noqa: E402
+
+
+@register_step("fleetdummy")
+class FleetDummy(Step):
+    """Four idempotent batches with a launch/persist split, so both the
+    ``batch_run`` and ``persist`` fault sites are real in the pipelined
+    path and a replayed batch leaves identical bytes."""
+
+    N_BATCHES = 4
+    SLEEP = float(os.environ.get("FLEET_DUMMY_SLEEP", "0") or 0)
+
+    def create_batches(self, args):
+        return [{} for _ in range(self.N_BATCHES)]
+
+    def run_batch(self, batch):
+        if self.SLEEP:
+            time.sleep(self.SLEEP)
+        out = self.step_dir / f"out_{batch['index']:03d}.txt"
+        out.write_text(f"payload-{batch['index']}")
+        return {"i": batch["index"]}
+
+    def launch_batch(self, batch, prefetched=None):
+        return batch, {"index": batch["index"]}
+
+    def persist_batch(self, eff, ctx):
+        return self.run_batch(eff)
+
+
+def main() -> None:
+    serve_root, host = sys.argv[1], sys.argv[2]
+    lease_s = float(sys.argv[3]) if len(sys.argv) > 3 else 2.0
+    max_jobs = int(sys.argv[4]) if len(sys.argv) > 4 else 0
+    idle_exit = float(sys.argv[5]) if len(sys.argv) > 5 else 10.0
+
+    from pathlib import Path
+
+    from tmlibrary_tpu import serve
+
+    rc = serve.run_serve(
+        Path(serve_root), poll_s=0.05, max_jobs=max_jobs,
+        idle_exit_s=idle_exit, host=host, lease_s=lease_s,
+    )
+    print(f"WORKER_EXIT host={host} rc={rc}", flush=True)
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

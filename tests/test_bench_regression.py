@@ -177,6 +177,54 @@ def test_cli_exit_no_baseline(tmp_path):
     assert proc.returncode == 3, proc.stdout + proc.stderr
 
 
+def test_cli_absent_and_empty_history_is_friendly_no_baseline(tmp_path):
+    """A fresh checkout has no BENCH_HISTORY.jsonl at all (and a touched
+    one is empty): both are the pinned exit 3 with a hint naming the
+    file, not a crash or a confusing 'no comparable records'."""
+    absent = str(tmp_path / "nowhere" / "BENCH_HISTORY.jsonl")
+    proc = _run(["--history", absent, "--no-queue"])
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "no_baseline" in proc.stdout
+    assert "absent" in proc.stdout and absent in proc.stdout
+
+    empty = tmp_path / "BENCH_HISTORY.jsonl"
+    empty.touch()
+    proc = _run(["--history", str(empty), "--no-queue"])
+    assert proc.returncode == 3, proc.stdout + proc.stderr
+    assert "empty" in proc.stdout
+    # --json keeps the verdict machine-readable on the same path
+    proc = _run(["--history", str(empty), "--no-queue", "--json"])
+    assert proc.returncode == 3
+    verdict = json.loads(proc.stdout)
+    assert verdict["status"] == "no_baseline"
+    assert verdict["history_records"] == 0
+    # BENCH_HISTORY env routes the default path the same way
+    proc = _run(["--no-queue"], BENCH_HISTORY=absent)
+    assert proc.returncode == 3
+    assert "absent" in proc.stdout
+
+
+def test_workflow_status_survives_absent_bench_history(
+        monkeypatch, tmp_path, capsys):
+    """``tmx workflow status`` must render (exit 0) when the bench
+    history and the on-hardware bench cache are both absent — the
+    staleness advisory line just stays silent."""
+    from tmlibrary_tpu.cli import main
+    from tmlibrary_tpu.models.experiment import Experiment
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    placeholder = Experiment(name="e", plates=[], channels=[],
+                             site_height=1, site_width=1)
+    store = ExperimentStore.create(tmp_path / "e", placeholder)
+    monkeypatch.setenv("BENCH_HISTORY",
+                       str(tmp_path / "no" / "BENCH_HISTORY.jsonl"))
+    monkeypatch.setenv("BENCH_TPU_CACHE",
+                       str(tmp_path / "no" / "BENCH_TPU.json"))
+    assert main(["workflow", "status", "--root", str(store.root)]) == 0
+    out = capsys.readouterr().out
+    assert "bench records stale" not in out
+
+
 def test_cli_baseline_file_and_json(tmp_path):
     baseline = _write(tmp_path / "b.jsonl",
                       [{**_rec(100.0), "recorded_at_unix": _fresh(500)}])

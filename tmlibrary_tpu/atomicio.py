@@ -55,3 +55,23 @@ def atomic_write_json(path: Path | str, obj: Any,
     """``atomic_write_text`` for a JSON payload (serialized first, so a
     serialization error can never leave a partial file either)."""
     atomic_write_text(path, json.dumps(obj, **dumps_kwargs), fsync=fsync)
+
+
+def claim_rename(src: Path | str, dst: Path | str) -> bool:
+    """Atomically move ``src`` to ``dst``; returns whether *this caller*
+    won the move.
+
+    This is the fleet spool protocol's claim arbiter (DESIGN.md §25):
+    several hosts polling one spool directory race to ``rename(2)`` the
+    same source file, POSIX guarantees exactly one rename observes the
+    source, and every loser gets ``ENOENT`` — converted here to a plain
+    ``False`` so "someone else claimed it" is a decision, not an error.
+    The destination may already exist (a stale copy left by a crashed
+    reaper); rename atomically replaces it, which is exactly the
+    last-write-wins recovery those torn sweeps need.
+    """
+    try:
+        os.replace(src, dst)
+        return True
+    except FileNotFoundError:
+        return False

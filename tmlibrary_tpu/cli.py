@@ -449,6 +449,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "(0 = never)")
     p_srun.add_argument("--no-telemetry", action="store_true",
                         help="disable the metrics registry for the daemon")
+    p_srun.add_argument("--host", default=None, metavar="ID",
+                        help="fleet host identity: claims are leased as "
+                             "this id and events/heartbeats land in "
+                             "per-host files (default TMX_HOST_ID when a "
+                             "fleet is active, else single-host mode)")
+    p_srun.add_argument("--lease", type=float, default=None,
+                        metavar="SECONDS",
+                        help="claim lease duration; an expired lease whose "
+                             "owner's heartbeat is stale is reclaimed by "
+                             "a peer (default TM_SERVE_LEASE_S, 15)")
     p_sstatus = serve_sub.add_parser(
         "status", help="queue depth, per-tenant admitted/rejected/"
                        "budget-remaining, oldest-job age")
@@ -501,6 +511,12 @@ def build_parser() -> argparse.ArgumentParser:
                        help="query jobs: payload as inline JSON")
     p_enq.add_argument("--payload-file", default=None,
                        help="query jobs: payload from a JSON file")
+    p_enq.add_argument("--affinity-key", default=None, metavar="KEY",
+                       help="compiled-program affinity key for fleet "
+                            "routing (default: auto-derived content "
+                            "digest of the workflow description + "
+                            "jterator pipelines; hosts prefer jobs whose "
+                            "key is warm in their compile caches)")
 
     p_query = sub.add_parser(
         "query", help="one-shot analytics query over an experiment's "
@@ -1045,6 +1061,24 @@ def cmd_serve(args) -> int:
         if view.get("preemptions"):
             print(f"preemptions: {view['preemptions']} (drained + "
                   "re-spooled; jobs converge on restart)")
+        fleet = view.get("fleet") or {}
+        hosts = fleet.get("hosts") or {}
+        if hosts:
+            aff = fleet.get("affinity") or {}
+            rate = aff.get("hit_rate")
+            print(f"fleet: {len(hosts)} host(s)  "
+                  f"reclaims {fleet.get('reclaims_total', 0)}  "
+                  f"stale claims {fleet.get('stale_claims_total', 0)}  "
+                  f"affinity "
+                  + (f"{rate:.0%}" if rate is not None else "-")
+                  + f" ({aff.get('hits', 0)}/{aff.get('known', 0)})")
+            for name in sorted(hosts):
+                h = hosts[name]
+                age = h.get("heartbeat_age_s")
+                print(f"  {name:14s} "
+                      f"{'LIVE' if h.get('live') else 'dead':4s}  "
+                      f"hb " + (f"{age:.1f}s" if age is not None else "-")
+                      + f"  leases {h.get('leases', 0)}")
         _render_heartbeats(serve_mod.serve_dir(root),
                            running=bool(view.get("live")))
         return 0
@@ -1077,6 +1111,7 @@ def cmd_serve(args) -> int:
     rc = serve_mod.run_serve(
         root, admission=admission, poll_s=args.poll,
         max_jobs=args.max_jobs, idle_exit_s=args.idle_exit,
+        host=args.host, lease_s=args.lease,
     )
     if rc == EXIT_PREEMPTED:
         print("serve preempted: queued jobs re-spooled — restart "
@@ -1149,6 +1184,7 @@ def cmd_enqueue(args) -> int:
         trace_id=trace_id,
         kind=kind,
         payload=payload,
+        affinity_key=getattr(args, "affinity_key", None),
     )
     try:
         path = serve_mod.enqueue_job(Path(args.root), spec)
@@ -1736,17 +1772,18 @@ def cmd_slo(args) -> int:
 
     Exit codes (pinned, same discipline as qc/bench_regression):
     0 ok · 1 some tenant's burn >= 1 · 3 no job-completion data."""
+    from tmlibrary_tpu import serve as serve_mod
     from tmlibrary_tpu import slo as slo_mod
 
     root = Path(args.root)
-    lp = root / "serve" / "ledger.jsonl"
-    if not lp.exists():
+    if not serve_mod.serve_ledger_paths(root):
         # experiment roots have no job completions — say so with the
         # pinned no-data code rather than a generic error
         print(f"no serve ledger under {root} — `tmx slo` reads a serve "
               "root", file=sys.stderr)
         return slo_mod.EXIT_NO_DATA
-    view = slo_mod.report(RunLedger(lp).events())
+    # merged per-host history: fleet burn is one report, not N
+    view = slo_mod.report(serve_mod.serve_ledger_events(root))
     if getattr(args, "as_json", False):
         print(json.dumps(view, indent=2))
     else:

@@ -227,6 +227,14 @@ class LibraryConfig:
             _setting("serve_admission_deadline_s", "60")
         )
     )
+    #: fleet spool lease duration, seconds: how long one host's claim on
+    #: an admitted job stays valid without renewal.  A peer's reaper may
+    #: reclaim the job once the lease is expired AND the claiming host's
+    #: heartbeat has gone stale — so this bounds how long a dead host can
+    #: sit on a job.  Renewal rides the heartbeat cadence (lease/3).
+    serve_lease_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("serve_lease_s", "15"))
+    )
     # ---------------------------------------------------------- SLO
     # (slo.py; env: TM_SLO_* here, with TMX_SLO_* runtime overrides —
     # including per-tenant TMX_SLO_<KNOB>_<TENANT> — taking precedence)

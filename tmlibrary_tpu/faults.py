@@ -42,6 +42,31 @@ Hook sites (``site`` field of a spec):
     non-fatal raising kind converts to a pinned ``admission_fault``
     rejection — chaos can flood or wedge the queue but never crash
     the daemon.  Neither site forces the sequential engine path.
+``claim``
+    fired between winning the fleet spool's ``incoming/ → admitted/``
+    claim rename and durably writing the lease file (context: ``step``
+    = tenant, ``event`` = job id) — the exact torn-claim window the
+    reaper's orphan pass must cover.  Non-fatal kinds leave the
+    admitted spec claim-less for the reaper; ``kill`` is a host dying
+    mid-claim.
+``lease_renew``
+    fired inside the serve daemon's lease-renewal pass (context:
+    ``step`` = host id).  ``hang`` is the canonical GC-pause
+    simulation: renewal wedges past the lease deadline, peers reclaim,
+    and the owner's next terminal transition gets fenced.
+``reclaim``
+    fired inside the reaper, once per job about to be swept back to
+    ``incoming/`` (context: ``step`` = tenant, ``event`` = job id) —
+    non-fatal kinds defer the sweep to the next pass, ``kill`` is a
+    reaper dying mid-reclaim (torn state the claim arbiter and the
+    live-claim duplicate check must absorb).
+``done_rename``
+    fired just before a job's fenced terminal ``done``/``failed``/
+    ``expired`` transition (context: ``step`` = tenant, ``event`` =
+    job id).  ``hang`` sleeps past the lease so the epoch fence
+    rejects the transition (``stale_claim``); ``kill`` is a host dying
+    with the result computed but unpublished.
+    None of these fleet sites forces the sequential engine path.
 
 Two kinds are special.  ``kill`` hard-exits the process
 (``os._exit(41)``) instead of raising — no exception propagation, no

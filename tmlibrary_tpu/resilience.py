@@ -604,6 +604,62 @@ class PhaseWatchdog:
             self._thread = None
 
 
+class LeaseRenewer:
+    """Background renewal loop for time-bounded claims (fleet spool
+    leases, DESIGN.md §25).
+
+    The serve daemon's main loop blocks for the whole duration of a job
+    execution, which can be minutes — far past any sane lease.  This
+    thread keeps the daemon's claims (and its heartbeat) fresh while the
+    main thread works: every ``period`` seconds it invokes ``renew``,
+    which must be safe to call from a non-engine thread (claim files and
+    heartbeats are plain ``atomicio`` writes; the ledger is never touched
+    here — thread discipline from DESIGN.md §13).
+
+    A renewal that raises is *counted and skipped*, never propagated: a
+    transient IO flake must not kill the renewer, because a dead renewer
+    turns into an expired lease and a spurious reclaim.  The failure
+    count is observable for tests and post-mortems.  ``renew_now`` runs
+    one synchronous renewal for deterministic tests.
+    """
+
+    def __init__(self, renew: Callable[[], None], period: float):
+        self.renew = renew
+        self.period = max(0.05, float(period))
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="tmx-lease-renewer", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.period):
+            self.renew_now()
+
+    def renew_now(self) -> bool:
+        """One renewal pass; returns False (and counts) on failure."""
+        try:
+            self.renew()
+            return True
+        except Exception:
+            self.failures += 1
+            logger.warning("lease renewal failed (%d so far)",
+                           self.failures, exc_info=True)
+            return False
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+
 def watchdog_enabled() -> bool:
     """Master gate: ``TMX_WATCHDOG`` env beats the install config
     (``TM_WATCHDOG`` / INI ``watchdog``); off by default, and off means

@@ -40,30 +40,68 @@ admitted-but-unfinished job is re-spooled to ``incoming/``, a
 ``serve_preempted`` event seals the serve ledger, and the daemon exits
 :data:`~tmlibrary_tpu.resilience.EXIT_PREEMPTED` (75) for its wrapper
 to restart.  A hard kill is equally safe: startup recovery re-spools
-whatever was left in ``admitted/``.
+whatever was left in ``admitted/`` — scoped to jobs whose claim is
+absent or provably expired, so a restarting host never steals a live
+peer's work.
 
-Fault-injection sites: ``enqueue`` (fires inside :func:`enqueue_job`)
-and ``admission`` (fires inside the daemon's scan loop, ``step`` = the
-tenant, ``event`` = the job id).  An injected admission fault converts
-to a ``admission_fault`` rejection — overload or chaos must never crash
-the daemon.  The admission loop is armed by the phase watchdog
-(``admission`` phase) when the watchdog master switch is on.
+**Fleet spool protocol** (DESIGN.md §25): several daemons may share one
+spool.  Pickup is an atomic *claim*: the host that wins the
+``incoming/ → admitted/`` rename (``atomicio.claim_rename``) owns the
+job and records a lease — ``admitted/<job>.claim.<host_id>`` with a
+deadline renewed on the heartbeat cadence by a background
+:class:`~tmlibrary_tpu.resilience.LeaseRenewer`.  Every claim stamps a
+monotonically increasing ``claim_epoch`` into the job spec; the owner
+re-checks its claim (file present, epoch matching) before every
+``done``/``failed``/``expired`` transition, so a stale host resuming
+after a GC pause gets a pinned ``stale_claim`` ledger event instead of
+clobbering a reclaimed job's result.  A **reaper** in the poll loop
+detects dead peers (lease deadline passed AND the per-host
+``heartbeat.<host>.json`` stale) and sweeps their claimed jobs back to
+``incoming/`` with attempt counts preserved — daemon death never
+charges tenant retry budgets — emitting ``job_reclaimed`` events that
+``registry_from_ledger`` replays.  Each fleet host seals its own
+``serve/ledger.<host>.jsonl``; status/SLO/replay consumers merge them
+(:func:`serve_ledger_events`), keeping admission/WDRR/shed decisions
+pure functions of the merged per-host ledger history.
+
+**Affinity routing**: jobs carry a compiled-program affinity key
+(:func:`affinity_key_for` — a content digest over the workflow
+description + jterator pipeline files, i.e. the inputs of
+``program_digest_extras``'s compile key).  A host greedily claims jobs
+whose key is warm in its process-level AOT/compile caches first, and
+defers cold-key jobs to affine peers — bounded: once a job has waited
+one lease period, any host claims it.
+
+Fault-injection sites: ``enqueue`` (fires inside :func:`enqueue_job`),
+``admission`` (inside the daemon's scan loop, ``step`` = the tenant,
+``event`` = the job id), ``claim`` (between winning the claim rename
+and durably writing the claim file — the window recovery/reaping must
+cover), ``lease_renew`` (inside the renewal pass; a hang here is the
+GC-pause simulation), ``reclaim`` (inside the reaper, per reclaimed
+job) and ``done_rename`` (just before the fenced terminal transition).
+An injected admission fault converts to a ``admission_fault`` rejection
+— overload or chaos must never crash the daemon.  The admission loop is
+armed by the phase watchdog (``admission`` phase) when the watchdog
+master switch is on.
 """
 
 from __future__ import annotations
 
 import functools
+import hashlib
 import logging
 import sys
+import threading
 import time
 from contextlib import nullcontext
 from pathlib import Path
 
 from tmlibrary_tpu import faults, slo, telemetry
-from tmlibrary_tpu.atomicio import atomic_write_json
+from tmlibrary_tpu.atomicio import atomic_write_json, claim_rename
 from tmlibrary_tpu.errors import FaultInjected, PreemptedError
 from tmlibrary_tpu.resilience import (
     EXIT_PREEMPTED,
+    LeaseRenewer,
     PhaseWatchdog,
     install_preemption_handlers,
     preemption_reason,
@@ -106,16 +144,75 @@ def serve_dir(serve_root: Path) -> Path:
     return Path(serve_root) / "serve"
 
 
-def ledger_path(serve_root: Path) -> Path:
-    return serve_dir(serve_root) / "ledger.jsonl"
+def ledger_path(serve_root: Path, host: str | None = None) -> Path:
+    """One fleet host's serve ledger: the legacy single-host name for
+    ``host0``/no-host (so existing consumers keep working), a per-host
+    ``ledger.<host>.jsonl`` for every other fleet member — same naming
+    convention as :func:`telemetry.heartbeat_path`."""
+    if host in (None, "host0"):
+        return serve_dir(serve_root) / "ledger.jsonl"
+    return serve_dir(serve_root) / f"ledger.{host}.jsonl"
 
 
-def heartbeat_file(serve_root: Path) -> Path:
-    return serve_dir(serve_root) / "heartbeat.json"
+def serve_ledger_paths(serve_root: Path) -> list[Path]:
+    """Every per-host serve ledger under the root, sorted by name."""
+    return sorted(serve_dir(serve_root).glob("ledger*.jsonl"))
+
+
+def serve_ledger_events(serve_root: Path) -> list[dict]:
+    """The merged per-host serve ledger history, ordered by timestamp
+    (stable within a host's ledger).  This is THE fleet read path:
+    status, SLO burn, replay and the exactly-once chaos proofs all
+    consume this merge, so admission/shed decisions stay pure functions
+    of one well-defined event history regardless of how many hosts
+    wrote it."""
+    from tmlibrary_tpu.workflow.engine import RunLedger
+
+    events: list[dict] = []
+    for lp in serve_ledger_paths(serve_root):
+        events.extend(RunLedger(lp).events())
+    events.sort(key=lambda ev: float(ev.get("ts", 0.0) or 0.0))
+    return events
+
+
+def heartbeat_file(serve_root: Path, host: str | None = None) -> Path:
+    """One fleet host's serve heartbeat (legacy name for host0/no-host,
+    ``heartbeat.<host>.json`` otherwise)."""
+    if host in (None, "host0"):
+        return serve_dir(serve_root) / "heartbeat.json"
+    return serve_dir(serve_root) / f"heartbeat.{host}.json"
 
 
 def status_file(serve_root: Path) -> Path:
     return serve_dir(serve_root) / "status.json"
+
+
+def claim_path(serve_root: Path, job_id: str, host: str) -> Path:
+    """The lease file recording ``host``'s claim on an admitted job."""
+    return spool_dir(serve_root, "admitted") / f"{job_id}.claim.{host}"
+
+
+def job_claims(serve_root: Path,
+               job_id: str | None = None) -> list[tuple[Path, str, str]]:
+    """All claim files in the spool as ``(path, job_id, host)``, sorted;
+    optionally filtered to one job."""
+    out: list[tuple[Path, str, str]] = []
+    pattern = f"{job_id}.claim.*" if job_id else "*.claim.*"
+    for p in sorted(spool_dir(serve_root, "admitted").glob(pattern)):
+        jid, _, host = p.name.rpartition(".claim.")
+        if jid and host:
+            out.append((p, jid, host))
+    return out
+
+
+def read_claim(path: Path) -> dict | None:
+    import json
+
+    try:
+        claim = json.loads(Path(path).read_text())
+    except (OSError, ValueError):
+        return None
+    return claim if isinstance(claim, dict) else None
 
 
 def ensure_layout(serve_root: Path) -> None:
@@ -130,6 +227,40 @@ def is_serve_root(root: Path) -> bool:
     return (root / "spool").is_dir() or ledger_path(root).exists()
 
 
+def affinity_key_for(root: str | Path,
+                     description: str | None = None) -> str | None:
+    """Best-effort compiled-program affinity key for a workflow job.
+
+    A content digest over the inputs that determine which compiled
+    program family the job routes to: the workflow description YAML plus
+    every jterator pipeline description (``*.pipe.yaml``) under the
+    experiment root — the same file contents ``description_digest`` /
+    ``program_digest_extras`` fold into the real compile key, without
+    importing jax at enqueue time.  A proxy on purpose: two jobs with
+    identical keys share their pipeline content (a warm-cache hit is
+    real); distinct keys for identical programs merely cost an affinity
+    miss, never correctness.  Returns None when nothing is readable —
+    affinity is a routing hint, not a requirement."""
+    try:
+        root = Path(root)
+        desc = Path(description) if description else (
+            root / "workflow" / "workflow.yaml")
+        if not desc.is_absolute():
+            desc = root / desc
+        h = hashlib.sha1()
+        h.update(desc.read_bytes())
+        # bounded: pipeline descriptions are small and few; a runaway
+        # directory must not turn enqueue into a crawl
+        for i, p in enumerate(sorted(root.rglob("*.pipe.yaml"))):
+            if i >= 64:
+                break
+            h.update(p.name.encode())
+            h.update(p.read_bytes())
+        return h.hexdigest()[:16]
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------- enqueue
 def enqueue_job(serve_root: Path, spec: JobSpec) -> Path:
     """Drop one job spec into the spool (the ``tmx enqueue`` backend).
@@ -140,6 +271,8 @@ def enqueue_job(serve_root: Path, spec: JobSpec) -> Path:
     ensure_layout(serve_root)
     if not spec.submitted_at:
         spec.submitted_at = time.time()
+    if spec.affinity_key is None and spec.kind == "workflow":
+        spec.affinity_key = affinity_key_for(spec.root, spec.description)
     faults.maybe_fire("enqueue", step=spec.tenant, event=spec.job_id)
     path = spool_dir(serve_root, "incoming") / f"{spec.job_id}.json"
     atomic_write_json(path, spec.to_dict())
@@ -154,7 +287,8 @@ class ServeDaemon:
                  admission: AdmissionConfig | None = None,
                  poll_s: float | None = None,
                  max_jobs: int = 0, idle_exit_s: float = 0.0,
-                 install_handlers: bool = True):
+                 install_handlers: bool = True,
+                 host: str | None = None, lease_s: float | None = None):
         from tmlibrary_tpu.config import cfg
         from tmlibrary_tpu.workflow.engine import RunLedger
 
@@ -167,10 +301,36 @@ class ServeDaemon:
         self.max_jobs = int(max_jobs)
         self.idle_exit_s = float(idle_exit_s)
         self.install_handlers = bool(install_handlers)
-        self.ledger = RunLedger(
-            ledger_path(self.serve_root), fsync=cfg.ledger_fsync,
-            host=(telemetry.host_id() if telemetry.fleet_active() else None),
+        #: this daemon's fleet identity: the explicit ``host`` parameter
+        #: (in-process multi-daemon tests), else the process identity
+        #: when a fleet is active, else None — single-host daemons keep
+        #: the seed-era ledger/heartbeat names and host-less events
+        self.host: str | None = host or (
+            telemetry.host_id() if telemetry.fleet_active() else None
         )
+        #: the name stamped into claim files (claims always name an
+        #: owner, even single-host ones — the protocol is uniform)
+        self.host_name: str = self.host or "host0"
+        self.lease_s = float(cfg.serve_lease_s if lease_s is None
+                             else lease_s)
+        self.ledger = RunLedger(
+            ledger_path(self.serve_root, self.host), fsync=cfg.ledger_fsync,
+            host=self.host,
+        )
+        #: job_id → claim epoch for every lease this daemon holds; the
+        #: lock covers the renewal thread reading while the main loop
+        #: claims/releases
+        self._claims: dict[str, int] = {}
+        self._claims_lock = threading.Lock()
+        self._renewer: LeaseRenewer | None = None
+        #: affinity keys whose compiled programs this process has
+        #: (likely) warmed — fed by completed executions, consulted by
+        #: the claim loop's greedy preference
+        self._warm_keys: set[str] = set()
+        #: job_id → first time this daemon saw (and deferred) a cold-key
+        #: job, the staleness bound's fallback clock when a spec carries
+        #: no submitted_at
+        self._deferred_seen: dict[str, float] = {}
         #: admission-phase watchdog — a wedged scan (hung filesystem,
         #: injected hang) fires telemetry + the breaker path instead of
         #: stalling silently
@@ -197,6 +357,10 @@ class ServeDaemon:
         return self._watchdog.arm(phase, step="serve")
 
     def _metric(self, kind: str, name: str, value: float = 1.0, **labels):
+        if self.host is not None:
+            # fleet mode: live series carry the host label, exactly as
+            # registry_from_ledger derives them from host-stamped events
+            labels.setdefault("host", self.host)
         reg = telemetry.get_registry()
         if kind == "counter":
             reg.counter(name, **labels).inc(value)
@@ -208,7 +372,10 @@ class ServeDaemon:
     def _move_spool(self, job_id: str, dst_state: str,
                     envelope: dict) -> None:
         """Land ``job_id``'s spool file in ``dst_state`` with an
-        envelope payload, removing it from every transient state."""
+        envelope payload, removing it from every transient state (the
+        job's claim files included — a terminal transition ends the
+        lease; any *foreign* claim file still present is stale by the
+        epoch monotonicity invariant, since we verified ours first)."""
         atomic_write_json(
             spool_dir(self.serve_root, dst_state) / f"{job_id}.json",
             envelope,
@@ -217,16 +384,114 @@ class ServeDaemon:
             f = spool_dir(self.serve_root, state) / f"{job_id}.json"
             if f.exists() and state != dst_state:
                 f.unlink()
+        for p, _, _ in job_claims(self.serve_root, job_id):
+            p.unlink(missing_ok=True)
+
+    # ------------------------------------------------------------- leases
+    def _write_claim(self, job_id: str, epoch: int) -> None:
+        now = time.time()
+        atomic_write_json(
+            claim_path(self.serve_root, job_id, self.host_name), {
+                "job": job_id, "host": self.host_name, "epoch": int(epoch),
+                "claimed_at": round(now, 6), "lease_s": self.lease_s,
+                "lease_deadline": round(now + self.lease_s, 6),
+            },
+        )
+
+    def _renew_leases(self) -> None:
+        """One renewal pass: refresh every held claim's lease deadline
+        plus this host's heartbeat.  Runs on the LeaseRenewer thread
+        while the main loop executes jobs — only ``atomicio`` writes,
+        never the ledger (thread discipline).  The ``lease_renew``
+        fault site fires here: a hang wedges renewal past the lease,
+        which is exactly what a long GC pause looks like to peers."""
+        faults.maybe_fire("lease_renew", step=self.host_name)
+        with self._claims_lock:
+            held = dict(self._claims)
+        for job_id, epoch in held.items():
+            self._write_claim(job_id, epoch)
+        self._write_serve_heartbeat(queue_depth=None)
+
+    def _verify_claim(self, job: JobSpec) -> bool:
+        """The fencing check before every terminal transition: do we
+        still hold this job's lease at the epoch we claimed it?  A
+        reaper that reclaimed the job removed our claim file first, so
+        a stale owner fails here — file gone, or epoch superseded."""
+        with self._claims_lock:
+            epoch = self._claims.get(job.job_id)
+        if epoch is None:
+            return False
+        claim = read_claim(
+            claim_path(self.serve_root, job.job_id, self.host_name))
+        return (claim is not None
+                and claim.get("host") == self.host_name
+                and int(claim.get("epoch", -1)) == int(epoch))
+
+    def _fence(self, job: JobSpec, outcome: str) -> bool:
+        """The gate in front of every terminal spool transition.  Fires
+        the ``done_rename`` fault site (a hang here IS the GC-pause
+        scenario the protocol exists for: sleep past the lease, wake,
+        and find the job reclaimed), then verifies the lease.  False
+        means the transition must be dropped (``stale_claim`` sealed).
+
+        A residual window remains between this check and the rename —
+        DESIGN.md §25 documents why it is safe: a reaper re-runs the job
+        from the experiment ledger's resume path, so even a transition
+        that slips through converges to the same bytes."""
+        try:
+            faults.maybe_fire("done_rename", step=job.tenant,
+                              event=job.job_id)
+        except FaultInjected as exc:
+            if exc.fatal:
+                raise
+        except Exception:
+            pass  # a hang's post-sleep error: the pause already happened
+        if self._verify_claim(job):
+            return True
+        self._stale_claim(job, outcome)
+        return False
+
+    def _stale_claim(self, job: JobSpec, outcome: str) -> None:
+        """Fenced: our lease was reclaimed while we ran.  Pinned
+        ``stale_claim`` event, drop the result, touch neither spool nor
+        queue accounting — the job belongs to its new owner now, and a
+        daemon death (or pause) must never charge the tenant."""
+        with self._claims_lock:
+            epoch = self._claims.pop(job.job_id, None)
+        logger.warning(
+            "stale claim: job %s (epoch %s) was reclaimed while this "
+            "host ran it — dropping the %s transition",
+            job.job_id, epoch, outcome,
+        )
+        self.ledger.append(event="stale_claim", job=job.job_id,
+                           tenant=job.tenant, epoch=epoch,
+                           outcome=outcome)
+        self._metric("counter", "tmx_serve_stale_claims_total",
+                     tenant=job.tenant)
+
+    def _release_claim(self, job_id: str) -> None:
+        with self._claims_lock:
+            self._claims.pop(job_id, None)
+        claim_path(self.serve_root, job_id,
+                   self.host_name).unlink(missing_ok=True)
+
+    def _write_serve_heartbeat(self, queue_depth: int | None) -> None:
+        extra = {"role": "serve", "host": self.host_name,
+                 "lease_s": self.lease_s}
+        if queue_depth is not None:
+            extra["queue_depth"] = queue_depth
+        telemetry.write_heartbeat(
+            heartbeat_file(self.serve_root, self.host),
+            period=self.poll_s, extra=extra,
+        )
 
     def _publish_state(self) -> None:
         """Heartbeat + live status/queue gauges, every loop iteration."""
         snap = self.queue.snapshot()
-        telemetry.write_heartbeat(
-            heartbeat_file(self.serve_root), period=self.poll_s,
-            extra={"queue_depth": snap["depth"], "role": "serve"},
-        )
+        self._write_serve_heartbeat(queue_depth=snap["depth"])
         atomic_write_json(status_file(self.serve_root), {
-            "ts": time.time(), "jobs_run": self._jobs_run, **snap,
+            "ts": time.time(), "jobs_run": self._jobs_run,
+            "host": self.host_name, **snap,
         })
         self._metric("gauge", "tmx_serve_queue_depth", snap["depth"])
         age = snap.get("oldest_job_age_s")
@@ -244,7 +509,10 @@ class ServeDaemon:
             return
         self._last_slo_check = now
         try:
-            view = slo.report(self.ledger.events(), now=time.time())
+            # merged per-host history: one fleet-wide SLO truth no matter
+            # which host evaluates it
+            view = slo.report(serve_ledger_events(self.serve_root),
+                              now=time.time())
             burning: set[tuple[str, str]] = set()
             for b in slo.breaches(view):
                 key = (b["tenant"], b["window"])
@@ -269,9 +537,11 @@ class ServeDaemon:
     def _write_metrics(self) -> None:
         if not telemetry.enabled():
             return
+        name = ("metrics.json" if self.host in (None, "host0")
+                else f"metrics.{self.host}.json")
         try:
             atomic_write_json(
-                serve_dir(self.serve_root) / "metrics.json",
+                serve_dir(self.serve_root) / name,
                 telemetry.get_registry().snapshot(),
             )
         except Exception:
@@ -281,9 +551,32 @@ class ServeDaemon:
     def _recover_spool(self) -> int:
         """Re-spool jobs a previous daemon admitted but never finished
         (crash or preemption) back into ``incoming/`` — startup is the
-        crash-consistent counterpart of the SIGTERM drain."""
+        crash-consistent counterpart of the SIGTERM drain.
+
+        Fleet-scoped: the sweep only takes jobs whose claim is *ours*
+        (a previous incarnation of this host died holding the lease),
+        absent (claim-less admitted specs are torn-claim or torn-reclaim
+        residue), or provably expired.  A job under a live peer's lease
+        is that peer's work — the seed-era unconditional sweep would
+        steal it and run it twice."""
         recovered = 0
+        now = time.time()
+        claims_by_job: dict[str, list[tuple[Path, str]]] = {}
+        for cpath, jid, owner in job_claims(self.serve_root):
+            claims_by_job.setdefault(jid, []).append((cpath, owner))
         for f in sorted(spool_dir(self.serve_root, "admitted").glob("*.json")):
+            live_peer = False
+            for cpath, owner in claims_by_job.get(f.stem, []):
+                if owner == self.host_name:
+                    cpath.unlink(missing_ok=True)  # our own dead lease
+                    continue
+                claim = read_claim(cpath)
+                if claim is not None and not self._claim_expired(claim, now):
+                    live_peer = True
+                else:
+                    cpath.unlink(missing_ok=True)
+            if live_peer:
+                continue
             target = spool_dir(self.serve_root, "incoming") / f.name
             if target.exists():
                 f.unlink()  # incoming copy already exists (torn drain)
@@ -312,9 +605,6 @@ class ServeDaemon:
         try:
             faults.maybe_fire("admission", step=spec.tenant,
                               event=spec.job_id)
-            if (spool_dir(self.serve_root, "admitted")
-                    / f"{spec.job_id}.json").exists():
-                return reject(REASON_DUPLICATE)
             return self.queue.offer(spec)
         except FaultInjected as exc:
             if exc.fatal:
@@ -325,18 +615,209 @@ class ServeDaemon:
                            spec.job_id, exc)
             return reject(REASON_FAULT)
 
+    def _claimed_elsewhere(self, job_id: str) -> bool:
+        """Live-claim duplicate test for an incoming spec: an admitted
+        copy only blocks re-submission while somebody actually holds its
+        lease.  A claim-less or expired admitted copy is torn-claim or
+        torn-reclaim residue — it must stay claimable, and the claim
+        rename atomically replaces it."""
+        with self._claims_lock:
+            if job_id in self._claims:
+                return True
+        if not (spool_dir(self.serve_root, "admitted")
+                / f"{job_id}.json").exists():
+            return False
+        now = time.time()
+        for cpath, _, _ in job_claims(self.serve_root, job_id):
+            claim = read_claim(cpath)
+            if claim is not None and not self._claim_expired(claim, now):
+                return True
+        return False
+
+    def _live_peers(self) -> list[str]:
+        """Other fleet hosts with a fresh serve heartbeat on this root."""
+        peers: list[str] = []
+        for hb in serve_dir(self.serve_root).glob("heartbeat*.json"):
+            data = telemetry.read_heartbeat(hb)
+            if data is None:
+                continue
+            owner = str(data.get("host") or "host0")
+            if owner == self.host_name:
+                continue
+            age = telemetry.heartbeat_age(hb)
+            period = float(data.get("period", 0) or 0)
+            if age is not None and age <= max(5.0, 4 * period):
+                peers.append(owner)
+        return peers
+
+    def _should_defer(self, spec: JobSpec, now: float,
+                      live_peers: list[str]) -> bool:
+        """Affinity routing's cold-key deferral, staleness-bounded: skip
+        a job whose compiled-program key is cold here while live peers
+        exist (one of them is likelier to have it warm) — but never for
+        longer than one lease period, after which any host claims it.
+        A host with nothing warm yet has no basis for preference and
+        claims everything."""
+        key = spec.affinity_key
+        if key is None or not self._warm_keys or key in self._warm_keys:
+            self._deferred_seen.pop(spec.job_id, None)
+            return False
+        if not live_peers:
+            return False
+        first = self._deferred_seen.setdefault(spec.job_id, now)
+        waited = now - (float(spec.submitted_at)
+                        if spec.submitted_at else first)
+        if waited >= self.lease_s:
+            self._deferred_seen.pop(spec.job_id, None)
+            return False
+        return True
+
+    def _try_claim(self, path: Path, spec: JobSpec) -> bool:
+        """Claim one incoming spec for this host: win the atomic
+        ``incoming/ → admitted/`` rename, bump the claim epoch into the
+        spec, and record the lease.  False means a peer won the race (or
+        an injected claim fault left the job for the reaper's orphan
+        pass).  The ``claim`` fault site fires in the exact window the
+        protocol must cover: rename won, lease not yet durable."""
+        admitted = (spool_dir(self.serve_root, "admitted")
+                    / f"{spec.job_id}.json")
+        if not claim_rename(path, admitted):
+            return False
+        epoch = int(spec.claim_epoch) + 1
+        spec.claim_epoch = epoch
+        try:
+            faults.maybe_fire("claim", step=spec.tenant, event=spec.job_id)
+            atomic_write_json(admitted, spec.to_dict())
+            self._write_claim(spec.job_id, epoch)
+        except FaultInjected as exc:
+            if exc.fatal:
+                raise
+            logger.warning(
+                "claim fault for job %s: leaving the admitted spec for "
+                "the reaper's orphan pass (%s)", spec.job_id, exc)
+            return False
+        except Exception as exc:
+            logger.warning("claim write failed for job %s: %s",
+                           spec.job_id, exc)
+            return False
+        with self._claims_lock:
+            self._claims[spec.job_id] = epoch
+        self._deferred_seen.pop(spec.job_id, None)
+        return True
+
+    # -------------------------------------------------------------- reaper
+    def _claim_expired(self, claim: dict, now: float) -> bool:
+        """A lease is reclaimable only when *both* signals agree the
+        owner is gone: the lease deadline has passed AND the owner's
+        heartbeat is older than the lease (or absent).  A host that
+        still heartbeats but wedged one renewal keeps its jobs."""
+        deadline = float(claim.get("lease_deadline", 0) or 0)
+        if now < deadline:
+            return False
+        owner = str(claim.get("host") or "host0")
+        lease = float(claim.get("lease_s") or self.lease_s)
+        age = telemetry.heartbeat_age(
+            heartbeat_file(self.serve_root, owner))
+        return age is None or age > lease
+
+    def _reap_expired(self) -> int:
+        """One reaper pass: sweep dead peers' expired leases (and
+        claim-less orphaned admitted specs) back to ``incoming/``."""
+        now = time.time()
+        reclaimed = 0
+        for cpath, jid, owner in job_claims(self.serve_root):
+            if owner == self.host_name:
+                continue  # own leases are renewed, never reaped
+            claim = read_claim(cpath)
+            if claim is None or self._claim_expired(claim, now):
+                reclaimed += self._reclaim(jid, claim, cpath)
+        # orphan pass: an admitted spec with no claim file at all is the
+        # residue of a host that died between winning the claim rename
+        # and durably writing its lease; one lease period of grace
+        # covers a live claimant still mid-write
+        for f in spool_dir(self.serve_root, "admitted").glob("*.json"):
+            with self._claims_lock:
+                if f.stem in self._claims:
+                    continue
+            if job_claims(self.serve_root, f.stem):
+                continue
+            try:
+                age = now - f.stat().st_mtime
+            except OSError:
+                continue
+            if age > self.lease_s:
+                reclaimed += self._reclaim(f.stem, None, None)
+        return reclaimed
+
+    def _reclaim(self, job_id: str, claim: dict | None,
+                 claim_file: Path | None) -> int:
+        """Sweep one dead host's job back to ``incoming/``: unlink the
+        stale claim FIRST (that is the fence — the stale owner's
+        ``_verify_claim`` fails from this point on), then re-spool the
+        spec with its epoch and attempt count preserved (daemon death
+        never charges a tenant's retry budget), then drop the admitted
+        copy and seal a ``job_reclaimed`` event."""
+        admitted = (spool_dir(self.serve_root, "admitted")
+                    / f"{job_id}.json")
+        spec = self._load_spec(admitted) if admitted.exists() else None
+        if spec is None:
+            # claim residue without an admitted spec: the job already
+            # reached a terminal state — just drop the stale file
+            if claim_file is not None:
+                claim_file.unlink(missing_ok=True)
+            return 0
+        try:
+            faults.maybe_fire("reclaim", step=spec.tenant, event=job_id)
+        except FaultInjected as exc:
+            if exc.fatal:
+                raise
+            return 0  # injected reclaim fault: retry next pass
+        if claim_file is not None:
+            claim_file.unlink(missing_ok=True)
+        atomic_write_json(
+            spool_dir(self.serve_root, "incoming") / f"{job_id}.json",
+            spec.to_dict(),
+        )
+        admitted.unlink(missing_ok=True)
+        from_host = (claim or {}).get("host")
+        self.ledger.append(event="job_reclaimed", job=job_id,
+                           tenant=spec.tenant, from_host=from_host,
+                           epoch=spec.claim_epoch, attempt=spec.attempt)
+        self._metric("counter", "tmx_serve_reclaims_total",
+                     tenant=spec.tenant)
+        logger.warning(
+            "reclaimed job %s from %s (epoch %s): lease expired and "
+            "owner heartbeat stale", job_id,
+            from_host or "<no claim>", spec.claim_epoch,
+        )
+        return 1
+
     def _scan_incoming(self) -> None:
         sheds = 0
+        live_peers = self._live_peers()
+        entries: list[tuple[Path, "JobSpec | None"]] = []
         for path in sorted(spool_dir(self.serve_root, "incoming")
                            .glob("*.json")):
-            if preemption_requested():
-                return  # drain beats admission; specs stay spooled
             with telemetry.trace_scope(job=path.stem), \
                     telemetry.span("spool_pickup", emit=self.ledger.append):
-                spec = self._load_spec(path)
+                entries.append((path, self._load_spec(path)))
+        # greedy affinity: warm-key jobs first (stable, so spool order is
+        # preserved within each group)
+        entries.sort(key=lambda e: bool(
+            e[1] is not None and e[1].affinity_key is not None
+            and self._warm_keys and e[1].affinity_key not in self._warm_keys
+        ))
+        for path, spec in entries:
+            if preemption_requested():
+                return  # drain beats admission; specs stay spooled
             if spec is None:
+                # arbitrate the rejection too: exactly one fleet host
+                # moves the invalid spec and seals the event
                 decision = reject(REASON_INVALID)
-                self._move_spool(path.stem, "rejected", {
+                dst = spool_dir(self.serve_root, "rejected") / path.name
+                if not claim_rename(path, dst):
+                    continue
+                atomic_write_json(dst, {
                     "job_id": path.stem, "decision": decision.to_dict(),
                     "ts": time.time(),
                 })
@@ -353,21 +834,44 @@ class ServeDaemon:
             with telemetry.trace_scope(trace_id=spec.trace_id,
                                        job=spec.job_id,
                                        tenant=spec.tenant):
+                if self._claimed_elsewhere(spec.job_id):
+                    decision = reject(REASON_DUPLICATE)
+                    dst = spool_dir(self.serve_root, "rejected") / path.name
+                    if not claim_rename(path, dst):
+                        continue
+                    atomic_write_json(dst, {
+                        "job": spec.to_dict(),
+                        "decision": decision.to_dict(), "ts": time.time(),
+                    })
+                    self.ledger.append(
+                        event="job_rejected", job=spec.job_id,
+                        tenant=spec.tenant, reason=decision.reason,
+                        retry_after_s=decision.retry_after_s,
+                    )
+                    self._metric("counter", "tmx_serve_rejected_total",
+                                 tenant=spec.tenant,
+                                 reason=decision.reason)
+                    continue
+                if self._should_defer(spec, time.time(), live_peers):
+                    continue  # an affine peer should claim this one
+                if not self._try_claim(path, spec):
+                    continue  # a peer won the race (or claim fault)
                 with telemetry.span("admission", emit=self.ledger.append):
                     decision = self._offer(spec)
                 if decision.admitted:
-                    atomic_write_json(
-                        spool_dir(self.serve_root, "admitted")
-                        / f"{spec.job_id}.json",
-                        spec.to_dict(),
-                    )
-                    path.unlink()
                     now = time.time()
                     wait = (max(0.0, now - float(spec.submitted_at))
                             if spec.submitted_at else None)
                     self._admit_ts[spec.job_id] = now
                     extra = ({"queue_wait_s": round(wait, 3)}
                              if wait is not None else {})
+                    if spec.affinity_key is not None:
+                        hit = spec.affinity_key in self._warm_keys
+                        extra["affinity"] = "hit" if hit else "miss"
+                        if hit:
+                            self._metric("counter",
+                                         "tmx_serve_affinity_hits_total",
+                                         tenant=spec.tenant)
                     if wait is not None and telemetry.enabled():
                         # enqueue → admit, as a span so the Chrome trace
                         # shows the wait as a real interval
@@ -379,7 +883,8 @@ class ServeDaemon:
                     self.ledger.append(event="job_admitted",
                                        job=spec.job_id,
                                        tenant=spec.tenant,
-                                       attempt=spec.attempt, **extra)
+                                       attempt=spec.attempt,
+                                       epoch=spec.claim_epoch, **extra)
                     self._metric("counter", "tmx_serve_admitted_total",
                                  tenant=spec.tenant)
                     if wait is not None:
@@ -392,6 +897,7 @@ class ServeDaemon:
                         "decision": decision.to_dict(),
                         "ts": time.time(),
                     })
+                    self._release_claim(spec.job_id)
                     self.ledger.append(
                         event="job_rejected", job=spec.job_id,
                         tenant=spec.tenant, reason=decision.reason,
@@ -444,6 +950,10 @@ class ServeDaemon:
                                elapsed=round(delay, 6))
         self.ledger.append(event="job_started", job=job.job_id,
                            tenant=job.tenant, attempt=job.attempt, **extra)
+        if job.affinity_key:
+            # executing the job is what warms this process's compile/AOT
+            # caches for its program family
+            self._warm_keys.add(job.affinity_key)
         if delay is not None:
             self._metric("histogram", "tmx_serve_sched_delay_seconds",
                          delay, tenant=job.tenant)
@@ -490,12 +1000,15 @@ class ServeDaemon:
                     summary = wf.run(resume=resume)
         except PreemptedError as exc:
             if exc.reason == "deadline" and not preemption_requested():
+                if not self._fence(job, "expired"):
+                    return "stale"
                 self.ledger.append(event="job_expired", job=job.job_id,
                                    tenant=job.tenant, step=exc.step)
                 self._move_spool(job.job_id, "expired", {
                     "job": job.to_dict(), "reason": "deadline",
                     "ts": time.time(),
                 })
+                self._release_claim(job.job_id)
                 self._metric("counter",
                              "tmx_serve_deadline_expired_total",
                              tenant=job.tenant)
@@ -512,6 +1025,8 @@ class ServeDaemon:
             self._job_failed(job, exc)
             return "failed"
         elapsed = time.monotonic() - t0
+        if not self._fence(job, "done"):
+            return "stale"
         extra_done = {}
         if job.kind == "query" and isinstance(summary, dict):
             # carried so registry_from_ledger can replay the analytics
@@ -522,11 +1037,13 @@ class ServeDaemon:
                           "query_elapsed_s": summary.get("elapsed_s")}
         self.ledger.append(event="job_done", job=job.job_id,
                            tenant=job.tenant, elapsed_s=round(elapsed, 3),
-                           resumed=resume, **extra_done)
+                           epoch=job.claim_epoch, resumed=resume,
+                           **extra_done)
         self._move_spool(job.job_id, "done", {
             "job": job.to_dict(), "summary": summary,
             "elapsed_s": round(elapsed, 3), "ts": time.time(),
         })
+        self._release_claim(job.job_id)
         self.queue.record_result(job.tenant, ok=True)
         self._metric("counter", "tmx_serve_jobs_done_total",
                      tenant=job.tenant)
@@ -566,6 +1083,8 @@ class ServeDaemon:
 
     def _job_failed(self, job: JobSpec, exc: Exception) -> None:
         logger.warning("serve job %s failed: %s", job.job_id, exc)
+        if not self._fence(job, "failed"):
+            return
         self.ledger.append(event="job_failed", job=job.job_id,
                            tenant=job.tenant, error=str(exc),
                            exception=type(exc).__name__)
@@ -573,6 +1092,7 @@ class ServeDaemon:
             "job": job.to_dict(), "error": str(exc),
             "exception": type(exc).__name__, "ts": time.time(),
         })
+        self._release_claim(job.job_id)
         self.queue.record_result(job.tenant, ok=False)
         self._metric("counter", "tmx_serve_jobs_failed_total",
                      tenant=job.tenant)
@@ -593,12 +1113,13 @@ class ServeDaemon:
             atomic_write_json(
                 spool_dir(self.serve_root, "incoming")
                 / f"{job.job_id}.json",
-                job.to_dict(),
+                job.to_dict(),  # claim_epoch rides along for the fence
             )
             admitted = (spool_dir(self.serve_root, "admitted")
                         / f"{job.job_id}.json")
             if admitted.exists():
                 admitted.unlink()
+            self._release_claim(job.job_id)
             self.ledger.append(event="job_requeued", job=job.job_id,
                                tenant=job.tenant, phase="drain")
         self.ledger.append(event="serve_preempted",
@@ -626,7 +1147,13 @@ class ServeDaemon:
             recovered = self._recover_spool()
             self.ledger.append(event="serve_started",
                                recovered=recovered,
+                               lease_s=self.lease_s,
                                max_queue=self.queue.config.max_queue)
+            # lease renewal rides the heartbeat cadence from its own
+            # thread, so a long blocking job never lets our claims lapse
+            self._renewer = LeaseRenewer(self._renew_leases,
+                                         period=max(0.2, self.lease_s / 3))
+            self._renewer.start()
             while True:
                 try:
                     with self._arm("admission"):
@@ -639,6 +1166,14 @@ class ServeDaemon:
                     # incl. WatchdogTimeout from a wedged scan: count it
                     # and keep serving — overload/chaos never crash
                     logger.warning("admission scan error: %s", exc)
+                try:
+                    self._reap_expired()
+                except FaultInjected as exc:
+                    if exc.fatal:
+                        raise
+                    logger.warning("reaper fault: %s", exc)
+                except Exception as exc:
+                    logger.warning("reaper error: %s", exc)
                 if self._watchdog is not None:
                     fired = False
                     for ev in self._watchdog.drain_events():
@@ -676,6 +1211,8 @@ class ServeDaemon:
                                 self.max_jobs)
                     return 0
         finally:
+            if self._renewer is not None:
+                self._renewer.stop()
             if self._watchdog is not None:
                 self._watchdog.stop()
             exc = sys.exc_info()[1]
@@ -710,15 +1247,35 @@ def serve_status_view(serve_root: Path) -> dict:
     counters — readable with or without a live daemon."""
     serve_root = Path(serve_root)
     view: dict = {"root": str(serve_root), "live": False}
-    hb_path = heartbeat_file(serve_root)
-    hb = telemetry.read_heartbeat(hb_path)
-    if hb is not None:
+    # ---- fleet: one row per per-host heartbeat; the legacy top-level
+    # heartbeat_age_s/live keys reflect the freshest host so single-host
+    # consumers keep working unchanged
+    hosts: dict[str, dict] = {}
+    best_age: float | None = None
+    for hb_path in sorted(serve_dir(serve_root).glob("heartbeat*.json")):
+        hb = telemetry.read_heartbeat(hb_path)
+        if hb is None:
+            continue
+        host = str(hb.get("host") or "host0")
         age = telemetry.heartbeat_age(hb_path)
         period = float(hb.get("period", 0) or 0)
-        view["heartbeat_age_s"] = None if age is None else round(age, 1)
-        view["live"] = bool(
+        live = bool(
             age is not None and (period <= 0 or age <= max(5.0, 4 * period))
         )
+        hosts[host] = {
+            "heartbeat_age_s": None if age is None else round(age, 1),
+            "live": live, "lease_s": hb.get("lease_s"), "leases": 0,
+        }
+        view["live"] = view["live"] or live
+        if age is not None and (best_age is None or age < best_age):
+            best_age = age
+    if hosts:
+        view["heartbeat_age_s"] = (None if best_age is None
+                                   else round(best_age, 1))
+    for _, _, owner in job_claims(serve_root):
+        hosts.setdefault(owner, {"heartbeat_age_s": None, "live": False,
+                                 "lease_s": None, "leases": 0})
+        hosts[owner]["leases"] += 1
     import json
 
     try:
@@ -730,31 +1287,43 @@ def serve_status_view(serve_root: Path) -> dict:
         for state in SPOOL_STATES
         if spool_dir(serve_root, state).is_dir()
     }
-    lp = ledger_path(serve_root)
     tenants: dict[str, dict] = {}
     preempted = 0
+    reclaims = 0
+    stale_claims = 0
+    affinity_hits = 0
+    affinity_known = 0
     view["slo"] = None
-    if lp.exists():
-        from tmlibrary_tpu.workflow.engine import RunLedger
-
-        events = RunLedger(lp).events()
+    events = serve_ledger_events(serve_root)
+    if events:
         waits: dict[str, list[float]] = {}
         for ev in events:
             kind = ev.get("event")
             if kind == "serve_preempted":
                 preempted += 1
                 continue
+            if kind == "stale_claim":
+                stale_claims += 1
+                continue
             if kind not in ("job_admitted", "job_rejected", "job_done",
-                            "job_failed", "job_expired", "job_requeued"):
+                            "job_failed", "job_expired", "job_requeued",
+                            "job_reclaimed"):
                 continue
             t = tenants.setdefault(str(ev.get("tenant", "unknown")), {
                 "admitted": 0, "rejected": 0, "done": 0, "failed": 0,
-                "expired": 0, "requeued": 0,
+                "expired": 0, "requeued": 0, "reclaimed": 0,
             })
             t[kind.removeprefix("job_")] += 1
-            if kind == "job_admitted" and ev.get("queue_wait_s") is not None:
-                waits.setdefault(str(ev.get("tenant", "unknown")),
-                                 []).append(float(ev["queue_wait_s"]))
+            if kind == "job_reclaimed":
+                reclaims += 1
+            if kind == "job_admitted":
+                if ev.get("queue_wait_s") is not None:
+                    waits.setdefault(str(ev.get("tenant", "unknown")),
+                                     []).append(float(ev["queue_wait_s"]))
+                if ev.get("affinity") is not None:
+                    affinity_known += 1
+                    if ev["affinity"] == "hit":
+                        affinity_hits += 1
         view["queue_wait_s"] = {
             tenant: {"n": len(vals),
                      "p50": slo.quantile(vals, 0.50),
@@ -763,11 +1332,23 @@ def serve_status_view(serve_root: Path) -> dict:
         }
         try:
             # the SLO panel `tmx top`/`tmx slo`/CI all consume — derived
-            # from the same ledger events, so it works with or without a
-            # live daemon
+            # from the same (merged) ledger events, so it works with or
+            # without a live daemon
             view["slo"] = slo.report(events)
         except Exception:
             logger.debug("slo report failed", exc_info=True)
     view["tenants"] = tenants
     view["preemptions"] = preempted
+    view["fleet"] = {
+        "hosts": hosts,
+        "ledgers": [p.name for p in serve_ledger_paths(serve_root)],
+        "reclaims_total": reclaims,
+        "stale_claims_total": stale_claims,
+        "affinity": {
+            "hits": affinity_hits,
+            "known": affinity_known,
+            "hit_rate": (round(affinity_hits / affinity_known, 3)
+                         if affinity_known else None),
+        },
+    }
     return view

@@ -11,7 +11,14 @@ Everything derives from the serve ledger's job-completion events
 (``job_done``/``job_failed``/``job_expired``), so the whole surface is
 **replayable**: :func:`report` over a ledger reconstructs exactly what the
 live daemon saw, order-independently (multi-host merged ledgers dedup by
-the same host/ts fingerprint the metrics derivation uses).  The raw
+the same host/ts fingerprint the metrics derivation uses).  Fleet
+consumers (``tmx slo``, the daemon's own burn check, CI) feed it
+:func:`tmlibrary_tpu.serve.serve_ledger_events` — the merged per-host
+history — so burn is one fleet-wide truth.  The fleet spool protocol's
+``job_reclaimed``/``stale_claim`` events are deliberately *not*
+outcomes: a reclaimed job completes later under its new owner (one
+``job_done``), and charging a daemon death to a tenant's availability
+would double-count it.  The raw
 ``tmx_slo_*`` series (:func:`observe_job`) are fed identically by the
 live daemon and by ``telemetry.registry_from_ledger``.
 

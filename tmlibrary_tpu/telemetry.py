@@ -1322,6 +1322,7 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
             ).inc()
         elif kind in ("job_admitted", "job_rejected", "job_done",
                       "job_failed", "job_expired", "job_requeued",
+                      "job_reclaimed", "stale_claim",
                       "job_started", "serve_preempted", "slo_burn"):
             # serve-ledger events (serve.py): per-tenant admission /
             # outcome series, mirroring the daemon's live tmx_serve_*
@@ -1335,6 +1336,11 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                     reg.histogram("tmx_serve_queue_wait_seconds",
                                   tenant=tenant, **hl).observe(
                         float(ev["queue_wait_s"]))
+                if ev.get("affinity") == "hit":
+                    # fleet affinity routing (serve.py): the claiming
+                    # host's compiled-program cache was already warm
+                    reg.counter("tmx_serve_affinity_hits_total",
+                                tenant=tenant, **hl).inc()
             elif kind == "job_started":
                 if "sched_delay_s" in ev:
                     reg.histogram("tmx_serve_sched_delay_seconds",
@@ -1390,6 +1396,17 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                 _observe_slo(reg, tenant, "expired", None, hl)
             elif kind == "job_requeued":
                 reg.counter("tmx_serve_requeued_total",
+                            tenant=tenant, **hl).inc()
+            elif kind == "job_reclaimed":
+                # the reaper swept a dead host's leased job back to
+                # incoming/ (serve.py _reclaim) — attempt preserved, so
+                # no retry-budget series moves here
+                reg.counter("tmx_serve_reclaims_total",
+                            tenant=tenant, **hl).inc()
+            elif kind == "stale_claim":
+                # a fenced terminal transition: the claim epoch check
+                # stopped a reclaimed job's first owner from publishing
+                reg.counter("tmx_serve_stale_claims_total",
                             tenant=tenant, **hl).inc()
             elif kind == "serve_preempted":
                 reg.counter("tmx_serve_preemptions_total", **hl).inc()

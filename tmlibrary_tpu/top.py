@@ -286,6 +286,27 @@ def render_dashboard(view: dict, width: int = 80) -> str:
         if srv.get("preemptions"):
             lines.append(f"  serve preemptions: {srv['preemptions']} "
                          "(drained + re-spooled)")
+        # ---- FLEET row: per-host liveness/leases + reclaim/affinity
+        fleet = srv.get("fleet") or {}
+        fhosts = fleet.get("hosts") or {}
+        if fhosts:
+            aff = fleet.get("affinity") or {}
+            rate = aff.get("hit_rate")
+            host_bits = []
+            for name in sorted(fhosts):
+                h = fhosts[name]
+                age = h.get("heartbeat_age_s")
+                host_bits.append(
+                    f"{name}"
+                    f"[{'live' if h.get('live') else 'DEAD'}"
+                    + (f" hb {age:.0f}s" if age is not None else "")
+                    + f" leases {h.get('leases', 0)}]")
+            lines.append(
+                "  fleet " + " ".join(host_bits)
+                + f"  reclaims {fleet.get('reclaims_total', 0)}"
+                + f"  stale {fleet.get('stale_claims_total', 0)}"
+                + "  affinity "
+                + (f"{rate:.0%}" if rate is not None else "-"))
         # ---- SLO panel: per-tenant latency/availability vs objective
         slo_view = srv.get("slo") or {}
         waits = srv.get("queue_wait_s") or {}

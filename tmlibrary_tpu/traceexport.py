@@ -97,7 +97,8 @@ def collect_events(root: Path) -> list[dict]:
         from tmlibrary_tpu import serve
 
         if serve.is_serve_root(root):
-            events.extend(_read_ledger(serve.ledger_path(root)))
+            for lp in serve.serve_ledger_paths(root):
+                events.extend(_read_ledger(lp))  # every fleet host
             for exp_root in _spooled_experiment_roots(root):
                 events.extend(
                     _read_ledger(exp_root / "workflow" / "ledger.jsonl"))

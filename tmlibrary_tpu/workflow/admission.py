@@ -116,6 +116,18 @@ class JobSpec:
     #: the query payload for ``kind="query"`` jobs (tool name +
     #: tool-specific arguments); ignored for workflow jobs
     payload: dict | None = None
+    #: highest lease epoch this job has ever been claimed under (fleet
+    #: spool protocol, DESIGN.md §25).  Each claim stamps ``epoch + 1``
+    #: back into the spooled spec; the claiming host checks its epoch
+    #: against the on-disk claim before every done/failed transition, so
+    #: a stale host resuming after a GC pause cannot clobber a reclaimed
+    #: job's result.  Old spool files deserialize at epoch 0.
+    claim_epoch: int = 0
+    #: compiled-program affinity key (``serve.affinity_key_for``): a
+    #: content digest over the job's workflow description + jterator
+    #: project, the routing hint a fleet host compares against its warm
+    #: AOT/compile caches when choosing which spooled jobs to claim.
+    affinity_key: str | None = None
 
     def sort_key(self) -> tuple:
         """Deterministic within-tenant order: priority desc, then
