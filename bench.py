@@ -18,6 +18,7 @@ record instead of a stack trace and rc=1.
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -1441,11 +1442,26 @@ def measure_analytics() -> None:
     pays (store mmap + Parquet writes excluded; those are ingest-shaped,
     not query-shaped).  The record carries its OWN metric, config and a
     non-``pipelined`` ``timing_methodology`` so ``perf._history_key``
-    can never judge it against a sites/sec capture."""
+    can never judge it against a sites/sec capture.
+
+    ``BENCH_ANALYTICS_INDEX=ivf`` switches the headline knn sweep onto
+    the IVF index (``analytics/index.py``) — the methodology string
+    then carries ``+index=ivf`` and ``+recall=...`` so
+    ``perf._methodology_class`` separates indexed captures from brute
+    history the same way ``+strategy=fused`` separates reduction
+    strategies: the regression sentinel never compares an approximate
+    sublinear sweep against an exact O(N·N) one silently.  Every run
+    additionally records ``index_vs_brute`` rows (built on CLUSTERED
+    synthetic populations — the microscopy case; iid Gaussian data has
+    no cell structure and unfairly tanks IVF recall) with per-size
+    brute/ivf qps, speedup, build cost and measured recall@k.
+    ``BENCH_ANALYTICS_RECORD_TUNING=1`` persists the winner as the
+    ``best_index`` tuning verdict (``tuning.tuned_analytics_index``)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from tmlibrary_tpu.analytics import index as aidx
     from tmlibrary_tpu.analytics import ops
     from tmlibrary_tpu.analytics import spatial as asp
     from tmlibrary_tpu.tools.clustering import kmeans
@@ -1456,21 +1472,43 @@ def measure_analytics() -> None:
     ]
     n_features = int(os.environ.get("BENCH_ANALYTICS_FEATURES", "32"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
+    headline_index = os.environ.get("BENCH_ANALYTICS_INDEX", "brute")
+    if headline_index not in ("brute", "ivf"):
+        raise SystemExit(
+            f"BENCH_ANALYTICS_INDEX={headline_index!r}: expected brute|ivf"
+        )
     # embedding keeps a reduced kNN-graph build at 1e5 affordable by
     # reusing the same tiled kNN the knn tool runs; k matches the tool
     # defaults so the number answers "what does one default query cost"
     tool_params = {"knn_k": 10, "embedding_k": 15, "kmeans_k": 5}
 
     per_tool: dict = {}
+    headline_recall: dict = {}
     for n in sizes:
         rng = np.random.default_rng(0)
         x = rng.normal(size=(n, n_features)).astype(np.float32)
         site_index = rng.integers(0, 64, size=n).astype(np.int64)
         centroids = rng.uniform(0.0, 2048.0, size=(n, 2)).astype(np.float64)
 
-        def run_knn():
-            idx, dist = ops.knn(x, k=tool_params["knn_k"])
-            return idx
+        if headline_index == "ivf":
+            # build OUTSIDE the timed region: the index amortizes over
+            # every query on an unchanged store, so the headline times
+            # what a warm indexed query pays.  Build cost and recall
+            # are recorded (not hidden) in index_vs_brute below.
+            h_cent, h_mem, _ = aidx.ivf_build_arrays(x)
+            headline_recall[str(n)] = aidx.measure_recall(
+                x, h_cent, h_mem, k=tool_params["knn_k"]
+            )
+
+            def run_knn():
+                idx, dist = aidx.ivf_search_arrays(
+                    x, h_cent, h_mem, k=tool_params["knn_k"]
+                )
+                return idx
+        else:
+            def run_knn():
+                idx, dist = ops.knn(x, k=tool_params["knn_k"])
+                return idx
 
         def run_pca():
             scores, comps, ratio = ops.pca(x, n_components=2)
@@ -1507,7 +1545,64 @@ def measure_analytics() -> None:
                 best = min(best, time.perf_counter() - t0)
             per_tool.setdefault(tool, {})[str(n)] = round(1.0 / best, 3)
 
+    # ---- index-vs-brute: the sublinear claim, measured side by side.
+    # Clustered populations (Gaussian blobs): microscopy object features
+    # concentrate around phenotype modes, which is the regime IVF cell
+    # probing exploits; iid noise has no cells to probe and would report
+    # a recall floor no real store exhibits.
+    k_cmp = tool_params["knn_k"]
+    index_rows = []
+    for n in sizes:
+        rng = np.random.default_rng(7)
+        n_blobs = max(8, int(round(math.sqrt(n))))
+        blob_centers = rng.normal(size=(n_blobs, n_features))
+        labels = rng.integers(0, n_blobs, size=n)
+        xb = (blob_centers[labels]
+              + 0.15 * rng.normal(size=(n, n_features))).astype(np.float32)
+
+        t0 = time.perf_counter()
+        cent, mem, _ = aidx.ivf_build_arrays(xb)
+        jax.block_until_ready(jnp.asarray(cent))
+        build_s = time.perf_counter() - t0
+
+        def sweep_brute():
+            return ops.knn(xb, k=k_cmp)[0]
+
+        def sweep_ivf():
+            return aidx.ivf_search_arrays(xb, cent, mem, k=k_cmp)[0]
+
+        timings = {}
+        for name, fn in (("brute", sweep_brute), ("ivf", sweep_ivf)):
+            fn()  # warm-up: compiles + first dispatch
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn())
+                best = min(best, time.perf_counter() - t0)
+            timings[name] = best
+        index_rows.append({
+            "n": n,
+            "brute_qps": round(1.0 / timings["brute"], 3),
+            "ivf_qps": round(1.0 / timings["ivf"], 3),
+            "speedup": round(timings["brute"] / timings["ivf"], 3),
+            "recall_at_k": aidx.measure_recall(xb, cent, mem, k=k_cmp),
+            "build_s": round(build_s, 4),
+            "n_cells": int(cent.shape[0]),
+            "top_p": aidx.DEFAULT_TOP_P,
+            "k": k_cmp,
+        })
+
     largest = str(max(sizes))
+    # methodology provenance: the string IS the _methodology_class, so
+    # an indexed capture carries +index=ivf (+recall at the headline
+    # size) and can never be judged against brute-force history — the
+    # same sentinel-separation discipline as "+strategy=fused"
+    methodology = "analytics-tools-v1"
+    if headline_index == "ivf":
+        methodology += "+index=ivf"
+        r = headline_recall.get(largest)
+        if r is not None:
+            methodology += f"+recall={r}"
     record = {
         "metric": "analytics_queries_per_sec",
         "value": per_tool["knn"][largest],
@@ -1521,14 +1616,32 @@ def measure_analytics() -> None:
         "n_objects": sizes,
         "n_features": n_features,
         "per_tool": per_tool,
+        "index": headline_index,
+        "index_vs_brute": index_rows,
         # deliberately NOT _ledger_fields(): queries/sec is its own
         # experiment family — the methodology string below is the
         # _methodology_class verbatim, never "pipelined*" and never
         # "host-synchronous" (the sites/sec families)
-        "timing_methodology": "analytics-tools-v1",
+        "timing_methodology": methodology,
         "pipeline_depth": None,
         "pipelined": False,
     }
+    if headline_recall:
+        record["recall_at_k"] = headline_recall
+    if os.environ.get("BENCH_ANALYTICS_RECORD_TUNING") == "1":
+        # persist the measured winner as the tuned verdict only when
+        # asked: a casual bench run must not rewrite production routing
+        from tmlibrary_tpu.tuning import record_config_sweep
+
+        wins = [r for r in index_rows if r["speedup"] > 1.0]
+        best = "ivf" if len(wins) == len(index_rows) and index_rows else "brute"
+        record_config_sweep("analytics", {
+            "backend": jax.default_backend(),
+            "best_index": best,
+            "rows": index_rows,
+            "timing_methodology": methodology,
+        })
+        record["best_index"] = best
     emit_record(record)
 
 

@@ -12,10 +12,22 @@ miss, then — byte-identical payload, unchanged store digest — a cache
 HIT on the same key), and a real ``tmx serve run`` daemon answers a
 ``kind: query`` job for the SAME clustering payload, which must arrive
 as a cache hit seeded by the one-shot path: the digest-keyed artifact
-cache is shared across serving paths.  The daemon leg's SLO view for
-the ``query`` tenant and a schema-valid Chrome trace (whose job span
-nests the ``feature_store``/``query_tool`` phases) upload as CI
-artifacts.  Exit 0 and ``ANALYTICS PASS`` on success; 1 otherwise.
+cache is shared across serving paths.
+
+Two further legs exercise the sublinear path (DESIGN.md §26): ``tmx
+index build`` persists an IVF index whose manifest must carry a recall
+measurement, an indexed one-shot kNN must route through it
+(``index_cache: hit``, never a rebuild) and — probed exhaustively via
+a ``top_p`` above the cell count, which clamps — EQUAL brute; and
+a fresh daemon admits THREE concurrent ``kind: query`` kNN jobs with
+different k which must coalesce into ONE batched sweep — cache states
+``miss`` + 2×``fused``, three distinct per-job cache keys on disk, a
+single ``query_fused`` ledger event with ``window: 3``, and every
+follower's ``query.json`` naming the leader key.  The daemon legs' SLO
+view for the ``query`` tenant, the index manifest, and a schema-valid
+Chrome trace (whose job span nests the ``feature_store``/``query_tool``
+phases) upload as CI artifacts.  Exit 0 and ``ANALYTICS PASS`` on
+success; 1 otherwise.
 """
 
 import argparse
@@ -78,7 +90,7 @@ def main(argv=None) -> int:
         root.mkdir(parents=True, exist_ok=True)
         source = make_source(root)
 
-        print("[1/4] real `tmx workflow submit` producing feature shards")
+        print("[1/6] real `tmx workflow submit` producing feature shards")
         store, desc = make_store(root, "exp", source)
         desc.save(store.workflow_dir / "workflow.yaml")
         rc = _tmx(["workflow", "submit", "--root", str(store.root),
@@ -93,7 +105,7 @@ def main(argv=None) -> int:
             return 1
         print(f"      {len(shards)} feature shard(s) written")
 
-        print("[2/4] one-shot queries: knn miss -> hit, clustering, "
+        print("[2/6] one-shot queries: knn miss -> hit, clustering, "
               "spatial")
         knn1 = _query(store.root, ["--tool", "knn", "--objects", "nuclei",
                                    "--payload", '{"k": 5}'])
@@ -134,7 +146,7 @@ def main(argv=None) -> int:
         print(f"      spatial: density over {spat['attributes']['n_sites']} "
               "site(s)")
 
-        print("[3/4] serve daemon answers the same clustering query as a "
+        print("[3/6] serve daemon answers the same clustering query as a "
               "kind=query job (cross-path cache hit)")
         sroot = root / "serve_root"
         rc = _tmx(["enqueue", "--root", str(sroot),
@@ -205,7 +217,119 @@ def main(argv=None) -> int:
               f"enrichment miss (marked fraction "
               f"{enr['attributes']['marked_fraction']})")
 
-        print("[4/4] SLO + trace views for the query tenant")
+        print("[4/6] tmx index build -> manifest, indexed query agrees "
+              "with brute")
+        rc = _tmx(["index", "build", "--root", str(store.root),
+                   "--objects", "nuclei"])
+        if rc.returncode != 0:
+            print(f"ANALYTICS FAIL: tmx index build exited "
+                  f"{rc.returncode}\n{rc.stdout}")
+            return 1
+        manifest = None
+        for line in reversed(rc.stdout.splitlines()):
+            if line.startswith("{"):
+                manifest = json.loads(line)
+                break
+        if not manifest or int(manifest.get("n_cells") or 0) < 1 \
+                or float(manifest.get("recall_at_k") or 0.0) < 0.9:
+            print(f"ANALYTICS FAIL: index manifest malformed or recall "
+                  f"below 0.9 at the default probe width: {manifest}")
+            return 1
+        lst = _tmx(["index", "list", "--root", str(store.root),
+                    "--objects", "nuclei"])
+        listing = json.loads(lst.stdout.splitlines()[-1])
+        states = [r.get("state") for r in listing.get("indexes", [])]
+        if lst.returncode != 0 or states != ["fresh"]:
+            print(f"ANALYTICS FAIL: index list should show one fresh "
+                  f"index, got {listing}")
+            return 1
+        # top_p far above the cell count clamps to an exhaustive probe,
+        # so the indexed answer must EQUAL brute — and the pre-built
+        # index must serve it as a cache hit, not a rebuild
+        knn_ivf = _query(store.root, ["--tool", "knn", "--objects",
+                                      "nuclei", "--payload",
+                                      '{"k": 5, "top_p": 4096}',
+                                      "--index", "ivf"])
+        attrs = knn_ivf["attributes"]
+        if knn_ivf["cache"] != "miss" or attrs.get("index") != "ivf" \
+                or attrs.get("index_cache") != "hit":
+            print(f"ANALYTICS FAIL: indexed knn did not route through "
+                  f"the persisted index: {knn_ivf}")
+            return 1
+        drift = abs(float(attrs["mean_distance"])
+                    - float(knn1["attributes"]["mean_distance"]))
+        if drift > 1e-5:
+            print(f"ANALYTICS FAIL: indexed knn disagrees with brute at "
+                  f"exhaustive probe width (mean distance drift {drift})")
+            return 1
+        print(f"      index: {manifest['n_objects']} objects in "
+              f"{manifest['n_cells']} cells, recall "
+              f"{manifest['recall_at_k']}, exhaustive-probe answer "
+              "== brute")
+
+        print("[5/6] daemon fuses 3 concurrent kNN jobs into one sweep")
+        froot = root / "fusion_root"
+        for i, k in enumerate((3, 4, 5)):
+            rc = _tmx(["enqueue", "--root", str(froot),
+                       "--experiment", str(store.root),
+                       "--tenant", "query", "--job-id", f"q-knn-{k}",
+                       "--kind", "query", "--tool", "knn",
+                       "--objects", "nuclei",
+                       "--payload", json.dumps({"k": k}),
+                       "--index", "ivf"])
+            if rc.returncode != 0:
+                print(f"ANALYTICS FAIL: enqueue k={k} exited "
+                      f"{rc.returncode}\n{rc.stdout}")
+                return 1
+        rc = _tmx(["serve", "run", "--root", str(froot), "--poll", "0.1",
+                   "--max-jobs", "3"])
+        if rc.returncode != 0:
+            print(f"ANALYTICS FAIL: fusion serve run exited "
+                  f"{rc.returncode}\n{rc.stdout[-3000:]}")
+            return 1
+        fdone = {p.stem: json.loads(p.read_text())["summary"]
+                 for p in (froot / "spool" / "done").glob("*.json")}
+        if sorted(fdone) != ["q-knn-3", "q-knn-4", "q-knn-5"]:
+            print(f"ANALYTICS FAIL: expected all 3 fused jobs done, got "
+                  f"{sorted(fdone)}")
+            return 1
+        caches = sorted(s["cache"] for s in fdone.values())
+        fkeys = {s["key"] for s in fdone.values()}
+        if caches != ["fused", "fused", "miss"] or len(fkeys) != 3 \
+                or any(s.get("fusion_window") != 3 for s in fdone.values()):
+            print(f"ANALYTICS FAIL: fusion window malformed (caches "
+                  f"{caches}, {len(fkeys)} keys): {fdone}")
+            return 1
+        # per-job cache entries on disk, every follower naming the leader
+        leader_key = next(s["key"] for s in fdone.values()
+                          if s["cache"] == "miss")
+        for s in fdone.values():
+            cache_dir = Path(s["result_dir"])
+            if not (cache_dir / "result.json").exists():
+                print(f"ANALYTICS FAIL: fused job left no cache entry "
+                      f"at {cache_dir}")
+                return 1
+            prov = json.loads((cache_dir / "query.json").read_text())
+            if prov.get("fusion_window") != 3 \
+                    or prov.get("fused_with") != leader_key:
+                print(f"ANALYTICS FAIL: cache provenance malformed: "
+                      f"{prov}")
+                return 1
+        fused_evs = [
+            json.loads(line) for line in
+            (froot / "serve" / "ledger.jsonl").read_text().splitlines()
+            if '"query_fused"' in line
+        ]
+        fused_evs = [e for e in fused_evs
+                     if e.get("event") == "query_fused"]
+        if len(fused_evs) != 1 or fused_evs[0].get("window") != 3:
+            print(f"ANALYTICS FAIL: expected one query_fused event with "
+                  f"window 3, got {fused_evs}")
+            return 1
+        print(f"      fusion: 1 sweep answered 3 jobs (leader "
+              f"{leader_key}, caches miss+2 fused)")
+
+        print("[6/6] SLO + trace views for the query tenant")
         slo = _tmx(["slo", "--root", str(sroot), "--json"])
         if slo.returncode != 0:
             print(f"ANALYTICS FAIL: tmx slo exited {slo.returncode}\n"
@@ -245,11 +369,18 @@ def main(argv=None) -> int:
             shutil.copy(trace_out, art / "analytics_trace.json")
             (art / "analytics_queries.json").write_text(json.dumps({
                 "knn_miss": knn1, "knn_hit": knn2,
+                "knn_indexed": knn_ivf,
                 "clustering_oneshot": clus,
                 "clustering_served": cl, "enrichment_served": enr,
+                "fused_served": fdone,
             }, indent=2, default=str))
+            (art / "analytics_index_manifest.json").write_text(
+                json.dumps({"build": manifest, "list": listing},
+                           indent=2, default=str))
             shutil.copy(sroot / "serve" / "ledger.jsonl",
                         art / "analytics_serve_ledger.jsonl")
+            shutil.copy(froot / "serve" / "ledger.jsonl",
+                        art / "analytics_fusion_ledger.jsonl")
 
         print("ANALYTICS PASS: digest-keyed query cache shared across "
               "one-shot and served paths")

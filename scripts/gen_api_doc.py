@@ -349,11 +349,16 @@ def analytics_section() -> list[str]:
            "routes the same payload through the serve daemon "
            "(admission, WDRR, trace spans, SLO).  Results cache under "
            "`tools/queries/<key>/` keyed by the feature-store content "
-           "digest + the canonical payload (DESIGN.md §24).",
+           "digest + the canonical payload (DESIGN.md §24).  `tmx index "
+           "build|list --root EXP --objects NAME` manages the persisted "
+           "IVF kNN index; `--index auto|ivf|brute` routes a query "
+           "(DESIGN.md §26), and concurrent fusable kNN jobs in the "
+           "daemon share one batched sweep.",
            "",
            "| symbol | role |", "|---|---|"]
     for modname, prefix in (("store", "analytics.store"),
                             ("ops", "analytics.ops"),
+                            ("index", "analytics.index"),
                             ("spatial", "analytics.spatial"),
                             ("query", "analytics.query")):
         mod = importlib.import_module(f"tmlibrary_tpu.analytics.{modname}")

@@ -59,6 +59,22 @@ def _reset_routing_history():
 
 
 @pytest.fixture(autouse=True)
+def _reset_trace_context():
+    """The trace context is process-global on purpose (executor worker
+    threads inherit the running job's labels).  Chaos tests leave
+    hang-injected daemon threads parked INSIDE a job's trace scope;
+    such a thread restores the empty context when its fault sleep
+    expires, but until then the next test would observe the hung job's
+    labels.  Clearing here is safe either way: the parked thread's
+    ``finally`` restores the empty dict it captured on entry."""
+    from tmlibrary_tpu import telemetry
+
+    telemetry.set_trace_context()
+    yield
+    telemetry.set_trace_context()
+
+
+@pytest.fixture(autouse=True)
 def _reset_qc():
     """The QC session singleton and its enable override are
     process-global; leak state and one test's sketches/flags bleed into

@@ -1,0 +1,561 @@
+"""TPU-native IVF (inverted-file) kNN index over the feature store.
+
+Brute-force kNN (``analytics/ops.py``) sweeps every query against every
+row: O(N) per query.  The IVF index makes that sublinear the
+accelerator-native way (the rapids-singlecell pattern): train C ≈ 4√N
+centroids with the SAME deterministic k-means the clustering tool runs
+(``tools/clustering.kmeans`` — one trainer, one definition), assign
+every object to its nearest cell, and answer a query by scoring only
+the members of ``top_p`` nearby cells.  Two probe shapes, both ONE
+compiled XLA program of MXU-shaped work:
+
+- **query-major** (explicit query points): query→centroid matmul,
+  ``lax.top_k`` over cells, member gather, candidate matmul, final
+  ``top_k`` — tiled over the query axis exactly like brute force.
+- **cell-major** (the self-kNN sweep every tool runs): queries grouped
+  by their OWN cell share one candidate set — the members of that
+  cell's ``top_p`` nearest cells — so the distance block is a real
+  (cap, m) GEMM per cell (``einsum('cqf,cmf->cqm')``, a batched
+  matmul) instead of per-row matvecs.  Same flops, MXU/BLAS-shaped:
+  measured ~2.5x brute force on CPU at 12k objects where the
+  query-major shape only broke even.
+
+Persistence and invalidation
+----------------------------
+The index persists next to the store under
+``<analytics>/<objects>/index/<selection>/`` (``centroids.npy``,
+``members.npy``, ``assignments.npy``, ``index_meta.json``) keyed by the
+feature selection.  ``index_meta.json`` pins the builder inputs — the
+store's content ``digest``, the selection, cells/seed — plus the
+index's OWN content digest (sha256 over centroid and member bytes) and
+the recall@k it measured against exact brute force at build time on a
+strided query sample.  :meth:`IvfIndex.ensure` reuses only while the
+recorded store digest equals the live store's; an appended shard rolls
+the store digest (``analytics/store.py``), so the index invalidates and
+rebuilds exactly when the matrix content moved.
+
+Mode resolution
+---------------
+``resolve_index_mode`` implements the established precedence chain
+(``ops/reduction.py`` discipline): explicit payload request beats the
+``TMX_ANALYTICS_INDEX`` env (CLI knob, validated loud) beats the
+``analytics_index`` config setting beats the machine-written
+``TUNING.json`` verdict (``tuning.tuned_analytics_index``) beats the
+auto default (ivf at or above ``TMX_ANALYTICS_INDEX_MIN`` objects, else
+brute — small stores fit one brute tile anyway).  ``knn_search`` is the
+one dispatcher every consumer (knn/embedding tools, the fused serve
+sweep, recall measurement) routes through; it degrades to brute force
+on any index failure and counts
+``tmx_analytics_index_{builds,hits,fallbacks}_total``.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import json
+import math
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tmlibrary_tpu import telemetry
+from tmlibrary_tpu.analytics import ops
+from tmlibrary_tpu.analytics.store import FeatureStore
+from tmlibrary_tpu.atomicio import atomic_write_json
+from tmlibrary_tpu.errors import NotSupportedError, StoreError
+
+INDEX_MODES = ("auto", "ivf", "brute")
+INDEX_SCHEMA_VERSION = 1
+
+#: auto mode: brute force below this many objects (a store this small
+#: fits one brute tile — the index would only add a gather)
+DEFAULT_AUTO_MIN_OBJECTS = 4096
+
+#: cells probed per query by default; recall@k rises with it and
+#: ``top_p == n_cells`` degenerates to exact brute force over all cells
+DEFAULT_TOP_P = 8
+
+#: auto cell count is this multiple of √N: finer cells cut the padded
+#: candidate list (cap tracks the LARGEST cell, and k-means cells over
+#: clustered populations are imbalanced) — the search cost is
+#: top_p × cap per query, so smaller cap beats fuller cells
+AUTO_CELLS_SQRT_MULT = 4
+
+#: build-time recall sample: this many strided queries vs exact kNN
+RECALL_SAMPLE = 128
+RECALL_K = 10
+
+
+def _metric(name: str, value: float = 1.0, **labels) -> None:
+    telemetry.get_registry().counter(name, **labels).inc(value)
+
+
+def auto_min_objects() -> int:
+    """The auto-mode brute→ivf cutover, env-overridable for tests/CI."""
+    try:
+        return int(os.environ.get("TMX_ANALYTICS_INDEX_MIN",
+                                  DEFAULT_AUTO_MIN_OBJECTS))
+    except ValueError:
+        return DEFAULT_AUTO_MIN_OBJECTS
+
+
+def _validate(mode: str) -> str:
+    if mode not in INDEX_MODES:
+        raise NotSupportedError(
+            f"unknown analytics index mode '{mode}' "
+            f"(expected one of {INDEX_MODES})"
+        )
+    return mode
+
+
+def resolve_index_mode(explicit: str | None = None,
+                       n_objects: int | None = None
+                       ) -> tuple[str, str]:
+    """Resolve to a concrete ``("ivf" or "brute", source)`` pair.
+
+    Precedence (the ``ops/reduction.py`` chain): ``explicit`` (payload/
+    call site, fails LOUD on a bad name) > ``TMX_ANALYTICS_INDEX`` env
+    (loud) > ``analytics_index`` config (loud) > the machine-written
+    tuned verdict (malformed entries degrade silently — stale data must
+    not crash production) > auto by store size.  ``source`` names the
+    link that decided, for attribute provenance.
+    """
+    if explicit and explicit != "auto":
+        return _validate(str(explicit)), "payload"
+    env = os.environ.get("TMX_ANALYTICS_INDEX")
+    if env and env != "auto":
+        return _validate(env), "env"
+    from tmlibrary_tpu.config import _setting
+
+    configured = _setting("analytics_index", "auto")
+    if configured and configured != "auto":
+        return _validate(configured), "config"
+    from tmlibrary_tpu.tuning import tuned_analytics_index
+
+    tuned = tuned_analytics_index(jax.default_backend())
+    if tuned is not None:
+        return tuned, "tuned"
+    if n_objects is not None and int(n_objects) >= auto_min_objects():
+        return "ivf", "auto"
+    return "brute", "auto"
+
+
+# ---------------------------------------------------------------- kernel
+@functools.partial(jax.jit, static_argnums=(5, 6, 7))
+def _ivf_tile(q: jax.Array, x: jax.Array, cent: jax.Array,
+              members: jax.Array, base: jax.Array, k: int, top_p: int,
+              exclude_self: bool) -> tuple[jax.Array, jax.Array]:
+    """Top-k of one query tile through the cell lists: ONE program of
+    matmul + ``top_k`` + gather + matmul + ``top_k``.  ``base`` is
+    traced (every tile shares one compiled program, like ``_knn_tile``);
+    padded member slots (-1) and, for self-kNN, each query's own row
+    are masked to +inf before the final ``top_k``."""
+    # (T, C) query→centroid distances, then the top_p cells per query
+    dc = (
+        jnp.sum(q * q, axis=1, keepdims=True)
+        - 2.0 * q @ cent.T
+        + jnp.sum(cent * cent, axis=1)[None]
+    )
+    _, cells = jax.lax.top_k(-dc, top_p)                      # (T, P)
+    cand = members[cells].reshape(q.shape[0], -1)             # (T, P*cap)
+    safe = jnp.maximum(cand, 0)
+    cx = x[safe]                                              # (T, M, F)
+    d2 = (
+        jnp.sum(q * q, axis=1, keepdims=True)
+        - 2.0 * jnp.einsum("tf,tmf->tm", q, cx)
+        + jnp.sum(cx * cx, axis=-1)
+    )
+    invalid = cand < 0
+    if exclude_self:
+        rows = base + jnp.arange(q.shape[0])
+        invalid = invalid | (cand == rows[:, None])
+    d2 = jnp.where(invalid, jnp.inf, d2)
+    neg, pos = jax.lax.top_k(-d2, k)
+    idx = jnp.take_along_axis(cand, pos, axis=1)
+    dist = jnp.sqrt(jnp.maximum(-neg, 0.0))
+    return idx.astype(jnp.int32), dist
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _ivf_self_tile(x: jax.Array, mem: jax.Array, cand: jax.Array,
+                   k: int) -> tuple[jax.Array, jax.Array]:
+    """Self-kNN for one tile of CELLS: each cell's members are the
+    queries, the members of its ``top_p`` nearest cells (``cand``,
+    precomputed once from centroid-to-centroid distances) are the
+    shared candidates, so the distance block is one (cap, m) GEMM per
+    cell — a batched matmul, not per-row matvecs.  Padded member slots
+    (-1) in both roles and each query's own row are masked to +inf;
+    rows scatter back to store order on the host."""
+    qx = x[jnp.maximum(mem, 0)]                               # (Ct, cap, F)
+    cx = x[jnp.maximum(cand, 0)]                              # (Ct, m, F)
+    d2 = (
+        jnp.sum(qx * qx, axis=-1)[:, :, None]
+        - 2.0 * jnp.einsum("cqf,cmf->cqm", qx, cx)
+        + jnp.sum(cx * cx, axis=-1)[:, None, :]
+    )
+    bad = (cand[:, None, :] < 0) | (cand[:, None, :] == mem[:, :, None])
+    d2 = jnp.where(bad, jnp.inf, d2)
+    neg, pos = jax.lax.top_k(-d2, k)
+    idx = jnp.take_along_axis(
+        jnp.broadcast_to(cand[:, None, :], d2.shape), pos, axis=2
+    )
+    return idx.astype(jnp.int32), jnp.sqrt(jnp.maximum(-neg, 0.0))
+
+
+#: centroid training runs on at most this many strided rows — the
+#: coarse quantizer does not need every point, and this caps the
+#: training cost independent of store size
+TRAIN_SAMPLE_CAP = 8192
+
+#: greedy k-means++ seeding is O(n·k²); past this many cells the index
+#: switches to the strided seeding (both deterministic)
+GREEDY_SEED_MAX_CELLS = 64
+
+
+@jax.jit
+def assign_cells(x: jax.Array, cent: jax.Array) -> jax.Array:
+    """Nearest-centroid assignment for every row: the same matmul
+    expansion + argmin Lloyd's runs, as one standalone program — the
+    full-store pass after sampled training."""
+    d2 = (
+        jnp.sum(x * x, axis=1, keepdims=True)
+        - 2.0 * x @ cent.T
+        + jnp.sum(cent * cent, axis=1)[None]
+    )
+    return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+
+def ivf_build_arrays(x: np.ndarray, n_cells: int | None = None,
+                     seed: int = 0, n_iter: int = 25
+                     ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Train the cell structure on a raw matrix: ``(centroids (C, F)
+    float32, members (C, cap) int32 padded -1, assignments (N,)
+    int32)``.  The trainer IS ``tools/clustering.kmeans`` (deterministic
+    seeding + empty-cell reseed), so the index and the clustering tool
+    share one centroid definition; at index scale (C ≈ √N) it trains on
+    an evenly strided sample with strided seeding, then assigns every
+    row in one :func:`assign_cells` pass.  Standalone so ``bench.py``
+    can build over synthetic matrices without a feature store."""
+    from tmlibrary_tpu.tools.clustering import kmeans
+
+    x = np.ascontiguousarray(x, np.float32)
+    n = int(x.shape[0])
+    if n == 0:
+        raise StoreError("cannot build an IVF index over an empty store")
+    c = (int(n_cells) if n_cells
+         else max(1, int(round(AUTO_CELLS_SQRT_MULT * math.sqrt(n)))))
+    c = max(1, min(c, n))
+    train_n = min(n, max(TRAIN_SAMPLE_CAP, 2 * c))
+    train = (x if train_n >= n
+             else x[np.linspace(0, n - 1, train_n).astype(np.int64)])
+    init = "greedy" if c <= GREEDY_SEED_MAX_CELLS else "stride"
+    _, cent = jax.jit(kmeans, static_argnums=(1, 2, 4))(
+        jnp.asarray(train), c, n_iter, seed, init
+    )
+    assign_np = np.asarray(assign_cells(jnp.asarray(x), cent), np.int32)
+    counts = np.bincount(assign_np, minlength=c)
+    cap = max(1, int(counts.max()))
+    members = np.full((c, cap), -1, np.int32)
+    fill = np.zeros(c, np.int64)
+    order = np.argsort(assign_np, kind="stable")  # row order within cells
+    for row in order:
+        cell = assign_np[row]
+        members[cell, fill[cell]] = row
+        fill[cell] += 1
+    return np.asarray(cent, np.float32), members, assign_np
+
+
+def ivf_search_arrays(x: np.ndarray, centroids: np.ndarray,
+                      members: np.ndarray, k: int,
+                      queries: np.ndarray | None = None,
+                      top_p: int | None = None, tile: int | None = None
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """IVF kNN over raw arrays; same contract as ``ops.knn`` (indices
+    sorted nearest-first, self excluded when ``queries`` is None).
+    The self sweep runs cell-major (``_ivf_self_tile``: one GEMM per
+    cell over its ``top_p``-nearest-cell candidates); explicit queries
+    run query-major (``_ivf_tile``: each query probes ITS ``top_p``
+    nearest cells).  Rows whose probed cells hold fewer than k members
+    report the shortfall as +inf distance (index 0) rather than
+    silently wrong neighbors — with ``top_p * cap > k`` this does not
+    happen on any non-degenerate store."""
+    x = jnp.asarray(x, jnp.float32)
+    cent = jnp.asarray(centroids, jnp.float32)
+    mem = jnp.asarray(members, jnp.int32)
+    n = int(x.shape[0])
+    c, cap = int(mem.shape[0]), int(mem.shape[1])
+    self_query = queries is None
+    q_all = x if self_query else jnp.asarray(queries, jnp.float32)
+    nq = int(q_all.shape[0])
+    k = min(int(k), n - 1 if self_query else n)
+    if k <= 0:
+        return (np.zeros((nq, 0), np.int32), np.zeros((nq, 0), np.float32))
+    top_p = int(top_p) if top_p else DEFAULT_TOP_P
+    # enough probed members to fill k answers (+1 covers self-exclusion)
+    while top_p < c and top_p * cap < k + 1:
+        top_p += 1
+    top_p = min(top_p, c)
+    m = top_p * cap
+
+    if self_query:
+        # cell-major: candidate list per CELL (members of its top_p
+        # nearest cells, self first — top_k on the negated distance
+        # matrix puts the zero diagonal first), identical for every
+        # query in the cell and independent of k, so the k-prefix
+        # fusion property holds exactly as on the brute path
+        dcc = (
+            jnp.sum(cent * cent, axis=1, keepdims=True)
+            - 2.0 * cent @ cent.T
+            + jnp.sum(cent * cent, axis=1)[None]
+        )
+        _, cellrank = jax.lax.top_k(-dcc, top_p)              # (C, P)
+        cand = mem[cellrank].reshape(c, m)                    # (C, m)
+        if tile:
+            cells_tile = max(1, min(c, int(tile)))
+        else:
+            # (Ct, cap, m) distance block is the big intermediate
+            per_cell = 4 * cap * m
+            cells_tile = max(
+                1, min(c, ops.KNN_TILE_BLOCK_BYTES // max(1, per_cell))
+            )
+        idx_out = np.empty((n, k), np.int32)
+        dist_out = np.empty((n, k), np.float32)
+        mem_np = np.asarray(mem)
+        valid = mem_np >= 0
+        for start in range(0, c, cells_tile):
+            stop = min(start + cells_tile, c)
+            mem_t, cand_t = mem[start:stop], cand[start:stop]
+            pad = cells_tile - (stop - start)
+            if pad:  # fixed tile shape -> one compiled program
+                mem_t = jnp.pad(mem_t, ((0, pad), (0, 0)),
+                                constant_values=-1)
+                cand_t = jnp.pad(cand_t, ((0, pad), (0, 0)),
+                                 constant_values=-1)
+            idx, dist = _ivf_self_tile(x, mem_t, cand_t, k)
+            v = valid[start:stop]
+            rows = mem_np[start:stop][v]
+            idx_out[rows] = np.asarray(idx)[: stop - start][v]
+            dist_out[rows] = np.asarray(dist)[: stop - start][v]
+        return idx_out, dist_out
+
+    if tile:
+        tile = int(tile)
+    else:
+        # (tile, M, F) candidate block is the big intermediate
+        per_row = 4 * m * (int(x.shape[1]) + 2)
+        tile = max(8, min(nq, ops.KNN_TILE_BLOCK_BYTES // max(1, per_row)))
+    idx_out = np.empty((nq, k), np.int32)
+    dist_out = np.empty((nq, k), np.float32)
+    for start in range(0, nq, tile):
+        stop = min(start + tile, nq)
+        q = q_all[start:stop]
+        pad = tile - (stop - start)
+        if pad:  # fixed tile shape -> one compiled program for the sweep
+            q = jnp.pad(q, ((0, pad), (0, 0)))
+        idx, dist = _ivf_tile(q, x, cent, mem, jnp.int32(start), k,
+                              top_p, self_query)
+        idx_out[start:stop] = np.asarray(idx)[: stop - start]
+        dist_out[start:stop] = np.asarray(dist)[: stop - start]
+    return idx_out, dist_out
+
+
+def measure_recall(x: np.ndarray, centroids: np.ndarray,
+                   members: np.ndarray, k: int = RECALL_K,
+                   top_p: int | None = None,
+                   sample: int = RECALL_SAMPLE) -> float:
+    """recall@k of the IVF search vs exact brute force on a strided
+    query sample (deterministic; no store needed — bench uses it too).
+    Probes query-major (each sample point probes ITS nearest cells);
+    the cell-major self sweep probes per-cell neighborhoods instead,
+    whose recall the test suite pins separately on clustered data."""
+    n = int(np.asarray(x).shape[0])
+    k = max(1, min(int(k), n - 1))
+    take = max(1, min(int(sample), n))
+    rows = np.linspace(0, n - 1, take).astype(np.int64)
+    q = np.asarray(x, np.float32)[rows]
+    exact_idx, _ = ops.knn(x, k, queries=q)
+    ivf_idx, _ = ivf_search_arrays(x, centroids, members, k, queries=q,
+                                   top_p=top_p)
+    hits = 0
+    for a, b in zip(ivf_idx, exact_idx):
+        hits += len(set(a.tolist()) & set(b.tolist()))
+    return round(hits / float(exact_idx.size), 6)
+
+
+# ------------------------------------------------------------ persistence
+def selection_key(features: list[str] | None,
+                  n_cells: int | None = None) -> str:
+    """Directory key for one (feature selection, cell count) pair —
+    'all' is the full matrix at the auto √N cell count.  An explicit
+    cell count (e.g. the clustering tool reusing the codebook at its
+    own k) gets its own directory so it never clobbers the search
+    index."""
+    sel = ("all" if not features
+           else hashlib.sha256(
+               json.dumps(list(features)).encode()).hexdigest()[:12])
+    return sel if n_cells is None else f"{sel}-c{int(n_cells)}"
+
+
+def index_dir(fs: FeatureStore, features: list[str] | None = None,
+              n_cells: int | None = None) -> Path:
+    """Where one selection's persisted index artifacts live."""
+    return fs.root / "index" / selection_key(features, n_cells)
+
+
+def _index_digest(centroids: np.ndarray, members: np.ndarray) -> str:
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(centroids, np.float32).tobytes())
+    h.update(np.ascontiguousarray(members, np.int32).tobytes())
+    return h.hexdigest()
+
+
+class IvfIndex:
+    """The persisted artifact; open through :meth:`ensure`."""
+
+    def __init__(self, root: Path, meta: dict, centroids: np.ndarray,
+                 members: np.ndarray):
+        self.root = Path(root)
+        self.meta = meta
+        self.centroids = centroids
+        self.members = members
+        #: how :meth:`ensure` produced this instance ("build" | "hit");
+        #: consumers carry it into result attributes so ledger replay
+        #: can reconstruct the build/hit counters (telemetry.py)
+        self.cache_state = "build"
+
+    @property
+    def digest(self) -> str:
+        return self.meta["digest"]
+
+    @property
+    def n_cells(self) -> int:
+        return int(self.meta["n_cells"])
+
+    @property
+    def recall_at_k(self) -> float | None:
+        return self.meta.get("recall_at_k")
+
+    def assignments(self) -> np.ndarray:
+        """(N,) int32 cell assignment per object row — the clustering
+        tool reuses this directly when its k equals ``n_cells``."""
+        return np.load(self.root / "assignments.npy")
+
+    # ------------------------------------------------------------ build
+    @classmethod
+    def build(cls, fs: FeatureStore, features: list[str] | None = None,
+              n_cells: int | None = None, seed: int = 0,
+              n_iter: int = 25) -> "IvfIndex":
+        _, x, feat_cols = fs.standardized(features)
+        centroids, members, assign = ivf_build_arrays(
+            x, n_cells=n_cells, seed=seed, n_iter=n_iter
+        )
+        recall = measure_recall(x, centroids, members)
+        root = index_dir(fs, features, n_cells)
+        root.mkdir(parents=True, exist_ok=True)
+        np.save(root / "centroids.npy", centroids)
+        np.save(root / "members.npy", members)
+        np.save(root / "assignments.npy", assign)
+        counts = np.bincount(assign, minlength=centroids.shape[0])
+        meta = {
+            "schema_version": INDEX_SCHEMA_VERSION,
+            "kind": "ivf",
+            "objects_name": fs.meta.get("objects_name"),
+            "store_digest": fs.digest,
+            "features": feat_cols,
+            "selection": selection_key(features, n_cells),
+            "n_objects": int(x.shape[0]),
+            "n_cells": int(centroids.shape[0]),
+            "cell_capacity": int(members.shape[1]),
+            "cell_fill": round(float(counts.mean())
+                               / max(1, int(members.shape[1])), 4),
+            "seed": int(seed),
+            "n_iter": int(n_iter),
+            "digest": _index_digest(centroids, members),
+            "recall_at_k": recall,
+            "recall_k": RECALL_K,
+            "recall_sample": RECALL_SAMPLE,
+            "default_top_p": DEFAULT_TOP_P,
+            "built_at": time.time(),
+        }
+        atomic_write_json(root / "index_meta.json", meta)
+        _metric("tmx_analytics_index_builds_total")
+        return cls(root, meta, centroids, members)
+
+    @classmethod
+    def ensure(cls, fs: FeatureStore, features: list[str] | None = None,
+               n_cells: int | None = None, seed: int = 0,
+               rebuild: bool = False) -> "IvfIndex":
+        """Open or (re)build.  Reuse requires the recorded store digest
+        to equal the live one — an append rolled the store digest, so
+        stale indexes rebuild here, never serve."""
+        root = index_dir(fs, features, n_cells)
+        meta_path = root / "index_meta.json"
+        if not rebuild and meta_path.exists():
+            try:
+                meta = json.loads(meta_path.read_text())
+                if (meta.get("schema_version") == INDEX_SCHEMA_VERSION
+                        and meta.get("store_digest") == fs.digest
+                        and (n_cells is None
+                             or int(meta.get("n_cells", -1)) == int(n_cells))
+                        and (root / "centroids.npy").exists()
+                        and (root / "members.npy").exists()):
+                    _metric("tmx_analytics_index_hits_total")
+                    out = cls(
+                        root, meta,
+                        np.load(root / "centroids.npy"),
+                        np.load(root / "members.npy"),
+                    )
+                    out.cache_state = "hit"
+                    return out
+            except Exception:
+                pass  # corrupt artifact: rebuild below
+        return cls.build(fs, features, n_cells=n_cells, seed=seed)
+
+    def search(self, x: np.ndarray, k: int,
+               queries: np.ndarray | None = None,
+               top_p: int | None = None, tile: int | None = None
+               ) -> tuple[np.ndarray, np.ndarray]:
+        return ivf_search_arrays(x, self.centroids, self.members, k,
+                                 queries=queries, top_p=top_p, tile=tile)
+
+
+# ------------------------------------------------------------- dispatcher
+def knn_search(fs: FeatureStore, x: np.ndarray, k: int,
+               queries: np.ndarray | None = None,
+               mode: str | None = None, features: list[str] | None = None,
+               top_p: int | None = None, tile: int | None = None
+               ) -> tuple[np.ndarray, np.ndarray, dict[str, Any]]:
+    """The ONE kNN dispatch every consumer routes through.
+
+    ``x`` must be the store's standardized matrix for ``features`` (the
+    callers already hold it).  Returns ``(idx, dist, info)`` where
+    ``info`` records the resolved mode, why, and — on the ivf path —
+    the index digest and its measured recall@k.  Any index failure
+    degrades to brute force and counts a fallback; results stay
+    correct, only slower."""
+    requested, source = resolve_index_mode(mode, n_objects=int(x.shape[0]))
+    info: dict[str, Any] = {"index": requested, "index_source": source}
+    if requested == "ivf":
+        try:
+            idx_obj = IvfIndex.ensure(fs, features)
+            out_idx, out_dist = idx_obj.search(x, k, queries=queries,
+                                               top_p=top_p, tile=tile)
+            info.update({
+                "index_digest": idx_obj.digest,
+                "index_cache": idx_obj.cache_state,
+                "recall_at_k": idx_obj.recall_at_k,
+                "n_cells": idx_obj.n_cells,
+                "top_p": int(top_p) if top_p else DEFAULT_TOP_P,
+            })
+            return out_idx, out_dist, info
+        except Exception as exc:  # degrade, never fail the query
+            _metric("tmx_analytics_index_fallbacks_total")
+            info.update({"index": "brute", "index_fallback": str(exc)})
+    out_idx, out_dist = ops.knn(x, k, queries=queries, tile=tile)
+    return out_idx, out_dist, info

@@ -176,14 +176,23 @@ def _spectral(neighbors: jax.Array, weights: jax.Array, n: int,
 
 
 def spectral_embedding(x: np.ndarray, n_components: int = 2, k: int = 15,
-                       n_iter: int = 60, tile: int | None = None
+                       n_iter: int = 60, tile: int | None = None,
+                       graph: tuple[np.ndarray, np.ndarray] | None = None
                        ) -> np.ndarray:
     """UMAP-style 2-D layout: kNN graph -> Gaussian edge weights ->
     top eigenvectors of the normalized adjacency (trivial vector
-    deflated).  Returns (N, n_components) float32, deterministic."""
+    deflated).  Returns (N, n_components) float32, deterministic.
+
+    ``graph`` supplies a precomputed self-kNN ``(neighbors, dists)``
+    pair — the embedding tool passes an index-backed graph here
+    (``analytics/index.knn_search``) so the layout goes sublinear with
+    the store; without it the exact brute-force sweep runs."""
     n = int(np.asarray(x).shape[0])
     k = max(1, min(int(k), n - 1))
-    neighbors, dists = knn(x, k, tile=tile)
+    if graph is not None:
+        neighbors, dists = graph
+    else:
+        neighbors, dists = knn(x, k, tile=tile)
     # adaptive Gaussian kernel: each row's bandwidth is its median
     # neighbor distance (umap's local connectivity, simplified)
     sigma = np.maximum(np.median(dists, axis=1, keepdims=True), 1e-6)

@@ -134,6 +134,129 @@ def run_query(store: "ExperimentStore", payload: dict[str, Any],
     return _summary(result, key, fs.digest, "miss", elapsed, cache_dir)
 
 
+def fusion_signature(payload: dict[str, Any]) -> str | None:
+    """The fusable identity of a query payload, or None when the tool
+    cannot ride a shared sweep.
+
+    Jobs fuse when everything except ``k`` matches: one indexed (or
+    brute) neighbor sweep at the LARGEST requested k serves every job,
+    because ``lax.top_k`` rows are sorted nearest-first with a
+    deterministic tie-break — the k-prefix of a larger-k sweep IS the
+    smaller-k answer, bit for bit.  Today that family is the ``knn``
+    tool; identical payloads of ANY tool already coalesce through the
+    digest-keyed cache (first job misses, the rest hit)."""
+    if payload.get("tool") != "knn":
+        return None
+    return canonical_payload(
+        {k: v for k, v in payload.items() if k != "k"}
+    )
+
+
+def run_query_batch(store: "ExperimentStore",
+                    payloads: list[dict[str, Any]],
+                    use_cache: bool = True,
+                    emit: Callable[..., Any] | None = None
+                    ) -> list[dict[str, Any]]:
+    """Answer N fusable queries with ONE batched device sweep.
+
+    Every payload must share a :func:`fusion_signature` (the serve
+    daemon's fusion group predicate guarantees it; checked loud here).
+    Cache hits are served per job first; the remaining jobs run one
+    ``knn_search`` at the largest k, each job's result is sliced from
+    the shared (idx, dist) prefix, assembled by the SAME code the
+    sequential path runs, and cached under its own ``query_key``.  The
+    first computed job reports ``cache: miss`` (it would have paid the
+    sweep anyway); followers report ``cache: fused`` plus
+    ``fused_with``/``fusion_window`` provenance.  Summaries return in
+    payload order."""
+    payloads = [dict(p) for p in payloads]
+    if not payloads:
+        return []
+    if len(payloads) == 1:
+        return [run_query(store, payloads[0], use_cache=use_cache,
+                          emit=emit)]
+    sig = fusion_signature(payloads[0])
+    if sig is None or any(fusion_signature(p) != sig for p in payloads[1:]):
+        raise NotSupportedError(
+            "run_query_batch needs payloads sharing one fusion signature"
+        )
+    t0 = time.monotonic()
+    with telemetry.span("feature_store", emit=emit):
+        fs = FeatureStore.ensure(store, payloads[0]["objects_name"])
+    keys = [query_key(fs.digest, p) for p in payloads]
+    out: list[dict[str, Any] | None] = [None] * len(payloads)
+    pending: list[int] = []
+    for i, (p, key) in enumerate(zip(payloads, keys)):
+        cache_dir = queries_dir(store) / key
+        if use_cache and (cache_dir / "result.json").exists():
+            result = ToolResult.load(cache_dir)
+            elapsed = round(time.monotonic() - t0, 4)
+            _metric("counter", "tmx_analytics_queries_total",
+                    tool="knn", cache="hit")
+            _metric("counter", "tmx_analytics_cache_hits_total", tool="knn")
+            _metric("histogram", "tmx_analytics_query_seconds", elapsed,
+                    tool="knn")
+            out[i] = _summary(result, key, fs.digest, "hit", elapsed,
+                              cache_dir)
+        else:
+            pending.append(i)
+    if not pending:
+        return [s for s in out if s is not None]
+
+    import numpy as np
+
+    from tmlibrary_tpu.analytics import ops
+    from tmlibrary_tpu.analytics.index import knn_search
+    from tmlibrary_tpu.analytics.tools import assemble_knn_result
+
+    ref = payloads[pending[0]]
+    features = ref.get("features")
+    k_max = max(int(payloads[i].get("k", 10)) for i in pending)
+    with telemetry.span("query_tool", emit=emit):
+        ids, x, feat_cols = fs.standardized(features)
+        idx, dist, info = knn_search(
+            fs, x, k_max, mode=ref.get("index"), features=features,
+            top_p=ref.get("top_p"), tile=ref.get("tile"),
+        )
+    window = len(pending)
+    tile_rows = int(ref.get("tile") or ops.knn_tile_rows(len(ids)))
+    leader_key = keys[pending[0]]
+    for rank, i in enumerate(pending):
+        p, key = payloads[i], keys[i]
+        k_i = min(int(p.get("k", 10)), idx.shape[1])
+        result = assemble_knn_result(
+            p["objects_name"], ids.copy(),
+            np.ascontiguousarray(idx[:, :k_i]),
+            np.ascontiguousarray(dist[:, :k_i]),
+            feat_cols, fs.digest, tile_rows, info,
+        )
+        cache_dir = queries_dir(store) / key
+        result.save(cache_dir)
+        elapsed = round(time.monotonic() - t0, 4)
+        atomic_write_json(cache_dir / "query.json", {
+            "key": key,
+            "tool": "knn",
+            "payload": p,
+            "store_digest": fs.digest,
+            "elapsed_s": elapsed,
+            "cached_at": time.time(),
+            "fusion_window": window,
+            "fused_with": leader_key,
+        })
+        cache = "miss" if rank == 0 else "fused"
+        _metric("counter", "tmx_analytics_queries_total",
+                tool="knn", cache=cache)
+        _metric("histogram", "tmx_analytics_query_seconds", elapsed,
+                tool="knn")
+        summary = _summary(result, key, fs.digest, cache, elapsed,
+                           cache_dir)
+        summary["fusion_window"] = window
+        if rank:
+            summary["fused_with"] = leader_key
+        out[i] = summary
+    return [s for s in out if s is not None]
+
+
 def _summary(result: ToolResult, key: str, digest: str, cache: str,
              elapsed: float, cache_dir: Path) -> dict[str, Any]:
     return {

@@ -11,20 +11,46 @@ shards ONCE into ``<experiment>/analytics/<objects_name>/``::
                     well_row, well_col (+ site_y/site_x and the
                     Morphology centroids when the run measured them)
     meta.json       feature names (in matrix column order), shapes,
-                    the content digest, and the source-shard digest
+                    the content digest, the source-shard digest and the
+                    per-shard ingest ledger
 
 so a whole experiment loads as ONE device array — the rapids-singlecell
 pattern of accelerator-native single-cell analytics, on XLA.
 
-Digests
--------
-``digest`` is a sha256 over the feature names, the raw float32 matrix
-bytes and the identity columns — i.e. over the *content* a query can
-observe.  Two stores built from bit-identical features (e.g. the same
-workflow at different pipeline depths) share a digest, so the query
-cache (``analytics/query.py``) keys results on it.  ``source_digest``
-hashes the raw shard files and is only used for staleness: when a new
-shard lands (or one is rewritten), :meth:`FeatureStore.ensure` rebuilds.
+Digests (schema v2: per-shard chains)
+-------------------------------------
+``digest`` covers the *content* a query can observe — the feature names
+in matrix column order, the float32 matrix bytes and the identity
+columns — but is computed as a CHAIN over the sorted shards::
+
+    state_0   = sha256(features_json)
+    state_i+1 = sha256(state_i || shard_name || sha256(shard rows))
+
+so two stores built from bit-identical features (e.g. the same workflow
+at different pipeline depths) still share a digest, and — the reason the
+chain exists — an APPEND of new shards can roll the digest forward from
+the recorded ``state_N`` touching only the new rows, landing on exactly
+the value a from-scratch rebuild computes.  ``source_digest`` is the
+same chain shape over the raw shard files (name + file sha256): the
+staleness key.  ``meta.json`` additionally records one ledger row per
+ingested shard (name, rows, file sha, size, mtime) so :meth:`ensure`
+can classify the shard directory as *unchanged* (cheap stat fast path),
+*grown* (append only the new tail shards) or *rewritten* (full rebuild)
+without re-hashing bytes it already ingested.
+
+Incremental ingest
+------------------
+:meth:`FeatureStore.append` folds new shards into the existing
+artifacts with work proportional to the NEW shards only: matrix rows
+are appended to ``matrix.npy`` in place (the .npy header is patched for
+the new row count), the narrow identity frame is extended, and both
+digest chains roll forward.  Appends are only taken when every already
+ingested shard is untouched and every new shard sorts after the last
+ingested one (jterator batch shards are ``batch_NNN`` — monotonic), so
+row order stays identical to a rebuild; anything else falls back to a
+full rebuild.  A rolled ``digest`` invalidates the query cache
+(``analytics/query.py``) and the IVF index (``analytics/index.py``) —
+both key on it.
 
 The matrix stores RAW values (as float32, the dtype every tool already
 converts to); standardization (z-score with finite-mean NaN imputation,
@@ -37,6 +63,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import struct
 import time
 from pathlib import Path
 from typing import TYPE_CHECKING
@@ -61,7 +88,14 @@ ID_COLUMNS = ("site_index", "label", "plate", "well_row", "well_col",
 NON_FEATURE_COLUMNS = ("site_index", "label", "plate", "well_row",
                        "well_col", "site_y", "site_x")
 
-SCHEMA_VERSION = 1
+#: v2: chained per-shard digests + the shard ingest ledger (v1 metas —
+#: whole-matrix digests, no shard ledger — rebuild on first ensure)
+SCHEMA_VERSION = 2
+
+_RENAME = {
+    "Morphology_centroid_y": "centroid_y",
+    "Morphology_centroid_x": "centroid_x",
+}
 
 
 def analytics_dir(store: "ExperimentStore", objects_name: str) -> Path:
@@ -69,29 +103,38 @@ def analytics_dir(store: "ExperimentStore", objects_name: str) -> Path:
     return Path(store.root) / "analytics" / objects_name
 
 
-def _source_digest(store: "ExperimentStore", objects_name: str) -> str:
-    """sha256 over the raw feature shards (names + bytes): the staleness
-    key.  Any appended or rewritten shard changes it."""
-    h = hashlib.sha256()
+def _shard_paths(store: "ExperimentStore", objects_name: str) -> list[Path]:
     shards = sorted(store.features_dir(objects_name).glob("*.parquet"))
     if not shards:
         raise StoreError(f"no feature shards for '{objects_name}'")
-    for p in shards:
-        h.update(p.name.encode())
-        h.update(p.read_bytes())
-    return h.hexdigest()
+    return shards
 
 
-def _content_digest(features: list[str], matrix: np.ndarray,
-                    index: pd.DataFrame) -> str:
-    """sha256 over what a query can observe: feature names in column
-    order, the float32 matrix bytes, and the identity columns."""
+def _chain(state: str, shard_name: str, chunk_hex: str) -> str:
+    """One link of a shard digest chain (content or source)."""
+    return hashlib.sha256(
+        f"{state}|{shard_name}|{chunk_hex}".encode()
+    ).hexdigest()
+
+
+def _content_seed(features: list[str]) -> str:
+    """Chain seed: the feature names in matrix column order."""
+    return hashlib.sha256(json.dumps(features).encode()).hexdigest()
+
+
+def _source_seed() -> str:
+    return hashlib.sha256(b"tmx-feature-source-v2").hexdigest()
+
+
+def _rows_digest(matrix_rows: np.ndarray, index_rows: pd.DataFrame) -> str:
+    """sha256 over one shard's observable content: its float32 matrix
+    rows plus its identity rows (column name + raw values, object
+    columns via a stable JSON string form)."""
     h = hashlib.sha256()
-    h.update(json.dumps(features).encode())
-    h.update(np.ascontiguousarray(matrix, np.float32).tobytes())
-    for col in index.columns:
+    h.update(np.ascontiguousarray(matrix_rows, np.float32).tobytes())
+    for col in index_rows.columns:
         h.update(col.encode())
-        vals = index[col].to_numpy()
+        vals = index_rows[col].to_numpy()
         if vals.dtype == object:
             h.update(json.dumps([str(v) for v in vals]).encode())
         else:
@@ -99,8 +142,119 @@ def _content_digest(features: list[str], matrix: np.ndarray,
     return h.hexdigest()
 
 
+def _file_sha(path: Path) -> str:
+    return hashlib.sha256(path.read_bytes()).hexdigest()
+
+
+def _shard_record(path: Path, rows: int, sha: str) -> dict:
+    st = path.stat()
+    return {
+        "name": path.name,
+        "rows": int(rows),
+        "sha": sha,
+        "size": int(st.st_size),
+        "mtime_ns": int(st.st_mtime_ns),
+    }
+
+
+def _shard_unchanged(path: Path, rec: dict) -> bool:
+    """Cheap staleness check for one already-ingested shard: the
+    (size, mtime) stat fast path, falling back to the recorded file
+    sha when the stat moved (e.g. an idempotent re-write of identical
+    bytes — common under workflow retries)."""
+    try:
+        st = path.stat()
+    except OSError:
+        return False
+    if (int(st.st_size) == int(rec.get("size", -1))
+            and int(st.st_mtime_ns) == int(rec.get("mtime_ns", -1))):
+        return True
+    return _file_sha(path) == rec.get("sha")
+
+
+# ------------------------------------------------------- npy row append
+def _npy_header_bytes(shape: tuple, dtype: np.dtype, version: tuple,
+                      total_len: int) -> bytes | None:
+    """A v1/v2 .npy header for ``shape`` padded to exactly ``total_len``
+    bytes (magic included), or None when it cannot fit — the caller
+    falls back to a full matrix rewrite."""
+    descr = np.lib.format.dtype_to_descr(np.dtype(dtype))
+    body = ("{'descr': %r, 'fortran_order': False, 'shape': %r, }"
+            % (descr, tuple(int(s) for s in shape))).encode("latin1")
+    magic = b"\x93NUMPY" + bytes(bytearray(version))
+    size_len = 2 if version == (1, 0) else 4
+    payload_len = total_len - len(magic) - size_len
+    if len(body) + 1 > payload_len or payload_len < 0:
+        return None
+    body = body + b" " * (payload_len - len(body) - 1) + b"\n"
+    size = (struct.pack("<H", payload_len) if size_len == 2
+            else struct.pack("<I", payload_len))
+    return magic + size + body
+
+
+def _append_npy_rows(path: Path, rows: np.ndarray) -> None:
+    """Append C-order rows to an existing ``.npy`` in place: new row
+    bytes go at the end, the fixed-size header is patched for the new
+    shape.  When the header cannot hold the larger shape string (rare:
+    the digit count outgrew the padding) the matrix is rewritten from
+    its own memmap — still never from the Parquet shards."""
+    rows = np.ascontiguousarray(rows)
+    with open(path, "r+b") as f:
+        version = np.lib.format.read_magic(f)
+        if version == (1, 0):
+            shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+        else:
+            shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+        if fortran:
+            raise StoreError("matrix.npy is Fortran-ordered; cannot append")
+        if np.dtype(dtype) != rows.dtype or shape[1:] != rows.shape[1:]:
+            raise StoreError(
+                f"matrix layout mismatch on append: have {shape} "
+                f"{np.dtype(dtype)}, appending {rows.shape} {rows.dtype}"
+            )
+        data_start = f.tell()
+        new_shape = (int(shape[0]) + int(rows.shape[0]),) + tuple(shape[1:])
+        header = _npy_header_bytes(new_shape, dtype, version, data_start)
+        if header is not None:
+            f.seek(0, 2)
+            f.write(rows.tobytes())
+            f.seek(0)
+            f.write(header)
+            return
+    # fallback: header outgrown — rewrite from the existing artifact
+    old = np.load(path, mmap_mode="r")
+    merged = np.concatenate([np.asarray(old), rows], axis=0)
+    del old
+    np.save(path, merged)
+
+
+def _source_digest(store: "ExperimentStore", objects_name: str) -> str:
+    """Chained sha256 over the raw feature shards (name + file sha):
+    the staleness key.  Any appended or rewritten shard changes it.
+    Kept as a module function for callers that need the chain without
+    building (``FeatureStore.build`` computes it incrementally)."""
+    state = _source_seed()
+    for p in _shard_paths(store, objects_name):
+        state = _chain(state, p.name, _file_sha(p))
+    return state
+
+
+def _extract(table: pd.DataFrame, feat_cols: list[str]
+             ) -> tuple[np.ndarray, pd.DataFrame]:
+    """(float32 matrix, renamed identity frame) for one table — the ONE
+    definition both the full build and the append path run, so their
+    bytes (and therefore their chained digests) agree."""
+    # C-order explicitly: pandas hands back Fortran-order blocks, and
+    # the in-place row append needs C-order matrix bytes on disk
+    matrix = np.ascontiguousarray(table[feat_cols].to_numpy(np.float32))
+    index = table[[c for c in ID_COLUMNS if c in table.columns]].copy()
+    index = index.rename(columns=_RENAME)
+    return matrix, index
+
+
 class FeatureStore:
-    """The built artifact: open with :meth:`ensure` (builds or reuses)."""
+    """The built artifact: open with :meth:`ensure` (builds, appends or
+    reuses)."""
 
     def __init__(self, root: Path, meta: dict):
         self.root = Path(root)
@@ -112,18 +266,33 @@ class FeatureStore:
     @classmethod
     def build(cls, store: "ExperimentStore", objects_name: str,
               source_digest: str | None = None) -> "FeatureStore":
-        table = store.read_features(objects_name)
+        """Full ingest of every shard (``source_digest`` is accepted for
+        backwards compatibility and ignored — the chain is computed
+        per shard while the bytes are in hand anyway)."""
+        shard_paths = _shard_paths(store, objects_name)
+        tables = [pd.read_parquet(p) for p in shard_paths]
+        table = pd.concat(tables, ignore_index=True)
         feat_cols = [
             c for c in table.columns
             if c not in NON_FEATURE_COLUMNS
             and np.issubdtype(table[c].dtype, np.number)
         ]
-        matrix = table[feat_cols].to_numpy(np.float32)
-        index = table[[c for c in ID_COLUMNS if c in table.columns]].copy()
-        index = index.rename(columns={
-            "Morphology_centroid_y": "centroid_y",
-            "Morphology_centroid_x": "centroid_x",
-        })
+        matrix, index = _extract(table, feat_cols)
+        # chained digests over the per-shard row slices of the SAME
+        # concatenated frame the matrix was cut from, so heterogeneous
+        # shard schemas (concat unions columns) hash what was ingested
+        state = _content_seed(feat_cols)
+        src = _source_seed()
+        shards = []
+        lo = 0
+        for p, t in zip(shard_paths, tables):
+            hi = lo + len(t)
+            state = _chain(state, p.name,
+                           _rows_digest(matrix[lo:hi], index.iloc[lo:hi]))
+            sha = _file_sha(p)
+            src = _chain(src, p.name, sha)
+            shards.append(_shard_record(p, hi - lo, sha))
+            lo = hi
         root = analytics_dir(store, objects_name)
         root.mkdir(parents=True, exist_ok=True)
         np.save(root / "matrix.npy", matrix)
@@ -135,33 +304,117 @@ class FeatureStore:
             "columns": [c for c in table.columns],
             "n_objects": int(matrix.shape[0]),
             "n_features": int(matrix.shape[1]),
-            "digest": _content_digest(feat_cols, matrix, index),
-            "source_digest": (source_digest
-                              or _source_digest(store, objects_name)),
+            "digest": state,
+            "source_digest": src,
+            "shards": shards,
+            "build_kind": "full",
             "built_at": time.time(),
         }
+        atomic_write_json(root / "meta.json", meta)
+        return cls(root, meta)
+
+    # ----------------------------------------------------------- append
+    @classmethod
+    def append(cls, store: "ExperimentStore", objects_name: str,
+               meta: dict, new_paths: list[Path]) -> "FeatureStore":
+        """Fold ``new_paths`` (sorted, all after the last ingested
+        shard) into the existing artifacts.  Work is proportional to
+        the new shards: only they are read, their rows are appended to
+        ``matrix.npy`` in place, the identity frame is extended, and
+        both digest chains roll forward from the recorded state —
+        landing on exactly the digests a from-scratch rebuild computes.
+
+        Raises :class:`StoreError` when a new shard's schema does not
+        match the store (the caller rebuilds instead)."""
+        feat_cols = list(meta["features"])
+        root = analytics_dir(store, objects_name)
+        state = meta["digest"]
+        src = meta["source_digest"]
+        shards = list(meta["shards"])
+        mats, frames = [], []
+        for p in new_paths:
+            t = pd.read_parquet(p)
+            new_feats = [
+                c for c in t.columns
+                if c not in NON_FEATURE_COLUMNS
+                and np.issubdtype(t[c].dtype, np.number)
+            ]
+            if new_feats != feat_cols or list(t.columns) != meta["columns"]:
+                raise StoreError(
+                    f"shard {p.name} schema differs from store "
+                    f"(append needs identical columns)"
+                )
+            m, idx = _extract(t, feat_cols)
+            state = _chain(state, p.name, _rows_digest(m, idx))
+            sha = _file_sha(p)
+            src = _chain(src, p.name, sha)
+            shards.append(_shard_record(p, len(t), sha))
+            mats.append(m)
+            frames.append(idx)
+        new_matrix = np.concatenate(mats, axis=0) if mats else \
+            np.zeros((0, len(feat_cols)), np.float32)
+        _append_npy_rows(root / "matrix.npy", new_matrix)
+        index = pd.concat(
+            [pd.read_parquet(root / "index.parquet"), *frames],
+            ignore_index=True,
+        )
+        index.to_parquet(root / "index.parquet", index=False)
+        meta = dict(meta)
+        meta.update({
+            "n_objects": int(meta["n_objects"]) + int(new_matrix.shape[0]),
+            "digest": state,
+            "source_digest": src,
+            "shards": shards,
+            "build_kind": "append",
+            "appended_rows": int(new_matrix.shape[0]),
+            "appended_shards": [p.name for p in new_paths],
+            "built_at": time.time(),
+        })
         atomic_write_json(root / "meta.json", meta)
         return cls(root, meta)
 
     @classmethod
     def ensure(cls, store: "ExperimentStore", objects_name: str,
                rebuild: bool = False) -> "FeatureStore":
-        """Open the store, (re)building when missing or stale — the
-        single entry point every tool and query goes through."""
+        """Open the store, (re)building or appending when stale — the
+        single entry point every tool and query goes through.
+
+        Shard-directory classification against the meta's shard ledger:
+
+        - unchanged (same names, stat/sha match) → reuse as-is;
+        - grown (every ingested shard untouched, new shards all sort
+          after the last ingested one) → :meth:`append` the tail;
+        - anything else (removed/rewritten/out-of-order shards, v1
+          meta, corrupt artifacts) → full :meth:`build`.
+        """
         root = analytics_dir(store, objects_name)
         meta_path = root / "meta.json"
-        src = _source_digest(store, objects_name)
+        shard_paths = _shard_paths(store, objects_name)
         if not rebuild and meta_path.exists():
             try:
                 meta = json.loads(meta_path.read_text())
                 if (meta.get("schema_version") == SCHEMA_VERSION
-                        and meta.get("source_digest") == src
+                        and isinstance(meta.get("shards"), list)
                         and (root / "matrix.npy").exists()
                         and (root / "index.parquet").exists()):
-                    return cls(root, meta)
+                    recorded = meta["shards"]
+                    by_name = {p.name: p for p in shard_paths}
+                    names = [p.name for p in shard_paths]
+                    rec_names = [r["name"] for r in recorded]
+                    if (names[: len(rec_names)] == rec_names
+                            and all(_shard_unchanged(by_name[r["name"]], r)
+                                    for r in recorded)):
+                        new_paths = shard_paths[len(rec_names):]
+                        if not new_paths:
+                            return cls(root, meta)
+                        try:
+                            return cls.append(store, objects_name, meta,
+                                              new_paths)
+                        except StoreError:
+                            pass  # schema drift: fall through to rebuild
             except Exception:
                 pass  # corrupt meta: fall through to rebuild
-        return cls.build(store, objects_name, source_digest=src)
+        return cls.build(store, objects_name)
 
     @classmethod
     def open(cls, root: Path) -> "FeatureStore":

@@ -18,38 +18,65 @@ from tmlibrary_tpu.errors import NotSupportedError
 from tmlibrary_tpu.tools.base import Tool, ToolResult, register_tool
 
 
+def assemble_knn_result(objects_name: str, ids, idx: np.ndarray,
+                        dist: np.ndarray, feat_cols: list[str],
+                        store_digest: str, tile_rows: int,
+                        info: dict) -> ToolResult:
+    """Build the knn ToolResult from a finished neighbor sweep.  Shared
+    by :class:`Knn` and the fused multi-query path in
+    ``analytics/query.py`` (which runs ONE sweep at the largest k and
+    slices per job) so fused and sequential results are assembled by
+    the same code — bit-identity is then only about the sweep itself."""
+    k_eff = idx.shape[1]
+    ids["value"] = (dist.mean(axis=1).astype(np.float64)
+                    if k_eff else 0.0)
+    for j in range(k_eff):
+        ids[f"nn{j}"] = idx[:, j].astype(np.int32)
+        ids[f"nnd{j}"] = dist[:, j].astype(np.float64)
+    return ToolResult(
+        tool="knn", objects_name=objects_name,
+        layer_type="continuous", values=ids,
+        attributes={
+            "k": k_eff,
+            "features": feat_cols,
+            "tile_rows": tile_rows,
+            "mean_distance": (float(dist.mean()) if dist.size else 0.0),
+            "store_digest": store_digest,
+            **info,
+        },
+    )
+
+
 @register_tool("knn")
 class Knn(Tool):
-    """Tiled brute-force k nearest neighbors over the standardized
-    feature matrix.  Payload: ``objects_name``, optional ``k`` (default
-    10), ``features``, ``tile``.  ``values.value`` is each object's mean
-    distance to its k neighbors (an outlier score, continuous layer);
-    ``nn0..`` / ``nnd0..`` columns carry the neighbor row indices (into
-    the store's canonical object order) and distances."""
+    """k nearest neighbors over the standardized feature matrix —
+    IVF-indexed or tiled brute force per the ``index`` knob
+    (``analytics/index.resolve_index_mode``).  Payload: ``objects_name``,
+    optional ``k`` (default 10), ``features``, ``tile``, ``index``
+    (``auto|ivf|brute``), ``top_p`` (cells probed per query on the ivf
+    path).  ``values.value`` is each object's mean distance to its k
+    neighbors (an outlier score, continuous layer); ``nn0..`` /
+    ``nnd0..`` columns carry the neighbor row indices (into the store's
+    canonical object order) and distances.  Attributes record the
+    resolved index mode, why it was picked, and — when indexed — the
+    index digest and its measured recall@k."""
 
     def process(self, payload: dict) -> ToolResult:
+        from tmlibrary_tpu.analytics.index import knn_search
+
         objects_name = payload["objects_name"]
         k = int(payload.get("k", 10))
+        features = payload.get("features")
         fs = FeatureStore.ensure(self.store, objects_name)
-        ids, x, feat_cols = fs.standardized(payload.get("features"))
-        idx, dist = ops.knn(x, k, tile=payload.get("tile"))
-        k_eff = idx.shape[1]
-        ids["value"] = (dist.mean(axis=1).astype(np.float64)
-                        if k_eff else 0.0)
-        for j in range(k_eff):
-            ids[f"nn{j}"] = idx[:, j].astype(np.int32)
-            ids[f"nnd{j}"] = dist[:, j].astype(np.float64)
-        return ToolResult(
-            tool=self.name, objects_name=objects_name,
-            layer_type="continuous", values=ids,
-            attributes={
-                "k": k_eff,
-                "features": feat_cols,
-                "tile_rows": int(payload.get("tile")
-                                 or ops.knn_tile_rows(len(ids))),
-                "mean_distance": (float(dist.mean()) if dist.size else 0.0),
-                "store_digest": fs.digest,
-            },
+        ids, x, feat_cols = fs.standardized(features)
+        idx, dist, info = knn_search(
+            fs, x, k, mode=payload.get("index"), features=features,
+            top_p=payload.get("top_p"), tile=payload.get("tile"),
+        )
+        return assemble_knn_result(
+            objects_name, ids, idx, dist, feat_cols, fs.digest,
+            int(payload.get("tile") or ops.knn_tile_rows(len(ids))),
+            info,
         )
 
 
@@ -86,16 +113,28 @@ class Pca(Tool):
 class Embedding(Tool):
     """kNN-graph spectral embedding (UMAP-style 2-D layout).  Payload:
     ``objects_name``, optional ``n_components`` (default 2), ``k``
-    (default 15), ``features``.  ``value`` is the first embedding
-    coordinate; ``emb0..`` columns carry all of them."""
+    (default 15), ``features``, ``index`` (``auto|ivf|brute``) and
+    ``top_p`` for the graph-construction kNN — the O(N·k) graph is the
+    embedding's only store-sized sweep, so the index makes the whole
+    layout sublinear.  ``value`` is the first embedding coordinate;
+    ``emb0..`` columns carry all of them."""
 
     def process(self, payload: dict) -> ToolResult:
+        from tmlibrary_tpu.analytics.index import knn_search
+
         objects_name = payload["objects_name"]
         n_components = int(payload.get("n_components", 2))
         k = int(payload.get("k", 15))
+        features = payload.get("features")
         fs = FeatureStore.ensure(self.store, objects_name)
-        ids, x, feat_cols = fs.standardized(payload.get("features"))
-        emb = ops.spectral_embedding(x, n_components=n_components, k=k)
+        ids, x, feat_cols = fs.standardized(features)
+        k_eff = max(1, min(k, len(ids) - 1))
+        neighbors, dists, info = knn_search(
+            fs, x, k_eff, mode=payload.get("index"), features=features,
+            top_p=payload.get("top_p"), tile=payload.get("tile"),
+        )
+        emb = ops.spectral_embedding(x, n_components=n_components,
+                                     k=k_eff, graph=(neighbors, dists))
         ids["value"] = emb[:, 0].astype(np.float64)
         for j in range(emb.shape[1]):
             ids[f"emb{j}"] = emb[:, j].astype(np.float64)
@@ -108,6 +147,7 @@ class Embedding(Tool):
                 "features": feat_cols,
                 "method": "spectral",
                 "store_digest": fs.digest,
+                **info,
             },
         )
 

@@ -511,6 +511,11 @@ def build_parser() -> argparse.ArgumentParser:
                        help="query jobs: payload as inline JSON")
     p_enq.add_argument("--payload-file", default=None,
                        help="query jobs: payload from a JSON file")
+    p_enq.add_argument("--index", default=None,
+                       choices=["auto", "ivf", "brute"],
+                       help="query jobs: kNN index routing — merged into "
+                            "the payload (default: auto via env/config/"
+                            "tuned verdict/store size)")
     p_enq.add_argument("--affinity-key", default=None, metavar="KEY",
                        help="compiled-program affinity key for fleet "
                             "routing (default: auto-derived content "
@@ -534,9 +539,39 @@ def build_parser() -> argparse.ArgumentParser:
                          help="tool payload as inline JSON")
     p_query.add_argument("--payload-file", default=None,
                          help="tool payload from a JSON file")
+    p_query.add_argument("--index", default=None,
+                         choices=["auto", "ivf", "brute"],
+                         help="kNN index routing (knn/embedding/"
+                              "clustering/classification tools) — merged "
+                              "into the payload")
     p_query.add_argument("--no-cache", action="store_true",
                          help="recompute even when a digest-keyed cached "
                               "result exists")
+
+    p_index = sub.add_parser(
+        "index", help="IVF kNN index over an experiment's feature store "
+                      "(analytics/index.py): build or inspect the "
+                      "persisted per-selection index artifacts")
+    index_sub = p_index.add_subparsers(dest="verb", required=True)
+    p_ibuild = index_sub.add_parser(
+        "build", help="build (or reuse) the index for one objects_name; "
+                      "prints the manifest JSON")
+    _add_common(p_ibuild)
+    p_ibuild.add_argument("--objects", required=True, metavar="NAME",
+                          help="mapobject type to index")
+    p_ibuild.add_argument("--features", default=None,
+                          help="comma list of feature columns (default: "
+                               "all)")
+    p_ibuild.add_argument("--cells", type=int, default=None,
+                          help="cell count override (default: 4*sqrt(N))")
+    p_ibuild.add_argument("--rebuild", action="store_true",
+                          help="force a rebuild even when the persisted "
+                               "index matches the live store digest")
+    p_ilist = index_sub.add_parser(
+        "list", help="list persisted indexes for one objects_name with "
+                     "staleness vs the live store digest")
+    _add_common(p_ilist)
+    p_ilist.add_argument("--objects", required=True, metavar="NAME")
 
     p_slo = sub.add_parser(
         "slo", help="per-tenant SLO report over a serve root: p50/p95 "
@@ -1138,6 +1173,8 @@ def _query_payload(args) -> dict:
         payload.setdefault("tool", args.tool)
     if getattr(args, "objects", None):
         payload.setdefault("objects_name", args.objects)
+    if getattr(args, "index", None):
+        payload.setdefault("index", args.index)
     if not payload.get("tool"):
         raise SystemExit("query needs a tool (--tool or payload 'tool')")
     if not payload.get("objects_name"):
@@ -1155,6 +1192,45 @@ def cmd_query(args) -> int:
         store, payload, use_cache=not args.no_cache,
     )
     print(json.dumps(summary, default=str))
+    return 0
+
+
+def cmd_index(args) -> int:
+    from tmlibrary_tpu.analytics.index import IvfIndex
+    from tmlibrary_tpu.analytics.store import FeatureStore
+
+    store = _open_store(args)
+    fs = FeatureStore.ensure(store, args.objects)
+    if args.verb == "build":
+        features = (
+            [f.strip() for f in args.features.split(",") if f.strip()]
+            if args.features else None
+        )
+        idx = IvfIndex.ensure(fs, features, n_cells=args.cells,
+                              rebuild=args.rebuild)
+        print(json.dumps({**idx.meta, "cache": idx.cache_state,
+                          "root": str(idx.root)}, default=str))
+        return 0
+    # list: every persisted selection, with staleness vs the live digest
+    rows = []
+    for meta_path in sorted((fs.root / "index").glob("*/index_meta.json")):
+        try:
+            meta = json.loads(meta_path.read_text())
+        except Exception:
+            continue
+        rows.append({
+            "selection": meta.get("selection"),
+            "n_cells": meta.get("n_cells"),
+            "n_objects": meta.get("n_objects"),
+            "recall_at_k": meta.get("recall_at_k"),
+            "digest": meta.get("digest"),
+            "state": ("fresh" if meta.get("store_digest") == fs.digest
+                      else "stale"),
+            "root": str(meta_path.parent),
+        })
+    print(json.dumps({"objects_name": args.objects,
+                      "store_digest": fs.digest, "indexes": rows},
+                     default=str))
     return 0
 
 
@@ -2250,6 +2326,8 @@ def main(argv=None) -> int:
             return cmd_enqueue(args)
         if args.command == "query":
             return cmd_query(args)
+        if args.command == "index":
+            return cmd_index(args)
         if args.command == "tool":
             return cmd_tool(args)
         if args.command == "project":

@@ -227,6 +227,25 @@ class LibraryConfig:
             _setting("serve_admission_deadline_s", "60")
         )
     )
+    #: multi-query fusion in the serve loop: concurrent `kind: query`
+    #: jobs against one store digest coalesce into one batched device
+    #: sweep (serve.py; per-job caches and attribution preserved)
+    serve_query_fusion: bool = dataclasses.field(
+        default_factory=lambda: _setting("serve_query_fusion", "1").lower()
+        in ("1", "true", "yes")
+    )
+    #: max jobs folded into one fused query sweep
+    serve_fusion_window: int = dataclasses.field(
+        default_factory=lambda: int(_setting("serve_fusion_window", "8"))
+    )
+    # --------------------------------------------------------- analytics
+    #: kNN index mode for the analytics tier ("auto" | "ivf" | "brute");
+    #: "auto" falls through to the tuned TUNING.json verdict, then a
+    #: size cutover (analytics/index.py documents the full resolution
+    #: order — the TMX_ANALYTICS_INDEX env beats this setting)
+    analytics_index: str = dataclasses.field(
+        default_factory=lambda: _setting("analytics_index", "auto")
+    )
     #: fleet spool lease duration, seconds: how long one host's claim on
     #: an admitted job stays valid without renewal.  A peer's reaper may
     #: reclaim the job once the lease is expired AND the claiming host's

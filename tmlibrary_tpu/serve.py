@@ -343,6 +343,13 @@ class ServeDaemon:
         #: job_id → admission wall time, for the WDRR scheduling-delay
         #: span (admit → execute start)
         self._admit_ts: dict[str, float] = {}
+        #: multi-query fusion (cfg.serve_query_fusion): leader job_id →
+        #: follower JobSpecs pulled from the queue to ride its sweep,
+        #: and follower job_id → its precomputed summary.  Every
+        #: follower still runs its own full job lifecycle — only the
+        #: device work is shared.
+        self._fusion_peers: dict[str, list[JobSpec]] = {}
+        self._fusion_results: dict[str, dict] = {}
         #: (tenant, window) pairs already warned this burn episode —
         #: slo_burn is warn-only AND latched, so a sustained breach is
         #: one ledger event, not one per loop iteration
@@ -1035,6 +1042,21 @@ class ServeDaemon:
                           "tool": summary.get("tool"),
                           "cache": summary.get("cache"),
                           "query_elapsed_s": summary.get("elapsed_s")}
+            if summary.get("fusion_window"):
+                extra_done["fusion_window"] = summary["fusion_window"]
+            attrs = summary.get("attributes") or {}
+            if attrs.get("index"):
+                # index provenance rides the done event so ledger replay
+                # and `tmx top` can attribute throughput to ivf vs brute
+                extra_done["index"] = attrs["index"]
+            if summary.get("cache") == "miss":
+                # only a miss drove an index ensure (hits/fused reuse
+                # the leader's sweep) — gating here keeps the replayed
+                # build/hit counters equal to the live ones
+                if attrs.get("index_cache"):
+                    extra_done["index_cache"] = attrs["index_cache"]
+                if attrs.get("index_fallback"):
+                    extra_done["index_fallback"] = True
         self.ledger.append(event="job_done", job=job.job_id,
                            tenant=job.tenant, elapsed_s=round(elapsed, 3),
                            epoch=job.claim_epoch, resumed=resume,
@@ -1057,15 +1079,24 @@ class ServeDaemon:
 
     def _run_query(self, job: JobSpec, store, deadline: float | None
                    ) -> dict:
-        """Execute one ``kind=query`` job: a single analytics query
-        through :func:`tmlibrary_tpu.analytics.query.run_query`, inside
-        the caller's job span (its ``feature_store``/``query_tool``
-        phases become child spans on the serve ledger).  Queries are
-        short and idempotent (digest-keyed cache), so preemption and
-        deadline are checked once up front instead of per batch — a
-        re-spooled query re-runs as a cache hit."""
+        """Execute one ``kind=query`` job inside the caller's job span
+        (its ``feature_store``/``query_tool`` phases become child spans
+        on the serve ledger).  Queries are short and idempotent
+        (digest-keyed cache), so preemption and deadline are checked
+        once up front instead of per batch — a re-spooled query re-runs
+        as a cache hit.
+
+        Fusion: a leader job (one with follower peers pulled by the run
+        loop) executes the WHOLE group as one
+        :func:`~tmlibrary_tpu.analytics.query.run_query_batch` sweep and
+        stashes each follower's summary; a follower pops its stashed
+        summary instead of touching the device.  Either way every job
+        gets its own lifecycle events, cache entry and tenant
+        attribution."""
         from tmlibrary_tpu.analytics import query as analytics_query
 
+        stashed = self._fusion_results.pop(job.job_id, None)
+        group = self._fusion_peers.pop(job.job_id, None) or []
         if preemption_requested():
             raise PreemptedError("preempted before query start",
                                  step="query",
@@ -1073,9 +1104,32 @@ class ServeDaemon:
         if deadline is not None and time.time() >= deadline:
             raise PreemptedError("query deadline expired before start",
                                  step="query", reason="deadline")
-        summary = analytics_query.run_query(
-            store, dict(job.payload or {}), emit=self.ledger.append,
-        )
+        if stashed is not None:
+            summary = stashed
+        elif group:
+            payloads = [dict(job.payload or {})]
+            payloads.extend(dict(j.payload or {}) for j in group)
+            summaries = analytics_query.run_query_batch(
+                store, payloads, emit=self.ledger.append,
+            )
+            summary = summaries[0]
+            for peer, s in zip(group, summaries[1:]):
+                self._fusion_results[peer.job_id] = s
+            window = len(payloads)
+            self.ledger.append(
+                event="query_fused", job=job.job_id, tenant=job.tenant,
+                window=window,
+                jobs=[j.job_id for j in group],
+                store_digest=summary.get("store_digest"),
+            )
+            self._metric("counter", "tmx_serve_query_fused_total",
+                         value=float(window))
+            self._metric("histogram", "tmx_serve_fusion_window",
+                         float(window))
+        else:
+            summary = analytics_query.run_query(
+                store, dict(job.payload or {}), emit=self.ledger.append,
+            )
         self._metric("counter", "tmx_analytics_jobs_total",
                      tenant=job.tenant,
                      tool=str(summary.get("tool", "unknown")))
@@ -1098,16 +1152,49 @@ class ServeDaemon:
                      tenant=job.tenant)
         slo.observe_job(telemetry.get_registry(), job.tenant, "failed")
 
+    def _fusion_group_for(self, job: JobSpec) -> list[JobSpec]:
+        """Follower jobs to fuse with ``job``'s sweep: queued ``query``
+        jobs on the SAME experiment root whose payloads share ``job``'s
+        fusion signature (everything but k — same store digest by
+        construction, since the digest is a pure function of the root's
+        shards).  Pulled from the admission queue up to the configured
+        window; empty when fusion is off, the job is not fusable, or
+        nobody else is waiting."""
+        from tmlibrary_tpu.config import cfg
+
+        window = int(cfg.serve_fusion_window)
+        if (not cfg.serve_query_fusion or window <= 1
+                or job.kind != "query"):
+            return []
+        from tmlibrary_tpu.analytics.query import fusion_signature
+
+        sig = fusion_signature(job.payload or {})
+        if sig is None:
+            return []
+        group = self.queue.take_matching(
+            lambda j: (j.kind == "query" and j.root == job.root
+                       and fusion_signature(j.payload or {}) == sig),
+            window - 1,
+        )
+        if group:
+            self._fusion_peers[job.job_id] = list(group)
+        return group
+
     # -------------------------------------------------------------- drain
-    def _drain_and_exit(self, current: JobSpec | None = None) -> int:
+    def _drain_and_exit(self, current: JobSpec | None = None,
+                        pending: list[JobSpec] | None = None) -> int:
         """The SIGTERM path: re-spool the interrupted job plus every
         queued job back to ``incoming/`` (attempt counts preserved — a
         preemption must never charge a tenant's retry budget), seal the
         serve ledger with ``serve_preempted``, and hand the pinned
-        resume exit code to the wrapper."""
+        resume exit code to the wrapper.  ``pending`` carries fusion
+        followers pulled from the queue but not yet executed — their
+        fused results are already in the query cache, so the re-run is
+        a cache hit."""
         requeued = []
         if current is not None:
             requeued.append(current)
+        requeued.extend(pending or [])
         requeued.extend(self.queue.drain())
         for job in requeued:
             atomic_write_json(
@@ -1202,10 +1289,19 @@ class ServeDaemon:
                     time.sleep(self.poll_s)
                     continue
                 idle_since = None
+                group = self._fusion_group_for(job)
                 outcome = self._execute(job)
                 if outcome == "preempted":
-                    return self._drain_and_exit(current=job)
+                    return self._drain_and_exit(current=job, pending=group)
                 self._jobs_run += 1
+                for i, peer in enumerate(group):
+                    outcome = self._execute(peer)
+                    if outcome == "preempted":
+                        return self._drain_and_exit(
+                            current=peer, pending=group[i + 1:])
+                    self._jobs_run += 1
+                # max-jobs is honored at group granularity: a fused
+                # window always finishes before the daemon exits
                 if self.max_jobs and self._jobs_run >= self.max_jobs:
                     logger.info("serve reached max-jobs=%d — exiting",
                                 self.max_jobs)
@@ -1294,6 +1390,12 @@ def serve_status_view(serve_root: Path) -> dict:
     affinity_hits = 0
     affinity_known = 0
     view["slo"] = None
+    view["queries"] = None
+    queries: dict = {"total": 0, "cache": {}, "index": {},
+                     "fusion_events": 0, "fusion_jobs": 0,
+                     "index_builds": 0, "index_hits": 0,
+                     "index_fallbacks": 0}
+    qtimes: list[float] = []
     events = serve_ledger_events(serve_root)
     if events:
         waits: dict[str, list[float]] = {}
@@ -1305,6 +1407,28 @@ def serve_status_view(serve_root: Path) -> dict:
             if kind == "stale_claim":
                 stale_claims += 1
                 continue
+            if kind == "query_fused":
+                queries["fusion_events"] += 1
+                queries["fusion_jobs"] += int(ev.get("window") or 0)
+                continue
+            if kind == "job_done" and ev.get("kind") == "query":
+                # the QUERY row: per-cache / per-index-mode counts plus
+                # query latency, straight from the done-event extras the
+                # daemon records for ledger replay (no registry needed)
+                queries["total"] += 1
+                c = str(ev.get("cache") or "?")
+                queries["cache"][c] = queries["cache"].get(c, 0) + 1
+                mode = str(ev.get("index") or "?")
+                queries["index"][mode] = queries["index"].get(mode, 0) + 1
+                ic = ev.get("index_cache")
+                if ic == "build":
+                    queries["index_builds"] += 1
+                elif ic == "hit":
+                    queries["index_hits"] += 1
+                if ev.get("index_fallback"):
+                    queries["index_fallbacks"] += 1
+                if ev.get("query_elapsed_s") is not None:
+                    qtimes.append(float(ev["query_elapsed_s"]))
             if kind not in ("job_admitted", "job_rejected", "job_done",
                             "job_failed", "job_expired", "job_requeued",
                             "job_reclaimed"):
@@ -1337,6 +1461,13 @@ def serve_status_view(serve_root: Path) -> dict:
             view["slo"] = slo.report(events)
         except Exception:
             logger.debug("slo report failed", exc_info=True)
+    if queries["total"] or queries["fusion_events"]:
+        queries["elapsed_s"] = {
+            "n": len(qtimes),
+            "p50": slo.quantile(qtimes, 0.50),
+            "p95": slo.quantile(qtimes, 0.95),
+        } if qtimes else None
+        view["queries"] = queries
     view["tenants"] = tenants
     view["preemptions"] = preempted
     view["fleet"] = {

@@ -1323,7 +1323,8 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
         elif kind in ("job_admitted", "job_rejected", "job_done",
                       "job_failed", "job_expired", "job_requeued",
                       "job_reclaimed", "stale_claim",
-                      "job_started", "serve_preempted", "slo_burn"):
+                      "job_started", "serve_preempted", "slo_burn",
+                      "query_fused"):
             # serve-ledger events (serve.py): per-tenant admission /
             # outcome series, mirroring the daemon's live tmx_serve_*
             # and tmx_slo_* metrics so a serve ledger alone reconstructs
@@ -1386,6 +1387,18 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                             float(ev["query_elapsed_s"]))
                     reg.counter("tmx_analytics_jobs_total",
                                 tenant=tenant, tool=tool, **hl).inc()
+                    # index lifecycle: only miss events carry these (the
+                    # one path that drove an index ensure), so replayed
+                    # build/hit/fallback counts equal the live ones
+                    if ev.get("index_cache") == "build":
+                        reg.counter(
+                            "tmx_analytics_index_builds_total").inc()
+                    elif ev.get("index_cache") == "hit":
+                        reg.counter(
+                            "tmx_analytics_index_hits_total").inc()
+                    if ev.get("index_fallback"):
+                        reg.counter(
+                            "tmx_analytics_index_fallbacks_total").inc()
             elif kind == "job_failed":
                 reg.counter("tmx_serve_jobs_failed_total",
                             tenant=tenant, **hl).inc()
@@ -1408,6 +1421,14 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                 # stopped a reclaimed job's first owner from publishing
                 reg.counter("tmx_serve_stale_claims_total",
                             tenant=tenant, **hl).inc()
+            elif kind == "query_fused":
+                # one batched sweep served `window` query jobs (serve.py
+                # _run_query fusion) — same series the daemon fed live
+                window = float(ev.get("window") or 0)
+                reg.counter("tmx_serve_query_fused_total",
+                            **hl).inc(window)
+                reg.histogram("tmx_serve_fusion_window",
+                              **hl).observe(window)
             elif kind == "serve_preempted":
                 reg.counter("tmx_serve_preemptions_total", **hl).inc()
         elif kind in ("init_done", "description_drift",

@@ -128,7 +128,46 @@ class Classification(Tool):
             x, x_train = x[:, keep], x_train[:, keep]
             feat_cols = [feat_cols[i] for i in keep]
 
-        if method == "logreg":
+        index_info: dict = {}
+        if method == "knn":
+            # kNN label spreading over the STORE graph: each object's
+            # class is the majority among the labeled objects inside
+            # its k-neighborhood.  The neighbor sweep routes through
+            # the analytics index dispatcher (``index`` / ``top_p``
+            # payload knobs, same precedence chain as the knn tool), so
+            # at store scale classification goes sublinear too.
+            from tmlibrary_tpu.analytics.index import knn_search
+
+            k_nn = int(payload.get("k", 10))
+            fs = self.feature_store(objects_name)
+            nn_idx, _, index_info = knn_search(
+                fs, x, k_nn, mode=payload.get("index"),
+                features=feat_cols, top_p=payload.get("top_p"),
+            )
+            index_info = {"k": k_nn, **index_info}
+            n = len(x)
+            seeded = np.full(n, -1, np.int64)
+            seeded[np.asarray(rows)] = y_train
+            neigh = seeded[nn_idx]  # (N, k) class per neighbor, -1 unlabeled
+            votes = np.stack(
+                [(neigh == c).sum(axis=1) for c in range(len(class_names))],
+                axis=1,
+            )
+            pred = votes.argmax(axis=1)  # ties -> lowest class index
+            # objects with no labeled neighbor in range: nearest
+            # training example directly (the training matrix is tiny)
+            bare = votes.sum(axis=1) == 0
+            if bare.any():
+                xb = x[bare]
+                d2 = (
+                    np.sum(xb * xb, axis=1, keepdims=True)
+                    - 2.0 * xb @ x_train.T
+                    + np.sum(x_train * x_train, axis=1)[None]
+                )
+                pred[bare] = y_train[np.argmin(d2, axis=1)]
+            pred = pred.astype(np.int64)
+            pred_train = pred[np.asarray(rows)]
+        elif method == "logreg":
             w, b = jax.jit(softmax_train, static_argnums=(2,))(
                 jnp.asarray(x_train), jnp.asarray(y_train), len(class_names)
             )
@@ -182,5 +221,6 @@ class Classification(Tool):
                     "training": train_counts,
                     "predicted": pred_counts,
                 },
+                **index_info,
             },
         )

@@ -6,7 +6,11 @@ selected features of one mapobject type, producing a categorical
 
 TPU rebuild: Lloyd's algorithm in JAX (one jit: distance matmul on the MXU,
 ``segment_sum`` centroid update, fixed iteration count), deterministic
-k-means++-style seeding with a fixed PRNG key.
+k-means++-style seeding with a fixed PRNG key.  This k-means is also the
+IVF index's centroid trainer (``analytics/index.py``) — one definition of
+the codebook for both consumers, which is why empty clusters get a
+deterministic reseed instead of freezing in place: a dead cell in the
+index is wasted probe budget on every query.
 """
 
 from __future__ import annotations
@@ -18,29 +22,60 @@ import numpy as np
 from tmlibrary_tpu.tools.base import Tool, ToolResult, register_tool
 
 
+def _reseed_empty(updated: jax.Array, counts: jax.Array, x: jax.Array,
+                  d_assign: jax.Array) -> jax.Array:
+    """Deterministic empty-cluster reseed: each dead centroid (zero
+    members after a Lloyd assignment) is re-seeded from the farthest
+    points — the rows with the largest distance to their assigned
+    centroid, ranked by ``lax.top_k`` (value then lowest-index, so the
+    choice is reproducible).  The i-th dead slot takes the i-th
+    farthest point; live slots keep the Lloyd update.  Pure function of
+    its inputs: unit-pinned directly in the test suite."""
+    k = updated.shape[0]
+    k_far = min(int(k), int(x.shape[0]))
+    _, far_idx = jax.lax.top_k(d_assign, k_far)
+    dead = counts <= 0
+    rank = jnp.clip(jnp.cumsum(dead.astype(jnp.int32)) - 1, 0, k_far - 1)
+    return jnp.where(dead[:, None], x[far_idx[rank]], updated)
+
+
 def kmeans(
-    x: jax.Array, k: int, n_iter: int = 50, seed: int = 0
+    x: jax.Array, k: int, n_iter: int = 50, seed: int = 0,
+    init: str = "greedy"
 ) -> tuple[jax.Array, jax.Array]:
-    """JAX k-means; returns (assignments (N,), centroids (k, F))."""
+    """JAX k-means; returns (assignments (N,), centroids (k, F)).
+
+    ``init`` picks the seeding: ``"greedy"`` (default) is the
+    k-means++-style farthest-point loop — best quality, O(n·k²) — and
+    ``"stride"`` seeds from evenly strided rows in one gather, the
+    right trade for the IVF coarse quantizer where k ≈ √N makes the
+    greedy loop quadratic in the cell count.  Both are deterministic.
+    """
     x = jnp.asarray(x, jnp.float32)
     n = x.shape[0]
     key = jax.random.PRNGKey(seed)
 
-    # k-means++ style greedy seeding (deterministic given the key).
-    # One fori_loop over a preallocated (k, F) buffer — the old Python
-    # `for _ in range(k-1)` dispatched (and, unjitted, synced) per
-    # centroid and unrolled to k programs under jit.  Unset rows are
-    # masked to +inf before the min, which is exactly "min over the
-    # first i centroids", so assignments stay bit-identical.
-    first = jax.random.randint(key, (), 0, n)
-    centroids = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
+    if init == "stride":
+        # evenly strided rows: deterministic, one gather, no O(k²) loop
+        rows = jnp.linspace(0, n - 1, k).astype(jnp.int32)
+        centroids = x[rows]
+    else:
+        # k-means++ style greedy seeding (deterministic given the key).
+        # One fori_loop over a preallocated (k, F) buffer — the old
+        # Python `for _ in range(k-1)` dispatched (and, unjitted,
+        # synced) per centroid and unrolled to k programs under jit.
+        # Unset rows are masked to +inf before the min, which is
+        # exactly "min over the first i centroids", so assignments stay
+        # bit-identical.
+        first = jax.random.randint(key, (), 0, n)
+        centroids = jnp.zeros((k, x.shape[1]), x.dtype).at[0].set(x[first])
 
-    def seed_step(i, cent):
-        d2 = jnp.sum((x[:, None, :] - cent[None]) ** 2, axis=-1)  # (n, k)
-        d2 = jnp.where(jnp.arange(k)[None, :] < i, d2, jnp.inf)
-        return cent.at[i].set(x[jnp.argmax(jnp.min(d2, axis=1))])
+        def seed_step(i, cent):
+            d2 = jnp.sum((x[:, None, :] - cent[None]) ** 2, axis=-1)  # (n, k)
+            d2 = jnp.where(jnp.arange(k)[None, :] < i, d2, jnp.inf)
+            return cent.at[i].set(x[jnp.argmax(jnp.min(d2, axis=1))])
 
-    centroids = jax.lax.fori_loop(1, k, seed_step, centroids)
+        centroids = jax.lax.fori_loop(1, k, seed_step, centroids)
 
     def step(carry, _):
         cent = carry
@@ -54,6 +89,10 @@ def kmeans(
         sums = jax.ops.segment_sum(x, assign, num_segments=k)
         counts = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), assign, num_segments=k)
         new_cent = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0), cent)
+        # dead centroids re-seed from the farthest points instead of
+        # freezing at their stale position (d2 is already in hand, so
+        # the reseed costs one top_k + gather)
+        new_cent = _reseed_empty(new_cent, counts, x, jnp.min(d2, axis=1))
         return new_cent, None
 
     centroids, _ = jax.lax.scan(step, centroids, None, length=n_iter)
@@ -68,18 +107,44 @@ def kmeans(
 @register_tool("clustering")
 class Clustering(Tool):
     """k-means over object features (JAX Lloyd's, deterministic
-    seeding).  Payload: ``objects_name``, optional ``k`` (default 3)
-    and ``features``.  Reports per-cluster sizes + inertia."""
+    seeding).  Payload: ``objects_name``, optional ``k`` (default 3),
+    ``features``, and ``index`` (``auto|ivf|brute``): on the ivf path
+    the tool reuses the persisted IVF codebook at ``n_cells=k``
+    (``analytics/index.IvfIndex``) — sampled training + one assignment
+    pass instead of full-store Lloyd's, same trainer, provenance in the
+    attributes.  Reports per-cluster sizes + inertia."""
 
     def process(self, payload: dict) -> ToolResult:
         objects_name = payload["objects_name"]
         k = int(payload.get("k", 3))
         features = payload.get("features")
         ids, x, feat_cols = self.load_feature_matrix(objects_name, features)
-        assign, centroids = jax.jit(kmeans, static_argnums=(1,))(jnp.asarray(x), k)
-        assign_np = np.asarray(assign).astype(np.int32)
+        from tmlibrary_tpu.analytics.index import (
+            IvfIndex, resolve_index_mode,
+        )
+
+        resolved, source = resolve_index_mode(
+            payload.get("index"), n_objects=len(ids)
+        )
+        index_info: dict = {"index": resolved, "index_source": source}
+        if resolved == "ivf":
+            # reuse (or build) the persisted codebook at this k: the
+            # index trains on a strided sample and assigns the full
+            # store in one pass — sublinear, deterministic, same
+            # `kmeans` trainer; NOT bit-identical to full-store Lloyd's
+            fs = self.feature_store(objects_name)
+            idx_obj = IvfIndex.ensure(fs, features, n_cells=k)
+            assign_np = idx_obj.assignments().astype(np.int32)
+            cent_np = np.asarray(idx_obj.centroids, np.float32)
+            index_info["index_digest"] = idx_obj.digest
+            index_info["index_cache"] = idx_obj.cache_state
+        else:
+            assign, centroids = jax.jit(kmeans, static_argnums=(1,))(
+                jnp.asarray(x), k
+            )
+            assign_np = np.asarray(assign).astype(np.int32)
+            cent_np = np.asarray(centroids)
         ids["value"] = assign_np
-        cent_np = np.asarray(centroids)
         # reported fit quality (same spirit as classification's training
         # metrics): per-cluster sizes + total within-cluster sum of
         # squares (sklearn's inertia_) over the standardized features
@@ -99,5 +164,6 @@ class Clustering(Tool):
                 "cluster_sizes": {str(i): int(n) for i, n in
                                   enumerate(sizes)},
                 "inertia": round(inertia, 4),
+                **index_info,
             },
         )

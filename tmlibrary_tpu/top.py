@@ -283,6 +283,33 @@ def render_dashboard(view: dict, width: int = 80) -> str:
                 f"budget {lt.get('retry_budget_remaining', '-')} "
                 f"breaker {lt.get('breaker', '-')}"
             )
+        # ---- QUERY row: analytics serving — cache mix, index routing,
+        # fusion, and query latency from the done-event extras
+        q = srv.get("queries")
+        if q:
+            cache = q.get("cache") or {}
+            cache_txt = " ".join(
+                f"{name} {cache[name]}"
+                for name in ("miss", "fused", "hit") if cache.get(name)
+            ) or "-"
+            index = q.get("index") or {}
+            index_txt = " ".join(
+                f"{name} {count}" for name, count in sorted(index.items())
+            ) or "-"
+            line = (f"  query jobs {q.get('total', 0):<4d} "
+                    f"cache [{cache_txt}]  index [{index_txt}]")
+            if q.get("fusion_events"):
+                line += (f"  fused {q['fusion_jobs']} jobs/"
+                         f"{q['fusion_events']} sweeps")
+            if q.get("index_builds") or q.get("index_hits"):
+                line += (f"  idx build {q.get('index_builds', 0)}"
+                         f"/hit {q.get('index_hits', 0)}")
+            if q.get("index_fallbacks"):
+                line += f"  ** {q['index_fallbacks']} INDEX FALLBACKS **"
+            el = q.get("elapsed_s")
+            if el and el.get("p95") is not None:
+                line += f"  p95 {el['p95']:.3f}s"
+            lines.append(line)
         if srv.get("preemptions"):
             lines.append(f"  serve preemptions: {srv['preemptions']} "
                          "(drained + re-spooled)")

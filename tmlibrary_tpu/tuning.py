@@ -195,6 +195,34 @@ def tuned_object_capacity(backend: str | None = None) -> int | None:
     return None
 
 
+_ANALYTICS_INDEX_MODES = ("ivf", "brute")
+
+
+def tuned_analytics_index(backend: str | None = None) -> str | None:
+    """The swept analytics kNN index verdict for ``backend``
+    (``"ivf"`` | ``"brute"``), or None.  ``bench.py`` BENCH_CONFIG=
+    analytics records the winner (``best_index``) when the sweep is
+    asked to persist its verdict; same provenance and backend-scoping
+    rules as :func:`tuned_reduction_strategy` — a verdict measured on
+    one backend never sets another's default, and malformed values
+    degrade to None (the auto size cutover)."""
+    tuning = load_tuning()
+    if not tuning:
+        return None
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    entry = tuning.get("analytics_index")
+    if isinstance(entry, dict):
+        value = entry.get(backend)
+    elif isinstance(entry, str) and tuning.get("backend") == backend:
+        value = entry
+    else:
+        value = None
+    return value if value in _ANALYTICS_INDEX_MODES else None
+
+
 def record_config_sweep(config: str, entry: dict) -> dict:
     """Merge one per-config sweep verdict into the tuning file.
 
@@ -238,6 +266,13 @@ def record_config_sweep(config: str, entry: dict) -> dict:
             caps = {}
         caps[backend] = capacity
         data["object_capacity"] = caps
+    index_mode = entry.get("best_index")
+    if backend and index_mode in _ANALYTICS_INDEX_MODES:
+        idx = data.get("analytics_index")
+        if not isinstance(idx, dict):
+            idx = {}
+        idx[backend] = index_mode
+        data["analytics_index"] = idx
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     atomic_write_text(
         path, json.dumps(data, indent=2, sort_keys=True) + "\n"

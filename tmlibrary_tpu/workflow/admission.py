@@ -356,6 +356,32 @@ class AdmissionQueue:
                     return job
         return None  # unreachable with positive weights; defensive
 
+    def take_matching(self, pred, limit: int) -> list[JobSpec]:
+        """Remove and return up to ``limit`` queued jobs satisfying
+        ``pred(job)``, in deterministic (tenant-name, priority) order —
+        the multi-query fusion path pulls same-store query jobs to ride
+        one batched device sweep.  DRR deficits are untouched: fused
+        followers ride the leader's turn (their work is free at the
+        device), and every follower still records its own result, so
+        per-tenant accounting stays intact."""
+        out: list[JobSpec] = []
+        if limit <= 0:
+            return out
+        for name in sorted(self._tenants):
+            st = self._tenants[name]
+            keep = []
+            for item in st.queue:
+                job = item[1]
+                if len(out) < int(limit) and pred(job):
+                    out.append(job)
+                    self._queued_ids.discard(job.job_id)
+                else:
+                    keep.append(item)
+            st.queue[:] = keep
+            if len(out) >= int(limit):
+                break
+        return out
+
     def drain(self) -> list[JobSpec]:
         """Remove and return every queued job in deterministic
         (tenant-name, priority) order — the SIGTERM re-spool path."""
