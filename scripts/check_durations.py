@@ -1,7 +1,8 @@
 #!/usr/bin/env python
-"""CI gate: no single non-slow test may exceed the tier-1 time budget.
+"""CI gate: tier-1 time budgets, per-test and whole-suite.
 
     python scripts/check_durations.py LOGFILE [--limit SECONDS]
+                                              [--budget SECONDS]
 
 Parses the ``--durations`` section pytest appends to the tier-1 log
 (lines like ``  12.34s call     tests/test_x.py::test_y``) and fails
@@ -9,6 +10,12 @@ when any ``call`` phase exceeds the limit (default 60s).  A test that
 creeps past the budget pushes the whole suite toward the gate timeout
 long before it actually times out — this catches the creep at the
 commit that introduces it.
+
+It also reads pytest's summary line (``== 123 passed in 456.78s ==``)
+and gates total suite wall time against the tier-1 budget (default
+870s, the gate's ``timeout``), warning once the suite spends 80% of it:
+individual tests can all be comfortably under the per-test limit while
+their sum quietly walks the suite into the timeout.
 """
 import argparse
 import re
@@ -18,6 +25,15 @@ DURATION_RE = re.compile(
     r"^\s*(?P<seconds>\d+(?:\.\d+)?)s\s+(?P<phase>call|setup|teardown)\s+"
     r"(?P<test>\S+)"
 )
+
+#: pytest's final summary, e.g. ``=== 10 passed, 2 skipped in 93.21s ===``
+#: (with or without the ``(0:01:33)`` suffix newer pytest adds)
+SUMMARY_RE = re.compile(
+    r"=+\s.*\bin\s+(?P<seconds>\d+(?:\.\d+)?)s(?:\s+\([0-9:]+\))?\s+=+"
+)
+
+#: fraction of the suite budget at which the gate starts warning
+WARN_FRACTION = 0.8
 
 
 def check(lines, limit: float):
@@ -47,11 +63,26 @@ def slowest(lines, n: int = 10):
     return sorted(timed, reverse=True)[:n]
 
 
+def total_wall(lines):
+    """Suite wall time from pytest's summary line; None when absent.
+    The last match wins — reruns/sections may print several."""
+    total = None
+    for line in lines:
+        m = SUMMARY_RE.search(line)
+        if m:
+            total = float(m.group("seconds"))
+    return total
+
+
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("logfile")
     parser.add_argument("--limit", type=float, default=60.0,
                         help="per-test call budget in seconds (default 60)")
+    parser.add_argument("--budget", type=float, default=870.0,
+                        help="total suite wall-time budget in seconds "
+                             "(default 870, the tier-1 gate timeout); "
+                             "0 disables the suite gate")
     args = parser.parse_args()
     with open(args.logfile, errors="replace") as fh:
         lines = fh.readlines()
@@ -65,15 +96,33 @@ def main() -> int:
         print("check_durations: top slowest tests (call phase):")
         for seconds, test in top:
             print(f"  {seconds:8.2f}s  {test}")
+    rc = 0
     if offenders:
         print(f"check_durations: {len(offenders)} test(s) over the "
               f"{args.limit:g}s budget:", file=sys.stderr)
         for seconds, test in sorted(offenders, reverse=True):
             print(f"  {seconds:8.2f}s  {test}", file=sys.stderr)
-        return 1
-    print(f"check_durations: {checked} timed calls, all within "
-          f"{args.limit:g}s")
-    return 0
+        rc = 1
+    wall = total_wall(lines)
+    if args.budget > 0:
+        if wall is None:
+            print("check_durations: no pytest summary line — suite wall "
+                  "time not checked", file=sys.stderr)
+        elif wall > args.budget:
+            print(f"check_durations: suite wall time {wall:.1f}s exceeds "
+                  f"the {args.budget:g}s budget", file=sys.stderr)
+            rc = rc or 1
+        elif wall > WARN_FRACTION * args.budget:
+            print(f"check_durations: WARNING suite wall time {wall:.1f}s "
+                  f"is {wall / args.budget:.0%} of the {args.budget:g}s "
+                  "budget — trim before it hits the gate timeout")
+        else:
+            print(f"check_durations: suite wall time {wall:.1f}s within "
+                  f"the {args.budget:g}s budget")
+    if rc == 0:
+        print(f"check_durations: {checked} timed calls, all within "
+              f"{args.limit:g}s")
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""CI canary smoke: a LIVE daemon's probes catch an injected hang.
+
+    python scripts/ci_canary_smoke.py [ARTIFACT_DIR] [--keep DIR]
+
+``tests/test_canary.py`` proves the probe lifecycle and the anomaly
+detector's replay purity inside one pytest process; this harness runs
+the real thing: a separate ``tmx serve run --canary 1`` process probes
+itself once a second while a ``TMX_FAULT_PLAN`` hang (2s sleep +
+TransientDeviceError) is armed against its 8th probe.  The probe must
+absorb the fault as a *degraded* success whose inflated end-to-end
+latency trips the EWMA/z-score detector — exactly one latched
+``anomaly`` ledger event (the latch must hold: no repeat while the
+stream recovers, no false positives on the clean probes before or
+after) — and the durable time-series must land on disk and replay
+through ``tmx timeline``.  Finally the ledger is replayed through
+``canary.anomaly_report`` and must reproduce the live daemon's anomaly
+bit for bit (the DESIGN.md §27 purity contract, crossed over a real
+process boundary).
+
+When ARTIFACT_DIR is given, the ``tsdb.*.jsonl`` segments, the serve
+ledger, and a ``tmx timeline --json`` dump are copied there for CI
+artifact upload.  Exit 0 and ``CANARY PASS`` on success; 1 otherwise.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: the probe the hang targets: past the detector's warmup
+#: (ANOMALY_MIN_SAMPLES=5) so the spike lands on a settled baseline
+FAULT_SEQ = 8
+#: how long the daemon serves (idle-exit; ~one probe per second)
+RUN_S = 14.0
+
+FAULT_PLAN = {
+    "faults": [{"site": "canary_probe", "kind": "hang",
+                "seconds": 2.0, "batch": FAULT_SEQ}],
+}
+
+
+def _env() -> dict:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "PYTHONPATH": str(REPO),
+           "TMX_FAULT_PLAN": json.dumps(FAULT_PLAN),
+           "TM_SERVE_ANOMALY_CHECK_S": "0.5",
+           "TM_TSDB_FLUSH_S": "1"}
+    return env
+
+
+def _ledger_events(path: Path) -> list:
+    events = []
+    if not path.exists():
+        return events
+    for line in path.read_text().splitlines():
+        try:
+            events.append(json.loads(line))
+        except ValueError:
+            continue
+    return events
+
+
+def _tmx(args: list, timeout=300) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "tmlibrary_tpu.cli", *args],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, timeout=timeout,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("artifacts", nargs="?", default=None,
+                        help="copy tsdb segments + timeline/ledger dumps "
+                             "here for CI artifact upload")
+    parser.add_argument("--keep", metavar="DIR", default=None,
+                        help="run inside DIR and keep everything "
+                             "(default: a temp dir, removed afterwards)")
+    args = parser.parse_args(argv)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        root = Path(args.keep) if args.keep else Path(tmp)
+        root.mkdir(parents=True, exist_ok=True)
+        sroot = root / "serve_root"
+
+        print(f"[1/3] live daemon, 1s canary period, hang armed against "
+              f"probe #{FAULT_SEQ}")
+        log_path = root / "canary_run.log"
+        with open(log_path, "w") as out:
+            proc = subprocess.run(
+                [sys.executable, "-m", "tmlibrary_tpu.cli", "serve", "run",
+                 "--root", str(sroot), "--canary", "1.0", "--poll", "0.1",
+                 "--idle-exit", str(RUN_S)],
+                env=_env(), stdout=out, stderr=subprocess.STDOUT,
+                text=True, timeout=300,
+            )
+        if proc.returncode != 0:
+            print(f"CANARY FAIL: daemon exited {proc.returncode}\n"
+                  + log_path.read_text()[-3000:])
+            return 1
+
+        events = _ledger_events(sroot / "serve" / "ledger.jsonl")
+        probes_done = [e for e in events if e.get("event") == "job_done"
+                       and e.get("kind") == "canary"]
+        degraded = [e for e in probes_done if e.get("degraded")]
+        anomalies = [e for e in events if e.get("event") == "anomaly"]
+        print(f"      {len(probes_done)} probes done, "
+              f"{len(degraded)} degraded, {len(anomalies)} anomalies")
+        if len(probes_done) <= FAULT_SEQ:
+            print(f"CANARY FAIL: only {len(probes_done)} probes completed "
+                  f"— the fault at #{FAULT_SEQ} never fired")
+            return 1
+        if len(degraded) != 1:
+            print(f"CANARY FAIL: expected exactly 1 degraded probe "
+                  f"(the hang), got {len(degraded)}")
+            return 1
+        if len(anomalies) != 1:
+            print(f"CANARY FAIL: expected exactly ONE latched anomaly, "
+                  f"got {len(anomalies)}: {anomalies}")
+            return 1
+        anom = anomalies[0]
+        if anom.get("metric") != "canary_latency" or \
+                float(anom.get("value", 0)) < 1.0:
+            print(f"CANARY FAIL: anomaly is not the latency spike: {anom}")
+            return 1
+        print(f"      anomaly: {anom['metric']} value {anom['value']}s "
+              f"z={anom['zscore']}")
+
+        print("[2/3] replay parity: anomaly_report over the drained "
+              "ledger")
+        from tmlibrary_tpu import canary
+
+        replay = canary.anomaly_report(events)
+        live = [{"metric": e.get("metric"), "host": e.get("stream_host"),
+                 "seq": e.get("seq"), "ts": e.get("sample_ts"),
+                 "value": e.get("value"), "ewma": e.get("ewma"),
+                 "zscore": e.get("zscore")} for e in anomalies]
+        if replay != live:
+            print(f"CANARY FAIL: replay diverges from the live daemon\n"
+                  f"  live:   {live}\n  replay: {replay}")
+            return 1
+        print("      replay reproduces the live anomaly bit-identically")
+
+        print("[3/3] durable time-series + tmx timeline")
+        segments = sorted((sroot / "serve").glob("tsdb.*.jsonl"))
+        if not segments:
+            print("CANARY FAIL: no tsdb segments written")
+            return 1
+        tl = _tmx(["timeline", "--root", str(sroot), "--json"])
+        if tl.returncode != 0:
+            print(f"CANARY FAIL: tmx timeline exited {tl.returncode}\n"
+                  f"{tl.stdout}")
+            return 1
+        doc = json.loads(tl.stdout)
+        names = {s["name"] for s in doc.get("series", [])}
+        if doc.get("source") != "tsdb" or not any(
+                "tmx_canary_latency_seconds" in n for n in names):
+            print(f"CANARY FAIL: timeline missing canary series "
+                  f"(source={doc.get('source')}, {len(names)} series)")
+            return 1
+        print(f"      {len(segments)} segment(s), "
+              f"{len(doc['series'])} series in timeline")
+
+        if args.artifacts:
+            art = Path(args.artifacts)
+            art.mkdir(parents=True, exist_ok=True)
+            for seg in segments:
+                shutil.copy(seg, art / seg.name)
+            shutil.copy(sroot / "serve" / "ledger.jsonl",
+                        art / "canary_serve_ledger.jsonl")
+            (art / "canary_timeline.json").write_text(tl.stdout or "")
+
+        print("CANARY PASS: injected hang -> one degraded probe, one "
+              "latched anomaly, replay parity, durable timeline")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -335,6 +335,39 @@ def slo_section() -> list[str]:
     return out
 
 
+def timeseries_section() -> list[str]:
+    from tmlibrary_tpu import canary, timeseries
+
+    out = ["## Continuous observability (`tmx timeline`, canary probes)",
+           "",
+           (inspect.getdoc(timeseries) or "").split("\n")[0],
+           "",
+           "Every registry snapshot flush also lands as timestamped "
+           "samples in an append-only per-host `tsdb.<host>.jsonl` "
+           "segment (raw ring -> 1m -> 15m rollups, retention "
+           "compaction); `tmx timeline --root DIR [--metric SUB] "
+           "[--json]` merges the per-host segments into per-series "
+           "sparklines, falling back to ledger replay for seed-era "
+           "roots.  `tmx serve run --canary SECONDS` arms per-host "
+           "self-probes whose latency feeds an EWMA/z-score anomaly "
+           "detector — a pure function of the ledger window, so replay "
+           "reproduces the live anomaly sequence bit-identically "
+           "(DESIGN.md §27).",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for mod, prefix in ((timeseries, "timeseries"), (canary, "canary")):
+        for name in sorted(n for n in dir(mod) if not n.startswith("_")):
+            obj = getattr(mod, name)
+            if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+                continue
+            if getattr(obj, "__module__", "") != mod.__name__:
+                continue
+            doc = (inspect.getdoc(obj) or "").split("\n")[0]
+            out.append(f"| `{prefix}.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def analytics_section() -> list[str]:
     import importlib
 
@@ -394,6 +427,7 @@ def main() -> None:
         *resilience_section(),
         *serve_section(),
         *slo_section(),
+        *timeseries_section(),
         *analytics_section(),
     ]
     # optional output override so a freshness check can generate into a

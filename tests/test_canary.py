@@ -1,0 +1,266 @@
+"""Canary probes + anomaly detection (DESIGN.md §27).
+
+Proves the proactive observability contracts: probes ride the real
+spool lifecycle while staying invisible to every tenant surface
+(admission queue, quotas, WDRR, SLO error budgets), their results are
+discarded, and the EWMA/z-score anomaly detector is a pure prefix-
+stable function of the ledger window — a live daemon's emitted anomaly
+sequence replays bit-identically from the drained ledger, and a clean
+run replays to zero anomalies.
+"""
+
+import json
+
+import pytest
+
+from test_serve import make_exp, spec  # noqa: F401 — registers ServeDummy
+
+from tmlibrary_tpu import canary, faults, serve, slo, telemetry
+from tmlibrary_tpu.errors import TransientDeviceError
+from tmlibrary_tpu.workflow.admission import AdmissionConfig
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    telemetry.reset_registry(enabled=True)
+    yield
+    faults.clear()
+    telemetry.reset_registry()
+
+
+def daemon(sroot, **kw):
+    kw.setdefault("install_handlers", False)
+    kw.setdefault("poll_s", 0.01)
+    kw.setdefault("canary_period_s", 0.02)
+    kw.setdefault("anomaly_check_s", 0.02)
+    return serve.ServeDaemon(sroot, **kw)
+
+
+# --------------------------------------------------------------- the probe
+def test_probe_spec_shape():
+    s = canary.make_probe_spec("/tmp/sroot", "host1", 7, now=1234.5)
+    assert s.kind == canary.CANARY_KIND
+    assert s.tenant == canary.CANARY_TENANT
+    assert s.payload == {"host": "host1", "seq": 7}
+    assert s.submitted_at == 1234.5
+    assert s.job_id.startswith("canary-host1-")
+    # the id embeds the submission time: restart-collision-proof
+    assert s.job_id != canary.make_probe_spec(
+        "/tmp/sroot", "host1", 7, now=1235.5).job_id
+
+
+def test_run_probe_deterministic_and_fault_absorbing(monkeypatch):
+    clean = canary.run_probe({"host": "h", "seq": 1})
+    assert clean["ok"] and not clean["degraded"]
+    assert clean == canary.run_probe({"host": "h", "seq": 1})
+    # a transient device blip is the thing canaries measure: absorbed
+    # as a degraded success, latency carries the signal
+    monkeypatch.setattr(
+        faults, "maybe_fire",
+        lambda site, **ctx: (_ for _ in ()).throw(TransientDeviceError("x")))
+    assert canary.run_probe({"host": "h", "seq": 1})["degraded"]
+    # anything else is a real failure and must propagate
+    monkeypatch.setattr(
+        faults, "maybe_fire",
+        lambda site, **ctx: (_ for _ in ()).throw(ValueError("boom")))
+    with pytest.raises(ValueError):
+        canary.run_probe({"host": "h", "seq": 1})
+
+
+# ------------------------------------------------------------ the detector
+def _latency_events(values, host="host0", kind="canary", start=100.0):
+    return [{"event": "job_done", "kind": kind, "host": host,
+             "ts": start + i, "elapsed_s": v, "job": f"j{i}"}
+            for i, v in enumerate(values)]
+
+
+def test_signal_samples_streams_and_canary_split():
+    events = [
+        {"event": "job_done", "kind": "canary", "host": "h1",
+         "ts": 1.0, "elapsed_s": 0.1},
+        {"event": "job_done", "ts": 2.0, "elapsed_s": 5.0},
+        {"event": "job_admitted", "ts": 3.0, "queue_wait_s": 0.5},
+        {"event": "job_admitted", "kind": "canary", "ts": 3.5,
+         "queue_wait_s": 9.0},  # canary wait never a tenant signal
+        {"event": "job_started", "ts": 4.0, "sched_delay_s": 0.2},
+        {"event": "job_reclaimed", "ts": 5.0, "host": "h2"},
+        {"event": "job_reclaimed", "ts": 9.0, "host": "h2"},
+        {"event": "slo_burn", "ts": 10.0, "burn": 2.5},
+    ]
+    metrics = [(m, v) for m, _, _, v in canary.signal_samples(events)]
+    assert metrics == [
+        ("canary_latency", 0.1), ("job_seconds", 5.0),
+        ("queue_wait", 0.5), ("straggler_skew", 0.2),
+        ("reclaim_gap", 4.0), ("slo_burn", 2.5),
+    ]
+
+
+def test_anomaly_spike_latches_once_then_rearms():
+    base = [1.0, 1.01, 0.99, 1.0, 1.02, 1.0]
+    spike = [50.0, 50.0, 50.0]  # sustained excursion: ONE anomaly
+    recover = [1.0, 1.0]
+    spike2 = [80.0]
+    report = canary.anomaly_report(
+        _latency_events(base + spike + recover + spike2))
+    assert [r["seq"] for r in report] == [0, 1]
+    assert all(r["metric"] == "canary_latency" for r in report)
+    assert report[0]["value"] == 50.0 and report[1]["value"] == 80.0
+    # anomalous samples never fed the EWMA: baseline stays ~1
+    assert report[1]["ewma"] < 2.0
+
+
+def test_anomaly_clean_run_is_silent():
+    assert canary.anomaly_report(
+        _latency_events([1.0, 1.05, 0.95, 1.0, 1.1, 0.9, 1.0, 1.02])) == []
+
+
+def test_anomaly_warmup_swallows_early_spikes():
+    # fewer than ANOMALY_MIN_SAMPLES: never flags, however wild
+    assert canary.anomaly_report(_latency_events([1.0, 99.0, 1.0])) == []
+
+
+def test_anomaly_prefix_stability():
+    values = [1.0] * 6 + [40.0] + [1.0] * 4 + [60.0] + [1.0] * 3
+    events = _latency_events(values)
+    full = canary.anomaly_report(events)
+    assert len(full) == 2
+    for k in range(len(events) + 1):
+        prefix = canary.anomaly_report(events[:k])
+        assert prefix == full[:len(prefix)]
+
+
+def test_anomaly_ignores_its_own_events():
+    events = _latency_events([1.0] * 6 + [40.0])
+    report = canary.anomaly_report(events)
+    echoed = events + [{"event": "anomaly", "ts": 999.0, **report[0]}]
+    assert canary.anomaly_report(echoed) == report
+
+
+# ------------------------------------------------- daemon + invisibility
+def test_daemon_canary_lifecycle_and_tenant_invisibility(tmp_path):
+    """Probes ride spool->claim->done, results are discarded, and every
+    tenant-facing surface is untouched: admission snapshot, quota
+    accounting, SLO tenants, serve-status tenant table."""
+    exp = make_exp(tmp_path, "exp")
+    sroot = tmp_path / "sroot"
+    serve.enqueue_job(sroot, spec("t-1", exp.root))
+    d = daemon(sroot, idle_exit_s=0.6,
+               admission=AdmissionConfig(max_queue=4, tenant_quota=2))
+    assert d.run() == 0
+
+    events = serve.serve_ledger_events(sroot)
+    probes = [e for e in events if e.get("kind") == "canary"]
+    done = [e for e in probes if e.get("event") == "job_done"]
+    assert done, "no canary probe completed"
+    # full lifecycle per probe: admitted -> started -> done
+    assert {e["event"] for e in probes} == {"job_admitted", "job_started",
+                                            "job_done"}
+    # results discarded: no canary file left in any spool state
+    for state in serve.SPOOL_STATES:
+        leftover = [p.name for p in
+                    serve.spool_dir(sroot, state).glob("canary-*.json")]
+        assert leftover == [], (state, leftover)
+    # the real tenant job ran normally
+    assert (serve.spool_dir(sroot, "done") / "t-1.json").exists()
+
+    # tenant invisibility, surface by surface
+    snap = d.queue.snapshot()
+    assert canary.CANARY_TENANT not in snap.get("tenants", {})
+    view = serve.serve_status_view(sroot)
+    assert canary.CANARY_TENANT not in view["tenants"]
+    assert sorted(view["slo"]["tenants"]) == ["a"]
+    assert view["canary"]["ok"] == len(done)
+    srep = slo.report(events)
+    assert sorted(srep["tenants"]) == ["a"]
+    assert srep["canary"]["hosts"]["host0"]["availability"] == 1.0
+
+    # replay: canary events feed ONLY tmx_canary_* series
+    reg = telemetry.registry_from_ledger(events)
+    rsnap = reg.snapshot()
+    counters = {(c["name"], tuple(sorted(c["labels"].items()))): c["value"]
+                for c in rsnap["counters"]}
+    assert counters[("tmx_canary_probes_total", ())] == len(done)
+    # the pseudo-tenant never appears as a label on any tenant series
+    assert not any(("tenant", canary.CANARY_TENANT) in labels
+                   for _, labels in counters)
+
+
+def test_daemon_anomaly_live_vs_replay_parity(tmp_path):
+    """The acceptance pin: a fault-injected degraded run's live anomaly
+    events replay bit-identically from the drained ledger, and a clean
+    run replays to zero anomalies."""
+    faults.install(faults.FaultPlan([faults.FaultSpec(
+        site="canary_probe", kind="hang", seconds=0.4, batch=8)]))
+    sroot = tmp_path / "sroot"
+    assert daemon(sroot, idle_exit_s=1.2).run() == 0
+
+    events = serve.serve_ledger_events(sroot)
+    live = [e for e in events if e.get("event") == "anomaly"]
+    assert len(live) == 1, live
+    assert live[0]["metric"] == "canary_latency"
+    degraded = [e for e in events
+                if e.get("event") == "job_done" and e.get("degraded")]
+    assert len(degraded) == 1
+
+    replay = canary.anomaly_report(events)
+    live_norm = [{"metric": e["metric"], "host": e["stream_host"],
+                  "seq": e["seq"], "ts": e["sample_ts"],
+                  "value": e["value"], "ewma": e["ewma"],
+                  "zscore": e["zscore"]} for e in live]
+    assert live_norm == replay  # bit-identical
+
+    # replay derivation carries the anomaly counter
+    reg = telemetry.registry_from_ledger(events)
+    names = {c["name"] for c in reg.snapshot()["counters"]}
+    assert "tmx_anomalies_total" in names
+
+    # clean control: no faults -> zero anomalies, live and replayed
+    sroot2 = tmp_path / "sroot2"
+    faults.clear()
+    assert daemon(sroot2, idle_exit_s=0.8).run() == 0
+    clean = serve.serve_ledger_events(sroot2)
+    assert not [e for e in clean if e.get("event") == "anomaly"]
+    assert canary.anomaly_report(clean) == []
+
+
+def test_canary_off_by_default(tmp_path):
+    sroot = tmp_path / "sroot"
+    assert daemon(sroot, canary_period_s=0.0, idle_exit_s=0.1).run() == 0
+    events = serve.serve_ledger_events(sroot)
+    assert not [e for e in events if e.get("kind") == "canary"]
+
+
+def test_stale_foreign_probe_swept(tmp_path):
+    """A dead daemon's probe is debris: a foreign host never executes it
+    (self-addressed), and sweeps it to rejected/ once stale."""
+    sroot = tmp_path / "sroot"
+    fresh = canary.make_probe_spec(sroot, "deadhost", 1)
+    stale = canary.make_probe_spec(sroot, "deadhost", 2,
+                                   now=1000.0)  # long past CANARY_STALE_S
+    serve.enqueue_job(sroot, fresh)
+    serve.enqueue_job(sroot, stale)
+    d = daemon(sroot, canary_period_s=0.0)
+    d._scan_incoming()
+    assert d._canary_ready == []
+    incoming = {p.stem for p in
+                serve.spool_dir(sroot, "incoming").glob("*.json")}
+    rejected = {p.stem for p in
+                serve.spool_dir(sroot, "rejected").glob("*.json")}
+    assert fresh.job_id in incoming  # not ours, not stale: left alone
+    assert stale.job_id in rejected  # swept
+
+
+def test_top_dashboard_canary_and_anomaly_rows(tmp_path):
+    faults.install(faults.FaultPlan([faults.FaultSpec(
+        site="canary_probe", kind="hang", seconds=0.4, batch=8)]))
+    sroot = tmp_path / "sroot"
+    assert daemon(sroot, idle_exit_s=1.2).run() == 0
+    faults.clear()
+
+    from tmlibrary_tpu import top
+
+    view = top.collect_fleet(sroot)
+    frame = top.render_dashboard(view)
+    assert "canary probes" in frame
+    assert "ANOMALY x1" in frame and "canary_latency:1" in frame

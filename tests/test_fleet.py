@@ -287,14 +287,19 @@ def test_registry_from_ledger_two_host_attribution():
 
 
 def test_registry_from_ledger_order_independent_and_dedups():
+    def series(events):
+        # capture stamps are wall-clock by design; the derived SERIES
+        # must be identical, so compare modulo captured_at/sequence
+        snap = telemetry.registry_from_ledger(events).snapshot()
+        return {k: snap[k] for k in ("counters", "gauges", "histograms")}
+
     events = _two_host_events()
-    base = telemetry.registry_from_ledger(events).snapshot()
+    base = series(events)
     # interleaving order must not matter (hosts' appends race on a pod)
-    shuffled = list(reversed(events))
-    assert telemetry.registry_from_ledger(shuffled).snapshot() == base
+    assert series(list(reversed(events))) == base
     # exact duplicates (one physical event copied into both per-host
     # ledgers, then both ledgers concatenated) are dropped
-    assert telemetry.registry_from_ledger(events + events).snapshot() == base
+    assert series(events + events) == base
 
 
 def test_registry_from_ledger_seed_era_unchanged():
@@ -493,3 +498,79 @@ def test_ledger_host_field_optional(tmp_path):
     # an explicit host on the event wins (replayed foreign events)
     fleet.append(event="batch_done", host="host0")
     assert fleet.events()[1]["host"] == "host0"
+
+
+def test_merge_snapshots_gauge_collision_prefers_newer_capture():
+    """Gauge collisions resolve by (captured_at, sequence) recency, not
+    by the order the snapshot files happened to be globbed in."""
+    def stamped(value, captured_at, sequence):
+        reg = telemetry.MetricsRegistry(enabled=True)
+        reg.gauge("tmx_jterator_sites_per_sec").set(value)
+        snap = reg.snapshot()
+        snap["captured_at"] = captured_at
+        snap["sequence"] = sequence
+        return snap
+
+    old = stamped(10.0, 100.0, 1)
+    new = stamped(99.0, 200.0, 1)
+    for order in ([("host0", old), ("host0", new)],
+                  [("host0", new), ("host0", old)]):
+        merged = telemetry.merge_snapshots(order)
+        (g,) = [g for g in merged["gauges"]
+                if g["name"] == "tmx_jterator_sites_per_sec"]
+        assert g["value"] == 99.0, order
+    # same clock tick: the sequence counter breaks the tie
+    s1 = stamped(1.0, 100.0, 1)
+    s2 = stamped(2.0, 100.0, 2)
+    for order in ([("h", s1), ("h", s2)], [("h", s2), ("h", s1)]):
+        merged = telemetry.merge_snapshots(order)
+        (g,) = merged["gauges"]
+        assert g["value"] == 2.0, order
+    # pre-stamp-era snapshots: fall back to last-write-wins
+    for snap in (old, new):
+        snap.pop("captured_at"), snap.pop("sequence")
+    merged = telemetry.merge_snapshots([("host0", new), ("host0", old)])
+    (g,) = merged["gauges"]
+    assert g["value"] == 10.0
+
+
+# ------------------------------ top --json on thin / seed-era roots
+def test_top_json_zero_completed_jobs(tmp_path, capsys):
+    """A freshly-started run (heartbeats, no batch ever finished) must
+    render a dashboard, not divide by zero."""
+    from tmlibrary_tpu.cli import main
+
+    root = tmp_path / "run"
+    wf = root / "workflow"
+    wf.mkdir(parents=True)
+    telemetry.write_heartbeat(wf / "heartbeat.json", period=2.0)
+    with (wf / "ledger.jsonl").open("w") as fh:
+        fh.write(json.dumps({"event": "run_started", "ts": 1.0}) + "\n")
+        fh.write(json.dumps({"event": "init_done", "step": "jterator",
+                             "batches": 4, "ts": 2.0}) + "\n")
+    assert main(["top", "--root", str(root), "--once", "--json"]) == 0
+    view = json.loads(capsys.readouterr().out)
+    assert view["hosts"] and not view["hosts"][0]["stale"]
+    # text mode on the same root also renders cleanly
+    assert main(["top", "--root", str(root), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "tmx top" in out
+
+
+def test_top_json_heartbeat_only_host(tmp_path, capsys):
+    """A host that has only ever heartbeated (no metrics snapshot, no
+    ledger events) still shows up in the fleet table."""
+    from tmlibrary_tpu.cli import main
+
+    root = tmp_path / "run"
+    wf = root / "workflow"
+    wf.mkdir(parents=True)
+    (wf / "heartbeat.host1.json").write_text(json.dumps(
+        {"ts": time.time(), "pid": 2, "period": 2.0, "host": "host1"}
+    ))
+    assert main(["top", "--root", str(root), "--once", "--json"]) == 0
+    view = json.loads(capsys.readouterr().out)
+    hosts = {h["host"] for h in view["hosts"]}
+    assert hosts == {"host1"}
+    assert main(["top", "--root", str(root), "--once"]) == 0
+    assert "host1" in capsys.readouterr().out

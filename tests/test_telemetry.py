@@ -869,3 +869,44 @@ def test_registry_from_ledger_queue_wait_sched_delay_and_burn():
     lat = reg.histogram("tmx_slo_job_latency_seconds", tenant="a",
                         host="h0")
     assert lat.count == 1 and lat.sum == pytest.approx(1.5)
+
+
+def test_prometheus_escaping_full_spec_round_trip():
+    """Label values exercising every escape the text format defines —
+    backslash, double quote, newline — plus commas and equals signs
+    inside quoted values, across multiple labels on one series
+    (the naive comma-split parser choked on all of these)."""
+    reg = telemetry.MetricsRegistry(enabled=True)
+    nasty = 'a"b\\c\nd,e=f'
+    reg.counter("tmx_esc_total", path=nasty, other="x,y=z").inc(2)
+    text = telemetry.render_prometheus(reg.snapshot())
+    assert '\\n' in text and '\\"' in text and "\\\\" in text
+    samples = telemetry.parse_prometheus(text)
+    (sample,) = [s for s in samples if s[0] == "tmx_esc_total"]
+    assert sample[1] == {"path": nasty, "other": "x,y=z"}
+    assert sample[2] == 2.0
+    # and a second render/parse trip is stable
+    again = telemetry.render_prometheus(reg.snapshot())
+    assert telemetry.parse_prometheus(again)
+
+
+def test_parse_prometheus_rejects_broken_labels():
+    for bad in ('m{a="unterminated} 1\n',
+                'm{a=unquoted} 1\n',
+                'm{="noname"} 1\n',
+                'm{a="x"junk} 1\n'):
+        with pytest.raises(ValueError):
+            telemetry.parse_prometheus(bad)
+
+
+def test_snapshot_stamps_captured_at_and_sequence():
+    reg = telemetry.MetricsRegistry(enabled=True)
+    reg.counter("c").inc()
+    s1 = reg.snapshot()
+    s2 = reg.snapshot()
+    assert s1["captured_at"] <= s2["captured_at"]
+    # sequence is monotonic per registry, independent of the clock
+    assert (s1["sequence"], s2["sequence"]) == (1, 2)
+    # and render_json round-trips the stamps
+    doc = json.loads(telemetry.render_json(s2))
+    assert doc["sequence"] == 2 and "captured_at" in doc

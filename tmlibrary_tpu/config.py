@@ -254,6 +254,38 @@ class LibraryConfig:
     serve_lease_s: float = dataclasses.field(
         default_factory=lambda: float(_setting("serve_lease_s", "15"))
     )
+    # -------------------------------------------------- observability
+    # (timeseries.py / canary.py; DESIGN.md §27)
+    #: canary probe period, seconds; 0 disables probes (the default —
+    #: probes are an always-on-service feature, opt-in per daemon)
+    serve_canary_period_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("serve_canary_period_s",
+                                               "0"))
+    )
+    #: how often the daemon re-runs the anomaly detector over the merged
+    #: fleet ledger (the detector itself is pure; this only throttles
+    #: the ledger re-read)
+    serve_anomaly_check_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("serve_anomaly_check_s",
+                                               "5"))
+    )
+    #: minimum seconds between time-series flushes of a live registry
+    #: snapshot into the tsdb segment
+    tsdb_flush_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("tsdb_flush_s", "10"))
+    )
+    #: raw samples older than this are dropped at compaction (rollups
+    #: summarize them first — see timeseries.compact_records)
+    tsdb_retention_s: float = dataclasses.field(
+        default_factory=lambda: float(_setting("tsdb_retention_s",
+                                               "86400"))
+    )
+    #: segment size that triggers a compaction pass (an O(1) stat per
+    #: flush, so the hot path never pays for downsampling)
+    tsdb_segment_bytes: int = dataclasses.field(
+        default_factory=lambda: int(_setting("tsdb_segment_bytes",
+                                             "1048576"))
+    )
     # ---------------------------------------------------------- SLO
     # (slo.py; env: TM_SLO_* here, with TMX_SLO_* runtime overrides —
     # including per-tenant TMX_SLO_<KNOB>_<TENANT> — taking precedence)

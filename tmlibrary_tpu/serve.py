@@ -96,7 +96,7 @@ import time
 from contextlib import nullcontext
 from pathlib import Path
 
-from tmlibrary_tpu import faults, slo, telemetry
+from tmlibrary_tpu import canary, faults, slo, telemetry, timeseries
 from tmlibrary_tpu.atomicio import atomic_write_json, claim_rename
 from tmlibrary_tpu.errors import FaultInjected, PreemptedError
 from tmlibrary_tpu.resilience import (
@@ -288,7 +288,9 @@ class ServeDaemon:
                  poll_s: float | None = None,
                  max_jobs: int = 0, idle_exit_s: float = 0.0,
                  install_handlers: bool = True,
-                 host: str | None = None, lease_s: float | None = None):
+                 host: str | None = None, lease_s: float | None = None,
+                 canary_period_s: float | None = None,
+                 anomaly_check_s: float | None = None):
         from tmlibrary_tpu.config import cfg
         from tmlibrary_tpu.workflow.engine import RunLedger
 
@@ -356,6 +358,30 @@ class ServeDaemon:
         self._slo_latched: set[tuple[str, str]] = set()
         self._shed_latch = False
         self._last_slo_check = 0.0
+        #: synthetic canary probes (canary.py): self-addressed
+        #: ``kind="canary"`` jobs enqueued every ``canary_period_s``
+        #: seconds (0 = off), riding the normal spool lifecycle but
+        #: bypassing the admission queue — invisible to tenant quota,
+        #: WDRR, retry budgets and the per-tenant SLO
+        self.canary_period_s = float(
+            cfg.serve_canary_period_s if canary_period_s is None
+            else canary_period_s)
+        self.anomaly_check_s = float(
+            cfg.serve_anomaly_check_s if anomaly_check_s is None
+            else anomaly_check_s)
+        self._canary_seq = 0
+        self._canary_inflight: str | None = None
+        self._canary_started = 0.0
+        self._last_canary = 0.0
+        self._canary_ready: list[JobSpec] = []
+        #: anomaly fingerprints already written to the ledger this
+        #: process — the latch mirroring ``_slo_latched``: the detector
+        #: (a pure function of the event window) returns the full
+        #: historical sequence, the daemon appends only the new tail
+        self._anomaly_emitted: set[tuple] = set()
+        self._last_anomaly_check = 0.0
+        self._tsdb_flush_s = float(cfg.tsdb_flush_s)
+        self._last_tsdb_flush = 0.0
 
     # ------------------------------------------------------------ helpers
     def _arm(self, phase: str):
@@ -540,6 +566,95 @@ class ServeDaemon:
             self._slo_latched &= burning
         except Exception:
             logger.debug("slo evaluation failed", exc_info=True)
+
+    def _check_anomalies(self) -> None:
+        """Periodic warn-only anomaly evaluation (throttled): run the
+        pure EWMA/z-score detector (:func:`canary.anomaly_report`) over
+        the merged serve ledger and append the anomalies it found that
+        this daemon has not yet written — latched, one event per
+        excursion.  Because the detector is a pure function of the event
+        window, replaying the final ledger reproduces this exact event
+        sequence (the pinned parity contract).  Each host reports only
+        its own streams, so a fleet emits every anomaly exactly once."""
+        now = time.monotonic()
+        if now - self._last_anomaly_check < self.anomaly_check_s:
+            return
+        self._last_anomaly_check = now
+        try:
+            events = [ev for ev in serve_ledger_events(self.serve_root)
+                      if ev.get("event") != "anomaly"]
+            for rec in canary.anomaly_report(events):
+                if rec["host"] != self.host_name:
+                    continue
+                fp = (rec["metric"], rec["host"], rec["seq"])
+                if fp in self._anomaly_emitted:
+                    continue
+                self._anomaly_emitted.add(fp)
+                self.ledger.append(
+                    event="anomaly", metric=rec["metric"],
+                    stream_host=rec["host"], seq=rec["seq"],
+                    sample_ts=rec["ts"], value=rec["value"],
+                    ewma=rec["ewma"], zscore=rec["zscore"],
+                )
+                self._metric("counter", "tmx_anomalies_total",
+                             metric=rec["metric"])
+                logger.warning(
+                    "anomaly on %s (host %s): value %s vs ewma %s, "
+                    "z=%s (warn-only — inspect with `tmx timeline`)",
+                    rec["metric"], rec["host"], rec["value"],
+                    rec["ewma"], rec["zscore"],
+                )
+        except Exception:
+            logger.debug("anomaly evaluation failed", exc_info=True)
+
+    def _maybe_canary(self) -> None:
+        """Enqueue the next self-addressed canary probe when the period
+        has elapsed and the previous probe has finished (a wedged
+        pipeline must not pile probes onto itself — one slow probe IS
+        the signal).  A probe lost to a crash re-arms after a grace
+        window."""
+        if self.canary_period_s <= 0:
+            return
+        now = time.monotonic()
+        if self._last_canary and now - self._last_canary < self.canary_period_s:
+            return
+        if self._canary_inflight is not None:
+            grace = max(5 * self.canary_period_s, 30.0)
+            if now - self._canary_started < grace:
+                return
+            self._canary_inflight = None  # lost probe — re-arm
+        self._canary_seq += 1
+        spec = canary.make_probe_spec(self.serve_root, self.host_name,
+                                      self._canary_seq)
+        try:
+            enqueue_job(self.serve_root, spec)
+        except FaultInjected as exc:
+            if exc.fatal:
+                raise
+            logger.warning("canary enqueue fault: %s", exc)
+            return
+        except Exception as exc:
+            logger.warning("canary enqueue failed: %s", exc)
+            return
+        self._canary_inflight = spec.job_id
+        self._canary_started = now
+        self._last_canary = now
+
+    def _flush_timeseries(self, force: bool = False) -> None:
+        """Land the live registry in this host's tsdb segment
+        (timeseries.py) — throttled; one ``enabled()`` check when
+        telemetry is off."""
+        if not telemetry.enabled():
+            return
+        now = time.monotonic()
+        if not force and now - self._last_tsdb_flush < self._tsdb_flush_s:
+            return
+        self._last_tsdb_flush = now
+        try:
+            timeseries.flush_registry(serve_dir(self.serve_root),
+                                      host=self.host or "host0")
+        except Exception:
+            logger.debug("tsdb flush failed", exc_info=True)
 
     def _write_metrics(self) -> None:
         if not telemetry.enabled():
@@ -841,6 +956,39 @@ class ServeDaemon:
             with telemetry.trace_scope(trace_id=spec.trace_id,
                                        job=spec.job_id,
                                        tenant=spec.tenant):
+                if spec.kind == canary.CANARY_KIND:
+                    # self-addressed probe: only the issuing host may
+                    # claim it (the latency measures THAT host's
+                    # pipeline), and it never touches the admission
+                    # queue — no quota, no WDRR deficit, no retry
+                    # budget, no breaker (tenant invisibility, pinned)
+                    owner = (spec.payload or {}).get("host")
+                    if owner and owner != self.host_name:
+                        if (spec.submitted_at and time.time()
+                                - float(spec.submitted_at)
+                                > canary.CANARY_STALE_S):
+                            # a dead daemon's probe: one winner sweeps
+                            # the debris, nobody executes it
+                            claim_rename(
+                                path,
+                                spool_dir(self.serve_root, "rejected")
+                                / path.name)
+                        continue
+                    if not self._try_claim(path, spec):
+                        continue
+                    now = time.time()
+                    wait = (max(0.0, now - float(spec.submitted_at))
+                            if spec.submitted_at else None)
+                    extra = ({"queue_wait_s": round(wait, 3)}
+                             if wait is not None else {})
+                    self.ledger.append(
+                        event="job_admitted", job=spec.job_id,
+                        tenant=spec.tenant, kind=canary.CANARY_KIND,
+                        attempt=spec.attempt, epoch=spec.claim_epoch,
+                        **extra)
+                    self._metric("counter", "tmx_canary_probes_total")
+                    self._canary_ready.append(spec)
+                    continue
                 if self._claimed_elsewhere(spec.job_id):
                     decision = reject(REASON_DUPLICATE)
                     dst = spool_dir(self.serve_root, "rejected") / path.name
@@ -939,7 +1087,93 @@ class ServeDaemon:
         ledgers, covers enqueue → result."""
         with telemetry.trace_scope(trace_id=job.trace_id, job=job.job_id,
                                    tenant=job.tenant):
+            if job.kind == canary.CANARY_KIND:
+                return self._execute_canary(job)
             return self._execute_traced(job)
+
+    def _discard_canary(self, job: JobSpec) -> None:
+        """Canary results are discarded: delete the admitted spec
+        instead of archiving it (probes at a 1 s period would otherwise
+        grow ``done/`` without bound), release the lease, and let the
+        scheduler arm the next probe."""
+        try:
+            (spool_dir(self.serve_root, "admitted")
+             / f"{job.job_id}.json").unlink(missing_ok=True)
+        except OSError:
+            pass
+        self._release_claim(job.job_id)
+        if self._canary_inflight == job.job_id:
+            self._canary_inflight = None
+
+    def _sweep_own_canaries(self) -> None:
+        """Shutdown tidy-up: a probe enqueued on the final loop iteration
+        can still sit unclaimed in ``incoming/`` — synthetic work
+        addressed to a process that is about to not exist.  Discard it,
+        plus any probe claimed but never executed, so restarts and
+        foreign stale-sweeps never meet our debris."""
+        try:
+            for path in spool_dir(self.serve_root, "incoming").glob(
+                    f"canary-{self.host_name}-*.json"):
+                path.unlink(missing_ok=True)
+        except OSError:
+            pass
+        while self._canary_ready:
+            try:
+                self._discard_canary(self._canary_ready.pop(0))
+            except Exception:
+                logger.debug("canary discard on shutdown failed",
+                             exc_info=True)
+        self._canary_inflight = None
+
+    def _execute_canary(self, job: JobSpec) -> str:
+        """Run one canary probe to an outcome, on a lifecycle parallel
+        to :meth:`_execute_traced` but feeding only the ``tmx_canary_*``
+        series: no ``queue.record_result`` (breakers/retry budgets are
+        tenant machinery), no ``slo.observe_job`` (per-tenant SLO must
+        not see probes — per-host availability flows through
+        :func:`slo.canary_report` instead)."""
+        self.ledger.append(event="job_started", job=job.job_id,
+                           tenant=job.tenant, kind=canary.CANARY_KIND,
+                           attempt=job.attempt)
+        t0 = time.monotonic()
+        try:
+            with telemetry.span(
+                "job",
+                emit=functools.partial(self.ledger.append,
+                                       attempt=job.attempt),
+            ):
+                summary = canary.run_probe(job.payload or {})
+        except FaultInjected as exc:
+            if exc.fatal:
+                raise
+            return self._canary_failed(job, exc)
+        except Exception as exc:
+            return self._canary_failed(job, exc)
+        elapsed = time.monotonic() - t0
+        if not self._fence(job, "done"):
+            return "stale"
+        extra = {"degraded": True} if summary.get("degraded") else {}
+        self.ledger.append(event="job_done", job=job.job_id,
+                           tenant=job.tenant, kind=canary.CANARY_KIND,
+                           elapsed_s=round(elapsed, 3),
+                           epoch=job.claim_epoch, **extra)
+        self._metric("counter", "tmx_canary_ok_total")
+        self._metric("histogram", "tmx_canary_latency_seconds", elapsed)
+        if extra:
+            self._metric("counter", "tmx_canary_degraded_total")
+        self._discard_canary(job)
+        return "done"
+
+    def _canary_failed(self, job: JobSpec, exc: Exception) -> str:
+        if not self._fence(job, "failed"):
+            return "stale"
+        logger.warning("canary probe %s failed: %s", job.job_id, exc)
+        self.ledger.append(event="job_failed", job=job.job_id,
+                           tenant=job.tenant, kind=canary.CANARY_KIND,
+                           error=f"{type(exc).__name__}: {exc}")
+        self._metric("counter", "tmx_canary_failed_total")
+        self._discard_canary(job)
+        return "failed"
 
     def _execute_traced(self, job: JobSpec) -> str:
         from tmlibrary_tpu.models.store import ExperimentStore
@@ -1274,8 +1508,22 @@ class ServeDaemon:
                         )
                 self._publish_state()
                 self._check_slo()
+                self._check_anomalies()
+                self._flush_timeseries()
+                try:
+                    self._maybe_canary()
+                except Exception as exc:
+                    logger.warning("canary scheduling error: %s", exc)
                 if preemption_requested():
                     return self._drain_and_exit()
+                while self._canary_ready:
+                    # probes run ahead of tenant work (they must not
+                    # queue behind it or they'd measure the backlog
+                    # twice) and never count toward max-jobs
+                    probe = self._canary_ready.pop(0)
+                    if self._execute(probe) == "preempted":
+                        self._discard_canary(probe)
+                        return self._drain_and_exit()
                 job = self.queue.take()
                 if job is None:
                     if self.idle_exit_s > 0:
@@ -1322,7 +1570,15 @@ class ServeDaemon:
                     reason=f"crash:{type(exc).__name__}",
                 )
             try:
+                self._sweep_own_canaries()
+            except Exception:
+                pass
+            try:
                 self._publish_state()
+            except Exception:
+                pass
+            try:
+                self._flush_timeseries(force=True)
             except Exception:
                 pass
             self._write_metrics()
@@ -1391,6 +1647,11 @@ def serve_status_view(serve_root: Path) -> dict:
     affinity_known = 0
     view["slo"] = None
     view["queries"] = None
+    view["canary"] = None
+    view["anomalies"] = None
+    canary_stats = {"probes": 0, "ok": 0, "failed": 0, "degraded": 0}
+    canary_lat: list[float] = []
+    anomalies: dict[str, int] = {}
     queries: dict = {"total": 0, "cache": {}, "index": {},
                      "fusion_events": 0, "fusion_jobs": 0,
                      "index_builds": 0, "index_hits": 0,
@@ -1429,6 +1690,24 @@ def serve_status_view(serve_root: Path) -> dict:
                     queries["index_fallbacks"] += 1
                 if ev.get("query_elapsed_s") is not None:
                     qtimes.append(float(ev["query_elapsed_s"]))
+            if kind == "anomaly":
+                m = str(ev.get("metric") or "?")
+                anomalies[m] = anomalies.get(m, 0) + 1
+                continue
+            if ev.get("kind") == "canary":
+                # probes are tenant-invisible: their own CANARY panel,
+                # never the tenant tables or queue-wait stats
+                if kind == "job_admitted":
+                    canary_stats["probes"] += 1
+                elif kind == "job_done":
+                    canary_stats["ok"] += 1
+                    if ev.get("degraded"):
+                        canary_stats["degraded"] += 1
+                    if ev.get("elapsed_s") is not None:
+                        canary_lat.append(float(ev["elapsed_s"]))
+                elif kind == "job_failed":
+                    canary_stats["failed"] += 1
+                continue
             if kind not in ("job_admitted", "job_rejected", "job_done",
                             "job_failed", "job_expired", "job_requeued",
                             "job_reclaimed"):
@@ -1461,6 +1740,15 @@ def serve_status_view(serve_root: Path) -> dict:
             view["slo"] = slo.report(events)
         except Exception:
             logger.debug("slo report failed", exc_info=True)
+        if any(canary_stats.values()):
+            canary_stats["latency_s"] = {
+                "n": len(canary_lat),
+                "p50": slo.quantile(canary_lat, 0.50),
+                "p95": slo.quantile(canary_lat, 0.95),
+            } if canary_lat else None
+            view["canary"] = canary_stats
+        if anomalies:
+            view["anomalies"] = anomalies
     if queries["total"] or queries["fusion_events"]:
         queries["elapsed_s"] = {
             "n": len(qtimes),

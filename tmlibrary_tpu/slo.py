@@ -151,6 +151,11 @@ def job_completions(events: Iterable[dict]) -> list[dict]:
         outcome = _OUTCOMES.get(kind)
         if outcome is None:
             continue
+        if ev.get("kind") == "canary":
+            # canary probes are tenant-invisible (DESIGN.md §27): their
+            # availability is per-host, via canary_report(), never a
+            # tenant's error budget
+            continue
         host = str(ev.get("host", "")) if ev.get("host") else ""
         if host:
             fp = (host, ev.get("ts"), kind, ev.get("job"))
@@ -166,6 +171,46 @@ def job_completions(events: Iterable[dict]) -> list[dict]:
         }
         out.append(rec)
     return out
+
+
+def canary_report(events: Iterable[dict]) -> dict:
+    """Per-host black-box availability from canary probe completions.
+
+    The tenant-facing SLO machinery never sees canary events (they are
+    filtered in :func:`job_completions`); this is the other half of the
+    split — probes measure *hosts*, tenants measure *workloads*.  Pure
+    and order-independent like everything else in this module."""
+    seen: set[tuple] = set()
+    hosts: dict[str, dict] = {}
+    lat: dict[str, list[float]] = {}
+    for ev in events:
+        kind = ev.get("event")
+        outcome = _OUTCOMES.get(kind)
+        if outcome is None or ev.get("kind") != "canary":
+            continue
+        host = str(ev.get("host", "")) or "host0"
+        fp = (host, ev.get("ts"), kind, ev.get("job"))
+        if fp in seen:
+            continue
+        seen.add(fp)
+        h = hosts.setdefault(host, {"probes": 0, "ok": 0, "failed": 0,
+                                    "degraded": 0})
+        h["probes"] += 1
+        if outcome == "ok":
+            h["ok"] += 1
+            if ev.get("degraded"):
+                h["degraded"] += 1
+            if ev.get("elapsed_s") is not None:
+                lat.setdefault(host, []).append(float(ev["elapsed_s"]))
+        else:
+            h["failed"] += 1
+    for host, h in hosts.items():
+        h["availability"] = (round(h["ok"] / h["probes"], 6)
+                             if h["probes"] else None)
+        vals = lat.get(host)
+        h["latency_p50_s"] = quantile(vals, 0.50) if vals else None
+        h["latency_p95_s"] = quantile(vals, 0.95) if vals else None
+    return {"hosts": {host: hosts[host] for host in sorted(hosts)}}
 
 
 def quantile(values: list[float], q: float) -> float | None:
@@ -188,9 +233,11 @@ def report(events: Iterable[dict], now: float | None = None) -> dict:
     burn rates it had while live (and the report stays deterministic for
     pinned fixtures).
     """
+    events = list(events)
     completions = job_completions(events)
     if now is None:
         now = max((c["ts"] for c in completions), default=time.time())
+    canary = canary_report(events)
     tenants: dict[str, list[dict]] = {}
     for c in completions:
         tenants.setdefault(c["tenant"], []).append(c)
@@ -240,6 +287,8 @@ def report(events: Iterable[dict], now: float | None = None) -> dict:
             "burn": _round_burn(worst_burn),
             "breach": bool(worst_burn >= 1.0),
         }
+    if canary["hosts"]:
+        view["canary"] = canary
     return view
 
 

@@ -254,6 +254,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._instruments: dict[tuple, Any] = {}
         self._trackers: dict[str, ThroughputTracker] = {}
+        #: monotonic snapshot counter — with ``captured_at`` it makes
+        #: every snapshot self-describing about its age, so the fleet
+        #: merge can prefer the newer capture on gauge collisions
+        self._sequence = 0
 
     def _get(self, cls, name: str, labels: dict[str, str]):
         if not self.enabled:
@@ -297,10 +301,23 @@ class MetricsRegistry:
             self._trackers.clear()
 
     def snapshot(self) -> dict:
-        """JSON-ready dump of every instrument, stable ordering."""
+        """JSON-ready dump of every instrument, stable ordering.
+
+        Stamped with ``captured_at`` (wall time) and a monotonic
+        ``sequence`` so downstream consumers — the fleet merge's
+        newer-capture-wins gauge fold, the time-series flush hook — can
+        order captures without trusting file mtimes.  A disabled
+        registry keeps the bare unstamped shape: it records nothing, so
+        there is no capture to order."""
+        out: dict[str, Any] = {"counters": [], "gauges": [], "histograms": []}
+        if not self.enabled:
+            return out
         with self._lock:
             instruments = sorted(self._instruments.items())
-        out: dict[str, list] = {"counters": [], "gauges": [], "histograms": []}
+            self._sequence += 1
+            seq = self._sequence
+        out["captured_at"] = round(time.time(), 6)
+        out["sequence"] = seq
         for (kind, _name, _labels), inst in instruments:
             entry = {"name": inst.name, "labels": dict(inst.labels)}
             if kind == "Counter":
@@ -984,6 +1001,64 @@ def _prom_unescape(value: str) -> str:
     return "".join(out)
 
 
+def _parse_label_body(body: str, lineno: int) -> dict[str, str]:
+    """Escape-aware label-body scanner for :func:`parse_prometheus`.
+
+    A naive ``split(",")`` mis-tokenizes any label *value* containing a
+    comma, ``=`` or an escaped quote — all of which :func:`_prom_escape`
+    legitimately produces — so rendered output would fail its own
+    parser.  This scanner walks the quoted strings honoring the text
+    format's three escapes (``\\\\``, ``\\"``, ``\\n``), making
+    every rendered exposition round-trip exactly."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        if body[i] == ",":
+            i += 1
+            continue
+        eq = body.find("=", i)
+        if eq < 0:
+            raise ValueError(f"line {lineno}: bad label body {body!r}")
+        key = body[i:eq].strip()
+        if not key:
+            raise ValueError(f"line {lineno}: empty label name in {body!r}")
+        i = eq + 1
+        if i >= n or body[i] != '"':
+            raise ValueError(f"line {lineno}: unquoted value for {key!r}")
+        i += 1
+        buf: list[str] = []
+        closed = False
+        while i < n:
+            ch = body[i]
+            if ch == "\\" and i + 1 < n:
+                nxt = body[i + 1]
+                if nxt == "n":
+                    buf.append("\n")
+                    i += 2
+                    continue
+                if nxt in ('"', "\\"):
+                    buf.append(nxt)
+                    i += 2
+                    continue
+                buf.append(ch)
+                i += 1
+                continue
+            if ch == '"':
+                closed = True
+                i += 1
+                break
+            buf.append(ch)
+            i += 1
+        if not closed:
+            raise ValueError(
+                f"line {lineno}: unterminated value for {key!r}")
+        if i < n and body[i] != ",":
+            raise ValueError(
+                f"line {lineno}: junk after value for {key!r}")
+        labels[key] = "".join(buf)
+    return labels
+
+
 def parse_prometheus(text: str) -> list[tuple[str, dict[str, str], float]]:
     """Minimal exposition-format parser (used by tests to validate output).
 
@@ -1006,13 +1081,7 @@ def parse_prometheus(text: str) -> list[tuple[str, dict[str, str], float]]:
             body, _, rest = tail.rpartition("}")
             if not rest or not rest.strip():
                 raise ValueError(f"line {lineno}: bad sample {line!r}")
-            for item in body.split(","):
-                if not item:
-                    continue
-                k, _, v = item.partition("=")
-                if not (v.startswith('"') and v.endswith('"')):
-                    raise ValueError(f"line {lineno}: bad label {item!r}")
-                labels[k] = _prom_unescape(v[1:-1])
+            labels = _parse_label_body(body, lineno)
         else:
             name, _, rest = line.partition(" ")
         if not name or not name.replace("_", "").replace(":", "").isalnum():
@@ -1073,10 +1142,24 @@ def merge_snapshots(
     and histogram count/sum add, gauges keep the last value, max keeps
     the max, and histogram quantiles follow the larger sample.  The
     result renders through :func:`render_prometheus` /
-    :func:`render_json` unchanged."""
+    :func:`render_json` unchanged.
+
+    Gauge collisions resolve by capture recency: snapshots stamp
+    ``captured_at``/``sequence`` (:meth:`MetricsRegistry.snapshot`), and
+    the newer capture's value wins regardless of the order the snapshot
+    files were globbed in.  Un-stamped (pre-stamp-era) snapshots fall
+    back to the old last-write-wins behavior."""
     out: dict[str, list] = {"counters": [], "gauges": [], "histograms": []}
     index: dict[tuple, dict] = {}
+    stamps: dict[tuple, tuple] = {}
     for host, snap in host_snapshots:
+        stamp = None
+        if snap.get("captured_at") is not None:
+            try:
+                stamp = (float(snap["captured_at"]),
+                         float(snap.get("sequence", 0) or 0))
+            except (TypeError, ValueError):
+                stamp = None
         for kind in ("counters", "gauges", "histograms"):
             for entry in snap.get(kind, []) or []:
                 labels = dict(entry.get("labels") or {})
@@ -1088,12 +1171,20 @@ def merge_snapshots(
                     merged["labels"] = labels
                     index[key] = merged
                     out[kind].append(merged)
+                    if stamp is not None:
+                        stamps[key] = stamp
                 elif kind == "counters":
                     merged["value"] = (merged.get("value", 0.0)
                                        + entry.get("value", 0.0))
                 elif kind == "gauges":
-                    merged["value"] = entry.get("value",
-                                                merged.get("value", 0.0))
+                    prev = stamps.get(key)
+                    if stamp is None or prev is None or stamp >= prev:
+                        merged["value"] = entry.get(
+                            "value", merged.get("value", 0.0))
+                        if stamp is None:
+                            stamps.pop(key, None)
+                        else:
+                            stamps[key] = stamp
                 else:
                     if entry.get("count", 0) > merged.get("count", 0):
                         for q in ("p50", "p95"):
@@ -1329,6 +1420,24 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
             # outcome series, mirroring the daemon's live tmx_serve_*
             # and tmx_slo_* metrics so a serve ledger alone reconstructs
             # them (order-independent, like the fleet merge)
+            if ev.get("kind") == "canary":
+                # canary probes (canary.py) are invisible to tenants:
+                # they feed their own tmx_canary_* series — never the
+                # per-tenant serve counters and never the SLO series —
+                # exactly as the live daemon records them
+                if kind == "job_admitted":
+                    reg.counter("tmx_canary_probes_total", **hl).inc()
+                elif kind == "job_done":
+                    reg.counter("tmx_canary_ok_total", **hl).inc()
+                    if "elapsed_s" in ev:
+                        reg.histogram("tmx_canary_latency_seconds",
+                                      **hl).observe(float(ev["elapsed_s"]))
+                    if ev.get("degraded"):
+                        reg.counter("tmx_canary_degraded_total",
+                                    **hl).inc()
+                elif kind == "job_failed":
+                    reg.counter("tmx_canary_failed_total", **hl).inc()
+                continue
             tenant = str(ev.get("tenant", "")) or "unknown"
             if kind == "job_admitted":
                 reg.counter("tmx_serve_admitted_total",
@@ -1431,6 +1540,14 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                               **hl).observe(window)
             elif kind == "serve_preempted":
                 reg.counter("tmx_serve_preemptions_total", **hl).inc()
+        elif kind == "anomaly":
+            # latched warn-only detector events (canary.py): same
+            # counter the live daemon ticks, keyed by the degraded
+            # signal stream
+            reg.counter(
+                "tmx_anomalies_total",
+                metric=str(ev.get("metric", "")) or "unknown", **hl,
+            ).inc()
         elif kind in ("init_done", "description_drift",
                       "serve_started"):
             pass  # known structural events with no metric series

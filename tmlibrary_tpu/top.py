@@ -353,6 +353,22 @@ def render_dashboard(view: dict, width: int = 80) -> str:
                 f"wait p95 {wait_txt} "
                 f"burn {t.get('burn')}{burn_flag}"
             )
+        # ---- CANARY row: per-host black-box probe health
+        can = srv.get("canary") or {}
+        if can.get("probes") or can.get("ok") or can.get("failed"):
+            lat = can.get("latency_s") or {}
+            p95 = lat.get("p95")
+            lines.append(
+                f"  canary probes {can.get('probes', 0)} "
+                f"ok {can.get('ok', 0)} failed {can.get('failed', 0)} "
+                f"degraded {can.get('degraded', 0)} "
+                + ("lat p95 -" if p95 is None else f"lat p95 {p95:.3f}s"))
+        # ---- ANOMALY row: latched detector hits per signal stream
+        anom = srv.get("anomalies") or {}
+        if anom:
+            total = sum(anom.values())
+            per = " ".join(f"{m}:{n}" for m, n in sorted(anom.items()))
+            lines.append(f"  ANOMALY x{total}  {per}")
 
     # ---- breaker / degradation state
     deg = view["degraded"]

@@ -44,8 +44,8 @@ _KNOWN_PH = {_PH_COMPLETE, _PH_INSTANT, _PH_METADATA,
 #: job-lifecycle ledger kinds rendered as instant markers
 _INSTANT_KINDS = ("job_admitted", "job_rejected", "job_started",
                   "job_done", "job_failed", "job_expired", "job_requeued",
-                  "slo_burn", "run_preempted", "serve_preempted",
-                  "watchdog")
+                  "slo_burn", "anomaly", "run_preempted",
+                  "serve_preempted", "watchdog")
 
 
 # ------------------------------------------------------------- collection

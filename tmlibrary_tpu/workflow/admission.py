@@ -109,9 +109,11 @@ class JobSpec:
     #: id links enqueue → admission → queue wait → execution phases.
     trace_id: str | None = None
     #: job kind: ``workflow`` (the default — run the experiment's
-    #: workflow) or ``query`` (answer one analytics query; see
-    #: ``analytics/query.py``).  Old spool files carry no ``kind`` and
-    #: deserialize as workflows.
+    #: workflow), ``query`` (answer one analytics query; see
+    #: ``analytics/query.py``), or ``canary`` (a self-addressed health
+    #: probe — claimed directly by its issuing daemon, never admitted to
+    #: the queue; see ``canary.py``).  Old spool files carry no ``kind``
+    #: and deserialize as workflows.
     kind: str = "workflow"
     #: the query payload for ``kind="query"`` jobs (tool name +
     #: tool-specific arguments); ignored for workflow jobs

@@ -754,6 +754,14 @@ class Workflow:
         except OSError:
             logger.debug("metrics snapshot write failed", exc_info=True)
         try:
+            # same snapshot, durably: one timestamped sample per series
+            # into the per-host tsdb segment (`tmx timeline` feeds on it)
+            from tmlibrary_tpu import timeseries
+
+            timeseries.flush_registry(self.store.workflow_dir)
+        except Exception:
+            logger.debug("tsdb flush failed", exc_info=True)
+        try:
             # per-program roofline/compile attribution for `tmx perf`
             from tmlibrary_tpu import perf
 
