@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""CI warm-start smoke: the cold-start elimination plane on a REAL
+process boundary (DESIGN.md §28).
+
+    python scripts/ci_warmstart_smoke.py [ARTIFACT_DIR]
+
+``tests/test_aotstore.py`` proves the store contracts inside pytest;
+this harness crosses the boundary the tentpole promises to win: the
+SAME jterator Cell Painting workflow runs twice in two separate
+processes against one serialized-executable store.  Run 1 cold-compiles
+both capacity rungs and exports; run 2 must show import hits, ZERO new
+compiles (``tmx_perf_compiles_total == 0``), byte-identical features
+and labels, and a strictly lower time-to-first-batch.
+
+When ARTIFACT_DIR is given, the store manifest (``tmx cache list
+--json``) and both runs' compile-plane tallies land there for CI
+artifact upload.  Exit 0 and ``WARMSTART PASS`` on success; 1
+otherwise.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+WORKER = REPO / "tests" / "warmstart_worker.py"
+CAPACITIES = "16,64"  # a mid-ladder rung + the single-bucket ceiling
+
+
+def _env(store_dir: Path) -> dict:
+    env = {**os.environ, "PYTHONPATH": str(REPO)}
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "TMX_AOT_STORE": "1",
+        "TMX_AOT_STORE_DIR": str(store_dir),
+        # deterministic tallies: no background speculative compiles
+        "TMX_AOT_SPECULATE": "0",
+        # pure-XLA ops — host-callback (pure_callback) programs embed
+        # process-local pointers and refuse to serialize on cpu
+        "TMX_NATIVE": "0",
+    })
+    return env
+
+
+def _run(tag: str, out_dir: Path, env: dict) -> tuple[dict, Path]:
+    out_json = out_dir / f"warmstart_{tag}.json"
+    out_npz = out_dir / f"warmstart_{tag}.npz"
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, str(WORKER), str(out_json), str(out_npz),
+         CAPACITIES],
+        env=env, capture_output=True, text=True, timeout=900,
+    )
+    wall_s = time.perf_counter() - t0
+    if proc.returncode != 0:
+        print(proc.stdout[-2000:])
+        print(proc.stderr[-2000:], file=sys.stderr)
+        raise SystemExit(f"warmstart worker {tag} failed "
+                         f"(rc={proc.returncode})")
+    record = json.loads(out_json.read_text())
+    record["wall_s"] = round(wall_s, 3)
+    print(f"[warmstart] run {tag}: compiles={record['perf_compiles']:.0f} "
+          f"cold={record['cold']} imports={record['import_hit']} "
+          f"exports={record['export']} "
+          f"ttfb={record['time_to_first_batch_s']:.3f}s "
+          f"wall={wall_s:.1f}s")
+    return record, out_npz
+
+
+def _store_manifest(store_dir: Path) -> dict:
+    proc = subprocess.run(
+        [sys.executable, "-m", "tmlibrary_tpu.cli", "cache", "list",
+         "--json", "--dir", str(store_dir)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": str(REPO)},
+        capture_output=True, text=True, timeout=120,
+    )
+    if proc.returncode != 0:
+        raise SystemExit(f"tmx cache list failed: {proc.stderr[-500:]}")
+    return json.loads(proc.stdout)
+
+
+def main() -> int:
+    out_dir = Path(sys.argv[1] if len(sys.argv) > 1
+                   else "/tmp/warmstart-smoke")
+    out_dir.mkdir(parents=True, exist_ok=True)
+    store_dir = out_dir / "aotstore"
+    env = _env(store_dir)
+
+    cold, npz_a = _run("cold", out_dir, env)
+    warm, npz_b = _run("warm", out_dir, env)
+
+    manifest = _store_manifest(store_dir)
+    (out_dir / "warmstart_store_manifest.json").write_text(
+        json.dumps(manifest, indent=2))
+    (out_dir / "warmstart_metrics.json").write_text(json.dumps(
+        {"cold_run": cold, "warm_run": warm,
+         "capacities": CAPACITIES}, indent=2))
+
+    failures = []
+    if not (cold["cold"] >= 2 and cold["export"] >= 2):
+        failures.append(f"cold run did not populate the store: {cold}")
+    if warm["perf_compiles"] != 0 or warm["cold"] != 0:
+        failures.append(f"warm run recompiled: {warm}")
+    if warm["import_hit"] < 2:
+        failures.append(f"warm run missed the store: {warm}")
+    if not warm["time_to_first_batch_s"] < cold["time_to_first_batch_s"]:
+        failures.append(
+            "warm time-to-first-batch not lower: "
+            f"{warm['time_to_first_batch_s']:.3f}s vs "
+            f"{cold['time_to_first_batch_s']:.3f}s")
+    if len(manifest.get("entries", [])) < 2:
+        failures.append(f"store manifest too small: {manifest}")
+
+    import numpy as np
+
+    a, b = np.load(npz_a), np.load(npz_b)
+    if set(a.files) != set(b.files) or not a.files:
+        failures.append("cold/warm result leaf sets differ")
+    else:
+        for name in a.files:
+            if not np.array_equal(a[name], b[name]):
+                failures.append(f"leaf {name} not bit-identical")
+                break
+
+    if failures:
+        for f in failures:
+            print(f"WARMSTART FAIL: {f}", file=sys.stderr)
+        return 1
+    speedup = cold["time_to_first_batch_s"] / max(
+        warm["time_to_first_batch_s"], 1e-9)
+    print(f"WARMSTART PASS: zero-compile warm start, "
+          f"time-to-first-batch {cold['time_to_first_batch_s']:.2f}s → "
+          f"{warm['time_to_first_batch_s']:.2f}s ({speedup:.1f}x), "
+          f"{len(a.files)} leaves bit-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -275,6 +275,38 @@ def perf_section() -> list[str]:
     return out
 
 
+def aotstore_section() -> list[str]:
+    from tmlibrary_tpu import aotstore
+
+    out = ["## Cold-start elimination (`aotstore`, `tmx cache`)", "",
+           (inspect.getdoc(aotstore) or "").split("\n")[0],
+           "",
+           "perf.py's AOT compile path exports every executable into a "
+           "content-addressed on-disk store (digest = program identity "
+           "+ capacity rung + reduction strategy + input signature + "
+           "jax/jaxlib/backend fingerprint) and imports it back on the "
+           "next process — or the next fleet host, via the shared "
+           "serve-root store — instead of compiling.  Compile-ahead "
+           "speculation (`perf.speculate_compile`) precompiles likely "
+           "next capacity rungs off the critical path.  Operator "
+           "surface: `tmx cache list|gc [--dir D] [--json]`, the WARM "
+           "row in `tmx top` / `tmx serve status`, and the "
+           "`tmx_compile_{cold,warm,import_hit,export}_total` / "
+           "`tmx_compile_seconds_saved_total` series (DESIGN.md §28).",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for name in sorted(n for n in dir(aotstore) if not n.startswith("_")):
+        obj = getattr(aotstore, name)
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "") != aotstore.__name__:
+            continue
+        doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append(f"| `aotstore.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def serve_section() -> list[str]:
     from tmlibrary_tpu import serve
     from tmlibrary_tpu.workflow import admission
@@ -424,6 +456,7 @@ def main() -> None:
         *top_section(),
         *qc_section(),
         *perf_section(),
+        *aotstore_section(),
         *resilience_section(),
         *serve_section(),
         *slo_section(),

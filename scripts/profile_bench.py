@@ -80,6 +80,10 @@ def scalar(fn):
 
 
 def main():
+    from tmlibrary_tpu.config import cfg
+    from tmlibrary_tpu.utils import enable_compilation_cache
+
+    enable_compilation_cache(cfg.compile_cache_dir or None)
     data = synthetic_cell_painting_batch(BATCH, size=SIZE)
     dapi = jax.device_put(jnp.asarray(data["DAPI"]))
     actin = jax.device_put(jnp.asarray(data["Actin"]))

@@ -289,6 +289,14 @@ def main():
     values for skipped stages are preserved."""
     import jax
 
+    from tmlibrary_tpu.config import cfg
+    from tmlibrary_tpu.utils import enable_compilation_cache
+
+    # persistent compile cache: a relay window re-running earlier stages
+    # should not re-pay their XLA compiles (same wiring as bench.py's
+    # child and the serve daemon)
+    enable_compilation_cache(cfg.compile_cache_dir or None)
+
     skip = set(filter(None, os.environ.get("TUNE_SKIP", "").split(",")))
     prior = {}
     if os.path.exists(TUNING_PATH):

@@ -23,6 +23,14 @@ import pytest
 
 from tmlibrary_tpu import log as tm_log
 
+# The serialized-executable store + compile-ahead speculation default ON
+# in production, but the suite pins exact compile counts in several
+# places (zero-compile smokes, perf attribution); a store hit or a
+# background speculative compile would make those counts flaky.  Tests
+# that exercise the warm path opt back in with monkeypatch.setenv.
+os.environ.setdefault("TMX_AOT_STORE", "0")
+os.environ.setdefault("TMX_AOT_SPECULATE", "0")
+
 
 @pytest.fixture(scope="session")
 def devices():
@@ -72,6 +80,23 @@ def _reset_trace_context():
     telemetry.set_trace_context()
     yield
     telemetry.set_trace_context()
+
+
+@pytest.fixture(autouse=True)
+def _reset_aotstore():
+    """The executable store's process-default dir and compile tallies
+    are process-global (serve daemons point the default at their spool
+    root); leaking either across tests would misdirect a later test's
+    store IO or skew its provenance counts."""
+    from tmlibrary_tpu import aotstore
+
+    aotstore.set_process_default_dir(None)
+    aotstore.reset_counts()
+    aotstore.reset_seconds_saved()
+    yield
+    aotstore.set_process_default_dir(None)
+    aotstore.reset_counts()
+    aotstore.reset_seconds_saved()
 
 
 @pytest.fixture(autouse=True)

@@ -121,6 +121,29 @@ def select_capacity(observed: int, ladder: tuple[int, ...]) -> int:
     return ladder[-1]
 
 
+def likely_next_rungs(current: int, ladder: tuple[int, ...],
+                      observed: "int | None" = None,
+                      count: int = 1) -> tuple[int, ...]:
+    """The capacity rungs escalation would reach next from ``current`` —
+    the compile-ahead speculation targets (aotstore/perf): warming them
+    during prefetch idle means a saturated batch re-runs one bucket up
+    without paying compile on the critical path.
+
+    When the ``observed`` peak already demands a higher rung than
+    ``current`` (routing history from a peer job, or a count recorded
+    after this program compiled), speculation jumps straight to the
+    rung that peak selects instead of the literal next one.  Returns up
+    to ``count`` ascending rungs strictly above ``current``; empty at
+    the ceiling — there is nothing left to warm."""
+    current = int(current)
+    rungs = [int(c) for c in ladder if int(c) > current]
+    if observed is not None:
+        target = select_capacity(int(observed), ladder)
+        if target > current:
+            rungs = [c for c in rungs if c >= target]
+    return tuple(rungs[: max(0, int(count))])
+
+
 def slot_occupancy(total_objects: float, n_slots: float) -> float:
     """Fraction of padded object slots actually used (0 when there are
     no slots) — the padding-waste signal carried by bench records and

@@ -264,6 +264,39 @@ def build_parser() -> argparse.ArgumentParser:
     p_phist.add_argument("--tail", type=int, default=10,
                          help="history lines to print (default 10)")
 
+    p_cache = sub.add_parser(
+        "cache",
+        help="the serialized-executable store (aotstore): list entries "
+             "or garbage-collect stale/oversize artifacts",
+    )
+    p_cache.add_argument("-v", "--verbosity", action="count", default=0)
+    cache_sub = p_cache.add_subparsers(dest="verb", required=True)
+    p_clist = cache_sub.add_parser(
+        "list", help="store entries, most recently used first")
+    p_clist.add_argument("--dir", default=None, dest="store_dir",
+                         help="store directory (default TMX_AOT_STORE_DIR, "
+                              "config aot_store_dir, or ~/.cache)")
+    p_clist.add_argument("--json", action="store_true", dest="as_json",
+                         help="emit entries + stats as JSON (CI manifest)")
+    p_cgc = cache_sub.add_parser(
+        "gc", help="evict stale-fingerprint, over-age and over-cap entries")
+    p_cgc.add_argument("--dir", default=None, dest="store_dir",
+                       help="store directory (default TMX_AOT_STORE_DIR, "
+                            "config aot_store_dir, or ~/.cache)")
+    p_cgc.add_argument("--max-bytes", type=int, default=None,
+                       dest="max_bytes",
+                       help="LRU size cap to enforce (default the "
+                            "configured store cap)")
+    p_cgc.add_argument("--max-age-days", type=float, default=None,
+                       dest="max_age_days",
+                       help="drop entries unused for this many days")
+    p_cgc.add_argument("--keep-stale", action="store_true",
+                       dest="keep_stale",
+                       help="keep entries from other jax/backend "
+                            "fingerprints (default: drop them)")
+    p_cgc.add_argument("--json", action="store_true", dest="as_json",
+                       help="emit the gc summary as JSON")
+
     p_qc = sub.add_parser(
         "qc",
         help="data-quality report for a run (per-step table, worst-focus "
@@ -2377,6 +2410,66 @@ def _perf_history(args, perf, tuning) -> int:
     return 0
 
 
+def cmd_cache(args) -> int:
+    """``tmx cache list|gc`` — inspect and prune the serialized-
+    executable store (DESIGN.md §28)."""
+    import json as json_mod
+
+    from tmlibrary_tpu import aotstore
+
+    directory = getattr(args, "store_dir", None)
+    if args.verb == "list":
+        rows = aotstore.list_entries(directory)
+        stats = aotstore.store_stats(directory)
+        if args.as_json:
+            print(json_mod.dumps({"stats": stats, "entries": rows},
+                                 indent=2, sort_keys=True))
+            return 0
+        print(f"store: {stats['dir']}  "
+              f"({'enabled' if stats['enabled'] else 'DISABLED'})")
+        print(f"fingerprint: {stats['fingerprint']}  entries: "
+              f"{stats['entries']}  bytes: {stats['total_bytes']}  "
+              f"stale: {stats['stale_entries']}")
+        if rows:
+            print(f"{'digest':<18} {'program':<24} {'cap':>5} "
+                  f"{'strategy':<10} {'size':>9} {'age':>8} fp")
+            for m in rows:
+                age = m.get("age_s")
+                age_txt = "-" if age is None else (
+                    f"{age / 3600:.1f}h" if age >= 3600 else f"{age:.0f}s")
+                fp = str(m.get("fingerprint") or "?")[:8]
+                if m.get("stale"):
+                    fp += " STALE"
+                print(f"{str(m.get('digest'))[:16]:<18} "
+                      f"{str(m.get('program'))[:24]:<24} "
+                      f"{str(m.get('capacity') if m.get('capacity') is not None else '-'):>5} "
+                      f"{str(m.get('strategy') or '-')[:10]:<10} "
+                      f"{int(m.get('size_bytes') or 0):>9} "
+                      f"{age_txt:>8} {fp}")
+        return 0
+    if args.verb == "gc":
+        max_age_s = (None if args.max_age_days is None
+                     else float(args.max_age_days) * 86400.0)
+        result = aotstore.prune(
+            directory,
+            max_bytes=args.max_bytes,
+            max_age_s=max_age_s,
+            drop_stale_fingerprint=not args.keep_stale,
+        )
+        if args.as_json:
+            print(json_mod.dumps(result, indent=2, sort_keys=True))
+            return 0
+        print(f"removed {len(result['removed'])} entr"
+              f"{'y' if len(result['removed']) == 1 else 'ies'}, "
+              f"kept {result['kept']} "
+              f"({result['total_bytes']} bytes)")
+        for digest in result["removed"]:
+            print(f"  - {digest}")
+        return 0
+    print(f"unknown cache verb: {args.verb}", file=sys.stderr)
+    return 2
+
+
 def main(argv=None) -> int:
     # TMX_PLATFORM=cpu forces the backend IN-PROCESS before first use:
     # plain JAX_PLATFORMS is overridden by TPU-relay site configs, and a
@@ -2435,6 +2528,8 @@ def main(argv=None) -> int:
             return cmd_weights(args)
         if args.command == "perf":
             return cmd_perf(args)
+        if args.command == "cache":
+            return cmd_cache(args)
         return cmd_step(args)
     except Exception as e:
         print(f"error: {e}", file=sys.stderr)

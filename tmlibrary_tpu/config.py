@@ -152,6 +152,31 @@ class LibraryConfig:
     compile_cache_dir: str = dataclasses.field(
         default_factory=lambda: _setting("compile_cache_dir", "")
     )
+    #: serialized AOT executable store master switch (aotstore.py): the
+    #: perf AOT path exports every compiled executable and imports it
+    #: back on the next process/host instead of compiling cold.  The
+    #: TMX_AOT_STORE env (set by tests/operators) beats this setting
+    aot_store: str = dataclasses.field(
+        default_factory=lambda: _setting("aot_store", "1")
+    )
+    #: store directory; "" = the resolution chain in aotstore.store_dir
+    #: (TMX_AOT_STORE_DIR env > this > process default — serve daemons
+    #: point the default at the shared serve root > ~/.cache)
+    aot_store_dir: str = dataclasses.field(
+        default_factory=lambda: _setting("aot_store_dir", "")
+    )
+    #: LRU cap on the store's total payload bytes (<=0 = uncapped);
+    #: TMX_AOT_STORE_MAX_BYTES env beats this setting
+    aot_store_max_bytes: str = dataclasses.field(
+        default_factory=lambda: _setting("aot_store_max_bytes", "")
+    )
+    #: compile-ahead speculation switch: a background warm thread
+    #: precompiles the likely next capacity rungs during prefetch idle
+    #: so bucket escalation stops paying compile on the critical path.
+    #: The TMX_AOT_SPECULATE env beats this setting
+    aot_speculate: str = dataclasses.field(
+        default_factory=lambda: _setting("aot_speculate", "1")
+    )
     # ------------------------------------------------- grouped reductions
     #: grouped-reduction strategy for the measurement stack
     #: ("auto" | "onehot" | "sort" | "scatter"); "auto" falls through to

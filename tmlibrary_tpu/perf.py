@@ -22,7 +22,13 @@ place the XLA cost model is read and interpreted:
   object, so attribution adds **zero extra compiles**) and subsequent
   calls execute that compiled object directly.  New signatures count as
   recompiles.  Any failure in the AOT path falls back to the plain jit
-  call — instrumentation may never break a run;
+  call — instrumentation may never break a run.  The same hook is the
+  cold-start plane's beachhead (:mod:`tmlibrary_tpu.aotstore`): before
+  compiling it consults the serialized-executable store (an import hit
+  skips the compile entirely — ``tmx_compile_import_hit_total``), after
+  compiling it exports the executable for the next process/host, and
+  :func:`speculate_compile` lets a background thread precompile the
+  likely next capacity rung so escalation lands ``warm``;
 * a process-wide profile store (:func:`perf_profiles` /
   :func:`perf_snapshot`) keyed by (program, step, capacity, strategy),
   mirrored into ``tmx_perf_*`` registry metrics and persisted by the
@@ -388,44 +394,82 @@ def _instrumented_call(fn, key, args, kwargs, sub_costs=None):
         known = sig in state["sigs"]
         compiled = state["sigs"].get(sig)
         dead = state["dead"]
+        spec_hit = known and sig in state.get("speculative", ())
+        if spec_hit:
+            state["speculative"].discard(sig)
     if dead and not known:
         return fn(*args, **kwargs)
-    if not known:
-        compile_s = None
-        t0 = time.perf_counter()
+    if spec_hit:
+        # a background speculation thread (or a store import it made)
+        # already built this executable: no critical-path compile
         try:
-            compiled = fn.lower(*args, **kwargs).compile()
-            compile_s = time.perf_counter() - t0
-        except Exception:
-            compiled = None
-        cost = cost_from_compiled(compiled) if compiled is not None \
-            else ProgramCost()
-        with _LOCK:
-            recompile = bool(state["sigs"])
-            if len(state["sigs"]) >= _MAX_SIGNATURES:
-                state["dead"] = True
-            else:
-                state["sigs"][sig] = compiled
-        try:
-            import jax
+            from tmlibrary_tpu import aotstore
 
-            backend = jax.default_backend()
+            aotstore.note_warm(program)
         except Exception:
-            backend = "unknown"
-        record_compile(program=program, step=step, capacity=capacity,
-                       strategy=strategy, backend=backend,
-                       compile_s=compile_s, cost=cost, recompile=recompile)
-        if sub_costs is not None:
+            pass
+    if not known:
+        imported = _try_store_import(key, sig)
+        if imported is not None:
+            compiled, meta = imported
+            with _LOCK:
+                if len(state["sigs"]) < _MAX_SIGNATURES:
+                    state["sigs"][sig] = compiled
+            # an import hit is NOT a compile: record_compile is skipped
+            # so the zero-new-compiles pinning (warm-start tests / CI
+            # smoke) holds; the profile store still learns about it
+            record_import(program=program, step=step, capacity=capacity,
+                          strategy=strategy,
+                          saved_s=meta.get("compile_s"))
+        else:
+            compile_s = None
+            t0 = time.perf_counter()
             try:
-                for sub_name, sub_cost in sub_costs(args, kwargs):
-                    record_compile(
-                        program=f"{program}:{sub_name}", step=step,
-                        capacity=capacity, strategy=strategy,
-                        backend=backend, cost=sub_cost,
-                        recompile=recompile,
-                    )
+                compiled = fn.lower(*args, **kwargs).compile()
+                compile_s = time.perf_counter() - t0
             except Exception:
-                pass
+                compiled = None
+            cost = cost_from_compiled(compiled) if compiled is not None \
+                else ProgramCost()
+            with _LOCK:
+                recompile = bool(state["sigs"])
+                if len(state["sigs"]) >= _MAX_SIGNATURES:
+                    state["dead"] = True
+                else:
+                    state["sigs"][sig] = compiled
+            try:
+                import jax
+
+                backend = jax.default_backend()
+            except Exception:
+                backend = "unknown"
+            record_compile(program=program, step=step, capacity=capacity,
+                           strategy=strategy, backend=backend,
+                           compile_s=compile_s, cost=cost,
+                           recompile=recompile)
+            if compiled is not None:
+                try:
+                    from tmlibrary_tpu import aotstore
+
+                    aotstore.note_cold(program)
+                    aotstore.export_entry(
+                        compiled, program=program, step=step,
+                        capacity=capacity, strategy=strategy,
+                        signature=sig, compile_s=compile_s,
+                    )
+                except Exception:
+                    pass
+            if sub_costs is not None:
+                try:
+                    for sub_name, sub_cost in sub_costs(args, kwargs):
+                        record_compile(
+                            program=f"{program}:{sub_name}", step=step,
+                            capacity=capacity, strategy=strategy,
+                            backend=backend, cost=sub_cost,
+                            recompile=recompile,
+                        )
+                except Exception:
+                    pass
     if compiled is not None:
         try:
             return compiled(*args, **kwargs)
@@ -434,6 +478,141 @@ def _instrumented_call(fn, key, args, kwargs, sub_costs=None):
             with _LOCK:
                 state["sigs"][sig] = None
     return fn(*args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# Serialized-executable store hooks + compile-ahead speculation
+
+def _try_store_import(key, sig):
+    """Look the (program, capacity, strategy, signature) executable up in
+    the serialized store.  None on miss/disabled/any failure — the cold
+    path must always be reachable."""
+    program, step, capacity, strategy = key
+    try:
+        from tmlibrary_tpu import aotstore
+
+        if not aotstore.enabled():
+            return None
+        return aotstore.import_entry(program=program, capacity=capacity,
+                                     strategy=strategy, signature=sig)
+    except Exception:
+        return None
+
+
+def record_import(*, program: str, step: str = "jterator",
+                  capacity: int | None = None, strategy: str | None = None,
+                  saved_s: float | None = None) -> dict:
+    """Record one store import hit in the profile store.  Deliberately
+    does NOT touch the compile counters — an import is the *absence* of
+    a compile, and the warm-start tests pin that distinction."""
+    key = (program, step, capacity, strategy)
+    with _LOCK:
+        entry = _PROFILES.setdefault(key, {
+            "program": program,
+            "step": step,
+            "capacity": capacity,
+            "strategy": strategy,
+            "backend": "unknown",
+            "flops": None,
+            "bytes": None,
+            "arithmetic_intensity": None,
+            "bound_by": None,
+            "compiles": 0,
+            "recompiles": 0,
+            "compile_seconds_total": 0.0,
+            "last_compile_s": None,
+        })
+        entry["imports"] = int(entry.get("imports") or 0) + 1
+        if isinstance(saved_s, (int, float)) and saved_s > 0:
+            entry["compile_seconds_saved"] = round(
+                float(entry.get("compile_seconds_saved") or 0.0)
+                + float(saved_s), 4,
+            )
+        return dict(entry)
+
+
+def adopt_executable(key, sig, compiled) -> bool:
+    """Register a speculatively-built executable so the next real call
+    with this signature is a hit (and counts as ``warm``, not a
+    compile).  False when the signature is already known, the program is
+    dead, or the signature cache is full — the speculation thread races
+    the real call and the real call always wins."""
+    with _LOCK:
+        state = _RUNTIME.setdefault(key, {"sigs": {}, "dead": False})
+        if (sig in state["sigs"] or state["dead"]
+                or len(state["sigs"]) >= _MAX_SIGNATURES):
+            return False
+        state["sigs"][sig] = compiled
+        state.setdefault("speculative", set()).add(sig)
+        return True
+
+
+def abstract_args(args, kwargs):
+    """Shape/dtype skeleton of a call: every array leaf becomes a
+    ``jax.ShapeDtypeStruct``.  The skeleton has the same
+    :func:`_args_signature` as the originals, can be lowered against,
+    and holds no buffers — safe to hand to a speculation thread while
+    the real (possibly donated) arrays are consumed."""
+    import jax
+
+    def conv(leaf):
+        if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(conv, (args, kwargs))
+
+
+def speculate_compile(wrapped_fn, args, kwargs) -> str | None:
+    """Precompile one instrumented batch fn off the critical path.
+
+    ``wrapped_fn`` is an :func:`instrument_batch_fn` wrapper (it carries
+    ``perf_key`` + ``__wrapped__``); ``args``/``kwargs`` may be real
+    arrays or an :func:`abstract_args` skeleton.  Tries the serialized
+    store first (an import there counts as an ``import_hit``), then
+    compiles and exports.  Returns ``"known"`` (already built),
+    ``"imported"``, ``"compiled"``, or None on any failure.  Runs on a
+    background thread: every path is exception-proof and the later real
+    call counts as ``warm`` instead of a compile."""
+    key = getattr(wrapped_fn, "perf_key", None)
+    fn = getattr(wrapped_fn, "__wrapped__", None)
+    if key is None or fn is None:
+        return None
+    try:
+        sig = _args_signature(args, kwargs)
+    except Exception:
+        return None
+    with _LOCK:
+        state = _RUNTIME.setdefault(key, {"sigs": {}, "dead": False})
+        if sig in state["sigs"] or state["dead"]:
+            return "known"
+    imported = _try_store_import(key, sig)
+    if imported is not None:
+        compiled, meta = imported
+        if adopt_executable(key, sig, compiled):
+            record_import(program=key[0], step=key[1], capacity=key[2],
+                          strategy=key[3], saved_s=meta.get("compile_s"))
+            return "imported"
+        return "known"
+    compile_s = None
+    t0 = time.perf_counter()
+    try:
+        compiled = fn.lower(*args, **kwargs).compile()
+        compile_s = time.perf_counter() - t0
+    except Exception:
+        return None
+    if not adopt_executable(key, sig, compiled):
+        return "known"
+    try:
+        from tmlibrary_tpu import aotstore
+
+        aotstore.export_entry(
+            compiled, program=key[0], step=key[1], capacity=key[2],
+            strategy=key[3], signature=sig, compile_s=compile_s,
+        )
+    except Exception:
+        pass
+    return "compiled"
 
 
 # ---------------------------------------------------------------------------

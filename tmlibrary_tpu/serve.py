@@ -96,7 +96,7 @@ import time
 from contextlib import nullcontext
 from pathlib import Path
 
-from tmlibrary_tpu import canary, faults, slo, telemetry, timeseries
+from tmlibrary_tpu import aotstore, canary, faults, slo, telemetry, timeseries
 from tmlibrary_tpu.atomicio import atomic_write_json, claim_rename
 from tmlibrary_tpu.errors import FaultInjected, PreemptedError
 from tmlibrary_tpu.resilience import (
@@ -185,6 +185,14 @@ def heartbeat_file(serve_root: Path, host: str | None = None) -> Path:
 
 def status_file(serve_root: Path) -> Path:
     return serve_dir(serve_root) / "status.json"
+
+
+def aot_store_path(serve_root: Path) -> Path:
+    """The fleet-shared serialized-executable store for this spool —
+    every daemon exports here and imports peers' executables from here
+    (``TMX_AOT_STORE_DIR``/config still override inside
+    :func:`aotstore.store_dir`)."""
+    return Path(serve_root) / "aotstore"
 
 
 def claim_path(serve_root: Path, job_id: str, host: str) -> Path:
@@ -382,6 +390,23 @@ class ServeDaemon:
         self._last_anomaly_check = 0.0
         self._tsdb_flush_s = float(cfg.tsdb_flush_s)
         self._last_tsdb_flush = 0.0
+        #: fleet warm-start (DESIGN.md §28): every daemon on this spool
+        #: shares one serialized-executable store under the serve root
+        #: (env/config overrides still win inside store_dir), so a cold
+        #: host imports a peer's exported executables instead of
+        #: deferring to it.  The compilation cache rides along — serve
+        #: is the long-lived process the cache exists for.
+        aotstore.set_process_default_dir(str(aot_store_path(self.serve_root)))
+        try:
+            from tmlibrary_tpu.utils import enable_compilation_cache
+
+            enable_compilation_cache(cfg.compile_cache_dir or None)
+        except Exception:
+            logger.debug("compilation cache setup failed", exc_info=True)
+        #: throttled store-stats cache for _publish_state/_should_defer —
+        #: (monotonic_ts, stats dict); listing the store every poll-loop
+        #: iteration would hammer the shared filesystem
+        self._store_stats_cache: tuple[float, dict] | None = None
 
     # ------------------------------------------------------------ helpers
     def _arm(self, phase: str):
@@ -518,15 +543,46 @@ class ServeDaemon:
             period=self.poll_s, extra=extra,
         )
 
+    def _store_stats(self, max_age_s: float = 10.0) -> dict:
+        """Throttled :func:`aotstore.store_stats` for the shared store —
+        the poll loop and the deferral decision both consult it, and a
+        directory listing per loop iteration would hammer the shared
+        filesystem a fleet mounts it on."""
+        now = time.monotonic()
+        if (self._store_stats_cache is not None
+                and now - self._store_stats_cache[0] < max_age_s):
+            return self._store_stats_cache[1]
+        try:
+            stats = aotstore.store_stats()
+        except Exception:
+            logger.debug("aot store stats failed", exc_info=True)
+            stats = {"enabled": False, "entries": 0, "total_bytes": 0}
+        self._store_stats_cache = (now, stats)
+        return stats
+
     def _publish_state(self) -> None:
         """Heartbeat + live status/queue gauges, every loop iteration."""
         snap = self.queue.snapshot()
         self._write_serve_heartbeat(queue_depth=snap["depth"])
+        # fleet warm-start: publish this host's warm digests + the shared
+        # store's shape next to the queue snapshot, so `tmx serve status`
+        # and peers can see who is warm without touching the registry
+        store = self._store_stats()
+        warm = {
+            "store_entries": int(store.get("entries", 0)),
+            "store_bytes": int(store.get("total_bytes", 0)),
+            "store_enabled": bool(store.get("enabled", False)),
+            "warm_keys": len(self._warm_keys),
+            "warm_digests": list(aotstore.warm_digests(limit=8)),
+            "seconds_saved": round(aotstore.seconds_saved(), 3),
+        }
         atomic_write_json(status_file(self.serve_root), {
             "ts": time.time(), "jobs_run": self._jobs_run,
-            "host": self.host_name, **snap,
+            "host": self.host_name, "warm": warm, **snap,
         })
         self._metric("gauge", "tmx_serve_queue_depth", snap["depth"])
+        self._metric("gauge", "tmx_aot_store_entries", warm["store_entries"])
+        self._metric("gauge", "tmx_aot_store_bytes", warm["store_bytes"])
         age = snap.get("oldest_job_age_s")
         if age is not None:
             self._metric("gauge", "tmx_serve_oldest_job_age_seconds", age)
@@ -779,12 +835,26 @@ class ServeDaemon:
         exist (one of them is likelier to have it warm) — but never for
         longer than one lease period, after which any host claims it.
         A host with nothing warm yet has no basis for preference and
-        claims everything."""
+        claims everything.
+
+        Fleet warm-start (DESIGN.md §28) retires most deferrals: when
+        the shared serialized-executable store has entries for this
+        jax/backend fingerprint, a cold host imports a peer's exported
+        executables instead of waiting for the peer — claiming the job
+        *makes* this host warm, so deferring would only add latency."""
         key = spec.affinity_key
         if key is None or not self._warm_keys or key in self._warm_keys:
             self._deferred_seen.pop(spec.job_id, None)
             return False
         if not live_peers:
+            return False
+        store = self._store_stats()
+        if store.get("enabled") and int(store.get("entries", 0)) > int(
+                store.get("stale_entries", 0) or 0):
+            # at least one importable executable exists — become a warm
+            # host rather than deferring to one
+            self._deferred_seen.pop(spec.job_id, None)
+            self._metric("counter", "tmx_serve_warmstart_claims_total")
             return False
         first = self._deferred_seen.setdefault(spec.job_id, now)
         waited = now - (float(spec.submitted_at)
@@ -1211,6 +1281,7 @@ class ServeDaemon:
             return "deadline"
 
         t0 = time.monotonic()
+        compile_counts_t0 = aotstore.counts_snapshot()
         try:
             # the job span: per-attempt wall time of the whole execution,
             # the parent interval the engine's run→step→batch→phase tree
@@ -1291,6 +1362,20 @@ class ServeDaemon:
                     extra_done["index_cache"] = attrs["index_cache"]
                 if attrs.get("index_fallback"):
                     extra_done["index_fallback"] = True
+        # warm-start provenance: this job's cold-compile / store-import
+        # deltas ride the done event so ledger replay and `tmx serve
+        # status` can show which jobs became warm hosts for free
+        counts_t1 = aotstore.counts_snapshot()
+        for kind, field in (("cold", "compiles_cold"),
+                            ("import_hit", "compile_imports")):
+            delta = counts_t1.get(kind, 0.0) - compile_counts_t0.get(kind, 0.0)
+            if delta > 0:
+                extra_done[field] = int(delta)
+        if counts_t1 != compile_counts_t0:
+            # the job compiled/exported/imported: drop the throttled
+            # store-stats cache so the next published warm view reflects
+            # the new entries instead of a pre-job snapshot
+            self._store_stats_cache = None
         self.ledger.append(event="job_done", job=job.job_id,
                            tenant=job.tenant, elapsed_s=round(elapsed, 3),
                            epoch=job.claim_epoch, resumed=resume,
@@ -1645,6 +1730,8 @@ def serve_status_view(serve_root: Path) -> dict:
     stale_claims = 0
     affinity_hits = 0
     affinity_known = 0
+    compile_imports = 0
+    compiles_cold = 0
     view["slo"] = None
     view["queries"] = None
     view["canary"] = None
@@ -1717,6 +1804,9 @@ def serve_status_view(serve_root: Path) -> dict:
                 "expired": 0, "requeued": 0, "reclaimed": 0,
             })
             t[kind.removeprefix("job_")] += 1
+            if kind == "job_done":
+                compile_imports += int(ev.get("compile_imports") or 0)
+                compiles_cold += int(ev.get("compiles_cold") or 0)
             if kind == "job_reclaimed":
                 reclaims += 1
             if kind == "job_admitted":
@@ -1758,6 +1848,25 @@ def serve_status_view(serve_root: Path) -> dict:
         view["queries"] = queries
     view["tenants"] = tenants
     view["preemptions"] = preempted
+    # ---- WARM: the fleet-shared serialized-executable store (DESIGN.md
+    # §28) read straight from disk, plus the daemon's last-published
+    # warm snapshot — meaningful with or without a live daemon
+    try:
+        store = aotstore.store_stats(str(aot_store_path(serve_root)))
+        view["warm"] = {
+            "store_dir": store.get("dir"),
+            "entries": int(store.get("entries", 0)),
+            "bytes": int(store.get("total_bytes", 0)),
+            "stale_entries": int(store.get("stale_entries", 0)),
+            "fingerprint": store.get("fingerprint"),
+            "compile_imports": compile_imports,
+            "compiles_cold": compiles_cold,
+            "published": (view["status"] or {}).get("warm")
+            if isinstance(view.get("status"), dict) else None,
+        }
+    except Exception:
+        logger.debug("warm store view failed", exc_info=True)
+        view["warm"] = None
     view["fleet"] = {
         "hosts": hosts,
         "ledgers": [p.name for p in serve_ledger_paths(serve_root)],

@@ -1404,6 +1404,13 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
         elif kind == "qc_budget_exceeded":
             reg.counter("tmx_qc_budget_exceeded_total",
                         step=step, **hl).inc()
+        elif kind == "first_batch":
+            # cold-start attribution (engine.py): wall seconds from
+            # run_started to the first persisted batch — the number the
+            # aotstore warm path exists to shrink
+            if "time_to_first_batch_s" in ev:
+                reg.gauge("tmx_time_to_first_batch_seconds", **hl).set(
+                    float(ev["time_to_first_batch_s"]))
         elif kind == "run_preempted":
             reg.counter("tmx_preemptions_total", **hl).inc()
         elif kind == "watchdog":
@@ -1479,6 +1486,16 @@ def registry_from_ledger(events: Iterable[dict]) -> MetricsRegistry:
                                   tenant=tenant, **hl).observe(
                         float(ev["elapsed_s"]))
                 _observe_slo(reg, tenant, "ok", ev.get("elapsed_s"), hl)
+                # warm-start provenance (aotstore): done events carry the
+                # job's cold-compile / store-import deltas; replayed
+                # totals match the live ones summed across programs (the
+                # live series carry a program label the ledger does not)
+                if ev.get("compiles_cold"):
+                    reg.counter("tmx_compile_cold_total", **hl).inc(
+                        int(ev["compiles_cold"]))
+                if ev.get("compile_imports"):
+                    reg.counter("tmx_compile_import_hit_total", **hl).inc(
+                        int(ev["compile_imports"]))
                 if ev.get("kind") == "query" and ev.get("tool"):
                     # analytics query jobs (serve.py _run_query): replay
                     # the tmx_analytics_* series run_query fed live —
@@ -1618,7 +1635,16 @@ def build_span_tree(events: Iterable[dict]) -> dict:
                 node = _batch_node(step, ev.get("batch"))
                 node["elapsed"] = elapsed
             else:  # phase span (prefetch_wait/dispatch/device_block/persist)
-                parent = _batch_node(step, ev.get("batch"))
+                # batch-less phase spans (e.g. a compile attributed to
+                # the step, not to one batch) stay OUT of the tree:
+                # fabricating a "batch:None" node would miscount
+                # batches, and nesting under the step would outweigh
+                # every real batch on the critical path.  Compile cost
+                # keeps its own surfaces (perf profiles, `tmx trace`
+                # raw spans, the WARM row).
+                if ev.get("batch") is None:
+                    continue
+                parent = _batch_node(step, ev["batch"])
                 parent["children"].append(
                     {"name": f"phase:{name}", "elapsed": elapsed,
                      "children": []}

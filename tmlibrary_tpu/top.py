@@ -233,6 +233,24 @@ def render_dashboard(view: dict, width: int = 80) -> str:
                 f"n={h.get('count', 0)} p50={h.get('p50', 0) * 1e3:.1f}ms "
                 f"p95={h.get('p95', 0) * 1e3:.1f}ms"
             )
+
+        # ---- WARM row: the cold-start plane (aotstore) — critical-path
+        # compiles vs speculative/imported executables, and the compile
+        # seconds the store gave back
+        cold = _counter_sum(merged, "tmx_compile_cold_total")
+        spec = _counter_sum(merged, "tmx_compile_warm_total")
+        imp = _counter_sum(merged, "tmx_compile_import_hit_total")
+        exp = _counter_sum(merged, "tmx_compile_export_total")
+        if cold or spec or imp or exp:
+            line = (f"warm: compiles cold {int(cold)} warm {int(spec)} "
+                    f"imported {int(imp)} exported {int(exp)}")
+            saved = _gauges(merged, "tmx_compile_seconds_saved_total")
+            if saved:
+                line += f"  saved {saved[0].get('value', 0.0):.1f}s"
+            ttfb = _gauges(merged, "tmx_time_to_first_batch_seconds")
+            if ttfb:
+                line += f"  first batch {ttfb[0].get('value', 0.0):.2f}s"
+            lines.append(line)
     else:
         lines.append("metrics: no snapshot yet (telemetry off, or first "
                      "snapshot not written)")
@@ -334,6 +352,21 @@ def render_dashboard(view: dict, width: int = 80) -> str:
                 + f"  stale {fleet.get('stale_claims_total', 0)}"
                 + "  affinity "
                 + (f"{rate:.0%}" if rate is not None else "-"))
+        # ---- WARM row: the fleet-shared executable store + this spool's
+        # ledger-replayed import/cold split (DESIGN.md §28)
+        warm = srv.get("warm") or {}
+        pub = warm.get("published") or {}
+        if (warm.get("entries") or warm.get("compile_imports")
+                or warm.get("compiles_cold")):
+            line = (f"  WARM store {warm.get('entries', 0)} entries "
+                    f"{_fmt_bytes(warm.get('bytes', 0))}")
+            if warm.get("stale_entries"):
+                line += f" ({warm['stale_entries']} stale)"
+            line += (f"  imports {warm.get('compile_imports', 0)}"
+                     f"  cold {warm.get('compiles_cold', 0)}")
+            if pub.get("seconds_saved"):
+                line += f"  saved {pub['seconds_saved']:.1f}s"
+            lines.append(line)
         # ---- SLO panel: per-tenant latency/availability vs objective
         slo_view = srv.get("slo") or {}
         waits = srv.get("queue_wait_s") or {}

@@ -608,6 +608,12 @@ class Workflow:
                                    previous=prev, current=desc_hash)
         self.ledger.append(event="run_started", description_hash=desc_hash,
                            resume=resume)
+        # cold-start attribution: wall clock from run start to the first
+        # persisted batch of a device-dispatching step (the time XLA
+        # compiles dominate on a cold process — the aotstore warm-start
+        # plane exists to shrink it)
+        self._run_wall_t0 = time.time()
+        self._first_batch_noted = False
         telemetry.get_registry().counter("tmx_runs_total").inc()
         sampler = self._start_sampler()
         guard = self.resilience.guard if self.resilience.enabled else None
@@ -912,6 +918,10 @@ class Workflow:
                 stats=pstats,
                 should_stop=self._should_stop,
                 watchdog=self._watchdog,
+                # compile-ahead speculation (aotstore plane): steps that
+                # expose the hook warm the likely next capacity rungs on
+                # a background thread once the window starts filling
+                warm_hook=getattr(step, "speculate_ahead", None),
             )
             gen = executor.run(pending)
         elif (hasattr(step, "run_batches_pipelined") and pending
@@ -1057,6 +1067,27 @@ class Workflow:
                             elapsed=b_elapsed,
                             attempts=outcome.attempts,
                             result=outcome.value)
+                        # only device-dispatching steps (the launch/
+                        # block/persist protocol — where the XLA
+                        # compiles live) count: a metaconfig batch
+                        # landing in milliseconds would mask the
+                        # cold-start this metric exists to expose
+                        if (not getattr(self, "_first_batch_noted", True)
+                                and getattr(self, "_run_wall_t0", None)
+                                and hasattr(step, "launch_batch")):
+                            self._first_batch_noted = True
+                            ttfb = time.time() - self._run_wall_t0
+                            # NOT batch= : any step+batch event mints a
+                            # batch node in build_span_tree, and this
+                            # marker is an instant, not a span
+                            self.ledger.append(
+                                step=sd.name, event="first_batch",
+                                first_batch_index=batch["index"],
+                                time_to_first_batch_s=round(ttfb, 6),
+                            )
+                            metrics.gauge(
+                                "tmx_time_to_first_batch_seconds"
+                            ).set(round(ttfb, 6))
                         self._note_straggler(sd.name, batch["index"],
                                              outcome.value)
                         qc_flagged += self._note_qc(sd.name, batch["index"],

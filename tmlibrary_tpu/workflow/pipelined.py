@@ -193,6 +193,7 @@ class PipelinedExecutor:
         stats=None,
         should_stop: Callable[[], bool] | None = None,
         watchdog=None,
+        warm_hook: Callable[[], None] | None = None,
     ):
         if depth is None:
             depth, depth_source = resolve_pipeline_depth()
@@ -215,6 +216,15 @@ class PipelinedExecutor:
         #: resilience.PhaseWatchdog (or None): deadlines over the
         #: launch/block/persist phases
         self.watchdog = watchdog
+        #: compile-ahead speculation hook (aotstore plane): fired ONCE,
+        #: right after the first batch's launch returns — the device is
+        #: busy, the prefetch workers own the host IO, and the window is
+        #: filling, so this is the prefetch-idle moment to start warming
+        #: the likely next capacity rungs on a background thread.  The
+        #: hook manages its own thread; a failure is swallowed (warming
+        #: is an optimization, never a correctness dependency)
+        self.warm_hook = warm_hook
+        self._warmed = False
 
     # ------------------------------------------------------------------ run
     def run(self, batches: Iterable[dict]) -> Iterator[tuple[dict, dict]]:
@@ -376,6 +386,12 @@ class PipelinedExecutor:
                     if stats is not None:
                         stats.record("dispatch", time.perf_counter() - t0,
                                      batch=bidx, t0=w0)
+                    if self.warm_hook is not None and not self._warmed:
+                        self._warmed = True
+                        try:
+                            self.warm_hook()
+                        except Exception:
+                            logger.debug("warm hook failed", exc_info=True)
                 except Exception:
                     # drain the WHOLE window: every already-launched batch
                     # persists (and the caller ledgers it) before the

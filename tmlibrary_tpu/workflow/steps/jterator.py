@@ -1102,7 +1102,117 @@ class ImageAnalysisRunner(Step):
         if sharding is not None:
             shifts = jax.device_put(shifts, sharding)
 
+        self._note_speculation_ctx(
+            batch["args"], capacity, (raw, inputs["stats"], shifts)
+        )
         return fn(raw, inputs["stats"], shifts)
+
+    # ------------------------------------------- compile-ahead speculation
+    def _note_speculation_ctx(self, args, capacity, call_args) -> None:
+        """Remember the shape/dtype skeleton of the latest dispatch so
+        the compile-ahead warm thread (:meth:`speculate_ahead`) can
+        precompile the next capacity rung against the exact same input
+        signature.  No buffers are retained — the skeleton is
+        ``ShapeDtypeStruct`` leaves only (the real arrays may be
+        donated)."""
+        try:
+            from tmlibrary_tpu import aotstore
+
+            if not aotstore.speculation_enabled():
+                return
+            from tmlibrary_tpu import perf
+
+            cap = int(capacity if capacity is not None
+                      else args["max_objects"])
+            self._spec_ctx = (args, cap, perf.abstract_args(call_args, {}))
+        except Exception:
+            pass
+
+    def speculate_ahead(self) -> None:
+        """Compile-ahead speculation (DESIGN.md §28): precompile the
+        likely next capacity rungs on a background daemon thread while
+        the device chews on dispatched batches, so bucket escalation
+        (and the TUNING.json-hinted rung) never pays compile on the
+        critical path.  Wired as the pipelined executor's warm hook;
+        no-op when disabled, before the first dispatch, or while a
+        previous warm thread is still running."""
+        try:
+            from tmlibrary_tpu import aotstore
+
+            if not aotstore.speculation_enabled():
+                return
+        except Exception:
+            return
+        if getattr(self, "_spec_ctx", None) is None:
+            return
+        prev = getattr(self, "_spec_thread", None)
+        if prev is not None and prev.is_alive():
+            return
+        # NOT a daemon thread: the interpreter tearing down while XLA
+        # is mid-compile aborts the whole process (C++ terminate), so
+        # exit must join an in-flight speculative compile.  The worker
+        # checks main-thread liveness between rungs to keep that join
+        # bounded to at most one rung.
+        t = threading.Thread(
+            target=self._speculate_worker, name="tmx-warm", daemon=False
+        )
+        self._spec_thread = t
+        t.start()
+
+    def _speculate_worker(self) -> None:
+        try:
+            args, cap, (abs_args, abs_kwargs) = self._spec_ctx
+            ceiling = int(args["max_objects"])
+            from tmlibrary_tpu.capacity import (
+                likely_next_rungs,
+                observed_peak,
+                resolve_bucket_ladder,
+            )
+
+            ladder = resolve_bucket_ladder(
+                ceiling, args.get("object_buckets", "auto")
+            )
+            observed = None
+            if len(ladder) > 1:
+                observed = observed_peak(
+                    self._routing_key(args, ceiling, ladder)
+                )
+            targets = list(likely_next_rungs(cap, ladder, observed=observed))
+            from tmlibrary_tpu.tuning import tuned_object_capacity
+
+            hint = tuned_object_capacity()
+            if hint and hint in ladder and hint > cap \
+                    and hint not in targets:
+                targets.append(int(hint))
+            if not targets:
+                return
+            from tmlibrary_tpu import perf
+            from tmlibrary_tpu import qc as qc_mod
+            from tmlibrary_tpu.jterator.pipeline import cached_batch_fn
+
+            desc = self._description(args)
+            for rung in targets:
+                if not threading.main_thread().is_alive():
+                    return  # shutting down: don't start another compile
+                # the process-level cache, NOT self._pipeline: tracing a
+                # new rung takes seconds and must not hold the instance
+                # compile lock a concurrent escalation launch needs
+                fn = cached_batch_fn(
+                    desc, int(rung), self._window,
+                    donate=None if args.get("donate_buffers", True)
+                    else False,
+                    reduction_strategy=args.get("reduction_strategy",
+                                                "auto"),
+                    qc=qc_mod.enabled(),
+                )
+                outcome = perf.speculate_compile(fn, abs_args, abs_kwargs)
+                if outcome in ("compiled", "imported"):
+                    logger.info(
+                        "compile-ahead: capacity rung %d %s in the "
+                        "background", rung, outcome,
+                    )
+        except Exception:
+            logger.debug("compile-ahead speculation failed", exc_info=True)
 
     def _persist(self, batch: dict, result, capacity: int | None = None) -> dict:
         """Fetch one launched batch's device results and write them out."""
