@@ -240,6 +240,24 @@ def _ledger_fields(pdepth: "int | None", max_objects: "int | None" = None) -> di
         out["reduction_strategy"] = strat
         if strat == "fused":
             out["timing_methodology"] += "+strategy=fused"
+    # records self-describe the resolved work-aware scheduling mode the
+    # same way: a packed capture dispatches a different batch plan than a
+    # directory-order one.  The methodology only grows a +schedule=
+    # suffix when the mode was EXPLICITLY requested (env/cli/config/
+    # tuning — the sweep grid sets TMX_SCHEDULE per mode), so default
+    # runs keep matching their historic unsuffixed families while
+    # sweep-grid rows split into per-mode classes
+    try:
+        from tmlibrary_tpu.workflow.schedule import resolve_schedule
+
+        mode, source = resolve_schedule()
+    except Exception:
+        mode, source = None, None
+    if mode:
+        out["schedule"] = mode
+        out["schedule_source"] = source
+        if source != "default":
+            out["timing_methodology"] += f"+schedule={mode}"
     return out
 
 
@@ -540,6 +558,29 @@ def measure_sweep() -> None:
     else:
         capacities = [max_objects]
 
+    # the work-aware scheduling axis: off by default so historic grids
+    # stay comparable — BENCH_SWEEP_SCHEDULE=1 puts packed-vs-unpacked
+    # dispatch on the grid (a comma list picks exact modes).  The mode
+    # rides TMX_SCHEDULE during each cell so every dispatch-plane
+    # consumer resolves it exactly like production, and the winning mode
+    # lands as the tuned best_schedule verdict.
+    env_sched = os.environ.get("BENCH_SWEEP_SCHEDULE")
+    if env_sched:
+        if env_sched.strip().lower() in ("1", "true", "auto", "on"):
+            schedule_modes: "list[str | None]" = ["off", "pack"]
+        else:
+            schedule_modes = [
+                m.strip() for m in env_sched.split(",") if m.strip()
+            ]
+        for m in schedule_modes:
+            if m not in ("off", "pack"):
+                raise SystemExit(
+                    f"unknown schedule mode '{m}' (choose from off, pack)"
+                )
+    else:
+        schedule_modes = [None]
+    prev_sched = os.environ.get("TMX_SCHEDULE")
+
     knobs = dict(
         size=size, batch=batch, max_objects=max_objects,
         sites=int(os.environ.get("BENCH_SITES", "96")),
@@ -563,46 +604,60 @@ def measure_sweep() -> None:
             try:
                 wl.fetch(wl.launch())  # compile + warm outside the clock
                 for depth in depths:
-                    best = float("inf")
-                    for _ in range(reps):
-                        ex = PipelinedExecutor(
-                            _SweepStep(wl), depth=depth, depth_source="sweep"
-                        )
-                        t0 = time.perf_counter()
-                        for _ in ex.run(
-                            [{"index": i} for i in range(n_exec)]
-                        ):
-                            pass
-                        best = min(best, time.perf_counter() - t0)
-                    value = n_exec * wl.n_items / best
-                    row = {
-                        "strategy": label,
-                        "pipeline_depth": depth,
-                        "capacity": cap,
-                        "items_per_sec": round(value, 3),
-                        "best_s": round(best, 4),
-                    }
-                    if not strategy_invariant:
-                        # on-chip working-set estimate for this
-                        # (strategy, capacity) cell, so a rung's VMEM
-                        # pressure reads next to its throughput
-                        from tmlibrary_tpu.ops.fused_measure import (
-                            vmem_bytes_estimate,
-                        )
+                    for mode in schedule_modes:
+                        if mode is not None:
+                            os.environ["TMX_SCHEDULE"] = mode
+                        best = float("inf")
+                        for _ in range(reps):
+                            ex = PipelinedExecutor(
+                                _SweepStep(wl), depth=depth,
+                                depth_source="sweep",
+                            )
+                            t0 = time.perf_counter()
+                            for _ in ex.run(
+                                [{"index": i} for i in range(n_exec)]
+                            ):
+                                pass
+                            best = min(best, time.perf_counter() - t0)
+                        value = n_exec * wl.n_items / best
+                        row = {
+                            "strategy": label,
+                            "pipeline_depth": depth,
+                            "capacity": cap,
+                            "items_per_sec": round(value, 3),
+                            "best_s": round(best, 4),
+                        }
+                        if mode is not None:
+                            row["schedule"] = mode
+                        if not strategy_invariant:
+                            # on-chip working-set estimate for this
+                            # (strategy, capacity) cell, so a rung's VMEM
+                            # pressure reads next to its throughput
+                            from tmlibrary_tpu.ops.fused_measure import (
+                                vmem_bytes_estimate,
+                            )
 
-                        row["vmem_bytes_estimate"] = vmem_bytes_estimate(
-                            cap, strategy=label
+                            row["vmem_bytes_estimate"] = vmem_bytes_estimate(
+                                cap, strategy=label
+                            )
+                        if strategy_invariant:
+                            row["strategy_invariant"] = True
+                        rows.append(row)
+                        _mirror_gauge(
+                            "tmx_bench_sweep_cell_items_per_sec", value,
+                            backend=backend, config=config, strategy=label,
+                            depth=str(depth), capacity=str(cap),
+                            **({"schedule": mode} if mode else {}),
                         )
-                    if strategy_invariant:
-                        row["strategy_invariant"] = True
-                    rows.append(row)
-                    _mirror_gauge(
-                        "tmx_bench_sweep_cell_items_per_sec", value,
-                        backend=backend, config=config, strategy=label,
-                        depth=str(depth), capacity=str(cap),
-                    )
             finally:
                 wl.close()
+    if env_sched:
+        # restore the ambient request: the grid's last cell must not
+        # leak its mode into this process's emitted-record provenance
+        if prev_sched is None:
+            os.environ.pop("TMX_SCHEDULE", None)
+        else:
+            os.environ["TMX_SCHEDULE"] = prev_sched
 
     best_row = max(rows, key=lambda r: r["items_per_sec"])
     base_row = min(
@@ -632,6 +687,11 @@ def measure_sweep() -> None:
         "best_capacity": (
             best_row["capacity"] if len(capacities) > 1 else None
         ),
+        # None when the schedule axis wasn't swept — a one-mode grid is
+        # no evidence about packing, so no tuned verdict
+        "best_schedule": (
+            best_row.get("schedule") if len(schedule_modes) > 1 else None
+        ),
         "capacities": capacities,
         "best_items_per_sec": best_row["items_per_sec"],
         "n_exec": n_exec,
@@ -645,6 +705,10 @@ def measure_sweep() -> None:
             + (
                 "" if strategy_invariant
                 else f", strategies={'+'.join(strategies)}"
+            )
+            + (
+                f", schedule={'+'.join(schedule_modes)}"
+                if len(schedule_modes) > 1 else ""
             )
         ),
         "swept_at": swept_at,
@@ -686,6 +750,7 @@ def measure_sweep() -> None:
         "best_strategy": entry["best_strategy"],
         "best_pipeline": entry["best_pipeline"],
         "best_capacity": entry["best_capacity"],
+        "best_schedule": entry["best_schedule"],
         "rows": rows,
         "tuning_json": tuning_mod.tuning_json_path(),
         **_ledger_fields(best_row["pipeline_depth"], max_objects),
@@ -1808,14 +1873,24 @@ def measure_workflow(size: int) -> None:
         stage_s: dict[str, float] = {}
         counts = {"nuclei": 0, "cells": 0}
         mosaic_shape = n_levels = None
+        occ_vals: list[float] = []
+        skew_vals: list[float] = []
+        sched_plan = None
         for ev in wf.ledger.events():
             if ev.get("event") == "step_done":
                 stage_s[ev["step"]] = round(ev["elapsed"], 3)
+            if (ev.get("event") == "schedule_plan"
+                    and ev.get("step") == "jterator"):
+                sched_plan = ev
             if ev.get("event") == "batch_done":
                 res = ev.get("result") or {}
                 if ev.get("step") == "jterator":
                     for name, n in (res.get("objects") or {}).items():
                         counts[name] = counts.get(name, 0) + int(n)
+                    if isinstance(res.get("slot_occupancy"), (int, float)):
+                        occ_vals.append(float(res["slot_occupancy"]))
+                    if isinstance(res.get("straggler_skew_s"), (int, float)):
+                        skew_vals.append(float(res["straggler_skew_s"]))
                 if ev.get("step") == "illuminati" and "mosaic_shape" in res:
                     mosaic_shape = tuple(res["mosaic_shape"])
                     n_levels = int(res["n_levels"])
@@ -1909,6 +1984,23 @@ def measure_workflow(size: int) -> None:
         "warm_time_to_first_batch_s": (None if ttfb_warm is None
                                        else round(ttfb_warm, 3)),
         "aot_store": _aotstore_provenance(),
+        # dispatch-plan provenance: what the work-model scheduler
+        # delivered on the timed run (mean batch slot occupancy, worst
+        # per-batch straggler skew) and which plan it ran under — the
+        # packed-vs-unpacked comparison key for the recapture pass
+        "slot_occupancy": (
+            round(sum(occ_vals) / len(occ_vals), 4) if occ_vals else None
+        ),
+        "straggler_skew_s": (
+            round(max(skew_vals), 6) if skew_vals else None
+        ),
+        "schedule_plan": (
+            {k: sched_plan.get(k) for k in
+             ("plan_digest", "mode", "source", "n_batches",
+              "pred_occupancy_packed", "pred_occupancy_unpacked",
+              "pred_skew_packed", "pred_skew_unpacked")}
+            if sched_plan else None
+        ),
         # depth 1 is the sequential engine path — record it as
         # host-synchronous, same as the pre-executor bench did
         **_ledger_fields(pdepth if pdepth > 1 else None, max_objects),

@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""CI schedule smoke: work-aware packing wins without changing results.
+
+    python scripts/ci_schedule_smoke.py [ARTIFACT_DIR] [WORKDIR]
+
+``tests/test_schedule.py`` proves the planner contracts inside pytest;
+this harness drives the REAL workflow surface on a forced-CPU 8-device
+mesh: the SAME skewed synthetic experiment (dense sites leading the
+directory order — the worst case for contiguous batching) submits
+twice in one process, ``--schedule off`` first, then ``--schedule
+auto``.  The off run feeds the planner's EWMA cost model, so the auto
+run packs from real history.  The gate:
+
+- features and labels bit-identical across the two runs,
+- strictly HIGHER mean slot occupancy with packing on,
+- strictly LOWER simulated straggler skew (the per-shard object-count
+  spread the ledger records — deterministic on CPU, unlike wall time),
+- ZERO new compiled signatures: the packed run's (padded batch, rung)
+  set is a subset of the unpacked run's, and the process-wide pipeline
+  program cache does not grow.
+
+The recorded packing plan and the occupancy/skew comparison land in
+ARTIFACT_DIR for upload.  Exit 0 and ``SCHEDULE PASS`` on success; 1
+otherwise.
+"""
+import json
+import os
+import shutil
+import sys
+import tempfile
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+# deterministic tallies: no AOT import/export, no background compiles
+os.environ.setdefault("TMX_AOT_STORE", "0")
+os.environ.setdefault("TMX_AOT_SPECULATE", "0")
+os.environ.pop("TMX_SCHEDULE", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import yaml  # noqa: E402
+
+from ci_metrics_snapshot import PIPE_YAML, run  # noqa: E402
+
+N_DEVICES = 8
+BATCH_SIZE = 16
+#: sparse stays at ~5 objects (below the first rung) but dense enough
+#: that otsu sees a real foreground class — a near-empty site drives the
+#: threshold into the noise floor, where raw component counts explode
+#: past the small rung and clip_label_count truncates before min_area
+#: filtering can run (capacity-dependent results, the thing this smoke
+#: exists to forbid)
+DENSE_BLOBS, SPARSE_BLOBS = 12, 5
+
+
+def synth_skewed_source(src: Path) -> None:
+    """8 wells x 4 sites, 64x64: within every well, sites 0-1 are dense
+    (~12 objects) and sites 2-3 sparse (~5) — so the directory-order
+    batches mix densities and the plain contiguous shard split is
+    maximally lumpy."""
+    import cv2
+
+    rng = np.random.default_rng(23)
+    yy, xx = np.mgrid[0:64, 0:64]
+    # 4x4 grid of well-separated cell centers: dense sites draw 12 of
+    # them (objects stay distinct — merged blobs would flatten the
+    # density contrast the smoke depends on), sparse sites draw 2
+    grid = [(8 + 16 * gy, 8 + 16 * gx) for gy in range(4)
+            for gx in range(4)]
+    wells = [f"{row}{col:02d}" for row in "AB" for col in range(1, 5)]
+    for well in wells:
+        for site in range(4):
+            n_blobs = DENSE_BLOBS if site < 2 else SPARSE_BLOBS
+            img = rng.normal(300, 20, (64, 64))
+            cells = rng.permutation(len(grid))[:n_blobs]
+            for cell in cells:
+                cy, cx = grid[cell]
+                cy = cy + rng.integers(-2, 3)
+                cx = cx + rng.integers(-2, 3)
+                img += 4000 * np.exp(
+                    -((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * 2.0**2)
+                )
+            cv2.imwrite(str(src / f"{well}_s{site}_DAPI.png"),
+                        np.clip(img, 0, 65535).astype(np.uint16))
+
+
+def submit(work: Path, src: Path, root: Path, pipe: Path,
+           schedule: str) -> None:
+    from tmlibrary_tpu.workflow.engine import WorkflowDescription
+
+    run(["create", "--root", root, "--name", f"ci_sched_{schedule}"])
+    desc = work / f"workflow_{schedule}.yaml"
+    WorkflowDescription.canonical({
+        "metaconfig": {"source_dir": str(src)},
+        "imextract": {},
+        "corilla": {"chunk_size": 8, "n_devices": 1},
+        "jterator": {"pipe": str(pipe), "batch_size": BATCH_SIZE,
+                     "max_objects": 64, "n_devices": N_DEVICES,
+                     "schedule": schedule},
+    }).save(desc)
+    run(["workflow", "submit", "--root", root, "--description", desc,
+         "--pipeline-depth", "4"])
+
+
+def jt_events(root: Path) -> list[dict]:
+    from tmlibrary_tpu.models.store import ExperimentStore
+    from tmlibrary_tpu.workflow.engine import RunLedger
+
+    store = ExperimentStore.open(root)
+    return RunLedger(store.workflow_dir / "ledger.jsonl").events()
+
+
+def batch_stats(events: list[dict]) -> dict:
+    """Occupancy / simulated-skew / compile-signature aggregates from
+    the jterator ``batch_done`` stream."""
+    occ, spreads, signatures = [], [], set()
+    for e in events:
+        if e.get("event") != "batch_done" or e.get("step") != "jterator":
+            continue
+        res = e.get("result") or {}
+        occ.append(float(res.get("slot_occupancy", 0.0)))
+        shard = res.get("shard_objects") or []
+        if shard:
+            spreads.append(float(max(shard) - min(shard)))
+        n = int(res.get("n_sites", 0))
+        padded = -(-n // N_DEVICES) * N_DEVICES
+        cap = int(res.get("bucket_capacity", 0))
+        signatures.add((padded, cap))
+        # an escalated batch also ran (and compiled) the rungs it walked
+        # through below the final one
+        ladder = (8, 16, 32, 64)
+        walked = int(res.get("bucket_escalations", 0))
+        idx = ladder.index(cap) if cap in ladder else len(ladder) - 1
+        for back in range(1, walked + 1):
+            if idx - back >= 0:
+                signatures.add((padded, ladder[idx - back]))
+    return {
+        "n_batches": len(occ),
+        "mean_slot_occupancy": round(float(np.mean(occ)), 4) if occ else 0.0,
+        "mean_shard_object_spread": (
+            round(float(np.mean(spreads)), 3) if spreads else None),
+        "signatures": sorted(signatures),
+    }
+
+
+def main() -> int:
+    out_dir = Path(sys.argv[1] if len(sys.argv) > 1
+                   else "/tmp/schedule-smoke")
+    out_dir.mkdir(parents=True, exist_ok=True)
+    work = Path(sys.argv[2]) if len(sys.argv) > 2 else Path(
+        tempfile.mkdtemp(prefix="tmx-ci-schedule-")
+    )
+    work.mkdir(parents=True, exist_ok=True)
+    src = work / "microscope"
+    src.mkdir(exist_ok=True)
+    synth_skewed_source(src)
+    pipe = work / "nuclei.pipe.yaml"
+    spec = json.loads(json.dumps(PIPE_YAML))
+    spec["description"] = "ci schedule smoke — smooth, segment, measure"
+    pipe.write_text(yaml.safe_dump(spec))
+
+    from tmlibrary_tpu.jterator.pipeline import _BATCH_FN_CACHE
+    from tmlibrary_tpu.models.store import ExperimentStore
+
+    root_off = work / "exp_off"
+    root_auto = work / "exp_auto"
+
+    # run 1: packing off — the reference AND the cost-model feed (the
+    # routing key is description-derived, so the second root sees the
+    # history this run accumulates in process)
+    submit(work, src, root_off, pipe, "off")
+    programs_after_off = set(_BATCH_FN_CACHE)
+
+    # run 2: auto — resolves to packing, plans from the EWMA history
+    submit(work, src, root_auto, pipe, "auto")
+    programs_after_auto = set(_BATCH_FN_CACHE)
+
+    ev_off, ev_auto = jt_events(root_off), jt_events(root_auto)
+    stats_off, stats_auto = batch_stats(ev_off), batch_stats(ev_auto)
+    plans_off = [e for e in ev_off if e.get("event") == "schedule_plan"]
+    plans_auto = [e for e in ev_auto if e.get("event") == "schedule_plan"]
+
+    store_off = ExperimentStore.open(root_off)
+    store_auto = ExperimentStore.open(root_auto)
+    plan_file = store_auto.workflow_dir / "jterator" / "schedule_plan.json"
+    if plan_file.exists():
+        shutil.copy(plan_file, out_dir / "schedule_plan.json")
+    comparison = {
+        "off": stats_off, "auto": stats_auto,
+        "plan_events": plans_auto,
+        "program_cache_growth": sorted(
+            str(k) for k in (programs_after_auto - programs_after_off)),
+    }
+    (out_dir / "schedule_occupancy.json").write_text(
+        json.dumps(comparison, indent=2, default=str))
+
+    failures = []
+    if plans_off:
+        failures.append(f"off run recorded a plan: {plans_off}")
+    if len(plans_auto) != 1 or plans_auto[0].get("mode") != "pack":
+        failures.append(f"auto run did not pack: {plans_auto}")
+    if not stats_auto["mean_slot_occupancy"] > stats_off["mean_slot_occupancy"]:
+        failures.append(
+            "packed occupancy not higher: "
+            f"{stats_auto['mean_slot_occupancy']} vs "
+            f"{stats_off['mean_slot_occupancy']}")
+    if (stats_off["mean_shard_object_spread"] is None
+            or stats_auto["mean_shard_object_spread"] is None
+            or not stats_auto["mean_shard_object_spread"]
+            < stats_off["mean_shard_object_spread"]):
+        failures.append(
+            "packed shard spread not lower: "
+            f"{stats_auto['mean_shard_object_spread']} vs "
+            f"{stats_off['mean_shard_object_spread']}")
+    extra_sigs = set(map(tuple, stats_auto["signatures"])) - \
+        set(map(tuple, stats_off["signatures"]))
+    if extra_sigs:
+        failures.append(f"packed run minted new signatures: {extra_sigs}")
+    if programs_after_auto - programs_after_off:
+        failures.append(
+            "packed run compiled new pipeline programs: "
+            f"{comparison['program_cache_growth']}")
+
+    labels_off = store_off.read_labels(None, "nuclei")
+    labels_auto = store_auto.read_labels(None, "nuclei")
+    if not np.array_equal(labels_off, labels_auto):
+        failures.append("label stacks diverged between off and auto")
+    import pandas as pd
+
+    def feats(store):
+        frames = []
+        fdir = Path(store.root) / "features" / "nuclei"
+        for shard in sorted(fdir.glob("*.parquet")):
+            frames.append(pd.read_parquet(shard))
+        df = pd.concat(frames, ignore_index=True)
+        return df.sort_values(
+            ["site_index", "label"]).reset_index(drop=True)
+
+    f_off, f_auto = feats(store_off), feats(store_auto)
+    try:
+        pd.testing.assert_frame_equal(f_off, f_auto)
+    except AssertionError as exc:
+        failures.append(f"feature tables diverged: {exc}")
+
+    if failures:
+        for f in failures:
+            print(f"SCHEDULE FAIL: {f}", file=sys.stderr)
+        return 1
+    print(
+        "SCHEDULE PASS: bit-identical outputs, occupancy "
+        f"{stats_off['mean_slot_occupancy']} -> "
+        f"{stats_auto['mean_slot_occupancy']}, shard spread "
+        f"{stats_off['mean_shard_object_spread']} -> "
+        f"{stats_auto['mean_shard_object_spread']}, zero new compiles"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -275,6 +275,41 @@ def perf_section() -> list[str]:
     return out
 
 
+def schedule_section() -> list[str]:
+    from tmlibrary_tpu.workflow import schedule
+
+    out = ["## Work-aware site scheduling (`workflow/schedule.py`)", "",
+           (inspect.getdoc(schedule) or "").split("\n")[0],
+           "",
+           "Per-site object counts (harvested from prior runs' feature "
+           "shards, refined by a live EWMA over every completed batch) "
+           "feed a deterministic packing plan: sites sorted by "
+           "predicted work into rung-homogeneous batches (the same "
+           "batch-size multiset directory order produces, so no new "
+           "compiled signatures), each batch's sites permuted so every "
+           "device shard carries near-equal predicted work.  The plan "
+           "is recorded as a `schedule_plan` ledger event + side file "
+           "so `--resume` re-derives identical batch boundaries.  Knobs "
+           "(precedence order): `--schedule pack|off|auto`, "
+           "`TMX_SCHEDULE`, install config `schedule`, the swept "
+           "TUNING.json `schedule` verdict, default packing on.  "
+           "Surfaced by the PACK row in `tmx top`, the packing table "
+           "in `tmx perf`, and the `tmx_schedule_*` / "
+           "`tmx_device_predicted_work` series (DESIGN.md §29).",
+           "",
+           "| symbol | role |", "|---|---|"]
+    for name in sorted(n for n in dir(schedule) if not n.startswith("_")):
+        obj = getattr(schedule, name)
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        if getattr(obj, "__module__", "") != schedule.__name__:
+            continue
+        doc = (inspect.getdoc(obj) or "").split("\n")[0]
+        out.append(f"| `schedule.{name}` | {doc} |")
+    out.append("")
+    return out
+
+
 def aotstore_section() -> list[str]:
     from tmlibrary_tpu import aotstore
 
@@ -456,6 +491,7 @@ def main() -> None:
         *top_section(),
         *qc_section(),
         *perf_section(),
+        *schedule_section(),
         *aotstore_section(),
         *resilience_section(),
         *serve_section(),

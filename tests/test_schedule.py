@@ -1,0 +1,410 @@
+"""Work-aware site scheduling (``workflow/schedule.py`` + the dispatch
+plane that consumes it).
+
+Three layers of guarantees:
+
+- The plan as a pure function: mode resolution precedence (cli > env >
+  config > tuning verdict > default), the EWMA cost predictor, LPT shard
+  balancing, and packing determinism — the same history snapshot always
+  yields the same plan digest.
+- The bit-identity contract that makes packing safe to enable: per-site
+  labels and features are byte-identical with scheduling on vs off,
+  through the pipelined executor at depth > 1, with no new compiled
+  signatures (the packed run's batch-size multiset and routed rung set
+  are both subsets of the unpacked run's).
+- Durability: the recorded ``schedule_plan`` ledger event + plan side
+  file make a mid-run kill + ``--resume`` converge on bit-identical
+  batch boundaries (matching plan digests across both attempts).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from test_pipelined import (  # noqa: F401 — fixture re-export
+    _read_features_sorted,
+    _run_prep_steps,
+    spatial_store,
+)
+from test_workflow import (  # noqa: F401 — fixture re-export
+    make_description,
+    source_dir,
+    store,
+    synth_site_image,
+)
+
+from tmlibrary_tpu.capacity import (
+    note_site_counts,
+    seed_site_counts,
+    select_capacity,
+    site_count_snapshot,
+)
+from tmlibrary_tpu.parallel.mesh import balanced_shard_order
+from tmlibrary_tpu.workflow import schedule
+from tmlibrary_tpu.workflow.engine import Workflow
+from tmlibrary_tpu.workflow.pipelined import PipelinedExecutor
+from tmlibrary_tpu.workflow.registry import get_step
+
+
+@pytest.fixture(autouse=True)
+def _isolate_schedule(tmp_path, monkeypatch):
+    """Mode resolution must come from the knobs each test pins — not the
+    repo's TUNING.json, the ambient env, or the install config."""
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tmp_path / "no_tuning.json"))
+    for var in ("TMX_SCHEDULE", "TM_SCHEDULE", "TMX_OBJECT_BUCKETS",
+                "TMX_SCHEDULE_EWMA"):
+        monkeypatch.delenv(var, raising=False)
+
+
+# -------------------------------------------------------- mode resolution
+def test_resolve_schedule_precedence(tmp_path, monkeypatch):
+    # default: packing on, attributed to "default"
+    assert schedule.resolve_schedule() == ("pack", "default")
+    assert schedule.resolve_schedule("auto") == ("pack", "default")
+    # tuning verdict (lowest non-default rung)
+    tuning = tmp_path / "TUNING.json"
+    tuning.write_text(json.dumps({
+        "backend": "cpu",
+        "written_by": "scripts/tune_tpu.py write_results",
+        "schedule": {"cpu": "off"},
+    }))
+    monkeypatch.setenv("TMX_TUNING_JSON", str(tuning))
+    assert schedule.resolve_schedule() == ("off", "tuning")
+    # install config beats tuning
+    monkeypatch.setenv("TM_SCHEDULE", "pack")
+    assert schedule.resolve_schedule() == ("pack", "config")
+    # env beats config
+    monkeypatch.setenv("TMX_SCHEDULE", "off")
+    assert schedule.resolve_schedule() == ("off", "env")
+    # explicit beats everything; spelling aliases normalize
+    assert schedule.resolve_schedule("pack") == ("pack", "cli")
+    assert schedule.resolve_schedule("on") == ("pack", "cli")
+    assert schedule.resolve_schedule("none") == ("off", "cli")
+
+
+def test_resolve_schedule_rejects_unknown(monkeypatch):
+    with pytest.raises(ValueError):
+        schedule.resolve_schedule("sideways")
+    monkeypatch.setenv("TMX_SCHEDULE", "banana")
+    with pytest.raises(ValueError):
+        schedule.resolve_schedule()
+
+
+def test_schedule_enabled():
+    assert schedule.schedule_enabled("pack")
+    assert schedule.schedule_enabled("auto")
+    assert not schedule.schedule_enabled("off")
+    assert not schedule.schedule_enabled("0")
+
+
+def test_tuned_schedule_loader(tmp_path, monkeypatch):
+    from tmlibrary_tpu.tuning import tuned_schedule
+
+    path = tmp_path / "TUNING.json"
+    path.write_text(json.dumps({
+        "backend": "cpu",
+        "written_by": "scripts/tune_tpu.py write_results",
+        "schedule": {"cpu": "pack", "tpu": "off"},
+    }))
+    monkeypatch.setenv("TMX_TUNING_JSON", str(path))
+    # backend scoped: one backend's verdict never sets another's default
+    assert tuned_schedule("cpu") == "pack"
+    assert tuned_schedule("tpu") == "off"
+    assert tuned_schedule("gpu") is None
+    # provenance gate: no written_by -> no verdict
+    path.write_text(json.dumps({"backend": "cpu",
+                                "schedule": {"cpu": "pack"}}))
+    assert tuned_schedule("cpu") is None
+    # malformed values degrade to None, never raise
+    path.write_text(json.dumps({
+        "backend": "cpu", "written_by": "x",
+        "schedule": {"cpu": "fastest-please"},
+    }))
+    assert tuned_schedule("cpu") is None
+
+
+# --------------------------------------------------------------- predictor
+def test_predictor_ewma_and_cold_prior():
+    key = "test-predictor-key"
+    assert site_count_snapshot(key) == {}
+    # unseen sites fall back to the caller's prior
+    assert schedule.predict_site_counts(key, [0, 1], 7.0) == [7.0, 7.0]
+    # first observation seeds directly (no decay toward zero)
+    note_site_counts(key, {0: 10.0})
+    assert schedule.predict_site_counts(key, [0, 1], 7.0) == [10.0, 7.0]
+    # later observations blend at the EWMA alpha (default 0.5)
+    note_site_counts(key, {0: 20.0, 1: 4.0})
+    assert schedule.predict_site_counts(key, [0, 1], 7.0) == [15.0, 4.0]
+    # harvest seeding never overwrites live EWMA state
+    assert seed_site_counts(key, {0: 999, 2: 3}) == 1
+    assert schedule.predict_site_counts(key, [0, 2], 7.0) == [15.0, 3.0]
+
+
+def test_contiguous_shard_work_matches_plain_split():
+    w = [5.0, 1.0, 1.0, 1.0, 1.0, 3.0]
+    assert schedule.contiguous_shard_work(w, 2) == [7.0, 5.0]
+    # short tail: trailing shards may carry zero sites (padding lanes)
+    assert schedule.contiguous_shard_work(w, 4) == [6.0, 2.0, 4.0, 0.0]
+    assert schedule.contiguous_shard_work(w, 1) == [12.0]
+
+
+def test_balanced_shard_order_reduces_skew():
+    items = list(range(6))
+    weights = [10.0, 9.0, 1.0, 1.0, 1.0, 2.0]
+    permuted, loads = balanced_shard_order(items, weights, 2)
+    # a permutation, never a re-composition
+    assert sorted(permuted) == items
+    assert sum(loads) == sum(weights)
+    naive = schedule.contiguous_shard_work(weights, 2)
+    assert max(loads) - min(loads) < max(naive) - min(naive)
+    # the permuted contiguous split delivers exactly the claimed loads
+    by_item = dict(zip(items, weights))
+    chunk = -(-len(permuted) // 2)
+    for s in range(2):
+        got = sum(by_item[i] for i in permuted[s * chunk:(s + 1) * chunk])
+        assert got == pytest.approx(loads[s])
+    # single shard / single item short-circuit untouched
+    assert balanced_shard_order(items, weights, 1) == (items, [sum(weights)])
+
+
+# ----------------------------------------------------------------- packing
+def _toy_plan(predicted, **kw):
+    sites = list(range(len(predicted)))
+    kw.setdefault("batch_size", 4)
+    kw.setdefault("ladder", (8, 16, 32, 64))
+    kw.setdefault("n_devices", 2)
+    kw.setdefault("seed", "digest-a")
+    return schedule.pack_plan(sites, predicted, **kw)
+
+
+def test_pack_plan_deterministic():
+    predicted = [30.0, 2.0, 3.0, 2.0, 28.0, 1.0, 2.0, 2.0, 5.0, 4.0]
+    a = _toy_plan(predicted)
+    b = _toy_plan(predicted)
+    assert a == b
+    assert a["digest"] == b["digest"]
+    # the digest is the content: any input change moves it
+    assert _toy_plan(predicted, seed="digest-b")["digest"] != a["digest"]
+    assert _toy_plan(predicted[:-1])["digest"] != a["digest"]
+
+
+def test_pack_plan_preserves_batch_size_multiset_and_rungs():
+    predicted = [30.0, 2.0, 3.0, 2.0, 28.0, 1.0, 2.0, 2.0, 5.0, 4.0]
+    plan = _toy_plan(predicted)
+    sizes = sorted(len(b["sites"]) for b in plan["batches"])
+    # 10 sites / batch 4 -> the directory-order multiset {4, 4, 2}
+    assert sizes == [2, 4, 4]
+    covered = sorted(s for b in plan["batches"] for s in b["sites"])
+    assert covered == list(range(10))
+    # each batch's rung is the strict-inequality pick for its own peak
+    by_site = dict(enumerate(predicted))
+    for b in plan["batches"]:
+        peak = max(by_site[s] for s in b["sites"])
+        assert b["rung"] == select_capacity(int(np.ceil(peak)), (8, 16, 32, 64))
+    # the two dense sites pack together: one big-rung batch, two small
+    rungs = sorted(b["rung"] for b in plan["batches"])
+    assert rungs == [8, 8, 32]
+
+
+def test_plan_event_predicts_occupancy_and_skew_wins():
+    predicted = [30.0, 2.0, 3.0, 2.0, 28.0, 1.0, 2.0, 2.0, 5.0, 4.0]
+    plan = _toy_plan(predicted)
+    ev = schedule.plan_event(plan)
+    assert ev["plan_digest"] == plan["digest"]
+    assert ev["n_batches"] == 3 and ev["n_sites"] == 10
+    assert ev["rungs"] == {"8": 2, "32": 1}
+    # packing's whole point, stated by the plan itself
+    assert ev["pred_occupancy_packed"] > ev["pred_occupancy_unpacked"]
+    assert ev["pred_skew_packed"] <= ev["pred_skew_unpacked"]
+
+
+def test_plan_file_roundtrip(tmp_path):
+    path = tmp_path / "schedule_plan.json"
+    plan = _toy_plan([3.0, 2.0, 1.0, 4.0, 5.0])
+    schedule.write_plan(path, plan)
+    assert schedule.load_plan(path) == plan
+    # None removes; a missing/torn file degrades to "no plan"
+    schedule.write_plan(path, None)
+    assert not path.exists()
+    assert schedule.load_plan(path) is None
+    path.write_text("{not json")
+    assert schedule.load_plan(path) is None
+
+
+# ------------------------------------------------ cold start: no plan
+def test_cold_start_degenerates_to_directory_order(source_dir, store):
+    """No per-site history and no routing-key peak: the planner must not
+    guess — batches stay directory-order partitions with classic
+    ladder[0]-and-escalate routing (a guessed rung would mint compiles
+    the unpacked run never pays)."""
+    desc = make_description(source_dir, store)
+    _run_prep_steps(desc, store)
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    jt = get_step("jterator")(store)
+    jt.init({**jd.args, "batch_size": 2, "schedule": "pack"})
+    batches = [jt.load_batch(i) for i in jt.list_batches()]
+    assert [b["sites"] for b in batches] == \
+        [[2 * i, 2 * i + 1] for i in range(8)]
+    assert all("schedule" not in b for b in batches)
+    assert jt.schedule_plan_info() is None
+    assert not (jt.step_dir / "schedule_plan.json").exists()
+
+
+# -------------------------------------- bit-identity + zero new compiles
+def test_packing_bit_identical_and_no_new_compiles(source_dir, store):
+    """With history, packing reorders batches — but per-site labels and
+    features stay byte-identical to the unpacked run, through the
+    pipelined executor at depths 1 and 4, and the packed run introduces
+    no new compiled signatures (same batch-size multiset, routed rung
+    set a subset of the unpacked run's)."""
+    import pandas.testing
+
+    from tmlibrary_tpu.jterator.pipeline import _BATCH_FN_CACHE
+
+    desc = make_description(source_dir, store)
+    _run_prep_steps(desc, store)
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    # batch_size 3 over 16 sites: a ragged tail batch, so the multiset
+    # contract covers the partial-batch shape too
+    args = {**jd.args, "batch_size": 3, "schedule": "off"}
+
+    jt = get_step("jterator")(store)
+    jt.init(args)
+    summaries = [jt.run(j) for j in jt.list_batches()]
+    caps_off = {s["bucket_capacity"] for s in summaries}
+    ref_labels = store.read_labels(None, "nuclei").copy()
+    ref_feats = _read_features_sorted(store, "nuclei")
+    compiled_before = set(_BATCH_FN_CACHE)
+    # the unpacked run's persists fed the EWMA predictor; the harvest
+    # path reads the same truth back from the persisted shards
+    harvested = schedule.harvest_store_counts(store)
+    assert set(harvested) == set(range(16))
+    assert all(n > 0 for n in harvested.values())
+
+    for depth in (1, 4):
+        jt2 = get_step("jterator")(store)
+        jt2.init({**args, "schedule": "pack"})
+        batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+        # the plan engaged: every batch carries its slice of the plan
+        assert all(b.get("schedule", {}).get("rung") for b in batches)
+        digests = {b["schedule"]["plan_digest"] for b in batches}
+        assert len(digests) == 1
+        assert sorted(len(b["sites"]) for b in batches) == \
+            sorted([3] * 5 + [1])
+        assert sorted(s for b in batches for s in b["sites"]) == \
+            list(range(16))
+        info = jt2.schedule_plan_info()
+        assert info and info["plan_digest"] == digests.pop()
+        assert info["mode"] == "pack" and info["source"] == "cli"
+
+        out = list(PipelinedExecutor(jt2, depth=depth).run(batches))
+        caps_pack = {r["bucket_capacity"] for _, r in out}
+        assert caps_pack <= caps_off, (caps_pack, caps_off)
+        assert all(r.get("schedule_rung") for _, r in out)
+        assert all("bucket_escalations" not in r for _, r in out)
+        assert np.array_equal(store.read_labels(None, "nuclei"),
+                              ref_labels), f"labels diverged: depth {depth}"
+        pandas.testing.assert_frame_equal(
+            _read_features_sorted(store, "nuclei"), ref_feats
+        )
+    # zero-new-compiles: the packed runs added no pipeline programs
+    assert set(_BATCH_FN_CACHE) == compiled_before
+
+
+def test_spatial_layout_ignores_packing(spatial_store, monkeypatch):
+    """The spatial layout's sharding unit is the well mosaic — there is
+    nothing to pack, and the env knob must not perturb it."""
+    import pandas.testing
+
+    st = spatial_store
+    args = {"layout": "spatial", "n_devices": 8}
+    monkeypatch.setenv("TMX_SCHEDULE", "off")
+    jt = get_step("jterator")(st)
+    jt.init(args)
+    for j in jt.list_batches():
+        jt.run(j)
+    ref_labels = st.read_labels(None, "mosaic_cells").copy()
+    ref_feats = _read_features_sorted(st, "mosaic_cells")
+    assert ref_labels.max() > 0
+
+    monkeypatch.setenv("TMX_SCHEDULE", "pack")
+    jt2 = get_step("jterator")(st)
+    jt2.init(args)
+    batches = [jt2.load_batch(i) for i in jt2.list_batches()]
+    assert all("schedule" not in b for b in batches)
+    assert jt2.schedule_plan_info() is None
+    out = list(PipelinedExecutor(jt2, depth=2).run(batches))
+    assert len(out) == 2
+    assert np.array_equal(st.read_labels(None, "mosaic_cells"), ref_labels)
+    pandas.testing.assert_frame_equal(
+        _read_features_sorted(st, "mosaic_cells"), ref_feats
+    )
+
+
+# ------------------------------------------------- kill + resume converge
+def test_resume_converges_on_recorded_plan(source_dir, store):
+    """A mid-run kill leaves the ledger prefix + the plan side file; the
+    resume re-appends the SAME ``schedule_plan`` event (bit-identical
+    digest — batch boundaries re-derive from the recorded plan, not from
+    a fresh prediction over drifted history) and converges to the
+    unpacked reference bit-exactly."""
+    import pandas.testing
+
+    desc = make_description(source_dir, store)
+    jd = next(s for stage in desc.stages for s in stage.steps
+              if s.name == "jterator")
+    jd.args["batch_size"] = 2
+    jd.args["schedule"] = "off"
+
+    # run 0 (packing off): the reference outputs AND the history the
+    # planner will harvest
+    wf0 = Workflow(store, desc, pipeline_depth=2)
+    wf0.run()
+    assert not any(e.get("event") == "schedule_plan"
+                   for e in wf0.ledger.events())
+    ref_labels = store.read_labels(None, "nuclei").copy()
+    ref_feats = _read_features_sorted(store, "nuclei")
+
+    # run 1 (packing on): plans from history, then "dies" after three
+    # jterator batches — simulated by truncating the ledger to the
+    # durable prefix a kill would leave (outputs persist idempotently,
+    # so replayed batches must rewrite identical bytes)
+    jd.args["schedule"] = "pack"
+    wf1 = Workflow(store, desc, pipeline_depth=2)
+    wf1.run()
+    plans = [e for e in wf1.ledger.events()
+             if e.get("event") == "schedule_plan"]
+    assert len(plans) == 1 and plans[0]["mode"] == "pack"
+    lines = wf1.ledger.path.read_text().splitlines()
+    cut, seen = None, 0
+    for i, raw in enumerate(lines):
+        e = json.loads(raw)
+        if e.get("event") == "batch_done" and e.get("step") == "jterator":
+            seen += 1
+            if seen == 4:
+                cut = i
+                break
+    assert cut is not None, "expected at least 4 jterator batches"
+    wf1.ledger.path.write_text("\n".join(lines[:cut]) + "\n")
+
+    wf2 = Workflow(store, desc, pipeline_depth=2)
+    summary = wf2.run(resume=True)
+    assert summary["jterator"]["n_batches"] == 8
+    events = wf2.ledger.events()
+    plans = [e for e in events if e.get("event") == "schedule_plan"]
+    assert len(plans) == 2
+    assert plans[0]["plan_digest"] == plans[1]["plan_digest"]
+    assert {p["mode"] for p in plans} == {"pack"}
+    assert wf2.ledger.completed_batches("jterator") == set(range(8))
+    done = [e for e in events if e.get("event") == "batch_done"
+            and e.get("step") == "jterator"]
+    for e in done:
+        res = e.get("result") or {}
+        assert res.get("schedule_rung") == res.get("bucket_capacity")
+    assert np.array_equal(store.read_labels(None, "nuclei"), ref_labels)
+    pandas.testing.assert_frame_equal(
+        _read_features_sorted(store, "nuclei"), ref_feats
+    )

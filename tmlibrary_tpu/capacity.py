@@ -176,6 +176,25 @@ def ceiling_slots(slots: int, cap: int, ceiling: int) -> int:
 _ROUTING_LOCK = threading.Lock()
 _ROUTING_HISTORY: dict[str, int] = {}
 
+#: per-site observed-count EWMA, scoped by the same routing key — the
+#: work-aware scheduler's cost model (workflow/schedule.py) consumes it
+#: to pack rung-homogeneous batches; fed from the identical persist-side
+#: stream note_observed_peak already rides
+_SITE_HISTORY: dict[str, dict[int, float]] = {}
+
+#: EWMA smoothing for per-site counts: high enough that one completed
+#: run dominates stale history, low enough that a single noisy batch
+#: does not whipsaw the packing plan (TMX_SCHEDULE_EWMA overrides)
+DEFAULT_SITE_EWMA_ALPHA = 0.5
+
+
+def _site_ewma_alpha() -> float:
+    try:
+        return float(os.environ.get("TMX_SCHEDULE_EWMA",
+                                    DEFAULT_SITE_EWMA_ALPHA))
+    except ValueError:
+        return DEFAULT_SITE_EWMA_ALPHA
+
 
 def routing_key(description_key: str, ceiling: int,
                 ladder: tuple[int, ...]) -> str:
@@ -213,7 +232,54 @@ def routing_history_snapshot() -> dict[str, int]:
         return dict(_ROUTING_HISTORY)
 
 
+def note_site_counts(key: str, counts: "dict[int, float]",
+                     alpha: "float | None" = None) -> None:
+    """EWMA-merge one completed batch's per-site observed object counts
+    into ``key``'s site history (persist workers call this concurrently
+    with the scheduler's plan-time reads, same discipline as
+    :func:`note_observed_peak`).  First observation of a site seeds the
+    EWMA directly."""
+    if not counts:
+        return
+    a = _site_ewma_alpha() if alpha is None else float(alpha)
+    a = min(1.0, max(0.0, a))
+    with _ROUTING_LOCK:
+        table = _SITE_HISTORY.setdefault(key, {})
+        for site, count in counts.items():
+            site = int(site)
+            prior = table.get(site)
+            value = float(count)
+            table[site] = value if prior is None else (
+                a * value + (1.0 - a) * prior
+            )
+
+
+def seed_site_counts(key: str, counts: "dict[int, float]") -> int:
+    """Fill ``key``'s site history from persisted prior-run evidence
+    (feature shards harvested before ``delete_previous_output`` wipes
+    them) WITHOUT disturbing live EWMA state — only sites with no entry
+    yet are seeded.  Returns the number of sites newly seeded."""
+    seeded = 0
+    with _ROUTING_LOCK:
+        table = _SITE_HISTORY.setdefault(key, {})
+        for site, count in counts.items():
+            site = int(site)
+            if site not in table:
+                table[site] = float(count)
+                seeded += 1
+    return seeded
+
+
+def site_count_snapshot(key: str) -> "dict[int, float]":
+    """Copy of ``key``'s per-site EWMA table — the scheduler's plan is a
+    pure function of this snapshot plus the site list (determinism
+    contract, tests/test_schedule.py)."""
+    with _ROUTING_LOCK:
+        return dict(_SITE_HISTORY.get(key, {}))
+
+
 def reset_routing_history() -> None:
     """Drop all routing history (tests, fresh benchmarking runs)."""
     with _ROUTING_LOCK:
         _ROUTING_HISTORY.clear()
+        _SITE_HISTORY.clear()

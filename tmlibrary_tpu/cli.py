@@ -394,6 +394,18 @@ def build_parser() -> argparse.ArgumentParser:
              "(default: TMX_OBJECT_BUCKETS / TM_OBJECT_BUCKETS config, "
              "else auto)",
     )
+    shared.add_argument(
+        "--schedule", default=None, metavar="MODE",
+        choices=("auto", "pack", "off"),
+        help="work-aware site scheduling for the jterator step "
+             "(workflow/schedule.py): 'pack' predicts per-site object "
+             "counts from prior-run history, packs rung-homogeneous "
+             "batches and balances per-device shard work (bit-identical "
+             "per-site results, higher slot occupancy, lower straggler "
+             "skew), 'off' keeps directory-order batching, 'auto' "
+             "follows TMX_SCHEDULE / TM_SCHEDULE config, else the "
+             "provenance-gated tuning/TUNING.json verdict, else pack",
+    )
     # fault-tolerance knobs (resilience.py; defaults from LibraryConfig /
     # TM_RETRY_ATTEMPTS, TM_MAX_BATCH_FAILURES, ... env)
     shared.add_argument(
@@ -1050,6 +1062,18 @@ def cmd_workflow(args) -> int:
             _os.environ.pop("TMX_OBJECT_BUCKETS", None)
         else:
             _os.environ["TMX_OBJECT_BUCKETS"] = args.object_buckets
+    if getattr(args, "schedule", None):
+        import os as _os
+
+        # same env pattern as --object-buckets: the scheduler resolves
+        # its mode at init/create_batches time (workflow/schedule.py
+        # precedence: explicit > env > config > tuning > default), so
+        # the request must outlive this function; "auto" clears any
+        # stale explicit request so the chain falls through
+        if args.schedule == "auto":
+            _os.environ.pop("TMX_SCHEDULE", None)
+        else:
+            _os.environ["TMX_SCHEDULE"] = args.schedule
     if getattr(args, "qc", None) is not None:
         import os as _os
 
@@ -2163,6 +2187,77 @@ def _snapshot_gauge(snapshot: dict, name: str) -> "float | None":
     return None
 
 
+def _snapshot_counter(snapshot: dict, name: str) -> float:
+    total = 0.0
+    for entry in snapshot.get("counters", []):
+        if entry.get("name") == name:
+            total += float(entry.get("value") or 0)
+    return total
+
+
+def _perf_schedule_summary(events: list) -> list:
+    """Per-step packing readout from the ledger alone: the recorded
+    ``schedule_plan`` provenance (digest, predicted occupancy/skew for
+    packed vs the directory-order counterfactual) joined with what the
+    run actually delivered (mean ``batch_done`` slot occupancy, mean
+    actual shard-work spread from ``shard_objects``, plan hit rate from
+    escalation-free planned batches)."""
+    plans: dict[str, dict] = {}
+    actual: dict[str, dict] = {}
+    for ev in events:
+        step = str(ev.get("step", "")) or "unknown"
+        if ev.get("event") == "schedule_plan":
+            plans[step] = ev  # last plan wins (resume re-appends the same)
+        if ev.get("event") != "batch_done":
+            continue
+        res = ev.get("result")
+        if not isinstance(res, dict):
+            continue
+        agg = actual.setdefault(step, {
+            "occ": [], "spread": [], "pred_skew": [],
+            "planned": 0, "hits": 0,
+        })
+        if isinstance(res.get("slot_occupancy"), (int, float)):
+            agg["occ"].append(float(res["slot_occupancy"]))
+        shard = res.get("shard_objects")
+        if isinstance(shard, list) and len(shard) > 1:
+            vals = [float(v) for v in shard]
+            agg["spread"].append(max(vals) - min(vals))
+        if isinstance(res.get("predicted_skew"), (int, float)):
+            agg["pred_skew"].append(float(res["predicted_skew"]))
+        if res.get("schedule_rung"):
+            agg["planned"] += 1
+            if not res.get("bucket_escalations"):
+                agg["hits"] += 1
+    out = []
+    mean = lambda xs: round(sum(xs) / len(xs), 4) if xs else None  # noqa: E731
+    for step in sorted(set(plans) | set(actual)):
+        plan = plans.get(step, {})
+        agg = actual.get(step, {})
+        if not plan and not agg.get("planned"):
+            continue
+        out.append({
+            "step": step,
+            "mode": plan.get("mode"),
+            "source": plan.get("source"),
+            "plan_digest": plan.get("plan_digest"),
+            "n_batches": plan.get("n_batches"),
+            "pred_occupancy_packed": plan.get("pred_occupancy_packed"),
+            "pred_occupancy_unpacked": plan.get("pred_occupancy_unpacked"),
+            "pred_skew_packed": plan.get("pred_skew_packed"),
+            "pred_skew_unpacked": plan.get("pred_skew_unpacked"),
+            "mean_slot_occupancy": mean(agg.get("occ", [])),
+            "mean_shard_object_spread": mean(agg.get("spread", [])),
+            "mean_predicted_skew": mean(agg.get("pred_skew", [])),
+            "planned_batches": agg.get("planned", 0),
+            "plan_hit_rate": (
+                round(agg["hits"] / agg["planned"], 4)
+                if agg.get("planned") else None
+            ),
+        })
+    return out
+
+
 def _perf_strategy_comparison(programs: list) -> list:
     """Group program profiles by (program, step, capacity) and keep the
     groups recorded under two or more reduction strategies — the
@@ -2277,6 +2372,7 @@ def cmd_perf(args) -> int:
     avoided = _snapshot_gauge(snapshot,
                               "tmx_jterator_padded_flops_avoided_frac")
     occupancy = _snapshot_gauge(snapshot, "tmx_jterator_slot_occupancy")
+    schedule_rows = _perf_schedule_summary(events)
 
     history = tuning.load_bench_history()
     measured = [r for r in history
@@ -2293,6 +2389,7 @@ def cmd_perf(args) -> int:
             "phases": phases_out,
             "padded_flops_avoided_frac": avoided,
             "slot_occupancy": occupancy,
+            "schedule": schedule_rows,
             "latest_bench": latest,
         }, indent=2))
         return 0
@@ -2354,6 +2451,33 @@ def cmd_perf(args) -> int:
     if avoided is not None:
         occ = f" (slot occupancy {occupancy:.2f})" if occupancy else ""
         print(f"padded-FLOPs-avoided: {avoided:.1%}{occ}")
+    if schedule_rows:
+        print()
+        print("schedule packing (workflow/schedule.py — predicted vs "
+              "delivered):")
+        print(f"{'step':<10} {'mode':<5} {'plan':<16} {'batches':>7} "
+              f"{'occ':>6} {'occ-unpacked':>12} {'skew':>8} "
+              f"{'skew-unpacked':>13} {'hit-rate':>8}")
+        fmt = lambda v, spec=".2f": (  # noqa: E731
+            format(float(v), spec) if isinstance(v, (int, float)) else "-"
+        )
+        for row in schedule_rows:
+            occ_actual = (row["mean_slot_occupancy"]
+                          if row["mean_slot_occupancy"] is not None
+                          else row["pred_occupancy_packed"])
+            skew_actual = (row["mean_shard_object_spread"]
+                           if row["mean_shard_object_spread"] is not None
+                           else row["pred_skew_packed"])
+            print(
+                f"{row['step']:<10} {str(row['mode'] or '-'):<5} "
+                f"{str(row['plan_digest'] or '-'):<16} "
+                f"{str(row['n_batches'] or row['planned_batches']):>7} "
+                f"{fmt(occ_actual):>6} "
+                f"{fmt(row['pred_occupancy_unpacked']):>12} "
+                f"{fmt(skew_actual, '.1f'):>8} "
+                f"{fmt(row['pred_skew_unpacked'], '.1f'):>13} "
+                f"{fmt(row['plan_hit_rate']):>8}"
+            )
     if latest:
         print(f"latest bench: {latest.get('metric')} = {latest.get('value')}"
               f" ({latest.get('backend')})"

@@ -186,6 +186,15 @@ class LibraryConfig:
     reduction_strategy: str = dataclasses.field(
         default_factory=lambda: _setting("reduction_strategy", "auto")
     )
+    #: work-aware site scheduling mode for the jterator dispatch plane
+    #: ("auto" | "pack" | "off"); "auto" falls through to the tuned
+    #: TUNING.json verdict, then packing on (workflow/schedule.py
+    #: documents the full resolution order — the TMX_SCHEDULE env set by
+    #: the CLI --schedule knob beats this setting).  Packing is
+    #: bit-identical per site; the knob is purely a performance decision
+    schedule: str = dataclasses.field(
+        default_factory=lambda: _setting("schedule", "auto")
+    )
     #: donate raw-image/stats buffers to engine-built batch programs so
     #: XLA reuses their device memory for outputs
     donate_buffers: bool = dataclasses.field(

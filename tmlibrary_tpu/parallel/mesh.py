@@ -43,6 +43,42 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
+def balanced_shard_order(
+    items: "list", weights: "list[float]", n_shards: int,
+) -> "tuple[list, list[float]]":
+    """Permute ``items`` so the contiguous equal-size chunks that
+    :func:`batch_sharding` slices off the leading axis carry near-equal
+    total ``weights`` (greedy LPT over the shard loads).
+
+    The workflow layer pads a batch to a multiple of the mesh size by
+    appending dummy lanes at the END, so the last shard's capacity is
+    reduced by the pad it will absorb.  Deterministic: ties break on the
+    original item order, never on dict/hash order.  Returns the permuted
+    items and the per-shard predicted loads (padding lanes count zero).
+    """
+    n = len(items)
+    n_shards = max(1, int(n_shards))
+    if n_shards == 1 or n <= 1:
+        return list(items), [float(sum(weights))] if items else [0.0]
+    chunk = -(-n // n_shards)  # ceil: the post-padding per-shard width
+    # padding lanes fill from the END of the leading axis, so trailing
+    # shards lose capacity to the pad they will absorb (possibly whole
+    # shards, when n < (n_shards - 1) * chunk)
+    capacity = [max(0, min(chunk, n - s * chunk)) for s in range(n_shards)]
+    shards: list[list[int]] = [[] for _ in range(n_shards)]
+    loads = [0.0] * n_shards
+    order = sorted(range(n), key=lambda i: (-float(weights[i]), i))
+    for i in order:
+        best = min(
+            (s for s in range(n_shards) if len(shards[s]) < capacity[s]),
+            key=lambda s: (loads[s], s),
+        )
+        shards[best].append(i)
+        loads[best] += float(weights[i])
+    permuted = [items[i] for s in shards for i in s]
+    return permuted, loads
+
+
 def shard_batch(array, mesh: Mesh, axis: str = "sites"):
     """Place a host (B, ...) array onto the mesh, sharded on the leading
     axis.  B must divide evenly by the mesh size (pad upstream — batch

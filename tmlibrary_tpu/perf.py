@@ -739,6 +739,12 @@ def _methodology_class(rec: dict) -> str:
         cls = "pipelined+bucketed" if "bucketed" in m else "pipelined"
         if "strategy=fused" in m:
             cls += "+fused"
+        # work-aware site scheduling changes the dispatch plan (packed
+        # rung-homogeneous batches vs directory order) — a packed capture
+        # is a different experiment from an unpacked one
+        sched = re.search(r"schedule=([a-z]+)", m)
+        if sched:
+            cls += f"+schedule={sched.group(1)}"
         model = re.search(r"model=([0-9a-f]+)", m)
         if model:
             cls += f"+model={model.group(1)}"

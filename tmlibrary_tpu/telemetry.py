@@ -503,7 +503,8 @@ def straggler_threshold(slowest: float) -> float:
 
 
 def record_device_times(times: list[tuple[str, float]], step: str = "",
-                        batch: Any = None) -> float:
+                        batch: Any = None,
+                        predicted: "list[float] | None" = None) -> float:
     """Feed per-device batch wall times into the labeled registry series
     and return the straggler skew (max − min over devices).
 
@@ -511,10 +512,16 @@ def record_device_times(times: list[tuple[str, float]], step: str = "",
     (plus a ``_hist`` histogram so p50/p95 survive the last-write gauge)
     and ``tmx_straggler_skew_seconds{host=,step=}``; bumps
     ``tmx_stragglers_total`` when the skew clears
-    :func:`straggler_threshold`.  The *ledger* ``straggler`` event is the
-    caller's job (the engine appends it on its own thread from the batch
-    summary) — this function only touches the thread-safe registry, so
-    it is safe from executor worker threads.
+    :func:`straggler_threshold`.  When the scheduler's ``predicted``
+    per-shard work rides along (same order as ``times``), each device's
+    prediction is published as
+    ``tmx_device_predicted_work{device=,host=,step=}`` plus a predicted
+    skew gauge — the pair lets the anomaly plane tell data skew
+    (predicted AND actual both skewed) from a slow device (actual only).
+    The *ledger* ``straggler`` event is the caller's job (the engine
+    appends it on its own thread from the batch summary) — this function
+    only touches the thread-safe registry, so it is safe from executor
+    worker threads.
     """
     if not enabled() or not times:
         return 0.0
@@ -523,12 +530,22 @@ def record_device_times(times: list[tuple[str, float]], step: str = "",
     step = step or "unknown"
     vals = [float(t) for _, t in times]
     skew = max(vals) - min(vals)
-    for dev, t in times:
+    pred = None
+    if predicted is not None and len(predicted) == len(times):
+        pred = [float(p) for p in predicted]
+    for i, (dev, t) in enumerate(times):
         reg.gauge("tmx_device_batch_seconds", device=str(dev), host=h,
                   step=step).set(float(t))
         reg.histogram("tmx_device_batch_seconds_hist", device=str(dev),
                       host=h, step=step).observe(float(t))
+        if pred is not None:
+            reg.gauge("tmx_device_predicted_work", device=str(dev), host=h,
+                      step=step).set(pred[i])
     reg.gauge("tmx_straggler_skew_seconds", host=h, step=step).set(skew)
+    if pred is not None:
+        reg.gauge("tmx_predicted_work_skew", host=h, step=step).set(
+            max(pred) - min(pred)
+        )
     if skew > straggler_threshold(max(vals)):
         reg.counter("tmx_stragglers_total", host=h, step=step).inc()
     return skew

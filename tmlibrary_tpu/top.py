@@ -195,6 +195,22 @@ def render_dashboard(view: dict, width: int = 80) -> str:
                 + (f"  routed {int(routed)}" if routed else "")
             )
 
+        # ---- PACK row: the work-model scheduler (workflow/schedule.py)
+        # — how many batches ran under a plan, how often the planned
+        # capacity rung held without an escalation re-launch, and the
+        # predicted-work skew the shard balancer left behind
+        planned = _counter_sum(merged, "tmx_schedule_batches_total")
+        if planned:
+            hits = _counter_sum(merged, "tmx_schedule_plan_hit_total")
+            rate = hits / planned if planned else 0.0
+            line = (f"pack: planned {int(planned)} batch(es)  rung hits "
+                    f"{int(hits)} [{_bar(rate, 16)}] {rate * 100:.0f}%")
+            pskew = _gauges(merged, "tmx_predicted_work_skew")
+            if pskew:
+                line += (f"  predicted skew "
+                         f"{pskew[0].get('value', 0.0):.1f} objects")
+            lines.append(line)
+
         # ---- per-device utilization bars: each device's last batch wall
         # time relative to the slowest device (1.0 == the straggler)
         dev = _gauges(merged, "tmx_device_batch_seconds")

@@ -195,6 +195,36 @@ def tuned_object_capacity(backend: str | None = None) -> int | None:
     return None
 
 
+_SCHEDULE_MODES = ("pack", "off")
+
+
+def tuned_schedule(backend: str | None = None) -> str | None:
+    """The swept work-aware scheduling verdict for ``backend``
+    (``"pack"`` | ``"off"``), or None.  ``bench.py --sweep`` records the
+    winner (``best_schedule``) when ``BENCH_SWEEP_SCHEDULE`` puts the
+    packing axis on the grid; the jterator dispatch plane consumes it
+    through ``workflow.schedule.resolve_schedule``'s precedence chain.
+    Same provenance and backend-scoping rules as
+    :func:`tuned_reduction_strategy` — a verdict measured on one backend
+    never sets another's default, and malformed values degrade to None
+    (the default: packing on)."""
+    tuning = load_tuning()
+    if not tuning:
+        return None
+    if backend is None:
+        import jax
+
+        backend = jax.default_backend()
+    entry = tuning.get("schedule")
+    if isinstance(entry, dict):
+        value = entry.get(backend)
+    elif isinstance(entry, str) and tuning.get("backend") == backend:
+        value = entry
+    else:
+        value = None
+    return value if value in _SCHEDULE_MODES else None
+
+
 _ANALYTICS_INDEX_MODES = ("ivf", "brute")
 
 
@@ -266,6 +296,13 @@ def record_config_sweep(config: str, entry: dict) -> dict:
             caps = {}
         caps[backend] = capacity
         data["object_capacity"] = caps
+    sched = entry.get("best_schedule")
+    if backend and sched in _SCHEDULE_MODES:
+        verdict = data.get("schedule")
+        if not isinstance(verdict, dict):
+            verdict = {}
+        verdict[backend] = sched
+        data["schedule"] = verdict
     index_mode = entry.get("best_index")
     if backend and index_mode in _ANALYTICS_INDEX_MODES:
         idx = data.get("analytics_index")

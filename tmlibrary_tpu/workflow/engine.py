@@ -835,9 +835,18 @@ class Workflow:
         slowest = max(float(t) for t in times.values())
         if float(skew) <= telemetry.straggler_threshold(slowest):
             return
+        extra = {}
+        # scheduler's predicted per-shard work rides the same event so
+        # the anomaly plane (canary.py) can tell data skew — predicted
+        # AND actual both skewed — from a slow device (actual only)
+        if result.get("predicted_shard_work"):
+            extra["predicted_shard_work"] = [
+                float(w) for w in result["predicted_shard_work"]
+            ]
+            extra["predicted_skew"] = float(result.get("predicted_skew", 0.0))
         self.ledger.append(
             step=step_name, event="straggler", batch=batch_index,
-            skew_s=float(skew), device_wall_times=times,
+            skew_s=float(skew), device_wall_times=times, **extra,
         )
 
     def _note_qc(self, step_name: str, batch_index, result) -> int:
@@ -1015,6 +1024,22 @@ class Workflow:
                 quarantined = set()
                 self.ledger.append(step=sd.name, event="init_done",
                                    n_batches=len(batches))
+            # durable schedule-plan provenance: whenever the step planned
+            # its batches with the work-model scheduler, the plan digest
+            # (and its predicted occupancy/skew deltas) lands in the
+            # ledger — on --resume the same event re-appends from the
+            # plan side file, so convergence is auditable from the
+            # ledger alone (bit-identical digests across attempts)
+            plan_info = getattr(step, "schedule_plan_info", None)
+            if callable(plan_info):
+                try:
+                    info = plan_info()
+                except Exception:
+                    info = None
+                if info:
+                    self.ledger.append(
+                        step=sd.name, event="schedule_plan", **info
+                    )
             pending = [b for b in batches if b["index"] not in done]
             # quarantined batches first: the most suspect work re-runs at
             # the start of the resume, while everything else still follows

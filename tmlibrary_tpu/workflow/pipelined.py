@@ -54,6 +54,7 @@ from __future__ import annotations
 import collections
 import concurrent.futures
 import contextlib
+import inspect
 import logging
 import time
 from typing import Any, Callable, Iterable, Iterator
@@ -389,7 +390,23 @@ class PipelinedExecutor:
                     if self.warm_hook is not None and not self._warmed:
                         self._warmed = True
                         try:
-                            self.warm_hook()
+                            # plan-aware warming: a hook that takes a
+                            # parameter gets the un-launched tail, so
+                            # schedule-planned rungs warm as certainties
+                            # rather than ladder guesses; zero-arg hooks
+                            # keep their existing contract
+                            try:
+                                takes_upcoming = bool(
+                                    inspect.signature(
+                                        self.warm_hook
+                                    ).parameters
+                                )
+                            except (TypeError, ValueError):
+                                takes_upcoming = False
+                            if takes_upcoming:
+                                self.warm_hook(batches[i + 1:])
+                            else:
+                                self.warm_hook()
                         except Exception:
                             logger.debug("warm hook failed", exc_info=True)
                 except Exception:

@@ -167,6 +167,16 @@ class ImageAnalysisRunner(Step):
                       "capacities, e.g. '8,32'. Results are bit-identical "
                       "across bucket choices — routing is purely a "
                       "performance decision"),
+        Argument("schedule", str, default="auto",
+                 choices=("auto", "pack", "off"),
+                 help="work-aware site scheduling (workflow/schedule.py): "
+                      "'pack' plans cost-model batches (rung-homogeneous "
+                      "packing + straggler-balanced shard order) from the "
+                      "per-site count history; 'off' keeps directory-order "
+                      "batching; 'auto' follows TMX_SCHEDULE / config / "
+                      "the tuned verdict, then packs. Results are "
+                      "bit-identical per site either way — scheduling is "
+                      "purely a performance decision"),
         Argument("reduction_strategy", str, default="auto",
                  choices=("auto", "onehot", "sort", "scatter", "fused"),
                  help="grouped-reduction strategy for the measurement "
@@ -239,9 +249,115 @@ class ImageAnalysisRunner(Step):
             raise ValueError("--pipe is required for --layout sites")
         sites = list(range(self.store.n_sites))
         batch_size = args["batch_size"] or self._auto_batch_size()
+        plan = self._schedule_plan(args, sites, batch_size)
+        if plan is not None:
+            from tmlibrary_tpu.workflow import schedule as schedule_mod
+
+            schedule_mod.write_plan(self._schedule_plan_path, plan)
+            return [
+                {
+                    "sites": b["sites"],
+                    "schedule": {
+                        "rung": b["rung"],
+                        "predicted": b["predicted"],
+                        "shard_work": b["shard_work"],
+                        "shard_work_naive": b["shard_work_naive"],
+                        "plan_digest": plan["digest"],
+                    },
+                }
+                for b in plan["batches"]
+            ]
         return [
             {"sites": part} for part in create_partitions(sites, batch_size)
         ]
+
+    def init(self, args=None):
+        """Harvest the PREVIOUS run's persisted per-site object counts
+        into the scheduler's cost model before ``delete_previous_output``
+        wipes the feature shards they live in — the predictor's seed for
+        a fresh process planning over a previously-analyzed experiment."""
+        resolved = self.batch_args.resolve(args)
+        if resolved.get("layout", "sites") == "sites" and resolved.get("pipe"):
+            self._seed_schedule_history(resolved)
+        return super().init(args)
+
+    def _seed_schedule_history(self, args) -> None:
+        from tmlibrary_tpu.workflow import schedule as schedule_mod
+
+        try:
+            mode, _ = schedule_mod.resolve_schedule(args.get("schedule"))
+            if not schedule_mod.schedule_enabled(mode):
+                return
+            counts = schedule_mod.harvest_store_counts(self.store)
+            if not counts:
+                return
+            from tmlibrary_tpu.capacity import (
+                resolve_bucket_ladder,
+                seed_site_counts,
+            )
+
+            ceiling = int(args["max_objects"])
+            ladder = resolve_bucket_ladder(
+                ceiling, args.get("object_buckets", "auto")
+            )
+            seeded = seed_site_counts(
+                self._routing_key(args, ceiling, ladder), counts
+            )
+            if seeded:
+                logger.info(
+                    "schedule: seeded %d site cost(s) from persisted "
+                    "feature shards", seeded,
+                )
+        except Exception:
+            # the cost model is a performance input, never a planning
+            # dependency — a broken harvest degrades to the prior
+            logger.debug("schedule history harvest failed", exc_info=True)
+
+    def _schedule_plan(self, args, sites: list, batch_size: int):
+        """The work-model packing plan for a sites-layout run, or None
+        when scheduling is off (or the run is too small to pack)."""
+        from tmlibrary_tpu.workflow import schedule as schedule_mod
+
+        mode, source = schedule_mod.resolve_schedule(args.get("schedule"))
+        if not schedule_mod.schedule_enabled(mode) or len(sites) <= 1:
+            schedule_mod.write_plan(self._schedule_plan_path, None)
+            return None
+        import jax
+
+        from tmlibrary_tpu.capacity import (
+            observed_peak,
+            resolve_bucket_ladder,
+        )
+        from tmlibrary_tpu.jterator.pipeline import description_digest
+
+        ceiling = int(args["max_objects"])
+        ladder = resolve_bucket_ladder(
+            ceiling, args.get("object_buckets", "auto")
+        )
+        key = self._routing_key(args, ceiling, ladder)
+        from tmlibrary_tpu.capacity import site_count_snapshot
+
+        table = site_count_snapshot(key)
+        peak = observed_peak(key)
+        if not table and peak is None:
+            # true cold start: no per-site history AND no program-family
+            # peak.  A uniform prediction cannot beat directory order,
+            # and pinning a guessed rung would mint compiles the
+            # unpacked run never pays — degenerate to no plan (classic
+            # ladder[0]-and-escalate routing) until history exists.
+            schedule_mod.write_plan(self._schedule_plan_path, None)
+            return None
+        # prior for sites with no history: the routing-key peak when one
+        # exists, else the densest harvested site (conservative)
+        prior = float(peak) if peak is not None else float(max(table.values()))
+        predicted = schedule_mod.predict_site_counts(key, sites, prior)
+        n_dev = args["n_devices"] or len(jax.devices())
+        n_dev = min(int(n_dev), len(jax.devices()))
+        return schedule_mod.pack_plan(
+            sites, predicted, batch_size, ladder, n_dev,
+            seed=description_digest(self._description(args)),
+            mode=mode, source=source,
+        )
 
     @staticmethod
     def _auto_batch_size() -> int:
@@ -375,6 +491,13 @@ class ImageAnalysisRunner(Step):
         )
         if len(ladder) == 1:
             return ceiling
+        # a packed batch routes to its PLANNED rung: the whole point of
+        # rung-homogeneous packing is that a sparse batch stops paying
+        # for the global peak.  Under-prediction only costs the existing
+        # escalation re-launch (_persist), never a wrong result.
+        planned = (batch.get("schedule") or {}).get("rung")
+        if planned and int(planned) in ladder:
+            return int(planned)
         from tmlibrary_tpu.capacity import observed_peak
 
         observed = observed_peak(self._routing_key(args, ceiling, ladder))
@@ -417,6 +540,62 @@ class ImageAnalysisRunner(Step):
             ceiling, args.get("object_buckets", "auto")
         )
         note_observed_peak(self._routing_key(args, ceiling, ladder), peak)
+
+    def _note_site_costs(self, args, sites, site_counts) -> None:
+        """Feed one batch's per-site peak object counts into the work
+        model's EWMA history (persist-worker side, same stream as
+        :meth:`_note_peak`).  Fed unconditionally — a schedule-off run
+        still builds the history a later packed run predicts from."""
+        try:
+            from tmlibrary_tpu.capacity import (
+                note_site_counts,
+                resolve_bucket_ladder,
+            )
+
+            ceiling = int(args["max_objects"])
+            ladder = resolve_bucket_ladder(
+                ceiling, args.get("object_buckets", "auto")
+            )
+            note_site_counts(
+                self._routing_key(args, ceiling, ladder),
+                {int(s): float(c) for s, c in zip(sites, site_counts)},
+            )
+        except Exception:
+            logger.debug("site-cost history update failed", exc_info=True)
+
+    def _shard_objects(self, args, site_counts) -> "list[int] | None":
+        """Actual per-shard object totals under the leading-axis slicing
+        :meth:`_load_inputs` applies (ceil-width chunks; padding lanes
+        are appended at the END and their recomputed objects are dropped
+        on export, so they count zero here).  None on a 1-device mesh —
+        there is no skew to report."""
+        try:
+            import jax
+
+            n_dev = int(args["n_devices"] or len(jax.devices()))
+            n_dev = min(n_dev, len(jax.devices()))
+        except Exception:
+            return None
+        n = len(site_counts)
+        if n_dev <= 1 or n == 0:
+            return None
+        chunk = -(-n // n_dev)
+        arr = np.asarray(site_counts)
+        return [
+            int(arr[s * chunk:(s + 1) * chunk].sum()) for s in range(n_dev)
+        ]
+
+    def _note_schedule(self, escalations: int) -> None:
+        """Plan-accounting counters: batches dispatched under a schedule
+        plan, and plan hits (the planned rung held without an escalation
+        re-launch) — the prediction-quality signal ``tmx top``'s PACK
+        row and ``tmx perf`` read."""
+        if not telemetry.enabled():
+            return
+        reg = telemetry.get_registry()
+        reg.counter("tmx_schedule_batches_total").inc()
+        if not escalations:
+            reg.counter("tmx_schedule_plan_hit_total").inc()
 
     def run_batch(self, batch: dict) -> dict:
         self._mark_work_start()
@@ -507,6 +686,14 @@ class ImageAnalysisRunner(Step):
         # meta travels alongside the device arrays so block_batch can stamp
         # per-device completion times against the true dispatch instant
         meta = {"t0": time.perf_counter(), "index": batch.get("index")}
+        plan = batch.get("schedule") or {}
+        if plan.get("shard_work"):
+            # predicted per-shard work rides to the telemetry/ledger
+            # surfaces so the anomaly plane can tell data skew (predicted
+            # AND actual both skewed) from a slow device (actual only)
+            meta["predicted_shard_work"] = [
+                float(w) for w in plan["shard_work"]
+            ]
         return batch, (
             "sites",
             (self._launch(batch, prefetched, capacity=cap), cap, meta),
@@ -525,7 +712,8 @@ class ImageAnalysisRunner(Step):
                 if len(times) > 1:
                     meta["device_times"] = times
                     meta["skew"] = telemetry.record_device_times(
-                        times, step=self.name, batch=meta.get("index")
+                        times, step=self.name, batch=meta.get("index"),
+                        predicted=meta.get("predicted_shard_work"),
                     )
             # SiteResult is a registered pytree: block on all leaves
             jax.block_until_ready(payload[0])
@@ -552,6 +740,10 @@ class ImageAnalysisRunner(Step):
                 d: round(float(t), 6) for d, t in meta["device_times"]
             }
             out["straggler_skew_s"] = round(float(meta.get("skew", 0.0)), 6)
+        if meta and meta.get("predicted_shard_work"):
+            pred = [round(float(w), 3) for w in meta["predicted_shard_work"]]
+            out["predicted_shard_work"] = pred
+            out["predicted_skew"] = round(max(pred) - min(pred), 3)
         return out
 
     # ------------------------------------------------------------ spatial run
@@ -1128,14 +1320,19 @@ class ImageAnalysisRunner(Step):
         except Exception:
             pass
 
-    def speculate_ahead(self) -> None:
+    def speculate_ahead(self, upcoming=None) -> None:
         """Compile-ahead speculation (DESIGN.md §28): precompile the
         likely next capacity rungs on a background daemon thread while
         the device chews on dispatched batches, so bucket escalation
         (and the TUNING.json-hinted rung) never pays compile on the
         critical path.  Wired as the pipelined executor's warm hook;
         no-op when disabled, before the first dispatch, or while a
-        previous warm thread is still running."""
+        previous warm thread is still running.
+
+        ``upcoming`` (optional) is the not-yet-launched tail of the
+        batch list: when batches carry a schedule plan, their planned
+        rungs are certainties, not guesses, so the worker warms those
+        first and falls back to the ladder heuristics after."""
         try:
             from tmlibrary_tpu import aotstore
 
@@ -1148,6 +1345,7 @@ class ImageAnalysisRunner(Step):
         prev = getattr(self, "_spec_thread", None)
         if prev is not None and prev.is_alive():
             return
+        self._spec_upcoming = list(upcoming) if upcoming else []
         # NOT a daemon thread: the interpreter tearing down while XLA
         # is mid-compile aborts the whole process (C++ terminate), so
         # exit must join an in-flight speculative compile.  The worker
@@ -1184,6 +1382,16 @@ class ImageAnalysisRunner(Step):
             if hint and hint in ladder and hint > cap \
                     and hint not in targets:
                 targets.append(int(hint))
+            # planned rungs from the schedule plan's upcoming batches are
+            # certainties, not heuristics: warm them FIRST, in dispatch
+            # order, then fall through to the ladder guesses
+            planned: list[int] = []
+            for b in getattr(self, "_spec_upcoming", []) or []:
+                rung = (b.get("schedule") or {}).get("rung")
+                if rung and int(rung) in ladder and int(rung) != cap \
+                        and int(rung) not in planned:
+                    planned.append(int(rung))
+            targets = planned + [t for t in targets if t not in planned]
             if not targets:
                 return
             from tmlibrary_tpu import perf
@@ -1366,6 +1574,26 @@ class ImageAnalysisRunner(Step):
             (int(v.max(initial=0)) for v in counts.values()), default=0
         )
         self._note_peak(args, peak)
+        # per-site costs feed the work-model scheduler's EWMA through the
+        # same persist-side stream the peak rides; the densest object
+        # family is what sets a site's capacity rung
+        site_counts = None
+        if counts:
+            site_counts = np.maximum.reduce(
+                [np.asarray(v) for v in counts.values()]
+            )
+            self._note_site_costs(args, sites, site_counts)
+            shard_objects = self._shard_objects(args, site_counts)
+            if shard_objects is not None:
+                # actual per-shard work under the applied site order —
+                # the straggler-balance evidence a ledger alone can
+                # compare against predicted_shard_work (and against an
+                # unbalanced run of the same experiment)
+                summary["shard_objects"] = shard_objects
+        plan = batch.get("schedule") or {}
+        if plan.get("rung"):
+            summary["schedule_rung"] = int(plan["rung"])
+            self._note_schedule(escalations)
         total_objects = sum(summary["objects"].values())
         slots = len(counts) * n_valid * cap
         summary["bucket_capacity"] = cap
@@ -1612,6 +1840,20 @@ class ImageAnalysisRunner(Step):
         return done
 
     @property
+    def _schedule_plan_path(self):
+        return self.step_dir / "schedule_plan.json"
+
+    def schedule_plan_info(self) -> dict | None:
+        """The recorded packing plan's compact summary (the engine's
+        ``schedule_plan`` ledger event) — re-read from the side file so
+        a resume appends the SAME digest it recorded at init time, which
+        is the bit-identical-boundaries proof."""
+        from tmlibrary_tpu.workflow import schedule as schedule_mod
+
+        plan = schedule_mod.load_plan(self._schedule_plan_path)
+        return schedule_mod.plan_event(plan) if plan else None
+
+    @property
     def _cap_override_path(self):
         return self.step_dir / "cap_overrides.json"
 
@@ -1697,8 +1939,11 @@ class ImageAnalysisRunner(Step):
             if d.exists():
                 shutil.rmtree(d)
             d.mkdir()
-        # stale saturation signal and cap escalations belong to the
-        # deleted outputs (a fresh plan restarts from the init-time cap)
+        # stale saturation signal, cap escalations and the packing plan
+        # belong to the deleted outputs (a fresh plan restarts from the
+        # init-time cap; create_batches re-derives the schedule from the
+        # just-harvested history)
         self._saturation_path.unlink(missing_ok=True)
         self._saturation_path.with_suffix(".lock").unlink(missing_ok=True)
         self._cap_override_path.unlink(missing_ok=True)
+        self._schedule_plan_path.unlink(missing_ok=True)
